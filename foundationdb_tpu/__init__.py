@@ -1,0 +1,22 @@
+"""foundationdb_tpu: a from-scratch, TPU-native rebuild of FoundationDB 6.0.
+
+Layering mirrors the reference's strict layer map (see SURVEY.md section 1):
+
+  flow/      - deterministic actor runtime (ref: flow/)
+  rpc/       - typed endpoints + simulated/real transport (ref: fdbrpc/)
+  conflict/  - MVCC conflict-detection engines, the TPU north star
+               (ref: fdbserver/SkipList.cpp behind fdbserver/ConflictSet.h)
+  client/    - transaction API with read-your-writes (ref: fdbclient/)
+  server/    - cluster roles: master, proxy, resolver, tlog, storage
+               (ref: fdbserver/)
+  sim/       - deterministic cluster simulation + workloads
+               (ref: fdbrpc/sim2.actor.cpp, fdbserver/SimulatedCluster.actor.cpp)
+  parallel/  - multi-device (Mesh/shard_map) sharding of the data plane
+  ops/       - JAX/XLA kernel helpers (sorts, range-max, stabbing queries)
+
+The compute hot path (whole-batch conflict resolution) runs on TPU via JAX;
+the control plane is a deterministic single-threaded actor runtime, preserving
+the reference's simulation-first testing property.
+"""
+
+__version__ = "0.1.0"

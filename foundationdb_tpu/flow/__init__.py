@@ -1,0 +1,54 @@
+"""Deterministic actor runtime: the rebuild of the reference's flow/ layer.
+
+The reference implements actors via a C# source-to-source compiler
+(flow/actorcompiler/ActorCompiler.cs) generating C++ callback state machines.
+Python has native coroutines, so the actor compiler's job is done by
+async/await; this package supplies the rest of the runtime: a deterministic
+virtual-time event loop (ref: flow/Net2.actor.cpp run loop), futures
+(ref: flow/flow.h SAV/Future/Promise), a seeded RNG through which *all*
+simulation randomness flows (ref: flow/DeterministicRandom.h), structured
+trace events (ref: flow/Trace.h), the knobs registry (ref: flow/Knobs.h) and
+BUGGIFY fault-injection hooks (ref: flow/flow.h:50-67).
+"""
+
+from .error import FdbError, error_code, ActorCancelled
+from .rng import DeterministicRandom
+from .future import Future, Promise, PromiseStream, FutureStream
+from .eventloop import (
+    EventLoop,
+    Task,
+    TaskPriority,
+    g_network,
+    set_event_loop,
+    current_loop,
+)
+from .trace import TraceEvent, Severity, TraceCollector
+from .knobs import Knobs, FlowKnobs, ClientKnobs, ServerKnobs, g_knobs
+from .buggify import buggify, set_buggify_enabled
+
+__all__ = [
+    "FdbError",
+    "error_code",
+    "ActorCancelled",
+    "DeterministicRandom",
+    "Future",
+    "Promise",
+    "PromiseStream",
+    "FutureStream",
+    "EventLoop",
+    "Task",
+    "TaskPriority",
+    "g_network",
+    "set_event_loop",
+    "current_loop",
+    "TraceEvent",
+    "Severity",
+    "TraceCollector",
+    "Knobs",
+    "FlowKnobs",
+    "ClientKnobs",
+    "ServerKnobs",
+    "g_knobs",
+    "buggify",
+    "set_buggify_enabled",
+]

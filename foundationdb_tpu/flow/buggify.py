@@ -1,0 +1,43 @@
+"""BUGGIFY: randomized rare-path activation, simulation only.
+
+Ref: flow/flow.h:50-67.  Each BUGGIFY call site is independently "activated"
+with probability 0.25 the first time it is evaluated in a simulation run;
+an activated site then fires with probability 0.25 per evaluation.  Sites
+are keyed by an explicit name (the reference keys by __FILE__:__LINE__).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from .knobs import g_knobs
+from .rng import DeterministicRandom
+
+_enabled = False
+_rng: Optional[DeterministicRandom] = None
+_site_activated: dict[str, bool] = {}
+fired_sites: set[str] = set()
+
+
+def set_buggify_enabled(enabled: bool, rng: Optional[DeterministicRandom] = None):
+    global _enabled, _rng
+    _enabled = enabled
+    _rng = rng
+    _site_activated.clear()
+    fired_sites.clear()
+
+
+def buggify(site: str) -> bool:
+    """True randomly, only when buggification is on (i.e. in simulation)."""
+    if not _enabled or _rng is None:
+        return False
+    if site not in _site_activated:
+        _site_activated[site] = (
+            _rng.random01() < g_knobs.flow.buggify_activated_probability
+        )
+    if not _site_activated[site]:
+        return False
+    fired = _rng.random01() < g_knobs.flow.buggify_fired_probability
+    if fired:
+        fired_sites.add(site)
+    return fired

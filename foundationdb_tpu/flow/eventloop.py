@@ -1,0 +1,360 @@
+"""Deterministic virtual-time event loop: the rebuild of Net2's run loop.
+
+Ref: flow/Net2.actor.cpp:117 (Net2), flow/network.h:194 (INetwork), task
+priority bands flow/network.h:31-64.  The reference runs one cooperative
+thread per process; timers and ready tasks are ordered by (time, priority).
+In simulation (fdbrpc/sim2.actor.cpp) time is virtual and advances to the
+next event instantly; randomness flows through DeterministicRandom so runs
+are reproducible from the seed.
+
+This loop is simulation-first: time is always virtual.  A wall-clock-paced
+driver can wrap `run_one` and sleep to align virtual and real time; the role
+code is identical either way, preserving the reference's single most
+load-bearing design decision (same actors on Sim2 or Net2 — see SURVEY.md §1).
+
+Coroutines ("actors") are driven by Task.  `await future` suspends until the
+future is set; resumption goes through the loop's queue at a task priority,
+never synchronously, so event ordering is fully determined by (time,
+priority, insertion sequence).
+"""
+
+from __future__ import annotations
+
+import heapq
+from typing import Coroutine, Optional
+
+from .error import ActorCancelled
+from .future import Future, Promise
+from .rng import DeterministicRandom
+
+
+class TaskPriority:
+    """Numeric priority bands; higher runs first at equal time.
+
+    Values mirror flow/network.h:31-64 (TaskMaxPriority = 1000000 ...
+    TaskZeroPriority = 0); only the bands the rebuild uses are listed.
+    """
+
+    Max = 1000000
+    RunCycleFunction = 20000
+    FlushTrace = 10500
+    WriteSocket = 10000
+    PollEIO = 9900
+    DiskIOComplete = 9150
+    LoadBalancedEndpoint = 9000
+    ReadSocket = 9000
+    CoordinationReply = 8810
+    Coordination = 8800
+    FailureMonitor = 8700
+    ResolutionMetrics = 8700
+    ClusterController = 8650
+    ProxyCommitDispatcher = 8640
+    TLogQueuingMetrics = 8620
+    TLogPop = 8610
+    TLogPeekReply = 8600
+    TLogPeek = 8590
+    TLogCommitReply = 8580
+    TLogCommit = 8570
+    ProxyGetRawCommittedVersion = 8565
+    ProxyResolverReply = 8560
+    ProxyCommitBatcher = 8550
+    ProxyCommit = 8540
+    TLogConfirmRunningReply = 8530
+    TLogConfirmRunning = 8520
+    ProxyGetKeyServersLocations = 8515
+    ProxyGRVTimer = 8510
+    ProxyGetConsistentReadVersion = 8500
+    DefaultPromiseEndpoint = 8000
+    DefaultOnMainThread = 7500
+    DefaultDelay = 7010
+    DefaultYield = 7000
+    DiskRead = 5010
+    DefaultEndpoint = 5000
+    UnknownEndpoint = 4000
+    MoveKeys = 3550
+    DataDistributionLaunch = 3530
+    DataDistribution = 3500
+    DiskWrite = 3010
+    UpdateStorage = 3000
+    BatchCopy = 2900
+    Low = 2000
+    Min = 1000
+    Zero = 0
+
+
+class Task(Future):
+    """Drives a coroutine; the Task itself is a Future of the coroutine result.
+
+    Ref: the actor compiler's generated Actor<T> classes (flow/flow.h:910);
+    cancellation semantics follow flow: cancelling throws actor_cancelled
+    inside the actor at its current wait point, synchronously.
+    """
+
+    __slots__ = ("_coro", "_loop", "name", "_waiting_on", "_cancelled")
+
+    def __init__(self, loop: "EventLoop", coro: Coroutine, name: str = ""):
+        super().__init__()
+        self._coro = coro
+        self._loop = loop
+        self.name = name or getattr(coro, "__name__", "actor")
+        self._waiting_on: Optional[Future] = None
+        self._cancelled = False
+
+    def _step(self, value=None, error: Optional[BaseException] = None):
+        if self.is_ready():
+            return
+        self._waiting_on = None
+        try:
+            if error is not None:
+                awaited = self._coro.throw(error)
+            else:
+                awaited = self._coro.send(value)
+        except StopIteration as stop:
+            self._set(stop.value)
+            return
+        except BaseException as e:  # noqa: BLE001 - errors flow into the future
+            self._set_error(e)
+            return
+        # The coroutine yielded a Future it is waiting on.
+        assert isinstance(awaited, Future), (
+            f"actor {self.name} awaited a non-Future: {awaited!r}"
+        )
+        self._waiting_on = awaited
+        awaited.add_callback(self._on_ready)
+
+    def _on_ready(self, fut: Future):
+        prio = fut.priority if fut.priority is not None else TaskPriority.DefaultOnMainThread
+        if fut.is_error():
+            err = fut.error()
+            self._loop._schedule(prio, lambda: self._step(error=err))
+        else:
+            val = fut.get()
+            self._loop._schedule(prio, lambda: self._step(value=val))
+
+    def cancel(self):
+        """Throw actor_cancelled into the coroutine now (ref: actor cancel).
+
+        Cancellation is synchronous, as in flow (actor destruction runs the
+        unwind immediately).  Waits during cancellation never complete: if
+        cleanup code awaits (e.g. in a finally block), the await immediately
+        re-raises actor_cancelled until the coroutine exits.  A real error
+        raised during unwind propagates into the task's future.
+        """
+        if self.is_ready() or self._cancelled:
+            return
+        self._cancelled = True
+        if self._waiting_on is not None:
+            self._waiting_on.remove_callback(self._on_ready)
+            self._waiting_on = None
+        err: BaseException = ActorCancelled()
+        try:
+            for _ in range(1000):
+                self._coro.throw(ActorCancelled())
+            raise RuntimeError(f"actor {self.name} ignored cancellation")
+        except StopIteration:
+            pass
+        except ActorCancelled:
+            pass
+        except BaseException as e:  # noqa: BLE001 - surfaced via the future
+            err = e
+        if not self.is_ready():
+            self._set_error(err)
+
+
+class EventLoop:
+    """Single-threaded deterministic event loop with virtual time."""
+
+    def __init__(self, seed: int = 1):
+        self.rng = DeterministicRandom(seed)
+        self._now = 0.0
+        self._seq = 0
+        # Heap entries: (time, -priority, seq, fn)
+        self._heap: list = []
+        self._stopped = False
+        self.tasks_run = 0
+
+    # --- time ---
+    def now(self) -> float:
+        return self._now
+
+    # --- scheduling primitives ---
+    def _schedule(self, priority: int, fn, at: Optional[float] = None) -> list:
+        """Queue fn; returns a one-element cell usable to cancel the entry."""
+        self._seq += 1
+        t = self._now if at is None else at
+        cell = [fn]
+        heapq.heappush(self._heap, (t, -priority, self._seq, cell))
+        return cell
+
+    def delay(self, seconds: float, priority: int = TaskPriority.DefaultDelay) -> Future:
+        """Future that fires `seconds` of virtual time from now.
+
+        Ref: INetwork::delay flow/network.h; ordering at equal deadlines is by
+        priority then FIFO, matching Net2's timer/ready queues.
+        """
+        f = Future(priority)
+        cell = self._schedule(priority, lambda: f._set(None), at=self._now + max(0.0, seconds))
+        f.timer_cell = cell
+        return f
+
+    def cancel_timer(self, f: Future):
+        """Drop a pending delay()'s heap entry (it never fires)."""
+        cell = getattr(f, "timer_cell", None)
+        if cell is not None:
+            cell[0] = None
+
+    def yield_(self, priority: int = TaskPriority.DefaultYield) -> Future:
+        return self.delay(0.0, priority)
+
+    def spawn(self, coro: Coroutine, name: str = "", priority: int = TaskPriority.DefaultOnMainThread) -> Task:
+        task = Task(self, coro, name)
+        self._schedule(priority, task._step)
+        return task
+
+    # --- run loop ---
+    def run_one(self) -> bool:
+        """Run the next event, advancing virtual time. False if none left."""
+        while self._heap and not self._stopped:
+            t, _negprio, _seq, cell = heapq.heappop(self._heap)
+            fn = cell[0]
+            if fn is None:  # cancelled timer
+                continue
+            if t > self._now:
+                self._now = t
+            self.tasks_run += 1
+            fn()
+            return True
+        return False
+
+    def run_until(self, future: Future, timeout_vt: Optional[float] = None):
+        """Drive the loop until `future` is ready; returns its value."""
+        deadline = None if timeout_vt is None else self._now + timeout_vt
+        while not future.is_ready():
+            if deadline is not None and self._heap and self._heap[0][0] > deadline:
+                raise TimeoutError(
+                    f"virtual-time deadline {deadline} exceeded (now={self._now})"
+                )
+            if not self.run_one():
+                raise RuntimeError("event loop ran dry awaiting future")
+        return future.get()
+
+    def run(self, max_events: Optional[int] = None):
+        n = 0
+        while self.run_one():
+            n += 1
+            if max_events is not None and n >= max_events:
+                break
+
+    def stop(self):
+        self._stopped = True
+
+
+# --- global loop access (ref: g_network global) ---
+_current_loop: Optional[EventLoop] = None
+
+
+def set_event_loop(loop: Optional[EventLoop]):
+    global _current_loop
+    _current_loop = loop
+
+
+def current_loop() -> EventLoop:
+    assert _current_loop is not None, "no event loop set (call set_event_loop)"
+    return _current_loop
+
+
+def g_network() -> EventLoop:
+    return current_loop()
+
+
+# --- combinators (ref: genericactors.actor.h) ---
+def all_of(futures) -> Future:
+    """Future of all values; errors immediately on the first error, like the
+    reference's waitForAll (it does not wait out the other futures)."""
+    futures = list(futures)
+    out = Promise()
+    remaining = [len(futures)]
+    results = [None] * len(futures)
+    cbs = []
+
+    def unsubscribe():
+        for f, cb in zip(futures, cbs):
+            f.remove_callback(cb)
+
+    def make_cb(i):
+        def cb(f: Future):
+            if out.is_set():
+                return
+            if f.is_error():
+                out.send_error(f.error())
+                unsubscribe()
+                return
+            results[i] = f.get()
+            remaining[0] -= 1
+            if remaining[0] == 0:
+                out.send(results)
+
+        return cb
+
+    if not futures:
+        out.send([])
+        return out.future
+    for i, f in enumerate(futures):
+        cb = make_cb(i)
+        cbs.append(cb)
+        f.add_callback(cb)
+        if out.is_set():
+            break
+    return out.future
+
+
+async def wait_for_all(futures):
+    """Wait for every future; first error propagates (ref: waitForAll)."""
+    return await all_of(futures)
+
+
+def first_of(loop: EventLoop, *futures: Future) -> Future:
+    """Future of (index, value) for whichever input fires first (ref:
+    choose/when).  Losing futures are unsubscribed (not cancelled — the
+    caller may still hold them)."""
+    out = Promise()
+    cbs: list = []
+
+    def settle():
+        for f, cb in zip(futures, cbs):
+            f.remove_callback(cb)
+
+    def make_cb(i):
+        def cb(f: Future):
+            if out.is_set():
+                return
+            if f.is_error():
+                out.send_error(f.error())
+            else:
+                out.send((i, f.get()))
+            settle()
+
+        return cb
+
+    for i, f in enumerate(futures):
+        cb = make_cb(i)
+        cbs.append(cb)
+        f.add_callback(cb)
+        if out.is_set():
+            break
+    return out.future
+
+
+async def timeout_after(loop: EventLoop, fut: Future, seconds: float, default=None):
+    """Value of fut, or `default` if `seconds` of virtual time elapse first.
+
+    The internal timer is cancelled when fut wins, so repeated timeouts on
+    long waits don't accumulate dead heap entries; `fut` itself is only
+    unsubscribed on timeout (the caller may still hold it).
+    """
+    timer = loop.delay(seconds)
+    idx, val = await first_of(loop, fut, timer)
+    if idx == 0:
+        loop.cancel_timer(timer)
+        return val
+    return default

@@ -1,0 +1,186 @@
+"""Futures/promises: single-assignment variables with callback chains.
+
+Ref: flow/flow.h — SAV :347, Future :591, Promise :705, FutureStream :756,
+PromiseStream :833.  The reference's futures are single-threaded and fire
+callbacks synchronously when set; ours do the same (no thread safety needed:
+one event loop thread, like the reference's one-network-thread rule).
+
+A Future here is awaitable from coroutines driven by the EventLoop.  Unlike
+asyncio futures, set() delivers *synchronously* to plain callbacks, while
+awaiting coroutines are resumed via the loop's ready queue at a task priority,
+mirroring how flow delivers to actor callbacks through task priorities.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Generator, Optional
+
+from .error import ActorCancelled, FdbError
+
+_PENDING = 0
+_VALUE = 1
+_ERROR = 2
+
+
+class Future:
+    __slots__ = ("_state", "_result", "_callbacks", "priority", "timer_cell")
+
+    def __init__(self, priority: Optional[int] = None):
+        self._state = _PENDING
+        self._result: Any = None
+        self._callbacks: list[Callable[["Future"], None]] = []
+        # Priority at which awaiting coroutines resume; None = inherit.
+        self.priority = priority
+        # Set by EventLoop.delay so pending timers can be cancelled.
+        self.timer_cell = None
+
+    # -- inspection --
+    def is_ready(self) -> bool:
+        return self._state != _PENDING
+
+    def is_error(self) -> bool:
+        return self._state == _ERROR
+
+    def get(self):
+        """Value if ready, raising if error; ref Future::get()."""
+        if self._state == _VALUE:
+            return self._result
+        if self._state == _ERROR:
+            raise self._result
+        raise FdbError("future_version")  # get() on not-ready is a logic error
+
+    def error(self) -> Optional[BaseException]:
+        return self._result if self._state == _ERROR else None
+
+    # -- assignment (normally via Promise) --
+    def _set(self, value):
+        assert self._state == _PENDING, "Future already set"
+        self._state = _VALUE
+        self._result = value
+        self._fire()
+
+    def _set_error(self, err: BaseException):
+        assert self._state == _PENDING, "Future already set"
+        self._state = _ERROR
+        self._result = err
+        self._fire()
+
+    def _fire(self):
+        cbs, self._callbacks = self._callbacks, []
+        for cb in cbs:
+            cb(self)
+
+    def add_callback(self, cb: Callable[["Future"], None]):
+        if self._state != _PENDING:
+            cb(self)
+        else:
+            self._callbacks.append(cb)
+
+    def remove_callback(self, cb):
+        try:
+            self._callbacks.remove(cb)
+        except ValueError:
+            pass
+
+    # -- awaitable protocol --
+    def __await__(self) -> Generator["Future", None, Any]:
+        if self._state == _PENDING:
+            yield self  # Task.step picks this up and subscribes
+        return self.get()
+
+
+class Promise:
+    """Write side of a Future; ref flow/flow.h:705."""
+
+    __slots__ = ("future",)
+
+    def __init__(self, priority: Optional[int] = None):
+        self.future = Future(priority)
+
+    def send(self, value=None):
+        self.future._set(value)
+
+    def send_error(self, err: BaseException):
+        self.future._set_error(err)
+
+    def is_set(self) -> bool:
+        return self.future.is_ready()
+
+    def __repr__(self):
+        return f"Promise(ready={self.future.is_ready()})"
+
+
+def ready_future(value=None) -> Future:
+    f = Future()
+    f._set(value)
+    return f
+
+
+def error_future(err: BaseException) -> Future:
+    f = Future()
+    f._set_error(err)
+    return f
+
+
+class FutureStream:
+    """Read side of a PromiseStream; ref flow/flow.h:756.
+
+    pop() returns a Future for the next element.  Elements are queued; an
+    error (e.g. end_of_stream) is delivered after all queued values.
+    """
+
+    __slots__ = ("_queue", "_waiters", "_error")
+
+    def __init__(self):
+        self._queue: list = []
+        self._waiters: list[Promise] = []
+        self._error: Optional[BaseException] = None
+
+    def pop(self) -> Future:
+        if self._queue:
+            return ready_future(self._queue.pop(0))
+        if self._error is not None:
+            return error_future(self._error)
+        p = Promise()
+        self._waiters.append(p)
+        return p.future
+
+    def is_ready(self) -> bool:
+        return bool(self._queue) or self._error is not None
+
+    def _push(self, value):
+        if self._waiters:
+            self._waiters.pop(0).send(value)
+        else:
+            self._queue.append(value)
+
+    def _push_error(self, err: BaseException):
+        self._error = err
+        waiters, self._waiters = self._waiters, []
+        for w in waiters:
+            w.send_error(err)
+
+
+class PromiseStream:
+    """Write side: send() any number of values; ref flow/flow.h:833."""
+
+    __slots__ = ("_stream",)
+
+    def __init__(self):
+        self._stream = FutureStream()
+
+    @property
+    def future_stream(self) -> FutureStream:
+        return self._stream
+
+    def send(self, value=None):
+        self._stream._push(value)
+
+    def send_error(self, err: BaseException):
+        self._stream._push_error(err)
+
+    def pop(self) -> Future:
+        return self._stream.pop()
+
+    def is_ready(self) -> bool:
+        return self._stream.is_ready()

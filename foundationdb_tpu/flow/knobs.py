@@ -1,0 +1,99 @@
+"""Knobs: flat name -> typed config registry; ref flow/Knobs.h:31.
+
+The reference registers ~433 knobs across FlowKnobs (flow/Knobs.cpp),
+ClientKnobs (fdbclient/Knobs.cpp) and ServerKnobs (fdbserver/Knobs.cpp),
+overridable via --knob_name=value.  We keep the three-class split and the
+string-keyed override API; only knobs the rebuild actually consults are
+declared (grown as subsystems land).
+"""
+
+from __future__ import annotations
+
+
+class Knobs:
+    """Attribute-style knobs with string override (set_knob("name", "1.5"))."""
+
+    def __init__(self):
+        self._names: dict[str, type] = {}
+
+    def _init(self, name: str, default):
+        setattr(self, name, default)
+        self._names[name.lower()] = type(default)
+
+    def set_knob(self, name: str, value: str):
+        key = name.lower()
+        if key not in self._names:
+            raise KeyError(f"unknown knob {name}")
+        ty = self._names[key]
+        if ty is bool:
+            parsed = value.lower() in ("1", "true", "yes")
+        else:
+            parsed = ty(value)
+        setattr(self, key, parsed)
+
+    def all(self) -> dict:
+        return {k: getattr(self, k) for k in self._names}
+
+
+class FlowKnobs(Knobs):
+    def __init__(self):
+        super().__init__()
+        # ref flow/Knobs.cpp — delays and buggification
+        self._init("min_delay_cpu_effects", 0.001)
+        self._init("max_buggified_delay", 0.2)
+        self._init("buggify_activated_probability", 0.25)
+        self._init("buggify_fired_probability", 0.25)
+        self._init("slowtask_profiling_interval", 0.125)
+
+
+class ClientKnobs(Knobs):
+    def __init__(self):
+        super().__init__()
+        # ref fdbclient/Knobs.cpp
+        self._init("default_transaction_timeout", 0.0)  # unlimited, like ref
+        self._init("max_retry_delay", 1.0)
+        self._init("initial_retry_delay", 0.01)
+        self._init("grv_batch_interval", 0.005)  # MAX_BATCH_INTERVAL
+        self._init("grv_max_batch_size", 1024)
+        self._init("location_cache_size", 300000)
+        self._init("key_size_limit", 10000)
+        self._init("value_size_limit", 100000)
+        self._init("transaction_size_limit", 10 * 1024 * 1024)
+
+
+class ServerKnobs(Knobs):
+    def __init__(self):
+        super().__init__()
+        # ref fdbserver/Knobs.cpp
+        self._init("commit_transaction_batch_interval", 0.002)
+        self._init("commit_transaction_batch_count_max", 32768)
+        self._init("max_write_transaction_life_versions", 5_000_000)
+        self._init("versions_per_second", 1_000_000)
+        self._init("max_versions_in_flight", 100_000_000)
+        self._init("storage_durability_lag", 0.05)
+        self._init("tlog_spill_threshold", 1 << 30)
+        self._init("resolver_state_memory_limit", 1 << 30)
+        # TPU conflict engine knobs (new to the rebuild)
+        self._init("conflict_device_min_batch", 256)  # below: CPU fallback
+        self._init("conflict_device_key_words", 4)  # uint32 words per key
+        self._init("conflict_max_device_key_bytes", 16)  # > this: CPU fallback
+        self._init("conflict_history_capacity", 1 << 20)
+
+
+class KnobSet:
+    def __init__(self):
+        self.flow = FlowKnobs()
+        self.client = ClientKnobs()
+        self.server = ServerKnobs()
+
+    def set_knob(self, name: str, value: str):
+        for k in (self.flow, self.client, self.server):
+            try:
+                k.set_knob(name, value)
+                return
+            except KeyError:
+                continue
+        raise KeyError(f"unknown knob {name}")
+
+
+g_knobs = KnobSet()

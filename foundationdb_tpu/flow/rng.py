@@ -1,0 +1,96 @@
+"""DeterministicRandom: the single seeded RNG all simulation randomness uses.
+
+Ref: flow/DeterministicRandom.h:30 (random01 :47, randomInt :53,
+randomUniqueID, randomAlphaNumeric).  The reference routes *every* random
+decision in simulation through g_random so runs are bit-reproducible from the
+seed; we keep that property.  Each EventLoop owns one DeterministicRandom;
+code must never use the global `random` module or wall-clock entropy in sim.
+"""
+
+from __future__ import annotations
+
+import math
+import random as _pyrandom
+
+
+class UID:
+    """128-bit unique id, as in flow/IRandom.h's UID."""
+
+    __slots__ = ("first", "second")
+
+    def __init__(self, first: int, second: int):
+        self.first = first & 0xFFFFFFFFFFFFFFFF
+        self.second = second & 0xFFFFFFFFFFFFFFFF
+
+    def __repr__(self):
+        return f"{self.first:016x}{self.second:016x}"
+
+    def short_string(self):
+        return f"{self.first:016x}"[:8]
+
+    def __eq__(self, other):
+        return (
+            isinstance(other, UID)
+            and self.first == other.first
+            and self.second == other.second
+        )
+
+    def __hash__(self):
+        return hash((self.first, self.second))
+
+    def __lt__(self, other):
+        return (self.first, self.second) < (other.first, other.second)
+
+
+class DeterministicRandom:
+    __slots__ = ("_r", "seed")
+
+    def __init__(self, seed: int):
+        self.seed = seed
+        self._r = _pyrandom.Random(seed)
+
+    # --- core API (mirrors flow/IRandom.h) ---
+    def random01(self) -> float:
+        return self._r.random()
+
+    def random_int(self, lo: int, hi: int) -> int:
+        """Uniform integer in [lo, hi). Matches randomInt's half-open range."""
+        if hi <= lo:
+            raise ValueError(f"random_int empty range [{lo},{hi})")
+        return self._r.randrange(lo, hi)
+
+    def random_int64(self, lo: int, hi: int) -> int:
+        return self._r.randrange(lo, hi)
+
+    def random_unique_id(self) -> UID:
+        return UID(self._r.getrandbits(64), self._r.getrandbits(64))
+
+    def random_alpha_numeric(self, length: int) -> str:
+        chars = "abcdefghijklmnopqrstuvwxyz0123456789"
+        return "".join(chars[self._r.randrange(0, 36)] for _ in range(length))
+
+    def random_bytes(self, length: int) -> bytes:
+        return bytes(self._r.getrandbits(8) for _ in range(length))
+
+    def random_choice(self, seq):
+        return seq[self._r.randrange(0, len(seq))]
+
+    def random_shuffle(self, seq: list) -> None:
+        self._r.shuffle(seq)
+
+    def random_exp(self, mean: float) -> float:
+        """Exponentially distributed, used for simulated latencies."""
+        return -math.log(1.0 - self._r.random()) * mean
+
+    def random_skewed_uint32(self, lo: int, hi: int) -> int:
+        """Log-uniform in [lo, hi), as DeterministicRandom::randomSkewedUInt32."""
+        lmin = math.log2(max(lo, 1))
+        lmax = math.log2(hi)
+        return min(hi - 1, max(lo, int(2 ** (lmin + self._r.random() * (lmax - lmin)))))
+
+    def coinflip(self) -> bool:
+        return self._r.random() < 0.5
+
+    def split(self) -> "DeterministicRandom":
+        """Derive an independent deterministic child stream."""
+        return DeterministicRandom(self._r.getrandbits(63))

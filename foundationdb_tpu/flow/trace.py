@@ -1,0 +1,114 @@
+"""Structured trace events; ref flow/Trace.h:101 (TraceEvent builder).
+
+The reference writes rolled XML trace files per process with severity,
+throttling, and a builder API: TraceEvent("Name").detail("K", v).  We keep
+the builder shape and collect events into an in-memory collector (optionally
+spooling to JSON-lines files), which the simulator and tests can query.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from typing import Any, Optional
+
+
+class Severity:
+    Debug = 5
+    Info = 10
+    Warn = 20
+    WarnAlways = 30
+    Error = 40
+
+
+class TraceCollector:
+    """Destination for trace events (per process or global)."""
+
+    def __init__(self, path: Optional[str] = None, min_severity: int = Severity.Info):
+        self.events: list[dict] = []
+        self.path = path
+        self.min_severity = min_severity
+        self._fh = open(path, "a") if path else None
+        self.counts: dict[str, int] = {}
+
+    def emit(self, event: dict):
+        if event["Severity"] < self.min_severity:
+            return
+        self.counts[event["Type"]] = self.counts.get(event["Type"], 0) + 1
+        self.events.append(event)
+        if self._fh:
+            self._fh.write(json.dumps(event) + "\n")
+
+    def find(self, type_: str) -> list[dict]:
+        return [e for e in self.events if e["Type"] == type_]
+
+    def clear(self):
+        self.events.clear()
+        self.counts.clear()
+
+    def close(self):
+        if self._fh:
+            self._fh.close()
+            self._fh = None
+
+
+_global_collector = TraceCollector()
+
+
+def set_global_collector(c: TraceCollector):
+    global _global_collector
+    _global_collector = c
+
+
+def global_collector() -> TraceCollector:
+    return _global_collector
+
+
+class TraceEvent:
+    """Builder: TraceEvent("Name").detail("Key", value) — emits on context exit
+    or explicitly via log(); auto-emits when garbage collected is NOT relied
+    upon (unlike the reference's destructor emit) — call .log() or use `with`.
+    """
+
+    __slots__ = ("type", "severity", "fields", "_collector", "_emitted")
+
+    def __init__(self, type_: str, severity: int = Severity.Info, collector: Optional[TraceCollector] = None):
+        self.type = type_
+        self.severity = severity
+        self.fields: dict[str, Any] = {}
+        self._collector = collector or _global_collector
+        self._emitted = False
+
+    def detail(self, key: str, value) -> "TraceEvent":
+        self.fields[key] = value
+        return self
+
+    def error(self, err: BaseException) -> "TraceEvent":
+        self.fields["Error"] = str(err)
+        if self.severity < Severity.Error:
+            self.severity = Severity.Error
+        return self
+
+    def log(self, now: Optional[float] = None):
+        if self._emitted:
+            return
+        self._emitted = True
+        if now is None:
+            # Virtual time when a loop is running — wall clock would break
+            # same-seed trace reproducibility (SURVEY.md §5 determinism).
+            from .eventloop import _current_loop
+
+            now = _current_loop.now() if _current_loop is not None else time.time()
+        ev = {"Type": self.type, "Severity": self.severity, "Time": now}
+        ev.update(self.fields)
+        self._collector.emit(ev)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is not None and "Error" not in self.fields:
+            self.fields["Error"] = str(exc)
+            self.severity = max(self.severity, Severity.Error)
+        self.log()
+        return False

@@ -1,0 +1,180 @@
+"""Flow runtime tests: determinism, futures, cancellation, priorities.
+
+Mirrors the reference's flow-primitive self-tests (fdbrpc/dsltest.actor.cpp):
+future/promise semantics, actor cancellation, delay ordering — plus the
+bit-reproducibility property sim runs rely on (SURVEY.md §4.8).
+"""
+
+import pytest
+
+from foundationdb_tpu.flow import (
+    ActorCancelled,
+    DeterministicRandom,
+    EventLoop,
+    FdbError,
+    Promise,
+    PromiseStream,
+    TaskPriority,
+    buggify,
+    set_buggify_enabled,
+)
+from foundationdb_tpu.flow.eventloop import first_of, timeout_after, wait_for_all
+from foundationdb_tpu.flow.future import error_future, ready_future
+
+
+def test_promise_future_basics():
+    p = Promise()
+    assert not p.future.is_ready()
+    p.send(42)
+    assert p.future.is_ready() and p.future.get() == 42
+
+    e = Promise()
+    e.send_error(FdbError("not_committed"))
+    assert e.future.is_error()
+    with pytest.raises(FdbError):
+        e.future.get()
+
+
+def test_actor_await_and_result():
+    loop = EventLoop(seed=7)
+
+    async def child(x):
+        await loop.delay(0.5)
+        return x * 2
+
+    async def parent():
+        a = loop.spawn(child(3))
+        b = loop.spawn(child(4))
+        return await a + await b
+
+    t = loop.spawn(parent())
+    assert loop.run_until(t) == 14
+    assert loop.now() == pytest.approx(0.5)
+
+
+def test_delay_ordering_by_time_then_priority():
+    loop = EventLoop(seed=1)
+    order = []
+
+    async def waiter(tag, dt, prio):
+        await loop.delay(dt, prio)
+        order.append(tag)
+
+    loop.spawn(waiter("late", 2.0, TaskPriority.Max))
+    loop.spawn(waiter("early_low", 1.0, TaskPriority.Low))
+    loop.spawn(waiter("early_high", 1.0, TaskPriority.Max))
+    loop.run()
+    assert order == ["early_high", "early_low", "late"]
+
+
+def test_cancellation_propagates():
+    loop = EventLoop(seed=1)
+    cleaned = []
+
+    async def forever():
+        try:
+            await loop.delay(1e9)
+        except ActorCancelled:
+            cleaned.append("cancelled")
+            raise
+
+    t = loop.spawn(forever())
+    loop.run(max_events=1)
+    t.cancel()
+    assert cleaned == ["cancelled"]
+    assert t.is_error()
+
+
+def test_promise_stream_fifo_and_end():
+    loop = EventLoop(seed=1)
+    ps = PromiseStream()
+    got = []
+
+    async def consumer():
+        while True:
+            try:
+                got.append(await ps.pop())
+            except FdbError as e:
+                assert e.name == "end_of_stream"
+                return "done"
+
+    t = loop.spawn(consumer())
+    for i in range(3):
+        ps.send(i)
+    ps.send_error(FdbError("end_of_stream"))
+    assert loop.run_until(t) == "done"
+    assert got == [0, 1, 2]
+
+
+def test_first_of_and_timeout():
+    loop = EventLoop(seed=1)
+
+    async def main():
+        idx, val = await first_of(loop, loop.delay(5.0), loop.delay(1.0))
+        assert idx == 1
+        v = await timeout_after(loop, loop.delay(100.0), 2.0, default="timed_out")
+        assert v == "timed_out"
+        v2 = await timeout_after(loop, ready_future("fast"), 2.0)
+        assert v2 == "fast"
+        return "ok"
+
+    assert loop.run_until(loop.spawn(main())) == "ok"
+
+
+def test_wait_for_all_error_propagates():
+    loop = EventLoop(seed=1)
+
+    async def main():
+        with pytest.raises(FdbError):
+            await wait_for_all([ready_future(1), error_future(FdbError("io_error"))])
+        return True
+
+    assert loop.run_until(loop.spawn(main()))
+
+
+def _sim_trace(seed):
+    """A small chaotic actor soup; returns the event interleaving."""
+    loop = EventLoop(seed=seed)
+    log = []
+
+    async def actor(name):
+        for _ in range(5):
+            await loop.delay(loop.rng.random01(), priority=loop.rng.random_int(1, 10000))
+            log.append((name, round(loop.now(), 9)))
+            if loop.rng.coinflip():
+                loop.spawn(subactor(name))
+
+    async def subactor(parent):
+        await loop.delay(loop.rng.random01() * 0.1)
+        log.append((parent + "/sub", round(loop.now(), 9)))
+
+    for i in range(4):
+        loop.spawn(actor(f"a{i}"))
+    loop.run()
+    return log
+
+
+def test_deterministic_reproducibility():
+    assert _sim_trace(12345) == _sim_trace(12345)
+    assert _sim_trace(12345) != _sim_trace(54321)
+
+
+def test_deterministic_random_stability():
+    r1 = DeterministicRandom(99)
+    r2 = DeterministicRandom(99)
+    seq1 = [r1.random_int(0, 1000) for _ in range(100)] + [r1.random01()]
+    seq2 = [r2.random_int(0, 1000) for _ in range(100)] + [r2.random01()]
+    assert seq1 == seq2
+    assert r1.random_unique_id() == r2.random_unique_id()
+
+
+def test_buggify_gated_and_deterministic():
+    set_buggify_enabled(False)
+    assert not any(buggify("site_a") for _ in range(100))
+
+    set_buggify_enabled(True, DeterministicRandom(5))
+    fires1 = [buggify("site_a") for _ in range(100)]
+    set_buggify_enabled(True, DeterministicRandom(5))
+    fires2 = [buggify("site_a") for _ in range(100)]
+    assert fires1 == fires2
+    set_buggify_enabled(False)
