@@ -1,0 +1,43 @@
+"""MVCC conflict detection — the TPU north star of the rebuild.
+
+The reference Resolver decides, for every transaction in a
+ResolveTransactionBatchRequest, whether its reads conflict with writes
+committed after its read snapshot (ref: fdbserver/Resolver.actor.cpp:71
+resolveBatch; engine behind the narrow ABI fdbserver/ConflictSet.h, CPU
+implementation fdbserver/SkipList.cpp).
+
+Semantics implemented identically by every backend here (see engine docs):
+  - history: a step function key -> last-committed-write version; a read
+    [b, e) at snapshot v conflicts iff max over the half-open range is > v
+  - too old: read_snapshot < oldestVersion and the txn has read ranges
+  - intra-batch: txns in batch order; reads checked against writes of
+    earlier non-conflicted txns (half-open interval intersection); writes
+    of conflicted txns are never visible
+  - merge: committed txns' write ranges set the step function to `now`
+  - eviction: boundary i is dropped iff vers[i] < oldest and vers[i-1] < oldest
+    (exact for all queries with snapshot >= oldestVersion)
+
+Backends:
+  oracle     - brute force, obviously correct, test-only
+  engine_cpu - bisect/step-function host engine (production small-batch path)
+  engine_jax - whole-batch vectorized engine for TPU (production large-batch
+               path), differentially tested against the others
+"""
+
+from .types import (
+    CONFLICT,
+    TOO_OLD,
+    COMMITTED,
+    TransactionConflictInfo,
+    result_name,
+)
+from .api import ConflictSet
+
+__all__ = [
+    "CONFLICT",
+    "TOO_OLD",
+    "COMMITTED",
+    "TransactionConflictInfo",
+    "result_name",
+    "ConflictSet",
+]

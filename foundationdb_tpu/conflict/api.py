@@ -1,0 +1,130 @@
+"""ConflictSet: the unified conflict-engine ABI, mirroring
+fdbserver/ConflictSet.h (newConflictSet/ConflictBatch::addTransaction/
+detectConflicts) with backend dispatch.
+
+Backends:
+  "cpu"    - engine_cpu.CpuConflictSet (host, exact, low latency)
+  "jax"    - engine_jax.JaxConflictSet (device, whole-batch vectorized)
+  "oracle" - oracle.OracleConflictSet (test-only brute force)
+  "hybrid" - jax for large batches, cpu for small ones / oversized keys,
+             with state kept authoritative on whichever side last ran
+             (the async-offload + fallback design from BASELINE.json)
+
+Usage mirrors the reference ABI:
+    cs = ConflictSet(backend="hybrid")
+    batch = cs.new_batch()
+    for tr in txns: batch.add_transaction(tr)
+    statuses = batch.detect_conflicts(now, new_oldest_version)
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..flow.knobs import g_knobs
+from .engine_cpu import CpuConflictSet
+from .oracle import OracleConflictSet
+from .types import TransactionConflictInfo
+
+
+class ConflictBatch:
+    """Ref: ConflictBatch in fdbserver/ConflictSet.h:32."""
+
+    def __init__(self, cs: "ConflictSet"):
+        self._cs = cs
+        self._txns: list[TransactionConflictInfo] = []
+
+    def add_transaction(self, tr: TransactionConflictInfo):
+        self._txns.append(tr)
+
+    @property
+    def transaction_count(self) -> int:
+        return len(self._txns)
+
+    def detect_conflicts(self, now: int, new_oldest_version: int) -> List[int]:
+        return self._cs._detect(self._txns, now, new_oldest_version)
+
+
+class ConflictSet:
+    def __init__(
+        self,
+        backend: str = "cpu",
+        oldest_version: int = 0,
+        key_words: Optional[int] = None,
+        device=None,
+    ):
+        self.backend = backend
+        self._cpu: Optional[CpuConflictSet] = None
+        self._jax = None
+        self._oracle: Optional[OracleConflictSet] = None
+        kw = key_words if key_words is not None else g_knobs.server.conflict_device_key_words
+        if backend in ("cpu", "hybrid"):
+            self._cpu = CpuConflictSet(oldest_version)
+        if backend == "oracle":
+            self._oracle = OracleConflictSet(oldest_version)
+        if backend in ("jax", "hybrid"):
+            from .engine_jax import JaxConflictSet  # lazy: jax import is heavy
+
+            self._jax = JaxConflictSet(
+                oldest_version=oldest_version, key_words=kw, device=device
+            )
+        # hybrid: which side holds the authoritative history
+        self._authority = "cpu" if backend == "hybrid" else backend
+        self._key_words = kw
+        # True once a long-key write range may have entered CPU history;
+        # the device cannot represent it, so authority stays on CPU.
+        self._history_long_keys = False
+
+    def new_batch(self) -> ConflictBatch:
+        return ConflictBatch(self)
+
+    @property
+    def oldest_version(self) -> int:
+        eng = self._engine_for_authority()
+        return eng.oldest_version
+
+    def _engine_for_authority(self):
+        return {"cpu": self._cpu, "jax": self._jax, "oracle": self._oracle}[
+            self._authority
+        ]
+
+    def _detect(self, txns, now, new_oldest_version) -> List[int]:
+        if self.backend == "hybrid":
+            return self._detect_hybrid(txns, now, new_oldest_version)
+        return self._engine_for_authority().detect(txns, now, new_oldest_version)
+
+    def _detect_hybrid(self, txns, now, new_oldest_version) -> List[int]:
+        srv = g_knobs.server
+        max_key = min(srv.conflict_max_device_key_bytes, self._key_words * 4)
+        big = len(txns) >= srv.conflict_device_min_batch
+        batch_fits = all(
+            len(b) <= max_key and len(e) <= max_key
+            for tr in txns
+            for (b, e) in tr.read_ranges + tr.write_ranges
+        )
+        if not batch_fits and any(
+            len(b) > max_key or len(e) > max_key
+            for tr in txns
+            for (b, e) in tr.write_ranges
+        ):
+            # A long-key write may enter history; until the window flushes it
+            # the device state cannot represent the step function exactly.
+            # Conservative: pin authority to CPU until clear().
+            self._history_long_keys = True
+        if big and batch_fits and not self._history_long_keys:
+            if self._authority == "cpu":
+                self._jax.load_from(self._cpu)
+                self._authority = "jax"
+            return self._jax.detect(txns, now, new_oldest_version)
+        if self._authority == "jax":
+            self._jax.store_to(self._cpu)
+            self._authority = "cpu"
+        return self._cpu.detect(txns, now, new_oldest_version)
+
+    def clear(self, version: int):
+        for eng in (self._cpu, self._jax, self._oracle):
+            if eng is not None:
+                eng.clear(version)
+        if self.backend == "hybrid":
+            self._authority = "cpu"
+        self._history_long_keys = False

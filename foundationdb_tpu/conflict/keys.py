@@ -1,0 +1,82 @@
+"""Key digitization: byte-string keys -> fixed-width uint32 word vectors.
+
+A key of <= 4*KW bytes becomes KW big-endian uint32 words (zero padded) plus
+a length word; lexicographic order on (words..., length) equals bytewise
+order on the original keys (zero-padded prefixes compare equal on words, and
+the genuinely shorter key sorts first via the length word — matching e.g.
+b"a" < b"a\\x00").  Keys longer than 4*KW bytes cannot be represented
+exactly; the hybrid ConflictSet routes batches containing them to the CPU
+engine (SURVEY.md §7 hard-parts list: fixed-width digitization + fallback).
+
+Word layout note: comparisons treat index 0 as most significant (see
+ops.rangequery.lex_less iterating from the LAST axis backward => we store
+words most-significant-last to match).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as np
+
+# Sentinel "plus infinity" key (greater than any real key: real length word
+# is < 2**31 and the sentinel is the max uint32).
+INF_WORD = np.uint32(0xFFFFFFFF)
+
+
+def encode_keys(keys: Sequence[bytes], key_words: int) -> np.ndarray:
+    """[N, key_words+1] uint32; words most-significant-LAST, length last."""
+    width = key_words * 4
+    n = len(keys)
+    out = np.zeros((n, key_words + 1), dtype=np.uint32)
+    if n == 0:
+        return out
+    if any(len(k) > width for k in keys):
+        raise ValueError(
+            f"key longer than {width} bytes cannot be digitized at "
+            f"key_words={key_words}; route to the CPU engine"
+        )
+    joined = b"".join(k.ljust(width, b"\x00") for k in keys)
+    words = np.frombuffer(joined, dtype=">u4").reshape(n, key_words).astype(np.uint32)
+    # reverse so index 0 is least significant (lex_less scans last-to-first)
+    out[:, :key_words] = words[:, ::-1]
+    out[:, key_words] = np.fromiter((len(k) for k in keys), np.uint32, count=n)
+    return out
+
+
+def encode_int_keys(ints: np.ndarray, key_words: int, byte_len: int = 8) -> np.ndarray:
+    """Fast path for integer-derived keys (big-endian byte_len-byte keys).
+
+    Equivalent to encode_keys([i.to_bytes(byte_len, 'big') for i in ints]).
+    Used by the bench (the reference microbench uses int keys,
+    SkipList.cpp:1440) and by any layer storing pre-packed keys.
+    """
+    assert byte_len <= 8 and byte_len <= key_words * 4
+    n = len(ints)
+    out = np.zeros((n, key_words + 1), dtype=np.uint32)
+    v = ints.astype(np.uint64)
+    shifted = v << np.uint64(8 * (8 - byte_len))  # left-align in 8 bytes
+    hi = (shifted >> np.uint64(32)).astype(np.uint32)
+    lo = (shifted & np.uint64(0xFFFFFFFF)).astype(np.uint32)
+    out[:, key_words - 1] = hi
+    if key_words >= 2:
+        out[:, key_words - 2] = lo
+    out[:, key_words] = byte_len
+    return out
+
+
+def decode_key(row: np.ndarray, key_words: int) -> bytes:
+    length = int(row[key_words])
+    if length == int(INF_WORD):
+        return b"\xff" * (key_words * 4 + 1)  # sentinel, cannot round-trip
+    words = row[:key_words][::-1].astype(">u4")
+    return words.tobytes()[:length]
+
+
+def max_sentinel(key_words: int) -> np.ndarray:
+    return np.full((key_words + 1,), INF_WORD, dtype=np.uint32)
+
+
+def fits(keys: List[bytes], key_words: int) -> bool:
+    width = key_words * 4
+    return all(len(k) <= width for k in keys)

@@ -1,0 +1,63 @@
+"""Brute-force conflict oracle: obviously correct, O(batch * history).
+
+Test-only differential baseline for the production engines.  Implements the
+reference semantics (fdbserver/SkipList.cpp ConflictBatch) by direct
+simulation: history is a flat list of committed write ranges with versions.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from .types import CONFLICT, COMMITTED, TOO_OLD, TransactionConflictInfo, intersects
+
+
+class OracleConflictSet:
+    def __init__(self, oldest_version: int = 0):
+        self.oldest_version = oldest_version
+        # (begin, end, version) of every committed write still in the window
+        self.history: list[tuple[bytes, bytes, int]] = []
+
+    def detect(
+        self,
+        transactions: List[TransactionConflictInfo],
+        now: int,
+        new_oldest_version: int,
+    ) -> List[int]:
+        statuses: list[int] = []
+        # Writes of in-batch committed txns, visible to later txns only.
+        batch_writes: list[tuple[bytes, bytes]] = []
+        for tr in transactions:
+            # ref SkipList.cpp:985 addTransaction: tooOld needs read ranges
+            if tr.read_snapshot < self.oldest_version and tr.read_ranges:
+                statuses.append(TOO_OLD)
+                continue
+            conflict = False
+            for r in tr.read_ranges:
+                for (b, e, v) in self.history:
+                    if v > tr.read_snapshot and intersects(r, (b, e)):
+                        conflict = True
+                        break
+                if conflict:
+                    break
+            if not conflict:
+                for r in tr.read_ranges:
+                    if any(intersects(r, w) for w in batch_writes):
+                        conflict = True
+                        break
+            if conflict:
+                statuses.append(CONFLICT)
+            else:
+                statuses.append(COMMITTED)
+                batch_writes.extend(tr.write_ranges)
+        self.history.extend((b, e, now) for (b, e) in batch_writes)
+        if new_oldest_version > self.oldest_version:
+            self.oldest_version = new_oldest_version
+            # Exact for queries with snapshot >= oldest: conflicts need v > snapshot
+            self.history = [h for h in self.history if h[2] >= self.oldest_version]
+        return statuses
+
+    def clear(self, version: int):
+        """Ref ConflictSet.h clearConflictSet."""
+        self.history.clear()
+        self.oldest_version = version

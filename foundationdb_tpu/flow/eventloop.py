@@ -313,7 +313,7 @@ async def wait_for_all(futures):
     return await all_of(futures)
 
 
-def first_of(loop: EventLoop, *futures: Future) -> Future:
+def first_of(*futures: Future) -> Future:
     """Future of (index, value) for whichever input fires first (ref:
     choose/when).  Losing futures are unsubscribed (not cancelled — the
     caller may still hold them)."""
@@ -348,13 +348,16 @@ def first_of(loop: EventLoop, *futures: Future) -> Future:
 async def timeout_after(loop: EventLoop, fut: Future, seconds: float, default=None):
     """Value of fut, or `default` if `seconds` of virtual time elapse first.
 
-    The internal timer is cancelled when fut wins, so repeated timeouts on
-    long waits don't accumulate dead heap entries; `fut` itself is only
-    unsubscribed on timeout (the caller may still hold it).
+    The internal timer is always cancelled once fut settles (value or error),
+    so repeated timeouts on long waits don't accumulate dead heap entries;
+    `fut` itself is only unsubscribed on timeout (the caller may still hold
+    it).
     """
     timer = loop.delay(seconds)
-    idx, val = await first_of(loop, fut, timer)
-    if idx == 0:
+    try:
+        idx, val = await first_of(fut, timer)
+    finally:
         loop.cancel_timer(timer)
+    if idx == 0:
         return val
     return default

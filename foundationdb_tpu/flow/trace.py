@@ -35,9 +35,12 @@ class TraceCollector:
         if event["Severity"] < self.min_severity:
             return
         self.counts[event["Type"]] = self.counts.get(event["Type"], 0) + 1
-        self.events.append(event)
         if self._fh:
+            # File-backed: spool only, so long runs stay bounded in memory
+            # (the reference rolls trace files for the same reason).
             self._fh.write(json.dumps(event) + "\n")
+        else:
+            self.events.append(event)
 
     def find(self, type_: str) -> list[dict]:
         return [e for e in self.events if e["Type"] == type_]

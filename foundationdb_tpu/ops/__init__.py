@@ -1,0 +1,32 @@
+"""Vectorized query primitives for the TPU data plane.
+
+These are the XLA-friendly building blocks the conflict engine composes:
+multiword lexicographic binary search, sparse-table range max/min, and a
+dyadic segment-tree interval-stabbing query.  All shapes are static; all
+control flow is unrolled or lax loops, so everything jits onto the TPU
+without host round-trips.
+"""
+
+from .rangequery import (
+    lex_less,
+    lex_leq,
+    searchsorted_words,
+    build_max_table,
+    build_min_table,
+    range_max,
+    range_min,
+    floor_log2,
+)
+from .stabbing import stabbing_min
+
+__all__ = [
+    "lex_less",
+    "lex_leq",
+    "searchsorted_words",
+    "build_max_table",
+    "build_min_table",
+    "range_max",
+    "range_min",
+    "floor_log2",
+    "stabbing_min",
+]

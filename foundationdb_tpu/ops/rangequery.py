@@ -1,0 +1,105 @@
+"""Multiword binary search + sparse-table range max/min.
+
+The conflict engine's history is a step function over byte-string keys
+digitized as fixed-width vectors of uint32 words (see conflict/keys.py).
+These helpers answer, fully vectorized:
+
+  - searchsorted_words: rank of each query key among sorted history keys
+    (replaces the reference skip list's Finger descent, SkipList.cpp:345)
+  - range_max over a sparse table: max version within a contiguous index
+    span (replaces CheckMax's pyramid walk, SkipList.cpp:772-830)
+
+Sparse tables cost O(N log N) to build per batch and O(1) per query; the
+whole batch of queries runs as a handful of gathers on device.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def lex_less(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    """a < b lexicographically over trailing word axis; [..., W] uint32."""
+    lt = jnp.zeros(a.shape[:-1], dtype=bool)
+    for w in range(a.shape[-1] - 1, -1, -1):
+        aw, bw = a[..., w], b[..., w]
+        lt = (aw < bw) | ((aw == bw) & lt)
+    return lt
+
+
+def lex_leq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
+    leq = jnp.ones(a.shape[:-1], dtype=bool)
+    for w in range(a.shape[-1] - 1, -1, -1):
+        aw, bw = a[..., w], b[..., w]
+        leq = (aw < bw) | ((aw == bw) & leq)
+    return leq
+
+
+def searchsorted_words(keys: jnp.ndarray, q: jnp.ndarray, side: str) -> jnp.ndarray:
+    """Insertion ranks of q [M, W] into sorted keys [N, W].
+
+    side='left':  count of keys strictly < q
+    side='right': count of keys <= q
+    Fixed log2(N)+1 binary-search iterations of vectorized gathers.
+    """
+    n, _w = keys.shape
+    m = q.shape[0]
+    lo = jnp.zeros((m,), jnp.int32)
+    hi = jnp.full((m,), n, jnp.int32)
+    steps = max(1, math.ceil(math.log2(max(n, 2))) + 1)
+    cmp = lex_less if side == "left" else lex_leq
+    for _ in range(steps):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        kmid = keys[jnp.clip(mid, 0, n - 1)]
+        go_right = cmp(kmid, q)
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
+def floor_log2(x: jnp.ndarray) -> jnp.ndarray:
+    """floor(log2(x)) for x >= 1, int32."""
+    return 31 - jax.lax.clz(jnp.maximum(x, 1).astype(jnp.int32))
+
+
+def _build_table(values: jnp.ndarray, op) -> jnp.ndarray:
+    """Stacked sparse table [L+1, N]; table[l][i] covers [i, i + 2^l)."""
+    n = values.shape[0]
+    levels = [values]
+    span = 1
+    lmax = max(1, math.ceil(math.log2(max(n, 2))))
+    for _ in range(lmax):
+        prev = levels[-1]
+        idx = jnp.minimum(jnp.arange(n, dtype=jnp.int32) + span, n - 1)
+        levels.append(op(prev, prev[idx]))
+        span *= 2
+    return jnp.stack(levels)
+
+
+def build_max_table(values: jnp.ndarray) -> jnp.ndarray:
+    return _build_table(values, jnp.maximum)
+
+
+def build_min_table(values: jnp.ndarray) -> jnp.ndarray:
+    return _build_table(values, jnp.minimum)
+
+
+def _range_query(table: jnp.ndarray, i: jnp.ndarray, j: jnp.ndarray, op) -> jnp.ndarray:
+    """op over values[i..j] inclusive; requires i <= j elementwise."""
+    length = j - i + 1
+    lev = floor_log2(length)
+    left = table[lev, i]
+    right = table[lev, j - (1 << lev) + 1]
+    return op(left, right)
+
+
+def range_max(table, i, j):
+    return _range_query(table, i, j, jnp.maximum)
+
+
+def range_min(table, i, j):
+    return _range_query(table, i, j, jnp.minimum)

@@ -1,0 +1,61 @@
+"""Dyadic segment-tree interval stabbing: per-slot min over covering intervals.
+
+Given M intervals [lo_i, hi_i) over N = 2^k slots, each with an int32 weight,
+computes for every slot the minimum weight among intervals covering it
+(+INF where uncovered).  This is how the conflict engine answers, for every
+point of the key space at once, "what is the earliest transaction whose write
+covers this point?" — the vectorized replacement for the reference's ordered
+MiniConflictSet scan (SkipList.cpp:1133 checkIntraBatchConflicts), where the
+batch-order constraint 's earlier than t' becomes 'min covering writer < t'.
+
+Build: each interval min-updates its O(log N) dyadic cover nodes (the classic
+iterative segment-tree range update, vectorized across all intervals); a
+top-down push then folds node values onto leaves.  O((M + N) log N) total,
+all scatters/gathers.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+INF32 = jnp.int32(2**31 - 1)
+
+
+def stabbing_min(
+    lo: jnp.ndarray,
+    hi: jnp.ndarray,
+    weight: jnp.ndarray,
+    valid: jnp.ndarray,
+    n_log2: int,
+) -> jnp.ndarray:
+    """Per-slot min weight over covering intervals.
+
+    lo, hi: int32 [M] half-open slot intervals, 0 <= lo <= hi <= N
+    weight: int32 [M]; valid: bool [M] (invalid intervals ignored)
+    returns int32 [N] (INF32 where uncovered), N = 2^n_log2.
+    """
+    n = 1 << n_log2
+    # Flat tree: node 1 is root, leaves are [n, 2n); index 2n is a dummy
+    # slot for masked-off scatters.
+    tree = jnp.full((2 * n + 1,), INF32, dtype=jnp.int32)
+    w = jnp.where(valid, weight.astype(jnp.int32), INF32)
+    li = jnp.where(valid, lo + n, 2 * n).astype(jnp.int32)
+    ri = jnp.where(valid, hi + n, 2 * n).astype(jnp.int32)
+    for _ in range(n_log2 + 1):
+        active = li < ri
+        upd_l = active & (li % 2 == 1)
+        tree = tree.at[jnp.where(upd_l, li, 2 * n)].min(jnp.where(upd_l, w, INF32))
+        li = li + upd_l
+        upd_r = active & (ri % 2 == 1)
+        ri = ri - upd_r
+        tree = tree.at[jnp.where(upd_r, ri, 2 * n)].min(jnp.where(upd_r, w, INF32))
+        li = li // 2
+        ri = ri // 2
+    # Push node minima down to leaves, level by level.
+    for d in range(n_log2):
+        lvl_start = 1 << d
+        parents = tree[lvl_start : 2 * lvl_start]
+        children = tree[2 * lvl_start : 4 * lvl_start]
+        children = jnp.minimum(children, jnp.repeat(parents, 2))
+        tree = tree.at[2 * lvl_start : 4 * lvl_start].set(children)
+    return tree[n : 2 * n]

@@ -1,0 +1,179 @@
+"""Differential tests: CPU engine vs brute-force oracle.
+
+Strategy per SURVEY.md §4.8: the oracle is the obviously-correct model; the
+production engines must make byte-identical decisions on randomized batch
+streams, including adversarial shapes (chains where a conflicted txn
+un-conflicts a later one, snapshot==version boundaries, window eviction).
+"""
+
+import pytest
+
+from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+from foundationdb_tpu.conflict.types import (
+    COMMITTED,
+    CONFLICT,
+    TOO_OLD,
+    TransactionConflictInfo as T,
+)
+from foundationdb_tpu.flow import DeterministicRandom
+
+
+def k(i: int) -> bytes:
+    return b"%08d" % i
+
+
+def test_simple_conflict_and_strictness():
+    cs = CpuConflictSet()
+    # txn A writes [10,20) at version 100
+    s = cs.detect([T(read_snapshot=0, write_ranges=[(k(10), k(20))])], 100, 0)
+    assert s == [COMMITTED]
+    # read at snapshot 99 overlapping -> conflict; at 100 -> NO conflict (strict >)
+    s = cs.detect(
+        [
+            T(read_snapshot=99, read_ranges=[(k(15), k(16))]),
+            T(read_snapshot=100, read_ranges=[(k(15), k(16))]),
+            T(read_snapshot=99, read_ranges=[(k(20), k(25))]),  # half-open: no overlap
+            T(read_snapshot=99, read_ranges=[(k(5), k(10))]),  # ends at begin: no
+            T(read_snapshot=99, read_ranges=[(k(5), k(10) + b"\x00")]),  # 1 past: yes
+        ],
+        101,
+        0,
+    )
+    assert s == [CONFLICT, COMMITTED, COMMITTED, COMMITTED, CONFLICT]
+
+
+def test_too_old_requires_read_ranges():
+    cs = CpuConflictSet(oldest_version=50)
+    s = cs.detect(
+        [
+            T(read_snapshot=10, read_ranges=[(k(1), k(2))]),  # too old
+            T(read_snapshot=10, write_ranges=[(k(1), k(2))]),  # no reads: commits
+            T(read_snapshot=50, read_ranges=[(k(5), k(6))]),  # at boundary: fine
+        ],
+        60,
+        50,
+    )
+    assert s == [TOO_OLD, COMMITTED, COMMITTED]
+
+
+def test_intra_batch_order_and_chain():
+    cs = CpuConflictSet()
+    # t0 writes X; t1 reads X (conflicts with t0) and writes Y;
+    # t2 reads Y -> must COMMIT because t1 conflicted (its write invisible)
+    s = cs.detect(
+        [
+            T(read_snapshot=0, write_ranges=[(b"x", b"x\x00")]),
+            T(
+                read_snapshot=0,
+                read_ranges=[(b"x", b"x\x00")],
+                write_ranges=[(b"y", b"y\x00")],
+            ),
+            T(read_snapshot=0, read_ranges=[(b"y", b"y\x00")]),
+        ],
+        10,
+        0,
+    )
+    assert s == [COMMITTED, CONFLICT, COMMITTED]
+
+
+def test_intra_batch_reads_precede_own_writes():
+    # A txn whose read range overlaps its OWN write range must not self-conflict
+    cs = CpuConflictSet()
+    s = cs.detect(
+        [T(read_snapshot=0, read_ranges=[(b"a", b"b")], write_ranges=[(b"a", b"b")])],
+        10,
+        0,
+    )
+    assert s == [COMMITTED]
+
+
+def test_later_txn_write_does_not_conflict_earlier_read():
+    cs = CpuConflictSet()
+    s = cs.detect(
+        [
+            T(read_snapshot=0, read_ranges=[(b"a", b"b")]),
+            T(read_snapshot=0, write_ranges=[(b"a", b"b")]),
+        ],
+        10,
+        0,
+    )
+    assert s == [COMMITTED, COMMITTED]
+
+
+def test_window_eviction_too_old():
+    cs = CpuConflictSet()
+    cs.detect([T(read_snapshot=0, write_ranges=[(k(1), k(2))])], 100, 0)
+    cs.detect([], 200, 150)  # advance window past version 100
+    s = cs.detect(
+        [
+            T(read_snapshot=149, read_ranges=[(k(1), k(2))]),  # below window
+            T(read_snapshot=150, read_ranges=[(k(1), k(2))]),  # at window: ok, no conflict
+        ],
+        201,
+        150,
+    )
+    assert s == [TOO_OLD, COMMITTED]
+
+
+def _random_batch(rng: DeterministicRandom, keyspace: int, version: int, n: int):
+    txns = []
+    for _ in range(n):
+        tr = T(
+            read_snapshot=max(0, version - rng.random_int(0, 30)),
+            read_ranges=[],
+            write_ranges=[],
+        )
+        for _ in range(rng.random_int(0, 4)):
+            a = rng.random_int(0, keyspace)
+            b = a + 1 + rng.random_int(0, max(1, keyspace // 8))
+            tr.read_ranges.append((k(a), k(b)))
+        for _ in range(rng.random_int(0, 3)):
+            a = rng.random_int(0, keyspace)
+            b = a + 1 + rng.random_int(0, max(1, keyspace // 10))
+            tr.write_ranges.append((k(a), k(b)))
+        txns.append(tr)
+    return txns
+
+
+@pytest.mark.parametrize("seed,keyspace", [(1, 30), (2, 30), (3, 1000), (4, 8), (5, 200)])
+def test_differential_cpu_vs_oracle(seed, keyspace):
+    rng = DeterministicRandom(seed)
+    cpu = CpuConflictSet()
+    orc = OracleConflictSet()
+    version = 10
+    for batch_i in range(40):
+        txns = _random_batch(rng, keyspace, version, rng.random_int(1, 25))
+        now = version + rng.random_int(1, 10)
+        new_oldest = max(0, version - 25)
+        got = cpu.detect(txns, now, new_oldest)
+        want = orc.detect(txns, now, new_oldest)
+        assert got == want, f"batch {batch_i}: cpu={got} oracle={want}"
+        version = now
+
+
+def test_variable_length_keys_differential():
+    rng = DeterministicRandom(77)
+    cpu = CpuConflictSet()
+    orc = OracleConflictSet()
+    alphabet = [b"", b"\x00", b"a", b"ab", b"ab\x00", b"abc", b"b", b"\xff", b"\xff\xff"]
+    version = 5
+    for _ in range(60):
+        txns = []
+        for _ in range(rng.random_int(1, 12)):
+            tr = T(read_snapshot=max(0, version - rng.random_int(0, 10)))
+            for _ in range(rng.random_int(0, 3)):
+                a, b = rng.random_choice(alphabet), rng.random_choice(alphabet)
+                if a > b:
+                    a, b = b, a
+                tr.read_ranges.append((a, b))
+            for _ in range(rng.random_int(0, 3)):
+                a, b = rng.random_choice(alphabet), rng.random_choice(alphabet)
+                if a > b:
+                    a, b = b, a
+                tr.write_ranges.append((a, b))
+            txns.append(tr)
+        now = version + rng.random_int(1, 5)
+        new_oldest = max(0, version - 8)
+        assert cpu.detect(txns, now, new_oldest) == orc.detect(txns, now, new_oldest)
+        version = now

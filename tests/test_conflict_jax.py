@@ -1,0 +1,250 @@
+"""Differential tests: JAX device engine vs CPU engine vs oracle.
+
+The acceptance gate from BASELINE.json: identical decisions between the
+device engine and the CPU reference across randomized and adversarial batch
+streams, including window eviction, rebase, and hybrid handoff.
+"""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+from foundationdb_tpu.conflict.engine_jax import JaxConflictSet
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+from foundationdb_tpu.conflict.types import (
+    COMMITTED,
+    CONFLICT,
+    TOO_OLD,
+    TransactionConflictInfo as T,
+)
+from foundationdb_tpu.flow import DeterministicRandom
+
+
+def k(i: int) -> bytes:
+    return b"%08d" % i
+
+
+@pytest.fixture(scope="module")
+def jcs_factory():
+    def make(**kw):
+        kw.setdefault("key_words", 3)
+        kw.setdefault("h_cap", 1 << 10)
+        return JaxConflictSet(**kw)
+
+    return make
+
+
+def test_basic_decisions(jcs_factory):
+    cs = jcs_factory()
+    s = cs.detect([T(read_snapshot=0, write_ranges=[(k(10), k(20))])], 100, 0)
+    assert s == [COMMITTED]
+    s = cs.detect(
+        [
+            T(read_snapshot=99, read_ranges=[(k(15), k(16))]),
+            T(read_snapshot=100, read_ranges=[(k(15), k(16))]),
+            T(read_snapshot=99, read_ranges=[(k(20), k(25))]),
+            T(read_snapshot=99, read_ranges=[(k(5), k(10))]),
+            T(read_snapshot=99, read_ranges=[(k(5), k(10) + b"\x00")]),
+        ],
+        101,
+        0,
+    )
+    assert s == [CONFLICT, COMMITTED, COMMITTED, COMMITTED, CONFLICT]
+
+
+def test_intra_batch_chain(jcs_factory):
+    cs = jcs_factory()
+    s = cs.detect(
+        [
+            T(read_snapshot=0, write_ranges=[(b"x", b"x\x00")]),
+            T(
+                read_snapshot=0,
+                read_ranges=[(b"x", b"x\x00")],
+                write_ranges=[(b"y", b"y\x00")],
+            ),
+            T(read_snapshot=0, read_ranges=[(b"y", b"y\x00")]),
+        ],
+        10,
+        0,
+    )
+    assert s == [COMMITTED, CONFLICT, COMMITTED]
+    # the conflicted txn's write must NOT have entered history
+    s2 = cs.detect([T(read_snapshot=5, read_ranges=[(b"y", b"y\x00")])], 11, 0)
+    assert s2 == [COMMITTED]
+    # but the committed writes did
+    s3 = cs.detect([T(read_snapshot=5, read_ranges=[(b"x", b"x\x00")])], 12, 0)
+    assert s3 == [CONFLICT]
+
+
+def test_deep_chain_exactness(jcs_factory):
+    # w0 -> r1w1 -> r2w2 -> ... alternating: sequential semantics says
+    # odd txns conflict, even commit.  Exercises multi-round fixpoint.
+    cs = jcs_factory()
+    n = 12
+    txns = [T(read_snapshot=0, write_ranges=[(k(0), k(1))])]
+    for i in range(1, n):
+        txns.append(
+            T(
+                read_snapshot=0,
+                read_ranges=[(k(i - 1), k(i))],
+                write_ranges=[(k(i), k(i + 1))],
+            )
+        )
+    got = cs.detect(txns, 10, 0)
+    want = OracleConflictSet().detect(txns, 10, 0)
+    assert got == want
+    assert cs.last_iters > 1  # genuinely needed multiple rounds
+
+
+def test_too_old_and_window(jcs_factory):
+    cs = jcs_factory(oldest_version=50)
+    s = cs.detect(
+        [
+            T(read_snapshot=10, read_ranges=[(k(1), k(2))]),
+            T(read_snapshot=10, write_ranges=[(k(1), k(2))]),
+            T(read_snapshot=50, read_ranges=[(k(5), k(6))]),
+        ],
+        60,
+        50,
+    )
+    assert s == [TOO_OLD, COMMITTED, COMMITTED]
+    cs2 = jcs_factory()
+    cs2.detect([T(read_snapshot=0, write_ranges=[(k(1), k(2))])], 100, 0)
+    cs2.detect([], 200, 150)
+    s = cs2.detect(
+        [
+            T(read_snapshot=149, read_ranges=[(k(1), k(2))]),
+            T(read_snapshot=150, read_ranges=[(k(1), k(2))]),
+        ],
+        201,
+        150,
+    )
+    assert s == [TOO_OLD, COMMITTED]
+
+
+def _random_stream(seed, keyspace, batches, txns_per_batch, snap_lag=25):
+    rng = DeterministicRandom(seed)
+    version = 10
+    out = []
+    for _ in range(batches):
+        txns = []
+        for _ in range(rng.random_int(1, txns_per_batch + 1)):
+            tr = T(read_snapshot=max(0, version - rng.random_int(0, snap_lag)))
+            for _ in range(rng.random_int(0, 4)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 8))
+                tr.read_ranges.append((k(a), k(b)))
+            for _ in range(rng.random_int(0, 3)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 10))
+                tr.write_ranges.append((k(a), k(b)))
+            txns.append(tr)
+        now = version + rng.random_int(1, 10)
+        new_oldest = max(0, version - snap_lag)
+        out.append((txns, now, new_oldest))
+        version = now
+    return out
+
+
+@pytest.mark.parametrize(
+    "seed,keyspace", [(11, 30), (12, 8), (13, 500), (14, 60), (15, 3)]
+)
+def test_differential_jax_vs_cpu_vs_oracle(jcs_factory, seed, keyspace):
+    jcs = jcs_factory()
+    cpu = CpuConflictSet()
+    orc = OracleConflictSet()
+    for bi, (txns, now, new_oldest) in enumerate(
+        _random_stream(seed, keyspace, batches=25, txns_per_batch=20)
+    ):
+        gj = jcs.detect(txns, now, new_oldest)
+        gc = cpu.detect(txns, now, new_oldest)
+        go = orc.detect(txns, now, new_oldest)
+        assert gj == gc == go, (
+            f"batch {bi}: jax={gj} cpu={gc} oracle={go} "
+            f"txns={[(t.read_snapshot, t.read_ranges, t.write_ranges) for t in txns]}"
+        )
+
+
+def test_variable_length_keys(jcs_factory):
+    rng = DeterministicRandom(7)
+    jcs = jcs_factory()
+    cpu = CpuConflictSet()
+    alphabet = [b"", b"\x00", b"a", b"ab", b"ab\x00", b"abc", b"b", b"\xff", b"\xff\xff"]
+    version = 5
+    for _ in range(30):
+        txns = []
+        for _ in range(rng.random_int(1, 10)):
+            tr = T(read_snapshot=max(0, version - rng.random_int(0, 10)))
+            for _ in range(rng.random_int(0, 3)):
+                a, b = rng.random_choice(alphabet), rng.random_choice(alphabet)
+                if a > b:
+                    a, b = b, a
+                tr.read_ranges.append((a, b))
+            for _ in range(rng.random_int(0, 3)):
+                a, b = rng.random_choice(alphabet), rng.random_choice(alphabet)
+                if a > b:
+                    a, b = b, a
+                tr.write_ranges.append((a, b))
+            txns.append(tr)
+        now = version + rng.random_int(1, 5)
+        new_oldest = max(0, version - 8)
+        assert jcs.detect(txns, now, new_oldest) == cpu.detect(txns, now, new_oldest)
+        version = now
+
+
+def test_history_growth_and_eviction_bound(jcs_factory):
+    # many disjoint writes; window advances right behind -> history stays small
+    jcs = jcs_factory(h_cap=1 << 9)
+    cpu = CpuConflictSet()
+    v = 0
+    for i in range(40):
+        txns = [
+            T(read_snapshot=v, write_ranges=[(k(100 * i + j), k(100 * i + j + 2))])
+            for j in range(0, 20, 2)
+        ]
+        assert jcs.detect(txns, v + 5, v) == cpu.detect(txns, v + 5, v)
+        v += 5
+    assert jcs.boundary_count == cpu.boundary_count
+
+
+def test_hybrid_handoff():
+    from foundationdb_tpu.conflict.api import ConflictSet
+    from foundationdb_tpu.flow.knobs import g_knobs
+
+    old_min = g_knobs.server.conflict_device_min_batch
+    g_knobs.server.conflict_device_min_batch = 4
+    try:
+        hyb = ConflictSet(backend="hybrid", key_words=3)
+        orc = OracleConflictSet()
+        for bi, (txns, now, new_oldest) in enumerate(
+            _random_stream(21, 40, batches=20, txns_per_batch=12)
+        ):
+            if bi % 3 == 2:  # force a small batch -> CPU path
+                txns = txns[:2]
+            b = hyb.new_batch()
+            for t in txns:
+                b.add_transaction(t)
+            got = b.detect_conflicts(now, new_oldest)
+            want = orc.detect(txns, now, new_oldest)
+            assert got == want, f"batch {bi}: hybrid={got} oracle={want}"
+    finally:
+        g_knobs.server.conflict_device_min_batch = old_min
+
+
+def test_long_keys_route_to_cpu():
+    from foundationdb_tpu.conflict.api import ConflictSet
+    from foundationdb_tpu.flow.knobs import g_knobs
+
+    old_min = g_knobs.server.conflict_device_min_batch
+    g_knobs.server.conflict_device_min_batch = 1
+    try:
+        hyb = ConflictSet(backend="hybrid", key_words=3)
+        long_key = b"z" * 100  # > 12 bytes: must fall back, not truncate
+        b = hyb.new_batch()
+        b.add_transaction(T(read_snapshot=0, write_ranges=[(long_key, long_key + b"\x01")]))
+        assert b.detect_conflicts(10, 0) == [COMMITTED]
+        b2 = hyb.new_batch()
+        b2.add_transaction(T(read_snapshot=5, read_ranges=[(long_key, long_key + b"\x01")]))
+        assert b2.detect_conflicts(11, 0) == [CONFLICT]
+    finally:
+        g_knobs.server.conflict_device_min_batch = old_min

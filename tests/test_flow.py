@@ -110,7 +110,7 @@ def test_first_of_and_timeout():
     loop = EventLoop(seed=1)
 
     async def main():
-        idx, val = await first_of(loop, loop.delay(5.0), loop.delay(1.0))
+        idx, val = await first_of(loop.delay(5.0), loop.delay(1.0))
         assert idx == 1
         v = await timeout_after(loop, loop.delay(100.0), 2.0, default="timed_out")
         assert v == "timed_out"
