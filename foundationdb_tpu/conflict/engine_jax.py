@@ -146,12 +146,7 @@ class PackedBatch:
 # ---------------------------------------------------------------------------
 
 
-@partial(
-    jax.jit,
-    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap"),
-    donate_argnames=("hkeys", "hvers", "hcount", "oldest"),
-)
-def _detect_step(
+def detect_core(
     hkeys,
     hvers,
     hcount,
@@ -429,6 +424,16 @@ def _detect_step(
         undecided_left.astype(jnp.int32),
         iters,
     )
+
+
+# Jitted single-device entry point; detect_core stays undecorated so the
+# sharded resolver (parallel/sharded_resolver.py) can call it inside
+# shard_map with per-shard clipped inputs.
+_detect_step = partial(
+    jax.jit,
+    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap"),
+    donate_argnames=("hkeys", "hvers", "hcount", "oldest"),
+)(detect_core)
 
 
 class JaxConflictSet:

@@ -2,17 +2,26 @@
 
 Multi-chip TPU hardware is not available in CI; sharding tests run on
 8 virtual CPU devices (the driver separately dry-runs the multichip path).
-Must run before jax is imported anywhere.
+
+Note: this environment's sitecustomize registers the axon TPU plugin and
+calls jax.config.update("jax_platforms", "axon,cpu") at interpreter start,
+which overrides the JAX_PLATFORMS env var — so we must override the config
+back (env vars alone are ineffective).  XLA_FLAGS must be set before the
+CPU client is created (first jax.devices() call), which this file
+guarantees by running before any test imports jax-using modules.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
