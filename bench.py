@@ -1,0 +1,157 @@
+"""Resolver conflict-detection benchmark (the north-star metric).
+
+Mirrors the reference's skipListTest microbench (fdbserver/SkipList.cpp:
+1412-1502): batches of transactions with 1 read + 1 write conflict range
+each, int keys uniform in [0, 2e7), range width 1 + U[0,10), read_snapshot =
+batch index, detect at now = i+50 with window new_oldest = i.
+
+Measured:
+  - CPU baseline: CpuConflictSet (the host fallback engine) at the
+    reference's 2500-txn batches.  (The reference's own C++ SkipList number
+    must be produced by `fdbserver -r skiplisttest`; until a native baseline
+    lands in-repo, the host engine is the stand-in baseline.)
+  - Device: JaxConflictSet at 64k-txn batches (the BASELINE.json target
+    configuration), including host packing + transfer + device->host
+    verdict readback.
+
+Prints ONE JSON line: value = device txns/sec at 64k batches,
+vs_baseline = device / CPU-baseline throughput ratio.
+"""
+
+import json
+import time
+
+import numpy as np
+
+KEYSPACE = 20_000_000
+KEY_BYTES = 4  # 20M keys fit in 4 big-endian bytes, like the ref's setK ints
+KEY_WORDS = 2
+WINDOW = 50  # detect at now=i+50, evict below i => 50-batch live window
+
+
+def gen_packed(rng, n_txn, batch_index, key_words):
+    """Vectorized PackedBatch generation (1 read + 1 write range per txn)."""
+    from foundationdb_tpu.conflict.engine_jax import PackedBatch, _next_pow2
+    from foundationdb_tpu.conflict import keys as keylib
+
+    cap = _next_pow2(n_txn, 8)
+    pb = PackedBatch(cap, cap, cap, key_words)
+    for begin, end, txn in (
+        (pb.r_begin, pb.r_end, pb.r_txn),
+        (pb.w_begin, pb.w_end, pb.w_txn),
+    ):
+        a = rng.integers(0, KEYSPACE, n_txn, dtype=np.int64)
+        b = a + 1 + rng.integers(0, 10, n_txn, dtype=np.int64)
+        begin[:n_txn] = keylib.encode_int_keys(a, key_words, KEY_BYTES)
+        end[:n_txn] = keylib.encode_int_keys(b, key_words, KEY_BYTES)
+        txn[:n_txn] = np.arange(n_txn, dtype=np.int32)
+    pb.r_snap[:n_txn] = batch_index
+    pb.t_snap[:n_txn] = batch_index
+    pb.t_has_reads[:n_txn] = True
+    pb.t_valid[:n_txn] = True
+    pb.n_txn = pb.n_r = pb.n_w = n_txn
+    return pb
+
+
+def txns_from_packed(pb, n_txn):
+    """Unpack to TransactionConflictInfo list for the CPU engine."""
+    from foundationdb_tpu.conflict import keys as keylib
+    from foundationdb_tpu.conflict.types import TransactionConflictInfo
+
+    out = []
+    for t in range(n_txn):
+        out.append(
+            TransactionConflictInfo(
+                read_snapshot=int(pb.t_snap[t]),
+                read_ranges=[
+                    (
+                        keylib.decode_key(pb.r_begin[t], pb.key_words),
+                        keylib.decode_key(pb.r_end[t], pb.key_words),
+                    )
+                ],
+                write_ranges=[
+                    (
+                        keylib.decode_key(pb.w_begin[t], pb.key_words),
+                        keylib.decode_key(pb.w_end[t], pb.key_words),
+                    )
+                ],
+            )
+        )
+    return out
+
+
+def bench_cpu(rng, n_batches=20, per_batch=2500):
+    from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+
+    cs = CpuConflictSet()
+    batches = [
+        txns_from_packed(gen_packed(rng, per_batch, i, KEY_WORDS), per_batch)
+        for i in range(n_batches)
+    ]
+    t0 = time.perf_counter()
+    for i, txns in enumerate(batches):
+        cs.detect(txns, now=i + WINDOW, new_oldest_version=i)
+    dt = time.perf_counter() - t0
+    return n_batches * per_batch / dt
+
+
+def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=1 << 20, window=4):
+    """Steady-state device throughput at the BASELINE.json 64k-batch config.
+
+    `window` (batches until a write is evicted) is scaled down from the
+    reference's 50 so the live boundary count (~window * 2 * per_batch) fits
+    h_cap with no mid-run growth: growth changes the jit static shape and
+    would put a fresh XLA compile inside the timed region.
+    """
+    import os
+
+    from foundationdb_tpu.conflict.engine_jax import JaxConflictSet
+
+    verbose = bool(os.environ.get("BENCH_VERBOSE"))
+    cs = JaxConflictSet(key_words=KEY_WORDS, h_cap=h_cap)
+    warm = window + 2
+    batches = [
+        gen_packed(rng, per_batch, i, KEY_WORDS) for i in range(n_batches + warm)
+    ]
+    h_cap0 = cs.h_cap
+    # Warm-up: compile AND fill the MVCC window to steady state.
+    for i in range(warm):
+        cs.detect_packed(batches[i], now=i + window, new_oldest_version=i)
+    t0 = time.perf_counter()
+    for j in range(warm, warm + n_batches):
+        t1 = time.perf_counter()
+        statuses = cs.detect_packed(
+            batches[j], now=j + window, new_oldest_version=j
+        )
+        if verbose:
+            import sys
+
+            print(
+                f"batch {j - warm}: {(time.perf_counter() - t1) * 1e3:.1f} ms "
+                f"boundaries={cs.boundary_count}",
+                file=sys.stderr,
+            )
+    np.asarray(statuses)  # ensure final readback landed
+    dt = time.perf_counter() - t0
+    assert cs.h_cap == h_cap0, "history grew mid-bench; raise h_cap"
+    return n_batches * per_batch / dt
+
+
+def main():
+    rng = np.random.default_rng(2024)
+    cpu_rate = bench_cpu(rng)
+    jax_rate = bench_jax(rng)
+    print(
+        json.dumps(
+            {
+                "metric": "resolver_conflict_txns_per_sec_64k_batch",
+                "value": round(jax_rate, 1),
+                "unit": "txn/s",
+                "vs_baseline": round(jax_rate / cpu_rate, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

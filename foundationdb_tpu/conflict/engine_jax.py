@@ -445,11 +445,17 @@ class JaxConflictSet:
         key_words: int = 4,
         h_cap: int = 1 << 16,
         device=None,
+        bucket_mins: tuple = (8, 8, 8),
     ):
         self.key_words = key_words
         self.h_cap = h_cap
         self.device = device
         self._base = oldest_version  # absolute version of rel 0
+        # Floors for (txn, read-range, write-range) capacity buckets: raising
+        # them makes varied small batches share one compiled program instead
+        # of recompiling per power-of-two shape (compile churn costs more
+        # than padded compute on device).
+        self.bucket_mins = bucket_mins
         self._init_state(oldest_rel=0)
         self.last_iters = 0
 
@@ -508,7 +514,10 @@ class JaxConflictSet:
         now: int,
         new_oldest_version: int,
     ) -> List[int]:
-        pb = PackedBatch.from_transactions(transactions, self.key_words)
+        mt, mr, mw = self.bucket_mins
+        pb = PackedBatch.from_transactions(
+            transactions, self.key_words, min_txn=mt, min_rr=mr, min_wr=mw
+        )
         statuses = self.detect_packed(pb, now, new_oldest_version)
         return [int(s) for s in statuses[: len(transactions)]]
 

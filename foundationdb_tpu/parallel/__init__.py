@@ -1,0 +1,15 @@
+"""Multi-device scaling: the rebuild's answer to the reference's
+multi-resolver key-range sharding (ref: keyResolvers KeyRangeMap,
+MasterProxyServer.actor.cpp:185; ResolutionRequestBuilder :237).
+
+Instead of N resolver processes coordinated over TCP, the key space is
+sharded across a `jax.sharding.Mesh` axis: every device holds one shard of
+the conflict-history step function and resolves the (replicated) batch
+against its own key range; verdicts are combined with a `pmin` collective
+over ICI — the device-mesh translation of the proxy's min() combine
+(MasterProxyServer.actor.cpp:492-499).
+"""
+
+from .sharded_resolver import ShardedJaxConflictSet, uniform_int_split_keys
+
+__all__ = ["ShardedJaxConflictSet", "uniform_int_split_keys"]

@@ -1,0 +1,25 @@
+"""RPC + virtualized network: the rebuild of the reference's fdbrpc/ layer.
+
+The reference runs identical role code over two interchangeable networks —
+real TCP (FlowTransport over Net2) and the deterministic simulator (Sim2) —
+selected at startup (fdbserver.actor.cpp:1468-1473).  This package keeps
+that architecture: `SimNetwork` is the deterministic in-process fabric with
+latency, clogging, partitions and kills (ref: fdbrpc/sim2.actor.cpp,
+ISimulator fdbrpc/simulator.h:35); typed request/reply endpoints
+(`RequestStream`, ref: fdbrpc/fdbrpc.h:212) ride on top and never know which
+fabric they are on.  A DCN/TCP transport for real deployment plugs in behind
+the same Endpoint/send contract.
+"""
+
+from .network import SimNetwork, SimProcess, SimMachine, Endpoint
+from .stream import RequestStream, RequestStreamRef, BrokenPromise
+
+__all__ = [
+    "SimNetwork",
+    "SimProcess",
+    "SimMachine",
+    "Endpoint",
+    "RequestStream",
+    "RequestStreamRef",
+    "BrokenPromise",
+]

@@ -1,0 +1,232 @@
+"""Deterministic in-process network fabric (the Sim2 rebuild).
+
+Ref: fdbrpc/sim2.actor.cpp — ProcessInfo/MachineInfo (simulator.h:47,112),
+kill APIs (:148-153), clogging (:263-264), Sim2Conn latency model (:180).
+Everything runs on one flow EventLoop; "processes" are actor groups, a
+"send" is a scheduled delivery after a random latency drawn from the loop's
+DeterministicRandom, so whole-cluster runs are bit-reproducible per seed.
+
+Design notes vs the reference:
+  - No byte serialization in simulation: payloads are deep-copied at send
+    time, which provides the same isolation property (no shared mutable
+    state across the process boundary) the reference gets from serializing.
+    A real DCN transport behind the same send() contract does serialize.
+  - Kills are modeled at delivery: messages to a dead process vanish; reply
+    promises held against it break (ref: connectionKeeper noticing a closed
+    connection -> broken_promise on outstanding NetSAVs,
+    FlowTransport.actor.cpp:355).
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Tuple
+
+from ..flow.asyncvar import AsyncVar
+from ..flow.error import FdbError
+from ..flow.eventloop import EventLoop, Task, TaskPriority
+from ..flow.trace import TraceEvent
+
+
+@dataclass(frozen=True)
+class Endpoint:
+    """Addressable receiver: (process address, token). Ref: fdbrpc endpoint
+    tokens — a UID keying the receiver map on the destination."""
+
+    address: str
+    token: int
+
+
+class SimMachine:
+    """A machine groups processes and shares a failure domain (ref:
+    MachineInfo simulator.h:112; machineId in LocalityData)."""
+
+    def __init__(self, network: "SimNetwork", machine_id: str, dc_id: str = "dc0"):
+        self.network = network
+        self.machine_id = machine_id
+        self.dc_id = dc_id
+        self.processes: List[SimProcess] = []
+
+    def kill(self):
+        for p in list(self.processes):
+            p.kill()
+
+
+class SimProcess:
+    """An actor group with an address; the unit of kill/reboot (ref:
+    ProcessInfo simulator.h:47)."""
+
+    def __init__(self, network: "SimNetwork", name: str, machine: SimMachine):
+        self.network = network
+        self.name = name
+        self.machine = machine
+        self.address = f"{machine.machine_id}:{len(machine.processes)}"
+        machine.processes.append(self)
+        self.alive = True
+        self.excluded = False
+        self._endpoints: Dict[int, Callable] = {}
+        self._next_token = 1
+        self._tasks: List[Task] = []
+        # Futures (reply promises) this process is waiting on, keyed by the
+        # remote address expected to answer; broken on that process's death.
+        self._pending_on: Dict[str, set] = {}
+        network._register(self)
+
+    # -- actor management --
+    def spawn(self, coro, name: str = "") -> Task:
+        assert self.alive, f"spawn on dead process {self.name}"
+        t = self.network.loop.spawn(coro, name=f"{self.name}/{name}")
+        self._tasks.append(t)
+        self._tasks = [x for x in self._tasks if not x.is_ready()]
+        return t
+
+    # -- endpoints --
+    def make_endpoint(self, receiver: Callable, token: Optional[int] = None) -> Endpoint:
+        if token is None:
+            token = self._next_token
+            self._next_token += 1
+        assert token not in self._endpoints
+        self._endpoints[token] = receiver
+        return Endpoint(self.address, token)
+
+    def drop_endpoint(self, ep: Endpoint):
+        self._endpoints.pop(ep.token, None)
+
+    # -- lifecycle --
+    def kill(self):
+        """Kill: cancel actors, drop endpoints, break promises held against
+        this process (ref: ISimulator::killProcess simulator.h:148)."""
+        if not self.alive:
+            return
+        self.alive = False
+        TraceEvent("ProcessKilled").detail("name", self.name).log()
+        self._endpoints.clear()
+        tasks, self._tasks = self._tasks, []
+        for t in tasks:
+            if not t.is_ready():
+                t.cancel()
+        self.network._on_process_death(self)
+
+    def reboot(self):
+        """Return to life with a fresh endpoint table; role actors must be
+        respawned by the caller (the worker rebooter's job, ref:
+        simulatedFDBDRebooter SimulatedCluster.actor.cpp:197)."""
+        assert not self.alive
+        self.alive = True
+        self._endpoints.clear()
+        self._pending_on.clear()
+
+
+class SimNetwork:
+    """The fabric: routing, latency, clogs, partitions, kill notification."""
+
+    def __init__(self, loop: EventLoop, *, deep_copy: bool = True):
+        self.loop = loop
+        self.deep_copy = deep_copy
+        self.machines: Dict[str, SimMachine] = {}
+        self._procs: Dict[str, SimProcess] = {}
+        # (src_ip, dst_ip) -> virtual time until which sends are held
+        self._clogged: Dict[Tuple[str, str], float] = {}
+        self.failure: Dict[str, AsyncVar] = {}  # address -> AsyncVar[bool up]
+        self.messages_sent = 0
+
+    # -- topology --
+    def machine(self, machine_id: str, dc_id: str = "dc0") -> SimMachine:
+        m = self.machines.get(machine_id)
+        if m is None:
+            m = SimMachine(self, machine_id, dc_id)
+            self.machines[machine_id] = m
+        return m
+
+    def process(self, name: str, machine_id: Optional[str] = None) -> SimProcess:
+        m = self.machine(machine_id or name)
+        return SimProcess(self, name, m)
+
+    def _register(self, p: SimProcess):
+        self._procs[p.address] = p
+        self.failure.setdefault(p.address, AsyncVar(True))
+
+    def get_process(self, address: str) -> Optional[SimProcess]:
+        return self._procs.get(address)
+
+    # -- latency / fault models --
+    def _latency(self) -> float:
+        # ref Sim2Conn: a fraction of a millisecond, randomized per packet
+        return 0.0001 + 0.0004 * self.loop.rng.random01()
+
+    def clog_pair(self, ip_a: str, ip_b: str, seconds: float):
+        """Hold traffic both ways between two machines (ref:
+        ISimulator::clogPair simulator.h:264)."""
+        until = self.loop.now() + seconds
+        for pair in ((ip_a, ip_b), (ip_b, ip_a)):
+            self._clogged[pair] = max(self._clogged.get(pair, 0.0), until)
+
+    def unclog_all(self):
+        self._clogged.clear()
+
+    def _clog_release(self, src_ip: str, dst_ip: str) -> float:
+        return self._clogged.get((src_ip, dst_ip), 0.0)
+
+    # -- sending --
+    def send(self, dst: Endpoint, payload, priority: int = TaskPriority.DefaultEndpoint):
+        """Fire-and-forget message to an endpoint; vanishes if the target is
+        dead or the endpoint is gone at delivery time (like an unreliable
+        packet; reliability is built above via reply promises + retries)."""
+        self.messages_sent += 1
+        msg = copy.deepcopy(payload) if self.deep_copy else payload
+        deliver_at = self.loop.now() + self._latency()
+        self._schedule_delivery(dst, msg, deliver_at, priority)
+
+    def send_from(
+        self,
+        src: SimProcess,
+        dst: Endpoint,
+        payload,
+        priority: int = TaskPriority.DefaultEndpoint,
+    ):
+        if not src.alive:
+            return
+        self.messages_sent += 1
+        msg = copy.deepcopy(payload) if self.deep_copy else payload
+        src_ip = src.machine.machine_id
+        dst_ip = dst.address.split(":")[0]
+        release = self._clog_release(src_ip, dst_ip)
+        deliver_at = max(self.loop.now(), release) + self._latency()
+        self._schedule_delivery(dst, msg, deliver_at, priority)
+
+    def _schedule_delivery(self, dst: Endpoint, msg, at: float, priority: int):
+        def deliver():
+            p = self._procs.get(dst.address)
+            if p is None or not p.alive:
+                return
+            receiver = p._endpoints.get(dst.token)
+            if receiver is None:
+                return
+            receiver(msg)
+
+        self.loop._schedule(priority, deliver, at=at)
+
+    # -- death notification --
+    def _on_process_death(self, dead: SimProcess):
+        self.failure[dead.address].set(False)
+        for p in self._procs.values():
+            pending = p._pending_on.pop(dead.address, None)
+            if not pending:
+                continue
+            for promise, reply_ep in pending:
+                p.drop_endpoint(reply_ep)  # one-shot endpoint, never answered
+                if not promise.is_set():
+                    # Deliver after a latency, as a closing connection would.
+                    self.loop._schedule(
+                        TaskPriority.DefaultEndpoint,
+                        lambda pr=promise: (
+                            None
+                            if pr.is_set()
+                            else pr.send_error(FdbError("broken_promise"))
+                        ),
+                        at=self.loop.now() + self._latency(),
+                    )
+
+    def mark_up(self, address: str):
+        self.failure[address].set(True)

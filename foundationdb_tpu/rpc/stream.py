@@ -1,0 +1,148 @@
+"""Typed request/reply endpoints over the network fabric.
+
+Ref: fdbrpc/fdbrpc.h — RequestStream :212 (server side: a stream of
+requests), ReplyPromise :94 (a promise whose fulfillment travels back over
+the network as a serialized SAV), getReply :235 (send + wait).  The rebuild
+keeps the shape: a server pops (request, reply) pairs; a client's get_reply
+returns a future that errors with broken_promise if the server dies
+(ref: NetSAV broken on connection failure).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Optional
+
+from ..flow.error import FdbError
+from ..flow.eventloop import TaskPriority
+from ..flow.future import Future, Promise, PromiseStream
+from .network import Endpoint, SimNetwork, SimProcess
+
+
+def BrokenPromise() -> FdbError:
+    return FdbError("broken_promise")
+
+
+@dataclass
+class _Envelope:
+    request: Any
+    reply_to: Optional[Endpoint]
+
+
+class Reply:
+    """Server-side handle for answering one request; send() travels back to
+    the caller's one-shot reply endpoint (ref: ReplyPromise fdbrpc.h:94)."""
+
+    __slots__ = ("_net", "_src", "_reply_to", "_sent")
+
+    def __init__(self, net: SimNetwork, src: SimProcess, reply_to: Optional[Endpoint]):
+        self._net = net
+        self._src = src
+        self._reply_to = reply_to
+        self._sent = False
+
+    def send(self, value=None):
+        self._send((False, value))
+
+    def send_error(self, name: str):
+        self._send((True, name))
+
+    def _send(self, wire):
+        if self._sent or self._reply_to is None:
+            return
+        self._sent = True
+        self._net.send_from(
+            self._src, self._reply_to, wire, priority=TaskPriority.DefaultPromiseEndpoint
+        )
+
+
+class RequestStream:
+    """Server side: a well-known endpoint producing (request, Reply) pairs."""
+
+    def __init__(self, process: SimProcess, name: str, token: Optional[int] = None):
+        self.process = process
+        self.name = name
+        self._stream = PromiseStream()
+        self.endpoint = process.make_endpoint(self._deliver, token=token)
+
+    def _deliver(self, env: _Envelope):
+        reply = Reply(self.process.network, self.process, env.reply_to)
+        self._stream.send((env.request, reply))
+
+    def pop(self) -> Future:
+        """Future of the next (request, Reply)."""
+        return self._stream.pop()
+
+    def ref(self) -> "RequestStreamRef":
+        return RequestStreamRef(self.endpoint, self.name)
+
+
+@dataclass(frozen=True)
+class RequestStreamRef:
+    """Client-side handle; what interface structs carry (ref: the
+    RequestStream<T> members of e.g. MasterProxyInterface.h)."""
+
+    endpoint: Endpoint
+    name: str = ""
+
+    def get_reply(self, src: SimProcess, request) -> Future:
+        """Send and await the reply (ref: getReply fdbrpc.h:235).
+
+        The future errors with broken_promise if the destination process
+        dies before answering (detected via the fabric's death notification,
+        standing in for a closed connection).
+        """
+        net = src.network
+        out = Promise(priority=TaskPriority.DefaultPromiseEndpoint)
+        dst_proc = net.get_process(self.endpoint.address)
+        if dst_proc is None or not dst_proc.alive:
+            # Target already down: fail after a connection-attempt latency
+            # (ref: failed connect -> broken_promise on the reply).
+            net.loop._schedule(
+                TaskPriority.DefaultPromiseEndpoint,
+                lambda: out.send_error(BrokenPromise()),
+                at=net.loop.now() + net._latency(),
+            )
+            return out.future
+        reply_ep_holder = {}
+
+        def on_reply(wire):
+            src.drop_endpoint(reply_ep_holder["ep"])
+            pending = src._pending_on.get(self.endpoint.address)
+            if pending is not None:
+                pending.discard((out, reply_ep_holder["ep"]))
+            if out.is_set():
+                return
+            is_err, value = wire
+            if is_err:
+                out.send_error(FdbError(value))
+            else:
+                out.send(value)
+
+        reply_ep = src.make_endpoint(on_reply)
+        reply_ep_holder["ep"] = reply_ep
+        src._pending_on.setdefault(self.endpoint.address, set()).add(
+            (out, reply_ep)
+        )
+        net.send_from(src, self.endpoint, _Envelope(request, reply_ep))
+        return out.future
+
+    def send(self, src: SimProcess, request):
+        """One-way send, no reply expected (ref: RequestStream::send)."""
+        src.network.send_from(src, self.endpoint, _Envelope(request, None))
+
+
+async def retry_get_reply(
+    ref: RequestStreamRef, src: SimProcess, request, *, delay: float = 0.1
+):
+    """getReply with broken_promise retry after a backoff — the minimal
+    stand-in for the reference's loadBalance single-target path
+    (fdbrpc/LoadBalance.actor.h:159) until replica sets exist."""
+    loop = src.network.loop
+    while True:
+        try:
+            return await ref.get_reply(src, request)
+        except FdbError as e:
+            if e.name != "broken_promise":
+                raise
+            await loop.delay(delay)

@@ -1,0 +1,156 @@
+"""Role interface structs: the request/reply schema between roles.
+
+Ref: fdbclient/MasterProxyInterface.h (CommitTransactionRequest :76,
+GetReadVersionRequest :122), fdbserver/ResolverInterface.h
+(ResolveTransactionBatchRequest :83), fdbserver/TLogInterface.h,
+fdbclient/StorageServerInterface.h.  Each *Interface dataclass carries the
+client-side RequestStreamRefs, like the reference's interface structs carry
+RequestStream<T> members.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..client.types import Mutation
+from ..conflict.types import TransactionConflictInfo
+from ..rpc.stream import RequestStreamRef
+
+
+# --- sequencer (master's version allocator; ref masterserver.actor.cpp:783) ---
+
+
+@dataclass
+class GetCommitVersionRequest:
+    requesting_proxy: str = ""
+
+
+@dataclass
+class GetCommitVersionReply:
+    version: int = 0
+    prev_version: int = 0
+
+
+@dataclass
+class SequencerInterface:
+    get_commit_version: RequestStreamRef = None
+    report_committed: RequestStreamRef = None  # proxy -> master committed ver
+    get_committed_version: RequestStreamRef = None
+
+
+# --- proxy (ref fdbclient/MasterProxyInterface.h) ---
+
+
+@dataclass
+class CommitTransactionRequest:
+    transaction: "object" = None  # client.types.CommitTransactionRef
+    flags: int = 0
+
+
+@dataclass
+class GetReadVersionRequest:
+    transaction_count: int = 1
+    flags: int = 0
+
+
+@dataclass
+class ProxyInterface:
+    commit: RequestStreamRef = None
+    get_consistent_read_version: RequestStreamRef = None
+
+
+# --- resolver (ref fdbserver/ResolverInterface.h:83-98) ---
+
+
+@dataclass
+class ResolveTransactionBatchRequest:
+    prev_version: int = 0
+    version: int = 0
+    last_received_version: int = 0
+    transactions: List[TransactionConflictInfo] = field(default_factory=list)
+
+
+@dataclass
+class ResolveTransactionBatchReply:
+    committed: List[int] = field(default_factory=list)  # conflict.types codes
+
+
+@dataclass
+class ResolverInterface:
+    resolve: RequestStreamRef = None
+
+
+# --- tlog (ref fdbserver/TLogInterface.h) ---
+
+
+@dataclass
+class TLogCommitRequest:
+    prev_version: int = 0
+    version: int = 0
+    mutations: List[Mutation] = field(default_factory=list)
+
+
+@dataclass
+class TLogPeekRequest:
+    begin_version: int = 0
+    # tag omitted in the single-storage slice; tag partitioning arrives with
+    # the TagPartitioned log system
+    limit_versions: int = 1000
+
+
+@dataclass
+class TLogPeekReply:
+    entries: List[Tuple[int, List[Mutation]]] = field(default_factory=list)
+    end_version: int = 0  # exclusive: peeked everything below this
+    has_more: bool = False
+
+
+@dataclass
+class TLogPopRequest:
+    version: int = 0  # durable-on-storage; log may discard <= version
+
+
+@dataclass
+class TLogInterface:
+    commit: RequestStreamRef = None
+    peek: RequestStreamRef = None
+    pop: RequestStreamRef = None
+
+
+# --- storage (ref fdbclient/StorageServerInterface.h) ---
+
+
+@dataclass
+class GetValueRequest:
+    key: bytes = b""
+    version: int = 0
+
+
+@dataclass
+class GetValueReply:
+    value: Optional[bytes] = None
+    version: int = 0
+
+
+@dataclass
+class GetKeyValuesRequest:
+    begin: bytes = b""
+    end: bytes = b"\xff"
+    version: int = 0
+    limit: int = 1 << 30
+    reverse: bool = False
+
+
+@dataclass
+class GetKeyValuesReply:
+    data: List[Tuple[bytes, bytes]] = field(default_factory=list)
+    more: bool = False
+    version: int = 0
+
+
+@dataclass
+class StorageInterface:
+    get_value: RequestStreamRef = None
+    get_key_values: RequestStreamRef = None
+    get_version: RequestStreamRef = None

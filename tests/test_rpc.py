@@ -1,0 +1,263 @@
+"""RPC + simulated network tests: request/reply, determinism, kills, clogs."""
+
+import pytest
+
+from foundationdb_tpu.flow import EventLoop, FdbError, set_event_loop
+from foundationdb_tpu.flow.asyncvar import AsyncVar, NotifiedVersion
+from foundationdb_tpu.rpc import RequestStream, SimNetwork
+from foundationdb_tpu.rpc.stream import retry_get_reply
+
+
+@pytest.fixture
+def net():
+    loop = EventLoop(seed=42)
+    set_event_loop(loop)
+    yield SimNetwork(loop)
+    set_event_loop(None)
+
+
+def make_echo_server(net, name="server"):
+    proc = net.process(name)
+    rs = RequestStream(proc, "echo")
+
+    async def server():
+        while True:
+            req, reply = await rs.pop()
+            reply.send(("echo", req))
+
+    proc.spawn(server(), "echo")
+    return proc, rs.ref()
+
+
+def test_request_reply(net):
+    _, ref = make_echo_server(net)
+    client = net.process("client")
+    got = {}
+
+    async def go():
+        got["v"] = await ref.get_reply(client, 123)
+
+    client.spawn(go())
+    net.loop.run()
+    assert got["v"] == ("echo", 123)
+    assert net.loop.now() > 0  # latency actually elapsed
+
+
+def test_determinism_same_seed():
+    def run(seed):
+        loop = EventLoop(seed=seed)
+        set_event_loop(loop)
+        net = SimNetwork(loop)
+        _, ref = make_echo_server(net)
+        client = net.process("client")
+        order = []
+
+        async def one(i):
+            await ref.get_reply(client, i)
+            order.append((i, loop.now()))
+
+        for i in range(10):
+            client.spawn(one(i))
+        loop.run()
+        set_event_loop(None)
+        return order
+
+    assert run(7) == run(7)
+    assert run(7) != run(8)  # different seed -> different latencies
+
+
+def test_kill_breaks_promise(net):
+    server, ref = make_echo_server(net)
+
+    # A server that never replies, so the request is outstanding at kill time.
+    slow = net.process("slow")
+    rs = RequestStream(slow, "never")
+
+    async def never():
+        while True:
+            await rs.pop()  # pop and drop
+
+    slow.spawn(never(), "never")
+    client = net.process("client")
+    result = {}
+
+    async def go():
+        try:
+            await rs.ref().get_reply(client, "hi")
+            result["r"] = "replied"
+        except FdbError as e:
+            result["r"] = e.name
+
+    client.spawn(go())
+
+    async def killer():
+        await net.loop.delay(0.01)
+        slow.kill()
+
+    client.spawn(killer())
+    net.loop.run()
+    assert result["r"] == "broken_promise"
+
+
+def test_get_reply_to_already_dead_process(net):
+    """A request to a process that is already dead must fail promptly with
+    broken_promise (the failed-connect path), not hang."""
+    server, ref = make_echo_server(net)
+    server.kill()
+    client = net.process("client")
+    result = {}
+
+    async def go():
+        try:
+            await ref.get_reply(client, 1)
+            result["r"] = "replied"
+        except FdbError as e:
+            result["r"] = e.name
+
+    client.spawn(go())
+    net.loop.run()
+    assert result["r"] == "broken_promise"
+    assert client._endpoints == {}  # no leaked one-shot reply endpoints
+
+
+def test_no_endpoint_leak_on_kill(net):
+    """Reply endpoints registered before a kill are dropped when broken."""
+    slow = net.process("slow")
+    rs = RequestStream(slow, "never")
+
+    async def never():
+        while True:
+            await rs.pop()
+
+    slow.spawn(never(), "never")
+    client = net.process("client")
+
+    async def go():
+        try:
+            await rs.ref().get_reply(client, "x")
+        except FdbError:
+            pass
+
+    client.spawn(go())
+
+    async def killer():
+        await net.loop.delay(0.01)
+        slow.kill()
+
+    client.spawn(killer())
+    net.loop.run()
+    assert client._endpoints == {}
+    assert client._pending_on == {}
+
+
+def test_retry_after_reboot(net):
+    """broken_promise retry reaches the rebooted server (same endpoint token)."""
+    proc = net.process("server")
+    token = 99
+
+    def start_server():
+        rs = RequestStream(proc, "echo", token=token)
+
+        async def server():
+            while True:
+                req, reply = await rs.pop()
+                reply.send(req * 2)
+
+        proc.spawn(server(), "echo")
+        return rs.ref()
+
+    ref = start_server()
+    client = net.process("client")
+    result = {}
+
+    async def go():
+        result["v"] = await retry_get_reply(ref, client, 21, delay=0.05)
+
+    client.spawn(go())
+
+    async def chaos():
+        await net.loop.delay(0.00001)  # kill before the request arrives
+        proc.kill()
+        await net.loop.delay(0.02)
+        proc.reboot()
+        start_server()
+
+    net.process("chaos").spawn(chaos())
+    net.loop.run()
+    assert result["v"] == 42
+
+
+def test_clog_delays_delivery(net):
+    server, ref = make_echo_server(net, "mserver")
+    client = net.process("mclient")
+    times = {}
+
+    async def go(tag):
+        await ref.get_reply(client, tag)
+        times[tag] = net.loop.now()
+
+    # First request unclogged for a baseline.
+    client.spawn(go("fast"))
+    net.loop.run()
+    baseline = times["fast"]
+    net.clog_pair("mclient", "mserver", 5.0)
+    client.spawn(go("slow"))
+    net.loop.run()
+    assert times["slow"] >= 5.0 > baseline
+
+
+def test_payload_isolation(net):
+    """Mutating a sent payload after send must not affect the receiver."""
+    proc = net.process("server")
+    rs = RequestStream(proc, "take")
+    seen = {}
+
+    async def server():
+        req, reply = await rs.pop()
+        seen["v"] = list(req)
+        reply.send(None)
+
+    proc.spawn(server())
+    client = net.process("client")
+
+    async def go():
+        payload = [1, 2, 3]
+        f = rs.ref().get_reply(client, payload)
+        payload.append(999)  # after-send mutation
+        await f
+
+    client.spawn(go())
+    net.loop.run()
+    assert seen["v"] == [1, 2, 3]
+
+
+def test_asyncvar_and_notified_version():
+    loop = EventLoop(seed=1)
+    set_event_loop(loop)
+    av = AsyncVar(1)
+    nv = NotifiedVersion(0)
+    log = []
+
+    async def watcher():
+        while av.get() < 3:
+            await av.on_change()
+        log.append(("av", av.get()))
+
+    async def waiter():
+        await nv.when_at_least(10)
+        log.append(("nv", nv.get()))
+
+    loop.spawn(watcher())
+    loop.spawn(waiter())
+
+    async def driver():
+        await loop.delay(0.01)
+        av.set(2)
+        av.set(3)
+        nv.set(5)
+        nv.set(12)
+
+    loop.spawn(driver())
+    loop.run()
+    assert ("av", 3) in log and ("nv", 12) in log
+    set_event_loop(None)
