@@ -1,0 +1,28 @@
+"""Client library: the rebuild of fdbclient/ — transaction API, RYW overlay,
+atomic ops, wire types."""
+
+from .atomic import apply_atomic, transform_versionstamp
+from .transaction import Database, Transaction
+from .types import (
+    ALL_KEYS,
+    CommitTransactionRef,
+    KeySelector,
+    Mutation,
+    MutationType,
+    key_after,
+    strinc,
+)
+
+__all__ = [
+    "apply_atomic",
+    "transform_versionstamp",
+    "Database",
+    "Transaction",
+    "ALL_KEYS",
+    "CommitTransactionRef",
+    "KeySelector",
+    "Mutation",
+    "MutationType",
+    "key_after",
+    "strinc",
+]

@@ -127,3 +127,31 @@ def apply_atomic(
     op: MutationType, existing: Optional[bytes], operand: bytes
 ) -> bytes:
     return APPLY[op](existing, operand)
+
+
+def transform_versionstamp(data: bytes, version: int, txn_number: int) -> bytes:
+    """Substitute the 10-byte versionstamp into a SET_VERSIONSTAMPED_* param.
+
+    Ref: Atomic.h transformVersionstampMutation :258 / placeVersionstamp
+    :249 — the param's final 4 bytes are a little-endian offset (stripped);
+    the stamp is 8-byte big-endian commit version + 2-byte big-endian
+    transaction-number-in-batch.  An out-of-bounds offset is
+    client_invalid_operation (ref: getVersionstampKeyRange :240), checked
+    client-side at mutation time via validate_versionstamp_param.
+    """
+    validate_versionstamp_param(data)
+    pos = int.from_bytes(data[-4:], "little", signed=True)
+    body = bytearray(data[:-4])
+    body[pos : pos + 8] = version.to_bytes(8, "big")
+    body[pos + 8 : pos + 10] = txn_number.to_bytes(2, "big")
+    return bytes(body)
+
+
+def validate_versionstamp_param(data: bytes) -> None:
+    from ..flow.error import FdbError
+
+    if len(data) < 4:
+        raise FdbError("client_invalid_operation")
+    pos = int.from_bytes(data[-4:], "little", signed=True)
+    if pos < 0 or pos + 10 > len(data) - 4:
+        raise FdbError("client_invalid_operation")

@@ -1,0 +1,281 @@
+"""Client transaction API: the NativeAPI + ReadYourWrites rebuild (v1).
+
+Ref: fdbclient/NativeAPI.actor.cpp (getReadVersion :2770, getValue :1164,
+getRange :1603, tryCommit :2361, retry loop onError) and
+fdbclient/ReadYourWrites.actor.cpp (uncommitted-write overlay on reads).
+
+RYW model: the transaction keeps its ordered mutation log; a read replays
+the mutations affecting that key over the storage snapshot value — simpler
+than the reference's versioned WriteMap treap but the same observable
+semantics (including atomic-op stacks and set/clear ordering).  Reads add
+read conflict ranges unless snapshot=True; every mutation adds its write
+conflict range (ref: commitMutations adding ranges per mutation).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from ..conflict.types import Range
+from ..flow.error import FdbError
+from ..flow.knobs import g_knobs
+from ..rpc.network import SimProcess
+from ..server.interfaces import (
+    CommitTransactionRequest,
+    GetKeyValuesRequest,
+    GetReadVersionRequest,
+    GetValueRequest,
+    ProxyInterface,
+    StorageInterface,
+)
+from .atomic import apply_atomic
+from .types import (
+    ATOMIC_TYPES,
+    CommitTransactionRef,
+    Mutation,
+    MutationType,
+    key_after,
+)
+
+
+class Database:
+    """A handle bound to a client process + cluster interfaces (ref:
+    Database/Cluster in NativeAPI.h; location cache arrives with sharding)."""
+
+    def __init__(
+        self,
+        process: SimProcess,
+        proxy: ProxyInterface,
+        storage: StorageInterface,
+    ):
+        self.process = process
+        self.proxy = proxy
+        self.storage = storage
+
+    def create_transaction(self) -> "Transaction":
+        return Transaction(self)
+
+    async def run(self, fn):
+        """Retry loop (ref: the @fdb.transactional decorator / onError)."""
+        tr = self.create_transaction()
+        while True:
+            try:
+                result = await fn(tr)
+                await tr.commit()
+                return result
+            except FdbError as e:
+                await tr.on_error(e)
+
+
+class Transaction:
+    def __init__(self, db: Database):
+        self.db = db
+        self._read_version: Optional[int] = None
+        self.mutations: List[Mutation] = []
+        self.read_conflict_ranges: List[Range] = []
+        self.write_conflict_ranges: List[Range] = []
+        self.committed_version: Optional[int] = None
+        self._retries = 0
+
+    # --- versions ---
+    async def get_read_version(self) -> int:
+        if self._read_version is None:
+            self._read_version = await self.db.proxy.get_consistent_read_version.get_reply(
+                self.db.process, GetReadVersionRequest()
+            )
+        return self._read_version
+
+    def set_read_version(self, version: int):
+        self._read_version = version
+
+    # --- local overlay (RYW) ---
+    def _replay(self, key: bytes, base: Optional[bytes]) -> Optional[bytes]:
+        """Apply this txn's mutation log, in order, to `base` for `key`."""
+        val = base
+        for m in self.mutations:
+            if m.type == MutationType.CLEAR_RANGE:
+                if m.param1 <= key < m.param2:
+                    val = None
+            elif m.param1 != key:
+                continue
+            elif m.type == MutationType.SET_VALUE:
+                val = m.param2
+            elif m.type in (
+                MutationType.SET_VERSIONSTAMPED_KEY,
+                MutationType.SET_VERSIONSTAMPED_VALUE,
+            ):
+                raise FdbError("accessed_unreadable")
+            elif m.type in ATOMIC_TYPES:
+                val = apply_atomic(m.type, val, m.param2)
+        return val
+
+    def _touched_keys(self, begin: bytes, end: bytes) -> List[bytes]:
+        out = set()
+        for m in self.mutations:
+            if m.type != MutationType.CLEAR_RANGE and begin <= m.param1 < end:
+                out.add(m.param1)
+        return sorted(out)
+
+    # --- reads ---
+    async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
+        version = await self.get_read_version()
+        reply = await self.db.storage.get_value.get_reply(
+            self.db.process, GetValueRequest(key=key, version=version)
+        )
+        if not snapshot:
+            self.add_read_conflict_range(key, key_after(key))
+        return self._replay(key, reply.value)
+
+    async def get_range(
+        self,
+        begin: bytes,
+        end: bytes,
+        limit: int = 1 << 30,
+        reverse: bool = False,
+        snapshot: bool = False,
+    ) -> List[Tuple[bytes, bytes]]:
+        version = await self.get_read_version()
+        reply = await self.db.storage.get_key_values.get_reply(
+            self.db.process,
+            GetKeyValuesRequest(
+                begin=begin, end=end, version=version, limit=limit, reverse=reverse
+            ),
+        )
+        base = dict(reply.data)
+        merged = set(base)
+        merged.update(self._touched_keys(begin, end))
+        out = []
+        for k in sorted(merged, reverse=reverse):
+            v = self._replay(k, base.get(k))
+            if v is not None:
+                out.append((k, v))
+                if len(out) >= limit:
+                    break
+        if not snapshot:
+            # Conflict range covers only what was actually observed: when the
+            # limit truncated the scan, trim to the returned extent (ref: RYW
+            # readThrough trimming on limited reads).
+            if len(out) >= limit and out:
+                if reverse:
+                    self.add_read_conflict_range(out[-1][0], end)
+                else:
+                    self.add_read_conflict_range(begin, key_after(out[-1][0]))
+            else:
+                self.add_read_conflict_range(begin, end)
+        return out
+
+    # --- writes ---
+    def set(self, key: bytes, value: bytes):
+        self._check_size(key, value)
+        self.mutations.append(Mutation(MutationType.SET_VALUE, key, value))
+        self.add_write_conflict_range(key, key_after(key))
+
+    def clear(self, key: bytes):
+        self.mutations.append(
+            Mutation(MutationType.CLEAR_RANGE, key, key_after(key))
+        )
+        self.add_write_conflict_range(key, key_after(key))
+
+    def clear_range(self, begin: bytes, end: bytes):
+        if begin > end:
+            raise FdbError("inverted_range")
+        self.mutations.append(Mutation(MutationType.CLEAR_RANGE, begin, end))
+        self.add_write_conflict_range(begin, end)
+
+    def atomic_op(self, op: MutationType, key: bytes, operand: bytes):
+        assert op in ATOMIC_TYPES, op
+        self._check_size(key, operand)
+        if op == MutationType.SET_VERSIONSTAMPED_KEY:
+            from .atomic import validate_versionstamp_param
+
+            validate_versionstamp_param(key)
+            # The stamped key is unknown until commit; conflict on the whole
+            # possible stamp range (ref: getVersionstampKeyRange :226).
+            pos = int.from_bytes(key[-4:], "little", signed=True)
+            body = key[:-4]
+            self.mutations.append(Mutation(op, key, operand))
+            self.add_write_conflict_range(
+                body[:pos] + b"\x00" * 10 + body[pos + 10 :],
+                key_after(body[:pos] + b"\xff" * 10 + body[pos + 10 :]),
+            )
+            return
+        if op == MutationType.SET_VERSIONSTAMPED_VALUE:
+            from .atomic import validate_versionstamp_param
+
+            validate_versionstamp_param(operand)
+        self.mutations.append(Mutation(op, key, operand))
+        self.add_write_conflict_range(key, key_after(key))
+
+    def _check_size(self, key: bytes, value: bytes):
+        ck = g_knobs.client
+        if len(key) > ck.key_size_limit:
+            raise FdbError("key_too_large")
+        if len(value) > ck.value_size_limit:
+            raise FdbError("value_too_large")
+
+    # --- conflict ranges ---
+    def add_read_conflict_range(self, begin: bytes, end: bytes):
+        if begin < end:
+            self.read_conflict_ranges.append((begin, end))
+
+    def add_write_conflict_range(self, begin: bytes, end: bytes):
+        if begin < end:
+            self.write_conflict_ranges.append((begin, end))
+
+    # --- commit ---
+    async def commit(self) -> Optional[int]:
+        if not self.mutations and not self.write_conflict_ranges:
+            self.committed_version = self._read_version
+            return self.committed_version  # read-only: nothing to do
+        read_snapshot = (
+            self._read_version if self.read_conflict_ranges else 0
+        ) or 0
+        tref = CommitTransactionRef(
+            read_snapshot=read_snapshot,
+            read_conflict_ranges=_coalesce(self.read_conflict_ranges),
+            write_conflict_ranges=_coalesce(self.write_conflict_ranges),
+            mutations=list(self.mutations),
+        )
+        version = await self.db.proxy.commit.get_reply(
+            self.db.process, CommitTransactionRequest(transaction=tref)
+        )
+        self.committed_version = version
+        return version
+
+    async def on_error(self, e: FdbError):
+        """Backoff + reset if retryable, else re-raise (ref: onError)."""
+        if not (
+            e.is_retryable_in_transaction() or e.name == "broken_promise"
+        ):
+            raise e
+        ck = g_knobs.client
+        delay = min(
+            ck.max_retry_delay, ck.initial_retry_delay * (2**self._retries)
+        )
+        self._retries += 1
+        await self.db.process.network.loop.delay(
+            delay * self.db.process.network.loop.rng.random01()
+        )
+        self.reset()
+
+    def reset(self):
+        self._read_version = None
+        self.mutations = []
+        self.read_conflict_ranges = []
+        self.write_conflict_ranges = []
+        self.committed_version = None
+
+
+def _coalesce(ranges: List[Range]) -> List[Range]:
+    """Merge overlapping/adjacent ranges (ref: the conflict-range coalescing
+    in CommitTransactionRef construction)."""
+    if len(ranges) <= 1:
+        return list(ranges)
+    s = sorted(ranges)
+    out = [list(s[0])]
+    for b, e in s[1:]:
+        if b <= out[-1][1]:
+            out[-1][1] = max(out[-1][1], e)
+        else:
+            out.append([b, e])
+    return [(b, e) for b, e in out]

@@ -116,6 +116,7 @@ class SimProcess:
         self.alive = True
         self._endpoints.clear()
         self._pending_on.clear()
+        self.network.mark_up(self.address)
 
 
 class SimNetwork:

@@ -1,0 +1,18 @@
+"""Server roles: the rebuild of fdbserver/ (one actor class per role).
+
+Landed: Sequencer (master's version allocator), Proxy (commit pipeline +
+GRV), Resolver (pluggable conflict backend incl. the TPU engines), TLog
+(in-memory v1), StorageServer (MVCC reads over pulled log data), SimCluster
+(single-generation wiring).  Recovery, coordination, data distribution and
+the tag-partitioned log system land with the control-plane milestone
+(SURVEY.md §7 step 6).
+"""
+
+from .cluster import SimCluster
+from .proxy import Proxy
+from .resolver import Resolver
+from .sequencer import Sequencer
+from .storage import StorageServer
+from .tlog import TLog
+
+__all__ = ["SimCluster", "Proxy", "Resolver", "Sequencer", "StorageServer", "TLog"]

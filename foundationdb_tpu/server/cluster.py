@@ -1,0 +1,74 @@
+"""Single-generation simulated cluster: the minimum end-to-end slice.
+
+Wires sequencer + proxy + resolver + tlog + storage on a SimNetwork (ref:
+the role wiring worker.actor.cpp does from Initialize*Requests after master
+recovery; recovery/recruitment itself arrives with the control plane).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..flow.eventloop import EventLoop, set_event_loop
+from ..rpc.network import SimNetwork
+from .proxy import Proxy
+from .resolver import Resolver
+from .sequencer import Sequencer
+from .storage import StorageServer
+from .tlog import TLog
+
+
+class SimCluster:
+    def __init__(
+        self,
+        seed: int = 1,
+        conflict_backend: str = "cpu",
+        conflict_set=None,
+        loop: Optional[EventLoop] = None,
+    ):
+        self.loop = loop or EventLoop(seed=seed)
+        set_event_loop(self.loop)
+        self.net = SimNetwork(self.loop)
+        self.master_proc = self.net.process("master")
+        self.resolver_proc = self.net.process("resolver")
+        self.tlog_proc = self.net.process("tlog")
+        self.storage_proc = self.net.process("storage")
+        self.proxy_proc = self.net.process("proxy")
+
+        self.sequencer = Sequencer(self.master_proc)
+        self.resolver = Resolver(
+            self.resolver_proc,
+            backend=conflict_backend,
+            conflict_set=conflict_set,
+        )
+        self.tlog = TLog(self.tlog_proc)
+        self.storage = StorageServer(self.storage_proc, self.tlog.interface())
+        self.proxy = Proxy(
+            self.proxy_proc,
+            self.sequencer.interface(),
+            [self.resolver.interface()],
+            [self.tlog.interface()],
+        )
+        self._n_clients = 0
+
+    def database(self, name: str = ""):
+        # Imported here: client.transaction imports server.interfaces (the
+        # interface structs live with the client, as in fdbclient/), so a
+        # module-level import would be circular.
+        from ..client.transaction import Database
+
+        self._n_clients += 1
+        proc = self.net.process(name or f"client{self._n_clients}")
+        return Database(
+            proc, self.proxy.interface(), self.storage.interface()
+        )
+
+    def run_until(self, future, timeout_vt: float = 1000.0):
+        return self.loop.run_until(future, timeout_vt=timeout_vt)
+
+    def run_all(self, coros_by_db, timeout_vt: float = 1000.0):
+        """Spawn one coroutine per (db, coro) pair and run until all done."""
+        from ..flow.eventloop import all_of
+
+        tasks = [db.process.spawn(c) for db, c in coros_by_db]
+        return self.run_until(all_of(tasks), timeout_vt=timeout_vt)

@@ -1,0 +1,69 @@
+"""Resolver role: MVCC conflict decision per version window.
+
+Ref: Resolver.actor.cpp resolveBatch :71 — per-proxy ordering by prevVersion
+(:104-115 via NotifiedVersion), ConflictBatch over the ConflictSet
+(:140-153), window GC at version - MAX_WRITE_TRANSACTION_LIFE_VERSIONS
+(:153).  The conflict backend is pluggable (conflict.api.ConflictSet):
+"cpu", "jax", "hybrid", or a mesh-sharded set from parallel/ — the
+north-star swap point (BASELINE.json).
+"""
+
+from __future__ import annotations
+
+from ..conflict.api import ConflictSet
+from ..flow.asyncvar import NotifiedVersion
+from ..flow.knobs import g_knobs
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream
+from .interfaces import (
+    ResolveTransactionBatchReply,
+    ResolveTransactionBatchRequest,
+    ResolverInterface,
+)
+
+
+class Resolver:
+    def __init__(
+        self,
+        process: SimProcess,
+        backend: str = "cpu",
+        epoch_begin_version: int = 0,
+        conflict_set: ConflictSet = None,
+    ):
+        self.process = process
+        self.conflicts = conflict_set or ConflictSet(
+            backend=backend, oldest_version=epoch_begin_version
+        )
+        self.version = NotifiedVersion(epoch_begin_version)
+        self.total_resolved = 0
+        self._stream = RequestStream(process, "resolve")
+        process.spawn(self._serve(), "resolver")
+
+    def interface(self) -> ResolverInterface:
+        return ResolverInterface(resolve=self._stream.ref())
+
+    async def _serve(self):
+        while True:
+            req, reply = await self._stream.pop()
+            self.process.spawn(self._resolve_one(req, reply), "resolve_batch")
+
+    async def _resolve_one(self, req: ResolveTransactionBatchRequest, reply):
+        # Order batches by the sequencer's prevVersion chain: a batch may
+        # arrive before its predecessor (ref :104-115).
+        await self.version.when_at_least(req.prev_version)
+        if req.version > self.version.get():
+            batch = self.conflicts.new_batch()
+            for tr in req.transactions:
+                batch.add_transaction(tr)
+            window = g_knobs.server.max_write_transaction_life_versions
+            statuses = batch.detect_conflicts(
+                now=req.version, new_oldest_version=req.version - window
+            )
+            self.total_resolved += len(statuses)
+            self.version.set(req.version)
+            reply.send(ResolveTransactionBatchReply(committed=statuses))
+        else:
+            # Duplicate/replayed batch (proxy retry after timeout): the
+            # reference answers from its per-proxy reply cache; with a
+            # single proxy a duplicate can only be a stale retry.
+            reply.send_error("operation_failed")

@@ -1,0 +1,70 @@
+"""Sequencer: the master's commit-version allocator.
+
+Ref: masterserver.actor.cpp getVersion :783 — hands out monotone commit
+versions with prevVersion chaining so resolvers and logs can totally order
+batches; provideVersions :850 serves the stream.  Version arithmetic follows
+the reference: advance roughly versions_per_second * elapsed, never
+backwards.
+"""
+
+from __future__ import annotations
+
+from ..flow.asyncvar import NotifiedVersion
+from ..flow.knobs import g_knobs
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream
+from .interfaces import (
+    GetCommitVersionReply,
+    SequencerInterface,
+)
+
+
+class Sequencer:
+    def __init__(self, process: SimProcess, epoch_begin_version: int = 0):
+        self.process = process
+        self.version = epoch_begin_version  # last version handed out
+        self.committed = NotifiedVersion(epoch_begin_version)
+        self._last_grant_time = process.network.loop.now()
+        self._commit_stream = RequestStream(process, "get_commit_version")
+        self._report_stream = RequestStream(process, "report_committed")
+        self._read_stream = RequestStream(process, "get_committed_version")
+        process.spawn(self._serve_commit_versions(), "sequencer_commit")
+        process.spawn(self._serve_reports(), "sequencer_report")
+        process.spawn(self._serve_reads(), "sequencer_read")
+
+    def interface(self) -> SequencerInterface:
+        return SequencerInterface(
+            get_commit_version=self._commit_stream.ref(),
+            report_committed=self._report_stream.ref(),
+            get_committed_version=self._read_stream.ref(),
+        )
+
+    def _next_version(self) -> tuple:
+        """(version, prev_version): versions track virtual time (ref:
+        getVersion computes t1*VERSIONS_PER_SECOND skew :800-809)."""
+        loop = self.process.network.loop
+        now = loop.now()
+        vps = g_knobs.server.versions_per_second
+        advance = max(1, int((now - self._last_grant_time) * vps))
+        self._last_grant_time = now
+        prev = self.version
+        self.version = prev + advance
+        return self.version, prev
+
+    async def _serve_commit_versions(self):
+        while True:
+            _req, reply = await self._commit_stream.pop()
+            version, prev = self._next_version()
+            reply.send(GetCommitVersionReply(version=version, prev_version=prev))
+
+    async def _serve_reports(self):
+        while True:
+            version, reply = await self._report_stream.pop()
+            if version > self.committed.get():
+                self.committed.set(version)
+            reply.send(None)
+
+    async def _serve_reads(self):
+        while True:
+            _req, reply = await self._read_stream.pop()
+            reply.send(self.committed.get())

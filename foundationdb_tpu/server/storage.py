@@ -1,0 +1,221 @@
+"""Storage server role: versioned MVCC reads over pulled log data.
+
+Ref: storageserver.actor.cpp — VersionedData :236-260 (MVCC window),
+getValueQ :684 / getKeyValues :1182 read path with waitForVersion :631;
+update() pulls mutations from the log via peek and applies them in version
+order; atomics are applied at the storage server exactly as the client
+would (shared fdbclient/Atomic.h semantics -> client/atomic.py).
+
+v1 model: per-key version chains + a version-stamped clear-range list; one
+storage process owns the whole key space (sharding arrives with
+DataDistribution).  All history is retained in-memory; the durability
+milestone adds the persistent engine + window trimming.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, bisect_right, insort
+from typing import Dict, List, Optional, Tuple
+
+from ..client.atomic import apply_atomic
+from ..client.types import Mutation, MutationType
+from ..flow.asyncvar import NotifiedVersion
+from ..flow.knobs import g_knobs
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream
+from .interfaces import (
+    GetKeyValuesReply,
+    GetKeyValuesRequest,
+    GetValueReply,
+    GetValueRequest,
+    StorageInterface,
+    TLogInterface,
+    TLogPeekRequest,
+    TLogPopRequest,
+)
+
+
+class VersionedStore:
+    """Per-key version chains + clear-range history (the flat-python stand-in
+    for the reference's PTree VersionedMap, fdbclient/VersionedMap.h:43).
+
+    Entries are ordered by (version, seq) where seq is the mutation's index
+    within its version, so set-then-clear vs clear-then-set of the same key
+    inside one commit resolve exactly as the mutation order says.
+    """
+
+    _SEQ_INF = 1 << 62
+
+    def __init__(self):
+        # key -> [(version, seq, value-or-None)]
+        self.kv: Dict[bytes, List[Tuple[int, int, Optional[bytes]]]] = {}
+        self.sorted_keys: List[bytes] = []
+        # (version, seq, begin, end)
+        self.clears: List[Tuple[int, int, bytes, bytes]] = []
+
+    # -- reads --
+    def _latest_clear_over(self, key: bytes, version: int) -> Tuple[int, int]:
+        best = (-1, -1)
+        for v, s, b, e in self.clears:
+            if v <= version and b <= key < e and (v, s) > best:
+                best = (v, s)
+        return best
+
+    def get(self, key: bytes, version: int) -> Optional[bytes]:
+        chain = self.kv.get(key)
+        stamp_e, val = (-1, -1), None
+        if chain:
+            i = bisect_right(chain, (version, self._SEQ_INF)) - 1
+            if i >= 0:
+                ver, seq, val = chain[i]
+                stamp_e = (ver, seq)
+        if self._latest_clear_over(key, version) > stamp_e:
+            return None
+        return val
+
+    def get_range(
+        self,
+        begin: bytes,
+        end: bytes,
+        version: int,
+        limit: int,
+        reverse: bool = False,
+    ) -> List[Tuple[bytes, bytes]]:
+        i = bisect_left(self.sorted_keys, begin)
+        j = bisect_left(self.sorted_keys, end)
+        keys = self.sorted_keys[i:j]
+        if reverse:
+            keys = reversed(keys)
+        out = []
+        for k in keys:
+            v = self.get(k, version)
+            if v is not None:
+                out.append((k, v))
+                if len(out) >= limit:
+                    break
+        return out
+
+    # -- writes (applied in (version, seq) order by the update loop) --
+    def set(self, key: bytes, value: bytes, version: int, seq: int = 0):
+        chain = self.kv.get(key)
+        if chain is None:
+            self.kv[key] = [(version, seq, value)]
+            insort(self.sorted_keys, key)
+        else:
+            chain.append((version, seq, value))
+
+    def clear_range(self, begin: bytes, end: bytes, version: int, seq: int = 0):
+        self.clears.append((version, seq, begin, end))
+
+
+class StorageServer:
+    def __init__(
+        self,
+        process: SimProcess,
+        tlog: TLogInterface,
+        epoch_begin_version: int = 0,
+    ):
+        self.process = process
+        self.tlog = tlog
+        self.store = VersionedStore()
+        self.version = NotifiedVersion(epoch_begin_version)
+        self._gv_stream = RequestStream(process, "get_value")
+        self._gkv_stream = RequestStream(process, "get_key_values")
+        self._ver_stream = RequestStream(process, "get_version")
+        process.spawn(self._update_loop(), "ss_update")
+        process.spawn(self._serve_get_value(), "ss_get_value")
+        process.spawn(self._serve_get_key_values(), "ss_get_key_values")
+        process.spawn(self._serve_get_version(), "ss_get_version")
+
+    def interface(self) -> StorageInterface:
+        return StorageInterface(
+            get_value=self._gv_stream.ref(),
+            get_key_values=self._gkv_stream.ref(),
+            get_version=self._ver_stream.ref(),
+        )
+
+    # -- write path: pull from the log (ref: storageserver update()) --
+    async def _update_loop(self):
+        from ..rpc.stream import retry_get_reply
+
+        loop = self.process.network.loop
+        while True:
+            reply = await retry_get_reply(
+                self.tlog.peek,
+                self.process,
+                TLogPeekRequest(begin_version=self.version.get()),
+            )
+            for version, mutations in reply.entries:
+                if version <= self.version.get():
+                    continue
+                self._apply(version, mutations)
+                self.version.set(version)
+            # In-memory engine: applied == durable, pop eagerly (ref: tLogPop
+            # once storage has made data durable).
+            self.tlog.pop.send(
+                self.process, TLogPopRequest(version=self.version.get())
+            )
+            if not reply.has_more:
+                await loop.delay(0.001)  # poll; push-based peek comes later
+
+    def _apply(self, version: int, mutations: List[Mutation]):
+        for seq, m in enumerate(mutations):
+            if m.type == MutationType.SET_VALUE:
+                self.store.set(m.param1, m.param2, version, seq)
+            elif m.type == MutationType.CLEAR_RANGE:
+                self.store.clear_range(m.param1, m.param2, version, seq)
+            elif m.type in (MutationType.NO_OP, MutationType.DEBUG_KEY):
+                pass
+            else:
+                existing = self.store.get(m.param1, version)
+                self.store.set(
+                    m.param1, apply_atomic(m.type, existing, m.param2), version, seq
+                )
+
+    # -- read path --
+    async def _wait_for_version(self, version: int):
+        """Ref: waitForVersion storageserver.actor.cpp:631."""
+        if version > self.version.get() + g_knobs.server.max_versions_in_flight:
+            from ..flow.error import FdbError
+
+            raise FdbError("future_version")
+        await self.version.when_at_least(version)
+
+    async def _serve_get_value(self):
+        while True:
+            req, reply = await self._gv_stream.pop()
+            self.process.spawn(self._get_value_one(req, reply), "ss_gv")
+
+    async def _get_value_one(self, req: GetValueRequest, reply):
+        try:
+            await self._wait_for_version(req.version)
+        except Exception as e:  # noqa: BLE001
+            reply.send_error(getattr(e, "name", "internal_error"))
+            return
+        reply.send(
+            GetValueReply(value=self.store.get(req.key, req.version), version=req.version)
+        )
+
+    async def _serve_get_key_values(self):
+        while True:
+            req, reply = await self._gkv_stream.pop()
+            self.process.spawn(self._get_key_values_one(req, reply), "ss_gkv")
+
+    async def _get_key_values_one(self, req: GetKeyValuesRequest, reply):
+        try:
+            await self._wait_for_version(req.version)
+        except Exception as e:  # noqa: BLE001
+            reply.send_error(getattr(e, "name", "internal_error"))
+            return
+        data = self.store.get_range(
+            req.begin, req.end, req.version, req.limit + 1, req.reverse
+        )
+        more = len(data) > req.limit
+        reply.send(
+            GetKeyValuesReply(data=data[: req.limit], more=more, version=req.version)
+        )
+
+    async def _serve_get_version(self):
+        while True:
+            _req, reply = await self._ver_stream.pop()
+            reply.send(self.version.get())

@@ -1,0 +1,97 @@
+"""TLog role: the durable mutation log (in-memory v1).
+
+Ref: TLogServer.actor.cpp — commit path appends version->messages and
+fsyncs (here: a simulated commit delay), tLogPeekMessages :946 serves
+storage servers, tLogPop :894 discards data durable on storage.  Tag
+partitioning and disk spill arrive with the TagPartitioned log system; this
+v1 keeps one logical tag.
+"""
+
+from __future__ import annotations
+
+from bisect import bisect_left, bisect_right
+from typing import List, Tuple
+
+from ..flow.asyncvar import NotifiedVersion
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream
+from .interfaces import (
+    TLogCommitRequest,
+    TLogInterface,
+    TLogPeekReply,
+    TLogPeekRequest,
+    TLogPopRequest,
+)
+
+# Simulated fsync time for the in-memory log (a DiskQueue with a simulated
+# IAsyncFile replaces this in the durability milestone).
+COMMIT_DELAY = 0.0005
+
+
+class TLog:
+    def __init__(self, process: SimProcess, epoch_begin_version: int = 0):
+        self.process = process
+        # Parallel sorted lists: versions[i] holds mutation list entries[i].
+        self.versions: List[int] = []
+        self.entries: List[list] = []
+        self.durable = NotifiedVersion(epoch_begin_version)
+        self.popped = epoch_begin_version
+        self._commit_stream = RequestStream(process, "tlog_commit")
+        self._peek_stream = RequestStream(process, "tlog_peek")
+        self._pop_stream = RequestStream(process, "tlog_pop")
+        process.spawn(self._serve_commit(), "tlog_commit")
+        process.spawn(self._serve_peek(), "tlog_peek")
+        process.spawn(self._serve_pop(), "tlog_pop")
+
+    def interface(self) -> TLogInterface:
+        return TLogInterface(
+            commit=self._commit_stream.ref(),
+            peek=self._peek_stream.ref(),
+            pop=self._pop_stream.ref(),
+        )
+
+    async def _serve_commit(self):
+        while True:
+            req, reply = await self._commit_stream.pop()
+            self.process.spawn(self._commit_one(req, reply), "tlog_commit_one")
+
+    async def _commit_one(self, req: TLogCommitRequest, reply):
+        # Versions are committed in the sequencer's order (ref: TLogServer
+        # waits version ordering before appending).
+        await self.durable.when_at_least(req.prev_version)
+        if req.version <= self.durable.get():
+            reply.send(self.durable.get())  # duplicate
+            return
+        self.versions.append(req.version)
+        self.entries.append(req.mutations)
+        await self.process.network.loop.delay(COMMIT_DELAY)  # fsync stand-in
+        self.durable.set(req.version)
+        reply.send(req.version)
+
+    async def _serve_peek(self):
+        while True:
+            req, reply = await self._peek_stream.pop()
+            i = bisect_right(self.versions, req.begin_version)
+            j = min(i + req.limit_versions, len(self.versions))
+            # Only durable versions are visible to peeks.
+            durable_end = bisect_right(self.versions, self.durable.get())
+            j = min(j, durable_end)
+            reply.send(
+                TLogPeekReply(
+                    entries=list(zip(self.versions[i:j], self.entries[i:j])),
+                    end_version=self.durable.get()
+                    if j == durable_end
+                    else self.versions[j - 1] if j > i else req.begin_version,
+                    has_more=j < durable_end,
+                )
+            )
+
+    async def _serve_pop(self):
+        while True:
+            req, reply = await self._pop_stream.pop()
+            if req.version > self.popped:
+                self.popped = req.version
+                k = bisect_right(self.versions, req.version)
+                del self.versions[:k]
+                del self.entries[:k]
+            reply.send(None)

@@ -1,0 +1,368 @@
+"""End-to-end slice tests: client -> proxy -> resolver -> tlog -> storage.
+
+Workload designs follow the reference's simulation workloads (SURVEY.md §4):
+Cycle (fdbserver/workloads/Cycle.actor.cpp: transactional pointer-chasing
+ring whose total invariant survives concurrency), AtomicOps, WriteDuringRead
+-style RYW checks, and Sideband-style causal reads.  All runs are seeded and
+deterministic.
+"""
+
+import pytest
+
+from foundationdb_tpu.client.types import MutationType
+from foundationdb_tpu.flow import FdbError, set_event_loop
+from foundationdb_tpu.server import SimCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def test_set_get_commit():
+    c = SimCluster(seed=1)
+    db = c.database()
+    out = {}
+
+    async def go(tr):
+        tr.set(b"hello", b"world")
+        out["pre"] = await tr.get(b"hello")  # RYW sees uncommitted write
+
+    c.run_all([(db, db.run(go))])
+    assert out["pre"] == b"world"
+
+    async def check(tr):
+        out["post"] = await tr.get(b"hello")
+        out["missing"] = await tr.get(b"nope")
+
+    c.run_all([(db, db.run(check))])
+    assert out["post"] == b"world"
+    assert out["missing"] is None
+
+
+def test_clear_range_and_get_range():
+    c = SimCluster(seed=2)
+    db = c.database()
+    out = {}
+
+    async def fill(tr):
+        for i in range(10):
+            tr.set(b"k%02d" % i, b"v%d" % i)
+
+    async def clear(tr):
+        tr.clear_range(b"k03", b"k07")
+        out["ryw"] = await tr.get_range(b"k", b"l")  # sees the clear pre-commit
+
+    async def check(tr):
+        out["post"] = await tr.get_range(b"k", b"l")
+        out["limited"] = await tr.get_range(b"k", b"l", limit=2)
+        out["rev"] = await tr.get_range(b"k", b"l", limit=2, reverse=True)
+
+    c.run_all([(db, db.run(fill))])
+    c.run_all([(db, db.run(clear))])
+    c.run_all([(db, db.run(check))])
+    keys = [k for k, _ in out["post"]]
+    assert keys == [b"k00", b"k01", b"k02", b"k07", b"k08", b"k09"]
+    assert out["ryw"] == out["post"]
+    assert [k for k, _ in out["limited"]] == [b"k00", b"k01"]
+    assert [k for k, _ in out["rev"]] == [b"k09", b"k08"]
+
+
+def test_conflict_between_transactions():
+    """Classic write-skew prevention: two txns read the same key at the same
+    snapshot, both write it; exactly one commits (ref: Serializability)."""
+    c = SimCluster(seed=3)
+    db1, db2 = c.database(), c.database()
+    results = []
+
+    def make(db, me):
+        async def go():
+            tr = db.create_transaction()
+            try:
+                v = await tr.get(b"counter")
+                n = int(v or b"0")
+                tr.set(b"counter", b"%d" % (n + 1))
+                await tr.commit()
+                results.append((me, "committed"))
+            except FdbError as e:
+                results.append((me, e.name))
+
+        return go()
+
+    # Launch both concurrently: same read snapshot, conflicting writes.
+    c.run_all([(db1, make(db1, 1)), (db2, make(db2, 2))])
+    statuses = sorted(s for _, s in results)
+    assert statuses == ["committed", "not_committed"], results
+
+
+def test_cycle_workload_invariant():
+    """Cycle workload: N nodes in a ring, each txn rotates 3 pointers; the
+    ring's total and reachability are invariant (ref: Cycle.actor.cpp)."""
+    N = 8
+    OPS = 30
+    c = SimCluster(seed=4)
+    db_init = c.database()
+
+    async def init(tr):
+        for i in range(N):
+            tr.set(b"cycle/%03d" % i, b"%03d" % ((i + 1) % N))
+
+    c.run_all([(db_init, db_init.run(init))])
+
+    dbs = [c.database() for _ in range(4)]
+    done = []
+
+    def worker(db, wid):
+        async def go():
+            rng = c.loop.rng
+            for _ in range(OPS):
+                async def op(tr):
+                    a = int(rng.random_int(0, N))
+                    ka = b"cycle/%03d" % a
+                    b = int((await tr.get(ka)).decode())
+                    kb = b"cycle/%03d" % b
+                    cc = int((await tr.get(kb)).decode())
+                    kc = b"cycle/%03d" % cc
+                    d = int((await tr.get(kc)).decode())
+                    # rotate: a->c, c->b, b->d
+                    tr.set(ka, b"%03d" % cc)
+                    tr.set(kc, b"%03d" % b)
+                    tr.set(kb, b"%03d" % d)
+
+                await db.run(op)
+            done.append(wid)
+
+        return go()
+
+    c.run_all(
+        [(db, worker(db, i)) for i, db in enumerate(dbs)], timeout_vt=5000.0
+    )
+    assert len(done) == 4
+
+    out = {}
+
+    async def check(tr):
+        out["ring"] = await tr.get_range(b"cycle/", b"cycle0")
+
+    c.run_all([(db_init, db_init.run(check))])
+    ring = {k: int(v.decode()) for k, v in out["ring"]}
+    assert len(ring) == N
+    # Reachability: following pointers from 0 visits every node exactly once.
+    seen, cur = set(), 0
+    for _ in range(N):
+        assert cur not in seen
+        seen.add(cur)
+        cur = ring[b"cycle/%03d" % cur]
+    assert cur == 0 and len(seen) == N
+
+
+def test_atomic_ops_end_to_end():
+    c = SimCluster(seed=5)
+    db = c.database()
+    out = {}
+
+    async def add(tr):
+        tr.atomic_op(MutationType.ADD_VALUE, b"sum", (5).to_bytes(8, "little"))
+
+    for _ in range(3):
+        c.run_all([(db, db.run(add))])
+
+    async def check(tr):
+        out["sum"] = await tr.get(b"sum")
+        # RYW atomic on top of a stored value
+        tr.atomic_op(MutationType.ADD_VALUE, b"sum", (1).to_bytes(8, "little"))
+        out["ryw"] = await tr.get(b"sum")
+        tr.atomic_op(MutationType.BYTE_MAX, b"bm", b"abc")
+        out["bm"] = await tr.get(b"bm")
+
+    c.run_all([(db, db.run(check))])
+    assert int.from_bytes(out["sum"], "little") == 15
+    assert int.from_bytes(out["ryw"], "little") == 16
+    assert out["bm"] == b"abc"
+
+
+def test_versionstamped_key():
+    c = SimCluster(seed=6)
+    db = c.database()
+
+    async def write(tr):
+        # key = prefix + 10 stamp bytes, offset 4 (little-endian suffix)
+        key = b"log/" + b"\x00" * 10 + (4).to_bytes(4, "little")
+        tr.atomic_op(MutationType.SET_VERSIONSTAMPED_KEY, key, b"payload")
+
+    c.run_all([(db, db.run(write))])
+    out = {}
+
+    async def check(tr):
+        out["rows"] = await tr.get_range(b"log/", b"log0")
+
+    c.run_all([(db, db.run(check))])
+    assert len(out["rows"]) == 1
+    k, v = out["rows"][0]
+    assert v == b"payload" and len(k) == 14
+    stamp_version = int.from_bytes(k[4:12], "big")
+    assert stamp_version > 0  # the commit version was substituted
+
+
+def test_set_then_clear_same_transaction():
+    """Mutation order within one commit must hold at storage: set;clear ->
+    gone, clear;set -> present (regression: intra-version ordering)."""
+    c = SimCluster(seed=13)
+    db = c.database()
+
+    async def w1(tr):
+        tr.set(b"a", b"x")
+        tr.clear(b"a")
+        tr.clear(b"b")
+        tr.set(b"b", b"y")
+
+    c.run_all([(db, db.run(w1))])
+    out = {}
+
+    async def check(tr):
+        out["a"] = await tr.get(b"a")
+        out["b"] = await tr.get(b"b")
+
+    c.run_all([(db, db.run(check))])
+    assert out["a"] is None
+    assert out["b"] == b"y"
+
+
+def test_versionstamp_invalid_offset_rejected():
+    c = SimCluster(seed=14)
+    db = c.database()
+    tr = db.create_transaction()
+    with pytest.raises(FdbError) as ei:
+        tr.atomic_op(
+            MutationType.SET_VERSIONSTAMPED_KEY,
+            b"xy" + (100).to_bytes(4, "little"),
+            b"v",
+        )
+    assert ei.value.name == "client_invalid_operation"
+
+
+def test_limited_range_read_trims_conflict_range():
+    """A limit-truncated range read must not conflict with writes beyond the
+    returned extent (regression: full-range conflict on limited reads)."""
+    c = SimCluster(seed=15)
+    db1, db2 = c.database(), c.database()
+
+    async def fill(tr):
+        for i in range(6):
+            tr.set(b"t%02d" % i, b"v")
+
+    c.run_all([(db1, db1.run(fill))])
+    results = []
+
+    async def limited_reader():
+        tr = db1.create_transaction()
+        try:
+            rows = await tr.get_range(b"t", b"u", limit=2)
+            assert [k for k, _ in rows] == [b"t00", b"t01"]
+            await c.loop.delay(0.05)  # let the far writer commit in between
+            tr.set(b"reader_done", b"1")
+            await tr.commit()
+            results.append("reader_committed")
+        except FdbError as e:
+            results.append(f"reader_{e.name}")
+
+    async def far_writer():
+        tr = db2.create_transaction()
+        await tr.get_read_version()
+        tr.set(b"t05", b"clobber")  # beyond the reader's returned extent
+        await tr.commit()
+        results.append("writer_committed")
+
+    c.run_all([(db1, limited_reader()), (db2, far_writer())])
+    assert "reader_committed" in results and "writer_committed" in results
+
+
+def test_causal_consistency_across_clients():
+    """Sideband-style: after A commits, B's fresh snapshot must see it."""
+    c = SimCluster(seed=7)
+    a, b = c.database(), c.database()
+    out = {}
+
+    async def writer(tr):
+        tr.set(b"flag", b"1")
+
+    c.run_all([(a, a.run(writer))])
+
+    async def reader(tr):
+        out["v"] = await tr.get(b"flag")
+
+    c.run_all([(b, b.run(reader))])
+    assert out["v"] == b"1"
+
+
+def test_determinism_same_seed_same_history():
+    def run(seed):
+        c = SimCluster(seed=seed)
+        dbs = [c.database() for _ in range(3)]
+        log = []
+
+        def w(db, i):
+            async def go():
+                for j in range(5):
+                    async def op(tr):
+                        v = await tr.get(b"x")
+                        tr.set(b"x", (v or b"") + b"%d" % i)
+
+                    await db.run(op)
+                log.append((i, round(c.loop.now(), 9)))
+
+            return go()
+
+        c.run_all([(db, w(db, i)) for i, db in enumerate(dbs)])
+        final = {}
+
+        async def check(tr):
+            final["x"] = await tr.get(b"x")
+
+        c.run_all([(dbs[0], dbs[0].run(check))])
+        return log, final["x"]
+
+    assert run(11) == run(11)
+    assert run(11) != run(12)
+
+
+def test_differential_cpu_vs_jax_backend():
+    """The same seeded workload must produce identical commit/abort history
+    and final state on the CPU and JAX conflict backends (the BASELINE.json
+    acceptance property)."""
+
+    def run(backend):
+        c = SimCluster(seed=99, conflict_backend=backend)
+        dbs = [c.database() for _ in range(3)]
+        history = []
+
+        def w(db, i):
+            async def go():
+                rng = c.loop.rng
+                for j in range(6):
+                    tr = db.create_transaction()
+                    try:
+                        k = b"d/%d" % int(rng.random_int(0, 5))
+                        v = await tr.get(k)
+                        tr.set(k, (v or b"") + b"%d" % i)
+                        ver = await tr.commit()
+                        history.append((i, j, "ok"))
+                    except FdbError as e:
+                        history.append((i, j, e.name))
+
+            return go()
+
+        c.run_all([(db, w(db, i)) for i, db in enumerate(dbs)], timeout_vt=5000.0)
+        out = {}
+
+        async def check(tr):
+            out["all"] = await tr.get_range(b"d/", b"d0")
+
+        c.run_all([(dbs[0], dbs[0].run(check))])
+        return history, out["all"]
+
+    h_cpu, s_cpu = run("cpu")
+    h_jax, s_jax = run("jax")
+    assert h_cpu == h_jax
+    assert s_cpu == s_jax
