@@ -75,6 +75,7 @@ class Transaction:
         self.read_conflict_ranges: List[Range] = []
         self.write_conflict_ranges: List[Range] = []
         self.committed_version: Optional[int] = None
+        self.options: dict = {}
         self._retries = 0
 
     # --- versions ---
@@ -171,6 +172,7 @@ class Transaction:
         self.add_write_conflict_range(key, key_after(key))
 
     def clear(self, key: bytes):
+        self._check_legal_key(key)
         self.mutations.append(
             Mutation(MutationType.CLEAR_RANGE, key, key_after(key))
         )
@@ -179,6 +181,9 @@ class Transaction:
     def clear_range(self, begin: bytes, end: bytes):
         if begin > end:
             raise FdbError("inverted_range")
+        self._check_legal_key(begin)
+        if end > b"\xff" and not self.options.get("access_system_keys"):
+            raise FdbError("key_outside_legal_range")
         self.mutations.append(Mutation(MutationType.CLEAR_RANGE, begin, end))
         self.add_write_conflict_range(begin, end)
 
@@ -212,6 +217,13 @@ class Transaction:
             raise FdbError("key_too_large")
         if len(value) > ck.value_size_limit:
             raise FdbError("value_too_large")
+        self._check_legal_key(key)
+
+    def _check_legal_key(self, key: bytes):
+        """Clients may not touch the system keyspace (ref: keys >= \\xff are
+        illegal without ACCESS_SYSTEM_KEYS; fdbclient key_outside_legal_range)."""
+        if key >= b"\xff" and not self.options.get("access_system_keys"):
+            raise FdbError("key_outside_legal_range")
 
     # --- conflict ranges ---
     def add_read_conflict_range(self, begin: bytes, end: bytes):

@@ -52,6 +52,7 @@ class ConflictSet:
         oldest_version: int = 0,
         key_words: Optional[int] = None,
         device=None,
+        bucket_mins: tuple = (8, 8, 8),
     ):
         self.backend = backend
         self._cpu: Optional[CpuConflictSet] = None
@@ -66,7 +67,10 @@ class ConflictSet:
             from .engine_jax import JaxConflictSet  # lazy: jax import is heavy
 
             self._jax = JaxConflictSet(
-                oldest_version=oldest_version, key_words=kw, device=device
+                oldest_version=oldest_version,
+                key_words=kw,
+                device=device,
+                bucket_mins=bucket_mins,
             )
         # hybrid: which side holds the authoritative history
         self._authority = "cpu" if backend == "hybrid" else backend

@@ -1,0 +1,21 @@
+"""Virtualized file I/O: the rebuild of the reference's IAsyncFile stack.
+
+Ref: fdbrpc/IAsyncFile.h:32 (read/write/sync/truncate contract),
+AsyncFileNonDurable.actor.h (simulation-only crash-durability model: writes
+are only guaranteed after sync(); on a simulated kill, unsynced writes are
+independently dropped, partially applied, or corrupted).  Files live in a
+SimFileSystem keyed by machine, so a rebooted process on the same machine
+recovers whatever "disk" state survived.
+"""
+
+from .simfile import SimFileSystem, SimAsyncFile, KillMode
+from .diskqueue import DiskQueue
+from .kvstore import KeyValueStoreMemory
+
+__all__ = [
+    "SimFileSystem",
+    "SimAsyncFile",
+    "KillMode",
+    "DiskQueue",
+    "KeyValueStoreMemory",
+]

@@ -1,0 +1,146 @@
+"""IKeyValueStore + the memory engine (RAM map, disk-queue WAL + snapshot).
+
+Ref: fdbserver/IKeyValueStore.h:38 (set/clear/commit/readValue/readRange
+contract: mutations are visible immediately, durable when commit()'s future
+fires) and KeyValueStoreMemory.actor.cpp (in-RAM IndexedSet whose ops are
+logged to a DiskQueue, with periodic full snapshots pushed into the same
+queue so the log can be popped).
+"""
+
+from __future__ import annotations
+
+import pickle
+from bisect import bisect_left, insort
+from typing import Dict, List, Optional, Tuple
+
+from ..rpc.network import SimProcess
+from .diskqueue import DiskQueue
+from .simfile import SimFileSystem
+
+
+class IKeyValueStore:
+    """The storage-engine contract (ref IKeyValueStore.h:38)."""
+
+    def set(self, key: bytes, value: bytes):
+        raise NotImplementedError
+
+    def clear_range(self, begin: bytes, end: bytes):
+        raise NotImplementedError
+
+    async def commit(self):
+        raise NotImplementedError
+
+    def read_value(self, key: bytes) -> Optional[bytes]:
+        raise NotImplementedError
+
+    def read_range(
+        self, begin: bytes, end: bytes, limit: int = 1 << 30
+    ) -> List[Tuple[bytes, bytes]]:
+        raise NotImplementedError
+
+
+class KeyValueStoreMemory(IKeyValueStore):
+    """RAM map + WAL; recovery = last snapshot + subsequent op records."""
+
+    SNAPSHOT_EVERY_BYTES = 1 << 20
+
+    def __init__(self, queue: DiskQueue):
+        self._q = queue
+        self._data: Dict[bytes, bytes] = {}
+        self._keys: List[bytes] = []
+        self._uncommitted: List[Tuple[str, bytes, bytes]] = []
+        self._seq = queue.popped_seq
+        self._bytes_since_snapshot = 0
+
+    # -- lifecycle --
+    @classmethod
+    async def open(
+        cls, fs: SimFileSystem, process: SimProcess, filename: str
+    ) -> "KeyValueStoreMemory":
+        queue, records = await DiskQueue.open(fs, process, filename)
+        kv = cls(queue)
+        # Find the last complete snapshot, replay ops after it.
+        snap_idx = None
+        for i, (_seq, payload) in enumerate(records):
+            if payload[:1] == b"S":
+                snap_idx = i
+        start = 0
+        if snap_idx is not None:
+            kv._data = dict(pickle.loads(records[snap_idx][1][1:]))
+            start = snap_idx + 1
+        for seq, payload in records[start:]:
+            if payload[:1] != b"O":
+                continue
+            for op, k, v in pickle.loads(payload[1:]):
+                kv._apply(op, k, v)
+        kv._keys = sorted(kv._data)
+        kv._seq = records[-1][0] if records else queue.popped_seq
+        return kv
+
+    # -- writes --
+    def set(self, key: bytes, value: bytes):
+        self._uncommitted.append(("set", key, value))
+        self._apply("set", key, value, maintain_index=True)
+
+    def clear_range(self, begin: bytes, end: bytes):
+        self._uncommitted.append(("clear", begin, end))
+        self._apply("clear", begin, end, maintain_index=True)
+
+    def _apply(self, op: str, a: bytes, b: bytes, maintain_index: bool = False):
+        if op == "set":
+            if maintain_index and a not in self._data:
+                insort(self._keys, a)
+            self._data[a] = b
+        else:
+            if maintain_index:
+                i = bisect_left(self._keys, a)
+                j = bisect_left(self._keys, b)
+                for k in self._keys[i:j]:
+                    del self._data[k]
+                del self._keys[i:j]
+            else:
+                for k in [k for k in self._data if a <= k < b]:
+                    del self._data[k]
+
+    async def commit(self):
+        """Durable when returned (ref IKeyValueStore.h:43)."""
+        ops, self._uncommitted = self._uncommitted, []
+        self._seq += 1
+        payload = b"O" + pickle.dumps(ops, protocol=4)
+        self._q.push(self._seq, payload)
+        self._bytes_since_snapshot += len(payload)
+        await self._q.commit()
+        if self._bytes_since_snapshot >= self.SNAPSHOT_EVERY_BYTES:
+            await self._snapshot()
+
+    async def _snapshot(self):
+        """Push the full map, then pop everything before it (ref: the memory
+        engine's interleaved snapshot chunks).
+
+        Two-phase on purpose: the pop (header write) must only become
+        durable AFTER the snapshot frame is — the crash model resolves
+        pending writes independently, and a surviving popped pointer with a
+        dropped snapshot frame would discard acknowledged records.
+        """
+        self._seq += 1
+        self._q.push(
+            self._seq, b"S" + pickle.dumps(list(self._data.items()), protocol=4)
+        )
+        await self._q.commit()  # phase 1: snapshot frame durable
+        self._q.pop(self._seq - 1)
+        await self._q.commit()  # phase 2: popped pointer durable
+        self._bytes_since_snapshot = 0
+
+    # -- reads --
+    def read_value(self, key: bytes) -> Optional[bytes]:
+        return self._data.get(key)
+
+    def read_range(
+        self, begin: bytes, end: bytes, limit: int = 1 << 30
+    ) -> List[Tuple[bytes, bytes]]:
+        i = bisect_left(self._keys, begin)
+        j = bisect_left(self._keys, end)
+        out = []
+        for k in self._keys[i : min(j, i + limit)]:
+            out.append((k, self._data[k]))
+        return out

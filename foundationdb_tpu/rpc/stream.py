@@ -10,6 +10,7 @@ returns a future that errors with broken_promise if the server dies
 
 from __future__ import annotations
 
+import zlib
 from dataclasses import dataclass
 from typing import Any, Optional
 
@@ -21,6 +22,13 @@ from .network import Endpoint, SimNetwork, SimProcess
 
 def BrokenPromise() -> FdbError:
     return FdbError("broken_promise")
+
+
+def well_known_token(name: str) -> int:
+    """Stable token derived from the stream name, so a client-side ref keeps
+    working across the server process's reboot (ref: well-known endpoint
+    tokens, e.g. the coordinators' WLTOKEN_* constants)."""
+    return (1 << 40) | (zlib.crc32(name.encode()) & 0xFFFFFFFF)
 
 
 @dataclass
@@ -59,9 +67,17 @@ class Reply:
 class RequestStream:
     """Server side: a well-known endpoint producing (request, Reply) pairs."""
 
-    def __init__(self, process: SimProcess, name: str, token: Optional[int] = None):
+    def __init__(
+        self,
+        process: SimProcess,
+        name: str,
+        token: Optional[int] = None,
+        well_known: bool = False,
+    ):
         self.process = process
         self.name = name
+        if token is None and well_known:
+            token = well_known_token(name)
         self._stream = PromiseStream()
         self.endpoint = process.make_endpoint(self._deliver, token=token)
 

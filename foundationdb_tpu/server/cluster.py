@@ -25,31 +25,116 @@ class SimCluster:
         conflict_backend: str = "cpu",
         conflict_set=None,
         loop: Optional[EventLoop] = None,
+        durable: bool = False,
     ):
         self.loop = loop or EventLoop(seed=seed)
         set_event_loop(self.loop)
         self.net = SimNetwork(self.loop)
+        self.conflict_backend = conflict_backend
+        self._conflict_set = conflict_set
+        self.durable = durable
+        self.fs = None
         self.master_proc = self.net.process("master")
         self.resolver_proc = self.net.process("resolver")
         self.tlog_proc = self.net.process("tlog")
         self.storage_proc = self.net.process("storage")
         self.proxy_proc = self.net.process("proxy")
-
-        self.sequencer = Sequencer(self.master_proc)
-        self.resolver = Resolver(
-            self.resolver_proc,
-            backend=conflict_backend,
-            conflict_set=conflict_set,
-        )
-        self.tlog = TLog(self.tlog_proc)
-        self.storage = StorageServer(self.storage_proc, self.tlog.interface())
-        self.proxy = Proxy(
-            self.proxy_proc,
-            self.sequencer.interface(),
-            [self.resolver.interface()],
-            [self.tlog.interface()],
-        )
         self._n_clients = 0
+
+        if durable:
+            from ..fileio import SimFileSystem
+
+            self.fs = SimFileSystem(self.net)
+            self._start_roles_durable(epoch_begin=0)
+        else:
+            self.sequencer = Sequencer(self.master_proc)
+            self.resolver = Resolver(
+                self.resolver_proc,
+                backend=conflict_backend,
+                conflict_set=conflict_set,
+            )
+            self.tlog = TLog(self.tlog_proc)
+            self.storage = StorageServer(self.storage_proc, self.tlog.interface())
+            self.proxy = Proxy(
+                self.proxy_proc,
+                self.sequencer.interface(),
+                [self.resolver.interface()],
+                [self.tlog.interface()],
+            )
+
+    def _start_roles_durable(self, epoch_begin: int):
+        """(Re)build all roles from the machines' disks at a new epoch (the
+        static stand-in for master recovery's recruitment; the real recovery
+        state machine arrives with the control plane)."""
+
+        async def build():
+            self.tlog = await TLog.recover(
+                self.tlog_proc, self.fs, "tlog.dq", fast_forward_to=epoch_begin
+            )
+            self.storage = await StorageServer.recover(
+                self.storage_proc, self.tlog.interface(), self.fs, "storage.dq"
+            )
+            self.sequencer = Sequencer(
+                self.master_proc, epoch_begin_version=epoch_begin
+            )
+            self.resolver = Resolver(
+                self.resolver_proc,
+                backend=self.conflict_backend,
+                conflict_set=self._conflict_set,
+                epoch_begin_version=epoch_begin,
+            )
+            self.proxy = Proxy(
+                self.proxy_proc,
+                self.sequencer.interface(),
+                [self.resolver.interface()],
+                [self.tlog.interface()],
+                epoch_begin_version=epoch_begin,
+            )
+
+        self.loop.run_until(self.master_proc.spawn(build(), "recovery"))
+
+    def crash_and_recover(self):
+        """Kill every server process, resolve unsynced disk writes per the
+        corruption model, reboot, and rebuild roles from disk at a new epoch
+        (ref: restartSimulatedSystem SimulatedCluster.actor.cpp:597)."""
+        assert self.durable, "crash_and_recover requires durable=True"
+        from ..flow.knobs import g_knobs
+
+        procs = [
+            self.master_proc,
+            self.resolver_proc,
+            self.tlog_proc,
+            self.storage_proc,
+            self.proxy_proc,
+        ]
+        for p in procs:
+            p.kill()
+        for p in procs:
+            self.fs.crash_machine(p.machine.machine_id)
+        for p in procs:
+            p.reboot()
+        # New epoch begins beyond anything the old one may have handed out
+        # (ref: recoverFrom picking recoveryTransactionVersion past the old
+        # epoch's end, masterserver.actor.cpp:725).
+        epoch_begin = (
+            self.sequencer.version + g_knobs.server.max_versions_in_flight
+        )
+        self._start_roles_durable(epoch_begin=epoch_begin)
+        # The recovery transaction: an empty commit that advances the chain
+        # through the new epoch so storage catches up to GRV-visible versions
+        # (ref: the RECOVERY_TRANSACTION state, masterserver.actor.cpp:1158).
+        from ..client.types import CommitTransactionRef
+        from .interfaces import CommitTransactionRequest
+
+        async def recovery_txn():
+            await self.proxy.interface().commit.get_reply(
+                self.master_proc,
+                CommitTransactionRequest(transaction=CommitTransactionRef()),
+            )
+
+        self.loop.run_until(
+            self.master_proc.spawn(recovery_txn(), "recovery_txn")
+        )
 
     def database(self, name: str = ""):
         # Imported here: client.transaction imports server.interfaces (the

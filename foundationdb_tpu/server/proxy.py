@@ -47,8 +47,8 @@ class Proxy:
         self.resolvers = resolvers
         self.tlogs = tlogs
         self.committed = NotifiedVersion(epoch_begin_version)
-        self._commit_stream = RequestStream(process, "commit")
-        self._grv_stream = RequestStream(process, "grv")
+        self._commit_stream = RequestStream(process, "commit", well_known=True)
+        self._grv_stream = RequestStream(process, "grv", well_known=True)
         self.stats = {"committed": 0, "conflicted": 0, "too_old": 0, "batches": 0}
         process.spawn(self._commit_batcher(), "proxy_batcher")
         process.spawn(self._serve_grv(), "proxy_grv")

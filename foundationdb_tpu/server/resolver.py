@@ -36,7 +36,7 @@ class Resolver:
         )
         self.version = NotifiedVersion(epoch_begin_version)
         self.total_resolved = 0
-        self._stream = RequestStream(process, "resolve")
+        self._stream = RequestStream(process, "resolve", well_known=True)
         process.spawn(self._serve(), "resolver")
 
     def interface(self) -> ResolverInterface:

@@ -25,9 +25,9 @@ class Sequencer:
         self.version = epoch_begin_version  # last version handed out
         self.committed = NotifiedVersion(epoch_begin_version)
         self._last_grant_time = process.network.loop.now()
-        self._commit_stream = RequestStream(process, "get_commit_version")
-        self._report_stream = RequestStream(process, "report_committed")
-        self._read_stream = RequestStream(process, "get_committed_version")
+        self._commit_stream = RequestStream(process, "get_commit_version", well_known=True)
+        self._report_stream = RequestStream(process, "report_committed", well_known=True)
+        self._read_stream = RequestStream(process, "get_committed_version", well_known=True)
         process.spawn(self._serve_commit_versions(), "sequencer_commit")
         process.spawn(self._serve_reports(), "sequencer_report")
         process.spawn(self._serve_reads(), "sequencer_read")

@@ -61,7 +61,9 @@ class VersionedStore:
                 best = (v, s)
         return best
 
-    def get(self, key: bytes, version: int) -> Optional[bytes]:
+    def get_stamped(self, key: bytes, version: int):
+        """(touched, value): touched=False means no window entry covers the
+        key at this version (the caller may fall through to a base engine)."""
         chain = self.kv.get(key)
         stamp_e, val = (-1, -1), None
         if chain:
@@ -69,9 +71,31 @@ class VersionedStore:
             if i >= 0:
                 ver, seq, val = chain[i]
                 stamp_e = (ver, seq)
-        if self._latest_clear_over(key, version) > stamp_e:
-            return None
+        stamp_c = self._latest_clear_over(key, version)
+        if stamp_c > stamp_e:
+            return True, None
+        if stamp_e == (-1, -1):
+            return False, None
+        return True, val
+
+    def get(self, key: bytes, version: int) -> Optional[bytes]:
+        _touched, val = self.get_stamped(key, version)
         return val
+
+    def trim(self, through_version: int):
+        """Drop window state at versions <= through_version (the base engine
+        is durable through it; ref: the MVCC window following durability,
+        storageserver updateStorage -> setOldestVersion)."""
+        for key in list(self.kv):
+            chain = [e for e in self.kv[key] if e[0] > through_version]
+            if chain:
+                self.kv[key] = chain
+            else:
+                del self.kv[key]
+                i = bisect_left(self.sorted_keys, key)
+                if i < len(self.sorted_keys) and self.sorted_keys[i] == key:
+                    del self.sorted_keys[i]
+        self.clears = [c for c in self.clears if c[0] > through_version]
 
     def get_range(
         self,
@@ -108,24 +132,50 @@ class VersionedStore:
         self.clears.append((version, seq, begin, end))
 
 
+VERSION_META_KEY = b"\xff\xffmeta/durable_version"
+
+
 class StorageServer:
+    """In-memory MVCC window, optionally over a durable base engine.
+
+    With `kvstore` set, applied mutations are mirrored into the engine and
+    committed on a cadence; the window is trimmed to the durable floor and
+    the TLog popped only after durability (ref: updateStorage ->
+    IKeyValueStore::commit -> tLogPop).  Without it, applied == durable and
+    the log is popped eagerly (the original in-memory slice).
+    """
+
     def __init__(
         self,
         process: SimProcess,
         tlog: TLogInterface,
         epoch_begin_version: int = 0,
+        kvstore=None,
     ):
         self.process = process
         self.tlog = tlog
         self.store = VersionedStore()
+        self.kvstore = kvstore
         self.version = NotifiedVersion(epoch_begin_version)
-        self._gv_stream = RequestStream(process, "get_value")
-        self._gkv_stream = RequestStream(process, "get_key_values")
-        self._ver_stream = RequestStream(process, "get_version")
+        self.durable_version = epoch_begin_version
+        self._gv_stream = RequestStream(process, "get_value", well_known=True)
+        self._gkv_stream = RequestStream(process, "get_key_values", well_known=True)
+        self._ver_stream = RequestStream(process, "get_version", well_known=True)
         process.spawn(self._update_loop(), "ss_update")
         process.spawn(self._serve_get_value(), "ss_get_value")
         process.spawn(self._serve_get_key_values(), "ss_get_key_values")
         process.spawn(self._serve_get_version(), "ss_get_version")
+
+    @classmethod
+    async def recover(cls, process: SimProcess, tlog: TLogInterface, fs, filename: str):
+        """Reopen the base engine and resume pulling from its durable
+        version (ref: storageServer rollback/restart recovery)."""
+        from ..fileio.kvstore import KeyValueStoreMemory
+
+        kv = await KeyValueStoreMemory.open(fs, process, filename)
+        meta = kv.read_value(VERSION_META_KEY)
+        durable = int(meta.decode()) if meta else 0
+        return cls(process, tlog, epoch_begin_version=durable, kvstore=kv)
 
     def interface(self) -> StorageInterface:
         return StorageInterface(
@@ -139,6 +189,7 @@ class StorageServer:
         from ..rpc.stream import retry_get_reply
 
         loop = self.process.network.loop
+        last_durable_commit = loop.now()
         while True:
             reply = await retry_get_reply(
                 self.tlog.peek,
@@ -150,13 +201,57 @@ class StorageServer:
                     continue
                 self._apply(version, mutations)
                 self.version.set(version)
-            # In-memory engine: applied == durable, pop eagerly (ref: tLogPop
-            # once storage has made data durable).
-            self.tlog.pop.send(
-                self.process, TLogPopRequest(version=self.version.get())
-            )
+            if self.kvstore is None:
+                # In-memory engine: applied == durable, pop eagerly.
+                self.durable_version = self.version.get()
+                self.tlog.pop.send(
+                    self.process, TLogPopRequest(version=self.version.get())
+                )
+            elif (
+                loop.now() - last_durable_commit
+                >= g_knobs.server.storage_durability_lag
+                and self.version.get() > self.durable_version
+            ):
+                await self._make_durable()
+                last_durable_commit = loop.now()
             if not reply.has_more:
                 await loop.delay(0.001)  # poll; push-based peek comes later
+
+    async def _make_durable(self):
+        """Fold window mutations through the applied version into the base
+        engine in (version, seq) order, commit, trim, pop the log (ref:
+        updateStorage storageserver.actor.cpp).
+
+        The durable floor is raised BEFORE the engine's RAM state is
+        mutated: reads below the new floor error transaction_too_old instead
+        of falling through the window to a base engine that is already ahead
+        of their version (the fold + commit spans awaits)."""
+        new_durable = self.version.get()
+        self.durable_version = new_durable
+        ops = []
+        for key, chain in self.store.kv.items():
+            for ver, seq, val in chain:
+                if ver <= new_durable:
+                    ops.append((ver, seq, "set", key, val))
+        for ver, seq, b, e in self.store.clears:
+            if ver <= new_durable:
+                ops.append((ver, seq, "clear", b, e))
+        ops.sort(key=lambda o: (o[0], o[1]))
+        for _v, _s, op, a, b in ops:
+            if op == "set":
+                self.kvstore.set(a, b)
+            else:
+                self.kvstore.clear_range(a, b)
+        self.kvstore.set(VERSION_META_KEY, b"%d" % new_durable)
+        await self.kvstore.commit()
+        self.store.trim(new_durable)
+        self.tlog.pop.send(self.process, TLogPopRequest(version=new_durable))
+
+    def _get_current(self, key: bytes, version: int) -> Optional[bytes]:
+        touched, val = self.store.get_stamped(key, version)
+        if not touched and self.kvstore is not None:
+            return self.kvstore.read_value(key)
+        return val
 
     def _apply(self, version: int, mutations: List[Mutation]):
         for seq, m in enumerate(mutations):
@@ -167,7 +262,7 @@ class StorageServer:
             elif m.type in (MutationType.NO_OP, MutationType.DEBUG_KEY):
                 pass
             else:
-                existing = self.store.get(m.param1, version)
+                existing = self._get_current(m.param1, version)
                 self.store.set(
                     m.param1, apply_atomic(m.type, existing, m.param2), version, seq
                 )
@@ -175,11 +270,17 @@ class StorageServer:
     # -- read path --
     async def _wait_for_version(self, version: int):
         """Ref: waitForVersion storageserver.actor.cpp:631."""
-        if version > self.version.get() + g_knobs.server.max_versions_in_flight:
-            from ..flow.error import FdbError
+        from ..flow.error import FdbError
 
+        if version > self.version.get() + g_knobs.server.max_versions_in_flight:
             raise FdbError("future_version")
+        if version < self.durable_version:
+            # The window below the durable floor is gone (ref: reads below
+            # oldestVersion -> transaction_too_old, storageserver :640).
+            raise FdbError("transaction_too_old")
         await self.version.when_at_least(version)
+        if version < self.durable_version:  # floor may have risen across the wait
+            raise FdbError("transaction_too_old")
 
     async def _serve_get_value(self):
         while True:
@@ -193,7 +294,9 @@ class StorageServer:
             reply.send_error(getattr(e, "name", "internal_error"))
             return
         reply.send(
-            GetValueReply(value=self.store.get(req.key, req.version), version=req.version)
+            GetValueReply(
+                value=self._get_current(req.key, req.version), version=req.version
+            )
         )
 
     async def _serve_get_key_values(self):
@@ -207,13 +310,52 @@ class StorageServer:
         except Exception as e:  # noqa: BLE001
             reply.send_error(getattr(e, "name", "internal_error"))
             return
-        data = self.store.get_range(
+        data = self._range_at(
             req.begin, req.end, req.version, req.limit + 1, req.reverse
         )
         more = len(data) > req.limit
         reply.send(
             GetKeyValuesReply(data=data[: req.limit], more=more, version=req.version)
         )
+
+    def _range_at(self, begin, end, version, limit, reverse):
+        """Window-over-base merged range read (window clears mask base keys).
+
+        Two-pointer merge over the already-sorted base and window key lists
+        with early exit, so a limited read costs O(limit + skipped-masked),
+        not O(range size).
+        """
+        if self.kvstore is None:
+            return self.store.get_range(begin, end, version, limit, reverse)
+        base_keys = self.kvstore._keys
+        bi = bisect_left(base_keys, begin)
+        bj = bisect_left(base_keys, end)
+        wkeys = self.store.sorted_keys
+        wi = bisect_left(wkeys, begin)
+        wj = bisect_left(wkeys, end)
+        a = base_keys[bi:bj]
+        b = wkeys[wi:wj]
+        if reverse:
+            a, b = a[::-1], b[::-1]
+        rows: list = []
+        ia = ib = 0
+        before = (lambda x, y: x > y) if reverse else (lambda x, y: x < y)
+        while (ia < len(a) or ib < len(b)) and len(rows) < limit:
+            if ib >= len(b) or (ia < len(a) and before(a[ia], b[ib])):
+                k = a[ia]
+                ia += 1
+            elif ia >= len(a) or before(b[ib], a[ia]):
+                k = b[ib]
+                ib += 1
+            else:  # same key in both
+                k = a[ia]
+                ia += 1
+                ib += 1
+            touched, wv = self.store.get_stamped(k, version)
+            v = wv if touched else self.kvstore.read_value(k)
+            if v is not None:
+                rows.append((k, v))
+        return rows
 
     async def _serve_get_version(self):
         while True:

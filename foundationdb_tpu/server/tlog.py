@@ -29,19 +29,52 @@ COMMIT_DELAY = 0.0005
 
 
 class TLog:
-    def __init__(self, process: SimProcess, epoch_begin_version: int = 0):
+    def __init__(
+        self,
+        process: SimProcess,
+        epoch_begin_version: int = 0,
+        disk_queue=None,
+    ):
         self.process = process
         # Parallel sorted lists: versions[i] holds mutation list entries[i].
         self.versions: List[int] = []
         self.entries: List[list] = []
         self.durable = NotifiedVersion(epoch_begin_version)
         self.popped = epoch_begin_version
-        self._commit_stream = RequestStream(process, "tlog_commit")
-        self._peek_stream = RequestStream(process, "tlog_peek")
-        self._pop_stream = RequestStream(process, "tlog_pop")
+        self.disk_queue = disk_queue  # None = in-memory (simulated fsync)
+        self._commit_stream = RequestStream(process, "tlog_commit", well_known=True)
+        self._peek_stream = RequestStream(process, "tlog_peek", well_known=True)
+        self._pop_stream = RequestStream(process, "tlog_pop", well_known=True)
         process.spawn(self._serve_commit(), "tlog_commit")
         process.spawn(self._serve_peek(), "tlog_peek")
         process.spawn(self._serve_pop(), "tlog_pop")
+
+    @classmethod
+    async def recover(
+        cls,
+        process: SimProcess,
+        fs,
+        filename: str = "tlog.dq",
+        fast_forward_to: int = 0,
+    ) -> "TLog":
+        """Reopen the on-disk queue and rebuild the unpopped suffix (ref:
+        TLogServer restorePersistentState).  `fast_forward_to` jumps the
+        durable chain to the new epoch's begin version so post-recovery
+        pushes (whose prevVersion is the recovery version) can land."""
+        import pickle
+
+        from ..fileio.diskqueue import DiskQueue
+
+        q, records = await DiskQueue.open(fs, process, filename)
+        log = cls(process, disk_queue=q)
+        for _seq, payload in records:
+            version, mutations = pickle.loads(payload)
+            log.versions.append(version)
+            log.entries.append(mutations)
+        log.popped = q.popped_seq
+        last = log.versions[-1] if log.versions else q.popped_seq
+        log.durable.set(max(last, fast_forward_to))
+        return log
 
     def interface(self) -> TLogInterface:
         return TLogInterface(
@@ -64,7 +97,15 @@ class TLog:
             return
         self.versions.append(req.version)
         self.entries.append(req.mutations)
-        await self.process.network.loop.delay(COMMIT_DELAY)  # fsync stand-in
+        if self.disk_queue is not None:
+            import pickle
+
+            self.disk_queue.push(
+                req.version, pickle.dumps((req.version, req.mutations), protocol=4)
+            )
+            await self.disk_queue.commit()  # real (simulated-file) fsync
+        else:
+            await self.process.network.loop.delay(COMMIT_DELAY)  # fsync stand-in
         self.durable.set(req.version)
         reply.send(req.version)
 
@@ -94,4 +135,7 @@ class TLog:
                 k = bisect_right(self.versions, req.version)
                 del self.versions[:k]
                 del self.entries[:k]
+                if self.disk_queue is not None:
+                    # Persisted with the next commit (lazy, like the ref).
+                    self.disk_queue.pop(req.version)
             reply.send(None)

@@ -29,6 +29,10 @@ def jcs_factory():
     def make(**kw):
         kw.setdefault("key_words", 3)
         kw.setdefault("h_cap", 1 << 10)
+        # One shared shape bucket across every test in this module: each
+        # distinct (txn_cap, rr_cap, wr_cap, h_cap) is a separate multi-minute
+        # XLA compile on this 1-core host.
+        kw.setdefault("bucket_mins", (32, 128, 64))
         return JaxConflictSet(**kw)
 
     return make
@@ -214,7 +218,7 @@ def test_hybrid_handoff():
     old_min = g_knobs.server.conflict_device_min_batch
     g_knobs.server.conflict_device_min_batch = 4
     try:
-        hyb = ConflictSet(backend="hybrid", key_words=3)
+        hyb = ConflictSet(backend="hybrid", key_words=3, bucket_mins=(32, 128, 64))
         orc = OracleConflictSet()
         for bi, (txns, now, new_oldest) in enumerate(
             _random_stream(21, 40, batches=20, txns_per_batch=12)

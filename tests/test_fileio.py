@@ -1,0 +1,164 @@
+"""Crash-durability tests for the simulated file stack.
+
+Strategy follows the reference (SURVEY.md §4): commit through the public
+API, kill the machine (which resolves unsynced writes per the NonDurable
+corruption model), reboot, recover, and assert the prefix-durability
+contract.  Seeds are swept so drop/torn/corrupt paths all fire.
+"""
+
+import pytest
+
+from foundationdb_tpu.fileio import DiskQueue, KeyValueStoreMemory, KillMode, SimFileSystem
+from foundationdb_tpu.flow import EventLoop, set_event_loop
+from foundationdb_tpu.rpc import SimNetwork
+
+
+def make_env(seed, kill_mode=KillMode.FULL_CORRUPTION):
+    loop = EventLoop(seed=seed)
+    set_event_loop(loop)
+    net = SimNetwork(loop)
+    fs = SimFileSystem(net, kill_mode=kill_mode)
+    return loop, net, fs
+
+
+def drive(loop, proc, coro):
+    return loop.run_until(proc.spawn(coro), timeout_vt=100.0)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_diskqueue_prefix_durability(seed):
+    loop, net, fs = make_env(seed)
+    proc = net.process("node")
+    state = {}
+
+    async def writer():
+        q, rec = await DiskQueue.open(fs, proc, "queue.dq")
+        assert rec == []
+        committed = []
+        seq = 0
+        for round_ in range(5):
+            for _ in range(loop.rng.random_int(1, 4)):
+                seq += 1
+                q.push(seq, b"payload-%d" % seq * loop.rng.random_int(1, 9))
+            await q.commit()
+            committed.append(seq)
+        # Push some records that are never committed.
+        for _ in range(loop.rng.random_int(0, 3)):
+            seq += 1
+            q.push(seq, b"uncommitted-%d" % seq)
+        state["committed_through"] = committed[-1]
+        state["pushed_through"] = seq
+
+    drive(loop, proc, writer())
+    proc.kill()
+    fs.crash_machine("node")
+    proc.reboot()
+
+    async def recover():
+        _q, rec = await DiskQueue.open(fs, proc, "queue.dq")
+        state["recovered"] = rec
+
+    drive(loop, proc, recover())
+    rec = state["recovered"]
+    seqs = [s for s, _ in rec]
+    # Prefix: contiguous from 1, contains at least everything committed.
+    assert seqs == list(range(1, len(seqs) + 1))
+    assert len(seqs) >= state["committed_through"]
+    assert len(seqs) <= state["pushed_through"]
+    # Committed payloads intact (never corrupted).
+    for s, payload in rec:
+        if s <= state["committed_through"]:
+            assert payload.startswith(b"payload-")
+    set_event_loop(None)
+
+
+@pytest.mark.parametrize("seed", range(8))
+def test_kvstore_memory_recovers_committed_state(seed):
+    loop, net, fs = make_env(seed)
+    proc = net.process("node")
+    state = {}
+
+    async def writer():
+        kv = await KeyValueStoreMemory.open(fs, proc, "store.dq")
+        committed = {}
+        for round_ in range(6):
+            for _ in range(loop.rng.random_int(1, 5)):
+                k = b"k%d" % loop.rng.random_int(0, 20)
+                if loop.rng.random01() < 0.25:
+                    e = b"k%d" % loop.rng.random_int(0, 30)
+                    b, e = min(k, e), max(k, e)
+                    kv.clear_range(b, e)
+                    for kk in [x for x in committed if b <= x < e]:
+                        del committed[kk]
+                else:
+                    v = b"v%d-%d" % (round_, loop.rng.random_int(0, 1000))
+                    kv.set(k, v)
+                    committed[k] = v
+            await kv.commit()
+        # Uncommitted tail: must NOT survive.
+        kv.set(b"uncommitted", b"x")
+        state["committed"] = dict(committed)
+
+    drive(loop, proc, writer())
+    proc.kill()
+    fs.crash_machine("node")
+    proc.reboot()
+
+    async def recover():
+        kv = await KeyValueStoreMemory.open(fs, proc, "store.dq")
+        state["recovered"] = dict(kv.read_range(b"", b"\xff"))
+
+    drive(loop, proc, recover())
+    assert state["recovered"] == state["committed"]
+    set_event_loop(None)
+
+
+def test_kvstore_snapshot_compaction():
+    loop, net, fs = make_env(3)
+    proc = net.process("node")
+    state = {}
+
+    async def writer():
+        kv = await KeyValueStoreMemory.open(fs, proc, "store.dq")
+        kv.SNAPSHOT_EVERY_BYTES = 256  # force frequent snapshots
+        for i in range(30):
+            kv.set(b"key%02d" % (i % 7), b"val%d" % i)
+            await kv.commit()
+        state["popped"] = kv._q.popped_seq
+        state["final"] = dict(kv.read_range(b"", b"\xff"))
+
+    drive(loop, proc, writer())
+    assert state["popped"] > 0  # snapshots actually popped the log
+
+    async def recover():
+        kv = await KeyValueStoreMemory.open(fs, proc, "store.dq")
+        state["recovered"] = dict(kv.read_range(b"", b"\xff"))
+
+    drive(loop, proc, recover())
+    assert state["recovered"] == state["final"]
+    set_event_loop(None)
+
+
+def test_sync_makes_writes_survive_full_corruption():
+    """Synced data survives any kill mode; unsynced may not."""
+    loop, net, fs = make_env(5)
+    proc = net.process("node")
+
+    async def writer():
+        f = fs.open(proc, "raw.bin")
+        await f.write(0, b"A" * 100)
+        await f.sync()
+        await f.write(100, b"B" * 100)  # unsynced
+
+    drive(loop, proc, writer())
+    proc.kill()
+    fs.crash_machine("node")
+    proc.reboot()
+
+    async def reader():
+        f = fs.open(proc, "raw.bin")
+        return await f.read(0, 200)
+
+    data = drive(loop, proc, reader())
+    assert data[:100] == b"A" * 100
+    set_event_loop(None)
