@@ -40,17 +40,40 @@ from .types import (
 
 class Database:
     """A handle bound to a client process + cluster interfaces (ref:
-    Database/Cluster in NativeAPI.h; location cache arrives with sharding)."""
+    Database/Cluster in NativeAPI.h; location cache arrives with sharding).
+
+    Static mode: fixed proxy/storage interfaces (SimCluster).  Dynamic mode:
+    `info_var` holds a ClientDBInfo maintained by a cluster-controller
+    monitor; interfaces refresh across recoveries (ref: the client's
+    monitorProxies / ClientDBInfo subscription)."""
 
     def __init__(
         self,
         process: SimProcess,
-        proxy: ProxyInterface,
-        storage: StorageInterface,
+        proxy: ProxyInterface = None,
+        storage: StorageInterface = None,
+        info_var=None,
     ):
         self.process = process
-        self.proxy = proxy
-        self.storage = storage
+        self._proxy = proxy
+        self._storage = storage
+        self.info_var = info_var
+
+    @property
+    def proxy(self) -> ProxyInterface:
+        if self.info_var is not None and self.info_var.get().proxy is not None:
+            return self.info_var.get().proxy
+        return self._proxy
+
+    @property
+    def storage(self) -> StorageInterface:
+        if self.info_var is not None and self.info_var.get().storage is not None:
+            return self.info_var.get().storage
+        return self._storage
+
+    async def wait_connected(self):
+        while self.proxy is None or self.storage is None:
+            await self.info_var.on_change()
 
     def create_transaction(self) -> "Transaction":
         return Transaction(self)
@@ -81,6 +104,8 @@ class Transaction:
     # --- versions ---
     async def get_read_version(self) -> int:
         if self._read_version is None:
+            if self.db.info_var is not None:
+                await self.db.wait_connected()
             self._read_version = await self.db.proxy.get_consistent_read_version.get_reply(
                 self.db.process, GetReadVersionRequest()
             )
@@ -239,6 +264,8 @@ class Transaction:
         if not self.mutations and not self.write_conflict_ranges:
             self.committed_version = self._read_version
             return self.committed_version  # read-only: nothing to do
+        if self.db.info_var is not None:
+            await self.db.wait_connected()
         read_snapshot = (
             self._read_version if self.read_conflict_ranges else 0
         ) or 0

@@ -82,11 +82,16 @@ class SimProcess:
         return t
 
     # -- endpoints --
-    def make_endpoint(self, receiver: Callable, token: Optional[int] = None) -> Endpoint:
+    def make_endpoint(
+        self,
+        receiver: Callable,
+        token: Optional[int] = None,
+        replace: bool = False,
+    ) -> Endpoint:
         if token is None:
             token = self._next_token
             self._next_token += 1
-        assert token not in self._endpoints
+        assert replace or token not in self._endpoints, f"token {token} in use"
         self._endpoints[token] = receiver
         return Endpoint(self.address, token)
 
@@ -203,6 +208,18 @@ class SimNetwork:
                 return
             receiver = p._endpoints.get(dst.token)
             if receiver is None:
+                # Live process, no such endpoint (e.g. the role died with a
+                # reboot in between): answer a request's reply promise with
+                # broken_promise, as the reference does for a request to an
+                # unknown endpoint token (FlowTransport deliver :430).
+                reply_to = getattr(msg, "reply_to", None)
+                if reply_to is not None and hasattr(msg, "request"):
+                    self._schedule_delivery(
+                        reply_to,
+                        (True, "broken_promise"),
+                        self.loop.now() + self._latency(),
+                        priority,
+                    )
                 return
             receiver(msg)
 

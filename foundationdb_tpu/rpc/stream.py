@@ -76,10 +76,18 @@ class RequestStream:
     ):
         self.process = process
         self.name = name
+        replace = False
         if token is None and well_known:
             token = well_known_token(name)
+            # Well-known streams are per-role singletons: a new generation's
+            # role instance on the same process replaces the old receiver
+            # (the reference's equivalent: a rebooted role re-registers its
+            # well-known endpoints).
+            replace = True
         self._stream = PromiseStream()
-        self.endpoint = process.make_endpoint(self._deliver, token=token)
+        self.endpoint = process.make_endpoint(
+            self._deliver, token=token, replace=replace
+        )
 
     def _deliver(self, env: _Envelope):
         reply = Reply(self.process.network, self.process, env.reply_to)

@@ -1,0 +1,351 @@
+"""Cluster controller: elected singleton that recruits roles and drives
+write-subsystem recovery.
+
+Ref: fdbserver/ClusterController.actor.cpp (worker registry + recruitment
+:341-659, failure detection :1257, ServerDBInfo broadcast) and the master
+recovery state machine (masterserver.actor.cpp :1101-1254: READING_CSTATE ->
+LOCKING_CSTATE -> RECRUITING -> RECOVERY_TRANSACTION -> WRITING_CSTATE ->
+FULLY_RECOVERED).  For this milestone the CC *hosts* the recovery driver
+(the reference recruits a separate master worker; splitting it out is a
+later refinement) — the protocol steps and the cstate write-before-serve
+ordering follow the reference.
+
+Fault model covered: any single role-process failure (proxy, resolver,
+sequencer-host, tlog, storage) triggers a new generation; stateful roles
+are recruited back onto workers whose machines hold their disk files.
+"""
+
+from __future__ import annotations
+
+import pickle
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ..flow.asyncvar import AsyncVar
+from ..flow.error import ActorCancelled, FdbError
+from ..flow.eventloop import timeout_after
+from ..flow.knobs import g_knobs
+from ..flow.trace import TraceEvent
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream, RequestStreamRef
+from .coordination import (
+    CoordinatedState,
+    CoordinatorInterface,
+    LeaderInfo,
+    try_become_leader,
+)
+from .interfaces import CommitTransactionRequest
+from .worker import (
+    FastForwardTLog,
+    InitProxy,
+    InitResolver,
+    InitSequencer,
+    InitStorage,
+    InitTLog,
+    LockTLog,
+    WorkerInterface,
+)
+
+PING_INTERVAL = 0.5
+PING_TIMEOUT = 2.0
+
+
+@dataclass
+class ClientDBInfo:
+    """What clients need (ref: fdbclient ClientDBInfo: proxy list)."""
+
+    generation: int = 0
+    proxy: object = None  # ProxyInterface
+    storage: object = None  # StorageInterface (single-shard v1)
+
+
+class ClusterController:
+    def __init__(
+        self,
+        process: SimProcess,
+        coordinators: List[CoordinatorInterface],
+        conflict_backend: str = "cpu",
+    ):
+        self.process = process
+        self.coordinators = coordinators
+        self.conflict_backend = conflict_backend
+        self.workers: Dict[str, WorkerInterface] = {}
+        self.client_info = AsyncVar(ClientDBInfo())
+        self._info_waiters: list = []
+        self.generation = 0
+        self.is_leader = AsyncVar(False)
+        self._register_stream = RequestStream(process, "cc_register", well_known=True)
+        self._info_stream = RequestStream(process, "cc_client_info", well_known=True)
+        self._recovery_needed = AsyncVar(0)  # bumped on role failure
+        change_id = process.network.loop.rng.random_int(1, 1 << 31)
+        self._leader_info = LeaderInfo(
+            priority=0,
+            change_id=change_id,
+            address=process.address,
+            payload={"register_worker": self._register_stream.ref()},
+        )
+        process.spawn(
+            try_become_leader(
+                process, coordinators, self._leader_info, self.is_leader
+            ),
+            "cc_candidacy",
+        )
+        process.spawn(self._serve_register(), "cc_register")
+        process.spawn(self._serve_client_info(), "cc_info")
+        process.spawn(self._run(), "cc_run")
+
+    # --- worker registry (ref RegisterWorkerRequest handling) ---
+    async def _serve_register(self):
+        while True:
+            wi, reply = await self._register_stream.pop()
+            fresh = wi.address not in self.workers
+            self.workers[wi.address] = wi
+            if fresh:
+                self._recovery_needed.trigger()  # may unblock recruitment
+            reply.send(None)
+
+    async def _serve_client_info(self):
+        # Parked long-polls drain on the next client_info change; the list
+        # is capped (clients whose waiter was dropped just see a same-
+        # generation reply and re-poll) so a stable generation cannot
+        # accumulate unbounded waiters.
+        while True:
+            known_gen, reply = await self._info_stream.pop()
+            info = self.client_info.get()
+            if info.generation != known_gen and info.proxy is not None:
+                reply.send(info)
+            elif len(self._info_waiters) < 256:
+                self._info_waiters.append(reply)
+            else:
+                reply.send(info)
+
+    def _publish_client_info(self, info: ClientDBInfo):
+        self.client_info.set(info)
+        waiters, self._info_waiters = self._info_waiters, []
+        for r in waiters:
+            r.send(info)
+
+    def client_info_ref(self) -> RequestStreamRef:
+        return self._info_stream.ref()
+
+    # --- the CC main loop: hold leadership, run recoveries ---
+    async def _run(self):
+        loop = self.process.network.loop
+        while True:
+            if not self.is_leader.get():
+                await self.is_leader.on_change()
+                continue
+            try:
+                await self._recovery()
+            except ActorCancelled:
+                raise
+            except Exception as e:  # noqa: BLE001 - any failure: retry
+                TraceEvent("RecoveryFailed", severity=20).detail(
+                    "error", getattr(e, "name", repr(e))
+                ).log()
+                await loop.delay(0.5)
+                continue
+            # Recovered: watch for role failures; any failure -> new recovery.
+            await self._watch_roles()
+
+    # --- recovery state machine (ref masterserver :1101-1254) ---
+    async def _recovery(self):
+        loop = self.process.network.loop
+        self.generation += 1
+        TraceEvent("RecoveryStarted").detail("generation", self.generation).log()
+
+        # READING_CSTATE
+        cstate = CoordinatedState(self.process, self.coordinators)
+        raw = await cstate.read()
+        prev = (
+            pickle.loads(raw)
+            if raw
+            else {"epoch_end": 0, "tlog_addr": None, "storage_addr": None}
+        )
+
+        # Wait for a usable worker set: stateful roles MUST return to the
+        # machines holding their files (recorded in cstate) — recruiting a
+        # fresh empty tlog/storage elsewhere would silently drop
+        # acknowledged data.  Without replication, a permanently dead
+        # stateful machine means recovery (correctly) waits.
+        tlog_w, storage_w = await self._wait_workers(
+            prev.get("tlog_addr"), prev.get("storage_addr")
+        )
+
+        # LOCKING: stop the old tlog generation and learn its durable end.
+        epoch_end = prev["epoch_end"]
+        lock = await self._try(tlog_w.init_role.get_reply(self.process, LockTLog()))
+        if isinstance(lock, int):
+            epoch_end = max(epoch_end, lock)
+
+        # RECRUITING (ref worker.actor.cpp :494-560 Initialize* handling).
+        # The tlog recovers first WITHOUT a fast-forward so the true durable
+        # end is known before the recovery version is fixed; an epoch begun
+        # below the log's durable end would let stale-version commits be
+        # swallowed as duplicates.
+        tlog_if, tlog_durable = await tlog_w.init_role.get_reply(
+            self.process,
+            InitTLog(epoch_begin=0, epoch=self.generation),
+        )
+        epoch_end = max(epoch_end, tlog_durable)
+        recovery_version = epoch_end + g_knobs.server.max_versions_in_flight
+        await tlog_w.init_role.get_reply(
+            self.process, FastForwardTLog(version=recovery_version)
+        )
+        seq_w = self._pick_stateless()
+        seq_if = await seq_w.init_role.get_reply(
+            self.process, InitSequencer(epoch_begin=recovery_version)
+        )
+        res_w = self._pick_stateless()
+        res_if = await res_w.init_role.get_reply(
+            self.process,
+            InitResolver(
+                backend=self.conflict_backend,
+                epoch_begin=recovery_version,
+                epoch=self.generation,
+            ),
+        )
+        storage_if = await storage_w.init_role.get_reply(
+            self.process, InitStorage(tlog=tlog_if)
+        )
+        proxy_w = self._pick_stateless()
+        proxy_if = await proxy_w.init_role.get_reply(
+            self.process,
+            InitProxy(
+                sequencer=seq_if,
+                resolvers=[res_if],
+                tlogs=[tlog_if],
+                epoch_begin=recovery_version,
+                epoch=self.generation,
+            ),
+        )
+        self._role_addrs = {
+            "tlog": tlog_w.address,
+            "sequencer": seq_w.address,
+            "resolver": res_w.address,
+            "storage": storage_w.address,
+            "proxy": proxy_w.address,
+        }
+
+        # WRITING_CSTATE — before serving clients (write-before-use).  The
+        # stateful-role addresses are part of the manifest so the next
+        # recovery waits for the right machines.
+        await cstate.set(
+            pickle.dumps(
+                {
+                    "epoch_end": recovery_version,
+                    "tlog_addr": tlog_w.address,
+                    "storage_addr": storage_w.address,
+                },
+                protocol=4,
+            )
+        )
+
+        # RECOVERY_TRANSACTION: advance the chain into the new epoch.
+        from ..client.types import CommitTransactionRef
+
+        await proxy_if.commit.get_reply(
+            self.process, CommitTransactionRequest(transaction=CommitTransactionRef())
+        )
+
+        # FULLY_RECOVERED: publish to clients (drains parked long-polls).
+        self._publish_client_info(
+            ClientDBInfo(
+                generation=self.generation, proxy=proxy_if, storage=storage_if
+            )
+        )
+        TraceEvent("RecoveryComplete").detail("generation", self.generation).detail(
+            "recovery_version", recovery_version
+        ).log()
+
+    async def _wait_workers(self, tlog_addr=None, storage_addr=None):
+        """(tlog_worker, storage_worker).
+
+        With a previous generation's manifest, wait for THOSE addresses (or
+        a worker that reports holding the file — same machine, new process
+        slot).  Fresh cluster: any live workers.
+        """
+        from ..flow.eventloop import timeout_after
+
+        loop = self.process.network.loop
+        while True:
+            live = await self._live_workers()
+
+            def find(addr, has_file_attr, default):
+                if addr is None:
+                    return default  # fresh cluster: no files exist yet
+                for w in live:
+                    if w.address == addr or getattr(w, has_file_attr):
+                        return w
+                return None
+
+            tlog_w = find(tlog_addr, "has_tlog_file", live[0] if live else None)
+            storage_w = find(
+                storage_addr, "has_storage_file", live[-1] if live else None
+            )
+            if tlog_w is not None and storage_w is not None:
+                return tlog_w, storage_w
+            TraceEvent("RecoveryWaitingForWorkers").detail(
+                "tlog_addr", tlog_addr
+            ).detail("storage_addr", storage_addr).log()
+            # Wake early if a worker registers (or every 0.5s).
+            await timeout_after(
+                loop, self._recovery_needed.on_change(), 0.5
+            )
+
+    async def _live_workers(self) -> List[WorkerInterface]:
+        out = []
+        for wi in list(self.workers.values()):
+            pong = await self._try(
+                wi.ping.get_reply(self.process, None), timeout=PING_TIMEOUT
+            )
+            if pong == "pong":
+                out.append(wi)
+            else:
+                del self.workers[wi.address]
+        # Deterministic order (registration dict order varies with timing).
+        out.sort(key=lambda w: w.address)
+        return out
+
+    def _pick_stateless(self) -> WorkerInterface:
+        """Spread stateless roles across live workers round-robin-ish (ref:
+        fitness-based recruitment; refined when process classes land)."""
+        addrs = sorted(self.workers)
+        self._rr = getattr(self, "_rr", 0) + 1
+        return self.workers[addrs[self._rr % len(addrs)]]
+
+    async def _watch_roles(self):
+        """Ping every recruited role's worker; any failure starts a new
+        generation (ref: masterserver waitFailure on each role -> recovery)."""
+        loop = self.process.network.loop
+        while self.is_leader.get():
+            for role, addr in self._role_addrs.items():
+                wi = self.workers.get(addr)
+                if wi is None:
+                    TraceEvent("RoleWorkerLost").detail("role", role).log()
+                    return
+                # role_check (not just ping): a rebooted worker answers pings
+                # but no longer hosts the role.
+                installed = await self._try(
+                    wi.role_check.get_reply(self.process, role),
+                    timeout=PING_TIMEOUT,
+                )
+                if installed is not True:
+                    TraceEvent("RoleFailed").detail("role", role).detail(
+                        "address", addr
+                    ).log()
+                    return  # back to _run -> new recovery
+            await loop.delay(PING_INTERVAL)
+
+    async def _try(self, fut, timeout: float = 5.0):
+        loop = self.process.network.loop
+
+        async def swallow():
+            try:
+                return await fut
+            except FdbError as e:
+                return e
+
+        return await timeout_after(
+            loop, self.process.spawn(swallow()), timeout, default=None
+        )

@@ -1,0 +1,334 @@
+"""Coordinators: replicated generation register + leader election.
+
+Ref: fdbserver/Coordination.actor.cpp — localGenerationReg :125 (per-key
+(value, readGen, writeGen) with generation promises), leaderRegister :203
+(candidacy/nominee/heartbeat), CoordinatedState.actor.cpp (quorum
+read/write with coordinated_state_conflict), LeaderElection.actor.cpp
+(tryBecomeLeader), fdbclient/MonitorLeader.actor.cpp (majority-nominee
+polling).
+
+The rebuild keeps the protocol essence on the deterministic fabric:
+
+  - generation register: read(key, gen) promises not to accept older
+    writes; write(key, value, gen) succeeds iff gen >= every promised gen
+  - quorum client: read from a majority, take the value with the highest
+    write generation; write to a majority at a higher generation or fail
+    with coordinated_state_conflict
+  - leader register: leases; nominee = lowest (priority, change_id) among
+    live candidates; candidates poll and hold a majority to lead
+
+All timing is virtual; elections are seed-reproducible.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from ..flow.asyncvar import AsyncVar
+from ..flow.error import FdbError
+from ..flow.eventloop import all_of, first_of, timeout_after
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream, RequestStreamRef
+
+CANDIDATE_TTL = 2.0
+NOMINEE_TICK = 0.5
+POLL_INTERVAL = 0.5
+
+
+@dataclass(frozen=True, order=True)
+class LeaderInfo:
+    """Candidate identity; lower sorts first and wins nomination (ref:
+    LeaderInfo operator< — priority then changeID)."""
+
+    priority: int
+    change_id: int
+    address: str = field(compare=False, default="")
+    payload: object = field(compare=False, default=None)
+
+
+# A generation is (counter, salt): totally ordered, unique per session
+# (ref: UniqueGeneration(generation, uid), CoordinationInterface.h).
+ZERO_GEN = (0, 0)
+
+
+@dataclass
+class GenReadRequest:
+    key: bytes
+    gen: tuple  # the reader's unique generation (plants the read promise)
+
+
+@dataclass
+class GenReadReply:
+    value: Optional[bytes]
+    write_gen: tuple
+    read_gen: tuple
+
+
+@dataclass
+class GenWriteRequest:
+    key: bytes
+    value: bytes
+    gen: tuple
+
+
+@dataclass
+class CandidacyRequest:
+    key: bytes
+    info: LeaderInfo
+    known_nominee: Optional[int]  # change_id the candidate last saw
+
+
+@dataclass
+class CoordinatorInterface:
+    gen_read: RequestStreamRef = None
+    gen_write: RequestStreamRef = None
+    candidacy: RequestStreamRef = None
+    get_leader: RequestStreamRef = None
+
+
+class Coordinator:
+    """One coordinator process: generation register + leader register."""
+
+    def __init__(self, process: SimProcess):
+        self.process = process
+        # key -> (value, read_gen, write_gen)
+        self.registry: Dict[bytes, Tuple[Optional[bytes], int, int]] = {}
+        # leader register (single implicit key, like one leaderRegister actor)
+        self.candidates: Dict[int, Tuple[LeaderInfo, float]] = {}
+        self.nominee: Optional[LeaderInfo] = None
+        self._waiters: List = []  # (known_change_id, reply)
+        self._gr = RequestStream(process, "coord_gen_read", well_known=True)
+        self._gw = RequestStream(process, "coord_gen_write", well_known=True)
+        self._cd = RequestStream(process, "coord_candidacy", well_known=True)
+        self._gl = RequestStream(process, "coord_get_leader", well_known=True)
+        process.spawn(self._serve_gen_read(), "coord_gr")
+        process.spawn(self._serve_gen_write(), "coord_gw")
+        process.spawn(self._serve_candidacy(), "coord_cd")
+        process.spawn(self._serve_get_leader(), "coord_gl")
+        process.spawn(self._nominee_tick(), "coord_tick")
+
+    def interface(self) -> CoordinatorInterface:
+        return CoordinatorInterface(
+            gen_read=self._gr.ref(),
+            gen_write=self._gw.ref(),
+            candidacy=self._cd.ref(),
+            get_leader=self._gl.ref(),
+        )
+
+    # --- generation register (ref localGenerationReg :125-160) ---
+    async def _serve_gen_read(self):
+        while True:
+            req, reply = await self._gr.pop()
+            value, rgen, wgen = self.registry.get(req.key, (None, ZERO_GEN, ZERO_GEN))
+            if rgen < req.gen:
+                rgen = req.gen
+                self.registry[req.key] = (value, rgen, wgen)
+            reply.send(GenReadReply(value=value, write_gen=wgen, read_gen=rgen))
+
+    async def _serve_gen_write(self):
+        while True:
+            req, reply = await self._gw.pop()
+            value, rgen, wgen = self.registry.get(req.key, (None, ZERO_GEN, ZERO_GEN))
+            # Accept iff the writer's generation matches the newest promise
+            # (ref: readGen <= gen && writeGen < gen, Coordination :148).
+            if rgen <= req.gen and wgen < req.gen:
+                self.registry[req.key] = (req.value, rgen, req.gen)
+                reply.send(req.gen)  # accepted
+            else:
+                reply.send(max(rgen, wgen))  # conflict: newer gen promised
+
+    # --- leader register (ref leaderRegister :203) ---
+    def _recompute_nominee(self, now: float):
+        live = [info for info, exp in self.candidates.values() if exp > now]
+        new = min(live) if live else None
+        if new != self.nominee:
+            self.nominee = new
+            waiters, self._waiters = self._waiters, []
+            for _known, reply in waiters:
+                reply.send(self.nominee)
+
+    async def _serve_candidacy(self):
+        # Candidacy is lease refresh + immediate nomination report: parking
+        # here would delay the candidate's own lease renewal past the TTL
+        # and make nominations flap (observed; the reference separates the
+        # heartbeat from the long-poll for the same reason).
+        while True:
+            req, reply = await self._cd.pop()
+            now = self.process.network.loop.now()
+            self.candidates[req.info.change_id] = (req.info, now + CANDIDATE_TTL)
+            self._recompute_nominee(now)
+            reply.send(self.nominee)
+
+    async def _serve_get_leader(self):
+        # Waiter list capped: abandoned long-polls (the poller timed out and
+        # re-polled) would otherwise accumulate one entry per poll cycle for
+        # as long as the nominee is stable.
+        while True:
+            req, reply = await self._gl.pop()
+            known = req  # the change_id the client knows, or None
+            if self.nominee is not None and self.nominee.change_id != known:
+                reply.send(self.nominee)
+            elif len(self._waiters) < 256:
+                self._waiters.append((known, reply))
+            else:
+                reply.send(self.nominee)  # poller re-polls; stays bounded
+
+    async def _nominee_tick(self):
+        loop = self.process.network.loop
+        while True:
+            await loop.delay(NOMINEE_TICK)
+            self._recompute_nominee(loop.now())
+
+
+class CoordinatedState:
+    """Quorum client over the coordinators' generation registers (ref:
+    CoordinatedState.actor.cpp).  One instance per reader/writer session."""
+
+    def __init__(
+        self,
+        process: SimProcess,
+        coordinators: List[CoordinatorInterface],
+        key: bytes = b"cstate",
+    ):
+        self.process = process
+        self.coordinators = coordinators
+        self.key = key
+        self.gen = ZERO_GEN  # this session's generation, fixed at read()
+        self._read_done = False
+
+    @property
+    def _quorum(self) -> int:
+        return len(self.coordinators) // 2 + 1
+
+    async def _quorum_replies(self, coros):
+        """First `quorum` successful replies (tolerates a minority of
+        failures)."""
+        results = []
+        pending = [self.process.spawn(c) for c in coros]
+        while pending and len(results) < self._quorum:
+            idx, val = await first_of(*pending)
+            pending.pop(idx)
+            if not isinstance(val, Exception):
+                results.append(val)
+        if len(results) < self._quorum:
+            raise FdbError("coordinators_changed")
+        return results
+
+    async def _replicated_read(self, gen) -> GenReadReply:
+        replies = await self._quorum_replies(
+            _swallow(c.gen_read.get_reply(self.process, GenReadRequest(self.key, gen)))
+            for c in self.coordinators
+        )
+        best = max(replies, key=lambda r: r.write_gen)
+        max_rgen = max(r.read_gen for r in replies)
+        return GenReadReply(
+            value=best.value, write_gen=best.write_gen, read_gen=max_rgen
+        )
+
+    async def read(self) -> Optional[bytes]:
+        """Two-phase (ref CoordinatedStateImpl::read): learn the newest
+        generation, then plant our own (higher) read promise and read the
+        authoritative value at it.  set() reuses that same generation, which
+        is exactly what makes a later reader's promise doom our write."""
+        probe = await self._replicated_read(ZERO_GEN)
+        counter = max(probe.write_gen[0], probe.read_gen[0]) + 1
+        salt = self.process.network.loop.rng.random_int(1, 1 << 30)
+        self.gen = (counter, salt)
+        rep = await self._replicated_read(self.gen)
+        self._read_done = True
+        return rep.value
+
+    async def set(self, value: bytes):
+        """Conditional write at the read-time generation (ref setExclusive:
+        any register that promised a newer generation rejects ->
+        coordinated_state_conflict)."""
+        assert self._read_done, "CoordinatedState.set requires a prior read"
+        replies = await self._quorum_replies(
+            _swallow(
+                c.gen_write.get_reply(
+                    self.process, GenWriteRequest(self.key, value, self.gen)
+                )
+            )
+            for c in self.coordinators
+        )
+        if any(r != self.gen for r in replies):
+            raise FdbError("coordinated_state_conflict")
+
+
+async def _swallow(fut):
+    """Convert an RPC error into a returned exception (quorum logic counts
+    failures instead of failing fast)."""
+    try:
+        return await fut
+    except FdbError as e:
+        return e
+
+
+async def try_become_leader(
+    process: SimProcess,
+    coordinators: List[CoordinatorInterface],
+    info: LeaderInfo,
+    is_leader: AsyncVar,
+):
+    """Run candidacy forever: refresh leases, watch nominations; set
+    `is_leader` True while this process holds a majority nomination (ref:
+    tryBecomeLeaderInternal LeaderElection.actor.cpp:78)."""
+    loop = process.network.loop
+    quorum = len(coordinators) // 2 + 1
+
+    async def one_round():
+        # All coordinators in parallel: a refresh round must complete well
+        # inside CANDIDATE_TTL or our own leases lapse and nominations flap.
+        futs = [
+            process.spawn(
+                _swallow(
+                    c.candidacy.get_reply(
+                        process, CandidacyRequest(b"", info, info.change_id)
+                    )
+                )
+            )
+            for c in coordinators
+        ]
+        votes = 0
+        for f in futs:
+            reply = await timeout_after(loop, f, POLL_INTERVAL, default=None)
+            if (
+                reply is not None
+                and not isinstance(reply, Exception)
+                and reply.change_id == info.change_id
+            ):
+                votes += 1
+        return votes
+
+    while True:
+        votes = await one_round()
+        is_leader.set(votes >= quorum)
+        await loop.delay(POLL_INTERVAL)
+
+
+async def monitor_leader(
+    process: SimProcess,
+    coordinators: List[CoordinatorInterface],
+    leader_var: AsyncVar,
+):
+    """Poll coordinators; publish the majority nominee (ref:
+    monitorLeaderInternal MonitorLeader.actor.cpp:427)."""
+    loop = process.network.loop
+    while True:
+        counts: Dict[int, Tuple[int, LeaderInfo]] = {}
+        for c in coordinators:
+            known = leader_var.get().change_id if leader_var.get() else None
+            fut = process.spawn(_swallow(c.get_leader.get_reply(process, known)))
+            reply = await timeout_after(loop, fut, POLL_INTERVAL, default=None)
+            if reply is None or isinstance(reply, Exception):
+                continue
+            n, _ = counts.get(reply.change_id, (0, reply))
+            counts[reply.change_id] = (n + 1, reply)
+        quorum = len(coordinators) // 2 + 1
+        for change_id, (n, info) in counts.items():
+            if n >= quorum:
+                if leader_var.get() is None or leader_var.get().change_id != change_id:
+                    leader_var.set(info)
+                break
+        await loop.delay(POLL_INTERVAL)

@@ -1,0 +1,144 @@
+"""Dynamic simulated cluster: coordinators + workers + elected controller.
+
+The full control-plane topology (ref: SimulatedCluster.actor.cpp
+setupSimulatedSystem): coordinator processes run the generation/leader
+registers; worker processes register with whichever cluster controller wins
+the election; the CC recruits roles onto workers and re-runs the recovery
+state machine whenever a role's process dies.  Clients discover proxies via
+the CC's ClientDBInfo long-poll, so they follow recoveries automatically.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..flow.asyncvar import AsyncVar
+from ..flow.error import FdbError
+from ..flow.eventloop import EventLoop, set_event_loop
+from ..fileio import SimFileSystem
+from ..rpc.network import SimNetwork
+from .cluster_controller import ClientDBInfo, ClusterController
+from .coordination import Coordinator, monitor_leader
+from .worker import WorkerServer, run_worker_registration
+
+
+class DynamicCluster:
+    def __init__(
+        self,
+        seed: int = 1,
+        n_coordinators: int = 3,
+        n_workers: int = 5,
+        n_controllers: int = 2,
+        conflict_backend: str = "cpu",
+        loop: Optional[EventLoop] = None,
+    ):
+        self.loop = loop or EventLoop(seed=seed)
+        set_event_loop(self.loop)
+        self.net = SimNetwork(self.loop)
+        self.fs = SimFileSystem(self.net)
+
+        self.coordinators = [
+            Coordinator(self.net.process(f"coord{i}")) for i in range(n_coordinators)
+        ]
+        self.coord_ifaces = [c.interface() for c in self.coordinators]
+
+        # Controller candidates: whichever wins the election acts.
+        self.controllers = [
+            ClusterController(
+                self.net.process(f"cc{i}"),
+                self.coord_ifaces,
+                conflict_backend=conflict_backend,
+            )
+            for i in range(n_controllers)
+        ]
+
+        self.workers: List[WorkerServer] = []
+        for i in range(n_workers):
+            proc = self.net.process(f"worker{i}")
+            w = WorkerServer(proc, self.fs)
+            self.workers.append(w)
+            leader_var = AsyncVar(None)
+            proc.spawn(
+                monitor_leader(proc, self.coord_ifaces, leader_var), "leader_mon"
+            )
+            proc.spawn(run_worker_registration(w, leader_var), "registration")
+
+        self._n_clients = 0
+
+    # --- clients ---
+    def database(self, name: str = ""):
+        from ..client.transaction import Database
+
+        self._n_clients += 1
+        proc = self.net.process(name or f"client{self._n_clients}")
+        info_var = AsyncVar(ClientDBInfo())
+        leader_var = AsyncVar(None)
+        proc.spawn(monitor_leader(proc, self.coord_ifaces, leader_var), "leader_mon")
+        proc.spawn(
+            self._monitor_client_info(proc, leader_var, info_var), "info_mon"
+        )
+        return Database(proc, info_var=info_var)
+
+    async def _monitor_client_info(self, proc, leader_var, info_var):
+        """Long-poll the elected CC for ClientDBInfo (ref: monitorProxies)."""
+        loop = self.loop
+        while True:
+            leader = leader_var.get()
+            if leader is None:
+                await loop.delay(0.2)
+                continue
+            cc = next(
+                (
+                    c
+                    for c in self.controllers
+                    if c.process.address == leader.address
+                ),
+                None,
+            )
+            if cc is None:
+                await loop.delay(0.2)
+                continue
+            try:
+                from ..flow.eventloop import timeout_after
+
+                # Bounded long-poll: if we guessed the leader wrong (or it
+                # changes), re-check rather than park forever.
+                info = await timeout_after(
+                    loop,
+                    cc.client_info_ref().get_reply(
+                        proc, info_var.get().generation
+                    ),
+                    2.0,
+                    default=None,
+                )
+                if info is not None:
+                    info_var.set(info)
+            except FdbError:
+                await loop.delay(0.2)
+
+    # --- drivers ---
+    def run_until(self, future, timeout_vt: float = 1000.0):
+        return self.loop.run_until(future, timeout_vt=timeout_vt)
+
+    def run_all(self, coros_by_db, timeout_vt: float = 1000.0):
+        from ..flow.eventloop import all_of
+
+        tasks = [db.process.spawn(c) for db, c in coros_by_db]
+        return self.run_until(all_of(tasks), timeout_vt=timeout_vt)
+
+    def kill_role_process(self, role: str):
+        """Kill the worker process currently hosting `role` (as recruited by
+        the acting controller)."""
+        cc = self.acting_controller()
+        addr = cc._role_addrs[role]
+        proc = self.net.get_process(addr)
+        proc.kill()
+        return proc
+
+    def acting_controller(self) -> ClusterController:
+        for c in self.controllers:
+            # A dead CC's is_leader var is frozen at its last value; only a
+            # live process can act.
+            if c.process.alive and c.is_leader.get():
+                return c
+        raise RuntimeError("no controller is leader")

@@ -69,6 +69,7 @@ class ResolveTransactionBatchRequest:
     version: int = 0
     last_received_version: int = 0
     transactions: List[TransactionConflictInfo] = field(default_factory=list)
+    epoch: int = 0  # generation guard: stale-epoch requests are rejected
 
 
 @dataclass
@@ -89,6 +90,7 @@ class TLogCommitRequest:
     prev_version: int = 0
     version: int = 0
     mutations: List[Mutation] = field(default_factory=list)
+    epoch: int = 0  # generation guard (ref: epoch locking at recovery)
 
 
 @dataclass

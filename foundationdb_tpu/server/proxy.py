@@ -41,8 +41,10 @@ class Proxy:
         resolvers: List[ResolverInterface],
         tlogs: List[TLogInterface],
         epoch_begin_version: int = 0,
+        epoch: int = 0,
     ):
         self.process = process
+        self.epoch = epoch
         self.sequencer = sequencer
         self.resolvers = resolvers
         self.tlogs = tlogs
@@ -127,7 +129,7 @@ class Proxy:
             for (req, _reply) in batch
         ]
         resolve_req = ResolveTransactionBatchRequest(
-            prev_version=prev, version=version, transactions=infos
+            prev_version=prev, version=version, transactions=infos, epoch=self.epoch
         )
         replies = await wait_for_all(
             [r.resolve.get_reply(self.process, resolve_req) for r in self.resolvers]
@@ -164,7 +166,10 @@ class Proxy:
                 tl.commit.get_reply(
                     self.process,
                     TLogCommitRequest(
-                        prev_version=prev, version=version, mutations=mutations
+                        prev_version=prev,
+                        version=version,
+                        mutations=mutations,
+                        epoch=self.epoch,
                     ),
                 )
                 for tl in self.tlogs

@@ -29,8 +29,10 @@ class Resolver:
         backend: str = "cpu",
         epoch_begin_version: int = 0,
         conflict_set: ConflictSet = None,
+        epoch: int = 0,
     ):
         self.process = process
+        self.epoch = epoch
         self.conflicts = conflict_set or ConflictSet(
             backend=backend, oldest_version=epoch_begin_version
         )
@@ -48,6 +50,9 @@ class Resolver:
             self.process.spawn(self._resolve_one(req, reply), "resolve_batch")
 
     async def _resolve_one(self, req: ResolveTransactionBatchRequest, reply):
+        if req.epoch != self.epoch:
+            reply.send_error("operation_failed")  # stale generation's proxy
+            return
         # Order batches by the sequencer's prevVersion chain: a batch may
         # arrive before its predecessor (ref :104-115).
         await self.version.when_at_least(req.prev_version)

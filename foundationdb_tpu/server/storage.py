@@ -333,24 +333,29 @@ class StorageServer:
         wkeys = self.store.sorted_keys
         wi = bisect_left(wkeys, begin)
         wj = bisect_left(wkeys, end)
-        a = base_keys[bi:bj]
-        b = wkeys[wi:wj]
-        if reverse:
-            a, b = a[::-1], b[::-1]
         rows: list = []
-        ia = ib = 0
         before = (lambda x, y: x > y) if reverse else (lambda x, y: x < y)
-        while (ia < len(a) or ib < len(b)) and len(rows) < limit:
-            if ib >= len(b) or (ia < len(a) and before(a[ia], b[ib])):
-                k = a[ia]
-                ia += 1
-            elif ia >= len(a) or before(b[ib], a[ia]):
-                k = b[ib]
-                ib += 1
+        # Index the sorted lists in place (no range-sized copies) so a
+        # limited read really is O(limit + masked keys skipped).
+        if reverse:
+            ia, ea, step = bj - 1, bi - 1, -1
+            ib, eb = wj - 1, wi - 1
+        else:
+            ia, ea, step = bi, bj, 1
+            ib, eb = wi, wj
+        while (ia != ea or ib != eb) and len(rows) < limit:
+            ka = base_keys[ia] if ia != ea else None
+            kb = wkeys[ib] if ib != eb else None
+            if kb is None or (ka is not None and before(ka, kb)):
+                k = ka
+                ia += step
+            elif ka is None or before(kb, ka):
+                k = kb
+                ib += step
             else:  # same key in both
-                k = a[ia]
-                ia += 1
-                ib += 1
+                k = ka
+                ia += step
+                ib += step
             touched, wv = self.store.get_stamped(k, version)
             v = wv if touched else self.kvstore.read_value(k)
             if v is not None:

@@ -34,14 +34,19 @@ class TLog:
         process: SimProcess,
         epoch_begin_version: int = 0,
         disk_queue=None,
+        epoch: int = 0,
     ):
         self.process = process
+        self.epoch = epoch
         # Parallel sorted lists: versions[i] holds mutation list entries[i].
         self.versions: List[int] = []
         self.entries: List[list] = []
         self.durable = NotifiedVersion(epoch_begin_version)
         self.popped = epoch_begin_version
         self.disk_queue = disk_queue  # None = in-memory (simulated fsync)
+        # Epoch-end lock: a locked log rejects further commits (ref: the
+        # TLogLockResult protocol during recovery's LOCKING_CSTATE).
+        self.locked = False
         self._commit_stream = RequestStream(process, "tlog_commit", well_known=True)
         self._peek_stream = RequestStream(process, "tlog_peek", well_known=True)
         self._pop_stream = RequestStream(process, "tlog_pop", well_known=True)
@@ -56,6 +61,7 @@ class TLog:
         fs,
         filename: str = "tlog.dq",
         fast_forward_to: int = 0,
+        epoch: int = 0,
     ) -> "TLog":
         """Reopen the on-disk queue and rebuild the unpopped suffix (ref:
         TLogServer restorePersistentState).  `fast_forward_to` jumps the
@@ -66,7 +72,7 @@ class TLog:
         from ..fileio.diskqueue import DiskQueue
 
         q, records = await DiskQueue.open(fs, process, filename)
-        log = cls(process, disk_queue=q)
+        log = cls(process, disk_queue=q, epoch=epoch)
         for _seq, payload in records:
             version, mutations = pickle.loads(payload)
             log.versions.append(version)
@@ -89,9 +95,18 @@ class TLog:
             self.process.spawn(self._commit_one(req, reply), "tlog_commit_one")
 
     async def _commit_one(self, req: TLogCommitRequest, reply):
+        if self.locked or req.epoch != self.epoch:
+            # Locked (epoch ended) or a stale generation's proxy reaching a
+            # newer log: never silently absorb (ref: epoch locking prevents
+            # cross-generation pushes).
+            reply.send_error("tlog_stopped")
+            return
         # Versions are committed in the sequencer's order (ref: TLogServer
         # waits version ordering before appending).
         await self.durable.when_at_least(req.prev_version)
+        if self.locked:
+            reply.send_error("tlog_stopped")
+            return
         if req.version <= self.durable.get():
             reply.send(self.durable.get())  # duplicate
             return

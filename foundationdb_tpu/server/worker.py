@@ -1,0 +1,234 @@
+"""Worker: the generic process agent that hosts roles on request.
+
+Ref: fdbserver/worker.actor.cpp — workerServer :481 registers with the
+cluster controller and spawns role actors from Initialize*Requests
+(:494-560); a role's state files live on the worker's machine, so the
+controller recruits stateful roles back onto the machines that hold their
+disks (the rebuild's stand-in for tag-aware recruitment until replication
+lands).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream, RequestStreamRef
+from .interfaces import ResolverInterface, SequencerInterface, TLogInterface
+from .proxy import Proxy
+from .resolver import Resolver
+from .sequencer import Sequencer
+from .storage import StorageServer
+from .tlog import TLog
+
+
+@dataclass
+class WorkerInterface:
+    address: str = ""
+    init_role: RequestStreamRef = None
+    ping: RequestStreamRef = None
+    role_check: RequestStreamRef = None
+    has_tlog_file: bool = False
+    has_storage_file: bool = False
+
+
+@dataclass
+class InitSequencer:
+    epoch_begin: int = 0
+
+
+@dataclass
+class InitResolver:
+    backend: str = "cpu"
+    epoch_begin: int = 0
+    epoch: int = 0
+
+
+@dataclass
+class InitTLog:
+    epoch_begin: int = 0
+    recover_from_disk: bool = True
+    epoch: int = 0
+
+
+@dataclass
+class LockTLog:
+    """Epoch end: stop the current tlog generation, report durable version
+    (ref: TLogServer epoch end locking via TagPartitionedLogSystem)."""
+
+
+@dataclass
+class FastForwardTLog:
+    """Jump the recovered tlog's durable chain to the new epoch's begin,
+    once the recovery version is fixed (it must exceed the log's true
+    durable end, which is only known after recovery from disk)."""
+
+    version: int = 0
+
+
+@dataclass
+class InitStorage:
+    tlog: TLogInterface = None
+
+
+@dataclass
+class InitProxy:
+    sequencer: SequencerInterface = None
+    resolvers: List[ResolverInterface] = field(default_factory=list)
+    tlogs: List[TLogInterface] = field(default_factory=list)
+    epoch_begin: int = 0
+    epoch: int = 0
+
+
+class WorkerServer:
+    def __init__(self, process: SimProcess, fs):
+        self.process = process
+        self.fs = fs
+        self.roles: dict = {}
+        self.role_tasks: dict = {}  # role name -> actor tasks to cancel on replace
+        self._init_stream = RequestStream(process, "worker_init", well_known=True)
+        self._ping_stream = RequestStream(process, "worker_ping", well_known=True)
+        self._role_check_stream = RequestStream(
+            process, "worker_role_check", well_known=True
+        )
+        process.spawn(self._serve_init(), "worker_init")
+        process.spawn(self._serve_ping(), "worker_ping")
+        process.spawn(self._serve_role_check(), "worker_role_check")
+
+    def _replace_role(self, name: str, role, tasks):
+        """Install a new generation's role instance, cancelling the previous
+        instance's actors so two generations never run side by side (e.g.
+        two storage servers double-applying to one engine file)."""
+        for t in self.role_tasks.get(name, []):
+            if not t.is_ready():
+                t.cancel()
+        self.roles[name] = role
+        self.role_tasks[name] = tasks
+
+    def interface(self) -> WorkerInterface:
+        return WorkerInterface(
+            address=self.process.address,
+            init_role=self._init_stream.ref(),
+            ping=self._ping_stream.ref(),
+            role_check=self._role_check_stream.ref(),
+            has_tlog_file=self.fs.exists(self.process, "tlog.dq"),
+            has_storage_file=self.fs.exists(self.process, "storage.dq"),
+        )
+
+    async def _serve_ping(self):
+        while True:
+            _req, reply = await self._ping_stream.pop()
+            reply.send("pong")
+
+    async def _serve_role_check(self):
+        """Is a role still installed?  A rebooted worker answers pings but
+        has an empty role table — the controller uses this to detect role
+        death on a live process (ref: per-role waitFailureServer)."""
+        while True:
+            role_name, reply = await self._role_check_stream.pop()
+            reply.send(role_name in self.roles)
+
+    async def _serve_init(self):
+        while True:
+            req, reply = await self._init_stream.pop()
+            self.process.spawn(self._init_one(req, reply), "worker_init_one")
+
+    async def _init_one(self, req, reply):
+        # Task capture: actors this process spawns while the role constructs
+        # belong to the new role instance (recoveries are driven serially by
+        # the CC, so concurrent unrelated spawns are not expected here).
+        # Identity-based: spawn() prunes finished tasks, so indices shift.
+        before = {id(t) for t in self.process._tasks}
+
+        def new_tasks():
+            return [t for t in self.process._tasks if id(t) not in before]
+
+        try:
+            if isinstance(req, InitSequencer):
+                role = Sequencer(self.process, epoch_begin_version=req.epoch_begin)
+                self._replace_role("sequencer", role, new_tasks())
+                reply.send(role.interface())
+            elif isinstance(req, InitResolver):
+                role = Resolver(
+                    self.process,
+                    backend=req.backend,
+                    epoch_begin_version=req.epoch_begin,
+                    epoch=req.epoch,
+                )
+                self._replace_role("resolver", role, new_tasks())
+                reply.send(role.interface())
+            elif isinstance(req, InitTLog):
+                if req.recover_from_disk:
+                    role = await TLog.recover(
+                        self.process,
+                        self.fs,
+                        "tlog.dq",
+                        fast_forward_to=req.epoch_begin,
+                        epoch=req.epoch,
+                    )
+                else:
+                    role = TLog(
+                        self.process,
+                        epoch_begin_version=req.epoch_begin,
+                        epoch=req.epoch,
+                    )
+                self._replace_role("tlog", role, new_tasks())
+                reply.send((role.interface(), role.durable.get()))
+            elif isinstance(req, LockTLog):
+                role: Optional[TLog] = self.roles.get("tlog")
+                if role is None:
+                    reply.send(None)
+                else:
+                    role.locked = True
+                    reply.send(role.durable.get())
+            elif isinstance(req, FastForwardTLog):
+                role = self.roles.get("tlog")
+                if role is None:
+                    reply.send_error("recruitment_failed")
+                else:
+                    if req.version > role.durable.get():
+                        role.durable.set(req.version)
+                    reply.send(role.durable.get())
+            elif isinstance(req, InitStorage):
+                role = await StorageServer.recover(
+                    self.process, req.tlog, self.fs, "storage.dq"
+                )
+                self._replace_role("storage", role, new_tasks())
+                reply.send(role.interface())
+            elif isinstance(req, InitProxy):
+                role = Proxy(
+                    self.process,
+                    req.sequencer,
+                    req.resolvers,
+                    req.tlogs,
+                    epoch_begin_version=req.epoch_begin,
+                    epoch=req.epoch,
+                )
+                self._replace_role("proxy", role, new_tasks())
+                reply.send(role.interface())
+            else:
+                reply.send_error("client_invalid_operation")
+        except Exception:  # noqa: BLE001 - recruitment failed; CC retries
+            reply.send_error("recruitment_failed")
+
+
+async def run_worker_registration(
+    worker: WorkerServer, cc_leader_var, interval: float = 1.0
+):
+    """Keep the cluster controller aware of this worker (ref:
+    registrationClient worker.actor.cpp; re-registers on CC change)."""
+    from ..flow.error import FdbError
+
+    process = worker.process
+    loop = process.network.loop
+    while True:
+        leader = cc_leader_var.get()
+        if leader is not None and leader.payload is not None:
+            register_ref = leader.payload.get("register_worker")
+            if register_ref is not None:
+                try:
+                    await register_ref.get_reply(process, worker.interface())
+                except FdbError:
+                    pass
+        await loop.delay(interval)
