@@ -20,6 +20,7 @@ from ..conflict.types import Range
 from ..flow.error import FdbError
 from ..flow.knobs import g_knobs
 from ..rpc.network import SimProcess
+from ..flow.future import Future, Promise
 from ..server.interfaces import (
     CommitTransactionRequest,
     GetKeyValuesRequest,
@@ -27,11 +28,13 @@ from ..server.interfaces import (
     GetValueRequest,
     ProxyInterface,
     StorageInterface,
+    WatchValueRequest,
 )
 from .atomic import apply_atomic
 from .types import (
     ATOMIC_TYPES,
     CommitTransactionRef,
+    KeySelector,
     Mutation,
     MutationType,
     key_after,
@@ -100,6 +103,7 @@ class Transaction:
         self.committed_version: Optional[int] = None
         self.options: dict = {}
         self._retries = 0
+        self._watches: List[tuple] = []  # (key, value, Promise), armed at commit
 
     # --- versions ---
     async def get_read_version(self) -> int:
@@ -190,6 +194,27 @@ class Transaction:
                 self.add_read_conflict_range(begin, end)
         return out
 
+    async def get_key(self, selector: KeySelector, snapshot: bool = False) -> bytes:
+        """Resolve a KeySelector to a key (ref: Transaction::getKey; storage
+        getKeyQ).  Resolution: index into the sorted key list at
+        (first key {>|>=} sel.key) + offset - 1; before-the-front resolves
+        to b"" and past-the-end to b"\\xff" (allKeys end), like the ref."""
+        start = key_after(selector.key) if selector.or_equal else selector.key
+        if selector.offset >= 1:
+            rows = await self.get_range(
+                start, b"\xff", limit=selector.offset, snapshot=snapshot
+            )
+            if len(rows) >= selector.offset:
+                return rows[selector.offset - 1][0]
+            return b"\xff"
+        back = 1 - selector.offset
+        rows = await self.get_range(
+            b"", start, limit=back, reverse=True, snapshot=snapshot
+        )
+        if len(rows) >= back:
+            return rows[back - 1][0]
+        return b""
+
     # --- writes ---
     def set(self, key: bytes, value: bytes):
         self._check_size(key, value)
@@ -250,6 +275,46 @@ class Transaction:
         if key >= b"\xff" and not self.options.get("access_system_keys"):
             raise FdbError("key_outside_legal_range")
 
+    # --- watches (ref: Transaction::watch + commitAndWatch NativeAPI:2544) ---
+    async def watch(self, key: bytes) -> Future:
+        """Future that fires when `key`'s value changes from what this
+        transaction observes.  Registered only after a successful commit
+        (read-only transactions register at the read version); the watch
+        re-arms itself across storage failures."""
+        self._check_legal_key(key)
+        value = await self.get(key, snapshot=True)
+        p = Promise()
+        self._watches.append((key, value, p))
+        return p.future
+
+    async def _arm_watch(self, key: bytes, value, promise: Promise, version: int):
+        while True:
+            try:
+                fired = await self.db.storage.watch_value.get_reply(
+                    self.db.process, WatchValueRequest(key, value, version)
+                )
+                if not promise.is_set():
+                    promise.send(fired)
+                return
+            except FdbError as e:
+                if e.name not in ("broken_promise", "transaction_too_old"):
+                    if not promise.is_set():
+                        promise.send_error(e)
+                    return
+                # Storage moved/restarted: re-register against the current
+                # value; if it changed while we were down, fire.
+                await self.db.process.network.loop.delay(0.1)
+                tr = self.db.create_transaction()
+                try:
+                    now_val = await tr.get(key, snapshot=True)
+                except FdbError:
+                    continue
+                if now_val != value:
+                    if not promise.is_set():
+                        promise.send(tr._read_version)
+                    return
+                version = tr._read_version
+
     # --- conflict ranges ---
     def add_read_conflict_range(self, begin: bytes, end: bytes):
         if begin < end:
@@ -263,6 +328,7 @@ class Transaction:
     async def commit(self) -> Optional[int]:
         if not self.mutations and not self.write_conflict_ranges:
             self.committed_version = self._read_version
+            self._launch_watches(self._read_version or 0)
             return self.committed_version  # read-only: nothing to do
         if self.db.info_var is not None:
             await self.db.wait_connected()
@@ -279,7 +345,15 @@ class Transaction:
             self.db.process, CommitTransactionRequest(transaction=tref)
         )
         self.committed_version = version
+        self._launch_watches(version)
         return version
+
+    def _launch_watches(self, version: int):
+        watches, self._watches = self._watches, []
+        for key, value, promise in watches:
+            self.db.process.spawn(
+                self._arm_watch(key, value, promise, version), "watch"
+            )
 
     async def on_error(self, e: FdbError):
         """Backoff + reset if retryable, else re-raise (ref: onError)."""
@@ -303,6 +377,10 @@ class Transaction:
         self.read_conflict_ranges = []
         self.write_conflict_ranges = []
         self.committed_version = None
+        for _k, _v, promise in self._watches:
+            if not promise.is_set():
+                promise.send_error(FdbError("watch_cancelled"))
+        self._watches = []
 
 
 def _coalesce(ranges: List[Range]) -> List[Range]:

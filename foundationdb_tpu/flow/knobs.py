@@ -78,6 +78,12 @@ class ServerKnobs(Knobs):
         self._init("conflict_device_key_words", 4)  # uint32 words per key
         self._init("conflict_max_device_key_bytes", 16)  # > this: CPU fallback
         self._init("conflict_history_capacity", 1 << 20)
+        self._init("max_watches", 10000)  # ref: MAX_STORAGE_SERVER_WATCHES
+        # Ratekeeper (ref: Ratekeeper.actor.cpp knobs, distilled)
+        self._init("ratekeeper_max_tps", 100000.0)
+        self._init("ratekeeper_min_tps", 10.0)
+        self._init("ratekeeper_target_lag_versions", 500_000)
+        self._init("ratekeeper_spring_lag_versions", 2_000_000)
 
 
 class KnobSet:

@@ -10,6 +10,13 @@ from __future__ import annotations
 from typing import Optional
 
 from ..flow.eventloop import EventLoop, set_event_loop
+
+
+def even_split_keys(n_resolvers: int) -> list:
+    """n-1 single-byte split points partitioning the key space evenly (ref:
+    the initial keyResolvers split; dynamic rebalancing via
+    ResolutionSplitRequest arrives later)."""
+    return [bytes([256 * i // n_resolvers]) for i in range(1, n_resolvers)]
 from ..rpc.network import SimNetwork
 from .proxy import Proxy
 from .resolver import Resolver
@@ -26,6 +33,7 @@ class SimCluster:
         conflict_set=None,
         loop: Optional[EventLoop] = None,
         durable: bool = False,
+        n_resolvers: int = 1,
     ):
         self.loop = loop or EventLoop(seed=seed)
         set_event_loop(self.loop)
@@ -35,31 +43,42 @@ class SimCluster:
         self.durable = durable
         self.fs = None
         self.master_proc = self.net.process("master")
-        self.resolver_proc = self.net.process("resolver")
+        self.resolver_procs = [
+            self.net.process(f"resolver{i}" if i else "resolver")
+            for i in range(n_resolvers)
+        ]
+        self.resolver_proc = self.resolver_procs[0]
         self.tlog_proc = self.net.process("tlog")
         self.storage_proc = self.net.process("storage")
         self.proxy_proc = self.net.process("proxy")
         self._n_clients = 0
+        self.split_keys = even_split_keys(n_resolvers)
 
         if durable:
             from ..fileio import SimFileSystem
 
+            assert n_resolvers == 1, "durable multi-resolver: use DynamicCluster"
             self.fs = SimFileSystem(self.net)
             self._start_roles_durable(epoch_begin=0)
         else:
             self.sequencer = Sequencer(self.master_proc)
-            self.resolver = Resolver(
-                self.resolver_proc,
-                backend=conflict_backend,
-                conflict_set=conflict_set,
-            )
+            self.resolvers = [
+                Resolver(
+                    p,
+                    backend=conflict_backend,
+                    conflict_set=conflict_set if i == 0 else None,
+                )
+                for i, p in enumerate(self.resolver_procs)
+            ]
+            self.resolver = self.resolvers[0]
             self.tlog = TLog(self.tlog_proc)
             self.storage = StorageServer(self.storage_proc, self.tlog.interface())
             self.proxy = Proxy(
                 self.proxy_proc,
                 self.sequencer.interface(),
-                [self.resolver.interface()],
+                [r.interface() for r in self.resolvers],
                 [self.tlog.interface()],
+                resolver_split_keys=self.split_keys,
             )
 
     def _start_roles_durable(self, epoch_begin: int):

@@ -152,7 +152,19 @@ class GetKeyValuesReply:
 
 
 @dataclass
+class WatchValueRequest:
+    """Fire when key's value differs from `value` at or after `version`
+    (ref: WatchValueRequest StorageServerInterface.h; watchValue_impl
+    storageserver.actor.cpp:760)."""
+
+    key: bytes = b""
+    value: Optional[bytes] = None
+    version: int = 0
+
+
+@dataclass
 class StorageInterface:
     get_value: RequestStreamRef = None
     get_key_values: RequestStreamRef = None
     get_version: RequestStreamRef = None
+    watch_value: RequestStreamRef = None

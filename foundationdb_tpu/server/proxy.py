@@ -33,6 +33,28 @@ from .interfaces import (
 )
 
 
+def split_ranges_for_resolver(
+    tr: TransactionConflictInfo, lo: bytes, hi
+) -> TransactionConflictInfo:
+    """Clip a transaction's conflict ranges to one resolver's key range
+    (ref: ResolutionRequestBuilder.addTransaction
+    MasterProxyServer.actor.cpp:280-303 — every resolver gets a slot for
+    every transaction so reply indices align; ranges outside its space are
+    simply absent)."""
+
+    def clip(rng):
+        b, e = rng
+        cb = max(b, lo)
+        ce = e if hi is None else min(e, hi)
+        return (cb, ce) if cb < ce else None
+
+    return TransactionConflictInfo(
+        read_snapshot=tr.read_snapshot,
+        read_ranges=[c for r in tr.read_ranges if (c := clip(r)) is not None],
+        write_ranges=[c for r in tr.write_ranges if (c := clip(r)) is not None],
+    )
+
+
 class Proxy:
     def __init__(
         self,
@@ -42,12 +64,22 @@ class Proxy:
         tlogs: List[TLogInterface],
         epoch_begin_version: int = 0,
         epoch: int = 0,
+        resolver_split_keys: List[bytes] = None,
+        ratekeeper=None,  # RatekeeperInterface or None (no admission control)
     ):
         self.process = process
         self.epoch = epoch
         self.sequencer = sequencer
         self.resolvers = resolvers
         self.tlogs = tlogs
+        # Key-space partition across resolvers (ref: keyResolvers
+        # KeyRangeMap :185).  n resolvers need n-1 split points.
+        split = resolver_split_keys or []
+        assert len(split) == len(resolvers) - 1, "need n-1 split keys"
+        self.resolver_bounds = list(
+            zip([b""] + split, split + [None])
+        )  # [(lo, hi_or_None)] per resolver
+        self.ratekeeper = ratekeeper
         self.committed = NotifiedVersion(epoch_begin_version)
         self._commit_stream = RequestStream(process, "commit", well_known=True)
         self._grv_stream = RequestStream(process, "grv", well_known=True)
@@ -63,8 +95,44 @@ class Proxy:
 
     # --- GRV (ref transactionStarter :934; single-proxy causal shortcut) ---
     async def _serve_grv(self):
+        """Release read versions no faster than the ratekeeper's budget
+        (ref: transactionStarter draining its queue against the rate)."""
+        loop = self.process.network.loop
+        budget = 1.0
+        last_refill = loop.now()
+        tps = None
+        last_fetch = -1e9
         while True:
             _req, reply = await self._grv_stream.pop()
+            if self.ratekeeper is not None:
+                if loop.now() - last_fetch > 0.1:
+                    try:
+                        info = await self.ratekeeper.get_rate.get_reply(
+                            self.process, None
+                        )
+                        tps = info.tps
+                    except Exception:  # noqa: BLE001 - rk down: keep old rate
+                        pass
+                    last_fetch = loop.now()
+                if tps is not None:
+                    now = loop.now()
+                    budget = min(
+                        budget + (now - last_refill) * tps, max(1.0, tps * 0.1)
+                    )
+                    last_refill = now
+                    while budget < 1.0:
+                        # Floor the wait: a sub-float-resolution delay would
+                        # not advance virtual time and the loop would spin.
+                        await loop.delay(
+                            max((1.0 - budget) / max(tps, 1e-6), 5e-4)
+                        )
+                        now = loop.now()
+                        budget = min(
+                            budget + (now - last_refill) * tps,
+                            max(1.0, tps * 0.1),
+                        )
+                        last_refill = now
+                    budget -= 1.0
             reply.send(self.committed.get())
 
     # --- commit batching (ref batcher.actor.h + commitBatch :318) ---
@@ -128,11 +196,21 @@ class Proxy:
             )
             for (req, _reply) in batch
         ]
-        resolve_req = ResolveTransactionBatchRequest(
-            prev_version=prev, version=version, transactions=infos, epoch=self.epoch
-        )
         replies = await wait_for_all(
-            [r.resolve.get_reply(self.process, resolve_req) for r in self.resolvers]
+            [
+                r.resolve.get_reply(
+                    self.process,
+                    ResolveTransactionBatchRequest(
+                        prev_version=prev,
+                        version=version,
+                        transactions=[
+                            split_ranges_for_resolver(tr, lo, hi) for tr in infos
+                        ],
+                        epoch=self.epoch,
+                    ),
+                )
+                for r, (lo, hi) in zip(self.resolvers, self.resolver_bounds)
+            ]
         )
         statuses = [
             min(rep.committed[t] for rep in replies) for t in range(len(batch))

@@ -1,0 +1,81 @@
+"""Ratekeeper: cluster-wide admission control.
+
+Ref: fdbserver/Ratekeeper.actor.cpp — trackStorageServerQueueInfo :138 /
+trackTLogQueueInfo :179 sample every log and storage server; updateRate
+:251-340 computes a global transactions-per-second limit from the worst
+queues (a "spring" that compresses as the lag approaches the limit); proxies
+fetch the limit with their GRV loop (rateKeeper :509) and release queued
+read-version requests no faster than the budget.
+
+The rebuild's primary signal is version lag (log durable version minus
+storage applied version): storage falling behind the log is exactly the
+condition the reference's MVCC window protects (reads older than the window
+die with transaction_too_old), so admission slows before the window is
+overrun.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import List
+
+from ..flow.knobs import g_knobs
+from ..rpc.network import SimProcess
+from ..rpc.stream import RequestStream, RequestStreamRef
+
+
+@dataclass
+class RateInfo:
+    tps: float = 1e9
+    lag_versions: int = 0
+
+
+@dataclass
+class RatekeeperInterface:
+    get_rate: RequestStreamRef = None
+
+
+class Ratekeeper:
+    def __init__(
+        self,
+        process: SimProcess,
+        tlogs: List[object],  # TLog role objects (sim: direct metric access)
+        storages: List[object],
+        sample_interval: float = 0.1,
+    ):
+        self.process = process
+        self.tlogs = tlogs
+        self.storages = storages
+        self.sample_interval = sample_interval
+        self.rate = RateInfo(tps=g_knobs.server.ratekeeper_max_tps)
+        self._stream = RequestStream(process, "rk_get_rate", well_known=True)
+        process.spawn(self._update_loop(), "rk_update")
+        process.spawn(self._serve(), "rk_serve")
+
+    def interface(self) -> RatekeeperInterface:
+        return RatekeeperInterface(get_rate=self._stream.ref())
+
+    async def _update_loop(self):
+        """Ref updateRate :251-340, distilled: spring on worst version lag."""
+        loop = self.process.network.loop
+        srv = g_knobs.server
+        while True:
+            await loop.delay(self.sample_interval)
+            log_v = max((t.durable.get() for t in self.tlogs), default=0)
+            ss_v = min((s.version.get() for s in self.storages), default=log_v)
+            lag = max(0, log_v - ss_v)
+            target = srv.ratekeeper_target_lag_versions
+            spring = srv.ratekeeper_spring_lag_versions
+            if lag <= target:
+                factor = 1.0
+            else:
+                factor = max(0.0, 1.0 - (lag - target) / spring)
+            self.rate = RateInfo(
+                tps=max(srv.ratekeeper_min_tps, srv.ratekeeper_max_tps * factor),
+                lag_versions=lag,
+            )
+
+    async def _serve(self):
+        while True:
+            _req, reply = await self._stream.pop()
+            reply.send(self.rate)

@@ -1,0 +1,93 @@
+"""Cluster status document (ref: Status.actor.cpp clusterGetStatus :1690 —
+the giant JSON doc consumed by fdbcli `status` and the StatusWorkload).
+
+The rebuild aggregates live role state into the same overall shape
+(cluster/qos/data/workload sections, recovery state, process list); fields
+grow as subsystems land.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+def cluster_status(cluster) -> dict:
+    """Status for a SimCluster or DynamicCluster."""
+    doc: dict = {
+        "client": {
+            "database_status": {"available": True, "healthy": True},
+            "coordinators": {},
+        },
+        "cluster": {},
+    }
+    cl = doc["cluster"]
+    if hasattr(cluster, "controllers"):  # DynamicCluster
+        try:
+            cc = cluster.acting_controller()
+        except RuntimeError:
+            cc = None
+        doc["client"]["database_status"]["available"] = cc is not None and (
+            cc.client_info.get().proxy is not None
+        )
+        cl["recovery_state"] = {
+            "name": "fully_recovered" if cc and cc.client_info.get().proxy else "recruiting",
+            "generation": cc.generation if cc else 0,
+        }
+        cl["cluster_controller"] = cc.process.address if cc else None
+        cl["workers"] = sorted(cc.workers) if cc else []
+        cl["coordinators"] = [
+            c.process.address for c in cluster.coordinators
+        ]
+        doc["client"]["coordinators"] = {
+            "quorum_reachable": sum(
+                1 for c in cluster.coordinators if c.process.alive
+            )
+            > len(cluster.coordinators) // 2,
+        }
+        roles = {}
+        for w in cluster.workers:
+            for name, role in w.roles.items():
+                roles.setdefault(name, []).append(w.process.address)
+        cl["roles"] = roles
+        storage = next(
+            (w.roles["storage"] for w in cluster.workers if "storage" in w.roles),
+            None,
+        )
+        tlog = next(
+            (w.roles["tlog"] for w in cluster.workers if "tlog" in w.roles), None
+        )
+        proxy = next(
+            (w.roles["proxy"] for w in cluster.workers if "proxy" in w.roles), None
+        )
+    else:  # SimCluster
+        cl["recovery_state"] = {"name": "fully_recovered", "generation": 1}
+        cl["roles"] = {
+            "sequencer": [cluster.master_proc.address],
+            "resolver": [p.address for p in cluster.resolver_procs],
+            "tlog": [cluster.tlog_proc.address],
+            "storage": [cluster.storage_proc.address],
+            "proxy": [cluster.proxy_proc.address],
+        }
+        storage, tlog, proxy = cluster.storage, cluster.tlog, cluster.proxy
+
+    if storage is not None:
+        cl["data"] = {
+            "storage_version": storage.version.get(),
+            "durable_version": storage.durable_version,
+            "total_keys_estimate": len(storage.store.sorted_keys)
+            + (len(storage.kvstore._keys) if storage.kvstore else 0),
+        }
+    if tlog is not None:
+        cl["logs"] = {
+            "log_version": tlog.durable.get(),
+            "queue_length": len(tlog.versions),
+            "popped_version": tlog.popped,
+        }
+    if proxy is not None:
+        cl["workload"] = {
+            "transactions": dict(proxy.stats),
+            "committed_version": proxy.committed.get(),
+        }
+        rk = getattr(proxy, "ratekeeper", None)
+        cl["qos"] = {"ratekeeper_enabled": rk is not None}
+    return doc

@@ -32,6 +32,7 @@ from .interfaces import (
     TLogInterface,
     TLogPeekRequest,
     TLogPopRequest,
+    WatchValueRequest,
 )
 
 
@@ -161,10 +162,14 @@ class StorageServer:
         self._gv_stream = RequestStream(process, "get_value", well_known=True)
         self._gkv_stream = RequestStream(process, "get_key_values", well_known=True)
         self._ver_stream = RequestStream(process, "get_version", well_known=True)
+        self._watch_stream = RequestStream(process, "watch_value", well_known=True)
+        # key -> [(watched_value, reply)] parked until the key changes
+        self._watches: Dict[bytes, list] = {}
         process.spawn(self._update_loop(), "ss_update")
         process.spawn(self._serve_get_value(), "ss_get_value")
         process.spawn(self._serve_get_key_values(), "ss_get_key_values")
         process.spawn(self._serve_get_version(), "ss_get_version")
+        process.spawn(self._serve_watch_value(), "ss_watch")
 
     @classmethod
     async def recover(cls, process: SimProcess, tlog: TLogInterface, fs, filename: str):
@@ -182,7 +187,59 @@ class StorageServer:
             get_value=self._gv_stream.ref(),
             get_key_values=self._gkv_stream.ref(),
             get_version=self._ver_stream.ref(),
+            watch_value=self._watch_stream.ref(),
         )
+
+    # -- watches (ref watchValue_impl storageserver.actor.cpp:760) --
+    async def _serve_watch_value(self):
+        while True:
+            req, reply = await self._watch_stream.pop()
+            self.process.spawn(self._watch_one(req, reply), "ss_watch_one")
+
+    async def _watch_one(self, req: WatchValueRequest, reply):
+        from ..flow.knobs import g_knobs
+
+        try:
+            await self._wait_for_version(req.version)
+        except Exception as e:  # noqa: BLE001
+            reply.send_error(getattr(e, "name", "internal_error"))
+            return
+        current = self._get_current(req.key, self.version.get())
+        if current != req.value:
+            reply.send(self.version.get())  # changed already: fire now
+            return
+        n_parked = sum(len(v) for v in self._watches.values())
+        if n_parked >= g_knobs.server.max_watches:
+            reply.send_error("too_many_watches")
+            return
+        self._watches.setdefault(req.key, []).append((req.value, reply))
+
+    def _check_watches(self, version: int, touched_keys, cleared_ranges):
+        """Called after applying a version's mutations: fire watches whose
+        key changed value."""
+        if not self._watches:
+            return
+        candidates = set()
+        for k in self._watches:
+            if k in touched_keys:
+                candidates.add(k)
+            else:
+                for b, e in cleared_ranges:
+                    if b <= k < e:
+                        candidates.add(k)
+                        break
+        for k in candidates:
+            still = []
+            for watched_value, reply in self._watches.get(k, []):
+                now_val = self._get_current(k, version)
+                if now_val != watched_value:
+                    reply.send(version)
+                else:
+                    still.append((watched_value, reply))
+            if still:
+                self._watches[k] = still
+            else:
+                self._watches.pop(k, None)
 
     # -- write path: pull from the log (ref: storageserver update()) --
     async def _update_loop(self):
@@ -254,11 +311,14 @@ class StorageServer:
         return val
 
     def _apply(self, version: int, mutations: List[Mutation]):
+        touched, cleared = set(), []
         for seq, m in enumerate(mutations):
             if m.type == MutationType.SET_VALUE:
                 self.store.set(m.param1, m.param2, version, seq)
+                touched.add(m.param1)
             elif m.type == MutationType.CLEAR_RANGE:
                 self.store.clear_range(m.param1, m.param2, version, seq)
+                cleared.append((m.param1, m.param2))
             elif m.type in (MutationType.NO_OP, MutationType.DEBUG_KEY):
                 pass
             else:
@@ -266,6 +326,8 @@ class StorageServer:
                 self.store.set(
                     m.param1, apply_atomic(m.type, existing, m.param2), version, seq
                 )
+                touched.add(m.param1)
+        self._check_watches(version, touched, cleared)
 
     # -- read path --
     async def _wait_for_version(self, version: int):

@@ -1,0 +1,217 @@
+"""fdbcli-equivalent interactive shell (ref: fdbcli/fdbcli.actor.cpp — the
+command table :423-464: get/set/clear/clearrange/getrange/status/writemode,
+transaction begin/commit/rollback).
+
+The command processor is decoupled from I/O so tests drive it directly; the
+__main__ entry runs a REPL against a fresh simulated cluster (attaching to
+a real deployment reuses the same Database handle).
+"""
+
+from __future__ import annotations
+
+import json
+import shlex
+from typing import List, Optional
+
+from ..flow.error import FdbError
+from ..server.status import cluster_status
+
+
+def _fmt_key(b: bytes) -> str:
+    return repr(b)[1:]  # b'x' -> 'x' repr without the b prefix
+
+
+class CliProcessor:
+    """One command in, list of output lines out."""
+
+    HELP = {
+        "get": "get <key> — read a value",
+        "set": "set <key> <value> — write a key (writemode must be on)",
+        "clear": "clear <key> — delete a key",
+        "clearrange": "clearrange <begin> <end> — delete a key range",
+        "getrange": "getrange <begin> [end] [limit] — read a range",
+        "getrangekeys": "getrangekeys <begin> [end] [limit] — keys only",
+        "status": "status [json] — cluster status",
+        "writemode": "writemode <on|off> — allow writes",
+        "begin": "begin — start an explicit transaction",
+        "commit": "commit — commit the explicit transaction",
+        "rollback": "rollback — abandon the explicit transaction",
+        "watch": "watch <key> — report when the key changes",
+        "help": "help — this text",
+    }
+
+    def __init__(self, cluster, db):
+        self.cluster = cluster
+        self.db = db
+        self.write_mode = False
+        self._tr = None  # explicit transaction, between begin/commit
+
+    async def run_command(self, line: str) -> List[str]:
+        try:
+            parts = shlex.split(line)
+        except ValueError as e:
+            return [f"ERROR: {e}"]
+        if not parts:
+            return []
+        cmd, *args = parts
+        handler = getattr(self, f"_cmd_{cmd}", None)
+        if handler is None:
+            return [f"ERROR: unknown command `{cmd}'; type `help' for help"]
+        try:
+            return await handler(args)
+        except FdbError as e:
+            return [f"ERROR: {e.name} ({e.code})"]
+
+    # -- transaction plumbing: implicit per-command or explicit begin/commit
+    def _txn(self):
+        return self._tr if self._tr is not None else self.db.create_transaction()
+
+    async def _finish(self, tr) -> List[str]:
+        if self._tr is None:
+            await tr.commit()
+        return []
+
+    # -- commands --
+    async def _cmd_help(self, args):
+        return [self.HELP[k] for k in sorted(self.HELP)]
+
+    async def _cmd_get(self, args):
+        (key,) = args
+        tr = self._txn()
+        v = await tr.get(key.encode())
+        await self._finish(tr)
+        if v is None:
+            return [f"`{key}': not found"]
+        return [f"`{key}' is `{v.decode(errors='replace')}'"]
+
+    async def _cmd_set(self, args):
+        if not self.write_mode:
+            return ["ERROR: writemode must be enabled (writemode on)"]
+        key, value = args
+        tr = self._txn()
+        tr.set(key.encode(), value.encode())
+        await self._finish(tr)
+        return ["Committed" if self._tr is None else "Staged"]
+
+    async def _cmd_clear(self, args):
+        if not self.write_mode:
+            return ["ERROR: writemode must be enabled (writemode on)"]
+        (key,) = args
+        tr = self._txn()
+        tr.clear(key.encode())
+        await self._finish(tr)
+        return ["Committed" if self._tr is None else "Staged"]
+
+    async def _cmd_clearrange(self, args):
+        if not self.write_mode:
+            return ["ERROR: writemode must be enabled (writemode on)"]
+        begin, end = args
+        tr = self._txn()
+        tr.clear_range(begin.encode(), end.encode())
+        await self._finish(tr)
+        return ["Committed" if self._tr is None else "Staged"]
+
+    async def _cmd_getrange(self, args, keys_only=False):
+        begin = args[0].encode()
+        end = args[1].encode() if len(args) > 1 else b"\xff"
+        limit = int(args[2]) if len(args) > 2 else 25
+        tr = self._txn()
+        rows = await tr.get_range(begin, end, limit=limit)
+        await self._finish(tr)
+        out = [f"Range limited to {limit} keys"] if len(rows) >= limit else []
+        for k, v in rows:
+            if keys_only:
+                out.append(f"`{_fmt_key(k)}'")
+            else:
+                out.append(f"`{_fmt_key(k)}' is `{v.decode(errors='replace')}'")
+        return out
+
+    async def _cmd_getrangekeys(self, args):
+        return await self._cmd_getrange(args, keys_only=True)
+
+    async def _cmd_writemode(self, args):
+        (mode,) = args
+        self.write_mode = mode == "on"
+        return []
+
+    async def _cmd_status(self, args):
+        doc = cluster_status(self.cluster)
+        if args and args[0] == "json":
+            return json.dumps(doc, indent=2, default=str).splitlines()
+        cl = doc["cluster"]
+        lines = [
+            "Configuration:",
+            f"  Recovery state   - {cl['recovery_state']['name']} "
+            f"(generation {cl['recovery_state']['generation']})",
+            f"  Roles            - "
+            + ", ".join(f"{r}x{len(a)}" for r, a in sorted(cl["roles"].items())),
+        ]
+        if "data" in cl:
+            lines.append(
+                f"  Storage          - version {cl['data']['storage_version']}, "
+                f"~{cl['data']['total_keys_estimate']} keys"
+            )
+        if "workload" in cl:
+            t = cl["workload"]["transactions"]
+            lines.append(
+                f"  Workload         - {t['committed']} committed, "
+                f"{t['conflicted']} conflicted"
+            )
+        return lines
+
+    async def _cmd_begin(self, args):
+        if self._tr is not None:
+            return ["ERROR: already in a transaction"]
+        self._tr = self.db.create_transaction()
+        return ["Transaction started"]
+
+    async def _cmd_commit(self, args):
+        if self._tr is None:
+            return ["ERROR: no transaction in progress"]
+        tr, self._tr = self._tr, None
+        version = await tr.commit()
+        return [f"Committed ({version})"]
+
+    async def _cmd_rollback(self, args):
+        if self._tr is None:
+            return ["ERROR: no transaction in progress"]
+        self._tr = None
+        return ["Transaction rolled back"]
+
+    async def _cmd_watch(self, args):
+        (key,) = args
+        tr = self.db.create_transaction()
+        fut = await tr.watch(key.encode())
+        await tr.commit()
+        version = await fut
+        return [f"`{key}' changed at version {version}"]
+
+
+def main():  # pragma: no cover - interactive entry
+    import sys
+
+    from ..server import SimCluster
+
+    cluster = SimCluster(seed=0)
+    db = cluster.database("cli")
+    cli = CliProcessor(cluster, db)
+    print("fdbcli (tpu-kv simulated cluster); type `help' for help")
+    while True:
+        try:
+            line = input("fdb> ")
+        except (EOFError, KeyboardInterrupt):
+            break
+        if line.strip() in ("exit", "quit"):
+            break
+
+        async def run():
+            return await cli.run_command(line)
+
+        task = db.process.spawn(run())
+        out = cluster.loop.run_until(task, timeout_vt=60.0)
+        for ln in out:
+            print(ln)
+
+
+if __name__ == "__main__":  # pragma: no cover
+    main()

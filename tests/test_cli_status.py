@@ -1,0 +1,98 @@
+"""fdbcli-equivalent command processor + status doc (ref: fdbcli commands,
+Status.actor.cpp clusterGetStatus)."""
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.server import SimCluster
+from foundationdb_tpu.server.status import cluster_status
+from foundationdb_tpu.tools.cli import CliProcessor
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def drive(cluster, db, cli, line):
+    async def run():
+        return await cli.run_command(line)
+
+    return cluster.loop.run_until(db.process.spawn(run()), timeout_vt=60.0)
+
+
+def test_cli_crud_and_status():
+    c = SimCluster(seed=71)
+    db = c.database("cli")
+    cli = CliProcessor(c, db)
+
+    assert "ERROR" in drive(c, db, cli, "set k v")[0]  # writemode off
+    assert drive(c, db, cli, "writemode on") == []
+    assert drive(c, db, cli, "set k v") == ["Committed"]
+    assert drive(c, db, cli, "get k") == ["`k' is `v'"]
+    assert drive(c, db, cli, "set k2 v2") == ["Committed"]
+    rows = drive(c, db, cli, "getrange k")
+    assert any("k2" in r for r in rows)
+    assert drive(c, db, cli, "clear k") == ["Committed"]
+    assert drive(c, db, cli, "get k") == ["`k': not found"]
+    status = drive(c, db, cli, "status")
+    assert any("fully_recovered" in s for s in status)
+    assert any("committed" in s for s in status)
+    # unknown command
+    assert "unknown command" in drive(c, db, cli, "frobnicate")[0]
+
+
+def test_cli_explicit_transaction():
+    c = SimCluster(seed=72)
+    db = c.database("cli")
+    cli = CliProcessor(c, db)
+    drive(c, db, cli, "writemode on")
+    assert drive(c, db, cli, "begin") == ["Transaction started"]
+    assert drive(c, db, cli, "set a 1") == ["Staged"]
+    assert drive(c, db, cli, "get a") == ["`a' is `1'"]  # RYW inside txn
+    assert drive(c, db, cli, "commit")[0].startswith("Committed (")
+    assert drive(c, db, cli, "get a") == ["`a' is `1'"]
+
+    drive(c, db, cli, "begin")
+    drive(c, db, cli, "set b 2")
+    assert drive(c, db, cli, "rollback") == ["Transaction rolled back"]
+    assert drive(c, db, cli, "get b") == ["`b': not found"]
+
+
+def test_status_json_shapes():
+    c = SimCluster(seed=73)
+    db = c.database()
+
+    async def w(tr):
+        tr.set(b"x", b"y")
+
+    c.run_all([(db, db.run(w))])
+    doc = cluster_status(c)
+    assert doc["client"]["database_status"]["available"]
+    assert doc["cluster"]["workload"]["transactions"]["committed"] >= 1
+    assert doc["cluster"]["logs"]["log_version"] > 0
+    assert doc["cluster"]["data"]["total_keys_estimate"] >= 1
+
+
+def test_status_dynamic_cluster():
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+
+    c = DynamicCluster(seed=74)
+    db = c.database()
+
+    async def w(tr):
+        tr.set(b"x", b"y")
+
+    c.run_all([(db, db.run(w))], timeout_vt=300.0)
+    doc = cluster_status(c)
+    assert doc["client"]["database_status"]["available"]
+    assert doc["client"]["coordinators"]["quorum_reachable"]
+    assert doc["cluster"]["recovery_state"]["name"] == "fully_recovered"
+    assert set(doc["cluster"]["roles"]) >= {
+        "proxy",
+        "resolver",
+        "sequencer",
+        "storage",
+        "tlog",
+    }

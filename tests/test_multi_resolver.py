@@ -1,0 +1,90 @@
+"""Multi-resolver range sharding at the proxy (ref: keyResolvers +
+ResolutionRequestBuilder + min-combine; the process-level counterpart of the
+device-mesh sharded resolver in parallel/)."""
+
+import pytest
+
+from foundationdb_tpu.flow import FdbError, set_event_loop
+from foundationdb_tpu.server import SimCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def run_workload(seed, n_resolvers):
+    c = SimCluster(seed=seed, n_resolvers=n_resolvers)
+    dbs = [c.database() for _ in range(3)]
+    history = []
+
+    def w(db, i):
+        async def go():
+            rng = c.loop.rng
+            for j in range(8):
+                tr = db.create_transaction()
+                try:
+                    # keys spread across the whole byte space so ranges
+                    # actually land on different resolvers
+                    k = bytes([int(rng.random_int(0, 250))]) + b"/k"
+                    v = await tr.get(k)
+                    tr.set(k, (v or b"") + b"%d" % i)
+                    await tr.commit()
+                    history.append((i, j, "ok"))
+                except FdbError as e:
+                    history.append((i, j, e.name))
+
+        return go()
+
+    c.run_all([(db, w(db, i)) for i, db in enumerate(dbs)], timeout_vt=2000.0)
+    out = {}
+
+    async def check(tr):
+        out["state"] = await tr.get_range(b"", b"\xff")
+
+    c.run_all([(dbs[0], dbs[0].run(check))])
+    resolved = [r.total_resolved for r in c.resolvers]
+    return history, out["state"], resolved
+
+
+def test_no_lost_updates_across_resolvers():
+    """Serializability invariant under 4-way resolver sharding: every
+    committed read-modify-write append survives (a missed cross-resolver
+    conflict would lose one), and every resolver participates."""
+    for n_resolvers in (1, 4):
+        history, state, resolved = run_workload(55, n_resolvers)
+        committed = sum(1 for (_i, _j, s) in history if s == "ok")
+        appended = sum(len(v) for _k, v in state)
+        assert appended == committed, (n_resolvers, history, state)
+        assert all(r == resolved[0] for r in resolved) and resolved[0] > 0
+
+
+def test_cross_boundary_conflicts_detected():
+    """A transaction spanning a resolver boundary must still conflict with a
+    write on the far side (the min-combine across resolvers)."""
+    c = SimCluster(seed=56, n_resolvers=4)
+    db1, db2 = c.database(), c.database()
+    results = []
+
+    def make(db, me, key):
+        async def go():
+            tr = db.create_transaction()
+            try:
+                # read a range spanning all resolver boundaries
+                await tr.get_range(b"\x10", b"\xf0", limit=5)
+                tr.set(key, b"x")
+                await tr.commit()
+                results.append((me, "committed"))
+            except FdbError as e:
+                results.append((me, e.name))
+
+        return go()
+
+    # Both transactions read overlapping cross-boundary ranges and write
+    # keys on different resolvers: classic write-skew, exactly one commits.
+    c.run_all(
+        [(db1, make(db1, 1, b"\x20k")), (db2, make(db2, 2, b"\xe0k"))],
+        timeout_vt=500.0,
+    )
+    assert sorted(s for _, s in results) == ["committed", "not_committed"]

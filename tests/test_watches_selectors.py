@@ -1,0 +1,147 @@
+"""Watches + key selectors (ref: Watches + KeySelector workloads)."""
+
+import pytest
+
+from foundationdb_tpu.client.types import KeySelector
+from foundationdb_tpu.flow import FdbError, set_event_loop
+from foundationdb_tpu.server import SimCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def test_watch_fires_on_change():
+    c = SimCluster(seed=31)
+    db_w, db_m = c.database(), c.database()
+    events = []
+
+    async def watcher():
+        tr = db_w.create_transaction()
+        fut = await tr.watch(b"signal")
+        await tr.commit()  # read-only commit arms the watch
+        events.append(("armed", c.loop.now()))
+        fired_version = await fut
+        events.append(("fired", fired_version > 0))
+
+    async def mutator():
+        await c.loop.delay(0.05)
+
+        async def op(tr):
+            tr.set(b"signal", b"go")
+
+        await db_m.run(op)
+        events.append(("mutated",))
+
+    c.run_all([(db_w, watcher()), (db_m, mutator())], timeout_vt=100.0)
+    assert ("fired", True) in events
+
+
+def test_watch_no_false_fire_on_same_value():
+    """Setting the same value must NOT fire the watch (value-compare, not
+    write-compare — ref watchValue semantics)."""
+    c = SimCluster(seed=32)
+    db_w, db_m = c.database(), c.database()
+    state = {"fired": False}
+
+    async def setup(tr):
+        tr.set(b"k", b"same")
+
+    c.run_all([(db_w, db_w.run(setup))])
+
+    async def watcher():
+        tr = db_w.create_transaction()
+        fut = await tr.watch(b"k")
+        await tr.commit()
+
+        async def on_fire():
+            await fut
+            state["fired"] = True
+
+        db_w.process.spawn(on_fire())
+
+    async def rewrite_same(tr):
+        tr.set(b"k", b"same")
+
+    c.run_all([(db_w, watcher())])
+    c.run_all([(db_m, db_m.run(rewrite_same))])
+    # Drain some virtual time; the watch must still be parked.
+    idle = c.net.process("idle")
+
+    async def wait_a_bit():
+        await c.loop.delay(1.0)
+
+    c.run_until(idle.spawn(wait_a_bit()), timeout_vt=50.0)
+    assert not state["fired"]
+
+    async def rewrite_diff(tr):
+        tr.set(b"k", b"different")
+
+    c.run_all([(db_m, db_m.run(rewrite_diff))])
+    c.run_until(idle.spawn(wait_a_bit()), timeout_vt=50.0)
+    assert state["fired"]
+
+
+def test_watch_fires_immediately_if_already_changed():
+    c = SimCluster(seed=33)
+    db = c.database()
+
+    async def setup(tr):
+        tr.set(b"k", b"v1")
+
+    c.run_all([(db, db.run(setup))])
+    fired = {}
+
+    async def race():
+        tr = db.create_transaction()
+        fut = await tr.watch(b"k")  # sees v1
+        # Another client changes the value before the watch is armed.
+        db2 = c.database()
+
+        async def change(tr2):
+            tr2.set(b"k", b"v2")
+
+        await db2.run(change)
+        await tr.commit()
+        fired["version"] = await fut
+
+    c.run_all([(db, race())], timeout_vt=100.0)
+    assert fired["version"] > 0
+
+
+def test_key_selectors():
+    c = SimCluster(seed=34)
+    db = c.database()
+
+    async def fill(tr):
+        for k in (b"a", b"c", b"e", b"g"):
+            tr.set(k, b"x")
+
+    c.run_all([(db, db.run(fill))])
+    out = {}
+
+    async def resolve(tr):
+        out["fge_c"] = await tr.get_key(KeySelector.first_greater_or_equal(b"c"))
+        out["fge_d"] = await tr.get_key(KeySelector.first_greater_or_equal(b"d"))
+        out["fgt_c"] = await tr.get_key(KeySelector.first_greater_than(b"c"))
+        out["llt_c"] = await tr.get_key(KeySelector.last_less_than(b"c"))
+        out["lle_c"] = await tr.get_key(KeySelector.last_less_or_equal(b"c"))
+        out["lle_d"] = await tr.get_key(KeySelector.last_less_or_equal(b"d"))
+        out["fge_z"] = await tr.get_key(KeySelector.first_greater_or_equal(b"z"))
+        out["llt_a"] = await tr.get_key(KeySelector.last_less_than(b"a"))
+        out["fge_c_off2"] = await tr.get_key(KeySelector(b"c", False, 2))
+        out["llt_g_off-1"] = await tr.get_key(KeySelector(b"g", False, -1))
+
+    c.run_all([(db, db.run(resolve))])
+    assert out["fge_c"] == b"c"
+    assert out["fge_d"] == b"e"
+    assert out["fgt_c"] == b"e"
+    assert out["llt_c"] == b"a"
+    assert out["lle_c"] == b"c"
+    assert out["lle_d"] == b"c"
+    assert out["fge_z"] == b"\xff"  # past the end
+    assert out["llt_a"] == b""  # before the front
+    assert out["fge_c_off2"] == b"e"
+    assert out["llt_g_off-1"] == b"c"
