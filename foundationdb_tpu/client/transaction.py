@@ -165,22 +165,44 @@ class Transaction:
         snapshot: bool = False,
     ) -> List[Tuple[bytes, bytes]]:
         version = await self.get_read_version()
-        reply = await self.db.storage.get_key_values.get_reply(
-            self.db.process,
-            GetKeyValuesRequest(
-                begin=begin, end=end, version=version, limit=limit, reverse=reverse
-            ),
-        )
-        base = dict(reply.data)
-        merged = set(base)
-        merged.update(self._touched_keys(begin, end))
-        out = []
-        for k in sorted(merged, reverse=reverse):
-            v = self._replay(k, base.get(k))
-            if v is not None:
-                out.append((k, v))
-                if len(out) >= limit:
-                    break
+        out: List[Tuple[bytes, bytes]] = []
+        # Page through storage until `limit` MERGED rows exist or the range
+        # is exhausted: local clears can mask base rows, so a single fetch of
+        # `limit` rows may under-fill even though more matching keys exist
+        # beyond the fetched extent (ref: RYW readThrough continuation).
+        lo, hi = begin, end  # remaining un-scanned extent
+        while len(out) < limit and lo < hi:
+            reply = await self.db.storage.get_key_values.get_reply(
+                self.db.process,
+                GetKeyValuesRequest(
+                    begin=lo,
+                    end=hi,
+                    version=version,
+                    limit=limit - len(out),
+                    reverse=reverse,
+                ),
+            )
+            base = dict(reply.data)
+            if reply.more:
+                # Covered extent ends at the last base row fetched; continue
+                # from there next page.
+                if reverse:
+                    cov_lo, cov_hi = reply.data[-1][0], hi
+                    hi = cov_lo
+                else:
+                    cov_lo, cov_hi = lo, key_after(reply.data[-1][0])
+                    lo = cov_hi
+            else:
+                cov_lo, cov_hi = lo, hi
+                lo = hi  # exhausted
+            merged = set(base)
+            merged.update(self._touched_keys(cov_lo, cov_hi))
+            for k in sorted(merged, reverse=reverse):
+                v = self._replay(k, base.get(k))
+                if v is not None:
+                    out.append((k, v))
+                    if len(out) >= limit:
+                        break
         if not snapshot:
             # Conflict range covers only what was actually observed: when the
             # limit truncated the scan, trim to the returned extent (ref: RYW

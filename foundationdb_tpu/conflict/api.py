@@ -78,6 +78,13 @@ class ConflictSet:
         # True once a long-key write range may have entered CPU history;
         # the device cannot represent it, so authority stays on CPU.
         self._history_long_keys = False
+        # Hysteresis: consecutive sub-threshold batches seen while device
+        # authority is held.  Authority only returns to the CPU after
+        # AUTHORITY_HYSTERESIS of them — an alternating big/small workload
+        # must not pay a full history transfer per flip (ADVICE r1).
+        self._small_streak = 0
+
+    AUTHORITY_HYSTERESIS = 8
 
     def new_batch(self) -> ConflictBatch:
         return ConflictBatch(self)
@@ -115,14 +122,24 @@ class ConflictSet:
             # the device state cannot represent the step function exactly.
             # Conservative: pin authority to CPU until clear().
             self._history_long_keys = True
-        if big and batch_fits and not self._history_long_keys:
+        device_ok = batch_fits and not self._history_long_keys
+        if device_ok and self._authority == "jax":
+            # Already on device: run there even below the size threshold
+            # (device dispatch on a warm small bucket beats a full history
+            # transfer); only a sustained small streak flips authority back.
+            self._small_streak = 0 if big else self._small_streak + 1
+            if self._small_streak < self.AUTHORITY_HYSTERESIS:
+                return self._jax.detect(txns, now, new_oldest_version)
+        if big and device_ok:
             if self._authority == "cpu":
                 self._jax.load_from(self._cpu)
                 self._authority = "jax"
+                self._small_streak = 0
             return self._jax.detect(txns, now, new_oldest_version)
         if self._authority == "jax":
             self._jax.store_to(self._cpu)
             self._authority = "cpu"
+            self._small_streak = 0
         return self._cpu.detect(txns, now, new_oldest_version)
 
     def clear(self, version: int):

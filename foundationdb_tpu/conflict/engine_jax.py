@@ -66,6 +66,37 @@ def _next_pow2(n: int, lo: int) -> int:
     return max(lo, 1 << max(0, math.ceil(math.log2(max(n, 1)))))
 
 
+def _unpack_transactions(pb: "PackedBatch") -> List[TransactionConflictInfo]:
+    """PackedBatch -> TransactionConflictInfo list (CPU-fallback path only;
+    keys come back in their packed fixed-width form, which is the key space
+    both engines decide over)."""
+    txns = [
+        TransactionConflictInfo(
+            read_snapshot=int(pb.t_snap[t]), read_ranges=[], write_ranges=[]
+        )
+        for t in range(pb.n_txn)
+    ]
+    for i in range(pb.n_r):
+        t = int(pb.r_txn[i])
+        if t < pb.n_txn:
+            txns[t].read_ranges.append(
+                (
+                    keylib.decode_key(pb.r_begin[i], pb.key_words),
+                    keylib.decode_key(pb.r_end[i], pb.key_words),
+                )
+            )
+    for i in range(pb.n_w):
+        t = int(pb.w_txn[i])
+        if t < pb.n_txn:
+            txns[t].write_ranges.append(
+                (
+                    keylib.decode_key(pb.w_begin[i], pb.key_words),
+                    keylib.decode_key(pb.w_end[i], pb.key_words),
+                )
+            )
+    return txns
+
+
 class PackedBatch:
     """Host-side (numpy) dense form of a transaction batch.
 
@@ -415,6 +446,17 @@ def detect_core(
         jnp.where(status == _COMM, COMMITTED, CONFLICT),
     ).astype(jnp.int32)
 
+    # If the fixpoint failed to converge (cannot happen for well-formed
+    # batches — the iteration cap exceeds the longest dependency chain — but
+    # guarded anyway), the statuses are unreliable and so is the write merge
+    # derived from them: keep the history state UNCHANGED so the host can
+    # re-run the batch on the CPU engine against pristine state.
+    ok = undecided_left == 0
+    out_keys = jnp.where(ok, out_keys, hkeys)
+    out_vers = jnp.where(ok, out_vers, hvers)
+    out_count = jnp.where(ok, out_count, hcount)
+    new_oldest = jnp.where(ok, new_oldest, oldest)
+
     return (
         out_keys,
         out_vers,
@@ -562,8 +604,30 @@ class JaxConflictSet:
             h_cap=self.h_cap,
         )
         self.last_iters = int(iters)
-        assert int(undecided) == 0, "intra-batch fixpoint failed to converge"
+        if int(undecided) != 0:
+            # detect_core left the history state untouched in this case;
+            # resolve the batch on the CPU engine against pristine state and
+            # adopt its result — the resolver must never die on a
+            # pathological batch (BASELINE.json's CPU-fallback requirement).
+            return self._fallback_cpu(pb, now, new_oldest_version)
         return np.asarray(statuses)
+
+    def _fallback_cpu(self, pb: PackedBatch, now: int, new_oldest_version: int):
+        from ..flow.trace import TraceEvent
+        from .engine_cpu import CpuConflictSet
+
+        TraceEvent("ConflictFixpointDiverged", severity=30).detail(
+            "n_txn", pb.n_txn
+        ).detail("now", now).log()
+        cpu = CpuConflictSet()
+        self.store_to(cpu)
+        statuses = cpu.detect(
+            _unpack_transactions(pb), now=now, new_oldest_version=new_oldest_version
+        )
+        self.load_from(cpu)
+        out = np.full((pb.txn_cap,), COMMITTED, np.int32)
+        out[: pb.n_txn] = statuses
+        return out
 
     # -- hybrid state exchange with the CPU engine --
     def load_from(self, cpu) -> None:

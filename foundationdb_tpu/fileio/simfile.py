@@ -82,6 +82,8 @@ class _SimFile:
             else:
                 # FULL_CORRUPTION: flip bytes somewhere in the write
                 buf = bytearray(data)
+                if not buf:
+                    continue  # nothing to corrupt in a zero-length write
                 for _ in range(rng.random_int(1, max(2, len(buf) // 8))):
                     buf[rng.random_int(0, len(buf))] = rng.random_int(0, 256)
                 self._apply(off, bytes(buf))

@@ -41,7 +41,13 @@ from jax import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..conflict import keys as keylib
-from ..conflict.engine_jax import FLOOR_REL, REBASE_THRESHOLD, PackedBatch, detect_core
+from ..conflict.engine_jax import (
+    FLOOR_REL,
+    REBASE_THRESHOLD,
+    PackedBatch,
+    _next_pow2,
+    detect_core,
+)
 from ..conflict.types import TransactionConflictInfo
 from ..ops.rangequery import lex_less
 
@@ -126,6 +132,16 @@ def _shard_body(
         h_cap=h_cap,
     )
     (out_keys, out_vers, out_count, new_oldest, status, undecided, iters) = out
+    # Convergence is all-or-nothing across the mesh: if ANY shard's fixpoint
+    # diverged, every shard keeps its pristine state (detect_core already
+    # reverts the local shard; this psum extends the revert globally) so the
+    # host can re-run the whole batch on the CPU engine consistently.
+    total_undec = jax.lax.psum(undecided, AXIS)
+    ok = total_undec == 0
+    out_keys = jnp.where(ok, out_keys, hkeys[0])
+    out_vers = jnp.where(ok, out_vers, hvers[0])
+    out_count = jnp.where(ok, out_count, hcount[0])
+    new_oldest = jnp.where(ok, new_oldest, oldest[0])
     return (
         out_keys[None],
         out_vers[None],
@@ -231,6 +247,9 @@ class ShardedJaxConflictSet:
             lo[1:] = enc
             hi[:-1] = enc
         self.bucket_mins = bucket_mins
+        # Decoded shard bounds, for host-side state exchange (CPU fallback,
+        # resharding): split_keys[s-1] is shard s's inclusive lower bound.
+        self.split_keys = [bytes(k) for k in split_keys]
         self._shardspec = NamedSharding(mesh, P(AXIS))
         self._lo = jax.device_put(jnp.asarray(lo), self._shardspec)
         self._hi = jax.device_put(jnp.asarray(hi), self._shardspec)
@@ -344,5 +363,190 @@ class ShardedJaxConflictSet:
             jnp.asarray(clip(new_oldest_version), dtype=jnp.int32),
         )
         self.last_iters = int(iters)
-        assert int(undecided) == 0, "intra-batch fixpoint failed to converge"
+        if int(undecided) != 0:
+            # All shards kept pristine state (the psum gate in _shard_body);
+            # re-run the batch on the CPU engine and push the result back.
+            return self._fallback_cpu(pb, now, new_oldest_version)
         return np.asarray(statuses)
+
+    def _fallback_cpu(self, pb: PackedBatch, now: int, new_oldest_version: int):
+        """Re-run a diverged batch on per-shard CPU engines with the exact
+        multi-resolver semantics of the device path: ranges clipped per
+        shard, each shard commits writes on its LOCAL verdict, verdicts
+        min-combined (ref Resolver.actor.cpp:140-153, proxy :492-499)."""
+        from ..flow.trace import TraceEvent
+        from ..conflict.engine_jax import _unpack_transactions
+        from ..conflict.types import COMMITTED
+
+        TraceEvent("ConflictFixpointDiverged", severity=30).detail(
+            "n_txn", pb.n_txn
+        ).detail("sharded", True).log()
+        engines = self._store_shard_engines()
+        txns = _unpack_transactions(pb)
+        bounds = list(
+            zip([b""] + self.split_keys, self.split_keys + [None])
+        )
+        verdicts = []
+        for (lo, hi), eng in zip(bounds, engines):
+            local = []
+            for tr in txns:
+                rr, wr = [], []
+                for (b, e) in tr.read_ranges:
+                    cb = max(b, lo)
+                    ce = e if hi is None else min(e, hi)
+                    if cb < ce:
+                        rr.append((cb, ce))
+                for (b, e) in tr.write_ranges:
+                    cb = max(b, lo)
+                    ce = e if hi is None else min(e, hi)
+                    if cb < ce:
+                        wr.append((cb, ce))
+                local.append(
+                    TransactionConflictInfo(
+                        read_snapshot=tr.read_snapshot,
+                        read_ranges=rr,
+                        write_ranges=wr,
+                    )
+                )
+            verdicts.append(eng.detect(local, now, new_oldest_version))
+        statuses = [min(v) for v in zip(*verdicts)] if txns else []
+        self._load_shard_engines(engines)
+        out = np.full((pb.txn_cap,), COMMITTED, np.int32)
+        out[: pb.n_txn] = statuses
+        return out
+
+    def _store_shard_engines(self) -> list:
+        """Per-shard CpuConflictSet mirrors of the device state."""
+        from ..conflict.engine_cpu import CpuConflictSet, FLOOR_VERSION
+
+        hkeys = np.asarray(self._hkeys)
+        hvers = np.asarray(self._hvers)
+        counts = np.asarray(self._hcount)
+        oldest = np.asarray(self._oldest)
+        engines = []
+        for s in range(self.n_shards):
+            eng = CpuConflictSet(int(oldest[s]) + self._base)
+            n = int(counts[s])
+            eng.keys = [
+                keylib.decode_key(hkeys[s, i], self.key_words) for i in range(n)
+            ]
+            eng.vers = [
+                FLOOR_VERSION if int(v) == FLOOR_REL else int(v) + self._base
+                for v in hvers[s, :n]
+            ]
+            engines.append(eng)
+        return engines
+
+    def _load_shard_engines(self, engines: list) -> None:
+        from ..conflict.engine_cpu import FLOOR_VERSION
+
+        S, kw1 = self.n_shards, self.key_words + 1
+        need = max(len(e.keys) for e in engines) + 2
+        if need + 8 > self.h_cap:
+            self._grow(_next_pow2(need + 8, self.h_cap * 2))
+        hkeys = np.full((S, self.h_cap, kw1), keylib.INF_WORD, np.uint32)
+        hvers = np.full((S, self.h_cap), FLOOR_REL, np.int32)
+        counts = np.zeros((S,), np.int32)
+        oldest = np.zeros((S,), np.int32)
+        for s, eng in enumerate(engines):
+            n = len(eng.keys)
+            hkeys[s, :n] = keylib.encode_keys(eng.keys, self.key_words)
+            hvers[s, :n] = [
+                FLOOR_REL
+                if v == FLOOR_VERSION
+                else int(np.clip(v - self._base, FLOOR_REL + 1, 2**31 - 2))
+                for v in eng.vers
+            ]
+            counts[s] = n
+            oldest[s] = int(
+                np.clip(eng.oldest_version - self._base, 0, 2**31 - 2)
+            )
+        put = partial(jax.device_put, device=self._shardspec)
+        self._hkeys = put(jnp.asarray(hkeys))
+        self._hvers = put(jnp.asarray(hvers))
+        self._hcount = put(jnp.asarray(counts))
+        self._oldest = put(jnp.asarray(oldest, dtype=jnp.int32))
+
+    # -- host state exchange (CPU fallback + resharding) --
+    def store_to(self, cpu) -> None:
+        """Flatten the per-shard step functions into the CPU engine's global
+        one.  Shard s owns [lo_s, hi_s); its boundary list is already sorted,
+        so concatenating shards in order — re-anchoring each shard's value at
+        lo_s and dropping boundaries outside its ownership — yields the
+        global sorted boundary array."""
+        from bisect import bisect_right
+
+        from ..conflict.engine_cpu import FLOOR_VERSION
+
+        hkeys = np.asarray(self._hkeys)
+        hvers = np.asarray(self._hvers)
+        counts = np.asarray(self._hcount)
+
+        def absv(rel: int) -> int:
+            return FLOOR_VERSION if rel == FLOOR_REL else int(rel) + self._base
+
+        keys: list = []
+        vers: list = []
+        for s in range(self.n_shards):
+            n = int(counts[s])
+            sk = [keylib.decode_key(hkeys[s, i], self.key_words) for i in range(n)]
+            sv = hvers[s, :n]
+            lo_key = b"" if s == 0 else self.split_keys[s - 1]
+            hi_key = None if s == self.n_shards - 1 else self.split_keys[s]
+            at_lo = bisect_right(sk, lo_key) - 1
+            keys.append(lo_key)
+            vers.append(absv(sv[at_lo]))
+            for i in range(at_lo + 1, n):
+                if hi_key is not None and sk[i] >= hi_key:
+                    break
+                keys.append(sk[i])
+                vers.append(absv(sv[i]))
+        cpu.keys = keys
+        cpu.vers = vers
+        cpu.oldest_version = self.oldest_version
+
+    def load_from(self, cpu) -> None:
+        """Scatter the CPU engine's global step function back into per-shard
+        slices (inverse of store_to)."""
+        from bisect import bisect_left, bisect_right
+
+        from ..conflict.engine_cpu import FLOOR_VERSION
+
+        self._base = cpu.oldest_version
+        S, kw1 = self.n_shards, self.key_words + 1
+        need = 2
+        bounds = [b""] + self.split_keys + [None]
+        per_shard: list = []
+        for s in range(S):
+            lo_key, hi_key = bounds[s], bounds[s + 1]
+            i0 = bisect_right(cpu.keys, lo_key)  # strictly-after lo
+            i1 = len(cpu.keys) if hi_key is None else bisect_left(cpu.keys, hi_key)
+            v_at_lo = cpu._value_at(lo_key)
+            sk = [b""] + cpu.keys[i0:i1]
+            sv = [v_at_lo] + cpu.vers[i0:i1]
+            per_shard.append((sk, sv))
+            need = max(need, len(sk) + 2)
+        if need + 8 > self.h_cap:
+            self._grow(_next_pow2(need + 8, self.h_cap * 2))
+        hkeys = np.full((S, self.h_cap, kw1), keylib.INF_WORD, np.uint32)
+        hvers = np.full((S, self.h_cap), FLOOR_REL, np.int32)
+        counts = np.zeros((S,), np.int32)
+        for s, (sk, sv) in enumerate(per_shard):
+            n = len(sk)
+            hkeys[s, :n] = keylib.encode_keys(sk, self.key_words)
+            rel = np.array(
+                [
+                    FLOOR_REL
+                    if v == FLOOR_VERSION
+                    else int(np.clip(v - self._base, FLOOR_REL + 1, 2**31 - 2))
+                    for v in sv
+                ],
+                np.int32,
+            )
+            hvers[s, :n] = rel
+            counts[s] = n
+        put = partial(jax.device_put, device=self._shardspec)
+        self._hkeys = put(jnp.asarray(hkeys))
+        self._hvers = put(jnp.asarray(hvers))
+        self._hcount = put(jnp.asarray(counts))
+        self._oldest = put(jnp.zeros((S,), jnp.int32))

@@ -151,8 +151,6 @@ class ClusterController:
     # --- recovery state machine (ref masterserver :1101-1254) ---
     async def _recovery(self):
         loop = self.process.network.loop
-        self.generation += 1
-        TraceEvent("RecoveryStarted").detail("generation", self.generation).log()
 
         # READING_CSTATE
         cstate = CoordinatedState(self.process, self.coordinators)
@@ -162,6 +160,21 @@ class ClusterController:
             if raw
             else {"epoch_end": 0, "tlog_addr": None, "storage_addr": None}
         )
+
+        # The epoch/generation is monotone ACROSS controller failovers: it is
+        # persisted in the manifest and bumped past any previously persisted
+        # value (ref: DBCoreState recoveryCount, masterserver recoverFrom).
+        # A fresh CC starting at a private counter of 0 must not reuse an
+        # epoch a dead controller already recruited with — stale proxies
+        # would pass the tlog/resolver epoch checks and drop commits.
+        self.generation = max(self.generation, prev.get("generation", 0)) + 1
+        TraceEvent("RecoveryStarted").detail("generation", self.generation).log()
+
+        # LOCKING_CSTATE: persist the bumped generation BEFORE recruiting so
+        # even an aborted recovery permanently retires its epoch (a later
+        # recovery — ours or another CC's — reads it and goes higher).
+        prev["generation"] = self.generation
+        await cstate.set(pickle.dumps(prev, protocol=4))
 
         # Wait for a usable worker set: stateful roles MUST return to the
         # machines holding their files (recorded in cstate) — recruiting a
@@ -229,10 +242,21 @@ class ClusterController:
 
         # WRITING_CSTATE — before serving clients (write-before-use).  The
         # stateful-role addresses are part of the manifest so the next
-        # recovery waits for the right machines.
-        await cstate.set(
+        # recovery waits for the right machines.  A fresh session (read +
+        # conditional write): if any other recovery read the cstate since our
+        # lock write, this raises coordinated_state_conflict and we abort —
+        # exactly the fencing the reference gets from MovableCoordinatedState.
+        cstate2 = CoordinatedState(self.process, self.coordinators)
+        raw2 = await cstate2.read()
+        cur = pickle.loads(raw2) if raw2 else {}
+        if cur.get("generation", 0) > self.generation:
+            # Another controller locked a newer epoch while we recruited;
+            # writing our manifest now would regress the generation chain.
+            raise FdbError("recovery_superseded")
+        await cstate2.set(
             pickle.dumps(
                 {
+                    "generation": self.generation,
                     "epoch_end": recovery_version,
                     "tlog_addr": tlog_w.address,
                     "storage_addr": storage_w.address,

@@ -366,3 +366,26 @@ def test_differential_cpu_vs_jax_backend():
     h_jax, s_jax = run("jax")
     assert h_cpu == h_jax
     assert s_cpu == s_jax
+
+
+def test_limited_range_read_pages_past_local_clears():
+    """Regression (ADVICE r1): a limited get_range must keep fetching when
+    local clears mask base rows — storage has p1..p5, the txn cleared p1,p2,
+    limit=3 must still return [p3, p4, p5], not just [p3]."""
+    c = SimCluster(seed=21)
+    db = c.database()
+    out = {}
+
+    async def fill(tr):
+        for i in range(1, 6):
+            tr.set(b"p%d" % i, b"v%d" % i)
+
+    async def read(tr):
+        tr.clear_range(b"p1", b"p3")  # masks p1, p2
+        out["fwd"] = await tr.get_range(b"p", b"q", limit=3)
+        out["rev"] = await tr.get_range(b"p", b"q", limit=5, reverse=True)
+
+    c.run_all([(db, db.run(fill))])
+    c.run_all([(db, db.run(read))])
+    assert [k for k, _ in out["fwd"]] == [b"p3", b"p4", b"p5"]
+    assert [k for k, _ in out["rev"]] == [b"p5", b"p4", b"p3"]

@@ -157,3 +157,69 @@ def test_cross_shard_write_read_conflict(sharded):
         read_ranges=[(make_key(boundary - 2), make_key(boundary + 3))],
     )
     assert sharded.detect([r2], now=40, new_oldest_version=0) == [COMMITTED]
+
+
+def test_sharded_divergence_falls_back_to_cpu(sharded, monkeypatch):
+    """If any shard's fixpoint diverges, the whole batch re-runs on per-shard
+    CPU engines with identical multi-resolver semantics, and the device state
+    round-trips exactly (decisions keep matching the oracle afterward)."""
+    import jax.numpy as jnp
+
+    sharded.clear(0)
+    rng = np.random.default_rng(23)
+    split = uniform_int_split_keys(N_SHARDS, 2000, KEY_BYTES)
+    oracle = MultiResolverCpuOracle(split)
+    real_step_for = type(sharded)._step_for
+
+    def diverged_step_for(self, pb):
+        def step(lo, hi, hkeys, hvers, hcount, oldest, *rest):
+            return (
+                hkeys,
+                hvers,
+                hcount,
+                oldest,
+                jnp.zeros((pb.txn_cap,), jnp.int32),
+                jnp.asarray(1, jnp.int32),
+                jnp.asarray(0, jnp.int32),
+            )
+
+        return step
+
+    now = 100
+    for batch_i in range(9):
+        patched = 3 <= batch_i < 6
+        monkeypatch.setattr(
+            type(sharded),
+            "_step_for",
+            diverged_step_for if patched else real_step_for,
+        )
+        txns = [random_txn(rng, now) for _ in range(int(rng.integers(1, 30)))]
+        now += int(rng.integers(1, 30))
+        new_oldest = max(0, now - 120)
+        got = sharded.detect(txns, now, new_oldest)
+        want = oracle.detect(txns, now, new_oldest)
+        assert got == want, f"batch {batch_i} (patched={patched})"
+    monkeypatch.setattr(type(sharded), "_step_for", real_step_for)
+
+
+def test_sharded_global_state_roundtrip(sharded):
+    """store_to flattens per-shard step functions into one global CPU engine
+    and load_from scatters it back; a round trip must be exact (this is the
+    resharding primitive): decisions keep matching the oracle afterward."""
+    from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+
+    sharded.clear(0)
+    rng = np.random.default_rng(31)
+    split = uniform_int_split_keys(N_SHARDS, 2000, KEY_BYTES)
+    oracle = MultiResolverCpuOracle(split)
+    now = 50
+    for batch_i in range(6):
+        txns = [random_txn(rng, now) for _ in range(int(rng.integers(1, 30)))]
+        now += int(rng.integers(1, 20))
+        new_oldest = max(0, now - 120)
+        got = sharded.detect(txns, now, new_oldest)
+        assert got == oracle.detect(txns, now, new_oldest), f"batch {batch_i}"
+        if batch_i in (2, 4):
+            flat = CpuConflictSet()
+            sharded.store_to(flat)
+            sharded.load_from(flat)
