@@ -35,6 +35,12 @@ class DiskQueue:
         self._pending: List[Tuple[int, bytes]] = []
         self.popped_seq = 0
         self._header_dirty = False
+        # FIFO commit serialization: commit() snapshots _tail and then
+        # awaits disk writes; a second commit entering during that await
+        # would capture the same tail and clobber the first commit's frames
+        # (acked-data loss after recovery).  Callers with multiple actors
+        # (e.g. the coordinator's read/write serve loops) are safe.
+        self._commit_chain = None
 
     # -- lifecycle --
     @classmethod
@@ -75,7 +81,23 @@ class DiskQueue:
         self._pending.append((seq, payload))
 
     async def commit(self):
-        """Write buffered frames + header, fsync; prefix-durable on return."""
+        """Write buffered frames + header, fsync; prefix-durable on return.
+        Concurrent calls are serialized FIFO (see __init__)."""
+        from ..flow.future import Promise
+
+        prev = self._commit_chain
+        gate = Promise()
+        self._commit_chain = gate.future
+        if prev is not None:
+            await prev
+        try:
+            await self._commit_locked()
+        finally:
+            gate.send(None)
+            if self._commit_chain is gate.future:
+                self._commit_chain = None
+
+    async def _commit_locked(self):
         writes = []
         off = self._tail
         for seq, payload in self._pending:

@@ -88,10 +88,25 @@ class CoordinatorInterface:
 
 
 class Coordinator:
-    """One coordinator process: generation register + leader register."""
+    """One coordinator process: generation register + leader register.
 
-    def __init__(self, process: SimProcess):
+    With `fs`, the generation register (values AND promises) is persisted
+    through the durable storage stack before any reply (ref: localGenerationReg
+    commits its OnDemandStore before answering, Coordination.actor.cpp:125-160)
+    — a restarted coordinator keeps its promises, so a stale CoordinatedState
+    write can never reach quorum after a whole-cluster power loss.  The leader
+    register stays ephemeral (leases, as in the reference)."""
+
+    def __init__(
+        self,
+        process: SimProcess,
+        fs=None,
+        filename: str = "coordination.dq",
+    ):
         self.process = process
+        self.fs = fs
+        self.filename = filename
+        self._store = None
         # key -> (value, read_gen, write_gen)
         self.registry: Dict[bytes, Tuple[Optional[bytes], int, int]] = {}
         # leader register (single implicit key, like one leaderRegister actor)
@@ -102,11 +117,35 @@ class Coordinator:
         self._gw = RequestStream(process, "coord_gen_write", well_known=True)
         self._cd = RequestStream(process, "coord_candidacy", well_known=True)
         self._gl = RequestStream(process, "coord_get_leader", well_known=True)
-        process.spawn(self._serve_gen_read(), "coord_gr")
-        process.spawn(self._serve_gen_write(), "coord_gw")
-        process.spawn(self._serve_candidacy(), "coord_cd")
-        process.spawn(self._serve_get_leader(), "coord_gl")
-        process.spawn(self._nominee_tick(), "coord_tick")
+        process.spawn(self._boot(), "coord_boot")
+
+    async def _boot(self):
+        """Recover the generation register from disk, then serve.  Requests
+        arriving before recovery park in the streams' queues."""
+        if self.fs is not None:
+            import pickle
+
+            from ..fileio.kvstore import KeyValueStoreMemory
+
+            self._store = await KeyValueStoreMemory.open(
+                self.fs, self.process, self.filename
+            )
+            for k, v in self._store.read_range(b"", b"\xff" * 16):
+                self.registry[k] = pickle.loads(v)
+        p = self.process
+        p.spawn(self._serve_gen_read(), "coord_gr")
+        p.spawn(self._serve_gen_write(), "coord_gw")
+        p.spawn(self._serve_candidacy(), "coord_cd")
+        p.spawn(self._serve_get_leader(), "coord_gl")
+        p.spawn(self._nominee_tick(), "coord_tick")
+
+    async def _persist(self, key: bytes):
+        if self._store is None:
+            return
+        import pickle
+
+        self._store.set(key, pickle.dumps(self.registry[key], protocol=4))
+        await self._store.commit()
 
     def interface(self) -> CoordinatorInterface:
         return CoordinatorInterface(
@@ -124,6 +163,9 @@ class Coordinator:
             if rgen < req.gen:
                 rgen = req.gen
                 self.registry[req.key] = (value, rgen, wgen)
+                # The promise must survive a restart or a later stale write
+                # could be accepted; durable BEFORE the reply.
+                await self._persist(req.key)
             reply.send(GenReadReply(value=value, write_gen=wgen, read_gen=rgen))
 
     async def _serve_gen_write(self):
@@ -134,6 +176,7 @@ class Coordinator:
             # (ref: readGen <= gen && writeGen < gen, Coordination :148).
             if rgen <= req.gen and wgen < req.gen:
                 self.registry[req.key] = (req.value, rgen, req.gen)
+                await self._persist(req.key)  # durable before the ack
                 reply.send(req.gen)  # accepted
             else:
                 reply.send(max(rgen, wgen))  # conflict: newer gen promised

@@ -36,25 +36,38 @@ class DynamicCluster:
         set_event_loop(self.loop)
         self.net = SimNetwork(self.loop)
         self.fs = SimFileSystem(self.net)
+        self.conflict_backend = conflict_backend
 
+        self._coord_procs = [
+            self.net.process(f"coord{i}") for i in range(n_coordinators)
+        ]
+        self._cc_procs = [self.net.process(f"cc{i}") for i in range(n_controllers)]
+        self._worker_procs = [
+            self.net.process(f"worker{i}") for i in range(n_workers)
+        ]
+        self._n_clients = 0
+        self._build_server_side()
+
+    def _build_server_side(self):
+        """Construct coordinator/controller/worker role objects on their
+        (live) processes.  Runs at first boot and after crash_and_recover;
+        well-known stream tokens are name-derived, so refs held by clients
+        stay valid across a rebuild on the same addresses."""
         self.coordinators = [
-            Coordinator(self.net.process(f"coord{i}")) for i in range(n_coordinators)
+            Coordinator(p, fs=self.fs) for p in self._coord_procs
         ]
         self.coord_ifaces = [c.interface() for c in self.coordinators]
 
         # Controller candidates: whichever wins the election acts.
         self.controllers = [
             ClusterController(
-                self.net.process(f"cc{i}"),
-                self.coord_ifaces,
-                conflict_backend=conflict_backend,
+                p, self.coord_ifaces, conflict_backend=self.conflict_backend
             )
-            for i in range(n_controllers)
+            for p in self._cc_procs
         ]
 
         self.workers: List[WorkerServer] = []
-        for i in range(n_workers):
-            proc = self.net.process(f"worker{i}")
+        for proc in self._worker_procs:
             w = WorkerServer(proc, self.fs)
             self.workers.append(w)
             leader_var = AsyncVar(None)
@@ -63,7 +76,22 @@ class DynamicCluster:
             )
             proc.spawn(run_worker_registration(w, leader_var), "registration")
 
-        self._n_clients = 0
+    def crash_and_recover(self):
+        """Whole-cluster power loss: kill every server process (coordinators
+        included), resolve unsynced disk writes per the corruption model,
+        reboot, and rebuild everything from disk.  The cluster manifest must
+        come back from the coordinators' files alone (ref:
+        restartSimulatedSystem SimulatedCluster.actor.cpp:597 +
+        Coordination.actor.cpp OnDemandStore persistence).  Clients survive
+        and re-discover the new generation via their long-polls."""
+        procs = self._coord_procs + self._cc_procs + self._worker_procs
+        for p in procs:
+            p.kill()
+        for p in procs:
+            self.fs.crash_machine(p.machine.machine_id)
+        for p in procs:
+            p.reboot()
+        self._build_server_side()
 
     # --- clients ---
     def database(self, name: str = ""):

@@ -184,3 +184,61 @@ def test_dynamic_determinism():
         return hist
 
     assert run(33) == run(33)
+
+
+def test_whole_cluster_crash_recovers_from_coordinator_disks():
+    """Power-loss test (VERDICT r1 item 5): kill EVERY server process
+    including coordinators, corrupt unsynced writes, reboot.  The manifest
+    (generation + stateful-role placement) must come back from coordinator
+    disks alone; acknowledged data must survive; the epoch chain must stay
+    monotone (new generation > pre-crash generation)."""
+    c, db = bootstrap(seed=55)
+    out = {}
+
+    async def w(tr):
+        tr.set(b"durable", b"yes")
+
+    c.run_all([(db, db.run(w))], timeout_vt=300.0)
+    gen_before = c.acting_controller().generation
+
+    c.crash_and_recover()
+
+    async def check(tr):
+        out["v"] = await tr.get(b"durable")
+        tr.set(b"post-crash", b"written")
+
+    c.run_all([(db, db.run(check))], timeout_vt=900.0)
+    assert out["v"] == b"yes"
+    assert c.acting_controller().generation > gen_before
+
+    async def check2(tr):
+        out["post"] = await tr.get(b"post-crash")
+
+    c.run_all([(db, db.run(check2))], timeout_vt=300.0)
+    assert out["post"] == b"written"
+
+
+def test_repeated_whole_cluster_crashes():
+    """Crash the whole cluster several times in a row; the generation chain
+    must be strictly monotone and data cumulative."""
+    c, db = bootstrap(seed=56)
+    gens = [c.acting_controller().generation]
+    for round_i in range(3):
+        key = b"round%d" % round_i
+        out = {}
+
+        async def w(tr, key=key):
+            tr.set(key, b"v")
+
+        c.run_all([(db, db.run(w))], timeout_vt=600.0)
+        c.crash_and_recover()
+
+        async def check(tr):
+            for r in range(round_i + 1):
+                out[b"round%d" % r] = await tr.get(b"round%d" % r)
+
+        c.run_all([(db, db.run(check))], timeout_vt=900.0)
+        for r in range(round_i + 1):
+            assert out[b"round%d" % r] == b"v", (round_i, r)
+        gens.append(c.acting_controller().generation)
+    assert gens == sorted(set(gens)), gens

@@ -162,3 +162,48 @@ def test_sync_makes_writes_survive_full_corruption():
     data = drive(loop, proc, reader())
     assert data[:100] == b"A" * 100
     set_event_loop(None)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_diskqueue_concurrent_commits_serialize(seed):
+    """Regression (code-review r2): two actors committing the same DiskQueue
+    concurrently must not clobber each other's frames — after a crash, every
+    acked record from BOTH actors must be recovered."""
+    loop, net, fs = make_env(seed)
+    proc = net.process("node")
+    state = {"acked": set()}
+
+    async def run():
+        q, rec = await DiskQueue.open(fs, proc, "cq.dq")
+        assert rec == []
+
+        async def committer(base):
+            for i in range(6):
+                seq = base + i
+                q.push(seq, b"actor%d-%d" % (base, seq) * 3)
+                await q.commit()
+                state["acked"].add(seq)
+
+        from foundationdb_tpu.flow.eventloop import all_of
+
+        await all_of(
+            [
+                proc.spawn(committer(100)),
+                proc.spawn(committer(200)),
+                proc.spawn(committer(300)),
+            ]
+        )
+
+    drive(loop, proc, run())
+    proc.kill()
+    fs.crash_machine(proc.machine.machine_id)
+    proc.reboot()
+
+    async def recover():
+        q, rec = await DiskQueue.open(fs, proc, "cq.dq")
+        got = {seq for seq, _ in rec}
+        missing = state["acked"] - got
+        assert not missing, f"acked records lost: {sorted(missing)}"
+
+    drive(loop, proc, recover())
+    set_event_loop(None)
