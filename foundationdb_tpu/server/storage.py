@@ -134,6 +134,7 @@ class VersionedStore:
 
 
 VERSION_META_KEY = b"\xff\xffmeta/durable_version"
+OWNED_META_KEY = b"\xff\xffmeta/owned_ranges"
 
 
 class StorageServer:
@@ -144,6 +145,17 @@ class StorageServer:
     the TLog popped only after durability (ref: updateStorage ->
     IKeyValueStore::commit -> tLogPop).  Without it, applied == durable and
     the log is popped eagerly (the original in-memory slice).
+
+    Sharding: `owned` maps key ranges this server serves (ref: serverKeys /
+    shardsAffectedByTeamFailure).  Ownership changes ride the mutation
+    stream itself — every storage intercepts `\xff/keyServers/` mutations
+    (ApplyMetadataMutation analog) so a shard handoff happens at an exact
+    commit version on every role that watches the stream.  A range being
+    fetched (`adding`) applies mutations but does not serve reads (ref:
+    AddingShard, storageserver.actor.cpp:85-133).  Reads outside owned
+    ranges fail with wrong_shard_server (the client invalidates its
+    location cache and retries).  Ownership is persisted with the durable
+    snapshot and recovered before log replay.
     """
 
     def __init__(
@@ -152,17 +164,31 @@ class StorageServer:
         tlog: TLogInterface,
         epoch_begin_version: int = 0,
         kvstore=None,
+        storage_id: str = None,
+        owned_all: bool = True,
+        owned_ranges: list = None,
     ):
+        from ..utils import RangeMap
+
         self.process = process
         self.tlog = tlog
         self.store = VersionedStore()
         self.kvstore = kvstore
+        self.storage_id = storage_id or f"ss:{process.machine.machine_id}"
+        self.owned = RangeMap(False)
+        if owned_ranges is not None:
+            for b, e in owned_ranges:
+                self.owned.set_range(b, e, True)
+        elif owned_all:
+            self.owned.set_range(b"", None, True)
+        self.adding = RangeMap(False)
         self.version = NotifiedVersion(epoch_begin_version)
         self.durable_version = epoch_begin_version
         self._gv_stream = RequestStream(process, "get_value", well_known=True)
         self._gkv_stream = RequestStream(process, "get_key_values", well_known=True)
         self._ver_stream = RequestStream(process, "get_version", well_known=True)
         self._watch_stream = RequestStream(process, "watch_value", well_known=True)
+        self._fetch_stream = RequestStream(process, "fetch_shard", well_known=True)
         # key -> [(watched_value, reply)] parked until the key changes
         self._watches: Dict[bytes, list] = {}
         process.spawn(self._update_loop(), "ss_update")
@@ -170,24 +196,49 @@ class StorageServer:
         process.spawn(self._serve_get_key_values(), "ss_get_key_values")
         process.spawn(self._serve_get_version(), "ss_get_version")
         process.spawn(self._serve_watch_value(), "ss_watch")
+        process.spawn(self._serve_fetch_shard(), "ss_fetch")
 
     @classmethod
-    async def recover(cls, process: SimProcess, tlog: TLogInterface, fs, filename: str):
+    async def recover(
+        cls,
+        process: SimProcess,
+        tlog: TLogInterface,
+        fs,
+        filename: str,
+        storage_id: str = None,
+        owned_all: bool = True,
+    ):
         """Reopen the base engine and resume pulling from its durable
-        version (ref: storageServer rollback/restart recovery)."""
+        version (ref: storageServer rollback/restart recovery).  Ownership
+        is restored from the durable meta record; keyServers mutations in
+        the replayed log tail re-apply any later changes."""
+        import pickle
+
         from ..fileio.kvstore import KeyValueStoreMemory
 
         kv = await KeyValueStoreMemory.open(fs, process, filename)
         meta = kv.read_value(VERSION_META_KEY)
         durable = int(meta.decode()) if meta else 0
-        return cls(process, tlog, epoch_begin_version=durable, kvstore=kv)
+        owned_meta = kv.read_value(OWNED_META_KEY)
+        owned_ranges = pickle.loads(owned_meta) if owned_meta else None
+        return cls(
+            process,
+            tlog,
+            epoch_begin_version=durable,
+            kvstore=kv,
+            storage_id=storage_id,
+            owned_all=owned_all if owned_meta is None else False,
+            owned_ranges=owned_ranges,
+        )
 
     def interface(self) -> StorageInterface:
         return StorageInterface(
+            storage_id=self.storage_id,
             get_value=self._gv_stream.ref(),
             get_key_values=self._gkv_stream.ref(),
             get_version=self._ver_stream.ref(),
             watch_value=self._watch_stream.ref(),
+            fetch_shard=self._fetch_stream.ref(),
         )
 
     # -- watches (ref watchValue_impl storageserver.actor.cpp:760) --
@@ -313,12 +364,22 @@ class StorageServer:
     def _apply(self, version: int, mutations: List[Mutation]):
         touched, cleared = set(), []
         for seq, m in enumerate(mutations):
+            # Metadata interception first (ref ApplyMetadataMutation.h):
+            # every storage watches keyServers changes regardless of
+            # ownership — that is how shard handoffs reach them, serialized
+            # with the stream at this exact version.
+            self._apply_metadata(m, version)
+            if not self._applies_here(m):
+                continue
             if m.type == MutationType.SET_VALUE:
                 self.store.set(m.param1, m.param2, version, seq)
                 touched.add(m.param1)
             elif m.type == MutationType.CLEAR_RANGE:
-                self.store.clear_range(m.param1, m.param2, version, seq)
-                cleared.append((m.param1, m.param2))
+                for cb, ce, _v in list(
+                    self._clip_to_applied(m.param1, m.param2)
+                ):
+                    self.store.clear_range(cb, ce, version, seq)
+                    cleared.append((cb, ce))
             elif m.type in (MutationType.NO_OP, MutationType.DEBUG_KEY):
                 pass
             else:
@@ -328,6 +389,78 @@ class StorageServer:
                 )
                 touched.add(m.param1)
         self._check_watches(version, touched, cleared)
+
+    def _applies_here(self, m: Mutation) -> bool:
+        """Point mutations: owned-or-adding at the key; clears: any overlap
+        (clipped at application)."""
+        if m.type == MutationType.CLEAR_RANGE:
+            return any(True for _ in self._clip_to_applied(m.param1, m.param2))
+        return self.owned[m.param1] or self.adding[m.param1]
+
+    def _clip_to_applied(self, begin: bytes, end: bytes):
+        """Sub-ranges of [begin, end) that are owned or being added."""
+        for cb, ce, v in self.owned.intersecting(begin, end):
+            if v:
+                yield cb, ce, v
+            else:
+                e2 = ce
+                for ab, ae, av in self.adding.intersecting(cb, e2):
+                    if av:
+                        yield ab, ae, av
+
+    def _apply_metadata(self, m: Mutation, version: int):
+        from . import system_keys as sk
+
+        if m.type == MutationType.SET_VALUE and m.param1.startswith(
+            sk.KEY_SERVERS_PREFIX
+        ):
+            begin = sk.key_servers_begin(m.param1)
+            team = sk.decode_team(m.param2)
+            # This entry covers [begin, next keyServers entry).  The full
+            # extent is recomputed from the authoritative system keyspace by
+            # whoever owns it; for ownership purposes each storage only needs
+            # the transition at `begin`: the range [begin, end*) where end*
+            # is the next boundary KNOWN LOCALLY.  The proxy always writes
+            # boundary pairs (begin and end entries) in one commit, so local
+            # knowledge is complete for the affected span.
+            ends = [
+                b
+                for b, _e, v in self.owned.items()
+                if b > begin and v is not None
+            ]
+            mine = self.storage_id in team
+            end = self._pending_shard_end
+            if end is not None and end > begin:
+                if mine:
+                    self.owned.set_range(begin, end, True)
+                    self.adding.set_range(begin, end, False)
+                else:
+                    self._disown(begin, end)
+            self._pending_shard_end = None
+
+    _pending_shard_end = None
+
+    def _disown(self, begin: bytes, end):
+        had = any(v for _b, _e, v in self.owned.intersecting(begin, end))
+        self.owned.set_range(begin, end, False)
+        self.adding.set_range(begin, end, False)
+        if had:
+            self._drop_range(begin, end)
+
+    def _drop_range(self, begin: bytes, end):
+        """Evict data for a range this server no longer owns; parked watches
+        in the range fire wrong_shard_server so clients re-route."""
+        hi = end if end is not None else b"\xff\xff\xff\xff"
+        if self.kvstore is not None:
+            self.kvstore.clear_range(begin, hi)
+        i = bisect_left(self.store.sorted_keys, begin)
+        j = bisect_left(self.store.sorted_keys, hi)
+        for k in self.store.sorted_keys[i:j]:
+            self.store.kv.pop(k, None)
+        del self.store.sorted_keys[i:j]
+        for k in [k for k in self._watches if begin <= k < hi]:
+            for _val, reply in self._watches.pop(k):
+                reply.send_error("wrong_shard_server")
 
     # -- read path --
     async def _wait_for_version(self, version: int):

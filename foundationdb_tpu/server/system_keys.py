@@ -1,0 +1,43 @@
+"""System keyspace (`\xff`) encodings: the shard map lives IN the database.
+
+Ref: fdbclient/SystemData.{h,cpp} — `keyServersKey(k) = \xff/keyServers/ + k`
+whose value lists the storage servers for the shard beginning at k, and
+fdbserver/ApplyMetadataMutation.h — roles learn metadata changes by watching
+these keys in the mutation stream itself, so a shard handoff is serialized
+with user commits at an exact version.
+
+Values are pickled lists of storage-server ids (a "team"; replication >1
+arrives with the tag-partitioned log).
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional, Tuple
+
+SYSTEM_PREFIX = b"\xff"
+KEY_SERVERS_PREFIX = b"\xff/keyServers/"
+KEY_SERVERS_END = b"\xff/keyServers0"  # '0' == '/' + 1
+SERVER_LIST_PREFIX = b"\xff/serverList/"
+SERVER_LIST_END = b"\xff/serverList0"
+
+
+def key_servers_key(key: bytes) -> bytes:
+    return KEY_SERVERS_PREFIX + key
+
+
+def key_servers_begin(sys_key: bytes) -> bytes:
+    assert sys_key.startswith(KEY_SERVERS_PREFIX), sys_key
+    return sys_key[len(KEY_SERVERS_PREFIX):]
+
+
+def encode_team(storage_ids: List[str]) -> bytes:
+    return pickle.dumps(list(storage_ids), protocol=4)
+
+
+def decode_team(value: Optional[bytes]) -> List[str]:
+    return list(pickle.loads(value)) if value else []
+
+
+def server_list_key(storage_id: str) -> bytes:
+    return SERVER_LIST_PREFIX + storage_id.encode()

@@ -1,0 +1,3 @@
+from .rangemap import RangeMap
+
+__all__ = ["RangeMap"]

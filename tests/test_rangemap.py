@@ -1,0 +1,68 @@
+"""RangeMap (KeyRangeMap analog) unit tests + randomized differential vs a
+brute-force dict-of-keys model."""
+
+import numpy as np
+
+from foundationdb_tpu.utils import RangeMap
+
+
+def test_basic_set_get():
+    m = RangeMap("s0")
+    assert m[b""] == "s0" and m[b"zzz"] == "s0"
+    m.set_range(b"b", b"d", "s1")
+    assert m[b"a"] == "s0"
+    assert m[b"b"] == "s1"
+    assert m[b"c\xff"] == "s1"
+    assert m[b"d"] == "s0"
+    assert list(m.items()) == [
+        (b"", b"b", "s0"),
+        (b"b", b"d", "s1"),
+        (b"d", None, "s0"),
+    ]
+
+
+def test_coalescing():
+    m = RangeMap("a")
+    m.set_range(b"b", b"c", "b")
+    m.set_range(b"c", b"d", "b")
+    assert list(m.items()) == [(b"", b"b", "a"), (b"b", b"d", "b"), (b"d", None, "a")]
+    m.set_range(b"b", b"d", "a")
+    assert list(m.items()) == [(b"", None, "a")]
+
+
+def test_set_to_infinity():
+    m = RangeMap("x")
+    m.set_range(b"m", None, "y")
+    assert m[b"z"] == "y" and m[b"a"] == "x"
+    assert list(m.items()) == [(b"", b"m", "x"), (b"m", None, "y")]
+
+
+def test_intersecting_clips():
+    m = RangeMap("a")
+    m.set_range(b"c", b"f", "b")
+    got = list(m.intersecting(b"d", b"z"))
+    assert got == [(b"d", b"f", "b"), (b"f", b"z", "a")]
+    got = list(m.intersecting(b"c", b"d"))
+    assert got == [(b"c", b"d", "b")]
+
+
+def test_randomized_vs_bruteforce():
+    rng = np.random.default_rng(5)
+    m = RangeMap(0)
+    keys = [b"%03d" % i for i in range(100)]
+    brute = {k: 0 for k in keys}
+    for step in range(300):
+        a, b = sorted(rng.integers(0, 100, 2))
+        v = int(rng.integers(0, 5))
+        if a == b:
+            b = a + 1
+        m.set_range(b"%03d" % a, b"%03d" % b, v)
+        for i in range(a, b):
+            brute[b"%03d" % i] = v
+        for k in keys:
+            assert m[k] == brute[k], (step, k)
+        # invariants: begins sorted+unique, neighbours coalesced
+        assert m.begins == sorted(set(m.begins))
+        assert all(
+            m.values[i] != m.values[i - 1] for i in range(1, len(m.values))
+        )
