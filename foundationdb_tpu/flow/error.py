@@ -127,6 +127,14 @@ class ActorCancelled(FdbError):
         super().__init__("actor_cancelled")
 
 
+class SimulationFailure(Exception):
+    """An actor crashed with a non-FdbError exception (a genuine bug, not a
+    simulated fault).  The event loop surfaces this immediately from
+    run_until so a broken role constructor fails every test loudly instead
+    of hanging the cluster (the reference crashes the process on broken
+    invariants; determinism-as-sanitizer, SURVEY §5)."""
+
+
 def internal_error(msg: str = "") -> FdbError:
     e = FdbError("internal_error")
     if msg:

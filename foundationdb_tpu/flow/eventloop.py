@@ -23,7 +23,7 @@ from __future__ import annotations
 import heapq
 from typing import Coroutine, Optional
 
-from .error import ActorCancelled
+from .error import ActorCancelled, FdbError, SimulationFailure
 from .future import Future, Promise
 from .rng import DeterministicRandom
 
@@ -114,6 +114,7 @@ class Task(Future):
             return
         except BaseException as e:  # noqa: BLE001 - errors flow into the future
             self._set_error(e)
+            self._loop._note_actor_failure(self.name, e)
             return
         # The coroutine yielded a Future it is waiting on.
         assert isinstance(awaited, Future), (
@@ -159,6 +160,7 @@ class Task(Future):
             err = e
         if not self.is_ready():
             self._set_error(err)
+            self._loop._note_actor_failure(self.name, err)
 
 
 class EventLoop:
@@ -172,6 +174,19 @@ class EventLoop:
         self._heap: list = []
         self._stopped = False
         self.tasks_run = 0
+        # (actor name, exception) for tasks that died with a non-FdbError
+        # exception: genuine bugs, surfaced as SimulationFailure by run_until.
+        self.failed_actors: list = []
+
+    def _note_actor_failure(self, name: str, err: BaseException):
+        """Record an actor crash that is a bug (Python error), not a
+        simulated fault (FdbError / ActorCancelled flow through futures as
+        expected distributed errors)."""
+        if isinstance(err, FdbError):
+            return
+        if any(e is err for _n, e in self.failed_actors):
+            return  # same exception propagating through an awaiter chain
+        self.failed_actors.append((name, err))
 
     # --- time ---
     def now(self) -> float:
@@ -230,12 +245,25 @@ class EventLoop:
         """Drive the loop until `future` is ready; returns its value."""
         deadline = None if timeout_vt is None else self._now + timeout_vt
         while not future.is_ready():
+            if self.failed_actors:
+                name, err = self.failed_actors[0]
+                self.failed_actors = []
+                raise SimulationFailure(
+                    f"unhandled exception in actor {name!r}: {err!r}"
+                ) from err
             if deadline is not None and self._heap and self._heap[0][0] > deadline:
                 raise TimeoutError(
                     f"virtual-time deadline {deadline} exceeded (now={self._now})"
                 )
             if not self.run_one():
                 raise RuntimeError("event loop ran dry awaiting future")
+        if future.is_error():
+            # The awaited future's own error is observed by the caller via
+            # get(); don't re-raise it as a SimulationFailure later.
+            err = future.error()
+            self.failed_actors = [
+                (n, e) for n, e in self.failed_actors if e is not err
+            ]
         return future.get()
 
     def run(self, max_events: Optional[int] = None):

@@ -79,6 +79,7 @@ class ServerKnobs(Knobs):
         self._init("conflict_max_device_key_bytes", 16)  # > this: CPU fallback
         self._init("conflict_history_capacity", 1 << 20)
         self._init("max_watches", 10000)  # ref: MAX_STORAGE_SERVER_WATCHES
+        self._init("fetch_shard_page_rows", 5000)  # ref: FETCH_BLOCK_BYTES analog
         # Ratekeeper (ref: Ratekeeper.actor.cpp knobs, distilled)
         self._init("ratekeeper_max_tps", 100000.0)
         self._init("ratekeeper_min_tps", 10.0)

@@ -110,7 +110,13 @@ class TLogPeekReply:
 
 @dataclass
 class TLogPopRequest:
-    version: int = 0  # durable-on-storage; log may discard <= version
+    """Per-consumer durability mark (ref: tLogPop TLogServer.actor.cpp:894
+    pops per TAG; the log discards only below the min across tags).  A
+    consumer's first pop registers its tag; a storage registers at
+    construction so entries it hasn't peeked are never discarded."""
+
+    version: int = 0  # durable-on-this-consumer; tag's mark rises to it
+    tag: str = ""  # consumer identity (storage id); "" = the default tag
 
 
 @dataclass
@@ -163,8 +169,46 @@ class WatchValueRequest:
 
 
 @dataclass
+class FetchShardRequest:
+    """Page of shard data at a FIXED snapshot version, served during a data
+    move (ref: fetchKeys' getRange reads at fetchVersion,
+    storageserver.actor.cpp fetchKeys).  The destination pages by advancing
+    `begin` past the last returned key, all pages at the same version."""
+
+    begin: bytes = b""
+    end: bytes = b"\xff"
+    version: int = 0
+
+
+@dataclass
+class FetchShardReply:
+    data: List[Tuple[bytes, bytes]] = field(default_factory=list)
+    version: int = 0
+    more: bool = False
+
+
+@dataclass
+class GetShardStateRequest:
+    """Ref: GetShardStateRequest StorageServerInterface.h; DD polls the
+    destination until the shard is FETCHED before finishing a move."""
+
+    begin: bytes = b""
+    end: bytes = b"\xff"
+
+
+# GetShardStateReply is a plain string:
+#   "readable"  - owned and serving reads over the whole range
+#   "adding"    - a fetch is still streaming data in
+#   "fetched"   - data complete; waiting for the ownership flip
+#   "missing"   - not owned, not being added (e.g. lost across a crash)
+
+
+@dataclass
 class StorageInterface:
+    storage_id: str = ""
     get_value: RequestStreamRef = None
     get_key_values: RequestStreamRef = None
     get_version: RequestStreamRef = None
     watch_value: RequestStreamRef = None
+    fetch_shard: RequestStreamRef = None
+    get_shard_state: RequestStreamRef = None

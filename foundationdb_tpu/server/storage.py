@@ -6,26 +6,39 @@ update() pulls mutations from the log via peek and applies them in version
 order; atomics are applied at the storage server exactly as the client
 would (shared fdbclient/Atomic.h semantics -> client/atomic.py).
 
-v1 model: per-key version chains + a version-stamped clear-range list; one
-storage process owns the whole key space (sharding arrives with
-DataDistribution).  All history is retained in-memory; the durability
-milestone adds the persistent engine + window trimming.
+Sharding: `owned` maps the key ranges this server serves (ref: serverKeys).
+Ownership changes ride the mutation stream itself — every storage intercepts
+`\xff/keyServers/` mutations (the ApplyMetadataMutation analog,
+fdbserver/ApplyMetadataMutation.h) so a shard handoff happens at an exact
+commit version on every role that watches the stream.  A range being
+fetched buffers its mutations until the snapshot arrives (ref: AddingShard,
+storageserver.actor.cpp:85-133), then replays the tail and goes live when
+the settling keyServers record lands.  Reads outside owned ranges fail with
+wrong_shard_server (the client invalidates its location cache and retries);
+reads below a fetched shard's snapshot version fail transaction_too_old
+(ref: the shard's transferredVersion floor in fetchKeys).
 """
 
 from __future__ import annotations
 
+import pickle
 from bisect import bisect_left, bisect_right, insort
 from typing import Dict, List, Optional, Tuple
 
 from ..client.atomic import apply_atomic
-from ..client.types import Mutation, MutationType
+from ..client.types import Mutation, MutationType, key_after
 from ..flow.asyncvar import NotifiedVersion
+from ..flow.error import FdbError
 from ..flow.knobs import g_knobs
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
+from ..utils import RangeMap
 from .interfaces import (
+    FetchShardReply,
+    FetchShardRequest,
     GetKeyValuesReply,
     GetKeyValuesRequest,
+    GetShardStateRequest,
     GetValueReply,
     GetValueRequest,
     StorageInterface,
@@ -34,6 +47,11 @@ from .interfaces import (
     TLogPopRequest,
     WatchValueRequest,
 )
+
+# User + system data lives in [b"", KEYSPACE_END); keys at or beyond it are
+# per-engine metadata outside the replicated keyspace (ref: allKeys end
+# \xff\xff, fdbclient/SystemData.cpp).
+KEYSPACE_END = b"\xff\xff"
 
 
 class VersionedStore:
@@ -137,6 +155,30 @@ VERSION_META_KEY = b"\xff\xffmeta/durable_version"
 OWNED_META_KEY = b"\xff\xffmeta/owned_ranges"
 
 
+class AddingShard:
+    """A range this server is becoming responsible for (ref: AddingShard
+    storageserver.actor.cpp:85-133).  While FETCHING, the stream's mutations
+    for the range are buffered (applying them before the base snapshot lands
+    would double-apply atomics and break chain ordering); once the snapshot
+    at `fetch_version` is in, the buffered tail above it replays and the
+    shard waits READY for the settling keyServers record."""
+
+    FETCHING = 0
+    READY = 1
+
+    __slots__ = ("begin", "end", "src_ids", "phase", "buffer", "fetch_version",
+                 "finalized")
+
+    def __init__(self, begin: bytes, end: bytes, src_ids: List[str]):
+        self.begin = begin
+        self.end = end
+        self.src_ids = src_ids
+        self.phase = AddingShard.FETCHING
+        self.buffer: List[Tuple[int, int, Mutation]] = []  # (version, seq, m)
+        self.fetch_version = 0
+        self.finalized = False  # settling record arrived while still fetching
+
+
 class StorageServer:
     """In-memory MVCC window, optionally over a durable base engine.
 
@@ -145,17 +187,6 @@ class StorageServer:
     the TLog popped only after durability (ref: updateStorage ->
     IKeyValueStore::commit -> tLogPop).  Without it, applied == durable and
     the log is popped eagerly (the original in-memory slice).
-
-    Sharding: `owned` maps key ranges this server serves (ref: serverKeys /
-    shardsAffectedByTeamFailure).  Ownership changes ride the mutation
-    stream itself — every storage intercepts `\xff/keyServers/` mutations
-    (ApplyMetadataMutation analog) so a shard handoff happens at an exact
-    commit version on every role that watches the stream.  A range being
-    fetched (`adding`) applies mutations but does not serve reads (ref:
-    AddingShard, storageserver.actor.cpp:85-133).  Reads outside owned
-    ranges fail with wrong_shard_server (the client invalidates its
-    location cache and retries).  Ownership is persisted with the durable
-    snapshot and recovered before log replay.
     """
 
     def __init__(
@@ -166,22 +197,38 @@ class StorageServer:
         kvstore=None,
         storage_id: str = None,
         owned_all: bool = True,
-        owned_ranges: list = None,
+        meta=None,
     ):
-        from ..utils import RangeMap
-
         self.process = process
         self.tlog = tlog
         self.store = VersionedStore()
         self.kvstore = kvstore
         self.storage_id = storage_id or f"ss:{process.machine.machine_id}"
         self.owned = RangeMap(False)
-        if owned_ranges is not None:
-            for b, e in owned_ranges:
-                self.owned.set_range(b, e, True)
+        self.adding = RangeMap(False)  # range -> AddingShard while moving in
+        self.avail = RangeMap(0)  # per-range read-version floor (fetch snap)
+        # storage id -> StorageInterface, learned from \xff/serverList/
+        # mutations in the stream (ref: the serverList system keys).
+        self.server_list: Dict[str, StorageInterface] = {}
+        self._meta_dirty = True
+        if meta is not None:
+            owned_entries, avail_entries, server_list, ready_shards = meta
+            for b, e, v in owned_entries:
+                self.owned.set_range(b, e, v)
+            for b, e, v in avail_entries:
+                self.avail.set_range(b, e, v)
+            self.server_list = dict(server_list)
+            # READY AddingShards persist with the same commit that made
+            # their fetched data durable, so a crash between FETCHED and the
+            # settle record doesn't lose the move (the settle replayed from
+            # the log tail finds the shard and flips it).
+            for b, e, fv in ready_shards:
+                shard = AddingShard(b, e, [])
+                shard.phase = AddingShard.READY
+                shard.fetch_version = fv
+                self.adding.set_range(b, e, shard)
         elif owned_all:
             self.owned.set_range(b"", None, True)
-        self.adding = RangeMap(False)
         self.version = NotifiedVersion(epoch_begin_version)
         self.durable_version = epoch_begin_version
         self._gv_stream = RequestStream(process, "get_value", well_known=True)
@@ -189,14 +236,24 @@ class StorageServer:
         self._ver_stream = RequestStream(process, "get_version", well_known=True)
         self._watch_stream = RequestStream(process, "watch_value", well_known=True)
         self._fetch_stream = RequestStream(process, "fetch_shard", well_known=True)
+        self._shard_state_stream = RequestStream(
+            process, "get_shard_state", well_known=True
+        )
         # key -> [(watched_value, reply)] parked until the key changes
         self._watches: Dict[bytes, list] = {}
+        # Register our pop tag before anything else runs: the log must not
+        # discard entries this storage hasn't peeked (per-tag popping).
+        tlog.pop.send(
+            process,
+            TLogPopRequest(version=epoch_begin_version, tag=self.storage_id),
+        )
         process.spawn(self._update_loop(), "ss_update")
         process.spawn(self._serve_get_value(), "ss_get_value")
         process.spawn(self._serve_get_key_values(), "ss_get_key_values")
         process.spawn(self._serve_get_version(), "ss_get_version")
         process.spawn(self._serve_watch_value(), "ss_watch")
         process.spawn(self._serve_fetch_shard(), "ss_fetch")
+        process.spawn(self._serve_get_shard_state(), "ss_shard_state")
 
     @classmethod
     async def recover(
@@ -211,24 +268,24 @@ class StorageServer:
         """Reopen the base engine and resume pulling from its durable
         version (ref: storageServer rollback/restart recovery).  Ownership
         is restored from the durable meta record; keyServers mutations in
-        the replayed log tail re-apply any later changes."""
-        import pickle
-
+        the replayed log tail re-apply any later changes.  A move that was
+        in flight at the crash is simply absent (AddingShards are not
+        durable) — DD observes "missing" shard state and restarts it."""
         from ..fileio.kvstore import KeyValueStoreMemory
 
         kv = await KeyValueStoreMemory.open(fs, process, filename)
-        meta = kv.read_value(VERSION_META_KEY)
-        durable = int(meta.decode()) if meta else 0
+        vmeta = kv.read_value(VERSION_META_KEY)
+        durable = int(vmeta.decode()) if vmeta else 0
         owned_meta = kv.read_value(OWNED_META_KEY)
-        owned_ranges = pickle.loads(owned_meta) if owned_meta else None
+        meta = pickle.loads(owned_meta) if owned_meta else None
         return cls(
             process,
             tlog,
             epoch_begin_version=durable,
             kvstore=kv,
             storage_id=storage_id,
-            owned_all=owned_all if owned_meta is None else False,
-            owned_ranges=owned_ranges,
+            owned_all=owned_all if meta is None else False,
+            meta=meta,
         )
 
     def interface(self) -> StorageInterface:
@@ -239,6 +296,7 @@ class StorageServer:
             get_version=self._ver_stream.ref(),
             watch_value=self._watch_stream.ref(),
             fetch_shard=self._fetch_stream.ref(),
+            get_shard_state=self._shard_state_stream.ref(),
         )
 
     # -- watches (ref watchValue_impl storageserver.actor.cpp:760) --
@@ -248,12 +306,14 @@ class StorageServer:
             self.process.spawn(self._watch_one(req, reply), "ss_watch_one")
 
     async def _watch_one(self, req: WatchValueRequest, reply):
-        from ..flow.knobs import g_knobs
-
         try:
+            self._check_range_owned(req.key, key_after(req.key), req.version)
             await self._wait_for_version(req.version)
-        except Exception as e:  # noqa: BLE001
-            reply.send_error(getattr(e, "name", "internal_error"))
+            # Ownership may have moved away during the wait; re-check so a
+            # disowned (dropped) range re-routes instead of reading as empty.
+            self._check_range_owned(req.key, key_after(req.key), req.version)
+        except FdbError as e:
+            reply.send_error(e.name)
             return
         current = self._get_current(req.key, self.version.get())
         if current != req.value:
@@ -313,7 +373,10 @@ class StorageServer:
                 # In-memory engine: applied == durable, pop eagerly.
                 self.durable_version = self.version.get()
                 self.tlog.pop.send(
-                    self.process, TLogPopRequest(version=self.version.get())
+                    self.process,
+                    TLogPopRequest(
+                        version=self.version.get(), tag=self.storage_id
+                    ),
                 )
             elif (
                 loop.now() - last_durable_commit
@@ -351,9 +414,25 @@ class StorageServer:
             else:
                 self.kvstore.clear_range(a, b)
         self.kvstore.set(VERSION_META_KEY, b"%d" % new_durable)
+        if self._meta_dirty:
+            self._meta_dirty = False
+            ready = {
+                id(a): a for _b, _e, a in self.adding.items()
+                if a and a.phase == AddingShard.READY
+            }
+            meta = (
+                [(b, e, v) for b, e, v in self.owned.items()],
+                [(b, e, v) for b, e, v in self.avail.items()],
+                dict(self.server_list),
+                [(a.begin, a.end, a.fetch_version) for a in ready.values()],
+            )
+            self.kvstore.set(OWNED_META_KEY, pickle.dumps(meta, protocol=4))
         await self.kvstore.commit()
         self.store.trim(new_durable)
-        self.tlog.pop.send(self.process, TLogPopRequest(version=new_durable))
+        self.tlog.pop.send(
+            self.process,
+            TLogPopRequest(version=new_durable, tag=self.storage_id),
+        )
 
     def _get_current(self, key: bytes, version: int) -> Optional[bytes]:
         touched, val = self.store.get_stamped(key, version)
@@ -361,96 +440,156 @@ class StorageServer:
             return self.kvstore.read_value(key)
         return val
 
+    # -- mutation application + metadata interception --
     def _apply(self, version: int, mutations: List[Mutation]):
         touched, cleared = set(), []
         for seq, m in enumerate(mutations):
             # Metadata interception first (ref ApplyMetadataMutation.h):
-            # every storage watches keyServers changes regardless of
-            # ownership — that is how shard handoffs reach them, serialized
+            # every storage watches keyServers/serverList changes regardless
+            # of ownership — that is how shard handoffs reach it, serialized
             # with the stream at this exact version.
             self._apply_metadata(m, version)
-            if not self._applies_here(m):
-                continue
-            if m.type == MutationType.SET_VALUE:
-                self.store.set(m.param1, m.param2, version, seq)
-                touched.add(m.param1)
-            elif m.type == MutationType.CLEAR_RANGE:
-                for cb, ce, _v in list(
-                    self._clip_to_applied(m.param1, m.param2)
-                ):
-                    self.store.clear_range(cb, ce, version, seq)
-                    cleared.append((cb, ce))
-            elif m.type in (MutationType.NO_OP, MutationType.DEBUG_KEY):
-                pass
-            else:
-                existing = self._get_current(m.param1, version)
-                self.store.set(
-                    m.param1, apply_atomic(m.type, existing, m.param2), version, seq
-                )
-                touched.add(m.param1)
+            self._route_mutation(m, version, seq, touched, cleared)
         self._check_watches(version, touched, cleared)
 
-    def _applies_here(self, m: Mutation) -> bool:
-        """Point mutations: owned-or-adding at the key; clears: any overlap
-        (clipped at application)."""
+    def _route_mutation(self, m: Mutation, version: int, seq: int,
+                        touched: set, cleared: list):
+        """Apply to owned ranges; buffer into FETCHING AddingShards; apply
+        directly into READY ones; drop the rest."""
         if m.type == MutationType.CLEAR_RANGE:
-            return any(True for _ in self._clip_to_applied(m.param1, m.param2))
-        return self.owned[m.param1] or self.adding[m.param1]
-
-    def _clip_to_applied(self, begin: bytes, end: bytes):
-        """Sub-ranges of [begin, end) that are owned or being added."""
-        for cb, ce, v in self.owned.intersecting(begin, end):
-            if v:
-                yield cb, ce, v
+            for cb, ce, v in self.owned.intersecting(m.param1, m.param2):
+                ce = m.param2 if ce is None else ce
+                if v:
+                    self.store.clear_range(cb, ce, version, seq)
+                    cleared.append((cb, ce))
+                    continue
+                for ab, ae, shard in self.adding.intersecting(cb, ce):
+                    if not shard:
+                        continue
+                    ae = ce if ae is None else ae
+                    clip = Mutation(MutationType.CLEAR_RANGE, ab, ae)
+                    if shard.phase == AddingShard.FETCHING:
+                        shard.buffer.append((version, seq, clip))
+                    else:
+                        self.store.clear_range(ab, ae, version, seq)
+            return
+        if m.type in (MutationType.NO_OP, MutationType.DEBUG_KEY):
+            return
+        key = m.param1
+        if self.owned[key]:
+            self._apply_point(m, version, seq)
+            touched.add(key)
+            return
+        shard = self.adding[key]
+        if shard:
+            if shard.phase == AddingShard.FETCHING:
+                shard.buffer.append((version, seq, m))
             else:
-                e2 = ce
-                for ab, ae, av in self.adding.intersecting(cb, e2):
-                    if av:
-                        yield ab, ae, av
+                self._apply_point(m, version, seq)
+
+    def _apply_point(self, m: Mutation, version: int, seq: int):
+        if m.type == MutationType.SET_VALUE:
+            self.store.set(m.param1, m.param2, version, seq)
+        else:
+            existing = self._get_current(m.param1, version)
+            self.store.set(
+                m.param1, apply_atomic(m.type, existing, m.param2), version, seq
+            )
 
     def _apply_metadata(self, m: Mutation, version: int):
         from . import system_keys as sk
 
-        if m.type == MutationType.SET_VALUE and m.param1.startswith(
-            sk.KEY_SERVERS_PREFIX
-        ):
+        if m.type != MutationType.SET_VALUE:
+            return
+        if m.param1.startswith(sk.SERVER_LIST_PREFIX):
+            self.server_list[sk.server_list_id(m.param1)] = (
+                sk.decode_server_entry(m.param2)
+            )
+            self._meta_dirty = True
+        elif m.param1.startswith(sk.KEY_SERVERS_PREFIX):
+            self._meta_dirty = True
             begin = sk.key_servers_begin(m.param1)
-            team = sk.decode_team(m.param2)
-            # This entry covers [begin, next keyServers entry).  The full
-            # extent is recomputed from the authoritative system keyspace by
-            # whoever owns it; for ownership purposes each storage only needs
-            # the transition at `begin`: the range [begin, end*) where end*
-            # is the next boundary KNOWN LOCALLY.  The proxy always writes
-            # boundary pairs (begin and end entries) in one commit, so local
-            # knowledge is complete for the affected span.
-            ends = [
-                b
-                for b, _e, v in self.owned.items()
-                if b > begin and v is not None
-            ]
-            mine = self.storage_id in team
-            end = self._pending_shard_end
-            if end is not None and end > begin:
-                if mine:
-                    self.owned.set_range(begin, end, True)
-                    self.adding.set_range(begin, end, False)
-                else:
-                    self._disown(begin, end)
-            self._pending_shard_end = None
+            src, dest, end = sk.decode_key_servers(m.param2)
+            if dest:
+                self._start_adding(begin, end, src, dest, version)
+            else:
+                self._finish_shard(begin, end, src, version)
 
-    _pending_shard_end = None
+    def _start_adding(self, begin: bytes, end: bytes, src: List[str],
+                      dest: List[str], version: int):
+        """A move src -> dest began at `version`.  Sources keep serving
+        reads until the settling record; a destination that lacks the data
+        starts an AddingShard fetch (ref: startMoveKeys writing dest into
+        keyServers, MoveKeys.actor.cpp)."""
+        if self.storage_id not in dest or self.storage_id in src:
+            return
+        if all(v for _b, _e, v in self.owned.intersecting(begin, end)):
+            return  # already fully own it
+        overlapping = {
+            id(a): a for _b, _e, a in self.adding.intersecting(begin, end) if a
+        }
+        if len(overlapping) == 1:
+            a = next(iter(overlapping.values()))
+            if a.begin == begin and a.end == end:
+                return  # duplicate record (DD retry); fetch already running
+        # A different overlapping move supersedes: cancel the old shards over
+        # their FULL extents (their fetch actors notice and abort; any piece
+        # outside [begin,end) becomes "missing" and DD restarts it).
+        for a in overlapping.values():
+            self.adding.set_range(a.begin, a.end, False)
+            self.owned.set_range(a.begin, a.end, False)
+        shard = AddingShard(begin, end, [s for s in src if s != self.storage_id])
+        self.owned.set_range(begin, end, False)
+        self.adding.set_range(begin, end, shard)
+        if not shard.src_ids:
+            # Brand-new (empty) shard: nothing to fetch.
+            shard.fetch_version = version
+            shard.phase = AddingShard.READY
+        else:
+            self.process.spawn(self._fetch_shard_data(shard), "ss_fetch_data")
 
-    def _disown(self, begin: bytes, end):
+    def _finish_shard(self, begin: bytes, end: bytes, team: List[str],
+                      version: int):
+        """A settling record: [begin, end) now belongs to `team` (ref:
+        finishMoveKeys flipping serverKeys).  Non-members disown and drop;
+        members flip their AddingShard live (or adopt an empty new shard)."""
+        if self.storage_id not in team:
+            self._disown(begin, end)
+            return
+        shards = {id(a): a for _b, _e, a in self.adding.intersecting(begin, end)
+                  if a}
+        for a in shards.values():
+            if a.phase == AddingShard.READY:
+                self._flip_to_owned(a)
+            else:
+                # Fetch still in flight (only possible if DD restarted and
+                # re-settled blindly): flip when the data completes.
+                a.finalized = True
+        # NOTE: an unowned sub-range with no AddingShard here stays unowned
+        # ("missing") — e.g. an in-flight move lost across a crash.  Adopting
+        # it empty would turn data loss into a readable empty shard; instead
+        # DD observes "missing" via get_shard_state and restarts the move.
+        # Seeding a brand-new shard uses a (src=[], dest=team) record (which
+        # creates an empty READY AddingShard) followed by a settle.
+
+    def _flip_to_owned(self, shard: AddingShard):
+        self.adding.set_range(shard.begin, shard.end, False)
+        self.owned.set_range(shard.begin, shard.end, True)
+        self.avail.set_range(shard.begin, shard.end, shard.fetch_version)
+        self._meta_dirty = True
+
+    def _disown(self, begin: bytes, end: bytes):
         had = any(v for _b, _e, v in self.owned.intersecting(begin, end))
         self.owned.set_range(begin, end, False)
         self.adding.set_range(begin, end, False)
+        self._meta_dirty = True
         if had:
             self._drop_range(begin, end)
 
-    def _drop_range(self, begin: bytes, end):
+    def _drop_range(self, begin: bytes, end: bytes):
         """Evict data for a range this server no longer owns; parked watches
         in the range fire wrong_shard_server so clients re-route."""
-        hi = end if end is not None else b"\xff\xff\xff\xff"
+        hi = min(end, KEYSPACE_END) if end is not None else KEYSPACE_END
         if self.kvstore is not None:
             self.kvstore.clear_range(begin, hi)
         i = bisect_left(self.store.sorted_keys, begin)
@@ -462,11 +601,131 @@ class StorageServer:
             for _val, reply in self._watches.pop(k):
                 reply.send_error("wrong_shard_server")
 
+    # -- shard fetch: destination side (ref fetchKeys storageserver :85-133) --
+    async def _fetch_shard_data(self, shard: AddingShard):
+        loop = self.process.network.loop
+        attempt = 0
+        while True:
+            if self.adding[shard.begin] is not shard:
+                return  # move cancelled or superseded
+            srcs = [self.server_list.get(s) for s in shard.src_ids]
+            srcs = [s for s in srcs if s is not None]
+            if not srcs:
+                await loop.delay(0.05)  # serverList entry not yet seen
+                continue
+            src = srcs[attempt % len(srcs)]
+            attempt += 1
+            snap = self.version.get()
+            try:
+                await self._fetch_pages(shard, src, snap)
+                break
+            except FdbError:
+                # Source dead / snapshot aged out of its window / it no
+                # longer owns the range: back off and retry at a newer
+                # snapshot (ref: fetchKeys' transaction_too_old retry).
+                await loop.delay(0.05)
+        if self.adding[shard.begin] is not shard:
+            return
+        # Replay the buffered tail the snapshot missed, in stream order.
+        for ver, seq, m in shard.buffer:
+            if ver <= shard.fetch_version:
+                continue
+            if m.type == MutationType.CLEAR_RANGE:
+                self.store.clear_range(m.param1, m.param2, ver, seq)
+            else:
+                self._apply_point(m, ver, seq)
+        shard.buffer = []
+        shard.phase = AddingShard.READY
+        self._meta_dirty = True  # READY shards persist with the durable meta
+        if shard.finalized:
+            self._flip_to_owned(shard)
+
+    async def _fetch_pages(self, shard: AddingShard, src: StorageInterface,
+                           snap: int):
+        """Stream the shard at one fixed snapshot version.  A clear at the
+        snapshot resets any partial previous attempt (it sorts below the
+        page's sets at the same version), so retries at newer snapshots
+        converge."""
+        self.store.clear_range(shard.begin, shard.end, snap, 0)
+        begin = shard.begin
+        while True:
+            rep: FetchShardReply = await src.fetch_shard.get_reply(
+                self.process,
+                FetchShardRequest(begin=begin, end=shard.end, version=snap),
+            )
+            for k, v in rep.data:
+                self.store.set(k, v, snap, 1)
+            if not rep.more:
+                break
+            begin = key_after(rep.data[-1][0])
+        shard.fetch_version = snap
+
+    # -- shard fetch: source side --
+    async def _serve_fetch_shard(self):
+        while True:
+            req, reply = await self._fetch_stream.pop()
+            self.process.spawn(self._fetch_shard_one(req, reply), "ss_fetch_one")
+
+    async def _fetch_shard_one(self, req: FetchShardRequest, reply):
+        try:
+            await self._wait_for_version(req.version)
+        except FdbError as e:
+            reply.send_error(e.name)
+            return
+        if not all(
+            v for _b, _e, v in self.owned.intersecting(req.begin, req.end)
+        ):
+            reply.send_error("wrong_shard_server")
+            return
+        page = g_knobs.server.fetch_shard_page_rows
+        data = self._range_at(req.begin, req.end, req.version, page + 1, False)
+        reply.send(
+            FetchShardReply(data=data[:page], version=req.version,
+                            more=len(data) > page)
+        )
+
+    async def _serve_get_shard_state(self):
+        while True:
+            req, reply = await self._shard_state_stream.pop()
+            reply.send(self._shard_state(req))
+
+    def _shard_state(self, req: GetShardStateRequest) -> str:
+        states = set()
+        for b, e, v in self.owned.intersecting(req.begin, req.end):
+            if v:
+                states.add("readable")
+                continue
+            e2 = req.end if e is None else e
+            adds = [a for _ab, _ae, a in self.adding.intersecting(b, e2) if a]
+            if not adds:
+                states.add("missing")
+            else:
+                states.update(
+                    "fetched" if a.phase == AddingShard.READY else "adding"
+                    for a in adds
+                )
+        for s in ("missing", "adding", "fetched"):
+            if s in states:
+                return s
+        return "readable"
+
     # -- read path --
+    def _check_range_owned(self, begin: bytes, end: bytes, version: int):
+        """Reject reads this server can't answer: outside owned ranges ->
+        wrong_shard_server (client re-routes); below a fetched shard's
+        snapshot floor -> transaction_too_old (ref: getShardState /
+        waitForVersion interplay in storageserver read paths)."""
+        for _b, _e, v in self.owned.intersecting(begin, end):
+            if not v:
+                raise FdbError("wrong_shard_server")
+        floor = 0
+        for _b, _e, v in self.avail.intersecting(begin, end):
+            floor = max(floor, v)
+        if version < floor:
+            raise FdbError("transaction_too_old")
+
     async def _wait_for_version(self, version: int):
         """Ref: waitForVersion storageserver.actor.cpp:631."""
-        from ..flow.error import FdbError
-
         if version > self.version.get() + g_knobs.server.max_versions_in_flight:
             raise FdbError("future_version")
         if version < self.durable_version:
@@ -484,9 +743,11 @@ class StorageServer:
 
     async def _get_value_one(self, req: GetValueRequest, reply):
         try:
+            self._check_range_owned(req.key, key_after(req.key), req.version)
             await self._wait_for_version(req.version)
-        except Exception as e:  # noqa: BLE001
-            reply.send_error(getattr(e, "name", "internal_error"))
+            self._check_range_owned(req.key, key_after(req.key), req.version)
+        except FdbError as e:
+            reply.send_error(e.name)
             return
         reply.send(
             GetValueReply(
@@ -501,9 +762,11 @@ class StorageServer:
 
     async def _get_key_values_one(self, req: GetKeyValuesRequest, reply):
         try:
+            self._check_range_owned(req.begin, req.end, req.version)
             await self._wait_for_version(req.version)
-        except Exception as e:  # noqa: BLE001
-            reply.send_error(getattr(e, "name", "internal_error"))
+            self._check_range_owned(req.begin, req.end, req.version)
+        except FdbError as e:
+            reply.send_error(e.name)
             return
         data = self._range_at(
             req.begin, req.end, req.version, req.limit + 1, req.reverse

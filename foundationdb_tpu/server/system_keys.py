@@ -1,19 +1,27 @@
 """System keyspace (`\xff`) encodings: the shard map lives IN the database.
 
 Ref: fdbclient/SystemData.{h,cpp} — `keyServersKey(k) = \xff/keyServers/ + k`
-whose value lists the storage servers for the shard beginning at k, and
+whose value names the storage teams for the shard beginning at k, and
 fdbserver/ApplyMetadataMutation.h — roles learn metadata changes by watching
 these keys in the mutation stream itself, so a shard handoff is serialized
 with user commits at an exact version.
 
-Values are pickled lists of storage-server ids (a "team"; replication >1
-arrives with the tag-partitioned log).
+Rebuild deviation from the reference encoding: each keyServers entry also
+carries the shard's END key.  The reference derives extents from entry
+adjacency (it reads the authoritative keyspace back); here every storage
+applies metadata purely from the mutation stream, so the record must be
+self-contained.  A move in flight is (src, dest, end) with dest non-empty;
+a settled shard is (team, [], end).
+
+`\xff/serverList/<id>` maps a storage id to its pickled interface (ref:
+serverListKeyFor SystemData.cpp), letting every role resolve ids to
+endpoints passively from the stream.
 """
 
 from __future__ import annotations
 
 import pickle
-from typing import List, Optional, Tuple
+from typing import List, Tuple
 
 SYSTEM_PREFIX = b"\xff"
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
@@ -31,13 +39,34 @@ def key_servers_begin(sys_key: bytes) -> bytes:
     return sys_key[len(KEY_SERVERS_PREFIX):]
 
 
-def encode_team(storage_ids: List[str]) -> bytes:
-    return pickle.dumps(list(storage_ids), protocol=4)
+def encode_key_servers(
+    src: List[str], dest: List[str], end: bytes
+) -> bytes:
+    """Shard record for [begin, end): settled on `src` when `dest` is empty,
+    else a move src -> dest in flight (ref: keyServersValue's src/dest
+    encoding, SystemData.cpp)."""
+    return pickle.dumps((list(src), list(dest), end), protocol=4)
 
 
-def decode_team(value: Optional[bytes]) -> List[str]:
-    return list(pickle.loads(value)) if value else []
+def decode_key_servers(value: bytes) -> Tuple[List[str], List[str], bytes]:
+    src, dest, end = pickle.loads(value)
+    return list(src), list(dest), end
 
 
 def server_list_key(storage_id: str) -> bytes:
     return SERVER_LIST_PREFIX + storage_id.encode()
+
+
+def server_list_id(sys_key: bytes) -> str:
+    assert sys_key.startswith(SERVER_LIST_PREFIX), sys_key
+    return sys_key[len(SERVER_LIST_PREFIX):].decode()
+
+
+def encode_server_entry(interface) -> bytes:
+    """Pickled StorageInterface (refs are plain dataclasses of endpoint
+    tokens, so they survive the log's pickle round-trip)."""
+    return pickle.dumps(interface, protocol=4)
+
+
+def decode_server_entry(value: bytes):
+    return pickle.loads(value)

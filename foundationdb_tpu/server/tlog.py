@@ -43,6 +43,9 @@ class TLog:
         self.entries: List[list] = []
         self.durable = NotifiedVersion(epoch_begin_version)
         self.popped = epoch_begin_version
+        # tag -> highest pop seen; entries are discarded below min over tags
+        # (ref: per-tag popping, TLogServer.actor.cpp:894).
+        self.popped_tags: dict = {}
         self.disk_queue = disk_queue  # None = in-memory (simulated fsync)
         # Epoch-end lock: a locked log rejects further commits (ref: the
         # TLogLockResult protocol during recovery's LOCKING_CSTATE).
@@ -145,12 +148,16 @@ class TLog:
     async def _serve_pop(self):
         while True:
             req, reply = await self._pop_stream.pop()
-            if req.version > self.popped:
-                self.popped = req.version
-                k = bisect_right(self.versions, req.version)
+            tag = req.tag or "_default"
+            if req.version > self.popped_tags.get(tag, -1):
+                self.popped_tags[tag] = req.version
+            floor = min(self.popped_tags.values())
+            if floor > self.popped:
+                self.popped = floor
+                k = bisect_right(self.versions, floor)
                 del self.versions[:k]
                 del self.entries[:k]
                 if self.disk_queue is not None:
                     # Persisted with the next commit (lazy, like the ref).
-                    self.disk_queue.pop(req.version)
+                    self.disk_queue.pop(floor)
             reply.send(None)

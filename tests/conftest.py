@@ -25,3 +25,36 @@ import jax  # noqa: E402
 jax.config.update("jax_platforms", "cpu")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# --- per-test wall-clock timeout (no pytest-timeout in this image) ---
+# A hung simulation (e.g. an actor crash swallowed into an infinite retry
+# loop) must fail the test, not block the suite forever.  SIGALRM-based:
+# Linux-only, single-threaded tests — both true here.  Generous enough for
+# first-time JAX compilation (~20-40s).
+
+import signal  # noqa: E402
+
+import pytest  # noqa: E402
+
+TEST_TIMEOUT_S = 180
+
+
+class TestWallClockTimeout(BaseException):
+    """BaseException so broad `except Exception` retry handlers in role code
+    cannot swallow the watchdog and re-hang the suite."""
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    def on_alarm(signum, frame):
+        raise TestWallClockTimeout(
+            f"test exceeded {TEST_TIMEOUT_S}s wall-clock (hung simulation?)"
+        )
+
+    old = signal.signal(signal.SIGALRM, on_alarm)
+    signal.alarm(TEST_TIMEOUT_S)
+    try:
+        yield
+    finally:
+        signal.alarm(0)
+        signal.signal(signal.SIGALRM, old)

@@ -68,6 +68,11 @@ def test_status_json_shapes():
         tr.set(b"x", b"y")
 
     c.run_all([(db, db.run(w))])
+
+    async def settle():  # storage applies the log asynchronously post-commit
+        await c.loop.delay(0.05)
+
+    c.run_until(db.process.spawn(settle()))
     doc = cluster_status(c)
     assert doc["client"]["database_status"]["available"]
     assert doc["cluster"]["workload"]["transactions"]["committed"] >= 1
