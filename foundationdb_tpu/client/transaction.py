@@ -23,6 +23,7 @@ from ..rpc.network import SimProcess
 from ..flow.future import Future, Promise
 from ..server.interfaces import (
     CommitTransactionRequest,
+    GetKeyServersLocationsRequest,
     GetKeyValuesRequest,
     GetReadVersionRequest,
     GetValueRequest,
@@ -30,6 +31,7 @@ from ..server.interfaces import (
     StorageInterface,
     WatchValueRequest,
 )
+from ..utils import RangeMap
 from .atomic import apply_atomic
 from .types import (
     ATOMIC_TYPES,
@@ -41,14 +43,26 @@ from .types import (
 )
 
 
+# Reroute policy shared by every routed read (point, range, watch): on
+# wrong_shard_server / broken_promise, invalidate the cached location, wait,
+# re-resolve, retry (ref: the backoff in getValue/getRange wrong-shard paths).
+MAX_REROUTE_ATTEMPTS = 60
+REROUTE_DELAY = 0.01
+
+
 class Database:
     """A handle bound to a client process + cluster interfaces (ref:
-    Database/Cluster in NativeAPI.h; location cache arrives with sharding).
+    Database/Cluster in NativeAPI.h).
 
     Static mode: fixed proxy/storage interfaces (SimCluster).  Dynamic mode:
     `info_var` holds a ClientDBInfo maintained by a cluster-controller
     monitor; interfaces refresh across recoveries (ref: the client's
-    monitorProxies / ClientDBInfo subscription)."""
+    monitorProxies / ClientDBInfo subscription).
+
+    The location cache (ref: getKeyLocation_internal
+    NativeAPI.actor.cpp:1027) maps key ranges to storage teams, filled from
+    the proxy's key-location service and invalidated on wrong_shard_server /
+    broken_promise so reads re-route after shard moves and storage deaths."""
 
     def __init__(
         self,
@@ -61,6 +75,47 @@ class Database:
         self._proxy = proxy
         self._storage = storage
         self.info_var = info_var
+        # range -> tuple(StorageInterface) | () unsharded | None unknown
+        self._loc_cache = RangeMap(None)
+
+    def invalidate_location(self, begin: bytes, end: Optional[bytes] = None):
+        self._loc_cache.set_range(begin, end or key_after(begin), None)
+
+    async def get_locations(self, begin: bytes, end: bytes):
+        """(b, e, team) entries covering [begin, end); team () = unsharded
+        (use the default storage interface).  Refetches until every gap is
+        filled — the proxy truncates replies at its limit, so a huge range
+        may take several round trips (ref: the paged getKeyServersLocations
+        in getRange, NativeAPI.actor.cpp:1603)."""
+        for _ in range(100):
+            entries = list(self._loc_cache.intersecting(begin, end))
+            gap = next(
+                ((b, e) for b, e, v in entries if v is None), None
+            )
+            if gap is None:
+                return entries
+            gb, ge = gap
+            rep = await self.proxy.get_key_servers_locations.get_reply(
+                self.process,
+                GetKeyServersLocationsRequest(
+                    begin=gb, end=end if ge is None else min(ge, end)
+                ),
+            )
+            if not rep.results:
+                # Proxy has no entry (shouldn't happen: RangeMap is total);
+                # treat as unsharded rather than spin.
+                self._loc_cache.set_range(gb, ge if ge is not None else end, ())
+                continue
+            for b, e, ifaces in rep.results:
+                self._loc_cache.set_range(b, e, tuple(ifaces))
+        return list(self._loc_cache.intersecting(begin, end))
+
+    async def storage_for_key(self, key: bytes) -> StorageInterface:
+        locs = await self.get_locations(key, key_after(key))
+        _b, _e, team = locs[0]
+        if team:
+            return team[0]  # loadBalance across replicas arrives with repl>1
+        return self.storage
 
     @property
     def proxy(self) -> ProxyInterface:
@@ -147,11 +202,28 @@ class Transaction:
         return sorted(out)
 
     # --- reads ---
+    async def _get_from_storage(self, key: bytes, version: int):
+        """Routed point read with location-cache invalidation + retry (ref:
+        getValue's wrong_shard_server handling, NativeAPI.actor.cpp:1164)."""
+        loop = self.db.process.network.loop
+        last = FdbError("broken_promise")
+        for _ in range(MAX_REROUTE_ATTEMPTS):
+            iface = await self.db.storage_for_key(key)
+            try:
+                return await iface.get_value.get_reply(
+                    self.db.process, GetValueRequest(key=key, version=version)
+                )
+            except FdbError as e:
+                if e.name not in ("wrong_shard_server", "broken_promise"):
+                    raise
+                last = e
+                self.db.invalidate_location(key)
+                await loop.delay(REROUTE_DELAY)
+        raise last
+
     async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
         version = await self.get_read_version()
-        reply = await self.db.storage.get_value.get_reply(
-            self.db.process, GetValueRequest(key=key, version=version)
-        )
+        reply = await self._get_from_storage(key, version)
         if not snapshot:
             self.add_read_conflict_range(key, key_after(key))
         return self._replay(key, reply.value)
@@ -166,35 +238,61 @@ class Transaction:
     ) -> List[Tuple[bytes, bytes]]:
         version = await self.get_read_version()
         out: List[Tuple[bytes, bytes]] = []
+        loop = self.db.process.network.loop
         # Page through storage until `limit` MERGED rows exist or the range
         # is exhausted: local clears can mask base rows, so a single fetch of
         # `limit` rows may under-fill even though more matching keys exist
         # beyond the fetched extent (ref: RYW readThrough continuation).
+        # Each page is clipped to one shard (ref: getRange's per-shard
+        # iteration, NativeAPI.actor.cpp:1603).
         lo, hi = begin, end  # remaining un-scanned extent
+        misroutes = 0
         while len(out) < limit and lo < hi:
-            reply = await self.db.storage.get_key_values.get_reply(
-                self.db.process,
-                GetKeyValuesRequest(
-                    begin=lo,
-                    end=hi,
-                    version=version,
-                    limit=limit - len(out),
-                    reverse=reverse,
-                ),
-            )
+            locs = await self.db.get_locations(lo, hi)
+            if reverse:
+                b, _e, team = locs[-1]
+                req_lo, req_hi = max(b, lo), hi
+            else:
+                _b, e, team = locs[0]
+                req_lo = lo
+                req_hi = hi if e is None else min(e, hi)
+            iface = team[0] if team else self.db.storage
+            try:
+                reply = await iface.get_key_values.get_reply(
+                    self.db.process,
+                    GetKeyValuesRequest(
+                        begin=req_lo,
+                        end=req_hi,
+                        version=version,
+                        limit=limit - len(out),
+                        reverse=reverse,
+                    ),
+                )
+            except FdbError as e:
+                if e.name not in ("wrong_shard_server", "broken_promise"):
+                    raise
+                misroutes += 1
+                if misroutes > MAX_REROUTE_ATTEMPTS:
+                    raise
+                self.db.invalidate_location(req_lo, req_hi)
+                await loop.delay(REROUTE_DELAY)
+                continue
             base = dict(reply.data)
             if reply.more:
                 # Covered extent ends at the last base row fetched; continue
                 # from there next page.
                 if reverse:
-                    cov_lo, cov_hi = reply.data[-1][0], hi
+                    cov_lo, cov_hi = reply.data[-1][0], req_hi
                     hi = cov_lo
                 else:
-                    cov_lo, cov_hi = lo, key_after(reply.data[-1][0])
+                    cov_lo, cov_hi = req_lo, key_after(reply.data[-1][0])
                     lo = cov_hi
             else:
-                cov_lo, cov_hi = lo, hi
-                lo = hi  # exhausted
+                cov_lo, cov_hi = req_lo, req_hi
+                if reverse:
+                    hi = req_lo
+                else:
+                    lo = req_hi
             merged = set(base)
             merged.update(self._touched_keys(cov_lo, cov_hi))
             for k in sorted(merged, reverse=reverse):
@@ -312,14 +410,18 @@ class Transaction:
     async def _arm_watch(self, key: bytes, value, promise: Promise, version: int):
         while True:
             try:
-                fired = await self.db.storage.watch_value.get_reply(
+                iface = await self.db.storage_for_key(key)
+                fired = await iface.watch_value.get_reply(
                     self.db.process, WatchValueRequest(key, value, version)
                 )
                 if not promise.is_set():
                     promise.send(fired)
                 return
             except FdbError as e:
-                if e.name not in ("broken_promise", "transaction_too_old"):
+                if e.name == "wrong_shard_server":
+                    # Shard moved: re-route and re-register.
+                    self.db.invalidate_location(key)
+                elif e.name not in ("broken_promise", "transaction_too_old"):
                     if not promise.is_set():
                         promise.send_error(e)
                     return

@@ -34,6 +34,7 @@ class SimCluster:
         loop: Optional[EventLoop] = None,
         durable: bool = False,
         n_resolvers: int = 1,
+        n_storages: int = 1,
     ):
         self.loop = loop or EventLoop(seed=seed)
         set_event_loop(self.loop)
@@ -49,7 +50,11 @@ class SimCluster:
         ]
         self.resolver_proc = self.resolver_procs[0]
         self.tlog_proc = self.net.process("tlog")
-        self.storage_proc = self.net.process("storage")
+        self.storage_procs = [
+            self.net.process(f"storage{i}" if i else "storage")
+            for i in range(n_storages)
+        ]
+        self.storage_proc = self.storage_procs[0]
         self.proxy_proc = self.net.process("proxy")
         self._n_clients = 0
         self.split_keys = even_split_keys(n_resolvers)
@@ -58,6 +63,7 @@ class SimCluster:
             from ..fileio import SimFileSystem
 
             assert n_resolvers == 1, "durable multi-resolver: use DynamicCluster"
+            assert n_storages == 1, "durable multi-storage: use DynamicCluster"
             self.fs = SimFileSystem(self.net)
             self._start_roles_durable(epoch_begin=0)
         else:
@@ -72,7 +78,18 @@ class SimCluster:
             ]
             self.resolver = self.resolvers[0]
             self.tlog = TLog(self.tlog_proc)
-            self.storage = StorageServer(self.storage_proc, self.tlog.interface())
+            # Storage 0 owns everything at bootstrap (including the \xff
+            # system keyspace); DD redistributes from there.
+            self.storages = [
+                StorageServer(
+                    p,
+                    self.tlog.interface(),
+                    storage_id=f"ss{i}",
+                    owned_all=(i == 0),
+                )
+                for i, p in enumerate(self.storage_procs)
+            ]
+            self.storage = self.storages[0]
             self.proxy = Proxy(
                 self.proxy_proc,
                 self.sequencer.interface(),
@@ -80,6 +97,17 @@ class SimCluster:
                 [self.tlog.interface()],
                 resolver_split_keys=self.split_keys,
             )
+
+    def data_distributor(self):
+        """A DataDistributor driving this cluster (its own client process);
+        pre-registered with every storage's id -> interface."""
+        from .data_distribution import DataDistributor
+
+        dd = DataDistributor(
+            self.database("dd"),
+            {s.storage_id: s.interface() for s in self.storages},
+        )
+        return dd
 
     def _start_roles_durable(self, epoch_begin: int):
         """(Re)build all roles from the machines' disks at a new epoch (the
@@ -93,6 +121,7 @@ class SimCluster:
             self.storage = await StorageServer.recover(
                 self.storage_proc, self.tlog.interface(), self.fs, "storage.dq"
             )
+            self.storages = [self.storage]
             self.sequencer = Sequencer(
                 self.master_proc, epoch_begin_version=epoch_begin
             )

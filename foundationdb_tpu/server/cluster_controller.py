@@ -268,8 +268,33 @@ class ClusterController:
         # RECOVERY_TRANSACTION: advance the chain into the new epoch.
         from ..client.types import CommitTransactionRef
 
-        await proxy_if.commit.get_reply(
+        recovery_txn_version = await proxy_if.commit.get_reply(
             self.process, CommitTransactionRequest(transaction=CommitTransactionRef())
+        )
+
+        # Rebuild the proxy's routing map from storage ownership meta once
+        # the storage has replayed through the recovery transaction (the
+        # txnStateStore-recovery analog; ref recoverFrom masterserver:725).
+        # Must finish before clients see the new generation, and before DD
+        # resumes metadata writes.
+        from .interfaces import GetOwnedMetaRequest
+
+        meta = await timeout_after(
+            loop,
+            storage_if.get_owned_meta.get_reply(
+                self.process,
+                GetOwnedMetaRequest(min_version=recovery_txn_version),
+            ),
+            30.0,
+        )
+        if meta is None:
+            raise FdbError("timed_out")
+        sid, owned_ranges, server_list = meta
+        server_list = dict(server_list)
+        server_list.setdefault(sid, storage_if)
+        await proxy_if.load_system_map.get_reply(
+            self.process,
+            ([(b, e, [sid]) for b, e in owned_ranges], server_list),
         )
 
         # FULLY_RECOVERED: publish to clients (drains parked long-polls).

@@ -1,0 +1,204 @@
+"""DataDistribution v1: shard placement driven by transactions on the
+`\xff` system keyspace.
+
+Ref: fdbserver/DataDistribution.actor.cpp:493 (DDTeamCollection),
+fdbserver/MoveKeys.actor.cpp (startMoveKeys/finishMoveKeys updating the
+keyServers map transactionally), fdbserver/DataDistributionTracker.actor.cpp
+(shard split).  Like the reference, DD is a CLIENT of the database it
+manages: every placement change is an ordinary transaction on system keys,
+so handoffs serialize with user commits at exact versions and survive
+recoveries via the log.
+
+v1 scope: seeding, explicit split/move, even spreading, and shard-state
+polling.  Failure-driven re-replication needs storage replication >= 2 (a
+dead source with replication 1 has nothing to fetch from) and lands with
+the tag-partitioned log system.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..flow.error import FdbError
+from . import system_keys as sk
+from .interfaces import GetShardStateRequest, StorageInterface
+from .storage import KEYSPACE_END
+
+
+class DataDistributor:
+    """Runs MoveKeys-style protocols through a client Database handle."""
+
+    def __init__(self, db, storages: Dict[str, StorageInterface] = None):
+        self.db = db
+        self.loop = db.process.network.loop
+        # Known storages (also discoverable from \xff/serverList/).
+        self.storages: Dict[str, StorageInterface] = dict(storages or {})
+
+    # --- bootstrap ---
+    async def register_storages(self, storages: Dict[str, StorageInterface]):
+        """Publish \xff/serverList/ entries so every role can resolve ids to
+        interfaces from the mutation stream (ref: serverListKeyFor)."""
+        self.storages.update(storages)
+
+        async def txn(tr):
+            tr.options["access_system_keys"] = True
+            for sid, iface in storages.items():
+                tr.set(sk.server_list_key(sid), sk.encode_server_entry(iface))
+
+        await self.db.run(txn)
+
+    async def seed(self, team: List[str]):
+        """Record initial ownership of the whole keyspace by `team` (which
+        must already hold the data — at bootstrap the first storage owns
+        everything).  No-op if a shard map already exists (ref: the seeding
+        in the master's RECOVERY_TRANSACTION for new databases)."""
+        existing = await self.read_shard_map()
+        if existing:
+            return
+
+        async def txn(tr):
+            tr.options["access_system_keys"] = True
+            tr.set(
+                sk.key_servers_key(b""),
+                sk.encode_key_servers(team, [], KEYSPACE_END),
+            )
+
+        await self.db.run(txn)
+
+    # --- introspection ---
+    async def read_shard_map(self) -> List[Tuple[bytes, bytes, list, list]]:
+        """[(begin, end, team, dest_or_empty)] from the authoritative
+        keyspace (ref: krmGetRanges over keyServers)."""
+
+        async def txn(tr):
+            tr.options["access_system_keys"] = True
+            return await tr.get_range(sk.KEY_SERVERS_PREFIX, sk.KEY_SERVERS_END)
+
+        rows = await self.db.run(txn)
+        out = []
+        for k, v in rows:
+            src, dest, end = sk.decode_key_servers(v)
+            out.append((sk.key_servers_begin(k), end, src, dest))
+        return out
+
+    async def _shard_at(self, begin: bytes):
+        for b, e, team, dest in await self.read_shard_map():
+            if b == begin:
+                return b, e, team, dest
+        raise ValueError(f"no shard begins at {begin!r}")
+
+    # --- operations ---
+    async def split(self, at_key: bytes):
+        """Split the shard containing at_key into two (metadata only; no
+        data movement — both halves stay on the same team).  Ref:
+        shardSplitter DataDistributionTracker.actor.cpp."""
+        shards = await self.read_shard_map()
+        for b, e, team, dest in shards:
+            if b < at_key and (at_key < e):
+                assert not dest, "split during a move is not supported (v1)"
+
+                async def txn(tr, b=b, e=e, team=team):
+                    tr.options["access_system_keys"] = True
+                    tr.set(
+                        sk.key_servers_key(b),
+                        sk.encode_key_servers(team, [], at_key),
+                    )
+                    tr.set(
+                        sk.key_servers_key(at_key),
+                        sk.encode_key_servers(team, [], e),
+                    )
+
+                await self.db.run(txn)
+                return
+        # at_key is already a boundary (or outside the map): nothing to do.
+
+    async def move(self, begin: bytes, dest_team: List[str],
+                   poll_interval: float = 0.05, max_polls: int = 2000):
+        """Move the shard beginning at `begin` to `dest_team`: startMove
+        record -> wait for every destination to report FETCHED -> settle
+        (ref: startMoveKeys / waitForShardReady / finishMoveKeys,
+        MoveKeys.actor.cpp)."""
+        b, e, team, dest = await self._shard_at(begin)
+        if dest:
+            # A previous move is recorded in flight; re-drive it to done.
+            dest_team = dest
+        elif set(team) == set(dest_team):
+            return
+
+        if not dest:
+            async def start(tr):
+                tr.options["access_system_keys"] = True
+                tr.set(
+                    sk.key_servers_key(b),
+                    sk.encode_key_servers(team, dest_team, e),
+                )
+
+            await self.db.run(start)
+
+        await self._wait_fetched(b, e, dest_team, poll_interval, max_polls)
+
+        async def finish(tr):
+            tr.options["access_system_keys"] = True
+            tr.set(sk.key_servers_key(b), sk.encode_key_servers(dest_team, [], e))
+
+        await self.db.run(finish)
+
+    async def _wait_fetched(self, begin: bytes, end: bytes, dest_team: List[str],
+                            poll_interval: float, max_polls: int):
+        req = GetShardStateRequest(begin=begin, end=end)
+        for _ in range(max_polls):
+            states = []
+            for sid in dest_team:
+                iface = self.storages.get(sid)
+                if iface is None:
+                    states.append("unknown")
+                    continue
+                try:
+                    states.append(
+                        await iface.get_shard_state.get_reply(
+                            self.db.process, req
+                        )
+                    )
+                except FdbError:
+                    states.append("unreachable")
+            if all(s in ("fetched", "readable") for s in states):
+                return
+            if "missing" in states:
+                # The destination lost the in-flight move (crash): restart
+                # it by rewriting the startMove record.
+                b2, e2, team, dest = await self._shard_at(begin)
+                if dest:
+                    async def restart(tr, b2=b2, e2=e2, team=team, dest=dest):
+                        tr.options["access_system_keys"] = True
+                        tr.set(
+                            sk.key_servers_key(b2),
+                            sk.encode_key_servers(team, dest, e2),
+                        )
+
+                    await self.db.run(restart)
+            await self.loop.delay(poll_interval)
+        raise TimeoutError(f"shard [{begin!r}, {end!r}) never became fetched")
+
+    async def spread_evenly(self, split_points: Optional[List[bytes]] = None):
+        """Partition the USER keyspace across all registered storages: split
+        at fixed byte boundaries (or given points) and round-robin the
+        shards.  The system keyspace (\xff...) stays on its current owner.
+        The dynamic, byte-sample-driven rebalancer replaces this once
+        storage metrics exist (ref: DataDistributionTracker byte samples)."""
+        ids = sorted(self.storages)
+        if len(ids) < 2:
+            return
+        if split_points is None:
+            n = len(ids)
+            split_points = [bytes([256 * i // n]) for i in range(1, n)]
+        for p in split_points:
+            await self.split(p)
+        await self.split(b"\xff")  # keep the system keyspace its own shard
+        shards = [
+            (b, e, team) for b, e, team, dest in await self.read_shard_map()
+            if not dest and b < b"\xff"
+        ]
+        for i, (b, _e, team) in enumerate(shards):
+            target = [ids[i % len(ids)]]
+            if set(team) != set(target):
+                await self.move(b, target)

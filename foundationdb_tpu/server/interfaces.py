@@ -55,9 +55,34 @@ class GetReadVersionRequest:
 
 
 @dataclass
+class GetKeyServersLocationsRequest:
+    """Key -> storage-team lookup (ref: GetKeyServersLocationsRequest
+    MasterProxyInterface.h:36; served from the proxy's interception of
+    keyServers metadata — the txnStateStore analog)."""
+
+    begin: bytes = b""
+    end: bytes = b"\xff"
+    limit: int = 1000
+
+
+@dataclass
+class GetKeyServersLocationsReply:
+    # (range_begin, range_end_or_None, [StorageInterface]); an empty team
+    # means the range is unsharded (client falls back to its default).
+    results: List[Tuple[bytes, Optional[bytes], list]] = field(
+        default_factory=list
+    )
+
+
+@dataclass
 class ProxyInterface:
     commit: RequestStreamRef = None
     get_consistent_read_version: RequestStreamRef = None
+    get_key_servers_locations: RequestStreamRef = None
+    # Recovery-time injection of the shard map recovered from storage
+    # ownership meta (the txnStateStore-recovery analog); request payload is
+    # ([(begin, end, [ids])], {id: StorageInterface}).
+    load_system_map: RequestStreamRef = None
 
 
 # --- resolver (ref fdbserver/ResolverInterface.h:83-98) ---
@@ -117,6 +142,10 @@ class TLogPopRequest:
 
     version: int = 0  # durable-on-this-consumer; tag's mark rises to it
     tag: str = ""  # consumer identity (storage id); "" = the default tag
+    # True when a storage is removed from the cluster for good (DD exclude):
+    # its tag stops holding the discard floor, so a dead consumer can't
+    # freeze log trimming forever.
+    unregister: bool = False
 
 
 @dataclass
@@ -204,6 +233,16 @@ class GetShardStateRequest:
 
 
 @dataclass
+class GetOwnedMetaRequest:
+    """Recovery-time ownership dump: replies (storage_id, [(b, e)] owned,
+    server_list) once the storage has replayed the log through min_version,
+    so the new proxy's routing map reflects every settled handoff (the
+    txnStateStore-recovery analog)."""
+
+    min_version: int = 0
+
+
+@dataclass
 class StorageInterface:
     storage_id: str = ""
     get_value: RequestStreamRef = None
@@ -212,3 +251,4 @@ class StorageInterface:
     watch_value: RequestStreamRef = None
     fetch_shard: RequestStreamRef = None
     get_shard_state: RequestStreamRef = None
+    get_owned_meta: RequestStreamRef = None

@@ -22,8 +22,10 @@ from ..flow.eventloop import first_of
 from ..flow.knobs import g_knobs
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
+from ..utils import RangeMap
 from .interfaces import (
     GetCommitVersionReply,
+    GetKeyServersLocationsReply,
     ProxyInterface,
     ResolveTransactionBatchRequest,
     ResolverInterface,
@@ -66,6 +68,7 @@ class Proxy:
         epoch: int = 0,
         resolver_split_keys: List[bytes] = None,
         ratekeeper=None,  # RatekeeperInterface or None (no admission control)
+        system_map=None,  # recovered ([(b, e, [ids])], {id: StorageInterface})
     ):
         self.process = process
         self.epoch = epoch
@@ -81,17 +84,86 @@ class Proxy:
         )  # [(lo, hi_or_None)] per resolver
         self.ratekeeper = ratekeeper
         self.committed = NotifiedVersion(epoch_begin_version)
+        # Authoritative key -> storage-team map, maintained by intercepting
+        # keyServers/serverList metadata mutations in the commits this proxy
+        # processes (single-proxy stand-in for the reference's txnStateStore
+        # + ApplyMetadataMutation; ref MasterProxyServer.actor.cpp:185,457).
+        # Values are tuples of storage ids; None = unsharded (no DD yet).
+        self.key_servers = RangeMap(None)
+        self.server_list: dict = {}
+        if system_map is not None:
+            entries, server_list = system_map
+            for b, e, team in entries:
+                self.key_servers.set_range(b, e, tuple(team))
+            self.server_list = dict(server_list)
+        # Metadata applies in version order across overlapped batches (the
+        # prevVersion chain, like the log's).
+        self._meta_version = NotifiedVersion(epoch_begin_version)
         self._commit_stream = RequestStream(process, "commit", well_known=True)
         self._grv_stream = RequestStream(process, "grv", well_known=True)
+        self._loc_stream = RequestStream(
+            process, "get_key_servers_locations", well_known=True
+        )
+        self._load_map_stream = RequestStream(
+            process, "load_system_map", well_known=True
+        )
         self.stats = {"committed": 0, "conflicted": 0, "too_old": 0, "batches": 0}
         process.spawn(self._commit_batcher(), "proxy_batcher")
         process.spawn(self._serve_grv(), "proxy_grv")
+        process.spawn(self._serve_locations(), "proxy_locations")
+        process.spawn(self._serve_load_map(), "proxy_load_map")
 
     def interface(self) -> ProxyInterface:
         return ProxyInterface(
             commit=self._commit_stream.ref(),
             get_consistent_read_version=self._grv_stream.ref(),
+            get_key_servers_locations=self._loc_stream.ref(),
+            load_system_map=self._load_map_stream.ref(),
         )
+
+    async def _serve_load_map(self):
+        """Recovery-time map injection (see ProxyInterface.load_system_map).
+        Safe only before DD resumes writing metadata — the controller loads
+        the map before publishing the cluster to clients."""
+        while True:
+            (entries, server_list), reply = await self._load_map_stream.pop()
+            for b, e, team in entries:
+                self.key_servers.set_range(b, e, tuple(team))
+            self.server_list.update(server_list)
+            reply.send(None)
+
+    # --- key-location service (ref readRequestServer :1045) ---
+    async def _serve_locations(self):
+        while True:
+            req, reply = await self._loc_stream.pop()
+            out = []
+            for b, e, team in self.key_servers.intersecting(req.begin, req.end):
+                ifaces = (
+                    [self.server_list[s] for s in team if s in self.server_list]
+                    if team
+                    else []
+                )
+                out.append((b, e, ifaces))
+                if len(out) >= req.limit:
+                    break
+            reply.send(GetKeyServersLocationsReply(results=out))
+
+    def _intercept_metadata(self, m: Mutation):
+        """ApplyMetadataMutation analog for the proxy's own map."""
+        from .system_keys import parse_metadata_mutation
+
+        parsed = parse_metadata_mutation(m)
+        if parsed is None:
+            return
+        if parsed[0] == "server":
+            _kind, sid, iface = parsed
+            self.server_list[sid] = iface
+        else:
+            _kind, begin, src, dest, end = parsed
+            # Reads route to the data holders: the sources while a move is
+            # in flight (they serve until the settle), the team once settled.
+            # A seed record (empty src) routes to dest — the shard is new.
+            self.key_servers.set_range(begin, end, tuple(src or dest))
 
     # --- GRV (ref transactionStarter :934; single-proxy causal shortcut) ---
     async def _serve_grv(self):
@@ -253,6 +325,20 @@ class Proxy:
                 for tl in self.tlogs
             ]
         )
+
+        # Metadata interception, in version order across overlapped batches
+        # (the prevVersion chain, like the log's; ref applyMetadataMutations
+        # MasterProxyServer.actor.cpp:457).  Runs AFTER the log push so a
+        # batch that dies at the log (commit_unknown_result, nothing reached
+        # storages) cannot leave the routing map pointing at a handoff that
+        # never happened.  Uses the raw transaction mutations: metadata keys
+        # are never versionstamped.
+        await self._meta_version.when_at_least(prev)
+        for (req, _reply), status in zip(batch, statuses):
+            if status == COMMITTED:
+                for m in req.transaction.mutations:
+                    self._intercept_metadata(m)
+        self._meta_version.set(version)
 
         # Phase 5: report + reply (ref :636-677).
         await self.sequencer.report_committed.get_reply(self.process, version)

@@ -239,6 +239,9 @@ class StorageServer:
         self._shard_state_stream = RequestStream(
             process, "get_shard_state", well_known=True
         )
+        self._owned_meta_stream = RequestStream(
+            process, "get_owned_meta", well_known=True
+        )
         # key -> [(watched_value, reply)] parked until the key changes
         self._watches: Dict[bytes, list] = {}
         # Register our pop tag before anything else runs: the log must not
@@ -254,6 +257,7 @@ class StorageServer:
         process.spawn(self._serve_watch_value(), "ss_watch")
         process.spawn(self._serve_fetch_shard(), "ss_fetch")
         process.spawn(self._serve_get_shard_state(), "ss_shard_state")
+        process.spawn(self._serve_get_owned_meta(), "ss_owned_meta")
 
     @classmethod
     async def recover(
@@ -297,6 +301,7 @@ class StorageServer:
             watch_value=self._watch_stream.ref(),
             fetch_shard=self._fetch_stream.ref(),
             get_shard_state=self._shard_state_stream.ref(),
+            get_owned_meta=self._owned_meta_stream.ref(),
         )
 
     # -- watches (ref watchValue_impl storageserver.actor.cpp:760) --
@@ -497,19 +502,17 @@ class StorageServer:
             )
 
     def _apply_metadata(self, m: Mutation, version: int):
-        from . import system_keys as sk
+        from .system_keys import parse_metadata_mutation
 
-        if m.type != MutationType.SET_VALUE:
+        parsed = parse_metadata_mutation(m)
+        if parsed is None:
             return
-        if m.param1.startswith(sk.SERVER_LIST_PREFIX):
-            self.server_list[sk.server_list_id(m.param1)] = (
-                sk.decode_server_entry(m.param2)
-            )
-            self._meta_dirty = True
-        elif m.param1.startswith(sk.KEY_SERVERS_PREFIX):
-            self._meta_dirty = True
-            begin = sk.key_servers_begin(m.param1)
-            src, dest, end = sk.decode_key_servers(m.param2)
+        self._meta_dirty = True
+        if parsed[0] == "server":
+            _kind, sid, iface = parsed
+            self.server_list[sid] = iface
+        else:
+            _kind, begin, src, dest, end = parsed
             if dest:
                 self._start_adding(begin, end, src, dest, version)
             else:
@@ -682,6 +685,23 @@ class StorageServer:
         reply.send(
             FetchShardReply(data=data[:page], version=req.version,
                             more=len(data) > page)
+        )
+
+    async def _serve_get_owned_meta(self):
+        while True:
+            req, reply = await self._owned_meta_stream.pop()
+            self.process.spawn(self._owned_meta_one(req, reply), "ss_om_one")
+
+    async def _owned_meta_one(self, req, reply):
+        # Answer only once the replayed log tail (with any settled handoffs)
+        # is applied, so the recovered routing map is not stale.
+        await self.version.when_at_least(req.min_version)
+        reply.send(
+            (
+                self.storage_id,
+                [(b, e) for b, e, v in self.owned.items() if v],
+                dict(self.server_list),
+            )
         )
 
     async def _serve_get_shard_state(self):

@@ -70,3 +70,24 @@ def encode_server_entry(interface) -> bytes:
 
 def decode_server_entry(value: bytes):
     return pickle.loads(value)
+
+
+def parse_metadata_mutation(m):
+    """Shared ApplyMetadataMutation decoder for every role that watches the
+    stream (proxy + storages must agree on the shard map byte-for-byte).
+
+    Returns None (not metadata), ("server", id, StorageInterface), or
+    ("shard", begin, src, dest, end).  CLEAR_RANGE over metadata keys is
+    deliberately not interpreted: DD only ever overwrites records (clearing
+    one would silently orphan a range — if shard-map compaction ever clears
+    boundary entries, both intercept sites change here together)."""
+    from ..client.types import MutationType
+
+    if m.type != MutationType.SET_VALUE:
+        return None
+    if m.param1.startswith(SERVER_LIST_PREFIX):
+        return ("server", server_list_id(m.param1), decode_server_entry(m.param2))
+    if m.param1.startswith(KEY_SERVERS_PREFIX):
+        src, dest, end = decode_key_servers(m.param2)
+        return ("shard", key_servers_begin(m.param1), src, dest, end)
+    return None

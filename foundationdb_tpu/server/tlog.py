@@ -149,7 +149,12 @@ class TLog:
         while True:
             req, reply = await self._pop_stream.pop()
             tag = req.tag or "_default"
-            if req.version > self.popped_tags.get(tag, -1):
+            if req.unregister:
+                self.popped_tags.pop(tag, None)
+                if not self.popped_tags:
+                    reply.send(None)
+                    continue
+            elif req.version > self.popped_tags.get(tag, -1):
                 self.popped_tags[tag] = req.version
             floor = min(self.popped_tags.values())
             if floor > self.popped:
