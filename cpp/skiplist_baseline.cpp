@@ -1,0 +1,469 @@
+// C++ CPU baseline for the resolver conflict-detection benchmark.
+//
+// The reference measures its ConflictSet with `fdbserver -r skiplisttest`
+// (fdbserver/SkipList.cpp:1412-1502): 500 batches x 2500 transactions, each
+// with 1 read + 1 write conflict range, integer keys uniform in [0, 2e7),
+// range width 1 + U[0,10), read_snapshot = batch index, detect at
+// now = i + 50, window evicted below i.  Building the reference's binary
+// needs its Mono-era actor-compiler toolchain, so this is an independent,
+// from-scratch C++ implementation of the same *semantics* (the in-repo
+// authority is foundationdb_tpu/conflict/engine_cpu.py; differentially
+// tested against it via --selftest) at competitive native performance:
+// a versioned skiplist whose links carry span version-maxima.
+//
+// Data model (same as engine_cpu.py): a step function key -> version of the
+// last committed write covering [key, next_key).  A read [b, e) at snapshot
+// s conflicts iff max version over the covering entries > s.  Committed
+// writes overwrite [b, e) at the batch version; eviction drops a boundary
+// iff it and its predecessor are both below the window.
+//
+// Invariant note: maxv spans may transiently OVER-approximate by versions
+// already below the eviction window (deletions fold the dead node's span
+// max into the predecessor instead of an exact walk).  Safe: every live
+// read snapshot is >= the window floor, so a dead below-window version can
+// never flip a `max > snapshot` comparison.
+//
+// Usage:
+//   skiplist_baseline                  run the microbench, print one JSON line
+//   skiplist_baseline --batches N --per-batch M [--window W]
+//   skiplist_baseline --selftest       read batches on stdin, print decisions
+//
+// Selftest stdin format (ints):
+//   B <now> <new_oldest> <ntxn>
+//   <snap> <nr> <nw> then nr+nw lines "r b e" / "w b e"
+// Output: one line per batch: space-separated statuses
+// (0=conflict, 1=too_old, 2=committed — conflict/types.py codes).
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <cstdlib>
+#include <chrono>
+#include <map>
+#include <vector>
+
+namespace {
+
+constexpr int kMaxH = 16;
+constexpr int64_t kFloorVersion = INT64_MIN / 4;
+constexpr int kConflict = 0, kTooOld = 1, kCommitted = 2;
+
+struct Node {
+  uint64_t key;
+  int64_t vers;  // version of [key, next->key)
+  int h;
+  Node* nxt[kMaxH];
+  int64_t maxv[kMaxH];  // max vers over nodes in (this, nxt[l]]
+};
+
+class Pool {
+ public:
+  Node* alloc() {
+    if (free_) {
+      Node* n = free_;
+      free_ = n->nxt[0];
+      return n;
+    }
+    if (block_used_ == kBlock) {
+      blocks_.push_back(new Node[kBlock]);
+      block_used_ = 0;
+    }
+    return &blocks_.back()[block_used_++];
+  }
+  void release(Node* n) {
+    n->nxt[0] = free_;
+    free_ = n;
+  }
+  ~Pool() {
+    for (Node* b : blocks_) delete[] b;
+  }
+
+ private:
+  static constexpr size_t kBlock = 1 << 14;
+  std::vector<Node*> blocks_;
+  size_t block_used_ = kBlock;
+  Node* free_ = nullptr;
+};
+
+class VersionedSkipList {
+ public:
+  VersionedSkipList() {
+    head_ = pool_.alloc();
+    head_->key = 0;
+    head_->vers = kFloorVersion;
+    head_->h = kMaxH;
+    for (int l = 0; l < kMaxH; l++) {
+      head_->nxt[l] = nullptr;
+      head_->maxv[l] = kFloorVersion;
+    }
+    n_nodes_ = 1;
+  }
+
+  // Max version over step entries covering [b, e); requires b < e.
+  int64_t RangeMax(uint64_t b, uint64_t e) const {
+    const Node* x = head_;
+    for (int l = kMaxH - 1; l >= 0; l--)
+      while (x->nxt[l] && x->nxt[l]->key <= b) x = x->nxt[l];
+    // x = last node with key <= b; accumulate span maxima over nodes < e.
+    int64_t acc = x->vers;
+    int l = x->h - 1;
+    while (l >= 0) {
+      const Node* nx = x->nxt[l];
+      if (nx && nx->key < e) {
+        if (x->maxv[l] > acc) acc = x->maxv[l];
+        x = nx;
+        l = x->h - 1;  // climb as high as the new node allows
+      } else {
+        l--;
+      }
+    }
+    return acc;
+  }
+
+  // Set the step function to `v` on [b, e); b < e.
+  void Overwrite(uint64_t b, uint64_t e, int64_t v) {
+    Node* pred[kMaxH];
+    Node* x = head_;
+    for (int l = kMaxH - 1; l >= 0; l--) {
+      while (x->nxt[l] && x->nxt[l]->key < b) x = x->nxt[l];
+      pred[l] = x;
+    }
+    // Scan the doomed region [b, e) once at level 0, collecting nodes and
+    // the version that resumes at e.
+    Node* y = pred[0]->nxt[0];
+    Node* doomed = nullptr;
+    int n_doomed = 0;
+    int64_t val_before_e = pred[0]->vers;
+    while (y && y->key < e) {
+      val_before_e = y->vers;
+      Node* nx = y->nxt[0];
+      y->nxt[0] = doomed;  // reuse nxt[0] as the doomed-chain link
+      doomed = y;
+      n_doomed++;
+      y = nx;
+    }
+    bool node_at_e = y && y->key == e;
+
+    // Unlink the doomed span at levels >= 1, then level 0 via pred.
+    for (int l = 1; l < kMaxH; l++) {
+      Node* z = pred[l]->nxt[l];
+      while (z && z->key < e) z = z->nxt[l];
+      pred[l]->nxt[l] = z;
+    }
+    pred[0]->nxt[0] = y;
+    n_nodes_ -= n_doomed;
+    while (doomed) {
+      Node* nx = doomed->nxt[0];
+      pool_.release(doomed);
+      doomed = nx;
+    }
+
+    // Boundary at b, and an end boundary at e resuming the old value.
+    InsertAfterPreds(pred, b, v);
+    if (!node_at_e) {
+      Node* pred2[kMaxH];
+      for (int l = 0; l < kMaxH; l++) {
+        Node* p = pred[l];
+        while (p->nxt[l] && p->nxt[l]->key < e) p = p->nxt[l];
+        pred2[l] = p;
+      }
+      InsertAfterPreds(pred2, e, val_before_e);
+    }
+    RecomputePath(pred, e);
+  }
+
+  // Evict boundaries wholly below `oldest`, sweeping at most `budget`
+  // level-0 nodes from a cursor (ref: the amortized removal sweep in
+  // setOldestVersion).  The head boundary is never removed.
+  void EvictBelow(int64_t oldest, int budget) {
+    Node* pred[kMaxH];
+    Node* x = head_;
+    for (int l = kMaxH - 1; l >= 0; l--) {
+      while (x->nxt[l] && x->nxt[l]->key < sweep_key_) x = x->nxt[l];
+      pred[l] = x;
+    }
+    Node* prev = pred[0];
+    Node* cur = prev->nxt[0];
+    while (cur && budget > 0) {
+      budget--;
+      if (cur->vers < oldest && prev->vers < oldest) {
+        for (int l = 0; l < cur->h; l++) {
+          // pred[l] is the last level-l node before cur; absorb cur's span
+          // max (over-approx by a below-window amount; see header note).
+          if (pred[l]->nxt[l] == cur) {
+            pred[l]->nxt[l] = cur->nxt[l];
+            if (cur->maxv[l] > pred[l]->maxv[l])
+              pred[l]->maxv[l] = cur->maxv[l];
+          }
+        }
+        Node* nx = cur->nxt[0];
+        pool_.release(cur);
+        n_nodes_--;
+        cur = nx;
+      } else {
+        for (int l = 0; l < cur->h; l++) pred[l] = cur;
+        prev = cur;
+        cur = cur->nxt[0];
+      }
+    }
+    sweep_key_ = cur ? cur->key : 0;  // wrap at the end
+  }
+
+  size_t node_count() const { return n_nodes_; }
+
+ private:
+  void InsertAfterPreds(Node* pred[kMaxH], uint64_t key, int64_t v) {
+    int h = 1;
+    uint64_t r = NextRand();
+    while (h < kMaxH && (r & 3) == 3) {  // p = 1/4 per extra level
+      r >>= 2;
+      h++;
+    }
+    Node* n = pool_.alloc();
+    n->key = key;
+    n->vers = v;
+    n->h = h;
+    for (int l = 0; l < kMaxH; l++) {
+      n->maxv[l] = kFloorVersion;
+      n->nxt[l] = nullptr;
+    }
+    for (int l = 0; l < h; l++) {
+      n->nxt[l] = pred[l]->nxt[l];
+      pred[l]->nxt[l] = n;
+    }
+    n_nodes_++;
+  }
+
+  // Recompute span maxima, bottom-up, for every node on the predecessor
+  // path plus the nodes inserted in (pred, last_key] — the only spans a
+  // bounded overwrite can change.
+  void RecomputePath(Node* pred[kMaxH], uint64_t last_key) {
+    for (int l = 0; l < kMaxH; l++) {
+      for (Node* x = pred[l]; x && x->key <= last_key; x = x->nxt[l]) {
+        x->maxv[l] = Recompute(x, l);
+        if (x == pred[l] && x->key > last_key) break;
+      }
+      // pred[l] itself always recomputed (the loop starts there; its key
+      // is < b <= last_key except for head wraps, which still enter once).
+    }
+  }
+
+  int64_t Recompute(const Node* x, int l) const {
+    if (l == 0) return x->nxt[0] ? x->nxt[0]->vers : kFloorVersion;
+    int64_t m = kFloorVersion;
+    const Node* end = x->nxt[l];
+    for (const Node* y = x; y != end; y = y->nxt[l - 1]) {
+      if (y->maxv[l - 1] > m) m = y->maxv[l - 1];
+      if (!y->nxt[l - 1]) break;
+    }
+    return m;
+  }
+
+  uint64_t NextRand() {
+    rng_ ^= rng_ << 13;
+    rng_ ^= rng_ >> 7;
+    rng_ ^= rng_ << 17;
+    return rng_;
+  }
+
+  Pool pool_;
+  Node* head_;
+  uint64_t rng_ = 0x9e3779b97f4a7c15ull;
+  uint64_t sweep_key_ = 0;
+  size_t n_nodes_;
+};
+
+// Merged half-open interval set: the intra-batch committed-write
+// accumulator (engine_cpu._IntervalSet semantics).
+class IntervalSet {
+ public:
+  void clear() { m_.clear(); }
+  bool Intersects(uint64_t b, uint64_t e) const {
+    if (b >= e) return false;
+    auto it = m_.upper_bound(b);
+    if (it != m_.begin()) {
+      auto p = std::prev(it);
+      if (p->second > b) return true;
+    }
+    return it != m_.end() && it->first < e;
+  }
+  void Add(uint64_t b, uint64_t e) {
+    if (b >= e) return;
+    auto it = m_.upper_bound(b);
+    if (it != m_.begin()) {
+      auto p = std::prev(it);
+      if (p->second >= b) {
+        b = p->first;
+        if (p->second > e) e = p->second;
+        it = m_.erase(p);
+      }
+    }
+    while (it != m_.end() && it->first <= e) {
+      if (it->second > e) e = it->second;
+      it = m_.erase(it);
+    }
+    m_.emplace(b, e);
+  }
+  template <typename F>
+  void ForEach(F f) const {
+    for (auto& kv : m_) f(kv.first, kv.second);
+  }
+
+ private:
+  std::map<uint64_t, uint64_t> m_;
+};
+
+struct Txn {
+  int64_t snap;
+  std::vector<std::pair<uint64_t, uint64_t>> reads, writes;
+};
+
+class ConflictSet {
+ public:
+  std::vector<int> Detect(const std::vector<Txn>& txns, int64_t now,
+                          int64_t new_oldest) {
+    std::vector<int> st(txns.size(), kCommitted);
+    // Phase 1: too-old + history (ref checkReadConflictRanges).
+    for (size_t t = 0; t < txns.size(); t++) {
+      const Txn& tr = txns[t];
+      if (tr.snap < oldest_ && !tr.reads.empty()) {
+        st[t] = kTooOld;
+        continue;
+      }
+      for (auto& r : tr.reads) {
+        if (r.first < r.second &&
+            list_.RangeMax(r.first, r.second) > tr.snap) {
+          st[t] = kConflict;
+          break;
+        }
+      }
+    }
+    // Phase 2: intra-batch, in order (ref checkIntraBatchConflicts).
+    active_.clear();
+    for (size_t t = 0; t < txns.size(); t++) {
+      if (st[t] != kCommitted) continue;
+      bool hit = false;
+      for (auto& r : txns[t].reads)
+        if (active_.Intersects(r.first, r.second)) {
+          hit = true;
+          break;
+        }
+      if (hit) {
+        st[t] = kConflict;
+        continue;
+      }
+      for (auto& w : txns[t].writes) active_.Add(w.first, w.second);
+    }
+    // Phase 3: merge committed writes at `now` (ref mergeWriteConflictRanges).
+    active_.ForEach(
+        [&](uint64_t b, uint64_t e) { list_.Overwrite(b, e, now); });
+    // Phase 4: window eviction (amortized cursor sweep, ref removeBefore).
+    if (new_oldest > oldest_) {
+      oldest_ = new_oldest;
+      list_.EvictBelow(oldest_, 40000);
+    }
+    return st;
+  }
+
+  size_t node_count() const { return list_.node_count(); }
+
+ private:
+  VersionedSkipList list_;
+  IntervalSet active_;
+  int64_t oldest_ = 0;
+};
+
+uint64_t g_rand = 88172645463325252ull;
+uint64_t Rand() {
+  g_rand ^= g_rand << 13;
+  g_rand ^= g_rand >> 7;
+  g_rand ^= g_rand << 17;
+  return g_rand;
+}
+
+int RunBench(int n_batches, int per_batch, int window) {
+  constexpr uint64_t kKeyspace = 20000000;
+  ConflictSet cs;
+  // Pre-generate all batches (generation excluded from the timed region,
+  // as in bench.py's gen_packed pre-pass).
+  std::vector<std::vector<Txn>> batches(n_batches);
+  for (int i = 0; i < n_batches; i++) {
+    batches[i].resize(per_batch);
+    for (int t = 0; t < per_batch; t++) {
+      Txn& tr = batches[i][t];
+      tr.snap = i;
+      uint64_t rb = Rand() % kKeyspace;
+      tr.reads.push_back({rb, rb + 1 + Rand() % 10});
+      uint64_t wb = Rand() % kKeyspace;
+      tr.writes.push_back({wb, wb + 1 + Rand() % 10});
+    }
+  }
+  int64_t n_committed = 0;
+  auto t0 = std::chrono::steady_clock::now();
+  for (int i = 0; i < n_batches; i++) {
+    auto st = cs.Detect(batches[i], i + window, i);
+    for (int s : st) n_committed += (s == kCommitted);
+  }
+  auto t1 = std::chrono::steady_clock::now();
+  double dt = std::chrono::duration<double>(t1 - t0).count();
+  double rate = (double)n_batches * per_batch / dt;
+  printf(
+      "{\"metric\": \"cpp_skiplist_txns_per_sec\", \"value\": %.1f, "
+      "\"unit\": \"txn/s\", \"batches\": %d, \"per_batch\": %d, "
+      "\"window\": %d, \"committed\": %lld, \"boundaries\": %zu, "
+      "\"seconds\": %.3f}\n",
+      rate, n_batches, per_batch, window, (long long)n_committed,
+      cs.node_count(), dt);
+  return 0;
+}
+
+int RunSelftest() {
+  ConflictSet cs;
+  char tag[8];
+  long long now, old_;
+  int ntxn;
+  while (scanf("%7s %lld %lld %d", tag, &now, &old_, &ntxn) == 4) {
+    std::vector<Txn> txns(ntxn);
+    for (int t = 0; t < ntxn; t++) {
+      long long snap;
+      int nr, nw;
+      if (scanf("%lld %d %d", &snap, &nr, &nw) != 3) return 1;
+      txns[t].snap = snap;
+      for (int k = 0; k < nr + nw; k++) {
+        char rw[4];
+        unsigned long long b, e;
+        if (scanf("%3s %llu %llu", rw, &b, &e) != 3) return 1;
+        if (rw[0] == 'r')
+          txns[t].reads.push_back({b, e});
+        else
+          txns[t].writes.push_back({b, e});
+      }
+    }
+    auto st = cs.Detect(txns, now, old_);
+    for (size_t i = 0; i < st.size(); i++)
+      printf("%d%c", st[i], i + 1 == st.size() ? '\n' : ' ');
+    if (st.empty()) printf("\n");
+    fflush(stdout);
+  }
+  return 0;
+}
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  int n_batches = 500, per_batch = 2500, window = 50;
+  bool selftest = false;
+  for (int i = 1; i < argc; i++) {
+    if (!strcmp(argv[i], "--selftest")) {
+      selftest = true;
+    } else if (!strcmp(argv[i], "--batches") && i + 1 < argc) {
+      n_batches = atoi(argv[++i]);
+    } else if (!strcmp(argv[i], "--per-batch") && i + 1 < argc) {
+      per_batch = atoi(argv[++i]);
+    } else if (!strcmp(argv[i], "--window") && i + 1 < argc) {
+      window = atoi(argv[++i]);
+    }
+  }
+  if (selftest) return RunSelftest();
+  return RunBench(n_batches, per_batch, window);
+}

@@ -200,12 +200,11 @@ def detect_core(
     wr_cap: int,
     h_cap: int,
 ):
-    kw1 = hkeys.shape[1]
+    kw1 = hkeys.shape[0]
     H = h_cap
     TXN, RR, WR = txn_cap, rr_cap, wr_cap
     P = 2 * RR + 2 * WR
     p_log2 = max(1, math.ceil(math.log2(P)))
-    P_pad = 1 << p_log2
 
     r_nonempty = lex_less(r_begin, r_end)
     r_valid = r_txn < TXN
@@ -234,19 +233,21 @@ def detect_core(
             jnp.full((WR,), 1, jnp.uint32),
         ]
     )
-    pkeys = jnp.concatenate([r_begin, r_end, w_begin, w_end], axis=0)
-    packed_tail = pkeys[:, kw1 - 1] * 4 + cat  # (length << 2) | category
+    pkeys = jnp.concatenate([r_begin, r_end, w_begin, w_end], axis=1)
+    packed_tail = pkeys[kw1 - 1] * 4 + cat  # (length << 2) | category
     iota = jnp.arange(P, dtype=jnp.int32)
-    # Sort operands: key words from most significant (index kw1-2; see
-    # keys.py layout) down, then the packed (length,category) word, then the
-    # payload iota; stable for determinism.
-    word_ops = [pkeys[:, w] for w in range(kw1 - 2, -1, -1)]
+    # Sort operands: key words most-significant-first (keys.py layout), then
+    # the packed (length,category) word, then the payload iota; stable for
+    # determinism.
+    word_ops = [pkeys[w] for w in range(kw1 - 1)]
     res = jax.lax.sort(
         tuple(word_ops) + (packed_tail, iota), num_keys=kw1, is_stable=True
     )
     perm = res[-1]
     pos = jnp.zeros((P,), jnp.int32).at[perm].set(iota)
-    sorted_keys = pkeys[perm]
+    # Sorted keys come straight off the sort outputs (no permutation
+    # gather): words, then length recovered from the packed tail.
+    sorted_keys = jnp.stack(list(res[: kw1 - 1]) + [res[kw1 - 1] // 4])
 
     rb_idx = pos[:RR]
     re_idx = pos[RR : 2 * RR]
@@ -316,17 +317,38 @@ def detect_core(
     seg_of_end = jnp.cumsum(is_end) - 1
     nseg = jnp.sum(is_start)
 
-    inf_row = jnp.full((kw1,), keylib.INF_WORD, dtype=jnp.uint32)
-    ub = (
-        jnp.full((WR + 1, kw1), keylib.INF_WORD, dtype=jnp.uint32)
-        .at[jnp.where(is_start, seg_of_start, WR)]
-        .set(jnp.where(is_start[:, None], sorted_keys, inf_row))[:WR]
-    )
-    ue = (
-        jnp.full((WR + 1, kw1), keylib.INF_WORD, dtype=jnp.uint32)
-        .at[jnp.where(is_end, seg_of_end, WR)]
-        .set(jnp.where(is_end[:, None], sorted_keys, inf_row))[:WR]
-    )
+    # Compactions below are SORT-BY-TARGET-POSITION, not scatter: a
+    # single-key int32 sort carrying the payload words runs ~23x faster
+    # than the equivalent scatter on TPU (measured v5e, 8M rows: 54ms vs
+    # 1250ms).  Rows being dropped get a past-the-end position and fall off
+    # the trailing slice; surviving slots beyond the live count are masked
+    # to the INF sentinel afterwards (streaming select).
+    inf32 = jnp.uint32(keylib.INF_WORD)
+
+    def compact_to(pos, valid, words, width, fill_vers=None, vers=None,
+                   count=None):
+        """Reorder columns of `words` [kw1, N] so column i lands at pos[i];
+        invalid columns drop off the end.  Returns [kw1, width] (+vers)."""
+        n = pos.shape[0]
+        dump = jnp.int32(n + width + 2)
+        p = jnp.where(valid, pos.astype(jnp.int32), dump)
+        ops = (p,) + tuple(words[w] for w in range(words.shape[0]))
+        if vers is not None:
+            ops = ops + (vers,)
+        res = jax.lax.sort(ops, num_keys=1, is_stable=True)
+        out = jnp.stack(res[1 : 1 + words.shape[0]])[:, :width]
+        if count is not None:
+            live = jnp.arange(width) < count
+            out = jnp.where(live[None, :], out, inf32)
+            if vers is not None:
+                v = jnp.where(live, res[-1][:width], fill_vers)
+                return out, v
+        if vers is not None:
+            return out, res[-1][:width]
+        return out
+
+    ub = compact_to(seg_of_start, is_start, sorted_keys, WR, count=nseg)
+    ue = compact_to(seg_of_end, is_end, sorted_keys, WR, count=nseg)
     seg_valid = jnp.arange(WR) < nseg
 
     # Merge touching segments (ue[s-1] == ub[s]): the gap between them is a
@@ -335,35 +357,27 @@ def detect_core(
     chain_start = jnp.concatenate(
         [
             jnp.ones((1,), bool),
-            ~jnp.all(ue[:-1] == ub[1:], axis=1),
+            ~jnp.all(ue[:, :-1] == ub[:, 1:], axis=0),
         ]
     ) | ~seg_valid
     chain_id = jnp.cumsum(chain_start) - 1
     is_chain_last = jnp.concatenate([chain_start[1:], jnp.ones((1,), bool)])
-    ub = (
-        jnp.full((WR + 1, kw1), keylib.INF_WORD, jnp.uint32)
-        .at[jnp.where(chain_start & seg_valid, chain_id, WR)]
-        .set(jnp.where((chain_start & seg_valid)[:, None], ub, inf_row))[:WR]
-    )
-    ue = (
-        jnp.full((WR + 1, kw1), keylib.INF_WORD, jnp.uint32)
-        .at[jnp.where(is_chain_last & seg_valid, chain_id, WR)]
-        .set(jnp.where((is_chain_last & seg_valid)[:, None], ue, inf_row))[:WR]
-    )
-    nseg = jnp.sum(chain_start & seg_valid)
+    nseg2 = jnp.sum(chain_start & seg_valid)
+    ub = compact_to(chain_id, chain_start & seg_valid, ub, WR, count=nseg2)
+    ue = compact_to(chain_id, is_chain_last & seg_valid, ue, WR, count=nseg2)
+    nseg = nseg2
     seg_valid = jnp.arange(WR) < nseg
 
     # ---- phase 5: rewrite the step function (ref addConflictRanges) ----
-    iv = searchsorted_words(hkeys, ue, "right") - 1
+    rank_right = searchsorted_words(hkeys, ue, "right")
+    iv = rank_right - 1
     end_val = hvers[jnp.clip(iv, 0, H - 1)]
-    eq_at_ue = (
-        searchsorted_words(hkeys, ue, "right") - searchsorted_words(hkeys, ue, "left")
-    ) > 0
+    eq_at_ue = (rank_right - searchsorted_words(hkeys, ue, "left")) > 0
 
     # new boundary entries, interleaved (ub0, ue0, ub1, ue1, ...)
     n_new_cap = 2 * WR
-    new_keys = jnp.zeros((n_new_cap, kw1), jnp.uint32)
-    new_keys = new_keys.at[0::2].set(ub).at[1::2].set(ue)
+    new_keys = jnp.zeros((kw1, n_new_cap), jnp.uint32)
+    new_keys = new_keys.at[:, 0::2].set(ub).at[:, 1::2].set(ue)
     new_vers = (
         jnp.zeros((n_new_cap,), jnp.int32)
         .at[0::2]
@@ -373,51 +387,71 @@ def detect_core(
     )
     new_vld = jnp.zeros((n_new_cap,), bool)
     new_vld = new_vld.at[0::2].set(seg_valid).at[1::2].set(seg_valid & ~eq_at_ue)
-    nk = jnp.where(new_vld[:, None], new_keys, inf_row)
+    nk = jnp.where(new_vld[None, :], new_keys, inf32)
     nw_iota = jnp.arange(n_new_cap, dtype=jnp.int32)
     nres = jax.lax.sort(
-        tuple(nk[:, w] for w in range(kw1 - 1, -1, -1)) + (nw_iota,),
+        tuple(nk[w] for w in range(kw1)) + (nw_iota,),
         num_keys=kw1,
         is_stable=True,
     )
     nperm = nres[-1]
-    new_keys_s = nk[nperm]
+    new_keys_s = jnp.stack(nres[:kw1])
     new_vers_s = new_vers[nperm]
     nnew = jnp.sum(new_vld)
     new_valid_s = jnp.arange(n_new_cap) < nnew
 
-    # which old boundaries survive (not overwritten by a segment)
+    # Which old boundaries survive (not overwritten by a segment), and where
+    # everything lands in the merged order.  All per-old-row quantities are
+    # derived by RANK INVERSION: search the (few) segment/new keys into the
+    # (huge) history once, then turn the ranks into per-history-row values
+    # with difference arrays + cumsums — pure streaming.  Issuing one query
+    # PER HISTORY ROW into the small tables instead costs H * log(W) random
+    # gathers and dominated the whole batch at h_cap = 8M.
     old_iota = jnp.arange(H, dtype=jnp.int32)
     old_valid = old_iota < hcount
-    si = searchsorted_words(ub, hkeys, "right") - 1
-    in_seg = (si >= 0) & (si < nseg) & lex_less(hkeys, ue[jnp.clip(si, 0, WR - 1)])
+    # in_seg: old key i lies in some segment [ub_s, ue_s).  Mark +1 at the
+    # first old index >= ub_s and -1 at the first >= ue_s; coverage > 0 after
+    # a cumsum (segments are disjoint).
+    seg_lo = searchsorted_words(hkeys, ub, "left")
+    seg_hi = searchsorted_words(hkeys, ue, "left")
+    seg_diff = (
+        jnp.zeros((H + 1,), jnp.int32)
+        .at[jnp.where(seg_valid, seg_lo, H)]
+        .add(jnp.where(seg_valid, 1, 0))
+        .at[jnp.where(seg_valid, seg_hi, H)]
+        .add(jnp.where(seg_valid, -1, 0))
+    )
+    in_seg = jnp.cumsum(seg_diff[:H]) > 0
     keep_old = old_valid & ~in_seg
     kept_rank = jnp.cumsum(keep_old) - 1
     removed_cum = jnp.concatenate(
         [jnp.zeros((1,), jnp.int32), jnp.cumsum((old_valid & in_seg).astype(jnp.int32))]
     )
 
-    count_new_less = searchsorted_words(new_keys_s, hkeys, "left")
+    # count_new_less[i] = #new keys strictly below old key i
+    #                   = #j with (#old <= new_j) <= i, via a rank histogram.
+    t_rank_r = searchsorted_words(hkeys, new_keys_s, "right")
+    new_hist = (
+        jnp.zeros((H + 1,), jnp.int32)
+        .at[jnp.where(new_valid_s, t_rank_r, H)]
+        .add(jnp.where(new_valid_s, 1, 0))
+    )
+    count_new_less = jnp.cumsum(new_hist[:H])
     pos_old = kept_rank.astype(jnp.int32) + count_new_less
     t_rank = searchsorted_words(hkeys, new_keys_s, "left")
     count_kept_less = t_rank - removed_cum[t_rank]
     pos_new = jnp.arange(n_new_cap, dtype=jnp.int32) + count_kept_less
 
-    merged_keys = (
-        jnp.full((H + 1, kw1), keylib.INF_WORD, jnp.uint32)
-        .at[jnp.where(keep_old, pos_old, H)]
-        .set(jnp.where(keep_old[:, None], hkeys, inf_row))
-        .at[jnp.where(new_valid_s, pos_new, H)]
-        .set(jnp.where(new_valid_s[:, None], new_keys_s, inf_row))[:H]
-    )
-    merged_vers = (
-        jnp.full((H + 1,), FLOOR_REL, jnp.int32)
-        .at[jnp.where(keep_old, pos_old, H)]
-        .set(jnp.where(keep_old, hvers, FLOOR_REL))
-        .at[jnp.where(new_valid_s, pos_new, H)]
-        .set(jnp.where(new_valid_s, new_vers_s, FLOOR_REL))[:H]
-    )
     merged_count = jnp.sum(keep_old) + nnew
+    merged_keys, merged_vers = compact_to(
+        jnp.concatenate([pos_old, pos_new]),
+        jnp.concatenate([keep_old, new_valid_s]),
+        jnp.concatenate([hkeys, new_keys_s], axis=1),
+        H,
+        fill_vers=jnp.int32(FLOOR_REL),
+        vers=jnp.concatenate([hvers, new_vers_s]),
+        count=merged_count,
+    )
 
     # ---- phase 6: window eviction (ref removeBefore wasAbove rule) ----
     new_oldest = jnp.maximum(oldest, new_oldest_rel)
@@ -427,17 +461,16 @@ def detect_core(
         (jnp.arange(H) == 0) | (merged_vers >= new_oldest) | (prev_v >= new_oldest)
     )
     rank2 = jnp.cumsum(keep2) - 1
-    out_keys = (
-        jnp.full((H + 1, kw1), keylib.INF_WORD, jnp.uint32)
-        .at[jnp.where(keep2, rank2, H)]
-        .set(jnp.where(keep2[:, None], merged_keys, inf_row))[:H]
-    )
-    out_vers = (
-        jnp.full((H + 1,), FLOOR_REL, jnp.int32)
-        .at[jnp.where(keep2, rank2, H)]
-        .set(jnp.where(keep2, merged_vers, FLOOR_REL))[:H]
-    )
     out_count = jnp.sum(keep2)
+    out_keys, out_vers = compact_to(
+        rank2,
+        keep2,
+        merged_keys,
+        H,
+        fill_vers=jnp.int32(FLOOR_REL),
+        vers=merged_vers,
+        count=out_count,
+    )
 
     # ---- final statuses in the reference's enum ----
     out_status = jnp.where(
@@ -478,6 +511,66 @@ _detect_step = partial(
 )(detect_core)
 
 
+def _blob_offsets(txn_cap: int, rr_cap: int, wr_cap: int, kw1: int):
+    """Field offsets (in uint32 words) of the single-transfer batch blob.
+
+    One contiguous host->device copy per batch instead of ~12: the axon/PCIe
+    path has a large per-transfer fixed cost (measured ~136ms for a dozen
+    small arrays on this host vs ~20ms for one blob)."""
+    sizes = [
+        rr_cap * kw1,  # r_begin
+        rr_cap * kw1,  # r_end
+        wr_cap * kw1,  # w_begin
+        wr_cap * kw1,  # w_end
+        rr_cap,  # r_txn (i32)
+        rr_cap,  # r_snap_rel (i32)
+        wr_cap,  # w_txn (i32)
+        txn_cap,  # t_snap_rel (i32)
+        txn_cap,  # t_flags (bit0 has_reads, bit1 valid)
+        2,  # now_rel, new_oldest_rel (i32)
+    ]
+    offs, o = [], 0
+    for s in sizes:
+        offs.append(o)
+        o += s
+    return offs, o
+
+
+def _blob_core(hkeys, hvers, hcount, oldest, blob, *, txn_cap, rr_cap,
+               wr_cap, h_cap, kw1):
+    offs, _total = _blob_offsets(txn_cap, rr_cap, wr_cap, kw1)
+    as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    # Key fields are packed word-major (kw1, N): see rangequery.py on TPU
+    # minor-dim tiling.
+    r_begin = blob[offs[0] : offs[0] + rr_cap * kw1].reshape(kw1, rr_cap)
+    r_end = blob[offs[1] : offs[1] + rr_cap * kw1].reshape(kw1, rr_cap)
+    w_begin = blob[offs[2] : offs[2] + wr_cap * kw1].reshape(kw1, wr_cap)
+    w_end = blob[offs[3] : offs[3] + wr_cap * kw1].reshape(kw1, wr_cap)
+    r_txn = as_i32(blob[offs[4] : offs[4] + rr_cap])
+    r_snap = as_i32(blob[offs[5] : offs[5] + rr_cap])
+    w_txn = as_i32(blob[offs[6] : offs[6] + wr_cap])
+    t_snap = as_i32(blob[offs[7] : offs[7] + txn_cap])
+    t_flags = blob[offs[8] : offs[8] + txn_cap]
+    t_has_reads = (t_flags & 1) > 0
+    t_valid = (t_flags & 2) > 0
+    scalars = as_i32(blob[offs[9] : offs[9] + 2])
+    return detect_core(
+        hkeys, hvers, hcount, oldest,
+        r_begin, r_end, r_txn, r_snap,
+        w_begin, w_end, w_txn,
+        t_snap, t_has_reads, t_valid,
+        scalars[0], scalars[1],
+        txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap, h_cap=h_cap,
+    )
+
+
+_blob_step = partial(
+    jax.jit,
+    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "kw1"),
+    donate_argnames=("hkeys", "hvers", "hcount", "oldest"),
+)(_blob_core)
+
+
 class JaxConflictSet:
     """Host wrapper owning the device-resident history state."""
 
@@ -504,14 +597,19 @@ class JaxConflictSet:
     # -- state management --
     def _init_state(self, oldest_rel: int):
         kw1 = self.key_words + 1
-        hkeys = np.full((self.h_cap, kw1), keylib.INF_WORD, np.uint32)
-        hkeys[0] = 0  # b"" floor boundary
-        hkeys[0, self.key_words] = 0
+        # Word-major (kw1, H): see rangequery.py on TPU minor-dim tiling.
+        hkeys = np.full((kw1, self.h_cap), keylib.INF_WORD, np.uint32)
+        hkeys[:, 0] = 0  # b"" floor boundary
         hvers = np.full((self.h_cap,), FLOOR_REL, np.int32)
         self._hkeys = jnp.asarray(hkeys)
         self._hvers = jnp.asarray(hvers)
         self._hcount = jnp.asarray(1, jnp.int32)
         self._oldest = jnp.asarray(oldest_rel, jnp.int32)
+        # Host-side UPPER BOUND on the boundary count (each batch adds at
+        # most 2*wr_cap).  Growth checks use the bound so dispatch_packed
+        # never blocks on the in-flight batch's real count; the true value
+        # is synced only when the bound approaches capacity.
+        self._hcount_bound = 1
 
     @property
     def oldest_version(self) -> int:
@@ -535,14 +633,20 @@ class JaxConflictSet:
                 self._hvers = jnp.maximum(self._hvers - d, FLOOR_REL)
                 self._oldest = self._oldest - d
                 self._base += d
-        if int(self._hcount) + 2 * wr_cap + 2 > self.h_cap:
-            self._grow(max(self.h_cap * 2, self.h_cap + 4 * wr_cap))
+        if self._hcount_bound + 2 * wr_cap + 2 > self.h_cap:
+            # Bound exhausted: sync the true count once (this is the only
+            # device round-trip on the dispatch path) and grow if the REAL
+            # count is near capacity.
+            self._hcount_bound = int(self._hcount)
+            if self._hcount_bound + 2 * wr_cap + 2 > self.h_cap:
+                self._grow(max(self.h_cap * 2, self.h_cap + 4 * wr_cap))
 
     def _grow(self, new_cap: int):
         kw1 = self.key_words + 1
         pad = new_cap - self.h_cap
         self._hkeys = jnp.concatenate(
-            [self._hkeys, jnp.full((pad, kw1), keylib.INF_WORD, jnp.uint32)]
+            [self._hkeys, jnp.full((kw1, pad), keylib.INF_WORD, jnp.uint32)],
+            axis=1,
         )
         self._hvers = jnp.concatenate(
             [self._hvers, jnp.full((pad,), FLOOR_REL, jnp.int32)]
@@ -563,9 +667,9 @@ class JaxConflictSet:
         statuses = self.detect_packed(pb, now, new_oldest_version)
         return [int(s) for s in statuses[: len(transactions)]]
 
-    def detect_packed(self, pb: PackedBatch, now: int, new_oldest_version: int):
-        """Run one packed batch; returns numpy statuses [txn_cap]."""
-        self._maybe_grow_or_rebase(now, pb.wr_cap)
+    def _pack_blob(self, pb: PackedBatch, now: int, new_oldest_version: int):
+        """Single contiguous uint32 blob for one-copy dispatch (see
+        _blob_offsets)."""
         rel = self._rel
         r_snap = np.clip(
             pb.r_snap - self._base, FLOOR_REL + 1, 2**31 - 2
@@ -573,6 +677,33 @@ class JaxConflictSet:
         t_snap = np.clip(
             pb.t_snap - self._base, FLOOR_REL + 1, 2**31 - 2
         ).astype(np.int32)
+        t_flags = pb.t_has_reads.astype(np.uint32) | (
+            pb.t_valid.astype(np.uint32) << 1
+        )
+        return np.concatenate(
+            [
+                np.ascontiguousarray(pb.r_begin.T).reshape(-1),
+                np.ascontiguousarray(pb.r_end.T).reshape(-1),
+                np.ascontiguousarray(pb.w_begin.T).reshape(-1),
+                np.ascontiguousarray(pb.w_end.T).reshape(-1),
+                pb.r_txn.view(np.uint32),
+                r_snap.view(np.uint32),
+                pb.w_txn.view(np.uint32),
+                t_snap.view(np.uint32),
+                t_flags,
+                np.array(
+                    [rel(now), rel(new_oldest_version)], np.int32
+                ).view(np.uint32),
+            ]
+        )
+
+    def dispatch_packed(self, pb: PackedBatch, now: int, new_oldest_version: int):
+        """Asynchronously dispatch one batch; returns (statuses_dev,
+        undecided_dev) WITHOUT syncing, so callers can pipeline host packing
+        and transfer of batch N+1 under device compute of batch N.  The
+        caller must eventually check undecided (see detect_packed)."""
+        self._maybe_grow_or_rebase(now, pb.wr_cap)
+        blob = self._pack_blob(pb, now, new_oldest_version)
         (
             self._hkeys,
             self._hvers,
@@ -581,29 +712,28 @@ class JaxConflictSet:
             statuses,
             undecided,
             iters,
-        ) = _detect_step(
+        ) = _blob_step(
             self._hkeys,
             self._hvers,
             self._hcount,
             self._oldest,
-            jnp.asarray(pb.r_begin),
-            jnp.asarray(pb.r_end),
-            jnp.asarray(pb.r_txn),
-            jnp.asarray(r_snap),
-            jnp.asarray(pb.w_begin),
-            jnp.asarray(pb.w_end),
-            jnp.asarray(pb.w_txn),
-            jnp.asarray(t_snap),
-            jnp.asarray(pb.t_has_reads),
-            jnp.asarray(pb.t_valid),
-            jnp.asarray(rel(now), dtype=jnp.int32),
-            jnp.asarray(rel(new_oldest_version), dtype=jnp.int32),
+            jnp.asarray(blob),
             txn_cap=pb.txn_cap,
             rr_cap=pb.rr_cap,
             wr_cap=pb.wr_cap,
             h_cap=self.h_cap,
+            kw1=self.key_words + 1,
         )
-        self.last_iters = int(iters)
+        self._last_iters_dev = iters
+        self._hcount_bound = min(
+            self._hcount_bound + 2 * pb.wr_cap, self.h_cap
+        )
+        return statuses, undecided
+
+    def detect_packed(self, pb: PackedBatch, now: int, new_oldest_version: int):
+        """Run one packed batch; returns numpy statuses [txn_cap]."""
+        statuses, undecided = self.dispatch_packed(pb, now, new_oldest_version)
+        self.last_iters = int(self._last_iters_dev)
         if int(undecided) != 0:
             # detect_core left the history state untouched in this case;
             # resolve the batch on the CPU engine against pristine state and
@@ -639,8 +769,8 @@ class JaxConflictSet:
             self._grow(_next_pow2(n + 8, self.h_cap * 2))
         self._base = cpu.oldest_version
         kw1 = self.key_words + 1
-        hkeys = np.full((self.h_cap, kw1), keylib.INF_WORD, np.uint32)
-        hkeys[:n] = keylib.encode_keys(cpu.keys, self.key_words)
+        hkeys = np.full((kw1, self.h_cap), keylib.INF_WORD, np.uint32)
+        hkeys[:, :n] = keylib.encode_keys(cpu.keys, self.key_words).T
         hvers = np.full((self.h_cap,), FLOOR_REL, np.int32)
         rel = np.clip(
             np.array(cpu.vers, dtype=np.int64) - self._base, FLOOR_REL, 2**31 - 2
@@ -651,13 +781,14 @@ class JaxConflictSet:
         self._hvers = jnp.asarray(hvers)
         self._hcount = jnp.asarray(n, jnp.int32)
         self._oldest = jnp.asarray(0, jnp.int32)
+        self._hcount_bound = n
 
     def store_to(self, cpu) -> None:
         """Write device state back into the CPU engine."""
         from .engine_cpu import FLOOR_VERSION
 
         n = int(self._hcount)
-        hkeys = np.asarray(self._hkeys[:n])
+        hkeys = np.asarray(self._hkeys[:, :n]).T
         hvers = np.asarray(self._hvers[:n])
         cpu.keys = [keylib.decode_key(hkeys[i], self.key_words) for i in range(n)]
         cpu.vers = [

@@ -1,16 +1,23 @@
 """Key digitization: byte-string keys -> fixed-width uint32 word vectors.
 
 A key of <= 4*KW bytes becomes KW big-endian uint32 words (zero padded) plus
-a length word; lexicographic order on (words..., length) equals bytewise
-order on the original keys (zero-padded prefixes compare equal on words, and
-the genuinely shorter key sorts first via the length word — matching e.g.
-b"a" < b"a\\x00").  Keys longer than 4*KW bytes cannot be represented
-exactly; the hybrid ConflictSet routes batches containing them to the CPU
-engine (SURVEY.md §7 hard-parts list: fixed-width digitization + fallback).
+a length word; lexicographic order on (words msw-first..., length) equals
+bytewise order on the original keys (zero-padded prefixes compare equal on
+words, and the genuinely shorter key sorts first via the length word —
+matching e.g. b"a" < b"a\\x00").  Keys longer than 4*KW bytes cannot be
+represented exactly; the hybrid ConflictSet routes batches containing them
+to the CPU engine (SURVEY.md §7 hard-parts list: fixed-width digitization +
+fallback).
 
-Word layout note: comparisons treat index 0 as most significant (see
-ops.rangequery.lex_less iterating from the LAST axis backward => we store
-words most-significant-last to match).
+Word layout: index 0 is the MOST significant word; the length word is last
+(the least significant tie-break).  ops.rangequery.lex_less processes the
+trailing index first, giving index 0 the highest priority — one convention
+shared by comparisons, sorts, and searches.
+
+Host arrays are row-major [N, key_words+1]; the device engine transposes to
+word-major [key_words+1, N] at dispatch (TPU tiling pads the minor
+dimension to 128 lanes, so (N, 3) arrays would occupy ~43x their size and
+turn every row gather into a 512-byte fetch).
 """
 
 from __future__ import annotations
@@ -25,7 +32,7 @@ INF_WORD = np.uint32(0xFFFFFFFF)
 
 
 def encode_keys(keys: Sequence[bytes], key_words: int) -> np.ndarray:
-    """[N, key_words+1] uint32; words most-significant-LAST, length last."""
+    """[N, key_words+1] uint32; words most-significant-FIRST, length last."""
     width = key_words * 4
     n = len(keys)
     out = np.zeros((n, key_words + 1), dtype=np.uint32)
@@ -38,8 +45,7 @@ def encode_keys(keys: Sequence[bytes], key_words: int) -> np.ndarray:
         )
     joined = b"".join(k.ljust(width, b"\x00") for k in keys)
     words = np.frombuffer(joined, dtype=">u4").reshape(n, key_words).astype(np.uint32)
-    # reverse so index 0 is least significant (lex_less scans last-to-first)
-    out[:, :key_words] = words[:, ::-1]
+    out[:, :key_words] = words
     out[:, key_words] = np.fromiter((len(k) for k in keys), np.uint32, count=n)
     return out
 
@@ -58,9 +64,9 @@ def encode_int_keys(ints: np.ndarray, key_words: int, byte_len: int = 8) -> np.n
     shifted = v << np.uint64(8 * (8 - byte_len))  # left-align in 8 bytes
     hi = (shifted >> np.uint64(32)).astype(np.uint32)
     lo = (shifted & np.uint64(0xFFFFFFFF)).astype(np.uint32)
-    out[:, key_words - 1] = hi
+    out[:, 0] = hi
     if key_words >= 2:
-        out[:, key_words - 2] = lo
+        out[:, 1] = lo
     out[:, key_words] = byte_len
     return out
 
@@ -69,7 +75,7 @@ def decode_key(row: np.ndarray, key_words: int) -> bytes:
     length = int(row[key_words])
     if length == int(INF_WORD):
         return b"\xff" * (key_words * 4 + 1)  # sentinel, cannot round-trip
-    words = row[:key_words][::-1].astype(">u4")
+    words = row[:key_words].astype(">u4")
     return words.tobytes()[:length]
 
 

@@ -55,14 +55,14 @@ AXIS = "resolvers"
 
 
 def _lex_max(a: jnp.ndarray, bound: jnp.ndarray) -> jnp.ndarray:
-    """Row-wise max(a, bound); a [N, W], bound [W]."""
-    b = jnp.broadcast_to(bound, a.shape)
-    return jnp.where(lex_less(a, b)[..., None], b, a)
+    """Column-wise max(a, bound); a [W, N] word-major, bound [W]."""
+    b = jnp.broadcast_to(bound[:, None], a.shape)
+    return jnp.where(lex_less(a, b)[None, :], b, a)
 
 
 def _lex_min(a: jnp.ndarray, bound: jnp.ndarray) -> jnp.ndarray:
-    b = jnp.broadcast_to(bound, a.shape)
-    return jnp.where(lex_less(b, a)[..., None], b, a)
+    b = jnp.broadcast_to(bound[:, None], a.shape)
+    return jnp.where(lex_less(b, a)[None, :], b, a)
 
 
 def _shard_body(
@@ -260,8 +260,10 @@ class ShardedJaxConflictSet:
     # -- state management (mirrors JaxConflictSet, with a leading shard axis) --
     def _init_state(self, oldest_rel: int):
         S, kw1 = self.n_shards, self.key_words + 1
-        hkeys = np.full((S, self.h_cap, kw1), keylib.INF_WORD, np.uint32)
-        hkeys[:, 0, :] = 0  # b"" floor boundary per shard
+        # Word-major per shard: (S, kw1, H) — see ops/rangequery.py on TPU
+        # minor-dim tiling.
+        hkeys = np.full((S, kw1, self.h_cap), keylib.INF_WORD, np.uint32)
+        hkeys[:, :, 0] = 0  # b"" floor boundary per shard
         hvers = np.full((S, self.h_cap), FLOOR_REL, np.int32)
         put = partial(jax.device_put, device=self._shardspec)
         self._hkeys = put(jnp.asarray(hkeys))
@@ -297,8 +299,8 @@ class ShardedJaxConflictSet:
         put = partial(jax.device_put, device=self._shardspec)
         self._hkeys = put(
             jnp.concatenate(
-                [self._hkeys, jnp.full((S, pad, kw1), keylib.INF_WORD, jnp.uint32)],
-                axis=1,
+                [self._hkeys, jnp.full((S, kw1, pad), keylib.INF_WORD, jnp.uint32)],
+                axis=2,
             )
         )
         self._hvers = put(
@@ -350,12 +352,12 @@ class ShardedJaxConflictSet:
             self._hvers,
             self._hcount,
             self._oldest,
-            jnp.asarray(pb.r_begin),
-            jnp.asarray(pb.r_end),
+            jnp.asarray(np.ascontiguousarray(pb.r_begin.T)),
+            jnp.asarray(np.ascontiguousarray(pb.r_end.T)),
             jnp.asarray(pb.r_txn),
             jnp.asarray(clip(pb.r_snap).astype(np.int32)),
-            jnp.asarray(pb.w_begin),
-            jnp.asarray(pb.w_end),
+            jnp.asarray(np.ascontiguousarray(pb.w_begin.T)),
+            jnp.asarray(np.ascontiguousarray(pb.w_end.T)),
             jnp.asarray(pb.w_txn),
             jnp.asarray(clip(pb.t_snap).astype(np.int32)),
             jnp.asarray(pb.t_valid),
@@ -427,8 +429,9 @@ class ShardedJaxConflictSet:
         for s in range(self.n_shards):
             eng = CpuConflictSet(int(oldest[s]) + self._base)
             n = int(counts[s])
+            rows = hkeys[s, :, :n].T
             eng.keys = [
-                keylib.decode_key(hkeys[s, i], self.key_words) for i in range(n)
+                keylib.decode_key(rows[i], self.key_words) for i in range(n)
             ]
             eng.vers = [
                 FLOOR_VERSION if int(v) == FLOOR_REL else int(v) + self._base
@@ -444,13 +447,13 @@ class ShardedJaxConflictSet:
         need = max(len(e.keys) for e in engines) + 2
         if need + 8 > self.h_cap:
             self._grow(_next_pow2(need + 8, self.h_cap * 2))
-        hkeys = np.full((S, self.h_cap, kw1), keylib.INF_WORD, np.uint32)
+        hkeys = np.full((S, kw1, self.h_cap), keylib.INF_WORD, np.uint32)
         hvers = np.full((S, self.h_cap), FLOOR_REL, np.int32)
         counts = np.zeros((S,), np.int32)
         oldest = np.zeros((S,), np.int32)
         for s, eng in enumerate(engines):
             n = len(eng.keys)
-            hkeys[s, :n] = keylib.encode_keys(eng.keys, self.key_words)
+            hkeys[s, :, :n] = keylib.encode_keys(eng.keys, self.key_words).T
             hvers[s, :n] = [
                 FLOOR_REL
                 if v == FLOOR_VERSION
@@ -489,7 +492,8 @@ class ShardedJaxConflictSet:
         vers: list = []
         for s in range(self.n_shards):
             n = int(counts[s])
-            sk = [keylib.decode_key(hkeys[s, i], self.key_words) for i in range(n)]
+            rows = hkeys[s, :, :n].T
+            sk = [keylib.decode_key(rows[i], self.key_words) for i in range(n)]
             sv = hvers[s, :n]
             lo_key = b"" if s == 0 else self.split_keys[s - 1]
             hi_key = None if s == self.n_shards - 1 else self.split_keys[s]
@@ -528,12 +532,12 @@ class ShardedJaxConflictSet:
             need = max(need, len(sk) + 2)
         if need + 8 > self.h_cap:
             self._grow(_next_pow2(need + 8, self.h_cap * 2))
-        hkeys = np.full((S, self.h_cap, kw1), keylib.INF_WORD, np.uint32)
+        hkeys = np.full((S, kw1, self.h_cap), keylib.INF_WORD, np.uint32)
         hvers = np.full((S, self.h_cap), FLOOR_REL, np.int32)
         counts = np.zeros((S,), np.int32)
         for s, (sk, sv) in enumerate(per_shard):
             n = len(sk)
-            hkeys[s, :n] = keylib.encode_keys(sk, self.key_words)
+            hkeys[s, :, :n] = keylib.encode_keys(sk, self.key_words).T
             rel = np.array(
                 [
                     FLOOR_REL

@@ -328,3 +328,36 @@ def test_hybrid_authority_hysteresis():
         assert transfers["store"] == 0, transfers  # never thrashes back
     finally:
         g_knobs.server.conflict_device_min_batch = old_min
+
+
+def test_multiword_key_ordering_differential():
+    """Keys differing in BOTH 4-byte words: the word-significance convention
+    must agree between encode, lex compare, search, and the point sort
+    (regression: lex_less once treated the least significant word as most
+    significant, masked because earlier tests never exercised multiword
+    divergence)."""
+    from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+    from foundationdb_tpu.conflict.engine_jax import JaxConflictSet
+    from foundationdb_tpu.conflict.types import TransactionConflictInfo as T
+
+    k1 = (0x00000001_00000002).to_bytes(8, "big")
+    k2 = (0x00000002_00000001).to_bytes(8, "big")
+    k3 = (0x00000001_00000003).to_bytes(8, "big")
+    cpu, dev = CpuConflictSet(), JaxConflictSet(key_words=3, h_cap=64)
+    up = lambda k: k + b"\x00"
+    write_k1 = [T(read_snapshot=0, read_ranges=[], write_ranges=[(k1, up(k1))])]
+    for eng in (cpu, dev):
+        eng.detect(write_k1, now=1, new_oldest_version=0)
+    probes = [
+        T(read_snapshot=0, read_ranges=[(k1, up(k1))], write_ranges=[]),
+        T(read_snapshot=0, read_ranges=[(k2, up(k2))], write_ranges=[]),
+        T(read_snapshot=0, read_ranges=[(k3, up(k3))], write_ranges=[]),
+        T(read_snapshot=0, read_ranges=[(k1, k2)], write_ranges=[]),
+    ]
+    got_cpu = cpu.detect(probes, now=2, new_oldest_version=0)
+    got_dev = dev.detect(probes, now=2, new_oldest_version=0)
+    assert got_cpu == got_dev
+    # k1 was written at v1 > snapshot 0 -> conflict; k2/k3 untouched.
+    from foundationdb_tpu.conflict.types import COMMITTED, CONFLICT
+
+    assert got_cpu == [CONFLICT, COMMITTED, COMMITTED, CONFLICT]

@@ -1,0 +1,106 @@
+"""Differential test: the C++ skiplist baseline must make byte-identical
+decisions with the in-repo authority (engine_cpu.CpuConflictSet) on random
+batch streams — same discipline as the JAX-vs-CPU differential suite.
+
+Ref: the baseline mirrors fdbserver skipListTest semantics
+(SkipList.cpp:1412-1502); cpp/skiplist_baseline.cpp --selftest speaks a
+line protocol over stdin/stdout.
+"""
+
+import os
+import subprocess
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SRC = os.path.join(REPO, "cpp", "skiplist_baseline.cpp")
+BIN = os.path.join(REPO, "cpp", "skiplist_baseline")
+
+
+def build():
+    if os.path.exists(BIN) and os.path.getmtime(BIN) >= os.path.getmtime(SRC):
+        return
+    subprocess.run(
+        ["g++", "-O2", "-o", BIN, SRC], check=True, capture_output=True
+    )
+
+
+def int_key(v: int) -> bytes:
+    return int(v).to_bytes(4, "big")
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3])
+def test_cpp_baseline_differential(seed):
+    from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+    from foundationdb_tpu.conflict.types import TransactionConflictInfo
+
+    build()
+    rng = np.random.default_rng(seed)
+    KEYSPACE = 5000  # small keyspace => dense collisions
+    WINDOW = 4
+    n_batches = 30
+    lines = []
+    py_batches = []
+    for i in range(n_batches):
+        ntxn = int(rng.integers(1, 20))
+        lines.append(f"B {i + WINDOW} {i} {ntxn}")
+        txns = []
+        for _t in range(ntxn):
+            nr = int(rng.integers(0, 3))
+            nw = int(rng.integers(0, 3))
+            # snapshots sometimes stale enough to be too old / conflicting
+            snap = int(max(0, i - rng.integers(0, WINDOW + 3)))
+            lines.append(f"{snap} {nr} {nw}")
+            rr, wr = [], []
+            for _ in range(nr):
+                b = int(rng.integers(0, KEYSPACE))
+                e = b + 1 + int(rng.integers(0, 12))
+                lines.append(f"r {b} {e}")
+                rr.append((int_key(b), int_key(e)))
+            for _ in range(nw):
+                b = int(rng.integers(0, KEYSPACE))
+                e = b + 1 + int(rng.integers(0, 12))
+                lines.append(f"w {b} {e}")
+                wr.append((int_key(b), int_key(e)))
+            txns.append(
+                TransactionConflictInfo(
+                    read_snapshot=snap, read_ranges=rr, write_ranges=wr
+                )
+            )
+        py_batches.append(txns)
+
+    proc = subprocess.run(
+        [BIN, "--selftest"],
+        input="\n".join(lines) + "\n",
+        capture_output=True,
+        text=True,
+        check=True,
+        timeout=60,
+    )
+    cpp_out = [
+        [int(x) for x in line.split()]
+        for line in proc.stdout.strip().split("\n")
+    ]
+
+    cs = CpuConflictSet()
+    for i, txns in enumerate(py_batches):
+        want = cs.detect(txns, now=i + WINDOW, new_oldest_version=i)
+        assert cpp_out[i] == want, (
+            f"seed {seed} batch {i}: cpp={cpp_out[i]} py={want}"
+        )
+
+
+def test_cpp_baseline_bench_runs():
+    build()
+    out = subprocess.run(
+        [BIN, "--batches", "10", "--per-batch", "500"],
+        capture_output=True,
+        text=True,
+        check=True,
+        timeout=60,
+    ).stdout
+    import json
+
+    doc = json.loads(out)
+    assert doc["value"] > 0
