@@ -110,11 +110,13 @@ class Database:
                 self._loc_cache.set_range(b, e, tuple(ifaces))
         return list(self._loc_cache.intersecting(begin, end))
 
-    async def storage_for_key(self, key: bytes) -> StorageInterface:
+    async def storage_for_key(self, key: bytes, attempt: int = 0) -> StorageInterface:
+        """Replica for a read; successive attempts rotate through the team
+        (the minimal loadBalance, ref fdbrpc/LoadBalance.actor.h:159)."""
         locs = await self.get_locations(key, key_after(key))
         _b, _e, team = locs[0]
         if team:
-            return team[0]  # loadBalance across replicas arrives with repl>1
+            return team[attempt % len(team)]
         return self.storage
 
     @property
@@ -207,8 +209,8 @@ class Transaction:
         getValue's wrong_shard_server handling, NativeAPI.actor.cpp:1164)."""
         loop = self.db.process.network.loop
         last = FdbError("broken_promise")
-        for _ in range(MAX_REROUTE_ATTEMPTS):
-            iface = await self.db.storage_for_key(key)
+        for attempt in range(MAX_REROUTE_ATTEMPTS):
+            iface = await self.db.storage_for_key(key, attempt)
             try:
                 return await iface.get_value.get_reply(
                     self.db.process, GetValueRequest(key=key, version=version)
@@ -217,6 +219,9 @@ class Transaction:
                 if e.name not in ("wrong_shard_server", "broken_promise"):
                     raise
                 last = e
+                # Invalidate on broken_promise too: if the WHOLE cached team
+                # is dead (healed away), only a location refetch recovers
+                # (ref: re-resolving on all_alternatives_failed).
                 self.db.invalidate_location(key)
                 await loop.delay(REROUTE_DELAY)
         raise last
@@ -256,7 +261,7 @@ class Transaction:
                 _b, e, team = locs[0]
                 req_lo = lo
                 req_hi = hi if e is None else min(e, hi)
-            iface = team[0] if team else self.db.storage
+            iface = team[misroutes % len(team)] if team else self.db.storage
             try:
                 reply = await iface.get_key_values.get_reply(
                     self.db.process,

@@ -80,6 +80,10 @@ class ServerKnobs(Knobs):
         self._init("conflict_history_capacity", 1 << 20)
         self._init("max_watches", 10000)  # ref: MAX_STORAGE_SERVER_WATCHES
         self._init("fetch_shard_page_rows", 5000)  # ref: FETCH_BLOCK_BYTES analog
+        # Replication (ref: DatabaseConfiguration tLogReplicationFactor /
+        # storageTeamSize; clamped to the available process count)
+        self._init("log_replication_factor", 2)
+        self._init("storage_team_size", 2)
         # Ratekeeper (ref: Ratekeeper.actor.cpp knobs, distilled)
         self._init("ratekeeper_max_tps", 100000.0)
         self._init("ratekeeper_min_tps", 10.0)

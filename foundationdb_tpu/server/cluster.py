@@ -35,6 +35,7 @@ class SimCluster:
         durable: bool = False,
         n_resolvers: int = 1,
         n_storages: int = 1,
+        n_tlogs: int = 1,
     ):
         self.loop = loop or EventLoop(seed=seed)
         set_event_loop(self.loop)
@@ -49,7 +50,11 @@ class SimCluster:
             for i in range(n_resolvers)
         ]
         self.resolver_proc = self.resolver_procs[0]
-        self.tlog_proc = self.net.process("tlog")
+        self.tlog_procs = [
+            self.net.process(f"tlog{i}" if i else "tlog")
+            for i in range(n_tlogs)
+        ]
+        self.tlog_proc = self.tlog_procs[0]
         self.storage_procs = [
             self.net.process(f"storage{i}" if i else "storage")
             for i in range(n_storages)
@@ -64,6 +69,7 @@ class SimCluster:
 
             assert n_resolvers == 1, "durable multi-resolver: use DynamicCluster"
             assert n_storages == 1, "durable multi-storage: use DynamicCluster"
+            assert n_tlogs == 1, "durable multi-tlog: use DynamicCluster"
             self.fs = SimFileSystem(self.net)
             self._start_roles_durable(epoch_begin=0)
         else:
@@ -77,13 +83,15 @@ class SimCluster:
                 for i, p in enumerate(self.resolver_procs)
             ]
             self.resolver = self.resolvers[0]
-            self.tlog = TLog(self.tlog_proc)
+            self.tlogs = [TLog(p) for p in self.tlog_procs]
+            self.tlog = self.tlogs[0]
+            tlog_ifaces = [t.interface() for t in self.tlogs]
             # Storage 0 owns everything at bootstrap (including the \xff
             # system keyspace); DD redistributes from there.
             self.storages = [
                 StorageServer(
                     p,
-                    self.tlog.interface(),
+                    tlog_ifaces,
                     storage_id=f"ss{i}",
                     owned_all=(i == 0),
                 )
@@ -94,7 +102,7 @@ class SimCluster:
                 self.proxy_proc,
                 self.sequencer.interface(),
                 [r.interface() for r in self.resolvers],
-                [self.tlog.interface()],
+                tlog_ifaces,
                 resolver_split_keys=self.split_keys,
             )
 
@@ -118,6 +126,7 @@ class SimCluster:
             self.tlog = await TLog.recover(
                 self.tlog_proc, self.fs, "tlog.dq", fast_forward_to=epoch_begin
             )
+            self.tlogs = [self.tlog]
             self.storage = await StorageServer.recover(
                 self.storage_proc, self.tlog.interface(), self.fs, "storage.dq"
             )

@@ -119,13 +119,14 @@ class DataDistributor:
         (ref: startMoveKeys / waitForShardReady / finishMoveKeys,
         MoveKeys.actor.cpp)."""
         b, e, team, dest = await self._shard_at(begin)
-        if dest:
-            # A previous move is recorded in flight; re-drive it to done.
-            dest_team = dest
-        elif set(team) == set(dest_team):
+        if dest and set(dest) == set(dest_team):
+            pass  # same move already in flight; re-drive it to done
+        elif not dest and set(team) == set(dest_team):
             return
-
-        if not dest:
+        else:
+            # Fresh move, or superseding an in-flight move whose destination
+            # changed (e.g. heal() retargeting after a dest died): rewrite
+            # the start record; destinations cancel stale AddingShards.
             async def start(tr):
                 tr.options["access_system_keys"] = True
                 tr.set(
@@ -179,15 +180,19 @@ class DataDistributor:
             await self.loop.delay(poll_interval)
         raise TimeoutError(f"shard [{begin!r}, {end!r}) never became fetched")
 
-    async def spread_evenly(self, split_points: Optional[List[bytes]] = None):
+    async def spread_evenly(self, split_points: Optional[List[bytes]] = None,
+                            replication: int = 1):
         """Partition the USER keyspace across all registered storages: split
-        at fixed byte boundaries (or given points) and round-robin the
-        shards.  The system keyspace (\xff...) stays on its current owner.
-        The dynamic, byte-sample-driven rebalancer replaces this once
-        storage metrics exist (ref: DataDistributionTracker byte samples)."""
+        at fixed byte boundaries (or given points) and round-robin TEAMS of
+        `replication` consecutive storages (ref: DDTeamCollection building
+        storage teams per policy, DataDistribution.actor.cpp:493).  The
+        system keyspace (\xff...) stays on its current owner.  The dynamic,
+        byte-sample-driven rebalancer replaces this once storage metrics
+        exist (ref: DataDistributionTracker byte samples)."""
         ids = sorted(self.storages)
         if len(ids) < 2:
             return
+        replication = min(replication, len(ids))
         if split_points is None:
             n = len(ids)
             split_points = [bytes([256 * i // n]) for i in range(1, n)]
@@ -199,6 +204,26 @@ class DataDistributor:
             if not dest and b < b"\xff"
         ]
         for i, (b, _e, team) in enumerate(shards):
-            target = [ids[i % len(ids)]]
+            target = [ids[(i + r) % len(ids)] for r in range(replication)]
             if set(team) != set(target):
                 await self.move(b, target)
+
+    async def heal(self, dead_id: str, replacement_id: Optional[str] = None):
+        """Re-replicate every shard that lists a dead storage: survivors
+        stay the fetch sources, a replacement (or nothing, dropping to a
+        smaller team) joins (ref: teamTracker reacting to failures,
+        DataDistribution.actor.cpp:1237).  Requires replication >= 2 for
+        shards whose only copy died."""
+        for b, _e, team, dest in await self.read_shard_map():
+            members = set(dest or team)
+            if dead_id not in members:
+                continue
+            survivors = [s for s in (dest or team) if s != dead_id]
+            if not survivors:
+                raise RuntimeError(
+                    f"shard at {b!r}: sole replica {dead_id} died; data lost"
+                )
+            new_team = list(survivors)
+            if replacement_id and replacement_id not in new_team:
+                new_team.append(replacement_id)
+            await self.move(b, new_team)

@@ -112,17 +112,34 @@ class ResolverInterface:
 
 @dataclass
 class TLogCommitRequest:
+    """One version's mutations for THIS tlog, grouped by tag (ref:
+    TagPartitionedLogSystem push building per-log, per-tag message bundles,
+    TagPartitionedLogSystem.actor.cpp:63).  Each mutation carries its
+    commit-order seq so consumers subscribing to several tags replay a
+    version's mutations in the exact commit order.  Every tlog receives
+    every version (possibly with no tags) to keep the prevVersion chain."""
+
     prev_version: int = 0
     version: int = 0
-    mutations: List[Mutation] = field(default_factory=list)
+    # tag -> [(seq, Mutation)]
+    tagged: Dict[str, List[Tuple[int, Mutation]]] = field(default_factory=dict)
     epoch: int = 0  # generation guard (ref: epoch locking at recovery)
+
+
+# Broadcast tags: metadata mutations go everywhere (the private-mutation
+# analog, ref ApplyMetadataMutation tagging); un-sharded ranges (no
+# keyServers entry yet) use the default tag, also on every tlog.
+TAG_ALL = "_all"
+TAG_DEFAULT = "_default"
 
 
 @dataclass
 class TLogPeekRequest:
+    """Peek the union of `tags` (ref tLogPeekMessages :946; a storage
+    subscribes to its own tag + the broadcast tags)."""
+
     begin_version: int = 0
-    # tag omitted in the single-storage slice; tag partitioning arrives with
-    # the TagPartitioned log system
+    tags: List[str] = field(default_factory=lambda: [TAG_DEFAULT, TAG_ALL])
     limit_versions: int = 1000
 
 

@@ -1,0 +1,26 @@
+"""Tag -> tlog placement: which logs hold a tag's mutations.
+
+Ref: TagPartitionedLogSystem.actor.cpp:63 — each tag is pushed to a
+policy-selected subset of tlogs of size tLogReplicationFactor; peek-merge
+cursors read a tag back from any of them.  The rebuild's policy is a stable
+hash ring (locality-aware policies arrive with multi-DC): tag t lives on
+rf consecutive logs starting at crc32(t) mod n.  Broadcast tags (metadata
+`_all`, unsharded `_default`) live on every log so any consumer can peek
+its full tag set from one log.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import List, Optional
+
+from ..flow.knobs import g_knobs
+from .interfaces import TAG_ALL, TAG_DEFAULT
+
+
+def tlogs_for_tag(tag: str, n_tlogs: int, rf: Optional[int] = None) -> List[int]:
+    if tag in (TAG_ALL, TAG_DEFAULT):
+        return list(range(n_tlogs))
+    rf = min(rf or g_knobs.server.log_replication_factor, n_tlogs)
+    h = zlib.crc32(tag.encode()) % n_tlogs
+    return [(h + r) % n_tlogs for r in range(rf)]

@@ -24,6 +24,8 @@ from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
 from ..utils import RangeMap
 from .interfaces import (
+    TAG_ALL,
+    TAG_DEFAULT,
     GetCommitVersionReply,
     GetKeyServersLocationsReply,
     ProxyInterface,
@@ -33,6 +35,7 @@ from .interfaces import (
     TLogCommitRequest,
     TLogInterface,
 )
+from .log_system import tlogs_for_tag
 
 
 def split_ranges_for_resolver(
@@ -88,13 +91,16 @@ class Proxy:
         # keyServers/serverList metadata mutations in the commits this proxy
         # processes (single-proxy stand-in for the reference's txnStateStore
         # + ApplyMetadataMutation; ref MasterProxyServer.actor.cpp:185,457).
-        # Values are tuples of storage ids; None = unsharded (no DD yet).
+        # Values are (route_team, tag_team) id-tuples: reads route to the
+        # data holders (src during a move), mutations are tagged to every
+        # current AND incoming holder (src + dest, so an AddingShard's
+        # buffer sees the stream).  None = unsharded (no DD yet).
         self.key_servers = RangeMap(None)
         self.server_list: dict = {}
         if system_map is not None:
             entries, server_list = system_map
             for b, e, team in entries:
-                self.key_servers.set_range(b, e, tuple(team))
+                self.key_servers.set_range(b, e, (tuple(team), tuple(team)))
             self.server_list = dict(server_list)
         # Metadata applies in version order across overlapped batches (the
         # prevVersion chain, like the log's).
@@ -128,7 +134,7 @@ class Proxy:
         while True:
             (entries, server_list), reply = await self._load_map_stream.pop()
             for b, e, team in entries:
-                self.key_servers.set_range(b, e, tuple(team))
+                self.key_servers.set_range(b, e, (tuple(team), tuple(team)))
             self.server_list.update(server_list)
             reply.send(None)
 
@@ -137,16 +143,47 @@ class Proxy:
         while True:
             req, reply = await self._loc_stream.pop()
             out = []
-            for b, e, team in self.key_servers.intersecting(req.begin, req.end):
+            for b, e, v in self.key_servers.intersecting(req.begin, req.end):
+                route = v[0] if v else None
                 ifaces = (
-                    [self.server_list[s] for s in team if s in self.server_list]
-                    if team
+                    [self.server_list[s] for s in route if s in self.server_list]
+                    if route
                     else []
                 )
                 out.append((b, e, ifaces))
                 if len(out) >= req.limit:
                     break
             reply.send(GetKeyServersLocationsReply(results=out))
+
+    def _tags_for_mutation(self, m: Mutation) -> set:
+        """Storage tags a mutation must reach (ref: the keyInfo tag lookup
+        in commitBatch :547-600).  System-keyspace mutations broadcast
+        (TAG_ALL — the private-mutation analog); unsharded ranges use
+        TAG_DEFAULT (also on every log)."""
+        tags: set = set()
+
+        def range_tags(b, e):
+            for _b, _e, v in self.key_servers.intersecting(b, e):
+                if v and v[1]:
+                    tags.update(v[1])
+                else:
+                    tags.add(TAG_DEFAULT)
+
+        if m.type == MutationType.CLEAR_RANGE:
+            b, e = m.param1, m.param2
+            if e > b"\xff":
+                tags.add(TAG_ALL)
+            if b < b"\xff":
+                range_tags(b, min(e, b"\xff"))
+        elif m.param1 >= b"\xff":
+            tags.add(TAG_ALL)
+        else:
+            v = self.key_servers[m.param1]
+            if v and v[1]:
+                tags.update(v[1])
+            else:
+                tags.add(TAG_DEFAULT)
+        return tags
 
     def _intercept_metadata(self, m: Mutation):
         """ApplyMetadataMutation analog for the proxy's own map."""
@@ -163,7 +200,11 @@ class Proxy:
             # Reads route to the data holders: the sources while a move is
             # in flight (they serve until the settle), the team once settled.
             # A seed record (empty src) routes to dest — the shard is new.
-            self.key_servers.set_range(begin, end, tuple(src or dest))
+            # Tags cover src AND dest so in-flight AddingShards see the
+            # stream (ref: tag assignment from keyInfo incl. pending moves).
+            route = tuple(src or dest)
+            tags = tuple(sorted(set(src) | set(dest)))
+            self.key_servers.set_range(begin, end, (route, tags))
 
     # --- GRV (ref transactionStarter :934; single-proxy causal shortcut) ---
     async def _serve_grv(self):
@@ -288,9 +329,17 @@ class Proxy:
             min(rep.committed[t] for rep in replies) for t in range(len(batch))
         ]
 
-        # Phase 3: post-resolution processing — versionstamp substitution
-        # (ref :269-274) and mutation assembly for the log.
-        mutations: List[Mutation] = []
+        # Phase 3: post-resolution processing, strictly in version order
+        # (the prevVersion chain): versionstamp substitution (ref :269-274),
+        # metadata application, THEN per-tag assembly — so a batch's tags
+        # are computed against every earlier batch's (and its own) metadata,
+        # exactly like the reference's applyMetadataMutations :457 before
+        # tag assignment :547-600.  Without the ordering, a write pipelined
+        # behind a startMove could miss the destination's tag and silently
+        # diverge the new replica.
+        await self._meta_version.when_at_least(prev)
+        tagged: dict = {}
+        seq = 0
         for t, ((req, _reply), status) in enumerate(zip(batch, statuses)):
             if status != COMMITTED:
                 continue
@@ -307,10 +356,20 @@ class Proxy:
                         m.param1,
                         transform_versionstamp(m.param2, version, t),
                     )
-                mutations.append(m)
+                self._intercept_metadata(m)
+                for tag in self._tags_for_mutation(m):
+                    tagged.setdefault(tag, []).append((seq, m))
+                seq += 1
+        self._meta_version.set(version)
 
-        # Phase 4: push to the log; durable when the log says so (ref
-        # logSystem->push + quorum fsync).  All logs in parallel.
+        # Phase 4: push each tag to its logs (ref logSystem->push with
+        # policy-selected tlog subsets); every log gets every version so
+        # the prevVersion chain holds.  Durable when ALL acked.
+        n = len(self.tlogs)
+        per_log: List[dict] = [{} for _ in range(n)]
+        for tag, muts in tagged.items():
+            for li in tlogs_for_tag(tag, n):
+                per_log[li][tag] = muts
         await wait_for_all(
             [
                 tl.commit.get_reply(
@@ -318,29 +377,20 @@ class Proxy:
                     TLogCommitRequest(
                         prev_version=prev,
                         version=version,
-                        mutations=mutations,
+                        tagged=per_log[li],
                         epoch=self.epoch,
                     ),
                 )
-                for tl in self.tlogs
+                for li, tl in enumerate(self.tlogs)
             ]
         )
 
-        # Metadata interception, in version order across overlapped batches
-        # (the prevVersion chain, like the log's; ref applyMetadataMutations
-        # MasterProxyServer.actor.cpp:457).  Runs AFTER the log push so a
-        # batch that dies at the log (commit_unknown_result, nothing reached
-        # storages) cannot leave the routing map pointing at a handoff that
-        # never happened.  Uses the raw transaction mutations: metadata keys
-        # are never versionstamped.
-        await self._meta_version.when_at_least(prev)
-        for (req, _reply), status in zip(batch, statuses):
-            if status == COMMITTED:
-                for m in req.transaction.mutations:
-                    self._intercept_metadata(m)
-        self._meta_version.set(version)
-
-        # Phase 5: report + reply (ref :636-677).
+        # Phase 5: report + reply (ref :636-677).  NOTE: metadata applied
+        # pre-push (phase 3) — if the push then fails, the map may reflect a
+        # handoff whose commit outcome is unknown; that batch also wedges
+        # the log's version chain, so the generation is replaced and the
+        # recovered proxy rebuilds its map from storage ownership
+        # (get_owned_meta), which resolves either way.
         await self.sequencer.report_committed.get_reply(self.process, version)
         if version > self.committed.get():
             self.committed.set(version)

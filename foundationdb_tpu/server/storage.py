@@ -34,6 +34,8 @@ from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
 from ..utils import RangeMap
 from .interfaces import (
+    TAG_ALL,
+    TAG_DEFAULT,
     FetchShardReply,
     FetchShardRequest,
     GetKeyValuesReply,
@@ -192,7 +194,7 @@ class StorageServer:
     def __init__(
         self,
         process: SimProcess,
-        tlog: TLogInterface,
+        tlog,  # TLogInterface or List[TLogInterface]
         epoch_begin_version: int = 0,
         kvstore=None,
         storage_id: str = None,
@@ -200,7 +202,9 @@ class StorageServer:
         meta=None,
     ):
         self.process = process
-        self.tlog = tlog
+        self.tlogs: List[TLogInterface] = (
+            list(tlog) if isinstance(tlog, (list, tuple)) else [tlog]
+        )
         self.store = VersionedStore()
         self.kvstore = kvstore
         self.storage_id = storage_id or f"ss:{process.machine.machine_id}"
@@ -244,12 +248,31 @@ class StorageServer:
         )
         # key -> [(watched_value, reply)] parked until the key changes
         self._watches: Dict[bytes, list] = {}
-        # Register our pop tag before anything else runs: the log must not
-        # discard entries this storage hasn't peeked (per-tag popping).
-        tlog.pop.send(
-            process,
-            TLogPopRequest(version=epoch_begin_version, tag=self.storage_id),
-        )
+        # The logs holding this storage's tag (ref: peek-merge cursors over
+        # the tag's tlog subset); broadcast tags live everywhere, so any of
+        # these serves the full subscription.
+        from .log_system import tlogs_for_tag
+
+        self._my_logs = [
+            self.tlogs[i]
+            for i in tlogs_for_tag(self.storage_id, len(self.tlogs))
+        ]
+        self._tags = [self.storage_id, TAG_DEFAULT, TAG_ALL]
+        # Register our consumer floor before anything else runs: the logs
+        # must not discard entries this storage hasn't peeked.  Logs we
+        # never peek get a vacuous (infinite) floor so this consumer never
+        # blocks their trimming.
+        my = set(id(t) for t in self._my_logs)
+        for tl in self.tlogs:
+            tl.pop.send(
+                process,
+                TLogPopRequest(
+                    version=(
+                        epoch_begin_version if id(tl) in my else 1 << 60
+                    ),
+                    tag=self.storage_id,
+                ),
+            )
         process.spawn(self._update_loop(), "ss_update")
         process.spawn(self._serve_get_value(), "ss_get_value")
         process.spawn(self._serve_get_key_values(), "ss_get_key_values")
@@ -357,32 +380,48 @@ class StorageServer:
             else:
                 self._watches.pop(k, None)
 
-    # -- write path: pull from the log (ref: storageserver update()) --
-    async def _update_loop(self):
-        from ..rpc.stream import retry_get_reply
+    def _pop_all(self, version: int):
+        for tl in self._my_logs:
+            tl.pop.send(
+                self.process,
+                TLogPopRequest(version=version, tag=self.storage_id),
+            )
 
+    # -- write path: pull from the log (ref: storageserver update() via a
+    # peek cursor; failover across the tag's log replicas) --
+    async def _update_loop(self):
         loop = self.process.network.loop
         last_durable_commit = loop.now()
+        log_i = 0
         while True:
-            reply = await retry_get_reply(
-                self.tlog.peek,
-                self.process,
-                TLogPeekRequest(begin_version=self.version.get()),
-            )
+            try:
+                reply = await self._my_logs[
+                    log_i % len(self._my_logs)
+                ].peek.get_reply(
+                    self.process,
+                    TLogPeekRequest(
+                        begin_version=self.version.get(), tags=self._tags
+                    ),
+                )
+            except FdbError:
+                # This replica is down: rotate to another log holding our
+                # tag (ref: ServerPeekCursor bestServer failover).
+                log_i += 1
+                await loop.delay(0.05)
+                continue
             for version, mutations in reply.entries:
                 if version <= self.version.get():
                     continue
                 self._apply(version, mutations)
                 self.version.set(version)
+            # Advance through tag-empty versions up to the log's durable
+            # watermark: our tag has everything below it.
+            if reply.end_version > self.version.get():
+                self.version.set(reply.end_version)
             if self.kvstore is None:
                 # In-memory engine: applied == durable, pop eagerly.
                 self.durable_version = self.version.get()
-                self.tlog.pop.send(
-                    self.process,
-                    TLogPopRequest(
-                        version=self.version.get(), tag=self.storage_id
-                    ),
-                )
+                self._pop_all(self.version.get())
             elif (
                 loop.now() - last_durable_commit
                 >= g_knobs.server.storage_durability_lag
@@ -434,10 +473,7 @@ class StorageServer:
             self.kvstore.set(OWNED_META_KEY, pickle.dumps(meta, protocol=4))
         await self.kvstore.commit()
         self.store.trim(new_durable)
-        self.tlog.pop.send(
-            self.process,
-            TLogPopRequest(version=new_durable, tag=self.storage_id),
-        )
+        self._pop_all(new_durable)
 
     def _get_current(self, key: bytes, version: int) -> Optional[bytes]:
         touched, val = self.store.get_stamped(key, version)

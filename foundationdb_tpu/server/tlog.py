@@ -1,16 +1,18 @@
-"""TLog role: the durable mutation log (in-memory v1).
+"""TLog role: the durable, tag-partitioned mutation log.
 
-Ref: TLogServer.actor.cpp — commit path appends version->messages and
-fsyncs (here: a simulated commit delay), tLogPeekMessages :946 serves
-storage servers, tLogPop :894 discards data durable on storage.  Tag
-partitioning and disk spill arrive with the TagPartitioned log system; this
-v1 keeps one logical tag.
+Ref: TLogServer.actor.cpp — commit path appends version -> per-tag message
+bundles and fsyncs (TLogQueue/DiskQueue), tLogPeekMessages :946 serves a
+tag's stream to storage servers, tLogPop :894 discards below the consumer
+floors.  Each entry holds {tag: [(seq, Mutation)]}; a peek returns the
+union of the requested tags per version, re-merged into commit order by
+seq (a storage subscribes to its own tag plus the broadcast tags).
+Per-tag btree spill is still TODO; unspilled data rides the DiskQueue.
 """
 
 from __future__ import annotations
 
 from bisect import bisect_left, bisect_right
-from typing import List, Tuple
+from typing import Dict, List, Tuple
 
 from ..flow.asyncvar import NotifiedVersion
 from ..rpc.network import SimProcess
@@ -38,9 +40,10 @@ class TLog:
     ):
         self.process = process
         self.epoch = epoch
-        # Parallel sorted lists: versions[i] holds mutation list entries[i].
+        # Parallel sorted lists: versions[i] holds entries[i], a per-tag
+        # mutation bundle {tag: [(seq, Mutation)]}.
         self.versions: List[int] = []
-        self.entries: List[list] = []
+        self.entries: List[Dict[str, list]] = []
         self.durable = NotifiedVersion(epoch_begin_version)
         self.popped = epoch_begin_version
         # tag -> highest pop seen; entries are discarded below min over tags
@@ -77,9 +80,9 @@ class TLog:
         q, records = await DiskQueue.open(fs, process, filename)
         log = cls(process, disk_queue=q, epoch=epoch)
         for _seq, payload in records:
-            version, mutations = pickle.loads(payload)
+            version, tagged = pickle.loads(payload)
             log.versions.append(version)
-            log.entries.append(mutations)
+            log.entries.append(tagged)
         log.popped = q.popped_seq
         last = log.versions[-1] if log.versions else q.popped_seq
         log.durable.set(max(last, fast_forward_to))
@@ -114,17 +117,18 @@ class TLog:
             reply.send(self.durable.get())  # duplicate
             return
         self.versions.append(req.version)
-        self.entries.append(req.mutations)
+        self.entries.append(req.tagged)
         if self.disk_queue is not None:
             import pickle
 
             self.disk_queue.push(
-                req.version, pickle.dumps((req.version, req.mutations), protocol=4)
+                req.version, pickle.dumps((req.version, req.tagged), protocol=4)
             )
             await self.disk_queue.commit()  # real (simulated-file) fsync
         else:
             await self.process.network.loop.delay(COMMIT_DELAY)  # fsync stand-in
         self.durable.set(req.version)
+        self._trim()  # consumers with vacuous floors never pop again
         reply.send(req.version)
 
     async def _serve_peek(self):
@@ -135,9 +139,20 @@ class TLog:
             # Only durable versions are visible to peeks.
             durable_end = bisect_right(self.versions, self.durable.get())
             j = min(j, durable_end)
+            out = []
+            for k in range(i, j):
+                by_seq: Dict[int, object] = {}
+                for tag in req.tags:
+                    for seq, m in self.entries[k].get(tag, ()):
+                        by_seq[seq] = m  # dedupe: a mutation may ride 2 tags
+                if by_seq:
+                    out.append(
+                        (self.versions[k],
+                         [m for _s, m in sorted(by_seq.items())])
+                    )
             reply.send(
                 TLogPeekReply(
-                    entries=list(zip(self.versions[i:j], self.entries[i:j])),
+                    entries=out,
                     end_version=self.durable.get()
                     if j == durable_end
                     else self.versions[j - 1] if j > i else req.begin_version,
@@ -145,24 +160,27 @@ class TLog:
                 )
             )
 
+    def _trim(self):
+        """Discard below the min consumer floor (ref tLogPop :894)."""
+        if not self.popped_tags:
+            return
+        floor = min(self.popped_tags.values())
+        if floor > self.popped:
+            self.popped = floor
+            k = bisect_right(self.versions, floor)
+            del self.versions[:k]
+            del self.entries[:k]
+            if self.disk_queue is not None:
+                # Persisted with the next commit (lazy, like the ref).
+                self.disk_queue.pop(floor)
+
     async def _serve_pop(self):
         while True:
             req, reply = await self._pop_stream.pop()
             tag = req.tag or "_default"
             if req.unregister:
                 self.popped_tags.pop(tag, None)
-                if not self.popped_tags:
-                    reply.send(None)
-                    continue
             elif req.version > self.popped_tags.get(tag, -1):
                 self.popped_tags[tag] = req.version
-            floor = min(self.popped_tags.values())
-            if floor > self.popped:
-                self.popped = floor
-                k = bisect_right(self.versions, floor)
-                del self.versions[:k]
-                del self.entries[:k]
-                if self.disk_queue is not None:
-                    # Persisted with the next commit (lazy, like the ref).
-                    self.disk_queue.pop(floor)
+            self._trim()
             reply.send(None)

@@ -69,7 +69,7 @@ class FastForwardTLog:
 
 @dataclass
 class InitStorage:
-    tlog: TLogInterface = None
+    tlog: object = None  # TLogInterface or List[TLogInterface]
 
 
 @dataclass

@@ -1,0 +1,267 @@
+"""Tag-partitioned log + storage replication >= 2.
+
+Ref: TagPartitionedLogSystem.actor.cpp:63 (per-tag push to a policy-chosen
+tlog subset), tLogPeekMessages :946 (per-tag peek; failover across the
+tag's replicas), DDTeamCollection (teams of storageTeamSize), and the
+ConsistencyCheck workload (checkDataConsistency :562 — every replica of
+every shard agrees).
+"""
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.server import SimCluster
+from foundationdb_tpu.server.interfaces import GetKeyValuesRequest
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def settle(c, db, t=0.2):
+    async def idle():
+        await c.loop.delay(t)
+
+    c.run_until(db.process.spawn(idle()))
+
+
+def place(c, db, dd, replication, split_points):
+    async def go():
+        await dd.register_storages(dd.storages)
+        await dd.seed(["ss0"])
+        await dd.spread_evenly(
+            split_points=split_points, replication=replication
+        )
+
+    c.run_until(db.process.spawn(go()), timeout_vt=500.0)
+    settle(c, db)
+
+
+def fill(c, db, n=50):
+    async def txn(tr):
+        for i in range(n):
+            tr.set(b"k%03d" % i, b"v%d" % i)
+
+    c.run_all([(db, db.run(txn))])
+
+
+def replica_contents(c, db, storage, begin, end, version):
+    """Direct full-range read from one storage (no client routing)."""
+    out = {}
+
+    async def go():
+        rep = await storage.interface().get_key_values.get_reply(
+            db.process,
+            GetKeyValuesRequest(begin=begin, end=end, version=version),
+        )
+        out["rows"] = rep.data
+
+    c.run_until(db.process.spawn(go()), timeout_vt=200.0)
+    return out["rows"]
+
+
+def check_replicas_consistent(c, db):
+    """ConsistencyCheck analog: every live replica of every user shard
+    returns identical contents at one version."""
+    version = c.proxy.committed.get()
+    by_id = {s.storage_id: s for s in c.storages}
+    shard_map = list(c.proxy.key_servers.items())
+    checked = 0
+    for b, e, v in shard_map:
+        if v is None or b >= b"\xff":
+            continue
+        team = [by_id[sid] for sid in v[0] if sid in by_id]
+        live = [s for s in team if s.process.alive]
+        if len(live) < 2:
+            continue
+        e2 = e if e is not None else b"\xff"
+        contents = [
+            replica_contents(c, db, s, b, min(e2, b"\xff"), version)
+            for s in live
+        ]
+        for other in contents[1:]:
+            assert other == contents[0], (b, e)
+        checked += 1
+    return checked
+
+
+def test_replicated_teams_agree_under_load():
+    c = SimCluster(seed=41, n_storages=3, n_tlogs=2)
+    db = c.database()
+    fill(c, db)
+    dd = c.data_distributor()
+    place(c, db, dd, replication=2, split_points=[b"k020", b"k040"])
+
+    # Every storage holds SOME shard, each shard has 2 replicas.
+    owners = [s for s in c.storages if any(
+        val for _b, _e, val in s.owned.intersecting(b"k", b"l"))]
+    assert len(owners) == 3
+
+    # More writes after placement (tagged per team now).
+    async def more(tr):
+        for i in range(50):
+            tr.set(b"k%03d" % i, b"w%d" % i)
+
+    c.run_all([(db, db.run(more))])
+    settle(c, db)
+    assert check_replicas_consistent(c, db) >= 3
+
+    # Cross-shard client read sees the new values.
+    out = {}
+
+    async def read(tr):
+        out["rows"] = await tr.get_range(b"k", b"k\xff")
+
+    c.run_all([(db, db.run(read))])
+    assert len(out["rows"]) == 50 and out["rows"][7][1] == b"w7"
+
+
+def test_storage_kill_no_data_loss_and_heal():
+    """Kill one storage mid-workload: reads fail over to the surviving
+    replica; DD re-replicates onto a spare from the survivor (ref:
+    teamTracker + MoveKeys healing)."""
+    c = SimCluster(seed=42, n_storages=4, n_tlogs=2)
+    db = c.database()
+    fill(c, db)
+    dd = c.data_distributor()
+    # Teams of 2 over ss0..ss2; ss3 is the spare.
+    async def go():
+        await dd.register_storages(dd.storages)
+        await dd.seed(["ss0"])
+        await dd.split(b"k025")
+        await dd.split(b"\xff")
+        await dd.move(b"", ["ss0", "ss1"])
+        await dd.move(b"k025", ["ss1", "ss2"])
+
+    c.run_until(db.process.spawn(go()), timeout_vt=500.0)
+    settle(c, db)
+
+    victim = c.storages[1]  # replica of BOTH shards
+    victim.process.kill()
+
+    # All data still readable through the client (rotates to survivors).
+    out = {}
+
+    async def read(tr):
+        out["rows"] = await tr.get_range(b"k", b"k\xff")
+
+    c.run_all([(db, db.run(read))], timeout_vt=500.0)
+    assert len(out["rows"]) == 50
+
+    # Heal: survivors source the re-replication to the spare.
+    async def heal():
+        await dd.heal("ss1", "ss3")
+
+    c.run_until(db.process.spawn(heal()), timeout_vt=1000.0)
+    settle(c, db)
+    m = {b: (team, dest) for b, _e, team, dest in c.run_until(
+        db.process.spawn(dd.read_shard_map()), timeout_vt=200.0)}
+    assert set(m[b""][0]) == {"ss0", "ss3"}
+    assert set(m[b"k025"][0]) == {"ss2", "ss3"}
+    # The spare actually serves the data now.
+    version = c.proxy.committed.get()
+    rows = replica_contents(c, db, c.storages[3], b"k", b"k\xff", version)
+    assert len(rows) == 50
+    assert check_replicas_consistent(c, db) >= 2
+
+
+def test_tlog_kill_peek_failover():
+    """With log replication 2, each tag lives on both logs: killing one
+    tlog must not lose acknowledged data — storages keep catching up from
+    the surviving replica (ref: peek-merge cursor failover :568-581)."""
+    c = SimCluster(seed=43, n_storages=2, n_tlogs=2)
+    db = c.database()
+    fill(c, db, n=30)
+    # Kill a tlog immediately — lagging storages must fail over their peeks.
+    c.tlogs[1].process.kill()
+    settle(c, db, t=0.5)
+    version = c.proxy.committed.get()
+    rows = replica_contents(c, db, c.storages[0], b"k", b"k\xff", version)
+    assert len(rows) == 30
+
+    out = {}
+
+    async def read(tr):
+        out["v"] = await tr.get(b"k007")
+
+    c.run_all([(db, db.run(read))])
+    assert out["v"] == b"v7"
+
+
+def test_cycle_invariant_with_replication_and_kill():
+    """Cycle workload over replicated shards; one replica dies mid-run;
+    the ring invariant holds and survivors agree (zero data loss)."""
+    N = 8
+    c = SimCluster(seed=44, n_storages=3, n_tlogs=2)
+    db_init = c.database()
+
+    async def init(tr):
+        for i in range(N):
+            tr.set(b"cycle/%03d" % i, b"%03d" % ((i + 1) % N))
+
+    c.run_all([(db_init, db_init.run(init))])
+    dd = c.data_distributor()
+
+    async def go():
+        await dd.register_storages(dd.storages)
+        await dd.seed(["ss0"])
+        await dd.split(b"cycle/004")
+        await dd.split(b"\xff")
+        await dd.move(b"", ["ss0", "ss1"])
+        await dd.move(b"cycle/004", ["ss1", "ss2"])
+
+    c.run_until(db_init.process.spawn(go()), timeout_vt=500.0)
+    settle(c, db_init)
+
+    dbs = [c.database() for _ in range(3)]
+    done = []
+
+    def worker(db, wid):
+        async def run():
+            rng = c.loop.rng
+            for _ in range(15):
+                async def op(tr):
+                    a = int(rng.random_int(0, N))
+                    ka = b"cycle/%03d" % a
+                    b = int((await tr.get(ka)).decode())
+                    kb = b"cycle/%03d" % b
+                    cc = int((await tr.get(kb)).decode())
+                    kc = b"cycle/%03d" % cc
+                    d = int((await tr.get(kc)).decode())
+                    tr.set(ka, b"%03d" % cc)
+                    tr.set(kc, b"%03d" % b)
+                    tr.set(kb, b"%03d" % d)
+
+                await db.run(op)
+            done.append(wid)
+
+        return run()
+
+    async def killer():
+        await c.loop.delay(0.15)
+        c.storages[1].process.kill()  # a replica of both shards
+
+    tasks = [db.process.spawn(worker(db, i)) for i, db in enumerate(dbs)]
+    tasks.append(db_init.process.spawn(killer()))
+    from foundationdb_tpu.flow.eventloop import all_of
+
+    c.run_until(all_of(tasks), timeout_vt=5000.0)
+    assert len(done) == 3
+    settle(c, db_init)
+
+    out = {}
+
+    async def check(tr):
+        out["ring"] = await tr.get_range(b"cycle/", b"cycle0")
+
+    c.run_all([(db_init, db_init.run(check))])
+    ring = {k: int(v.decode()) for k, v in out["ring"]}
+    assert len(ring) == N
+    seen, cur = set(), 0
+    for _ in range(N):
+        assert cur not in seen
+        seen.add(cur)
+        cur = ring[b"cycle/%03d" % cur]
+    assert cur == 0 and len(seen) == N
