@@ -65,10 +65,14 @@ class ClusterController:
         process: SimProcess,
         coordinators: List[CoordinatorInterface],
         conflict_backend: str = "cpu",
+        n_tlogs: int = 1,
+        n_storages: int = 1,
     ):
         self.process = process
         self.coordinators = coordinators
         self.conflict_backend = conflict_backend
+        self.n_tlogs = n_tlogs
+        self.n_storages = n_storages
         self.workers: Dict[str, WorkerInterface] = {}
         self.client_info = AsyncVar(ClientDBInfo())
         self._info_waiters: list = []
@@ -155,11 +159,7 @@ class ClusterController:
         # READING_CSTATE
         cstate = CoordinatedState(self.process, self.coordinators)
         raw = await cstate.read()
-        prev = (
-            pickle.loads(raw)
-            if raw
-            else {"epoch_end": 0, "tlog_addr": None, "storage_addr": None}
-        )
+        prev = pickle.loads(raw) if raw else {"epoch_end": 0}
 
         # The epoch/generation is monotone ACROSS controller failovers: it is
         # persisted in the manifest and bumped past any previously persisted
@@ -179,32 +179,45 @@ class ClusterController:
         # Wait for a usable worker set: stateful roles MUST return to the
         # machines holding their files (recorded in cstate) — recruiting a
         # fresh empty tlog/storage elsewhere would silently drop
-        # acknowledged data.  Without replication, a permanently dead
-        # stateful machine means recovery (correctly) waits.
-        tlog_w, storage_w = await self._wait_workers(
-            prev.get("tlog_addr"), prev.get("storage_addr")
+        # acknowledged data.  A shard whose whole storage team is
+        # permanently dead means recovery (correctly) waits.
+        tlog_ws, storage_ws = await self._wait_workers(
+            prev.get("tlog_addrs"), prev.get("storage_addrs")
         )
 
-        # LOCKING: stop the old tlog generation and learn its durable end.
+        # LOCKING: stop every old-generation tlog, learn durable ends.
         epoch_end = prev["epoch_end"]
-        lock = await self._try(tlog_w.init_role.get_reply(self.process, LockTLog()))
-        if isinstance(lock, int):
-            epoch_end = max(epoch_end, lock)
+        for w in tlog_ws:
+            lock = await self._try(
+                w.init_role.get_reply(self.process, LockTLog())
+            )
+            if isinstance(lock, int):
+                epoch_end = max(epoch_end, lock)
 
         # RECRUITING (ref worker.actor.cpp :494-560 Initialize* handling).
-        # The tlog recovers first WITHOUT a fast-forward so the true durable
-        # end is known before the recovery version is fixed; an epoch begun
-        # below the log's durable end would let stale-version commits be
-        # swallowed as duplicates.
-        tlog_if, tlog_durable = await tlog_w.init_role.get_reply(
-            self.process,
-            InitTLog(epoch_begin=0, epoch=self.generation),
-        )
-        epoch_end = max(epoch_end, tlog_durable)
+        # Logs recover first WITHOUT a fast-forward so the true durable
+        # ends are known before the recovery version is fixed.  Epoch-end
+        # cut = min(durables): commits ack only after ALL logs fsync, so
+        # anything above the min is an un-acked orphan on a subset of logs
+        # and is truncated before the new epoch serves (ref: the epochEnd
+        # lock/version agreement, TagPartitionedLogSystem.actor.cpp).
+        tlog_ifs = []
+        durables = []
+        for w in tlog_ws:
+            tlog_if, tlog_durable = await w.init_role.get_reply(
+                self.process,
+                InitTLog(epoch_begin=0, epoch=self.generation),
+            )
+            tlog_ifs.append(tlog_if)
+            durables.append(tlog_durable)
+        cut = min(durables)
+        epoch_end = max([epoch_end] + durables)
         recovery_version = epoch_end + g_knobs.server.max_versions_in_flight
-        await tlog_w.init_role.get_reply(
-            self.process, FastForwardTLog(version=recovery_version)
-        )
+        for w in tlog_ws:
+            await w.init_role.get_reply(
+                self.process,
+                FastForwardTLog(version=recovery_version, truncate_above=cut),
+            )
         seq_w = self._pick_stateless()
         seq_if = await seq_w.init_role.get_reply(
             self.process, InitSequencer(epoch_begin=recovery_version)
@@ -218,27 +231,33 @@ class ClusterController:
                 epoch=self.generation,
             ),
         )
-        storage_if = await storage_w.init_role.get_reply(
-            self.process, InitStorage(tlog=tlog_if)
-        )
+        storage_ifs = []
+        for w in storage_ws:
+            storage_ifs.append(
+                await w.init_role.get_reply(
+                    self.process, InitStorage(tlog=list(tlog_ifs))
+                )
+            )
         proxy_w = self._pick_stateless()
         proxy_if = await proxy_w.init_role.get_reply(
             self.process,
             InitProxy(
                 sequencer=seq_if,
                 resolvers=[res_if],
-                tlogs=[tlog_if],
+                tlogs=list(tlog_ifs),
                 epoch_begin=recovery_version,
                 epoch=self.generation,
             ),
         )
         self._role_addrs = {
-            "tlog": tlog_w.address,
             "sequencer": seq_w.address,
             "resolver": res_w.address,
-            "storage": storage_w.address,
             "proxy": proxy_w.address,
         }
+        for i, w in enumerate(tlog_ws):
+            self._role_addrs[f"tlog{i}"] = w.address
+        for i, w in enumerate(storage_ws):
+            self._role_addrs[f"storage{i}"] = w.address
 
         # WRITING_CSTATE — before serving clients (write-before-use).  The
         # stateful-role addresses are part of the manifest so the next
@@ -258,8 +277,8 @@ class ClusterController:
                 {
                     "generation": self.generation,
                     "epoch_end": recovery_version,
-                    "tlog_addr": tlog_w.address,
-                    "storage_addr": storage_w.address,
+                    "tlog_addrs": [w.address for w in tlog_ws],
+                    "storage_addrs": [w.address for w in storage_ws],
                 },
                 protocol=4,
             )
@@ -272,71 +291,106 @@ class ClusterController:
             self.process, CommitTransactionRequest(transaction=CommitTransactionRef())
         )
 
-        # Rebuild the proxy's routing map from storage ownership meta once
-        # the storage has replayed through the recovery transaction (the
+        # Rebuild the proxy's routing map from every storage's ownership
+        # meta once each has replayed through the recovery transaction (the
         # txnStateStore-recovery analog; ref recoverFrom masterserver:725).
         # Must finish before clients see the new generation, and before DD
         # resumes metadata writes.
         from .interfaces import GetOwnedMetaRequest
 
-        meta = await timeout_after(
-            loop,
-            storage_if.get_owned_meta.get_reply(
-                self.process,
-                GetOwnedMetaRequest(min_version=recovery_txn_version),
-            ),
-            30.0,
-        )
-        if meta is None:
-            raise FdbError("timed_out")
-        sid, owned_ranges, server_list = meta
-        server_list = dict(server_list)
-        server_list.setdefault(sid, storage_if)
+        server_list: dict = {}
+        owned_by: dict = {}  # sid -> [(b, e_or_None)]
+        for storage_if in storage_ifs:
+            meta = await timeout_after(
+                loop,
+                storage_if.get_owned_meta.get_reply(
+                    self.process,
+                    GetOwnedMetaRequest(min_version=recovery_txn_version),
+                ),
+                30.0,
+            )
+            if meta is None:
+                raise FdbError("timed_out")
+            sid, owned_ranges, sl = meta
+            server_list.update(sl)
+            server_list.setdefault(sid, storage_if)
+            owned_by[sid] = owned_ranges
+        # Teams on ATOMIC segments: each storage coalesces its own ranges,
+        # so teammates' boundaries need not line up — cut at every boundary
+        # and compute membership per segment.
+        cuts = {b""}
+        for ranges in owned_by.values():
+            for b, e in ranges:
+                cuts.add(b)
+                if e is not None:
+                    cuts.add(e)
+        points = sorted(cuts)  # never empty: b"" is always present
+        segs = list(zip(points, points[1:]))
+        # Open-ended tail; uncovered segments get an empty team and are
+        # dropped below.
+        segs.append((points[-1], None))
+
+        def covers(ranges, k):
+            return any(
+                b <= k and (e is None or k < e) for b, e in ranges
+            )
+
+        entries = []
+        for sb, se in segs:
+            team = sorted(
+                sid for sid, rs in owned_by.items() if covers(rs, sb)
+            )
+            if team:
+                entries.append((sb, se, team))
         await proxy_if.load_system_map.get_reply(
-            self.process,
-            ([(b, e, [sid]) for b, e in owned_ranges], server_list),
+            self.process, (entries, server_list)
         )
 
         # FULLY_RECOVERED: publish to clients (drains parked long-polls).
         self._publish_client_info(
             ClientDBInfo(
-                generation=self.generation, proxy=proxy_if, storage=storage_if
+                generation=self.generation,
+                proxy=proxy_if,
+                storage=storage_ifs[0],
             )
         )
         TraceEvent("RecoveryComplete").detail("generation", self.generation).detail(
             "recovery_version", recovery_version
         ).log()
 
-    async def _wait_workers(self, tlog_addr=None, storage_addr=None):
-        """(tlog_worker, storage_worker).
+    async def _wait_workers(self, tlog_addrs=None, storage_addrs=None):
+        """(tlog_workers, storage_workers) lists.
 
-        With a previous generation's manifest, wait for THOSE addresses (or
-        a worker that reports holding the file — same machine, new process
-        slot).  Fresh cluster: any live workers.
+        With a previous generation's manifest, wait for THOSE addresses (the
+        simulator reboots machines at the same address, so the disks come
+        back there).  Fresh cluster: spread the stateful roles over live
+        workers — tlogs from the front, storages from the back (they may
+        share a worker; each worker hosts at most one of each).
         """
         from ..flow.eventloop import timeout_after
 
         loop = self.process.network.loop
         while True:
             live = await self._live_workers()
+            by_addr = {w.address: w for w in live}
 
-            def find(addr, has_file_attr, default):
-                if addr is None:
-                    return default  # fresh cluster: no files exist yet
-                for w in live:
-                    if w.address == addr or getattr(w, has_file_attr):
-                        return w
-                return None
+            def pick(addrs, count, from_back):
+                if addrs:
+                    ws = [by_addr.get(a) for a in addrs]
+                    return None if any(w is None for w in ws) else ws
+                if len(live) < count:
+                    return None
+                return (
+                    live[-count:] if from_back else live[:count]
+                )
 
-            tlog_w = find(tlog_addr, "has_tlog_file", live[0] if live else None)
-            storage_w = find(
-                storage_addr, "has_storage_file", live[-1] if live else None
-            )
-            if tlog_w is not None and storage_w is not None:
-                return tlog_w, storage_w
+            tlog_ws = pick(tlog_addrs, self.n_tlogs, False)
+            storage_ws = pick(storage_addrs, self.n_storages, True)
+            if tlog_ws is not None and storage_ws is not None:
+                return tlog_ws, storage_ws
             TraceEvent("RecoveryWaitingForWorkers").detail(
-                "tlog_addr", tlog_addr
-            ).detail("storage_addr", storage_addr).log()
+                "tlog_addrs", tlog_addrs
+            ).detail("storage_addrs", storage_addrs).log()
             # Wake early if a worker registers (or every 0.5s).
             await timeout_after(
                 loop, self._recovery_needed.on_change(), 0.5
@@ -374,9 +428,12 @@ class ClusterController:
                     TraceEvent("RoleWorkerLost").detail("role", role).log()
                     return
                 # role_check (not just ping): a rebooted worker answers pings
-                # but no longer hosts the role.
+                # but no longer hosts the role.  Worker role-table keys have
+                # no index suffix (one tlog/storage per worker).
                 installed = await self._try(
-                    wi.role_check.get_reply(self.process, role),
+                    wi.role_check.get_reply(
+                        self.process, role.rstrip("0123456789")
+                    ),
                     timeout=PING_TIMEOUT,
                 )
                 if installed is not True:

@@ -31,12 +31,16 @@ class DynamicCluster:
         n_controllers: int = 2,
         conflict_backend: str = "cpu",
         loop: Optional[EventLoop] = None,
+        n_tlogs: int = 1,
+        n_storages: int = 1,
     ):
         self.loop = loop or EventLoop(seed=seed)
         set_event_loop(self.loop)
         self.net = SimNetwork(self.loop)
         self.fs = SimFileSystem(self.net)
         self.conflict_backend = conflict_backend
+        self.n_tlogs = n_tlogs
+        self.n_storages = n_storages
 
         self._coord_procs = [
             self.net.process(f"coord{i}") for i in range(n_coordinators)
@@ -61,7 +65,11 @@ class DynamicCluster:
         # Controller candidates: whichever wins the election acts.
         self.controllers = [
             ClusterController(
-                p, self.coord_ifaces, conflict_backend=self.conflict_backend
+                p,
+                self.coord_ifaces,
+                conflict_backend=self.conflict_backend,
+                n_tlogs=self.n_tlogs,
+                n_storages=self.n_storages,
             )
             for p in self._cc_procs
         ]
@@ -156,9 +164,11 @@ class DynamicCluster:
 
     def kill_role_process(self, role: str):
         """Kill the worker process currently hosting `role` (as recruited by
-        the acting controller)."""
+        the acting controller).  Unsuffixed stateful names alias the first
+        instance ("tlog" -> "tlog0")."""
         cc = self.acting_controller()
-        addr = cc._role_addrs[role]
+        addrs = cc._role_addrs
+        addr = addrs.get(role) or addrs[role + "0"]
         proc = self.net.get_process(addr)
         proc.kill()
         return proc

@@ -124,6 +124,10 @@ class TLogCommitRequest:
     # tag -> [(seq, Mutation)]
     tagged: Dict[str, List[Tuple[int, Mutation]]] = field(default_factory=dict)
     epoch: int = 0  # generation guard (ref: epoch locking at recovery)
+    # Highest fully-acked version the proxy knows (ref:
+    # knownCommittedVersion riding pushes): consumers may apply up to it
+    # even when a log replica is unreachable.
+    known_committed: int = 0
 
 
 # Broadcast tags: metadata mutations go everywhere (the private-mutation
@@ -147,6 +151,7 @@ class TLogPeekRequest:
 class TLogPeekReply:
     entries: List[Tuple[int, List[Mutation]]] = field(default_factory=list)
     end_version: int = 0  # exclusive: peeked everything below this
+    known_committed: int = 0  # fully-acked watermark (see TLogCommitRequest)
     has_more: bool = False
 
 
@@ -170,6 +175,12 @@ class TLogInterface:
     commit: RequestStreamRef = None
     peek: RequestStreamRef = None
     pop: RequestStreamRef = None
+    # Durable-watermark probe (ref: confirmEpochLive / the known-committed
+    # version exchange).  Storages bound application to the MIN watermark
+    # across their tag's logs, so a version durable on only SOME logs (an
+    # un-acked orphan that epoch-end recovery will truncate) is never
+    # applied by anyone.
+    confirm: RequestStreamRef = None
 
 
 # --- storage (ref fdbclient/StorageServerInterface.h) ---

@@ -379,6 +379,7 @@ class Proxy:
                         version=version,
                         tagged=per_log[li],
                         epoch=self.epoch,
+                        known_committed=self.committed.get(),
                     ),
                 )
                 for li, tl in enumerate(self.tlogs)

@@ -258,6 +258,7 @@ class StorageServer:
             for i in tlogs_for_tag(self.storage_id, len(self.tlogs))
         ]
         self._tags = [self.storage_id, TAG_DEFAULT, TAG_ALL]
+        self._kc_cache = epoch_begin_version  # last all-logs-confirmed min
         # Register our consumer floor before anything else runs: the logs
         # must not discard entries this storage hasn't peeked.  Logs we
         # never peek get a vacuous (infinite) floor so this consumer never
@@ -387,6 +388,33 @@ class StorageServer:
                 TLogPopRequest(version=version, tag=self.storage_id),
             )
 
+    async def _known_committed_bound(self, reply) -> int:
+        """Highest version safe to APPLY (ref: knownCommittedVersion).
+        Commits ack only after EVERY log fsyncs, and epoch-end recovery
+        truncates above min(all durables) — so a version is safe once
+        (a) the proxy has seen it fully acked (rides the pushes), or
+        (b) ALL logs (not just our tag's subset: the recovery cut spans
+        every log) confirm it durable.  The confirm fan-out is skipped
+        while a previous round already covers the log's tail."""
+        bound = reply.known_committed
+        if len(self.tlogs) == 1:
+            return max(bound, reply.end_version)
+        best = max(bound, self._kc_cache)
+        if reply.end_version <= best:
+            return best  # nothing new to confirm
+        durables = []
+        for tl in self.tlogs:
+            try:
+                durables.append(
+                    await tl.confirm.get_reply(self.process, None)
+                )
+            except FdbError:
+                return best  # a log is unreachable: only (a) is safe
+        m = min(durables)
+        if m > self._kc_cache:
+            self._kc_cache = m
+        return max(bound, self._kc_cache)
+
     # -- write path: pull from the log (ref: storageserver update() via a
     # peek cursor; failover across the tag's log replicas) --
     async def _update_loop(self):
@@ -409,15 +437,20 @@ class StorageServer:
                 log_i += 1
                 await loop.delay(0.05)
                 continue
+            bound = await self._known_committed_bound(reply)
             for version, mutations in reply.entries:
                 if version <= self.version.get():
                     continue
+                if version > bound:
+                    break  # not yet known-committed; re-peek later
                 self._apply(version, mutations)
                 self.version.set(version)
-            # Advance through tag-empty versions up to the log's durable
-            # watermark: our tag has everything below it.
-            if reply.end_version > self.version.get():
-                self.version.set(reply.end_version)
+            # Advance through tag-empty versions, but never past what this
+            # peek actually covered (a limit-truncated peek may end below
+            # the known-committed watermark).
+            floor = min(bound, reply.end_version)
+            if floor > self.version.get():
+                self.version.set(floor)
             if self.kvstore is None:
                 # In-memory engine: applied == durable, pop eagerly.
                 self.durable_version = self.version.get()

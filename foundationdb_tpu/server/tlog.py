@@ -45,6 +45,7 @@ class TLog:
         self.versions: List[int] = []
         self.entries: List[Dict[str, list]] = []
         self.durable = NotifiedVersion(epoch_begin_version)
+        self.known_committed = epoch_begin_version
         self.popped = epoch_begin_version
         # tag -> highest pop seen; entries are discarded below min over tags
         # (ref: per-tag popping, TLogServer.actor.cpp:894).
@@ -56,9 +57,13 @@ class TLog:
         self._commit_stream = RequestStream(process, "tlog_commit", well_known=True)
         self._peek_stream = RequestStream(process, "tlog_peek", well_known=True)
         self._pop_stream = RequestStream(process, "tlog_pop", well_known=True)
+        self._confirm_stream = RequestStream(
+            process, "tlog_confirm", well_known=True
+        )
         process.spawn(self._serve_commit(), "tlog_commit")
         process.spawn(self._serve_peek(), "tlog_peek")
         process.spawn(self._serve_pop(), "tlog_pop")
+        process.spawn(self._serve_confirm(), "tlog_confirm")
 
     @classmethod
     async def recover(
@@ -80,7 +85,14 @@ class TLog:
         q, records = await DiskQueue.open(fs, process, filename)
         log = cls(process, disk_queue=q, epoch=epoch)
         for _seq, payload in records:
-            version, tagged = pickle.loads(payload)
+            rec = pickle.loads(payload)
+            if rec[0] == "__truncate__":
+                cut = rec[1]
+                k = bisect_right(log.versions, cut)
+                del log.versions[k:]
+                del log.entries[k:]
+                continue
+            version, tagged = rec
             log.versions.append(version)
             log.entries.append(tagged)
         log.popped = q.popped_seq
@@ -93,7 +105,33 @@ class TLog:
             commit=self._commit_stream.ref(),
             peek=self._peek_stream.ref(),
             pop=self._pop_stream.ref(),
+            confirm=self._confirm_stream.ref(),
         )
+
+    async def _serve_confirm(self):
+        while True:
+            _req, reply = await self._confirm_stream.pop()
+            reply.send(self.durable.get())
+
+    async def truncate_above(self, cut: int):
+        """Epoch-end cut: discard versions > cut (never acked — acks need
+        every log durable).  Durable via a marker record so a later
+        recovery does not resurrect the orphans from the disk queue."""
+        k = bisect_right(self.versions, cut)
+        if k < len(self.versions):
+            del self.versions[k:]
+            del self.entries[k:]
+        if self.disk_queue is not None:
+            import pickle
+
+            # seq = cut+1 so the marker outlives the orphans it erases (the
+            # disk queue's recovery drops records with seq <= popped_seq,
+            # and consumer floors never exceed the known-committed bound,
+            # which is <= cut, until after the new epoch begins).
+            self.disk_queue.push(
+                cut + 1, pickle.dumps(("__truncate__", cut), protocol=4)
+            )
+            await self.disk_queue.commit()
 
     async def _serve_commit(self):
         while True:
@@ -118,6 +156,8 @@ class TLog:
             return
         self.versions.append(req.version)
         self.entries.append(req.tagged)
+        if req.known_committed > self.known_committed:
+            self.known_committed = req.known_committed
         if self.disk_queue is not None:
             import pickle
 
@@ -156,15 +196,19 @@ class TLog:
                     end_version=self.durable.get()
                     if j == durable_end
                     else self.versions[j - 1] if j > i else req.begin_version,
+                    known_committed=self.known_committed,
                     has_more=j < durable_end,
                 )
             )
 
     def _trim(self):
-        """Discard below the min consumer floor (ref tLogPop :894)."""
+        """Discard below the min consumer floor (ref tLogPop :894).  Capped
+        at the durable watermark: vacuous floors (1<<60, from storages that
+        never peek this log) must not leak a bogus sequence into the disk
+        queue's popped_seq — a recovered log's durable end derives from it."""
         if not self.popped_tags:
             return
-        floor = min(self.popped_tags.values())
+        floor = min(min(self.popped_tags.values()), self.durable.get())
         if floor > self.popped:
             self.popped = floor
             k = bisect_right(self.versions, floor)

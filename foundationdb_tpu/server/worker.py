@@ -62,9 +62,17 @@ class LockTLog:
 class FastForwardTLog:
     """Jump the recovered tlog's durable chain to the new epoch's begin,
     once the recovery version is fixed (it must exceed the log's true
-    durable end, which is only known after recovery from disk)."""
+    durable end, which is only known after recovery from disk).
+
+    `truncate_above`: epoch-end cut (ref: the epochEnd lock protocol,
+    TagPartitionedLogSystem.actor.cpp).  Commits ack only after ALL logs
+    fsync, so min(recovered durables) bounds every acked version; entries
+    above it are un-acked orphans present on a strict subset of logs and
+    are discarded (durably, via a truncate marker) before the log serves
+    the new epoch."""
 
     version: int = 0
+    truncate_above: Optional[int] = None
 
 
 @dataclass
@@ -187,8 +195,13 @@ class WorkerServer:
                 if role is None:
                     reply.send_error("recruitment_failed")
                 else:
+                    if req.truncate_above is not None:
+                        # Epoch-end cut: drop un-acked orphans (durably).
+                        await role.truncate_above(req.truncate_above)
                     if req.version > role.durable.get():
                         role.durable.set(req.version)
+                    if req.version > role.known_committed:
+                        role.known_committed = req.version
                     reply.send(role.durable.get())
             elif isinstance(req, InitStorage):
                 role = await StorageServer.recover(
