@@ -70,9 +70,12 @@ class Database:
         proxy: ProxyInterface = None,
         storage: StorageInterface = None,
         info_var=None,
+        proxies: Optional[List[ProxyInterface]] = None,
     ):
         self.process = process
         self._proxy = proxy
+        self._proxies = list(proxies) if proxies else ([proxy] if proxy else [])
+        self._proxy_rr: dict = {}
         self._storage = storage
         self.info_var = info_var
         # range -> tuple(StorageInterface) | () unsharded | None unknown
@@ -95,7 +98,7 @@ class Database:
             if gap is None:
                 return entries
             gb, ge = gap
-            rep = await self.proxy.get_key_servers_locations.get_reply(
+            rep = await self.pick_proxy("loc").get_key_servers_locations.get_reply(
                 self.process,
                 GetKeyServersLocationsRequest(
                     begin=gb, end=end if ge is None else min(ge, end)
@@ -124,6 +127,25 @@ class Database:
         if self.info_var is not None and self.info_var.get().proxy is not None:
             return self.info_var.get().proxy
         return self._proxy
+
+    def pick_proxy(self, kind: str = "") -> ProxyInterface:
+        """Round-robin across the generation's proxies (ref: the proxy
+        load-balancing in getConsistentReadVersion / tryCommit via
+        loadBalance over ProxyInfo).  A separate counter per call site
+        (`kind`): one shared counter phase-locks with the fixed GRV+commit
+        call pattern (2 picks/txn), pinning every commit to one proxy."""
+        proxies = None
+        if self.info_var is not None:
+            info = self.info_var.get()
+            proxies = getattr(info, "proxies", None) or (
+                [info.proxy] if info.proxy is not None else None
+            )
+        if not proxies:
+            proxies = self._proxies
+        if not proxies:
+            return self.proxy
+        self._proxy_rr[kind] = self._proxy_rr.get(kind, 0) + 1
+        return proxies[self._proxy_rr[kind] % len(proxies)]
 
     @property
     def storage(self) -> StorageInterface:
@@ -167,7 +189,7 @@ class Transaction:
         if self._read_version is None:
             if self.db.info_var is not None:
                 await self.db.wait_connected()
-            self._read_version = await self.db.proxy.get_consistent_read_version.get_reply(
+            self._read_version = await self.db.pick_proxy("grv").get_consistent_read_version.get_reply(
                 self.db.process, GetReadVersionRequest()
             )
         return self._read_version
@@ -461,21 +483,81 @@ class Transaction:
             return self.committed_version  # read-only: nothing to do
         if self.db.info_var is not None:
             await self.db.wait_connected()
-        read_snapshot = (
-            self._read_version if self.read_conflict_ranges else 0
-        ) or 0
+        read = _coalesce(self.read_conflict_ranges)
+        write = _coalesce(self.write_conflict_ranges)
+        # Self-conflict guarantee (ref: makeSelfConflicting NativeAPI:2052,
+        # applied at :2505 unless causalWriteRisky): ensure read∩write is
+        # non-empty so a commit_unknown_result can later be resolved by a
+        # dummy transaction over a key in the intersection.
+        if not self.options.get("causal_write_risky") and (
+            _intersect_key(write, read) is None
+        ):
+            rng = self.db.process.network.loop.rng
+            sc = b"\xff/SC/" + rng.random_int(0, 1 << 62).to_bytes(8, "big")
+            r = (sc, key_after(sc))
+            read = read + [r]
+            write = write + [r]
+        if read and self._read_version is None:
+            # A blind write made self-conflicting still needs a snapshot to
+            # resolve against (ref: the causal-read-risky getReadVersion for
+            # commits without reads, NativeAPI:2497).
+            await self.get_read_version()
+        read_snapshot = (self._read_version if read else 0) or 0
         tref = CommitTransactionRef(
             read_snapshot=read_snapshot,
-            read_conflict_ranges=_coalesce(self.read_conflict_ranges),
-            write_conflict_ranges=_coalesce(self.write_conflict_ranges),
+            read_conflict_ranges=read,
+            write_conflict_ranges=write,
             mutations=list(self.mutations),
         )
-        version = await self.db.proxy.commit.get_reply(
-            self.db.process, CommitTransactionRequest(transaction=tref)
-        )
+        try:
+            version = await self.db.pick_proxy("commit").commit.get_reply(
+                self.db.process, CommitTransactionRequest(transaction=tref)
+            )
+        except FdbError as e:
+            if e.name in ("commit_unknown_result", "broken_promise"):
+                # The commit may still be in flight.  Before surfacing the
+                # unknown result, commit a conflicting dummy transaction
+                # over a key in the original's read∩write intersection: once
+                # it commits, the original has either committed or will
+                # forever conflict, so a retry observes definitive state
+                # (ref: commitDummyTransaction NativeAPI:2315, invoked
+                # :2430-2449).
+                if not self.options.get("causal_write_risky"):
+                    key = _intersect_key(write, read)
+                    assert key is not None  # guaranteed by self-conflicting
+                    await self._commit_dummy(key)
+                raise FdbError("commit_unknown_result")
+            raise
         self.committed_version = version
         self._launch_watches(version)
         return version
+
+    async def _commit_dummy(self, key: bytes):
+        """Fence the in-flight original (ref commitDummyTransaction :2315)."""
+        loop = self.db.process.network.loop
+        for attempt in range(60):
+            tr = Transaction(self.db)
+            tr.options["causal_write_risky"] = True
+            tr.options["access_system_keys"] = True
+            tr.add_read_conflict_range(key, key_after(key))
+            tr.add_write_conflict_range(key, key_after(key))
+            try:
+                # A conflict-ranges-only transaction must still traverse the
+                # commit pipeline: give it a read snapshot so it can
+                # conflict.  Inside the retry guard: the fence runs exactly
+                # when the generation is dying, so the GRV itself may get
+                # broken_promise.
+                await tr.get_read_version()
+                await tr.commit()
+                return
+            except FdbError as e:
+                if not (
+                    e.is_retryable_in_transaction()
+                    or e.name == "broken_promise"
+                ):
+                    raise
+                await loop.delay(0.05 * (attempt + 1))
+        raise FdbError("commit_unknown_result")
 
     def _launch_watches(self, version: int):
         watches, self._watches = self._watches, []
@@ -492,7 +574,8 @@ class Transaction:
             raise e
         ck = g_knobs.client
         delay = min(
-            ck.max_retry_delay, ck.initial_retry_delay * (2**self._retries)
+            ck.max_retry_delay,
+            ck.initial_retry_delay * (2 ** min(self._retries, 30)),
         )
         self._retries += 1
         await self.db.process.network.loop.delay(
@@ -510,6 +593,18 @@ class Transaction:
             if not promise.is_set():
                 promise.send_error(FdbError("watch_cancelled"))
         self._watches = []
+
+
+def _intersect_key(write: List[Range], read: List[Range]) -> Optional[bytes]:
+    """A key inside some write∩read range overlap, or None (ref: the
+    intersects() probe in tryCommit's commit_unknown_result handling,
+    NativeAPI.actor.cpp:2440-2443)."""
+    for wb, we in write:
+        for rb, re_ in read:
+            lo, hi = max(wb, rb), min(we, re_)
+            if lo < hi:
+                return lo
+    return None
 
 
 def _coalesce(ranges: List[Range]) -> List[Range]:

@@ -51,6 +51,10 @@ _ERRORS: dict[str, int] = {
     "please_reboot_delete": 1208,
     "master_proxy_failed": 1209,
     "master_resolver_failed": 1210,
+    # Rebuild-specific (no 6.0 analog code): a fresh replacement tlog was
+    # asked for versions predating its recruitment; the peeker must fail
+    # over to a surviving replica of its tag.
+    "peek_below_begin": 1211,
     "platform_error": 1500,
     "io_error": 1510,
     "file_not_found": 1511,

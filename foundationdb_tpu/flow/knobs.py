@@ -84,6 +84,18 @@ class ServerKnobs(Knobs):
         # storageTeamSize; clamped to the available process count)
         self._init("log_replication_factor", 2)
         self._init("storage_team_size", 2)
+        # How long recovery waits for a manifest machine to return before
+        # declaring it lost and recovering from the surviving replicas
+        # (possible only while the lost-count stays under the replication
+        # factor; ref: the required/desired TLog policy satisfaction wait in
+        # epochEnd, TagPartitionedLogSystem.actor.cpp).
+        self._init("recovery_missing_machine_grace", 4.0)
+        # Idle proxies still cut empty commit batches at this cadence so
+        # they receive other proxies' state transactions from the resolvers
+        # and the resolver's retention GC advances (ref: the
+        # COMMIT_TRANSACTION_BATCH_INTERVAL_MIN empty-batch tick in
+        # MasterProxyServer.actor.cpp commitBatcher).
+        self._init("commit_batch_idle_interval", 0.25)
         # Ratekeeper (ref: Ratekeeper.actor.cpp knobs, distilled)
         self._init("ratekeeper_max_tps", 100000.0)
         self._init("ratekeeper_min_tps", 10.0)

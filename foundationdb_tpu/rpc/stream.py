@@ -39,7 +39,14 @@ class _Envelope:
 
 class Reply:
     """Server-side handle for answering one request; send() travels back to
-    the caller's one-shot reply endpoint (ref: ReplyPromise fdbrpc.h:94)."""
+    the caller's one-shot reply endpoint (ref: ReplyPromise fdbrpc.h:94).
+
+    Dropping a Reply unanswered sends broken_promise to the requester —
+    exactly the reference's NetSAV/ReplyPromise destructor semantics: a
+    server actor that dies (e.g. its role was replaced by a new generation
+    on the same live process) breaks the caller's promise instead of
+    leaving it hanging forever (ref: ReplyPromise ~destructor sendError,
+    fdbrpc.h:94-120)."""
 
     __slots__ = ("_net", "_src", "_reply_to", "_sent")
 
@@ -62,6 +69,13 @@ class Reply:
         self._net.send_from(
             self._src, self._reply_to, wire, priority=TaskPriority.DefaultPromiseEndpoint
         )
+
+    def __del__(self):
+        if not self._sent and self._reply_to is not None:
+            try:
+                self._send((True, "broken_promise"))
+            except Exception:  # noqa: BLE001 - interpreter teardown
+                pass
 
 
 class RequestStream:
@@ -96,6 +110,12 @@ class RequestStream:
     def pop(self) -> Future:
         """Future of the next (request, Reply)."""
         return self._stream.pop()
+
+    def is_ready(self) -> bool:
+        """A request is already queued (pop() would complete immediately) —
+        lets servers drain a burst into one batch (ref: the queued-request
+        draining in transactionStarter, MasterProxyServer.actor.cpp:948)."""
+        return self._stream.is_ready()
 
     def ref(self) -> "RequestStreamRef":
         return RequestStreamRef(self.endpoint, self.name)

@@ -36,6 +36,7 @@ class SimCluster:
         n_resolvers: int = 1,
         n_storages: int = 1,
         n_tlogs: int = 1,
+        n_proxies: int = 1,
     ):
         self.loop = loop or EventLoop(seed=seed)
         set_event_loop(self.loop)
@@ -60,7 +61,11 @@ class SimCluster:
             for i in range(n_storages)
         ]
         self.storage_proc = self.storage_procs[0]
-        self.proxy_proc = self.net.process("proxy")
+        self.proxy_procs = [
+            self.net.process(f"proxy{i}" if i else "proxy")
+            for i in range(n_proxies)
+        ]
+        self.proxy_proc = self.proxy_procs[0]
         self._n_clients = 0
         self.split_keys = even_split_keys(n_resolvers)
 
@@ -79,6 +84,7 @@ class SimCluster:
                     p,
                     backend=conflict_backend,
                     conflict_set=conflict_set if i == 0 else None,
+                    n_proxies=n_proxies,
                 )
                 for i, p in enumerate(self.resolver_procs)
             ]
@@ -98,13 +104,19 @@ class SimCluster:
                 for i, p in enumerate(self.storage_procs)
             ]
             self.storage = self.storages[0]
-            self.proxy = Proxy(
-                self.proxy_proc,
-                self.sequencer.interface(),
-                [r.interface() for r in self.resolvers],
-                tlog_ifaces,
-                resolver_split_keys=self.split_keys,
-            )
+            self.proxies = [
+                Proxy(
+                    p,
+                    self.sequencer.interface(),
+                    [r.interface() for r in self.resolvers],
+                    tlog_ifaces,
+                    resolver_split_keys=self.split_keys,
+                    proxy_id=f"proxy{i}",
+                    n_proxies=n_proxies,
+                )
+                for i, p in enumerate(self.proxy_procs)
+            ]
+            self.proxy = self.proxies[0]
 
     def data_distributor(self):
         """A DataDistributor driving this cluster (its own client process);
@@ -147,6 +159,7 @@ class SimCluster:
                 [self.tlog.interface()],
                 epoch_begin_version=epoch_begin,
             )
+            self.proxies = [self.proxy]
 
         self.loop.run_until(self.master_proc.spawn(build(), "recovery"))
 
@@ -202,7 +215,10 @@ class SimCluster:
         self._n_clients += 1
         proc = self.net.process(name or f"client{self._n_clients}")
         return Database(
-            proc, self.proxy.interface(), self.storage.interface()
+            proc,
+            self.proxy.interface(),
+            self.storage.interface(),
+            proxies=[p.interface() for p in self.proxies],
         )
 
     def run_until(self, future, timeout_vt: float = 1000.0):

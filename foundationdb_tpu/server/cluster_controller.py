@@ -55,8 +55,9 @@ class ClientDBInfo:
     """What clients need (ref: fdbclient ClientDBInfo: proxy list)."""
 
     generation: int = 0
-    proxy: object = None  # ProxyInterface
+    proxy: object = None  # ProxyInterface (first proxy; convenience)
     storage: object = None  # StorageInterface (single-shard v1)
+    proxies: list = field(default_factory=list)  # all ProxyInterfaces
 
 
 class ClusterController:
@@ -67,12 +68,14 @@ class ClusterController:
         conflict_backend: str = "cpu",
         n_tlogs: int = 1,
         n_storages: int = 1,
+        n_proxies: int = 1,
     ):
         self.process = process
         self.coordinators = coordinators
         self.conflict_backend = conflict_backend
         self.n_tlogs = n_tlogs
         self.n_storages = n_storages
+        self.n_proxies = n_proxies
         self.workers: Dict[str, WorkerInterface] = {}
         self.client_info = AsyncVar(ClientDBInfo())
         self._info_waiters: list = []
@@ -185,9 +188,12 @@ class ClusterController:
             prev.get("tlog_addrs"), prev.get("storage_addrs")
         )
 
-        # LOCKING: stop every old-generation tlog, learn durable ends.
+        # LOCKING: stop every surviving old-generation tlog, learn durable
+        # ends (a None slot is a replica declared lost after the grace).
         epoch_end = prev["epoch_end"]
         for w in tlog_ws:
+            if w is None:
+                continue
             lock = await self._try(
                 w.init_role.get_reply(self.process, LockTLog())
             )
@@ -195,40 +201,87 @@ class ClusterController:
                 epoch_end = max(epoch_end, lock)
 
         # RECRUITING (ref worker.actor.cpp :494-560 Initialize* handling).
-        # Logs recover first WITHOUT a fast-forward so the true durable
-        # ends are known before the recovery version is fixed.  Epoch-end
-        # cut = min(durables): commits ack only after ALL logs fsync, so
-        # anything above the min is an un-acked orphan on a subset of logs
-        # and is truncated before the new epoch serves (ref: the epochEnd
-        # lock/version agreement, TagPartitionedLogSystem.actor.cpp).
-        tlog_ifs = []
+        # Surviving logs recover first WITHOUT a fast-forward so the true
+        # durable ends are known before the recovery version is fixed.
+        # Epoch-end cut = min(survivor durables): commits ack only after ALL
+        # logs fsync, so anything above the min is an un-acked orphan on a
+        # subset of logs and is truncated before the new epoch serves (ref:
+        # the epochEnd lock/version agreement,
+        # TagPartitionedLogSystem.actor.cpp).  With a lost replica the cut
+        # may retain entries whose ack never happened — safe: they were
+        # resolved and ordered, their clients saw commit_unknown_result.
+        tlog_ifs: list = [None] * len(tlog_ws)
         durables = []
-        for w in tlog_ws:
+        for i, w in enumerate(tlog_ws):
+            if w is None:
+                continue
             tlog_if, tlog_durable = await w.init_role.get_reply(
                 self.process,
                 InitTLog(epoch_begin=0, epoch=self.generation),
             )
-            tlog_ifs.append(tlog_if)
+            tlog_ifs[i] = tlog_if
             durables.append(tlog_durable)
         cut = min(durables)
         epoch_end = max([epoch_end] + durables)
         recovery_version = epoch_end + g_knobs.server.max_versions_in_flight
         for w in tlog_ws:
-            await w.init_role.get_reply(
-                self.process,
-                FastForwardTLog(version=recovery_version, truncate_above=cut),
-            )
-        seq_w = self._pick_stateless()
+            if w is not None:
+                await w.init_role.get_reply(
+                    self.process,
+                    FastForwardTLog(
+                        version=recovery_version, truncate_above=cut
+                    ),
+                )
+        # Fresh replacements for lost slots, at the SAME ring index so tag
+        # placement is stable; they refuse peeks below the recovery version,
+        # which routes old-epoch reads to the tag's surviving replicas.
+        if any(w is None for w in tlog_ws):
+            taken = {w.address for w in tlog_ws if w is not None}
+            candidates = [
+                self.workers[a]
+                for a in sorted(self.workers)
+                if a not in taken
+            ]
+            for i, w in enumerate(tlog_ws):
+                if w is not None:
+                    continue
+                if not candidates:
+                    raise FdbError("recruitment_failed")
+                repl = candidates.pop(0)
+                tlog_ifs[i], _d = await repl.init_role.get_reply(
+                    self.process,
+                    InitTLog(
+                        epoch_begin=recovery_version,
+                        epoch=self.generation,
+                        fresh=True,
+                    ),
+                )
+                tlog_ws[i] = repl
+        stateful_addrs = {w.address for w in tlog_ws} | {
+            w.address for w in storage_ws
+        }
+        seq_w = self._pick_stateless(avoid=stateful_addrs)
         seq_if = await seq_w.init_role.get_reply(
             self.process, InitSequencer(epoch_begin=recovery_version)
         )
-        res_w = self._pick_stateless()
+        # Pick the proxy workers FIRST so the resolver is told the exact
+        # proxy count that will be recruited (its state-txn GC waits for
+        # every proxy to check in); each worker hosts at most one proxy
+        # (role-table key "proxy"), so the count clamps to distinct workers
+        # (ref: proxy count vs worker fitness,
+        # ClusterController.actor.cpp:527-531).
+        proxy_ws = self._pick_distinct_stateless(
+            max(1, self.n_proxies), avoid=stateful_addrs
+        )
+        n_proxies = len(proxy_ws)
+        res_w = self._pick_stateless(avoid=stateful_addrs)
         res_if = await res_w.init_role.get_reply(
             self.process,
             InitResolver(
                 backend=self.conflict_backend,
                 epoch_begin=recovery_version,
                 epoch=self.generation,
+                n_proxies=n_proxies,
             ),
         )
         storage_ifs = []
@@ -238,22 +291,32 @@ class ClusterController:
                     self.process, InitStorage(tlog=list(tlog_ifs))
                 )
             )
-        proxy_w = self._pick_stateless()
-        proxy_if = await proxy_w.init_role.get_reply(
-            self.process,
-            InitProxy(
-                sequencer=seq_if,
-                resolvers=[res_if],
-                tlogs=list(tlog_ifs),
-                epoch_begin=recovery_version,
-                epoch=self.generation,
-            ),
+        from ..flow.eventloop import wait_for_all
+
+        proxy_ifs = await wait_for_all(
+            [
+                proxy_w.init_role.get_reply(
+                    self.process,
+                    InitProxy(
+                        sequencer=seq_if,
+                        resolvers=[res_if],
+                        tlogs=list(tlog_ifs),
+                        epoch_begin=recovery_version,
+                        epoch=self.generation,
+                        proxy_id=f"proxy{i}",
+                        n_proxies=len(proxy_ws),
+                    ),
+                )
+                for i, proxy_w in enumerate(proxy_ws)
+            ]
         )
+        proxy_if = proxy_ifs[0]
         self._role_addrs = {
             "sequencer": seq_w.address,
             "resolver": res_w.address,
-            "proxy": proxy_w.address,
         }
+        for i, w in enumerate(proxy_ws):
+            self._role_addrs[f"proxy{i}"] = w.address
         for i, w in enumerate(tlog_ws):
             self._role_addrs[f"tlog{i}"] = w.address
         for i, w in enumerate(storage_ws):
@@ -342,8 +405,13 @@ class ClusterController:
             )
             if team:
                 entries.append((sb, se, team))
-        await proxy_if.load_system_map.get_reply(
-            self.process, (entries, server_list)
+        await wait_for_all(
+            [
+                pif.load_system_map.get_reply(
+                    self.process, (entries, server_list)
+                )
+                for pif in proxy_ifs
+            ]
         )
 
         # FULLY_RECOVERED: publish to clients (drains parked long-polls).
@@ -352,6 +420,7 @@ class ClusterController:
                 generation=self.generation,
                 proxy=proxy_if,
                 storage=storage_ifs[0],
+                proxies=list(proxy_ifs),
             )
         )
         TraceEvent("RecoveryComplete").detail("generation", self.generation).detail(
@@ -359,35 +428,88 @@ class ClusterController:
         ).log()
 
     async def _wait_workers(self, tlog_addrs=None, storage_addrs=None):
-        """(tlog_workers, storage_workers) lists.
+        """(tlog_slots, storage_workers).
 
         With a previous generation's manifest, wait for THOSE addresses (the
         simulator reboots machines at the same address, so the disks come
         back there).  Fresh cluster: spread the stateful roles over live
         workers — tlogs from the front, storages from the back (they may
         share a worker; each worker hosts at most one of each).
+
+        `tlog_slots` is aligned with the manifest's tlog indices; an entry
+        of None marks a replica declared LOST: after
+        `recovery_missing_machine_grace` a missing machine stops blocking
+        recovery when the survivors still cover all acked data — fewer than
+        `log_replication_factor` logs lost means every tag retains at least
+        one live replica (commits ack only after ALL logs fsync), and any
+        surviving storage suffices to serve what it owns (DD heal restores
+        team width afterwards).  Losses at or beyond the replication factor
+        keep recovery waiting: proceeding could silently lose acked data.
         """
         from ..flow.eventloop import timeout_after
 
         loop = self.process.network.loop
+        last_count, last_change = -1, loop.now()
+        wait_begin = loop.now()
+        grace = g_knobs.server.recovery_missing_machine_grace
+        # Effective replication clamps to the log count (tlogs_for_tag does
+        # the same): with a single log, nothing may be declared lost.
+        rf = min(
+            g_knobs.server.log_replication_factor,
+            len(tlog_addrs) if tlog_addrs else self.n_tlogs,
+        )
         while True:
             live = await self._live_workers()
             by_addr = {w.address: w for w in live}
+            if len(live) != last_count:
+                last_count, last_change = len(live), loop.now()
+            grace_over = loop.now() - wait_begin >= grace
 
-            def pick(addrs, count, from_back):
+            def pick(addrs, count, from_back, max_lost=0):
                 if addrs:
                     ws = [by_addr.get(a) for a in addrs]
-                    return None if any(w is None for w in ws) else ws
+                    lost = sum(1 for w in ws if w is None)
+                    if lost == 0:
+                        return ws
+                    if grace_over and 0 < lost <= max_lost:
+                        TraceEvent("RecoveryProceedingDegraded").detail(
+                            "lost",
+                            [a for a, w in zip(addrs, ws) if w is None],
+                        ).log()
+                        return ws
+                    return None
                 if len(live) < count:
+                    return None
+                # Fresh cluster: wait for the worker set to stabilize before
+                # choosing homes for the disks — recruiting onto the single
+                # first-registered worker concentrates every stateful role
+                # (and its files) on one machine (ref: the CC waiting on
+                # RecruitFromConfiguration until enough workers of suitable
+                # fitness exist, ClusterController.actor.cpp:341+).
+                if loop.now() - last_change < 0.75:
                     return None
                 return (
                     live[-count:] if from_back else live[:count]
                 )
 
-            tlog_ws = pick(tlog_addrs, self.n_tlogs, False)
-            storage_ws = pick(storage_addrs, self.n_storages, True)
+            tlog_ws = pick(tlog_addrs, self.n_tlogs, False, max_lost=rf - 1)
+            # At most team_size-1 storages may be lost: a whole team gone
+            # means some shard has no surviving replica.
+            storage_ws = pick(
+                storage_addrs,
+                self.n_storages,
+                True,
+                max_lost=min(
+                    g_knobs.server.storage_team_size,
+                    len(storage_addrs) if storage_addrs else 1,
+                )
+                - 1,
+            )
             if tlog_ws is not None and storage_ws is not None:
-                return tlog_ws, storage_ws
+                # Lost storages are dropped (their shards live on surviving
+                # teammates); lost tlog slots stay as None so a fresh
+                # replacement keeps the tag ring's size and indices.
+                return tlog_ws, [w for w in storage_ws if w is not None]
             TraceEvent("RecoveryWaitingForWorkers").detail(
                 "tlog_addrs", tlog_addrs
             ).detail("storage_addrs", storage_addrs).log()
@@ -410,12 +532,37 @@ class ClusterController:
         out.sort(key=lambda w: w.address)
         return out
 
-    def _pick_stateless(self) -> WorkerInterface:
-        """Spread stateless roles across live workers round-robin-ish (ref:
-        fitness-based recruitment; refined when process classes land)."""
+    def _pick_stateless(self, avoid=()) -> WorkerInterface:
+        """Spread stateless roles across live workers round-robin-ish,
+        preferring workers NOT in `avoid` (the stateful-disk homes) so
+        losing a stateless role's process doesn't also take the only copy
+        of a disk (ref: fitness-based recruitment keeping transaction-class
+        processes off storage, ClusterController.actor.cpp:622-659)."""
         addrs = sorted(self.workers)
+        pool = [a for a in addrs if a not in avoid] or addrs
         self._rr = getattr(self, "_rr", 0) + 1
-        return self.workers[addrs[self._rr % len(addrs)]]
+        return self.workers[pool[self._rr % len(pool)]]
+
+    def _pick_distinct_stateless(self, n: int, avoid=()) -> List[WorkerInterface]:
+        """n workers, all distinct (each worker hosts at most one proxy),
+        preferring non-`avoid` workers; falls back to avoided ones only when
+        there aren't enough others."""
+        addrs = sorted(self.workers)
+        preferred = [a for a in addrs if a not in avoid]
+        pool = preferred + [a for a in addrs if a in avoid]
+        self._rr = getattr(self, "_rr", 0) + 1
+        start = self._rr
+        k = min(n, len(pool))
+        if k <= len(preferred):
+            return [
+                self.workers[preferred[(start + i) % len(preferred)]]
+                for i in range(k)
+            ]
+        # Not enough non-stateful workers: rotate over the whole pool (k <=
+        # len(pool), so modular picks stay distinct).
+        return [
+            self.workers[pool[(start + i) % len(pool)]] for i in range(k)
+        ]
 
     async def _watch_roles(self):
         """Ping every recruited role's worker; any failure starts a new

@@ -33,6 +33,7 @@ class DynamicCluster:
         loop: Optional[EventLoop] = None,
         n_tlogs: int = 1,
         n_storages: int = 1,
+        n_proxies: int = 1,
     ):
         self.loop = loop or EventLoop(seed=seed)
         set_event_loop(self.loop)
@@ -41,6 +42,7 @@ class DynamicCluster:
         self.conflict_backend = conflict_backend
         self.n_tlogs = n_tlogs
         self.n_storages = n_storages
+        self.n_proxies = n_proxies
 
         self._coord_procs = [
             self.net.process(f"coord{i}") for i in range(n_coordinators)
@@ -70,6 +72,7 @@ class DynamicCluster:
                 conflict_backend=self.conflict_backend,
                 n_tlogs=self.n_tlogs,
                 n_storages=self.n_storages,
+                n_proxies=self.n_proxies,
             )
             for p in self._cc_procs
         ]

@@ -92,14 +92,31 @@ class ProxyInterface:
 class ResolveTransactionBatchRequest:
     prev_version: int = 0
     version: int = 0
+    # Version through which this proxy has already RECEIVED resolve replies
+    # (lets the resolver GC its per-proxy reply cache; ref
+    # ResolverInterface.h lastReceivedVersion, Resolver.actor.cpp:126).
     last_received_version: int = 0
     transactions: List[TransactionConflictInfo] = field(default_factory=list)
+    # State transactions: (index-into-transactions, [Mutation]) for txns that
+    # touch the \xff system keyspace.  The resolver retains the committed
+    # ones so OTHER proxies learn metadata changes in version order (ref:
+    # txnStateTransactions ResolverInterface.h:96, retention :170-190).
+    state_txns: List[Tuple[int, list]] = field(default_factory=list)
+    proxy_id: str = "proxy0"
     epoch: int = 0  # generation guard: stale-epoch requests are rejected
 
 
 @dataclass
 class ResolveTransactionBatchReply:
     committed: List[int] = field(default_factory=list)  # conflict.types codes
+    # [(version, [(committed, [Mutation])])] for every state transaction at
+    # versions in (proxy's previous batch, this batch) — i.e. other proxies'
+    # metadata commits this proxy has not seen (ref: stateMutations
+    # ResolverInterface.h:74, filled at Resolver.actor.cpp:183-189).  Each
+    # resolver computes `committed` from its own clipped key space; the
+    # proxy applies a state txn only if EVERY resolver reports committed
+    # (ref: the min-combine at MasterProxyServer.actor.cpp:455).
+    state_mutations: List[Tuple[int, list]] = field(default_factory=list)
 
 
 @dataclass

@@ -72,9 +72,13 @@ class Proxy:
         resolver_split_keys: List[bytes] = None,
         ratekeeper=None,  # RatekeeperInterface or None (no admission control)
         system_map=None,  # recovered ([(b, e, [ids])], {id: StorageInterface})
+        proxy_id: str = "proxy0",
+        n_proxies: int = 1,
     ):
         self.process = process
         self.epoch = epoch
+        self.proxy_id = proxy_id
+        self.n_proxies = n_proxies
         self.sequencer = sequencer
         self.resolvers = resolvers
         self.tlogs = tlogs
@@ -102,9 +106,22 @@ class Proxy:
             for b, e, team in entries:
                 self.key_servers.set_range(b, e, (tuple(team), tuple(team)))
             self.server_list = dict(server_list)
-        # Metadata applies in version order across overlapped batches (the
-        # prevVersion chain, like the log's).
+        # Metadata applies in version order across THIS proxy's overlapped
+        # batches (the own-version chain); versions granted to other proxies
+        # in between are covered by the resolvers' state-mutation replies
+        # (ref: resolution[0].stateMutations applied at
+        # MasterProxyServer.actor.cpp:449-466 before own tag assignment).
         self._meta_version = NotifiedVersion(epoch_begin_version)
+        self._last_own_version = epoch_begin_version
+        # Local batch numbering serializes phase 1 so this proxy's versions
+        # are granted in local batch order (ref: localBatchNumber and the
+        # latestLocalCommitBatchResolving chain :362).
+        self._local_batches = 0
+        self._batch_resolving = NotifiedVersion(0)
+        # Version through which resolve replies have been processed; rides
+        # the next request so resolvers GC their reply caches (ref
+        # lastReceivedVersion).
+        self._last_received = epoch_begin_version
         self._commit_stream = RequestStream(process, "commit", well_known=True)
         self._grv_stream = RequestStream(process, "grv", well_known=True)
         self._loc_stream = RequestStream(
@@ -114,7 +131,10 @@ class Proxy:
             process, "load_system_map", well_known=True
         )
         self.stats = {"committed": 0, "conflicted": 0, "too_old": 0, "batches": 0}
+        self._last_batch_cut = process.network.loop.now()
         process.spawn(self._commit_batcher(), "proxy_batcher")
+        if n_proxies > 1:
+            process.spawn(self._idle_batch_ticker(), "proxy_idle_tick")
         process.spawn(self._serve_grv(), "proxy_grv")
         process.spawn(self._serve_locations(), "proxy_locations")
         process.spawn(self._serve_load_map(), "proxy_load_map")
@@ -206,10 +226,12 @@ class Proxy:
             tags = tuple(sorted(set(src) | set(dest)))
             self.key_servers.set_range(begin, end, (route, tags))
 
-    # --- GRV (ref transactionStarter :934; single-proxy causal shortcut) ---
+    # --- GRV (ref transactionStarter :934) ---
     async def _serve_grv(self):
-        """Release read versions no faster than the ratekeeper's budget
-        (ref: transactionStarter draining its queue against the rate)."""
+        """Batched read-version service: drain every queued request into one
+        batch, spend the ratekeeper budget for the whole batch, answer all
+        with one version (ref: transactionStarter draining its queue against
+        the rate, MasterProxyServer.actor.cpp:934-1033)."""
         loop = self.process.network.loop
         budget = 1.0
         last_refill = loop.now()
@@ -217,6 +239,10 @@ class Proxy:
         last_fetch = -1e9
         while True:
             _req, reply = await self._grv_stream.pop()
+            batch = [reply]
+            while self._grv_stream.is_ready():
+                _r, rep = await self._grv_stream.pop()
+                batch.append(rep)
             if self.ratekeeper is not None:
                 if loop.now() - last_fetch > 0.1:
                     try:
@@ -229,24 +255,64 @@ class Proxy:
                     last_fetch = loop.now()
                 if tps is not None:
                     now = loop.now()
-                    budget = min(
-                        budget + (now - last_refill) * tps, max(1.0, tps * 0.1)
-                    )
+                    cap = max(float(len(batch)), tps * 0.1)
+                    budget = min(budget + (now - last_refill) * tps, cap)
                     last_refill = now
-                    while budget < 1.0:
+                    while budget < len(batch):
                         # Floor the wait: a sub-float-resolution delay would
                         # not advance virtual time and the loop would spin.
                         await loop.delay(
-                            max((1.0 - budget) / max(tps, 1e-6), 5e-4)
+                            max(
+                                (len(batch) - budget) / max(tps, 1e-6), 5e-4
+                            )
                         )
                         now = loop.now()
-                        budget = min(
-                            budget + (now - last_refill) * tps,
-                            max(1.0, tps * 0.1),
-                        )
+                        budget = min(budget + (now - last_refill) * tps, cap)
                         last_refill = now
-                    budget -= 1.0
-            reply.send(self.committed.get())
+                    budget -= len(batch)
+            version = self.committed.get()
+            if self.n_proxies > 1:
+                # Another proxy may have committed (and acked) beyond this
+                # proxy's chain; the sequencer's committed watermark covers
+                # every proxy because each reports before replying to
+                # clients (ref: GRV asking all proxies + confirming logs,
+                # :956-1001 — the sequencer read is this rebuild's
+                # equivalent causal floor).
+                try:
+                    version = max(
+                        version,
+                        await self.sequencer.get_committed_version.get_reply(
+                            self.process, None
+                        ),
+                    )
+                except Exception:  # noqa: BLE001 - sequencer died: this
+                    # generation is ending; clients will retry against the
+                    # next one.
+                    for rep in batch:
+                        rep.send_error("broken_promise")
+                    continue
+            for rep in batch:
+                rep.send(version)
+
+    async def _idle_batch_ticker(self):
+        """Cut an EMPTY commit batch when no real batch has gone out for a
+        while: the resolve round-trip delivers other proxies' state
+        transactions (keeping this proxy's shard/tag map current even with
+        zero commit traffic) and advances the resolver's per-proxy
+        lastVersion so its retention GC can run (ref: the empty-batch tick
+        in commitBatcher, MasterProxyServer.actor.cpp; Resolver GC
+        :196-218)."""
+        loop = self.process.network.loop
+        interval = g_knobs.server.commit_batch_idle_interval
+        while True:
+            await loop.delay(interval)
+            if loop.now() - self._last_batch_cut < interval:
+                continue
+            self._last_batch_cut = loop.now()
+            self._local_batches += 1
+            self.process.spawn(
+                self._commit_batch([], self._local_batches), "idle_batch"
+            )
 
     # --- commit batching (ref batcher.actor.h + commitBatch :318) ---
     async def _commit_batcher(self):
@@ -273,12 +339,31 @@ class Proxy:
                     break
                 loop.cancel_timer(timer)
                 batch.append(val)
-            self.process.spawn(self._commit_batch(batch), "commit_batch")
+            self._last_batch_cut = loop.now()
+            self._local_batches += 1
+            self.process.spawn(
+                self._commit_batch(batch, self._local_batches), "commit_batch"
+            )
 
-    async def _commit_batch(self, batch: List[Tuple]):
+    async def _commit_batch(self, batch: List[Tuple], local_batch: int):
+        ctx: dict = {}
         try:
-            await self._commit_batch_impl(batch)
+            await self._commit_batch_impl(batch, local_batch, ctx)
         except Exception:  # noqa: BLE001
+            # Unwedge the local chains so later batches don't deadlock
+            # behind this one: they fail fast (the same dead role) and their
+            # clients get commit_unknown_result instead of hanging until
+            # failure detection replaces the generation.  Skipping this
+            # batch's metadata application is safe: nothing after it can
+            # durably commit in this generation (phase 4 requires ALL logs),
+            # and recovery rebuilds the map from storage ownership.
+            self._batch_resolving.set(
+                max(self._batch_resolving.get(), local_batch)
+            )
+            if "version" in ctx:
+                self._meta_version.set(
+                    max(self._meta_version.get(), ctx["version"])
+                )
             # A phase RPC failed (e.g. resolver/tlog died mid-batch).  The
             # outcome is genuinely unknown — the log may or may not have made
             # it durable — so every client gets commit_unknown_result (ref:
@@ -286,21 +371,33 @@ class Proxy:
             for _req, reply in batch:
                 reply.send_error("commit_unknown_result")
 
-    async def _commit_batch_impl(self, batch: List[Tuple]):
+    async def _commit_batch_impl(
+        self, batch: List[Tuple], local_batch: int, ctx: dict = None
+    ):
         from ..flow.eventloop import wait_for_all
 
         self.stats["batches"] += 1
-        # Phase 1: commit version from the sequencer (ref
-        # GetCommitVersionRequest -> masterserver getVersion :783).
+        # Phase 1: commit version from the sequencer, serialized in local
+        # batch order so this proxy's versions are monotone in batch order
+        # (ref: the localBatchNumber chain :362; GetCommitVersionRequest ->
+        # masterserver getVersion :783).
+        await self._batch_resolving.when_at_least(local_batch - 1)
         gv: GetCommitVersionReply = await self.sequencer.get_commit_version.get_reply(
             self.process, None
         )
         version, prev = gv.version, gv.prev_version
+        if ctx is not None:
+            ctx["version"] = version
+        own_prev, self._last_own_version = self._last_own_version, version
+        self._batch_resolving.set(local_batch)
 
         # Phase 2: resolution.  One ResolveTransactionBatchRequest per
         # resolver; each resolver sees the ranges in its key space (the
         # mesh-sharded ConflictSet clips on device) and verdicts are
         # min-combined (ref ResolutionRequestBuilder :237, combine :492-499).
+        # Transactions touching \xff are state transactions: their mutations
+        # ride the request so the resolvers can hand them to other proxies
+        # (ref ResolutionRequestBuilder :307).
         infos = [
             TransactionConflictInfo(
                 read_snapshot=req.transaction.read_snapshot,
@@ -309,6 +406,15 @@ class Proxy:
             )
             for (req, _reply) in batch
         ]
+        state_txns = [
+            (t, list(req.transaction.mutations))
+            for t, (req, _reply) in enumerate(batch)
+            if any(
+                m.param1 >= b"\xff"
+                or (m.type == MutationType.CLEAR_RANGE and m.param2 > b"\xff")
+                for m in req.transaction.mutations
+            )
+        ]
         replies = await wait_for_all(
             [
                 r.resolve.get_reply(
@@ -316,9 +422,12 @@ class Proxy:
                     ResolveTransactionBatchRequest(
                         prev_version=prev,
                         version=version,
+                        last_received_version=self._last_received,
                         transactions=[
                             split_ranges_for_resolver(tr, lo, hi) for tr in infos
                         ],
+                        state_txns=state_txns,
+                        proxy_id=self.proxy_id,
                         epoch=self.epoch,
                     ),
                 )
@@ -329,15 +438,25 @@ class Proxy:
             min(rep.committed[t] for rep in replies) for t in range(len(batch))
         ]
 
-        # Phase 3: post-resolution processing, strictly in version order
-        # (the prevVersion chain): versionstamp substitution (ref :269-274),
-        # metadata application, THEN per-tag assembly — so a batch's tags
-        # are computed against every earlier batch's (and its own) metadata,
-        # exactly like the reference's applyMetadataMutations :457 before
-        # tag assignment :547-600.  Without the ordering, a write pipelined
-        # behind a startMove could miss the destination's tag and silently
-        # diverge the new replica.
-        await self._meta_version.when_at_least(prev)
+        # Phase 3: post-resolution processing, strictly in this proxy's own
+        # version order: first the OTHER proxies' state transactions for the
+        # versions in between (from the resolvers' replies, committed on
+        # every resolver — ref :449-466), then own versionstamp substitution
+        # (ref :269-274), own metadata application, THEN per-tag assembly —
+        # so a batch's tags are computed against every earlier batch's (and
+        # its own) metadata, exactly like the reference's
+        # applyMetadataMutations :457 before tag assignment :547-600.
+        # Without the ordering, a write pipelined behind a startMove could
+        # miss the destination's tag and silently diverge the new replica.
+        await self._meta_version.when_at_least(own_prev)
+        for vi, (_v, txns) in enumerate(replies[0].state_mutations):
+            for ti, (committed, muts) in enumerate(txns):
+                if committed and all(
+                    rep.state_mutations[vi][1][ti][0] for rep in replies[1:]
+                ):
+                    for m in muts:
+                        self._intercept_metadata(m)
+        self._last_received = max(self._last_received, version)
         tagged: dict = {}
         seq = 0
         for t, ((req, _reply), status) in enumerate(zip(batch, statuses)):

@@ -3,14 +3,20 @@
 Ref: Resolver.actor.cpp resolveBatch :71 — per-proxy ordering by prevVersion
 (:104-115 via NotifiedVersion), ConflictBatch over the ConflictSet
 (:140-153), window GC at version - MAX_WRITE_TRANSACTION_LIFE_VERSIONS
-(:153).  The conflict backend is pluggable (conflict.api.ConflictSet):
-"cpu", "jax", "hybrid", or a mesh-sharded set from parallel/ — the
-north-star swap point (BASELINE.json).
+(:153), per-proxy reply cache (`outstandingBatches` :125-128, duplicate
+reply :240-256) and state-transaction retention for the other proxies
+(`recentStateTransactions` :170-190).  The conflict backend is pluggable
+(conflict.api.ConflictSet): "cpu", "jax", "hybrid", or a mesh-sharded set
+from parallel/ — the north-star swap point (BASELINE.json).
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
+from typing import Dict
+
 from ..conflict.api import ConflictSet
+from ..conflict.types import COMMITTED
 from ..flow.asyncvar import NotifiedVersion
 from ..flow.knobs import g_knobs
 from ..rpc.network import SimProcess
@@ -22,6 +28,17 @@ from .interfaces import (
 )
 
 
+@dataclass
+class _ProxyInfo:
+    """Ref: ProxyRequestsInfo Resolver.actor.cpp — lastVersion + the
+    outstanding reply cache keyed by version."""
+
+    last_version: int = 0
+    outstanding: Dict[int, ResolveTransactionBatchReply] = field(
+        default_factory=dict
+    )
+
+
 class Resolver:
     def __init__(
         self,
@@ -30,14 +47,21 @@ class Resolver:
         epoch_begin_version: int = 0,
         conflict_set: ConflictSet = None,
         epoch: int = 0,
+        n_proxies: int = 1,
     ):
         self.process = process
         self.epoch = epoch
+        self.n_proxies = n_proxies
         self.conflicts = conflict_set or ConflictSet(
             backend=backend, oldest_version=epoch_begin_version
         )
         self.version = NotifiedVersion(epoch_begin_version)
         self.total_resolved = 0
+        # Committed state transactions by version, retained until every
+        # proxy's lastVersion has passed them (ref :170-224).
+        self._recent_state_txns: Dict[int, list] = {}
+        self._proxy_info: Dict[str, _ProxyInfo] = {}
+        self._epoch_begin = epoch_begin_version
         self._stream = RequestStream(process, "resolve", well_known=True)
         process.spawn(self._serve(), "resolver")
 
@@ -56,19 +80,61 @@ class Resolver:
         # Order batches by the sequencer's prevVersion chain: a batch may
         # arrive before its predecessor (ref :104-115).
         await self.version.when_at_least(req.prev_version)
-        if req.version > self.version.get():
-            batch = self.conflicts.new_batch()
-            for tr in req.transactions:
-                batch.add_transaction(tr)
-            window = g_knobs.server.max_write_transaction_life_versions
-            statuses = batch.detect_conflicts(
-                now=req.version, new_oldest_version=req.version - window
-            )
-            self.total_resolved += len(statuses)
-            self.version.set(req.version)
-            reply.send(ResolveTransactionBatchReply(committed=statuses))
-        else:
-            # Duplicate/replayed batch (proxy retry after timeout): the
-            # reference answers from its per-proxy reply cache; with a
-            # single proxy a duplicate can only be a stale retry.
-            reply.send_error("operation_failed")
+        if self.version.get() != req.prev_version:
+            # Duplicate/replayed batch (proxy retry after timeout): answer
+            # from the per-proxy reply cache (ref :240-256).
+            pinfo = self._proxy_info.get(req.proxy_id)
+            cached = pinfo.outstanding.get(req.version) if pinfo else None
+            if cached is not None:
+                reply.send(cached)
+            else:
+                reply.send_error("operation_failed")
+            return
+
+        pinfo = self._proxy_info.setdefault(
+            req.proxy_id, _ProxyInfo(last_version=self._epoch_begin)
+        )
+        # The proxy has received everything through last_received_version;
+        # drop those cached replies (ref :126-128).
+        for v in [
+            v for v in pinfo.outstanding if v <= req.last_received_version
+        ]:
+            del pinfo.outstanding[v]
+        first_unseen = pinfo.last_version + 1
+        pinfo.last_version = req.version
+
+        batch = self.conflicts.new_batch()
+        for tr in req.transactions:
+            batch.add_transaction(tr)
+        window = g_knobs.server.max_write_transaction_life_versions
+        statuses = batch.detect_conflicts(
+            now=req.version, new_oldest_version=req.version - window
+        )
+        self.total_resolved += len(statuses)
+
+        # Retain this batch's state transactions with their verdicts so the
+        # other proxies' next batches learn them (ref :170-181).
+        if req.state_txns:
+            self._recent_state_txns[req.version] = [
+                (statuses[t] == COMMITTED, muts) for t, muts in req.state_txns
+            ]
+        out = ResolveTransactionBatchReply(
+            committed=statuses,
+            state_mutations=[
+                (v, self._recent_state_txns[v])
+                for v in sorted(self._recent_state_txns)
+                if first_unseen <= v < req.version
+            ],
+        )
+        pinfo.outstanding[req.version] = out
+
+        # GC retained state txns below every proxy's lastVersion — only once
+        # all proxies have checked in, else an unseen proxy could miss state
+        # (ref :196-218 requiring proxyInfoMap complete).
+        if len(self._proxy_info) >= self.n_proxies:
+            oldest = min(p.last_version for p in self._proxy_info.values())
+            for v in [v for v in self._recent_state_txns if v <= oldest]:
+                del self._recent_state_txns[v]
+
+        self.version.set(req.version)
+        reply.send(out)

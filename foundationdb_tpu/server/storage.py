@@ -402,14 +402,19 @@ class StorageServer:
         best = max(bound, self._kc_cache)
         if reply.end_version <= best:
             return best  # nothing new to confirm
-        durables = []
-        for tl in self.tlogs:
-            try:
-                durables.append(
-                    await tl.confirm.get_reply(self.process, None)
-                )
-            except FdbError:
-                return best  # a log is unreachable: only (a) is safe
+        from ..flow.eventloop import wait_for_all
+
+        try:
+            # One concurrent round — serial probes would multiply catch-up
+            # latency by the log count.
+            durables = await wait_for_all(
+                [
+                    tl.confirm.get_reply(self.process, None)
+                    for tl in self.tlogs
+                ]
+            )
+        except FdbError:
+            return best  # a log is unreachable: only (a) is safe
         m = min(durables)
         if m > self._kc_cache:
             self._kc_cache = m
@@ -821,7 +826,22 @@ class StorageServer:
             # The window below the durable floor is gone (ref: reads below
             # oldestVersion -> transaction_too_old, storageserver :640).
             raise FdbError("transaction_too_old")
-        await self.version.when_at_least(version)
+        if self.version.get() < version:
+            # Bounded wait: if this server's log stream has stalled (tlog
+            # dead, generation ending), fail the read instead of parking
+            # forever — the client retries with a fresh version against the
+            # next generation (ref: the FUTURE_VERSION_DELAY timeout in
+            # waitForVersion throwing future_version, storageserver :631).
+            from ..flow.eventloop import timeout_after
+
+            got = await timeout_after(
+                self.process.network.loop,
+                self.version.when_at_least(version),
+                1.0,
+                default=None,
+            )
+            if got is None and self.version.get() < version:
+                raise FdbError("future_version")
         if version < self.durable_version:  # floor may have risen across the wait
             raise FdbError("transaction_too_old")
 

@@ -37,9 +37,19 @@ class TLog:
         epoch_begin_version: int = 0,
         disk_queue=None,
         epoch: int = 0,
+        begin_version: int = 0,
     ):
         self.process = process
         self.epoch = epoch
+        # First version this log could possibly hold.  A FRESH log recruited
+        # to replace a permanently lost replica starts at the recovery
+        # version: peeks below it must ERROR (not silently advance past old
+        # versions it never saw) so storages fail over to a surviving
+        # replica of their tag for old-epoch data (ref: the old-log-system
+        # epochs in LogSystemConfig; peek cursors route pre-recovery reads
+        # to the previous generation's logs, TagPartitionedLogSystem
+        # :568-581).
+        self.begin_version = begin_version
         # Parallel sorted lists: versions[i] holds entries[i], a per-tag
         # mutation bundle {tag: [(seq, Mutation)]}.
         self.versions: List[int] = []
@@ -171,9 +181,40 @@ class TLog:
         self._trim()  # consumers with vacuous floors never pop again
         reply.send(req.version)
 
+    @classmethod
+    async def fresh(
+        cls,
+        process: SimProcess,
+        fs,
+        filename: str = "tlog.dq",
+        epoch_begin: int = 0,
+        epoch: int = 0,
+    ) -> "TLog":
+        """A brand-new durable log replacing a permanently lost replica.
+        Any stale file from an earlier generation on this machine is
+        deleted first — recovering it would resurrect a log that MISSED the
+        epochs between its death and now and silently skip mutations."""
+        from ..fileio.diskqueue import DiskQueue
+
+        if fs.exists(process, filename):
+            fs.delete(process, filename)
+        q, _records = await DiskQueue.open(fs, process, filename)
+        log = cls(
+            process,
+            epoch_begin_version=epoch_begin,
+            disk_queue=q,
+            epoch=epoch,
+            begin_version=epoch_begin,
+        )
+        return log
+
     async def _serve_peek(self):
         while True:
             req, reply = await self._peek_stream.pop()
+            if req.begin_version < self.begin_version:
+                # This log cannot answer for versions before it existed.
+                reply.send_error("peek_below_begin")
+                continue
             i = bisect_right(self.versions, req.begin_version)
             j = min(i + req.limit_versions, len(self.versions))
             # Only durable versions are visible to peeks.

@@ -43,6 +43,7 @@ class InitResolver:
     backend: str = "cpu"
     epoch_begin: int = 0
     epoch: int = 0
+    n_proxies: int = 1
 
 
 @dataclass
@@ -50,6 +51,9 @@ class InitTLog:
     epoch_begin: int = 0
     recover_from_disk: bool = True
     epoch: int = 0
+    # Replacement for a permanently lost replica: wipe any stale file and
+    # start a new durable log that only serves >= epoch_begin.
+    fresh: bool = False
 
 
 @dataclass
@@ -87,6 +91,8 @@ class InitProxy:
     tlogs: List[TLogInterface] = field(default_factory=list)
     epoch_begin: int = 0
     epoch: int = 0
+    proxy_id: str = "proxy0"
+    n_proxies: int = 1
 
 
 class WorkerServer:
@@ -163,11 +169,20 @@ class WorkerServer:
                     backend=req.backend,
                     epoch_begin_version=req.epoch_begin,
                     epoch=req.epoch,
+                    n_proxies=req.n_proxies,
                 )
                 self._replace_role("resolver", role, new_tasks())
                 reply.send(role.interface())
             elif isinstance(req, InitTLog):
-                if req.recover_from_disk:
+                if req.fresh:
+                    role = await TLog.fresh(
+                        self.process,
+                        self.fs,
+                        "tlog.dq",
+                        epoch_begin=req.epoch_begin,
+                        epoch=req.epoch,
+                    )
+                elif req.recover_from_disk:
                     role = await TLog.recover(
                         self.process,
                         self.fs,
@@ -217,6 +232,8 @@ class WorkerServer:
                     req.tlogs,
                     epoch_begin_version=req.epoch_begin,
                     epoch=req.epoch,
+                    proxy_id=req.proxy_id,
+                    n_proxies=req.n_proxies,
                 )
                 self._replace_role("proxy", role, new_tasks())
                 reply.send(role.interface())

@@ -329,3 +329,150 @@ def test_replicated_whole_cluster_crash():
     c.run_all([(db2, db2.run(readback))], timeout_vt=3000.0)
     assert len(out["rows"]) == 20
     assert out["rows"][5] == (b"c05", b"v5")
+
+
+def _respawn_worker(c, proc):
+    """Reboot a worker process and re-attach its agent (disk survives per
+    the corruption model)."""
+    from foundationdb_tpu.flow.asyncvar import AsyncVar
+    from foundationdb_tpu.server.coordination import monitor_leader
+    from foundationdb_tpu.server.worker import (
+        WorkerServer,
+        run_worker_registration,
+    )
+
+    c.fs.crash_machine(proc.machine.machine_id)
+    proc.reboot()
+    w = WorkerServer(proc, c.fs)
+    leader_var = AsyncVar(None)
+    proc.spawn(monitor_leader(proc, c.coord_ifaces, leader_var), "leader_mon")
+    proc.spawn(run_worker_registration(w, leader_var), "registration")
+    return w
+
+
+def test_permanent_tlog_loss_recovers_from_survivors():
+    """A tlog machine that NEVER returns: after the grace period, recovery
+    proceeds from the surviving replica (every acked mutation is durable on
+    every log, so one survivor covers all acked data) and recruits a fresh
+    replacement log at the same ring slot (ref: epochEnd proceeding when
+    the policy is satisfiable without the lost replica,
+    TagPartitionedLogSystem.actor.cpp)."""
+    c, db = bootstrap(seed=81, n_workers=7, n_tlogs=2, n_storages=2)
+    committed = {b"boot": b"1"}
+
+    async def w1(tr):
+        for i in range(10):
+            tr.set(b"p%02d" % i, b"x%d" % i)
+
+    c.run_all([(db, db.run(w1))], timeout_vt=300.0)
+    for i in range(10):
+        committed[b"p%02d" % i] = b"x%d" % i
+
+    dead = c.kill_role_process("tlog0")  # machine never comes back
+
+    async def w2(tr):
+        tr.set(b"after", b"loss")
+
+    c.run_all([(db, db.run(w2))], timeout_vt=2000.0)
+    committed[b"after"] = b"loss"
+
+    out = {}
+
+    async def readback(tr):
+        for k in committed:
+            out[k] = await tr.get(k)
+
+    c.run_all([(db, db.run(readback))], timeout_vt=2000.0)
+    assert out == committed
+    # The replacement is a different machine, recorded in the new manifest.
+    assert c.acting_controller()._role_addrs["tlog0"] != dead.address
+
+
+def test_permanent_tlog_loss_storage_replays_from_survivor():
+    """The hazard case: a storage rebooting AFTER the lost log was replaced
+    must replay its pre-recovery tail from the SURVIVING replica — the
+    fresh log refuses peeks below its begin version (peek_below_begin)
+    instead of silently skipping old versions."""
+    c, db = bootstrap(seed=82, n_workers=7, n_tlogs=2, n_storages=2)
+    committed = {b"boot": b"1"}
+
+    async def w1(tr):
+        for i in range(12):
+            tr.set(b"q%02d" % i, b"y%d" % i)
+
+    c.run_all([(db, db.run(w1))], timeout_vt=300.0)
+    for i in range(12):
+        committed[b"q%02d" % i] = b"y%d" % i
+
+    # Lose tlog0 forever AND bounce a storage machine at the same time: the
+    # rebooted storage replays its log tail across the epoch boundary.
+    c.kill_role_process("tlog0")
+    sproc = c.kill_role_process("storage0")
+    _respawn_worker(c, sproc)
+
+    async def w2(tr):
+        tr.set(b"after", b"replay")
+
+    c.run_all([(db, db.run(w2))], timeout_vt=2000.0)
+    committed[b"after"] = b"replay"
+
+    out = {}
+
+    async def readback(tr):
+        for k in committed:
+            out[k] = await tr.get(k)
+
+    c.run_all([(db, db.run(readback))], timeout_vt=2000.0)
+    assert out == committed
+
+
+def test_permanent_storage_loss_recovers_from_teammate():
+    """A storage machine that never returns: recovery proceeds after the
+    grace with the surviving teammate (replication >= 2 keeps every shard
+    covered); the dead machine is dropped from the manifest so later
+    recoveries don't wait for it either."""
+    c, db = bootstrap(seed=83, n_workers=7, n_tlogs=2, n_storages=2)
+    committed = {b"boot": b"1"}
+
+    async def w1(tr):
+        for i in range(10):
+            tr.set(b"s%02d" % i, b"z%d" % i)
+
+    c.run_all([(db, db.run(w1))], timeout_vt=300.0)
+    for i in range(10):
+        committed[b"s%02d" % i] = b"z%d" % i
+
+    dead = c.kill_role_process("storage0")
+
+    async def w2(tr):
+        tr.set(b"after", b"team")
+
+    c.run_all([(db, db.run(w2))], timeout_vt=2000.0)
+    committed[b"after"] = b"team"
+
+    out = {}
+
+    async def readback(tr):
+        for k in committed:
+            out[k] = await tr.get(k)
+
+    c.run_all([(db, db.run(readback))], timeout_vt=2000.0)
+    assert out == committed
+
+    # Kill ANOTHER role to force a second recovery: it must not wait for
+    # the long-dead storage machine.
+    proc = c.kill_role_process("proxy0")
+    _respawn_worker(c, proc)
+
+    async def w3(tr):
+        tr.set(b"after2", b"second")
+
+    c.run_all([(db, db.run(w3))], timeout_vt=2000.0)
+    out2 = {}
+
+    async def check2(tr):
+        out2["v"] = await tr.get(b"after2")
+
+    c.run_all([(db, db.run(check2))], timeout_vt=2000.0)
+    assert out2["v"] == b"second"
+    assert dead.address not in c.acting_controller()._role_addrs.values()
