@@ -533,9 +533,12 @@ class Transaction:
         return version
 
     async def _commit_dummy(self, key: bytes):
-        """Fence the in-flight original (ref commitDummyTransaction :2315)."""
+        """Fence the in-flight original (ref commitDummyTransaction :2315).
+        Retries ride the client retry knobs so the fence outlasts any
+        recovery the adjacent on_error backoff would survive."""
         loop = self.db.process.network.loop
-        for attempt in range(60):
+        ck = g_knobs.client
+        for attempt in range(ck.dummy_commit_max_retries):
             tr = Transaction(self.db)
             tr.options["causal_write_risky"] = True
             tr.options["access_system_keys"] = True
@@ -556,7 +559,12 @@ class Transaction:
                     or e.name == "broken_promise"
                 ):
                     raise
-                await loop.delay(0.05 * (attempt + 1))
+                await loop.delay(
+                    min(
+                        ck.max_retry_delay,
+                        ck.initial_retry_delay * (2 ** min(attempt, 30)),
+                    )
+                )
         raise FdbError("commit_unknown_result")
 
     def _launch_watches(self, version: int):

@@ -52,6 +52,10 @@ class ClientKnobs(Knobs):
         # ref fdbclient/Knobs.cpp
         self._init("default_transaction_timeout", 0.0)  # unlimited, like ref
         self._init("max_retry_delay", 1.0)
+        # commit_unknown_result fence: attempts before surfacing the
+        # unknown result unfenced (ref: commitDummyTransaction's retry loop,
+        # NativeAPI.actor.cpp:2315).
+        self._init("dummy_commit_max_retries", 120)
         self._init("initial_retry_delay", 0.01)
         self._init("grv_batch_interval", 0.005)  # MAX_BATCH_INTERVAL
         self._init("grv_max_batch_size", 1024)
@@ -96,6 +100,13 @@ class ServerKnobs(Knobs):
         # COMMIT_TRANSACTION_BATCH_INTERVAL_MIN empty-batch tick in
         # MasterProxyServer.actor.cpp commitBatcher).
         self._init("commit_batch_idle_interval", 0.25)
+        # Storage read stall bound (ref: FUTURE_VERSION_DELAY — waitForVersion
+        # throws future_version after this rather than parking forever on a
+        # stalled log stream).
+        self._init("future_version_delay", 1.0)
+        # Fresh-cluster recruitment waits for worker registrations to stop
+        # arriving for this long before choosing disk homes.
+        self._init("recruitment_stabilize_window", 0.75)
         # Ratekeeper (ref: Ratekeeper.actor.cpp knobs, distilled)
         self._init("ratekeeper_max_tps", 100000.0)
         self._init("ratekeeper_min_tps", 10.0)

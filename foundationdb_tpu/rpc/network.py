@@ -156,6 +156,13 @@ class SimNetwork:
     def get_process(self, address: str) -> Optional[SimProcess]:
         return self._procs.get(address)
 
+    def is_unreachable(self, address: str) -> bool:
+        """True when a send could never be answered: the process is known
+        dead (simulation omniscience; the real fabric returns False and
+        relies on connection failure)."""
+        p = self._procs.get(address)
+        return p is None or not p.alive
+
     # -- latency / fault models --
     def _latency(self) -> float:
         # ref Sim2Conn: a fraction of a millisecond, randomized per packet

@@ -1,0 +1,403 @@
+"""Real TCP network fabric behind the same send/endpoint contract as the
+simulator — the Net2/FlowTransport rebuild.
+
+Ref: flow/Net2.actor.cpp:117 (the real INetwork: reactor + timers + task
+priorities) and fdbrpc/FlowTransport.actor.cpp:160 (TransportData: peer
+connection map, connectionKeeper :355 reconnect/backoff, connectionReader
+:213 framing, deliver :430 token dispatch).  The single most load-bearing
+property of the reference — the SAME role actors run on either fabric,
+selected at startup (fdbserver.actor.cpp:1468-1473) — is preserved: roles
+receive a `RealProcess` instead of a `SimProcess` and never know the
+difference.
+
+Design:
+  - One flow EventLoop per OS process, driven by `run_realtime`: due timers
+    run as virtual-time events anchored to time.monotonic(); when idle, the
+    loop blocks in selectors.select() until the next timer or socket IO.
+    This is Net2's reactor loop (boost.asio there, selectors here).
+  - Wire format: 4-byte big-endian length + pickle((token, payload)).
+    Requests are `_Envelope(request, reply_to)` like the simulator; replies
+    are (is_err, value) tuples to the one-shot reply endpoint.  Pickle
+    stands in for the reference's versioned binary serialization — fine for
+    a trusted cluster, NOT a security boundary (the reference's wire
+    protocol isn't either; TLS wraps it).
+  - Connection lifecycle: lazy connect on first send, write-queue until
+    established, reconnect-on-next-send after failure.  A closed/failed
+    connection breaks every reply promise pending on that peer
+    (ref: connectionKeeper noticing a closed connection -> broken_promise
+    on outstanding NetSAVs, FlowTransport.actor.cpp:355).
+"""
+
+from __future__ import annotations
+
+import pickle
+import selectors
+import socket
+import struct
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..flow.error import FdbError
+from ..flow.eventloop import EventLoop, Task, TaskPriority
+from ..flow.trace import TraceEvent
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 << 20
+
+
+class RealMachine:
+    """Failure-domain stand-in so role code touching process.machine works."""
+
+    def __init__(self, machine_id: str):
+        self.machine_id = machine_id
+        self.dc_id = "dc0"
+        self.processes: List["RealProcess"] = []
+
+
+class RealProcess:
+    """The local OS process as an actor group; mirrors SimProcess's surface
+    (spawn / make_endpoint / drop_endpoint / address / alive)."""
+
+    def __init__(self, network: "RealNetwork", name: str):
+        self.network = network
+        self.name = name
+        self.machine = RealMachine(network.host)
+        self.address = network.address  # host:port of our listener
+        self.machine.processes.append(self)
+        self.alive = True
+        self.excluded = False
+        self._endpoints: Dict[int, Callable] = {}
+        self._tasks: List[Task] = []
+        self._pending_on: Dict[str, set] = {}
+        network._register(self)
+
+    def spawn(self, coro, name: str = "") -> Task:
+        assert self.alive, f"spawn on dead process {self.name}"
+        t = self.network.loop.spawn(coro, name=f"{self.name}/{name}")
+        self._tasks.append(t)
+        self._tasks = [x for x in self._tasks if not x.is_ready()]
+        return t
+
+    def make_endpoint(
+        self,
+        receiver: Callable,
+        token: Optional[int] = None,
+        replace: bool = False,
+    ):
+        from .network import Endpoint
+
+        if token is None:
+            # Network-global counter: remote frames carry only the token,
+            # so dynamic tokens must be unique across every co-located
+            # process sharing this listener.
+            token = self.network._token_counter
+            self.network._token_counter += 1
+        assert replace or token not in self._endpoints, f"token {token} in use"
+        self._endpoints[token] = receiver
+        return Endpoint(self.address, token)
+
+    def drop_endpoint(self, ep):
+        self._endpoints.pop(ep.token, None)
+
+
+class _Conn:
+    """One TCP connection with framing and a write queue."""
+
+    def __init__(self, net: "RealNetwork", sock: socket.socket, peer: Optional[str]):
+        self.net = net
+        self.sock = sock
+        self.peer = peer  # host:port listener address of the remote, if known
+        self.inbuf = b""
+        self.outbuf = b""
+        self.connected = peer is None  # accepted conns are connected already
+        self.closed = False
+
+    def enqueue(self, frame: bytes):
+        self.outbuf += _LEN.pack(len(frame)) + frame
+        self.net._want_write(self)
+
+    def close(self):
+        if self.closed:
+            return
+        self.closed = True
+        try:
+            self.net.selector.unregister(self.sock)
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            self.sock.close()
+        except OSError:
+            pass
+        if self.peer is not None:
+            self.net._on_conn_closed(self)
+
+
+class RealNetwork:
+    """The real fabric: listener + peer connections + local delivery."""
+
+    def __init__(self, loop: EventLoop, host: str = "127.0.0.1", port: int = 0):
+        self.loop = loop
+        self.selector = selectors.DefaultSelector()
+        self.host = host
+        self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._listener.bind((host, port))
+        self._listener.listen(64)
+        self._listener.setblocking(False)
+        self.port = self._listener.getsockname()[1]
+        self.address = f"{host}:{self.port}"
+        self.selector.register(
+            self._listener, selectors.EVENT_READ, self._on_accept
+        )
+        self._proc_list: List[RealProcess] = []
+        self._conns: Dict[str, _Conn] = {}  # peer address -> conn
+        self.messages_sent = 0
+        self._token_counter = 1
+        self._stopped = False
+
+    # -- topology (compat surface) --
+    # NOTE: every co-located RealProcess shares this network's listener
+    # address (they are role groups inside one OS process, like roles in
+    # one fdbserver); _procs is a list, and token dispatch is global.
+    def _register(self, p: RealProcess):
+        self._proc_list.append(p)
+
+    def process(self, name: str, machine_id: Optional[str] = None) -> RealProcess:
+        return RealProcess(self, name)
+
+    def get_process(self, address: str) -> Optional[RealProcess]:
+        if address == self.address and self._proc_list:
+            return self._proc_list[0]
+        return None
+
+    def is_unreachable(self, address: str) -> bool:
+        """Unknown until a connection attempt fails (the simulator can peek
+        at the remote process's liveness; the real network cannot)."""
+        return False
+
+    def _latency(self) -> float:
+        return 0.0001
+
+    # -- sending --
+    def send_from(
+        self,
+        src: RealProcess,
+        dst,
+        payload,
+        priority: int = TaskPriority.DefaultEndpoint,
+    ):
+        if not src.alive:
+            return
+        self.messages_sent += 1
+        if dst.address == self.address:
+            # Local delivery: scheduled (never inline) so ordering matches
+            # the simulator's send-then-return semantics.
+            def deliver():
+                self._deliver_local(dst.token, payload)
+
+            self.loop._schedule(priority, deliver)
+            return
+        frame = pickle.dumps((dst.token, payload), protocol=4)
+        if len(frame) > MAX_FRAME:
+            raise ValueError("frame too large")
+        self._get_conn(dst.address).enqueue(frame)
+
+    send = send_from  # fire-and-forget compat (src unused beyond liveness)
+
+    def _reply_broken(self, msg):
+        """Unknown endpoint token on a live process: break the request's
+        reply promise (ref: FlowTransport deliver :430)."""
+        reply_to = getattr(msg, "reply_to", None)
+        if reply_to is not None and hasattr(msg, "request"):
+            # May be local or remote.
+            src = self._proc_list[0] if self._proc_list else None
+            if src is not None:
+                self.send_from(src, reply_to, (True, "broken_promise"))
+
+    # -- connections --
+    def _get_conn(self, peer: str) -> _Conn:
+        conn = self._conns.get(peer)
+        if conn is not None and not conn.closed:
+            return conn
+        host, port_s = peer.rsplit(":", 1)
+        s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        s.setblocking(False)
+        conn = _Conn(self, s, peer)
+        self._conns[peer] = conn
+        try:
+            s.connect((host, int(port_s)))
+        except BlockingIOError:
+            pass
+        except OSError:
+            self.loop._schedule(
+                TaskPriority.DefaultEndpoint, lambda c=conn: c.close()
+            )
+            return conn
+        # Handshake frame 0 announces OUR listener address so the acceptor
+        # can map this connection to a peer (ref: ConnectPacket carrying the
+        # canonical address, FlowTransport.actor.cpp:196).
+        conn.outbuf = _LEN.pack(len(self.address.encode())) + self.address.encode()
+        self.selector.register(
+            s,
+            selectors.EVENT_READ | selectors.EVENT_WRITE,
+            lambda mask, c=conn: self._on_io(c, mask),
+        )
+        return conn
+
+    def _want_write(self, conn: _Conn):
+        if conn.closed:
+            return
+        try:
+            self.selector.modify(
+                conn.sock,
+                selectors.EVENT_READ | selectors.EVENT_WRITE,
+                lambda mask, c=conn: self._on_io(c, mask),
+            )
+        except KeyError:
+            pass
+
+    def _on_accept(self, _mask):
+        try:
+            s, _addr = self._listener.accept()
+        except OSError:
+            return
+        s.setblocking(False)
+        conn = _Conn(self, s, None)  # peer learned from the handshake frame
+        self.selector.register(
+            s,
+            selectors.EVENT_READ,
+            lambda mask, c=conn: self._on_io(c, mask),
+        )
+
+    def _on_io(self, conn: _Conn, mask):
+        if conn.closed:
+            return
+        if mask & selectors.EVENT_WRITE:
+            conn.connected = True
+            if conn.outbuf:
+                try:
+                    n = conn.sock.send(conn.outbuf)
+                    conn.outbuf = conn.outbuf[n:]
+                except BlockingIOError:
+                    pass
+                except OSError:
+                    conn.close()
+                    return
+            if not conn.outbuf:
+                try:
+                    self.selector.modify(
+                        conn.sock,
+                        selectors.EVENT_READ,
+                        lambda m, c=conn: self._on_io(c, m),
+                    )
+                except KeyError:
+                    pass
+        if mask & selectors.EVENT_READ:
+            try:
+                data = conn.sock.recv(1 << 20)
+            except BlockingIOError:
+                return
+            except OSError:
+                conn.close()
+                return
+            if not data:
+                conn.close()
+                return
+            conn.inbuf += data
+            self._drain_frames(conn)
+
+    def _drain_frames(self, conn: _Conn):
+        while True:
+            if len(conn.inbuf) < _LEN.size:
+                return
+            (length,) = _LEN.unpack_from(conn.inbuf, 0)
+            if length > MAX_FRAME:
+                conn.close()
+                return
+            if len(conn.inbuf) < _LEN.size + length:
+                return
+            frame = conn.inbuf[_LEN.size : _LEN.size + length]
+            conn.inbuf = conn.inbuf[_LEN.size + length :]
+            if conn.peer is None:
+                # First frame on an accepted connection: the handshake.
+                conn.peer = frame.decode()
+                old = self._conns.get(conn.peer)
+                if old is not None and old is not conn and not old.closed:
+                    # Simultaneous connect: keep both; sends use the latest.
+                    pass
+                self._conns[conn.peer] = conn
+                continue
+            try:
+                token, payload = pickle.loads(frame)
+            except Exception:  # noqa: BLE001 - corrupt frame: drop conn
+                conn.close()
+                return
+            self._deliver_local(token, payload)
+
+    def _deliver_local(self, token: int, payload):
+        for p in self._proc_list:
+            receiver = p._endpoints.get(token)
+            if receiver is not None:
+                receiver(payload)
+                return
+        self._reply_broken(payload)
+
+    def _on_conn_closed(self, conn: _Conn):
+        """Break reply promises pending on the lost peer (ref: the NetSAV
+        breakage on connection failure, FlowTransport.actor.cpp:355)."""
+        if self._conns.get(conn.peer) is conn:
+            del self._conns[conn.peer]
+        TraceEvent("ConnectionClosed").detail("peer", conn.peer).log()
+        for p in self._proc_list:
+            pending = p._pending_on.pop(conn.peer, None)
+            if not pending:
+                continue
+            for promise, reply_ep in pending:
+                p.drop_endpoint(reply_ep)
+                if not promise.is_set():
+                    self.loop._schedule(
+                        TaskPriority.DefaultEndpoint,
+                        lambda pr=promise: (
+                            None
+                            if pr.is_set()
+                            else pr.send_error(FdbError("broken_promise"))
+                        ),
+                    )
+
+    # -- the reactor loop (ref: Net2::run flow/Net2.actor.cpp:121) --
+    def stop(self):
+        self._stopped = True
+
+    def run_realtime(
+        self,
+        until=None,
+        timeout_s: Optional[float] = None,
+    ):
+        """Drive timers + IO on wall-clock time.  `until`: optional Future;
+        returns its value when ready.  Virtual `loop.now()` is anchored to
+        time.monotonic() at first call."""
+        loop = self.loop
+        t0 = time.monotonic() - loop._now
+        deadline = None if timeout_s is None else time.monotonic() + timeout_s
+        while not self._stopped:
+            if until is not None and until.is_ready():
+                return until.get()
+            if loop.failed_actors:
+                name, err = loop.failed_actors[0]
+                loop.failed_actors = []
+                raise RuntimeError(
+                    f"unhandled exception in actor {name!r}: {err!r}"
+                ) from err
+            if deadline is not None and time.monotonic() > deadline:
+                raise TimeoutError("run_realtime deadline exceeded")
+            now = time.monotonic() - t0
+            if loop._heap and loop._heap[0][0] <= now:
+                # Due event: let virtual time follow the wall clock.
+                loop.run_one()
+                continue
+            wait = min(loop._heap[0][0] - now, 0.05) if loop._heap else 0.05
+            events = self.selector.select(max(0.0, wait))
+            loop._now = time.monotonic() - t0
+            for key, mask in events:
+                key.data(mask)
+        return None

@@ -138,10 +138,11 @@ class RequestStreamRef:
         """
         net = src.network
         out = Promise(priority=TaskPriority.DefaultPromiseEndpoint)
-        dst_proc = net.get_process(self.endpoint.address)
-        if dst_proc is None or not dst_proc.alive:
-            # Target already down: fail after a connection-attempt latency
-            # (ref: failed connect -> broken_promise on the reply).
+        if net.is_unreachable(self.endpoint.address):
+            # Target known-down (the simulator can peek at remote liveness;
+            # a real network only learns from a failed connect): fail after
+            # a connection-attempt latency (ref: failed connect ->
+            # broken_promise on the reply).
             net.loop._schedule(
                 TaskPriority.DefaultPromiseEndpoint,
                 lambda: out.send_error(BrokenPromise()),

@@ -486,7 +486,10 @@ class ClusterController:
                 # (and its files) on one machine (ref: the CC waiting on
                 # RecruitFromConfiguration until enough workers of suitable
                 # fitness exist, ClusterController.actor.cpp:341+).
-                if loop.now() - last_change < 0.75:
+                if (
+                    loop.now() - last_change
+                    < g_knobs.server.recruitment_stabilize_window
+                ):
                     return None
                 return (
                     live[-count:] if from_back else live[:count]

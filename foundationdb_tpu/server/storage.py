@@ -837,7 +837,7 @@ class StorageServer:
             got = await timeout_after(
                 self.process.network.loop,
                 self.version.when_at_least(version),
-                1.0,
+                g_knobs.server.future_version_delay,
                 default=None,
             )
             if got is None and self.version.get() < version:

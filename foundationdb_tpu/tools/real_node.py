@@ -1,0 +1,145 @@
+"""Boot framework roles as a REAL OS process on TCP — the `fdbserver -r
+fdbd` analog for the rebuilt stack.
+
+Ref: fdbserver/fdbserver.actor.cpp:1468-1473 — the same role actors run on
+the real network (`g_network = newNet2(...)`) or the simulator
+(`startNewSimulator()`); this module is the real-network entry.  Topology
+here is the static minimum slice (one process hosting
+sequencer/resolver/tlog/storage/proxy, clients discovering interfaces via a
+bootstrap endpoint); the elected control plane rides the same transport
+later.
+
+Usage:
+  python -m foundationdb_tpu.tools.real_node server [--port N]
+      prints "READY <host:port>" then serves forever.
+  python -m foundationdb_tpu.tools.real_node client <server-addr> \
+      --id NAME --ops N [--check-count M]
+      runs N increment transactions (idempotence keys under NAME/), prints
+      "DONE <count-after>" — with --check-count also asserts the final
+      counter value.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from ..flow.eventloop import EventLoop, set_event_loop
+from ..rpc.real_network import RealNetwork
+from ..rpc.stream import RequestStream, RequestStreamRef, well_known_token
+from ..rpc.network import Endpoint
+
+
+def run_server(port: int) -> None:
+    from ..server.proxy import Proxy
+    from ..server.resolver import Resolver
+    from ..server.sequencer import Sequencer
+    from ..server.storage import StorageServer
+    from ..server.tlog import TLog
+
+    loop = EventLoop(seed=1)
+    set_event_loop(loop)
+    net = RealNetwork(loop, port=port)
+    proc = net.process("server")
+
+    sequencer = Sequencer(proc)
+    resolver = Resolver(proc, backend="cpu")
+    tlog = TLog(proc)
+    storage = StorageServer(
+        proc, [tlog.interface()], storage_id="ss0", owned_all=True
+    )
+    proxy = Proxy(
+        proc,
+        sequencer.interface(),
+        [resolver.interface()],
+        [tlog.interface()],
+    )
+
+    boot = RequestStream(proc, "bootstrap", well_known=True)
+
+    async def serve_bootstrap():
+        while True:
+            _req, reply = await boot.pop()
+            reply.send(
+                {
+                    "proxy": proxy.interface(),
+                    "storage": storage.interface(),
+                    "proxies": [proxy.interface()],
+                }
+            )
+
+    proc.spawn(serve_bootstrap(), "bootstrap")
+    print(f"READY {net.address}", flush=True)
+    net.run_realtime()
+
+
+def run_client(server: str, client_id: str, ops: int, check_count: int) -> None:
+    from ..client.transaction import Database
+
+    loop = EventLoop(seed=2)
+    set_event_loop(loop)
+    net = RealNetwork(loop)
+    proc = net.process(f"client-{client_id}")
+
+    boot_ref = RequestStreamRef(
+        Endpoint(server, well_known_token("bootstrap")), "bootstrap"
+    )
+
+    async def main():
+        ifaces = await boot_ref.get_reply(proc, None)
+        db = Database(
+            proc,
+            ifaces["proxy"],
+            ifaces["storage"],
+            proxies=ifaces["proxies"],
+        )
+        for i in range(ops):
+
+            async def op(tr, i=i):
+                v = await tr.get(b"count")
+                n = int(v.decode()) if v else 0
+                tr.set(b"count", b"%d" % (n + 1))
+                tr.set(b"%s/%04d" % (client_id.encode(), i), b"x")
+
+            await db.run(op)
+
+        out = {}
+
+        async def readback(tr):
+            v = await tr.get(b"count")
+            out["count"] = int(v.decode()) if v else 0
+            rows = await tr.get_range(
+                client_id.encode() + b"/", client_id.encode() + b"0"
+            )
+            out["mine"] = len(rows)
+
+        await db.run(readback)
+        return out
+
+    task = proc.spawn(main(), "client_main")
+    out = net.run_realtime(until=task, timeout_s=60.0)
+    assert out["mine"] == ops, out
+    if check_count >= 0:
+        assert out["count"] == check_count, out
+    print(f"DONE {out['count']}", flush=True)
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser()
+    sub = ap.add_subparsers(dest="mode", required=True)
+    s = sub.add_parser("server")
+    s.add_argument("--port", type=int, default=0)
+    c = sub.add_parser("client")
+    c.add_argument("server")
+    c.add_argument("--id", default="c1")
+    c.add_argument("--ops", type=int, default=20)
+    c.add_argument("--check-count", type=int, default=-1)
+    args = ap.parse_args(argv)
+    if args.mode == "server":
+        run_server(args.port)
+    else:
+        run_client(args.server, args.id, args.ops, args.check_count)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
