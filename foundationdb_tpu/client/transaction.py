@@ -238,7 +238,15 @@ class Transaction:
                     self.db.process, GetValueRequest(key=key, version=version)
                 )
             except FdbError as e:
-                if e.name not in ("wrong_shard_server", "broken_promise"):
+                # future_version also rotates: a replica too far behind its
+                # log (e.g. its range was popped past) should not fail reads
+                # its healthy teammates can serve (ref: loadBalance trying
+                # the next alternative).
+                if e.name not in (
+                    "wrong_shard_server",
+                    "broken_promise",
+                    "future_version",
+                ):
                     raise
                 last = e
                 # Invalidate on broken_promise too: if the WHOLE cached team
@@ -296,7 +304,11 @@ class Transaction:
                     ),
                 )
             except FdbError as e:
-                if e.name not in ("wrong_shard_server", "broken_promise"):
+                if e.name not in (
+                    "wrong_shard_server",
+                    "broken_promise",
+                    "future_version",
+                ):
                     raise
                 misroutes += 1
                 if misroutes > MAX_REROUTE_ATTEMPTS:

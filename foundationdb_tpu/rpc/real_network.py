@@ -111,6 +111,11 @@ class _Conn:
         self.outbuf = b""
         self.connected = peer is None  # accepted conns are connected already
         self.closed = False
+        # Superseded by a simultaneous-connect replacement: closing it must
+        # NOT break the peer's pending replies (they ride the replacement).
+        self.superseded = False
+        self.created = time.monotonic()
+        self.last_activity = time.monotonic()
 
     def enqueue(self, frame: bytes):
         self.outbuf += _LEN.pack(len(frame)) + frame
@@ -154,6 +159,42 @@ class RealNetwork:
         self.messages_sent = 0
         self._token_counter = 1
         self._stopped = False
+        self.connect_timeout = 5.0
+        # A peer with traffic owed to us (unsent frames or replies we are
+        # waiting on) that stays silent this long is declared failed (ref:
+        # the ping keepalive + failure detection on connectionKeeper).
+        self.idle_timeout = 15.0
+        self._arm_watchdog()
+
+    def _arm_watchdog(self):
+        if self._stopped:
+            return
+        self._watchdog()
+        self.loop._schedule(
+            TaskPriority.DefaultDelay,
+            self._arm_watchdog,
+            at=self.loop.now() + 1.0,
+        )
+
+    def _watchdog(self):
+        """Bound every hang: close connections that never finished
+        connecting, and connections owing us traffic that went silent —
+        closing breaks the pending reply promises so callers retry instead
+        of hanging forever (ref: connection monitoring/ping,
+        FlowTransport.actor.cpp connectionMonitor)."""
+        now = time.monotonic()
+        for conn in list(self._conns.values()):
+            if conn.closed:
+                continue
+            if not conn.connected and now - conn.created > self.connect_timeout:
+                conn.close()
+                continue
+            owed = bool(conn.outbuf) or any(
+                conn.peer in p._pending_on and p._pending_on[conn.peer]
+                for p in self._proc_list
+            )
+            if owed and now - conn.last_activity > self.idle_timeout:
+                conn.close()
 
     # -- topology (compat surface) --
     # NOTE: every co-located RealProcess shares this network's listener
@@ -202,7 +243,11 @@ class RealNetwork:
             raise ValueError("frame too large")
         self._get_conn(dst.address).enqueue(frame)
 
-    send = send_from  # fire-and-forget compat (src unused beyond liveness)
+    def send(self, dst, payload, priority: int = TaskPriority.DefaultEndpoint):
+        """Fire-and-forget, SimNetwork.send-compatible signature (no src)."""
+        src = self._proc_list[0] if self._proc_list else None
+        if src is not None:
+            self.send_from(src, dst, payload, priority)
 
     def _reply_broken(self, msg):
         """Unknown endpoint token on a live process: break the request's
@@ -274,6 +319,7 @@ class RealNetwork:
             return
         if mask & selectors.EVENT_WRITE:
             conn.connected = True
+            conn.last_activity = time.monotonic()
             if conn.outbuf:
                 try:
                     n = conn.sock.send(conn.outbuf)
@@ -303,6 +349,7 @@ class RealNetwork:
             if not data:
                 conn.close()
                 return
+            conn.last_activity = time.monotonic()
             conn.inbuf += data
             self._drain_frames(conn)
 
@@ -323,8 +370,13 @@ class RealNetwork:
                 conn.peer = frame.decode()
                 old = self._conns.get(conn.peer)
                 if old is not None and old is not conn and not old.closed:
-                    # Simultaneous connect: keep both; sends use the latest.
-                    pass
+                    # Simultaneous connect: the accepted conn wins.  The
+                    # replaced dial is closed WITHOUT breaking the peer's
+                    # pending replies — they are keyed by peer address and
+                    # ride whichever connection is current (ref: the
+                    # canonical-connection arbitration in connectionKeeper).
+                    old.superseded = True
+                    old.close()
                 self._conns[conn.peer] = conn
                 continue
             try:
@@ -344,9 +396,12 @@ class RealNetwork:
 
     def _on_conn_closed(self, conn: _Conn):
         """Break reply promises pending on the lost peer (ref: the NetSAV
-        breakage on connection failure, FlowTransport.actor.cpp:355)."""
+        breakage on connection failure, FlowTransport.actor.cpp:355).  A
+        superseded duplicate (simultaneous connect) closes silently."""
         if self._conns.get(conn.peer) is conn:
             del self._conns[conn.peer]
+        if conn.superseded:
+            return
         TraceEvent("ConnectionClosed").detail("peer", conn.peer).log()
         for p in self._proc_list:
             pending = p._pending_on.pop(conn.peer, None)

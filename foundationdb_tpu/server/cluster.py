@@ -37,9 +37,15 @@ class SimCluster:
         n_storages: int = 1,
         n_tlogs: int = 1,
         n_proxies: int = 1,
+        buggify: bool = True,
     ):
         self.loop = loop or EventLoop(seed=seed)
         set_event_loop(self.loop)
+        # Simulation buggifies by default, like the reference (flow/flow.h
+        # :60-67: BUGGIFY only fires under the simulator).
+        from ..flow.buggify import set_buggify_enabled
+
+        set_buggify_enabled(buggify, self.loop.rng)
         self.net = SimNetwork(self.loop)
         self.conflict_backend = conflict_backend
         self._conflict_set = conflict_set

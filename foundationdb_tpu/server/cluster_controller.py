@@ -347,6 +347,13 @@ class ClusterController:
             )
         )
 
+        from ..flow.buggify import buggify
+
+        if buggify("recovery_slow_cstate"):
+            # BUGGIFY: a slow WRITING_CSTATE->serving gap — widens the
+            # window where another controller could supersede us.
+            await loop.delay(loop.rng.random01() * 0.1)
+
         # RECOVERY_TRANSACTION: advance the chain into the new epoch.
         from ..client.types import CommitTransactionRef
 

@@ -34,9 +34,13 @@ class DynamicCluster:
         n_tlogs: int = 1,
         n_storages: int = 1,
         n_proxies: int = 1,
+        buggify: bool = True,
     ):
         self.loop = loop or EventLoop(seed=seed)
         set_event_loop(self.loop)
+        from ..flow.buggify import set_buggify_enabled
+
+        set_buggify_enabled(buggify, self.loop.rng)
         self.net = SimNetwork(self.loop)
         self.fs = SimFileSystem(self.net)
         self.conflict_backend = conflict_backend

@@ -232,6 +232,8 @@ class Proxy:
         batch, spend the ratekeeper budget for the whole batch, answer all
         with one version (ref: transactionStarter draining its queue against
         the rate, MasterProxyServer.actor.cpp:934-1033)."""
+        from ..flow.buggify import buggify
+
         loop = self.process.network.loop
         budget = 1.0
         last_refill = loop.now()
@@ -243,6 +245,10 @@ class Proxy:
             while self._grv_stream.is_ready():
                 _r, rep = await self._grv_stream.pop()
                 batch.append(rep)
+            if buggify("proxy_grv_delay"):
+                # BUGGIFY: stale-but-causal read versions (the committed
+                # floor only rises) — exercises waitForVersion fast paths.
+                await loop.delay(loop.rng.random01() * 0.02)
             if self.ratekeeper is not None:
                 if loop.now() - last_fetch > 0.1:
                     try:
@@ -316,6 +322,8 @@ class Proxy:
 
     # --- commit batching (ref batcher.actor.h + commitBatch :318) ---
     async def _commit_batcher(self):
+        from ..flow.buggify import buggify
+
         loop = self.process.network.loop
         srv = g_knobs.server
         pending = None  # a pop() that lost the race to the window timer
@@ -323,9 +331,16 @@ class Proxy:
             first = await (pending or self._commit_stream.pop())
             pending = None
             batch = [first]
+            # BUGGIFY: single-transaction batches maximize pipeline overlap
+            # and per-batch edge cases (ref: buggified batch knobs).
+            batch_max = (
+                1
+                if buggify("proxy_tiny_batch")
+                else srv.commit_transaction_batch_count_max
+            )
             deadline = loop.now() + srv.commit_transaction_batch_interval
             while (
-                len(batch) < srv.commit_transaction_batch_count_max
+                len(batch) < batch_max
                 and loop.now() < deadline
             ):
                 nxt = self._commit_stream.pop()
@@ -390,6 +405,13 @@ class Proxy:
             ctx["version"] = version
         own_prev, self._last_own_version = self._last_own_version, version
         self._batch_resolving.set(local_batch)
+        from ..flow.buggify import buggify
+
+        if buggify("proxy_resolve_delay"):
+            # BUGGIFY: let a LATER batch reach the resolvers first —
+            # exercises the prevVersion reorder wait (Resolver :104-115).
+            loop = self.process.network.loop
+            await loop.delay(loop.rng.random01() * 0.02)
 
         # Phase 2: resolution.  One ResolveTransactionBatchRequest per
         # resolver; each resolver sees the ranges in its key space (the

@@ -74,9 +74,16 @@ class Resolver:
             self.process.spawn(self._resolve_one(req, reply), "resolve_batch")
 
     async def _resolve_one(self, req: ResolveTransactionBatchRequest, reply):
+        from ..flow.buggify import buggify
+
         if req.epoch != self.epoch:
             reply.send_error("operation_failed")  # stale generation's proxy
             return
+        if buggify("resolver_delay"):
+            # BUGGIFY: batches arrive out of order — exercises the
+            # prevVersion chain wait below (ref :104-115).
+            loop = self.process.network.loop
+            await loop.delay(loop.rng.random01() * 0.02)
         # Order batches by the sequencer's prevVersion chain: a batch may
         # arrive before its predecessor (ref :104-115).
         await self.version.when_at_least(req.prev_version)

@@ -42,10 +42,16 @@ class Sequencer:
     def _next_version(self) -> tuple:
         """(version, prev_version): versions track virtual time (ref:
         getVersion computes t1*VERSIONS_PER_SECOND skew :800-809)."""
+        from ..flow.buggify import buggify
+
         loop = self.process.network.loop
         now = loop.now()
         vps = g_knobs.server.versions_per_second
         advance = max(1, int((now - self._last_grant_time) * vps))
+        if buggify("sequencer_version_jump"):
+            # BUGGIFY: a large version gap (clock skew analog) — exercises
+            # MVCC window GC and too-old classification downstream.
+            advance += int(loop.rng.random01() * vps * 0.5)
         self._last_grant_time = now
         prev = self.version
         self.version = prev + advance

@@ -423,10 +423,16 @@ class StorageServer:
     # -- write path: pull from the log (ref: storageserver update() via a
     # peek cursor; failover across the tag's log replicas) --
     async def _update_loop(self):
+        from ..flow.buggify import buggify
+
         loop = self.process.network.loop
         last_durable_commit = loop.now()
         log_i = 0
         while True:
+            if buggify("storage_apply_lag"):
+                # BUGGIFY: a lagging storage — exercises waitForVersion
+                # waits, future_version timeouts, and ratekeeper lag paths.
+                await loop.delay(loop.rng.random01() * 0.05)
             try:
                 reply = await self._my_logs[
                     log_i % len(self._my_logs)
@@ -461,8 +467,13 @@ class StorageServer:
                 self.durable_version = self.version.get()
                 self._pop_all(self.version.get())
             elif (
-                loop.now() - last_durable_commit
-                >= g_knobs.server.storage_durability_lag
+                (
+                    loop.now() - last_durable_commit
+                    >= g_knobs.server.storage_durability_lag
+                    # BUGGIFY: eager durability — trims the MVCC window as
+                    # aggressively as possible (transaction_too_old paths).
+                    or buggify("storage_eager_durable")
+                )
                 and self.version.get() > self.durable_version
             ):
                 await self._make_durable()

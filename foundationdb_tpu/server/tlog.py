@@ -102,6 +102,20 @@ class TLog:
                 del log.versions[k:]
                 del log.entries[k:]
                 continue
+            if rec[0] == "__pop__":
+                # Restore per-tag consumer floors: without them, the first
+                # pop after a recovery would trim entries a slower (or
+                # crashed-and-recovering) consumer still needs (ref: the
+                # persistTagPoppedKeys range in TLogServer's persistent
+                # state, TLogServer.actor.cpp).
+                _m, tag, ver, unregister = rec
+                if unregister:
+                    log.popped_tags.pop(tag, None)
+                else:
+                    log.popped_tags[tag] = max(
+                        log.popped_tags.get(tag, -1), ver
+                    )
+                continue
             version, tagged = rec
             log.versions.append(version)
             log.entries.append(tagged)
@@ -155,6 +169,13 @@ class TLog:
             # cross-generation pushes).
             reply.send_error("tlog_stopped")
             return
+        from ..flow.buggify import buggify
+
+        if buggify("tlog_slow_fsync"):
+            # BUGGIFY: a slow disk — commits ack late, widening the window
+            # where a kill strands un-acked data (the epoch-cut path).
+            loop = self.process.network.loop
+            await loop.delay(loop.rng.random01() * 0.02)
         # Versions are committed in the sequencer's order (ref: TLogServer
         # waits version ordering before appending).
         await self.durable.when_at_least(req.prev_version)
@@ -209,14 +230,24 @@ class TLog:
         return log
 
     async def _serve_peek(self):
+        from ..flow.buggify import buggify
+
         while True:
             req, reply = await self._peek_stream.pop()
-            if req.begin_version < self.begin_version:
-                # This log cannot answer for versions before it existed.
+            if req.begin_version < self.begin_version or (
+                req.begin_version < self.popped
+            ):
+                # This log cannot answer below its beginning or below its
+                # popped floor: silently returning only LATER versions would
+                # make the peeker skip data it never saw (loud failure; the
+                # consumer rotates to a replica that still has the range).
                 reply.send_error("peek_below_begin")
                 continue
+            # BUGGIFY: tiny peek pages force the has_more continuation path
+            # in every consumer (ref: buggified reply size limits).
+            limit = 2 if buggify("tlog_peek_truncate") else req.limit_versions
             i = bisect_right(self.versions, req.begin_version)
-            j = min(i + req.limit_versions, len(self.versions))
+            j = min(i + limit, len(self.versions))
             # Only durable versions are visible to peeks.
             durable_end = bisect_right(self.versions, self.durable.get())
             j = min(j, durable_end)
@@ -260,12 +291,29 @@ class TLog:
                 self.disk_queue.pop(floor)
 
     async def _serve_pop(self):
+        import pickle
+
         while True:
             req, reply = await self._pop_stream.pop()
             tag = req.tag or "_default"
+            changed = False
             if req.unregister:
-                self.popped_tags.pop(tag, None)
+                changed = self.popped_tags.pop(tag, None) is not None
             elif req.version > self.popped_tags.get(tag, -1):
                 self.popped_tags[tag] = req.version
+                changed = True
+            if changed and self.disk_queue is not None:
+                # Lazily persisted (rides the next commit).  Losing an
+                # unsynced pop record only LOWERS a recovered floor — the
+                # log retains more, never less.  seq = durable+1 so the
+                # record outlives the pop floor (which never exceeds the
+                # tag's own floor <= durable at pop time).
+                self.disk_queue.push(
+                    self.durable.get() + 1,
+                    pickle.dumps(
+                        ("__pop__", tag, req.version, req.unregister),
+                        protocol=4,
+                    ),
+                )
             self._trim()
             reply.send(None)

@@ -149,6 +149,13 @@ class WorkerServer:
             self.process.spawn(self._init_one(req, reply), "worker_init_one")
 
     async def _init_one(self, req, reply):
+        from ..flow.buggify import buggify
+
+        if buggify("worker_slow_init"):
+            # BUGGIFY: slow recruitment — stretches the recovery window so
+            # client retries and stale-generation requests overlap it.
+            loop = self.process.network.loop
+            await loop.delay(loop.rng.random01() * 0.1)
         # Task capture: actors this process spawns while the role constructs
         # belong to the new role instance (recoveries are driven serially by
         # the CC, so concurrent unrelated spawns are not expected here).

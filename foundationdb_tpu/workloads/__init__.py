@@ -1,0 +1,25 @@
+"""Workload framework: composable test workloads with setup/start/check
+phases, run concurrently against a simulated cluster.
+
+Ref: fdbserver/workloads/workloads.h:55 (TestWorkload's setup/start/check/
+getMetrics contract), tester.actor.cpp:239 (CompoundWorkload running the
+spec's stacked workloads concurrently), :778 (runTest driving the phases
+and the trailing consistency check).
+"""
+
+from .base import TestWorkload, run_workloads
+from .cycle import CycleWorkload
+from .chaos import AttritionWorkload, RandomCloggingWorkload
+from .consistency import ConsistencyChecker, check_consistency
+from .config import SimulationConfig
+
+__all__ = [
+    "TestWorkload",
+    "run_workloads",
+    "CycleWorkload",
+    "AttritionWorkload",
+    "RandomCloggingWorkload",
+    "ConsistencyChecker",
+    "check_consistency",
+    "SimulationConfig",
+]

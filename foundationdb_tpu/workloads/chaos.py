@@ -1,0 +1,74 @@
+"""Chaos injector workloads: swizzled clogging and machine attrition.
+
+Ref: fdbserver/workloads/RandomClogging.actor.cpp (random pairwise clogs;
+the "swizzled" variant clogs a changing subset then releases in reverse),
+fdbserver/workloads/MachineAttrition.actor.cpp (kill/reboot machines on a
+cadence while invariant workloads run).
+"""
+
+from __future__ import annotations
+
+from .base import TestWorkload
+
+
+class RandomCloggingWorkload(TestWorkload):
+    """Clog random machine pairs for random durations (swizzled: several
+    overlapping clogs whose releases interleave)."""
+
+    name = "random_clogging"
+
+    def __init__(self, duration: float = 3.0, max_clog: float = 0.4):
+        self.duration = duration
+        self.max_clog = max_clog
+
+    async def start(self, db, cluster):
+        loop = cluster.loop
+        rng = loop.rng
+        end = loop.now() + self.duration
+        machines = sorted(cluster.net.machines)
+        while loop.now() < end and len(machines) >= 2:
+            i = int(rng.random_int(0, len(machines)))
+            j = int(rng.random_int(0, len(machines) - 1))
+            if j >= i:
+                j += 1
+            cluster.net.clog_pair(
+                machines[i], machines[j], rng.random01() * self.max_clog
+            )
+            await loop.delay(0.05 + rng.random01() * 0.2)
+        cluster.net.unclog_all()
+
+
+class AttritionWorkload(TestWorkload):
+    """Kill a random worker machine (disks crash per the corruption model),
+    reboot it, and re-attach its worker agent; repeat.  The cluster must
+    recover a new generation each time with zero acked-data loss."""
+
+    name = "attrition"
+
+    def __init__(self, kills: int = 2, delay_between: float = 1.0):
+        self.kills = kills
+        self.delay_between = delay_between
+
+    async def start(self, db, cluster):
+        from ..flow.asyncvar import AsyncVar
+        from ..server.coordination import monitor_leader
+        from ..server.worker import WorkerServer, run_worker_registration
+
+        loop = cluster.loop
+        rng = loop.rng
+        for _ in range(self.kills):
+            await loop.delay(self.delay_between * (0.5 + rng.random01()))
+            procs = [p for p in cluster._worker_procs if p.alive]
+            if not procs:
+                continue
+            proc = procs[int(rng.random_int(0, len(procs)))]
+            proc.kill()
+            cluster.fs.crash_machine(proc.machine.machine_id)
+            proc.reboot()
+            w = WorkerServer(proc, cluster.fs)
+            leader_var = AsyncVar(None)
+            proc.spawn(
+                monitor_leader(proc, cluster.coord_ifaces, leader_var),
+                "leader_mon",
+            )
+            proc.spawn(run_worker_registration(w, leader_var), "registration")
