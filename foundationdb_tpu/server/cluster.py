@@ -124,6 +124,18 @@ class SimCluster:
             ]
             self.proxy = self.proxies[0]
 
+    def resolver_balancer(self, **kw):
+        """A ResolverBalancer polling this cluster's resolvers (its own
+        client process; ref: the master-hosted resolution balancing)."""
+        from .resolver_balancer import ResolverBalancer
+
+        return ResolverBalancer(
+            self.database("balancer"),
+            [r.interface() for r in self.resolvers],
+            self.split_keys,
+            **kw,
+        )
+
     def data_distributor(self):
         """A DataDistributor driving this cluster (its own client process);
         pre-registered with every storage's id -> interface."""

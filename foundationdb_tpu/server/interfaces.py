@@ -120,8 +120,29 @@ class ResolveTransactionBatchReply:
 
 
 @dataclass
+class ResolutionMetricsReply:
+    """Load signal for split balancing (ref: ResolutionMetricsRequest
+    ResolverInterface.h:108; the master polls these to drive splits)."""
+
+    ops: int = 0  # sampled conflict-range ops since the last poll
+
+
+@dataclass
+class ResolutionSplitRequest:
+    """Find the key splitting this resolver's sampled load in [begin, end)
+    at `fraction` of its mass (ref: ResolutionSplitRequest
+    ResolverInterface.h:118-131, served from the iopsSample)."""
+
+    begin: bytes = b""
+    end: Optional[bytes] = None
+    fraction: float = 0.5
+
+
+@dataclass
 class ResolverInterface:
     resolve: RequestStreamRef = None
+    metrics: RequestStreamRef = None
+    split: RequestStreamRef = None
 
 
 # --- tlog (ref fdbserver/TLogInterface.h) ---

@@ -84,11 +84,21 @@ class Proxy:
         self.tlogs = tlogs
         # Key-space partition across resolvers (ref: keyResolvers
         # KeyRangeMap :185).  n resolvers need n-1 split points.
+        from .system_keys import bounds_from_split_keys
+
         split = resolver_split_keys or []
         assert len(split) == len(resolvers) - 1, "need n-1 split keys"
-        self.resolver_bounds = list(
-            zip([b""] + split, split + [None])
-        )  # [(lo, hi_or_None)] per resolver
+        # [(lo, hi_or_None)] per resolver
+        self.resolver_bounds = bounds_from_split_keys(split)
+        # Superseded partitions still receiving ranges: [(bounds, until)].
+        # After a split moves at version V, batches through
+        # V + MVCC-window + in-flight-depth clip with the OLD bounds TOO, so
+        # the new owner of a boundary range builds history while the old
+        # owner still detects conflicts against writes it alone has seen
+        # (ref: keyResolvers keeping multiple (version, resolver) entries
+        # per range until the window expires, MasterProxyServer :185,
+        # ApplyMetadataMutation's keyResolvers handling).
+        self._old_bounds: List[Tuple[list, int]] = []
         self.ratekeeper = ratekeeper
         self.committed = NotifiedVersion(epoch_begin_version)
         # Authoritative key -> storage-team map, maintained by intercepting
@@ -205,7 +215,7 @@ class Proxy:
                 tags.add(TAG_DEFAULT)
         return tags
 
-    def _intercept_metadata(self, m: Mutation):
+    def _intercept_metadata(self, m: Mutation, version: int = 0):
         """ApplyMetadataMutation analog for the proxy's own map."""
         from .system_keys import parse_metadata_mutation
 
@@ -215,6 +225,19 @@ class Proxy:
         if parsed[0] == "server":
             _kind, sid, iface = parsed
             self.server_list[sid] = iface
+        elif parsed[0] == "resolver_split":
+            from .system_keys import bounds_from_split_keys
+
+            _kind, split = parsed
+            if len(split) != len(self.resolvers) - 1:
+                return  # malformed for this topology; ignore
+            until = (
+                version
+                + g_knobs.server.max_write_transaction_life_versions
+                + g_knobs.server.max_versions_in_flight
+            )
+            self._old_bounds.append((self.resolver_bounds, until))
+            self.resolver_bounds = bounds_from_split_keys(split)
         else:
             _kind, begin, src, dest, end = parsed
             # Reads route to the data holders: the sources while a move is
@@ -437,6 +460,29 @@ class Proxy:
                 for m in req.transaction.mutations
             )
         ]
+        # Clip per the current partition, UNIONed with any superseded
+        # partitions whose overlap window still covers this version (see
+        # _old_bounds).  Expired overlays are pruned here.
+        self._old_bounds = [
+            (b, until) for b, until in self._old_bounds if version <= until
+        ]
+        bound_sets = [self.resolver_bounds] + [b for b, _u in self._old_bounds]
+
+        def clip_for(ri: int, tr: TransactionConflictInfo):
+            lo, hi = bound_sets[0][ri]
+            out = split_ranges_for_resolver(tr, lo, hi)
+            for bounds in bound_sets[1:]:
+                lo2, hi2 = bounds[ri]
+                extra = split_ranges_for_resolver(tr, lo2, hi2)
+                # Deterministic dedupe (dict preserves insertion order).
+                out.read_ranges = list(
+                    dict.fromkeys(out.read_ranges + extra.read_ranges)
+                )
+                out.write_ranges = list(
+                    dict.fromkeys(out.write_ranges + extra.write_ranges)
+                )
+            return out
+
         replies = await wait_for_all(
             [
                 r.resolve.get_reply(
@@ -445,15 +491,13 @@ class Proxy:
                         prev_version=prev,
                         version=version,
                         last_received_version=self._last_received,
-                        transactions=[
-                            split_ranges_for_resolver(tr, lo, hi) for tr in infos
-                        ],
+                        transactions=[clip_for(ri, tr) for tr in infos],
                         state_txns=state_txns,
                         proxy_id=self.proxy_id,
                         epoch=self.epoch,
                     ),
                 )
-                for r, (lo, hi) in zip(self.resolvers, self.resolver_bounds)
+                for ri, r in enumerate(self.resolvers)
             ]
         )
         statuses = [
@@ -471,13 +515,13 @@ class Proxy:
         # Without the ordering, a write pipelined behind a startMove could
         # miss the destination's tag and silently diverge the new replica.
         await self._meta_version.when_at_least(own_prev)
-        for vi, (_v, txns) in enumerate(replies[0].state_mutations):
+        for vi, (sv, txns) in enumerate(replies[0].state_mutations):
             for ti, (committed, muts) in enumerate(txns):
                 if committed and all(
                     rep.state_mutations[vi][1][ti][0] for rep in replies[1:]
                 ):
                     for m in muts:
-                        self._intercept_metadata(m)
+                        self._intercept_metadata(m, version=sv)
         self._last_received = max(self._last_received, version)
         tagged: dict = {}
         seq = 0
@@ -497,7 +541,7 @@ class Proxy:
                         m.param1,
                         transform_versionstamp(m.param2, version, t),
                     )
-                self._intercept_metadata(m)
+                self._intercept_metadata(m, version=version)
                 for tag in self._tags_for_mutation(m):
                     tagged.setdefault(tag, []).append((seq, m))
                 seq += 1

@@ -22,10 +22,17 @@ from ..flow.knobs import g_knobs
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
 from .interfaces import (
+    ResolutionMetricsReply,
+    ResolutionSplitRequest,
     ResolveTransactionBatchReply,
     ResolveTransactionBatchRequest,
     ResolverInterface,
 )
+
+# Key-frequency sample bounds (ref: TransientStorageMetricSample iopsSample
+# Resolver.actor.cpp:146-151 — a decaying sample of conflict-range begin
+# keys, queried by the master's split balancing).
+SAMPLE_MAX_KEYS = 2000
 
 
 @dataclass
@@ -62,16 +69,87 @@ class Resolver:
         self._recent_state_txns: Dict[int, list] = {}
         self._proxy_info: Dict[str, _ProxyInfo] = {}
         self._epoch_begin = epoch_begin_version
+        # Decaying first-key frequency sample + op counter for split
+        # balancing (ref: iopsSample Resolver.actor.cpp:146-151,
+        # ResolutionMetricsRequest/SplitRequest service :276-284).
+        self._key_sample: Dict[bytes, int] = {}
+        self._metric_ops = 0
         self._stream = RequestStream(process, "resolve", well_known=True)
+        self._metrics_stream = RequestStream(
+            process, "resolution_metrics", well_known=True
+        )
+        self._split_stream = RequestStream(
+            process, "resolution_split", well_known=True
+        )
         process.spawn(self._serve(), "resolver")
+        process.spawn(self._serve_metrics(), "resolver_metrics")
+        process.spawn(self._serve_split(), "resolver_split")
 
     def interface(self) -> ResolverInterface:
-        return ResolverInterface(resolve=self._stream.ref())
+        return ResolverInterface(
+            resolve=self._stream.ref(),
+            metrics=self._metrics_stream.ref(),
+            split=self._split_stream.ref(),
+        )
 
     async def _serve(self):
         while True:
             req, reply = await self._stream.pop()
             self.process.spawn(self._resolve_one(req, reply), "resolve_batch")
+
+    def _sample(self, tr):
+        for rng in tr.read_ranges:
+            self._bump(rng[0])
+        for rng in tr.write_ranges:
+            self._bump(rng[0])
+        self._metric_ops += len(tr.read_ranges) + len(tr.write_ranges)
+
+    def _bump(self, key: bytes):
+        self._key_sample[key] = self._key_sample.get(key, 0) + 1
+        if len(self._key_sample) > SAMPLE_MAX_KEYS:
+            # Decay: halve every count, drop the zeros (the transient-sample
+            # expiry analog; keeps hot keys, sheds one-offs).
+            self._key_sample = {
+                k: v // 2 for k, v in self._key_sample.items() if v >= 2
+            }
+
+    async def _serve_metrics(self):
+        while True:
+            _req, reply = await self._metrics_stream.pop()
+            reply.send(ResolutionMetricsReply(ops=self._metric_ops))
+            self._metric_ops = 0
+
+    async def _serve_split(self):
+        while True:
+            req, reply = await self._split_stream.pop()
+            reply.send(self._split_key(req))
+
+    def _split_key(self, req: ResolutionSplitRequest):
+        """The sampled key at `fraction` of this resolver's mass within
+        [begin, end); None when the sample is too thin to split."""
+        keys = sorted(
+            k
+            for k in self._key_sample
+            if k >= req.begin and (req.end is None or k < req.end)
+        )
+        total = sum(self._key_sample[k] for k in keys)
+        if total == 0 or len(keys) < 2:
+            return None
+        # A boundary at key k puts the mass of every key < k on the left;
+        # pick the boundary whose LEFT mass is closest to fraction*total.
+        # (Crossing-key-inclusive accumulation would dump the crossing
+        # key's whole mass — possibly most of the range — on the donated
+        # side and overshoot wildly for skewed samples.)
+        target = total * req.fraction
+        acc = 0
+        best_key, best_err = None, None
+        for idx, k in enumerate(keys):
+            if idx > 0:  # boundary at keys[0] == empty left side: no-op
+                err = abs(acc - target)
+                if best_err is None or err < best_err:
+                    best_key, best_err = k, err
+            acc += self._key_sample[k]
+        return best_key
 
     async def _resolve_one(self, req: ResolveTransactionBatchRequest, reply):
         from ..flow.buggify import buggify
@@ -113,6 +191,7 @@ class Resolver:
         batch = self.conflicts.new_batch()
         for tr in req.transactions:
             batch.add_transaction(tr)
+            self._sample(tr)
         window = g_knobs.server.max_write_transaction_life_versions
         statuses = batch.detect_conflicts(
             now=req.version, new_oldest_version=req.version - window

@@ -1,0 +1,128 @@
+"""Resolution balancing: move resolver split points toward the load.
+
+Ref: the master's resolution balancer — it polls every resolver's
+ResolutionMetricsRequest, and when the load skews it asks the overloaded
+resolver for a split key from its iopsSample (ResolutionSplitRequest,
+ResolverInterface.h:108-131; Resolver.actor.cpp:276-284) and moves the
+boundary.  Here the new partition is committed as a system-key transaction
+(`\xff/conf/resolverSplit`), so every proxy applies it at an exact version
+through the state-transaction channel and runs the both-owners overlap
+window (proxy.py `_old_bounds`) before retiring the old partition.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..flow.knobs import g_knobs
+from .interfaces import ResolutionSplitRequest, ResolverInterface
+from . import system_keys as sk
+
+
+class ResolverBalancer:
+    def __init__(
+        self,
+        db,
+        resolvers: List[ResolverInterface],
+        split_keys: List[bytes],
+        min_ops: int = 50,
+        ratio: float = 1.5,
+    ):
+        assert len(split_keys) == len(resolvers) - 1
+        self.db = db
+        self.resolvers = resolvers
+        self.split_keys = list(split_keys)
+        self.min_ops = min_ops
+        self.ratio = ratio
+        self.moves = 0
+
+    async def run_once(self) -> Optional[List[bytes]]:
+        """One balancing round; returns the new split list if a boundary
+        moved, else None."""
+        proc = self.db.process
+        ops = []
+        for r in self.resolvers:
+            rep = await r.metrics.get_reply(proc, None)
+            ops.append(rep.ops)
+        # The most imbalanced ADJACENT pair (boundaries only move between
+        # neighbors, like the reference's balancer).
+        best, best_gap = None, 0
+        for i in range(len(ops) - 1):
+            gap = abs(ops[i] - ops[i + 1])
+            if gap > best_gap:
+                best, best_gap = i, gap
+        if best is None:
+            return None
+        i = best
+        oi, oj = ops[i], ops[i + 1]
+        if max(oi, oj) < self.min_ops or max(oi, oj) <= self.ratio * max(
+            1, min(oi, oj)
+        ):
+            return None
+        bounds = sk.bounds_from_split_keys(self.split_keys)
+        target = (oi + oj) / 2.0
+        if oi > oj:
+            # Donor on the left: keep its first `target/oi` of mass; the
+            # boundary moves LEFT to the donated remainder's first key.
+            lo, hi = bounds[i]
+            new_key = await self.resolvers[i].split.get_reply(
+                proc,
+                ResolutionSplitRequest(
+                    begin=lo, end=hi, fraction=target / max(oi, 1)
+                ),
+            )
+        else:
+            # Donor on the right: give away its first (oj-target)/oj of
+            # mass; the boundary moves RIGHT to the key after the donation.
+            lo, hi = bounds[i + 1]
+            new_key = await self.resolvers[i + 1].split.get_reply(
+                proc,
+                ResolutionSplitRequest(
+                    begin=lo,
+                    end=hi,
+                    fraction=(oj - target) / max(oj, 1),
+                ),
+            )
+        if new_key is None or new_key in (b"",):
+            return None
+        old = self.split_keys[i]
+        if new_key == old:
+            return None
+        new_splits = list(self.split_keys)
+        new_splits[i] = new_key
+        if sorted(set(new_splits)) != new_splits or b"" in new_splits:
+            return None  # refuse a degenerate partition
+
+        async def txn(tr):
+            tr.options["access_system_keys"] = True
+            tr.set(sk.RESOLVER_SPLIT_KEY, sk.encode_resolver_split(new_splits))
+
+        await self.db.run(txn)
+        self.split_keys = new_splits
+        self.moves += 1
+        return new_splits
+
+    async def run(self, interval: float = 0.5, rounds: Optional[int] = None):
+        """Poll loop.  After a move, wait out the proxies' overlap window
+        (MVCC window + in-flight depth, in seconds) before moving again —
+        overlapping transitions would stack overlays."""
+        loop = self.db.process.network.loop
+        vps = g_knobs.server.versions_per_second
+        overlap_s = (
+            g_knobs.server.max_write_transaction_life_versions
+            + g_knobs.server.max_versions_in_flight
+        ) / vps
+        n = 0
+        while rounds is None or n < rounds:
+            n += 1
+            moved = await self.run_once()
+            await loop.delay(interval + (overlap_s if moved else 0.0))
+            if moved:
+                # Discard the overlap window's metrics: both owners counted
+                # the donated range's traffic while proxies unioned old+new
+                # bounds, so the counters read double until reset.
+                for r in self.resolvers:
+                    try:
+                        await r.metrics.get_reply(self.db.process, None)
+                    except Exception:  # noqa: BLE001 - resolver died:
+                        pass  # the generation is ending anyway

@@ -592,11 +592,14 @@ class StorageServer:
         parsed = parse_metadata_mutation(m)
         if parsed is None:
             return
-        self._meta_dirty = True
         if parsed[0] == "server":
             _kind, sid, iface = parsed
             self.server_list[sid] = iface
+            self._meta_dirty = True
+        elif parsed[0] == "resolver_split":
+            pass  # proxy-side concern; storages don't partition resolution
         else:
+            self._meta_dirty = True
             _kind, begin, src, dest, end = parsed
             if dest:
                 self._start_adding(begin, end, src, dest, version)

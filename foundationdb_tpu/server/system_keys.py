@@ -28,6 +28,10 @@ KEY_SERVERS_PREFIX = b"\xff/keyServers/"
 KEY_SERVERS_END = b"\xff/keyServers0"  # '0' == '/' + 1
 SERVER_LIST_PREFIX = b"\xff/serverList/"
 SERVER_LIST_END = b"\xff/serverList0"
+# The resolver key-space partition (ref: the keyResolvers map the proxies
+# maintain, MasterProxyServer.actor.cpp:185; split points move at an exact
+# commit version via ResolutionSplitRequest, ResolverInterface.h:108-131).
+RESOLVER_SPLIT_KEY = b"\xff/conf/resolverSplit"
 
 
 def key_servers_key(key: bytes) -> bytes:
@@ -72,15 +76,32 @@ def decode_server_entry(value: bytes):
     return pickle.loads(value)
 
 
+def bounds_from_split_keys(split_keys: List[bytes]) -> List[tuple]:
+    """[(lo, hi_or_None)] per resolver from n-1 split points.  The proxies'
+    clipping and the balancer's reconstruction of the partition MUST agree
+    byte-for-byte, so this is the single definition."""
+    split = list(split_keys)
+    return list(zip([b""] + split, split + [None]))
+
+
+def encode_resolver_split(split_keys: List[bytes]) -> bytes:
+    return pickle.dumps(list(split_keys), protocol=4)
+
+
+def decode_resolver_split(value: bytes) -> List[bytes]:
+    return list(pickle.loads(value))
+
+
 def parse_metadata_mutation(m):
     """Shared ApplyMetadataMutation decoder for every role that watches the
     stream (proxy + storages must agree on the shard map byte-for-byte).
 
-    Returns None (not metadata), ("server", id, StorageInterface), or
-    ("shard", begin, src, dest, end).  CLEAR_RANGE over metadata keys is
-    deliberately not interpreted: DD only ever overwrites records (clearing
-    one would silently orphan a range — if shard-map compaction ever clears
-    boundary entries, both intercept sites change here together)."""
+    Returns None (not metadata), ("server", id, StorageInterface),
+    ("shard", begin, src, dest, end), or ("resolver_split", [split_keys]).
+    CLEAR_RANGE over metadata keys is deliberately not interpreted: DD only
+    ever overwrites records (clearing one would silently orphan a range —
+    if shard-map compaction ever clears boundary entries, both intercept
+    sites change here together)."""
     from ..client.types import MutationType
 
     if m.type != MutationType.SET_VALUE:
@@ -90,4 +111,6 @@ def parse_metadata_mutation(m):
     if m.param1.startswith(KEY_SERVERS_PREFIX):
         src, dest, end = decode_key_servers(m.param2)
         return ("shard", key_servers_begin(m.param1), src, dest, end)
+    if m.param1 == RESOLVER_SPLIT_KEY:
+        return ("resolver_split", decode_resolver_split(m.param2))
     return None

@@ -1,0 +1,115 @@
+"""Dynamic resolver split: load-driven boundary moves at a commit version.
+
+Ref: ResolverInterface.h:108-131 (ResolutionMetrics/SplitRequest),
+Resolver.actor.cpp:146-151 (iopsSample), :276-284 (serving both), and the
+master's resolution balancing; the proxies' keyResolvers transition keeps
+boundary ranges going to BOTH owners for an MVCC window.
+"""
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.server import SimCluster
+from foundationdb_tpu.workloads import CycleWorkload, run_workloads
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def test_metrics_and_split_service():
+    """Resolvers sample conflict-range keys and answer split queries."""
+    c = SimCluster(seed=101, n_resolvers=1)
+    db = c.database()
+
+    async def load():
+        for i in range(30):
+
+            async def op(tr, i=i):
+                await tr.get(b"hot/%03d" % (i % 5))
+                tr.set(b"hot/%03d" % (i % 5), b"x")
+
+            await db.run(op)
+
+    c.run_all([(db, load())], timeout_vt=2000.0)
+
+    out = {}
+
+    async def query():
+        from foundationdb_tpu.server.interfaces import ResolutionSplitRequest
+
+        iface = c.resolvers[0].interface()
+        rep = await iface.metrics.get_reply(db.process, None)
+        out["ops"] = rep.ops
+        out["split"] = await iface.split.get_reply(
+            db.process, ResolutionSplitRequest(begin=b"", end=None, fraction=0.5)
+        )
+
+    c.run_until(db.process.spawn(query()), timeout_vt=100.0)
+    assert out["ops"] > 0
+    assert out["split"] is not None and out["split"].startswith(b"hot/")
+
+
+def test_skewed_load_moves_the_split():
+    """All traffic below the initial 0x80 boundary: the balancer must move
+    the boundary into the hot region, splitting its mass."""
+    c = SimCluster(seed=102, n_resolvers=2)
+    assert c.split_keys == [b"\x80"]
+    db = c.database()
+
+    async def load():
+        for i in range(60):
+
+            async def op(tr, i=i):
+                k = b"hot/%03d" % (i % 20)
+                await tr.get(k)
+                tr.set(k, b"x%d" % i)
+
+            await db.run(op)
+
+    c.run_all([(db, load())], timeout_vt=4000.0)
+
+    bal = c.resolver_balancer(min_ops=20, ratio=1.5)
+    moved = c.run_until(
+        db.process.spawn(bal.run_once()), timeout_vt=1000.0
+    )
+    assert moved is not None and moved[0].startswith(b"hot/"), moved
+    # Every proxy applied the new partition (possibly after its idle tick).
+    settle = c.database()
+
+    async def nudge(tr):
+        tr.set(b"nudge", b"1")
+
+    c.run_all([(settle, settle.run(nudge))], timeout_vt=1000.0)
+    for p in c.proxies:
+        assert p.resolver_bounds[0][1].startswith(b"hot/"), (
+            p.proxy_id,
+            p.resolver_bounds,
+        )
+
+
+def test_serializability_across_split_moves():
+    """Cycle invariant holds while the balancer keeps moving the boundary
+    through the hot region — the overlap window must hand conflict history
+    to the new owner before the old one stops seeing the range."""
+    c = SimCluster(seed=103, n_resolvers=2, n_proxies=2)
+    db = c.database()
+
+    bal = c.resolver_balancer(min_ops=10, ratio=1.2)
+    stop = []
+
+    async def balance_loop():
+        while not stop:
+            await bal.run_once()
+            await c.loop.delay(0.15)
+
+    bal_task = db.process.spawn(balance_loop(), "balancer")
+
+    run_workloads(c, [CycleWorkload(nodes=8, ops=30, actors=4)])
+    stop.append(True)
+    c.run_until(bal_task, timeout_vt=2000.0)
+    # The point of the test is correctness under moves; require at least
+    # one move actually happened so the transition path was exercised.
+    assert bal.moves >= 1
