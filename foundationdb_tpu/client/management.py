@@ -1,0 +1,100 @@
+"""ManagementAPI: cluster configuration as transactions on `\xff/conf`.
+
+Ref: fdbclient/ManagementAPI.actor.cpp — `configure`, exclude/include are
+ordinary transactions on system keys (configKeysPrefix `\xff/conf/`,
+excludedServersPrefix); every role learns changes through the mutation
+stream, and the cluster controller reacts by recruiting a new generation
+when the topology no longer matches (changeConfig -> waitForFullReplication
+-> recovery).
+
+Supported here: proxy count (stateless; applied at the next generation),
+plus storage exclusion records consumed by DD healing.  Stateful counts
+(tlogs/storages) are recorded but not auto-applied — their disks pin them
+to machines, and resizing the log set changes tag placement for old
+epochs (see tlog.begin_version); that arrives with log-epoch routing.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+CONF_PREFIX = b"\xff/conf/"
+CONF_END = b"\xff/conf0"
+EXCLUDED_PREFIX = b"\xff/conf/excluded/"
+EXCLUDED_END = b"\xff/conf/excluded0"
+
+_INT_KEYS = ("proxies", "resolvers", "logs", "storage_team_size")
+
+
+def conf_key(name: str) -> bytes:
+    return CONF_PREFIX + name.encode()
+
+
+async def configure(db, **params) -> None:
+    """Transactionally set configuration fields, e.g.
+    configure(db, proxies=2) (ref: changeConfig ManagementAPI:253)."""
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        for name, value in params.items():
+            if name not in _INT_KEYS:
+                raise ValueError(f"unknown configuration key {name!r}")
+            tr.set(conf_key(name), b"%d" % int(value))
+
+    await db.run(txn)
+
+
+async def get_configuration(db) -> Dict[str, int]:
+    out = {}
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        rows = await tr.get_range(CONF_PREFIX, CONF_END)
+        for k, v in rows:
+            name = k[len(CONF_PREFIX):].decode()
+            if name.startswith("excluded/") or name == "resolverSplit":
+                continue
+            out[name] = int(v.decode())
+
+    await db.run(txn)
+    return out
+
+
+async def exclude_servers(db, storage_ids: List[str]) -> None:
+    """Mark storages for removal (ref: excludeServers ManagementAPI:556);
+    DD healing treats excluded servers like failed ones — moves their data
+    to teammates and unregisters their log tags."""
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        for sid in storage_ids:
+            tr.set(EXCLUDED_PREFIX + sid.encode(), b"1")
+
+    await db.run(txn)
+
+
+async def include_servers(db, storage_ids: Optional[List[str]] = None) -> None:
+    """Clear exclusion records (ref: includeServers ManagementAPI:606);
+    None = include everything."""
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        if storage_ids is None:
+            tr.clear_range(EXCLUDED_PREFIX, EXCLUDED_END)
+        else:
+            for sid in storage_ids:
+                tr.clear(EXCLUDED_PREFIX + sid.encode())
+
+    await db.run(txn)
+
+
+async def get_excluded_servers(db) -> List[str]:
+    out: List[str] = []
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        rows = await tr.get_range(EXCLUDED_PREFIX, EXCLUDED_END)
+        out[:] = [k[len(EXCLUDED_PREFIX):].decode() for k, _v in rows]
+
+    await db.run(txn)
+    return out

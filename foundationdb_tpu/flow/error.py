@@ -55,6 +55,14 @@ _ERRORS: dict[str, int] = {
     # asked for versions predating its recruitment; the peeker must fail
     # over to a surviving replica of its tag.
     "peek_below_begin": 1211,
+    # Directory-layer errors (rebuild-specific codes in an unused range;
+    # the 6.0 bindings raise language exceptions for these, but the
+    # rebuild keeps the one-error-type model).
+    "directory_already_exists": 2131,
+    "directory_does_not_exist": 2132,
+    "directory_incompatible_layer": 2133,
+    "directory_moved_under_itself": 2134,
+    "directory_prefix_not_empty": 2135,
     "platform_error": 1500,
     "io_error": 1510,
     "file_not_found": 1511,

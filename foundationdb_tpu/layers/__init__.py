@@ -1,0 +1,23 @@
+"""Client-side layers: tuple encoding, subspaces, and the directory layer.
+
+Ref: the reference ships these in every language binding
+(bindings/python/fdb/tuple.py, subspace_impl.py, directory_impl.py); they
+are the idiomatic way applications structure keys on the bare KV API.
+"""
+
+from . import tuple  # noqa: A004 - mirrors fdb.tuple's name
+from .directory import DirectoryLayer, DirectorySubspace, HighContentionAllocator
+from .subspace import Subspace
+from .tuple import Versionstamp, pack, range_of, unpack
+
+__all__ = [
+    "tuple",
+    "pack",
+    "unpack",
+    "range_of",
+    "Versionstamp",
+    "Subspace",
+    "DirectoryLayer",
+    "DirectorySubspace",
+    "HighContentionAllocator",
+]

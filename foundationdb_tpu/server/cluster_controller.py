@@ -171,6 +171,8 @@ class ClusterController:
         # epoch a dead controller already recruited with — stale proxies
         # would pass the tlog/resolver epoch checks and drop commits.
         self.generation = max(self.generation, prev.get("generation", 0)) + 1
+        if getattr(self, "_wanted_proxies", 0):
+            self.n_proxies = self._wanted_proxies
         TraceEvent("RecoveryStarted").detail("generation", self.generation).log()
 
         # LOCKING_CSTATE: persist the bumped generation BEFORE recruiting so
@@ -430,9 +432,71 @@ class ClusterController:
                 proxies=list(proxy_ifs),
             )
         )
+        # Watch `\xff/conf` for topology changes this generation can't
+        # satisfy (ref: the CC recruiting a new generation when the
+        # configuration's proxy count changes, changeConfig ->
+        # checkDataConfiguration).  One monitor per generation; the old
+        # one exits when the generation advances.  The stale flag is only
+        # ever CONSUMED by _watch_roles — a recovery completing must not
+        # clear a change detected while it ran.
+        self.process.spawn(
+            self._monitor_config(
+                proxy_ifs, storage_ifs[0], self.generation, n_proxies
+            ),
+            "cc_config_monitor",
+        )
         TraceEvent("RecoveryComplete").detail("generation", self.generation).detail(
             "recovery_version", recovery_version
         ).log()
+
+    async def _monitor_config(
+        self, proxy_ifs, storage_if, generation: int, recruited_proxies: int
+    ):
+        """Poll the configuration keys; when the desired proxy count
+        differs from what this generation actually RECRUITED, flag the
+        generation stale so _watch_roles starts a recovery with the new
+        count.  Comparing against the recruited count (not self.n_proxies)
+        means a change detected mid-recovery re-flags under the next
+        generation's monitor instead of being lost."""
+        from ..client.management import get_configuration
+        from ..client.transaction import Database
+
+        db = Database(
+            self.process,
+            proxy_ifs[0],
+            storage_if,
+            proxies=list(proxy_ifs),
+        )
+        loop = self.process.network.loop
+        while self.generation == generation and self.is_leader.get():
+            # Bounded poll: after a failure-recovery these interfaces are
+            # dead and get_configuration would retry broken_promise forever
+            # — the timeout re-checks the generation guard instead.
+            task = self.process.spawn(
+                self._get_conf_swallowing(db), "cc_conf_read"
+            )
+            conf = await timeout_after(loop, task, 5.0, default=None)
+            if conf is None:
+                task.cancel()
+                await loop.delay(0.2)
+                continue
+            wanted = conf.get("proxies")
+            if wanted and wanted != recruited_proxies:
+                TraceEvent("ConfigChangeRequiresRecovery").detail(
+                    "proxies_now", recruited_proxies
+                ).detail("proxies_wanted", wanted).log()
+                self._wanted_proxies = wanted
+                self._config_stale = True
+                return
+            await loop.delay(0.5)
+
+    async def _get_conf_swallowing(self, db):
+        from ..client.management import get_configuration
+
+        try:
+            return await get_configuration(db)
+        except (FdbError, ActorCancelled):
+            return None
 
     async def _wait_workers(self, tlog_addrs=None, storage_addrs=None):
         """(tlog_slots, storage_workers).
@@ -579,6 +643,9 @@ class ClusterController:
         generation (ref: masterserver waitFailure on each role -> recovery)."""
         loop = self.process.network.loop
         while self.is_leader.get():
+            if getattr(self, "_config_stale", False):
+                self._config_stale = False
+                return  # back to _run -> recovery with the new topology
             for role, addr in self._role_addrs.items():
                 wi = self.workers.get(addr)
                 if wi is None:

@@ -462,11 +462,16 @@ class Proxy:
         ]
         # Clip per the current partition, UNIONed with any superseded
         # partitions whose overlap window still covers this version (see
-        # _old_bounds).  Expired overlays are pruned here.
-        self._old_bounds = [
-            (b, until) for b, until in self._old_bounds if version <= until
+        # _old_bounds).  Filter per batch WITHOUT mutating: a later-version
+        # batch can reach this point before an earlier in-flight batch
+        # clips, and pruning here would strip an overlay the earlier batch
+        # still needs (its boundary ranges would reach only the new owner,
+        # missing old-owner-only history).  Pruning happens in phase 3,
+        # where the per-proxy version chain guarantees every earlier batch
+        # has already clipped.
+        bound_sets = [self.resolver_bounds] + [
+            b for b, until in self._old_bounds if version <= until
         ]
-        bound_sets = [self.resolver_bounds] + [b for b, _u in self._old_bounds]
 
         def clip_for(ri: int, tr: TransactionConflictInfo):
             lo, hi = bound_sets[0][ri]
@@ -515,6 +520,12 @@ class Proxy:
         # Without the ordering, a write pipelined behind a startMove could
         # miss the destination's tag and silently diverge the new replica.
         await self._meta_version.when_at_least(own_prev)
+        # Safe overlay prune: every own batch with a smaller version has
+        # finished phase 2 by now (phase 3 is version-ordered and phase 2
+        # precedes it), and future batches get larger versions.
+        self._old_bounds = [
+            (b, until) for b, until in self._old_bounds if until >= version
+        ]
         for vi, (sv, txns) in enumerate(replies[0].state_mutations):
             for ti, (committed, muts) in enumerate(txns):
                 if committed and all(

@@ -108,10 +108,24 @@ class Resolver:
         self._key_sample[key] = self._key_sample.get(key, 0) + 1
         if len(self._key_sample) > SAMPLE_MAX_KEYS:
             # Decay: halve every count, drop the zeros (the transient-sample
-            # expiry analog; keeps hot keys, sheds one-offs).
+            # expiry analog; keeps hot keys, sheds one-offs).  Under wide
+            # uniform load halving alone may not shrink the dict (all
+            # counts >= 2) — evict the coldest entries down to 3/4 capacity
+            # so the rebuild amortizes to once per cap/4 inserts instead of
+            # running on every insert of the hot path.
             self._key_sample = {
                 k: v // 2 for k, v in self._key_sample.items() if v >= 2
             }
+            target = SAMPLE_MAX_KEYS * 3 // 4
+            if len(self._key_sample) > target:
+                import heapq
+
+                for k, _v in heapq.nsmallest(
+                    len(self._key_sample) - target,
+                    self._key_sample.items(),
+                    key=lambda kv: (kv[1], kv[0]),
+                ):
+                    del self._key_sample[k]
 
     async def _serve_metrics(self):
         while True:

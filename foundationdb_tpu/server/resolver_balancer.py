@@ -44,21 +44,24 @@ class ResolverBalancer:
         for r in self.resolvers:
             rep = await r.metrics.get_reply(proc, None)
             ops.append(rep.ops)
-        # The most imbalanced ADJACENT pair (boundaries only move between
-        # neighbors, like the reference's balancer).
+        # The most imbalanced ADJACENT pair among those that PASS the
+        # move gate (boundaries only move between neighbors, like the
+        # reference's balancer).  Gating after selection would let one big
+        # but-below-ratio gap starve a qualifying pair elsewhere forever.
         best, best_gap = None, 0
         for i in range(len(ops) - 1):
-            gap = abs(ops[i] - ops[i + 1])
+            oi, oj = ops[i], ops[i + 1]
+            if max(oi, oj) < self.min_ops or max(oi, oj) <= self.ratio * max(
+                1, min(oi, oj)
+            ):
+                continue
+            gap = abs(oi - oj)
             if gap > best_gap:
                 best, best_gap = i, gap
         if best is None:
             return None
         i = best
         oi, oj = ops[i], ops[i + 1]
-        if max(oi, oj) < self.min_ops or max(oi, oj) <= self.ratio * max(
-            1, min(oi, oj)
-        ):
-            return None
         bounds = sk.bounds_from_split_keys(self.split_keys)
         target = (oi + oj) / 2.0
         if oi > oj:

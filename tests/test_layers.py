@@ -1,0 +1,175 @@
+"""Tuple/subspace/directory layers.
+
+Ref: bindings/python/fdb tuple.py (ordering + round-trip properties, the
+binding tester's core checks), subspace_impl.py, directory_impl.py (node
+tree + HighContentionAllocator under concurrency).
+"""
+
+import uuid
+
+import pytest
+
+from foundationdb_tpu.flow import FdbError, set_event_loop
+from foundationdb_tpu.layers import (
+    DirectoryLayer,
+    Subspace,
+    Versionstamp,
+    pack,
+    unpack,
+)
+from foundationdb_tpu.server import SimCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+SAMPLES = [
+    (),
+    (None,),
+    (b"",),
+    (b"foo", b"b\x00ar"),
+    ("unicode ☃", ""),
+    (0, 1, -1, 255, 256, -255, -256, 2**63 - 1, -(2**63) + 1),
+    (1.5, -1.5, 0.0, 3.141592653589793),
+    (True, False),
+    (uuid.UUID(int=0x1234567890ABCDEF1234567890ABCDEF),),
+    ((b"nested", (1, None), ()), 2),
+    (Versionstamp(b"\x01" * 10, 7),),
+]
+
+
+def test_tuple_roundtrip():
+    for t in SAMPLES:
+        assert unpack(pack(t)) == t, t
+
+
+def test_tuple_ordering_matches_bytes():
+    """pack preserves element-wise order (the layer's defining property)."""
+    vals = [
+        (0,), (1,), (255,), (256,), (-1,), (-256,),
+        (b"a",), (b"a\x00",), (b"b",),
+        ("a",), ("b",),
+        (1.0,), (-2.5,), (2.5,),
+        (False,), (True,),
+        ((1,),), ((1, 2),), ((2,),),
+    ]
+    import itertools
+
+    for a, b in itertools.combinations(vals, 2):
+        if type(a[0]) is not type(b[0]):
+            continue
+        expect = (a < b)
+        assert (pack(a) < pack(b)) == expect, (a, b)
+
+
+def test_subspace():
+    s = Subspace(("app", 1))
+    key = s.pack((b"k", 2))
+    assert s.contains(key)
+    assert s.unpack(key) == (b"k", 2)
+    nested = s[b"sub"]
+    assert nested.raw_prefix.startswith(s.raw_prefix)
+    b, e = s.range()
+    assert b < nested.pack((1,)) < e
+
+
+def test_directory_create_open_list_move_remove():
+    c = SimCluster(seed=110)
+    db = c.database()
+    d = DirectoryLayer()
+    out = {}
+
+    async def go(tr):
+        app = await d.create_or_open(tr, ("app",))
+        users = await d.create_or_open(tr, ("app", "users"))
+        tr.set(users.pack((b"alice",)), b"1")
+        out["app"] = app
+        out["users"] = users
+
+    c.run_all([(db, db.run(go))])
+    assert out["users"].raw_prefix != out["app"].raw_prefix
+
+    async def check(tr):
+        again = await d.open(tr, ("app", "users"))
+        out["again"] = again
+        out["alice"] = await tr.get(again.pack((b"alice",)))
+        out["ls_root"] = await d.list(tr, ())
+        out["ls_app"] = await d.list(tr, ("app",))
+        with pytest.raises(FdbError, match="directory_already_exists"):
+            await d.create(tr, ("app", "users"))
+        with pytest.raises(FdbError, match="directory_does_not_exist"):
+            await d.open(tr, ("app", "nope"))
+
+    c.run_all([(db, db.run(check))])
+    assert out["again"].raw_prefix == out["users"].raw_prefix
+    assert out["alice"] == b"1"
+    assert out["ls_root"] == ["app"]
+    assert out["ls_app"] == ["users"]
+
+    async def mv(tr):
+        moved = await d.move(tr, ("app", "users"), ("app", "members"))
+        out["moved"] = moved
+
+    c.run_all([(db, db.run(mv))])
+    assert out["moved"].raw_prefix == out["users"].raw_prefix
+
+    async def after_mv(tr):
+        out["ls_after"] = await d.list(tr, ("app",))
+        m = await d.open(tr, ("app", "members"))
+        out["alice2"] = await tr.get(m.pack((b"alice",)))
+
+    c.run_all([(db, db.run(after_mv))])
+    assert out["ls_after"] == ["members"]
+    assert out["alice2"] == b"1"
+
+    async def rm(tr):
+        out["removed"] = await d.remove(tr, ("app",))
+
+    c.run_all([(db, db.run(rm))])
+
+    async def gone(tr):
+        out["exists"] = await d.exists(tr, ("app",))
+        out["data"] = await tr.get(out["users"].pack((b"alice",)))
+
+    c.run_all([(db, db.run(gone))])
+    assert out["removed"] is True
+    assert out["exists"] is False
+    assert out["data"] is None
+
+
+def test_hca_concurrent_allocations_unique():
+    """Many clients allocating directories concurrently must get unique
+    prefixes (the HighContentionAllocator's whole point)."""
+    c = SimCluster(seed=111)
+    d = DirectoryLayer()
+    dbs = [c.database() for _ in range(6)]
+    results = []
+
+    def worker(db, wid):
+        async def go():
+            for i in range(4):
+
+                async def op(tr, i=i):
+                    sub = await d.create_or_open(
+                        tr, ("w%d" % wid, "d%d" % i)
+                    )
+                    return sub.raw_prefix
+
+                results.append(await db.run(op))
+
+        return go()
+
+    c.run_all(
+        [(db, worker(db, i)) for i, db in enumerate(dbs)], timeout_vt=5000.0
+    )
+    assert len(results) == 24
+    assert len(set(results)) == 24  # all prefixes distinct
+    # No prefix is a prefix of another (directories must not nest by
+    # accident).
+    for a in results:
+        for b in results:
+            if a is not b:
+                assert not b.startswith(a) or a == b
