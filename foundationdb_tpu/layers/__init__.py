@@ -7,7 +7,9 @@ are the idiomatic way applications structure keys on the bare KV API.
 
 from . import tuple  # noqa: A004 - mirrors fdb.tuple's name
 from .directory import DirectoryLayer, DirectorySubspace, HighContentionAllocator
+from .backup import BackupContainer, FileBackupAgent
 from .subspace import Subspace
+from .taskbucket import TaskBucket, TaskBucketExecutor
 from .tuple import Versionstamp, pack, range_of, unpack
 
 __all__ = [
@@ -17,6 +19,10 @@ __all__ = [
     "range_of",
     "Versionstamp",
     "Subspace",
+    "TaskBucket",
+    "TaskBucketExecutor",
+    "BackupContainer",
+    "FileBackupAgent",
     "DirectoryLayer",
     "DirectorySubspace",
     "HighContentionAllocator",

@@ -1,0 +1,221 @@
+"""Backup and restore agents over TaskBucket.
+
+Ref: fdbclient/FileBackupAgent.actor.cpp + BackupContainer.actor.cpp —
+submitBackup queues a TaskBucket task; agent processes claim range-dump
+tasks, write row pages into a backup container, and chain continuation
+tasks until the manifest completes; restore replays the container in
+batched transactions.
+
+Rebuild scope (documented deviations): the snapshot is taken at ONE read
+version carried through every page task, so the restored image is a true
+point-in-time snapshot; if the version falls out of the MVCC window
+mid-backup (transaction_too_old), the backup RESTARTS at a fresh version
+instead of stitching a mutation log over fuzzy range reads (the
+reference's mutation-log machinery arrives with DR).  The container is a
+directory of pickled page files on the cluster's simulated filesystem.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List, Optional
+
+from ..client.types import key_after
+from ..flow.error import FdbError
+from .subspace import Subspace
+from .taskbucket import TaskBucket, TaskBucketExecutor
+
+PAGE_ROWS = 1000
+
+
+class BackupContainer:
+    """A directory of page files + a manifest (ref: BackupContainer's
+    kvranges/ + snapshot manifest layout, compacted)."""
+
+    def __init__(self, fs, process, path: str):
+        self.fs = fs
+        self.process = process
+        self.path = path
+        self._n = 0
+
+    async def write_page(self, index: int, begin: bytes, rows) -> str:
+        name = f"{self.path}/range-{index:06d}"
+        f = self.fs.open(self.process, name)
+        blob = pickle.dumps((begin, rows), protocol=4)
+        await f.write(0, len(blob).to_bytes(8, "big") + blob)
+        await f.sync()
+        return name
+
+    async def write_manifest(
+        self, version: int, pages: int, begin: bytes = b"", end: bytes = b"\xff"
+    ):
+        f = self.fs.open(self.process, f"{self.path}/manifest")
+        blob = pickle.dumps(
+            {"version": version, "pages": pages, "begin": begin, "end": end},
+            protocol=4,
+        )
+        await f.write(0, len(blob).to_bytes(8, "big") + blob)
+        await f.sync()
+
+    async def _read_blob(self, name: str):
+        f = self.fs.open(self.process, name)
+        size = f.size()
+        if size < 8:
+            return None
+        img = await f.read(0, size)
+        n = int.from_bytes(img[:8], "big")
+        if len(img) < 8 + n:
+            return None
+        return pickle.loads(img[8 : 8 + n])
+
+    async def read_manifest(self) -> Optional[dict]:
+        if not self.fs.exists(self.process, f"{self.path}/manifest"):
+            return None
+        return await self._read_blob(f"{self.path}/manifest")
+
+    async def read_page(self, index: int):
+        return await self._read_blob(f"{self.path}/range-{index:06d}")
+
+
+class FileBackupAgent:
+    """Snapshot backup driver (ref: FileBackupAgent submitBackup :?  +
+    the RangeDump task family)."""
+
+    def __init__(
+        self,
+        db,
+        fs,
+        store_process=None,
+        bucket_prefix: bytes = b"\xff\x02/backup/",
+    ):
+        # Task state lives in the system keyspace like the reference's
+        # (ref: the backup agent's config space under \xff\x02).  The
+        # container filesystem is keyed per machine, so all agents write
+        # through ONE store process — the stand-in for a shared blobstore
+        # endpoint (ref: BlobStoreEndpoint fdbrpc/BlobStore.actor.cpp).
+        self.db = db
+        self.fs = fs
+        self.store_process = store_process or db.process
+        self.bucket = TaskBucket(Subspace(raw_prefix=bucket_prefix))
+
+    def container(self, path: str) -> BackupContainer:
+        return BackupContainer(self.fs, self.store_process, path)
+
+    async def submit_backup(
+        self, container: BackupContainer, begin: bytes = b"", end: bytes = b"\xff"
+    ):
+        """Queue the snapshot (ref: submitBackup writing the first task)."""
+
+        async def txn(tr):
+            tr.options["access_system_keys"] = True
+            version = await tr.get_read_version()
+            self.bucket.add(
+                tr,
+                {
+                    b"type": b"backup_range",
+                    b"path": container.path.encode(),
+                    b"begin": begin,
+                    b"end": end,
+                    b"restart_begin": begin,
+                    b"version": b"%d" % version,
+                    b"page": b"0",
+                },
+            )
+
+        await self.db.run(txn)
+
+    def executor(self, db=None) -> TaskBucketExecutor:
+        """A backup agent process (run several for parallelism/failover)."""
+        return TaskBucketExecutor(
+            db or self.db,
+            self.bucket,
+            {"backup_range": self._run_backup_range},
+        )
+
+    async def _run_backup_range(self, db, task) -> List[dict]:
+        p = task.params
+        container = self.container(p[b"path"].decode())
+        begin, end = p[b"begin"], p[b"end"]
+        version = int(p[b"version"])
+        page = int(p[b"page"])
+
+        async def read_page(tr):
+            tr.options["access_system_keys"] = True
+            tr.set_read_version(version)
+            rows = await tr.get_range(
+                begin, end, limit=PAGE_ROWS, snapshot=True
+            )
+            return rows
+
+        try:
+            rows = await db.run(read_page)
+        except FdbError as e:
+            if e.name != "transaction_too_old":
+                raise
+            # Snapshot fell out of the MVCC window: restart the whole
+            # backup at a fresh version (see module docstring).
+            async def fresh(tr):
+                return await tr.get_read_version()
+
+            new_version = await db.run(fresh)
+            return [
+                {
+                    b"type": b"backup_range",
+                    b"path": p[b"path"],
+                    b"begin": p[b"restart_begin"],
+                    b"end": end,
+                    b"restart_begin": p[b"restart_begin"],
+                    b"version": b"%d" % new_version,
+                    b"page": b"0",
+                }
+            ]
+        await container.write_page(page, begin, rows)
+        if len(rows) >= PAGE_ROWS:
+            return [
+                {
+                    b"type": b"backup_range",
+                    b"path": p[b"path"],
+                    b"begin": key_after(rows[-1][0]),
+                    b"end": end,
+                    b"restart_begin": p[b"restart_begin"],
+                    b"version": p[b"version"],
+                    b"page": b"%d" % (page + 1),
+                }
+            ]
+        await container.write_manifest(
+            version, page + 1, p[b"restart_begin"], end
+        )
+        return []
+
+    async def restore(self, container: BackupContainer, batch_rows: int = 500):
+        """Clear the target range and replay the container (ref:
+        FileBackupAgent restore tasks, compacted to a client-side loop)."""
+        manifest = await container.read_manifest()
+        if manifest is None:
+            raise FdbError("file_not_found")
+        # Clear the target range first so the result IS the snapshot image,
+        # not a merge with whatever was written since (ref: restore clearing
+        # restoreRange before applying).
+        async def clear_txn(tr):
+            tr.clear_range(
+                manifest.get("begin", b""), manifest.get("end", b"\xff")
+            )
+
+        await self.db.run(clear_txn)
+        rows_restored = 0
+        for i in range(manifest["pages"]):
+            pg = await container.read_page(i)
+            if pg is None:
+                raise FdbError("file_corrupt")
+            _begin, rows = pg
+            for off in range(0, max(len(rows), 1), batch_rows):
+                chunk = rows[off : off + batch_rows]
+
+                async def txn(tr, chunk=chunk):
+                    for k, v in chunk:
+                        tr.set(k, v)
+
+                if chunk:
+                    await self.db.run(txn)
+                    rows_restored += len(chunk)
+        return rows_restored

@@ -159,9 +159,7 @@ class DirectoryLayer:
             raise FdbError("directory_does_not_exist")
         # Create missing parents, then this directory.
         parent_node = self._node(b"")
-        walked: List[str] = []
         for name in path[:-1]:
-            walked.append(name)
             child = await tr.get(self._child_key(parent_node, name))
             if child is None:
                 sub = await self._create_one(tr, parent_node, name, b"")
@@ -219,6 +217,8 @@ class DirectoryLayer:
     async def remove(self, tr, path) -> bool:
         """Delete the directory, its subdirectories, and ALL content."""
         path = tuple(path)
+        if not path:
+            raise ValueError("the root directory cannot be removed")
         node, prefix = await self._find(tr, path)
         if node is None:
             return False

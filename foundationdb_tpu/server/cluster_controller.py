@@ -458,7 +458,6 @@ class ClusterController:
         count.  Comparing against the recruited count (not self.n_proxies)
         means a change detected mid-recovery re-flags under the next
         generation's monitor instead of being lost."""
-        from ..client.management import get_configuration
         from ..client.transaction import Database
 
         db = Database(

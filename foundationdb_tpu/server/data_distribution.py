@@ -208,6 +208,36 @@ class DataDistributor:
             if set(team) != set(target):
                 await self.move(b, target)
 
+    async def process_exclusions(
+        self, replacement_id: Optional[str] = None, tlogs: list = None
+    ) -> list:
+        """Apply operator exclusions (ref: DD reacting to
+        excludedServersKeys — excluded servers are treated like failed
+        ones): move every excluded server's shards to its teammates (or the
+        replacement), and when `tlogs` interfaces are given, unregister the
+        excluded server's log tag so its PERSISTED pop floor stops holding
+        the logs' discard floor.  Returns the ids acted on."""
+        from ..client.management import get_excluded_servers
+        from .interfaces import TLogPopRequest
+
+        excluded = await get_excluded_servers(self.db)
+        acted = []
+        for sid in excluded:
+            in_map = any(
+                sid in set(dest or team)
+                for _b, _e, team, dest in await self.read_shard_map()
+            )
+            if not in_map:
+                continue
+            await self.heal(sid, replacement_id)
+            for tl in tlogs or []:
+                await tl.pop.get_reply(
+                    self.db.process,
+                    TLogPopRequest(tag=sid, unregister=True),
+                )
+            acted.append(sid)
+        return acted
+
     async def heal(self, dead_id: str, replacement_id: Optional[str] = None):
         """Re-replicate every shard that lists a dead storage: survivors
         stay the fetch sources, a replacement (or nothing, dropping to a

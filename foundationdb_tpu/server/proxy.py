@@ -143,8 +143,11 @@ class Proxy:
         self.stats = {"committed": 0, "conflicted": 0, "too_old": 0, "batches": 0}
         self._last_batch_cut = process.network.loop.now()
         process.spawn(self._commit_batcher(), "proxy_batcher")
-        if n_proxies > 1:
-            process.spawn(self._idle_batch_ticker(), "proxy_idle_tick")
+        # Always tick (not just multi-proxy): empty batches advance the
+        # committed version with virtual time, which TaskBucket leases and
+        # MVCC-window expiry depend on (ref: the master's version clock
+        # advancing with wall time, masterserver getVersion :800-809).
+        process.spawn(self._idle_batch_ticker(), "proxy_idle_tick")
         process.spawn(self._serve_grv(), "proxy_grv")
         process.spawn(self._serve_locations(), "proxy_locations")
         process.spawn(self._serve_load_map(), "proxy_load_map")

@@ -463,8 +463,15 @@ class StorageServer:
             if floor > self.version.get():
                 self.version.set(floor)
             if self.kvstore is None:
-                # In-memory engine: applied == durable, pop eagerly.
-                self.durable_version = self.version.get()
+                # In-memory engine: every version stays in the RAM window,
+                # so only the MVCC-window floor limits old reads (ref: the
+                # 5s window, oldestVersion = version - MAX_WRITE_TRANSACTION
+                # _LIFE_VERSIONS); the log still pops eagerly.
+                self.durable_version = max(
+                    self.durable_version,
+                    self.version.get()
+                    - g_knobs.server.max_write_transaction_life_versions,
+                )
                 self._pop_all(self.version.get())
             elif (
                 (
@@ -489,8 +496,21 @@ class StorageServer:
         The durable floor is raised BEFORE the engine's RAM state is
         mutated: reads below the new floor error transaction_too_old instead
         of falling through the window to a base engine that is already ahead
-        of their version (the fold + commit spans awaits)."""
-        new_durable = self.version.get()
+        of their version (the fold + commit spans awaits).
+
+        The fold stops an MVCC window short of the applied version (ref:
+        storageserver keeping the newest ~5s in the versioned window;
+        oldestVersion trails by MAX_WRITE_TRANSACTION_LIFE_VERSIONS) so
+        reads at any version the resolver would still admit keep working —
+        durability of the recent tail is the log's job until it is popped
+        here."""
+        new_durable = max(
+            self.durable_version,
+            self.version.get()
+            - g_knobs.server.max_write_transaction_life_versions,
+        )
+        if new_durable <= self.durable_version:
+            return
         self.durable_version = new_durable
         ops = []
         for key, chain in self.store.kv.items():

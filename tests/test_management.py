@@ -59,10 +59,17 @@ def test_configure_proxies_triggers_regeneration():
         return await tr.get(b"before")
 
     async def wait_regen():
+        # Convergence is eventual: an unrelated recovery (failover, role
+        # failure) may interleave with the config-triggered one, and a new
+        # leader re-learns the desired count from \xff/conf.  Wait for the
+        # generation actually satisfying the configuration.
         loop = c.loop
         while True:
             cc = c.acting_controller()
-            if cc.generation > gen_before and cc.client_info.get().proxies:
+            if (
+                cc.generation > gen_before
+                and len(cc.client_info.get().proxies) == 2
+            ):
                 break
             await loop.delay(0.2)
         return await db.run(after)
@@ -90,3 +97,56 @@ def test_exclude_include_records():
     first, second = c.run_until(db.process.spawn(go()), timeout_vt=2000.0)
     assert first == ["ss:worker4"]
     assert second == []
+
+
+def test_exclusion_drives_dd_healing():
+    """exclude_servers + DD.process_exclusions: shards move off the
+    excluded storage and its log tag stops holding the discard floor."""
+    from foundationdb_tpu.server import SimCluster
+
+    c = SimCluster(seed=123, n_storages=2)
+    db = c.database()
+
+    async def fill(tr):
+        for i in range(30):
+            tr.set(b"x%03d" % i, b"v%d" % i)
+
+    c.run_all([(db, db.run(fill))])
+    dd = c.data_distributor()
+
+    async def place():
+        await dd.register_storages(dd.storages)
+        await dd.seed(["ss0"])
+        # Replicate onto ss1 (a real fetch), then exclude the original.
+        await dd.move(b"", ["ss0", "ss1"])
+        await mgmt.exclude_servers(db, ["ss0"])
+        # The excluded process shuts down (the realistic operator flow:
+        # exclude, wait for data to drain, decommission); its PERSISTED
+        # tag floor must not freeze log trimming forever.
+        c.storages[0].process.kill()
+        return await dd.process_exclusions(
+            tlogs=[t.interface() for t in c.tlogs]
+        )
+
+    acted = c.run_until(db.process.spawn(place()), timeout_vt=5000.0)
+    assert acted == ["ss0"]
+
+    async def verify():
+        shard_map = await dd.read_shard_map()
+        return shard_map
+
+    shard_map = c.run_until(db.process.spawn(verify()), timeout_vt=1000.0)
+    for _b, _e, team, dest in shard_map:
+        assert "ss0" not in set(team) | set(dest or []), shard_map
+
+    # Data still readable (served by ss1).
+    out = {}
+
+    async def check(tr):
+        out["rows"] = await tr.get_range(b"x", b"y")
+
+    c.run_all([(db, db.run(check))])
+    assert len(out["rows"]) == 30
+    # The excluded tag no longer holds any tlog's floor.
+    for t in c.tlogs:
+        assert "ss0" not in t.popped_tags
