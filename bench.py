@@ -159,7 +159,7 @@ def bench_cpu(rng, n_batches=20, per_batch=2500):
     return n_batches * per_batch / dt
 
 
-def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=1 << 22, window=WINDOW):
+def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=3407872, window=WINDOW):
     """Steady-state device throughput at the BASELINE.json 64k-batch config,
     with the reference's full 50-batch live window (skipListTest detects at
     now=i+50, evicts below i — SkipList.cpp:1473-1475).
@@ -167,8 +167,10 @@ def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=1 << 22, window=WINDOW):
     Dispatch is pipelined (dispatch_packed): host packing + the single-blob
     transfer of batch N+1 overlap device compute of batch N, exactly as the
     production resolver pipelines batches on the prevVersion chain.  h_cap
-    is pre-sized for the steady-state boundary count so no growth (= jit
-    reshape + recompile) happens inside the timed region.
+    is pre-sized for the steady-state boundary count (2.87M live
+    boundaries + ~19% headroom; every H-proportional pass scales with it)
+    so no growth (= jit reshape + recompile) happens inside the timed
+    region.
     """
     import jax
 
@@ -239,7 +241,7 @@ def main():
         platform = setup_jax()
         out["platform"] = platform
         warm_compile_probe()
-        _log("device bench: 24 batches x 65536 txns, window=50, h_cap=4M "
+        _log("device bench: 24 batches x 65536 txns, window=50, h_cap=3.25M "
              "(first compile may take minutes on this 1-core host)...")
         jax_rate = bench_jax(rng)
         _log(f"device: {jax_rate:,.0f} txn/s")

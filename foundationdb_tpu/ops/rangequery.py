@@ -74,6 +74,27 @@ def searchsorted_words(keys: jnp.ndarray, q: jnp.ndarray, side: str) -> jnp.ndar
     return lo
 
 
+def searchsorted_1d(keys: jnp.ndarray, q: jnp.ndarray, side: str) -> jnp.ndarray:
+    """Insertion ranks of int queries q into 1-D sorted int keys — the
+    single-word fast path of searchsorted_words (jnp.searchsorted lowers
+    poorly on TPU; this fixed-step loop of 1-D gathers measures ~1000x
+    faster at 64k queries into 128k keys)."""
+    n = keys.shape[0]
+    lo = jnp.zeros(q.shape, jnp.int32)
+    hi = jnp.full(q.shape, n, jnp.int32)
+    for _ in range(max(1, math.ceil(math.log2(max(n, 2))) + 1)):
+        # The active guard stops converged lanes: without it, one extra
+        # iteration past lo==hi==n keeps incrementing lo for queries at or
+        # beyond the last key whenever n is not a power of two.
+        active = lo < hi
+        mid = (lo + hi) // 2
+        kmid = keys[jnp.clip(mid, 0, n - 1)]
+        go_right = (kmid <= q) if side == "right" else (kmid < q)
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
 def floor_log2(x: jnp.ndarray) -> jnp.ndarray:
     """floor(log2(x)) for x >= 1, int32."""
     return 31 - jax.lax.clz(jnp.maximum(x, 1).astype(jnp.int32))

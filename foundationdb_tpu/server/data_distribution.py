@@ -222,14 +222,18 @@ class DataDistributor:
 
         excluded = await get_excluded_servers(self.db)
         acted = []
+        # One authoritative map read serves every membership check; heal()
+        # re-reads for itself, so refresh only after an actual heal.
+        shard_map = await self.read_shard_map()
         for sid in excluded:
             in_map = any(
                 sid in set(dest or team)
-                for _b, _e, team, dest in await self.read_shard_map()
+                for _b, _e, team, dest in shard_map
             )
             if not in_map:
                 continue
             await self.heal(sid, replacement_id)
+            shard_map = await self.read_shard_map()
             for tl in tlogs or []:
                 await tl.pop.get_reply(
                     self.db.process,

@@ -37,6 +37,11 @@ class CliProcessor:
         "commit": "commit — commit the explicit transaction",
         "rollback": "rollback — abandon the explicit transaction",
         "watch": "watch <key> — report when the key changes",
+        "configure": "configure <name>=<value> ... — change configuration "
+        "(proxies=N, storage_team_size=N, ...)",
+        "exclude": "exclude <storage_id> ... — mark storages for removal",
+        "include": "include [<storage_id> ...] — clear exclusions "
+        "(no args: all)",
         "help": "help — this text",
     }
 
@@ -177,6 +182,40 @@ class CliProcessor:
             return ["ERROR: no transaction in progress"]
         self._tr = None
         return ["Transaction rolled back"]
+
+    async def _cmd_configure(self, args):
+        """Ref: fdbcli `configure proxies=2 ...` -> changeConfig."""
+        from ..client import management as mgmt
+
+        params = {}
+        for a in args:
+            if "=" not in a:
+                return [f"ERROR: expected name=value, got `{a}'"]
+            name, value = a.split("=", 1)
+            try:
+                params[name] = int(value)
+            except ValueError:
+                return [f"ERROR: `{name}' needs an integer value, got `{value}'"]
+        try:
+            await mgmt.configure(self.db, **params)
+        except ValueError as e:
+            return [f"ERROR: {e}"]
+        return ["Configuration changed"]
+
+    async def _cmd_exclude(self, args):
+        from ..client import management as mgmt
+
+        if not args:
+            excluded = await mgmt.get_excluded_servers(self.db)
+            return [f"Excluded: {', '.join(excluded) or '(none)'}"]
+        await mgmt.exclude_servers(self.db, list(args))
+        return [f"Excluded {len(args)} server(s)"]
+
+    async def _cmd_include(self, args):
+        from ..client import management as mgmt
+
+        await mgmt.include_servers(self.db, list(args) or None)
+        return ["Included"]
 
     async def _cmd_watch(self, args):
         (key,) = args

@@ -101,3 +101,23 @@ def test_status_dynamic_cluster():
         "storage",
         "tlog",
     }
+
+
+def test_cli_configure_exclude_include():
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.tools.cli import CliProcessor
+
+    c = SimCluster(seed=55)
+    db = c.database("cli")
+    cli = CliProcessor(c, db)
+
+    def run(line):
+        task = db.process.spawn(cli.run_command(line))
+        return c.loop.run_until(task, timeout_vt=200.0)
+
+    assert run("configure proxies=2") == ["Configuration changed"]
+    assert run("exclude ss9") == ["Excluded 1 server(s)"]
+    assert run("exclude") == ["Excluded: ss9"]
+    assert run("include") == ["Included"]
+    assert run("exclude") == ["Excluded: (none)"]
+    assert run("configure bogus") == ["ERROR: expected name=value, got `bogus'"]
