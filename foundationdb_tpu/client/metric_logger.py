@@ -1,0 +1,66 @@
+"""MetricLogger: persist counter collections into the `\xff/metrics`
+keyspace.
+
+Ref: fdbclient/MetricLogger.actor.cpp — TDMetric time series are written
+into the database itself on a cadence, so operators and tools read metrics
+with ordinary transactions (fdbcli, StatusWorkload).  Here each counter
+lands at `\xff/metrics/<collection>/<name>` with a packed (time, value)
+sample appended to a bounded series.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import List
+
+METRICS_PREFIX = b"\xff/metrics/"
+METRICS_END = b"\xff/metrics0"
+MAX_SAMPLES = 64  # bounded series per metric (oldest dropped)
+
+
+def metric_key(collection: str, name: str) -> bytes:
+    return METRICS_PREFIX + collection.encode() + b"/" + name.encode()
+
+
+async def log_metrics_once(db, collections: List) -> None:
+    """One flush of every counter's current value (appended to its
+    series)."""
+    loop = db.process.network.loop
+    now = loop.now()
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        for coll in collections:
+            for name, c in coll.counters.items():
+                key = metric_key(coll.name, name)
+                raw = await tr.get(key)
+                series = pickle.loads(raw) if raw else []
+                series.append((now, c.value))
+                tr.set(
+                    key, pickle.dumps(series[-MAX_SAMPLES:], protocol=4)
+                )
+
+    await db.run(txn)
+
+
+async def run_metric_logger(db, collections: List, interval: float = 5.0):
+    """The periodic flush actor (ref: runMetrics MetricLogger.actor.cpp)."""
+    loop = db.process.network.loop
+    while True:
+        await loop.delay(interval)
+        await log_metrics_once(db, collections)
+
+
+async def read_metrics(db, collection: str) -> dict:
+    """{name: [(time, value)]} for one collection (the consumer side)."""
+    out = {}
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        prefix = METRICS_PREFIX + collection.encode() + b"/"
+        rows = await tr.get_range(prefix, prefix + b"\xff")
+        for k, v in rows:
+            out[k[len(prefix):].decode()] = pickle.loads(v)
+
+    await db.run(txn)
+    return out

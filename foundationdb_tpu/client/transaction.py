@@ -80,6 +80,11 @@ class Database:
         self.info_var = info_var
         # range -> tuple(StorageInterface) | () unsharded | None unknown
         self._loc_cache = RangeMap(None)
+        # Per-replica latency/failure model for read routing (ref:
+        # QueueModel fdbrpc/QueueModel.h, fed by loadBalance).
+        from ..rpc.loadbalance import QueueModel
+
+        self.queue_model = QueueModel()
 
     def invalidate_location(self, begin: bytes, end: Optional[bytes] = None):
         self._loc_cache.set_range(begin, end or key_after(begin), None)
@@ -227,27 +232,47 @@ class Transaction:
 
     # --- reads ---
     async def _get_from_storage(self, key: bytes, version: int):
-        """Routed point read with location-cache invalidation + retry (ref:
-        getValue's wrong_shard_server handling, NativeAPI.actor.cpp:1164)."""
+        """Routed point read: the replica team is ordered by the queue
+        model and slow replies hedge to the runner-up (ref: loadBalance
+        fdbrpc/LoadBalance.actor.h:159); wrong_shard_server invalidates the
+        location cache and re-resolves (ref: getValue's handling,
+        NativeAPI.actor.cpp:1164)."""
+        from ..rpc.loadbalance import load_balance
+
         loop = self.db.process.network.loop
         last = FdbError("broken_promise")
         for attempt in range(MAX_REROUTE_ATTEMPTS):
-            iface = await self.db.storage_for_key(key, attempt)
+            locs = await self.db.get_locations(key, key_after(key))
+            # Entry value None (unresolved after the gap-fill cap) or ()
+            # (unsharded) both fall back to the default storage.
+            team = list(locs[0][2] or ()) or [self.db.storage]
             try:
-                return await iface.get_value.get_reply(
-                    self.db.process, GetValueRequest(key=key, version=version)
+                return await load_balance(
+                    self.db.process,
+                    self.db.queue_model,
+                    team,
+                    lambda iface: iface.get_value.get_reply(
+                        self.db.process,
+                        GetValueRequest(key=key, version=version),
+                    ),
+                    key_of=lambda iface: getattr(iface, "storage_id", "")
+                    or id(iface),
                 )
             except FdbError as e:
-                # future_version also rotates: a replica too far behind its
-                # log (e.g. its range was popped past) should not fail reads
-                # its healthy teammates can serve (ref: loadBalance trying
-                # the next alternative).
                 if e.name not in (
                     "wrong_shard_server",
                     "broken_promise",
                     "future_version",
+                    "all_alternatives_failed",
                 ):
                     raise
+                if e.name == "future_version":
+                    # The team is just behind its log — retry without
+                    # invalidating (a location refetch would return the
+                    # identical team and only load the proxy).
+                    last = e
+                    await loop.delay(REROUTE_DELAY)
+                    continue
                 last = e
                 # Invalidate on broken_promise too: if the WHOLE cached team
                 # is dead (healed away), only a location refetch recovers

@@ -1,0 +1,39 @@
+"""Simulation-only invariant recorder.
+
+Ref: fdbrpc/sim_validation.{h,cpp} — production code records promises the
+simulation later checks ("this version was acknowledged durable"); a
+violation is a loud simulation failure, not a silent wrong answer.  State
+hangs off the event loop so concurrent simulated clusters in one test
+process do not interfere.
+"""
+
+from __future__ import annotations
+
+
+def _state(loop) -> dict:
+    st = getattr(loop, "_sim_validation", None)
+    if st is None:
+        st = loop._sim_validation = {}
+    return st
+
+
+def mark_at_least(loop, key: str, value: int):
+    """Record a monotone promise, e.g. 'commits through V were acked'."""
+    st = _state(loop)
+    if value > st.get(key, -(1 << 62)):
+        st[key] = value
+
+
+def marked(loop, key: str) -> int:
+    return _state(loop).get(key, -(1 << 62))
+
+
+def expect_at_least(loop, key: str, value: int, context: str = ""):
+    """The checking side: `value` must cover every marked promise (e.g. a
+    recovery's epoch cut must not truncate below an acked commit)."""
+    m = _state(loop).get(key, None)
+    if m is not None and value < m:
+        raise AssertionError(
+            f"sim_validation: {key} promised {m} but observed {value}"
+            + (f" ({context})" if context else "")
+        )

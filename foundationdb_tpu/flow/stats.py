@@ -1,0 +1,69 @@
+"""Counters with periodic trace emission.
+
+Ref: flow/Stats.h — `Counter` :55 (value + rate tracking),
+`CounterCollection` :63, and `traceCounters` :111 (an actor emitting every
+counter as a TraceEvent on an interval, resetting rates).
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from .trace import TraceEvent
+
+
+class Counter:
+    __slots__ = ("name", "value", "_last", "_last_t")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+        self._last = 0
+        self._last_t = 0.0
+
+    def add(self, n: int = 1):
+        self.value += n
+
+    def rate_since_last(self, now: float) -> float:
+        dt = now - self._last_t
+        r = (self.value - self._last) / dt if dt > 0 else 0.0
+        self._last = self.value
+        self._last_t = now
+        return r
+
+
+class CounterCollection:
+    def __init__(self, name: str):
+        self.name = name
+        self.counters: Dict[str, Counter] = {}
+
+    def counter(self, name: str) -> Counter:
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter(name)
+        return c
+
+    def add(self, name: str, n: int = 1):
+        self.counter(name).add(n)
+
+    def __getitem__(self, name: str) -> int:
+        return self.counter(name).value
+
+    def snapshot(self) -> Dict[str, int]:
+        return {k: c.value for k, c in self.counters.items()}
+
+
+async def trace_counters(
+    collection: CounterCollection, process, interval: float = 5.0
+):
+    """Emit every counter periodically (ref: traceCounters flow/Stats.h:111
+    — one event per collection with .detail per counter + rates)."""
+    loop = process.network.loop
+    while True:
+        await loop.delay(interval)
+        ev = TraceEvent(f"{collection.name}Metrics")
+        now = loop.now()
+        for name, c in sorted(collection.counters.items()):
+            ev.detail(name, c.value)
+            ev.detail(f"{name}Rate", round(c.rate_since_last(now), 3))
+        ev.log()

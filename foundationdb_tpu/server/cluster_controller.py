@@ -224,6 +224,14 @@ class ClusterController:
             tlog_ifs[i] = tlog_if
             durables.append(tlog_durable)
         cut = min(durables)
+        # The cut truncates above it; an acknowledged commit above the cut
+        # would be silent data loss — the recorder makes it loud (ref:
+        # sim_validation's durability promises, SURVEY §5).
+        from ..flow import sim_validation
+
+        sim_validation.expect_at_least(
+            loop, "acked_commit", cut, "epoch-end cut below an acked commit"
+        )
         epoch_end = max([epoch_end] + durables)
         recovery_version = epoch_end + g_knobs.server.max_versions_in_flight
         for w in tlog_ws:

@@ -140,7 +140,13 @@ class Proxy:
         self._load_map_stream = RequestStream(
             process, "load_system_map", well_known=True
         )
-        self.stats = {"committed": 0, "conflicted": 0, "too_old": 0, "batches": 0}
+        # Ref: ProxyStats MasterProxyServer.actor.cpp:45 + traceCounters.
+        from ..flow.stats import CounterCollection, trace_counters
+
+        self.stats = CounterCollection(f"Proxy{proxy_id}")
+        for _c in ("batches", "committed", "conflicted", "too_old"):
+            self.stats.counter(_c)  # pre-create: snapshots list all four
+        process.spawn(trace_counters(self.stats, process), "proxy_stats")
         self._last_batch_cut = process.network.loop.now()
         process.spawn(self._commit_batcher(), "proxy_batcher")
         # Always tick (not just multi-proxy): empty batches advance the
@@ -417,7 +423,7 @@ class Proxy:
     ):
         from ..flow.eventloop import wait_for_all
 
-        self.stats["batches"] += 1
+        self.stats.add("batches")
         # Phase 1: commit version from the sequencer, serialized in local
         # batch order so this proxy's versions are monotone in batch order
         # (ref: the localBatchNumber chain :362; GetCommitVersionRequest ->
@@ -585,6 +591,11 @@ class Proxy:
             ]
         )
 
+        from ..flow import sim_validation
+
+        sim_validation.mark_at_least(
+            self.process.network.loop, "acked_commit", version
+        )
         # Phase 5: report + reply (ref :636-677).  NOTE: metadata applied
         # pre-push (phase 3) — if the push then fails, the map may reflect a
         # handoff whose commit outcome is unknown; that batch also wedges
@@ -596,11 +607,11 @@ class Proxy:
             self.committed.set(version)
         for (req, reply), status in zip(batch, statuses):
             if status == COMMITTED:
-                self.stats["committed"] += 1
+                self.stats.add("committed")
                 reply.send(version)
             elif status == TOO_OLD:
-                self.stats["too_old"] += 1
+                self.stats.add("too_old")
                 reply.send_error("transaction_too_old")
             else:
-                self.stats["conflicted"] += 1
+                self.stats.add("conflicted")
                 reply.send_error("not_committed")

@@ -85,7 +85,7 @@ def cluster_status(cluster) -> dict:
         }
     if proxy is not None:
         cl["workload"] = {
-            "transactions": dict(proxy.stats),
+            "transactions": proxy.stats.snapshot(),
             "committed_version": proxy.committed.get(),
         }
         rk = getattr(proxy, "ratekeeper", None)

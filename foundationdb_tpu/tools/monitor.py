@@ -1,0 +1,165 @@
+"""Process watchdog: keep the configured server processes running.
+
+Ref: fdbmonitor/fdbmonitor.cpp — a deliberately plain (non-flow) daemon
+that parses an ini config, forks/execs one process per [section], restarts
+crashed children with exponential backoff (:274-283), and re-reads the
+config when it changes (inotify there; mtime polling here — same
+observable behavior, no platform dependency).
+
+Config format (ini):
+
+    [general]
+    restart_delay = 2          ; max backoff seconds
+    logdir = /var/log/cluster  ; per-child stdout/err files (optional)
+
+    [server.1]
+    command = python -m foundationdb_tpu.tools.real_node server --port 4500
+
+Run: python -m foundationdb_tpu.tools.monitor <conf-file>
+"""
+
+from __future__ import annotations
+
+import configparser
+import os
+import shlex
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, Optional
+
+
+class _Child:
+    def __init__(self, name: str, command: str):
+        self.name = name
+        self.command = command
+        self.proc: Optional[subprocess.Popen] = None
+        self.failures = 0
+        self.backoff_until = 0.0
+        self.started_at = 0.0
+
+    def alive(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+class Monitor:
+    def __init__(self, conf_path: str, out=sys.stderr):
+        self.conf_path = conf_path
+        self.out = out
+        self.children: Dict[str, _Child] = {}
+        self.max_restart_delay = 2.0
+        self.logdir: Optional[str] = None
+        self._conf_mtime = 0.0
+        self.stopped = False
+
+    def _log(self, msg: str):
+        print(f"[monitor] {msg}", file=self.out, flush=True)
+
+    def load_config(self) -> bool:
+        """(Re)read the config; returns True when it changed.  Sections
+        other than [general] each define one child via `command`."""
+        try:
+            mtime = os.stat(self.conf_path).st_mtime
+        except OSError:
+            return False
+        if mtime == self._conf_mtime:
+            return False
+        self._conf_mtime = mtime
+        cp = configparser.ConfigParser()
+        cp.read(self.conf_path)
+        if cp.has_option("general", "restart_delay"):
+            self.max_restart_delay = cp.getfloat("general", "restart_delay")
+        if cp.has_option("general", "logdir"):
+            self.logdir = cp.get("general", "logdir")
+            os.makedirs(self.logdir, exist_ok=True)
+        wanted = {
+            s: cp.get(s, "command")
+            for s in cp.sections()
+            if s != "general" and cp.has_option(s, "command")
+        }
+        # Stop removed/changed children; add new ones (ref: the config
+        # reload diffing in fdbmonitor's watch_conf_file handling).
+        for name in list(self.children):
+            ch = self.children[name]
+            if name not in wanted or wanted[name] != ch.command:
+                self._stop_child(ch)
+                del self.children[name]
+        for name, cmd in wanted.items():
+            if name not in self.children:
+                self.children[name] = _Child(name, cmd)
+        self._log(f"config loaded: {sorted(self.children)}")
+        return True
+
+    def _start_child(self, ch: _Child):
+        self._log(f"starting {ch.name}: {ch.command}")
+        ch.started_at = time.monotonic()
+        if self.logdir:
+            # Per-child log files, like fdbmonitor's logdir (unbuffered so
+            # READY-style liveness lines appear promptly).
+            logf = open(
+                os.path.join(self.logdir, f"{ch.name}.log"), "ab", buffering=0
+            )
+            ch.proc = subprocess.Popen(
+                shlex.split(ch.command), stdout=logf, stderr=subprocess.STDOUT
+            )
+            logf.close()
+        else:
+            ch.proc = subprocess.Popen(shlex.split(ch.command))
+
+    def _stop_child(self, ch: _Child):
+        if ch.alive():
+            self._log(f"stopping {ch.name} (pid {ch.proc.pid})")
+            ch.proc.send_signal(signal.SIGTERM)
+            try:
+                ch.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                ch.proc.kill()
+
+    def poll_once(self, now: Optional[float] = None):
+        """One supervision round: reap exits, schedule restarts with
+        doubling backoff capped at restart_delay (ref: fdbmonitor
+        :274-283 — delay halves again after a stable run)."""
+        now = time.monotonic() if now is None else now
+        self.load_config()
+        for ch in self.children.values():
+            if ch.alive():
+                continue
+            if ch.proc is not None:
+                rc = ch.proc.poll()
+                self._log(f"{ch.name} exited rc={rc}")
+                ch.proc = None
+                # A stable run forgives past crashes (ref: fdbmonitor
+                # halving the delay after the child stays up).
+                if now - ch.started_at > 2 * self.max_restart_delay + 5:
+                    ch.failures = 0
+                ch.failures += 1
+                delay = min(
+                    self.max_restart_delay, 0.1 * (2 ** min(ch.failures, 10))
+                )
+                ch.backoff_until = now + delay
+            if now >= ch.backoff_until:
+                self._start_child(ch)
+
+    def run(self):
+        self.load_config()
+        try:
+            while not self.stopped:
+                self.poll_once()
+                time.sleep(0.2)
+        finally:
+            for ch in self.children.values():
+                self._stop_child(ch)
+
+
+def main(argv=None):
+    argv = argv if argv is not None else sys.argv[1:]
+    if len(argv) != 1:
+        print("usage: monitor <conf-file>", file=sys.stderr)
+        return 2
+    Monitor(argv[0]).run()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

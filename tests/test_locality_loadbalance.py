@@ -1,0 +1,123 @@
+"""Replication-policy algebra + model-based read load balancing.
+
+Ref: fdbrpc/ReplicationPolicy.h:33,99,119 (PolicyOne/Across/And),
+fdbrpc/Locality.h:117, fdbrpc/LoadBalance.actor.h:159 (loadBalance with
+the hedged secondRequest :168), fdbrpc/QueueModel.h.
+"""
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.rpc.locality import (
+    LocalityData,
+    PolicyAcross,
+    PolicyAnd,
+    PolicyOne,
+)
+from foundationdb_tpu.rpc.loadbalance import QueueModel
+from foundationdb_tpu.server import SimCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def L(pid, zone, machine="", dc="dc0"):
+    return LocalityData(
+        process_id=pid, zone_id=zone, machine_id=machine or zone, dc_id=dc
+    )
+
+
+def test_policy_across_zones():
+    pol = PolicyAcross(2, "zoneid")
+    cands = {
+        "a": L("a", "z1"),
+        "b": L("b", "z1"),
+        "c": L("c", "z2"),
+    }
+    sel = pol.select_replicas(cands)
+    assert sel is not None
+    zones = {cands[k].zone_id for k in sel}
+    assert len(zones) == 2
+    assert pol.validate([cands[k] for k in sel])
+    # Only one zone available: unsatisfiable.
+    assert pol.select_replicas({"a": L("a", "z1"), "b": L("b", "z1")}) is None
+
+
+def test_policy_nested_and():
+    # Two DCs, each with two zones (the multi-region shape).
+    pol = PolicyAnd(
+        [
+            PolicyAcross(2, "dcid", PolicyAcross(2, "zoneid")),
+        ]
+    )
+    cands = {
+        "a": L("a", "z1", dc="dc0"),
+        "b": L("b", "z2", dc="dc0"),
+        "c": L("c", "z3", dc="dc1"),
+        "d": L("d", "z4", dc="dc1"),
+        "e": L("e", "z1", dc="dc0"),
+    }
+    sel = pol.select_replicas(cands)
+    assert sel is not None and len(sel) == 4
+    assert pol.validate([cands[k] for k in sel])
+    # Remove a DC: unsatisfiable.
+    del cands["c"], cands["d"]
+    assert pol.select_replicas(cands) is None
+
+
+def test_queue_model_prefers_fast_and_penalizes_failures():
+    m = QueueModel()
+    m.update("fast", 0.001, False)
+    m.update("slow", 0.1, False)
+    assert m.order(["slow", "fast"]) == ["fast", "slow"]
+    for _ in range(3):
+        m.update("fast", 0.001, True)  # repeated failures
+    assert m.order(["slow", "fast"]) == ["slow", "fast"]
+    m.update("fast", 0.001, False)  # penalty decays on success
+    m.update("fast", 0.001, False)
+    m.update("fast", 0.001, False)
+    assert m.order(["slow", "fast"]) == ["fast", "slow"]
+
+
+def test_hedged_read_beats_clogged_replica():
+    """With a replicated team, clogging the first replica's machine must
+    not stall reads: the hedge fires to the runner-up (ref: the
+    secondRequest path)."""
+    c = SimCluster(seed=140, n_storages=2)
+    db = c.database()
+
+    async def fill(tr):
+        for i in range(10):
+            tr.set(b"h%02d" % i, b"v%d" % i)
+
+    c.run_all([(db, db.run(fill))])
+    dd = c.data_distributor()
+
+    async def place():
+        await dd.register_storages(dd.storages)
+        await dd.seed(["ss0"])
+        await dd.move(b"", ["ss0", "ss1"])  # replicate everywhere
+
+    c.run_until(db.process.spawn(place()), timeout_vt=5000.0)
+
+    # Clog the first-ordered replica's machine from the client.
+    first = db.queue_model.order(["ss0", "ss1"])[0]
+    proc = {s.storage_id: s.process for s in c.storages}[first]
+    out = {}
+
+    async def read():
+        c.net.clog_pair(
+            db.process.machine.machine_id, proc.machine.machine_id, 30.0
+        )
+        t0 = c.loop.now()
+        tr = db.create_transaction()
+        out["val"] = await tr.get(b"h03")
+        out["dt"] = c.loop.now() - t0
+
+    c.run_all([(db, read())], timeout_vt=1000.0)
+    assert out["val"] == b"v3"
+    # Far faster than the 30s clog: the hedge answered.
+    assert out["dt"] < 5.0, out["dt"]

@@ -1,0 +1,102 @@
+"""Process watchdog: restart-on-crash over real OS processes.
+
+Ref: fdbmonitor/fdbmonitor.cpp (ini config, fork/exec, per-child logdir,
+restart backoff :274-283, config reload).
+"""
+
+import os
+import signal
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _ppid(pid: int) -> int:
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return int(f.read().split(")")[-1].split()[1])
+    except OSError:
+        return -1
+
+
+def _children_of(pid: int):
+    return [
+        int(p)
+        for p in os.listdir("/proc")
+        if p.isdigit() and _ppid(int(p)) == pid
+    ]
+
+
+def test_monitor_restarts_crashed_server(tmp_path):
+    conf = tmp_path / "cluster.conf"
+    logdir = tmp_path / "logs"
+    conf.write_text(
+        "[general]\n"
+        "restart_delay = 1\n"
+        f"logdir = {logdir}\n\n"
+        "[server.1]\n"
+        f"command = {sys.executable} -u -m foundationdb_tpu.tools.real_node server\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    mon = subprocess.Popen(
+        [sys.executable, "-m", "foundationdb_tpu.tools.monitor", str(conf)],
+        cwd=REPO,
+        env=env,
+    )
+    log = logdir / "server.1.log"
+
+    def ready_addrs():
+        if not log.exists():
+            return []
+        return [
+            ln.split()[1]
+            for ln in log.read_text().splitlines()
+            if ln.startswith("READY ")
+        ]
+
+    def wait_ready(count, timeout=45.0):
+        t0 = time.monotonic()
+        while time.monotonic() - t0 < timeout:
+            addrs = ready_addrs()
+            if len(addrs) >= count:
+                return addrs[-1]
+            time.sleep(0.1)
+        raise TimeoutError(f"server READY #{count} never appeared")
+
+    def run_client(addr, ops, check=-1):
+        args = [
+            sys.executable, "-m", "foundationdb_tpu.tools.real_node",
+            "client", addr, "--id", "m", "--ops", str(ops),
+        ]
+        if check >= 0:
+            args += ["--check-count", str(check)]
+        return subprocess.run(
+            args, cwd=REPO, env=env, capture_output=True, text=True, timeout=60
+        )
+
+    try:
+        addr = wait_ready(1)
+        r = run_client(addr, 5, check=5)
+        assert r.returncode == 0, r.stdout + r.stderr
+
+        # SIGKILL the child; the monitor must respawn it (fresh in-memory
+        # server: a new READY line with a new port).
+        kids = _children_of(mon.pid)
+        assert kids, "monitor has no children"
+        os.kill(kids[0], signal.SIGKILL)
+        addr2 = wait_ready(2)
+        r2 = run_client(addr2, 3, check=3)
+        assert r2.returncode == 0, r2.stdout + r2.stderr
+    finally:
+        mon.send_signal(signal.SIGTERM)
+        try:
+            mon.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            mon.kill()
+        # The monitor must not leave orphans behind.
+        time.sleep(0.3)
+        assert not _children_of(mon.pid)
