@@ -1,0 +1,366 @@
+// Native key-value engine: the reference's "memory" storage engine in C++.
+//
+// Ref: fdbserver/KeyValueStoreMemory.actor.cpp — the full key space lives in
+// RAM (here an ordered std::map); durability comes from a write-ahead log
+// with CRC-framed records fsynced at commit, periodically compacted into a
+// snapshot file (the reference snapshots through its disk queue; same
+// recovery contract: load snapshot, replay WAL, truncate torn tail).
+//
+// Exposed as a C ABI for ctypes (pybind11 is not available in this image).
+// Single-threaded by design, like every flow storage engine: the Python
+// event loop serializes access.
+//
+// File layout in <dir>:
+//   snapshot-<gen>      length-prefixed (k, v) pairs + trailer CRC
+//   wal-<gen>           CRC-framed records: 1-byte op, k, v
+//   CURRENT             "gen\n" — which generation is authoritative
+// Recovery: read CURRENT, load snapshot-<gen>, replay wal-<gen> until the
+// first bad frame (torn tail), ignore everything else.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <fcntl.h>
+#include <map>
+#include <string>
+#include <sys/stat.h>
+#include <unistd.h>
+#include <vector>
+
+namespace {
+
+uint32_t crc32(const uint8_t* data, size_t len, uint32_t seed = 0) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = ~seed;
+  for (size_t i = 0; i < len; i++) c = table[(c ^ data[i]) & 0xFF] ^ (c >> 8);
+  return ~c;
+}
+
+void put32(std::string& out, uint32_t v) {
+  out.append(reinterpret_cast<const char*>(&v), 4);
+}
+
+struct Store {
+  std::string dir;
+  std::map<std::string, std::string> kv;
+  int wal_fd = -1;
+  uint64_t gen = 0;
+  uint64_t wal_bytes = 0;
+  uint64_t compact_threshold = 64ull << 20;
+  std::string pending;  // buffered, unsynced WAL frames
+  std::string last_error;
+
+  std::string path(const char* kind, uint64_t g) const {
+    char buf[64];
+    snprintf(buf, sizeof buf, "/%s-%llu", kind, (unsigned long long)g);
+    return dir + buf;
+  }
+
+  bool write_all(int fd, const std::string& data) {
+    size_t off = 0;
+    while (off < data.size()) {
+      ssize_t n = ::write(fd, data.data() + off, data.size() - off);
+      if (n < 0) {
+        if (errno == EINTR) continue;
+        last_error = "write failed";
+        return false;
+      }
+      off += (size_t)n;
+    }
+    return true;
+  }
+
+  // -- WAL framing: [len u32][crc u32][op u8][klen u32][k][vlen u32][v] --
+  void frame(char op, const std::string& k, const std::string& v) {
+    std::string body;
+    body.push_back(op);
+    put32(body, (uint32_t)k.size());
+    body += k;
+    put32(body, (uint32_t)v.size());
+    body += v;
+    std::string rec;
+    put32(rec, (uint32_t)body.size());
+    put32(rec, crc32((const uint8_t*)body.data(), body.size()));
+    rec += body;
+    pending += rec;
+  }
+
+  bool commit() {
+    if (!pending.empty()) {
+      if (!write_all(wal_fd, pending)) return false;
+      wal_bytes += pending.size();
+      pending.clear();
+      if (::fdatasync(wal_fd) != 0) {
+        last_error = "fdatasync failed";
+        return false;
+      }
+    }
+    if (wal_bytes > compact_threshold) return compact();
+    return true;
+  }
+
+  bool compact() {
+    uint64_t next = gen + 1;
+    std::string snap = path("snapshot", next);
+    int fd = ::open(snap.c_str(), O_CREAT | O_TRUNC | O_WRONLY, 0644);
+    if (fd < 0) {
+      last_error = "snapshot open failed";
+      return false;
+    }
+    std::string buf;
+    uint32_t running = 0;
+    for (auto& [k, v] : kv) {
+      put32(buf, (uint32_t)k.size());
+      buf += k;
+      put32(buf, (uint32_t)v.size());
+      buf += v;
+      if (buf.size() > (1u << 20)) {
+        running = crc32((const uint8_t*)buf.data(), buf.size(), running);
+        if (!write_all(fd, buf)) { ::close(fd); return false; }
+        buf.clear();
+      }
+    }
+    running = crc32((const uint8_t*)buf.data(), buf.size(), running);
+    if (!write_all(fd, buf)) { ::close(fd); return false; }
+    std::string trailer = "SNAPEND!";
+    put32(trailer, running);
+    if (!write_all(fd, trailer) || ::fdatasync(fd) != 0) {
+      ::close(fd);
+      last_error = "snapshot write failed";
+      return false;
+    }
+    ::close(fd);
+    // Fresh empty WAL for the new generation, then flip CURRENT (the
+    // commit point of the compaction), then drop the old generation.
+    std::string wal = path("wal", next);
+    int wfd = ::open(wal.c_str(), O_CREAT | O_TRUNC | O_WRONLY, 0644);
+    if (wfd < 0) { last_error = "wal open failed"; return false; }
+    std::string cur = dir + "/CURRENT.tmp";
+    int cfd = ::open(cur.c_str(), O_CREAT | O_TRUNC | O_WRONLY, 0644);
+    if (cfd < 0) { ::close(wfd); last_error = "CURRENT open failed"; return false; }
+    char num[32];
+    snprintf(num, sizeof num, "%llu\n", (unsigned long long)next);
+    if (!write_all(cfd, num) || ::fdatasync(cfd) != 0) { ::close(cfd); ::close(wfd); return false; }
+    ::close(cfd);
+    if (::rename(cur.c_str(), (dir + "/CURRENT").c_str()) != 0) {
+      ::close(wfd);
+      last_error = "CURRENT rename failed";
+      return false;
+    }
+    ::unlink(path("snapshot", gen).c_str());
+    ::unlink(path("wal", gen).c_str());
+    if (wal_fd >= 0) ::close(wal_fd);
+    wal_fd = wfd;
+    wal_bytes = 0;
+    gen = next;
+    return true;
+  }
+
+  bool load_snapshot(const std::string& p) {
+    int fd = ::open(p.c_str(), O_RDONLY);
+    if (fd < 0) return true;  // absent = empty (gen 0 bootstrap)
+    struct stat st;
+    if (fstat(fd, &st) != 0) { ::close(fd); return false; }
+    std::string img((size_t)st.st_size, '\0');
+    size_t off = 0;
+    while (off < img.size()) {
+      ssize_t n = ::read(fd, &img[off], img.size() - off);
+      if (n <= 0) break;
+      off += (size_t)n;
+    }
+    ::close(fd);
+    if (img.size() < 12) return img.empty();
+    size_t body = img.size() - 12;
+    if (memcmp(img.data() + body, "SNAPEND!", 8) != 0) {
+      last_error = "snapshot trailer missing";
+      return false;
+    }
+    uint32_t want;
+    memcpy(&want, img.data() + body + 8, 4);
+    if (crc32((const uint8_t*)img.data(), body) != want) {
+      last_error = "snapshot crc mismatch";
+      return false;
+    }
+    size_t i = 0;
+    while (i + 8 <= body) {
+      uint32_t kl, vl;
+      memcpy(&kl, img.data() + i, 4);
+      if (i + 4 + kl + 4 > body) break;
+      memcpy(&vl, img.data() + i + 4 + kl, 4);
+      if (i + 8 + kl + vl > body) break;
+      kv.emplace(img.substr(i + 4, kl), img.substr(i + 8 + kl, vl));
+      i += 8 + kl + vl;
+    }
+    return true;
+  }
+
+  void apply(char op, const std::string& a, const std::string& b) {
+    if (op == 'S') {
+      kv[a] = b;
+    } else {  // 'C': clear range [a, b); empty b = clear to end
+      auto lo = kv.lower_bound(a);
+      auto hi = b.empty() ? kv.end() : kv.lower_bound(b);
+      kv.erase(lo, hi);
+    }
+  }
+
+  bool replay_wal(const std::string& p) {
+    int fd = ::open(p.c_str(), O_RDONLY);
+    if (fd < 0) return true;  // absent = nothing to replay
+    struct stat st;
+    fstat(fd, &st);
+    std::string img((size_t)st.st_size, '\0');
+    size_t off = 0;
+    while (off < img.size()) {
+      ssize_t n = ::read(fd, &img[off], img.size() - off);
+      if (n <= 0) break;
+      off += (size_t)n;
+    }
+    ::close(fd);
+    size_t i = 0;
+    while (i + 8 <= img.size()) {
+      uint32_t len, want;
+      memcpy(&len, img.data() + i, 4);
+      memcpy(&want, img.data() + i + 4, 4);
+      if (i + 8 + len > img.size()) break;  // torn tail
+      const uint8_t* b = (const uint8_t*)img.data() + i + 8;
+      if (crc32(b, len) != want) break;  // torn/corrupt: durable prefix ends
+      if (len < 9) break;
+      char op = (char)b[0];
+      uint32_t kl, vl;
+      memcpy(&kl, b + 1, 4);
+      if (5 + kl + 4 > len) break;
+      memcpy(&vl, b + 5 + kl, 4);
+      if (9 + kl + vl > len) break;
+      apply(op, std::string((const char*)b + 5, kl),
+            std::string((const char*)b + 9 + kl, vl));
+      i += 8 + len;
+    }
+    wal_bytes = i;
+    return true;
+  }
+
+  bool open_store(const char* d) {
+    dir = d;
+    ::mkdir(d, 0755);
+    // CURRENT names the authoritative generation.
+    FILE* f = fopen((dir + "/CURRENT").c_str(), "r");
+    if (f) {
+      unsigned long long g = 0;
+      if (fscanf(f, "%llu", &g) == 1) gen = g;
+      fclose(f);
+    }
+    if (!load_snapshot(path("snapshot", gen))) return false;
+    if (!replay_wal(path("wal", gen))) return false;
+    wal_fd = ::open(path("wal", gen).c_str(), O_CREAT | O_WRONLY | O_APPEND, 0644);
+    if (wal_fd < 0) {
+      last_error = "wal open failed";
+      return false;
+    }
+    return true;
+  }
+};
+
+struct Iter {
+  std::vector<std::pair<std::string, std::string>> rows;
+  size_t i = 0;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* kv_open(const char* dir) {
+  Store* s = new Store();
+  if (!s->open_store(dir)) {
+    delete s;
+    return nullptr;
+  }
+  return s;
+}
+
+void kv_close(void* h) {
+  Store* s = (Store*)h;
+  if (!s) return;
+  if (s->wal_fd >= 0) ::close(s->wal_fd);
+  delete s;
+}
+
+void kv_set(void* h, const char* k, uint32_t kl, const char* v, uint32_t vl) {
+  Store* s = (Store*)h;
+  std::string key(k, kl), val(v, vl);
+  s->frame('S', key, val);
+  s->apply('S', key, val);
+}
+
+void kv_clear_range(void* h, const char* b, uint32_t bl, const char* e, uint32_t el) {
+  Store* s = (Store*)h;
+  std::string begin(b, bl), end(e, el);
+  s->frame('C', begin, end);
+  s->apply('C', begin, end);
+}
+
+int kv_commit(void* h) { return ((Store*)h)->commit() ? 0 : -1; }
+
+int kv_compact(void* h) { return ((Store*)h)->compact() ? 0 : -1; }
+
+// get: returns 1 + fills out/out_len (valid until the next call), 0 if absent
+int kv_get(void* h, const char* k, uint32_t kl, const char** out, uint32_t* out_len) {
+  Store* s = (Store*)h;
+  auto it = s->kv.find(std::string(k, kl));
+  if (it == s->kv.end()) return 0;
+  *out = it->second.data();
+  *out_len = (uint32_t)it->second.size();
+  return 1;
+}
+
+void* kv_range_open(void* h, const char* b, uint32_t bl, const char* e,
+                    uint32_t el, uint32_t limit, int reverse) {
+  Store* s = (Store*)h;
+  std::string begin(b, bl), end(e, el);
+  Iter* it = new Iter();
+  auto lo = s->kv.lower_bound(begin);
+  auto hi = end.empty() ? s->kv.end() : s->kv.lower_bound(end);
+  if (!reverse) {
+    for (auto p = lo; p != hi && it->rows.size() < limit; ++p)
+      it->rows.emplace_back(p->first, p->second);
+  } else {
+    for (auto p = hi; p != lo && it->rows.size() < limit;) {
+      --p;
+      it->rows.emplace_back(p->first, p->second);
+    }
+  }
+  return it;
+}
+
+int kv_range_next(void* h, const char** k, uint32_t* kl, const char** v, uint32_t* vl) {
+  Iter* it = (Iter*)h;
+  if (it->i >= it->rows.size()) return 0;
+  auto& row = it->rows[it->i++];
+  *k = row.first.data();
+  *kl = (uint32_t)row.first.size();
+  *v = row.second.data();
+  *vl = (uint32_t)row.second.size();
+  return 1;
+}
+
+void kv_range_close(void* h) { delete (Iter*)h; }
+
+uint64_t kv_count(void* h) { return ((Store*)h)->kv.size(); }
+
+const char* kv_last_error(void* h) { return ((Store*)h)->last_error.c_str(); }
+
+void kv_set_compact_threshold(void* h, uint64_t bytes) {
+  ((Store*)h)->compact_threshold = bytes;
+}
+
+}  // extern "C"

@@ -135,6 +135,21 @@ class KeyValueStoreMemory(IKeyValueStore):
     def read_value(self, key: bytes) -> Optional[bytes]:
         return self._data.get(key)
 
+    def read_keys_page(
+        self, begin: bytes, end: bytes, limit: int, reverse: bool = False
+    ) -> List[bytes]:
+        """Up to `limit` keys of [begin, end) in scan order (the base-key
+        feed for the storage's window-over-base merge)."""
+        i = bisect_left(self._keys, begin)
+        j = bisect_left(self._keys, end)
+        if reverse:
+            lo = max(i, j - limit)
+            return self._keys[lo:j][::-1]
+        return self._keys[i : min(j, i + limit)]
+
+    def count(self) -> int:
+        return len(self._keys)
+
     def read_range(
         self, begin: bytes, end: bytes, limit: int = 1 << 30
     ) -> List[Tuple[bytes, bytes]]:

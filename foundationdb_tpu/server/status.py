@@ -75,7 +75,7 @@ def cluster_status(cluster) -> dict:
             "storage_version": storage.version.get(),
             "durable_version": storage.durable_version,
             "total_keys_estimate": len(storage.store.sorted_keys)
-            + (len(storage.kvstore._keys) if storage.kvstore else 0),
+            + (storage.kvstore.count() if storage.kvstore else 0),
         }
     if tlog is not None:
         cl["logs"] = {

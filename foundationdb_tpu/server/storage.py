@@ -928,35 +928,52 @@ class StorageServer:
         """
         if self.kvstore is None:
             return self.store.get_range(begin, end, version, limit, reverse)
-        base_keys = self.kvstore._keys
-        bi = bisect_left(base_keys, begin)
-        bj = bisect_left(base_keys, end)
+        # Base keys arrive in PAGES through the engine-neutral
+        # read_keys_page (works for the Python memory engine and the
+        # native C++ engine alike), merged against the window's sorted
+        # keys; window clears mask base rows, so more pages are pulled
+        # until `limit` merged rows exist or the base is exhausted.
         wkeys = self.store.sorted_keys
         wi = bisect_left(wkeys, begin)
         wj = bisect_left(wkeys, end)
-        rows: list = []
-        before = (lambda x, y: x > y) if reverse else (lambda x, y: x < y)
-        # Index the sorted lists in place (no range-sized copies) so a
-        # limited read really is O(limit + masked keys skipped).
+        # Window keys are indexed in place (no range-sized slice/reverse):
+        # a limited read stays O(limit + masked keys skipped).
         if reverse:
-            ia, ea, step = bj - 1, bi - 1, -1
-            ib, eb = wj - 1, wi - 1
+            iw, ew, wstep = wj - 1, wi - 1, -1
         else:
-            ia, ea, step = bi, bj, 1
-            ib, eb = wi, wj
-        while (ia != ea or ib != eb) and len(rows) < limit:
-            ka = base_keys[ia] if ia != ea else None
-            kb = wkeys[ib] if ib != eb else None
+            iw, ew, wstep = wi, wj, 1
+        before = (lambda x, y: x > y) if reverse else (lambda x, y: x < y)
+        rows: list = []
+        page_lo, page_hi = begin, end
+        page: list = []
+        ia = 0
+        exhausted = False
+        while len(rows) < limit:
+            if ia >= len(page) and not exhausted:
+                page = self.kvstore.read_keys_page(
+                    page_lo, page_hi, max(limit, 256), reverse
+                )
+                ia = 0
+                if len(page) < max(limit, 256):
+                    exhausted = True
+                elif reverse:
+                    page_hi = page[-1]  # next page strictly below
+                else:
+                    page_lo = page[-1] + b"\x00"
+            ka = page[ia] if ia < len(page) else None
+            kb = wkeys[iw] if iw != ew else None
+            if ka is None and kb is None:
+                break
             if kb is None or (ka is not None and before(ka, kb)):
                 k = ka
-                ia += step
+                ia += 1
             elif ka is None or before(kb, ka):
                 k = kb
-                ib += step
+                iw += wstep
             else:  # same key in both
                 k = ka
-                ia += step
-                ib += step
+                ia += 1
+                iw += wstep
             touched, wv = self.store.get_stamped(k, version)
             v = wv if touched else self.kvstore.read_value(k)
             if v is not None:

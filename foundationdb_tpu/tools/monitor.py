@@ -66,18 +66,25 @@ class Monitor:
         if mtime == self._conf_mtime:
             return False
         self._conf_mtime = mtime
-        cp = configparser.ConfigParser()
-        cp.read(self.conf_path)
-        if cp.has_option("general", "restart_delay"):
-            self.max_restart_delay = cp.getfloat("general", "restart_delay")
-        if cp.has_option("general", "logdir"):
-            self.logdir = cp.get("general", "logdir")
-            os.makedirs(self.logdir, exist_ok=True)
-        wanted = {
-            s: cp.get(s, "command")
-            for s in cp.sections()
-            if s != "general" and cp.has_option(s, "command")
-        }
+        # A bad edit of the LIVE config must not take the cluster down:
+        # keep supervising on the previous state and retry the parse on
+        # the next change (ref: fdbmonitor surviving reload errors).
+        try:
+            cp = configparser.ConfigParser()
+            cp.read(self.conf_path)
+            if cp.has_option("general", "restart_delay"):
+                self.max_restart_delay = cp.getfloat("general", "restart_delay")
+            if cp.has_option("general", "logdir"):
+                self.logdir = cp.get("general", "logdir")
+                os.makedirs(self.logdir, exist_ok=True)
+            wanted = {
+                s: cp.get(s, "command")
+                for s in cp.sections()
+                if s != "general" and cp.has_option(s, "command")
+            }
+        except (configparser.Error, ValueError, OSError) as e:
+            self._log(f"config reload failed (keeping previous): {e}")
+            return False
         # Stop removed/changed children; add new ones (ref: the config
         # reload diffing in fdbmonitor's watch_conf_file handling).
         for name in list(self.children):
@@ -139,9 +146,27 @@ class Monitor:
                 )
                 ch.backoff_until = now + delay
             if now >= ch.backoff_until:
-                self._start_child(ch)
+                try:
+                    self._start_child(ch)
+                except OSError as e:
+                    # e.g. the command's binary is missing: count it as a
+                    # crash and back off rather than killing the monitor.
+                    self._log(f"start of {ch.name} failed: {e}")
+                    ch.failures += 1
+                    ch.backoff_until = now + min(
+                        self.max_restart_delay,
+                        0.1 * (2 ** min(ch.failures, 10)),
+                    )
 
     def run(self):
+        # SIGTERM/SIGINT must reach the finally block: without handlers the
+        # default action kills this process outright and every supervised
+        # child leaks as an orphan (ref: fdbmonitor's signal handling).
+        def _stop(signum, frame):
+            self.stopped = True
+
+        signal.signal(signal.SIGTERM, _stop)
+        signal.signal(signal.SIGINT, _stop)
         self.load_config()
         try:
             while not self.stopped:

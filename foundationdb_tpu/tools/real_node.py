@@ -22,6 +22,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 
 from ..flow.eventloop import EventLoop, set_event_loop
@@ -30,11 +31,16 @@ from ..rpc.stream import RequestStream, RequestStreamRef, well_known_token
 from ..rpc.network import Endpoint
 
 
-def run_server(port: int) -> None:
+def run_server(port: int, datadir: str = "") -> None:
+    from ..flow.knobs import g_knobs
     from ..server.proxy import Proxy
     from ..server.resolver import Resolver
     from ..server.sequencer import Sequencer
-    from ..server.storage import StorageServer
+    from ..server.storage import (
+        OWNED_META_KEY,
+        VERSION_META_KEY,
+        StorageServer,
+    )
     from ..server.tlog import TLog
 
     loop = EventLoop(seed=1)
@@ -42,17 +48,66 @@ def run_server(port: int) -> None:
     net = RealNetwork(loop, port=port)
     proc = net.process("server")
 
-    sequencer = Sequencer(proc)
-    resolver = Resolver(proc, backend="cpu")
-    tlog = TLog(proc)
-    storage = StorageServer(
-        proc, [tlog.interface()], storage_id="ss0", owned_all=True
-    )
+    if datadir:
+        # Durable single-node deployment: the mutation log rides the
+        # crash-safe DiskQueue on REAL files (the sim<->real IAsyncFile
+        # swap), the storage base is the native C++ engine, and restart
+        # follows the same recovery the simulated durable cluster runs —
+        # recover the log, pick an epoch beyond every durable end, fast-
+        # forward, and resume the storage from its engine's durable
+        # version so it replays the log tail (ref: the restart path in
+        # SimulatedCluster restartSimulatedSystem + IKeyValueStore.h:43).
+        import pickle
+
+        from ..fileio.kvstore_native import NativeKeyValueStore
+        from ..fileio.realfile import RealFileSystem
+        from ..server.tlog import TLog as _TLog
+
+        fs = RealFileSystem(datadir)
+        kv = NativeKeyValueStore(os.path.join(datadir, "engine"))
+        vmeta = kv.read_value(VERSION_META_KEY)
+        durable = int(vmeta.decode()) if vmeta else 0
+        owned_meta = kv.read_value(OWNED_META_KEY)
+        meta = pickle.loads(owned_meta) if owned_meta else None
+
+        tlog = None
+
+        async def recover_log():
+            nonlocal tlog
+            tlog = await _TLog.recover(proc, fs, "tlog.dq")
+
+        t = proc.spawn(recover_log(), "recover_log")
+        net.run_realtime(until=t, timeout_s=60.0)
+        epoch_begin = (
+            max(tlog.durable.get(), durable)
+            + g_knobs.server.max_versions_in_flight
+        )
+        tlog.durable.set(epoch_begin)
+        tlog.known_committed = epoch_begin
+        storage = StorageServer(
+            proc,
+            [tlog.interface()],
+            epoch_begin_version=durable,
+            kvstore=kv,
+            storage_id="ss0",
+            owned_all=meta is None,
+            meta=meta,
+        )
+    else:
+        epoch_begin = 0
+        tlog = TLog(proc)
+        storage = StorageServer(
+            proc, [tlog.interface()], storage_id="ss0", owned_all=True
+        )
+
+    sequencer = Sequencer(proc, epoch_begin_version=epoch_begin)
+    resolver = Resolver(proc, backend="cpu", epoch_begin_version=epoch_begin)
     proxy = Proxy(
         proc,
         sequencer.interface(),
         [resolver.interface()],
         [tlog.interface()],
+        epoch_begin_version=epoch_begin,
     )
 
     boot = RequestStream(proc, "bootstrap", well_known=True)
@@ -129,6 +184,12 @@ def main(argv=None):
     sub = ap.add_subparsers(dest="mode", required=True)
     s = sub.add_parser("server")
     s.add_argument("--port", type=int, default=0)
+    s.add_argument(
+        "--datadir",
+        default="",
+        help="directory for durable storage (native C++ engine); empty = "
+        "in-memory only",
+    )
     c = sub.add_parser("client")
     c.add_argument("server")
     c.add_argument("--id", default="c1")
@@ -136,7 +197,7 @@ def main(argv=None):
     c.add_argument("--check-count", type=int, default=-1)
     args = ap.parse_args(argv)
     if args.mode == "server":
-        run_server(args.port)
+        run_server(args.port, datadir=args.datadir)
     else:
         run_client(args.server, args.id, args.ops, args.check_count)
 

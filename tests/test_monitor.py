@@ -92,11 +92,19 @@ def test_monitor_restarts_crashed_server(tmp_path):
         r2 = run_client(addr2, 3, check=3)
         assert r2.returncode == 0, r2.stdout + r2.stderr
     finally:
+        # Capture the live children BEFORE stopping: after the monitor
+        # exits, orphans would be reparented away from mon.pid and a
+        # children-of check would pass vacuously.
+        live_kids = _children_of(mon.pid)
         mon.send_signal(signal.SIGTERM)
         try:
             mon.wait(timeout=10)
         except subprocess.TimeoutExpired:
             mon.kill()
-        # The monitor must not leave orphans behind.
-        time.sleep(0.3)
-        assert not _children_of(mon.pid)
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline and any(
+            os.path.exists(f"/proc/{k}") for k in live_kids
+        ):
+            time.sleep(0.1)
+        leaked = [k for k in live_kids if os.path.exists(f"/proc/{k}")]
+        assert not leaked, f"monitor leaked children: {leaked}"
