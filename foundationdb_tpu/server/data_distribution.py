@@ -242,6 +242,41 @@ class DataDistributor:
             acted.append(sid)
         return acted
 
+    async def auto_split(self, max_shard_bytes: int) -> list:
+        """One split round driven by the storages' byte samples (ref:
+        DataDistributionTracker shard-size tracking + splitting,
+        DataDistributionTracker.actor.cpp): every shard whose sampled bytes
+        exceed the threshold splits at the key holding ~half its weight.
+        Returns the split keys applied."""
+        from .interfaces import GetStorageMetricsRequest
+
+        applied = []
+        for b, e, team, dest in await self.read_shard_map():
+            if dest:
+                continue  # mid-move; split() cannot rewrite a move record
+            members = [
+                sid for sid in team if sid in self.storages
+            ]
+            if not members:
+                continue
+            iface = self.storages[members[0]]
+            try:
+                m = await iface.get_storage_metrics.get_reply(
+                    self.db.process,
+                    GetStorageMetricsRequest(
+                        begin=b, end=e if e is not None else b""
+                    ),
+                )
+            except FdbError:
+                continue
+            if m.bytes <= max_shard_bytes or m.split_key is None:
+                continue
+            if m.split_key <= b or (e is not None and m.split_key >= e):
+                continue
+            await self.split(m.split_key)
+            applied.append(m.split_key)
+        return applied
+
     async def heal(self, dead_id: str, replacement_id: Optional[str] = None):
         """Re-replicate every shard that lists a dead storage: survivors
         stay the fetch sources, a replacement (or nothing, dropping to a

@@ -299,6 +299,22 @@ class GetShardStateRequest:
 
 
 @dataclass
+class GetStorageMetricsRequest:
+    """Byte estimate + split point for a range, from the byte sample (ref:
+    WaitMetricsRequest / SplitMetricsRequest, StorageServerInterface.h;
+    StorageMetrics.actor.h:404).  end=b"" means open-ended."""
+
+    begin: bytes = b""
+    end: bytes = b""
+
+
+@dataclass
+class GetStorageMetricsReply:
+    bytes: int = 0
+    split_key: Optional[bytes] = None  # ~half the sampled bytes below it
+
+
+@dataclass
 class GetOwnedMetaRequest:
     """Recovery-time ownership dump: replies (storage_id, [(b, e)] owned,
     server_list) once the storage has replayed the log through min_version,
@@ -311,6 +327,7 @@ class GetOwnedMetaRequest:
 @dataclass
 class StorageInterface:
     storage_id: str = ""
+    get_storage_metrics: RequestStreamRef = None
     get_value: RequestStreamRef = None
     get_key_values: RequestStreamRef = None
     get_version: RequestStreamRef = None

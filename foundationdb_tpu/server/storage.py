@@ -153,6 +153,72 @@ class VersionedStore:
         self.clears.append((version, seq, begin, end))
 
 
+class ByteSample:
+    """Sampled per-key byte weights with range sums and weighted split
+    points (ref: the byte sample fed by every mutation, StorageMetrics
+    .actor.h:404 — an IndexedSet with metric sums; here a sorted key list
+    + weight dict, adequate at simulation scale).
+
+    A key of total size s is sampled with probability min(1, s/UNIT) and
+    carries weight max(s, UNIT), so the expected weight equals the true
+    bytes and small keys stay out of the sample."""
+
+    UNIT = 100
+
+    def __init__(self, rng):
+        self.rng = rng
+        self.keys: List[bytes] = []
+        self.weight: Dict[bytes, int] = {}
+
+    def update(self, key: bytes, size: int):
+        # Every write RE-SAMPLES the key (ref: byteSample updates on each
+        # mutation): keeping a prior admission would bias repeatedly-
+        # overwritten small keys into the sample permanently.
+        admit = size >= self.UNIT or self.rng.random01() < size / self.UNIT
+        if key in self.weight:
+            if admit:
+                self.weight[key] = max(size, self.UNIT)
+            else:
+                del self.weight[key]
+                i = bisect_left(self.keys, key)
+                if i < len(self.keys) and self.keys[i] == key:
+                    del self.keys[i]
+        elif admit:
+            self.weight[key] = max(size, self.UNIT)
+            insort(self.keys, key)
+
+    def remove_range(self, begin: bytes, end: Optional[bytes]):
+        i = bisect_left(self.keys, begin)
+        j = bisect_left(self.keys, end) if end is not None else len(self.keys)
+        for k in self.keys[i:j]:
+            del self.weight[k]
+        del self.keys[i:j]
+
+    def bytes_in(self, begin: bytes, end: Optional[bytes]) -> int:
+        i = bisect_left(self.keys, begin)
+        j = bisect_left(self.keys, end) if end is not None else len(self.keys)
+        return sum(self.weight[k] for k in self.keys[i:j])
+
+    def split_point(self, begin: bytes, end: Optional[bytes]) -> Optional[bytes]:
+        """The sampled key closest to half the range's weight (ref:
+        splitMetrics picking the key where half the bytes fall)."""
+        i = bisect_left(self.keys, begin)
+        j = bisect_left(self.keys, end) if end is not None else len(self.keys)
+        ks = self.keys[i:j]
+        total = sum(self.weight[k] for k in ks)
+        if total == 0 or len(ks) < 2:
+            return None
+        acc = 0
+        best, best_err = None, None
+        for idx, k in enumerate(ks):
+            if idx > 0:
+                err = abs(acc - total / 2)
+                if best_err is None or err < best_err:
+                    best, best_err = k, err
+            acc += self.weight[k]
+        return best
+
+
 VERSION_META_KEY = b"\xff\xffmeta/durable_version"
 OWNED_META_KEY = b"\xff\xffmeta/owned_ranges"
 
@@ -235,6 +301,22 @@ class StorageServer:
             self.owned.set_range(b"", None, True)
         self.version = NotifiedVersion(epoch_begin_version)
         self.durable_version = epoch_begin_version
+        self.byte_sample = ByteSample(process.network.loop.rng)
+        if kvstore is not None:
+            # Rebuild from the durable base after a restart (the reference
+            # persists its byte sample for the same reason); paged so huge
+            # stores don't need one giant materialization.
+            lo = b""
+            while True:
+                page = kvstore.read_range(lo, KEYSPACE_END, limit=4096)
+                for k, v in page:
+                    self.byte_sample.update(k, len(k) + len(v))
+                if len(page) < 4096:
+                    break
+                lo = page[-1][0] + b"\x00"
+        self._metrics_stream = RequestStream(
+            process, "get_storage_metrics", well_known=True
+        )
         self._gv_stream = RequestStream(process, "get_value", well_known=True)
         self._gkv_stream = RequestStream(process, "get_key_values", well_known=True)
         self._ver_stream = RequestStream(process, "get_version", well_known=True)
@@ -276,6 +358,7 @@ class StorageServer:
             )
         process.spawn(self._update_loop(), "ss_update")
         process.spawn(self._serve_get_value(), "ss_get_value")
+        process.spawn(self._serve_metrics(), "ss_metrics")
         process.spawn(self._serve_get_key_values(), "ss_get_key_values")
         process.spawn(self._serve_get_version(), "ss_get_version")
         process.spawn(self._serve_watch_value(), "ss_watch")
@@ -319,6 +402,7 @@ class StorageServer:
     def interface(self) -> StorageInterface:
         return StorageInterface(
             storage_id=self.storage_id,
+            get_storage_metrics=self._metrics_stream.ref(),
             get_value=self._gv_stream.ref(),
             get_key_values=self._gkv_stream.ref(),
             get_version=self._ver_stream.ref(),
@@ -571,6 +655,7 @@ class StorageServer:
                 ce = m.param2 if ce is None else ce
                 if v:
                     self.store.clear_range(cb, ce, version, seq)
+                    self.byte_sample.remove_range(cb, ce)
                     cleared.append((cb, ce))
                     continue
                 for ab, ae, shard in self.adding.intersecting(cb, ce):
@@ -582,6 +667,7 @@ class StorageServer:
                         shard.buffer.append((version, seq, clip))
                     else:
                         self.store.clear_range(ab, ae, version, seq)
+                        self.byte_sample.remove_range(ab, ae)
             return
         if m.type in (MutationType.NO_OP, MutationType.DEBUG_KEY):
             return
@@ -600,11 +686,13 @@ class StorageServer:
     def _apply_point(self, m: Mutation, version: int, seq: int):
         if m.type == MutationType.SET_VALUE:
             self.store.set(m.param1, m.param2, version, seq)
+            val = m.param2
         else:
             existing = self._get_current(m.param1, version)
-            self.store.set(
-                m.param1, apply_atomic(m.type, existing, m.param2), version, seq
-            )
+            val = apply_atomic(m.type, existing, m.param2)
+            self.store.set(m.param1, val, version, seq)
+        if m.param1 < KEYSPACE_END:
+            self.byte_sample.update(m.param1, len(m.param1) + len(val or b""))
 
     def _apply_metadata(self, m: Mutation, version: int):
         from .system_keys import parse_metadata_mutation
@@ -701,6 +789,7 @@ class StorageServer:
         """Evict data for a range this server no longer owns; parked watches
         in the range fire wrong_shard_server so clients re-route."""
         hi = min(end, KEYSPACE_END) if end is not None else KEYSPACE_END
+        self.byte_sample.remove_range(begin, hi)
         if self.kvstore is not None:
             self.kvstore.clear_range(begin, hi)
         i = bisect_left(self.store.sorted_keys, begin)
@@ -743,6 +832,7 @@ class StorageServer:
                 continue
             if m.type == MutationType.CLEAR_RANGE:
                 self.store.clear_range(m.param1, m.param2, ver, seq)
+                self.byte_sample.remove_range(m.param1, m.param2)
             else:
                 self._apply_point(m, ver, seq)
         shard.buffer = []
@@ -758,6 +848,7 @@ class StorageServer:
         page's sets at the same version), so retries at newer snapshots
         converge."""
         self.store.clear_range(shard.begin, shard.end, snap, 0)
+        self.byte_sample.remove_range(shard.begin, shard.end)
         begin = shard.begin
         while True:
             rep: FetchShardReply = await src.fetch_shard.get_reply(
@@ -766,6 +857,7 @@ class StorageServer:
             )
             for k, v in rep.data:
                 self.store.set(k, v, snap, 1)
+                self.byte_sample.update(k, len(k) + len(v))
             if not rep.more:
                 break
             begin = key_after(rep.data[-1][0])
@@ -979,6 +1071,21 @@ class StorageServer:
             if v is not None:
                 rows.append((k, v))
         return rows
+
+    async def _serve_metrics(self):
+        """Byte estimates + split points for DD (ref: waitMetrics /
+        splitMetrics served from the byte sample)."""
+        from .interfaces import GetStorageMetricsReply
+
+        while True:
+            req, reply = await self._metrics_stream.pop()
+            end = req.end if req.end != b"" else None
+            reply.send(
+                GetStorageMetricsReply(
+                    bytes=self.byte_sample.bytes_in(req.begin, end),
+                    split_key=self.byte_sample.split_point(req.begin, end),
+                )
+            )
 
     async def _serve_get_version(self):
         while True:

@@ -245,3 +245,88 @@ def test_shard_map_is_authoritative_in_db():
     assert by_begin[b""][1] == ["ss0"]
     # Determinism: the same scenario replays identically from the seed.
     assert c.loop.rng.random_int(0, 1 << 30) is not None
+
+
+def test_auto_split_on_byte_samples():
+    """DD splits oversized shards at the byte-sample median (ref:
+    DataDistributionTracker split on shard size; StorageMetrics byte
+    sample)."""
+    c = SimCluster(seed=160, n_storages=2)
+    db = c.database()
+
+    # Skewed bulk: many large values under one prefix, a few elsewhere.
+    async def fill(tr, base):
+        for i in range(base, base + 40):
+            tr.set(b"big/%04d" % i, b"x" * 300)
+
+    for base in range(0, 160, 40):
+        c.run_all([(db, db.run(lambda tr, b=base: fill(tr, b)))])
+
+    async def small(tr):
+        for i in range(5):
+            tr.set(b"tiny/%02d" % i, b"y")
+
+    c.run_all([(db, db.run(small))])
+    settle(c, db)
+
+    dd = c.data_distributor()
+
+    async def place():
+        await dd.register_storages(dd.storages)
+        await dd.seed(["ss0"])
+        return await dd.auto_split(max_shard_bytes=20000)
+
+    split_keys = c.run_until(db.process.spawn(place()), timeout_vt=5000.0)
+    assert split_keys, "no split happened"
+    assert all(k.startswith(b"big/") for k in split_keys), split_keys
+
+    async def verify():
+        return await dd.read_shard_map()
+
+    shard_map = c.run_until(db.process.spawn(verify()), timeout_vt=1000.0)
+    assert len(shard_map) >= 2
+    # Data integrity across the split boundary.
+    out = {}
+
+    async def check(tr):
+        rows = await tr.get_range(b"big/", b"big0", limit=1 << 20)
+        out["n"] = len(rows)
+
+    c.run_all([(db, db.run(check))])
+    assert out["n"] == 160
+
+
+def test_byte_sample_follows_moves_and_clears():
+    """Metrics stay truthful across the paths the sample must track: shard
+    fetch populates the destination's sample, disown clears the source's,
+    and clear_range drops entries."""
+    c = SimCluster(seed=161, n_storages=2)
+    db = c.database()
+
+    async def fill(tr):
+        for i in range(50):
+            tr.set(b"mv/%03d" % i, b"z" * 200)
+
+    c.run_all([(db, db.run(fill))])
+    settle(c, db)
+    dd = c.data_distributor()
+
+    async def place():
+        await dd.register_storages(dd.storages)
+        await dd.seed(["ss0"])
+        await dd.split(b"mv/")
+        await dd.move(b"mv/", ["ss1"])
+
+    c.run_until(db.process.spawn(place()), timeout_vt=5000.0)
+    settle(c, db, 0.3)
+    s0, s1 = c.storages
+    # Destination learned the bytes through the fetch; source dropped them.
+    assert s1.byte_sample.bytes_in(b"mv/", b"mv0") > 5000
+    assert s0.byte_sample.bytes_in(b"mv/", b"mv0") == 0
+
+    async def wipe(tr):
+        tr.clear_range(b"mv/", b"mv0")
+
+    c.run_all([(db, db.run(wipe))])
+    settle(c, db, 0.3)
+    assert s1.byte_sample.bytes_in(b"mv/", b"mv0") == 0
