@@ -1,0 +1,197 @@
+"""Disaster recovery: continuous replication into a SECOND cluster.
+
+Ref: fdbclient/DatabaseBackupAgent.actor.cpp — DR copies a source cluster
+into a destination cluster by shipping the mutation stream; the
+destination applies each source version atomically, so it is at every
+moment a consistent (possibly older) snapshot of the source.  The agent
+here plays the LogRouter/backup-worker part directly: it registers a
+consumer tag on the source's logs (holding their discard floor, like a
+storage), takes an initial range snapshot, then tails the log and applies
+each version's user-keyspace mutations to the destination in one
+transaction.
+
+v1 scope: a single source log (SimCluster's default); the tag-partitioned
+multi-log merge cursor arrives with multi-region log routers.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..client.types import MutationType, key_after
+from ..flow.error import FdbError
+from ..server.interfaces import TLogPeekRequest, TLogPopRequest
+
+DR_TAG = "_dr"
+SNAPSHOT_PAGE = 1000
+# Destination-side progress marker: every apply transaction reads it and
+# writes the new version, making replay idempotent under blind retries
+# after commit_unknown_result AND resumable across agent restarts (ref:
+# the apply-version bookkeeping DatabaseBackupAgent keeps in the
+# destination).
+DR_APPLIED_KEY = b"\xff/dr/applied"
+
+
+class DRAgent:
+    def __init__(self, src_db, dst_db, src_tlogs: List, tag: str = DR_TAG):
+        assert len(src_tlogs) == 1, (
+            "v1 tails a single source log; multi-log merge cursors arrive "
+            "with log routers"
+        )
+        self.src_db = src_db
+        self.dst_db = dst_db
+        self.tlog = src_tlogs[0]
+        self.tag = tag
+        self.applied = 0  # source version the destination reflects
+        self._storage_tags: List[str] = []
+        self.stopped = False
+
+    async def start(self) -> int:
+        """Register the consumer floor, then copy the initial snapshot.
+        Registration happens FIRST so nothing the snapshot misses can be
+        discarded before tailing begins (ref: the backup range lock before
+        the initial snapshot)."""
+        proc = self.src_db.process
+        await self.tlog.pop.get_reply(
+            proc, TLogPopRequest(version=0, tag=self.tag)
+        )
+        await self._refresh_tags()
+        # Snapshot at one source read version (pages share it; a too-old
+        # snapshot restarts fresh, same discipline as the file backup).
+        while True:
+            tr = self.src_db.create_transaction()
+            version = await tr.get_read_version()
+            try:
+                await self._copy_snapshot(tr, version)
+                break
+            except FdbError as e:
+                if e.name != "transaction_too_old":
+                    raise
+        self.applied = version
+        await self._mark_applied(version)
+        await self.tlog.pop.get_reply(
+            proc, TLogPopRequest(version=version, tag=self.tag)
+        )
+        return version
+
+    async def _mark_applied(self, version: int):
+        async def txn(tr):
+            tr.options["access_system_keys"] = True
+            tr.set(DR_APPLIED_KEY, b"%d" % version)
+
+        await self.dst_db.run(txn)
+
+    async def _refresh_tags(self):
+        """Discover the source's per-storage tags from \xff/serverList/
+        (sharded sources tag user mutations per storage, not with the
+        default tag)."""
+        from ..server import system_keys as sk
+
+        async def txn(tr):
+            tr.options["access_system_keys"] = True
+            rows = await tr.get_range(
+                sk.SERVER_LIST_PREFIX, sk.SERVER_LIST_END
+            )
+            return [sk.server_list_id(k) for k, _v in rows]
+
+        self._storage_tags = await self.src_db.run(txn)
+
+    async def _copy_snapshot(self, tr, version: int):
+        # Destination range cleared first so the result IS the snapshot.
+        async def wipe(d):
+            d.clear_range(b"", b"\xff")
+
+        await self.dst_db.run(wipe)
+        lo = b""
+        while True:
+            rows = await tr.get_range(
+                lo, b"\xff", limit=SNAPSHOT_PAGE, snapshot=True
+            )
+
+            async def put(d, rows=rows):
+                for k, v in rows:
+                    d.set(k, v)
+
+            if rows:
+                await self.dst_db.run(put)
+            if len(rows) < SNAPSHOT_PAGE:
+                return
+            lo = key_after(rows[-1][0])
+
+    async def tail_once(self) -> int:
+        """Peek the source log past `applied` and apply each version's
+        user-keyspace mutations to the destination in ONE transaction (the
+        prefix-consistency guarantee).  Returns versions applied."""
+        proc = self.src_db.process
+        rep = await self.tlog.peek.get_reply(
+            proc,
+            TLogPeekRequest(
+                begin_version=self.applied,
+                tags=self._tags(),
+                limit_versions=64,
+            ),
+        )
+        n = 0
+        for version, mutations in rep.entries:
+            if version <= self.applied:
+                continue
+            from ..client.types import ATOMIC_TYPES
+
+            user = [m for m in mutations if m.param1 < b"\xff"]
+
+            async def apply(d, user=user, version=version):
+                # Idempotence fence: a blind retry after a lost commit
+                # reply (commit_unknown_result) re-reads the progress
+                # marker and no-ops if this version already applied.
+                d.options["access_system_keys"] = True
+                raw = await d.get(DR_APPLIED_KEY)
+                if raw is not None and int(raw) >= version:
+                    return
+                for m in user:
+                    if m.type == MutationType.SET_VALUE:
+                        d.set(m.param1, m.param2)
+                    elif m.type == MutationType.CLEAR_RANGE:
+                        d.clear_range(m.param1, min(m.param2, b"\xff"))
+                    elif m.type in ATOMIC_TYPES:
+                        # Replaying the op against the (identical) prefix
+                        # state yields the identical result (ref: mutation
+                        # log application in applyMutations).
+                        d.atomic_op(m.type, m.param1, m.param2)
+                d.set(DR_APPLIED_KEY, b"%d" % version)
+
+            if user:
+                await self.dst_db.run(apply)
+            self.applied = version
+            n += 1
+        # end_version is the last SCANNED version — safe to adopt even
+        # mid-backlog (has_more): versions below it carrying none of our
+        # tags would otherwise wedge the window forever.
+        if rep.end_version > self.applied:
+            self.applied = rep.end_version
+        await self.tlog.pop.get_reply(
+            proc, TLogPopRequest(version=self.applied, tag=self.tag)
+        )
+        return n
+
+    def _tags(self) -> List[str]:
+        """Every tag carrying user mutations: the defaults plus the
+        storage tags discovered from the source's serverList.  On a single
+        log, the union of all tags is the full stream."""
+        from ..server.interfaces import TAG_ALL, TAG_DEFAULT
+
+        return [TAG_DEFAULT, TAG_ALL] + list(self._storage_tags)
+
+    async def run(self, poll: float = 0.02, tag_refresh: float = 1.0):
+        loop = self.src_db.process.network.loop
+        last_refresh = -1e18
+        while not self.stopped:
+            if loop.now() - last_refresh > tag_refresh:
+                await self._refresh_tags()
+                last_refresh = loop.now()
+            n = await self.tail_once()
+            if n == 0:
+                await loop.delay(poll)
+
+    def set_storage_tags(self, tags: List[str]):
+        """Manual override for tests; run() refreshes from serverList."""
+        self._storage_tags = list(tags)
