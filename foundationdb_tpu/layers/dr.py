@@ -30,6 +30,11 @@ SNAPSHOT_PAGE = 1000
 # the apply-version bookkeeping DatabaseBackupAgent keeps in the
 # destination).
 DR_APPLIED_KEY = b"\xff/dr/applied"
+# b"syncing" while the initial snapshot is (re)building the destination —
+# consumers must treat the data as invalid until it returns to b"tailing"
+# (ref: the destination lock DatabaseBackupAgent holds during the initial
+# range copy).
+DR_STATE_KEY = b"\xff/dr/state"
 
 
 class DRAgent:
@@ -56,6 +61,17 @@ class DRAgent:
             proc, TLogPopRequest(version=0, tag=self.tag)
         )
         await self._refresh_tags()
+        # Resume: a previous incarnation that finished its snapshot left
+        # applied/state markers, and its pop floor is PERSISTED on the
+        # source log, so the stream since then is still retained — tail
+        # from the marker instead of re-copying everything.
+        resume = await self._read_progress()
+        if resume is not None:
+            self.applied = resume
+            await self.tlog.pop.get_reply(
+                proc, TLogPopRequest(version=resume, tag=self.tag)
+            )
+            return resume
         # Snapshot at one source read version (pages share it; a too-old
         # snapshot restarts fresh, same discipline as the file backup).
         while True:
@@ -68,16 +84,29 @@ class DRAgent:
                 if e.name != "transaction_too_old":
                     raise
         self.applied = version
-        await self._mark_applied(version)
+        await self._mark_applied(version, state=b"tailing")
         await self.tlog.pop.get_reply(
             proc, TLogPopRequest(version=version, tag=self.tag)
         )
         return version
 
-    async def _mark_applied(self, version: int):
+    async def _read_progress(self) -> Optional[int]:
+        async def txn(tr):
+            tr.options["access_system_keys"] = True
+            state = await tr.get(DR_STATE_KEY)
+            raw = await tr.get(DR_APPLIED_KEY)
+            if state == b"tailing" and raw is not None:
+                return int(raw)
+            return None
+
+        return await self.dst_db.run(txn)
+
+    async def _mark_applied(self, version: int, state: bytes = None):
         async def txn(tr):
             tr.options["access_system_keys"] = True
             tr.set(DR_APPLIED_KEY, b"%d" % version)
+            if state is not None:
+                tr.set(DR_STATE_KEY, state)
 
         await self.dst_db.run(txn)
 
@@ -97,8 +126,12 @@ class DRAgent:
         self._storage_tags = await self.src_db.run(txn)
 
     async def _copy_snapshot(self, tr, version: int):
-        # Destination range cleared first so the result IS the snapshot.
+        # Mark the destination INVALID for the whole multi-transaction
+        # copy (cleared back to "tailing" only when it completes), then
+        # wipe so the result IS the snapshot.
         async def wipe(d):
+            d.options["access_system_keys"] = True
+            d.set(DR_STATE_KEY, b"syncing")
             d.clear_range(b"", b"\xff")
 
         await self.dst_db.run(wipe)
@@ -123,6 +156,7 @@ class DRAgent:
         user-keyspace mutations to the destination in ONE transaction (the
         prefix-consistency guarantee).  Returns versions applied."""
         proc = self.src_db.process
+        before = self.applied
         rep = await self.tlog.peek.get_reply(
             proc,
             TLogPeekRequest(
@@ -132,11 +166,28 @@ class DRAgent:
             ),
         )
         n = 0
+        new_tag = False
         for version, mutations in rep.entries:
             if version <= self.applied:
                 continue
             from ..client.types import ATOMIC_TYPES
+            from ..server import system_keys as sk
 
+            # In-stream tag discovery: a storage registration rides the
+            # broadcast tag, and any mutation tagged ONLY with the new
+            # storage can exist at later versions only (routing to it
+            # requires keyServers commits after the registration) — so
+            # adding the tag before peeking past this version closes the
+            # new-storage race without polling.
+            for m in mutations:
+                if (
+                    m.type == MutationType.SET_VALUE
+                    and m.param1.startswith(sk.SERVER_LIST_PREFIX)
+                ):
+                    sid = sk.server_list_id(m.param1)
+                    if sid not in self._storage_tags:
+                        self._storage_tags.append(sid)
+                        new_tag = True
             user = [m for m in mutations if m.param1 < b"\xff"]
 
             async def apply(d, user=user, version=version):
@@ -163,14 +214,19 @@ class DRAgent:
                 await self.dst_db.run(apply)
             self.applied = version
             n += 1
+            if new_tag:
+                # Later versions in THIS reply may be missing the new
+                # tag's bundles: re-peek with the widened tag set.
+                break
         # end_version is the last SCANNED version — safe to adopt even
         # mid-backlog (has_more): versions below it carrying none of our
         # tags would otherwise wedge the window forever.
-        if rep.end_version > self.applied:
+        if not new_tag and rep.end_version > self.applied:
             self.applied = rep.end_version
-        await self.tlog.pop.get_reply(
-            proc, TLogPopRequest(version=self.applied, tag=self.tag)
-        )
+        if self.applied > before:
+            await self.tlog.pop.get_reply(
+                proc, TLogPopRequest(version=self.applied, tag=self.tag)
+            )
         return n
 
     def _tags(self) -> List[str]:

@@ -9,6 +9,7 @@ and the trailing consistency check).
 
 from .base import TestWorkload, run_workloads
 from .cycle import CycleWorkload
+from .invariants import AtomicOpsWorkload, SerializabilityWorkload
 from .chaos import AttritionWorkload, RandomCloggingWorkload
 from .consistency import ConsistencyChecker, check_consistency
 from .config import SimulationConfig
@@ -17,6 +18,8 @@ __all__ = [
     "TestWorkload",
     "run_workloads",
     "CycleWorkload",
+    "AtomicOpsWorkload",
+    "SerializabilityWorkload",
     "AttritionWorkload",
     "RandomCloggingWorkload",
     "ConsistencyChecker",

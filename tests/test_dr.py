@@ -190,8 +190,9 @@ def test_dr_follows_sharded_source():
     a2.run_all([(src, src.run(more))])
 
     async def tail():
+        # NO manual tag refresh: the agent must discover the per-storage
+        # tags from the serverList mutations IN the stream it tails.
         for _ in range(100):
-            await agent._refresh_tags()
             await agent.tail_once()
             await loop.delay(0.01)
 

@@ -106,3 +106,45 @@ def test_buggify_fires_across_seeds():
         fired |= set(bug.fired_sites)
         set_event_loop(None)
     assert len(fired) >= 3, fired
+
+
+def test_atomic_ops_and_serializability_workloads():
+    from foundationdb_tpu.workloads import (
+        AtomicOpsWorkload,
+        SerializabilityWorkload,
+    )
+
+    c = SimCluster(seed=95, n_proxies=2)
+    run_workloads(
+        c,
+        [
+            AtomicOpsWorkload(actors=3, ops=10),
+            SerializabilityWorkload(rounds=8),
+            CycleWorkload(nodes=5, ops=10, actors=2),
+        ],
+    )
+
+
+@pytest.mark.parametrize("seed", range(2000, 2006))
+def test_invariant_sweep_under_chaos(seed):
+    """Six seeds of the full invariant stack (atomic accounting, write-skew
+    probes, cycle) under clogging + attrition on random topologies."""
+    cfg = SimulationConfig.random(seed)
+    c = cfg.build(seed)
+    from foundationdb_tpu.workloads import (
+        AtomicOpsWorkload,
+        SerializabilityWorkload,
+    )
+
+    run_workloads(
+        c,
+        [
+            AtomicOpsWorkload(actors=2, ops=8),
+            SerializabilityWorkload(rounds=5),
+            CycleWorkload(nodes=5, ops=10, actors=2),
+            RandomCloggingWorkload(duration=2.0),
+            AttritionWorkload(kills=1),
+            ConsistencyChecker(require_comparisons=cfg.n_storages >= 2),
+        ],
+        timeout_vt=20000.0,
+    )
