@@ -37,10 +37,10 @@ _T0 = time.perf_counter()
 
 def setup_jax(tries=None, backoff=20):
     if tries is None:
-        # A failing axon init takes ~25 min to report UNAVAILABLE on this
-        # host (observed r2), so default to 2 tries to bound worst-case
-        # bench wall clock; override with BENCH_INIT_TRIES.
-        tries = int(os.environ.get("BENCH_INIT_TRIES", "2"))
+        # Device init runs inside a killable subprocess now (the parent
+        # enforces a hard wall-clock timeout), so one in-process try is
+        # enough; override with BENCH_INIT_TRIES.
+        tries = int(os.environ.get("BENCH_INIT_TRIES", "1"))
     """Import jax, enable the persistent compilation cache, and initialize
     the device backend with retries (the axon TPU tunnel on this host is
     slow to come up and has failed transiently before — BENCH_r01).
@@ -208,9 +208,115 @@ def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=3407872, window=WINDOW):
     return n_batches * per_batch / dt
 
 
+def emit(out, errors):
+    """Print the full best-so-far result as one JSON line and flush, so a
+    mid-run kill still leaves the best partial result on stdout (the driver
+    takes the last line).  Records 1-min load average and warns when > 1.5
+    (orphaned processes depressed round 3's baselines by ~2.6x)."""
+    load1 = os.getloadavg()[0]
+    out["loadavg_1m"] = round(load1, 2)
+    if load1 > 1.5:
+        out["load_warning"] = (
+            f"1-min load {load1:.2f} > 1.5 on a 1-core host; numbers below "
+            "are likely understated (check for orphaned processes)"
+        )
+    else:
+        out.pop("load_warning", None)
+    if errors:
+        out["error"] = "; ".join(errors)
+    print(json.dumps(out), flush=True)
+
+
+def device_phase_main():
+    """Runs inside a subprocess (see main): device init + the device bench.
+    The parent enforces a hard wall-clock timeout and kills us on hang, so a
+    broken axon tunnel (25-min init hangs, observed r2/r3) cannot eat the
+    driver's budget.  Prints one JSON line with the device results."""
+    res = {}
+    platform = setup_jax()
+    res["platform"] = platform
+    warm_compile_probe()
+    _log("device bench: 24 batches x 65536 txns, window=50, h_cap=3.25M "
+         "(first compile may take minutes on this 1-core host)...")
+    rng = np.random.default_rng(2024)
+    res["jax_txns_per_sec"] = round(bench_jax(rng), 1)
+    _log(f"device: {res['jax_txns_per_sec']:,.0f} txn/s")
+    print(json.dumps(res), flush=True)
+
+
+def run_device_subprocess(timeout):
+    """Run the device phase in a killable child; return its parsed JSON dict.
+    Raises on timeout / crash / unparseable output."""
+    import subprocess
+
+    t0 = time.perf_counter()
+    from foundationdb_tpu.utils.procutil import die_with_parent
+
+    proc = subprocess.Popen(
+        [sys.executable, os.path.abspath(__file__), "--device-phase"],
+        stdout=subprocess.PIPE,
+        stderr=sys.stderr,
+        text=True,
+        start_new_session=True,  # its own process group: killpg reaps helpers
+        preexec_fn=die_with_parent,  # and the tree dies if bench.py is killed
+    )
+    try:
+        stdout, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        import signal
+
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait()
+        raise TimeoutError(
+            f"device phase exceeded {timeout}s (tunnel hang?); killed"
+        )
+    _log(f"device subprocess exited rc={proc.returncode} "
+         f"after {time.perf_counter() - t0:.0f}s")
+    if proc.returncode != 0:
+        raise RuntimeError(f"device phase rc={proc.returncode}")
+    for line in reversed(stdout.strip().splitlines()):
+        try:
+            return json.loads(line)
+        except ValueError:
+            continue
+    raise RuntimeError("device phase printed no JSON")
+
+
+def probe_device(timeout):
+    """Cheap killable liveness check: `jax.devices()` in a child with a hard
+    timeout.  A dead tunnel costs `timeout` seconds here instead of the full
+    device-phase budget.  Popen + killpg (not subprocess.run): a hung init's
+    helper grandchildren hold the pipes open, and run()'s post-timeout
+    communicate() would block on them forever."""
+    import signal
+    import subprocess
+
+    from foundationdb_tpu.utils.procutil import die_with_parent
+
+    code = "import jax; print([str(d) for d in jax.devices()])"
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+        preexec_fn=die_with_parent,
+    )
+    try:
+        stdout, stderr = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait()
+        raise TimeoutError(f"device probe exceeded {timeout}s")
+    if proc.returncode != 0:
+        raise RuntimeError(f"device probe failed: {stderr.strip()[-500:]}")
+    _log(f"device probe ok: {stdout.strip()}")
+
+
 def main():
-    """Always prints exactly one JSON line on stdout, even on device failure
-    (then: value = CPU baseline, vs_baseline = 1.0, plus an "error" field)."""
+    """Prints a full JSON result line after EVERY completed phase (C++
+    baseline, Python CPU, device) — the driver's last-line read always sees
+    the best result achieved so far, even if a later phase is killed."""
     out = {
         "metric": "resolver_conflict_txns_per_sec_64k_batch",
         "value": 0.0,
@@ -227,6 +333,7 @@ def main():
         out["cpp_txns_per_sec"] = round(cpp_rate, 1)
     except Exception as e:
         errors.append(f"cpp: {type(e).__name__}: {e}")
+    emit(out, errors)
     try:
         rng = np.random.default_rng(2024)
         _log("Python engine: 20 batches x 2500 txns (CpuConflictSet)...")
@@ -237,15 +344,15 @@ def main():
         out["vs_baseline"] = round(cpu_rate / cpp_rate, 3) if cpp_rate else 1.0
     except Exception as e:
         errors.append(f"cpu: {type(e).__name__}: {e}")
+    emit(out, errors)
     try:
-        platform = setup_jax()
-        out["platform"] = platform
-        warm_compile_probe()
-        _log("device bench: 24 batches x 65536 txns, window=50, h_cap=3.25M "
-             "(first compile may take minutes on this 1-core host)...")
-        jax_rate = bench_jax(rng)
-        _log(f"device: {jax_rate:,.0f} txn/s")
-        out["value"] = round(jax_rate, 1)
+        probe_device(int(os.environ.get("BENCH_PROBE_TIMEOUT", "240")))
+        res = run_device_subprocess(
+            int(os.environ.get("BENCH_DEVICE_TIMEOUT", "1500"))
+        )
+        out["platform"] = res.get("platform")
+        jax_rate = res["jax_txns_per_sec"]
+        out["value"] = jax_rate
         # vs_baseline is the north-star ratio: device throughput over the
         # NATIVE C++ skiplist on this host (BASELINE.md:30-35).
         if cpp_rate:
@@ -254,10 +361,11 @@ def main():
             out["vs_baseline"] = round(jax_rate / cpu_rate, 3)
     except Exception as e:
         errors.append(f"device: {type(e).__name__}: {e}")
-    if errors:
-        out["error"] = "; ".join(errors)
-    print(json.dumps(out), flush=True)
+    emit(out, errors)
 
 
 if __name__ == "__main__":
-    main()
+    if "--device-phase" in sys.argv:
+        device_phase_main()
+    else:
+        main()

@@ -18,7 +18,12 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
-INF32 = jnp.int32(2**31 - 1)
+# Plain Python int so importing this module never touches a JAX backend
+# (a module-level jnp.int32() would device-commit at import time; with a
+# broken TPU tunnel that init can hang for ~25 min — observed round 3).
+# jnp ops cast it where used; the explicit dtype=jnp.int32 sites keep the
+# arrays int32.
+INF32 = 2**31 - 1
 
 
 def stabbing_min(

@@ -29,6 +29,8 @@ import sys
 import time
 from typing import Dict, Optional
 
+from ..utils.procutil import die_with_parent
+
 
 class _Child:
     def __init__(self, name: str, command: str):
@@ -108,11 +110,16 @@ class Monitor:
                 os.path.join(self.logdir, f"{ch.name}.log"), "ab", buffering=0
             )
             ch.proc = subprocess.Popen(
-                shlex.split(ch.command), stdout=logf, stderr=subprocess.STDOUT
+                shlex.split(ch.command),
+                stdout=logf,
+                stderr=subprocess.STDOUT,
+                preexec_fn=die_with_parent,
             )
             logf.close()
         else:
-            ch.proc = subprocess.Popen(shlex.split(ch.command))
+            ch.proc = subprocess.Popen(
+                shlex.split(ch.command), preexec_fn=die_with_parent
+            )
 
     def _stop_child(self, ch: _Child):
         if ch.alive():

@@ -50,6 +50,53 @@ class TestWallClockTimeout(BaseException):
     cannot swallow the watchdog and re-hang the suite."""
 
 
+# --- leaked-subprocess sweep (round-3 orphan incident) ---
+# PDEATHSIG on every spawn is the primary defense; this is the audit: at
+# session end, any still-alive real_node/monitor process started under THIS
+# pytest session (identified by an inherited env marker, so concurrent
+# sessions / unrelated monitors are untouched) is killed AND reported as a
+# failure so leaks can't go unnoticed.
+
+_SESSION_MARKER = f"FDB_TPU_PYTEST_SESSION={os.getpid()}"
+os.environ["FDB_TPU_PYTEST_SESSION"] = str(os.getpid())
+
+
+def _find_leaked_nodes():
+    me = os.getpid()
+    leaked = []
+    for p in os.listdir("/proc"):
+        if not p.isdigit() or int(p) == me:
+            continue
+        try:
+            with open(f"/proc/{p}/cmdline", "rb") as f:
+                cmd = f.read().replace(b"\x00", b" ").decode(errors="replace")
+            if "foundationdb_tpu.tools.real_node" not in cmd and (
+                "foundationdb_tpu.tools.monitor" not in cmd
+            ):
+                continue
+            with open(f"/proc/{p}/environ", "rb") as f:
+                environ = f.read().replace(b"\x00", b"\n").decode(
+                    errors="replace"
+                )
+        except OSError:
+            continue
+        if _SESSION_MARKER in environ.splitlines():
+            leaked.append((int(p), cmd.strip()))
+    return leaked
+
+
+def pytest_sessionfinish(session, exitstatus):
+    leaked = _find_leaked_nodes()
+    for pid, cmd in leaked:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+        print(f"\nLEAKED SUBPROCESS killed: pid={pid} {cmd}", file=sys.stderr)
+    if leaked and exitstatus == 0:
+        session.exitstatus = 1
+
+
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_call(item):
     budget = TEST_TIMEOUT_S

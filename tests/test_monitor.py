@@ -42,10 +42,15 @@ def test_monitor_restarts_crashed_server(tmp_path):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO
     env.setdefault("JAX_PLATFORMS", "cpu")
+    from foundationdb_tpu.utils.procutil import die_with_parent
+
     mon = subprocess.Popen(
         [sys.executable, "-m", "foundationdb_tpu.tools.monitor", str(conf)],
         cwd=REPO,
         env=env,
+        # The monitor dies with pytest; its own children carry the same
+        # PDEATHSIG (monitor.py), so the whole tree is kill-proof.
+        preexec_fn=die_with_parent,
     )
     log = logdir / "server.1.log"
 

@@ -14,6 +14,8 @@ import sys
 
 import pytest
 
+from foundationdb_tpu.utils.procutil import die_with_parent
+
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
@@ -29,6 +31,9 @@ def _spawn(args):
         stdout=subprocess.PIPE,
         stderr=subprocess.STDOUT,
         text=True,
+        # Kernel-enforced: the child dies even if pytest is SIGKILLed before
+        # the finally-block cleanup runs (round-3 orphan incident).
+        preexec_fn=die_with_parent,
     )
 
 
