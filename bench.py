@@ -232,6 +232,12 @@ def device_phase_main():
     The parent enforces a hard wall-clock timeout and kills us on hang, so a
     broken axon tunnel (25-min init hangs, observed r2/r3) cannot eat the
     driver's budget.  Prints one JSON line with the device results."""
+    from foundationdb_tpu.utils.procutil import reap_group_on_term
+
+    # If bench.py dies, the kernel TERMs us (PDEATHSIG) and this handler
+    # SIGKILLs our whole session — including tunnel helper grandchildren
+    # that PDEATHSIG alone would orphan.
+    reap_group_on_term()
     res = {}
     platform = setup_jax()
     res["platform"] = platform
@@ -247,33 +253,18 @@ def device_phase_main():
 def run_device_subprocess(timeout):
     """Run the device phase in a killable child; return its parsed JSON dict.
     Raises on timeout / crash / unparseable output."""
-    import subprocess
+    from foundationdb_tpu.utils.procutil import run_killable
 
     t0 = time.perf_counter()
-    from foundationdb_tpu.utils.procutil import die_with_parent
-
-    proc = subprocess.Popen(
+    rc, stdout, _ = run_killable(
         [sys.executable, os.path.abspath(__file__), "--device-phase"],
-        stdout=subprocess.PIPE,
+        timeout,
         stderr=sys.stderr,
-        text=True,
-        start_new_session=True,  # its own process group: killpg reaps helpers
-        preexec_fn=die_with_parent,  # and the tree dies if bench.py is killed
     )
-    try:
-        stdout, _ = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        import signal
-
-        os.killpg(proc.pid, signal.SIGKILL)
-        proc.wait()
-        raise TimeoutError(
-            f"device phase exceeded {timeout}s (tunnel hang?); killed"
-        )
-    _log(f"device subprocess exited rc={proc.returncode} "
+    _log(f"device subprocess exited rc={rc} "
          f"after {time.perf_counter() - t0:.0f}s")
-    if proc.returncode != 0:
-        raise RuntimeError(f"device phase rc={proc.returncode}")
+    if rc != 0:
+        raise RuntimeError(f"device phase rc={rc}")
     for line in reversed(stdout.strip().splitlines()):
         try:
             return json.loads(line)
@@ -285,30 +276,21 @@ def run_device_subprocess(timeout):
 def probe_device(timeout):
     """Cheap killable liveness check: `jax.devices()` in a child with a hard
     timeout.  A dead tunnel costs `timeout` seconds here instead of the full
-    device-phase budget.  Popen + killpg (not subprocess.run): a hung init's
-    helper grandchildren hold the pipes open, and run()'s post-timeout
-    communicate() would block on them forever."""
-    import signal
-    import subprocess
+    device-phase budget.  The child installs the group-reaping TERM handler
+    so tunnel helper grandchildren die with it."""
+    from foundationdb_tpu.utils.procutil import run_killable
 
-    from foundationdb_tpu.utils.procutil import die_with_parent
-
-    code = "import jax; print([str(d) for d in jax.devices()])"
-    proc = subprocess.Popen(
-        [sys.executable, "-c", code],
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
-        text=True,
-        start_new_session=True,
-        preexec_fn=die_with_parent,
+    repo = os.path.dirname(os.path.abspath(__file__))
+    code = (
+        f"import sys; sys.path.insert(0, {repo!r}); "
+        "from foundationdb_tpu.utils.procutil import reap_group_on_term; "
+        "reap_group_on_term(); "
+        "import jax; print([str(d) for d in jax.devices()])"
     )
-    try:
-        stdout, stderr = proc.communicate(timeout=timeout)
-    except subprocess.TimeoutExpired:
-        os.killpg(proc.pid, signal.SIGKILL)
-        proc.wait()
-        raise TimeoutError(f"device probe exceeded {timeout}s")
-    if proc.returncode != 0:
+    rc, stdout, stderr = run_killable(
+        [sys.executable, "-c", code], timeout
+    )
+    if rc != 0:
         raise RuntimeError(f"device probe failed: {stderr.strip()[-500:]}")
     _log(f"device probe ok: {stdout.strip()}")
 

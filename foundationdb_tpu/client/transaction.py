@@ -188,6 +188,7 @@ class Transaction:
         self.options: dict = {}
         self._retries = 0
         self._watches: List[tuple] = []  # (key, value, Promise), armed at commit
+        self._committing = False  # set at commit() entry, cleared by reset()
 
     # --- versions ---
     async def get_read_version(self) -> int:
@@ -203,32 +204,55 @@ class Transaction:
         self._read_version = version
 
     # --- local overlay (RYW) ---
-    def _replay(self, key: bytes, base: Optional[bytes]) -> Optional[bytes]:
-        """Apply this txn's mutation log, in order, to `base` for `key`."""
+    def _replay(
+        self, key: bytes, base: Optional[bytes], muts=None
+    ) -> Optional[bytes]:
+        """Apply a mutation log (default: this txn's), in order, to `base`
+        for `key`.  Readers pass the ISSUE-TIME snapshot of the log so a
+        write issued while the storage read was in flight does not leak into
+        the result (ref: RYW's WriteMap is consulted when the read is issued,
+        ReadYourWrites.actor.cpp readThrough — the WriteDuringRead workload
+        exists to check exactly this)."""
         val = base
-        for m in self.mutations:
+        for m in (self.mutations if muts is None else muts):
             if m.type == MutationType.CLEAR_RANGE:
                 if m.param1 <= key < m.param2:
                     val = None
+            elif m.type == MutationType.SET_VERSIONSTAMPED_KEY:
+                # The stamped key is unknown until commit: ANY key in the
+                # possible stamp range is unreadable (ref: RYW treating
+                # versionstamp writes as unreadable ranges,
+                # getVersionstampKeyRange :226).
+                pos = int.from_bytes(m.param1[-4:], "little", signed=True)
+                body = m.param1[:-4]
+                lo = body[:pos] + b"\x00" * 10 + body[pos + 10 :]
+                hi = body[:pos] + b"\xff" * 10 + body[pos + 10 :]
+                if lo <= key <= hi:
+                    raise FdbError("accessed_unreadable")
             elif m.param1 != key:
                 continue
             elif m.type == MutationType.SET_VALUE:
                 val = m.param2
-            elif m.type in (
-                MutationType.SET_VERSIONSTAMPED_KEY,
-                MutationType.SET_VERSIONSTAMPED_VALUE,
-            ):
+            elif m.type == MutationType.SET_VERSIONSTAMPED_VALUE:
                 raise FdbError("accessed_unreadable")
             elif m.type in ATOMIC_TYPES:
                 val = apply_atomic(m.type, val, m.param2)
         return val
 
-    def _touched_keys(self, begin: bytes, end: bytes) -> List[bytes]:
+    def _touched_keys(self, begin: bytes, end: bytes, muts=None) -> List[bytes]:
         out = set()
-        for m in self.mutations:
+        for m in (self.mutations if muts is None else muts):
             if m.type != MutationType.CLEAR_RANGE and begin <= m.param1 < end:
                 out.add(m.param1)
         return sorted(out)
+
+    def _check_usable(self):
+        """Reads and writes on a transaction whose commit has started (and
+        until reset/on_error) fail with used_during_commit (ref:
+        ReadYourWritesTransaction's checkUsedDuringCommit,
+        ReadYourWrites.actor.cpp)."""
+        if self._committing:
+            raise FdbError("used_during_commit")
 
     # --- reads ---
     async def _get_from_storage(self, key: bytes, version: int):
@@ -282,11 +306,14 @@ class Transaction:
         raise last
 
     async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
+        self._check_usable()
+        self._check_legal_key(key)  # reads of \xff.. need the option too
+        muts = tuple(self.mutations)  # issue-time RYW snapshot
         version = await self.get_read_version()
         reply = await self._get_from_storage(key, version)
         if not snapshot:
             self.add_read_conflict_range(key, key_after(key))
-        return self._replay(key, reply.value)
+        return self._replay(key, reply.value, muts)
 
     async def get_range(
         self,
@@ -296,6 +323,17 @@ class Transaction:
         reverse: bool = False,
         snapshot: bool = False,
     ) -> List[Tuple[bytes, bytes]]:
+        self._check_usable()
+        self._check_legal_key(begin)
+        if end > b"\xff" and not self.options.get("access_system_keys"):
+            raise FdbError("key_outside_legal_range")
+        muts = tuple(self.mutations)  # issue-time RYW snapshot
+        # A scan intersecting any pending versionstamped-key stamp range is
+        # unreadable as a whole (computed once per call, not per row; ref:
+        # RYW's unreadable ranges for range reads).
+        for lo_s, hi_s in _stamp_ranges(muts):
+            if begin <= hi_s and lo_s < end:
+                raise FdbError("accessed_unreadable")
         version = await self.get_read_version()
         out: List[Tuple[bytes, bytes]] = []
         loop = self.db.process.network.loop
@@ -358,9 +396,9 @@ class Transaction:
                 else:
                     lo = req_hi
             merged = set(base)
-            merged.update(self._touched_keys(cov_lo, cov_hi))
+            merged.update(self._touched_keys(cov_lo, cov_hi, muts))
             for k in sorted(merged, reverse=reverse):
-                v = self._replay(k, base.get(k))
+                v = self._replay(k, base.get(k), muts)
                 if v is not None:
                     out.append((k, v))
                     if len(out) >= limit:
@@ -401,11 +439,13 @@ class Transaction:
 
     # --- writes ---
     def set(self, key: bytes, value: bytes):
+        self._check_usable()
         self._check_size(key, value)
         self.mutations.append(Mutation(MutationType.SET_VALUE, key, value))
         self.add_write_conflict_range(key, key_after(key))
 
     def clear(self, key: bytes):
+        self._check_usable()
         self._check_legal_key(key)
         self.mutations.append(
             Mutation(MutationType.CLEAR_RANGE, key, key_after(key))
@@ -413,6 +453,7 @@ class Transaction:
         self.add_write_conflict_range(key, key_after(key))
 
     def clear_range(self, begin: bytes, end: bytes):
+        self._check_usable()
         if begin > end:
             raise FdbError("inverted_range")
         self._check_legal_key(begin)
@@ -422,6 +463,7 @@ class Transaction:
         self.add_write_conflict_range(begin, end)
 
     def atomic_op(self, op: MutationType, key: bytes, operand: bytes):
+        self._check_usable()
         assert op in ATOMIC_TYPES, op
         self._check_size(key, operand)
         if op == MutationType.SET_VERSIONSTAMPED_KEY:
@@ -514,6 +556,8 @@ class Transaction:
 
     # --- commit ---
     async def commit(self) -> Optional[int]:
+        self._check_usable()
+        self._committing = True
         if not self.mutations and not self.write_conflict_ranges:
             self.committed_version = self._read_version
             self._launch_watches(self._read_version or 0)
@@ -630,6 +674,7 @@ class Transaction:
 
     def reset(self):
         self._read_version = None
+        self._committing = False
         self.mutations = []
         self.read_conflict_ranges = []
         self.write_conflict_ranges = []
@@ -638,6 +683,23 @@ class Transaction:
             if not promise.is_set():
                 promise.send_error(FdbError("watch_cancelled"))
         self._watches = []
+
+
+def _stamp_ranges(muts) -> List[Tuple[bytes, bytes]]:
+    """[lo, hi] (inclusive) possible-key ranges of pending
+    SET_VERSIONSTAMPED_KEY mutations (ref: getVersionstampKeyRange :226)."""
+    out = []
+    for m in muts:
+        if m.type == MutationType.SET_VERSIONSTAMPED_KEY:
+            pos = int.from_bytes(m.param1[-4:], "little", signed=True)
+            body = m.param1[:-4]
+            out.append(
+                (
+                    body[:pos] + b"\x00" * 10 + body[pos + 10 :],
+                    body[:pos] + b"\xff" * 10 + body[pos + 10 :],
+                )
+            )
+    return out
 
 
 def _intersect_key(write: List[Range], read: List[Range]) -> Optional[bytes]:

@@ -39,3 +39,52 @@ def die_with_parent(sig: int = signal.SIGKILL) -> None:
         _libc.prctl(PR_SET_PDEATHSIG, int(sig), 0, 0, 0)
     except Exception:
         pass
+
+
+def die_with_parent_term() -> None:
+    """PDEATHSIG=SIGTERM variant: gives the child a chance to killpg its own
+    helpers (PDEATHSIG is NOT inherited by grandchildren) before dying —
+    see reap_group_on_term()."""
+    die_with_parent(signal.SIGTERM)
+
+
+def reap_group_on_term() -> None:
+    """Install a SIGTERM handler that SIGKILLs the caller's whole process
+    group (including helper grandchildren that PDEATHSIG does not cover)
+    and exits.  Pair with die_with_parent_term() in the spawner: parent
+    dies -> kernel TERMs the child -> child killpgs its session."""
+    import os
+
+    def _h(signum, frame):
+        try:
+            os.killpg(0, signal.SIGKILL)
+        finally:  # pragma: no cover - killpg(0) includes ourselves
+            os._exit(143)
+
+    signal.signal(signal.SIGTERM, _h)
+
+
+def run_killable(argv, timeout, stderr=None):
+    """Run argv in its own session with a hard wall-clock timeout; on
+    timeout SIGKILL the entire process group (pipes held open by helper
+    grandchildren cannot extend the wait — the round-3 hang mode of
+    subprocess.run).  Returns (returncode, stdout, stderr_text_or_None).
+    Raises TimeoutError on timeout."""
+    import os
+    import subprocess
+
+    proc = subprocess.Popen(
+        argv,
+        stdout=subprocess.PIPE,
+        stderr=stderr if stderr is not None else subprocess.PIPE,
+        text=True,
+        start_new_session=True,
+        preexec_fn=die_with_parent_term,
+    )
+    try:
+        stdout, err = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        os.killpg(proc.pid, signal.SIGKILL)
+        proc.wait()
+        raise TimeoutError(f"{argv[0]} exceeded {timeout}s; process group killed")
+    return proc.returncode, stdout, err

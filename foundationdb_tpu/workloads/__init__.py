@@ -13,6 +13,11 @@ from .invariants import AtomicOpsWorkload, SerializabilityWorkload
 from .chaos import AttritionWorkload, RandomCloggingWorkload
 from .consistency import ConsistencyChecker, check_consistency
 from .config import SimulationConfig
+from .write_during_read import WriteDuringReadWorkload
+from .random_read_write import RandomReadWriteWorkload
+from .fuzz_api import FuzzApiWorkload
+from .rollback import RollbackWorkload
+from .random_move_keys import RandomMoveKeysWorkload
 
 __all__ = [
     "TestWorkload",
@@ -25,4 +30,9 @@ __all__ = [
     "ConsistencyChecker",
     "check_consistency",
     "SimulationConfig",
+    "WriteDuringReadWorkload",
+    "RandomReadWriteWorkload",
+    "FuzzApiWorkload",
+    "RollbackWorkload",
+    "RandomMoveKeysWorkload",
 ]

@@ -1,0 +1,103 @@
+"""The BASELINE.json acceptance matrix: every named config exists as a
+runnable workload, and the CPU<->JAX conflict backends produce IDENTICAL
+histories on the adversarial ones.
+
+BASELINE.json configs:
+1. skipListTest microbench            -> bench.py (driver-run)
+2. WriteDuringRead, high contention   -> differential gate here
+3. RandomReadWrite, low contention    -> differential gate here
+4. Multi-resolver (4) + Cycle         -> differential gate here
+5. 64k-batch Zipf replay              -> bench.py device phase (driver-run)
+
+Identity of histories is the real acceptance bar (ref: the north star's
+"identical tooManyConflicts decisions vs CPU SkipList on the simulated
+WriteDuringRead workload"): the simulation is deterministic per seed, so
+swapping ONLY the conflict backend must reproduce the exact per-txn
+outcome sequence, final database state, and mismatch-free memory model.
+"""
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.server import SimCluster
+from foundationdb_tpu.workloads import (
+    CycleWorkload,
+    RandomReadWriteWorkload,
+    WriteDuringReadWorkload,
+    run_workloads,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def _final_state(cluster, prefix: bytes):
+    db = cluster.database("final_reader")
+
+    async def read(tr):
+        return await tr.get_range(prefix, prefix + b"\xff")
+
+    async def run():
+        out = None
+        tr = db.create_transaction()
+        out = await read(tr)
+        return out
+
+    return cluster.run_until(db.process.spawn(run(), "final"), timeout_vt=5000.0)
+
+
+def _run_wdr(backend: str, seed: int):
+    c = SimCluster(seed=seed, conflict_backend=backend, n_proxies=2)
+    wl = WriteDuringReadWorkload(nodes=25, txns=10)
+    run_workloads(c, [wl], timeout_vt=30000.0)
+    state = _final_state(c, wl.prefix)
+    set_event_loop(None)
+    return wl, state
+
+
+def test_write_during_read_differential_cpu_vs_jax():
+    """Config 2: the high-contention RYW workload, identical histories."""
+    cpu_wl, cpu_state = _run_wdr("cpu", seed=9001)
+    jax_wl, jax_state = _run_wdr("jax", seed=9001)
+    assert not cpu_wl.mismatches and not jax_wl.mismatches
+    assert cpu_wl.history == jax_wl.history
+    assert cpu_wl.committed_txns == jax_wl.committed_txns > 0
+    assert cpu_state == jax_state
+
+
+def _run_rrw(backend: str, seed: int):
+    c = SimCluster(seed=seed, conflict_backend=backend, n_proxies=2)
+    wl = RandomReadWriteWorkload(nodes=120, actors=3, txns_per_actor=6)
+    run_workloads(c, [wl], timeout_vt=30000.0)
+    state = _final_state(c, wl.prefix)
+    set_event_loop(None)
+    return wl, state
+
+
+def test_random_read_write_differential_cpu_vs_jax():
+    """Config 3: uniform keys, low contention, identical histories."""
+    cpu_wl, cpu_state = _run_rrw("cpu", seed=9002)
+    jax_wl, jax_state = _run_rrw("jax", seed=9002)
+    assert cpu_wl.committed == jax_wl.committed == 18
+    assert cpu_state == jax_state
+
+
+def _run_cycle_multi_resolver(backend: str, seed: int):
+    c = SimCluster(
+        seed=seed, conflict_backend=backend, n_resolvers=4, n_proxies=2
+    )
+    wl = CycleWorkload(nodes=8, ops=25, actors=3)
+    run_workloads(c, [wl], timeout_vt=30000.0)
+    state = _final_state(c, wl.prefix)
+    set_event_loop(None)
+    return state
+
+
+def test_cycle_multi_resolver_differential_cpu_vs_jax():
+    """Config 4: resolvers=4 with KeyRangeMap sharding, Cycle invariant."""
+    cpu_state = _run_cycle_multi_resolver("cpu", seed=9003)
+    jax_state = _run_cycle_multi_resolver("jax", seed=9003)
+    assert cpu_state == jax_state

@@ -1,0 +1,57 @@
+"""Rollback + RandomMoveKeys chaos workloads.
+
+Ref: fdbserver/workloads/Rollback.actor.cpp (partial-durability partition
+forcing version rollback through recovery) and RandomMoveKeys.actor.cpp
+(shard moves racing live load).
+"""
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.server import SimCluster
+from foundationdb_tpu.workloads import (
+    ConsistencyChecker,
+    CycleWorkload,
+    RandomMoveKeysWorkload,
+    RollbackWorkload,
+    run_workloads,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+@pytest.mark.parametrize("seed", [8101, 8102, 8103])
+def test_rollback_partition_recovers(seed):
+    """Clog proxy<->tlogs mid-commit; the recovery must roll back
+    non-quorum-durable versions and lose no acked commit (cycle ring
+    invariant + consistency check)."""
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+
+    c = DynamicCluster(seed=seed, n_workers=7, n_tlogs=2, n_storages=2)
+    wl = RollbackWorkload(rounds=1, clog_duration=2.0, delay_between=1.0)
+    run_workloads(
+        c,
+        [
+            CycleWorkload(nodes=6, ops=12, actors=2),
+            wl,
+            ConsistencyChecker(require_comparisons=True),
+        ],
+        timeout_vt=40000.0,
+    )
+    assert wl.triggered >= 1
+
+
+@pytest.mark.parametrize("seed", [8201, 8202])
+def test_random_move_keys_under_load(seed):
+    c = SimCluster(seed=seed, n_storages=3, n_proxies=2)
+    wl = RandomMoveKeysWorkload(moves=4)
+    run_workloads(
+        c,
+        [CycleWorkload(nodes=8, ops=20, actors=2), wl],
+        timeout_vt=40000.0,
+    )
+    assert wl.performed >= 1

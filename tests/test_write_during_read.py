@@ -1,0 +1,110 @@
+"""WriteDuringRead workload + the client semantics it exists to check.
+
+Ref: fdbserver/workloads/WriteDuringRead.actor.cpp (byte-exact memory model
+vs RYW transaction under concurrent intra-transaction ops) and
+ReadYourWrites.actor.cpp's used_during_commit contract.
+"""
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.flow.error import FdbError
+from foundationdb_tpu.server import SimCluster
+from foundationdb_tpu.workloads import (
+    RandomReadWriteWorkload,
+    WriteDuringReadWorkload,
+    run_workloads,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+@pytest.mark.parametrize("seed", [7001, 7002, 7003])
+def test_write_during_read_memory_model(seed):
+    c = SimCluster(seed=seed, n_proxies=2, n_storages=2)
+    wl = WriteDuringReadWorkload(nodes=30, txns=10)
+    run_workloads(c, [wl], timeout_vt=30000.0)
+    assert wl.committed_txns > 0
+    assert not wl.mismatches
+
+
+def test_random_read_write_workload():
+    c = SimCluster(seed=7010, n_proxies=2)
+    wl = RandomReadWriteWorkload(nodes=100, actors=3, txns_per_actor=6)
+    run_workloads(c, [wl], timeout_vt=30000.0)
+    assert wl.committed == 18
+
+
+def test_read_does_not_see_write_issued_during_flight():
+    """A set() issued while a get() is awaiting storage must NOT leak into
+    the get's result (issue-time RYW snapshot; the reference computes the
+    expected value synchronously at op issue — WriteDuringRead.actor.cpp
+    getAndCompare)."""
+    c = SimCluster(seed=7020)
+    db = c.database("t")
+
+    async def scenario():
+        async def fill(tr):
+            tr.set(b"k", b"old")
+
+        await db.run(fill)
+
+        tr = db.create_transaction()
+        got = {}
+
+        async def reader():
+            got["v"] = await tr.get(b"k")
+
+        task = db.process.spawn(reader(), "inflight_get")
+        # Let the read reach storage, then write while it is in flight.
+        await c.loop.delay(0.0001)
+        tr.set(b"k", b"new")
+        await task
+        # Issue-time snapshot: the in-flight read must see the OLD value.
+        assert got["v"] == b"old", got
+        # A read issued after the write sees it (RYW still works).
+        assert await tr.get(b"k") == b"new"
+
+    c.run_until(db.process.spawn(scenario(), "scenario"), timeout_vt=1000.0)
+
+
+def test_used_during_commit():
+    c = SimCluster(seed=7021)
+    db = c.database("t")
+
+    async def scenario():
+        tr = db.create_transaction()
+        tr.set(b"a", b"1")
+        commit_task = db.process.spawn(tr.commit(), "commit")
+        # Yield so the commit coroutine actually starts (and is in flight).
+        await c.loop.delay(0.0001)
+        # Ops racing the in-flight commit fail cleanly.
+        with pytest.raises(FdbError, match="used_during_commit"):
+            await tr.get(b"a")
+        with pytest.raises(FdbError, match="used_during_commit"):
+            tr.set(b"b", b"2")
+        with pytest.raises(FdbError, match="used_during_commit"):
+            tr.clear(b"a")
+        await commit_task
+        # Still unusable after commit completes, until reset.
+        with pytest.raises(FdbError, match="used_during_commit"):
+            await tr.get(b"a")
+        tr.reset()
+        assert await tr.get(b"a") == b"1"
+
+    c.run_until(db.process.spawn(scenario(), "scenario"), timeout_vt=1000.0)
+
+
+@pytest.mark.parametrize("seed", [7101, 7102, 7103, 7104])
+def test_fuzz_api_workload(seed):
+    from foundationdb_tpu.workloads import FuzzApiWorkload
+
+    c = SimCluster(seed=seed, n_proxies=2)
+    wl = FuzzApiWorkload(nodes=20, txns=15)
+    run_workloads(c, [wl], timeout_vt=30000.0)
+    assert not wl.failures
+    assert len(wl.errors_exercised) >= 3, wl.errors_exercised
