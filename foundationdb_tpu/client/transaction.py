@@ -85,6 +85,25 @@ class Database:
         from ..rpc.loadbalance import QueueModel
 
         self.queue_model = QueueModel()
+        # Endpoint liveness pushed from the CC's failure detector (ref:
+        # FailureMonitorClient): addr -> failed.  loadBalance orders dead
+        # replicas last so reads avoid them WITHOUT eating a timeout.
+        self.failure_states: dict = {}
+        if info_var is not None:
+            from ..server.failure_monitor import run_failure_monitor_client
+
+            process.spawn(
+                run_failure_monitor_client(self), "failure_monitor_client"
+            )
+
+    def is_failed(self, iface) -> bool:
+        """Is the process behind this interface marked failed?  Keyed by
+        any stream ref's endpoint address."""
+        for f in vars(iface).values():
+            ep = getattr(f, "endpoint", None)
+            if ep is not None:
+                return bool(self.failure_states.get(ep.address))
+        return False
 
     def invalidate_location(self, begin: bytes, end: Optional[bytes] = None):
         self._loc_cache.set_range(begin, end or key_after(begin), None)
@@ -195,8 +214,15 @@ class Transaction:
         if self._read_version is None:
             if self.db.info_var is not None:
                 await self.db.wait_connected()
+            from ..server.interfaces import GRV_FLAG_PRIORITY_BATCH
+
+            flags = (
+                GRV_FLAG_PRIORITY_BATCH
+                if self.options.get("priority_batch")
+                else 0
+            )
             self._read_version = await self.db.pick_proxy("grv").get_consistent_read_version.get_reply(
-                self.db.process, GetReadVersionRequest()
+                self.db.process, GetReadVersionRequest(flags=flags)
             )
         return self._read_version
 
@@ -223,10 +249,7 @@ class Transaction:
                 # possible stamp range is unreadable (ref: RYW treating
                 # versionstamp writes as unreadable ranges,
                 # getVersionstampKeyRange :226).
-                pos = int.from_bytes(m.param1[-4:], "little", signed=True)
-                body = m.param1[:-4]
-                lo = body[:pos] + b"\x00" * 10 + body[pos + 10 :]
-                hi = body[:pos] + b"\xff" * 10 + body[pos + 10 :]
+                (lo, hi), = _stamp_ranges([m])
                 if lo <= key <= hi:
                     raise FdbError("accessed_unreadable")
             elif m.param1 != key:
@@ -281,6 +304,7 @@ class Transaction:
                     ),
                     key_of=lambda iface: getattr(iface, "storage_id", "")
                     or id(iface),
+                    failed=self.db.is_failed,
                 )
             except FdbError as e:
                 if e.name not in (
@@ -354,7 +378,18 @@ class Transaction:
                 _b, e, team = locs[0]
                 req_lo = lo
                 req_hi = hi if e is None else min(e, hi)
-            iface = team[misroutes % len(team)] if team else self.db.storage
+            if team:
+                # Rotate on misroutes, but prefer replicas the failure
+                # monitor considers alive (ref: IFailureMonitor-aware pick).
+                cand = [
+                    team[(misroutes + j) % len(team)]
+                    for j in range(len(team))
+                ]
+                iface = next(
+                    (x for x in cand if not self.db.is_failed(x)), cand[0]
+                )
+            else:
+                iface = self.db.storage
             try:
                 reply = await iface.get_key_values.get_reply(
                     self.db.process,
@@ -472,13 +507,11 @@ class Transaction:
             validate_versionstamp_param(key)
             # The stamped key is unknown until commit; conflict on the whole
             # possible stamp range (ref: getVersionstampKeyRange :226).
-            pos = int.from_bytes(key[-4:], "little", signed=True)
-            body = key[:-4]
-            self.mutations.append(Mutation(op, key, operand))
-            self.add_write_conflict_range(
-                body[:pos] + b"\x00" * 10 + body[pos + 10 :],
-                key_after(body[:pos] + b"\xff" * 10 + body[pos + 10 :]),
-            )
+            # Same computation as the RYW-unreadable check, by construction.
+            m = Mutation(op, key, operand)
+            (lo, hi), = _stamp_ranges([m])
+            self.mutations.append(m)
+            self.add_write_conflict_range(lo, key_after(hi))
             return
         if op == MutationType.SET_VERSIONSTAMPED_VALUE:
             from .atomic import validate_versionstamp_param

@@ -10,7 +10,8 @@ recovers whatever "disk" state survived.
 
 from .simfile import SimFileSystem, SimAsyncFile, KillMode
 from .diskqueue import DiskQueue
-from .kvstore import KeyValueStoreMemory
+from .kvstore import KeyValueStoreMemory, open_engine
+from .btree import BTreeKeyValueStore
 
 __all__ = [
     "SimFileSystem",
@@ -18,4 +19,6 @@ __all__ = [
     "KillMode",
     "DiskQueue",
     "KeyValueStoreMemory",
+    "BTreeKeyValueStore",
+    "open_engine",
 ]

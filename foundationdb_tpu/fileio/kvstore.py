@@ -39,6 +39,18 @@ class IKeyValueStore:
         raise NotImplementedError
 
 
+async def open_engine(engine: str, fs, process, filename: str):
+    """Engine factory (ref: openKVStore's type dispatch,
+    KeyValueStoreMemory.actor.cpp / KeyValueStoreSQLite.actor.cpp)."""
+    if engine == "memory":
+        return await KeyValueStoreMemory.open(fs, process, filename)
+    if engine == "btree":
+        from .btree import BTreeKeyValueStore
+
+        return await BTreeKeyValueStore.open(fs, process, filename)
+    raise ValueError(f"unknown storage engine {engine!r}")
+
+
 class KeyValueStoreMemory(IKeyValueStore):
     """RAM map + WAL; recovery = last snapshot + subsequent op records."""
 

@@ -66,6 +66,9 @@ class RealAsyncFile:
     async def read(self, offset: int, length: int) -> bytes:
         return os.pread(self._fd, length, offset)
 
+    def read_sync(self, offset: int, length: int) -> bytes:
+        return os.pread(self._fd, length, offset)
+
     async def write(self, offset: int, data: bytes):
         os.pwrite(self._fd, data, offset)
 

@@ -146,6 +146,13 @@ class SimAsyncFile:
         self._check_alive()
         return self._f.view()[offset : offset + length]
 
+    def read_sync(self, offset: int, length: int) -> bytes:
+        """Zero-virtual-latency page read for engines whose read path is
+        synchronous (the btree engine; the reference charges such reads to
+        coro threads that likewise block the storage actor)."""
+        self._check_alive()
+        return self._f.view()[offset : offset + length]
+
     async def write(self, offset: int, data: bytes):
         await self.fs.network.loop.delay(
             self._disk_delay(), TaskPriority.DiskWrite

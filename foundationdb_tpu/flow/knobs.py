@@ -107,11 +107,26 @@ class ServerKnobs(Knobs):
         # Fresh-cluster recruitment waits for worker registrations to stop
         # arriving for this long before choosing disk homes.
         self._init("recruitment_stabilize_window", 0.75)
-        # Ratekeeper (ref: Ratekeeper.actor.cpp knobs, distilled)
+        # Ratekeeper (ref: Ratekeeper.actor.cpp knobs, distilled).  Byte
+        # targets are sim-scaled versions of TARGET_BYTES_PER_STORAGE_SERVER
+        # / SPRING_BYTES_STORAGE_SERVER (:251-340) and the TLog equivalents.
         self._init("ratekeeper_max_tps", 100000.0)
         self._init("ratekeeper_min_tps", 10.0)
         self._init("ratekeeper_target_lag_versions", 500_000)
         self._init("ratekeeper_spring_lag_versions", 2_000_000)
+        self._init("ratekeeper_target_ss_queue_bytes", 4 << 20)
+        self._init("ratekeeper_spring_ss_queue_bytes", 2 << 20)
+        self._init("ratekeeper_target_tlog_queue_bytes", 8 << 20)
+        self._init("ratekeeper_spring_tlog_queue_bytes", 4 << 20)
+        # Disk-free spring (ref: MIN_FREE_SPACE / MIN_FREE_SPACE_RATIO):
+        # below target free bytes the rate compresses; at min it floors.
+        self._init("ratekeeper_min_free_bytes", 4 << 20)
+        self._init("ratekeeper_target_free_bytes", 16 << 20)
+        # Simulated disk capacity per machine (the sim has no real device).
+        self._init("sim_disk_capacity_bytes", 1 << 30)
+        # Batch-priority lane: same springs at this fraction of the targets
+        # (ref: the separate batch limiter with lower TARGET_BYTES_*_BATCH).
+        self._init("ratekeeper_batch_target_fraction", 0.5)
 
 
 class KnobSet:

@@ -57,6 +57,7 @@ async def load_balance(
     key_of: Callable = None,
     hedge_after: float = 0.01,
     reroute_errors=("broken_promise", "future_version"),
+    failed: Callable = None,
 ):
     """Send via the model's best replica; hedge to the runner-up if the
     first reply is slower than `hedge_after` (ref: loadBalance's
@@ -66,13 +67,21 @@ async def load_balance(
     upstream).  Raises the last error when every alternative failed."""
     loop = process.network.loop
     key_of = key_of or (lambda a: id(a))
+    # Known-failed replicas sort LAST, not out: stale failure info must
+    # never make data unreachable (ref: loadBalance consulting
+    # IFailureMonitor before picking alternatives).
+    dead = failed or (lambda a: False)
     order = (
         sorted(
             alternatives,
-            key=lambda a: (model.expected(key_of(a)), str(key_of(a))),
+            key=lambda a: (
+                bool(dead(a)),
+                model.expected(key_of(a)),
+                str(key_of(a)),
+            ),
         )
         if model
-        else list(alternatives)
+        else sorted(alternatives, key=lambda a: bool(dead(a)))
     )
     last_err = FdbError("all_alternatives_failed")
     i = 0

@@ -70,7 +70,7 @@ class SimProcess:
         self._tasks: List[Task] = []
         # Futures (reply promises) this process is waiting on, keyed by the
         # remote address expected to answer; broken on that process's death.
-        self._pending_on: Dict[str, set] = {}
+        self._pending_on: Dict[str, dict] = {}  # addr -> ordered {(<Promise>,<Endpoint>): None}
         network._register(self)
 
     # -- actor management --

@@ -68,7 +68,7 @@ class RealProcess:
         self.excluded = False
         self._endpoints: Dict[int, Callable] = {}
         self._tasks: List[Task] = []
-        self._pending_on: Dict[str, set] = {}
+        self._pending_on: Dict[str, dict] = {}  # addr -> ordered {(<Promise>,<Endpoint>): None}
         network._register(self)
 
     def spawn(self, coro, name: str = "") -> Task:

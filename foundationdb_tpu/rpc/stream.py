@@ -155,7 +155,7 @@ class RequestStreamRef:
             src.drop_endpoint(reply_ep_holder["ep"])
             pending = src._pending_on.get(self.endpoint.address)
             if pending is not None:
-                pending.discard((out, reply_ep_holder["ep"]))
+                pending.pop((out, reply_ep_holder["ep"]), None)
             if out.is_set():
                 return
             is_err, value = wire
@@ -166,9 +166,14 @@ class RequestStreamRef:
 
         reply_ep = src.make_endpoint(on_reply)
         reply_ep_holder["ep"] = reply_ep
-        src._pending_on.setdefault(self.endpoint.address, set()).add(
+        # Insertion-ordered dict-as-set, NOT a set: on process death these
+        # promises are broken by iterating this container, and a set of
+        # id-hashed tuples iterates in allocation-dependent order — which
+        # made whole-cluster kills nondeterministic across interpreter runs
+        # (found by the same-seed byte-identity check).
+        src._pending_on.setdefault(self.endpoint.address, {})[
             (out, reply_ep)
-        )
+        ] = None
         net.send_from(src, self.endpoint, _Envelope(request, reply_ep))
         return out.future
 

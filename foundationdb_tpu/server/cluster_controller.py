@@ -58,6 +58,9 @@ class ClientDBInfo:
     proxy: object = None  # ProxyInterface (first proxy; convenience)
     storage: object = None  # StorageInterface (single-shard v1)
     proxies: list = field(default_factory=list)  # all ProxyInterfaces
+    # The acting CC's failure-detector stream (ref: ClientDBInfo carrying
+    # what FailureMonitorClient needs).
+    failure_monitor: object = None
 
 
 class ClusterController:
@@ -66,13 +69,17 @@ class ClusterController:
         process: SimProcess,
         coordinators: List[CoordinatorInterface],
         conflict_backend: str = "cpu",
+        storage_engine: str = "memory",
         n_tlogs: int = 1,
         n_storages: int = 1,
         n_proxies: int = 1,
+        fs=None,  # SimFileSystem: the ratekeeper's disk-free spring
     ):
         self.process = process
         self.coordinators = coordinators
+        self.fs = fs
         self.conflict_backend = conflict_backend
+        self.storage_engine = storage_engine
         self.n_tlogs = n_tlogs
         self.n_storages = n_storages
         self.n_proxies = n_proxies
@@ -84,6 +91,13 @@ class ClusterController:
         self._register_stream = RequestStream(process, "cc_register", well_known=True)
         self._info_stream = RequestStream(process, "cc_client_info", well_known=True)
         self._recovery_needed = AsyncVar(0)  # bumped on role failure
+        # Cluster-wide failure detection (ref: failure detection :1257 +
+        # the status broadcast): fed by the leader's ping sweep below,
+        # consumed by FailureMonitorClient via ClientDBInfo.
+        from .failure_monitor import FailureDetector
+
+        self.failure_detector = FailureDetector(process)
+        process.spawn(self._failure_ping_sweep(), "cc_failure_sweep")
         change_id = process.network.loop.rng.random_int(1, 1 << 31)
         self._leader_info = LeaderInfo(
             priority=0,
@@ -294,14 +308,53 @@ class ClusterController:
                 n_proxies=n_proxies,
             ),
         )
+        # Pre-register every expected storage tag's pop floor on every log
+        # BEFORE any storage can apply+pop: otherwise a fast replica's pops
+        # trim the log below a slow/re-recruited replica's replay point
+        # before that replica's own floor registration lands — a permanent
+        # wedge (recovery retries re-init the storage at its old durable
+        # version, the log refuses peek_below_begin forever).  Confirmed
+        # (get_reply), not fire-and-forget, so the ordering is guaranteed.
+        # Retention cost is bounded by the TLog spill.  (Ref: the log
+        # system knowing its expected tags from recruitment —
+        # TagPartitionedLogSystem's epoch tag sets.)
+        from .interfaces import TLogPopRequest
+
+        for w in storage_ws:
+            tag = "ss:" + w.address.split(":")[0]
+            for tl in tlog_ifs:
+                await tl.pop.get_reply(
+                    self.process, TLogPopRequest(version=0, tag=tag)
+                )
         storage_ifs = []
         for w in storage_ws:
             storage_ifs.append(
                 await w.init_role.get_reply(
-                    self.process, InitStorage(tlog=list(tlog_ifs))
+                    self.process,
+                    InitStorage(
+                        tlog=list(tlog_ifs), engine=self.storage_engine
+                    ),
                 )
             )
         from ..flow.eventloop import wait_for_all
+
+        # Ratekeeper singleton: recruited fresh each generation on the CC
+        # process, polling the new logs/storages over RPC (ref: the CC's
+        # ratekeeper singleton recruitment; trackTLogQueueInfo /
+        # trackStorageServerQueueInfo).  The old generation's instance (if
+        # any) is retired with its actors.
+        from .ratekeeper import Ratekeeper
+
+        for t in list(self.process._tasks):
+            if t.name.endswith("rk_update") or t.name.endswith("rk_serve"):
+                t.cancel()
+        self.ratekeeper = Ratekeeper(
+            self.process,
+            tlog_ifaces=list(tlog_ifs),
+            storage_ifaces=list(storage_ifs),
+            fs=self.fs,  # enables the disk-free spring in recruited mode
+        )
+        rk_if = self.ratekeeper.interface()
 
         proxy_ifs = await wait_for_all(
             [
@@ -315,6 +368,7 @@ class ClusterController:
                         epoch=self.generation,
                         proxy_id=f"proxy{i}",
                         n_proxies=len(proxy_ws),
+                        ratekeeper=rk_if,
                     ),
                 )
                 for i, proxy_w in enumerate(proxy_ws)
@@ -438,6 +492,7 @@ class ClusterController:
                 proxy=proxy_if,
                 storage=storage_ifs[0],
                 proxies=list(proxy_ifs),
+                failure_monitor=self.failure_detector.ref(),
             )
         )
         # Watch `\xff/conf` for topology changes this generation can't
@@ -598,6 +653,27 @@ class ClusterController:
             await timeout_after(
                 loop, self._recovery_needed.on_change(), 0.5
             )
+
+    async def _failure_ping_sweep(self):
+        """Leader-only sweep: ping every registered worker on a short
+        cadence and fold the verdicts into the failure detector (ref: the
+        CC's workerAvailabilityWatch feeding failure broadcasts).  The
+        sweep never unregisters workers — recoveries do that; this is the
+        fast-path liveness signal for routing."""
+        loop = self.process.network.loop
+        while True:
+            if not self.is_leader.get():
+                await self.is_leader.on_change()
+                continue
+            for addr in sorted(self.workers):
+                wi = self.workers.get(addr)
+                if wi is None:
+                    continue
+                pong = await self._try(
+                    wi.ping.get_reply(self.process, None), timeout=0.3
+                )
+                self.failure_detector.set_state(addr, pong != "pong")
+            await loop.delay(0.5)
 
     async def _live_workers(self) -> List[WorkerInterface]:
         out = []

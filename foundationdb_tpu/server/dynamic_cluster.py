@@ -35,6 +35,7 @@ class DynamicCluster:
         n_storages: int = 1,
         n_proxies: int = 1,
         buggify: bool = True,
+        storage_engine: str = "memory",
     ):
         self.loop = loop or EventLoop(seed=seed)
         set_event_loop(self.loop)
@@ -44,6 +45,7 @@ class DynamicCluster:
         self.net = SimNetwork(self.loop)
         self.fs = SimFileSystem(self.net)
         self.conflict_backend = conflict_backend
+        self.storage_engine = storage_engine
         self.n_tlogs = n_tlogs
         self.n_storages = n_storages
         self.n_proxies = n_proxies
@@ -74,6 +76,8 @@ class DynamicCluster:
                 p,
                 self.coord_ifaces,
                 conflict_backend=self.conflict_backend,
+                storage_engine=self.storage_engine,
+                fs=self.fs,
                 n_tlogs=self.n_tlogs,
                 n_storages=self.n_storages,
                 n_proxies=self.n_proxies,

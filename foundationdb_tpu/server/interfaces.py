@@ -48,6 +48,11 @@ class CommitTransactionRequest:
     flags: int = 0
 
 
+# GRV priority flags (ref: GetReadVersionRequest::FLAG_PRIORITY_* —
+# batch-priority requests ride a tighter ratekeeper lane).
+GRV_FLAG_PRIORITY_BATCH = 1
+
+
 @dataclass
 class GetReadVersionRequest:
     transaction_count: int = 1
@@ -219,6 +224,15 @@ class TLogInterface:
     # un-acked orphan that epoch-end recovery will truncate) is never
     # applied by anyone.
     confirm: RequestStreamRef = None
+    # Ratekeeper probe (ref: TLogQueuingMetricsRequest) — durable version +
+    # in-memory queue depth.
+    metrics: RequestStreamRef = None
+
+
+@dataclass
+class TLogMetricsReply:
+    durable_version: int = 0
+    queue_bytes: int = 0
 
 
 # --- storage (ref fdbclient/StorageServerInterface.h) ---
@@ -306,12 +320,20 @@ class GetStorageMetricsRequest:
 
     begin: bytes = b""
     end: bytes = b""
+    # Ratekeeper probe: skip the O(n) byte-sample scan, return only the
+    # version/queue signals (ref: StorageQueuingMetricsRequest being a
+    # separate, cheap request in the reference).
+    signals_only: bool = False
 
 
 @dataclass
 class GetStorageMetricsReply:
     bytes: int = 0
     split_key: Optional[bytes] = None  # ~half the sampled bytes below it
+    # Ratekeeper signals (ref: StorageQueueInfo fields ride the same
+    # metrics fetch in the reference's trackStorageServerQueueInfo).
+    version: int = 0
+    queue_bytes: int = 0
 
 
 @dataclass

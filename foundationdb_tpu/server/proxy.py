@@ -100,6 +100,7 @@ class Proxy:
         # ApplyMetadataMutation's keyResolvers handling).
         self._old_bounds: List[Tuple[list, int]] = []
         self.ratekeeper = ratekeeper
+        self.last_rate_info = None  # latest RateInfo fetched by the GRV loop
         self.committed = NotifiedVersion(epoch_begin_version)
         # Authoritative key -> storage-team map, maintained by intercepting
         # keyServers/serverList metadata mutations in the commits this proxy
@@ -265,18 +266,39 @@ class Proxy:
         with one version (ref: transactionStarter draining its queue against
         the rate, MasterProxyServer.actor.cpp:934-1033)."""
         from ..flow.buggify import buggify
+        from .interfaces import GRV_FLAG_PRIORITY_BATCH
 
         loop = self.process.network.loop
         budget = 1.0
+        batch_budget = 1.0
         last_refill = loop.now()
         tps = None
+        batch_tps = None
         last_fetch = -1e9
+        deferred: list = []  # batch-priority replies awaiting lane budget
         while True:
-            _req, reply = await self._grv_stream.pop()
-            batch = [reply]
-            while self._grv_stream.is_ready():
-                _r, rep = await self._grv_stream.pop()
-                batch.append(rep)
+            if deferred and not self._grv_stream.is_ready():
+                # Deferred batch-lane work but no new arrivals: tick the
+                # budget forward instead of parking on the stream.
+                await loop.delay(0.005)
+                pairs = []
+            else:
+                req0, reply0 = await self._grv_stream.pop()
+                pairs = [(req0, reply0)]
+                while self._grv_stream.is_ready():
+                    r, rep = await self._grv_stream.pop()
+                    pairs.append((r, rep))
+            batch = [
+                rep
+                for r, rep in pairs
+                if not (r is not None and r.flags & GRV_FLAG_PRIORITY_BATCH)
+            ]
+            lane = deferred + [
+                rep
+                for r, rep in pairs
+                if r is not None and r.flags & GRV_FLAG_PRIORITY_BATCH
+            ]
+            deferred = []
             if buggify("proxy_grv_delay"):
                 # BUGGIFY: stale-but-causal read versions (the committed
                 # floor only rises) — exercises waitForVersion fast paths.
@@ -288,13 +310,19 @@ class Proxy:
                             self.process, None
                         )
                         tps = info.tps
+                        batch_tps = getattr(info, "batch_tps", info.tps)
+                        self.last_rate_info = info  # surfaced by status/qos
                     except Exception:  # noqa: BLE001 - rk down: keep old rate
                         pass
                     last_fetch = loop.now()
                 if tps is not None:
                     now = loop.now()
                     cap = max(float(len(batch)), tps * 0.1)
+                    bcap = max(1.0, batch_tps * 0.1)
                     budget = min(budget + (now - last_refill) * tps, cap)
+                    batch_budget = min(
+                        batch_budget + (now - last_refill) * batch_tps, bcap
+                    )
                     last_refill = now
                     while budget < len(batch):
                         # Floor the wait: a sub-float-resolution delay would
@@ -306,8 +334,23 @@ class Proxy:
                         )
                         now = loop.now()
                         budget = min(budget + (now - last_refill) * tps, cap)
+                        batch_budget = min(
+                            batch_budget + (now - last_refill) * batch_tps,
+                            bcap,
+                        )
                         last_refill = now
                     budget -= len(batch)
+                    # Batch lane: answer only what its budget affords NOW;
+                    # the rest stays deferred (ref: the batch-priority GRV
+                    # queue released strictly behind the default lane).
+                    afford = int(batch_budget)
+                    if afford < len(lane):
+                        deferred = lane[afford:]
+                        lane = lane[:afford]
+                    batch_budget -= len(lane)
+            batch = batch + lane
+            if not batch:
+                continue
             version = self.committed.get()
             if self.n_proxies > 1:
                 # Another proxy may have committed (and acked) beyond this

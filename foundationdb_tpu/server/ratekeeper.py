@@ -27,7 +27,12 @@ from ..rpc.stream import RequestStream, RequestStreamRef
 @dataclass
 class RateInfo:
     tps: float = 1e9
+    batch_tps: float = 1e9  # the lower-priority lane's (tighter) limit
     lag_versions: int = 0
+    worst_ss_queue_bytes: int = 0
+    worst_tlog_queue_bytes: int = 0
+    min_free_bytes: int = 1 << 62
+    limiting: str = "none"  # which signal set the rate (for status/qos)
 
 
 @dataclass
@@ -39,13 +44,20 @@ class Ratekeeper:
     def __init__(
         self,
         process: SimProcess,
-        tlogs: List[object],  # TLog role objects (sim: direct metric access)
-        storages: List[object],
-        sample_interval: float = 0.1,
+        tlogs: List[object] = (),  # TLog role objects (direct metric access)
+        storages: List[object] = (),
+        sample_interval: float = 0.25,
+        fs=None,  # SimFileSystem: enables the disk-free spring
+        tlog_ifaces: List[object] = (),  # RPC mode (recruited ratekeeper):
+        storage_ifaces: List[object] = (),  # polls metrics like the ref's
+        # trackStorageServerQueueInfo / trackTLogQueueInfo actors.
     ):
         self.process = process
-        self.tlogs = tlogs
-        self.storages = storages
+        self.tlogs = list(tlogs)
+        self.storages = list(storages)
+        self.tlog_ifaces = list(tlog_ifaces)
+        self.storage_ifaces = list(storage_ifaces)
+        self.fs = fs
         self.sample_interval = sample_interval
         self.rate = RateInfo(tps=g_knobs.server.ratekeeper_max_tps)
         self._stream = RequestStream(process, "rk_get_rate", well_known=True)
@@ -55,24 +67,131 @@ class Ratekeeper:
     def interface(self) -> RatekeeperInterface:
         return RatekeeperInterface(get_rate=self._stream.ref())
 
-    async def _update_loop(self):
-        """Ref updateRate :251-340, distilled: spring on worst version lag."""
-        loop = self.process.network.loop
+    @staticmethod
+    def _spring(x: float, target: float, spring: float) -> float:
+        """The spring: full rate up to `target`, compressing linearly to
+        zero over `spring` beyond it (ref updateRate's
+        (targetBytes - queueBytes) / springBytes shaping, :251-340)."""
+        if x <= target:
+            return 1.0
+        return max(0.0, 1.0 - (x - target) / spring)
+
+    @staticmethod
+    def _free_factor(free: float, target: float, minimum: float) -> float:
+        """Full rate while free space >= target, zero at <= minimum,
+        linear between (ref: the MIN_FREE_SPACE clamp in updateRate)."""
+        if free >= target:
+            return 1.0
+        if free <= minimum:
+            return 0.0
+        return (free - minimum) / (target - minimum)
+
+    async def _signals(self):
+        """(lag, worst_ss_queue, worst_tlog_queue, min_free_bytes) from
+        direct role objects (in-process mode) and/or RPC metric probes
+        (recruited mode — ref trackStorageServerQueueInfo :138 /
+        trackTLogQueueInfo :179)."""
+        from ..flow.error import FdbError
+        from .interfaces import GetStorageMetricsRequest
+
         srv = g_knobs.server
+        log_vs = [t.durable.get() for t in self.tlogs]
+        ss_vs = [s.version.get() for s in self.storages]
+        ss_qs = [s.queue_bytes for s in self.storages]
+        tl_qs = [getattr(t, "_mem_bytes", 0) for t in self.tlogs]
+        for tl in self.tlog_ifaces:
+            try:
+                m = await tl.metrics.get_reply(self.process, None)
+                log_vs.append(m.durable_version)
+                tl_qs.append(m.queue_bytes)
+            except FdbError:
+                continue  # unreachable log: recovery is the real handler
+        for ss in self.storage_ifaces:
+            try:
+                m = await ss.get_storage_metrics.get_reply(
+                    self.process,
+                    GetStorageMetricsRequest(signals_only=True),
+                )
+                ss_vs.append(m.version)
+                ss_qs.append(m.queue_bytes)
+            except FdbError:
+                continue
+        log_v = max(log_vs, default=0)
+        ss_v = min(ss_vs, default=log_v)
+        lag = max(0, log_v - ss_v)
+        ss_q = max(ss_qs, default=0)
+        tl_q = max(tl_qs, default=0)
+        free = 1 << 62
+        if self.fs is not None:
+            used: dict = {}
+            for (mid, _name), f in self.fs._files.items():
+                used[mid] = used.get(mid, 0) + len(f.durable)
+            # Direct-object mode knows which machines host roles; RPC mode
+            # (recruited) conservatively covers every machine with files.
+            roles = {
+                p.process.machine.machine_id
+                for p in list(self.tlogs) + list(self.storages)
+            } or set(used)
+            cap = srv.sim_disk_capacity_bytes
+            for mid in roles:
+                free = min(free, max(0, cap - used.get(mid, 0)))
+        return lag, ss_q, tl_q, free
+
+    def _limit(self, lag, ss_q, tl_q, free, target_frac: float):
+        """TPS limit for one priority lane: min over every signal's spring
+        at `target_frac` of the configured targets (the batch lane runs the
+        same springs at tighter targets — ref the separate batch limiter)."""
+        srv = g_knobs.server
+        factors = {
+            "ss_lag": self._spring(
+                lag,
+                srv.ratekeeper_target_lag_versions * target_frac,
+                srv.ratekeeper_spring_lag_versions * target_frac,
+            ),
+            "ss_queue": self._spring(
+                ss_q,
+                srv.ratekeeper_target_ss_queue_bytes * target_frac,
+                srv.ratekeeper_spring_ss_queue_bytes * target_frac,
+            ),
+            "tlog_queue": self._spring(
+                tl_q,
+                srv.ratekeeper_target_tlog_queue_bytes * target_frac,
+                srv.ratekeeper_spring_tlog_queue_bytes * target_frac,
+            ),
+            # Free space springs the other way: LOW free compresses.  The
+            # batch lane throttles EARLIER (at a higher free watermark).
+            "disk_free": self._free_factor(
+                free,
+                srv.ratekeeper_target_free_bytes / target_frac,
+                srv.ratekeeper_min_free_bytes,
+            ),
+        }
+        limiting = min(factors, key=lambda k: factors[k])
+        factor = factors[limiting]
+        tps = max(srv.ratekeeper_min_tps, srv.ratekeeper_max_tps * factor)
+        return tps, (limiting if factor < 1.0 else "none")
+
+    async def _update_loop(self):
+        """Ref updateRate :251-340: springs on worst storage queue, worst
+        tlog queue, version lag, and free disk; a separate tighter batch
+        lane."""
+        loop = self.process.network.loop
         while True:
             await loop.delay(self.sample_interval)
-            log_v = max((t.durable.get() for t in self.tlogs), default=0)
-            ss_v = min((s.version.get() for s in self.storages), default=log_v)
-            lag = max(0, log_v - ss_v)
-            target = srv.ratekeeper_target_lag_versions
-            spring = srv.ratekeeper_spring_lag_versions
-            if lag <= target:
-                factor = 1.0
-            else:
-                factor = max(0.0, 1.0 - (lag - target) / spring)
+            lag, ss_q, tl_q, free = await self._signals()
+            tps, limiting = self._limit(lag, ss_q, tl_q, free, 1.0)
+            batch_tps, _ = self._limit(
+                lag, ss_q, tl_q, free,
+                g_knobs.server.ratekeeper_batch_target_fraction,
+            )
             self.rate = RateInfo(
-                tps=max(srv.ratekeeper_min_tps, srv.ratekeeper_max_tps * factor),
+                tps=tps,
+                batch_tps=batch_tps,
                 lag_versions=lag,
+                worst_ss_queue_bytes=ss_q,
+                worst_tlog_queue_bytes=tl_q,
+                min_free_bytes=free,
+                limiting=limiting,
             )
 
     async def _serve(self):

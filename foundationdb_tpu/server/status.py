@@ -49,13 +49,26 @@ def cluster_status(cluster) -> dict:
             for name, role in w.roles.items():
                 roles.setdefault(name, []).append(w.process.address)
         cl["roles"] = roles
-        storage = next(
-            (w.roles["storage"] for w in cluster.workers if "storage" in w.roles),
-            None,
-        )
-        tlog = next(
-            (w.roles["tlog"] for w in cluster.workers if "tlog" in w.roles), None
-        )
+        # Only THIS generation's recruited roles on live processes: a
+        # spare worker can still hold a frozen role object from an earlier
+        # generation (killed+rebooted, not re-recruited), which would wedge
+        # the min-version / queue aggregates forever.
+        # _role_addrs only exists after the first recruitment completes.
+        current = set(getattr(cc, "_role_addrs", {}).values() if cc else ())
+
+        def _live_roles(name):
+            return [
+                w.roles[name]
+                for w in cluster.workers
+                if name in w.roles
+                and w.process.alive
+                and (not current or w.process.address in current)
+            ]
+
+        storages = _live_roles("storage")
+        tlogs = _live_roles("tlog")
+        storage = storages[0] if storages else None
+        tlog = tlogs[0] if tlogs else None
         proxy = next(
             (w.roles["proxy"] for w in cluster.workers if "proxy" in w.roles), None
         )
@@ -68,6 +81,8 @@ def cluster_status(cluster) -> dict:
             "storage": [cluster.storage_proc.address],
             "proxy": [cluster.proxy_proc.address],
         }
+        storages = list(getattr(cluster, "storages", []) or [cluster.storage])
+        tlogs = list(getattr(cluster, "tlogs", []) or [cluster.tlog])
         storage, tlog, proxy = cluster.storage, cluster.tlog, cluster.proxy
 
     if storage is not None:
@@ -76,11 +91,44 @@ def cluster_status(cluster) -> dict:
             "durable_version": storage.durable_version,
             "total_keys_estimate": len(storage.store.sorted_keys)
             + (storage.kvstore.count() if storage.kvstore else 0),
+            # Worst across replicas, like the reference's worst-queue rows.
+            "storage_queue_bytes": max(
+                (s.queue_bytes for s in storages), default=0
+            ),
+            # The LAGGING replica bounds the quiet gate, not the leader —
+            # but only replicas in the SERVING set count: a spare that owns
+            # no range (e.g. re-recruited after its epoch's logs were lost)
+            # has nothing to catch up to and would wedge the gate forever.
+            "storage_version_min": min(
+                (
+                    s.version.get()
+                    for s in storages
+                    if any(v for _b, _e, v in s.owned.items())
+                    or any(a for _b, _e, a in s.adding.items())
+                ),
+                default=storage.version.get(),
+            ),
+            # Fetches in flight anywhere = data is moving (ref:
+            # moving_data.in_flight_bytes).
+            "moving_shards": sum(
+                sum(1 for _b, _e, a in s.adding.items() if a)
+                for s in storages
+            ),
         }
+    if proxy is not None:
+        # Shard map depth (ref: data.partitions_count): the proxy's live
+        # keyServers routing map.
+        cl.setdefault("data", {})["partitions_count"] = len(
+            list(proxy.key_servers.items())
+        )
     if tlog is not None:
         cl["logs"] = {
-            "log_version": tlog.durable.get(),
+            "log_version": max(t.durable.get() for t in tlogs),
             "queue_length": len(tlog.versions),
+            "queue_bytes": max(
+                (getattr(t, "_mem_bytes", 0) for t in tlogs), default=0
+            ),
+            "spilled_through_version": getattr(tlog, "spilled_through", 0),
             "popped_version": tlog.popped,
         }
     if proxy is not None:
@@ -89,5 +137,64 @@ def cluster_status(cluster) -> dict:
             "committed_version": proxy.committed.get(),
         }
         rk = getattr(proxy, "ratekeeper", None)
-        cl["qos"] = {"ratekeeper_enabled": rk is not None}
+        qos = {"ratekeeper_enabled": rk is not None}
+        info = getattr(proxy, "last_rate_info", None)
+        if info is not None:
+            # Ref: the qos section's transactions_per_second_limit /
+            # performance_limited_by fields (Status.actor.cpp:1690).
+            qos["transactions_per_second_limit"] = info.tps
+            qos["batch_transactions_per_second_limit"] = getattr(
+                info, "batch_tps", info.tps
+            )
+            qos["worst_queue_bytes_storage_server"] = getattr(
+                info, "worst_ss_queue_bytes", 0
+            )
+            qos["worst_queue_bytes_log_server"] = getattr(
+                info, "worst_tlog_queue_bytes", 0
+            )
+            qos["released_transactions_behind"] = info.lag_versions
+            qos["performance_limited_by"] = getattr(info, "limiting", "none")
+        cl["qos"] = qos
     return doc
+
+
+async def quiet_database(
+    db,
+    cluster,
+    timeout_vt: float = 60.0,
+    max_storage_queue_bytes: int = 64 << 10,
+    max_lag_versions: int = 1_000_000,
+) -> None:
+    """Wait until the cluster is quiescent (ref: waitForQuietDatabase,
+    QuietDatabase.actor.cpp:371): every storage's queue drained below the
+    bound, version lag inside the bound, and no shard move in flight.
+    Chaos teardowns gate their consistency checks on this instead of fixed
+    virtual-time sleeps.  Raises TimeoutError if never quiet."""
+    loop = db.process.network.loop
+    deadline = loop.now() + timeout_vt
+    while True:
+        doc = cluster_status(cluster)
+        cl = doc["cluster"]
+        data = cl.get("data", {})
+        logs = cl.get("logs", {})
+        # Sections absent (e.g. mid-recovery, roles not yet live) is NOT
+        # quiet — the gate must never pass vacuously.
+        quiet = (
+            "storage_version_min" in data
+            and "log_version" in logs
+            and data.get("storage_queue_bytes", 0) <= max_storage_queue_bytes
+            and data.get("moving_shards", 0) == 0
+            and logs["log_version"] - data["storage_version_min"]
+            <= max_lag_versions
+        )
+        if quiet:
+            return
+        if loop.now() > deadline:
+            raise TimeoutError(
+                f"database never became quiet: queue="
+                f"{data.get('storage_queue_bytes')} moving="
+                f"{data.get('moving_shards')} lag="
+                f"{logs.get('log_version', 0) - data.get('storage_version_min', 0)}"
+                f" sections=({sorted(data)}, {sorted(logs)})"
+            )
+        await loop.delay(0.25)

@@ -27,6 +27,7 @@ from typing import Dict, List, Optional, Tuple
 
 from ..client.atomic import apply_atomic
 from ..client.types import Mutation, MutationType, key_after
+from ..fileio.kvstore import open_engine
 from ..flow.asyncvar import NotifiedVersion
 from ..flow.error import FdbError
 from ..flow.knobs import g_knobs
@@ -302,6 +303,10 @@ class StorageServer:
         self.version = NotifiedVersion(epoch_begin_version)
         self.durable_version = epoch_begin_version
         self.byte_sample = ByteSample(process.network.loop.rng)
+        # Ratekeeper signals (ref: StorageQueueInfo — bytesInput /
+        # bytesDurable; queue depth = input - durable).
+        self.input_bytes = 0
+        self.durable_bytes = 0
         if kvstore is not None:
             # Rebuild from the durable base after a restart (the reference
             # persists its byte sample for the same reason); paged so huge
@@ -375,16 +380,19 @@ class StorageServer:
         filename: str,
         storage_id: str = None,
         owned_all: bool = True,
+        engine: str = "memory",
     ):
         """Reopen the base engine and resume pulling from its durable
         version (ref: storageServer rollback/restart recovery).  Ownership
         is restored from the durable meta record; keyServers mutations in
         the replayed log tail re-apply any later changes.  A move that was
         in flight at the crash is simply absent (AddingShards are not
-        durable) — DD observes "missing" shard state and restarts it."""
-        from ..fileio.kvstore import KeyValueStoreMemory
+        durable) — DD observes "missing" shard state and restarts it.
 
-        kv = await KeyValueStoreMemory.open(fs, process, filename)
+        engine: "memory" (WAL+snapshot RAM map, KeyValueStoreMemory.
+        actor.cpp analog) or "btree" (COW B+tree, the ssd-class engine —
+        datasets exceed RAM; ref KeyValueStoreSQLite.actor.cpp's role)."""
+        kv = await open_engine(engine, fs, process, filename)
         vmeta = kv.read_value(VERSION_META_KEY)
         durable = int(vmeta.decode()) if vmeta else 0
         owned_meta = kv.read_value(OWNED_META_KEY)
@@ -556,6 +564,7 @@ class StorageServer:
                     self.version.get()
                     - g_knobs.server.max_write_transaction_life_versions,
                 )
+                self.durable_bytes = self.input_bytes  # RAM window IS durable
                 self._pop_all(self.version.get())
             elif (
                 (
@@ -606,6 +615,7 @@ class StorageServer:
                 ops.append((ver, seq, "clear", b, e))
         ops.sort(key=lambda o: (o[0], o[1]))
         for _v, _s, op, a, b in ops:
+            self.durable_bytes += len(a) + len(b) + 16
             if op == "set":
                 self.kvstore.set(a, b)
             else:
@@ -627,6 +637,12 @@ class StorageServer:
         await self.kvstore.commit()
         self.store.trim(new_durable)
         self._pop_all(new_durable)
+
+    @property
+    def queue_bytes(self) -> int:
+        """Un-durable window depth (ref: StorageQueueInfo's
+        bytesInput - bytesDurable, the ratekeeper's storage signal)."""
+        return max(0, self.input_bytes - self.durable_bytes)
 
     def _get_current(self, key: bytes, version: int) -> Optional[bytes]:
         touched, val = self.store.get_stamped(key, version)
@@ -655,6 +671,7 @@ class StorageServer:
                 ce = m.param2 if ce is None else ce
                 if v:
                     self.store.clear_range(cb, ce, version, seq)
+                    self.input_bytes += len(cb) + len(ce) + 16
                     self.byte_sample.remove_range(cb, ce)
                     cleared.append((cb, ce))
                     continue
@@ -667,6 +684,7 @@ class StorageServer:
                         shard.buffer.append((version, seq, clip))
                     else:
                         self.store.clear_range(ab, ae, version, seq)
+                        self.input_bytes += len(ab) + len(ae) + 16
                         self.byte_sample.remove_range(ab, ae)
             return
         if m.type in (MutationType.NO_OP, MutationType.DEBUG_KEY):
@@ -691,6 +709,10 @@ class StorageServer:
             existing = self._get_current(m.param1, version)
             val = apply_atomic(m.type, existing, m.param2)
             self.store.set(m.param1, val, version, seq)
+        # Ratekeeper input accounting: count exactly what enters the
+        # window (what _make_durable later folds out), so queue_bytes =
+        # input - durable measures the REAL un-durable depth.
+        self.input_bytes += len(m.param1) + len(val or b"") + 16
         if m.param1 < KEYSPACE_END:
             self.byte_sample.update(m.param1, len(m.param1) + len(val or b""))
 
@@ -832,6 +854,7 @@ class StorageServer:
                 continue
             if m.type == MutationType.CLEAR_RANGE:
                 self.store.clear_range(m.param1, m.param2, ver, seq)
+                self.input_bytes += len(m.param1) + len(m.param2) + 16
                 self.byte_sample.remove_range(m.param1, m.param2)
             else:
                 self._apply_point(m, ver, seq)
@@ -848,6 +871,7 @@ class StorageServer:
         page's sets at the same version), so retries at newer snapshots
         converge."""
         self.store.clear_range(shard.begin, shard.end, snap, 0)
+        self.input_bytes += len(shard.begin) + len(shard.end) + 16
         self.byte_sample.remove_range(shard.begin, shard.end)
         begin = shard.begin
         while True:
@@ -857,6 +881,7 @@ class StorageServer:
             )
             for k, v in rep.data:
                 self.store.set(k, v, snap, 1)
+                self.input_bytes += len(k) + len(v) + 16
                 self.byte_sample.update(k, len(k) + len(v))
             if not rep.more:
                 break
@@ -1079,11 +1104,21 @@ class StorageServer:
 
         while True:
             req, reply = await self._metrics_stream.pop()
+            if getattr(req, "signals_only", False):
+                reply.send(
+                    GetStorageMetricsReply(
+                        version=self.version.get(),
+                        queue_bytes=self.queue_bytes,
+                    )
+                )
+                continue
             end = req.end if req.end != b"" else None
             reply.send(
                 GetStorageMetricsReply(
                     bytes=self.byte_sample.bytes_in(req.begin, end),
                     split_key=self.byte_sample.split_point(req.begin, end),
+                    version=self.version.get(),
+                    queue_bytes=self.queue_bytes,
                 )
             )
 

@@ -6,7 +6,15 @@ tag's stream to storage servers, tLogPop :894 discards below the consumer
 floors.  Each entry holds {tag: [(seq, Mutation)]}; a peek returns the
 union of the requested tags per version, re-merged into commit order by
 seq (a storage subscribes to its own tag plus the broadcast tags).
-Per-tag btree spill is still TODO; unspilled data rides the DiskQueue.
+
+Spill (ref: updatePersistentData, TLogServer.actor.cpp:539): when the
+in-memory window exceeds `spill_threshold_bytes`, the oldest durable
+versions move into a per-tag btree keyspace (`t/<tag>/<version>` in a COW
+B+tree file) and the DiskQueue is popped behind them — a lagging or
+crashed-but-registered consumer bounds the log's MEMORY, not its
+correctness: peeks below the in-memory floor are served from the spill
+store.  Consumer pops clear the spilled ranges; the popped floor and the
+spill watermark persist in the spill store's meta keys.
 """
 
 from __future__ import annotations
@@ -31,6 +39,9 @@ COMMIT_DELAY = 0.0005
 
 
 class TLog:
+    SPILL_META_THROUGH = b"\x00meta/spilled_through"
+    SPILL_META_POPPED = b"\x00meta/popped"
+
     def __init__(
         self,
         process: SimProcess,
@@ -38,6 +49,9 @@ class TLog:
         disk_queue=None,
         epoch: int = 0,
         begin_version: int = 0,
+        spill_store=None,
+        spill_threshold_bytes: int = 1 << 20,
+        spill_keep_versions: int = 16,
     ):
         self.process = process
         self.epoch = epoch
@@ -61,6 +75,15 @@ class TLog:
         # (ref: per-tag popping, TLogServer.actor.cpp:894).
         self.popped_tags: dict = {}
         self.disk_queue = disk_queue  # None = in-memory (simulated fsync)
+        # -- spill state (None spill_store = memory-only log, no spill) --
+        self.spill_store = spill_store
+        self.spill_threshold_bytes = spill_threshold_bytes
+        self.spill_keep_versions = spill_keep_versions
+        self.spilled_through = 0  # all versions <= this live in spill_store
+        self._spill_gc_floor = 0  # spill rows below this are already deleted
+        self._ver_bytes: List[int] = []  # parallel to versions
+        self._mem_bytes = 0
+        self._spilling = False
         # Epoch-end lock: a locked log rejects further commits (ref: the
         # TLogLockResult protocol during recovery's LOCKING_CSTATE).
         self.locked = False
@@ -70,10 +93,14 @@ class TLog:
         self._confirm_stream = RequestStream(
             process, "tlog_confirm", well_known=True
         )
+        self._metrics_stream = RequestStream(
+            process, "tlog_metrics", well_known=True
+        )
         process.spawn(self._serve_commit(), "tlog_commit")
         process.spawn(self._serve_peek(), "tlog_peek")
         process.spawn(self._serve_pop(), "tlog_pop")
         process.spawn(self._serve_confirm(), "tlog_confirm")
+        process.spawn(self._serve_metrics(), "tlog_metrics")
 
     @classmethod
     async def recover(
@@ -90,17 +117,23 @@ class TLog:
         pushes (whose prevVersion is the recovery version) can land."""
         import pickle
 
+        from ..fileio.btree import BTreeKeyValueStore
         from ..fileio.diskqueue import DiskQueue
 
         q, records = await DiskQueue.open(fs, process, filename)
-        log = cls(process, disk_queue=q, epoch=epoch)
+        spill = await BTreeKeyValueStore.open(fs, process, filename + ".spill")
+        log = cls(process, disk_queue=q, epoch=epoch, spill_store=spill)
+        raw = spill.read_value(cls.SPILL_META_THROUGH)
+        log.spilled_through = int(raw) if raw else 0
         for _seq, payload in records:
             rec = pickle.loads(payload)
             if rec[0] == "__truncate__":
                 cut = rec[1]
                 k = bisect_right(log.versions, cut)
+                log._mem_bytes -= sum(log._ver_bytes[k:])
                 del log.versions[k:]
                 del log.entries[k:]
+                del log._ver_bytes[k:]
                 continue
             if rec[0] == "__pop__":
                 # Restore per-tag consumer floors: without them, the first
@@ -117,10 +150,22 @@ class TLog:
                     )
                 continue
             version, tagged = rec
+            if version <= log.spilled_through:
+                continue  # already persisted in the spill store
             log.versions.append(version)
             log.entries.append(tagged)
-        log.popped = q.popped_seq
-        last = log.versions[-1] if log.versions else q.popped_seq
+            log._ver_bytes.append(len(payload))
+            log._mem_bytes += len(payload)
+        if log.spilled_through > 0:
+            # Spilled data survives below the queue's popped pointer; only
+            # the spill-store floor marks what consumers really released.
+            raw_p = spill.read_value(cls.SPILL_META_POPPED)
+            log.popped = int(raw_p) if raw_p else 0
+        else:
+            log.popped = q.popped_seq
+        last = log.versions[-1] if log.versions else max(
+            q.popped_seq, log.spilled_through
+        )
         log.durable.set(max(last, fast_forward_to))
         return log
 
@@ -130,6 +175,7 @@ class TLog:
             peek=self._peek_stream.ref(),
             pop=self._pop_stream.ref(),
             confirm=self._confirm_stream.ref(),
+            metrics=self._metrics_stream.ref(),
         )
 
     async def _serve_confirm(self):
@@ -137,14 +183,58 @@ class TLog:
             _req, reply = await self._confirm_stream.pop()
             reply.send(self.durable.get())
 
+    async def _serve_metrics(self):
+        from .interfaces import TLogMetricsReply
+
+        while True:
+            _req, reply = await self._metrics_stream.pop()
+            reply.send(
+                TLogMetricsReply(
+                    durable_version=self.durable.get(),
+                    queue_bytes=self._mem_bytes,
+                )
+            )
+
     async def truncate_above(self, cut: int):
         """Epoch-end cut: discard versions > cut (never acked — acks need
         every log durable).  Durable via a marker record so a later
-        recovery does not resurrect the orphans from the disk queue."""
+        recovery does not resurrect the orphans from the disk queue.
+        The SPILL store must be purged too: spilled versions above the cut
+        would otherwise be resurrected by _peek_spilled and feed
+        rolled-back mutations to the new generation."""
+        # Exclude an in-flight spill: it could be parked at its store
+        # commit holding versions above the cut; purging before it lands
+        # would resurrect them the moment it resumes.  The log is locked at
+        # epoch end (and _spill_task bails when locked), so no new spill
+        # starts after this wait.
+        loop = self.process.network.loop
+        while self._spilling:
+            await loop.delay(0.001)
+        if self.spill_store is not None and self.spilled_through > cut:
+            # Scan the whole tag keyspace for rows above the cut (the
+            # orphan suffix is small; truncation only happens at epoch
+            # end).  Deleting + lowering the watermark is one atomic
+            # spill-store commit.
+            lo = b"t/"
+            while True:
+                page = self.spill_store.read_range(lo, b"t0", limit=512)
+                for key, _payload in page:
+                    if int.from_bytes(key[-8:], "big") > cut:
+                        self.spill_store.clear_range(key, key + b"\x00")
+                if len(page) < 512:
+                    break
+                lo = page[-1][0] + b"\x00"
+            self.spilled_through = min(self.spilled_through, cut)
+            self.spill_store.set(
+                self.SPILL_META_THROUGH, b"%d" % self.spilled_through
+            )
+            await self.spill_store.commit()
         k = bisect_right(self.versions, cut)
         if k < len(self.versions):
+            self._mem_bytes -= sum(self._ver_bytes[k:])
             del self.versions[k:]
             del self.entries[k:]
+            del self._ver_bytes[k:]
         if self.disk_queue is not None:
             import pickle
 
@@ -192,15 +282,84 @@ class TLog:
         if self.disk_queue is not None:
             import pickle
 
-            self.disk_queue.push(
-                req.version, pickle.dumps((req.version, req.tagged), protocol=4)
-            )
+            payload = pickle.dumps((req.version, req.tagged), protocol=4)
+            self._ver_bytes.append(len(payload))
+            self._mem_bytes += len(payload)
+            self.disk_queue.push(req.version, payload)
             await self.disk_queue.commit()  # real (simulated-file) fsync
         else:
+            size = 64 + sum(
+                len(m.param1) + len(m.param2) + 32
+                for items in req.tagged.values()
+                for _seq, m in items
+            )
+            self._ver_bytes.append(size)
+            self._mem_bytes += size
             await self.process.network.loop.delay(COMMIT_DELAY)  # fsync stand-in
         self.durable.set(req.version)
         self._trim()  # consumers with vacuous floors never pop again
+        if (
+            self.spill_store is not None
+            and not self._spilling
+            and self._mem_bytes > self.spill_threshold_bytes
+        ):
+            self.process.spawn(self._spill_task(), "tlog_spill")
         reply.send(req.version)
+
+    @staticmethod
+    def _spill_key(tag: str, version: int) -> bytes:
+        return b"t/" + tag.encode() + b"/" + version.to_bytes(8, "big")
+
+    async def _spill_task(self):
+        """Move the oldest durable versions into the spill store, then drop
+        them from memory and pop the DiskQueue behind them (ref:
+        updatePersistentData TLogServer.actor.cpp:539).  One instance runs
+        at a time; consumer trims racing the awaits are re-checked by
+        version value, never by index."""
+        import pickle
+
+        if self._spilling:
+            return
+        self._spilling = True
+        try:
+            while (
+                not self.locked  # epoch ended: truncate may be purging
+                and self._mem_bytes > self.spill_threshold_bytes // 2
+                and len(self.versions) > self.spill_keep_versions
+            ):
+                durable = self.durable.get()
+                n = 0
+                while (
+                    n < len(self.versions) - self.spill_keep_versions
+                    and self.versions[n] <= durable
+                    and n < 64
+                ):
+                    n += 1
+                if n == 0:
+                    return
+                cut = self.versions[n - 1]
+                for k in range(n):
+                    for tag, items in self.entries[k].items():
+                        self.spill_store.set(
+                            self._spill_key(tag, self.versions[k]),
+                            pickle.dumps(items, protocol=4),
+                        )
+                self.spill_store.set(self.SPILL_META_THROUGH, b"%d" % cut)
+                await self.spill_store.commit()
+                # Spilled data is durable: drop it from memory (recompute
+                # the index — a consumer trim may have raced the commit)
+                # and pop the WAL behind it.
+                self.spilled_through = max(self.spilled_through, cut)
+                k = bisect_right(self.versions, cut)
+                self._mem_bytes -= sum(self._ver_bytes[:k])
+                del self.versions[:k]
+                del self.entries[:k]
+                del self._ver_bytes[:k]
+                if self.disk_queue is not None:
+                    self.disk_queue.pop(cut)
+                    await self.disk_queue.commit()
+        finally:
+            self._spilling = False
 
     @classmethod
     async def fresh(
@@ -215,17 +374,21 @@ class TLog:
         Any stale file from an earlier generation on this machine is
         deleted first — recovering it would resurrect a log that MISSED the
         epochs between its death and now and silently skip mutations."""
+        from ..fileio.btree import BTreeKeyValueStore
         from ..fileio.diskqueue import DiskQueue
 
-        if fs.exists(process, filename):
-            fs.delete(process, filename)
+        for stale in (filename, filename + ".spill"):
+            if fs.exists(process, stale):
+                fs.delete(process, stale)
         q, _records = await DiskQueue.open(fs, process, filename)
+        spill = await BTreeKeyValueStore.open(fs, process, filename + ".spill")
         log = cls(
             process,
             epoch_begin_version=epoch_begin,
             disk_queue=q,
             epoch=epoch,
             begin_version=epoch_begin,
+            spill_store=spill,
         )
         return log
 
@@ -246,6 +409,12 @@ class TLog:
             # BUGGIFY: tiny peek pages force the has_more continuation path
             # in every consumer (ref: buggified reply size limits).
             limit = 2 if buggify("tlog_peek_truncate") else req.limit_versions
+            if (
+                self.spill_store is not None
+                and req.begin_version < self.spilled_through
+            ):
+                reply.send(self._peek_spilled(req, limit))
+                continue
             i = bisect_right(self.versions, req.begin_version)
             j = min(i + limit, len(self.versions))
             # Only durable versions are visible to peeks.
@@ -273,6 +442,46 @@ class TLog:
                 )
             )
 
+    def _peek_spilled(self, req: TLogPeekRequest, limit: int) -> TLogPeekReply:
+        """Serve a peek whose begin is below the in-memory floor from the
+        spill store (ref: the persistentData read path of
+        tLogPeekMessages).  Per-tag scans each fetch their first `limit`
+        versions; any version inside the merged first `limit` is therefore
+        complete across tags."""
+        import pickle
+
+        by_ver: Dict[int, Dict[int, object]] = {}
+        for tag in req.tags:
+            lo = self._spill_key(tag, req.begin_version + 1)
+            hi = self._spill_key(tag, self.spilled_through + 1)
+            # limit+1: a tag returning exactly `limit` rows must still be
+            # detected as possibly-incomplete (truncated ⇒ has_more).
+            for k, payload in self.spill_store.read_range(
+                lo, hi, limit=limit + 1
+            ):
+                v = int.from_bytes(k[-8:], "big")
+                d = by_ver.setdefault(v, {})
+                for seq, m in pickle.loads(payload):
+                    d[seq] = m
+        vers = sorted(by_ver)
+        truncated = len(vers) > limit
+        vers = vers[:limit]
+        out = [
+            (v, [m for _s, m in sorted(by_ver[v].items())]) for v in vers
+        ]
+        if truncated:
+            end = vers[-1]
+            more = True
+        else:
+            end = self.spilled_through
+            more = bool(self.versions)
+        return TLogPeekReply(
+            entries=out,
+            end_version=end,
+            known_committed=self.known_committed,
+            has_more=more,
+        )
+
     def _trim(self):
         """Discard below the min consumer floor (ref tLogPop :894).  Capped
         at the durable watermark: vacuous floors (1<<60, from storages that
@@ -284,11 +493,34 @@ class TLog:
         if floor > self.popped:
             self.popped = floor
             k = bisect_right(self.versions, floor)
+            self._mem_bytes -= sum(self._ver_bytes[:k])
             del self.versions[:k]
             del self.entries[:k]
+            del self._ver_bytes[:k]
             if self.disk_queue is not None:
                 # Persisted with the next commit (lazy, like the ref).
                 self.disk_queue.pop(floor)
+            # Only while spilled rows can still exist below the floor: the
+            # no-spill case (and a fully-GC'd spill) must not pay a btree
+            # commit per floor advance forever.
+            if (
+                self.spill_store is not None
+                and self.spilled_through > 0
+                and self._spill_gc_floor < self.spilled_through
+            ):
+                self.process.spawn(self._spill_gc(floor), "tlog_spill_gc")
+
+    async def _spill_gc(self, floor: int):
+        """Delete spilled data below the global consumer floor and persist
+        the floor (one atomic spill-store commit).  Lazily lagging is safe:
+        a crash rolls the floor back, the log merely retains more."""
+        for tag in list(self.popped_tags) or []:
+            self.spill_store.clear_range(
+                self._spill_key(tag, 0), self._spill_key(tag, floor + 1)
+            )
+        self.spill_store.set(self.SPILL_META_POPPED, b"%d" % floor)
+        await self.spill_store.commit()
+        self._spill_gc_floor = max(self._spill_gc_floor, floor)
 
     async def _serve_pop(self):
         import pickle

@@ -82,6 +82,7 @@ class FastForwardTLog:
 @dataclass
 class InitStorage:
     tlog: object = None  # TLogInterface or List[TLogInterface]
+    engine: str = "memory"  # "memory" | "btree" (ref: openKVStore dispatch)
 
 
 @dataclass
@@ -93,6 +94,7 @@ class InitProxy:
     epoch: int = 0
     proxy_id: str = "proxy0"
     n_proxies: int = 1
+    ratekeeper: object = None  # RatekeeperInterface
 
 
 class WorkerServer:
@@ -227,7 +229,11 @@ class WorkerServer:
                     reply.send(role.durable.get())
             elif isinstance(req, InitStorage):
                 role = await StorageServer.recover(
-                    self.process, req.tlog, self.fs, "storage.dq"
+                    self.process,
+                    req.tlog,
+                    self.fs,
+                    "storage.dq" if req.engine == "memory" else "storage.bt",
+                    engine=req.engine,
                 )
                 self._replace_role("storage", role, new_tasks())
                 reply.send(role.interface())
@@ -241,6 +247,7 @@ class WorkerServer:
                     epoch=req.epoch,
                     proxy_id=req.proxy_id,
                     n_proxies=req.n_proxies,
+                    ratekeeper=req.ratekeeper,
                 )
                 self._replace_role("proxy", role, new_tasks())
                 reply.send(role.interface())

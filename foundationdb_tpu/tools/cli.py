@@ -152,9 +152,34 @@ class CliProcessor:
             + ", ".join(f"{r}x{len(a)}" for r, a in sorted(cl["roles"].items())),
         ]
         if "data" in cl:
+            d = cl["data"]
+            if "storage_version" in d:  # absent while no storage role lives
+                lines.append(
+                    f"  Storage          - version {d['storage_version']}, "
+                    f"~{d.get('total_keys_estimate', 0)} keys, "
+                    f"queue {d.get('storage_queue_bytes', 0)}B"
+                )
             lines.append(
-                f"  Storage          - version {cl['data']['storage_version']}, "
-                f"~{cl['data']['total_keys_estimate']} keys"
+                f"  Shards           - {d.get('partitions_count', 1)} "
+                f"({d.get('moving_shards', 0)} moving)"
+            )
+        if "logs" in cl:
+            lg = cl["logs"]
+            lines.append(
+                f"  Logs             - version {lg['log_version']}, "
+                f"queue {lg['queue_bytes']}B"
+                + (
+                    f", spilled through {lg['spilled_through_version']}"
+                    if lg.get("spilled_through_version")
+                    else ""
+                )
+            )
+        if "qos" in cl and "transactions_per_second_limit" in cl["qos"]:
+            q = cl["qos"]
+            lines.append(
+                f"  Ratekeeper       - limit {q['transactions_per_second_limit']:.0f} tps"
+                f" (batch {q['batch_transactions_per_second_limit']:.0f}), "
+                f"limited by: {q['performance_limited_by']}"
             )
         if "workload" in cl:
             t = cl["workload"]["transactions"]

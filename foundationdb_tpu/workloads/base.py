@@ -30,10 +30,16 @@ def run_workloads(
     cluster,
     workloads: List[TestWorkload],
     timeout_vt: float = 10000.0,
+    quiet: bool = False,
 ):
     """Drive the phases like runTest (tester.actor.cpp:778): setups
     sequentially, starts concurrently (chaos overlaps load), checks
-    sequentially; every check must return True."""
+    sequentially; every check must return True.
+
+    quiet=True waits for quiescence between start and check (ref:
+    waitForQuietDatabase before the trailing consistency check,
+    tester.actor.cpp:819 / QuietDatabase.actor.cpp:371) instead of relying
+    on fixed virtual-time margins inside the checks."""
     from ..flow.eventloop import all_of
 
     db = cluster.database("tester")
@@ -47,6 +53,13 @@ def run_workloads(
         for wl in workloads
     ]
     cluster.run_until(all_of(tasks), timeout_vt=timeout_vt)
+    if quiet:
+        from ..server.status import quiet_database
+
+        cluster.run_until(
+            db.process.spawn(quiet_database(db, cluster), "quiet_database"),
+            timeout_vt=timeout_vt,
+        )
     for wl in workloads:
         ok = cluster.run_until(
             db.process.spawn(wl.check(db, cluster), f"check:{wl.name}"),

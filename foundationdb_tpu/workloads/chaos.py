@@ -66,6 +66,14 @@ class AttritionWorkload(TestWorkload):
             cluster.fs.crash_machine(proc.machine.machine_id)
             proc.reboot()
             w = WorkerServer(proc, cluster.fs)
+            # Replace the dead worker in the cluster's bookkeeping: stale
+            # WorkerServer objects hold FROZEN role instances (e.g. a
+            # storage whose version never advances again), which would
+            # poison any aggregate read off cluster.workers (status,
+            # quiet_database).
+            cluster.workers = [
+                x for x in cluster.workers if x.process is not proc
+            ] + [w]
             leader_var = AsyncVar(None)
             proc.spawn(
                 monitor_leader(proc, cluster.coord_ifaces, leader_var),

@@ -42,6 +42,31 @@ ATOMIC_OPS = [
 ]
 
 
+def model_get_key(db: Dict[bytes, bytes], sel: KeySelector) -> bytes:
+    """KeySelector resolution against a model dict, matching the client's
+    documented semantics: index into the sorted key list at (first key
+    {>|>=} sel.key) + offset - 1; b"" before the front, b"\\xff" past the
+    end (ref: memoryGetKey WriteDuringRead.actor.cpp:118).  Shared by the
+    WriteDuringRead and FuzzApi oracles so selector semantics cannot
+    drift between them."""
+    import bisect
+
+    keys = sorted(db)
+    start = key_after(sel.key) if sel.or_equal else sel.key
+    idx = bisect.bisect_left(keys, start) + sel.offset - 1
+    if idx < 0:
+        return b""
+    if idx >= len(keys):
+        return b"\xff"
+    return keys[idx]
+
+
+def clamp_to_prefix(key: bytes, prefix: bytes) -> bytes:
+    """Clamp a resolved key into a workload's prefix span, the way the
+    reference clamps to its node range (WriteDuringRead.actor.cpp:148)."""
+    return min(max(key, prefix), prefix + b"\xff")
+
+
 class WriteDuringReadWorkload(TestWorkload):
     name = "write_during_read"
 
@@ -105,20 +130,7 @@ class WriteDuringReadWorkload(TestWorkload):
         return db.get(key)
 
     def _model_get_key(self, db: Dict[bytes, bytes], sel: KeySelector) -> bytes:
-        """KeySelector resolution against the model, matching the client's
-        documented semantics: index into the sorted key list at (first key
-        {>|>=} sel.key) + offset - 1; b"" before the front, b"\\xff" past
-        the end (ref: memoryGetKey WriteDuringRead.actor.cpp:118)."""
-        keys = sorted(db)
-        start = key_after(sel.key) if sel.or_equal else sel.key
-        import bisect
-
-        idx = bisect.bisect_left(keys, start) + sel.offset - 1
-        if idx < 0:
-            return b""
-        if idx >= len(keys):
-            return b"\xff"
-        return keys[idx]
+        return model_get_key(db, sel)
 
     def _model_get_range(
         self,
@@ -133,31 +145,42 @@ class WriteDuringReadWorkload(TestWorkload):
             keys = keys[::-1]
         return [(k, db[k]) for k in keys[:limit]]
 
-    # --- op coroutines: model computed BEFORE the first await ---
-    async def _op_get(self, tr, rng):
+    # --- op coroutines ---
+    # Every op starts with a random stagger so writes land WHILE reads are
+    # awaiting storage (the whole point of the workload).  After the
+    # stagger, a read computes its expected value from the model and issues
+    # the db read in the SAME task step (no await between) — matching the
+    # client's issue-time RYW snapshot; a write updates the model and the
+    # transaction atomically at its own issue point.
+    async def _stagger(self, loop, rng):
+        await loop.delay(rng.random01() * 0.003)
+
+    async def _op_get(self, tr, rng, loop):
+        await self._stagger(loop, rng)
         key = self._rand_key(rng)
         want = self._model_get(self.memory_db, key)
         got = await tr.get(key)
         if got != want:
             self._fail(f"get({key!r}): db={got!r} model={want!r}")
 
-    async def _op_get_key(self, tr, rng):
+    async def _op_get_key(self, tr, rng, loop):
+        await self._stagger(loop, rng)
         sel = self._rand_selector(rng)
         want = self._model_get_key(self.memory_db, sel)
         got = await tr.get_key(sel)
         # Keys outside the workload's prefix belong to other subsystems:
         # clamp both sides the way the reference clamps to its node range
         # (WriteDuringRead.actor.cpp:148 res > getKeyForIndex(nodes)).
-        lo, hi = self.prefix, self.prefix + b"\xff"
-        want = min(max(want, lo), hi)
-        got = min(max(got, lo), hi)
+        want = clamp_to_prefix(want, self.prefix)
+        got = clamp_to_prefix(got, self.prefix)
         if got != want:
             self._fail(
                 f"get_key({sel.key!r},{sel.or_equal},{sel.offset}): "
                 f"db={got!r} model={want!r}"
             )
 
-    async def _op_get_range(self, tr, rng):
+    async def _op_get_range(self, tr, rng, loop):
+        await self._stagger(loop, rng)
         begin, end = self._rand_range(rng)
         limit = (
             1 << 30
@@ -174,23 +197,27 @@ class WriteDuringReadWorkload(TestWorkload):
                 f"first diff {next((p for p in zip(got, want) if p[0] != p[1]), None)}"
             )
 
-    def _op_set(self, tr, rng):
+    async def _op_set(self, tr, rng, loop):
+        await self._stagger(loop, rng)
         key, value = self._rand_key(rng), self._rand_value(rng)
         self.memory_db[key] = value
         tr.set(key, value)
 
-    def _op_clear(self, tr, rng):
+    async def _op_clear(self, tr, rng, loop):
+        await self._stagger(loop, rng)
         key = self._rand_key(rng)
         self.memory_db.pop(key, None)
         tr.clear(key)
 
-    def _op_clear_range(self, tr, rng):
+    async def _op_clear_range(self, tr, rng, loop):
+        await self._stagger(loop, rng)
         begin, end = self._rand_range(rng)
         for k in [k for k in self.memory_db if begin <= k < end]:
             del self.memory_db[k]
         tr.clear_range(begin, end)
 
-    def _op_atomic(self, tr, rng):
+    async def _op_atomic(self, tr, rng, loop):
+        await self._stagger(loop, rng)
         op = ATOMIC_OPS[int(rng.random_int(0, len(ATOMIC_OPS)))]
         key, operand = self._rand_key(rng), self._rand_value(rng)
         new = apply_atomic(op, self.memory_db.get(key), operand)
@@ -233,24 +260,25 @@ class WriteDuringReadWorkload(TestWorkload):
             tr.set(self.marker, marker_val)
             self.memory_db[self.marker] = marker_val
             try:
+                loop = cluster.loop
                 for _wave in range(self.waves_per_txn):
                     ops = []
                     for _ in range(self.ops_per_wave):
                         r = rng.random01()
                         if r < 0.18:
-                            ops.append(self._op_get(tr, rng))
+                            ops.append(self._op_get(tr, rng, loop))
                         elif r < 0.30:
-                            ops.append(self._op_get_key(tr, rng))
+                            ops.append(self._op_get_key(tr, rng, loop))
                         elif r < 0.48:
-                            ops.append(self._op_get_range(tr, rng))
+                            ops.append(self._op_get_range(tr, rng, loop))
                         elif r < 0.66:
-                            self._op_set(tr, rng)
+                            ops.append(self._op_set(tr, rng, loop))
                         elif r < 0.76:
-                            self._op_clear(tr, rng)
+                            ops.append(self._op_clear(tr, rng, loop))
                         elif r < 0.84:
-                            self._op_clear_range(tr, rng)
+                            ops.append(self._op_clear_range(tr, rng, loop))
                         else:
-                            self._op_atomic(tr, rng)
+                            ops.append(self._op_atomic(tr, rng, loop))
                     if ops:
                         await all_of(
                             [proc.spawn(o, "wdr_op") for o in ops]

@@ -121,3 +121,63 @@ def test_cli_configure_exclude_include():
     assert run("include") == ["Included"]
     assert run("exclude") == ["Excluded: (none)"]
     assert run("configure bogus") == ["ERROR: expected name=value, got `bogus'"]
+
+
+def test_status_qos_and_logs_sections():
+    """qos/data/logs depth (ref Status.actor.cpp:1690): ratekeeper limits,
+    queue bytes, shard counts surface in the doc and the cli rendering."""
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.server.ratekeeper import Ratekeeper
+    from foundationdb_tpu.server.status import cluster_status
+    from foundationdb_tpu.tools.cli import CliProcessor
+
+    c = SimCluster(seed=71)
+    rk = Ratekeeper(c.master_proc, [c.tlog], [c.storage])
+    c.proxy.ratekeeper = rk.interface()
+    db = c.database()
+
+    async def drive():
+        for i in range(5):
+            tr = db.create_transaction()
+            tr.set(b"s%d" % i, b"v")
+            await tr.commit()
+        await c.loop.delay(0.3)  # rk sample + proxy rate fetch
+
+    c.run_all([(db, drive())], timeout_vt=100.0)
+    doc = cluster_status(c)
+    cl = doc["cluster"]
+    assert "storage_queue_bytes" in cl["data"]
+    assert cl["data"]["partitions_count"] >= 1
+    assert cl["logs"]["queue_bytes"] >= 0
+    assert cl["qos"]["ratekeeper_enabled"]
+    assert cl["qos"]["transactions_per_second_limit"] > 0
+    assert "performance_limited_by" in cl["qos"]
+
+    cli = CliProcessor(c, db)
+    out = c.run_until(
+        db.process.spawn(cli._cmd_status([]), "st"), timeout_vt=100.0
+    )
+    text = "\n".join(out)
+    assert "Ratekeeper" in text and "Shards" in text and "Logs" in text
+    set_event_loop(None)
+
+
+def test_quiet_database_waits_for_drain():
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.server.status import quiet_database
+
+    c = SimCluster(seed=72)
+    db = c.database()
+
+    async def drive():
+        for i in range(10):
+            tr = db.create_transaction()
+            tr.set(b"q%d" % i, b"v" * 50)
+            await tr.commit()
+        await quiet_database(db, c, timeout_vt=30.0)
+        # Quiet means queue drained and nothing moving.
+        assert c.storage.queue_bytes <= 64 << 10
+        return True
+
+    assert c.run_all([(db, drive())], timeout_vt=1000.0)[0]
+    set_event_loop(None)

@@ -66,3 +66,141 @@ def test_rate_drops_when_storage_lags():
         assert rk.rate.tps < 100000.0  # throttled below max
     finally:
         g_knobs.server.ratekeeper_max_tps = old
+
+
+def test_queue_bytes_signal_throttles():
+    """Storage queue bytes (input - durable) alone must compress the rate
+    (ref: TARGET_BYTES_PER_STORAGE_SERVER spring, Ratekeeper.actor.cpp
+    :251-340) — version lag stays small, the byte spring does the work."""
+    old_t = g_knobs.server.ratekeeper_target_ss_queue_bytes
+    old_s = g_knobs.server.ratekeeper_spring_ss_queue_bytes
+    g_knobs.server.ratekeeper_target_ss_queue_bytes = 2_000
+    g_knobs.server.ratekeeper_spring_ss_queue_bytes = 2_000
+    c, rk, old = make_rated_cluster(63, max_tps=100000.0)
+    try:
+        db = c.database()
+
+        async def writes():
+            for i in range(6):
+                tr = db.create_transaction()
+                tr.set(b"big%02d" % i, b"x" * 400)
+                await tr.commit()
+            await c.loop.delay(0.1)  # last write applied
+            # Freeze the apply loop (so it stops re-marking everything
+            # durable) and inject a queue depth; version lag stays 0, so
+            # only the byte spring can be the limiter.
+            for t in list(c.storage_proc._tasks):
+                if "ss_update" in t.name:
+                    t.cancel()
+            c.storage.input_bytes = c.storage.durable_bytes + 10_000
+            await c.loop.delay(0.4)  # two rk samples
+
+        c.run_all([(db, writes())], timeout_vt=100.0)
+        assert rk.rate.worst_ss_queue_bytes > 2_000
+        assert rk.rate.tps < 100000.0
+        assert rk.rate.limiting == "ss_queue"
+        # The batch lane is throttled at least as hard.
+        assert rk.rate.batch_tps <= rk.rate.tps
+    finally:
+        g_knobs.server.ratekeeper_max_tps = old
+        g_knobs.server.ratekeeper_target_ss_queue_bytes = old_t
+        g_knobs.server.ratekeeper_spring_ss_queue_bytes = old_s
+
+
+def test_batch_priority_lane_throttles_first():
+    """At moderate pressure the default lane keeps most of its rate while
+    the batch lane compresses (ref: the separate batch limiter with lower
+    targets)."""
+    old_t = g_knobs.server.ratekeeper_target_lag_versions
+    old_s = g_knobs.server.ratekeeper_spring_lag_versions
+    c, rk, old = make_rated_cluster(64, max_tps=1000.0)
+    try:
+        # Construct moderate lag: above the batch target (frac*target) but
+        # below the default target.
+        g_knobs.server.ratekeeper_target_lag_versions = 1000
+        g_knobs.server.ratekeeper_spring_lag_versions = 1000
+        lag = 1400  # batch target 500, spring 500 -> batch heavily cut
+        tps, limiting = rk._limit(lag, 0, 0, 1 << 62, 1.0)
+        btps, _ = rk._limit(lag, 0, 0, 1 << 62, 0.5)
+        assert tps > 0.5 * 1000.0  # default lane mostly open
+        assert btps < tps  # batch lane strictly behind
+        assert limiting == "ss_lag"
+    finally:
+        g_knobs.server.ratekeeper_max_tps = old
+        g_knobs.server.ratekeeper_target_lag_versions = old_t
+        g_knobs.server.ratekeeper_spring_lag_versions = old_s
+
+
+def test_batch_priority_grv_deferred_under_throttle():
+    """End-to-end: with the batch lane throttled hard (as under pressure),
+    batch-priority GRVs are deferred while the default lane flows."""
+    from foundationdb_tpu.server.ratekeeper import RateInfo
+
+    c, rk, old = make_rated_cluster(65, max_tps=100000.0)
+    try:
+        # Pin the lanes: default effectively open, batch ~30 tps.
+        for t in list(c.master_proc._tasks):
+            if "rk_update" in t.name:
+                t.cancel()
+        rk.rate = RateInfo(tps=100000.0, batch_tps=30.0)
+        db = c.database()
+        done = {"default": [], "batch": []}
+
+        async def default_client():
+            for _ in range(10):
+                tr = db.create_transaction()
+                await tr.get_read_version()
+                done["default"].append(c.loop.now())
+
+        async def batch_client():
+            for _ in range(10):
+                tr = db.create_transaction()
+                tr.options["priority_batch"] = True
+                await tr.get_read_version()
+                done["batch"].append(c.loop.now())
+
+        c.run_all(
+            [(db, default_client()), (db, batch_client())], timeout_vt=200.0
+        )
+        assert len(done["default"]) == 10 and len(done["batch"]) == 10
+        # Default lane unthrottled; the batch lane paced at ~30 tps must
+        # take >= ~0.2s of virtual time and finish well after the default.
+        assert done["batch"][-1] - done["batch"][0] >= 0.15
+        assert done["default"][-1] < done["batch"][-1]
+    finally:
+        g_knobs.server.ratekeeper_max_tps = old
+
+
+def test_saturation_stays_inside_mvcc_window():
+    """The 'Done' criterion: a write-saturation burst with a lagging
+    storage holds the lag inside the MVCC window — clients see no
+    transaction_too_old storm — while sustaining most of the unthrottled
+    commit throughput."""
+    from foundationdb_tpu.flow.error import FdbError
+
+    c, rk, old = make_rated_cluster(66, max_tps=100000.0)
+    try:
+        db = c.database()
+        stats = {"committed": 0, "too_old": 0}
+
+        async def writer(wid):
+            for i in range(25):
+                tr = db.create_transaction()
+                try:
+                    # Read-modify-write: the read can hit too_old if the
+                    # MVCC window is overrun.
+                    await tr.get(b"sat%02d" % wid)
+                    tr.set(b"sat%02d" % wid, b"%d" % i)
+                    await tr.commit()
+                    stats["committed"] += 1
+                except FdbError as e:
+                    if e.name == "transaction_too_old":
+                        stats["too_old"] += 1
+                    else:
+                        await tr.on_error(e)
+
+        c.run_all([(db, writer(w)) for w in range(4)], timeout_vt=300.0)
+        assert stats["committed"] >= 90  # most of 100 commits landed
+        assert stats["too_old"] <= 5, stats
+    finally:
+        g_knobs.server.ratekeeper_max_tps = old

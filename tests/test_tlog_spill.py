@@ -1,0 +1,226 @@
+"""TLog spill: bounded memory under consumer backlog.
+
+Ref: TLogServer.actor.cpp:539 updatePersistentData — old unpopped tag data
+moves from the in-memory window (and the DiskQueue) into a per-tag durable
+btree; a lagging consumer bounds the log's RAM, not its correctness.
+"""
+
+import pickle
+
+import pytest
+
+from foundationdb_tpu.client.types import Mutation, MutationType
+from foundationdb_tpu.fileio import SimFileSystem
+from foundationdb_tpu.flow import EventLoop, set_event_loop
+from foundationdb_tpu.rpc import SimNetwork
+from foundationdb_tpu.server.interfaces import (
+    TLogCommitRequest,
+    TLogPeekRequest,
+    TLogPopRequest,
+)
+from foundationdb_tpu.server.tlog import TLog
+
+
+def make_env(seed):
+    loop = EventLoop(seed=seed)
+    set_event_loop(loop)
+    net = SimNetwork(loop)
+    fs = SimFileSystem(net)
+    return loop, net, fs
+
+
+def _mut(i):
+    return Mutation(MutationType.SET_VALUE, b"k%06d" % i, b"v" * 100)
+
+
+async def _push(log_iface, proc, version, prev, tagged):
+    return await log_iface.commit.get_reply(
+        proc,
+        TLogCommitRequest(
+            version=version, prev_version=prev, tagged=tagged, epoch=0
+        ),
+    )
+
+
+@pytest.mark.parametrize("seed", [1, 2])
+def test_spill_bounds_memory_and_serves_backlog(seed):
+    """300 commits against a 20KB spill threshold with a consumer that
+    never pops: memory stays bounded near the threshold while EVERY version
+    remains peekable (old ones from the spill store), in order, intact."""
+    loop, net, fs = make_env(seed)
+    proc = net.process("tlog")
+    client = net.process("client")
+    state = {}
+
+    async def run():
+        log = await TLog.fresh(proc, fs, "t.dq")
+        log.spill_threshold_bytes = 20_000
+        log.spill_keep_versions = 8
+        iface = log.interface()
+        n = 300
+        for v in range(1, n + 1):
+            tagged = {"ss0": [(0, _mut(v))]}
+            await _push(iface, client, v, v - 1, tagged)
+        # Let the spill task drain.
+        for _ in range(200):
+            if log._mem_bytes <= log.spill_threshold_bytes and not log._spilling:
+                break
+            await loop.delay(0.01)
+        state["mem_bytes"] = log._mem_bytes
+        state["mem_versions"] = len(log.versions)
+        state["spilled_through"] = log.spilled_through
+        assert log.spilled_through > 0, "spill never engaged"
+        assert log._mem_bytes <= log.spill_threshold_bytes, (
+            f"memory unbounded: {log._mem_bytes}"
+        )
+        assert len(log.versions) < n // 2
+
+        # The lagging consumer now reads EVERYTHING from version 0.
+        got = []
+        begin = 0
+        while True:
+            rep = await iface.peek.get_reply(
+                client,
+                TLogPeekRequest(begin_version=begin, tags=["ss0"]),
+            )
+            for v, muts in rep.entries:
+                got.append((v, muts))
+            if rep.end_version <= begin and not rep.entries:
+                break
+            begin = max(rep.end_version, begin)
+            if begin >= n and not rep.has_more:
+                break
+        assert [v for v, _m in got] == list(range(1, n + 1))
+        assert all(
+            m[0].param1 == b"k%06d" % v for v, m in got
+        ), "spilled mutation payloads corrupted"
+        state["ok"] = True
+
+    loop.run_until(proc.spawn(run()), timeout_vt=5000.0)
+    assert state.get("ok")
+    set_event_loop(None)
+
+
+def test_spill_survives_crash_recovery():
+    """Spill, then SIGKILL the machine: recovery must serve the full
+    history — spilled prefix from the btree, suffix from the queue."""
+    loop, net, fs = make_env(11)
+    proc = net.process("tlog")
+    client = net.process("client")
+    state = {}
+
+    async def writer():
+        log = await TLog.fresh(proc, fs, "t.dq")
+        log.spill_threshold_bytes = 10_000
+        log.spill_keep_versions = 4
+        iface = log.interface()
+        for v in range(1, 121):
+            await _push(iface, client, v, v - 1, {"ss0": [(0, _mut(v))]})
+        for _ in range(200):
+            if not log._spilling and log._mem_bytes <= log.spill_threshold_bytes:
+                break
+            await loop.delay(0.01)
+        assert log.spilled_through > 0
+        state["spilled_through"] = log.spilled_through
+
+    loop.run_until(proc.spawn(writer()), timeout_vt=5000.0)
+    proc.kill()
+    fs.crash_machine("tlog")
+    proc.reboot()
+
+    async def recover():
+        log = await TLog.recover(proc, fs, "t.dq")
+        assert log.spilled_through == state["spilled_through"]
+        assert log.durable.get() == 120
+        iface = log.interface()
+        got = []
+        begin = 0
+        while begin < 120:
+            rep = await iface.peek.get_reply(
+                client, TLogPeekRequest(begin_version=begin, tags=["ss0"])
+            )
+            got.extend(v for v, _m in rep.entries)
+            begin = max(rep.end_version, begin + (0 if rep.entries else 1))
+        assert got == list(range(1, 121))
+        state["ok"] = True
+
+    loop.run_until(proc.spawn(recover()), timeout_vt=5000.0)
+    assert state.get("ok")
+    set_event_loop(None)
+
+
+def test_pop_clears_spilled_data():
+    """Consumer pops release spilled ranges: after popping everything, the
+    spill store's tag range is empty (storage reclaimed, ref tLogPop)."""
+    loop, net, fs = make_env(21)
+    proc = net.process("tlog")
+    client = net.process("client")
+    state = {}
+
+    async def run():
+        log = await TLog.fresh(proc, fs, "t.dq")
+        log.spill_threshold_bytes = 10_000
+        log.spill_keep_versions = 4
+        iface = log.interface()
+        for v in range(1, 101):
+            await _push(iface, client, v, v - 1, {"ss0": [(0, _mut(v))]})
+        for _ in range(200):
+            if not log._spilling:
+                break
+            await loop.delay(0.01)
+        assert log.spilled_through > 0
+        await iface.pop.get_reply(
+            client, TLogPopRequest(version=100, tag="ss0")
+        )
+        for _ in range(100):
+            await loop.delay(0.01)
+        left = log.spill_store.read_range(b"t/", b"t0", limit=10)
+        assert left == [], f"spilled rows survived the pop: {left[:3]}"
+        state["ok"] = True
+
+    loop.run_until(proc.spawn(run()), timeout_vt=5000.0)
+    assert state.get("ok")
+    set_event_loop(None)
+
+
+def test_truncate_above_purges_spill():
+    """Epoch-end truncation must purge spilled versions above the cut —
+    otherwise _peek_spilled resurrects rolled-back mutations into the new
+    generation (regression test for exactly that bug)."""
+    loop, net, fs = make_env(31)
+    proc = net.process("tlog")
+    client = net.process("client")
+    state = {}
+
+    async def run():
+        log = await TLog.fresh(proc, fs, "t.dq")
+        log.spill_threshold_bytes = 10_000
+        log.spill_keep_versions = 4
+        iface = log.interface()
+        for v in range(1, 101):
+            await _push(iface, client, v, v - 1, {"ss0": [(0, _mut(v))]})
+        for _ in range(200):
+            if not log._spilling:
+                break
+            await loop.delay(0.01)
+        assert log.spilled_through > 60, log.spilled_through
+        cut = 60
+        await log.truncate_above(cut)
+        assert log.spilled_through == cut
+        # Nothing above the cut may surface from any peek path.
+        got = []
+        begin = 0
+        while begin < cut:
+            rep = await iface.peek.get_reply(
+                client, TLogPeekRequest(begin_version=begin, tags=["ss0"])
+            )
+            got.extend(v for v, _m in rep.entries)
+            begin = max(rep.end_version, begin + (0 if rep.entries else 1))
+        assert got == list(range(1, cut + 1))
+        rows = log.spill_store.read_range(b"t/", b"t0")
+        assert all(int.from_bytes(k[-8:], "big") <= cut for k, _ in rows)
+        state["ok"] = True
+
+    loop.run_until(proc.spawn(run()), timeout_vt=5000.0)
+    assert state.get("ok")
+    set_event_loop(None)

@@ -88,6 +88,7 @@ def test_randomized_chaos_sweep(seed):
             checker,
         ],
         timeout_vt=20000.0,
+        quiet=True,  # gate the consistency check on quiescence
     )
 
 
