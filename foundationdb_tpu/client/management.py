@@ -23,7 +23,20 @@ CONF_END = b"\xff/conf0"
 EXCLUDED_PREFIX = b"\xff/conf/excluded/"
 EXCLUDED_END = b"\xff/conf/excluded0"
 
-_INT_KEYS = ("proxies", "resolvers", "logs", "storage_team_size")
+_INT_KEYS = (
+    "proxies",
+    "resolvers",
+    "logs",
+    "storage_team_size",
+    # Multi-region (ref: the region configuration in DatabaseConfiguration
+    # — usable_regions=2 keeps a second region's replica set; satellites
+    # are the synchronous full-stream logs in the primary region that make
+    # remote failover lossless).  Recorded in `\xff/conf` like the
+    # reference; SimCluster(n_satellite_tlogs=..) + LogRouter build the
+    # topology these knobs describe.
+    "usable_regions",
+    "satellite_logs",
+)
 
 
 def conf_key(name: str) -> bytes:

@@ -10,8 +10,9 @@ storage), takes an initial range snapshot, then tails the log and applies
 each version's user-keyspace mutations to the destination in one
 transaction.
 
-v1 scope: a single source log (SimCluster's default); the tag-partitioned
-multi-log merge cursor arrives with multi-region log routers.
+Multi-log sources ride a MergePeekCursor over the tag-partitioned log
+set (ref: the merged peek cursors DatabaseBackupAgent's log workers use);
+single-log sources are just the 1-wide case.
 """
 
 from __future__ import annotations
@@ -20,7 +21,7 @@ from typing import List, Optional
 
 from ..client.types import MutationType, key_after
 from ..flow.error import FdbError
-from ..server.interfaces import TLogPeekRequest, TLogPopRequest
+from ..server.interfaces import TLogPopRequest
 
 DR_TAG = "_dr"
 SNAPSHOT_PAGE = 1000
@@ -39,16 +40,13 @@ DR_STATE_KEY = b"\xff/dr/state"
 
 class DRAgent:
     def __init__(self, src_db, dst_db, src_tlogs: List, tag: str = DR_TAG):
-        assert len(src_tlogs) == 1, (
-            "v1 tails a single source log; multi-log merge cursors arrive "
-            "with log routers"
-        )
         self.src_db = src_db
         self.dst_db = dst_db
-        self.tlog = src_tlogs[0]
+        self.tlogs = list(src_tlogs)
         self.tag = tag
         self.applied = 0  # source version the destination reflects
         self._storage_tags: List[str] = []
+        self._cursor = None  # MergePeekCursor, (re)built on tag changes
         self.stopped = False
 
     async def start(self) -> int:
@@ -57,20 +55,16 @@ class DRAgent:
         discarded before tailing begins (ref: the backup range lock before
         the initial snapshot)."""
         proc = self.src_db.process
-        await self.tlog.pop.get_reply(
-            proc, TLogPopRequest(version=0, tag=self.tag)
-        )
+        await self._pop_all(0)
         await self._refresh_tags()
         # Resume: a previous incarnation that finished its snapshot left
         # applied/state markers, and its pop floor is PERSISTED on the
-        # source log, so the stream since then is still retained — tail
+        # source logs, so the stream since then is still retained — tail
         # from the marker instead of re-copying everything.
         resume = await self._read_progress()
         if resume is not None:
             self.applied = resume
-            await self.tlog.pop.get_reply(
-                proc, TLogPopRequest(version=resume, tag=self.tag)
-            )
+            await self._pop_all(resume)
             return resume
         # Snapshot at one source read version (pages share it; a too-old
         # snapshot restarts fresh, same discipline as the file backup).
@@ -85,10 +79,15 @@ class DRAgent:
                     raise
         self.applied = version
         await self._mark_applied(version, state=b"tailing")
-        await self.tlog.pop.get_reply(
-            proc, TLogPopRequest(version=version, tag=self.tag)
-        )
+        await self._pop_all(version)
         return version
+
+    async def _pop_all(self, version: int):
+        proc = self.src_db.process
+        for tl in self.tlogs:
+            await tl.pop.get_reply(
+                proc, TLogPopRequest(version=version, tag=self.tag)
+            )
 
     async def _read_progress(self) -> Optional[int]:
         async def txn(tr):
@@ -123,7 +122,10 @@ class DRAgent:
             )
             return [sk.server_list_id(k) for k, _v in rows]
 
-        self._storage_tags = await self.src_db.run(txn)
+        fresh = await self.src_db.run(txn)
+        if set(fresh) - set(self._storage_tags):
+            self._cursor = None  # widened tag set: rebuild from `applied`
+        self._storage_tags = sorted(set(self._storage_tags) | set(fresh))
 
     async def _copy_snapshot(self, tr, version: int):
         # Mark the destination INVALID for the whole multi-transaction
@@ -151,25 +153,40 @@ class DRAgent:
                 return
             lo = key_after(rows[-1][0])
 
-    async def tail_once(self) -> int:
-        """Peek the source log past `applied` and apply each version's
-        user-keyspace mutations to the destination in ONE transaction (the
-        prefix-consistency guarantee).  Returns versions applied."""
-        proc = self.src_db.process
-        before = self.applied
-        rep = await self.tlog.peek.get_reply(
-            proc,
-            TLogPeekRequest(
-                begin_version=self.applied,
+    def _get_cursor(self):
+        """The merge cursor over every source log for the current tag set;
+        rebuilt (from `applied`) whenever the tag set widens — or whenever
+        the cursor ran ahead of `applied` (a tail_once that raised or was
+        cancelled mid-batch): reusing it would silently skip the versions
+        in (applied, cursor.begin]."""
+        from ..rpc.peek_cursor import MergePeekCursor
+
+        if self._cursor is not None and self._cursor.begin != self.applied:
+            self._cursor = None
+        if self._cursor is None:
+            self._cursor = MergePeekCursor(
+                self.src_db.process,
+                self.tlogs,
                 tags=self._tags(),
+                begin=self.applied,
                 limit_versions=64,
-            ),
-        )
+            )
+        return self._cursor
+
+    async def tail_once(self) -> int:
+        """Pull the merged source stream past `applied` and apply each
+        version's user-keyspace mutations to the destination in ONE
+        transaction (the prefix-consistency guarantee).  Returns versions
+        applied."""
+        before = self.applied
+        cursor = self._get_cursor()
+        entries, horizon = await cursor.next_batch()
         n = 0
         new_tag = False
-        for version, mutations in rep.entries:
+        for version, bundle in entries:
             if version <= self.applied:
                 continue
+            mutations = cursor.flatten(bundle)
             from ..client.types import ATOMIC_TYPES
             from ..server import system_keys as sk
 
@@ -215,18 +232,18 @@ class DRAgent:
             self.applied = version
             n += 1
             if new_tag:
-                # Later versions in THIS reply may be missing the new
-                # tag's bundles: re-peek with the widened tag set.
+                # Later versions in THIS batch may be missing the new
+                # tag's bundles: rebuild the cursor from `applied` with
+                # the widened tag set.
+                self._cursor = None
                 break
-        # end_version is the last SCANNED version — safe to adopt even
-        # mid-backlog (has_more): versions below it carrying none of our
-        # tags would otherwise wedge the window forever.
-        if not new_tag and rep.end_version > self.applied:
-            self.applied = rep.end_version
+        # The merged horizon is known-complete — safe to adopt even
+        # mid-backlog: versions below it carrying none of our tags would
+        # otherwise wedge the window forever.
+        if not new_tag and horizon > self.applied:
+            self.applied = horizon
         if self.applied > before:
-            await self.tlog.pop.get_reply(
-                proc, TLogPopRequest(version=self.applied, tag=self.tag)
-            )
+            await self._pop_all(self.applied)
         return n
 
     def _tags(self) -> List[str]:

@@ -1,0 +1,102 @@
+"""Merge peek cursors over the tag-partitioned log set.
+
+Ref: fdbserver/LogSystemPeekCursor.actor.cpp — ServerPeekCursor reads one
+tag from one log with failover; MergedPeekCursor combines the cursors of
+every log holding the tag set, emitting versions in order only once every
+contributing log has reported past them (the known-complete horizon).
+Consumers: log routers pulling a full stream, DR agents tailing
+multi-log sources, and any reader whose tags span several logs.
+
+The rebuild merges RAW TAGGED bundles (version -> {tag: [(seq, m)]}),
+deduping replicated bundles by tag, so the output can be re-served
+per-tag (a router) or flattened to commit order (DR).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from ..flow.error import FdbError
+from ..server.interfaces import TLogPeekRequest
+
+
+class MergePeekCursor:
+    """Pull-merge over `logs` for `tags` (None = every tag).
+
+    next_batch() returns (entries, end_version):
+      entries: [(version, {tag: [(seq, mutation)]})] ascending, complete
+               through end_version;
+      end_version: the merged known-complete horizon (min over logs) —
+               versions <= it carrying none of the tags simply don't
+               appear.  A log that answers peek_below_begin or dies makes
+               the cursor raise; the caller re-resolves topology (ref:
+               the cursor invalidation on epoch end)."""
+
+    def __init__(
+        self,
+        process,
+        logs: List,
+        tags: Optional[List[str]] = None,
+        begin: int = 0,
+        limit_versions: int = 256,
+    ):
+        self.process = process
+        self.logs = list(logs)
+        self.tags = None if tags is None else list(tags)
+        self.begin = begin  # all versions <= begin already consumed
+        self.limit = limit_versions
+        # Per-log buffered entries + per-log scanned horizon.
+        self._buf: List[Dict[int, dict]] = [{} for _ in self.logs]
+        self._horizon: List[int] = [begin for _ in self.logs]
+        self.known_committed = 0
+
+    async def next_batch(self) -> Tuple[list, int]:
+        from ..flow.eventloop import wait_for_all
+
+        async def pull(i: int):
+            log = self.logs[i]
+            rep = await log.peek.get_reply(
+                self.process,
+                TLogPeekRequest(
+                    # Each log resumes from ITS OWN scanned horizon — a fast
+                    # log's buffered entries above the merge horizon are not
+                    # re-transferred while a slow log catches up.
+                    begin_version=max(self.begin, self._horizon[i]),
+                    tags=self.tags,
+                    limit_versions=self.limit,
+                    raw_tagged=True,
+                ),
+            )
+            for version, bundle in rep.entries:
+                if version > self.begin:
+                    self._buf[i][version] = bundle
+            self._horizon[i] = max(self._horizon[i], rep.end_version)
+            self.known_committed = max(
+                self.known_committed, rep.known_committed
+            )
+
+        await wait_for_all(
+            [self.process.spawn(pull(i), f"merge_pull{i}") for i in range(len(self.logs))]
+        )
+        horizon = min(self._horizon)
+        merged: Dict[int, Dict[str, list]] = {}
+        for buf in self._buf:
+            for version in [v for v in buf if v <= horizon]:
+                bundle = buf.pop(version)
+                out = merged.setdefault(version, {})
+                for tag, items in bundle.items():
+                    out.setdefault(tag, items)  # replica bundles identical
+        entries = [(v, merged[v]) for v in sorted(merged)]
+        if horizon > self.begin:
+            self.begin = horizon
+        return entries, self.begin
+
+    @staticmethod
+    def flatten(bundle: Dict[str, list]) -> list:
+        """One version's {tag: [(seq, m)]} -> commit-ordered [mutations]
+        (dedupe across tags by seq, like a single log's merged peek)."""
+        by_seq: Dict[int, object] = {}
+        for items in bundle.values():
+            for seq, m in items:
+                by_seq[seq] = m
+        return [m for _s, m in sorted(by_seq.items())]

@@ -38,6 +38,9 @@ class SimCluster:
         n_tlogs: int = 1,
         n_proxies: int = 1,
         buggify: bool = True,
+        n_satellite_tlogs: int = 0,  # extra logs carrying EVERY tag,
+        # synchronously in the commit ack set (ref: satellite TLogs;
+        # the remote region's zero-loss recovery source)
     ):
         self.loop = loop or EventLoop(seed=seed)
         set_event_loop(self.loop)
@@ -57,9 +60,15 @@ class SimCluster:
             for i in range(n_resolvers)
         ]
         self.resolver_proc = self.resolver_procs[0]
+        self.n_satellite_tlogs = n_satellite_tlogs
         self.tlog_procs = [
             self.net.process(f"tlog{i}" if i else "tlog")
             for i in range(n_tlogs)
+        ] + [
+            # Satellites on their own machines (a different DC in spirit;
+            # the sim fabric treats machines uniformly).
+            self.net.process(f"satlog{i}")
+            for i in range(n_satellite_tlogs)
         ]
         self.tlog_proc = self.tlog_procs[0]
         self.storage_procs = [
@@ -81,6 +90,7 @@ class SimCluster:
             assert n_resolvers == 1, "durable multi-resolver: use DynamicCluster"
             assert n_storages == 1, "durable multi-storage: use DynamicCluster"
             assert n_tlogs == 1, "durable multi-tlog: use DynamicCluster"
+            assert n_satellite_tlogs == 0, "satellites: non-durable SimCluster"
             self.fs = SimFileSystem(self.net)
             self._start_roles_durable(epoch_begin=0)
         else:
@@ -106,6 +116,7 @@ class SimCluster:
                     tlog_ifaces,
                     storage_id=f"ss{i}",
                     owned_all=(i == 0),
+                    n_route_logs=n_tlogs,  # satellites excluded from placement
                 )
                 for i, p in enumerate(self.storage_procs)
             ]
@@ -119,6 +130,7 @@ class SimCluster:
                     resolver_split_keys=self.split_keys,
                     proxy_id=f"proxy{i}",
                     n_proxies=n_proxies,
+                    n_satellites=n_satellite_tlogs,
                 )
                 for i, p in enumerate(self.proxy_procs)
             ]

@@ -166,11 +166,22 @@ class DataDistributor:
                 return
             if "missing" in states:
                 # The destination lost the in-flight move (crash): restart
-                # it by rewriting the startMove record.
+                # it by rewriting the startMove record — AND the serverList
+                # entries, because a destination that rejoined fresh at the
+                # current version never saw the original serverList writes
+                # and cannot resolve its fetch sources without them (ref:
+                # the serverListKeys rows re-read by fetchKeys).
                 b2, e2, team, dest = await self._shard_at(begin)
                 if dest:
                     async def restart(tr, b2=b2, e2=e2, team=team, dest=dest):
                         tr.options["access_system_keys"] = True
+                        for sid in set(team) | set(dest):
+                            iface = self.storages.get(sid)
+                            if iface is not None:
+                                tr.set(
+                                    sk.server_list_key(sid),
+                                    sk.encode_server_entry(iface),
+                                )
                         tr.set(
                             sk.key_servers_key(b2),
                             sk.encode_key_servers(team, dest, e2),

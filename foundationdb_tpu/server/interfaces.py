@@ -183,11 +183,20 @@ TAG_DEFAULT = "_default"
 @dataclass
 class TLogPeekRequest:
     """Peek the union of `tags` (ref tLogPeekMessages :946; a storage
-    subscribes to its own tag + the broadcast tags)."""
+    subscribes to its own tag + the broadcast tags).
+
+    tags=None subscribes to EVERY tag (a log router pulling the full
+    stream).  raw_tagged=True returns entries as (version, {tag: [(seq,
+    mutation)]}) instead of the merged (version, [mutations]) — the form a
+    router needs to re-serve arbitrary tag subsets downstream; it also
+    lets merge cursors dedupe across replicas by (tag, seq)."""
 
     begin_version: int = 0
-    tags: List[str] = field(default_factory=lambda: [TAG_DEFAULT, TAG_ALL])
+    tags: Optional[List[str]] = field(
+        default_factory=lambda: [TAG_DEFAULT, TAG_ALL]
+    )
     limit_versions: int = 1000
+    raw_tagged: bool = False
 
 
 @dataclass

@@ -74,6 +74,9 @@ class Proxy:
         system_map=None,  # recovered ([(b, e, [ids])], {id: StorageInterface})
         proxy_id: str = "proxy0",
         n_proxies: int = 1,
+        n_satellites: int = 0,  # trailing logs that receive EVERY tag (ref:
+        # satellite TLogs in the primary region — synchronous, in the ack
+        # set, carrying the full stream for remote-region recovery)
     ):
         self.process = process
         self.epoch = epoch
@@ -100,6 +103,7 @@ class Proxy:
         # ApplyMetadataMutation's keyResolvers handling).
         self._old_bounds: List[Tuple[list, int]] = []
         self.ratekeeper = ratekeeper
+        self.n_satellites = n_satellites
         self.last_rate_info = None  # latest RateInfo fetched by the GRV loop
         self.committed = NotifiedVersion(epoch_begin_version)
         # Authoritative key -> storage-team map, maintained by intercepting
@@ -614,9 +618,14 @@ class Proxy:
         # policy-selected tlog subsets); every log gets every version so
         # the prevVersion chain holds.  Durable when ALL acked.
         n = len(self.tlogs)
+        routing_n = n - self.n_satellites  # tag placement over regular logs
         per_log: List[dict] = [{} for _ in range(n)]
         for tag, muts in tagged.items():
-            for li in tlogs_for_tag(tag, n):
+            for li in tlogs_for_tag(tag, routing_n):
+                per_log[li][tag] = muts
+            # Satellites carry every tag (the full stream, synchronously
+            # in the ack set — the remote region's recovery source).
+            for li in range(routing_n, n):
                 per_log[li][tag] = muts
         await wait_for_all(
             [

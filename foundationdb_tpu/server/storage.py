@@ -267,10 +267,15 @@ class StorageServer:
         storage_id: str = None,
         owned_all: bool = True,
         meta=None,
+        n_route_logs: int = None,  # tag placement spans the first N logs
+        # (the rest are satellites: in the ack/confirm set, not consumed)
     ):
         self.process = process
         self.tlogs: List[TLogInterface] = (
             list(tlog) if isinstance(tlog, (list, tuple)) else [tlog]
+        )
+        self.n_route_logs = (
+            len(self.tlogs) if n_route_logs is None else n_route_logs
         )
         self.store = VersionedStore()
         self.kvstore = kvstore
@@ -342,7 +347,7 @@ class StorageServer:
 
         self._my_logs = [
             self.tlogs[i]
-            for i in tlogs_for_tag(self.storage_id, len(self.tlogs))
+            for i in tlogs_for_tag(self.storage_id, self.n_route_logs)
         ]
         self._tags = [self.storage_id, TAG_DEFAULT, TAG_ALL]
         self._kc_cache = epoch_begin_version  # last all-logs-confirmed min

@@ -422,8 +422,22 @@ class TLog:
             j = min(j, durable_end)
             out = []
             for k in range(i, j):
+                tags = (
+                    list(self.entries[k])  # None = subscribe to everything
+                    if req.tags is None
+                    else req.tags
+                )
+                if getattr(req, "raw_tagged", False):
+                    bundle = {
+                        t: list(self.entries[k][t])
+                        for t in tags
+                        if t in self.entries[k]
+                    }
+                    if bundle:
+                        out.append((self.versions[k], bundle))
+                    continue
                 by_seq: Dict[int, object] = {}
-                for tag in req.tags:
+                for tag in tags:
                     for seq, m in self.entries[k].get(tag, ()):
                         by_seq[seq] = m  # dedupe: a mutation may ride 2 tags
                 if by_seq:
@@ -442,6 +456,19 @@ class TLog:
                 )
             )
 
+    def _spill_tag_list(self) -> List[str]:
+        """Tags present in the spill store, discovered by prefix hops."""
+        tags = []
+        lo = b"t/"
+        while True:
+            page = self.spill_store.read_range(lo, b"t0", limit=1)
+            if not page:
+                return tags
+            key = page[0][0]
+            tag = key[2:-9].decode()  # t/<tag>/<8-byte version>
+            tags.append(tag)
+            lo = b"t/" + tag.encode() + b"/\xff\xff\xff\xff\xff\xff\xff\xff\xff"
+
     def _peek_spilled(self, req: TLogPeekRequest, limit: int) -> TLogPeekReply:
         """Serve a peek whose begin is below the in-memory floor from the
         spill store (ref: the persistentData read path of
@@ -450,8 +477,13 @@ class TLog:
         complete across tags."""
         import pickle
 
+        req_tags = (
+            self._spill_tag_list() if req.tags is None else req.tags
+        )
+        raw = getattr(req, "raw_tagged", False)
+        by_ver_tagged: Dict[int, Dict[str, list]] = {}
         by_ver: Dict[int, Dict[int, object]] = {}
-        for tag in req.tags:
+        for tag in req_tags:
             lo = self._spill_key(tag, req.begin_version + 1)
             hi = self._spill_key(tag, self.spilled_through + 1)
             # limit+1: a tag returning exactly `limit` rows must still be
@@ -460,15 +492,21 @@ class TLog:
                 lo, hi, limit=limit + 1
             ):
                 v = int.from_bytes(k[-8:], "big")
+                items = pickle.loads(payload)
+                if raw:
+                    by_ver_tagged.setdefault(v, {})[tag] = items
                 d = by_ver.setdefault(v, {})
-                for seq, m in pickle.loads(payload):
+                for seq, m in items:
                     d[seq] = m
         vers = sorted(by_ver)
         truncated = len(vers) > limit
         vers = vers[:limit]
-        out = [
-            (v, [m for _s, m in sorted(by_ver[v].items())]) for v in vers
-        ]
+        if raw:
+            out = [(v, by_ver_tagged[v]) for v in vers if by_ver_tagged.get(v)]
+        else:
+            out = [
+                (v, [m for _s, m in sorted(by_ver[v].items())]) for v in vers
+            ]
         if truncated:
             end = vers[-1]
             more = True
