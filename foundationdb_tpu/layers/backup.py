@@ -6,13 +6,18 @@ tasks, write row pages into a backup container, and chain continuation
 tasks until the manifest completes; restore replays the container in
 batched transactions.
 
-Rebuild scope (documented deviations): the snapshot is taken at ONE read
-version carried through every page task, so the restored image is a true
-point-in-time snapshot; if the version falls out of the MVCC window
-mid-backup (transaction_too_old), the backup RESTARTS at a fresh version
-instead of stitching a mutation log over fuzzy range reads (the
-reference's mutation-log machinery arrives with DR).  The container is a
-directory of pickled page files on the cluster's simulated filesystem.
+Two backup modes:
+- FileBackupAgent: one-shot snapshot at a single read version (restarts
+  on transaction_too_old) — the simple image copy.
+- ContinuousBackupAgent: snapshot + CONTINUOUS mutation log — registers
+  a consumer tag on the source logs (like the reference's `\\xff/backupLog`
+  stream feeding log files), tails the merged stream into log-chunk
+  files, and supports point-in-time restore at ANY version between the
+  snapshot and the last logged chunk (ref: FileBackupAgent's range dumps
+  + mutation logs stitched by applyMutations at restore).
+
+The container is a directory of pickled page/log files on the cluster's
+simulated filesystem (the BlobStore stand-in).
 """
 
 from __future__ import annotations
@@ -38,24 +43,25 @@ class BackupContainer:
         self.path = path
         self._n = 0
 
-    async def write_page(self, index: int, begin: bytes, rows) -> str:
-        name = f"{self.path}/range-{index:06d}"
+    async def _write_blob(self, name: str, obj) -> str:
+        """Length-prefixed pickled blob, synced (the twin of _read_blob)."""
         f = self.fs.open(self.process, name)
-        blob = pickle.dumps((begin, rows), protocol=4)
+        blob = pickle.dumps(obj, protocol=4)
         await f.write(0, len(blob).to_bytes(8, "big") + blob)
         await f.sync()
         return name
 
+    async def write_page(self, index: int, begin: bytes, rows) -> str:
+        return await self._write_blob(
+            f"{self.path}/range-{index:06d}", (begin, rows)
+        )
+
     async def write_manifest(
         self, version: int, pages: int, begin: bytes = b"", end: bytes = b"\xff"
     ):
-        f = self.fs.open(self.process, f"{self.path}/manifest")
-        blob = pickle.dumps(
-            {"version": version, "pages": pages, "begin": begin, "end": end},
-            protocol=4,
+        await self.write_manifest2(
+            {"version": version, "pages": pages, "begin": begin, "end": end}
         )
-        await f.write(0, len(blob).to_bytes(8, "big") + blob)
-        await f.sync()
 
     async def _read_blob(self, name: str):
         f = self.fs.open(self.process, name)
@@ -75,6 +81,24 @@ class BackupContainer:
 
     async def read_page(self, index: int):
         return await self._read_blob(f"{self.path}/range-{index:06d}")
+
+    # -- mutation-log files (ref: the logs/ half of BackupContainer) --
+    async def write_log_chunk(self, index: int, begin_ver: int,
+                              end_ver: int, entries) -> str:
+        """entries: [(version, [Mutation])], versions in (begin_ver,
+        end_ver]."""
+        return await self._write_blob(
+            f"{self.path}/log-{index:06d}", (begin_ver, end_ver, entries)
+        )
+
+    async def read_log_chunk(self, index: int):
+        return await self._read_blob(f"{self.path}/log-{index:06d}")
+
+    async def write_manifest2(self, manifest: dict):
+        """Full-dict manifest writer (continuous backups update it after
+        every durable log chunk so the container is restorable at any
+        moment)."""
+        await self._write_blob(f"{self.path}/manifest", manifest)
 
 
 class FileBackupAgent:
@@ -193,29 +217,207 @@ class FileBackupAgent:
         manifest = await container.read_manifest()
         if manifest is None:
             raise FdbError("file_not_found")
-        # Clear the target range first so the result IS the snapshot image,
-        # not a merge with whatever was written since (ref: restore clearing
-        # restoreRange before applying).
-        async def clear_txn(tr):
-            tr.clear_range(
-                manifest.get("begin", b""), manifest.get("end", b"\xff")
+        return await apply_snapshot_image(
+            self.db, container, manifest, batch_rows
+        )
+
+
+async def apply_snapshot_image(
+    db, container: BackupContainer, manifest: dict, batch_rows: int = 500
+) -> int:
+    """Clear the target range and replay the snapshot pages — the shared
+    first half of both restore paths (ref: restore clearing restoreRange
+    before applying the range files)."""
+
+    async def clear_txn(tr):
+        tr.clear_range(manifest.get("begin", b""), manifest.get("end", b"\xff"))
+
+    await db.run(clear_txn)
+    rows_restored = 0
+    for i in range(manifest["pages"]):
+        pg = await container.read_page(i)
+        if pg is None:
+            raise FdbError("file_corrupt")
+        _begin, rows = pg
+        for off in range(0, max(len(rows), 1), batch_rows):
+            chunk = rows[off : off + batch_rows]
+
+            async def txn(tr, chunk=chunk):
+                for k, v in chunk:
+                    tr.set(k, v)
+
+            if chunk:
+                await db.run(txn)
+                rows_restored += len(chunk)
+    return rows_restored
+
+
+class ContinuousBackupAgent:
+    """Snapshot + continuous mutation log -> point-in-time restore.
+
+    Ref: the FileBackupAgent's full shape (FileBackupAgent.actor.cpp):
+    range dumps at a snapshot version PLUS log files carrying every later
+    mutation (the reference taps `\xff/backupLog` written by the proxies;
+    the rebuild registers a consumer tag and tails the tag-partitioned
+    logs through a MergePeekCursor — same stream, pull instead of tap).
+    Restore at version V: apply the snapshot image, then every logged
+    mutation in (snapshot_version, V], in version order, one transaction
+    per version batch (applyMutations' discipline)."""
+
+    def __init__(self, db, fs, src_tlogs, container: BackupContainer,
+                 tag: str = "_backup"):
+        self.db = db
+        self.fs = fs
+        self.tlogs = list(src_tlogs)
+        self.container = container
+        self.tag = tag
+        self.snapshot_version = 0
+        self.logged_through = 0
+        self._chunks = 0  # log chunk files written
+        self._cursor = None
+        self.stopped = False
+
+    async def _pop_all(self, version: int):
+        from ..server.interfaces import TLogPopRequest
+
+        for tl in self.tlogs:
+            await tl.pop.get_reply(
+                self.db.process, TLogPopRequest(version=version, tag=self.tag)
             )
 
-        await self.db.run(clear_txn)
-        rows_restored = 0
-        for i in range(manifest["pages"]):
-            pg = await container.read_page(i)
-            if pg is None:
+    async def start(self, begin: bytes = b"", end: bytes = b"\xff") -> int:
+        """Register the log floor, then write the snapshot pages at one
+        version; the mutation log tails from that version."""
+        await self._pop_all(0)
+        while True:
+            tr = self.db.create_transaction()
+            version = await tr.get_read_version()
+            try:
+                pages = 0
+                lo = begin
+                while True:
+                    rows = await tr.get_range(
+                        lo, end, limit=PAGE_ROWS, snapshot=True
+                    )
+                    await self.container.write_page(pages, lo, rows)
+                    pages += 1
+                    if len(rows) < PAGE_ROWS:
+                        break
+                    lo = key_after(rows[-1][0])
+                break
+            except FdbError as e:
+                if e.name != "transaction_too_old":
+                    raise
+        self.snapshot_version = version
+        self.logged_through = version
+        await self._write_manifest(begin, end, pages)
+        await self._pop_all(version)
+        return version
+
+    async def _write_manifest(self, begin: bytes, end: bytes, pages: int):
+        self._pages = pages
+        self._begin, self._end = begin, end
+        await self.container.write_manifest2(
+            {
+                "version": self.snapshot_version,
+                "pages": pages,
+                "begin": begin,
+                "end": end,
+                "log_chunks": self._chunks,
+                "logged_through": self.logged_through,
+            }
+        )
+
+    async def tail_once(self) -> int:
+        """Pull the merged stream past logged_through into one durable log
+        chunk; returns versions captured."""
+        from ..rpc.peek_cursor import MergePeekCursor
+
+        if self._cursor is not None and self._cursor.begin != self.logged_through:
+            self._cursor = None
+        if self._cursor is None:
+            self._cursor = MergePeekCursor(
+                self.db.process,
+                self.tlogs,
+                tags=None,  # the full stream: no tag discovery needed
+                begin=self.logged_through,
+                limit_versions=128,
+            )
+        entries, horizon = await self._cursor.next_batch()
+        flat = [
+            (v, self._cursor.flatten(bundle))
+            for v, bundle in entries
+            if v > self.logged_through
+        ]
+        if not flat and horizon <= self.logged_through:
+            return 0
+        if flat:
+            await self.container.write_log_chunk(
+                self._chunks, self.logged_through, horizon, flat
+            )
+            self._chunks += 1
+        self.logged_through = max(self.logged_through, horizon)
+        await self._write_manifest(self._begin, self._end, self._pages)
+        await self._pop_all(self.logged_through)
+        return len(flat)
+
+    async def run(self, poll: float = 0.05):
+        loop = self.db.process.network.loop
+        while not self.stopped:
+            n = await self.tail_once()
+            if n == 0:
+                await loop.delay(poll)
+
+    async def restore(self, target_version: int = None,
+                      batch_rows: int = 500) -> int:
+        """Point-in-time restore: snapshot image + logged mutations
+        through `target_version` (default: everything logged).  Returns
+        the restore version actually applied."""
+        from ..client.types import ATOMIC_TYPES, MutationType
+
+        manifest = await self.container.read_manifest()
+        if manifest is None:
+            raise FdbError("file_not_found")
+        snap_v = manifest["version"]
+        logged = manifest.get("logged_through", snap_v)
+        target = logged if target_version is None else target_version
+        if not (snap_v <= target <= logged):
+            raise FdbError("restore_invalid_version")
+        begin, end = manifest.get("begin", b""), manifest.get("end", b"\xff")
+        uend = min(end, b"\xff")  # user-keyspace bound
+        await apply_snapshot_image(self.db, self.container, manifest, batch_rows)
+
+        def in_scope(m):
+            if m.type == MutationType.CLEAR_RANGE:
+                # A clear whose RANGE overlaps the backup bounds applies
+                # (clamped both sides) even when its start key is below
+                # `begin` — dropping it would resurrect deleted keys.
+                return m.param1 < uend and m.param2 > begin
+            return begin <= m.param1 < uend
+
+        # Mutation-log replay in version order through the target.
+        for ci in range(manifest.get("log_chunks", 0)):
+            chunk = await self.container.read_log_chunk(ci)
+            if chunk is None:
                 raise FdbError("file_corrupt")
-            _begin, rows = pg
-            for off in range(0, max(len(rows), 1), batch_rows):
-                chunk = rows[off : off + batch_rows]
+            _bv, _ev, entries = chunk
+            for version, mutations in entries:
+                if version <= snap_v or version > target:
+                    continue
+                user = [m for m in mutations if in_scope(m)]
+                if not user:
+                    continue
 
-                async def txn(tr, chunk=chunk):
-                    for k, v in chunk:
-                        tr.set(k, v)
+                async def apply(tr, user=user):
+                    for m in user:
+                        if m.type == MutationType.SET_VALUE:
+                            tr.set(m.param1, m.param2)
+                        elif m.type == MutationType.CLEAR_RANGE:
+                            tr.clear_range(
+                                max(m.param1, begin), min(m.param2, uend)
+                            )
+                        elif m.type in ATOMIC_TYPES:
+                            tr.atomic_op(m.type, m.param1, m.param2)
 
-                if chunk:
-                    await self.db.run(txn)
-                    rows_restored += len(chunk)
-        return rows_restored
+                await self.db.run(apply)
+        return target

@@ -54,9 +54,15 @@ class LogRouter:
         # floor passed us): the operator/recovery must re-point or rebuild
         # this router — retrying would spin forever.
         self.broken: Optional[FdbError] = None
-        process.spawn(self._register(), "lr_register")
-        process.spawn(self._pull_loop(), "lr_pull")
+        process.spawn(self._main(), "lr_main")
         process.spawn(self._floor_loop(), "lr_floor")
+
+    async def _main(self):
+        # Registration must COMPLETE before the first pull: a concurrent
+        # storage pop could advance the primary's floor past our begin in
+        # the window between them, breaking the router spuriously.
+        await self._register()
+        await self._pull_loop()
 
     def interface(self) -> TLogInterface:
         """Remote consumers treat the router exactly as a log."""
@@ -102,15 +108,7 @@ class LogRouter:
                 continue
             for version, bundle in entries:
                 # Feed the buffer directly (the pull IS the commit path).
-                self.log.versions.append(version)
-                self.log.entries.append(bundle)
-                size = 64 + sum(
-                    len(m.param1) + len(m.param2) + 32
-                    for items in bundle.values()
-                    for _s, m in items
-                )
-                self.log._ver_bytes.append(size)
-                self.log._mem_bytes += size
+                self.log.append_raw(version, bundle)
             if end > self.pulled:
                 self.pulled = end
                 self.log.known_committed = max(

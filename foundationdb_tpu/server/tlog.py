@@ -361,6 +361,21 @@ class TLog:
         finally:
             self._spilling = False
 
+    def append_raw(self, version: int, tagged: Dict[str, list]):
+        """Append a pulled entry directly (the LogRouter's fill path: the
+        pull IS the commit).  Keeps the versions/entries/_ver_bytes
+        parallel-array invariant and the byte accounting in ONE place."""
+        assert not self.versions or version > self.versions[-1]
+        size = 64 + sum(
+            len(m.param1) + len(m.param2) + 32
+            for items in tagged.values()
+            for _s, m in items
+        )
+        self.versions.append(version)
+        self.entries.append(tagged)
+        self._ver_bytes.append(size)
+        self._mem_bytes += size
+
     @classmethod
     async def fresh(
         cls,
@@ -467,7 +482,11 @@ class TLog:
             key = page[0][0]
             tag = key[2:-9].decode()  # t/<tag>/<8-byte version>
             tags.append(tag)
-            lo = b"t/" + tag.encode() + b"/\xff\xff\xff\xff\xff\xff\xff\xff\xff"
+            # Hop to the first key PAST every "t/<tag>/..." row: "0" is
+            # "/"+1, so this also clears tags that EXTEND this one with a
+            # "/" segment (e.g. "_lr/r1" after "_lr") — a 0xff-padded hop
+            # would sort above those and skip them.
+            lo = b"t/" + tag.encode() + b"0"
 
     def _peek_spilled(self, req: TLogPeekRequest, limit: int) -> TLogPeekReply:
         """Serve a peek whose begin is below the in-memory floor from the

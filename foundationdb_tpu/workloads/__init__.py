@@ -18,6 +18,8 @@ from .random_read_write import RandomReadWriteWorkload
 from .fuzz_api import FuzzApiWorkload
 from .rollback import RollbackWorkload
 from .random_move_keys import RandomMoveKeysWorkload
+from .sideband import SidebandWorkload
+from .watches import WatchesWorkload
 
 __all__ = [
     "TestWorkload",
@@ -35,4 +37,6 @@ __all__ = [
     "FuzzApiWorkload",
     "RollbackWorkload",
     "RandomMoveKeysWorkload",
+    "SidebandWorkload",
+    "WatchesWorkload",
 ]

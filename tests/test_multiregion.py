@@ -101,10 +101,6 @@ def test_two_dc_failover_zero_acked_loss():
     async def scenario():
         last_commit = 0
         for i in range(25):
-
-            async def op(tr, i=i):
-                tr.set(b"fo%03d" % i, b"val%03d" % i)
-
             tr = db.create_transaction()
             tr.set(b"fo%03d" % i, b"val%03d" % i)
             last_commit = await tr.commit()

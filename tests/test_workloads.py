@@ -149,3 +149,26 @@ def test_invariant_sweep_under_chaos(seed):
         ],
         timeout_vt=20000.0,
     )
+
+
+@pytest.mark.parametrize("seed", [9501, 9502])
+def test_sideband_external_consistency(seed):
+    """Commit acknowledged before a side-channel message must be visible
+    to any transaction started after the message (Sideband.actor.cpp)."""
+    from foundationdb_tpu.workloads import SidebandWorkload
+
+    c = SimCluster(seed=seed, n_proxies=2)
+    wl = SidebandWorkload(messages=15)
+    run_workloads(c, [wl], timeout_vt=20000.0)
+    assert wl.checked == 15 and wl.violations == 0
+
+
+def test_watches_chain():
+    """Watch chains fire on real changes, never spuriously
+    (Watches.actor.cpp)."""
+    from foundationdb_tpu.workloads import WatchesWorkload
+
+    c = SimCluster(seed=9510)
+    wl = WatchesWorkload(chain=3, rounds=4)
+    run_workloads(c, [wl], timeout_vt=30000.0)
+    assert wl.fired > 0 and wl.spurious == 0
