@@ -1,0 +1,80 @@
+"""Cluster connection file: `description:id@host:port,host:port,...`.
+
+Ref: fdbclient/ClusterConnectionFile (MonitorLeader.actor.cpp's
+ClusterConnectionString parse :53-120 and the file rewrite on coordinator
+changes).  The description names the cluster for humans; the id changes
+when the coordinator set changes; the address list is how every client
+and server finds the coordinators.  Rewrites are atomic (write-aside +
+rename) so a crash never leaves a torn file.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import List
+
+
+class ClusterFileError(ValueError):
+    pass
+
+
+@dataclass
+class ClusterConnectionString:
+    description: str
+    cluster_id: str
+    coordinators: List[str]  # "host:port" strings
+
+    @classmethod
+    def parse(cls, text: str) -> "ClusterConnectionString":
+        """Parse `desc:id@addr,addr,...` (comments and blank lines allowed
+        around the single connection line, like the reference's file)."""
+        lines = [
+            ln.strip()
+            for ln in text.splitlines()
+            if ln.strip() and not ln.strip().startswith("#")
+        ]
+        if len(lines) != 1:
+            raise ClusterFileError(
+                f"expected exactly one connection line, got {len(lines)}"
+            )
+        line = lines[0]
+        head, sep, addrs = line.partition("@")
+        if not sep or ":" not in head:
+            raise ClusterFileError(f"malformed connection string: {line!r}")
+        desc, _, cid = head.partition(":")
+        if not desc or not cid:
+            raise ClusterFileError(f"malformed description:id in {line!r}")
+        if not all(c.isalnum() or c == "_" for c in desc):
+            raise ClusterFileError(f"illegal description {desc!r}")
+        if not all(c.isalnum() for c in cid):
+            raise ClusterFileError(f"illegal id {cid!r}")
+        coords = [a.strip() for a in addrs.split(",") if a.strip()]
+        if not coords:
+            raise ClusterFileError("no coordinator addresses")
+        for a in coords:
+            if ":" not in a:
+                raise ClusterFileError(f"address {a!r} lacks a port")
+        return cls(description=desc, cluster_id=cid, coordinators=coords)
+
+    def format(self) -> str:
+        return (
+            f"{self.description}:{self.cluster_id}@"
+            + ",".join(self.coordinators)
+        )
+
+
+def read_cluster_file(path: str) -> ClusterConnectionString:
+    with open(path, "r", encoding="utf-8") as f:
+        return ClusterConnectionString.parse(f.read())
+
+
+def write_cluster_file(path: str, cs: ClusterConnectionString) -> None:
+    """Atomic rewrite (ref: the reference rewriting the file when the
+    coordinator set changes — never torn, old readers see old or new)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        f.write(cs.format() + "\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)

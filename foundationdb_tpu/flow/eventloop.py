@@ -21,6 +21,7 @@ priority, insertion sequence).
 from __future__ import annotations
 
 import heapq
+from time import perf_counter as _perf_counter
 from typing import Coroutine, Optional
 
 from .error import ActorCancelled, FdbError, SimulationFailure
@@ -174,6 +175,10 @@ class EventLoop:
         self._heap: list = []
         self._stopped = False
         self.tasks_run = 0
+        # Slow-task profiler threshold in WALL seconds (None = off; the
+        # simulator leaves it off — virtual time has no slow tasks; real
+        # deployments enable it, ref: Net2 slow-task profiling).
+        self.slow_task_threshold = None
         # (actor name, exception) for tasks that died with a non-FdbError
         # exception: genuine bugs, surfaced as SimulationFailure by run_until.
         self.failed_actors: list = []
@@ -237,7 +242,23 @@ class EventLoop:
             if t > self._now:
                 self._now = t
             self.tasks_run += 1
+            if self.slow_task_threshold is None:
+                fn()
+                return True
+            # Slow-task profiler (ref: Net2's slow task profiling): a
+            # single step hogging the reactor is the #1 real-deployment
+            # latency smell; surface it with its wall-clock cost.
+            w0 = _perf_counter()
             fn()
+            dt = _perf_counter() - w0
+            if dt >= self.slow_task_threshold:
+                from .trace import TraceEvent
+
+                TraceEvent("SlowTask", severity=20).detail(
+                    "wall_seconds", round(dt, 6)
+                ).detail(
+                    "fn", getattr(fn, "__qualname__", repr(fn))[:120]
+                ).log(now=self._now)
             return True
         return False
 

@@ -1,0 +1,53 @@
+"""SystemMonitor: periodic per-process metrics into the trace stream.
+
+Ref: flow/SystemMonitor.cpp — systemMonitor() emits ProcessMetrics /
+MachineMetrics TraceEvents on a cadence (CPU seconds, memory, network
+counters); dashboards and the status doc read them.  The rebuild's
+per-process numbers: event-loop throughput, live actor/endpoint counts,
+heap depth, and (real deployments) rusage CPU + max RSS.
+
+The slow-task profiler half (ref: Net2's slow-task profiling via
+setProfilingEnabled) lives in the event loop: see
+EventLoop.slow_task_threshold — any single task step exceeding it emits a
+SlowTask event with the task's wall-clock cost.
+"""
+
+from __future__ import annotations
+
+from .trace import TraceEvent
+
+
+async def run_system_monitor(
+    process, interval: float = 5.0, wall_metrics: bool = False
+):
+    """Per-process metrics cadence (ref: systemMonitor's delay loop).
+
+    wall_metrics=True adds rusage CPU seconds + max RSS — REAL deployments
+    only: those values are wall-clock-derived and would break the
+    simulator's bit-reproducibility if traced in sim runs (the
+    cross-interpreter byte-identity gate compares trace output)."""
+    loop = process.network.loop
+    last_tasks = loop.tasks_run
+    while True:
+        await loop.delay(interval)
+        ev = (
+            TraceEvent("ProcessMetrics")
+            .detail("process", process.name)
+            .detail("address", process.address)
+            .detail("tasks_run_delta", loop.tasks_run - last_tasks)
+            .detail("live_actors", len(process._tasks))
+            .detail("endpoints", len(process._endpoints))
+            .detail("heap_events", len(loop._heap))
+        )
+        last_tasks = loop.tasks_run
+        if wall_metrics:
+            try:
+                import resource
+
+                ru = resource.getrusage(resource.RUSAGE_SELF)
+                ev.detail("max_rss_kb", ru.ru_maxrss)
+                ev.detail("cpu_user_s", round(ru.ru_utime, 3))
+                ev.detail("cpu_sys_s", round(ru.ru_stime, 3))
+            except Exception:  # pragma: no cover - platform without rusage
+                pass
+        ev.log(now=loop.now())

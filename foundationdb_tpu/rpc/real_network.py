@@ -43,6 +43,10 @@ from ..flow.trace import TraceEvent
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 << 20
+# Wire protocol version, exchanged in the hello frame (ref: the
+# ProtocolVersion constant in ConnectPacket — bump on incompatible wire
+# changes; mismatched peers are rejected at connect, loudly).
+PROTOCOL_VERSION = b"FDBTPU-0x0FDB00B071000001"
 
 
 class RealMachine:
@@ -278,10 +282,13 @@ class RealNetwork:
                 TaskPriority.DefaultEndpoint, lambda c=conn: c.close()
             )
             return conn
-        # Handshake frame 0 announces OUR listener address so the acceptor
-        # can map this connection to a peer (ref: ConnectPacket carrying the
-        # canonical address, FlowTransport.actor.cpp:196).
-        conn.outbuf = _LEN.pack(len(self.address.encode())) + self.address.encode()
+        # Handshake frame 0: protocol version + OUR listener address (ref:
+        # ConnectPacket carrying protocolVersion + the canonical address,
+        # FlowTransport.actor.cpp:189-210).  A peer speaking a different
+        # protocol is rejected AT CONNECT — the live-upgrade story starts
+        # with being able to tell versions apart on the wire.
+        hello = PROTOCOL_VERSION + b" " + self.address.encode()
+        conn.outbuf = _LEN.pack(len(hello)) + hello
         self.selector.register(
             s,
             selectors.EVENT_READ | selectors.EVENT_WRITE,
@@ -367,7 +374,27 @@ class RealNetwork:
             conn.inbuf = conn.inbuf[_LEN.size + length :]
             if conn.peer is None:
                 # First frame on an accepted connection: the handshake.
-                conn.peer = frame.decode()
+                if b" " not in frame:
+                    # Pre-versioning peers sent a bare address: still an
+                    # incompatible protocol — reject LOUDLY so a
+                    # mixed-version rollout is diagnosable.
+                    TraceEvent(
+                        "IncompatibleProtocolVersion", severity=30
+                    ).detail("peer_version", "<unversioned>").detail(
+                        "local_version", PROTOCOL_VERSION.decode()
+                    ).log()
+                    conn.close()
+                    return
+                ver, addr = frame.split(b" ", 1)
+                if ver != PROTOCOL_VERSION:
+                    TraceEvent(
+                        "IncompatibleProtocolVersion", severity=30
+                    ).detail("peer_version", ver.decode(errors="replace")).detail(
+                        "local_version", PROTOCOL_VERSION.decode()
+                    ).log()
+                    conn.close()
+                    return
+                conn.peer = addr.decode()
                 old = self._conns.get(conn.peer)
                 if old is not None and old is not conn and not old.closed:
                     # Simultaneous connect: the accepted conn wins.  The

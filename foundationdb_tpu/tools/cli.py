@@ -42,6 +42,8 @@ class CliProcessor:
         "exclude": "exclude <storage_id> ... — mark storages for removal",
         "include": "include [<storage_id> ...] — clear exclusions "
         "(no args: all)",
+        "backup": "backup <start|status|restore> <path> [version] — "
+        "continuous backup driver (fdbbackup analog)",
         "help": "help — this text",
     }
 
@@ -50,6 +52,7 @@ class CliProcessor:
         self.db = db
         self.write_mode = False
         self._tr = None  # explicit transaction, between begin/commit
+        self._backups: dict = {}  # path -> ContinuousBackupAgent
 
     async def run_command(self, line: str) -> List[str]:
         try:
@@ -79,6 +82,60 @@ class CliProcessor:
     # -- commands --
     async def _cmd_help(self, args):
         return [self.HELP[k] for k in sorted(self.HELP)]
+
+    async def _cmd_backup(self, args):
+        """The fdbbackup driver (ref: fdbbackup's start/status/restore
+        subcommands over FileBackupAgent), running the continuous agent."""
+        if len(args) < 2:
+            return ["ERROR: backup <start|status|restore> <path> [version]"]
+        sub, path = args[0], args[1]
+        from ..fileio import SimFileSystem
+        from ..layers.backup import BackupContainer, ContinuousBackupAgent
+
+        if sub == "start":
+            if path in self._backups:
+                return [f"ERROR: backup to `{path}' already running"]
+            fs = getattr(self.cluster, "fs", None) or SimFileSystem(
+                self.cluster.net
+            )
+            container = BackupContainer(
+                fs, self.cluster.net.process(f"bk:{path}"), path
+            )
+            agent = ContinuousBackupAgent(
+                self.db,
+                fs,
+                [t.interface() for t in self.cluster.tlogs],
+                container,
+                tag=f"_backup/{path}",
+            )
+            v = await agent.start()
+            self.db.process.spawn(agent.run(), f"backup:{path}")
+            self._backups[path] = agent
+            return [f"Backup started to `{path}' at version {v}"]
+        agent = self._backups.get(path)
+        if sub == "status":
+            if agent is None:
+                return [f"No backup to `{path}'"]
+            return [
+                f"Backup `{path}': snapshot {agent.snapshot_version}, "
+                f"logged through {agent.logged_through} "
+                f"({agent._chunks} log chunks)"
+            ]
+        if sub == "restore":
+            if agent is None:
+                return [f"No backup to `{path}'"]
+            # Pause tailing for the restore, then RESUME it — the backup
+            # stays live afterwards (the restore's own writes are logged
+            # like any other mutations).
+            agent.stopped = True
+            target = int(args[2]) if len(args) > 2 else None
+            try:
+                v = await agent.restore(target_version=target)
+            finally:
+                agent.stopped = False
+                self.db.process.spawn(agent.run(), f"backup:{path}")
+            return [f"Restored `{path}' at version {v}; backup resumed"]
+        return [f"ERROR: unknown backup subcommand `{sub}'"]
 
     async def _cmd_get(self, args):
         (key,) = args

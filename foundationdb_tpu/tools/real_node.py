@@ -124,6 +124,12 @@ def run_server(port: int, datadir: str = "") -> None:
             )
 
     proc.spawn(serve_bootstrap(), "bootstrap")
+    # Real-deployment observability: per-process metrics cadence + the
+    # slow-task profiler (ref: systemMonitor + Net2 slow-task profiling).
+    from ..flow.system_monitor import run_system_monitor
+
+    loop.slow_task_threshold = 0.25
+    proc.spawn(run_system_monitor(proc, wall_metrics=True), "system_monitor")
     print(f"READY {net.address}", flush=True)
     net.run_realtime()
 

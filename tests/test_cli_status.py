@@ -181,3 +181,68 @@ def test_quiet_database_waits_for_drain():
 
     assert c.run_all([(db, drive())], timeout_vt=1000.0)[0]
     set_event_loop(None)
+
+
+def test_cluster_connection_file_roundtrip(tmp_path):
+    """Parse/format/atomic-rewrite of `desc:id@addr,...` (ref:
+    ClusterConnectionString, MonitorLeader.actor.cpp:53)."""
+    from foundationdb_tpu.client.cluster_file import (
+        ClusterConnectionString,
+        ClusterFileError,
+        read_cluster_file,
+        write_cluster_file,
+    )
+
+    text = "# my cluster\ntestdb:abc123@10.0.0.1:4500,10.0.0.2:4500\n"
+    p = tmp_path / "fdb.cluster"
+    p.write_text(text)
+    cs = read_cluster_file(str(p))
+    assert cs.description == "testdb" and cs.cluster_id == "abc123"
+    assert cs.coordinators == ["10.0.0.1:4500", "10.0.0.2:4500"]
+    cs.coordinators.append("10.0.0.3:4500")
+    cs.cluster_id = "def456"
+    write_cluster_file(str(p), cs)
+    back = read_cluster_file(str(p))
+    assert back == cs
+    for bad in (
+        "no-at-sign",
+        "desc@1.2.3.4:1",
+        "d:i@",
+        "d:i@nohostport",
+        "a:b@1.1.1.1:1\nc:d@2.2.2.2:2",
+    ):
+        import pytest as _pytest
+
+        with _pytest.raises(ClusterFileError):
+            ClusterConnectionString.parse(bad)
+
+
+def test_cli_backup_driver():
+    """backup start/status/restore through the CLI (fdbbackup analog)."""
+    from foundationdb_tpu.server import SimCluster
+
+    c = SimCluster(seed=73)
+    db = c.database()
+    cli = CliProcessor(c, db)
+    cli.write_mode = True
+
+    async def scenario():
+        await cli.run_command("set bk_a 1")
+        out = await cli.run_command("backup start bkdir")
+        assert out[0].startswith("Backup started"), out
+        await cli.run_command("set bk_b 2")
+        await c.loop.delay(0.5)  # agent tails
+        st = await cli.run_command("backup status bkdir")
+        assert "logged through" in st[0]
+        await cli.run_command("set bk_c 3")  # post-restore-point write
+        await c.loop.delay(0.5)
+        out2 = await cli.run_command("backup restore bkdir")
+        assert out2[0].startswith("Restored"), out2
+        rows = await cli.run_command("getrange bk_ bk~ 10")
+        text = "\n".join(rows)
+        assert "bk_a" in text and "bk_b" in text and "bk_c" in text
+        return True
+
+    assert c.run_until(
+        db.process.spawn(scenario(), "sc"), timeout_vt=20000.0
+    )

@@ -76,3 +76,54 @@ def test_sim_validation_catches_acked_loss():
     sim_validation.expect_at_least(loop, "acked_commit", 600)  # fine
     with pytest.raises(AssertionError, match="promised 500"):
         sim_validation.expect_at_least(loop, "acked_commit", 400)
+
+
+def test_system_monitor_emits_process_metrics():
+    """ProcessMetrics events on a cadence (ref: flow/SystemMonitor.cpp)."""
+    from foundationdb_tpu.flow.system_monitor import run_system_monitor
+    from foundationdb_tpu.flow.trace import TraceCollector, set_global_collector
+
+    col = TraceCollector()
+    set_global_collector(col)
+    try:
+        c = SimCluster(seed=88)
+        db = c.database()
+        db.process.spawn(run_system_monitor(db.process, interval=0.5), "sm")
+
+        async def idle():
+            await c.loop.delay(2.0)
+
+        c.run_until(db.process.spawn(idle(), "idle"), timeout_vt=100.0)
+        evs = col.find("ProcessMetrics")
+        assert len(evs) >= 3
+        assert evs[0]["tasks_run_delta"] >= 0
+        assert "live_actors" in evs[0] and "heap_events" in evs[0]
+    finally:
+        set_global_collector(TraceCollector())
+    set_event_loop(None)
+
+
+def test_slow_task_profiler_fires():
+    """A single step hogging the reactor beyond the threshold traces a
+    SlowTask (ref: Net2 slow-task profiling)."""
+    import time
+
+    from foundationdb_tpu.flow.trace import TraceCollector, set_global_collector
+
+    col = TraceCollector()
+    set_global_collector(col)
+    try:
+        c = SimCluster(seed=89)
+        c.loop.slow_task_threshold = 0.01
+        db = c.database()
+
+        async def hog():
+            t0 = time.perf_counter()
+            while time.perf_counter() - t0 < 0.02:
+                pass  # burn wall clock inside ONE step
+
+        c.run_until(db.process.spawn(hog(), "hog"), timeout_vt=100.0)
+        assert col.find("SlowTask"), "slow step never traced"
+    finally:
+        set_global_collector(TraceCollector())
+    set_event_loop(None)
