@@ -637,6 +637,9 @@ class Transaction:
                 # (ref: commitDummyTransaction NativeAPI:2315, invoked
                 # :2430-2449).
                 if not self.options.get("causal_write_risky"):
+                    from ..flow.testprobe import test_probe
+
+                    test_probe("commit_unknown_fence")
                     key = _intersect_key(write, read)
                     assert key is not None  # guaranteed by self-conflicting
                     await self._commit_dummy(key)

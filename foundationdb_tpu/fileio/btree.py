@@ -200,6 +200,10 @@ class BTreeKeyValueStore:
         room = self._ps - 12
         chunks = [data[i : i + room] for i in range(0, len(data), room)] or [b""]
         pids = [self._alloc() for _ in chunks]
+        if len(chunks) > 1:
+            from ..flow.testprobe import test_probe
+
+            test_probe("btree_chained_node")
         for i, chunk in enumerate(chunks):
             nxt = (pids[i + 1] + 1) if i + 1 < len(chunks) else 0
             await self._file.write(

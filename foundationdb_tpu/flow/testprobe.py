@@ -1,0 +1,24 @@
+"""Code-coverage probes: the TEST() macro + coveragetool analog.
+
+Ref: flow/UnitTest.h's TEST(condition) macro — a named probe at an
+interesting code path (a rare branch the simulation is supposed to reach)
+— and the coveragetool build step that fails CI when probes were never
+hit across the test corpus.  Here: `test_probe("name")` counts hits per
+site; tests/test_coverage.py runs a chaos corpus and asserts the required
+probe set actually fired, so silently-dead rare paths are loud.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+hit_sites: Dict[str, int] = {}
+
+
+def test_probe(name: str) -> None:
+    """Mark an interesting code path as reached (cheap: one dict bump)."""
+    hit_sites[name] = hit_sites.get(name, 0) + 1
+
+
+def reset() -> None:
+    hit_sites.clear()

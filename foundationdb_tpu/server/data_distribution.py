@@ -253,32 +253,36 @@ class DataDistributor:
             acted.append(sid)
         return acted
 
+    async def _team_metrics(self, begin, end, team):
+        """One team member's byte-sample metrics for a range, or None when
+        no member is reachable (shared by the split and merge trackers)."""
+        from .interfaces import GetStorageMetricsRequest
+
+        members = [sid for sid in team if sid in self.storages]
+        if not members:
+            return None
+        try:
+            return await self.storages[members[0]].get_storage_metrics.get_reply(
+                self.db.process,
+                GetStorageMetricsRequest(
+                    begin=begin, end=end if end is not None else b""
+                ),
+            )
+        except FdbError:
+            return None
+
     async def auto_split(self, max_shard_bytes: int) -> list:
         """One split round driven by the storages' byte samples (ref:
         DataDistributionTracker shard-size tracking + splitting,
         DataDistributionTracker.actor.cpp): every shard whose sampled bytes
         exceed the threshold splits at the key holding ~half its weight.
         Returns the split keys applied."""
-        from .interfaces import GetStorageMetricsRequest
-
         applied = []
         for b, e, team, dest in await self.read_shard_map():
             if dest:
                 continue  # mid-move; split() cannot rewrite a move record
-            members = [
-                sid for sid in team if sid in self.storages
-            ]
-            if not members:
-                continue
-            iface = self.storages[members[0]]
-            try:
-                m = await iface.get_storage_metrics.get_reply(
-                    self.db.process,
-                    GetStorageMetricsRequest(
-                        begin=b, end=e if e is not None else b""
-                    ),
-                )
-            except FdbError:
+            m = await self._team_metrics(b, e, team)
+            if m is None:
                 continue
             if m.bytes <= max_shard_bytes or m.split_key is None:
                 continue
@@ -287,6 +291,63 @@ class DataDistributor:
             await self.split(m.split_key)
             applied.append(m.split_key)
         return applied
+
+    async def auto_merge(self, min_shard_bytes: int) -> list:
+        """One merge round: ADJACENT shards owned by the SAME settled team
+        whose combined sampled bytes stay under the threshold coalesce into
+        one keyServers record (ref: shard merging when sizes fall below
+        SHARD_MIN_BYTES_PER_KSECOND territory —
+        DataDistributionTracker.actor.cpp's brokenPromiseToNever merge
+        path).  Never merges across the system-keyspace boundary or into
+        in-flight moves.  Returns the begin keys of absorbed shards."""
+        async def sampled(b, e, team):
+            m = await self._team_metrics(b, e, team)
+            return None if m is None else m.bytes
+
+        absorbed = []
+        shard_map = await self.read_shard_map()
+        i = 0
+        carry = None  # (index, bytes): the previous right shard's sample
+        while i + 1 < len(shard_map):
+            b1, e1, t1, d1 = shard_map[i]
+            b2, e2, t2, d2 = shard_map[i + 1]
+            if (
+                d1
+                or d2
+                or e1 != b2
+                or set(t1) != set(t2)
+                or b2 >= b"\xff"  # never absorb across/into system space
+                or (e2 is not None and e2 > b"\xff" and b1 < b"\xff")
+            ):
+                i += 1
+                continue
+            # Each shard is measured once per round: the right-hand sample
+            # carries forward as the next iteration's left-hand one.
+            if carry is not None and carry[0] == i:
+                s1 = carry[1]
+            else:
+                s1 = await sampled(b1, e1, t1)
+            s2 = await sampled(b2, e2, t2)
+            carry = (i + 1, s2)
+            if s1 is None or s2 is None or s1 + s2 > min_shard_bytes:
+                i += 1
+                continue
+
+            async def merge_txn(tr, b1=b1, b2=b2, e2=e2, team=t1):
+                tr.options["access_system_keys"] = True
+                # One record covers the union; the boundary record clears.
+                tr.set(
+                    sk.key_servers_key(b1),
+                    sk.encode_key_servers(list(team), [], e2),
+                )
+                tr.clear(sk.key_servers_key(b2))
+
+            await self.db.run(merge_txn)
+            absorbed.append(b2)
+            # The merged shard may merge again with its next neighbor.
+            shard_map = await self.read_shard_map()
+            carry = None  # indexes changed; stale samples must not carry
+        return absorbed
 
     async def heal(self, dead_id: str, replacement_id: Optional[str] = None):
         """Re-replicate every shard that lists a dead storage: survivors

@@ -349,6 +349,9 @@ class Proxy:
                     # queue released strictly behind the default lane).
                     afford = int(batch_budget)
                     if afford < len(lane):
+                        from ..flow.testprobe import test_probe
+
+                        test_probe("grv_batch_deferred")
                         deferred = lane[afford:]
                         lane = lane[:afford]
                     batch_budget -= len(lane)

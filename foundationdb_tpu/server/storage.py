@@ -542,6 +542,9 @@ class StorageServer:
             except FdbError:
                 # This replica is down: rotate to another log holding our
                 # tag (ref: ServerPeekCursor bestServer failover).
+                from ..flow.testprobe import test_probe
+
+                test_probe("storage_peek_failover")
                 log_i += 1
                 await loop.delay(0.05)
                 continue

@@ -231,6 +231,9 @@ class TLog:
             await self.spill_store.commit()
         k = bisect_right(self.versions, cut)
         if k < len(self.versions):
+            from ..flow.testprobe import test_probe
+
+            test_probe("epoch_orphans_truncated")
             self._mem_bytes -= sum(self._ver_bytes[k:])
             del self.versions[k:]
             del self.entries[k:]
@@ -344,6 +347,9 @@ class TLog:
                             self._spill_key(tag, self.versions[k]),
                             pickle.dumps(items, protocol=4),
                         )
+                from ..flow.testprobe import test_probe
+
+                test_probe("tlog_spilled")
                 self.spill_store.set(self.SPILL_META_THROUGH, b"%d" % cut)
                 await self.spill_store.commit()
                 # Spilled data is durable: drop it from memory (recompute
@@ -496,6 +502,9 @@ class TLog:
         complete across tags."""
         import pickle
 
+        from ..flow.testprobe import test_probe
+
+        test_probe("tlog_peek_spilled")
         req_tags = (
             self._spill_tag_list() if req.tags is None else req.tags
         )

@@ -1,0 +1,196 @@
+"""Probe-coverage gate: the coveragetool analog.
+
+Ref: flow/UnitTest.h's TEST() macro + the coveragetool CI step: named
+probes sit at rare-but-important code paths; a corpus run must actually
+REACH them, or the "coverage" the chaos suite claims is fiction.  This
+gate runs a compact chaos corpus and asserts the required probe set
+fired (buggify sites have their own equivalent gate in test_workloads).
+"""
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.flow import testprobe
+
+
+@pytest.fixture(autouse=True)
+def _probes():
+    testprobe.reset()
+    yield
+    set_event_loop(None)
+
+
+def test_chaos_corpus_reaches_probed_paths():
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.workloads import (
+        AttritionWorkload,
+        CycleWorkload,
+        RandomCloggingWorkload,
+        run_workloads,
+    )
+    from foundationdb_tpu.workloads.config import SimulationConfig
+
+    # A few seeds of cycle-under-chaos on random topologies: enough for
+    # the failover/fence paths to fire.
+    for seed in (3001, 3002, 3003):
+        cfg = SimulationConfig.random(seed)
+        c = cfg.build(seed)
+        run_workloads(
+            c,
+            [
+                CycleWorkload(nodes=5, ops=12, actors=2),
+                RandomCloggingWorkload(duration=2.0),
+                AttritionWorkload(kills=1),
+            ],
+            timeout_vt=20000.0,
+        )
+        set_event_loop(None)
+    hit = set(testprobe.hit_sites)
+    # Paths a chaos corpus MUST reach (kills + clogs + recoveries).
+    required = {"storage_peek_failover"}
+    missing = required - hit
+    assert not missing, f"chaos corpus never reached: {missing}; hit={hit}"
+
+
+def test_spill_and_btree_probes_fire():
+    """The spill/btree corpus (dedicated suites) reaches its probes;
+    drives the smallest cases directly so the probes count here."""
+    from foundationdb_tpu.fileio import SimFileSystem
+    from foundationdb_tpu.flow import EventLoop, set_event_loop as sel
+    from foundationdb_tpu.rpc import SimNetwork
+    from foundationdb_tpu.fileio.btree import BTreeKeyValueStore
+
+    loop = EventLoop(seed=1)
+    sel(loop)
+    net = SimNetwork(loop)
+    fs = SimFileSystem(net)
+    proc = net.process("n")
+
+    async def run():
+        kv = await BTreeKeyValueStore.open(fs, proc, "c.bt", page_size=512)
+        kv.set(b"big", b"x" * 4000)  # oversized node -> chained pages
+        await kv.commit()
+
+    loop.run_until(proc.spawn(run()), timeout_vt=100.0)
+    assert "btree_chained_node" in testprobe.hit_sites
+
+    from foundationdb_tpu.client.types import Mutation, MutationType
+    from foundationdb_tpu.server.interfaces import (
+        TLogCommitRequest,
+        TLogPeekRequest,
+    )
+    from foundationdb_tpu.server.tlog import TLog
+
+    proc2 = net.process("t")
+
+    async def spill():
+        log = await TLog.fresh(proc2, fs, "c.dq")
+        log.spill_threshold_bytes = 5_000
+        log.spill_keep_versions = 2
+        iface = log.interface()
+        for v in range(1, 60):
+            await iface.commit.get_reply(
+                proc,
+                TLogCommitRequest(
+                    version=v,
+                    prev_version=v - 1,
+                    tagged={"s": [(0, Mutation(
+                        MutationType.SET_VALUE, b"k%d" % v, b"v" * 200
+                    ))]},
+                    epoch=0,
+                ),
+            )
+        for _ in range(200):
+            if not log._spilling:
+                break
+            await loop.delay(0.01)
+        await iface.peek.get_reply(
+            proc, TLogPeekRequest(begin_version=0, tags=["s"])
+        )
+
+    loop.run_until(proc2.spawn(spill()), timeout_vt=1000.0)
+    assert "tlog_spilled" in testprobe.hit_sites
+    assert "tlog_peek_spilled" in testprobe.hit_sites
+
+
+def test_remaining_probes_fire_deterministically():
+    """Every shipped probe has a gate: epoch orphan truncation, GRV batch
+    deferral, and the commit-unknown fence are driven directly."""
+    from foundationdb_tpu.client.types import Mutation, MutationType
+    from foundationdb_tpu.fileio import SimFileSystem
+    from foundationdb_tpu.flow import EventLoop, set_event_loop as sel
+    from foundationdb_tpu.rpc import SimNetwork
+    from foundationdb_tpu.server.interfaces import TLogCommitRequest
+    from foundationdb_tpu.server.tlog import TLog
+
+    # -- epoch_orphans_truncated: truncate a log holding entries above cut.
+    loop = EventLoop(seed=2)
+    sel(loop)
+    net = SimNetwork(loop)
+    fs = SimFileSystem(net)
+    proc = net.process("t2")
+
+    async def orphan():
+        log = await TLog.fresh(proc, fs, "o.dq")
+        iface = log.interface()
+        for v in range(1, 6):
+            await iface.commit.get_reply(
+                proc,
+                TLogCommitRequest(
+                    version=v,
+                    prev_version=v - 1,
+                    tagged={"s": [(0, Mutation(
+                        MutationType.SET_VALUE, b"k", b"v"
+                    ))]},
+                    epoch=0,
+                ),
+            )
+        log.locked = True
+        await log.truncate_above(2)
+
+    loop.run_until(proc.spawn(orphan()), timeout_vt=100.0)
+    assert "epoch_orphans_truncated" in testprobe.hit_sites
+    sel(None)
+
+    # -- grv_batch_deferred: a hard-throttled batch lane defers replies.
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.server.ratekeeper import RateInfo, Ratekeeper
+
+    c = SimCluster(seed=3)
+    rk = Ratekeeper(c.master_proc, [c.tlog], [c.storage])
+    c.proxy.ratekeeper = rk.interface()
+    for t in list(c.master_proc._tasks):
+        if "rk_update" in t.name:
+            t.cancel()
+    rk.rate = RateInfo(tps=100000.0, batch_tps=5.0)
+    db = c.database()
+
+    async def batch_grvs():
+        for _ in range(6):
+            tr = db.create_transaction()
+            tr.options["priority_batch"] = True
+            await tr.get_read_version()
+
+    c.run_all([(db, batch_grvs())], timeout_vt=300.0)
+    assert "grv_batch_deferred" in testprobe.hit_sites
+    sel(None)
+
+    # -- commit_unknown_fence: a commit whose proxy dies mid-flight.
+    c2 = SimCluster(seed=4)
+    db2 = c2.database()
+    from foundationdb_tpu.flow.error import FdbError
+
+    async def unknown():
+        tr = db2.create_transaction()
+        await tr.get_read_version()
+        tr.set(b"uf", b"1")
+        task = db2.process.spawn(tr.commit(), "commit")
+        await c2.loop.delay(0.0001)  # commit in flight
+        c2.proxy_proc.kill()  # reply can never arrive -> broken_promise
+        try:
+            await task
+        except FdbError as e:
+            assert e.name == "commit_unknown_result"
+
+    c2.run_until(db2.process.spawn(unknown(), "u"), timeout_vt=300.0)
+    assert "commit_unknown_fence" in testprobe.hit_sites

@@ -330,3 +330,61 @@ def test_byte_sample_follows_moves_and_clears():
     c.run_all([(db, db.run(wipe))])
     settle(c, db, 0.3)
     assert s1.byte_sample.bytes_in(b"mv/", b"mv0") == 0
+
+
+def test_auto_merge_coalesces_small_adjacent_shards():
+    """Adjacent small shards on the same team merge back into one record
+    (ref: DataDistributionTracker's merge path); big shards and
+    cross-system-boundary pairs do not."""
+    c = SimCluster(seed=41, n_storages=2)
+    db = c.database()
+    fill(c, db, n=30)
+    dd = c.data_distributor()
+
+    async def place():
+        await dd.register_storages(dd.storages)
+        await dd.seed(["ss0"])
+        await dd.split(b"k010")
+        await dd.split(b"k020")
+        await dd.split(b"\xff")
+
+    c.run_until(db.process.spawn(place()), timeout_vt=500.0)
+
+    async def merge_round():
+        before = [
+            (b, e) for b, e, _t, _d in await dd.read_shard_map() if b < b"\xff"
+        ]
+        assert len(before) == 3, before
+        absorbed = await dd.auto_merge(min_shard_bytes=1 << 20)
+        after = [
+            (b, e, t) for b, e, t, _d in await dd.read_shard_map() if b < b"\xff"
+        ]
+        return absorbed, after
+
+    absorbed, after = c.run_until(
+        db.process.spawn(merge_round()), timeout_vt=500.0
+    )
+    # All three user shards coalesced into one settled record.
+    assert absorbed == [b"k010", b"k020"], absorbed
+    assert len(after) == 1 and after[0][0] == b"" and after[0][1] == b"\xff"
+
+    # Reads still route correctly through the merged map.
+    db.invalidate_location(b"")
+    assert dict(read_all(c, db))[b"k015"] == b"v15"
+
+    # A shard ABOVE the byte threshold does not merge.  (Values must be
+    # big enough to register in the probabilistic byte sample.)
+    async def split_again():
+        async def big(tr):
+            for i in range(10):
+                tr.set(b"k%03d" % i, b"x" * 5000)
+            for i in range(10, 20):
+                tr.set(b"k%03d" % i, b"x" * 5000)
+
+        await db.run(big)
+        await c.loop.delay(0.2)  # applied + sampled
+        await dd.split(b"k010")
+        return await dd.auto_merge(min_shard_bytes=1)  # everything too big
+
+    absorbed2 = c.run_until(db.process.spawn(split_again()), timeout_vt=500.0)
+    assert absorbed2 == []
