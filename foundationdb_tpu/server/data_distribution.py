@@ -317,7 +317,9 @@ class DataDistributor:
                 or e1 != b2
                 or set(t1) != set(t2)
                 or b2 >= b"\xff"  # never absorb across/into system space
-                or (e2 is not None and e2 > b"\xff" and b1 < b"\xff")
+                # end=None means "through the end of the keyspace" — past
+                # the system boundary by definition.
+                or ((e2 is None or e2 > b"\xff") and b1 < b"\xff")
             ):
                 i += 1
                 continue

@@ -19,6 +19,7 @@ from .fuzz_api import FuzzApiWorkload
 from .rollback import RollbackWorkload
 from .random_move_keys import RandomMoveKeysWorkload
 from .sideband import SidebandWorkload
+from .selector_correctness import SelectorCorrectnessWorkload
 from .watches import WatchesWorkload
 
 __all__ = [
@@ -38,5 +39,6 @@ __all__ = [
     "RollbackWorkload",
     "RandomMoveKeysWorkload",
     "SidebandWorkload",
+    "SelectorCorrectnessWorkload",
     "WatchesWorkload",
 ]

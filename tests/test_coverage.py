@@ -21,7 +21,6 @@ def _probes():
 
 
 def test_chaos_corpus_reaches_probed_paths():
-    from foundationdb_tpu.server import SimCluster
     from foundationdb_tpu.workloads import (
         AttritionWorkload,
         CycleWorkload,

@@ -172,3 +172,14 @@ def test_watches_chain():
     wl = WatchesWorkload(chain=3, rounds=4)
     run_workloads(c, [wl], timeout_vt=30000.0)
     assert wl.fired > 0 and wl.spurious == 0
+
+
+def test_selector_correctness_sweep():
+    """Exhaustive KeySelector resolution vs the model
+    (SelectorCorrectness.actor.cpp)."""
+    from foundationdb_tpu.workloads import SelectorCorrectnessWorkload
+
+    c = SimCluster(seed=9520)
+    wl = SelectorCorrectnessWorkload(nodes=8, max_offset=4)
+    run_workloads(c, [wl], timeout_vt=30000.0)
+    assert wl.checked >= 8 * 2 * 9 and not wl.failures
