@@ -104,15 +104,29 @@ class RealProcess:
         self._endpoints.pop(ep.token, None)
 
 
+class TLSConfig:
+    """Mutual-TLS material (ref: FDBLibTLS — both sides present a cert
+    signed by the shared CA; identity is the chain, not the hostname,
+    matching the plugin's verify-peers model)."""
+
+    def __init__(self, cert_file: str, key_file: str, ca_file: str):
+        self.cert_file = cert_file
+        self.key_file = key_file
+        self.ca_file = ca_file
+
+
 class _Conn:
-    """One TCP connection with framing and a write queue."""
+    """One TCP connection with framing and a write queue.  With TLS
+    configured, the connection speaks ciphertext on the socket and
+    plaintext frames internally via an SSLObject over memory BIOs (the
+    non-blocking form that composes with the selector reactor)."""
 
     def __init__(self, net: "RealNetwork", sock: socket.socket, peer: Optional[str]):
         self.net = net
         self.sock = sock
         self.peer = peer  # host:port listener address of the remote, if known
         self.inbuf = b""
-        self.outbuf = b""
+        self.outbuf = b""  # raw bytes for the socket (ciphertext under TLS)
         self.connected = peer is None  # accepted conns are connected already
         self.closed = False
         # Superseded by a simultaneous-connect replacement: closing it must
@@ -120,9 +134,97 @@ class _Conn:
         self.superseded = False
         self.created = time.monotonic()
         self.last_activity = time.monotonic()
+        # -- TLS state (None when the network runs plaintext) --
+        self.ssl = None
+        self._in_bio = None
+        self._out_bio = None
+        self._hs_done = False
+        self._plain_out = b""  # frames queued before the handshake finished
+
+    def start_tls(self, server_side: bool):
+        import ssl as _ssl
+
+        self._in_bio = _ssl.MemoryBIO()
+        self._out_bio = _ssl.MemoryBIO()
+        ctx = (
+            self.net._tls_server_ctx if server_side else self.net._tls_client_ctx
+        )
+        self.ssl = ctx.wrap_bio(
+            self._in_bio, self._out_bio, server_side=server_side
+        )
+        self._pump_handshake()
+
+    def _pump_handshake(self):
+        import ssl as _ssl
+
+        try:
+            self.ssl.do_handshake()
+            self._hs_done = True
+        except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError):
+            pass
+        except _ssl.SSLError as e:
+            TraceEvent("TLSHandshakeFailed", severity=30).detail(
+                "peer", self.peer or "<accepting>"
+            ).detail("error", str(e)[:200]).log()
+            # Flush the TLS alert OpenSSL produced and push it out before
+            # closing, so the rejected peer sees WHY (a handshake_failure
+            # alert) instead of a bare EOF it would retry forever.
+            self._flush_bio()
+            if self.outbuf:
+                try:
+                    self.sock.send(self.outbuf)
+                except OSError:
+                    pass
+            self.close()
+            return
+        self._flush_bio()
+        if self._hs_done and self._plain_out:
+            plain, self._plain_out = self._plain_out, b""
+            self._ssl_send(plain)
+
+    def _flush_bio(self):
+        raw = self._out_bio.read()
+        if raw:
+            self.outbuf += raw
+            self.net._want_write(self)
+
+    def _ssl_send(self, plain: bytes):
+        self.ssl.write(plain)
+        self._flush_bio()
+
+    def feed_raw(self, data: bytes):
+        """Socket bytes in -> plaintext appended to inbuf."""
+        import ssl as _ssl
+
+        if self.ssl is None:
+            self.inbuf += data
+            return
+        self._in_bio.write(data)
+        if not self._hs_done:
+            self._pump_handshake()
+            if self.closed or not self._hs_done:
+                return
+        while True:
+            try:
+                chunk = self.ssl.read(1 << 16)
+            except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError):
+                break
+            except _ssl.SSLError:
+                self.close()
+                return
+            if not chunk:
+                break
+            self.inbuf += chunk
+        self._flush_bio()
 
     def enqueue(self, frame: bytes):
-        self.outbuf += _LEN.pack(len(frame)) + frame
+        wire = _LEN.pack(len(frame)) + frame
+        if self.ssl is None:
+            self.outbuf += wire
+        elif self._hs_done:
+            self._ssl_send(wire)
+        else:
+            self._plain_out += wire  # released when the handshake completes
         self.net._want_write(self)
 
     def close(self):
@@ -144,10 +246,20 @@ class _Conn:
 class RealNetwork:
     """The real fabric: listener + peer connections + local delivery."""
 
-    def __init__(self, loop: EventLoop, host: str = "127.0.0.1", port: int = 0):
+    def __init__(
+        self,
+        loop: EventLoop,
+        host: str = "127.0.0.1",
+        port: int = 0,
+        tls: Optional[TLSConfig] = None,
+    ):
         self.loop = loop
         self.selector = selectors.DefaultSelector()
         self.host = host
+        self.tls = tls
+        if tls is not None:
+            self._tls_server_ctx = self._make_tls_ctx(tls, server_side=True)
+            self._tls_client_ctx = self._make_tls_ctx(tls, server_side=False)
         self._listener = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._listener.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._listener.bind((host, port))
@@ -169,6 +281,22 @@ class RealNetwork:
         # the ping keepalive + failure detection on connectionKeeper).
         self.idle_timeout = 15.0
         self._arm_watchdog()
+
+    @staticmethod
+    def _make_tls_ctx(tls: TLSConfig, server_side: bool):
+        """Mutual TLS both directions (ref: FDBLibTLS verify-peers): each
+        side must present a cert chained to the shared CA; hostname checks
+        are off — the CA, not DNS, is the trust root inside a cluster."""
+        import ssl as _ssl
+
+        ctx = _ssl.SSLContext(
+            _ssl.PROTOCOL_TLS_SERVER if server_side else _ssl.PROTOCOL_TLS_CLIENT
+        )
+        ctx.check_hostname = False
+        ctx.verify_mode = _ssl.CERT_REQUIRED
+        ctx.load_cert_chain(tls.cert_file, tls.key_file)
+        ctx.load_verify_locations(tls.ca_file)
+        return ctx
 
     def _arm_watchdog(self):
         if self._stopped:
@@ -282,13 +410,17 @@ class RealNetwork:
                 TaskPriority.DefaultEndpoint, lambda c=conn: c.close()
             )
             return conn
+        if self.tls is not None:
+            conn.start_tls(server_side=False)
+            if conn.closed:
+                return conn
         # Handshake frame 0: protocol version + OUR listener address (ref:
         # ConnectPacket carrying protocolVersion + the canonical address,
         # FlowTransport.actor.cpp:189-210).  A peer speaking a different
         # protocol is rejected AT CONNECT — the live-upgrade story starts
-        # with being able to tell versions apart on the wire.
-        hello = PROTOCOL_VERSION + b" " + self.address.encode()
-        conn.outbuf = _LEN.pack(len(hello)) + hello
+        # with being able to tell versions apart on the wire.  Under TLS it
+        # rides the encrypted channel after the TLS handshake.
+        conn.enqueue(PROTOCOL_VERSION + b" " + self.address.encode())
         self.selector.register(
             s,
             selectors.EVENT_READ | selectors.EVENT_WRITE,
@@ -315,6 +447,10 @@ class RealNetwork:
             return
         s.setblocking(False)
         conn = _Conn(self, s, None)  # peer learned from the handshake frame
+        if self.tls is not None:
+            conn.start_tls(server_side=True)
+            if conn.closed:
+                return
         self.selector.register(
             s,
             selectors.EVENT_READ,
@@ -357,7 +493,9 @@ class RealNetwork:
                 conn.close()
                 return
             conn.last_activity = time.monotonic()
-            conn.inbuf += data
+            conn.feed_raw(data)  # TLS decrypt (or identity) into inbuf
+            if conn.closed:
+                return
             self._drain_frames(conn)
 
     def _drain_frames(self, conn: _Conn):

@@ -31,7 +31,32 @@ from ..rpc.stream import RequestStream, RequestStreamRef, well_known_token
 from ..rpc.network import Endpoint
 
 
-def run_server(port: int, datadir: str = "") -> None:
+def _tls_config(args):
+    from ..rpc.real_network import TLSConfig
+
+    given = [
+        bool(getattr(args, "tls_cert", "")),
+        bool(getattr(args, "tls_key", "")),
+        bool(getattr(args, "tls_ca", "")),
+    ]
+    if not any(given):
+        return None
+    if not all(given):
+        # NEVER fall back to plaintext on a partial TLS config — that is a
+        # silent security downgrade.
+        raise SystemExit(
+            "TLS requires all of --tls-cert, --tls-key, --tls-ca"
+        )
+    return TLSConfig(args.tls_cert, args.tls_key, args.tls_ca)
+
+
+def _add_tls_args(parser):
+    parser.add_argument("--tls-cert", default="", help="PEM cert (mutual TLS)")
+    parser.add_argument("--tls-key", default="")
+    parser.add_argument("--tls-ca", default="")
+
+
+def run_server(port: int, datadir: str = "", tls=None) -> None:
     from ..flow.knobs import g_knobs
     from ..server.proxy import Proxy
     from ..server.resolver import Resolver
@@ -45,7 +70,7 @@ def run_server(port: int, datadir: str = "") -> None:
 
     loop = EventLoop(seed=1)
     set_event_loop(loop)
-    net = RealNetwork(loop, port=port)
+    net = RealNetwork(loop, port=port, tls=tls)
     proc = net.process("server")
 
     if datadir:
@@ -134,12 +159,14 @@ def run_server(port: int, datadir: str = "") -> None:
     net.run_realtime()
 
 
-def run_client(server: str, client_id: str, ops: int, check_count: int) -> None:
+def run_client(
+    server: str, client_id: str, ops: int, check_count: int, tls=None
+) -> None:
     from ..client.transaction import Database
 
     loop = EventLoop(seed=2)
     set_event_loop(loop)
-    net = RealNetwork(loop)
+    net = RealNetwork(loop, tls=tls)
     proc = net.process(f"client-{client_id}")
 
     boot_ref = RequestStreamRef(
@@ -196,16 +223,21 @@ def main(argv=None):
         help="directory for durable storage (native C++ engine); empty = "
         "in-memory only",
     )
+    _add_tls_args(s)
     c = sub.add_parser("client")
     c.add_argument("server")
     c.add_argument("--id", default="c1")
     c.add_argument("--ops", type=int, default=20)
     c.add_argument("--check-count", type=int, default=-1)
+    _add_tls_args(c)
     args = ap.parse_args(argv)
     if args.mode == "server":
-        run_server(args.port, datadir=args.datadir)
+        run_server(args.port, datadir=args.datadir, tls=_tls_config(args))
     else:
-        run_client(args.server, args.id, args.ops, args.check_count)
+        run_client(
+            args.server, args.id, args.ops, args.check_count,
+            tls=_tls_config(args),
+        )
 
 
 if __name__ == "__main__":

@@ -77,6 +77,8 @@ def build_actor(loop, rng, depth, trace, label="r"):
                 )
                 trace.append((label, f"all{len(vals)}"))
                 return sum(v or 0 for v in vals)
+            except ActorCancelled:
+                raise  # cancellation must PROPAGATE, never be swallowed
             except FdbError:
                 trace.append((label, "all_err"))
                 return -1
@@ -91,6 +93,11 @@ def build_actor(loop, rng, depth, trace, label="r"):
             try:
                 idx, val = await first_of(*tasks)
                 trace.append((label, f"first{idx}"))
+            except ActorCancelled:
+                for t in tasks:
+                    if not t.is_ready():
+                        t.cancel()
+                raise  # cancellation must PROPAGATE, never be swallowed
             except FdbError:
                 trace.append((label, "first_err"))
                 idx, val = -1, -1
@@ -107,6 +114,8 @@ def build_actor(loop, rng, depth, trace, label="r"):
         for i, c in enumerate(children):
             try:
                 total += (await loop.spawn(c, f"{label}.{i}")) or 0
+            except ActorCancelled:
+                raise  # cancellation must PROPAGATE, never be swallowed
             except FdbError:
                 trace.append((label, f"seq_err{i}"))
         trace.append((label, "seq"))
