@@ -33,6 +33,7 @@ from __future__ import annotations
 import pickle
 import selectors
 import socket
+import ssl
 import struct
 import time
 from typing import Callable, Dict, List, Optional
@@ -142,10 +143,8 @@ class _Conn:
         self._plain_out = b""  # frames queued before the handshake finished
 
     def start_tls(self, server_side: bool):
-        import ssl as _ssl
-
-        self._in_bio = _ssl.MemoryBIO()
-        self._out_bio = _ssl.MemoryBIO()
+        self._in_bio = ssl.MemoryBIO()
+        self._out_bio = ssl.MemoryBIO()
         ctx = (
             self.net._tls_server_ctx if server_side else self.net._tls_client_ctx
         )
@@ -155,26 +154,29 @@ class _Conn:
         self._pump_handshake()
 
     def _pump_handshake(self):
-        import ssl as _ssl
-
         try:
             self.ssl.do_handshake()
             self._hs_done = True
-        except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError):
+        except (ssl.SSLWantReadError, ssl.SSLWantWriteError):
             pass
-        except _ssl.SSLError as e:
+        except ssl.SSLError as e:
             TraceEvent("TLSHandshakeFailed", severity=30).detail(
                 "peer", self.peer or "<accepting>"
             ).detail("error", str(e)[:200]).log()
             # Flush the TLS alert OpenSSL produced and push it out before
             # closing, so the rejected peer sees WHY (a handshake_failure
-            # alert) instead of a bare EOF it would retry forever.
+            # alert) instead of a bare EOF it would retry forever.  Loop on
+            # partial sends (non-blocking socket); best-effort — a full
+            # send buffer drops the remainder rather than blocking.
             self._flush_bio()
-            if self.outbuf:
+            while self.outbuf:
                 try:
-                    self.sock.send(self.outbuf)
-                except OSError:
-                    pass
+                    n = self.sock.send(self.outbuf)
+                except (BlockingIOError, OSError):
+                    break
+                if n <= 0:
+                    break
+                self.outbuf = self.outbuf[n:]
             self.close()
             return
         self._flush_bio()
@@ -194,8 +196,6 @@ class _Conn:
 
     def feed_raw(self, data: bytes):
         """Socket bytes in -> plaintext appended to inbuf."""
-        import ssl as _ssl
-
         if self.ssl is None:
             self.inbuf += data
             return
@@ -207,9 +207,9 @@ class _Conn:
         while True:
             try:
                 chunk = self.ssl.read(1 << 16)
-            except (_ssl.SSLWantReadError, _ssl.SSLWantWriteError):
+            except (ssl.SSLWantReadError, ssl.SSLWantWriteError):
                 break
-            except _ssl.SSLError:
+            except ssl.SSLError:
                 self.close()
                 return
             if not chunk:
@@ -287,13 +287,11 @@ class RealNetwork:
         """Mutual TLS both directions (ref: FDBLibTLS verify-peers): each
         side must present a cert chained to the shared CA; hostname checks
         are off — the CA, not DNS, is the trust root inside a cluster."""
-        import ssl as _ssl
-
-        ctx = _ssl.SSLContext(
-            _ssl.PROTOCOL_TLS_SERVER if server_side else _ssl.PROTOCOL_TLS_CLIENT
+        ctx = ssl.SSLContext(
+            ssl.PROTOCOL_TLS_SERVER if server_side else ssl.PROTOCOL_TLS_CLIENT
         )
         ctx.check_hostname = False
-        ctx.verify_mode = _ssl.CERT_REQUIRED
+        ctx.verify_mode = ssl.CERT_REQUIRED
         ctx.load_cert_chain(tls.cert_file, tls.key_file)
         ctx.load_verify_locations(tls.ca_file)
         return ctx
@@ -321,9 +319,16 @@ class RealNetwork:
             if not conn.connected and now - conn.created > self.connect_timeout:
                 conn.close()
                 continue
-            owed = bool(conn.outbuf) or any(
-                conn.peer in p._pending_on and p._pending_on[conn.peer]
-                for p in self._proc_list
+            # _plain_out counts as owed traffic: frames parked behind a TLS
+            # handshake that never completes must trigger the idle close
+            # (and thus reconnect), exactly like unsent plaintext would.
+            owed = (
+                bool(conn.outbuf)
+                or bool(conn._plain_out)
+                or any(
+                    conn.peer in p._pending_on and p._pending_on[conn.peer]
+                    for p in self._proc_list
+                )
             )
             if owed and now - conn.last_activity > self.idle_timeout:
                 conn.close()

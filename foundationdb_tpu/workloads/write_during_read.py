@@ -79,6 +79,7 @@ class WriteDuringReadWorkload(TestWorkload):
         value_size_max: int = 24,
         initial_key_density: float = 0.5,
         prefix: bytes = b"\x02wdr/",
+        contention_actors: int = 0,
     ):
         self.nodes = nodes
         self.txns = txns
@@ -87,6 +88,14 @@ class WriteDuringReadWorkload(TestWorkload):
         self.value_size_max = value_size_max
         self.initial_key_density = initial_key_density
         self.prefix = prefix
+        # Adversarial contention WITHOUT corrupting the memory model:
+        # contender transactions declare write-CONFLICT ranges over the
+        # node keys but carry zero mutations — the resolver aborts the
+        # driver's overlapping reads (real not_committed outcomes in the
+        # history) while the database bytes stay exactly what the model
+        # says.  This is how the acceptance matrix gets high-contention
+        # conflict decisions out of a single-driver memory-model workload.
+        self.contention_actors = contention_actors
         self.marker = prefix + b"!marker"
         # Model state.
         self.memory_db: Dict[bytes, bytes] = {}
@@ -252,6 +261,23 @@ class WriteDuringReadWorkload(TestWorkload):
 
         rng = cluster.loop.rng
         proc = db.process
+        done = {"driver": False}
+        contenders = [
+            proc.spawn(self._contender(db, cluster, done, c), f"wdr_cont{c}")
+            for c in range(self.contention_actors)
+        ]
+        try:
+            await self._drive(db, cluster, rng, proc)
+        finally:
+            # Contenders must stop even when the driver dies — leaked
+            # actors would spin until the simulation's timeout.
+            done["driver"] = True
+        if contenders:
+            await all_of(contenders)
+
+    async def _drive(self, db, cluster, rng, proc):
+        from ..flow.eventloop import all_of
+
         txn_seq = 0
         while txn_seq < self.txns:
             txn_seq += 1
@@ -314,6 +340,24 @@ class WriteDuringReadWorkload(TestWorkload):
                     await cluster.loop.delay(0.05)
                 else:
                     raise
+
+    async def _contender(self, db, cluster, done, cid: int):
+        """Write-conflict-only pressure (see __init__): conflicts with the
+        driver's reads at the resolver, mutates nothing."""
+        from ..flow.error import FdbError
+
+        rng = cluster.loop.rng
+        while not done["driver"]:
+            tr = db.create_transaction()
+            a = int(rng.random_int(0, self.nodes))
+            span = 1 + int(rng.random_int(0, 4))
+            tr.add_write_conflict_range(self._key(a), self._key(a + span))
+            try:
+                await tr.get_read_version()
+                await tr.commit()
+            except FdbError:
+                pass  # contender outcomes are irrelevant
+            await cluster.loop.delay(0.002 + rng.random01() * 0.01)
 
     async def check(self, db, cluster) -> bool:
         final = {}

@@ -50,6 +50,32 @@ class TestWallClockTimeout(BaseException):
     cannot swallow the watchdog and re-hang the suite."""
 
 
+# --- real_node subprocess helper (shared by transport/monitor/TLS tests) ---
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def spawn_real_node(*args):
+    """Spawn `python -m foundationdb_tpu.tools.real_node <args>` with the
+    standard env (repo on path, CPU jax) and kernel-enforced reaping."""
+    import subprocess
+
+    from foundationdb_tpu.utils.procutil import die_with_parent
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO_ROOT
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    return subprocess.Popen(
+        [sys.executable, "-m", "foundationdb_tpu.tools.real_node", *args],
+        cwd=REPO_ROOT,
+        env=env,
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        preexec_fn=die_with_parent,
+    )
+
+
 # --- leaked-subprocess sweep (round-3 orphan incident) ---
 # PDEATHSIG on every spawn is the primary defense; this is the audit: at
 # session end, any still-alive real_node/monitor process started under THIS

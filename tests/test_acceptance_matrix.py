@@ -51,7 +51,10 @@ def _final_state(cluster, prefix: bytes):
 
 def _run_wdr(backend: str, seed: int):
     c = SimCluster(seed=seed, conflict_backend=backend, n_proxies=2)
-    wl = WriteDuringReadWorkload(nodes=25, txns=10)
+    # contention_actors: write-conflict-only contenders make the history
+    # carry REAL abort decisions (the high-contention config the north
+    # star names) while the memory model stays byte-exact.
+    wl = WriteDuringReadWorkload(nodes=25, txns=10, contention_actors=3)
     run_workloads(c, [wl], timeout_vt=30000.0)
     state = _final_state(c, wl.prefix)
     set_event_loop(None)
@@ -65,6 +68,8 @@ def test_write_during_read_differential_cpu_vs_jax():
     assert not cpu_wl.mismatches and not jax_wl.mismatches
     assert cpu_wl.history == jax_wl.history
     assert cpu_wl.committed_txns == jax_wl.committed_txns > 0
+    # The contention must actually produce conflict decisions to compare.
+    assert cpu_wl.conflicts == jax_wl.conflicts > 0, cpu_wl.history
     assert cpu_state == jax_state
 
 

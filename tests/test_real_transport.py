@@ -7,34 +7,16 @@ on localhost: one server hosting the write pipeline, two clients running
 serializable increment transactions concurrently.
 """
 
-import os
 import signal
 import subprocess
-import sys
 
 import pytest
 
-from foundationdb_tpu.utils.procutil import die_with_parent
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from conftest import spawn_real_node
 
 
 def _spawn(args):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO
-    # Keep the subprocesses light: the client/server path is pure-Python.
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    return subprocess.Popen(
-        [sys.executable, "-m", "foundationdb_tpu.tools.real_node", *args],
-        cwd=REPO,
-        env=env,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-        # Kernel-enforced: the child dies even if pytest is SIGKILLed before
-        # the finally-block cleanup runs (round-3 orphan incident).
-        preexec_fn=die_with_parent,
-    )
+    return spawn_real_node(*args)
 
 
 def test_three_process_localhost_cluster():

@@ -5,16 +5,16 @@ server and client certs; an IMPOSTOR CA signs a cert that must be
 rejected (the verify-peers model: trust is the CA chain, not hostnames).
 """
 
-import os
 import signal
 import subprocess
-import sys
 
 import pytest
 
-from foundationdb_tpu.utils.procutil import die_with_parent
+from conftest import spawn_real_node
 
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+def _spawn(args):
+    return spawn_real_node(*args)
 
 
 def _sh(*args):
@@ -39,21 +39,6 @@ def make_cert(dirpath, name, ca_key, ca_crt):
     _sh("openssl", "x509", "-req", "-in", csr, "-CA", ca_crt,
         "-CAkey", ca_key, "-CAcreateserial", "-out", crt, "-days", "1")
     return key, crt
-
-
-def _spawn(args):
-    env = dict(os.environ)
-    env["PYTHONPATH"] = REPO
-    env.setdefault("JAX_PLATFORMS", "cpu")
-    return subprocess.Popen(
-        [sys.executable, "-m", "foundationdb_tpu.tools.real_node", *args],
-        cwd=REPO,
-        env=env,
-        stdout=subprocess.PIPE,
-        stderr=subprocess.STDOUT,
-        text=True,
-        preexec_fn=die_with_parent,
-    )
 
 
 @pytest.fixture(scope="module")
