@@ -44,15 +44,21 @@ class CliProcessor:
         "(no args: all)",
         "backup": "backup <start|status|restore> <path> [version] — "
         "continuous backup driver (fdbbackup analog)",
+        "dr": "dr <start|status> — replicate into the destination cluster "
+        "(fdbdr analog; requires a destination)",
         "help": "help — this text",
     }
 
-    def __init__(self, cluster, db):
+    def __init__(self, cluster, db, dst_db=None):
         self.cluster = cluster
         self.db = db
+        # Destination database for `dr` commands (the fdbdr tool takes two
+        # cluster files; the shell takes two database handles).
+        self.dst_db = dst_db
         self.write_mode = False
         self._tr = None  # explicit transaction, between begin/commit
         self._backups: dict = {}  # path -> ContinuousBackupAgent
+        self._dr_agent = None
 
     async def run_command(self, line: str) -> List[str]:
         try:
@@ -124,18 +130,53 @@ class CliProcessor:
         if sub == "restore":
             if agent is None:
                 return [f"No backup to `{path}'"]
-            # Pause tailing for the restore, then RESUME it — the backup
-            # stays live afterwards (the restore's own writes are logged
-            # like any other mutations).
-            agent.stopped = True
-            target = int(args[2]) if len(args) > 2 else None
-            try:
-                v = await agent.restore(target_version=target)
-            finally:
-                agent.stopped = False
-                self.db.process.spawn(agent.run(), f"backup:{path}")
-            return [f"Restored `{path}' at version {v}; backup resumed"]
+            return await self._backup_restore(agent, path, args)
         return [f"ERROR: unknown backup subcommand `{sub}'"]
+
+    async def _backup_restore(self, agent, path, args):
+        # Pause tailing for the restore, then RESUME it — the backup
+        # stays live afterwards (the restore's own writes are logged
+        # like any other mutations).
+        agent.stopped = True
+        target = int(args[2]) if len(args) > 2 else None
+        try:
+            v = await agent.restore(target_version=target)
+        finally:
+            agent.stopped = False
+            self.db.process.spawn(agent.run(), f"backup:{path}")
+        return [f"Restored `{path}' at version {v}; backup resumed"]
+
+    async def _cmd_dr(self, args):
+        """The fdbdr driver (ref: fdbbackup/fdbdr's start/status over
+        DatabaseBackupAgent): continuous replication into the destination
+        database this shell was constructed with."""
+        if not args:
+            return ["ERROR: dr <start|status>"]
+        if self.dst_db is None:
+            return ["ERROR: no destination cluster configured"]
+        sub = args[0]
+        if sub == "start":
+            if self._dr_agent is not None:
+                return ["ERROR: DR already running"]
+            from ..layers.dr import DRAgent
+
+            agent = DRAgent(
+                self.db,
+                self.dst_db,
+                [t.interface() for t in self.cluster.tlogs],
+            )
+            v = await agent.start()
+            self.db.process.spawn(agent.run(), "dr_agent")
+            self._dr_agent = agent
+            return [f"DR started; initial snapshot at version {v}"]
+        if sub == "status":
+            if self._dr_agent is None:
+                return ["DR: not running"]
+            return [
+                f"DR: tailing, destination reflects source version "
+                f"{self._dr_agent.applied}"
+            ]
+        return [f"ERROR: unknown dr subcommand `{sub}'"]
 
     async def _cmd_get(self, args):
         (key,) = args

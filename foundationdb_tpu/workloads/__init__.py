@@ -21,6 +21,7 @@ from .random_move_keys import RandomMoveKeysWorkload
 from .sideband import SidebandWorkload
 from .selector_correctness import SelectorCorrectnessWorkload
 from .watches import WatchesWorkload
+from .increment import IncrementWorkload
 
 __all__ = [
     "TestWorkload",
@@ -41,4 +42,5 @@ __all__ = [
     "SidebandWorkload",
     "SelectorCorrectnessWorkload",
     "WatchesWorkload",
+    "IncrementWorkload",
 ]

@@ -246,3 +246,41 @@ def test_cli_backup_driver():
     assert c.run_until(
         db.process.spawn(scenario(), "sc"), timeout_vt=20000.0
     )
+
+
+def test_cli_dr_driver():
+    """dr start/status through the CLI (fdbdr analog): the destination
+    converges to the source."""
+    from foundationdb_tpu.server import SimCluster
+
+    src = SimCluster(seed=74)
+    # buggify is process-global: False here runs BOTH clusters fault-free
+    # deliberately (this is a convergence test, not a chaos test).
+    dst = SimCluster(seed=75, loop=src.loop, buggify=False)
+    sdb = src.database("cli_src")
+    ddb = dst.database("cli_dst")
+    cli = CliProcessor(src, sdb, dst_db=ddb)
+    cli.write_mode = True
+
+    async def scenario():
+        await cli.run_command("set drk_a 1")
+        out = await cli.run_command("dr start")
+        assert out[0].startswith("DR started"), out
+        await cli.run_command("set drk_b 2")
+        for _ in range(200):
+            st = await cli.run_command("dr status")
+            rows = {}
+
+            async def read(tr):
+                rows["r"] = await tr.get_range(b"drk", b"drl")
+
+            await ddb.run(read)
+            if dict(rows["r"]).get(b"drk_b") == b"2":
+                assert "tailing" in st[0]
+                return True
+            await src.loop.delay(0.05)
+        raise AssertionError(f"DR never converged: {rows['r']}")
+
+    assert src.run_until(
+        sdb.process.spawn(scenario(), "sc"), timeout_vt=20000.0
+    )

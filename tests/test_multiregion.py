@@ -151,6 +151,8 @@ def test_dr_multi_log_source():
 
     src = SimCluster(seed=9402, n_tlogs=2, n_storages=2)
     sdb = src.database("src_client")
+    # buggify is process-global: False here runs BOTH clusters fault-free
+    # deliberately (this is a convergence test, not a chaos test).
     dst = SimCluster(
         seed=9403, loop=src.loop, buggify=False
     )

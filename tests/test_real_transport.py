@@ -15,20 +15,16 @@ import pytest
 from conftest import spawn_real_node
 
 
-def _spawn(args):
-    return spawn_real_node(*args)
-
-
 def test_three_process_localhost_cluster():
-    server = _spawn(["server"])
+    server = spawn_real_node(*["server"])
     try:
         ready = server.stdout.readline().strip()
         assert ready.startswith("READY "), ready
         addr = ready.split()[1]
 
         # Two concurrent clients, 15 serializable increments each.
-        c1 = _spawn(["client", addr, "--id", "a", "--ops", "15"])
-        c2 = _spawn(["client", addr, "--id", "b", "--ops", "15"])
+        c1 = spawn_real_node(*["client", addr, "--id", "a", "--ops", "15"])
+        c2 = spawn_real_node(*["client", addr, "--id", "b", "--ops", "15"])
         out1, _ = c1.communicate(timeout=90)
         out2, _ = c2.communicate(timeout=90)
         assert c1.returncode == 0, out1
@@ -36,8 +32,8 @@ def test_three_process_localhost_cluster():
 
         # A third client verifies the serializable total: 30 increments
         # through conflicting read-modify-write transactions.
-        c3 = _spawn(
-            ["client", addr, "--id", "v", "--ops", "0", "--check-count", "30"]
+        c3 = spawn_real_node(
+            "client", addr, "--id", "v", "--ops", "0", "--check-count", "30"
         )
         out3, _ = c3.communicate(timeout=90)
         assert c3.returncode == 0, out3
@@ -55,24 +51,24 @@ def test_real_server_durable_restart(tmp_path):
     restart on the same datadir, and committed data must still be there
     (ref: the storage-engine recovery contract, IKeyValueStore.h:43)."""
     datadir = str(tmp_path / "data")
-    server = _spawn(["server", "--datadir", datadir])
+    server = spawn_real_node(*["server", "--datadir", datadir])
     try:
         ready = server.stdout.readline().strip()
         addr = ready.split()[1]
-        c1 = _spawn(["client", addr, "--id", "d", "--ops", "12"])
+        c1 = spawn_real_node(*["client", addr, "--id", "d", "--ops", "12"])
         out1, _ = c1.communicate(timeout=90)
         assert c1.returncode == 0, out1
     finally:
         server.kill()
         server.wait()
 
-    server2 = _spawn(["server", "--datadir", datadir])
+    server2 = spawn_real_node(*["server", "--datadir", datadir])
     try:
         ready2 = server2.stdout.readline().strip()
         addr2 = ready2.split()[1]
         # The verifier writes nothing; the counter and the idempotence
         # markers written before the kill must have survived.
-        c2 = _spawn(["client", addr2, "--id", "v", "--ops", "0",
+        c2 = spawn_real_node(*["client", addr2, "--id", "v", "--ops", "0",
                      "--check-count", "12"])
         out2, _ = c2.communicate(timeout=90)
         assert c2.returncode == 0, out2

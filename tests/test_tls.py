@@ -13,10 +13,6 @@ import pytest
 from conftest import spawn_real_node
 
 
-def _spawn(args):
-    return spawn_real_node(*args)
-
-
 def _sh(*args):
     subprocess.run(args, check=True, capture_output=True)
 
@@ -63,7 +59,7 @@ def test_tls_cluster_roundtrip(certs):
     encrypted channel end to end."""
     s_crt, s_key = certs["server"]
     c_crt, c_key = certs["client"]
-    server = _spawn([
+    server = spawn_real_node(*[
         "server", "--tls-cert", s_crt, "--tls-key", s_key,
         "--tls-ca", certs["ca"],
     ])
@@ -71,7 +67,7 @@ def test_tls_cluster_roundtrip(certs):
         ready = server.stdout.readline().strip()
         assert ready.startswith("READY "), ready
         addr = ready.split()[1]
-        cl = _spawn([
+        cl = spawn_real_node(*[
             "client", addr, "--id", "t", "--ops", "8", "--check-count", "8",
             "--tls-cert", c_crt, "--tls-key", c_key, "--tls-ca", certs["ca"],
         ])
@@ -91,7 +87,7 @@ def test_tls_rejects_untrusted_peer(certs):
     handshake; it makes no progress against the cluster."""
     s_crt, s_key = certs["server"]
     i_crt, i_key = certs["intruder"]
-    server = _spawn([
+    server = spawn_real_node(*[
         "server", "--tls-cert", s_crt, "--tls-key", s_key,
         "--tls-ca", certs["ca"],
     ])
@@ -99,7 +95,7 @@ def test_tls_rejects_untrusted_peer(certs):
         ready = server.stdout.readline().strip()
         assert ready.startswith("READY "), ready
         addr = ready.split()[1]
-        intruder = _spawn([
+        intruder = spawn_real_node(*[
             "client", addr, "--id", "x", "--ops", "1",
             "--tls-cert", i_crt, "--tls-key", i_key,
             # The intruder even TRUSTS the real CA; its own identity is
@@ -114,7 +110,7 @@ def test_tls_rejects_untrusted_peer(certs):
             intruder.kill()  # wedged at the rejected handshake: also a pass
         # The cluster still serves trusted clients afterwards.
         c_crt, c_key = certs["client"]
-        good = _spawn([
+        good = spawn_real_node(*[
             "client", addr, "--id", "g", "--ops", "2",
             "--tls-cert", c_crt, "--tls-key", c_key,
             "--tls-ca", certs["ca"],

@@ -183,3 +183,11 @@ def test_selector_correctness_sweep():
     wl = SelectorCorrectnessWorkload(nodes=8, max_offset=4)
     run_workloads(c, [wl], timeout_vt=30000.0)
     assert wl.checked >= 8 * 2 * 9 and not wl.failures
+
+
+def test_increment_workload():
+    """Concurrent RMW counters sum exactly (Increment.actor.cpp)."""
+    from foundationdb_tpu.workloads import IncrementWorkload
+
+    c = SimCluster(seed=9530, n_proxies=2)
+    run_workloads(c, [IncrementWorkload(counters=3, actors=3, ops=8)])
