@@ -295,6 +295,54 @@ def probe_device(timeout):
     _log(f"device probe ok: {stdout.strip()}")
 
 
+def wait_for_device(out, errors, deadline):
+    """Retry the killable liveness probe until it succeeds or `deadline`
+    (time.perf_counter() units) passes.  The axon tunnel is known to be down
+    for stretches and come back (BENCH_r01/r03/r04 all lost the lottery with
+    a single-shot probe); a tunnel that comes up at minute 50 of the budget
+    must still yield a device number.  Emits a heartbeat JSON line per
+    attempt so the driver's last-line read always shows progress
+    (probe_attempts / probe_elapsed_s) alongside the best-so-far result.
+
+    Returns True when a probe succeeded, False when the budget ran out."""
+    # 240s per attempt: a healthy-but-slow tunnel can need minutes to answer
+    # (r2's successful init took ~2 min); a dead tunnel hangs and gets
+    # killed at the timeout, so the attempt cadence self-adjusts.
+    probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
+    interval = int(os.environ.get("BENCH_PROBE_INTERVAL", "90"))
+    t_start = time.perf_counter()
+    while True:
+        remaining = deadline - time.perf_counter()
+        if remaining <= 5:
+            out.setdefault("probe_last_error", "no attempt fit in budget")
+            return False
+        out["probe_attempts"] = out.get("probe_attempts", 0) + 1
+        t_attempt = time.perf_counter()
+        try:
+            probe_device(min(probe_timeout, max(10, int(remaining))))
+            out.pop("probe_last_error", None)
+            out["probe_elapsed_s"] = round(time.perf_counter() - t_start, 1)
+            return True
+        except Exception as e:
+            msg = f"{type(e).__name__}: {str(e)[-300:]}"
+            out["probe_last_error"] = msg
+            out["probe_elapsed_s"] = round(time.perf_counter() - t_start, 1)
+            _log(
+                f"device probe attempt {out['probe_attempts']} failed ({msg}); "
+                f"{deadline - time.perf_counter():.0f}s of budget left"
+            )
+            emit(out, errors)  # heartbeat: best-so-far + probe progress
+            # Cadence-based sleep: attempts START every `interval` seconds;
+            # an attempt that burned its timeout re-probes immediately.
+            attempt_dur = time.perf_counter() - t_attempt
+            sleep_s = min(
+                max(0, interval - attempt_dur),
+                max(0, deadline - time.perf_counter() - 10),
+            )
+            if sleep_s > 0:
+                time.sleep(sleep_s)
+
+
 def main():
     """Prints a full JSON result line after EVERY completed phase (C++
     baseline, Python CPU, device) — the driver's last-line read always sees
@@ -328,10 +376,48 @@ def main():
         errors.append(f"cpu: {type(e).__name__}: {e}")
     emit(out, errors)
     try:
-        probe_device(int(os.environ.get("BENCH_PROBE_TIMEOUT", "240")))
-        res = run_device_subprocess(
-            int(os.environ.get("BENCH_DEVICE_TIMEOUT", "1500"))
-        )
+        device_phase(out, errors, cpp_rate, cpu_rate)
+    except Exception as e:
+        errors.append(f"device: {type(e).__name__}: {e}")
+    emit(out, errors)
+
+
+def device_phase(out, errors, cpp_rate, cpu_rate):
+    """Whole-budget device phase: BENCH_DEVICE_TIMEOUT is the TOTAL
+    wall-clock budget for probe attempts AND bench runs, consumed by a
+    probe→run→(on failure) re-probe loop, so a tunnel that flaps after a
+    successful probe (the r2/r3 init-hang mode) re-enters probing with the
+    remaining budget instead of abandoning it.  A successful probe grants
+    the run at least BENCH_RUN_MIN — a probe succeeding at minute 50 still
+    gets a full run (the persistent .jax_cache makes the compile fast), at
+    worst overrunning into the driver's kill, which is safe because every
+    phase already emitted its best-so-far line."""
+    budget = int(os.environ.get("BENCH_DEVICE_TIMEOUT", "3600"))
+    # Per-run cap, NOT the whole remaining budget: a device subprocess that
+    # hangs in backend init (the r2/r3 mode) is killed after run_min so the
+    # loop actually gets to re-probe with what's left.  run_min is sized for
+    # a worst-case cold compile on this 1-core host.
+    run_min = int(os.environ.get("BENCH_RUN_MIN", "1500"))
+    max_runs = int(os.environ.get("BENCH_RUN_ATTEMPTS", "4"))
+    deadline = time.perf_counter() + budget
+    run_attempts = 0
+    last_err = None
+    while time.perf_counter() < deadline - 5 and run_attempts < max_runs:
+        if not wait_for_device(out, errors, deadline):
+            break
+        run_attempts += 1
+        out["run_attempts"] = run_attempts
+        try:
+            res = run_device_subprocess(run_min)
+        except Exception as e:
+            last_err = f"run attempt {run_attempts}: {type(e).__name__}: {e}"
+            _log(f"device {last_err}; "
+                 f"{deadline - time.perf_counter():.0f}s of budget left")
+            emit(out, errors)
+            # A fast deterministic crash must not spin probe->run: pause
+            # before re-probing (the probe itself sleeps only on failure).
+            time.sleep(min(30, max(0, deadline - time.perf_counter() - 10)))
+            continue
         out["platform"] = res.get("platform")
         jax_rate = res["jax_txns_per_sec"]
         out["value"] = jax_rate
@@ -341,9 +427,12 @@ def main():
             out["vs_baseline"] = round(jax_rate / cpp_rate, 3)
         elif cpu_rate:
             out["vs_baseline"] = round(jax_rate / cpu_rate, 3)
-    except Exception as e:
-        errors.append(f"device: {type(e).__name__}: {e}")
-    emit(out, errors)
+        return
+    raise RuntimeError(
+        f"no device number: {out.get('probe_attempts', 0)} probe attempts, "
+        f"{run_attempts} run attempts over {budget}s; "
+        f"last: {last_err or out.get('probe_last_error')}"
+    )
 
 
 if __name__ == "__main__":
