@@ -278,18 +278,10 @@ def probe_device(timeout):
     timeout.  A dead tunnel costs `timeout` seconds here instead of the full
     device-phase budget.  The child installs the group-reaping TERM handler
     so tunnel helper grandchildren die with it."""
-    from foundationdb_tpu.utils.procutil import run_killable
+    from foundationdb_tpu.utils.procutil import device_probe_argv, run_killable
 
     repo = os.path.dirname(os.path.abspath(__file__))
-    code = (
-        f"import sys; sys.path.insert(0, {repo!r}); "
-        "from foundationdb_tpu.utils.procutil import reap_group_on_term; "
-        "reap_group_on_term(); "
-        "import jax; print([str(d) for d in jax.devices()])"
-    )
-    rc, stdout, stderr = run_killable(
-        [sys.executable, "-c", code], timeout
-    )
+    rc, stdout, stderr = run_killable(device_probe_argv(repo), timeout)
     if rc != 0:
         raise RuntimeError(f"device probe failed: {stderr.strip()[-500:]}")
     _log(f"device probe ok: {stdout.strip()}")

@@ -127,6 +127,17 @@ class ServerKnobs(Knobs):
         # Batch-priority lane: same springs at this fraction of the targets
         # (ref: the separate batch limiter with lower TARGET_BYTES_*_BATCH).
         self._init("ratekeeper_batch_target_fraction", 0.5)
+        # Self-driving DataDistribution (ref: DataDistribution.actor.cpp
+        # teamTracker + DataDistributionTracker cadences + the queue's
+        # RELOCATION_PARALLELISM_PER_SOURCE_SERVER; byte thresholds are
+        # sim-scaled versions of SHARD_MAX_BYTES / SHARD_MIN_BYTES).
+        self._init("dd_ping_interval", 0.5)
+        self._init("dd_ping_timeout", 0.4)
+        self._init("dd_failure_detections", 4)  # consecutive misses
+        self._init("dd_tracker_interval", 2.0)
+        self._init("dd_move_parallelism", 2)
+        self._init("dd_shard_max_bytes", 1 << 20)
+        self._init("dd_shard_min_bytes", 16 << 10)
 
 
 class KnobSet:

@@ -159,6 +159,17 @@ class SimCluster:
         )
         return dd
 
+    def dd_role(self, dd=None):
+        """A started self-driving DataDistribution role over this cluster
+        (ref: the DD singleton control loop, DataDistribution.actor.cpp);
+        the DynamicCluster recruits one automatically — here tests opt in."""
+        from .dd_role import DataDistributionRole
+
+        return DataDistributionRole(
+            dd or self.data_distributor(),
+            tlogs=[t.interface() for t in self.tlogs],
+        ).start()
+
     def _start_roles_durable(self, epoch_begin: int):
         """(Re)build all roles from the machines' disks at a new epoch (the
         static stand-in for master recovery's recruitment; the real recovery

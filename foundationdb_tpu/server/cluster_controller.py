@@ -173,6 +173,17 @@ class ClusterController:
     async def _recovery(self):
         loop = self.process.network.loop
 
+        # Retire the old generation's DD singleton first: its proxies are
+        # (or are about to be) dead, and a heal move racing recruitment
+        # would thrash against the routing rebuild below.  A still-running
+        # startup task (seed commit parked on dead proxies) dies with it.
+        for t in list(self.process._tasks):
+            if t.name.endswith("cc_start_dd"):
+                t.cancel()
+        if getattr(self, "dd_role", None) is not None:
+            self.dd_role.stop()
+            self.dd_role = None
+
         # READING_CSTATE
         cstate = CoordinatedState(self.process, self.coordinators)
         raw = await cstate.read()
@@ -286,7 +297,8 @@ class ClusterController:
         }
         seq_w = self._pick_stateless(avoid=stateful_addrs)
         seq_if = await seq_w.init_role.get_reply(
-            self.process, InitSequencer(epoch_begin=recovery_version)
+            self.process,
+            InitSequencer(epoch_begin=recovery_version, epoch=self.generation),
         )
         # Pick the proxy workers FIRST so the resolver is told the exact
         # proxy count that will be recruited (its state-txn GC waits for
@@ -495,6 +507,22 @@ class ClusterController:
                 failure_monitor=self.failure_detector.ref(),
             )
         )
+        # Recruit the DataDistribution singleton for this generation: seed
+        # the authoritative `\xff/keyServers` + `\xff/serverList` map from
+        # the owned-meta entries when none exists (the master's
+        # RECOVERY_TRANSACTION seeding for new databases), then start the
+        # live control loop — team healing, split/merge cadence, rebalance
+        # queue (ref: DataDistribution.actor.cpp running under the master).
+        # Spawned, NOT awaited: the seed transaction commits through the
+        # new proxies, and a role dying right here would otherwise wedge
+        # recovery itself (retrying a commit no one serves) instead of
+        # letting _watch_roles notice and start the next generation.
+        self.process.spawn(
+            self._start_data_distribution(
+                proxy_ifs, storage_ifs, tlog_ifs, entries, server_list
+            ),
+            "cc_start_dd",
+        )
         # Watch `\xff/conf` for topology changes this generation can't
         # satisfy (ref: the CC recruiting a new generation when the
         # configuration's proxy count changes, changeConfig ->
@@ -511,6 +539,52 @@ class ClusterController:
         TraceEvent("RecoveryComplete").detail("generation", self.generation).detail(
             "recovery_version", recovery_version
         ).log()
+
+    async def _start_data_distribution(
+        self, proxy_ifs, storage_ifs, tlog_ifs, entries, server_list
+    ):
+        """Seed the authoritative shard map when absent, then recruit the
+        DD singleton for this generation (ref: dataDistribution running
+        under the master, DataDistribution.actor.cpp; seeding ref: the
+        RECOVERY_TRANSACTION for new databases, masterserver.actor.cpp:1158)."""
+        from ..client.transaction import Database
+        from . import system_keys as sk
+        from .data_distribution import DataDistributor
+        from .dd_role import DataDistributionRole
+
+        db = Database(
+            self.process, proxy_ifs[0], storage_ifs[0], proxies=list(proxy_ifs)
+        )
+
+        async def seed(tr):
+            tr.options["access_system_keys"] = True
+            rows = await tr.get_range(sk.KEY_SERVERS_PREFIX, sk.KEY_SERVERS_END)
+            if rows:
+                return
+            for sid, iface in server_list.items():
+                tr.set(sk.server_list_key(sid), sk.encode_server_entry(iface))
+            for sb, se, team in entries:
+                tr.set(
+                    sk.key_servers_key(sb),
+                    sk.encode_key_servers(list(team), [], se),
+                )
+
+        try:
+            await db.run(seed)
+        except ActorCancelled:
+            raise
+        except Exception as e:  # noqa: BLE001 - next generation retries
+            TraceEvent("DDSeedFailed", severity=20).detail(
+                "error", repr(e)
+            ).log()
+            return
+        dd = DataDistributor(db, storages=dict(server_list))
+        gen = self.generation
+        self.dd_role = DataDistributionRole(
+            dd,
+            tlogs=list(tlog_ifs),
+            active_fn=lambda: self.is_leader.get() and self.generation == gen,
+        ).start()
 
     async def _monitor_config(
         self, proxy_ifs, storage_if, generation: int, recruited_proxies: int

@@ -480,7 +480,7 @@ class Proxy:
         # masterserver getVersion :783).
         await self._batch_resolving.when_at_least(local_batch - 1)
         gv: GetCommitVersionReply = await self.sequencer.get_commit_version.get_reply(
-            self.process, None
+            self.process, self.epoch  # fenced: only this generation is served
         )
         version, prev = gv.version, gv.prev_version
         if ctx is not None:

@@ -20,8 +20,11 @@ from .interfaces import (
 
 
 class Sequencer:
-    def __init__(self, process: SimProcess, epoch_begin_version: int = 0):
+    def __init__(
+        self, process: SimProcess, epoch_begin_version: int = 0, epoch: int = 0
+    ):
         self.process = process
+        self.epoch = epoch
         self.version = epoch_begin_version  # last version handed out
         self.committed = NotifiedVersion(epoch_begin_version)
         self._last_grant_time = process.network.loop.now()
@@ -59,7 +62,17 @@ class Sequencer:
 
     async def _serve_commit_versions(self):
         while True:
-            _req, reply = await self._commit_stream.pop()
+            req_epoch, reply = await self._commit_stream.pop()
+            # Epoch fencing: a previous generation's proxy can still reach
+            # this stream (well-known token on a rebooted machine) — serving
+            # it would consume a (prev, version) pair whose batch the
+            # resolvers reject by THEIR epoch check, leaving a permanent
+            # hole in the prevVersion chain that wedges every later batch.
+            # The reference's master only serves proxies of its own
+            # registration (getVersion, masterserver.actor.cpp:783).
+            if req_epoch is not None and req_epoch != self.epoch:
+                reply.send_error("operation_failed")
+                continue
             version, prev = self._next_version()
             reply.send(GetCommitVersionReply(version=version, prev_version=prev))
 

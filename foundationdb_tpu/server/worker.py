@@ -36,6 +36,7 @@ class WorkerInterface:
 @dataclass
 class InitSequencer:
     epoch_begin: int = 0
+    epoch: int = 0  # generation, for grant fencing
 
 
 @dataclass
@@ -169,7 +170,11 @@ class WorkerServer:
 
         try:
             if isinstance(req, InitSequencer):
-                role = Sequencer(self.process, epoch_begin_version=req.epoch_begin)
+                role = Sequencer(
+                    self.process,
+                    epoch_begin_version=req.epoch_begin,
+                    epoch=req.epoch,
+                )
                 self._replace_role("sequencer", role, new_tasks())
                 reply.send(role.interface())
             elif isinstance(req, InitResolver):

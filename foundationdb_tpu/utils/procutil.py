@@ -64,6 +64,22 @@ def reap_group_on_term() -> None:
     signal.signal(signal.SIGTERM, _h)
 
 
+def device_probe_argv(repo_root):
+    """argv for a killable child that answers `jax.devices()` or dies at
+    the caller's timeout — the ONLY safe way to test TPU-tunnel liveness on
+    this host (in-process backend init can hang ~45 min).  Shared by
+    bench.py's probe loop and tools/tunnel_watch.py."""
+    import sys
+
+    code = (
+        f"import sys; sys.path.insert(0, {repo_root!r}); "
+        "from foundationdb_tpu.utils.procutil import reap_group_on_term; "
+        "reap_group_on_term(); "
+        "import jax; print([str(d) for d in jax.devices()])"
+    )
+    return [sys.executable, "-c", code]
+
+
 def run_killable(argv, timeout, stderr=None):
     """Run argv in its own session with a hard wall-clock timeout; on
     timeout SIGKILL the entire process group (pipes held open by helper

@@ -1,0 +1,284 @@
+"""Self-driving DataDistribution: the live control loop heals, splits,
+rebalances, and drains WITHOUT any test intervention.
+
+Ref: fdbserver/DataDistribution.actor.cpp:1237 (teamTracker),
+DataDistributionTracker.actor.cpp (split cadence),
+DataDistributionQueue.actor.cpp (priority move queue) — the acceptance
+shape the round-4 review asked for: kill a storage permanently and watch
+the cluster restore full team width on its own; write a hot shard and
+watch it split + rebalance on its own.
+"""
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.flow.knobs import g_knobs
+from foundationdb_tpu.server import SimCluster
+from foundationdb_tpu.server.interfaces import GetKeyValuesRequest
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+@pytest.fixture
+def fast_dd():
+    """Sim-scaled DD cadences/thresholds; restored after each test."""
+    saved = {
+        k: getattr(g_knobs.server, k)
+        for k in (
+            "dd_tracker_interval",
+            "dd_shard_max_bytes",
+            "dd_shard_min_bytes",
+            "dd_failure_detections",
+        )
+    }
+    g_knobs.server.dd_tracker_interval = 0.5
+    yield g_knobs.server
+    for k, v in saved.items():
+        setattr(g_knobs.server, k, v)
+
+
+def wait_until(c, db, cond_coro_fn, timeout_vt=300.0, interval=0.25):
+    """Advance virtual time until an async condition holds (the 'no test
+    intervention' driver: the test only *observes*)."""
+    result = {}
+
+    async def poll():
+        while True:
+            ok = await cond_coro_fn()
+            if ok:
+                result["ok"] = True
+                return
+            await c.loop.delay(interval)
+
+    c.run_until(db.process.spawn(poll()), timeout_vt=timeout_vt)
+    return result.get("ok", False)
+
+
+def fill(c, db, n=50, prefix=b"k"):
+    async def txn(tr):
+        for i in range(n):
+            tr.set(prefix + b"%03d" % i, b"v%d" % i)
+
+    c.run_all([(db, db.run(txn))])
+
+
+def place_teams(c, db, dd):
+    """Initial placement: two user shards on overlapping width-2 teams over
+    ss0..ss2; ss3 stays a spare."""
+
+    async def go():
+        await dd.register_storages(dd.storages)
+        await dd.seed(["ss0"])
+        await dd.split(b"k025")
+        await dd.split(b"\xff")
+        await dd.move(b"", ["ss0", "ss1"])
+        await dd.move(b"k025", ["ss1", "ss2"])
+
+    c.run_until(db.process.spawn(go()), timeout_vt=500.0)
+
+
+def shard_map_rows(c, db, dd):
+    return c.run_until(
+        db.process.spawn(dd.read_shard_map()), timeout_vt=200.0
+    )
+
+
+def shard_teams(c, db, dd):
+    return {
+        b: (set(team), set(dest))
+        for b, _e, team, dest in shard_map_rows(c, db, dd)
+    }
+
+
+def test_storage_kill_heals_without_intervention(fast_dd):
+    """Kill a replica of two width-2 shards permanently; the DD role alone
+    must declare it failed, pick the spare, and restore both shards to
+    width 2 — the test never calls heal()."""
+    c = SimCluster(seed=172, n_storages=4, n_tlogs=2)
+    db = c.database()
+    fill(c, db)
+    dd = c.data_distributor()
+    place_teams(c, db, dd)
+    role = c.dd_role(dd)
+
+    c.storages[1].process.kill()  # replica of BOTH user shards
+
+    async def healed():
+        user = [
+            (b, set(team), set(dest))
+            for b, _e, team, dest in await dd.read_shard_map()
+            if b < b"\xff"
+        ]
+        return user and all(
+            not dest and "ss1" not in team and len(team) == 2
+            for _b, team, dest in user
+        )
+
+    assert wait_until(c, db, healed, timeout_vt=600.0)
+    # At least one relocation was an explicit heal; the other may have been
+    # the count-rebalancer racing ahead of failure detection (both valid).
+    assert role.moves_done >= 2 and role.heals_done >= 1
+
+    # Every replica the heal recruited actually serves its shard's data.
+    version = c.proxy.committed.get()
+    by_id = {s.storage_id: s for s in c.storages}
+    for b, e, team, _dest in shard_map_rows(c, db, dd):
+        if b >= b"\xff":
+            continue
+        lo, hi = max(b, b"k"), min(e or b"\xff", b"l")
+        if lo >= hi:
+            continue
+        contents = []
+        for sid in team:
+            out = {}
+
+            async def direct(sid=sid, lo=lo, hi=hi, out=out):
+                rep = await by_id[sid].interface().get_key_values.get_reply(
+                    db.process,
+                    GetKeyValuesRequest(begin=lo, end=hi, version=version),
+                )
+                out["rows"] = rep.data
+
+            c.run_until(db.process.spawn(direct()), timeout_vt=200.0)
+            contents.append(out["rows"])
+        assert contents and all(r == contents[0] for r in contents)
+
+    # And the client still reads everything through normal routing.
+    rows = {}
+
+    async def read(tr):
+        rows["all"] = await tr.get_range(b"k", b"l")
+
+    c.run_all([(db, db.run(read))], timeout_vt=500.0)
+    assert len(rows["all"]) == 50
+    role.stop()
+
+
+def test_hot_shard_splits_and_rebalances(fast_dd):
+    """One team owns everything; a write-hot shard crosses the byte
+    threshold: the tracker must split it and the queue must move a half
+    onto the idle storage — on its own."""
+    fast_dd.dd_shard_max_bytes = 3000
+    fast_dd.dd_shard_min_bytes = 0  # merge off: tiny shards are the point
+    c = SimCluster(seed=173, n_storages=2)
+    db = c.database()
+    dd = c.data_distributor()
+
+    async def go():
+        await dd.register_storages(dd.storages)
+        await dd.seed(["ss0"])
+        await dd.split(b"\xff")
+
+    c.run_until(db.process.spawn(go()), timeout_vt=500.0)
+    role = c.dd_role(dd)
+
+    # Hot writes: enough sampled bytes to trip the 3000-byte threshold.
+    for j in range(4):
+        async def txn(tr, j=j):
+            for i in range(60):
+                tr.set(b"h%d%03d" % (j, i), b"x" * 40)
+
+        c.run_all([(db, db.run(txn))], timeout_vt=500.0)
+
+    async def rebalanced():
+        per = {}
+        for b, _e, team, dest in await dd.read_shard_map():
+            if b >= b"\xff" or dest:
+                continue
+            for sid in team:
+                per[sid] = per.get(sid, 0) + 1
+        return role.splits_done >= 1 and per.get("ss1", 0) >= 1
+
+    assert wait_until(c, db, rebalanced, timeout_vt=900.0)
+
+    rows = {}
+
+    async def read(tr):
+        rows["all"] = await tr.get_range(b"h", b"i")
+
+    c.run_all([(db, db.run(read))], timeout_vt=500.0)
+    assert len(rows["all"]) == 240
+    role.stop()
+
+
+def test_dynamic_cluster_dd_drops_dead_storage(fast_dd):
+    """Full control plane: the CC recruits the DD singleton each generation
+    and seeds `\xff/keyServers` from the owned meta.  A storage machine that
+    never returns is (a) recovered around after the grace (existing
+    behavior) and (b) scrubbed from the authoritative shard map by DD alone
+    — no operator, no test intervention (ref: teamTracker,
+    DataDistribution.actor.cpp:1237)."""
+    from foundationdb_tpu.server.data_distribution import DataDistributor
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+
+    c = DynamicCluster(seed=271, n_workers=7, n_tlogs=2, n_storages=2)
+    db = c.database()
+
+    async def w1(tr):
+        tr.set(b"boot", b"1")
+        for i in range(10):
+            tr.set(b"d%02d" % i, b"x%d" % i)
+
+    c.run_all([(db, db.run(w1))], timeout_vt=300.0)
+
+    dead_proc = c.kill_role_process("storage0")
+    dead_sid = f"ss:{dead_proc.machine.machine_id}"
+
+    # Commits resume after the degraded recovery (existing guarantee).
+    async def w2(tr):
+        tr.set(b"after", b"loss")
+
+    c.run_all([(db, db.run(w2))], timeout_vt=2000.0)
+
+    # DD (recruited by the CC, reading the seeded map) must scrub the dead
+    # id from every team on its own.
+    reader = DataDistributor(db)
+
+    async def scrubbed():
+        rows = await reader.read_shard_map()
+        return rows and all(
+            dead_sid not in set(team) | set(dest)
+            for _b, _e, team, dest in rows
+        )
+
+    assert wait_until(c, db, scrubbed, timeout_vt=900.0)
+
+    out = {}
+
+    async def readback(tr):
+        out["rows"] = await tr.get_range(b"d", b"e")
+
+    c.run_all([(db, db.run(readback))], timeout_vt=500.0)
+    assert len(out["rows"]) == 10
+
+
+def test_exclusion_drains_server(fast_dd):
+    """Writing an exclusion (the operator action) is all it takes: the DD
+    role observes `\xff/conf/excluded/...` and relocates every shard off
+    the excluded server."""
+    from foundationdb_tpu.client.management import exclude_servers
+
+    c = SimCluster(seed=174, n_storages=4, n_tlogs=2)
+    db = c.database()
+    fill(c, db)
+    dd = c.data_distributor()
+    place_teams(c, db, dd)
+    role = c.dd_role(dd)
+
+    c.run_all([(db, exclude_servers(db, ["ss1"]))], timeout_vt=200.0)
+
+    async def drained():
+        for _b, _e, team, dest in await dd.read_shard_map():
+            if "ss1" in set(team) | set(dest):
+                return False
+        return True
+
+    assert wait_until(c, db, drained, timeout_vt=600.0)
+    # ss1 is still alive — drain must not have used it as a spare either.
+    teams = shard_teams(c, db, dd)
+    assert all("ss1" not in t | d for t, d in teams.values())
+    role.stop()

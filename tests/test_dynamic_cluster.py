@@ -476,3 +476,37 @@ def test_permanent_storage_loss_recovers_from_teammate():
     c.run_all([(db, db.run(check2))], timeout_vt=2000.0)
     assert out2["v"] == b"second"
     assert dead.address not in c.acting_controller()._role_addrs.values()
+
+
+def test_sequencer_fences_stale_epoch_grants():
+    """A previous generation's proxy reaching the new sequencer (same
+    well-known token on a rebooted machine) must get an error, not a
+    version grant: serving it would punch a permanent hole in the
+    prevVersion chain and wedge every later batch at the resolvers (ref:
+    the master serving only its own registered proxies, getVersion
+    masterserver.actor.cpp:783)."""
+    from foundationdb_tpu.flow.eventloop import EventLoop, set_event_loop
+    from foundationdb_tpu.rpc.network import SimNetwork
+    from foundationdb_tpu.server.sequencer import Sequencer
+
+    loop = EventLoop(seed=5)
+    set_event_loop(loop)
+    net = SimNetwork(loop)
+    sp = net.process("seq")
+    client = net.process("client")
+    seq = Sequencer(sp, epoch_begin_version=100, epoch=2)
+    out = {}
+
+    async def go():
+        iface = seq.interface()
+        try:
+            await iface.get_commit_version.get_reply(client, 1)  # stale
+            out["stale"] = "granted"
+        except FdbError as e:
+            out["stale"] = e.name
+        rep = await iface.get_commit_version.get_reply(client, 2)  # current
+        out["current"] = (rep.version, rep.prev_version)
+
+    loop.run_until(client.spawn(go()), timeout_vt=50.0)
+    assert out["stale"] == "operation_failed"
+    assert out["current"][1] == 100 and out["current"][0] > 100

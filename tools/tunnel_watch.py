@@ -1,0 +1,53 @@
+"""Tunnel liveness watcher: probe the TPU backend in a killable subprocess
+on a cadence, appending one status line per attempt to .tunnel_probe.log,
+and exit 0 the moment a probe succeeds.
+
+Run under tmux/nohup during long build sessions; the log's last line tells
+whether the device is reachable without risking an in-process backend-init
+hang (the axon tunnel can block `jax.devices()` for ~45 min — see
+bench.py probe_device for the same pattern).
+"""
+
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from foundationdb_tpu.utils.procutil import (  # noqa: E402
+    device_probe_argv,
+    run_killable,
+)
+
+LOG = os.path.join(REPO, ".tunnel_probe.log")
+PROBE_TIMEOUT = int(os.environ.get("TUNNEL_PROBE_TIMEOUT", "240"))
+INTERVAL = int(os.environ.get("TUNNEL_PROBE_INTERVAL", "360"))
+
+
+def log(line):
+    stamp = time.strftime("%H:%M:%S")
+    with open(LOG, "a") as f:
+        f.write(f"{stamp} {line}\n")
+    print(f"{stamp} {line}", flush=True)
+
+
+def main():
+    attempt = 0
+    while True:
+        attempt += 1
+        t0 = time.perf_counter()
+        try:
+            rc, out, err = run_killable(device_probe_argv(REPO), PROBE_TIMEOUT)
+            if rc == 0:
+                log(f"UP attempt={attempt} {out.strip()}")
+                return 0
+            log(f"DOWN attempt={attempt} rc={rc} {err.strip()[-200:]}")
+        except Exception as e:
+            log(f"DOWN attempt={attempt} {type(e).__name__}: {e}")
+        spent = time.perf_counter() - t0
+        time.sleep(max(0, INTERVAL - spent))
+
+
+if __name__ == "__main__":
+    sys.exit(main())
