@@ -15,12 +15,13 @@ Design:
     run as virtual-time events anchored to time.monotonic(); when idle, the
     loop blocks in selectors.select() until the next timer or socket IO.
     This is Net2's reactor loop (boost.asio there, selectors here).
-  - Wire format: 4-byte big-endian length + pickle((token, payload)).
-    Requests are `_Envelope(request, reply_to)` like the simulator; replies
-    are (is_err, value) tuples to the one-shot reply endpoint.  Pickle
-    stands in for the reference's versioned binary serialization — fine for
-    a trusted cluster, NOT a security boundary (the reference's wire
-    protocol isn't either; TLS wraps it).
+  - Wire format: 4-byte big-endian length + the versioned tagged binary
+    codec in rpc/wire.py encoding (token, payload).  Requests are
+    `_Envelope(request, reply_to)` like the simulator; replies are
+    (is_err, value) tuples to the one-shot reply endpoint.  Decoding
+    constructs data only (registered protocol structs) — a malformed or
+    unknown frame closes the connection loudly, never executes (ref: the
+    versioned struct serialization in flow/serialize.h:80).
   - Connection lifecycle: lazy connect on first send, write-queue until
     established, reconnect-on-next-send after failure.  A closed/failed
     connection breaks every reply promise pending on that peer
@@ -30,7 +31,6 @@ Design:
 
 from __future__ import annotations
 
-import pickle
 import selectors
 import socket
 import ssl
@@ -41,13 +41,15 @@ from typing import Callable, Dict, List, Optional
 from ..flow.error import FdbError
 from ..flow.eventloop import EventLoop, Task, TaskPriority
 from ..flow.trace import TraceEvent
+from .wire import WireDecodeError, decode_frame, encode_frame
 
 _LEN = struct.Struct(">I")
 MAX_FRAME = 64 << 20
 # Wire protocol version, exchanged in the hello frame (ref: the
 # ProtocolVersion constant in ConnectPacket — bump on incompatible wire
-# changes; mismatched peers are rejected at connect, loudly).
-PROTOCOL_VERSION = b"FDBTPU-0x0FDB00B071000001"
+# changes; mismatched peers are rejected at connect, loudly).  B072 is the
+# tagged-binary codec (rpc/wire.py) replacing pickle frames.
+PROTOCOL_VERSION = b"FDBTPU-0x0FDB00B072000001"
 
 
 class RealMachine:
@@ -375,7 +377,7 @@ class RealNetwork:
 
             self.loop._schedule(priority, deliver)
             return
-        frame = pickle.dumps((dst.token, payload), protocol=4)
+        frame = encode_frame((dst.token, payload))
         if len(frame) > MAX_FRAME:
             raise ValueError("frame too large")
         self._get_conn(dst.address).enqueue(frame)
@@ -550,8 +552,16 @@ class RealNetwork:
                 self._conns[conn.peer] = conn
                 continue
             try:
-                token, payload = pickle.loads(frame)
-            except Exception:  # noqa: BLE001 - corrupt frame: drop conn
+                decoded = decode_frame(frame)
+                token, payload = decoded
+                if not isinstance(token, int):
+                    raise WireDecodeError("token not an int")
+            except (WireDecodeError, TypeError, ValueError) as e:
+                # Corrupt or incompatible frame: drop the connection loudly
+                # (decode constructs data only — nothing executed).
+                TraceEvent("WireDecodeFailed", severity=30).detail(
+                    "peer", conn.peer
+                ).detail("error", str(e)[:200]).log()
                 conn.close()
                 return
             self._deliver_local(token, payload)

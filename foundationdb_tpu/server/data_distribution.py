@@ -91,14 +91,21 @@ class DataDistributor:
     async def split(self, at_key: bytes):
         """Split the shard containing at_key into two (metadata only; no
         data movement — both halves stay on the same team).  Ref:
-        shardSplitter DataDistributionTracker.actor.cpp."""
-        shards = await self.read_shard_map()
-        for b, e, team, dest in shards:
-            if b < at_key and (at_key < e):
-                assert not dest, "split during a move is not supported (v1)"
+        shardSplitter DataDistributionTracker.actor.cpp.
 
-                async def txn(tr, b=b, e=e, team=team):
-                    tr.options["access_system_keys"] = True
+        The containing record is READ INSIDE the transaction (ref:
+        startMoveKeys reading keyServers in-txn, MoveKeys.actor.cpp): a
+        concurrent move/merge/split conflicts and retries this txn against
+        the fresh map instead of being silently overwritten."""
+
+        async def txn(tr):
+            tr.options["access_system_keys"] = True
+            rows = await tr.get_range(sk.KEY_SERVERS_PREFIX, sk.KEY_SERVERS_END)
+            for k, v in rows:
+                b = sk.key_servers_begin(k)
+                team, dest, e = sk.decode_key_servers(v)
+                if b < at_key and (e is None or at_key < e):
+                    assert not dest, "split during a move is not supported (v1)"
                     tr.set(
                         sk.key_servers_key(b),
                         sk.encode_key_servers(team, [], at_key),
@@ -107,40 +114,63 @@ class DataDistributor:
                         sk.key_servers_key(at_key),
                         sk.encode_key_servers(team, [], e),
                     )
+                    return
+            # at_key already a boundary (or outside the map): nothing to do.
 
-                await self.db.run(txn)
-                return
-        # at_key is already a boundary (or outside the map): nothing to do.
+        await self.db.run(txn)
 
     async def move(self, begin: bytes, dest_team: List[str],
                    poll_interval: float = 0.05, max_polls: int = 2000):
         """Move the shard beginning at `begin` to `dest_team`: startMove
         record -> wait for every destination to report FETCHED -> settle
         (ref: startMoveKeys / waitForShardReady / finishMoveKeys,
-        MoveKeys.actor.cpp)."""
-        b, e, team, dest = await self._shard_at(begin)
-        if dest and set(dest) == set(dest_team):
-            pass  # same move already in flight; re-drive it to done
-        elif not dest and set(team) == set(dest_team):
-            return
-        else:
+        MoveKeys.actor.cpp).
+
+        Both metadata transactions READ the record in-txn before writing,
+        so a split/merge/other-move committing between this actor's steps
+        conflicts (and retries against fresh state) or raises ValueError
+        (shard gone / move superseded) instead of resurrecting a stale
+        end-key into the map — the exact overwrite hazard the reference
+        avoids the same way (MoveKeys.actor.cpp startMoveKeys reads
+        keyServers inside the transaction)."""
+
+        async def start(tr):
+            tr.options["access_system_keys"] = True
+            raw = await tr.get(sk.key_servers_key(begin))
+            if raw is None:
+                raise ValueError(f"no shard begins at {begin!r}")
+            team, dest, e = sk.decode_key_servers(raw)
+            if dest and set(dest) == set(dest_team):
+                return ("drive", e)  # same move in flight; re-drive to done
+            if not dest and set(team) == set(dest_team):
+                return ("done", e)
             # Fresh move, or superseding an in-flight move whose destination
             # changed (e.g. heal() retargeting after a dest died): rewrite
             # the start record; destinations cancel stale AddingShards.
-            async def start(tr):
-                tr.options["access_system_keys"] = True
-                tr.set(
-                    sk.key_servers_key(b),
-                    sk.encode_key_servers(team, dest_team, e),
-                )
+            tr.set(
+                sk.key_servers_key(begin),
+                sk.encode_key_servers(team, dest_team, e),
+            )
+            return ("drive", e)
 
-            await self.db.run(start)
+        state, e = await self.db.run(start)
+        if state == "done":
+            return
 
-        await self._wait_fetched(b, e, dest_team, poll_interval, max_polls)
+        await self._wait_fetched(begin, e, dest_team, poll_interval, max_polls)
 
         async def finish(tr):
             tr.options["access_system_keys"] = True
-            tr.set(sk.key_servers_key(b), sk.encode_key_servers(dest_team, [], e))
+            raw = await tr.get(sk.key_servers_key(begin))
+            if raw is None:
+                raise ValueError(f"shard {begin!r} vanished mid-move")
+            _team, dest, e2 = sk.decode_key_servers(raw)
+            if set(dest) != set(dest_team):
+                raise ValueError(f"move of {begin!r} superseded")
+            tr.set(
+                sk.key_servers_key(begin),
+                sk.encode_key_servers(dest_team, [], e2),
+            )
 
         await self.db.run(finish)
 
@@ -170,24 +200,30 @@ class DataDistributor:
                 # entries, because a destination that rejoined fresh at the
                 # current version never saw the original serverList writes
                 # and cannot resolve its fetch sources without them (ref:
-                # the serverListKeys rows re-read by fetchKeys).
-                b2, e2, team, dest = await self._shard_at(begin)
-                if dest:
-                    async def restart(tr, b2=b2, e2=e2, team=team, dest=dest):
-                        tr.options["access_system_keys"] = True
-                        for sid in set(team) | set(dest):
-                            iface = self.storages.get(sid)
-                            if iface is not None:
-                                tr.set(
-                                    sk.server_list_key(sid),
-                                    sk.encode_server_entry(iface),
-                                )
-                        tr.set(
-                            sk.key_servers_key(b2),
-                            sk.encode_key_servers(team, dest, e2),
-                        )
+                # the serverListKeys rows re-read by fetchKeys).  Read
+                # in-txn: a superseding move between poll and rewrite must
+                # not be clobbered with this attempt's stale record.
+                async def restart(tr):
+                    tr.options["access_system_keys"] = True
+                    raw = await tr.get(sk.key_servers_key(begin))
+                    if raw is None:
+                        return
+                    team, dest, e2 = sk.decode_key_servers(raw)
+                    if not dest:
+                        return
+                    for sid in set(team) | set(dest):
+                        iface = self.storages.get(sid)
+                        if iface is not None:
+                            tr.set(
+                                sk.server_list_key(sid),
+                                sk.encode_server_entry(iface),
+                            )
+                    tr.set(
+                        sk.key_servers_key(begin),
+                        sk.encode_key_servers(team, dest, e2),
+                    )
 
-                    await self.db.run(restart)
+                await self.db.run(restart)
             await self.loop.delay(poll_interval)
         raise TimeoutError(f"shard [{begin!r}, {end!r}) never became fetched")
 
@@ -335,16 +371,31 @@ class DataDistributor:
                 i += 1
                 continue
 
-            async def merge_txn(tr, b1=b1, b2=b2, e2=e2, team=t1):
+            async def merge_txn(tr, b1=b1, b2=b2):
                 tr.options["access_system_keys"] = True
+                # Re-validate in-txn (a concurrent move/split between the
+                # sampling reads and this commit must abort the merge, not
+                # be overwritten).
+                raw1 = await tr.get(sk.key_servers_key(b1))
+                raw2 = await tr.get(sk.key_servers_key(b2))
+                if raw1 is None or raw2 is None:
+                    return False
+                t1x, d1x, e1x = sk.decode_key_servers(raw1)
+                t2x, d2x, e2x = sk.decode_key_servers(raw2)
+                if d1x or d2x or e1x != b2 or set(t1x) != set(t2x):
+                    return False
                 # One record covers the union; the boundary record clears.
                 tr.set(
                     sk.key_servers_key(b1),
-                    sk.encode_key_servers(list(team), [], e2),
+                    sk.encode_key_servers(list(t1x), [], e2x),
                 )
                 tr.clear(sk.key_servers_key(b2))
+                return True
 
-            await self.db.run(merge_txn)
+            if not await self.db.run(merge_txn):
+                i += 1
+                carry = None
+                continue
             absorbed.append(b2)
             # The merged shard may merge again with its next neighbor.
             shard_map = await self.read_shard_map()

@@ -347,20 +347,37 @@ class DataDistributionRole:
                 TraceEvent("DDExclusionObserved").detail("id", sid).log()
             self.excluded = now_excluded
             unregistered &= now_excluded  # re-included: registration is live
-            # Convergent, not edge-triggered: keep retrying the tag
-            # unregister (so an unreachable tlog can't permanently pin its
-            # discard floor on an excluded server's persisted pop floor).
-            for sid in sorted(now_excluded - unregistered):
-                ok = True
-                for tl in self.tlogs:
-                    try:
-                        await tl.pop.get_reply(
-                            self.process, TLogPopRequest(tag=sid, unregister=True)
-                        )
-                    except FdbError:
-                        ok = False
-                if ok:
-                    unregistered.add(sid)
+            # Unregister a tag only AFTER the team tracker finished draining
+            # the server out of the shard map (ref: removeStorageServer at
+            # exclusion completion, not observation — unregistering a
+            # still-serving member would let the logs trim entries it has
+            # not applied).  Convergent: retried every round until every
+            # tlog acked, so an unreachable tlog can't permanently pin its
+            # discard floor on the excluded server's persisted pop floor.
+            pending = sorted(now_excluded - unregistered)
+            if pending:
+                try:
+                    shard_map = await self.dd.read_shard_map()
+                except (FdbError, TimeoutError):
+                    await self.loop.delay(self.tracker_interval)
+                    continue
+                still_member = set()
+                for _b, _e, team, dest in shard_map:
+                    still_member |= set(team) | set(dest)
+                for sid in pending:
+                    if sid in still_member:
+                        continue  # drain in progress
+                    ok = True
+                    for tl in self.tlogs:
+                        try:
+                            await tl.pop.get_reply(
+                                self.process,
+                                TLogPopRequest(tag=sid, unregister=True),
+                            )
+                        except FdbError:
+                            ok = False
+                    if ok:
+                        unregistered.add(sid)
             await self.loop.delay(self.tracker_interval)
 
     # --- the relocation queue (ref: DataDistributionQueue.actor.cpp) ---

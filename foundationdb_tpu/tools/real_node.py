@@ -212,6 +212,77 @@ def run_client(
     print(f"DONE {out['count']}", flush=True)
 
 
+def run_ntserver(port: int, tls=None) -> None:
+    """RPC echo server (ref: networktestServer, networktest.actor.cpp:40 —
+    `fdbserver -r networktestserver`): answers each request with its
+    payload, characterizing the fabric + codec end to end."""
+    loop = EventLoop(seed=1)
+    set_event_loop(loop)
+    net = RealNetwork(loop, port=port, tls=tls)
+    proc = net.process("ntserver")
+    stream = RequestStream(proc, "networktest", well_known=True)
+
+    async def serve():
+        while True:
+            payload, reply = await stream.pop()
+            reply.send(payload)
+
+    proc.spawn(serve(), "networktest_serve")
+    print(f"READY {net.address}", flush=True)
+    net.run_realtime()
+
+
+def run_ntclient(server: str, requests: int, parallel: int, size: int,
+                 tls=None) -> None:
+    """Closed-loop throughput driver (ref: networktestClient,
+    networktest.actor.cpp:57): `parallel` workers each keep one request in
+    flight until `requests` total complete; prints one JSON line with
+    req/s and payload MB/s."""
+    import json
+    import time as _time
+
+    loop = EventLoop(seed=2)
+    set_event_loop(loop)
+    net = RealNetwork(loop, tls=tls)
+    proc = net.process("ntclient")
+    ref = RequestStreamRef(
+        Endpoint(server, well_known_token("networktest")), "networktest"
+    )
+    payload = b"x" * size
+    done = {"n": 0}
+
+    async def worker():
+        while done["n"] < requests:
+            done["n"] += 1
+            got = await ref.get_reply(proc, payload)
+            assert got == payload
+
+    async def main():
+        # One warm-up round trip so connect/TLS handshake stays out of the
+        # timed region, as the reference's warmup phase does.
+        await ref.get_reply(proc, b"warm")
+        t0 = _time.monotonic()
+        from ..flow.eventloop import wait_for_all
+
+        await wait_for_all(
+            [proc.spawn(worker(), f"nt{i}") for i in range(parallel)]
+        )
+        dt = _time.monotonic() - t0
+        return {
+            "metric": "rpc_requests_per_sec",
+            "value": round(requests / dt, 1),
+            "unit": "req/s",
+            "payload_bytes": size,
+            "parallel": parallel,
+            "mb_per_sec": round(requests * size / dt / 1e6, 2),
+            "tls": tls is not None,
+        }
+
+    task = proc.spawn(main(), "nt_main")
+    out = net.run_realtime(until=task, timeout_s=120.0)
+    print(json.dumps(out), flush=True)
+
+
 def main(argv=None):
     ap = argparse.ArgumentParser()
     sub = ap.add_subparsers(dest="mode", required=True)
@@ -230,9 +301,25 @@ def main(argv=None):
     c.add_argument("--ops", type=int, default=20)
     c.add_argument("--check-count", type=int, default=-1)
     _add_tls_args(c)
+    ns = sub.add_parser("ntserver")
+    ns.add_argument("--port", type=int, default=0)
+    _add_tls_args(ns)
+    nc = sub.add_parser("ntclient")
+    nc.add_argument("server")
+    nc.add_argument("--requests", type=int, default=5000)
+    nc.add_argument("--parallel", type=int, default=16)
+    nc.add_argument("--size", type=int, default=128)
+    _add_tls_args(nc)
     args = ap.parse_args(argv)
     if args.mode == "server":
         run_server(args.port, datadir=args.datadir, tls=_tls_config(args))
+    elif args.mode == "ntserver":
+        run_ntserver(args.port, tls=_tls_config(args))
+    elif args.mode == "ntclient":
+        run_ntclient(
+            args.server, args.requests, args.parallel, args.size,
+            tls=_tls_config(args),
+        )
     else:
         run_client(
             args.server, args.id, args.ops, args.check_count,

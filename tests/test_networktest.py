@@ -1,0 +1,66 @@
+"""RPC throughput characterization of the real TCP fabric + wire codec.
+
+Ref: fdbserver/networktest.actor.cpp (`-r networktestserver` /
+`-r networktestclient`) — the reference's tool for measuring raw
+FlowTransport request/reply throughput, so serialization changes have a
+number.  CI mode keeps the run small and asserts only sanity floors; the
+measured rate is printed for the log.
+"""
+
+import json
+import signal
+import subprocess
+import sys
+
+import pytest
+
+from conftest import spawn_real_node
+from test_tls import make_ca, make_cert
+
+
+@pytest.fixture(scope="module")
+def tls_material(tmp_path_factory):
+    d = str(tmp_path_factory.mktemp("nt_tls"))
+    ca_key, ca_crt = make_ca(d, "nt-ca")
+    key, crt = make_cert(d, "nt-node", ca_key, ca_crt)
+    return crt, key, ca_crt
+
+
+def _run_pair(extra_server=(), extra_client=()):
+    server = spawn_real_node("ntserver", *extra_server)
+    try:
+        ready = server.stdout.readline().strip()
+        assert ready.startswith("READY "), ready
+        addr = ready.split()[1]
+        client = spawn_real_node(
+            "ntclient", addr, "--requests", "3000", "--parallel", "16",
+            "--size", "128", *extra_client,
+        )
+        out, _ = client.communicate(timeout=90)
+        assert client.returncode == 0, out
+        line = [ln for ln in out.splitlines() if ln.startswith("{")][-1]
+        return json.loads(line)
+    finally:
+        server.send_signal(signal.SIGTERM)
+        try:
+            server.wait(timeout=5)
+        except subprocess.TimeoutExpired:
+            server.kill()
+
+
+def test_networktest_throughput_plaintext():
+    res = _run_pair()
+    print(f"\nnetworktest plaintext: {res}", file=sys.stderr)
+    assert res["metric"] == "rpc_requests_per_sec"
+    # Sanity floor only (CI hosts vary); the real number goes to the log.
+    assert res["value"] > 300, res
+    assert res["tls"] is False
+
+
+def test_networktest_throughput_tls(tls_material):
+    cert, key, ca = tls_material
+    args = ["--tls-cert", cert, "--tls-key", key, "--tls-ca", ca]
+    res = _run_pair(extra_server=args, extra_client=args)
+    print(f"\nnetworktest mTLS: {res}", file=sys.stderr)
+    assert res["value"] > 200, res
+    assert res["tls"] is True

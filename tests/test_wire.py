@@ -1,0 +1,260 @@
+"""The versioned tagged binary wire codec (rpc/wire.py).
+
+Ref: flow/serialize.h:80-188 — every struct versioned, unknown data
+rejected loudly.  The decoder is driven with valid frames, evolved
+schemas, and a mutation fuzzer: malformed bytes must raise WireDecodeError
+and nothing else (decode constructs data, never executes).
+"""
+
+import dataclasses
+
+import pytest
+
+from foundationdb_tpu.client.types import (
+    CommitTransactionRef,
+    Mutation,
+    MutationType,
+)
+from foundationdb_tpu.rpc.network import Endpoint
+from foundationdb_tpu.rpc.stream import RequestStreamRef, _Envelope
+from foundationdb_tpu.rpc.wire import (
+    WIRE_VERSION,
+    WireDecodeError,
+    WireEncodeError,
+    decode_frame,
+    encode_frame,
+)
+from foundationdb_tpu.server.interfaces import (
+    CommitTransactionRequest,
+    GetKeyValuesRequest,
+    GetStorageMetricsReply,
+    StorageInterface,
+)
+
+
+def roundtrip(v):
+    out = decode_frame(encode_frame(v))
+    assert out == v, (out, v)
+    return out
+
+
+def test_primitives_roundtrip():
+    for v in (
+        None,
+        True,
+        False,
+        0,
+        1,
+        -1,
+        2**40,
+        -(2**40),
+        2**100,
+        0.0,
+        -1.5,
+        float("inf"),
+        b"",
+        b"\x00\xff" * 100,
+        "",
+        "héllo ☃",
+        [],
+        [1, [2, [3, b"x"]]],
+        (),
+        (1, "two", b"three", None),
+        {},
+        {b"k": [1, 2], "s": {"nested": True}, 7: None},
+    ):
+        roundtrip(v)
+
+
+def test_nan_roundtrip():
+    import math
+
+    out = decode_frame(encode_frame(float("nan")))
+    assert math.isnan(out)
+
+
+def test_structs_and_enums_roundtrip():
+    ep = Endpoint(address="10.0.0.1:4500", token=(1 << 40) | 1234)
+    ref = RequestStreamRef(endpoint=ep, name="commit")
+    tr = CommitTransactionRef(
+        read_snapshot=7,
+        read_conflict_ranges=[(b"a", b"b")],
+        write_conflict_ranges=[(b"a", b"a\x00")],
+        mutations=[Mutation(type=MutationType.SET_VALUE, param1=b"a", param2=b"v")],
+    )
+    req = CommitTransactionRequest(transaction=tr)
+    env = _Envelope(request=req, reply_to=ep)
+    out = roundtrip(env)
+    m = out.request.transaction.mutations[0]
+    assert isinstance(m.type, MutationType) and m.type is MutationType.SET_VALUE
+    roundtrip(ref)
+    roundtrip(
+        StorageInterface(storage_id="ss0", get_value=ref, get_version=ref)
+    )
+    roundtrip(GetKeyValuesRequest(begin=b"a", end=b"z", version=12))
+    roundtrip((False, GetStorageMetricsReply(bytes=10, split_key=None)))
+    roundtrip((True, "broken_promise"))
+
+
+def test_unregistered_class_rejected_at_encode():
+    @dataclasses.dataclass
+    class NotOnTheWire:
+        x: int = 1
+
+    with pytest.raises(WireEncodeError):
+        encode_frame(NotOnTheWire())
+    with pytest.raises(WireEncodeError):
+        encode_frame(object())
+
+
+def test_wire_version_gate():
+    frame = bytearray(encode_frame(42))
+    frame[0] = WIRE_VERSION + 1
+    with pytest.raises(WireDecodeError):
+        decode_frame(bytes(frame))
+
+
+def test_schema_evolution_fewer_fields_fill_defaults():
+    """An old peer omitting newly added trailing fields decodes with the
+    dataclass defaults (positional count-prefixed encoding)."""
+    full = encode_frame(GetKeyValuesRequest(begin=b"a", end=b"z"))
+    # Re-encode by hand with only the first 2 fields: find the varint field
+    # count right after the struct tag+id and truncate the value stream.
+    # Easier: build from a 2-field struct of identical name is impossible —
+    # instead patch the count byte and drop the tail values.
+    import foundationdb_tpu.rpc.wire as wire
+
+    cid = wire._class_id("GetKeyValuesRequest")
+    flds = wire._structs_by_id[cid][1]
+    assert len(flds) >= 3
+    out = [bytes((wire.WIRE_VERSION, wire.T_STRUCT))]
+    out.append(wire._U16.pack(cid))
+    wire._enc_varint(out, 2)
+    wire._encode(out, b"a", 1)
+    wire._encode(out, b"z", 1)
+    got = decode_frame(b"".join(out))
+    assert got.begin == b"a" and got.end == b"z"
+    assert got.version == dataclasses.fields(GetKeyValuesRequest)[2].default
+    assert full  # silence unused
+
+
+def test_schema_evolution_more_fields_rejected():
+    import foundationdb_tpu.rpc.wire as wire
+
+    cid = wire._class_id("GetKeyValuesRequest")
+    n = len(wire._structs_by_id[cid][1])
+    out = [bytes((wire.WIRE_VERSION, wire.T_STRUCT))]
+    out.append(wire._U16.pack(cid))
+    wire._enc_varint(out, n + 1)
+    for _ in range(n + 1):
+        wire._encode(out, None, 1)
+    with pytest.raises(WireDecodeError):
+        decode_frame(b"".join(out))
+
+
+def test_pickle_frames_rejected():
+    import pickle
+
+    evil = pickle.dumps((123, "payload"), protocol=4)
+    with pytest.raises(WireDecodeError):
+        decode_frame(evil)
+
+
+def test_decoder_fuzz_never_escapes_wiredecodeerror():
+    """Mutation + truncation + random-soup fuzz: decode either succeeds or
+    raises WireDecodeError — no other exception type, no side effects."""
+    import numpy as np
+
+    rng = np.random.default_rng(20260730)
+    ep = Endpoint(address="h:1", token=99)
+    seeds = [
+        encode_frame(v)
+        for v in (
+            _Envelope(
+                request=CommitTransactionRequest(
+                    transaction=CommitTransactionRef(
+                        mutations=[
+                            Mutation(MutationType.SET_VALUE, b"k" * 30, b"v" * 100)
+                        ]
+                    )
+                ),
+                reply_to=ep,
+            ),
+            (7, [(b"k", b"v")] * 10),
+            {b"a": 1, "b": [Endpoint("x:2", 3)]},
+        )
+    ]
+    checked = 0
+    for _ in range(4000):
+        base = bytearray(seeds[int(rng.integers(len(seeds)))])
+        mode = int(rng.integers(3))
+        if mode == 0:  # point mutations
+            for _ in range(int(rng.integers(1, 8))):
+                base[int(rng.integers(len(base)))] = int(rng.integers(256))
+            frame = bytes(base)
+        elif mode == 1:  # truncate / extend
+            cut = int(rng.integers(len(base) + 1))
+            frame = bytes(base[:cut]) + bytes(
+                rng.integers(0, 256, int(rng.integers(4)), dtype=np.uint8)
+            )
+        else:  # pure random soup
+            frame = bytes(
+                rng.integers(0, 256, int(rng.integers(1, 200)), dtype=np.uint8)
+            )
+        try:
+            decode_frame(frame)
+        except WireDecodeError:
+            pass
+        # anything else propagates and fails the test
+        checked += 1
+    assert checked == 4000
+
+
+def test_huge_length_prefixes_bounded():
+    """A crafted frame claiming a giant collection must error, not
+    allocate: lengths are checked against the remaining frame bytes."""
+    import foundationdb_tpu.rpc.wire as wire
+
+    out = [bytes((wire.WIRE_VERSION, wire.T_LIST))]
+    wire._enc_varint(out, 1 << 60)
+    with pytest.raises(WireDecodeError):
+        decode_frame(b"".join(out))
+    out = [bytes((wire.WIRE_VERSION, wire.T_BYTES))]
+    wire._enc_varint(out, 1 << 60)
+    with pytest.raises(WireDecodeError):
+        decode_frame(b"".join(out))
+
+
+def test_depth_bounded():
+    deep = None
+    for _ in range(200):
+        deep = [deep]
+    with pytest.raises(WireEncodeError):
+        encode_frame(deep)
+    frame = bytes((WIRE_VERSION,)) + bytes([7, 1]) * 200  # nested 1-lists
+    with pytest.raises(WireDecodeError):
+        decode_frame(frame)
+
+
+def test_resolver_batch_roundtrip():
+    """The proxy->resolver hot-path request (embeds conflict-engine types
+    from a third module) must be in the wire vocabulary — the first
+    cross-process proxy/resolver deployment sends it on every commit."""
+    from foundationdb_tpu.conflict.types import TransactionConflictInfo
+    from foundationdb_tpu.server.interfaces import (
+        ResolveTransactionBatchRequest,
+    )
+
+    req = ResolveTransactionBatchRequest(
+        prev_version=10,
+        version=20,
+        transactions=[
+            TransactionConflictInfo(
+                read_snapshot=5,
+                read_ranges=[(b"a", b"b")],
+                write_ranges=[(b"c", b"d")],
+            )
+        ],
+        proxy_id="proxy0",
+    )
+    roundtrip(req)
