@@ -115,7 +115,6 @@ def _build_registry():
     global _built
     if _built:
         return
-    _built = True
     from ..client import types as client_types
     from ..conflict import types as conflict_types
     from ..server import (
@@ -152,6 +151,10 @@ def _build_registry():
                     register_struct(obj)
                 elif issubclass(obj, IntEnum):
                     register_enum(obj)
+    # Marked ONLY after full success: a failed first build (import cycle,
+    # broken module) must surface its real error on every call, not decay
+    # into "unregistered struct" against a half-empty registry.
+    _built = True
 
 
 # --- encoding -------------------------------------------------------------

@@ -81,12 +81,6 @@ class DataDistributor:
             out.append((sk.key_servers_begin(k), end, src, dest))
         return out
 
-    async def _shard_at(self, begin: bytes):
-        for b, e, team, dest in await self.read_shard_map():
-            if b == begin:
-                return b, e, team, dest
-        raise ValueError(f"no shard begins at {begin!r}")
-
     # --- operations ---
     async def split(self, at_key: bytes):
         """Split the shard containing at_key into two (metadata only; no
@@ -100,7 +94,15 @@ class DataDistributor:
 
         async def txn(tr):
             tr.options["access_system_keys"] = True
-            rows = await tr.get_range(sk.KEY_SERVERS_PREFIX, sk.KEY_SERVERS_END)
+            # Only the CONTAINING record (greatest begin <= at_key) joins
+            # the read set: a full-map scan would conflict this split with
+            # every unrelated DD metadata write and rescan O(map) per retry.
+            rows = await tr.get_range(
+                sk.KEY_SERVERS_PREFIX,
+                sk.key_servers_key(at_key) + b"\x00",
+                limit=1,
+                reverse=True,
+            )
             for k, v in rows:
                 b = sk.key_servers_begin(k)
                 team, dest, e = sk.decode_key_servers(v)
@@ -114,7 +116,6 @@ class DataDistributor:
                         sk.key_servers_key(at_key),
                         sk.encode_key_servers(team, [], e),
                     )
-                    return
             # at_key already a boundary (or outside the map): nothing to do.
 
         await self.db.run(txn)

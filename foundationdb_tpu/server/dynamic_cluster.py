@@ -178,7 +178,9 @@ class DynamicCluster:
         the acting controller).  Unsuffixed stateful names alias the first
         instance ("tlog" -> "tlog0")."""
         cc = self.acting_controller()
-        addrs = cc._role_addrs
+        # Empty before the first recruitment finishes; KeyError then (the
+        # caller treats it as "role not recruited yet").
+        addrs = getattr(cc, "_role_addrs", {})
         addr = addrs.get(role) or addrs[role + "0"]
         proc = self.net.get_process(addr)
         proc.kill()

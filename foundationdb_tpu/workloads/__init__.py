@@ -9,7 +9,13 @@ and the trailing consistency check).
 
 from .base import TestWorkload, run_workloads
 from .cycle import CycleWorkload
-from .invariants import AtomicOpsWorkload, SerializabilityWorkload
+from .invariants import AtomicLedgerWorkload, WriteSkewWorkload
+from .atomic_ops import AtomicOpsWorkload
+from .serializability import SerializabilityWorkload
+from .versionstamp import VersionStampWorkload
+from .configure_db import ConfigureDatabaseWorkload
+from .remove_servers import RemoveServersSafelyWorkload
+from .targeted_kill import TargetedKillWorkload
 from .chaos import AttritionWorkload, RandomCloggingWorkload
 from .consistency import ConsistencyChecker, check_consistency
 from .config import SimulationConfig
@@ -27,8 +33,14 @@ __all__ = [
     "TestWorkload",
     "run_workloads",
     "CycleWorkload",
+    "AtomicLedgerWorkload",
+    "WriteSkewWorkload",
     "AtomicOpsWorkload",
     "SerializabilityWorkload",
+    "VersionStampWorkload",
+    "ConfigureDatabaseWorkload",
+    "RemoveServersSafelyWorkload",
+    "TargetedKillWorkload",
     "AttritionWorkload",
     "RandomCloggingWorkload",
     "ConsistencyChecker",

@@ -11,6 +11,31 @@ from __future__ import annotations
 from .base import TestWorkload
 
 
+def revive_worker(cluster, proc):
+    """Reboot a killed worker process and re-attach a fresh worker agent.
+
+    Replaces the dead worker in the cluster's bookkeeping: stale
+    WorkerServer objects hold FROZEN role instances (e.g. a storage whose
+    version never advances again), which would poison any aggregate read
+    off cluster.workers (status, quiet_database)."""
+    from ..flow.asyncvar import AsyncVar
+    from ..server.coordination import monitor_leader
+    from ..server.worker import WorkerServer, run_worker_registration
+
+    proc.reboot()
+    w = WorkerServer(proc, cluster.fs)
+    cluster.workers = [
+        x for x in cluster.workers if x.process is not proc
+    ] + [w]
+    leader_var = AsyncVar(None)
+    proc.spawn(
+        monitor_leader(proc, cluster.coord_ifaces, leader_var),
+        "leader_mon",
+    )
+    proc.spawn(run_worker_registration(w, leader_var), "registration")
+    return w
+
+
 class RandomCloggingWorkload(TestWorkload):
     """Clog random machine pairs for random durations (swizzled: several
     overlapping clogs whose releases interleave)."""
@@ -50,10 +75,6 @@ class AttritionWorkload(TestWorkload):
         self.delay_between = delay_between
 
     async def start(self, db, cluster):
-        from ..flow.asyncvar import AsyncVar
-        from ..server.coordination import monitor_leader
-        from ..server.worker import WorkerServer, run_worker_registration
-
         loop = cluster.loop
         rng = loop.rng
         for _ in range(self.kills):
@@ -64,19 +85,4 @@ class AttritionWorkload(TestWorkload):
             proc = procs[int(rng.random_int(0, len(procs)))]
             proc.kill()
             cluster.fs.crash_machine(proc.machine.machine_id)
-            proc.reboot()
-            w = WorkerServer(proc, cluster.fs)
-            # Replace the dead worker in the cluster's bookkeeping: stale
-            # WorkerServer objects hold FROZEN role instances (e.g. a
-            # storage whose version never advances again), which would
-            # poison any aggregate read off cluster.workers (status,
-            # quiet_database).
-            cluster.workers = [
-                x for x in cluster.workers if x.process is not proc
-            ] + [w]
-            leader_var = AsyncVar(None)
-            proc.spawn(
-                monitor_leader(proc, cluster.coord_ifaces, leader_var),
-                "leader_mon",
-            )
-            proc.spawn(run_worker_registration(w, leader_var), "registration")
+            revive_worker(cluster, proc)

@@ -1,4 +1,8 @@
-"""Invariant workloads: atomic-op accounting and write-skew prevention.
+"""Invariant workloads: atomic-op ledger accounting and write-skew prevention.
+
+The full reference-shaped AtomicOps / Serializability workloads live in
+atomic_ops.py / serializability.py; these two are their lightweight,
+chaos-cheap cousins kept for the randomized sweeps.
 
 Ref: fdbserver/workloads/AtomicOps.actor.cpp (per-actor ADD streams whose
 ledger and sum tables must agree) and the Serializability family — two
@@ -13,12 +17,12 @@ from ..flow.error import FdbError
 from .base import TestWorkload
 
 
-class AtomicOpsWorkload(TestWorkload):
+class AtomicLedgerWorkload(TestWorkload):
     """Each actor streams ADDs into a per-actor log key AND a shared total;
     the check phase asserts the shared total equals the sum of the logs
     (ref: AtomicOps' log/ops table comparison)."""
 
-    name = "atomic_ops"
+    name = "atomic_ledger"
 
     def __init__(self, actors: int = 3, ops: int = 20, prefix: bytes = b"ao/"):
         self.actors = actors
@@ -74,13 +78,13 @@ class AtomicOpsWorkload(TestWorkload):
         return out["total"] == out["logs"] and out["total"] > 0
 
 
-class SerializabilityWorkload(TestWorkload):
+class WriteSkewWorkload(TestWorkload):
     """Write-skew probes: pairs of transactions each read BOTH flag keys
     and set their own only if the other is unset; serializability admits at
     most one winner per round, and the check asserts no round ever ended
     with both flags set."""
 
-    name = "serializability"
+    name = "write_skew"
 
     def __init__(self, rounds: int = 10, prefix: bytes = b"ser/"):
         self.rounds = rounds

@@ -44,6 +44,29 @@ def test_chaos_corpus_reaches_probed_paths():
             timeout_vt=20000.0,
         )
         set_event_loop(None)
+    # The round-5 invariant trio under attrition: unknown-result commits
+    # are likely across these seeds, exercising the fence path.
+    from foundationdb_tpu.workloads import (
+        AtomicOpsWorkload,
+        SerializabilityWorkload,
+        VersionStampWorkload,
+    )
+
+    for seed in (3004, 3005):
+        cfg = SimulationConfig.random(seed)
+        c = cfg.build(seed)
+        run_workloads(
+            c,
+            [
+                AtomicOpsWorkload(groups=2, actors=2, ops=5),
+                VersionStampWorkload(actors=2, ops=4),
+                SerializabilityWorkload(registers=5, actors=2, ops=5),
+                RandomCloggingWorkload(duration=1.5),
+                AttritionWorkload(kills=1),
+            ],
+            timeout_vt=30000.0,
+        )
+        set_event_loop(None)
     hit = set(testprobe.hit_sites)
     # Paths a chaos corpus MUST reach (kills + clogs + recoveries).
     required = {"storage_peek_failover"}

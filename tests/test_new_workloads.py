@@ -1,0 +1,179 @@
+"""Round-5 workload additions, chaos-composed.
+
+Ref: fdbserver/workloads/AtomicOps.actor.cpp, VersionStamp.actor.cpp,
+Serializability.actor.cpp, ConfigureDatabase.actor.cpp,
+RemoveServersSafely.actor.cpp, TargetedKill.actor.cpp — each run plain and
+under the clogging/attrition chaos stack with the trailing consistency gate
+(tester.actor.cpp:819).
+"""
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.flow.knobs import g_knobs
+from foundationdb_tpu.server import SimCluster
+from foundationdb_tpu.workloads import (
+    AtomicOpsWorkload,
+    ConfigureDatabaseWorkload,
+    ConsistencyChecker,
+    CycleWorkload,
+    RandomCloggingWorkload,
+    AttritionWorkload,
+    RemoveServersSafelyWorkload,
+    SerializabilityWorkload,
+    TargetedKillWorkload,
+    VersionStampWorkload,
+    run_workloads,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def test_atomic_ops_versionstamp_serializability_plain():
+    c = SimCluster(seed=510, n_proxies=2, n_storages=2)
+    run_workloads(
+        c,
+        [
+            AtomicOpsWorkload(groups=2, actors=3, ops=8),
+            VersionStampWorkload(actors=3, ops=6),
+            SerializabilityWorkload(registers=6, actors=3, ops=8),
+        ],
+        timeout_vt=20000.0,
+    )
+
+
+@pytest.mark.parametrize("seed", [520, 521, 522])
+def test_atomic_ops_versionstamp_serializability_chaos(seed):
+    """The invariant trio under swizzled clogging: retries, stale location
+    caches, and recoveries must not break ledger sums, stamp ordering, or
+    the serial replay."""
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+
+    c = DynamicCluster(seed=seed, n_workers=7, n_proxies=2, n_storages=2,
+                       n_tlogs=2)
+    run_workloads(
+        c,
+        [
+            AtomicOpsWorkload(groups=2, actors=2, ops=6),
+            VersionStampWorkload(actors=2, ops=5),
+            SerializabilityWorkload(registers=5, actors=2, ops=6),
+            RandomCloggingWorkload(duration=2.0),
+            ConsistencyChecker(require_comparisons=True),
+        ],
+        timeout_vt=30000.0,
+        quiet=True,
+    )
+
+
+def test_serializability_detects_lost_update():
+    """The replay check itself must catch a violation: forge a record
+    claiming a read that serial order contradicts."""
+    c = SimCluster(seed=530, n_proxies=1, n_storages=1)
+    wl = SerializabilityWorkload(registers=4, actors=2, ops=6)
+    run_workloads(c, [wl], timeout_vt=20000.0)
+    # Sabotage: rewrite one record's reads to a value that was never
+    # current at its read version.
+    assert wl.records
+    rv, cv, tn, ident, reads, writes = wl.records[0]
+    forged = dict(reads)
+    forged[next(iter(forged))] = b"NEVER_WRITTEN"
+    wl.records[0] = (rv, cv, tn, ident, forged, writes)
+    db = c.database("forge")
+    ok = c.run_until(
+        db.process.spawn(wl.check(db, c)), timeout_vt=5000.0
+    )
+    assert ok is False
+
+
+@pytest.mark.parametrize("seed", [540, 541])
+def test_configure_database_under_chaos(seed):
+    """Live proxy/resolver count churn + clogging while Cycle runs; the
+    final configuration must match the last change and the ring must
+    survive every regeneration (ConfigureDatabase.actor.cpp)."""
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+
+    c = DynamicCluster(seed=seed, n_workers=7, n_proxies=1, n_storages=1)
+    run_workloads(
+        c,
+        [
+            ConfigureDatabaseWorkload(changes=3, delay_between=0.6),
+            CycleWorkload(nodes=5, ops=12, actors=2),
+            RandomCloggingWorkload(duration=1.5),
+        ],
+        timeout_vt=30000.0,
+    )
+
+
+def test_remove_servers_safely(request):
+    """Exclude -> DD drains -> kill: zero data loss, full-width teams on
+    the survivors (RemoveServersSafely.actor.cpp)."""
+    saved = g_knobs.server.dd_tracker_interval
+    g_knobs.server.dd_tracker_interval = 0.5
+    request.addfinalizer(
+        lambda: setattr(g_knobs.server, "dd_tracker_interval", saved)
+    )
+
+    c = SimCluster(seed=550, n_storages=4, n_tlogs=2)
+    db = c.database()
+
+    async def fill(tr):
+        for i in range(40):
+            tr.set(b"rs%03d" % i, b"v%d" % i)
+
+    c.run_all([(db, db.run(fill))])
+    dd = c.data_distributor()
+
+    async def place():
+        await dd.register_storages(dd.storages)
+        await dd.seed(["ss0"])
+        await dd.split(b"rs020")
+        await dd.split(b"\xff")
+        await dd.move(b"", ["ss0", "ss1"])
+        await dd.move(b"rs020", ["ss1", "ss2"])
+
+    c.run_until(db.process.spawn(place()), timeout_vt=500.0)
+    role = c.dd_role(dd)
+
+    victim_proc = c.storages[1].process
+    wl = RemoveServersSafelyWorkload(
+        victim="ss1", dd=dd, kill_process=victim_proc
+    )
+    run_workloads(
+        c,
+        [wl, CycleWorkload(nodes=5, ops=10, actors=2)],
+        timeout_vt=30000.0,
+    )
+    assert wl.drained and not victim_proc.alive
+
+    # Everything is still readable through normal routing.
+    out = {}
+
+    async def read(tr):
+        out["rows"] = await tr.get_range(b"rs", b"rt")
+
+    c.run_all([(db, db.run(read))], timeout_vt=2000.0)
+    assert len(out["rows"]) == 40
+    role.stop()
+
+
+@pytest.mark.parametrize("role", ["storage0", "tlog0", "proxy0"])
+def test_targeted_kill_each_role(role):
+    """Killing each named role mid-load exercises a distinct recovery path;
+    the ring and a fresh probe must survive all of them
+    (TargetedKill.actor.cpp)."""
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+
+    seed = 560 + ["storage0", "tlog0", "proxy0"].index(role)
+    c = DynamicCluster(seed=seed, n_workers=7, n_tlogs=2, n_storages=2)
+    run_workloads(
+        c,
+        [
+            TargetedKillWorkload(role=role, at=0.8, reboot=True),
+            CycleWorkload(nodes=5, ops=12, actors=2),
+        ],
+        timeout_vt=30000.0,
+    )

@@ -111,16 +111,16 @@ def test_buggify_fires_across_seeds():
 
 def test_atomic_ops_and_serializability_workloads():
     from foundationdb_tpu.workloads import (
-        AtomicOpsWorkload,
-        SerializabilityWorkload,
+        AtomicLedgerWorkload,
+        WriteSkewWorkload,
     )
 
     c = SimCluster(seed=95, n_proxies=2)
     run_workloads(
         c,
         [
-            AtomicOpsWorkload(actors=3, ops=10),
-            SerializabilityWorkload(rounds=8),
+            AtomicLedgerWorkload(actors=3, ops=10),
+            WriteSkewWorkload(rounds=8),
             CycleWorkload(nodes=5, ops=10, actors=2),
         ],
     )
@@ -133,15 +133,15 @@ def test_invariant_sweep_under_chaos(seed):
     cfg = SimulationConfig.random(seed)
     c = cfg.build(seed)
     from foundationdb_tpu.workloads import (
-        AtomicOpsWorkload,
-        SerializabilityWorkload,
+        AtomicLedgerWorkload,
+        WriteSkewWorkload,
     )
 
     run_workloads(
         c,
         [
-            AtomicOpsWorkload(actors=2, ops=8),
-            SerializabilityWorkload(rounds=5),
+            AtomicLedgerWorkload(actors=2, ops=8),
+            WriteSkewWorkload(rounds=5),
             CycleWorkload(nodes=5, ops=10, actors=2),
             RandomCloggingWorkload(duration=2.0),
             AttritionWorkload(kills=1),

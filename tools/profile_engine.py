@@ -1,0 +1,115 @@
+"""Per-phase device timing of the conflict engine at the bench config.
+
+Times each jitted sub-piece of detect_core in isolation (own compile, own
+block_until_ready bracket) at the BENCH shapes: 64k txns, rr=wr=64k ranges,
+h_cap=3.4M, steady-state hcount=2.87M.  Numbers guide which phase gets the
+next kernel (PERF_NOTES "next lever").
+
+Run on the TPU:  python tools/profile_engine.py
+"""
+import math
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from foundationdb_tpu.ops.rangequery import (
+    build_max_table, build_min_table, range_max, range_min,
+    searchsorted_words, searchsorted_1d,
+)
+from foundationdb_tpu.ops.stabbing import stabbing_min
+
+KW1 = 3  # bench config: key_words=2 + length word
+H = 3407872
+HCOUNT = 2874612
+RR = WR = 65536
+TXN = 65536
+P = 2 * RR + 2 * WR
+REPS = 10
+
+
+def timeit(name, fn, *args):
+    out = jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(REPS):
+        out = jax.block_until_ready(fn(*args))
+    dt = (time.perf_counter() - t0) / REPS
+    print(f"{name:42s} {dt*1e3:8.2f} ms")
+    return out
+
+
+def main():
+    rng = np.random.default_rng(7)
+    hkeys_np = np.sort(
+        rng.integers(0, 2**32, size=(H,), dtype=np.uint32)
+    ).astype(np.uint32)
+    hkeys = jnp.asarray(
+        np.stack([hkeys_np] + [rng.integers(0, 2**32, size=(H,), dtype=np.uint32)
+                               for _ in range(KW1 - 1)])
+    )
+    hvers = jnp.asarray(rng.integers(0, 1 << 20, size=(H,), dtype=np.int32))
+    q = jnp.asarray(rng.integers(0, 2**32, size=(KW1, RR), dtype=np.uint32))
+    q2 = jnp.asarray(rng.integers(0, 2**32, size=(KW1, 2 * WR), dtype=np.uint32))
+
+    print(f"config: H={H} hcount={HCOUNT} RR=WR={RR} P={P} reps={REPS}")
+
+    f = jax.jit(lambda k, x: searchsorted_words(k, x, "left"))
+    timeit("search 64k into H (x1; phase1 does x2)", f, hkeys, q)
+    f2 = jax.jit(lambda k, x: searchsorted_words(k, x, "left"))
+    timeit("search 128k into H (x1; phase5 does x2)", f2, hkeys, q2)
+
+    timeit("build_max_table over H", jax.jit(build_max_table), hvers)
+
+    i = jnp.asarray(rng.integers(0, H - 1, size=(RR,), dtype=np.int32))
+    j = jnp.clip(i + 1000, 0, H - 1)
+    tab = jax.jit(build_max_table)(hvers)
+    timeit("range_max 64k queries", jax.jit(range_max), tab, i, j)
+
+    # fixpoint pieces at full width P
+    p_log2 = max(1, math.ceil(math.log2(P)))
+    wb = jnp.asarray(np.sort(rng.integers(0, P, size=(WR,), dtype=np.int32)))
+    we = jnp.clip(wb + 4, 0, P - 1)
+    wt = jnp.asarray(rng.integers(0, TXN, size=(WR,), dtype=np.int32))
+    act = jnp.ones((WR,), bool)
+    f3 = jax.jit(lambda b, e, t, a: stabbing_min(b, e, t, a, p_log2))
+    stab = timeit("stabbing_min full width P", f3, wb, we, wt, act)
+    timeit("build_min_table over P", jax.jit(build_min_table), stab)
+
+    # phase4-6 streaming: cumsums over H
+    delta = jnp.asarray(rng.integers(-1, 2, size=(H,), dtype=np.int32))
+    timeit("one cumsum over H", jax.jit(lambda d: jnp.cumsum(d)), delta)
+
+    # new-keys sort: 128k x (kw1+1)
+    nk = jnp.asarray(rng.integers(0, 2**32, size=(KW1, 2 * WR), dtype=np.uint32))
+    iota = jnp.arange(2 * WR, dtype=jnp.int32)
+    f4 = jax.jit(
+        lambda k, io: jax.lax.sort(
+            tuple(k[w] for w in range(KW1)) + (io,), num_keys=KW1, is_stable=True
+        )
+    )
+    timeit("sort 128k new keys (kw1 keys + iota)", f4, nk, iota)
+
+    # compact_to analog: single-key sort of H rows carrying kw1+1 payloads
+    pos = jnp.asarray(rng.permutation(H).astype(np.int32))
+    f5 = jax.jit(
+        lambda p, k, v: jax.lax.sort(
+            (p,) + tuple(k[w] for w in range(KW1)) + (v,),
+            num_keys=1, is_stable=True,
+        )
+    )
+    timeit("compact_to sort H rows (x2 in ph5/6)", f5, pos, hkeys, hvers)
+
+    # merged concat form (phase 5 sorts H + 128k rows)
+    bigpos = jnp.asarray(rng.permutation(H + 2 * WR).astype(np.int32))
+    bigk = jnp.concatenate([hkeys, nk], axis=1)
+    bigv = jnp.concatenate([hvers, jnp.zeros((2 * WR,), jnp.int32)])
+    timeit("compact_to sort H+128k rows", f5, bigpos, bigk, bigv)
+
+
+if __name__ == "__main__":
+    main()
