@@ -65,9 +65,85 @@ async def get_configuration(db) -> Dict[str, int]:
         rows = await tr.get_range(CONF_PREFIX, CONF_END)
         for k, v in rows:
             name = k[len(CONF_PREFIX):].decode()
-            if name.startswith("excluded/") or name == "resolverSplit":
+            if (
+                name.startswith("excluded/")
+                or name.startswith("class/")
+                or name in ("resolverSplit", "coordinators")
+            ):
                 continue
             out[name] = int(v.decode())
+
+    await db.run(txn)
+    return out
+
+
+CLASS_PREFIX = b"\xff/conf/class/"
+CLASS_END = b"\xff/conf/class0"
+
+VALID_CLASSES = ("unset", "stateless", "transaction", "storage",
+                 "coordinator")
+
+
+async def change_coordinators(db, new_addresses: List[str]) -> None:
+    """Request a coordinator quorum change (ref: changeQuorum
+    ManagementAPI.actor.cpp:684).  Client-side safety checks here; the
+    acting cluster controller performs the movable-state handoff (write
+    manifest to the new quorum, fence + forward the old) and the change is
+    complete when every election client has retargeted.
+    """
+    if not new_addresses:
+        raise ValueError("empty coordinator set")
+    if len(set(new_addresses)) != len(new_addresses):
+        raise ValueError("duplicate coordinator address")
+    if len(new_addresses) % 2 == 0:
+        # An even quorum tolerates no more failures than the next odd size
+        # down and doubles the tie surface (the reference warns similarly).
+        raise ValueError("coordinator count must be odd")
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        tr.set(conf_key("coordinators"), ",".join(new_addresses).encode())
+
+    await db.run(txn)
+
+
+async def get_requested_coordinators(db) -> Optional[List[str]]:
+    out: List[Optional[bytes]] = [None]
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        out[0] = await tr.get(conf_key("coordinators"))
+
+    await db.run(txn)
+    return out[0].decode().split(",") if out[0] else None
+
+
+async def set_process_class(db, address: str, process_class: str) -> None:
+    """Assign a recruitment class to the worker at `address` (ref: setclass
+    fdbcli / processClass in SystemData) — applied at the next generation's
+    recruitment."""
+    if process_class not in VALID_CLASSES:
+        raise ValueError(f"unknown process class {process_class!r}")
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        if process_class == "unset":
+            tr.clear(CLASS_PREFIX + address.encode())
+        else:
+            tr.set(CLASS_PREFIX + address.encode(), process_class.encode())
+
+    await db.run(txn)
+
+
+async def get_process_classes(db) -> Dict[str, str]:
+    out: Dict[str, str] = {}
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        rows = await tr.get_range(CLASS_PREFIX, CLASS_END)
+        out.clear()
+        for k, v in rows:
+            out[k[len(CLASS_PREFIX):].decode()] = v.decode()
 
     await db.run(txn)
     return out

@@ -89,12 +89,57 @@ class Database:
         # FailureMonitorClient): addr -> failed.  loadBalance orders dead
         # replicas last so reads avoid them WITHOUT eating a timeout.
         self.failure_states: dict = {}
+        # Per-flags GRV coalescing lanes (ref: readVersionBatcher,
+        # NativeAPI.actor.cpp:2698): {flags: (pending promises, inflight)}.
+        self._grv_lanes: dict = {}
         if info_var is not None:
             from ..server.failure_monitor import run_failure_monitor_client
 
             process.spawn(
                 run_failure_monitor_client(self), "failure_monitor_client"
             )
+
+    # --- client-side GRV batching (ref: readVersionBatcher :2698) ---
+    async def batched_read_version(self, flags: int) -> int:
+        """Coalesce concurrent get_read_version calls: while one GRV
+        request is in flight, later callers queue and are all answered by
+        the NEXT single request — natural batching under load, zero added
+        latency when idle (the reference's batcher has the same shape:
+        requests accumulate behind the in-flight one)."""
+        lane = self._grv_lanes.setdefault(flags, {"pending": [], "busy": False})
+        p = Promise()
+        lane["pending"].append(p)
+        if not lane["busy"]:
+            # Marked busy HERE, not inside the drain: spawn() only schedules,
+            # so two same-tick callers would otherwise both observe idle and
+            # launch duplicate in-flight GRV requests.
+            lane["busy"] = True
+            self.process.spawn(self._grv_drain(flags), "grv_batcher")
+        return await p.future
+
+    async def _grv_drain(self, flags: int):
+        from ..flow.error import ActorCancelled
+
+        lane = self._grv_lanes[flags]
+        try:
+            while lane["pending"]:
+                batch, lane["pending"] = lane["pending"], []
+                try:
+                    version = await self.pick_proxy(
+                        "grv"
+                    ).get_consistent_read_version.get_reply(
+                        self.process, GetReadVersionRequest(flags=flags)
+                    )
+                    for p in batch:
+                        p.send(version)
+                except ActorCancelled:
+                    raise  # process dying: waiters die with it
+                except FdbError as e:
+                    # Each waiter retries through its own on_error loop.
+                    for p in batch:
+                        p.send_error(FdbError(e.name))
+        finally:
+            lane["busy"] = False
 
     def is_failed(self, iface) -> bool:
         """Is the process behind this interface marked failed?  Keyed by
@@ -221,9 +266,7 @@ class Transaction:
                 if self.options.get("priority_batch")
                 else 0
             )
-            self._read_version = await self.db.pick_proxy("grv").get_consistent_read_version.get_reply(
-                self.db.process, GetReadVersionRequest(flags=flags)
-            )
+            self._read_version = await self.db.batched_read_version(flags)
         return self._read_version
 
     def set_read_version(self, version: int):

@@ -55,6 +55,11 @@ _ERRORS: dict[str, int] = {
     # asked for versions predating its recruitment; the peeker must fail
     # over to a surviving replica of its tag.
     "peek_below_begin": 1211,
+    # Rebuild-specific: a coordinator quorum change named an address with
+    # no registered worker — the request is unsatisfiable and rejected
+    # (the 6.0 changeQuorum surfaces this as CoordinatorsResult, not an
+    # error code).
+    "no_such_worker": 1212,
     # Directory-layer errors (rebuild-specific codes in an unused range;
     # the 6.0 bindings raise language exceptions for these, but the
     # rebuild keeps the one-error-type model).

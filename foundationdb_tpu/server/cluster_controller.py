@@ -31,12 +31,15 @@ from ..rpc.stream import RequestStream, RequestStreamRef
 from .coordination import (
     CoordinatedState,
     CoordinatorInterface,
+    CoordinatorSet,
     LeaderInfo,
+    coordinator_interface_at,
     try_become_leader,
 )
 from .interfaces import CommitTransactionRequest
 from .worker import (
     FastForwardTLog,
+    InitCoordinator,
     InitProxy,
     InitResolver,
     InitSequencer,
@@ -84,6 +87,9 @@ class ClusterController:
         self.n_storages = n_storages
         self.n_proxies = n_proxies
         self.workers: Dict[str, WorkerInterface] = {}
+        # address -> process class (ref: ProcessClass); fed by the config
+        # monitor, consulted by the next generation's recruitment.
+        self.process_classes: Dict[str, str] = {}
         self.client_info = AsyncVar(ClientDBInfo())
         self._info_waiters: list = []
         self.generation = 0
@@ -188,6 +194,23 @@ class ClusterController:
         cstate = CoordinatedState(self.process, self.coordinators)
         raw = await cstate.read()
         prev = pickle.loads(raw) if raw else {"epoch_end": 0}
+        # Follow a quorum move: the fenced old state holds only a forward
+        # pointer (ref: MovableCoordinatedState reading MovedFrom).  Bounded
+        # hops — a chain of moves is one hop per retired quorum.
+        for _hop in range(4):
+            moved = prev.get("moved_to")
+            if not moved:
+                break
+            TraceEvent("CoordinatorsMovedFollow").detail("to", moved).log()
+            if isinstance(self.coordinators, CoordinatorSet):
+                self.coordinators.retarget(moved)
+            else:
+                self.coordinators = [
+                    coordinator_interface_at(a) for a in moved
+                ]
+            cstate = CoordinatedState(self.process, self.coordinators)
+            raw = await cstate.read()
+            prev = pickle.loads(raw) if raw else {"epoch_end": 0}
 
         # The epoch/generation is monotone ACROSS controller failovers: it is
         # persisted in the manifest and bumped past any previously persisted
@@ -624,6 +647,52 @@ class ClusterController:
                 self._wanted_proxies = wanted
                 self._config_stale = True
                 return
+            # Process classes: recruitment preferences for the NEXT
+            # generation (ref: setclass / ProcessClass fitness).
+            task = self.process.spawn(
+                self._get_classes_swallowing(db), "cc_class_read"
+            )
+            classes = await timeout_after(loop, task, 5.0, default=None)
+            if classes is None:
+                task.cancel()  # dead interfaces would retry forever
+            else:
+                self.process_classes = classes
+            # Coordinator quorum change (ref: changeQuorum
+            # ManagementAPI.actor.cpp:684, executed by the controller).
+            task = self.process.spawn(
+                self._get_coords_swallowing(db), "cc_coords_read"
+            )
+            wanted_coords = await timeout_after(loop, task, 5.0, default=None)
+            if wanted_coords is None:
+                task.cancel()
+            if (
+                wanted_coords
+                and isinstance(self.coordinators, CoordinatorSet)
+                and list(wanted_coords) != self.coordinators.addresses
+            ):
+                try:
+                    await self._change_coordinators(wanted_coords)
+                except FdbError as e:
+                    if e.name == "no_such_worker":
+                        # Unsatisfiable request (address is not a registered
+                        # worker): REJECT it — clear the conf key so the
+                        # operator sees the request dropped instead of the
+                        # controller retrying a doomed change forever.
+                        TraceEvent(
+                            "ChangeCoordinatorsRejected", severity=20
+                        ).detail("requested", list(wanted_coords)).log()
+                        await self._clear_coordinator_request(db)
+                        continue
+                    TraceEvent("ChangeCoordinatorsFailed", severity=20).detail(
+                        "error", getattr(e, "name", repr(e))
+                    ).log()
+                    await loop.delay(1.0)
+                    continue
+                # The reference forces a full recovery after a quorum
+                # change; ours re-derives every coordinator-held invariant
+                # under the new set the same way.
+                self._config_stale = True
+                return
             await loop.delay(0.5)
 
     async def _get_conf_swallowing(self, db):
@@ -633,6 +702,89 @@ class ClusterController:
             return await get_configuration(db)
         except (FdbError, ActorCancelled):
             return None
+
+    async def _get_coords_swallowing(self, db):
+        from ..client.management import get_requested_coordinators
+
+        try:
+            return await get_requested_coordinators(db)
+        except (FdbError, ActorCancelled):
+            return None
+
+    async def _get_classes_swallowing(self, db):
+        from ..client.management import get_process_classes
+
+        try:
+            return await get_process_classes(db)
+        except (FdbError, ActorCancelled):
+            return None
+
+    async def _clear_coordinator_request(self, db):
+        from ..client.management import conf_key
+
+        async def txn(tr):
+            tr.options["access_system_keys"] = True
+            tr.clear(conf_key("coordinators"))
+
+        try:
+            await db.run(txn)
+        except (FdbError, ActorCancelled):
+            pass  # next monitor round retries the rejection
+
+    async def _change_coordinators(self, new_addrs):
+        """The movable-state quorum swap (ref: changeQuorum
+        ManagementAPI.actor.cpp:684 + MovableCoordinatedState):
+
+          1. recruit a coordination server on every NEW address (idempotent
+             for members staying on),
+          2. copy the manifest into the new quorum's coordinated state,
+          3. fence the old quorum with a moved_to record — any stale
+             writer's generation is now below the fence write and fails
+             with coordinated_state_conflict,
+          4. tell old coordinators to forward election clients,
+          5. retarget our own cluster-file view.
+
+        Crash safety: a crash between 2 and 3 leaves the OLD quorum
+        authoritative (the copy is unreferenced garbage); after 3 every
+        reader follows the pointer, so there is no window with two
+        writable quorums."""
+        assert isinstance(self.coordinators, CoordinatorSet)
+        old_addrs = list(self.coordinators.addresses)
+        TraceEvent("ChangeCoordinatorsStart").detail("from", old_addrs).detail(
+            "to", list(new_addrs)
+        ).log()
+        for a in new_addrs:
+            if a in old_addrs:
+                continue  # already serving coordination
+            w = self.workers.get(a)
+            if w is None:
+                raise FdbError("no_such_worker")
+            ok = await self._try(
+                w.init_role.get_reply(self.process, InitCoordinator())
+            )
+            # ALL new members must be up before the state moves (the
+            # reference's changeQuorum insists the same).
+            if ok != "ok":
+                raise FdbError("coordinators_changed")
+        old_cs = CoordinatedState(self.process, self.coordinators)
+        raw = await old_cs.read()
+        new_ifaces = [coordinator_interface_at(a) for a in new_addrs]
+        new_cs = CoordinatedState(self.process, new_ifaces)
+        await new_cs.read()
+        await new_cs.set(raw or pickle.dumps({"epoch_end": 0}, protocol=4))
+        await old_cs.set(
+            pickle.dumps({"moved_to": list(new_addrs)}, protocol=4)
+        )
+        for c in old_cs.coordinators:
+            # Best-effort: a dead old coordinator forwards from its durable
+            # registry when it reboots; the moved_to fence already protects
+            # safety.
+            await self._try(
+                c.set_forward.get_reply(self.process, list(new_addrs)),
+                timeout=2.0,
+            )
+        self.coordinators.retarget(list(new_addrs))
+        TraceEvent("ChangeCoordinatorsDone").detail("to", list(new_addrs)).log()
 
     async def _wait_workers(self, tlog_addrs=None, storage_addrs=None):
         """(tlog_slots, storage_workers).
@@ -763,37 +915,53 @@ class ClusterController:
         out.sort(key=lambda w: w.address)
         return out
 
+    def _class_penalty(self, addr: str) -> int:
+        """Recruitment fitness for STATELESS roles (ref: ProcessClass
+        machineClassFitness, ClusterController.actor.cpp:622-659):
+        stateless-class first, unset next, stateful classes last."""
+        cls = self.process_classes.get(addr, "unset")
+        if cls == "stateless":
+            return 0
+        if cls == "unset":
+            return 1
+        return 2  # storage / transaction / coordinator: keep stateless off
+
     def _pick_stateless(self, avoid=()) -> WorkerInterface:
         """Spread stateless roles across live workers round-robin-ish,
-        preferring workers NOT in `avoid` (the stateful-disk homes) so
-        losing a stateless role's process doesn't also take the only copy
-        of a disk (ref: fitness-based recruitment keeping transaction-class
-        processes off storage, ClusterController.actor.cpp:622-659)."""
-        addrs = sorted(self.workers)
+        preferring workers NOT in `avoid` (the stateful-disk homes) and the
+        best process class so losing a stateless role's process doesn't
+        also take the only copy of a disk (ref: fitness-based recruitment
+        keeping transaction-class processes off storage,
+        ClusterController.actor.cpp:622-659)."""
+        addrs = sorted(self.workers, key=lambda a: (self._class_penalty(a), a))
         pool = [a for a in addrs if a not in avoid] or addrs
+        best = self._class_penalty(pool[0])
+        pool = [a for a in pool if self._class_penalty(a) == best]
         self._rr = getattr(self, "_rr", 0) + 1
         return self.workers[pool[self._rr % len(pool)]]
 
+    def _tiered_rotation(self, addrs: List[str], start: int) -> List[str]:
+        """Addresses grouped best-fitness-first, rotated WITHIN each tier:
+        rotation spreads load but must never promote a worse-class worker
+        over a better one."""
+        out: List[str] = []
+        for tier in sorted({self._class_penalty(a) for a in addrs}):
+            t = [a for a in addrs if self._class_penalty(a) == tier]
+            r = start % len(t)
+            out.extend(t[r:] + t[:r])
+        return out
+
     def _pick_distinct_stateless(self, n: int, avoid=()) -> List[WorkerInterface]:
         """n workers, all distinct (each worker hosts at most one proxy),
-        preferring non-`avoid` workers; falls back to avoided ones only when
-        there aren't enough others."""
+        preferring non-`avoid` workers of the best class; falls back only
+        when there aren't enough others."""
         addrs = sorted(self.workers)
-        preferred = [a for a in addrs if a not in avoid]
-        pool = preferred + [a for a in addrs if a in avoid]
         self._rr = getattr(self, "_rr", 0) + 1
         start = self._rr
-        k = min(n, len(pool))
-        if k <= len(preferred):
-            return [
-                self.workers[preferred[(start + i) % len(preferred)]]
-                for i in range(k)
-            ]
-        # Not enough non-stateful workers: rotate over the whole pool (k <=
-        # len(pool), so modular picks stay distinct).
-        return [
-            self.workers[pool[(start + i) % len(pool)]] for i in range(k)
-        ]
+        pool = self._tiered_rotation(
+            [a for a in addrs if a not in avoid], start
+        ) + self._tiered_rotation([a for a in addrs if a in avoid], start)
+        return [self.workers[a] for a in pool[: min(n, len(pool))]]
 
     async def _watch_roles(self):
         """Ping every recruited role's worker; any failure starts a new

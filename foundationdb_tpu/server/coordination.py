@@ -51,6 +51,9 @@ class LeaderInfo:
 # (ref: UniqueGeneration(generation, uid), CoordinationInterface.h).
 ZERO_GEN = (0, 0)
 
+# Registry key persisting a retired coordinator's forward pointer.
+FORWARD_KEY = b"\xff/forward"
+
 
 @dataclass
 class GenReadRequest:
@@ -85,6 +88,77 @@ class CoordinatorInterface:
     gen_write: RequestStreamRef = None
     candidacy: RequestStreamRef = None
     get_leader: RequestStreamRef = None
+    set_forward: RequestStreamRef = None
+
+
+def coordinator_interface_at(address: str) -> CoordinatorInterface:
+    """Interface for the coordinator at `address` from its well-known
+    tokens alone — how a process reaches coordinators it only knows from a
+    cluster-file line (ref: the WLTOKEN_* constants,
+    CoordinationInterface.h)."""
+    from ..rpc.stream import well_known_token
+    from ..rpc.network import Endpoint
+
+    def ref(name: str) -> RequestStreamRef:
+        return RequestStreamRef(Endpoint(address, well_known_token(name)), name)
+
+    return CoordinatorInterface(
+        gen_read=ref("coord_gen_read"),
+        gen_write=ref("coord_gen_write"),
+        candidacy=ref("coord_candidacy"),
+        get_leader=ref("coord_get_leader"),
+        set_forward=ref("coord_set_forward"),
+    )
+
+
+class CoordinatorSet:
+    """The mutable "cluster file": the coordinator addresses a process
+    currently believes in.  Election/monitor actors re-read it every round,
+    so a quorum change retargets them without restarts (ref: the connection
+    file rewrite in MonitorLeader.actor.cpp when coordinators forward)."""
+
+    def __init__(self, addresses: List[str],
+                 interfaces: Optional[List[CoordinatorInterface]] = None):
+        self.addresses = list(addresses)
+        self.interfaces = (
+            list(interfaces)
+            if interfaces is not None
+            else [coordinator_interface_at(a) for a in addresses]
+        )
+        self.changes = 0
+
+    def retarget(self, addresses: List[str]):
+        if list(addresses) == self.addresses:
+            return
+        self.addresses = list(addresses)
+        self.interfaces = [coordinator_interface_at(a) for a in addresses]
+        self.changes += 1
+
+
+def _resolve_coords(coordinators) -> List[CoordinatorInterface]:
+    """Accept a plain interface list (legacy call sites) or a
+    CoordinatorSet (retargetable)."""
+    if isinstance(coordinators, CoordinatorSet):
+        return coordinators.interfaces
+    return coordinators
+
+
+# A forwarded coordinator nominates this pseudo-leader: priority makes it
+# win min() immediately, the shared change_id makes the majority count
+# converge, and the payload carries the new addresses (ref: ForwardRequest,
+# Coordination.actor.cpp — "the cluster key is now served elsewhere").
+FORWARD_PRIORITY = -(1 << 40)
+
+
+def _forward_info(addrs: List[str]) -> LeaderInfo:
+    import zlib
+
+    blob = b",".join(a.encode() for a in addrs)
+    return LeaderInfo(
+        priority=FORWARD_PRIORITY,
+        change_id=zlib.crc32(blob),
+        payload={"moved_to": list(addrs)},
+    )
 
 
 class Coordinator:
@@ -113,10 +187,14 @@ class Coordinator:
         self.candidates: Dict[int, Tuple[LeaderInfo, float]] = {}
         self.nominee: Optional[LeaderInfo] = None
         self._waiters: List = []  # (known_change_id, reply)
+        # Non-None after a quorum move: addresses this coordinator forwards
+        # every election client to (ref: ForwardRequest handling).
+        self.forward: Optional[List[str]] = None
         self._gr = RequestStream(process, "coord_gen_read", well_known=True)
         self._gw = RequestStream(process, "coord_gen_write", well_known=True)
         self._cd = RequestStream(process, "coord_candidacy", well_known=True)
         self._gl = RequestStream(process, "coord_get_leader", well_known=True)
+        self._fw = RequestStream(process, "coord_set_forward", well_known=True)
         process.spawn(self._boot(), "coord_boot")
 
     async def _boot(self):
@@ -132,11 +210,18 @@ class Coordinator:
             )
             for k, v in self._store.read_range(b"", b"\xff" * 16):
                 self.registry[k] = pickle.loads(v)
+            fwd = self.registry.get(FORWARD_KEY)
+            if fwd is not None and fwd[0]:
+                # A rebooted retired coordinator must keep forwarding, or a
+                # client with a stale cluster file could re-elect on the
+                # old quorum (ref: forward is durable in the reference too).
+                self.forward = fwd[0].decode().split(",")
         p = self.process
         p.spawn(self._serve_gen_read(), "coord_gr")
         p.spawn(self._serve_gen_write(), "coord_gw")
         p.spawn(self._serve_candidacy(), "coord_cd")
         p.spawn(self._serve_get_leader(), "coord_gl")
+        p.spawn(self._serve_set_forward(), "coord_fw")
         p.spawn(self._nominee_tick(), "coord_tick")
 
     async def _persist(self, key: bytes):
@@ -153,7 +238,26 @@ class Coordinator:
             gen_write=self._gw.ref(),
             candidacy=self._cd.ref(),
             get_leader=self._gl.ref(),
+            set_forward=self._fw.ref(),
         )
+
+    async def _serve_set_forward(self):
+        """Retire this coordinator: durably record the successor addresses
+        and answer every future election request with the forward nominee
+        (ref: ForwardRequest, Coordination.actor.cpp)."""
+        while True:
+            addrs, reply = await self._fw.pop()
+            self.forward = list(addrs)
+            self.registry[FORWARD_KEY] = (
+                ",".join(addrs).encode(), ZERO_GEN, ZERO_GEN,
+            )
+            await self._persist(FORWARD_KEY)
+            # Flush parked get_leader waiters with the forward nominee.
+            self.nominee = _forward_info(self.forward)
+            waiters, self._waiters = self._waiters, []
+            for _known, w in waiters:
+                w.send(self.nominee)
+            reply.send(None)
 
     # --- generation register (ref localGenerationReg :125-160) ---
     async def _serve_gen_read(self):
@@ -183,6 +287,14 @@ class Coordinator:
 
     # --- leader register (ref leaderRegister :203) ---
     def _recompute_nominee(self, now: float):
+        if self.forward is not None:
+            new = _forward_info(self.forward)
+            if new != self.nominee:
+                self.nominee = new
+                waiters, self._waiters = self._waiters, []
+                for _known, reply in waiters:
+                    reply.send(self.nominee)
+            return
         live = [info for info, exp in self.candidates.values() if exp > now]
         new = min(live) if live else None
         if new != self.nominee:
@@ -231,11 +343,14 @@ class CoordinatedState:
     def __init__(
         self,
         process: SimProcess,
-        coordinators: List[CoordinatorInterface],
+        coordinators,
         key: bytes = b"cstate",
     ):
         self.process = process
-        self.coordinators = coordinators
+        # Pinned at construction: a session belongs to ONE quorum; a move
+        # mid-session must surface as coordinated_state_conflict, not be
+        # papered over by silently retargeting.
+        self.coordinators = list(_resolve_coords(coordinators))
         self.key = key
         self.gen = ZERO_GEN  # this session's generation, fixed at read()
         self._read_done = False
@@ -308,19 +423,28 @@ async def _swallow(fut):
         return e
 
 
+
+def _moved_to(info: LeaderInfo):
+    """Forward addresses carried by a nominee, or None."""
+    p = info.payload
+    return p.get("moved_to") if isinstance(p, dict) else None
+
 async def try_become_leader(
     process: SimProcess,
-    coordinators: List[CoordinatorInterface],
+    coordinators,
     info: LeaderInfo,
     is_leader: AsyncVar,
 ):
     """Run candidacy forever: refresh leases, watch nominations; set
     `is_leader` True while this process holds a majority nomination (ref:
-    tryBecomeLeaderInternal LeaderElection.actor.cpp:78)."""
-    loop = process.network.loop
-    quorum = len(coordinators) // 2 + 1
+    tryBecomeLeaderInternal LeaderElection.actor.cpp:78).
 
-    async def one_round():
+    `coordinators` may be a CoordinatorSet: the set is re-read every round
+    and forward replies retarget it, so candidacy survives a quorum change
+    (ref: the ForwardRequest path in LeaderElection)."""
+    loop = process.network.loop
+
+    async def one_round(coords):
         # All coordinators in parallel: a refresh round must complete well
         # inside CANDIDATE_TTL or our own leases lapse and nominations flap.
         futs = [
@@ -331,36 +455,51 @@ async def try_become_leader(
                     )
                 )
             )
-            for c in coordinators
+            for c in coords
         ]
-        votes = 0
+        votes, forwards = 0, {}
         for f in futs:
             reply = await timeout_after(loop, f, POLL_INTERVAL, default=None)
-            if (
-                reply is not None
-                and not isinstance(reply, Exception)
-                and reply.change_id == info.change_id
-            ):
+            if reply is None or isinstance(reply, Exception):
+                continue
+            moved = _moved_to(reply)
+            if moved is not None:
+                key = tuple(moved)
+                forwards[key] = forwards.get(key, 0) + 1
+            elif reply.change_id == info.change_id:
                 votes += 1
-        return votes
+        return votes, forwards
 
     while True:
-        votes = await one_round()
+        coords = _resolve_coords(coordinators)
+        quorum = len(coords) // 2 + 1
+        votes, forwards = await one_round(coords)
+        for addrs, n in forwards.items():
+            if n >= quorum and isinstance(coordinators, CoordinatorSet):
+                coordinators.retarget(list(addrs))
+                votes = 0
+                break
         is_leader.set(votes >= quorum)
         await loop.delay(POLL_INTERVAL)
 
 
 async def monitor_leader(
     process: SimProcess,
-    coordinators: List[CoordinatorInterface],
+    coordinators,
     leader_var: AsyncVar,
 ):
     """Poll coordinators; publish the majority nominee (ref:
-    monitorLeaderInternal MonitorLeader.actor.cpp:427)."""
+    monitorLeaderInternal MonitorLeader.actor.cpp:427).
+
+    `coordinators` may be a CoordinatorSet: a majority forward nominee
+    retargets the set instead of being published — the client-side half of
+    a coordinator quorum change (ref: MonitorLeader's connection-file
+    rewrite on forward)."""
     loop = process.network.loop
     while True:
+        coords = _resolve_coords(coordinators)
         counts: Dict[int, Tuple[int, LeaderInfo]] = {}
-        for c in coordinators:
+        for c in coords:
             known = leader_var.get().change_id if leader_var.get() else None
             fut = process.spawn(_swallow(c.get_leader.get_reply(process, known)))
             reply = await timeout_after(loop, fut, POLL_INTERVAL, default=None)
@@ -368,10 +507,16 @@ async def monitor_leader(
                 continue
             n, _ = counts.get(reply.change_id, (0, reply))
             counts[reply.change_id] = (n + 1, reply)
-        quorum = len(coordinators) // 2 + 1
+        quorum = len(coords) // 2 + 1
         for change_id, (n, info) in counts.items():
-            if n >= quorum:
-                if leader_var.get() is None or leader_var.get().change_id != change_id:
-                    leader_var.set(info)
+            if n < quorum:
+                continue
+            moved = _moved_to(info)
+            if moved is not None:
+                if isinstance(coordinators, CoordinatorSet):
+                    coordinators.retarget(list(moved))
                 break
+            if leader_var.get() is None or leader_var.get().change_id != change_id:
+                leader_var.set(info)
+            break
         await loop.delay(POLL_INTERVAL)

@@ -65,16 +65,31 @@ class DynamicCluster:
         (live) processes.  Runs at first boot and after crash_and_recover;
         well-known stream tokens are name-derived, so refs held by clients
         stay valid across a rebuild on the same addresses."""
+        from .coordination import CoordinatorSet
+
         self.coordinators = [
             Coordinator(p, fs=self.fs) for p in self._coord_procs
         ]
-        self.coord_ifaces = [c.interface() for c in self.coordinators]
+        # The test-visible "cluster file" (used for NEW clients); survives
+        # crash_and_recover so late observers skip the forward hop.  Every
+        # server PROCESS below gets its OWN CoordinatorSet — as in the
+        # reference each process trusts its own connection file and learns
+        # of a quorum change only through coordinator forwarding.
+        if not hasattr(self, "coord_set"):
+            self.coord_set = CoordinatorSet(
+                [p.address for p in self._coord_procs],
+                [c.interface() for c in self.coordinators],
+            )
+        # Server processes boot from the ORIGINAL file contents: after a
+        # crash_and_recover that followed a quorum move, they must re-find
+        # the cluster through the retired coordinators' durable forwards.
+        boot_addrs = [p.address for p in self._coord_procs]
 
         # Controller candidates: whichever wins the election acts.
         self.controllers = [
             ClusterController(
                 p,
-                self.coord_ifaces,
+                CoordinatorSet(boot_addrs),
                 conflict_backend=self.conflict_backend,
                 storage_engine=self.storage_engine,
                 fs=self.fs,
@@ -91,9 +106,16 @@ class DynamicCluster:
             self.workers.append(w)
             leader_var = AsyncVar(None)
             proc.spawn(
-                monitor_leader(proc, self.coord_ifaces, leader_var), "leader_mon"
+                monitor_leader(proc, CoordinatorSet(boot_addrs), leader_var),
+                "leader_mon",
             )
             proc.spawn(run_worker_registration(w, leader_var), "registration")
+
+    @property
+    def coord_ifaces(self):
+        """Live coordinator interfaces (back-compat accessor; the
+        retargetable truth is `coord_set`)."""
+        return self.coord_set.interfaces
 
     def crash_and_recover(self):
         """Whole-cluster power loss: kill every server process (coordinators
@@ -116,11 +138,20 @@ class DynamicCluster:
     def database(self, name: str = ""):
         from ..client.transaction import Database
 
+        from .coordination import CoordinatorSet
+
         self._n_clients += 1
         proc = self.net.process(name or f"client{self._n_clients}")
         info_var = AsyncVar(ClientDBInfo())
         leader_var = AsyncVar(None)
-        proc.spawn(monitor_leader(proc, self.coord_ifaces, leader_var), "leader_mon")
+        # Own connection-file view (snapshot of the cluster-level one);
+        # coordinator forwards retarget it if the quorum moves later.
+        proc.spawn(
+            monitor_leader(
+                proc, CoordinatorSet(list(self.coord_set.addresses)), leader_var
+            ),
+            "leader_mon",
+        )
         proc.spawn(
             self._monitor_client_info(proc, leader_var, info_var), "info_mon"
         )

@@ -149,8 +149,9 @@ class Proxy:
         from ..flow.stats import CounterCollection, trace_counters
 
         self.stats = CounterCollection(f"Proxy{proxy_id}")
-        for _c in ("batches", "committed", "conflicted", "too_old"):
-            self.stats.counter(_c)  # pre-create: snapshots list all four
+        for _c in ("batches", "committed", "conflicted", "too_old",
+                   "grv_requests"):
+            self.stats.counter(_c)  # pre-create: snapshots list them all
         process.spawn(trace_counters(self.stats, process), "proxy_stats")
         self._last_batch_cut = process.network.loop.now()
         process.spawn(self._commit_batcher(), "proxy_batcher")
@@ -292,6 +293,7 @@ class Proxy:
                 while self._grv_stream.is_ready():
                     r, rep = await self._grv_stream.pop()
                     pairs.append((r, rep))
+            self.stats.add("grv_requests", len(pairs))
             batch = [
                 rep
                 for r, rep in pairs

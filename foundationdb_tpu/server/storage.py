@@ -57,9 +57,79 @@ from .interfaces import (
 KEYSPACE_END = b"\xff\xff"
 
 
+class VersionedClears:
+    """Versioned clear-range index: key-partitioned stamp lists.
+
+    The key space is a partition (`bounds[i]` starts segment i); each
+    segment carries the ascending (version, seq) stamps of every clear
+    covering it.  A point query is two binary searches — segment by key,
+    stamp by version — replacing the O(#clears) scan the flat list needed
+    (the reference's PTree VersionedMap is versioned-ordered for the same
+    reason, fdbclient/VersionedMap.h:43).  Inserting a clear splits at its
+    endpoints and appends one stamp per covered segment; trim() drops
+    expired stamps and coalesces equal neighbours, so the structure stays
+    proportional to the LIVE window, not the clear history.
+    """
+
+    def __init__(self):
+        self.bounds: List[bytes] = [b""]
+        self.stamps: List[List[Tuple[int, int]]] = [[]]
+
+    def _split_at(self, key: bytes) -> int:
+        """Segment index beginning exactly at `key`, splitting if needed."""
+        i = bisect_right(self.bounds, key) - 1
+        if self.bounds[i] == key:
+            return i
+        self.bounds.insert(i + 1, key)
+        self.stamps.insert(i + 1, list(self.stamps[i]))
+        return i + 1
+
+    def add(self, begin: bytes, end: bytes, version: int, seq: int):
+        if begin >= end:
+            return
+        i = self._split_at(begin)
+        j = self._split_at(end)
+        for k in range(i, j):
+            self.stamps[k].append((version, seq))
+
+    def latest_over(self, key: bytes, version: int) -> Tuple[int, int]:
+        i = bisect_right(self.bounds, key) - 1
+        st = self.stamps[i]
+        p = bisect_right(st, (version, 1 << 62)) - 1
+        return st[p] if p >= 0 else (-1, -1)
+
+    def trim(self, through_version: int):
+        nb: List[bytes] = [b""]
+        ns: List[List[Tuple[int, int]]] = [
+            [t for t in self.stamps[0] if t[0] > through_version]
+        ]
+        for b, st in zip(self.bounds[1:], self.stamps[1:]):
+            st2 = [t for t in st if t[0] > through_version]
+            if st2 == ns[-1]:
+                continue  # identical neighbour: coalesce
+            nb.append(b)
+            ns.append(st2)
+        self.bounds, self.stamps = nb, ns
+
+    def __iter__(self):
+        """(version, seq, begin, end) fragments, coverage-equivalent to the
+        inserted clears (endpoints may be split finer)."""
+        for i, st in enumerate(self.stamps):
+            if not st:
+                continue
+            b = self.bounds[i]
+            e = self.bounds[i + 1] if i + 1 < len(self.bounds) else KEYSPACE_END
+            for (v, s) in st:
+                yield (v, s, b, e)
+
+    def __len__(self):
+        return sum(len(st) for st in self.stamps)
+
+
 class VersionedStore:
-    """Per-key version chains + clear-range history (the flat-python stand-in
-    for the reference's PTree VersionedMap, fdbclient/VersionedMap.h:43).
+    """Per-key version chains + versioned clear-range index (the python
+    stand-in for the reference's PTree VersionedMap,
+    fdbclient/VersionedMap.h:43).
 
     Entries are ordered by (version, seq) where seq is the mutation's index
     within its version, so set-then-clear vs clear-then-set of the same key
@@ -72,16 +142,11 @@ class VersionedStore:
         # key -> [(version, seq, value-or-None)]
         self.kv: Dict[bytes, List[Tuple[int, int, Optional[bytes]]]] = {}
         self.sorted_keys: List[bytes] = []
-        # (version, seq, begin, end)
-        self.clears: List[Tuple[int, int, bytes, bytes]] = []
+        self.clears = VersionedClears()
 
     # -- reads --
     def _latest_clear_over(self, key: bytes, version: int) -> Tuple[int, int]:
-        best = (-1, -1)
-        for v, s, b, e in self.clears:
-            if v <= version and b <= key < e and (v, s) > best:
-                best = (v, s)
-        return best
+        return self.clears.latest_over(key, version)
 
     def get_stamped(self, key: bytes, version: int):
         """(touched, value): touched=False means no window entry covers the
@@ -117,7 +182,7 @@ class VersionedStore:
                 i = bisect_left(self.sorted_keys, key)
                 if i < len(self.sorted_keys) and self.sorted_keys[i] == key:
                     del self.sorted_keys[i]
-        self.clears = [c for c in self.clears if c[0] > through_version]
+        self.clears.trim(through_version)
 
     def get_range(
         self,
@@ -151,7 +216,7 @@ class VersionedStore:
             chain.append((version, seq, value))
 
     def clear_range(self, begin: bytes, end: bytes, version: int, seq: int = 0):
-        self.clears.append((version, seq, begin, end))
+        self.clears.add(begin, end, version, seq)
 
 
 class ByteSample:

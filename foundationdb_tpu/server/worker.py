@@ -87,6 +87,15 @@ class InitStorage:
 
 
 @dataclass
+class InitCoordinator:
+    """Start a coordination server on this worker (ref: every fdbserver can
+    serve coordination when named in the connection string; the quorum
+    change recruits new members this way, ManagementAPI.actor.cpp:684)."""
+
+    pass
+
+
+@dataclass
 class InitProxy:
     sequencer: SequencerInterface = None
     resolvers: List[ResolverInterface] = field(default_factory=list)
@@ -112,6 +121,14 @@ class WorkerServer:
         process.spawn(self._serve_init(), "worker_init")
         process.spawn(self._serve_ping(), "worker_ping")
         process.spawn(self._serve_role_check(), "worker_role_check")
+        if fs is not None and fs.exists(process, "coordination.dq"):
+            # A worker that served coordination (post-quorum-change) must
+            # resume it AT BOOT, before any controller exists — elections
+            # need the registers up first (ref: coordination starting from
+            # the command line/cluster file, not CC recruitment).
+            from .coordination import Coordinator
+
+            self.roles["coordinator"] = Coordinator(process, fs=fs)
 
     def _replace_role(self, name: str, role, tasks):
         """Install a new generation's role instance, cancelling the previous
@@ -242,6 +259,15 @@ class WorkerServer:
                 )
                 self._replace_role("storage", role, new_tasks())
                 reply.send(role.interface())
+            elif isinstance(req, InitCoordinator):
+                from .coordination import Coordinator
+
+                if "coordinator" not in self.roles:
+                    # Idempotent: re-recruiting an existing coordinator must
+                    # not reset its registers (its promises are durable).
+                    role = Coordinator(self.process, fs=self.fs)
+                    self._replace_role("coordinator", role, new_tasks())
+                reply.send("ok")
             elif isinstance(req, InitProxy):
                 role = Proxy(
                     self.process,

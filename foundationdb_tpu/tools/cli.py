@@ -42,6 +42,10 @@ class CliProcessor:
         "exclude": "exclude <storage_id> ... — mark storages for removal",
         "include": "include [<storage_id> ...] — clear exclusions "
         "(no args: all)",
+        "coordinators": "coordinators [<address> ...] — change the "
+        "coordinator quorum (odd count; no args: show requested)",
+        "setclass": "setclass <address> <class> — recruitment class "
+        "(stateless|transaction|storage|unset)",
         "backup": "backup <start|status|restore> <path> [version] — "
         "continuous backup driver (fdbbackup analog)",
         "dr": "dr <start|status> — replicate into the destination cluster "
@@ -339,6 +343,33 @@ class CliProcessor:
 
         await mgmt.include_servers(self.db, list(args) or None)
         return ["Included"]
+
+    async def _cmd_coordinators(self, args):
+        """Ref: fdbcli `coordinators <addr> ...` -> changeQuorum
+        (ManagementAPI.actor.cpp:684).  No args: show the requested set."""
+        from ..client import management as mgmt
+
+        if not args:
+            cur = await mgmt.get_requested_coordinators(self.db)
+            return [f"Coordinators: {', '.join(cur) if cur else '(default)'}"]
+        try:
+            await mgmt.change_coordinators(self.db, list(args))
+        except ValueError as e:
+            return [f"ERROR: {e}"]
+        return ["Coordination state changed"]
+
+    async def _cmd_setclass(self, args):
+        """Ref: fdbcli `setclass <address> <class>`."""
+        from ..client import management as mgmt
+
+        if len(args) != 2:
+            return ["ERROR: usage: setclass <address> <class>"]
+        addr, cls = args
+        try:
+            await mgmt.set_process_class(self.db, addr, cls)
+        except ValueError as e:
+            return [f"ERROR: {e}"]
+        return [f"Process class for `{addr}' set to {cls}"]
 
     async def _cmd_watch(self, args):
         (key,) = args

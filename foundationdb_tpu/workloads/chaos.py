@@ -29,7 +29,7 @@ def revive_worker(cluster, proc):
     ] + [w]
     leader_var = AsyncVar(None)
     proc.spawn(
-        monitor_leader(proc, cluster.coord_ifaces, leader_var),
+        monitor_leader(proc, getattr(cluster, "coord_set", cluster.coord_ifaces), leader_var),
         "leader_mon",
     )
     proc.spawn(run_worker_registration(w, leader_var), "registration")

@@ -1,0 +1,292 @@
+"""Coordinator quorum change (changeQuorum) + process classes (setclass).
+
+Ref: fdbclient/ManagementAPI.actor.cpp:684 (changeQuorum's safety checks +
+the movable coordinated state), fdbserver/Coordination.actor.cpp
+(ForwardRequest), ClusterController.actor.cpp:622-659 (ProcessClass
+fitness in recruitment).
+"""
+
+import pickle
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.client import management as mgmt
+from foundationdb_tpu.server.coordination import CoordinatedState
+from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def _write(c, db, kv):
+    async def txn(tr):
+        for k, v in kv.items():
+            tr.set(k, v)
+
+    c.run_all([(db, db.run(txn))], timeout_vt=3000.0)
+
+
+def _read(c, db, begin, end):
+    out = {}
+
+    async def txn(tr):
+        out["rows"] = dict(await tr.get_range(begin, end))
+
+    c.run_all([(db, db.run(txn))], timeout_vt=3000.0)
+    return out["rows"]
+
+
+def _wait_vt(c, db, cond, timeout_vt=600.0):
+    done = {}
+
+    async def poll():
+        while not cond():
+            await c.loop.delay(0.25)
+        done["ok"] = True
+
+    c.run_until(db.process.spawn(poll()), timeout_vt=timeout_vt)
+    return done.get("ok", False)
+
+
+def test_change_coordinators_during_load():
+    """Swap the quorum onto three worker machines mid-load: the acting CC
+    performs the movable-state handoff, every election client retargets via
+    forwarding, and killing the ENTIRE old quorum afterward does not stop
+    the database."""
+    c = DynamicCluster(seed=601, n_workers=7)
+    db = c.database()
+    _write(c, db, {b"q%02d" % i: b"v%d" % i for i in range(20)})
+
+    new_set = [p.address for p in c._worker_procs[:3]]
+    c.run_all([(db, mgmt.change_coordinators(db, new_set))], timeout_vt=500.0)
+
+    def swapped():
+        try:
+            cc = c.acting_controller()
+        except RuntimeError:
+            return False
+        return cc.coordinators.addresses == new_set
+
+    assert _wait_vt(c, db, swapped, timeout_vt=1200.0)
+
+    # A FRESH client bootstrapping from a STALE cluster file works while
+    # the retired coordinators still forward (a stale file with the whole
+    # old quorum dead is unrecoverable in the reference too).
+    db2 = c.database("late_client")
+    _write(c, db2, {b"late": b"client"})
+
+    # The old quorum is now disposable: kill all three original
+    # coordinators permanently.
+    for p in c._coord_procs:
+        p.kill()
+
+    _write(c, db, {b"after_swap": b"yes"})
+    rows = _read(c, db, b"q", b"r")
+    assert len(rows) == 20
+    assert _read(c, db, b"after", b"aftes")[b"after_swap"] == b"yes"
+    # The pre-swap client AND the late client both keep working with the
+    # old quorum gone: their connection-file views were retargeted.
+    _write(c, db2, {b"late2": b"still works"})
+
+
+def test_reelection_on_new_quorum_after_swap():
+    """After the swap, kill the acting controller: the standby must win an
+    election held on the NEW coordinators (it learned them via candidacy
+    forwarding) and recover the database."""
+    c = DynamicCluster(seed=602, n_workers=7, n_controllers=2)
+    db = c.database()
+    _write(c, db, {b"r%02d" % i: b"v%d" % i for i in range(10)})
+
+    new_set = [p.address for p in c._worker_procs[:3]]
+    c.run_all([(db, mgmt.change_coordinators(db, new_set))], timeout_vt=500.0)
+
+    def swapped():
+        try:
+            return c.acting_controller().coordinators.addresses == new_set
+        except RuntimeError:
+            return False
+
+    assert _wait_vt(c, db, swapped, timeout_vt=1200.0)
+
+    # Decommission discipline (as in the reference): wait until EVERY
+    # controller's connection-file view has been rewritten by forwarding
+    # before destroying the old quorum — then give worker/client monitors
+    # a few poll rounds for the same.
+    def all_ccs_retargeted():
+        return all(
+            cc.coordinators.addresses == new_set for cc in c.controllers
+        )
+
+    assert _wait_vt(c, db, all_ccs_retargeted, timeout_vt=1200.0)
+
+    async def settle():
+        await c.loop.delay(5.0)
+
+    c.run_until(db.process.spawn(settle()), timeout_vt=100.0)
+
+    old_cc = c.acting_controller()
+    gen_before = old_cc.generation
+    for p in c._coord_procs:
+        p.kill()  # old quorum gone: only the new one can elect
+    old_cc.process.kill()
+
+    def new_leader():
+        try:
+            cc = c.acting_controller()
+        except RuntimeError:
+            return False
+        return cc is not old_cc and cc.coordinators.addresses == new_set
+
+    assert _wait_vt(c, db, new_leader, timeout_vt=2000.0)
+    _write(c, db, {b"after_failover": b"yes"})
+    assert c.acting_controller().generation > gen_before
+
+
+def test_stale_cstate_writer_fenced_after_move():
+    """A CoordinatedState session that read BEFORE the move must get
+    coordinated_state_conflict writing after it — the fence that makes the
+    handoff safe (ref: MovableCoordinatedState)."""
+    from foundationdb_tpu.flow.error import FdbError
+
+    c = DynamicCluster(seed=603, n_workers=6)
+    db = c.database()
+    _write(c, db, {b"x": b"1"})
+
+    # Stale session pinned to the ORIGINAL quorum, read done pre-move.
+    stale = CoordinatedState(db.process, list(c.coord_set.interfaces))
+    raw = {}
+
+    async def pre_read():
+        raw["v"] = await stale.read()
+
+    c.run_until(db.process.spawn(pre_read()), timeout_vt=500.0)
+
+    new_set = [p.address for p in c._worker_procs[:3]]
+    c.run_all([(db, mgmt.change_coordinators(db, new_set))], timeout_vt=500.0)
+
+    def swapped():
+        try:
+            return c.acting_controller().coordinators.addresses == new_set
+        except RuntimeError:
+            return False
+
+    assert _wait_vt(c, db, swapped, timeout_vt=1200.0)
+
+    async def stale_write():
+        try:
+            await stale.set(pickle.dumps({"evil": True}))
+        except FdbError as e:
+            return e.name
+        return "accepted"
+
+    out = c.run_until(db.process.spawn(stale_write()), timeout_vt=500.0)
+    assert out == "coordinated_state_conflict"
+
+
+def test_crash_recover_after_quorum_move():
+    """Whole-cluster power loss after the move: worker-hosted coordinators
+    resume from disk at boot, rebooted processes start from their ORIGINAL
+    cluster files and must re-find the cluster through the retired
+    coordinators' durable forwards."""
+    c = DynamicCluster(seed=604, n_workers=6)
+    db = c.database()
+    _write(c, db, {b"c%02d" % i: b"v%d" % i for i in range(10)})
+
+    new_set = [p.address for p in c._worker_procs[:3]]
+    c.run_all([(db, mgmt.change_coordinators(db, new_set))], timeout_vt=500.0)
+
+    def swapped():
+        try:
+            return c.acting_controller().coordinators.addresses == new_set
+        except RuntimeError:
+            return False
+
+    assert _wait_vt(c, db, swapped, timeout_vt=1200.0)
+
+    c.crash_and_recover()
+    db2 = c.database("post_crash")
+    assert len(_read(c, db2, b"c", b"d")) == 10
+    _write(c, db2, {b"post_crash": b"yes"})
+    # The recovered controller follows the durable forward to the new set.
+    assert c.acting_controller().coordinators.addresses == new_set
+
+
+def test_unsatisfiable_coordinator_request_is_rejected():
+    """A request naming an unregistered address must be DROPPED (conf key
+    cleared), not retried forever; the quorum stays unchanged and live."""
+    c = DynamicCluster(seed=606, n_workers=5)
+    db = c.database()
+    _write(c, db, {b"pre": b"1"})
+    before = list(c.acting_controller().coordinators.addresses)
+
+    c.run_all(
+        [(db, mgmt.change_coordinators(db, ["worker0:0", "nosuch:0", "worker1:0"]))],
+        timeout_vt=500.0,
+    )
+
+    done = {}
+
+    async def poll():
+        while True:
+            out = {}
+
+            async def probe(tr):
+                tr.options["access_system_keys"] = True
+                out["v"] = await tr.get(mgmt.conf_key("coordinators"))
+
+            await db.run(probe)
+            if out["v"] is None:
+                done["ok"] = True
+                return
+            await c.loop.delay(0.25)
+
+    c.run_until(db.process.spawn(poll()), timeout_vt=600.0)
+    assert done.get("ok")
+    assert c.acting_controller().coordinators.addresses == before
+    _write(c, db, {b"post_reject": b"yes"})
+
+
+def test_setclass_prefers_stateless_workers():
+    """Workers marked `stateless` must win proxy recruitment at the next
+    generation over storage-class ones (ProcessClass fitness)."""
+    c = DynamicCluster(seed=605, n_workers=6, n_proxies=1)
+    db = c.database()
+    _write(c, db, {b"s": b"1"})
+
+    preferred = [p.address for p in c._worker_procs[3:]]
+    for a in preferred:
+        c.run_all(
+            [(db, mgmt.set_process_class(db, a, "stateless"))],
+            timeout_vt=300.0,
+        )
+    for a in [p.address for p in c._worker_procs[:3]]:
+        c.run_all(
+            [(db, mgmt.set_process_class(db, a, "storage"))],
+            timeout_vt=300.0,
+        )
+
+    # Wait for the running generation's monitor to pick the classes up,
+    # then force a regeneration with two proxies.
+    def classes_seen():
+        try:
+            return len(c.acting_controller().process_classes) >= 6
+        except RuntimeError:
+            return False
+
+    assert _wait_vt(c, db, classes_seen, timeout_vt=600.0)
+    c.run_all([(db, mgmt.configure(db, proxies=2))], timeout_vt=500.0)
+
+    def regenerated():
+        try:
+            cc = c.acting_controller()
+        except RuntimeError:
+            return False
+        proxies = [a for r, a in cc._role_addrs.items() if r.startswith("proxy")]
+        return len(proxies) == 2 and all(a in preferred for a in proxies)
+
+    assert _wait_vt(c, db, regenerated, timeout_vt=2000.0)
+    _write(c, db, {b"after_setclass": b"yes"})
