@@ -92,12 +92,29 @@ class Database:
         # Per-flags GRV coalescing lanes (ref: readVersionBatcher,
         # NativeAPI.actor.cpp:2698): {flags: (pending promises, inflight)}.
         self._grv_lanes: dict = {}
+        # Client-observed latency distributions, surfaced by status (ref:
+        # the latency sample buckets in ClientDBInfo/Status).
+        from ..flow.stats import ContinuousSample
+
+        rng = process.network.loop.rng
+        self.latency_samples = {
+            "grv": ContinuousSample(rng),
+            "commit": ContinuousSample(rng),
+        }
         if info_var is not None:
             from ..server.failure_monitor import run_failure_monitor_client
 
             process.spawn(
                 run_failure_monitor_client(self), "failure_monitor_client"
             )
+
+    def _sample_debug_id(self) -> Optional[str]:
+        """A fresh debug id for the latency trace chain, or None when the
+        transaction is not sampled (ref: debugTransaction sampling)."""
+        rng = self.process.network.loop.rng
+        if rng.random01() >= g_knobs.client.latency_sample_rate:
+            return None
+        return f"{rng.random_int(0, 1 << 62):015x}"
 
     # --- client-side GRV batching (ref: readVersionBatcher :2698) ---
     async def batched_read_version(self, flags: int) -> int:
@@ -119,16 +136,32 @@ class Database:
 
     async def _grv_drain(self, flags: int):
         from ..flow.error import ActorCancelled
+        from ..flow.trace import trace_batch
 
+        loop = self.process.network.loop
         lane = self._grv_lanes[flags]
         try:
             while lane["pending"]:
                 batch, lane["pending"] = lane["pending"], []
+                debug_id = self._sample_debug_id()
+                trace_batch(
+                    "TransactionDebug",
+                    "NativeAPI.getConsistentReadVersion.Before",
+                    debug_id,
+                )
+                t0 = loop.now()
                 try:
                     version = await self.pick_proxy(
                         "grv"
                     ).get_consistent_read_version.get_reply(
-                        self.process, GetReadVersionRequest(flags=flags)
+                        self.process,
+                        GetReadVersionRequest(flags=flags, debug_id=debug_id),
+                    )
+                    self.latency_samples["grv"].add(loop.now() - t0)
+                    trace_batch(
+                        "TransactionDebug",
+                        "NativeAPI.getConsistentReadVersion.After",
+                        debug_id,
                     )
                     for p in batch:
                         p.send(version)
@@ -666,9 +699,16 @@ class Transaction:
             write_conflict_ranges=write,
             mutations=list(self.mutations),
         )
+        from ..flow.trace import trace_batch
+
+        loop = self.db.process.network.loop
+        debug_id = self.db._sample_debug_id()
+        trace_batch("CommitDebug", "NativeAPI.commit.Before", debug_id)
+        t0 = loop.now()
         try:
             version = await self.db.pick_proxy("commit").commit.get_reply(
-                self.db.process, CommitTransactionRequest(transaction=tref)
+                self.db.process,
+                CommitTransactionRequest(transaction=tref, debug_id=debug_id),
             )
         except FdbError as e:
             if e.name in ("commit_unknown_result", "broken_promise"):
@@ -688,6 +728,8 @@ class Transaction:
                     await self._commit_dummy(key)
                 raise FdbError("commit_unknown_result")
             raise
+        self.db.latency_samples["commit"].add(loop.now() - t0)
+        trace_batch("CommitDebug", "NativeAPI.commit.After", debug_id)
         self.committed_version = version
         self._launch_watches(version)
         return version

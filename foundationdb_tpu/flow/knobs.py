@@ -59,6 +59,10 @@ class ClientKnobs(Knobs):
         self._init("initial_retry_delay", 0.01)
         self._init("grv_batch_interval", 0.005)  # MAX_BATCH_INTERVAL
         self._init("grv_max_batch_size", 1024)
+        # Probability a transaction carries a debug id through the commit /
+        # GRV pipelines (ref: CLIENT_KNOBS latency-sample rates feeding
+        # g_traceBatch); tests raise it to 1.0.
+        self._init("latency_sample_rate", 0.01)
         self._init("location_cache_size", 300000)
         self._init("key_size_limit", 10000)
         self._init("value_size_limit", 100000)

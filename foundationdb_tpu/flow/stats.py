@@ -32,6 +32,61 @@ class Counter:
         return r
 
 
+class ContinuousSample:
+    """Bounded reservoir of a metric's recent distribution with percentile
+    queries (ref: fdbrpc/ContinuousSample.h — the structure behind the
+    status doc's latency percentiles).
+
+    Uses the caller's DeterministicRandom so sampling stays seed-
+    reproducible in simulation (the global `random` module is banned in
+    sim code paths)."""
+
+    __slots__ = ("size", "rng", "samples", "n", "_min", "_max")
+
+    def __init__(self, rng, size: int = 500):
+        self.size = size
+        self.rng = rng
+        self.samples: list = []
+        self.n = 0
+        self._min = None
+        self._max = None
+
+    def add(self, x: float):
+        self.n += 1
+        self._min = x if self._min is None else min(self._min, x)
+        self._max = x if self._max is None else max(self._max, x)
+        if len(self.samples) < self.size:
+            self.samples.append(x)
+        elif self.rng.random01() < self.size / self.n:
+            self.samples[int(self.rng.random_int(0, self.size))] = x
+
+    def percentile(self, p: float):
+        if not self.samples:
+            return None
+        s = sorted(self.samples)
+        return s[min(len(s) - 1, int(p * len(s)))]
+
+    @property
+    def min(self):
+        return self._min
+
+    @property
+    def max(self):
+        return self._max
+
+    def summary(self) -> dict:
+        """The status-doc shape (ref: the latency_probe / *_latency fields
+        in Status.actor.cpp's qos section)."""
+        return {
+            "count": self.n,
+            "min": self._min,
+            "median": self.percentile(0.5),
+            "p90": self.percentile(0.90),
+            "p99": self.percentile(0.99),
+            "max": self._max,
+        }
+
+
 class CounterCollection:
     def __init__(self, name: str):
         self.name = name

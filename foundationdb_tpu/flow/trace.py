@@ -67,6 +67,18 @@ def global_collector() -> TraceCollector:
     return _global_collector
 
 
+def trace_batch(type_: str, location: str, debug_id) -> None:
+    """The g_traceBatch analog (ref: flow/Trace.h TraceBatch + the
+    CommitDebug/TransactionDebug stage chains, NativeAPI.actor.cpp:2376,
+    Resolver.actor.cpp:84): one event per pipeline stage, keyed by the
+    SAMPLED transaction's debug id so the latency chain
+    client -> proxy -> resolver -> log -> reply can be reassembled.
+    No-op for unsampled work (debug_id None), which bounds volume."""
+    if debug_id is None:
+        return
+    TraceEvent(type_).detail("ID", debug_id).detail("Location", location).log()
+
+
 class TraceEvent:
     """Builder: TraceEvent("Name").detail("Key", value) — emits on context exit
     or explicitly via log(); auto-emits when garbage collected is NOT relied

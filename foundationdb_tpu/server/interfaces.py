@@ -46,6 +46,9 @@ class SequencerInterface:
 class CommitTransactionRequest:
     transaction: "object" = None  # client.types.CommitTransactionRef
     flags: int = 0
+    # Sampled-transaction id for the CommitDebug latency chain (ref:
+    # debugTransaction / g_traceBatch, NativeAPI.actor.cpp:2376).
+    debug_id: Optional[str] = None
 
 
 # GRV priority flags (ref: GetReadVersionRequest::FLAG_PRIORITY_* —
@@ -57,6 +60,7 @@ GRV_FLAG_PRIORITY_BATCH = 1
 class GetReadVersionRequest:
     transaction_count: int = 1
     flags: int = 0
+    debug_id: Optional[str] = None  # TransactionDebug chain (ref :2698)
 
 
 @dataclass
@@ -109,6 +113,9 @@ class ResolveTransactionBatchRequest:
     state_txns: List[Tuple[int, list]] = field(default_factory=list)
     proxy_id: str = "proxy0"
     epoch: int = 0  # generation guard: stale-epoch requests are rejected
+    # Batch-level CommitDebug id (ref: ResolveTransactionBatchRequest
+    # debugID, Resolver.actor.cpp:84).
+    debug_id: Optional[str] = None
 
 
 @dataclass
@@ -171,6 +178,7 @@ class TLogCommitRequest:
     # knownCommittedVersion riding pushes): consumers may apply up to it
     # even when a log replica is unreachable.
     known_committed: int = 0
+    debug_id: Optional[str] = None  # CommitDebug chain (TLog stages)
 
 
 # Broadcast tags: metadata mutations go everywhere (the private-mutation

@@ -152,6 +152,16 @@ class Proxy:
         for _c in ("batches", "committed", "conflicted", "too_old",
                    "grv_requests"):
             self.stats.counter(_c)  # pre-create: snapshots list them all
+        # Proxy-observed latency distributions (batch arrival -> reply),
+        # surfaced as status qos percentiles (ref: the commit/GRV latency
+        # bands Status.actor.cpp derives from proxy metrics).
+        from ..flow.stats import ContinuousSample
+
+        _rng = process.network.loop.rng
+        self.latency_samples = {
+            "commit": ContinuousSample(_rng),
+            "grv": ContinuousSample(_rng),
+        }
         process.spawn(trace_counters(self.stats, process), "proxy_stats")
         self._last_batch_cut = process.network.loop.now()
         process.spawn(self._commit_batcher(), "proxy_batcher")
@@ -281,6 +291,10 @@ class Proxy:
         batch_tps = None
         last_fetch = -1e9
         deferred: list = []  # batch-priority replies awaiting lane budget
+        from ..flow.trace import trace_batch
+
+        # reply -> (debug_id, arrival time); survives lane deferral.
+        grv_meta: dict = {}
         while True:
             if deferred and not self._grv_stream.is_ready():
                 # Deferred batch-lane work but no new arrivals: tick the
@@ -294,6 +308,16 @@ class Proxy:
                     r, rep = await self._grv_stream.pop()
                     pairs.append((r, rep))
             self.stats.add("grv_requests", len(pairs))
+            for r, rep in pairs:
+                grv_meta[id(rep)] = (
+                    getattr(r, "debug_id", None),
+                    loop.now(),
+                )
+                trace_batch(
+                    "TransactionDebug",
+                    "MasterProxyServer.serveGrv.GotRequest",
+                    getattr(r, "debug_id", None),
+                )
             batch = [
                 rep
                 for r, rep in pairs
@@ -379,9 +403,17 @@ class Proxy:
                     # generation is ending; clients will retry against the
                     # next one.
                     for rep in batch:
+                        grv_meta.pop(id(rep), None)
                         rep.send_error("broken_promise")
                     continue
             for rep in batch:
+                did, t_arr = grv_meta.pop(id(rep), (None, loop.now()))
+                self.latency_samples["grv"].add(loop.now() - t_arr)
+                trace_batch(
+                    "TransactionDebug",
+                    "MasterProxyServer.serveGrv.Replied",
+                    did,
+                )
                 rep.send(version)
 
     async def _idle_batch_ticker(self):
@@ -474,7 +506,19 @@ class Proxy:
         self, batch: List[Tuple], local_batch: int, ctx: dict = None
     ):
         from ..flow.eventloop import wait_for_all
+        from ..flow.trace import trace_batch
 
+        loop0 = self.process.network.loop
+        t_start = loop0.now()
+        # Batch-level debug id: the first sampled transaction's (ref:
+        # commitBatch folding member debugIDs into one batch UID :340).
+        batch_debug = next(
+            (req.debug_id for req, _r in batch if req.debug_id is not None),
+            None,
+        )
+        trace_batch(
+            "CommitDebug", "MasterProxyServer.commitBatch.Before", batch_debug
+        )
         self.stats.add("batches")
         # Phase 1: commit version from the sequencer, serialized in local
         # batch order so this proxy's versions are monotone in batch order
@@ -485,6 +529,11 @@ class Proxy:
             self.process, self.epoch  # fenced: only this generation is served
         )
         version, prev = gv.version, gv.prev_version
+        trace_batch(
+            "CommitDebug",
+            "MasterProxyServer.commitBatch.GotCommitVersion",
+            batch_debug,
+        )
         if ctx is not None:
             ctx["version"] = version
         own_prev, self._last_own_version = self._last_own_version, version
@@ -561,6 +610,7 @@ class Proxy:
                         state_txns=state_txns,
                         proxy_id=self.proxy_id,
                         epoch=self.epoch,
+                        debug_id=batch_debug,
                     ),
                 )
                 for ri, r in enumerate(self.resolvers)
@@ -569,6 +619,11 @@ class Proxy:
         statuses = [
             min(rep.committed[t] for rep in replies) for t in range(len(batch))
         ]
+        trace_batch(
+            "CommitDebug",
+            "MasterProxyServer.commitBatch.AfterResolution",
+            batch_debug,
+        )
 
         # Phase 3: post-resolution processing, strictly in this proxy's own
         # version order: first the OTHER proxies' state transactions for the
@@ -642,10 +697,16 @@ class Proxy:
                         tagged=per_log[li],
                         epoch=self.epoch,
                         known_committed=self.committed.get(),
+                        debug_id=batch_debug,
                     ),
                 )
                 for li, tl in enumerate(self.tlogs)
             ]
+        )
+        trace_batch(
+            "CommitDebug",
+            "MasterProxyServer.commitBatch.AfterLogPush",
+            batch_debug,
         )
 
         from ..flow import sim_validation
@@ -662,7 +723,13 @@ class Proxy:
         await self.sequencer.report_committed.get_reply(self.process, version)
         if version > self.committed.get():
             self.committed.set(version)
+        self.latency_samples["commit"].add(loop0.now() - t_start)
         for (req, reply), status in zip(batch, statuses):
+            trace_batch(
+                "CommitDebug",
+                "MasterProxyServer.commitBatch.AfterReply",
+                req.debug_id,
+            )
             if status == COMMITTED:
                 self.stats.add("committed")
                 reply.send(version)

@@ -167,10 +167,14 @@ class Resolver:
 
     async def _resolve_one(self, req: ResolveTransactionBatchRequest, reply):
         from ..flow.buggify import buggify
+        from ..flow.trace import trace_batch
 
         if req.epoch != self.epoch:
             reply.send_error("operation_failed")  # stale generation's proxy
             return
+        trace_batch(
+            "CommitDebug", "Resolver.resolveBatch.Before", req.debug_id
+        )
         if buggify("resolver_delay"):
             # BUGGIFY: batches arrive out of order — exercises the
             # prevVersion chain wait below (ref :104-115).
@@ -237,4 +241,5 @@ class Resolver:
                 del self._recent_state_txns[v]
 
         self.version.set(req.version)
+        trace_batch("CommitDebug", "Resolver.resolveBatch.After", req.debug_id)
         reply.send(out)

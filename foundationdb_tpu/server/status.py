@@ -155,7 +155,40 @@ def cluster_status(cluster) -> dict:
             qos["released_transactions_behind"] = info.lag_versions
             qos["performance_limited_by"] = getattr(info, "limiting", "none")
         cl["qos"] = qos
+        # Passive latency distributions from the proxy's ContinuousSamples
+        # (ref: the commit/GRV latency bands in Status.actor.cpp's qos; the
+        # ACTIVE probe is the async latency_probe() below).
+        samples = getattr(proxy, "latency_samples", None)
+        if samples is not None:
+            cl["latency"] = {
+                "commit_seconds": samples["commit"].summary(),
+                "grv_seconds": samples["grv"].summary(),
+            }
     return doc
+
+
+async def latency_probe(db) -> dict:
+    """Active end-to-end probe (ref: Status.actor.cpp's latency_probe
+    section — doLatencyProbe running a real transaction): one GRV, one
+    read, one commit, each timed in virtual seconds."""
+    loop = db.process.network.loop
+    out = {}
+    tr = db.create_transaction()
+    tr.options["access_system_keys"] = True
+    t0 = loop.now()
+    await tr.get_read_version()
+    out["transaction_start_seconds"] = loop.now() - t0
+    t0 = loop.now()
+    await tr.get(b"\xff/status/probe")
+    out["read_seconds"] = loop.now() - t0
+    t0 = loop.now()
+    rng = loop.rng
+    k = b"\xff/status/probe/%016x" % rng.random_int(0, 1 << 62)
+    tr.set(k, b"probe")
+    tr.clear(k)  # net no-op; the commit round-trip is what's measured
+    await tr.commit()
+    out["commit_seconds"] = loop.now() - t0
+    return out
 
 
 async def quiet_database(

@@ -269,6 +269,11 @@ class TLog:
             # where a kill strands un-acked data (the epoch-cut path).
             loop = self.process.network.loop
             await loop.delay(loop.rng.random01() * 0.02)
+        from ..flow.trace import trace_batch
+
+        trace_batch(
+            "CommitDebug", "TLog.tLogCommit.BeforeWaitForVersion", req.debug_id
+        )
         # Versions are committed in the sequencer's order (ref: TLogServer
         # waits version ordering before appending).
         await self.durable.when_at_least(req.prev_version)
@@ -300,6 +305,9 @@ class TLog:
             self._mem_bytes += size
             await self.process.network.loop.delay(COMMIT_DELAY)  # fsync stand-in
         self.durable.set(req.version)
+        trace_batch(
+            "CommitDebug", "TLog.tLogCommit.AfterTLogCommit", req.debug_id
+        )
         self._trim()  # consumers with vacuous floors never pop again
         if (
             self.spill_store is not None

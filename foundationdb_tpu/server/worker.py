@@ -87,6 +87,16 @@ class InitStorage:
 
 
 @dataclass
+class ProfilerRequest:
+    """Runtime CPU-profiler toggle (ref: ProfilerRequest in
+    fdbclient/ClientWorkerInterface.h, handled by worker.actor.cpp; the
+    CpuProfiler workload drives it)."""
+
+    enabled: bool = True
+    interval: float = 0.005
+
+
+@dataclass
 class InitCoordinator:
     """Start a coordination server on this worker (ref: every fdbserver can
     serve coordination when named in the connection string; the quorum
@@ -259,6 +269,10 @@ class WorkerServer:
                 )
                 self._replace_role("storage", role, new_tasks())
                 reply.send(role.interface())
+            elif isinstance(req, ProfilerRequest):
+                from ..flow.profiler import profiler_toggle
+
+                reply.send(profiler_toggle(req.enabled, req.interval))
             elif isinstance(req, InitCoordinator):
                 from .coordination import Coordinator
 

@@ -44,6 +44,8 @@ class CliProcessor:
         "(no args: all)",
         "coordinators": "coordinators [<address> ...] — change the "
         "coordinator quorum (odd count; no args: show requested)",
+        "profile": "profile <on|off|report> [interval] — sampling CPU "
+        "profiler runtime toggle",
         "setclass": "setclass <address> <class> — recruitment class "
         "(stateless|transaction|storage|unset)",
         "backup": "backup <start|status|restore> <path> [version] — "
@@ -100,7 +102,7 @@ class CliProcessor:
             return ["ERROR: backup <start|status|restore> <path> [version]"]
         sub, path = args[0], args[1]
         from ..fileio import SimFileSystem
-        from ..layers.backup import BackupContainer, ContinuousBackupAgent
+        from ..layers.backup import ContinuousBackupAgent, open_container
 
         if sub == "start":
             if path in self._backups:
@@ -108,9 +110,14 @@ class CliProcessor:
             fs = getattr(self.cluster, "fs", None) or SimFileSystem(
                 self.cluster.net
             )
-            container = BackupContainer(
-                fs, self.cluster.net.process(f"bk:{path}"), path
-            )
+            try:
+                # Scheme dispatch: blobstore:// targets the object store,
+                # anything else the cluster filesystem.
+                container = open_container(
+                    path, fs, self.cluster.net.process(f"bk:{path}")
+                )
+            except ValueError as e:
+                return [f"ERROR: {e}"]
             agent = ContinuousBackupAgent(
                 self.db,
                 fs,
@@ -244,6 +251,21 @@ class CliProcessor:
     async def _cmd_status(self, args):
         doc = cluster_status(self.cluster)
         if args and args[0] == "json":
+            from ..flow.eventloop import timeout_after
+
+            # The json form runs the ACTIVE probe like the reference's
+            # clusterGetStatus (Status.actor.cpp latency_probe section) —
+            # under a timeout: a throttled/recovering cluster (exactly
+            # what status diagnoses) must not hang the command.
+            loop = self.db.process.network.loop
+            task = self.db.process.spawn(
+                self._probe_swallowing(), "status_probe"
+            )
+            probe = await timeout_after(loop, task, 5.0, default=None)
+            if probe is None:
+                task.cancel()
+                probe = {"error": "probe timed out"}
+            doc["cluster"]["latency_probe"] = probe
             return json.dumps(doc, indent=2, default=str).splitlines()
         cl = doc["cluster"]
         lines = [
@@ -290,6 +312,14 @@ class CliProcessor:
                 f"{t['conflicted']} conflicted"
             )
         return lines
+
+    async def _probe_swallowing(self):
+        from ..server.status import latency_probe
+
+        try:
+            return await latency_probe(self.db)
+        except FdbError:
+            return {"error": "probe failed"}
 
     async def _cmd_begin(self, args):
         if self._tr is not None:
@@ -370,6 +400,31 @@ class CliProcessor:
         except ValueError as e:
             return [f"ERROR: {e}"]
         return [f"Process class for `{addr}' set to {cls}"]
+
+    async def _cmd_profile(self, args):
+        """Ref: fdbcli `profile` + the CpuProfiler workload's runtime
+        toggle (Profiler.actor.cpp:175)."""
+        from ..flow.profiler import get_profiler, profiler_toggle
+
+        if not args or args[0] not in ("on", "off", "report"):
+            return ["ERROR: usage: profile <on|off|report> [interval]"]
+        if args[0] == "report":
+            rep = get_profiler().report(top=10)
+            lines = [
+                f"Profiler: {'running' if rep['running'] else 'stopped'}, "
+                f"{rep['total_samples']} samples @ {rep['interval']*1e3:.1f}ms"
+            ]
+            for h in rep["hot_functions"]:
+                lines.append(
+                    f"  {h['fraction']*100:5.1f}%  {h['function']} "
+                    f"({h['file'].rsplit('/', 1)[-1]}:{h['line']})"
+                )
+            return lines
+        interval = float(args[1]) if len(args) > 1 else None
+        state = profiler_toggle(args[0] == "on", interval)
+        return [
+            f"Profiler {'running' if state['running'] else 'stopped'}"
+        ]
 
     async def _cmd_watch(self, args):
         (key,) = args

@@ -1,0 +1,146 @@
+"""BlobStore endpoint + HTTP client + blobstore:// backup containers.
+
+Ref: fdbrpc/BlobStore.h:34 (BlobStoreEndpoint with rate knobs),
+fdbrpc/HTTP.actor.cpp (hand-rolled HTTP/1.1), BackupContainer.actor.cpp
+(the blobstore container flavor).  Real sockets on localhost, like the
+real-transport suite.
+"""
+
+import time
+
+import pytest
+
+from foundationdb_tpu.fileio.blobstore import (
+    BlobStoreEndpoint,
+    BlobStoreServer,
+    TokenBucket,
+    build_response,
+    parse_request,
+)
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.flow.error import FdbError
+
+
+@pytest.fixture
+def server():
+    s = BlobStoreServer()
+    yield s
+    s.close()
+
+
+def test_http_codec_roundtrip():
+    raw = (
+        b"PUT /b/o HTTP/1.1\r\nHost: x\r\nContent-Length: 5\r\n\r\nhello"
+        b"GET /b/o HTTP/1.1\r\nContent-Length: 0\r\n\r\n"
+    )
+    m, p, h, body, used = parse_request(raw)
+    assert (m, p, body) == ("PUT", "/b/o", b"hello") and h["host"] == "x"
+    m2, p2, _h2, body2, _ = parse_request(raw[used:])
+    assert (m2, p2, body2) == ("GET", "/b/o", b"")
+    assert parse_request(raw[:10]) is None  # incomplete
+    resp = build_response(404, b"nope")
+    assert resp.startswith(b"HTTP/1.1 404") and resp.endswith(b"nope")
+
+
+def test_endpoint_crud_and_listing(server):
+    ep = BlobStoreEndpoint.from_url(server.url)
+    big = bytes(range(256)) * 4096  # 1 MiB
+    ep.put_object("pages/p1", b"alpha")
+    ep.put_object("pages/p2", big)
+    ep.put_object("manifest", b"{}")
+    assert ep.get_object("pages/p1") == b"alpha"
+    assert ep.get_object("pages/p2") == big
+    assert ep.list_objects("pages/") == ["pages/p1", "pages/p2"]
+    assert ep.list_objects() == ["manifest", "pages/p1", "pages/p2"]
+    assert ep.object_exists("manifest")
+    ep.delete_object("pages/p1")
+    assert not ep.object_exists("pages/p1")
+    with pytest.raises(FdbError, match="file_not_found"):
+        ep.get_object("pages/p1")
+    ep.close()
+
+
+def test_endpoint_url_knobs():
+    ep = BlobStoreEndpoint.from_url(
+        "blobstore://10.0.0.1:9000/bkt?requests_per_second=55"
+        "&read_bytes_per_second=1000000&retries=7"
+    )
+    assert (ep.host, ep.port, ep.bucket) == ("10.0.0.1", 9000, "bkt")
+    assert ep.req_bucket.rate == 55.0
+    assert ep.read_bucket.rate == 1000000.0
+    assert ep.retries == 7
+
+
+def test_token_bucket_paces_requests():
+    tb = TokenBucket(rate=200.0, burst=1.0)
+    t0 = time.monotonic()
+    for _ in range(21):
+        tb.acquire()
+    dt = time.monotonic() - t0
+    # 20 refills at 200/s = 100ms minimum (generous upper bound for a
+    # loaded host).
+    assert dt >= 0.08, dt
+
+
+def test_endpoint_reconnects_after_connection_loss(server):
+    """Keep-alive breakage mid-session: the retry loop must transparently
+    reconnect (ref: BlobStoreEndpoint::doRequest's reconnect-on-error)."""
+    ep = BlobStoreEndpoint.from_url(server.url)
+    ep.put_object("x", b"1")
+    server.kick_connections()
+    ep.put_object("y", b"2")  # must survive the dead keep-alive socket
+    assert ep.get_object("y") == b"2"
+    assert ep.get_object("x") == b"1"
+    ep.close()
+
+
+def test_snapshot_backup_to_blobstore_and_restore(server):
+    """End-to-end: dump a SimCluster keyspace into the blob store through
+    the agent's container factory, wipe, restore, verify — the reference's
+    backup-to-S3 path shape."""
+    from foundationdb_tpu.layers.backup import FileBackupAgent
+    from foundationdb_tpu.server import SimCluster
+
+    c = SimCluster(seed=820, n_proxies=1)
+    db = c.database("bk")
+
+    async def fill(tr):
+        for i in range(120):
+            tr.set(b"bs%03d" % i, b"val%d" % i)
+
+    c.run_all([(db, db.run(fill))], timeout_vt=2000.0)
+
+    agent = FileBackupAgent(db, c.fs)
+    container = agent.container(server.url + "/snap1")
+
+    async def run_backup():
+        await agent.submit_backup(container, begin=b"bs", end=b"bt")
+        await agent.executor(c.database()).run(until_empty=True)
+        return await container.read_manifest()
+
+    manifest = c.run_until(db.process.spawn(run_backup()), timeout_vt=5000.0)
+    assert manifest is not None and manifest["pages"] >= 1
+    # Pages physically live in the object store.
+    assert any(
+        n.startswith("snap1/range-") for (_b, n) in server.objects
+    ), sorted(server.objects)
+
+    async def wipe(tr):
+        tr.clear_range(b"bs", b"bt")
+
+    c.run_all([(db, db.run(wipe))], timeout_vt=2000.0)
+
+    async def run_restore():
+        await agent.restore(container)
+        return True
+
+    assert c.run_until(db.process.spawn(run_restore()), timeout_vt=5000.0)
+    out = {}
+
+    async def check(tr):
+        out["rows"] = await tr.get_range(b"bs", b"bt")
+
+    c.run_all([(db, db.run(check))], timeout_vt=2000.0)
+    assert len(out["rows"]) == 120
+    assert out["rows"][5] == (b"bs005", b"val5")
+    set_event_loop(None)

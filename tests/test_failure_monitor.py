@@ -85,13 +85,18 @@ def test_read_routes_around_suspect_replica_without_timeout():
         assert (await tr.get(b"fm01")) == b"v1"
 
         # Grey failure: CC can't reach the victim; the client still can.
+        # Long enough for several ping timeouts (PING_TIMEOUT=2.0) to
+        # elapse INSIDE the clog window — detection timing is seed
+        # dependent and must not race the clog's expiry.
         c.net.clog_pair(
-            victim.process.machine.machine_id, cc_machine, 2.0
+            victim.process.machine.machine_id, cc_machine, 8.0
         )
 
         # Wait until the failure broadcast reaches THIS client.
         addr = victim.process.address
-        for _ in range(60):
+        # Generous bound: detection needs several ping-sweep rounds and
+        # the exact count is seed/timing dependent.
+        for _ in range(600):
             if db.failure_states.get(addr):
                 break
             await c.loop.delay(0.02)

@@ -1,0 +1,84 @@
+"""Sampling CPU profiler + runtime toggle.
+
+Ref: flow/Profiler.actor.cpp:99 (SIGPROF sampler), :175 (runtime enable),
+fdbserver/workloads/CpuProfiler.actor.cpp (toggle over RPC).
+"""
+
+import time
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.flow.profiler import (
+    SamplingProfiler,
+    get_profiler,
+    profiler_toggle,
+)
+
+
+def _busy_marker_fn(deadline):
+    acc = 0
+    while time.monotonic() < deadline:
+        acc += sum(i * i for i in range(200))
+    return acc
+
+
+def test_sampler_catches_hot_function():
+    p = SamplingProfiler(interval=0.002)
+    p.start()
+    assert p.running
+    _busy_marker_fn(time.monotonic() + 0.4)
+    p.stop()
+    assert not p.running
+    rep = p.report()
+    assert rep["total_samples"] > 10
+    names = {h["function"] for h in rep["hot_functions"]}
+    assert any(
+        "_busy_marker_fn" in n or "<genexpr>" in n for n in names
+    ), names
+
+
+def test_toggle_stops_sampling():
+    p = SamplingProfiler(interval=0.002)
+    p.start()
+    _busy_marker_fn(time.monotonic() + 0.1)
+    p.stop()
+    n = p.total_samples
+    # Stopped: no further samples accumulate.
+    _busy_marker_fn(time.monotonic() + 0.15)
+    assert p.total_samples == n
+    # Restart works (the runtime toggle's whole point).
+    p.start()
+    _busy_marker_fn(time.monotonic() + 0.15)
+    p.stop()
+    assert p.total_samples > n
+
+
+def test_worker_rpc_toggle_and_cli():
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+    from foundationdb_tpu.server.worker import ProfilerRequest
+    from foundationdb_tpu.tools.cli import CliProcessor
+
+    c = DynamicCluster(seed=830, n_workers=5)
+    db = c.database()
+    wi = c.workers[0].interface()
+
+    async def toggle(enabled):
+        return await wi.init_role.get_reply(
+            db.process, ProfilerRequest(enabled=enabled, interval=0.002)
+        )
+
+    state = c.run_until(db.process.spawn(toggle(True)), timeout_vt=500.0)
+    assert state["running"] is True
+    _busy_marker_fn(time.monotonic() + 0.2)
+    state = c.run_until(db.process.spawn(toggle(False)), timeout_vt=500.0)
+    assert state["running"] is False
+    assert get_profiler().total_samples > 0
+
+    cli = CliProcessor(c, db)
+    out = c.run_until(
+        db.process.spawn(cli.run_command("profile report")), timeout_vt=500.0
+    )
+    assert out and out[0].startswith("Profiler: stopped")
+    set_event_loop(None)
+    profiler_toggle(False)
