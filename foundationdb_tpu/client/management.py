@@ -149,6 +149,51 @@ async def get_process_classes(db) -> Dict[str, str]:
     return out
 
 
+async def lock_database(db, uid: Optional[bytes] = None) -> bytes:
+    """Lock the database (ref: lockDatabase ManagementAPI.actor.cpp:400):
+    writes a UID into `\xff/dbLocked`; every non-lock-aware GRV/commit
+    fails database_locked until unlock.  Locking an already-locked
+    database with a DIFFERENT uid raises database_locked; same uid is
+    idempotent."""
+    if uid is None:
+        uid = b"%016x" % db.process.network.loop.rng.random_int(1, 1 << 62)
+    await _write_lock_record(db, uid, uid)
+    return uid
+
+
+async def _write_lock_record(db, holder_uid: bytes, value: bytes) -> None:
+    """Shared lock/unlock writer.  Explicit retry loop: db.run would retry
+    database_locked (it is in the client retry set, as in the reference's
+    onError), but a CONFLICTING holder must surface — the reference's
+    lockDatabase rethrows it before onError (ManagementAPI.actor.cpp:1279).
+    Idempotent under commit_unknown_result: rewriting the same value is
+    harmless."""
+    from ..flow.error import FdbError
+    from ..server.system_keys import DB_LOCKED_KEY
+
+    tr = db.create_transaction()
+    while True:
+        try:
+            tr.options["access_system_keys"] = True
+            tr.options["lock_aware"] = True
+            cur = await tr.get(DB_LOCKED_KEY)
+            if cur and cur != holder_uid:
+                raise FdbError("database_locked")  # someone else's lock
+            tr.set(DB_LOCKED_KEY, value)
+            await tr.commit()
+            return
+        except FdbError as e:
+            if e.name == "database_locked":
+                raise
+            await tr.on_error(e)
+
+
+async def unlock_database(db, uid: bytes) -> None:
+    """Ref: unlockDatabase — only the holder of the lock UID may unlock.
+    Writes the empty value (= unlocked; see DB_LOCKED_KEY)."""
+    await _write_lock_record(db, uid, b"")
+
+
 async def exclude_servers(db, storage_ids: List[str]) -> None:
     """Mark storages for removal (ref: excludeServers ManagementAPI:556);
     DD healing treats excluded servers like failed ones — moves their data

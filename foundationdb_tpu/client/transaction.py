@@ -292,13 +292,16 @@ class Transaction:
         if self._read_version is None:
             if self.db.info_var is not None:
                 await self.db.wait_connected()
-            from ..server.interfaces import GRV_FLAG_PRIORITY_BATCH
+            from ..server.interfaces import (
+                GRV_FLAG_LOCK_AWARE,
+                GRV_FLAG_PRIORITY_BATCH,
+            )
 
             flags = (
                 GRV_FLAG_PRIORITY_BATCH
                 if self.options.get("priority_batch")
                 else 0
-            )
+            ) | (GRV_FLAG_LOCK_AWARE if self.options.get("lock_aware") else 0)
             self._read_version = await self.db.batched_read_version(flags)
         return self._read_version
 
@@ -705,10 +708,17 @@ class Transaction:
         debug_id = self.db._sample_debug_id()
         trace_batch("CommitDebug", "NativeAPI.commit.Before", debug_id)
         t0 = loop.now()
+        from ..server.interfaces import COMMIT_FLAG_LOCK_AWARE
+
+        commit_flags = (
+            COMMIT_FLAG_LOCK_AWARE if self.options.get("lock_aware") else 0
+        )
         try:
             version = await self.db.pick_proxy("commit").commit.get_reply(
                 self.db.process,
-                CommitTransactionRequest(transaction=tref, debug_id=debug_id),
+                CommitTransactionRequest(
+                    transaction=tref, flags=commit_flags, debug_id=debug_id
+                ),
             )
         except FdbError as e:
             if e.name in ("commit_unknown_result", "broken_promise"):
@@ -744,6 +754,10 @@ class Transaction:
             tr = Transaction(self.db)
             tr.options["causal_write_risky"] = True
             tr.options["access_system_keys"] = True
+            # The fence must work under a database lock iff the original
+            # could commit under it.
+            if self.options.get("lock_aware"):
+                tr.options["lock_aware"] = True
             tr.add_read_conflict_range(key, key_after(key))
             tr.add_write_conflict_range(key, key_after(key))
             try:

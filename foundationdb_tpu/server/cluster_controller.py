@@ -511,10 +511,48 @@ class ClusterController:
             )
             if team:
                 entries.append((sb, se, team))
+
+        # Database lock state must survive the generation change: read
+        # `\xff/dbLocked` from a storage owning it and inject it with the
+        # map (ref: the txnStateStore carrying databaseLockedKey through
+        # recovery).
+        from .interfaces import GetKeyValuesRequest
+        from .system_keys import DB_LOCKED_KEY
+
+        locked_uid = None
+        lock_owner = next(
+            (
+                storage_ifs[i]
+                for i, (sid, rs) in enumerate(owned_by.items())
+                if covers(rs, DB_LOCKED_KEY)
+            ),
+            None,
+        )
+        if lock_owner is not None:
+            rep = await timeout_after(
+                loop,
+                lock_owner.get_key_values.get_reply(
+                    self.process,
+                    GetKeyValuesRequest(
+                        begin=DB_LOCKED_KEY,
+                        end=DB_LOCKED_KEY + b"\x00",
+                        version=recovery_txn_version,
+                    ),
+                ),
+                10.0,
+            )
+            if rep is None:
+                # NEVER come up unlocked on a read failure: dropping the
+                # lock across a generation change would unfence a database
+                # the operator believes frozen.  Fail the recovery; _run
+                # retries it.
+                raise FdbError("timed_out")
+            if rep.data:
+                locked_uid = rep.data[0][1] or None
         await wait_for_all(
             [
                 pif.load_system_map.get_reply(
-                    self.process, (entries, server_list)
+                    self.process, (entries, server_list, locked_uid)
                 )
                 for pif in proxy_ifs
             ]

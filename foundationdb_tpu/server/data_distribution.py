@@ -42,6 +42,7 @@ class DataDistributor:
 
         async def txn(tr):
             tr.options["access_system_keys"] = True
+            tr.options["lock_aware"] = True
             for sid, iface in storages.items():
                 tr.set(sk.server_list_key(sid), sk.encode_server_entry(iface))
 
@@ -58,6 +59,7 @@ class DataDistributor:
 
         async def txn(tr):
             tr.options["access_system_keys"] = True
+            tr.options["lock_aware"] = True
             tr.set(
                 sk.key_servers_key(b""),
                 sk.encode_key_servers(team, [], KEYSPACE_END),
@@ -72,6 +74,7 @@ class DataDistributor:
 
         async def txn(tr):
             tr.options["access_system_keys"] = True
+            tr.options["lock_aware"] = True
             return await tr.get_range(sk.KEY_SERVERS_PREFIX, sk.KEY_SERVERS_END)
 
         rows = await self.db.run(txn)
@@ -94,6 +97,7 @@ class DataDistributor:
 
         async def txn(tr):
             tr.options["access_system_keys"] = True
+            tr.options["lock_aware"] = True
             # Only the CONTAINING record (greatest begin <= at_key) joins
             # the read set: a full-map scan would conflict this split with
             # every unrelated DD metadata write and rescan O(map) per retry.
@@ -137,6 +141,7 @@ class DataDistributor:
 
         async def start(tr):
             tr.options["access_system_keys"] = True
+            tr.options["lock_aware"] = True
             raw = await tr.get(sk.key_servers_key(begin))
             if raw is None:
                 raise ValueError(f"no shard begins at {begin!r}")
@@ -162,6 +167,7 @@ class DataDistributor:
 
         async def finish(tr):
             tr.options["access_system_keys"] = True
+            tr.options["lock_aware"] = True
             raw = await tr.get(sk.key_servers_key(begin))
             if raw is None:
                 raise ValueError(f"shard {begin!r} vanished mid-move")
@@ -206,6 +212,7 @@ class DataDistributor:
                 # not be clobbered with this attempt's stale record.
                 async def restart(tr):
                     tr.options["access_system_keys"] = True
+                    tr.options["lock_aware"] = True
                     raw = await tr.get(sk.key_servers_key(begin))
                     if raw is None:
                         return
@@ -374,6 +381,7 @@ class DataDistributor:
 
             async def merge_txn(tr, b1=b1, b2=b2):
                 tr.options["access_system_keys"] = True
+                tr.options["lock_aware"] = True
                 # Re-validate in-txn (a concurrent move/split between the
                 # sampling reads and this commit must abort the merge, not
                 # be overwritten).

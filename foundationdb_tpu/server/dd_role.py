@@ -289,6 +289,7 @@ class DataDistributionRole:
 
         async def txn(tr):
             tr.options["access_system_keys"] = True
+            tr.options["lock_aware"] = True
             return await tr.get_range(sk.SERVER_LIST_PREFIX, sk.SERVER_LIST_END)
 
         for k, v in await self.dd.db.run(txn):

@@ -54,6 +54,10 @@ class CommitTransactionRequest:
 # GRV priority flags (ref: GetReadVersionRequest::FLAG_PRIORITY_* —
 # batch-priority requests ride a tighter ratekeeper lane).
 GRV_FLAG_PRIORITY_BATCH = 1
+# Lock-awareness (ref: the LOCK_AWARE transaction option + databaseLockedKey
+# checks in commitBatch / getLiveCommittedVersion).
+GRV_FLAG_LOCK_AWARE = 2
+COMMIT_FLAG_LOCK_AWARE = 1
 
 
 @dataclass

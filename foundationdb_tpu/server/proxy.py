@@ -115,6 +115,9 @@ class Proxy:
         # current AND incoming holder (src + dest, so an AddingShard's
         # buffer sees the stream).  None = unsharded (no DD yet).
         self.key_servers = RangeMap(None)
+        # Non-None while `\xff/dbLocked` holds a UID (ref: databaseLockedKey;
+        # learned via the mutation stream or recovery-time map injection).
+        self.locked_uid = None
         self.server_list: dict = {}
         if system_map is not None:
             entries, server_list = system_map
@@ -150,7 +153,7 @@ class Proxy:
 
         self.stats = CounterCollection(f"Proxy{proxy_id}")
         for _c in ("batches", "committed", "conflicted", "too_old",
-                   "grv_requests"):
+                   "grv_requests", "rejected_locked"):
             self.stats.counter(_c)  # pre-create: snapshots list them all
         # Proxy-observed latency distributions (batch arrival -> reply),
         # surfaced as status qos percentiles (ref: the commit/GRV latency
@@ -187,10 +190,15 @@ class Proxy:
         Safe only before DD resumes writing metadata — the controller loads
         the map before publishing the cluster to clients."""
         while True:
-            (entries, server_list), reply = await self._load_map_stream.pop()
+            payload, reply = await self._load_map_stream.pop()
+            entries, server_list = payload[0], payload[1]
             for b, e, team in entries:
                 self.key_servers.set_range(b, e, (tuple(team), tuple(team)))
             self.server_list.update(server_list)
+            if len(payload) > 2:
+                # Recovery-time lock state (a lock must survive the
+                # generation change that recruited this proxy).
+                self.locked_uid = payload[2] or None
             reply.send(None)
 
     # --- key-location service (ref readRequestServer :1045) ---
@@ -263,6 +271,10 @@ class Proxy:
             )
             self._old_bounds.append((self.resolver_bounds, until))
             self.resolver_bounds = bounds_from_split_keys(split)
+        elif parsed[0] == "lock":
+            # Ref: applyMetadataMutations handling databaseLockedKey — the
+            # proxy starts/stops rejecting non-lock-aware work.
+            self.locked_uid = parsed[1] or None
         else:
             _kind, begin, src, dest, end = parsed
             # Reads route to the data holders: the sources while a move is
@@ -308,6 +320,17 @@ class Proxy:
                     r, rep = await self._grv_stream.pop()
                     pairs.append((r, rep))
             self.stats.add("grv_requests", len(pairs))
+            if self.locked_uid is not None and pairs:
+                # Ref: GRVs also fail database_locked unless lock-aware.
+                from .interfaces import GRV_FLAG_LOCK_AWARE
+
+                kept = []
+                for r, rep in pairs:
+                    if r is not None and not (r.flags & GRV_FLAG_LOCK_AWARE):
+                        rep.send_error("database_locked")
+                    else:
+                        kept.append((r, rep))
+                pairs = kept
             for r, rep in pairs:
                 grv_meta[id(rep)] = (
                     getattr(r, "debug_id", None),
@@ -519,6 +542,22 @@ class Proxy:
         trace_batch(
             "CommitDebug", "MasterProxyServer.commitBatch.Before", batch_debug
         )
+        # Database lock (ref: commitBatch rejecting non-lock-aware txns
+        # while databaseLockedKey is set).  Rejected BEFORE resolution so
+        # their conflict ranges never enter history; the possibly-empty
+        # remainder still runs the pipeline to keep the version chains
+        # advancing.
+        if self.locked_uid is not None:
+            from .interfaces import COMMIT_FLAG_LOCK_AWARE
+
+            kept = []
+            for req, reply in batch:
+                if req.flags & COMMIT_FLAG_LOCK_AWARE:
+                    kept.append((req, reply))
+                else:
+                    self.stats.add("rejected_locked")
+                    reply.send_error("database_locked")
+            batch = kept
         self.stats.add("batches")
         # Phase 1: commit version from the sequencer, serialized in local
         # batch order so this proxy's versions are monotone in batch order
@@ -650,10 +689,27 @@ class Proxy:
                     for m in muts:
                         self._intercept_metadata(m, version=sv)
         self._last_received = max(self._last_received, version)
+        # Version-ordered lock fence: the state transactions just applied
+        # include any lock committed at a version below this batch, so a
+        # non-lock-aware transaction can never commit at a version above
+        # the lock's (the upfront check at batch entry is only the cheap
+        # fast path).  Rejected txns' conflict ranges already entered the
+        # resolvers' history as committed — the safe direction: at worst a
+        # later reader conflicts spuriously; their MUTATIONS never reach a
+        # log.
+        rejected_locked: set = set()
+        if self.locked_uid is not None:
+            from .interfaces import COMMIT_FLAG_LOCK_AWARE
+
+            for t, ((req, _reply), status) in enumerate(zip(batch, statuses)):
+                if status == COMMITTED and not (
+                    req.flags & COMMIT_FLAG_LOCK_AWARE
+                ):
+                    rejected_locked.add(t)
         tagged: dict = {}
         seq = 0
         for t, ((req, _reply), status) in enumerate(zip(batch, statuses)):
-            if status != COMMITTED:
+            if status != COMMITTED or t in rejected_locked:
                 continue
             for m in req.transaction.mutations:
                 if m.type == MutationType.SET_VERSIONSTAMPED_KEY:
@@ -724,13 +780,16 @@ class Proxy:
         if version > self.committed.get():
             self.committed.set(version)
         self.latency_samples["commit"].add(loop0.now() - t_start)
-        for (req, reply), status in zip(batch, statuses):
+        for t, ((req, reply), status) in enumerate(zip(batch, statuses)):
             trace_batch(
                 "CommitDebug",
                 "MasterProxyServer.commitBatch.AfterReply",
                 req.debug_id,
             )
-            if status == COMMITTED:
+            if t in rejected_locked:
+                self.stats.add("rejected_locked")
+                reply.send_error("database_locked")
+            elif status == COMMITTED:
                 self.stats.add("committed")
                 reply.send(version)
             elif status == TOO_OLD:

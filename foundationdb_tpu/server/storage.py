@@ -801,6 +801,8 @@ class StorageServer:
             self._meta_dirty = True
         elif parsed[0] == "resolver_split":
             pass  # proxy-side concern; storages don't partition resolution
+        elif parsed[0] == "lock":
+            pass  # lock enforcement lives at the proxies
         else:
             self._meta_dirty = True
             _kind, begin, src, dest, end = parsed

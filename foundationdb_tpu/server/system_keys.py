@@ -33,6 +33,12 @@ SERVER_LIST_END = b"\xff/serverList0"
 # commit version via ResolutionSplitRequest, ResolverInterface.h:108-131).
 RESOLVER_SPLIT_KEY = b"\xff/conf/resolverSplit"
 
+# Database lock record (ref: databaseLockedKey fdbclient/SystemData.cpp —
+# lockDatabase writes a UID here; proxies reject non-lock-aware work while
+# it is non-empty).  Unlock SETS it empty rather than clearing, keeping
+# parse_metadata_mutation's no-CLEAR-interpretation policy.
+DB_LOCKED_KEY = b"\xff/dbLocked"
+
 
 def key_servers_key(key: bytes) -> bytes:
     return KEY_SERVERS_PREFIX + key
@@ -113,4 +119,6 @@ def parse_metadata_mutation(m):
         return ("shard", key_servers_begin(m.param1), src, dest, end)
     if m.param1 == RESOLVER_SPLIT_KEY:
         return ("resolver_split", decode_resolver_split(m.param2))
+    if m.param1 == DB_LOCKED_KEY:
+        return ("lock", m.param2)  # empty value = unlocked
     return None

@@ -46,6 +46,8 @@ class CliProcessor:
         "coordinator quorum (odd count; no args: show requested)",
         "profile": "profile <on|off|report> [interval] — sampling CPU "
         "profiler runtime toggle",
+        "lock": "lock — lock the database (non-lock-aware work fails)",
+        "unlock": "unlock [uid] — release the database lock",
         "setclass": "setclass <address> <class> — recruitment class "
         "(stateless|transaction|storage|unset)",
         "backup": "backup <start|status|restore> <path> [version] — "
@@ -400,6 +402,23 @@ class CliProcessor:
         except ValueError as e:
             return [f"ERROR: {e}"]
         return [f"Process class for `{addr}' set to {cls}"]
+
+    async def _cmd_lock(self, args):
+        """Ref: fdbcli `lock` -> lockDatabase."""
+        from ..client import management as mgmt
+
+        uid = await mgmt.lock_database(self.db)
+        self._lock_uid = uid
+        return [f"Database locked with uid {uid.decode()}"]
+
+    async def _cmd_unlock(self, args):
+        from ..client import management as mgmt
+
+        uid = args[0].encode() if args else getattr(self, "_lock_uid", None)
+        if uid is None:
+            return ["ERROR: unlock <uid> (no lock taken in this session)"]
+        await mgmt.unlock_database(self.db, uid)
+        return ["Database unlocked"]
 
     async def _cmd_profile(self, args):
         """Ref: fdbcli `profile` + the CpuProfiler workload's runtime

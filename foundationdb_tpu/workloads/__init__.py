@@ -14,6 +14,9 @@ from .atomic_ops import AtomicOpsWorkload
 from .serializability import SerializabilityWorkload
 from .versionstamp import VersionStampWorkload
 from .configure_db import ConfigureDatabaseWorkload
+from .lock_database import LockDatabaseWorkload
+from .storefront import StorefrontWorkload
+from .unreadable import UnreadableWorkload
 from .remove_servers import RemoveServersSafelyWorkload
 from .targeted_kill import TargetedKillWorkload
 from .chaos import AttritionWorkload, RandomCloggingWorkload
@@ -39,6 +42,9 @@ __all__ = [
     "SerializabilityWorkload",
     "VersionStampWorkload",
     "ConfigureDatabaseWorkload",
+    "LockDatabaseWorkload",
+    "StorefrontWorkload",
+    "UnreadableWorkload",
     "RemoveServersSafelyWorkload",
     "TargetedKillWorkload",
     "AttritionWorkload",

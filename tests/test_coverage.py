@@ -30,8 +30,11 @@ def test_chaos_corpus_reaches_probed_paths():
     from foundationdb_tpu.workloads.config import SimulationConfig
 
     # A few seeds of cycle-under-chaos on random topologies: enough for
-    # the failover/fence paths to fire.
-    for seed in (3001, 3002, 3003):
+    # the failover/fence paths to fire.  Margin matters: the event
+    # schedule is RNG-stream sensitive, so one seed's probe flipping off
+    # after an unrelated code change must not kill the gate (observed
+    # round 5: the latency-sampling RNG draw shifted every later seed).
+    for seed in (3001, 3002, 3003, 3012, 3013):
         cfg = SimulationConfig.random(seed)
         c = cfg.build(seed)
         run_workloads(

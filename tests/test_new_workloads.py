@@ -160,6 +160,46 @@ def test_remove_servers_safely(request):
     role.stop()
 
 
+def test_storefront_unreadable_lock_workloads():
+    """Round-5 batch two: inventory accounting, unreadable stamp ranges,
+    and a lock/unlock cycle racing Cycle traffic (Storefront.actor.cpp,
+    Unreadable.actor.cpp, LockDatabase.actor.cpp)."""
+    from foundationdb_tpu.workloads import (
+        LockDatabaseWorkload,
+        StorefrontWorkload,
+        UnreadableWorkload,
+    )
+
+    c = SimCluster(seed=570, n_proxies=2, n_storages=2)
+    wl = LockDatabaseWorkload(at=0.6, hold=0.8)
+    run_workloads(
+        c,
+        [
+            StorefrontWorkload(items=4, actors=3, purchases=8),
+            UnreadableWorkload(rounds=6),
+            CycleWorkload(nodes=5, ops=12, actors=2),
+            wl,
+        ],
+        timeout_vt=30000.0,
+    )
+    assert wl.checked_while_locked
+
+
+@pytest.mark.parametrize("seed", [575, 576])
+def test_storefront_under_chaos(seed):
+    from foundationdb_tpu.workloads import StorefrontWorkload
+
+    c = SimCluster(seed=seed, n_proxies=2, n_tlogs=2)
+    run_workloads(
+        c,
+        [
+            StorefrontWorkload(items=3, actors=2, purchases=6),
+            RandomCloggingWorkload(duration=2.0),
+        ],
+        timeout_vt=30000.0,
+    )
+
+
 @pytest.mark.parametrize("role", ["storage0", "tlog0", "proxy0"])
 def test_targeted_kill_each_role(role):
     """Killing each named role mid-load exercises a distinct recovery path;
