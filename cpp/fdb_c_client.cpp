@@ -1,0 +1,771 @@
+// Native C client for foundationdb_tpu: the C ABI from fdb_tpu_c.h over
+// the versioned tagged wire protocol.
+//
+// Ref: bindings/c/fdb_c.cpp (the ABI shape) + fdbrpc/FlowTransport.actor.cpp
+// (framing: 4-byte big-endian length + versioned frame; hello =
+// "<PROTOCOL_VERSION> <address>"; requests are _Envelope(request, reply_to)
+// sent to (token, payload); replies are (is_err, value) tuples delivered to
+// the reply endpoint's token over the SAME connection).  Struct ids and
+// field positions come from wire_schema.h, generated from the live Python
+// registry so both implementations stay in lockstep.
+//
+// Build:  python tools/gen_wire_schema.py > cpp/wire_schema.h
+//         g++ -std=c++17 -O2 -fPIC -shared cpp/fdb_c_client.cpp -o libfdb_tpu_c.so
+
+#include <arpa/inet.h>
+#include <netdb.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <memory>
+#include <stdexcept>
+#include <string>
+#include <vector>
+
+#include "fdb_tpu_c.h"
+#include "wire_schema.h"
+
+namespace {
+
+// ---------------------------------------------------------------------------
+// Wire value model (mirrors rpc/wire.py's vocabulary)
+// ---------------------------------------------------------------------------
+
+enum Tag : uint8_t {
+  T_NONE = 0, T_TRUE = 1, T_FALSE = 2, T_INT = 3, T_FLOAT = 4,
+  T_BYTES = 5, T_STR = 6, T_LIST = 7, T_TUPLE = 8, T_DICT = 9,
+  T_STRUCT = 10, T_ENUM = 11,
+};
+
+struct Value;
+using ValuePtr = std::shared_ptr<Value>;
+
+struct Value {
+  Tag tag = T_NONE;
+  int64_t i = 0;            // T_INT / T_ENUM value
+  double f = 0;             // T_FLOAT
+  std::string bytes;        // T_BYTES / T_STR payload
+  std::vector<ValuePtr> items;            // list/tuple/struct fields
+  std::vector<std::pair<ValuePtr, ValuePtr>> pairs;  // dict
+  uint16_t class_id = 0;    // T_STRUCT / T_ENUM
+
+  static ValuePtr none() { auto v = std::make_shared<Value>(); return v; }
+  static ValuePtr boolean(bool b) {
+    auto v = std::make_shared<Value>(); v->tag = b ? T_TRUE : T_FALSE; return v;
+  }
+  static ValuePtr integer(int64_t n) {
+    auto v = std::make_shared<Value>(); v->tag = T_INT; v->i = n; return v;
+  }
+  static ValuePtr blob(const std::string& b) {
+    auto v = std::make_shared<Value>(); v->tag = T_BYTES; v->bytes = b; return v;
+  }
+  static ValuePtr str(const std::string& s) {
+    auto v = std::make_shared<Value>(); v->tag = T_STR; v->bytes = s; return v;
+  }
+  static ValuePtr list() { auto v = std::make_shared<Value>(); v->tag = T_LIST; return v; }
+  static ValuePtr tup() { auto v = std::make_shared<Value>(); v->tag = T_TUPLE; return v; }
+  static ValuePtr strct(uint16_t cid) {
+    auto v = std::make_shared<Value>(); v->tag = T_STRUCT; v->class_id = cid; return v;
+  }
+  static ValuePtr enm(uint16_t cid, int64_t n) {
+    auto v = std::make_shared<Value>(); v->tag = T_ENUM; v->class_id = cid; v->i = n; return v;
+  }
+};
+
+struct WireError : std::runtime_error {
+  using std::runtime_error::runtime_error;
+};
+
+// ---------------------------------------------------------------------------
+// Codec
+// ---------------------------------------------------------------------------
+
+void put_varint(std::string& out, uint64_t n) {
+  while (true) {
+    uint8_t b = n & 0x7F;
+    n >>= 7;
+    if (n) out.push_back(char(b | 0x80));
+    else { out.push_back(char(b)); return; }
+  }
+}
+
+uint64_t zigzag(int64_t n) {
+  return n >= 0 ? (uint64_t(n) << 1) : ((uint64_t(-n) << 1) - 1);
+}
+
+int64_t unzigzag(uint64_t n) {
+  return (n & 1) ? -int64_t((n + 1) >> 1) : int64_t(n >> 1);
+}
+
+void put_u16(std::string& out, uint16_t v) {
+  out.push_back(char(v >> 8));
+  out.push_back(char(v & 0xFF));
+}
+
+void encode(std::string& out, const ValuePtr& v, int depth = 0) {
+  if (depth > 64) throw WireError("nesting too deep");
+  switch (v->tag) {
+    case T_NONE: case T_TRUE: case T_FALSE:
+      out.push_back(char(v->tag));
+      break;
+    case T_INT:
+      out.push_back(char(T_INT));
+      put_varint(out, zigzag(v->i));
+      break;
+    case T_FLOAT: {
+      out.push_back(char(T_FLOAT));
+      uint64_t bits;
+      std::memcpy(&bits, &v->f, 8);
+      for (int s = 56; s >= 0; s -= 8) out.push_back(char((bits >> s) & 0xFF));
+      break;
+    }
+    case T_BYTES: case T_STR:
+      out.push_back(char(v->tag));
+      put_varint(out, v->bytes.size());
+      out += v->bytes;
+      break;
+    case T_LIST: case T_TUPLE:
+      out.push_back(char(v->tag));
+      put_varint(out, v->items.size());
+      for (auto& it : v->items) encode(out, it, depth + 1);
+      break;
+    case T_DICT:
+      out.push_back(char(T_DICT));
+      put_varint(out, v->pairs.size());
+      for (auto& kv : v->pairs) {
+        encode(out, kv.first, depth + 1);
+        encode(out, kv.second, depth + 1);
+      }
+      break;
+    case T_STRUCT:
+      out.push_back(char(T_STRUCT));
+      put_u16(out, v->class_id);
+      put_varint(out, v->items.size());
+      for (auto& it : v->items) encode(out, it, depth + 1);
+      break;
+    case T_ENUM:
+      out.push_back(char(T_ENUM));
+      put_u16(out, v->class_id);
+      put_varint(out, zigzag(v->i));
+      break;
+  }
+}
+
+struct Reader {
+  const uint8_t* p;
+  const uint8_t* end;
+  uint8_t byte() {
+    if (p >= end) throw WireError("truncated frame");
+    return *p++;
+  }
+  const uint8_t* take(size_t n) {
+    if (size_t(end - p) < n) throw WireError("truncated frame");
+    const uint8_t* q = p;
+    p += n;
+    return q;
+  }
+  uint64_t varint() {
+    uint64_t out = 0;
+    int shift = 0;
+    for (int i = 0; i < 16; i++) {
+      uint8_t b = byte();
+      out |= uint64_t(b & 0x7F) << shift;
+      if (!(b & 0x80)) return out;
+      shift += 7;
+    }
+    throw WireError("varint too long");
+  }
+  uint16_t u16() {
+    const uint8_t* q = take(2);
+    return uint16_t(q[0]) << 8 | q[1];
+  }
+};
+
+ValuePtr decode(Reader& r, int depth = 0) {
+  if (depth > 64) throw WireError("nesting too deep");
+  uint8_t tag = r.byte();
+  switch (tag) {
+    case T_NONE: return Value::none();
+    case T_TRUE: return Value::boolean(true);
+    case T_FALSE: return Value::boolean(false);
+    case T_INT: return Value::integer(unzigzag(r.varint()));
+    case T_FLOAT: {
+      const uint8_t* q = r.take(8);
+      uint64_t bits = 0;
+      for (int i = 0; i < 8; i++) bits = (bits << 8) | q[i];
+      auto v = std::make_shared<Value>();
+      v->tag = T_FLOAT;
+      std::memcpy(&v->f, &bits, 8);
+      return v;
+    }
+    case T_BYTES: case T_STR: {
+      uint64_t n = r.varint();
+      const uint8_t* q = r.take(n);
+      auto v = std::make_shared<Value>();
+      v->tag = Tag(tag);
+      v->bytes.assign(reinterpret_cast<const char*>(q), n);
+      return v;
+    }
+    case T_LIST: case T_TUPLE: {
+      uint64_t n = r.varint();
+      auto v = std::make_shared<Value>();
+      v->tag = Tag(tag);
+      for (uint64_t i = 0; i < n; i++) v->items.push_back(decode(r, depth + 1));
+      return v;
+    }
+    case T_DICT: {
+      uint64_t n = r.varint();
+      auto v = std::make_shared<Value>();
+      v->tag = T_DICT;
+      for (uint64_t i = 0; i < n; i++) {
+        auto k = decode(r, depth + 1);
+        auto val = decode(r, depth + 1);
+        v->pairs.emplace_back(k, val);
+      }
+      return v;
+    }
+    case T_STRUCT: {
+      uint16_t cid = r.u16();
+      uint64_t n = r.varint();
+      auto v = Value::strct(cid);
+      for (uint64_t i = 0; i < n; i++) v->items.push_back(decode(r, depth + 1));
+      return v;
+    }
+    case T_ENUM: {
+      uint16_t cid = r.u16();
+      return Value::enm(cid, unzigzag(r.varint()));
+    }
+    default:
+      throw WireError("unknown tag");
+  }
+}
+
+std::string encode_frame(const ValuePtr& v) {
+  std::string out;
+  out.push_back(char(FDBTPU_WIRE_VERSION));
+  encode(out, v);
+  return out;
+}
+
+ValuePtr decode_frame(const uint8_t* buf, size_t len) {
+  Reader r{buf, buf + len};
+  if (r.byte() != FDBTPU_WIRE_VERSION) throw WireError("wire version");
+  auto v = decode(r);
+  if (r.p != r.end) throw WireError("trailing bytes");
+  return v;
+}
+
+// ---------------------------------------------------------------------------
+// Error table (subset of flow/error.py; unknowns map to internal_error)
+// ---------------------------------------------------------------------------
+
+const std::map<std::string, int>& error_table() {
+  // Generated from flow/error.py (wire_schema.h) — never hand-copied.
+  static const std::map<std::string, int> t = {
+#define X(name, code) {name, code},
+      FDBTPU_ERROR_TABLE(X)
+#undef X
+  };
+  return t;
+}
+
+int error_code_for(const std::string& name) {
+  auto it = error_table().find(name);
+  return it != error_table().end() ? it->second : 4100;
+}
+
+// ---------------------------------------------------------------------------
+// Transport: one blocking connection; hello; request/reply matching
+// ---------------------------------------------------------------------------
+
+struct Connection {
+  int fd = -1;
+  std::string my_address;
+  uint64_t next_token = 1;
+  std::string inbuf;
+
+  explicit Connection(const std::string& hostport) {
+    auto colon = hostport.rfind(':');
+    if (colon == std::string::npos) throw WireError("address needs host:port");
+    std::string host = hostport.substr(0, colon);
+    std::string port = hostport.substr(colon + 1);
+    addrinfo hints{};
+    hints.ai_family = AF_INET;
+    hints.ai_socktype = SOCK_STREAM;
+    addrinfo* res = nullptr;
+    if (getaddrinfo(host.c_str(), port.c_str(), &hints, &res) != 0 || !res)
+      throw WireError("resolve failed");
+    fd = socket(res->ai_family, res->ai_socktype, 0);
+    if (fd < 0 || connect(fd, res->ai_addr, res->ai_addrlen) != 0) {
+      freeaddrinfo(res);
+      if (fd >= 0) close(fd);
+      fd = -1;
+      throw WireError("connect failed");
+    }
+    freeaddrinfo(res);
+    my_address = "cclient-" + std::to_string(uint64_t(getpid())) + "-" +
+                 std::to_string(uintptr_t(this) & 0xFFFF) + ":0";
+    std::string hello = std::string(FDBTPU_PROTOCOL_VERSION) + " " + my_address;
+    send_raw(hello);
+  }
+
+  ~Connection() {
+    if (fd >= 0) close(fd);
+  }
+
+  void send_raw(const std::string& frame) {
+    std::string msg;
+    uint32_t n = frame.size();
+    msg.push_back(char((n >> 24) & 0xFF));
+    msg.push_back(char((n >> 16) & 0xFF));
+    msg.push_back(char((n >> 8) & 0xFF));
+    msg.push_back(char(n & 0xFF));
+    msg += frame;
+    size_t off = 0;
+    while (off < msg.size()) {
+      ssize_t w = ::send(fd, msg.data() + off, msg.size() - off, 0);
+      if (w <= 0) throw WireError("send failed");
+      off += size_t(w);
+    }
+  }
+
+  // Read one complete frame body.
+  std::string read_frame() {
+    while (true) {
+      if (inbuf.size() >= 4) {
+        uint32_t n = (uint8_t(inbuf[0]) << 24) | (uint8_t(inbuf[1]) << 16) |
+                     (uint8_t(inbuf[2]) << 8) | uint8_t(inbuf[3]);
+        if (n > (64u << 20)) throw WireError("frame too large");
+        if (inbuf.size() >= 4 + size_t(n)) {
+          std::string frame = inbuf.substr(4, n);
+          inbuf.erase(0, 4 + size_t(n));
+          return frame;
+        }
+      }
+      char buf[65536];
+      ssize_t r = recv(fd, buf, sizeof buf, 0);
+      if (r <= 0) throw WireError("connection closed");
+      inbuf.append(buf, size_t(r));
+    }
+  }
+
+  // Send _Envelope(request, reply_to=(my_address, token)) to a stream
+  // endpoint and block for the (is_err, value) reply on that token.
+  ValuePtr call(const std::string& dst_addr, int64_t dst_token,
+                const ValuePtr& request, std::string* err_name) {
+    (void)dst_addr;  // single-connection client: everything rides this conn
+    uint64_t reply_token = next_token++;
+    auto reply_ep = Value::strct(SID_ENDPOINT);
+    reply_ep->items = {Value::str(my_address), Value::integer(int64_t(reply_token))};
+    auto env = Value::strct(SID_ENVELOPE);
+    env->items = {request, reply_ep};
+    auto msg = Value::tup();
+    msg->items = {Value::integer(dst_token), env};
+    send_raw(encode_frame(msg));
+    while (true) {
+      std::string frame = read_frame();
+      auto v = decode_frame(reinterpret_cast<const uint8_t*>(frame.data()),
+                            frame.size());
+      if (v->tag != T_TUPLE || v->items.size() != 2) throw WireError("bad frame");
+      if (v->items[0]->tag != T_INT) throw WireError("bad token");
+      if (uint64_t(v->items[0]->i) != reply_token) continue;  // stale reply
+      auto reply = v->items[1];
+      if (reply->tag != T_TUPLE || reply->items.size() != 2)
+        throw WireError("bad reply");
+      bool is_err = reply->items[0]->tag == T_TRUE;
+      if (is_err) {
+        *err_name = reply->items[1]->bytes;  // error name string
+        return nullptr;
+      }
+      err_name->clear();
+      return reply->items[1];
+    }
+  }
+};
+
+// crc32 for well-known tokens: token = (1<<40) | crc32(name).
+uint32_t crc32_of(const std::string& s) {
+  static uint32_t table[256];
+  static bool init = false;
+  if (!init) {
+    for (uint32_t i = 0; i < 256; i++) {
+      uint32_t c = i;
+      for (int k = 0; k < 8; k++) c = (c & 1) ? 0xEDB88320u ^ (c >> 1) : c >> 1;
+      table[i] = c;
+    }
+    init = true;
+  }
+  uint32_t c = 0xFFFFFFFFu;
+  for (unsigned char ch : s) c = table[(c ^ ch) & 0xFF] ^ (c >> 8);
+  return c ^ 0xFFFFFFFFu;
+}
+
+int64_t well_known_token(const std::string& name) {
+  return (int64_t(1) << 40) | crc32_of(name);
+}
+
+// Positional field access with the wire protocol's short-struct
+// tolerance: a peer may legally send FEWER fields than we know (old peer,
+// new local field — wire.py fills the tail from defaults); out-of-range
+// reads here return None instead of indexing past the vector.
+ValuePtr fget(const ValuePtr& v, size_t i) {
+  if (!v || v->tag != T_STRUCT || i >= v->items.size()) return Value::none();
+  return v->items[i];
+}
+
+// Extract (address, token) from a RequestStreamRef struct value.
+struct StreamRef {
+  std::string address;
+  int64_t token = 0;
+  bool ok = false;
+};
+
+StreamRef ref_of(const ValuePtr& v) {
+  StreamRef out;
+  if (!v || v->tag != T_STRUCT || v->class_id != SID_REQUESTSTREAMREF) return out;
+  auto ep = fget(v, F_REQUESTSTREAMREF_ENDPOINT);
+  if (!ep || ep->tag != T_STRUCT || ep->class_id != SID_ENDPOINT) return out;
+  auto addr = fget(ep, F_ENDPOINT_ADDRESS);
+  auto tok = fget(ep, F_ENDPOINT_TOKEN);
+  if (addr->tag != T_STR || tok->tag != T_INT) return out;
+  out.address = addr->bytes;
+  out.token = tok->i;
+  out.ok = true;
+  return out;
+}
+
+}  // namespace
+
+// ---------------------------------------------------------------------------
+// ABI objects
+// ---------------------------------------------------------------------------
+
+struct FDBDatabase {
+  std::unique_ptr<Connection> conn;
+  StreamRef grv, commit, get_value, get_key_values;
+};
+
+struct Range {
+  std::string begin, end;
+};
+
+struct FDBTransaction {
+  FDBDatabase* db = nullptr;
+  bool has_read_version = false;
+  int64_t read_version = 0;
+  std::vector<ValuePtr> mutations;  // Mutation structs
+  std::vector<Range> read_ranges, write_ranges;
+  std::map<std::string, std::pair<bool, std::string>> overlay;  // RYW: key -> (present, value)
+};
+
+struct FDBFuture {
+  int err = 0;
+  std::string err_name;
+  bool has_value = false;
+  bool present = false;
+  std::string value;
+  bool has_version = false;
+  int64_t version = 0;
+  // range results
+  bool has_kvs = false;
+  std::vector<std::pair<std::string, std::string>> kvs;
+  bool more = false;
+  std::vector<FDBKeyValue> kv_view;  // pointers into kvs
+};
+
+static std::string key_after(const std::string& k) { return k + '\0'; }
+
+static FDBFuture* make_err(const std::string& name) {
+  auto* f = new FDBFuture();
+  f->err_name = name;
+  f->err = error_code_for(name);
+  return f;
+}
+
+extern "C" {
+
+const char* fdb_get_error(fdb_error_t code) {
+  for (auto& kv : error_table())
+    if (kv.second == code) {
+      return kv.first.c_str();
+    }
+  return code == 0 ? "success" : "unknown_error_code";
+}
+
+fdb_error_t fdb_select_api_version(int) { return 0; }
+
+fdb_error_t fdb_create_database(const char* cluster_address, FDBDatabase** out_db) {
+  try {
+    auto db = std::make_unique<FDBDatabase>();
+    db->conn = std::make_unique<Connection>(cluster_address);
+    // Bootstrap: discover the proxy + storage interfaces (real_node.py's
+    // well-known bootstrap stream).
+    std::string err;
+    auto ifaces = db->conn->call(cluster_address, well_known_token("bootstrap"),
+                                 Value::none(), &err);
+    if (!ifaces || ifaces->tag != T_DICT) return FDB_E_NETWORK_FAILED;
+    for (auto& kv : ifaces->pairs) {
+      const std::string& name = kv.first->bytes;
+      if (name == "proxy" && kv.second->tag == T_STRUCT) {
+        db->grv = ref_of(fget(kv.second, F_PROXYINTERFACE_GET_CONSISTENT_READ_VERSION));
+        db->commit = ref_of(fget(kv.second, F_PROXYINTERFACE_COMMIT));
+      } else if (name == "storage" && kv.second->tag == T_STRUCT) {
+        db->get_value = ref_of(fget(kv.second, F_STORAGEINTERFACE_GET_VALUE));
+        db->get_key_values = ref_of(fget(kv.second, F_STORAGEINTERFACE_GET_KEY_VALUES));
+      }
+    }
+    if (!db->grv.ok || !db->commit.ok || !db->get_value.ok)
+      return FDB_E_NETWORK_FAILED;
+    *out_db = db.release();
+    return 0;
+  } catch (const std::exception&) {
+    return FDB_E_NETWORK_FAILED;
+  }
+}
+
+void fdb_database_destroy(FDBDatabase* db) { delete db; }
+
+fdb_error_t fdb_database_create_transaction(FDBDatabase* db, FDBTransaction** out_tr) {
+  auto* tr = new FDBTransaction();
+  tr->db = db;
+  *out_tr = tr;
+  return 0;
+}
+
+void fdb_transaction_destroy(FDBTransaction* tr) { delete tr; }
+
+void fdb_transaction_reset(FDBTransaction* tr) {
+  tr->has_read_version = false;
+  tr->mutations.clear();
+  tr->read_ranges.clear();
+  tr->write_ranges.clear();
+  tr->overlay.clear();
+}
+
+static ValuePtr make_mutation(int type, const std::string& p1, const std::string& p2) {
+  auto m = Value::strct(SID_MUTATION);
+  m->items = {Value::enm(EID_MUTATIONTYPE, type), Value::blob(p1), Value::blob(p2)};
+  return m;
+}
+
+void fdb_transaction_set(FDBTransaction* tr, const uint8_t* key, int key_len,
+                         const uint8_t* value, int value_len) {
+  std::string k(reinterpret_cast<const char*>(key), size_t(key_len));
+  std::string v(reinterpret_cast<const char*>(value), size_t(value_len));
+  tr->mutations.push_back(make_mutation(MT_SET_VALUE, k, v));
+  tr->write_ranges.push_back({k, key_after(k)});
+  tr->overlay[k] = {true, v};
+}
+
+void fdb_transaction_clear(FDBTransaction* tr, const uint8_t* key, int key_len) {
+  std::string k(reinterpret_cast<const char*>(key), size_t(key_len));
+  tr->mutations.push_back(make_mutation(MT_CLEAR_RANGE, k, key_after(k)));
+  tr->write_ranges.push_back({k, key_after(k)});
+  tr->overlay[k] = {false, ""};
+}
+
+void fdb_transaction_clear_range(FDBTransaction* tr, const uint8_t* begin,
+                                 int begin_len, const uint8_t* end, int end_len) {
+  std::string b(reinterpret_cast<const char*>(begin), size_t(begin_len));
+  std::string e(reinterpret_cast<const char*>(end), size_t(end_len));
+  tr->mutations.push_back(make_mutation(MT_CLEAR_RANGE, b, e));
+  tr->write_ranges.push_back({b, e});
+  // RYW overlay for range clears is coarse: later gets inside [b,e) miss.
+  for (auto it = tr->overlay.lower_bound(b);
+       it != tr->overlay.end() && it->first < e;)
+    it = tr->overlay.erase(it);
+}
+
+static int ensure_read_version(FDBTransaction* tr, std::string* err_name) {
+  if (tr->has_read_version) return 0;
+  auto req = Value::strct(SID_GETREADVERSIONREQUEST);
+  req->items = {Value::integer(1), Value::integer(0), Value::none()};
+  auto v = tr->db->conn->call(tr->db->grv.address, tr->db->grv.token, req, err_name);
+  if (!v) return error_code_for(*err_name);
+  tr->read_version = v->i;
+  tr->has_read_version = true;
+  return 0;
+}
+
+FDBFuture* fdb_transaction_get_read_version(FDBTransaction* tr) {
+  std::string err;
+  try {
+    int rc = ensure_read_version(tr, &err);
+    if (rc) return make_err(err);
+  } catch (const std::exception&) {
+    return make_err("broken_promise");
+  }
+  auto* f = new FDBFuture();
+  f->has_version = true;
+  f->version = tr->read_version;
+  return f;
+}
+
+FDBFuture* fdb_transaction_get(FDBTransaction* tr, const uint8_t* key, int key_len) {
+  std::string k(reinterpret_cast<const char*>(key), size_t(key_len));
+  // Read-your-writes: pending mutations win over the store.
+  auto ov = tr->overlay.find(k);
+  if (ov != tr->overlay.end()) {
+    auto* f = new FDBFuture();
+    f->has_value = true;
+    f->present = ov->second.first;
+    f->value = ov->second.second;
+    return f;
+  }
+  std::string err;
+  try {
+    int rc = ensure_read_version(tr, &err);
+    if (rc) return make_err(err);
+    auto req = Value::strct(SID_GETVALUEREQUEST);
+    req->items = {Value::blob(k), Value::integer(tr->read_version)};
+    auto v = tr->db->conn->call(tr->db->get_value.address,
+                                tr->db->get_value.token, req, &err);
+    if (!v) return make_err(err);
+    // GetValueReply(value, version)
+    auto* f = new FDBFuture();
+    f->has_value = true;
+    auto val = fget(v, F_GETVALUEREPLY_VALUE);
+    f->present = val->tag == T_BYTES;
+    if (f->present) f->value = val->bytes;
+    tr->read_ranges.push_back({k, key_after(k)});
+    return f;
+  } catch (const std::exception&) {
+    return make_err("broken_promise");
+  }
+}
+
+FDBFuture* fdb_transaction_get_range(FDBTransaction* tr, const uint8_t* begin,
+                                     int begin_len, const uint8_t* end,
+                                     int end_len, int limit) {
+  std::string b(reinterpret_cast<const char*>(begin), size_t(begin_len));
+  std::string e(reinterpret_cast<const char*>(end), size_t(end_len));
+  std::string err;
+  try {
+    int rc = ensure_read_version(tr, &err);
+    if (rc) return make_err(err);
+    auto req = Value::strct(SID_GETKEYVALUESREQUEST);
+    req->items = {Value::blob(b), Value::blob(e),
+                  Value::integer(tr->read_version),
+                  Value::integer(limit > 0 ? limit : (1 << 30)),
+                  Value::boolean(false)};
+    auto v = tr->db->conn->call(tr->db->get_key_values.address,
+                                tr->db->get_key_values.token, req, &err);
+    if (!v) return make_err(err);
+    auto* f = new FDBFuture();
+    f->has_kvs = true;
+    // Merge the RYW overlay over the server rows (pending sets win,
+    // pending point-clears mask) so get() and get_range() agree inside
+    // one transaction.  Range-clear coarseness is documented in
+    // fdb_tpu_c.h.
+    std::map<std::string, std::string> merged;
+    auto data = fget(v, F_GETKEYVALUESREPLY_DATA);
+    for (auto& row : data->items) {
+      if (row->items.size() >= 2)
+        merged[row->items[0]->bytes] = row->items[1]->bytes;
+    }
+    for (auto it = tr->overlay.lower_bound(b);
+         it != tr->overlay.end() && it->first < e; ++it) {
+      if (it->second.first) merged[it->first] = it->second.second;
+      else merged.erase(it->first);
+    }
+    for (auto& kv : merged) {
+      f->kvs.emplace_back(kv.first, kv.second);
+      if (limit > 0 && int(f->kvs.size()) >= limit) break;
+    }
+    f->more = fget(v, F_GETKEYVALUESREPLY_MORE)->tag == T_TRUE;
+    tr->read_ranges.push_back({b, e});
+    return f;
+  } catch (const std::exception&) {
+    return make_err("broken_promise");
+  }
+}
+
+FDBFuture* fdb_transaction_commit(FDBTransaction* tr) {
+  std::string err;
+  try {
+    if (tr->mutations.empty() && tr->write_ranges.empty()) {
+      auto* f = new FDBFuture();  // read-only: nothing to do
+      f->has_version = true;
+      f->version = tr->read_version;
+      return f;
+    }
+    // Reads need a snapshot to resolve against (a blind write commits
+    // with read_snapshot 0 and no read set, like causal_write_risky).
+    if (!tr->read_ranges.empty()) {
+      int rc = ensure_read_version(tr, &err);
+      if (rc) return make_err(err);
+    }
+    auto ctref = Value::strct(SID_COMMITTRANSACTIONREF);
+    auto rrs = Value::list();
+    for (auto& r : tr->read_ranges) {
+      auto t = Value::tup();
+      t->items = {Value::blob(r.begin), Value::blob(r.end)};
+      rrs->items.push_back(t);
+    }
+    auto wrs = Value::list();
+    for (auto& r : tr->write_ranges) {
+      auto t = Value::tup();
+      t->items = {Value::blob(r.begin), Value::blob(r.end)};
+      wrs->items.push_back(t);
+    }
+    auto muts = Value::list();
+    muts->items = tr->mutations;
+    ctref->items = {
+        Value::integer(tr->read_ranges.empty() ? 0 : tr->read_version),
+        rrs, wrs, muts};
+    auto req = Value::strct(SID_COMMITTRANSACTIONREQUEST);
+    req->items = {ctref, Value::integer(0), Value::none()};
+    auto v = tr->db->conn->call(tr->db->commit.address, tr->db->commit.token,
+                                req, &err);
+    if (!v) return make_err(err);
+    auto* f = new FDBFuture();
+    f->has_version = true;
+    f->version = v->i;
+    return f;
+  } catch (const std::exception&) {
+    return make_err("commit_unknown_result");
+  }
+}
+
+fdb_error_t fdb_future_block_until_ready(FDBFuture*) { return 0; }
+
+fdb_error_t fdb_future_get_error(FDBFuture* f) { return f->err; }
+
+fdb_error_t fdb_future_get_value(FDBFuture* f, fdb_bool_t* out_present,
+                                 const uint8_t** out_value, int* out_value_len) {
+  if (f->err) return f->err;
+  if (!f->has_value) return 4100;
+  *out_present = f->present ? 1 : 0;
+  *out_value = reinterpret_cast<const uint8_t*>(f->value.data());
+  *out_value_len = int(f->value.size());
+  return 0;
+}
+
+fdb_error_t fdb_future_get_version(FDBFuture* f, int64_t* out_version) {
+  if (f->err) return f->err;
+  if (!f->has_version) return 4100;
+  *out_version = f->version;
+  return 0;
+}
+
+fdb_error_t fdb_future_get_keyvalue_array(FDBFuture* f, const FDBKeyValue** out_kv,
+                                          int* out_count, fdb_bool_t* out_more) {
+  if (f->err) return f->err;
+  if (!f->has_kvs) return 4100;
+  f->kv_view.clear();
+  for (auto& kv : f->kvs)
+    f->kv_view.push_back(FDBKeyValue{
+        reinterpret_cast<const uint8_t*>(kv.first.data()), int(kv.first.size()),
+        reinterpret_cast<const uint8_t*>(kv.second.data()), int(kv.second.size())});
+  *out_kv = f->kv_view.data();
+  *out_count = int(f->kv_view.size());
+  *out_more = f->more ? 1 : 0;
+  return 0;
+}
+
+void fdb_future_destroy(FDBFuture* f) { delete f; }
+
+}  // extern "C"

@@ -1,0 +1,98 @@
+/* C ABI for the foundationdb_tpu framework.
+ *
+ * Ref: bindings/c/foundationdb/fdb_c.h:190 — the same surface shape
+ * (database / transaction / future handles, byte-string keys/values,
+ * integer error codes) so a caller of the reference's C API finds the
+ * familiar contract.  This client is NATIVE: it speaks the versioned
+ * tagged wire protocol (rpc/wire.py, generated schema in wire_schema.h)
+ * over TCP to a real-mode cluster — no embedded interpreter.
+ *
+ * Simplifications vs the reference ABI (documented, not hidden):
+ *   - Futures resolve synchronously (the call blocks); fdb_future_block_
+ *     until_ready is therefore a no-op kept for source compatibility.
+ *   - One outstanding request per transaction (single connection,
+ *     blocking reads).
+ *   - Read-your-writes covers point sets/clears (get and get_range both
+ *     see them); a clear_range's masking of SERVER rows inside the same
+ *     transaction is not modeled — commit ordering is still exact.
+ */
+#ifndef FDB_TPU_C_H
+#define FDB_TPU_C_H
+
+#include <stdint.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+typedef int fdb_error_t;
+typedef int fdb_bool_t;
+
+typedef struct FDBDatabase FDBDatabase;
+typedef struct FDBTransaction FDBTransaction;
+typedef struct FDBFuture FDBFuture;
+
+/* Error codes mirror flow/error.py (the reference's error_definitions). */
+#define FDB_E_SUCCESS 0
+#define FDB_E_NOT_COMMITTED 1020
+#define FDB_E_COMMIT_UNKNOWN_RESULT 1021
+#define FDB_E_TRANSACTION_TOO_OLD 1007
+#define FDB_E_BROKEN_PROMISE 1100
+#define FDB_E_DATABASE_LOCKED 1038
+#define FDB_E_NETWORK_FAILED 1026
+
+const char* fdb_get_error(fdb_error_t code);
+fdb_error_t fdb_select_api_version(int version);
+
+/* cluster_address: "host:port" of a real-mode node serving the
+ * well-known bootstrap stream (tools/real_node.py). */
+fdb_error_t fdb_create_database(const char* cluster_address,
+                                FDBDatabase** out_db);
+void fdb_database_destroy(FDBDatabase* db);
+
+fdb_error_t fdb_database_create_transaction(FDBDatabase* db,
+                                            FDBTransaction** out_tr);
+void fdb_transaction_destroy(FDBTransaction* tr);
+void fdb_transaction_reset(FDBTransaction* tr);
+
+void fdb_transaction_set(FDBTransaction* tr,
+                         const uint8_t* key, int key_len,
+                         const uint8_t* value, int value_len);
+void fdb_transaction_clear(FDBTransaction* tr,
+                           const uint8_t* key, int key_len);
+void fdb_transaction_clear_range(FDBTransaction* tr,
+                                 const uint8_t* begin, int begin_len,
+                                 const uint8_t* end, int end_len);
+
+FDBFuture* fdb_transaction_get(FDBTransaction* tr,
+                               const uint8_t* key, int key_len);
+FDBFuture* fdb_transaction_get_range(FDBTransaction* tr,
+                                     const uint8_t* begin, int begin_len,
+                                     const uint8_t* end, int end_len,
+                                     int limit);
+FDBFuture* fdb_transaction_get_read_version(FDBTransaction* tr);
+FDBFuture* fdb_transaction_commit(FDBTransaction* tr);
+
+/* Futures (synchronously resolved; see header comment). */
+fdb_error_t fdb_future_block_until_ready(FDBFuture* f);
+fdb_error_t fdb_future_get_error(FDBFuture* f);
+fdb_error_t fdb_future_get_value(FDBFuture* f, fdb_bool_t* out_present,
+                                 const uint8_t** out_value,
+                                 int* out_value_len);
+fdb_error_t fdb_future_get_version(FDBFuture* f, int64_t* out_version);
+typedef struct {
+    const uint8_t* key;
+    int key_len;
+    const uint8_t* value;
+    int value_len;
+} FDBKeyValue;
+fdb_error_t fdb_future_get_keyvalue_array(FDBFuture* f,
+                                          const FDBKeyValue** out_kv,
+                                          int* out_count,
+                                          fdb_bool_t* out_more);
+void fdb_future_destroy(FDBFuture* f);
+
+#ifdef __cplusplus
+}
+#endif
+#endif /* FDB_TPU_C_H */

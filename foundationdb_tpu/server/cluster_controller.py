@@ -469,6 +469,7 @@ class ClusterController:
 
         server_list: dict = {}
         owned_by: dict = {}  # sid -> [(b, e_or_None)]
+        live_if_by_sid: dict = {}  # the RECRUITED interfaces, by reported sid
         for storage_if in storage_ifs:
             meta = await timeout_after(
                 loop,
@@ -484,6 +485,7 @@ class ClusterController:
             server_list.update(sl)
             server_list.setdefault(sid, storage_if)
             owned_by[sid] = owned_ranges
+            live_if_by_sid[sid] = storage_if
         # Teams on ATOMIC segments: each storage coalesces its own ranges,
         # so teammates' boundaries need not line up — cut at every boundary
         # and compute membership per segment.
@@ -520,14 +522,13 @@ class ClusterController:
         from .system_keys import DB_LOCKED_KEY
 
         locked_uid = None
-        lock_owner = next(
-            (
-                storage_ifs[i]
-                for i, (sid, rs) in enumerate(owned_by.items())
-                if covers(rs, DB_LOCKED_KEY)
-            ),
+        lock_sid = next(
+            (sid for sid, rs in owned_by.items() if covers(rs, DB_LOCKED_KEY)),
             None,
         )
+        # sid -> LIVE recruited interface, recorded in the meta loop: no
+        # positional alignment between owned_by and storage_ifs is assumed.
+        lock_owner = live_if_by_sid.get(lock_sid) if lock_sid else None
         if lock_owner is not None:
             rep = await timeout_after(
                 loop,

