@@ -1,0 +1,138 @@
+"""MultiVersion client: pick the protocol generation the cluster speaks.
+
+Ref: fdbclient/MultiVersionTransaction.h:402 / MultiVersionApi — the
+reference app links ONE fdb_c version but loads every installed client
+library; whichever library's protocol matches the cluster serves the
+traffic, and a cluster upgrade switches libraries under the app without a
+restart.  The rebuild's analog: a registry of client *implementations*,
+each owning a codec generation (its wire PROTOCOL_VERSION and connect
+recipe); `MultiVersionClient.connect` probes the cluster with each in
+preference order — the transport rejects mismatched hellos AT CONNECT, so
+an incompatible generation fails fast and the next is tried (ref: the
+protocol-version gate in FlowTransport.actor.cpp:189-210).
+
+A generation here is (protocol_version, bootstrap) where bootstrap builds
+a Database over a RealNetwork speaking that version.  With one shipping
+protocol the registry holds one real generation; the tests register a
+fake future generation to prove the selection and rejection mechanics —
+exactly what the reference's MultiVersionApi tests do with dummy client
+libs.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ..flow.error import FdbError
+
+
+class ClientGeneration:
+    """One loadable 'client library': a protocol version + its connect()."""
+
+    def __init__(self, protocol_version: bytes,
+                 bootstrap: Callable, description: str = ""):
+        self.protocol_version = protocol_version
+        self.bootstrap = bootstrap
+        self.description = description or protocol_version.decode(
+            errors="replace"
+        )
+
+
+def current_generation() -> ClientGeneration:
+    """The generation this tree ships (the linked-in fdb_c analog)."""
+    from ..rpc.real_network import PROTOCOL_VERSION
+
+    return ClientGeneration(
+        PROTOCOL_VERSION, _bootstrap_current, "current tree"
+    )
+
+
+def _bootstrap_current(address: str, loop, protocol_version: bytes,
+                       timeout_s: float):
+    """Connect + bootstrap a Database over the given codec generation.
+    Raises FdbError('incompatible_protocol_version') when the cluster
+    rejects the hello (connection closed without a reply)."""
+    from ..rpc.network import Endpoint
+    from ..rpc.real_network import RealNetwork
+    from ..rpc.stream import RequestStreamRef, well_known_token
+    from .transaction import Database
+
+    net = RealNetwork(loop, protocol_version=protocol_version)
+    proc = net.process("mv_client")
+    boot = RequestStreamRef(
+        Endpoint(address, well_known_token("bootstrap")), "bootstrap"
+    )
+
+    async def probe():
+        return await boot.get_reply(proc, None)
+
+    task = proc.spawn(probe(), "mv_probe")
+    try:
+        ifaces = net.run_realtime(until=task, timeout_s=timeout_s)
+    except (FdbError, TimeoutError, RuntimeError) as e:
+        conn = net._conns.get(address)
+        established = (
+            (conn is not None and conn.connected)
+            # The transport removes a closed conn from _conns; its
+            # post-mortem records whether TCP connect ever completed.
+            or net._last_close_established.get(address, False)
+        )
+        net.close()
+        if isinstance(e, TimeoutError):
+            raise FdbError("timed_out") from e
+        if not established:
+            # Never reached the hello at all (refused / unreachable): a
+            # DOWN cluster is not a protocol mismatch — misreporting it as
+            # one would send the operator chasing version skew.
+            raise FdbError("connection_failed") from e
+        # Established then closed: the hello was rejected -> broken_promise
+        # on the bootstrap reply.
+        raise FdbError("incompatible_protocol_version") from e
+    db = Database(
+        proc,
+        ifaces["proxy"],
+        ifaces["storage"],
+        proxies=ifaces.get("proxies"),
+    )
+    return net, proc, db
+
+
+class MultiVersionClient:
+    """Probe the cluster with every registered generation, newest first
+    (ref: MultiVersionApi::createDatabase trying each client library)."""
+
+    def __init__(self, generations: Optional[List[ClientGeneration]] = None):
+        self.generations = generations or [current_generation()]
+        self.selected: Optional[ClientGeneration] = None
+        self.attempts: List[Tuple[str, str]] = []  # (description, outcome)
+
+    def connect(self, address: str, loop, timeout_s: float = 10.0):
+        """(net, proc, db) over the first compatible generation; raises
+        incompatible_protocol_version if none matches."""
+        deadline = time.monotonic() + timeout_s
+        last = "incompatible_protocol_version"
+        for gen in self.generations:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                # The stated timeout is a contract: no per-generation floor
+                # once it has elapsed.
+                self.attempts.append((gen.description, "skipped_deadline"))
+                continue
+            try:
+                net, proc, db = gen.bootstrap(
+                    address, loop, gen.protocol_version, budget
+                )
+            except FdbError as e:
+                self.attempts.append((gen.description, e.name))
+                last = e.name
+                continue
+            self.attempts.append((gen.description, "selected"))
+            self.selected = gen
+            return net, proc, db
+        # Every generation failed: surface the most informative error (a
+        # down cluster reports connection_failed, not version skew).
+        raise FdbError(
+            last if last in ("connection_failed", "timed_out")
+            else "incompatible_protocol_version"
+        )

@@ -93,6 +93,7 @@ _ERRORS: dict[str, int] = {
     "transaction_invalid_version": 2020,
     "environment_variable_network_option_failed": 2022,
     "transaction_read_only": 2023,
+    "incompatible_protocol_version": 2100,
     "key_too_large": 2102,
     "value_too_large": 2103,
     "unsupported_operation": 2108,

@@ -254,8 +254,13 @@ class RealNetwork:
         host: str = "127.0.0.1",
         port: int = 0,
         tls: Optional[TLSConfig] = None,
+        protocol_version: Optional[bytes] = None,
     ):
         self.loop = loop
+        # Overridable per network: the MultiVersion client probes a
+        # cluster with several codec generations (client/multi_version.py);
+        # everything else speaks the current one.
+        self.protocol_version = protocol_version or PROTOCOL_VERSION
         self.selector = selectors.DefaultSelector()
         self.host = host
         self.tls = tls
@@ -274,6 +279,7 @@ class RealNetwork:
         )
         self._proc_list: List[RealProcess] = []
         self._conns: Dict[str, _Conn] = {}  # peer address -> conn
+        self._last_close_established: Dict[str, bool] = {}
         self.messages_sent = 0
         self._token_counter = 1
         self._stopped = False
@@ -427,7 +433,7 @@ class RealNetwork:
         # protocol is rejected AT CONNECT — the live-upgrade story starts
         # with being able to tell versions apart on the wire.  Under TLS it
         # rides the encrypted channel after the TLS handshake.
-        conn.enqueue(PROTOCOL_VERSION + b" " + self.address.encode())
+        conn.enqueue(self.protocol_version + b" " + self.address.encode())
         self.selector.register(
             s,
             selectors.EVENT_READ | selectors.EVENT_WRITE,
@@ -468,7 +474,18 @@ class RealNetwork:
         if conn.closed:
             return
         if mask & selectors.EVENT_WRITE:
-            conn.connected = True
+            if not conn.connected:
+                # A FAILED non-blocking connect also selects writable;
+                # SO_ERROR is the real verdict (classic reactor gotcha —
+                # without this, a refused dial looks 'established' to the
+                # connection post-mortem).
+                err = conn.sock.getsockopt(
+                    socket.SOL_SOCKET, socket.SO_ERROR
+                )
+                if err != 0:
+                    conn.close()
+                    return
+                conn.connected = True
             conn.last_activity = time.monotonic()
             if conn.outbuf:
                 try:
@@ -526,16 +543,16 @@ class RealNetwork:
                     TraceEvent(
                         "IncompatibleProtocolVersion", severity=30
                     ).detail("peer_version", "<unversioned>").detail(
-                        "local_version", PROTOCOL_VERSION.decode()
+                        "local_version", self.protocol_version.decode()
                     ).log()
                     conn.close()
                     return
                 ver, addr = frame.split(b" ", 1)
-                if ver != PROTOCOL_VERSION:
+                if ver != self.protocol_version:
                     TraceEvent(
                         "IncompatibleProtocolVersion", severity=30
                     ).detail("peer_version", ver.decode(errors="replace")).detail(
-                        "local_version", PROTOCOL_VERSION.decode()
+                        "local_version", self.protocol_version.decode()
                     ).log()
                     conn.close()
                     return
@@ -580,6 +597,11 @@ class RealNetwork:
         superseded duplicate (simultaneous connect) closes silently."""
         if self._conns.get(conn.peer) is conn:
             del self._conns[conn.peer]
+        # Post-mortem for connection classification (e.g. the MultiVersion
+        # probe distinguishing hello-rejected from never-reached): did this
+        # connection ever complete the TCP connect?
+        if conn.peer is not None:
+            self._last_close_established[conn.peer] = conn.connected
         if conn.superseded:
             return
         TraceEvent("ConnectionClosed").detail("peer", conn.peer).log()
@@ -602,6 +624,29 @@ class RealNetwork:
     # -- the reactor loop (ref: Net2::run flow/Net2.actor.cpp:121) --
     def stop(self):
         self._stopped = True
+
+    def close(self):
+        """Full teardown: every connection, the listener, and the selector
+        fd.  stop() alone leaves fds open — fine for process-lifetime
+        networks, a leak for per-probe ones (the MultiVersion client
+        constructs one network per protocol generation probed)."""
+        self.stop()
+        for conn in list(self._conns.values()):
+            conn.superseded = True  # plain teardown: no broken-promise storm
+            conn.close()
+        self._conns.clear()
+        try:
+            self.selector.unregister(self._listener)
+        except (KeyError, ValueError):
+            pass
+        try:
+            self._listener.close()
+        except OSError:
+            pass
+        try:
+            self.selector.close()
+        except OSError:
+            pass
 
     def run_realtime(
         self,

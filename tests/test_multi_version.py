@@ -1,0 +1,106 @@
+"""MultiVersion client: protocol-generation selection against a live
+cluster.
+
+Ref: fdbclient/MultiVersionTransaction.h:402 / MultiVersionApi — several
+client libraries probe; the one whose protocol the cluster speaks serves.
+A fake future generation stands in for "another installed client
+library", exactly how the reference tests its dummy libs.
+"""
+
+import signal
+import subprocess
+
+import pytest
+
+from conftest import spawn_real_node
+
+from foundationdb_tpu.client.multi_version import (
+    ClientGeneration,
+    MultiVersionClient,
+    _bootstrap_current,
+    current_generation,
+)
+from foundationdb_tpu.flow.error import FdbError
+from foundationdb_tpu.flow.eventloop import EventLoop, set_event_loop
+
+
+@pytest.fixture(scope="module")
+def server():
+    proc = spawn_real_node("server")
+    ready = proc.stdout.readline().strip()
+    assert ready.startswith("READY "), ready
+    yield ready.split()[1]
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(timeout=5)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def _fake_future_gen():
+    return ClientGeneration(
+        b"FDBTPU-0xFFFFFFFFFFFFFFFFFUTURE",
+        _bootstrap_current,
+        "fake future client",
+    )
+
+
+def test_selects_compatible_generation_after_rejection(server):
+    """Newest-first probing: the fake future generation is rejected at the
+    hello, the shipped one connects, and the database works through it."""
+    loop = EventLoop(seed=11)
+    set_event_loop(loop)
+    mv = MultiVersionClient([_fake_future_gen(), current_generation()])
+    net, proc, db = mv.connect(server, loop, timeout_s=20.0)
+    assert mv.selected is not None and mv.selected.description == "current tree"
+    assert mv.attempts[0] == (
+        "fake future client", "incompatible_protocol_version"
+    )
+    assert mv.attempts[1][1] == "selected"
+
+    async def roundtrip():
+        tr = db.create_transaction()
+        tr.set(b"mv_key", b"mv_val")
+        await tr.commit()
+        tr2 = db.create_transaction()
+        return await tr2.get(b"mv_key")
+
+    task = proc.spawn(roundtrip(), "mv_roundtrip")
+    assert net.run_realtime(until=task, timeout_s=30.0) == b"mv_val"
+    net.close()
+
+
+def test_no_compatible_generation_raises(server):
+    loop = EventLoop(seed=12)
+    set_event_loop(loop)
+    mv = MultiVersionClient([_fake_future_gen()])
+    with pytest.raises(FdbError, match="incompatible_protocol_version"):
+        mv.connect(server, loop, timeout_s=15.0)
+    assert mv.attempts == [
+        ("fake future client", "incompatible_protocol_version")
+    ]
+
+
+def test_down_cluster_reports_connection_failed_not_version_skew():
+    """An unreachable cluster must NOT be misdiagnosed as a protocol
+    mismatch (the hello was never rejected — it was never delivered)."""
+    import socket
+
+    # A port nothing listens on.
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    dead_addr = "127.0.0.1:%d" % s.getsockname()[1]
+    s.close()
+
+    loop = EventLoop(seed=13)
+    set_event_loop(loop)
+    mv = MultiVersionClient([current_generation()])
+    with pytest.raises(FdbError, match="connection_failed|timed_out"):
+        mv.connect(dead_addr, loop, timeout_s=4.0)
+    assert mv.attempts[0][1] in ("connection_failed", "timed_out")
