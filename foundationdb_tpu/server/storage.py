@@ -222,8 +222,9 @@ class VersionedStore:
 class ByteSample:
     """Sampled per-key byte weights with range sums and weighted split
     points (ref: the byte sample fed by every mutation, StorageMetrics
-    .actor.h:404 — an IndexedSet with metric sums; here a sorted key list
-    + weight dict, adequate at simulation scale).
+    .actor.h:404) — backed by the order-statistic IndexedSet
+    (utils/indexed_set.py, the flow/IndexedSet.h analog): update, erase,
+    range-erase, and range-sum are all O(log n).
 
     A key of total size s is sampled with probability min(1, s/UNIT) and
     carries weight max(s, UNIT), so the expected weight equals the true
@@ -232,56 +233,44 @@ class ByteSample:
     UNIT = 100
 
     def __init__(self, rng):
+        from ..utils.indexed_set import IndexedSet
+
         self.rng = rng
-        self.keys: List[bytes] = []
-        self.weight: Dict[bytes, int] = {}
+        self.idx = IndexedSet(rng)
 
     def update(self, key: bytes, size: int):
         # Every write RE-SAMPLES the key (ref: byteSample updates on each
         # mutation): keeping a prior admission would bias repeatedly-
         # overwritten small keys into the sample permanently.
         admit = size >= self.UNIT or self.rng.random01() < size / self.UNIT
-        if key in self.weight:
-            if admit:
-                self.weight[key] = max(size, self.UNIT)
-            else:
-                del self.weight[key]
-                i = bisect_left(self.keys, key)
-                if i < len(self.keys) and self.keys[i] == key:
-                    del self.keys[i]
-        elif admit:
-            self.weight[key] = max(size, self.UNIT)
-            insort(self.keys, key)
+        if admit:
+            self.idx.set(key, max(size, self.UNIT))
+        else:
+            self.idx.erase(key)
 
     def remove_range(self, begin: bytes, end: Optional[bytes]):
-        i = bisect_left(self.keys, begin)
-        j = bisect_left(self.keys, end) if end is not None else len(self.keys)
-        for k in self.keys[i:j]:
-            del self.weight[k]
-        del self.keys[i:j]
+        self.idx.erase_range(begin, end)
 
     def bytes_in(self, begin: bytes, end: Optional[bytes]) -> int:
-        i = bisect_left(self.keys, begin)
-        j = bisect_left(self.keys, end) if end is not None else len(self.keys)
-        return sum(self.weight[k] for k in self.keys[i:j])
+        return self.idx.sum_range(begin, end)
 
     def split_point(self, begin: bytes, end: Optional[bytes]) -> Optional[bytes]:
         """The sampled key closest to half the range's weight (ref:
-        splitMetrics picking the key where half the bytes fall)."""
-        i = bisect_left(self.keys, begin)
-        j = bisect_left(self.keys, end) if end is not None else len(self.keys)
-        ks = self.keys[i:j]
-        total = sum(self.weight[k] for k in ks)
+        splitMetrics picking the key where half the bytes fall).  Scans
+        only the RANGE's sampled keys; key_at_metric offers the O(log n)
+        form when closest-to-half precision is not required."""
+        ks = self.idx.keys_in(begin, end)
+        total = sum(self.idx.get(k) for k in ks)
         if total == 0 or len(ks) < 2:
             return None
         acc = 0
         best, best_err = None, None
-        for idx, k in enumerate(ks):
-            if idx > 0:
+        for i, k in enumerate(ks):
+            if i > 0:
                 err = abs(acc - total / 2)
                 if best_err is None or err < best_err:
                     best, best_err = k, err
-            acc += self.weight[k]
+            acc += self.idx.get(k)
         return best
 
 
