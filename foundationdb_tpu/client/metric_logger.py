@@ -1,21 +1,29 @@
 """MetricLogger: persist counter collections into the `\xff/metrics`
-keyspace.
+keyspace as MULTI-RESOLUTION time series.
 
-Ref: fdbclient/MetricLogger.actor.cpp — TDMetric time series are written
-into the database itself on a cadence, so operators and tools read metrics
-with ordinary transactions (fdbcli, StatusWorkload).  Here each counter
-lands at `\xff/metrics/<collection>/<name>` with a packed (time, value)
-sample appended to a bounded series.
+Ref: fdbclient/MetricLogger.actor.cpp + flow/TDMetric.actor.h:168 — the
+reference's TDMetricCollection keeps each metric at several time LEVELS
+(finer-recent, coarser-long: each level covers ~4x the span of the one
+below) and writes them into the database itself, so operators and tools
+read metrics with ordinary transactions (fdbcli, StatusWorkload).  Here
+each counter lands at `\xff/metrics/<collection>/<name>` as LEVELS
+bounded series: level 0 records every flush; level i records one sample
+per BASE_RESOLUTION * 4**i seconds — 64 samples/level means level 3
+covers ~5.7 hours at a 5 s cadence while level 0 stays 5 s-grained.
+Values use the versioned wire codec (no pickle in stored state).
 """
 
 from __future__ import annotations
 
-import pickle
 from typing import List
+
+from ..rpc.wire import decode_frame, encode_frame
 
 METRICS_PREFIX = b"\xff/metrics/"
 METRICS_END = b"\xff/metrics0"
-MAX_SAMPLES = 64  # bounded series per metric (oldest dropped)
+MAX_SAMPLES = 64  # bounded series per level (oldest dropped)
+LEVELS = 4
+BASE_RESOLUTION = 5.0  # level i samples every BASE_RESOLUTION * 4**i
 
 
 def metric_key(collection: str, name: str) -> bytes:
@@ -34,16 +42,23 @@ async def log_metrics_once(db, collections: List) -> None:
             for name, c in coll.counters.items():
                 key = metric_key(coll.name, name)
                 raw = await tr.get(key)
-                series = pickle.loads(raw) if raw else []
-                series.append((now, c.value))
-                tr.set(
-                    key, pickle.dumps(series[-MAX_SAMPLES:], protocol=4)
+                levels = (
+                    decode_frame(raw) if raw else [[] for _ in range(LEVELS)]
                 )
+                for lv in range(LEVELS):
+                    series = levels[lv]
+                    period = BASE_RESOLUTION * (4 ** lv)
+                    if lv == 0 or not series or now - series[-1][0] >= period:
+                        series.append((now, c.value))
+                        del series[:-MAX_SAMPLES]
+                tr.set(key, encode_frame(levels))
 
     await db.run(txn)
 
 
-async def run_metric_logger(db, collections: List, interval: float = 5.0):
+async def run_metric_logger(
+    db, collections: List, interval: float = BASE_RESOLUTION
+):
     """The periodic flush actor (ref: runMetrics MetricLogger.actor.cpp)."""
     loop = db.process.network.loop
     while True:
@@ -60,7 +75,24 @@ async def read_metrics(db, collection: str) -> dict:
         prefix = METRICS_PREFIX + collection.encode() + b"/"
         rows = await tr.get_range(prefix, prefix + b"\xff")
         for k, v in rows:
-            out[k[len(prefix):].decode()] = pickle.loads(v)
+            out[k[len(prefix):].decode()] = decode_frame(v)
 
     await db.run(txn)
-    return out
+    return {name: levels[0] for name, levels in out.items()}
+
+
+async def read_metric_levels(db, collection: str, name: str) -> list:
+    """All resolution levels of one metric: [[(time, value)], ...] — level
+    i sampled every BASE_RESOLUTION * 4**i (ref: the per-level blocks in
+    TDMetric.actor.h)."""
+    out = {}
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        raw = await tr.get(metric_key(collection, name))
+        out["levels"] = (
+            decode_frame(raw) if raw else [[] for _ in range(LEVELS)]
+        )
+
+    await db.run(txn)
+    return out["levels"]

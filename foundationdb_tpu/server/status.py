@@ -164,6 +164,40 @@ def cluster_status(cluster) -> dict:
                 "commit_seconds": samples["commit"].summary(),
                 "grv_seconds": samples["grv"].summary(),
             }
+
+    # Processes / machines sections (ref: the per-process and per-machine
+    # maps in Status.actor.cpp:1690, fed by ProcessMetrics/MachineMetrics;
+    # here read live off the fabric + each process's actor bookkeeping).
+    net = getattr(cluster, "net", None)
+    if net is not None and hasattr(net, "_procs"):
+        role_by_addr: dict = {}
+        for rname, addrs in cl.get("roles", {}).items():
+            for a in addrs:
+                role_by_addr.setdefault(a, []).append(rname)
+        processes = {}
+        machines: dict = {}
+        for addr, p in sorted(net._procs.items()):
+            mid = p.machine.machine_id
+            processes[addr] = {
+                "machine_id": mid,
+                "excluded": bool(getattr(p, "excluded", False)),
+                "alive": p.alive,
+                "roles": sorted(role_by_addr.get(addr, [])),
+                "live_actors": len(p._tasks),
+                "endpoints": len(p._endpoints),
+            }
+            m = machines.setdefault(
+                mid,
+                {
+                    "datacenter_id": getattr(p.machine, "dc_id", "dc0"),
+                    "processes": 0,
+                    "alive_processes": 0,
+                },
+            )
+            m["processes"] += 1
+            m["alive_processes"] += 1 if p.alive else 0
+        cl["processes"] = processes
+        cl["machines"] = machines
     return doc
 
 

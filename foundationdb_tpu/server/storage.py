@@ -260,7 +260,7 @@ class ByteSample:
         only the RANGE's sampled keys; key_at_metric offers the O(log n)
         form when closest-to-half precision is not required."""
         ks = self.idx.keys_in(begin, end)
-        total = sum(self.idx.get(k) for k in ks)
+        total = self.idx.sum_range(begin, end)
         if total == 0 or len(ks) < 2:
             return None
         acc = 0

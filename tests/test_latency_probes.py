@@ -142,6 +142,8 @@ def test_status_schema_gate():
                 "commit_seconds": {"count": int, "median": float},
                 "grv_seconds": {"count": int, "median": float},
             },
+            "processes": {},
+            "machines": {},
         },
     }
 
@@ -157,3 +159,14 @@ def test_status_schema_gate():
                 )
 
     check(doc, schema)
+    # Processes carry role assignments and machine ids; every role address
+    # appears (Status.actor.cpp's processes map).
+    procs = doc["cluster"]["processes"]
+    assert procs, "no processes in status"
+    role_addrs = {
+        a for addrs in doc["cluster"]["roles"].values() for a in addrs
+    }
+    assert role_addrs <= set(procs), "role address missing from processes"
+    for p in procs.values():
+        assert {"machine_id", "alive", "roles", "live_actors"} <= set(p)
+    assert doc["cluster"]["machines"], "no machines in status"

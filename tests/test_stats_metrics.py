@@ -127,3 +127,41 @@ def test_slow_task_profiler_fires():
     finally:
         set_global_collector(TraceCollector())
     set_event_loop(None)
+
+
+def test_metric_levels_multi_resolution():
+    """TDMetric-style levels: level 0 records every flush; higher levels
+    thin out by 4x per level (flow/TDMetric.actor.h:168)."""
+    from foundationdb_tpu.client.metric_logger import (
+        BASE_RESOLUTION,
+        read_metric_levels,
+    )
+
+    c = SimCluster(seed=152)
+    db = c.database()
+
+    async def drive():
+        async def op(tr):
+            tr.set(b"lvl", b"x")
+
+        # Flush every BASE_RESOLUTION for ~20 periods of virtual time.
+        for _ in range(20):
+            await op_and_flush(op)
+        return await read_metric_levels(db, c.proxy.stats.name, "committed")
+
+    async def op_and_flush(op):
+        await db.run(op)
+        await log_metrics_once(db, [c.proxy.stats])
+        await c.loop.delay(BASE_RESOLUTION)
+
+    levels = c.run_until(db.process.spawn(drive()), timeout_vt=5000.0)
+    assert len(levels) == 4
+    n0, n1, n2 = len(levels[0]), len(levels[1]), len(levels[2])
+    assert n0 == 20
+    # Level 1 samples every 4 periods, level 2 every 16: strictly coarser.
+    assert 4 <= n1 <= 7 and n1 < n0, (n0, n1)
+    assert 1 <= n2 <= 3, n2
+    # Monotone timestamps, monotone counter values within each level.
+    for series in levels:
+        ts = [t for t, _v in series]
+        assert ts == sorted(ts)
