@@ -286,6 +286,39 @@ class Transaction:
         self._retries = 0
         self._watches: List[tuple] = []  # (key, value, Promise), armed at commit
         self._committing = False  # set at commit() entry, cleared by reset()
+        self._wm_init()
+
+    def _wm_init(self):
+        """The WriteMap: mutation-index-keyed structures so RYW reads cost
+        O(ops on the key + log) instead of scanning the whole mutation log
+        (ref: ReadYourWrites' WriteMap, fdbclient/WriteMap.h).  Issue-time
+        snapshots become an `upto` index — the structures are append-only,
+        so 'the write map as of mutation i' is answerable at any time."""
+        from ..server.storage import VersionedClears
+        from ..utils.indexed_set import IndexedSet
+
+        self._wm_key_ops: dict = {}  # key -> [mutation index] (non-clear ops)
+        # Ordered key index (O(log n) insert/range — insort's O(n) list
+        # shifts would punish descending-key write patterns).
+        self._wm_keys = IndexedSet(self.db.process.network.loop.rng)
+        self._wm_clears = VersionedClears()  # version = mutation index
+        self._wm_stamps: List[tuple] = []  # (index, lo, hi) of SVK ranges
+
+    def _append_mutation(self, m: Mutation):
+        idx = len(self.mutations)
+        self.mutations.append(m)
+        if m.type == MutationType.CLEAR_RANGE:
+            self._wm_clears.add(m.param1, m.param2, idx, 0)
+        elif m.type == MutationType.SET_VERSIONSTAMPED_KEY:
+            (lo, hi), = _stamp_ranges([m])
+            self._wm_stamps.append((idx, lo, hi))
+        else:
+            ops = self._wm_key_ops.get(m.param1)
+            if ops is None:
+                self._wm_key_ops[m.param1] = [idx]
+                self._wm_keys.set(m.param1, 1)
+            else:
+                ops.append(idx)
 
     # --- versions ---
     async def get_read_version(self) -> int:
@@ -310,43 +343,49 @@ class Transaction:
 
     # --- local overlay (RYW) ---
     def _replay(
-        self, key: bytes, base: Optional[bytes], muts=None
+        self, key: bytes, base: Optional[bytes], upto: int
     ) -> Optional[bytes]:
-        """Apply a mutation log (default: this txn's), in order, to `base`
-        for `key`.  Readers pass the ISSUE-TIME snapshot of the log so a
-        write issued while the storage read was in flight does not leak into
-        the result (ref: RYW's WriteMap is consulted when the read is issued,
-        ReadYourWrites.actor.cpp readThrough — the WriteDuringRead workload
-        exists to check exactly this)."""
-        val = base
-        for m in (self.mutations if muts is None else muts):
-            if m.type == MutationType.CLEAR_RANGE:
-                if m.param1 <= key < m.param2:
-                    val = None
-            elif m.type == MutationType.SET_VERSIONSTAMPED_KEY:
-                # The stamped key is unknown until commit: ANY key in the
-                # possible stamp range is unreadable (ref: RYW treating
-                # versionstamp writes as unreadable ranges,
-                # getVersionstampKeyRange :226).
-                (lo, hi), = _stamp_ranges([m])
-                if lo <= key <= hi:
-                    raise FdbError("accessed_unreadable")
-            elif m.param1 != key:
-                continue
-            elif m.type == MutationType.SET_VALUE:
-                val = m.param2
-            elif m.type == MutationType.SET_VERSIONSTAMPED_VALUE:
+        """The write map's view of `key` as of mutation index `upto` (the
+        ISSUE-TIME snapshot: a write issued while the storage read was in
+        flight must not leak into the result — ref: RYW's WriteMap
+        consulted when the read is issued, ReadYourWrites.actor.cpp
+        readThrough; the WriteDuringRead workload checks exactly this).
+
+        Semantics are identical to an in-order scan of mutations[:upto]:
+        a pending SVK whose stamp range covers the key — or a pending SVV
+        on the key — is unreadable EVEN IF a later clear masks it (the
+        scan raised at the earlier op's position)."""
+        for idx, lo, hi in self._wm_stamps:
+            if idx < upto and lo <= key <= hi:
                 raise FdbError("accessed_unreadable")
+        c, _s = (
+            self._wm_clears.latest_over(key, upto - 1)
+            if upto > 0
+            else (-1, -1)
+        )
+        val = None if c >= 0 else base
+        for idx in self._wm_key_ops.get(key, ()):
+            if idx >= upto:
+                break
+            m = self.mutations[idx]
+            if m.type == MutationType.SET_VERSIONSTAMPED_VALUE:
+                raise FdbError("accessed_unreadable")
+            if idx < c:
+                continue  # masked by the later clear
+            if m.type == MutationType.SET_VALUE:
+                val = m.param2
             elif m.type in ATOMIC_TYPES:
                 val = apply_atomic(m.type, val, m.param2)
         return val
 
-    def _touched_keys(self, begin: bytes, end: bytes, muts=None) -> List[bytes]:
-        out = set()
-        for m in (self.mutations if muts is None else muts):
-            if m.type != MutationType.CLEAR_RANGE and begin <= m.param1 < end:
-                out.add(m.param1)
-        return sorted(out)
+    def _touched_keys(self, begin: bytes, end: bytes, upto: int) -> List[bytes]:
+        """Keys in [begin, end) with any pending non-clear op below `upto`
+        (clear masking is _replay's business)."""
+        return [
+            k
+            for k in self._wm_keys.keys_in(begin, end)
+            if self._wm_key_ops[k][0] < upto
+        ]
 
     def _check_usable(self):
         """Reads and writes on a transaction whose commit has started (and
@@ -411,12 +450,12 @@ class Transaction:
     async def get(self, key: bytes, snapshot: bool = False) -> Optional[bytes]:
         self._check_usable()
         self._check_legal_key(key)  # reads of \xff.. need the option too
-        muts = tuple(self.mutations)  # issue-time RYW snapshot
+        upto = len(self.mutations)  # issue-time RYW snapshot
         version = await self.get_read_version()
         reply = await self._get_from_storage(key, version)
         if not snapshot:
             self.add_read_conflict_range(key, key_after(key))
-        return self._replay(key, reply.value, muts)
+        return self._replay(key, reply.value, upto)
 
     async def get_range(
         self,
@@ -430,12 +469,12 @@ class Transaction:
         self._check_legal_key(begin)
         if end > b"\xff" and not self.options.get("access_system_keys"):
             raise FdbError("key_outside_legal_range")
-        muts = tuple(self.mutations)  # issue-time RYW snapshot
+        upto = len(self.mutations)  # issue-time RYW snapshot
         # A scan intersecting any pending versionstamped-key stamp range is
         # unreadable as a whole (computed once per call, not per row; ref:
         # RYW's unreadable ranges for range reads).
-        for lo_s, hi_s in _stamp_ranges(muts):
-            if begin <= hi_s and lo_s < end:
+        for idx_s, lo_s, hi_s in self._wm_stamps:
+            if idx_s < upto and begin <= hi_s and lo_s < end:
                 raise FdbError("accessed_unreadable")
         version = await self.get_read_version()
         out: List[Tuple[bytes, bytes]] = []
@@ -510,9 +549,9 @@ class Transaction:
                 else:
                     lo = req_hi
             merged = set(base)
-            merged.update(self._touched_keys(cov_lo, cov_hi, muts))
+            merged.update(self._touched_keys(cov_lo, cov_hi, upto))
             for k in sorted(merged, reverse=reverse):
-                v = self._replay(k, base.get(k), muts)
+                v = self._replay(k, base.get(k), upto)
                 if v is not None:
                     out.append((k, v))
                     if len(out) >= limit:
@@ -555,13 +594,13 @@ class Transaction:
     def set(self, key: bytes, value: bytes):
         self._check_usable()
         self._check_size(key, value)
-        self.mutations.append(Mutation(MutationType.SET_VALUE, key, value))
+        self._append_mutation(Mutation(MutationType.SET_VALUE, key, value))
         self.add_write_conflict_range(key, key_after(key))
 
     def clear(self, key: bytes):
         self._check_usable()
         self._check_legal_key(key)
-        self.mutations.append(
+        self._append_mutation(
             Mutation(MutationType.CLEAR_RANGE, key, key_after(key))
         )
         self.add_write_conflict_range(key, key_after(key))
@@ -573,7 +612,7 @@ class Transaction:
         self._check_legal_key(begin)
         if end > b"\xff" and not self.options.get("access_system_keys"):
             raise FdbError("key_outside_legal_range")
-        self.mutations.append(Mutation(MutationType.CLEAR_RANGE, begin, end))
+        self._append_mutation(Mutation(MutationType.CLEAR_RANGE, begin, end))
         self.add_write_conflict_range(begin, end)
 
     def atomic_op(self, op: MutationType, key: bytes, operand: bytes):
@@ -588,15 +627,15 @@ class Transaction:
             # possible stamp range (ref: getVersionstampKeyRange :226).
             # Same computation as the RYW-unreadable check, by construction.
             m = Mutation(op, key, operand)
-            (lo, hi), = _stamp_ranges([m])
-            self.mutations.append(m)
+            self._append_mutation(m)  # records the stamp range once
+            _idx, lo, hi = self._wm_stamps[-1]
             self.add_write_conflict_range(lo, key_after(hi))
             return
         if op == MutationType.SET_VERSIONSTAMPED_VALUE:
             from .atomic import validate_versionstamp_param
 
             validate_versionstamp_param(operand)
-        self.mutations.append(Mutation(op, key, operand))
+        self._append_mutation(Mutation(op, key, operand))
         self.add_write_conflict_range(key, key_after(key))
 
     def _check_size(self, key: bytes, value: bytes):
@@ -811,6 +850,7 @@ class Transaction:
         self._read_version = None
         self._committing = False
         self.mutations = []
+        self._wm_init()
         self.read_conflict_ranges = []
         self.write_conflict_ranges = []
         self.committed_version = None

@@ -180,7 +180,6 @@ def cluster_status(cluster) -> dict:
             mid = p.machine.machine_id
             processes[addr] = {
                 "machine_id": mid,
-                "excluded": bool(getattr(p, "excluded", False)),
                 "alive": p.alive,
                 "roles": sorted(role_by_addr.get(addr, [])),
                 "live_actors": len(p._tasks),
