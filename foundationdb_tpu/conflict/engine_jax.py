@@ -195,6 +195,7 @@ def detect_core(
     t_valid,
     now_rel,
     new_oldest_rel,
+    do_evict=None,
     *,
     txn_cap: int,
     rr_cap: int,
@@ -615,6 +616,27 @@ def detect_core(
     out_count = jnp.sum(keep2)
     if "noevict" in _ablate:
         out_keys, out_vers, out_count = merged_keys, merged_vers, merged_count
+    elif do_evict is not None:
+        # Amortized eviction (perf experiment; decisions identical —
+        # stale sub-window rows can never flip a verdict because any
+        # snapshot that could see them is already TOO_OLD): the compaction
+        # sort runs only when the traced flag says so, at the cost of
+        # h_cap headroom for the unevicted batches in between.
+        def _evict(ops):
+            mk, mv = ops
+            k, v = compact_to(
+                rank2, keep2, mk, H,
+                fill_vers=jnp.int32(FLOOR_REL), vers=mv, count=out_count,
+            )
+            return k, v, out_count.astype(jnp.int32)
+
+        def _keep(ops):
+            mk, mv = ops
+            return mk, mv, merged_count.astype(jnp.int32)
+
+        out_keys, out_vers, out_count = jax.lax.cond(
+            do_evict != 0, _evict, _keep, (merged_keys, merged_vers)
+        )
     else:
         out_keys, out_vers = compact_to(
             rank2,
@@ -681,7 +703,7 @@ def _blob_offsets(txn_cap: int, rr_cap: int, wr_cap: int, kw1: int):
         wr_cap,  # w_txn (i32)
         txn_cap,  # t_snap_rel (i32)
         txn_cap,  # t_flags (bit0 has_reads, bit1 valid)
-        2,  # now_rel, new_oldest_rel (i32)
+        3,  # now_rel, new_oldest_rel, do_evict (i32)
     ]
     offs, o = [], 0
     for s in sizes:
@@ -691,7 +713,7 @@ def _blob_offsets(txn_cap: int, rr_cap: int, wr_cap: int, kw1: int):
 
 
 def _blob_core(hkeys, hvers, hcount, oldest, blob, *, txn_cap, rr_cap,
-               wr_cap, h_cap, kw1):
+               wr_cap, h_cap, kw1, amortized=False):
     offs, _total = _blob_offsets(txn_cap, rr_cap, wr_cap, kw1)
     as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
     # Key fields are packed word-major (kw1, N): see rangequery.py on TPU
@@ -707,20 +729,24 @@ def _blob_core(hkeys, hvers, hcount, oldest, blob, *, txn_cap, rr_cap,
     t_flags = blob[offs[8] : offs[8] + txn_cap]
     t_has_reads = (t_flags & 1) > 0
     t_valid = (t_flags & 2) > 0
-    scalars = as_i32(blob[offs[9] : offs[9] + 2])
+    scalars = as_i32(blob[offs[9] : offs[9] + 3])
     return detect_core(
         hkeys, hvers, hcount, oldest,
         r_begin, r_end, r_txn, r_snap,
         w_begin, w_end, w_txn,
         t_snap, t_has_reads, t_valid,
         scalars[0], scalars[1],
+        # Amortized-eviction experiment: the traced flag only enters the
+        # graph when enabled, so the default compile is byte-identical.
+        scalars[2] if amortized else None,
         txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap, h_cap=h_cap,
     )
 
 
 _blob_step = partial(
     jax.jit,
-    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "kw1"),
+    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "kw1",
+                     "amortized"),
     donate_argnames=("hkeys", "hvers", "hcount", "oldest"),
 )(_blob_core)
 
@@ -745,6 +771,14 @@ class JaxConflictSet:
         # of recompiling per power-of-two shape (compile churn costs more
         # than padded compute on device).
         self.bucket_mins = bucket_mins
+        # Eviction cadence (perf experiment; 1 = every batch, the default
+        # semantics).  >1 needs h_cap headroom for the unevicted batches.
+        import os as _os
+
+        self.evict_every = max(
+            1, int(_os.environ.get("FDB_TPU_EVICT_EVERY", "1"))
+        )
+        self._batches_since_evict = 0
         self._init_state(oldest_rel=0)
         self.last_iters = 0
 
@@ -821,7 +855,8 @@ class JaxConflictSet:
         statuses = self.detect_packed(pb, now, new_oldest_version)
         return [int(s) for s in statuses[: len(transactions)]]
 
-    def _pack_blob(self, pb: PackedBatch, now: int, new_oldest_version: int):
+    def _pack_blob(self, pb: PackedBatch, now: int, new_oldest_version: int,
+                   do_evict: int = 1):
         """Single contiguous uint32 blob for one-copy dispatch (see
         _blob_offsets)."""
         rel = self._rel
@@ -846,7 +881,7 @@ class JaxConflictSet:
                 t_snap.view(np.uint32),
                 t_flags,
                 np.array(
-                    [rel(now), rel(new_oldest_version)], np.int32
+                    [rel(now), rel(new_oldest_version), do_evict], np.int32
                 ).view(np.uint32),
             ]
         )
@@ -857,7 +892,11 @@ class JaxConflictSet:
         and transfer of batch N+1 under device compute of batch N.  The
         caller must eventually check undecided (see detect_packed)."""
         self._maybe_grow_or_rebase(now, pb.wr_cap)
-        blob = self._pack_blob(pb, now, new_oldest_version)
+        self._batches_since_evict += 1
+        do_evict = 1 if self._batches_since_evict >= self.evict_every else 0
+        if do_evict:
+            self._batches_since_evict = 0
+        blob = self._pack_blob(pb, now, new_oldest_version, do_evict)
         (
             self._hkeys,
             self._hvers,
@@ -877,6 +916,7 @@ class JaxConflictSet:
             wr_cap=pb.wr_cap,
             h_cap=self.h_cap,
             kw1=self.key_words + 1,
+            amortized=self.evict_every > 1,
         )
         self._last_iters_dev = iters
         self._hcount_bound = min(

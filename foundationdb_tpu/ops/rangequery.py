@@ -50,18 +50,24 @@ def lex_leq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return leq
 
 
-def searchsorted_words(keys: jnp.ndarray, q: jnp.ndarray, side: str) -> jnp.ndarray:
-    """Insertion ranks of q [W, M] into sorted keys [W, N].
+import os as _os
 
-    side='left':  count of keys strictly < q
-    side='right': count of keys <= q
-    Fixed log2(N)+1 binary-search iterations of vectorized gathers along the
-    lane axis.
-    """
+# Search strategy for big tables (perf experiment; decisions identical):
+#   ""        flat binary search (default)
+#   "2level"  coarse sampled-table bracket, then fine steps — the coarse
+#             table (one column per SAMPLE_STRIDE) is small enough for the
+#             compiler to keep on-chip, so only the fine log2(stride)
+#             steps gather from the full HBM-resident table.
+SEARCH_MODE = _os.environ.get("FDB_TPU_SEARCH", "")
+SAMPLE_STRIDE = 512
+_2LEVEL_MIN = 1 << 16  # below this a flat search wins (coarse build cost)
+
+
+def _searchsorted_words_flat(keys, q, side, lo=None, hi=None):
     _w, n = keys.shape
     m = q.shape[1]
-    lo = jnp.zeros((m,), jnp.int32)
-    hi = jnp.full((m,), n, jnp.int32)
+    lo = jnp.zeros((m,), jnp.int32) if lo is None else lo
+    hi = jnp.full((m,), n, jnp.int32) if hi is None else hi
     steps = max(1, math.ceil(math.log2(max(n, 2))) + 1)
     cmp = lex_less if side == "left" else lex_leq
     for _ in range(steps):
@@ -72,6 +78,51 @@ def searchsorted_words(keys: jnp.ndarray, q: jnp.ndarray, side: str) -> jnp.ndar
         lo = jnp.where(active & go_right, mid + 1, lo)
         hi = jnp.where(active & ~go_right, mid, hi)
     return lo
+
+
+def _searchsorted_words_2level(keys, q, side):
+    """Coarse-then-fine: bracket each query in a sampled table first, then
+    run only log2(stride) fine steps against the big table."""
+    _w, n = keys.shape
+    m = q.shape[1]
+    stride = SAMPLE_STRIDE
+    coarse = keys[:, ::stride]  # [W, ceil(n/stride)]
+    nc = coarse.shape[1]
+    cmp = lex_less if side == "left" else lex_leq
+    clo = jnp.zeros((m,), jnp.int32)
+    chi = jnp.full((m,), nc, jnp.int32)
+    for _ in range(max(1, math.ceil(math.log2(max(nc, 2))) + 1)):
+        active = clo < chi
+        mid = (clo + chi) // 2
+        kmid = coarse[:, jnp.clip(mid, 0, nc - 1)]
+        go_right = cmp(kmid, q)
+        clo = jnp.where(active & go_right, mid + 1, clo)
+        chi = jnp.where(active & ~go_right, mid, chi)
+    # Bracket in the full table: rank is in [ (clo-1)*stride, clo*stride ].
+    lo = jnp.clip((clo - 1) * stride, 0, n).astype(jnp.int32)
+    hi = jnp.minimum(clo * stride, n).astype(jnp.int32)
+    steps = max(1, math.ceil(math.log2(stride)) + 1)
+    for _ in range(steps):
+        active = lo < hi
+        mid = (lo + hi) // 2
+        kmid = keys[:, jnp.clip(mid, 0, n - 1)]
+        go_right = cmp(kmid, q)
+        lo = jnp.where(active & go_right, mid + 1, lo)
+        hi = jnp.where(active & ~go_right, mid, hi)
+    return lo
+
+
+def searchsorted_words(keys: jnp.ndarray, q: jnp.ndarray, side: str) -> jnp.ndarray:
+    """Insertion ranks of q [W, M] into sorted keys [W, N].
+
+    side='left':  count of keys strictly < q
+    side='right': count of keys <= q
+    Fixed log2(N)+1 binary-search iterations of vectorized gathers along the
+    lane axis (or the coarse-then-fine variant under FDB_TPU_SEARCH=2level).
+    """
+    if SEARCH_MODE == "2level" and keys.shape[1] >= _2LEVEL_MIN:
+        return _searchsorted_words_2level(keys, q, side)
+    return _searchsorted_words_flat(keys, q, side)
 
 
 def searchsorted_1d(keys: jnp.ndarray, q: jnp.ndarray, side: str) -> jnp.ndarray:

@@ -1,0 +1,81 @@
+"""A/B harness for conflict-engine perf experiments on the real TPU.
+
+Runs the driver-config device bench (24 x 64k txns, window=50) under each
+experiment flag combination in a fresh subprocess (flags are read at
+import), printing one JSON line per variant.  Variants are
+decision-identical to the baseline — verified by the differential suites
+under the same flags — so the only question hardware answers is speed.
+
+Variants:
+  baseline     the shipping configuration
+  search2level FDB_TPU_SEARCH=2level — coarse-then-fine history search
+  evict4       FDB_TPU_EVICT_EVERY=4 — eviction compaction every 4th
+               batch (h_cap gets headroom for the unevicted batches)
+  both         the two combined
+
+Run: python tools/perf_experiments.py   (on the TPU host)
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+RUNNER = r"""
+import json, sys, time
+sys.path.insert(0, %(repo)r)
+import numpy as np
+import bench
+
+rng = np.random.default_rng(2024)
+rate = bench.bench_jax(rng, h_cap=%(h_cap)d)
+print("RESULT " + json.dumps({"txns_per_sec": round(rate, 1)}))
+"""
+
+VARIANTS = [
+    ("baseline", {}, 3407872),
+    ("search2level", {"FDB_TPU_SEARCH": "2level"}, 3407872),
+    # Headroom: between evictions merged rows grow by <= 2*wr_cap per
+    # batch; 3 unevicted batches on top of the 2.87M steady state.
+    ("evict4", {"FDB_TPU_EVICT_EVERY": "4"}, 3407872 + 3 * 2 * 65536),
+    (
+        "both",
+        {"FDB_TPU_SEARCH": "2level", "FDB_TPU_EVICT_EVERY": "4"},
+        3407872 + 3 * 2 * 65536,
+    ),
+]
+
+
+def main():
+    out = {}
+    for name, flags, h_cap in VARIANTS:
+        env = dict(os.environ)
+        env.update(flags)
+        env["PYTHONPATH"] = REPO
+        code = RUNNER % {"repo": REPO, "h_cap": h_cap}
+        print(f"[ab] running {name} (flags={flags})...", file=sys.stderr,
+              flush=True)
+        try:
+            res = subprocess.run(
+                [sys.executable, "-c", code],
+                env=env, cwd=REPO, capture_output=True, text=True,
+                timeout=1800,
+            )
+            line = next(
+                (l for l in res.stdout.splitlines() if l.startswith("RESULT ")),
+                None,
+            )
+            if line is None:
+                out[name] = {"error": (res.stdout + res.stderr)[-400:]}
+            else:
+                out[name] = json.loads(line[len("RESULT "):])
+        except subprocess.TimeoutExpired:
+            out[name] = {"error": "timeout"}
+        print(json.dumps({name: out[name]}), flush=True)
+    print(json.dumps({"all": out}), flush=True)
+
+
+if __name__ == "__main__":
+    main()
