@@ -17,7 +17,21 @@ from __future__ import annotations
 
 from typing import List
 
-from ..rpc.wire import decode_frame, encode_frame
+from ..rpc.wire import WireDecodeError, decode_frame, encode_frame
+
+
+def _decode_levels(raw):
+    """Stored series -> levels; a foreign/corrupt value (e.g. rows written
+    by the old pickle format) resets the series instead of killing the
+    metric logger actor for the process lifetime."""
+    if raw:
+        try:
+            levels = decode_frame(raw)
+            if isinstance(levels, list) and len(levels) == LEVELS:
+                return levels
+        except WireDecodeError:
+            pass
+    return [[] for _ in range(LEVELS)]
 
 METRICS_PREFIX = b"\xff/metrics/"
 METRICS_END = b"\xff/metrics0"
@@ -42,9 +56,7 @@ async def log_metrics_once(db, collections: List) -> None:
             for name, c in coll.counters.items():
                 key = metric_key(coll.name, name)
                 raw = await tr.get(key)
-                levels = (
-                    decode_frame(raw) if raw else [[] for _ in range(LEVELS)]
-                )
+                levels = _decode_levels(raw)
                 for lv in range(LEVELS):
                     series = levels[lv]
                     period = BASE_RESOLUTION * (4 ** lv)
@@ -75,7 +87,7 @@ async def read_metrics(db, collection: str) -> dict:
         prefix = METRICS_PREFIX + collection.encode() + b"/"
         rows = await tr.get_range(prefix, prefix + b"\xff")
         for k, v in rows:
-            out[k[len(prefix):].decode()] = decode_frame(v)
+            out[k[len(prefix):].decode()] = _decode_levels(v)
 
     await db.run(txn)
     return {name: levels[0] for name, levels in out.items()}
@@ -90,9 +102,7 @@ async def read_metric_levels(db, collection: str, name: str) -> list:
     async def txn(tr):
         tr.options["access_system_keys"] = True
         raw = await tr.get(metric_key(collection, name))
-        out["levels"] = (
-            decode_frame(raw) if raw else [[] for _ in range(LEVELS)]
-        )
+        out["levels"] = _decode_levels(raw)
 
     await db.run(txn)
     return out["levels"]

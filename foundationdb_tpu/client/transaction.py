@@ -171,6 +171,14 @@ class Database:
                     # Each waiter retries through its own on_error loop.
                     for p in batch:
                         p.send_error(FdbError(e.name))
+                except Exception:  # noqa: BLE001
+                    # A non-FdbError (e.g. no proxy during a failover
+                    # window) must NOT strand the coalesced waiters in a
+                    # silent hang — before batching, each caller saw its
+                    # own exception.  Fail them retryably and keep
+                    # draining.
+                    for p in batch:
+                        p.send_error(FdbError("broken_promise"))
         finally:
             lane["busy"] = False
 

@@ -202,7 +202,20 @@ class ClusterController:
             if not moved:
                 break
             TraceEvent("CoordinatorsMovedFollow").detail("to", moved).log()
+            # Re-drive the retired members' forwards (best-effort): a CC
+            # that crashed between writing the moved_to fence and sending
+            # set_forward left the old quorum serving phantom elections —
+            # every later recovery that follows the pointer repairs that,
+            # so clients/workers on stale cluster files converge.
             if isinstance(self.coordinators, CoordinatorSet):
+                for addr, c in zip(
+                    self.coordinators.addresses, self.coordinators.interfaces
+                ):
+                    if addr not in moved:
+                        await self._try(
+                            c.set_forward.get_reply(self.process, list(moved)),
+                            timeout=2.0,
+                        )
                 self.coordinators.retarget(moved)
             else:
                 self.coordinators = [
@@ -620,6 +633,9 @@ class ClusterController:
 
         async def seed(tr):
             tr.options["access_system_keys"] = True
+            # Lock-aware like every DD metadata txn: recovery of a LOCKED
+            # database must still recruit its DataDistribution singleton.
+            tr.options["lock_aware"] = True
             rows = await tr.get_range(sk.KEY_SERVERS_PREFIX, sk.KEY_SERVERS_END)
             if rows:
                 return
@@ -808,16 +824,31 @@ class ClusterController:
         old_cs = CoordinatedState(self.process, self.coordinators)
         raw = await old_cs.read()
         new_ifaces = [coordinator_interface_at(a) for a in new_addrs]
-        new_cs = CoordinatedState(self.process, new_ifaces)
+        # The NEW quorum's state lives under its OWN membership-derived
+        # key (quorum_state_key): with overlapping memberships the shared
+        # registers hold both quorums' states side by side, so the
+        # moved_to fence below cannot clobber the copied manifest.
+        from .coordination import quorum_state_key
+
+        new_cs = CoordinatedState(
+            self.process, new_ifaces, key=quorum_state_key(list(new_addrs))
+        )
         await new_cs.read()
         await new_cs.set(raw or pickle.dumps({"epoch_end": 0}, protocol=4))
         await old_cs.set(
             pickle.dumps({"moved_to": list(new_addrs)}, protocol=4)
         )
-        for c in old_cs.coordinators:
+        for addr, c in zip(old_addrs, old_cs.coordinators):
+            if addr in new_addrs:
+                # A member STAYING in the quorum must keep serving real
+                # elections — forwarding it would out-vote the candidates
+                # with the forward pseudo-nominee forever (a majority of
+                # stayers would wedge every future election).
+                continue
             # Best-effort: a dead old coordinator forwards from its durable
             # registry when it reboots; the moved_to fence already protects
-            # safety.
+            # safety, and _recovery re-drives forwards when following a
+            # moved_to pointer (the crash-between-fence-and-forward window).
             await self._try(
                 c.set_forward.get_reply(self.process, list(new_addrs)),
                 timeout=2.0,

@@ -211,7 +211,13 @@ class Coordinator:
             for k, v in self._store.read_range(b"", b"\xff" * 16):
                 self.registry[k] = pickle.loads(v)
             fwd = self.registry.get(FORWARD_KEY)
-            if fwd is not None and fwd[0]:
+            if getattr(self, "_forward_cleared", False):
+                # clear_forward ran while this boot was still loading: the
+                # clear wins over whatever the disk said.
+                self.forward = None
+                self.registry[FORWARD_KEY] = (b"", ZERO_GEN, ZERO_GEN)
+                await self._persist(FORWARD_KEY)
+            elif fwd is not None and fwd[0]:
                 # A rebooted retired coordinator must keep forwarding, or a
                 # client with a stale cluster file could re-elect on the
                 # old quorum (ref: forward is durable in the reference too).
@@ -240,6 +246,21 @@ class Coordinator:
             get_leader=self._gl.ref(),
             set_forward=self._fw.ref(),
         )
+
+    async def clear_forward(self):
+        """Rejoin service: an address named in a NEW quorum must stop
+        forwarding (the InitCoordinator path), or a reused retired member
+        would answer every election with a stale pointer — two quorums
+        pointing at each other can never elect anyone.
+
+        Safe against the boot race: _boot checks the flag AFTER loading the
+        registry from disk, so a clear issued while recovery is still in
+        flight cannot be shadowed by the stale durable FORWARD_KEY."""
+        self._forward_cleared = True
+        self.forward = None
+        self.registry[FORWARD_KEY] = (b"", ZERO_GEN, ZERO_GEN)
+        await self._persist(FORWARD_KEY)
+        self.nominee = None  # next tick renominates from live candidates
 
     async def _serve_set_forward(self):
         """Retire this coordinator: durably record the successor addresses
@@ -336,21 +357,45 @@ class Coordinator:
             self._recompute_nominee(loop.now())
 
 
+def quorum_state_key(addresses: List[str]) -> bytes:
+    """The coordinated-state register key for ONE quorum membership.
+
+    Derived from the member addresses, so OVERLAPPING old/new quorums in a
+    coordinator change use DISTINCT keys on shared members — fencing the
+    old set can never clobber the new set's manifest (the reference gets
+    the same property by generating a new cluster id in the connection
+    string on every changeQuorum, ManagementAPI.actor.cpp:684)."""
+    import zlib
+
+    blob = ",".join(addresses).encode()
+    return b"cstate:%08x" % zlib.crc32(blob)
+
+
 class CoordinatedState:
     """Quorum client over the coordinators' generation registers (ref:
-    CoordinatedState.actor.cpp).  One instance per reader/writer session."""
+    CoordinatedState.actor.cpp).  One instance per reader/writer session.
+
+    With a CoordinatorSet (and no explicit key), the register key is
+    derived from the membership via quorum_state_key — see its docstring
+    for why overlapping quorums must not share a key."""
 
     def __init__(
         self,
         process: SimProcess,
         coordinators,
-        key: bytes = b"cstate",
+        key: Optional[bytes] = None,
     ):
         self.process = process
         # Pinned at construction: a session belongs to ONE quorum; a move
         # mid-session must surface as coordinated_state_conflict, not be
         # papered over by silently retargeting.
         self.coordinators = list(_resolve_coords(coordinators))
+        if key is None:
+            key = (
+                quorum_state_key(coordinators.addresses)
+                if isinstance(coordinators, CoordinatorSet)
+                else b"cstate"
+            )
         self.key = key
         self.gen = ZERO_GEN  # this session's generation, fixed at read()
         self._read_done = False

@@ -701,9 +701,19 @@ class Proxy:
         if self.locked_uid is not None:
             from .interfaces import COMMIT_FLAG_LOCK_AWARE
 
+            # State transactions are EXEMPT here: their metadata already
+            # travelled to every proxy via the resolvers' state_mutations
+            # with committed=True — rejecting only our local copy would
+            # diverge the proxies' shard/lock maps.  They remain subject to
+            # the batch-entry check; the residual same-window race admits a
+            # rare system-keyspace commit above the lock version, applied
+            # CONSISTENTLY everywhere (user-keyspace fencing is exact).
+            state_idx = {t for t, _muts in state_txns}
             for t, ((req, _reply), status) in enumerate(zip(batch, statuses)):
-                if status == COMMITTED and not (
-                    req.flags & COMMIT_FLAG_LOCK_AWARE
+                if (
+                    status == COMMITTED
+                    and t not in state_idx
+                    and not (req.flags & COMMIT_FLAG_LOCK_AWARE)
                 ):
                     rejected_locked.add(t)
         tagged: dict = {}

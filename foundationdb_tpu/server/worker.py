@@ -281,6 +281,9 @@ class WorkerServer:
                     # not reset its registers (its promises are durable).
                     role = Coordinator(self.process, fs=self.fs)
                     self._replace_role("coordinator", role, new_tasks())
+                # Joining a quorum un-retires the member: a durable forward
+                # from an EARLIER retirement must not shadow the new role.
+                await self.roles["coordinator"].clear_forward()
                 reply.send("ok")
             elif isinstance(req, InitProxy):
                 role = Proxy(

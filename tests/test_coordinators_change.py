@@ -156,8 +156,15 @@ def test_stale_cstate_writer_fenced_after_move():
     db = c.database()
     _write(c, db, {b"x": b"1"})
 
-    # Stale session pinned to the ORIGINAL quorum, read done pre-move.
-    stale = CoordinatedState(db.process, list(c.coord_set.interfaces))
+    # Stale session pinned to the ORIGINAL quorum (same membership-derived
+    # register key the real controllers use), read done pre-move.
+    from foundationdb_tpu.server.coordination import quorum_state_key
+
+    stale = CoordinatedState(
+        db.process,
+        list(c.coord_set.interfaces),
+        key=quorum_state_key(list(c.coord_set.addresses)),
+    )
     raw = {}
 
     async def pre_read():
@@ -248,6 +255,95 @@ def test_unsatisfiable_coordinator_request_is_rejected():
     assert done.get("ok")
     assert c.acting_controller().coordinators.addresses == before
     _write(c, db, {b"post_reject": b"yes"})
+
+
+def test_overlapping_quorum_change_keeps_elections_alive():
+    """Replace ONE member (the common operation): the two STAYING members
+    must keep serving real elections — forwarding them would out-vote
+    every candidate with the forward pseudo-nominee and wedge the cluster
+    permanently (round-5 review finding)."""
+    c = DynamicCluster(seed=607, n_workers=6, n_controllers=2)
+    db = c.database()
+    _write(c, db, {b"ov%02d" % i: b"v%d" % i for i in range(5)})
+
+    keep = [p.address for p in c._coord_procs[:2]]
+    new_set = keep + [c._worker_procs[0].address]
+    c.run_all([(db, mgmt.change_coordinators(db, new_set))], timeout_vt=500.0)
+
+    def swapped():
+        try:
+            return c.acting_controller().coordinators.addresses == new_set
+        except RuntimeError:
+            return False
+
+    assert _wait_vt(c, db, swapped, timeout_vt=1200.0)
+    # Staying members must NOT be forwarding.
+    for coord in c.coordinators[:2]:
+        assert coord.forward is None, coord.process.address
+
+    # Force a fresh election on the overlapping set: the standby must win.
+    old_cc = c.acting_controller()
+    old_cc.process.kill()
+
+    def new_leader():
+        try:
+            return c.acting_controller() is not old_cc
+        except RuntimeError:
+            return False
+
+    assert _wait_vt(c, db, new_leader, timeout_vt=2000.0)
+    _write(c, db, {b"after_overlap": b"yes"})
+
+
+def test_reused_retired_address_serves_again():
+    """A member retired in an earlier change (durable forward on disk) is
+    named in a LATER quorum: rejoining must clear its forward, or two
+    quorums point at each other and nobody can ever be elected (round-5
+    review finding).  New members must be registered workers, so the
+    chain is A -> B(w0,w1,w2) -> C(w1,w2,w3) [retires w0] ->
+    D(w0,w2,w3) [reuses w0]."""
+    c = DynamicCluster(seed=608, n_workers=7, n_controllers=2)
+    db = c.database()
+    _write(c, db, {b"ru": b"1"})
+
+    w = [p.address for p in c._worker_procs]
+
+    def on(addrs):
+        def cond():
+            try:
+                return c.acting_controller().coordinators.addresses == addrs
+            except RuntimeError:
+                return False
+
+        return cond
+
+    for step, new_set in enumerate(
+        ([w[0], w[1], w[2]], [w[1], w[2], w[3]], [w[0], w[2], w[3]])
+    ):
+        c.run_all(
+            [(db, mgmt.change_coordinators(db, new_set))], timeout_vt=500.0
+        )
+        assert _wait_vt(c, db, on(new_set), timeout_vt=2000.0), step
+
+    # The reused member (w0) is live again, not forwarding.
+    w0_worker = next(
+        x for x in c.workers if x.process.address == w[0]
+    )
+    assert w0_worker.roles["coordinator"].forward is None
+    _write(c, db, {b"after_reuse": b"yes"})
+
+    # Elections still work on the final set.
+    old_cc = c.acting_controller()
+    old_cc.process.kill()
+
+    def new_leader():
+        try:
+            return c.acting_controller() is not old_cc
+        except RuntimeError:
+            return False
+
+    assert _wait_vt(c, db, new_leader, timeout_vt=2000.0)
+    _write(c, db, {b"after_reuse2": b"yes"})
 
 
 def test_setclass_prefers_stateless_workers():
