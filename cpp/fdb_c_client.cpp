@@ -567,6 +567,36 @@ void fdb_transaction_clear(FDBTransaction* tr, const uint8_t* key, int key_len) 
   tr->overlay[k] = {false, ""};
 }
 
+void fdb_transaction_atomic_op(FDBTransaction* tr, const uint8_t* key,
+                               int key_len, const uint8_t* param,
+                               int param_len, int mutation_type) {
+  std::string k(reinterpret_cast<const char*>(key), size_t(key_len));
+  std::string p(reinterpret_cast<const char*>(param), size_t(param_len));
+  tr->mutations.push_back(make_mutation(mutation_type, k, p));
+  tr->write_ranges.push_back({k, key_after(k)});
+  // The overlay cannot model server-side atomic application: drop any
+  // cached view so a later get re-reads through the server... it cannot
+  // (the op is pending).  Parity note: reads of a key with a pending
+  // atomic in THIS simplified client return the pre-op value; use the
+  // Python client for full RYW-over-atomics semantics.
+  tr->overlay.erase(k);
+}
+
+fdb_error_t fdb_transaction_on_error(FDBTransaction* tr, fdb_error_t err) {
+  switch (err) {
+    case 1020:  /* not_committed */
+    case 1021:  /* commit_unknown_result */
+    case 1007:  /* transaction_too_old */
+    case 1009:  /* future_version */
+    case 1037:  /* process_behind */
+    case 1038:  /* database_locked */
+      fdb_transaction_reset(tr);
+      return 0;
+    default:
+      return err;
+  }
+}
+
 void fdb_transaction_clear_range(FDBTransaction* tr, const uint8_t* begin,
                                  int begin_len, const uint8_t* end, int end_len) {
   std::string b(reinterpret_cast<const char*>(begin), size_t(begin_len));

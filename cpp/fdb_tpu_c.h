@@ -70,6 +70,16 @@ FDBFuture* fdb_transaction_get_range(FDBTransaction* tr,
                                      const uint8_t* begin, int begin_len,
                                      const uint8_t* end, int end_len,
                                      int limit);
+/* mutation_type: the MutationType enum value (wire_schema.h MT_*; the
+ * full set matches client/types.py MutationType). */
+void fdb_transaction_atomic_op(FDBTransaction* tr,
+                               const uint8_t* key, int key_len,
+                               const uint8_t* param, int param_len,
+                               int mutation_type);
+/* Reset-and-classify like the reference's fdb_transaction_on_error:
+ * returns 0 when the error is retryable (the transaction has been reset
+ * and may be retried), else echoes the error. */
+fdb_error_t fdb_transaction_on_error(FDBTransaction* tr, fdb_error_t err);
 FDBFuture* fdb_transaction_get_read_version(FDBTransaction* tr);
 FDBFuture* fdb_transaction_commit(FDBTransaction* tr);
 

@@ -219,6 +219,43 @@ def test_c_client_conflict_detected(server):
     c.L.fdb_database_destroy(c.db)
 
 
+def test_c_client_atomic_add_and_on_error(server):
+    """Server-side atomic ADDs through the native client, with the
+    fdb_transaction_on_error retry loop shape a C caller writes."""
+    c = CClient(_build_lib(), server)
+    c.L.fdb_transaction_atomic_op.argtypes = [
+        ctypes.c_void_p, ctypes.c_char_p, ctypes.c_int,
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    c.L.fdb_transaction_on_error.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    MT_ADD = 2
+    one = (1).to_bytes(8, "little")
+
+    def add_once():
+        tr = c.txn()
+        try:
+            while True:
+                c.L.fdb_transaction_atomic_op(
+                    tr, b"c_atomic", len(b"c_atomic"), one, len(one), MT_ADD
+                )
+                r = c.commit(tr)
+                if isinstance(r, int):
+                    return
+                from foundationdb_tpu.flow.error import error_code
+
+                rc = c.L.fdb_transaction_on_error(tr, error_code(r[1]))
+                assert rc == 0, f"non-retryable: {r[1]}"
+        finally:
+            c.L.fdb_transaction_destroy(tr)
+
+    for _ in range(10):
+        add_once()
+    tr = c.txn()
+    val = c.get(tr, b"c_atomic")
+    assert int.from_bytes(val, "little") == 10, val
+    c.L.fdb_transaction_destroy(tr)
+    c.L.fdb_database_destroy(c.db)
+
+
 def test_bindingtester_differential_vs_python_client(server):
     """Mini bindingtester: the same randomized op sequence through the C
     client and the Python client against one cluster; final range scans
