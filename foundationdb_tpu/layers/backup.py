@@ -94,6 +94,12 @@ class BackupContainer:
     async def read_log_chunk(self, index: int):
         return await self._read_blob(f"{self.path}/log-{index:06d}")
 
+    async def delete_blob(self, name: str) -> None:
+        self.fs.delete(self.process, name)
+
+    async def delete_log_chunk(self, index: int) -> None:
+        await self.delete_blob(f"{self.path}/log-{index:06d}")
+
     async def write_manifest2(self, manifest: dict):
         """Full-dict manifest writer (continuous backups update it after
         every durable log chunk so the container is restorable at any
@@ -157,6 +163,9 @@ class BlobBackupContainer(BackupContainer):
 
     async def read_manifest(self) -> Optional[dict]:
         return await self._read_blob(f"{self.path}/manifest")
+
+    async def delete_blob(self, name: str) -> None:
+        self.endpoint.delete_object(self._object_key(name))
 
 
 def open_container(path: str, fs=None, process=None):
@@ -383,6 +392,7 @@ class ContinuousBackupAgent:
     async def _write_manifest(self, begin: bytes, end: bytes, pages: int):
         self._pages = pages
         self._begin, self._end = begin, end
+        prev = await self.container.read_manifest() or {}
         await self.container.write_manifest2(
             {
                 "version": self.snapshot_version,
@@ -390,9 +400,47 @@ class ContinuousBackupAgent:
                 "begin": begin,
                 "end": end,
                 "log_chunks": self._chunks,
+                "first_log_chunk": prev.get("first_log_chunk", 0),
                 "logged_through": self.logged_through,
             }
         )
+
+    async def resnapshot(self) -> int:
+        """Fresh snapshot image at a new version (ref: fdbbackup's
+        periodic snapshots — what makes `expire` safe: log chunks wholly
+        below the NEWEST snapshot are redundant for every restorable
+        target and only then may be deleted)."""
+        while True:
+            tr = self.db.create_transaction()
+            version = await tr.get_read_version()
+            try:
+                pages = 0
+                lo = self._begin
+                while True:
+                    rows = await tr.get_range(
+                        lo, self._end, limit=PAGE_ROWS, snapshot=True
+                    )
+                    await self.container.write_page(pages, lo, rows)
+                    pages += 1
+                    if len(rows) < PAGE_ROWS:
+                        break
+                    lo = key_after(rows[-1][0])
+                break
+            except FdbError as e:
+                if e.name != "transaction_too_old":
+                    raise
+        self.snapshot_version = version
+        await self._write_manifest(self._begin, self._end, pages)
+        return version
+
+    async def expire(self) -> int:
+        """Re-snapshot, then drop every log chunk made redundant by it
+        (ref: fdbbackup expire).  Returns chunks deleted."""
+        v = await self.resnapshot()
+        # The tail must cover the new snapshot before old chunks go: a
+        # chunk straddling v still carries needed versions and is kept by
+        # expire_container's end_ver check anyway.
+        return await expire_container(self.container, v)
 
     async def tail_once(self) -> int:
         """Pull the merged stream past logged_through into one durable log
@@ -461,8 +509,11 @@ class ContinuousBackupAgent:
                 return m.param1 < uend and m.param2 > begin
             return begin <= m.param1 < uend
 
-        # Mutation-log replay in version order through the target.
-        for ci in range(manifest.get("log_chunks", 0)):
+        # Mutation-log replay in version order through the target
+        # (chunks below first_log_chunk were expired — redundant for any
+        # target the snapshot-version check above admits).
+        for ci in range(manifest.get("first_log_chunk", 0),
+                        manifest.get("log_chunks", 0)):
             chunk = await self.container.read_log_chunk(ci)
             if chunk is None:
                 raise FdbError("file_corrupt")
@@ -487,3 +538,52 @@ class ContinuousBackupAgent:
 
                 await self.db.run(apply)
         return target
+
+
+async def describe_container(container: BackupContainer) -> dict:
+    """Ref: fdbbackup `describe` — summarize restorability: the snapshot
+    version, the continuous-log tail, and the restorable window."""
+    manifest = await container.read_manifest()
+    if manifest is None:
+        return {"restorable": False}
+    out = dict(manifest)
+    out["restorable"] = True
+    out["restorable_from"] = manifest["version"]
+    out["restorable_to"] = manifest.get("logged_through", manifest["version"])
+    # First retained chunk bounds the point-in-time floor after expiry.
+    first = manifest.get("first_log_chunk", 0)
+    chunks = manifest.get("log_chunks", 0)
+    if chunks > first:
+        head = await container.read_log_chunk(first)
+        if head is not None:
+            out["oldest_logged_version"] = head[0]
+    return out
+
+
+async def expire_container(container: BackupContainer,
+                           before_version: int) -> int:
+    """Ref: fdbbackup `expire --expire-before-version` — delete log chunks
+    ENTIRELY below `before_version` (the snapshot image stays: it is the
+    restore base).  Restore targets at or above the first retained
+    chunk's begin remain valid; returns the number of chunks deleted."""
+    manifest = await container.read_manifest()
+    if manifest is None:
+        return 0
+    first = manifest.get("first_log_chunk", 0)
+    chunks = manifest.get("log_chunks", 0)
+    deleted = 0
+    i = first
+    while i < chunks:
+        chunk = await container.read_log_chunk(i)
+        if chunk is None:
+            break
+        _b, end_ver, _entries = chunk
+        if end_ver > before_version:
+            break  # this chunk still carries live versions
+        await container.delete_log_chunk(i)
+        deleted += 1
+        i += 1
+    if deleted:
+        manifest["first_log_chunk"] = i
+        await container.write_manifest2(manifest)
+    return deleted

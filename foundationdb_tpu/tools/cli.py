@@ -50,8 +50,8 @@ class CliProcessor:
         "unlock": "unlock [uid] — release the database lock",
         "setclass": "setclass <address> <class> — recruitment class "
         "(stateless|transaction|storage|unset)",
-        "backup": "backup <start|status|restore> <path> [version] — "
-        "continuous backup driver (fdbbackup analog)",
+        "backup": "backup <start|status|restore|describe|expire> <path> "
+        "[version] — continuous backup driver (fdbbackup analog)",
         "dr": "dr <start|status> — replicate into the destination cluster "
         "(fdbdr analog; requires a destination)",
         "help": "help — this text",
@@ -144,6 +144,37 @@ class CliProcessor:
             if agent is None:
                 return [f"No backup to `{path}'"]
             return await self._backup_restore(agent, path, args)
+        if sub == "describe":
+            # Ref: fdbbackup describe.
+            from ..layers.backup import describe_container
+
+            container = (
+                agent.container if agent is not None
+                else open_container(
+                    path,
+                    getattr(self.cluster, "fs", None),
+                    self.cluster.net.process(f"bk:{path}"),
+                )
+            )
+            d = await describe_container(container)
+            if not d.get("restorable"):
+                return [f"`{path}': not restorable (no manifest)"]
+            return [
+                f"`{path}': restorable [{d['restorable_from']}, "
+                f"{d['restorable_to']}], snapshot {d['version']} "
+                f"({d['pages']} pages), log chunks "
+                f"{d.get('first_log_chunk', 0)}..{d.get('log_chunks', 0)}"
+            ]
+        if sub == "expire":
+            # Ref: fdbbackup expire — re-snapshot, then drop redundant
+            # log chunks.
+            if agent is None:
+                return [f"No backup to `{path}'"]
+            deleted = await agent.expire()
+            return [
+                f"Expired {deleted} log chunk(s); new snapshot at "
+                f"{agent.snapshot_version}"
+            ]
         return [f"ERROR: unknown backup subcommand `{sub}'"]
 
     async def _backup_restore(self, agent, path, args):
