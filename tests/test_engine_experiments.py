@@ -1,0 +1,76 @@
+"""The perf-experiment flags must stay decision-identical.
+
+FDB_TPU_SEARCH / FDB_TPU_EVICT_EVERY are read at import, so each flag
+combination runs its differential (device engine vs CPU oracle) in a
+fresh subprocess.  A regression in either experimental path fails here
+before it can corrupt an A/B measurement on hardware.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+from conftest import REPO_ROOT
+
+DIFF = r"""
+import os, sys
+sys.path.insert(0, %(repo)r)
+os.environ["JAX_PLATFORMS"] = "cpu"
+import jax
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+from foundationdb_tpu.conflict.engine_jax import JaxConflictSet
+from foundationdb_tpu.conflict.types import TransactionConflictInfo
+
+rng = np.random.default_rng(17)
+
+def txn(now):
+    def rr():
+        a = int(rng.integers(0, 3000))
+        b = a + 1 + int(rng.integers(0, 25))
+        return (a.to_bytes(4, "big"), b.to_bytes(4, "big"))
+    return TransactionConflictInfo(
+        read_snapshot=now - int(rng.integers(0, 40)),
+        read_ranges=[rr() for _ in range(int(rng.integers(0, 3)))],
+        write_ranges=[rr() for _ in range(int(rng.integers(0, 3)))],
+    )
+
+cpu, dev = CpuConflictSet(), JaxConflictSet(
+    key_words=2, h_cap=1 << 17, bucket_mins=(64, 128, 128)
+)
+now = 100
+for batch in range(10):
+    txns = [txn(now) for _ in range(int(rng.integers(5, 40)))]
+    now += int(rng.integers(1, 25))
+    oldest = max(0, now - 90)
+    got = dev.detect(txns, now=now, new_oldest_version=oldest)
+    want = cpu.detect(txns, now=now, new_oldest_version=oldest)
+    assert got == want, (batch, got, want)
+print("OK")
+"""
+
+
+@pytest.mark.parametrize(
+    "flags",
+    [
+        {"FDB_TPU_SEARCH": "2level"},
+        {"FDB_TPU_EVICT_EVERY": "3"},
+        {"FDB_TPU_SEARCH": "2level", "FDB_TPU_EVICT_EVERY": "3"},
+    ],
+    ids=["2level", "evict3", "both"],
+)
+def test_experiment_flags_decision_identical(flags):
+    env = dict(os.environ)
+    env.update(flags)
+    env["PYTHONPATH"] = REPO_ROOT
+    env["JAX_PLATFORMS"] = "cpu"
+    res = subprocess.run(
+        [sys.executable, "-c", DIFF % {"repo": REPO_ROOT}],
+        env=env, capture_output=True, text=True, timeout=600, cwd=REPO_ROOT,
+    )
+    assert res.returncode == 0 and "OK" in res.stdout, (
+        res.stdout[-500:] + res.stderr[-1500:]
+    )
