@@ -59,7 +59,7 @@ import os as _os
 #             compiler to keep on-chip, so only the fine log2(stride)
 #             steps gather from the full HBM-resident table.
 SEARCH_MODE = _os.environ.get("FDB_TPU_SEARCH", "")
-SAMPLE_STRIDE = 512
+SAMPLE_STRIDE = int(_os.environ.get("FDB_TPU_SEARCH_STRIDE", "512"))
 _2LEVEL_MIN = 1 << 16  # below this a flat search wins (coarse build cost)
 
 

@@ -45,6 +45,15 @@ VARIANTS = [
         {"FDB_TPU_SEARCH": "2level", "FDB_TPU_EVICT_EVERY": "4"},
         3407872 + 3 * 2 * 65536,
     ),
+    (
+        "both_evict8_stride1k",
+        {
+            "FDB_TPU_SEARCH": "2level",
+            "FDB_TPU_SEARCH_STRIDE": "1024",
+            "FDB_TPU_EVICT_EVERY": "8",
+        },
+        3407872 + 7 * 2 * 65536,
+    ),
 ]
 
 
