@@ -49,14 +49,24 @@ class DRAgent:
         self._cursor = None  # MergePeekCursor, (re)built on tag changes
         self.stopped = False
 
-    async def start(self) -> int:
+    async def start(self, skip_snapshot_from: Optional[int] = None) -> int:
         """Register the consumer floor, then copy the initial snapshot.
         Registration happens FIRST so nothing the snapshot misses can be
         discarded before tailing begins (ref: the backup range lock before
-        the initial snapshot)."""
+        the initial snapshot).
+
+        skip_snapshot_from=V skips the copy entirely: the caller certifies
+        the destination ALREADY equals the source as of source-version V
+        (the atomic-switchover contract — both sides locked and drained;
+        ref: atomicSwitchover avoiding a recopy)."""
         proc = self.src_db.process
         await self._pop_all(0)
         await self._refresh_tags()
+        if skip_snapshot_from is not None:
+            self.applied = skip_snapshot_from
+            await self._mark_applied(skip_snapshot_from, state=b"tailing")
+            await self._pop_all(skip_snapshot_from)
+            return skip_snapshot_from
         # Resume: a previous incarnation that finished its snapshot left
         # applied/state markers, and its pop floor is PERSISTED on the
         # source logs, so the stream since then is still retained — tail
@@ -70,6 +80,7 @@ class DRAgent:
         # snapshot restarts fresh, same discipline as the file backup).
         while True:
             tr = self.src_db.create_transaction()
+            tr.options["lock_aware"] = True
             version = await tr.get_read_version()
             try:
                 await self._copy_snapshot(tr, version)
@@ -92,6 +103,7 @@ class DRAgent:
     async def _read_progress(self) -> Optional[int]:
         async def txn(tr):
             tr.options["access_system_keys"] = True
+            tr.options["lock_aware"] = True
             state = await tr.get(DR_STATE_KEY)
             raw = await tr.get(DR_APPLIED_KEY)
             if state == b"tailing" and raw is not None:
@@ -103,6 +115,7 @@ class DRAgent:
     async def _mark_applied(self, version: int, state: bytes = None):
         async def txn(tr):
             tr.options["access_system_keys"] = True
+            tr.options["lock_aware"] = True
             tr.set(DR_APPLIED_KEY, b"%d" % version)
             if state is not None:
                 tr.set(DR_STATE_KEY, state)
@@ -117,6 +130,7 @@ class DRAgent:
 
         async def txn(tr):
             tr.options["access_system_keys"] = True
+            tr.options["lock_aware"] = True
             rows = await tr.get_range(
                 sk.SERVER_LIST_PREFIX, sk.SERVER_LIST_END
             )
@@ -212,6 +226,7 @@ class DRAgent:
                 # reply (commit_unknown_result) re-reads the progress
                 # marker and no-ops if this version already applied.
                 d.options["access_system_keys"] = True
+                d.options["lock_aware"] = True
                 raw = await d.get(DR_APPLIED_KEY)
                 if raw is not None and int(raw) >= version:
                     return
@@ -257,13 +272,86 @@ class DRAgent:
     async def run(self, poll: float = 0.02, tag_refresh: float = 1.0):
         loop = self.src_db.process.network.loop
         last_refresh = -1e18
-        while not self.stopped:
-            if loop.now() - last_refresh > tag_refresh:
-                await self._refresh_tags()
-                last_refresh = loop.now()
-            n = await self.tail_once()
-            if n == 0:
-                await loop.delay(poll)
+        self._running = True
+        try:
+            while not self.stopped:
+                if loop.now() - last_refresh > tag_refresh:
+                    await self._refresh_tags()
+                    last_refresh = loop.now()
+                n = await self.tail_once()
+                if n == 0:
+                    await loop.delay(poll)
+        finally:
+            self._running = False
+
+    async def switchover(self, reverse_tlogs: List) -> "DRAgent":
+        """fdbdr switch (ref: DatabaseBackupAgent::atomicSwitchover):
+
+          1. lock the SOURCE (no new primary writes),
+          2. lock the DESTINATION (freeze it while direction flips),
+          3. drain the remaining stream — the two databases are now equal,
+          4. start the REVERSE agent with NO recopy (skip_snapshot_from at
+             the frozen destination's version),
+          5. unlock the destination: it is the new primary; the old
+             primary STAYS locked as the replica (the reference keeps DR
+             destinations locked; every agent transaction is lock-aware).
+
+        Returns the running-direction-reversed agent; this agent stops."""
+        from ..client.management import lock_database, unlock_database
+
+        loop = self.src_db.process.network.loop
+        self.stopped = True
+        # WAIT for the spawned run() loop to actually exit: a tail_once
+        # in flight there shares this cursor — racing it could adopt a
+        # horizon past a version whose mutations the other coroutine is
+        # still holding, silently dropping them right before we certify
+        # equality.
+        while getattr(self, "_running", False):
+            await loop.delay(0.01)
+
+        src_uid = await lock_database(self.src_db)
+        self.switch_lock_uid = src_uid
+        dst_uid = None
+        try:
+            tr = self.src_db.create_transaction()
+            tr.options["lock_aware"] = True
+            final_v = await tr.get_read_version()
+            dst_uid = await lock_database(self.dst_db)
+            while self.applied < final_v:
+                n = await self.tail_once()
+                if n == 0:
+                    await loop.delay(0.02)
+
+            rev = DRAgent(
+                self.dst_db, self.src_db, reverse_tlogs,
+                tag=self.tag + "_rev",
+            )
+            tr2 = self.dst_db.create_transaction()
+            tr2.options["lock_aware"] = True
+            dest_v = await tr2.get_read_version()
+            await rev.start(skip_snapshot_from=dest_v)
+        except BaseException:
+            # Unwind: the primary must not stay locked behind a failed
+            # switch (the caller may restart run() and retry later).
+            try:
+                if dst_uid is not None:
+                    await unlock_database(self.dst_db, dst_uid)
+            finally:
+                await unlock_database(self.src_db, src_uid)
+                self.stopped = False
+            raise
+        # Release the forward consumer tag: its pop floor is frozen at the
+        # drained version and would otherwise retain every post-switch
+        # mutation on the old primary's logs forever.
+        for tl in self.tlogs:
+            await tl.pop.get_reply(
+                self.src_db.process,
+                TLogPopRequest(
+                    version=self.applied, tag=self.tag, unregister=True
+                ),
+            )
+        await unlock_database(self.dst_db, dst_uid)
+        return rev
 
     def set_storage_tags(self, tags: List[str]):
         """Manual override for tests; run() refreshes from serverList."""

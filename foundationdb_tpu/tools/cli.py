@@ -52,17 +52,20 @@ class CliProcessor:
         "(stateless|transaction|storage|unset)",
         "backup": "backup <start|status|restore|describe|expire> <path> "
         "[version] — continuous backup driver (fdbbackup analog)",
-        "dr": "dr <start|status> — replicate into the destination cluster "
-        "(fdbdr analog; requires a destination)",
+        "dr": "dr <start|status|switch> — replicate into the destination "
+        "cluster; switch reverses the roles (fdbdr analog)",
         "help": "help — this text",
     }
 
-    def __init__(self, cluster, db, dst_db=None):
+    def __init__(self, cluster, db, dst_db=None, dst_cluster=None):
         self.cluster = cluster
         self.db = db
         # Destination database for `dr` commands (the fdbdr tool takes two
-        # cluster files; the shell takes two database handles).
+        # cluster files; the shell takes two database handles).  The
+        # destination CLUSTER handle enables `dr switch` (the reverse
+        # agent needs the destination's logs).
         self.dst_db = dst_db
+        self.dst_cluster = dst_cluster
         self.write_mode = False
         self._tr = None  # explicit transaction, between begin/commit
         self._backups: dict = {}  # path -> ContinuousBackupAgent
@@ -219,6 +222,26 @@ class CliProcessor:
             return [
                 f"DR: tailing, destination reflects source version "
                 f"{self._dr_agent.applied}"
+            ]
+        if sub == "switch":
+            # Ref: fdbdr switch -> atomicSwitchover.
+            if self._dr_agent is None:
+                return ["ERROR: no DR running to switch"]
+            if self.dst_cluster is None:
+                return ["ERROR: switch needs the destination cluster handle"]
+            try:
+                rev = await self._dr_agent.switchover(
+                    [t.interface() for t in self.dst_cluster.tlogs]
+                )
+            except FdbError as e:
+                # switchover unwound its locks; resume forward replication.
+                self.db.process.spawn(self._dr_agent.run(), "dr_agent")
+                return [f"ERROR: switch failed ({e.name}); DR resumed"]
+            self.db.process.spawn(rev.run(), "dr_agent_rev")
+            self._dr_agent = rev
+            return [
+                "Switched: destination is now primary; old primary locked "
+                "as its replica"
             ]
         return [f"ERROR: unknown dr subcommand `{sub}'"]
 

@@ -329,3 +329,44 @@ def test_cli_backup_describe_and_expire_preserves_pitr():
     assert c.run_until(
         db.process.spawn(scenario(), "sc"), timeout_vt=30000.0
     )
+
+
+def test_cli_dr_switch():
+    """dr switch through the CLI: roles reverse, new-primary writes flow
+    back to the locked old primary (fdbdr switch analog)."""
+    from foundationdb_tpu.server import SimCluster
+
+    src = SimCluster(seed=78)
+    dst = SimCluster(seed=79, loop=src.loop, buggify=False)
+    sdb, ddb = src.database("sw_src"), dst.database("sw_dst")
+    cli = CliProcessor(src, sdb, dst_db=ddb, dst_cluster=dst)
+    cli.write_mode = True
+
+    async def scenario():
+        await cli.run_command("set pre 1")
+        out = await cli.run_command("dr start")
+        assert out[0].startswith("DR started"), out
+        await src.loop.delay(0.5)
+        out = await cli.run_command("dr switch")
+        assert out[0].startswith("Switched"), out
+
+        # Writes now go to the NEW primary and flow back to the old one.
+        tr = ddb.create_transaction()
+        tr.set(b"after_switch", b"yes")
+        await tr.commit()
+        for _ in range(200):
+            got = {}
+
+            async def check(t):
+                t.options["lock_aware"] = True
+                got["v"] = await t.get(b"after_switch")
+
+            await sdb.run(check)
+            if got["v"] == b"yes":
+                return True
+            await src.loop.delay(0.05)
+        return False
+
+    assert src.run_until(
+        sdb.process.spawn(scenario(), "sc"), timeout_vt=30000.0
+    )

@@ -201,3 +201,131 @@ def test_dr_follows_sharded_source():
     assert rows_b.get(b"s005") == b"updated"
     assert rows_b.get(b"s030") == b"updated2"
     assert sum(1 for k in rows_b if k.startswith(b"s0")) == 40
+
+
+def test_dr_atomic_switchover():
+    """fdbdr switch (DatabaseBackupAgent::atomicSwitchover): the roles
+    reverse with no recopy — the old primary is left locked as the
+    replica of the new one, new-primary writes flow back, nothing is
+    lost, and plain writes to the old primary fail database_locked."""
+    from foundationdb_tpu.flow.error import FdbError
+
+    loop, a, b = two_clusters(175)
+    src, dst = a.database(), b.database()
+
+    async def fill(tr):
+        for i in range(25):
+            tr.set(b"sw%03d" % i, b"v%d" % i)
+
+    a.run_all([(src, src.run(fill))])
+
+    agent = DRAgent(src, dst, [t.interface() for t in a.tlogs])
+    out = {}
+
+    async def drive():
+        await agent.start()
+        # Tail a bit, then some fresh source writes that must drain
+        # during the switch.
+        tr = src.create_transaction()
+        for i in range(25, 35):
+            tr.set(b"sw%03d" % i, b"late%d" % i)
+        await tr.commit()
+
+        rev = await agent.switchover([t.interface() for t in b.tlogs])
+        out["rev"] = rev
+
+        # Old primary is locked: a plain write fails.
+        tr2 = src.create_transaction()
+        tr2.set(b"stray", b"x")
+        try:
+            await tr2.commit()
+            out["stray"] = "accepted"
+        except FdbError as e:
+            out["stray"] = e.name
+
+        # New-primary writes replicate BACK to the old primary.
+        tr3 = dst.create_transaction()
+        for i in range(3):
+            tr3.set(b"post%02d" % i, b"p%d" % i)
+        await tr3.commit()
+        for _ in range(200):
+            n = await out["rev"].tail_once()
+            done = {}
+
+            async def check(tr):
+                tr.options["lock_aware"] = True
+                done["v"] = await tr.get(b"post02")
+
+            await src.run(check)
+            if done["v"] == b"p2":
+                break
+            await loop.delay(0.05)
+        out["replicated"] = done["v"]
+        return True
+
+    a.run_until(src.process.spawn(drive()), timeout_vt=30000.0)
+    assert out["stray"] == "database_locked"
+    assert out["replicated"] == b"p2"
+
+    # Full-content equality through lock-aware reads: everything the old
+    # primary ever committed + the new primary's writes, on BOTH sides.
+    rows_new = dict(read_all(b, dst))
+    got = {}
+
+    async def scan_old(tr):
+        tr.options["lock_aware"] = True
+        got["rows"] = dict(await tr.get_range(b"", b"\xff", limit=1 << 20))
+
+    a.run_all([(src, src.run(scan_old))])
+    rows_old = got["rows"]
+    user_new = {k: v for k, v in rows_new.items() if not k.startswith(b"\xff")}
+    user_old = {k: v for k, v in rows_old.items() if not k.startswith(b"\xff")}
+    assert user_new == user_old
+    assert user_new[b"sw034"] == b"late34" and user_new[b"post00"] == b"p0"
+
+
+def test_dr_switchover_unwinds_on_locked_destination():
+    """A destination already locked by someone else aborts the switch;
+    the unwind must leave the SOURCE unlocked and replication resumable."""
+    from foundationdb_tpu.client.management import lock_database
+    from foundationdb_tpu.flow.error import FdbError
+
+    loop, a, b = two_clusters(176)
+    src, dst = a.database(), b.database()
+
+    async def fill(tr):
+        tr.set(b"uw", b"1")
+
+    a.run_all([(src, src.run(fill))])
+    agent = DRAgent(src, dst, [t.interface() for t in a.tlogs])
+    out = {}
+
+    async def drive():
+        await agent.start()
+        await lock_database(dst, uid=b"someone-else")
+        try:
+            await agent.switchover([t.interface() for t in b.tlogs])
+            out["switch"] = "succeeded"
+        except FdbError as e:
+            out["switch"] = e.name
+        # Source must be WRITABLE again (unwound), and tailing resumable.
+        tr = src.create_transaction()
+        tr.set(b"post_unwind", b"yes")
+        await tr.commit()
+        for _ in range(100):
+            await agent.tail_once()
+            got = {}
+
+            async def check(t):
+                t.options["lock_aware"] = True
+                got["v"] = await t.get(b"post_unwind")
+
+            await dst.run(check)
+            if got["v"] == b"yes":
+                return True
+            await loop.delay(0.05)
+        return False
+
+    assert a.run_until(src.process.spawn(drive()), timeout_vt=30000.0)
+    assert out["switch"] == "database_locked"
+    assert agent.stopped is False
