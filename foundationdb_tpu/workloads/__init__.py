@@ -14,6 +14,7 @@ from .atomic_ops import AtomicOpsWorkload
 from .serializability import SerializabilityWorkload
 from .versionstamp import VersionStampWorkload
 from .configure_db import ConfigureDatabaseWorkload
+from .backup_correctness import BackupCorrectnessWorkload
 from .lock_database import LockDatabaseWorkload
 from .storefront import StorefrontWorkload
 from .unreadable import UnreadableWorkload
@@ -42,6 +43,7 @@ __all__ = [
     "SerializabilityWorkload",
     "VersionStampWorkload",
     "ConfigureDatabaseWorkload",
+    "BackupCorrectnessWorkload",
     "LockDatabaseWorkload",
     "StorefrontWorkload",
     "UnreadableWorkload",

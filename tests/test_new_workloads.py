@@ -217,3 +217,25 @@ def test_targeted_kill_each_role(role):
         ],
         timeout_vt=30000.0,
     )
+
+
+@pytest.mark.parametrize("seed", [580, 581])
+def test_backup_correctness_under_chaos(seed):
+    """Continuous backup tailing through clogging + live traffic; the
+    restored image must equal the live database byte for byte
+    (BackupAndRestoreCorrectness.actor.cpp)."""
+    from foundationdb_tpu.workloads import BackupCorrectnessWorkload
+
+    c = SimCluster(seed=seed, n_proxies=2, n_tlogs=1)
+    wl = BackupCorrectnessWorkload(duration=1.5)
+    run_workloads(
+        c,
+        [
+            wl,
+            CycleWorkload(nodes=5, ops=12, actors=2),
+            RandomCloggingWorkload(duration=1.5),
+        ],
+        timeout_vt=30000.0,
+        quiet=True,
+    )
+    assert wl.restored_rows > 0
