@@ -475,11 +475,18 @@ class BTreeKeyValueStore:
             self._root = await self._flush(self._root)
         await self._file.sync()  # data pages durable before the header
         self._gen += 1
-        # Pages freed building this generation become allocatable only once
-        # the new header is durable — i.e. for the NEXT commit.
-        freed, self._freed_this = self._freed_this, []
+        # Pages freed building this generation go INTO the new header's
+        # free list: once that header is durable they are genuinely
+        # unreferenced, and a crash BEFORE it recovers the old header
+        # (which still references them and never saw this free list).
+        # Extending the in-memory list here is safe — no allocation happens
+        # between this point and the header write — and deferring it past
+        # _write_header (the old ordering) permanently leaked every
+        # commit's COW'd working set on each crash: the pages were in
+        # neither the tree, nor the durable free list, nor `leaked`.
+        self._free.extend(self._freed_this)
+        self._freed_this = []
         await self._write_header()
-        self._free.extend(freed)
 
     async def _flush(self, node: _Node) -> int:
         if not node.leaf:

@@ -28,7 +28,11 @@ class MergePeekCursor:
                through end_version;
       end_version: the merged known-complete horizon (min over logs) —
                versions <= it carrying none of the tags simply don't
-               appear.  A log that answers peek_below_begin or dies makes
+               appear.  A member whose floor is above the merge begin (a
+               FRESH replacement log) serves from its floor
+               (allow_below_begin) — the range below it comes from the
+               replicas that still hold it, instead of the whole merge
+               wedging on peek_below_begin forever.  A log that DIES makes
                the cursor raise; the caller re-resolves topology (ref:
                the cursor invalidation on epoch end)."""
 
@@ -48,6 +52,13 @@ class MergePeekCursor:
         # Per-log buffered entries + per-log scanned horizon.
         self._buf: List[Dict[int, dict]] = [{} for _ in self.logs]
         self._horizon: List[int] = [begin for _ in self.logs]
+        # Start of each log's CURRENT contiguous coverage segment (the
+        # segment ends at _horizon[i]).  Each pull resumes from
+        # _horizon[i], so segments normally chain; a pull whose
+        # served_from jumps ABOVE the prior horizon (the log's floor
+        # popped past what it had scanned) leaves a hole, and the segment
+        # start resets to that served_from.  None until the first answer.
+        self._covered_from: List[Optional[int]] = [None for _ in self.logs]
         self.known_committed = 0
 
     async def next_batch(self) -> Tuple[list, int]:
@@ -65,11 +76,17 @@ class MergePeekCursor:
                     tags=self.tags,
                     limit_versions=self.limit,
                     raw_tagged=True,
+                    allow_below_begin=True,
                 ),
             )
             for version, bundle in rep.entries:
                 if version > self.begin:
                     self._buf[i][version] = bundle
+            if (
+                self._covered_from[i] is None
+                or rep.served_from > self._horizon[i]
+            ):
+                self._covered_from[i] = rep.served_from
             self._horizon[i] = max(self._horizon[i], rep.end_version)
             self.known_committed = max(
                 self.known_committed, rep.known_committed
@@ -78,6 +95,18 @@ class MergePeekCursor:
         await wait_for_all(
             [self.process.spawn(pull(i), f"merge_pull{i}") for i in range(len(self.logs))]
         )
+        if self.logs and not self._coverage_ok():
+            # Some tag's ENTIRE replica slot has coverage starting above
+            # the merge begin: a range at/above begin is held by nobody
+            # who could have that tag's data — advancing would silently
+            # skip mutations.  Raise like the single-log peek_below_begin
+            # so the caller re-resolves topology (a replica elsewhere, or
+            # a restore point) instead of emitting a gapped stream.
+            # Long-lived consumers (backup/DR) prevent this case outright
+            # by registering pop floors on every log; it remains reachable
+            # when a recovery replaces logs (fresh begin_version) while a
+            # cursor still needs the older range.
+            raise FdbError("peek_below_begin")
         horizon = min(self._horizon)
         merged: Dict[int, Dict[str, list]] = {}
         for buf in self._buf:
@@ -90,6 +119,37 @@ class MergePeekCursor:
         if horizon > self.begin:
             self.begin = horizon
         return entries, self.begin
+
+    def _coverage_ok(self) -> bool:
+        """Is every tag's range from self.begin held by at least one
+        member that could hold that tag?
+
+        Coverage is TAG-AWARE: non-broadcast tags live on only `rf`
+        consecutive ring members (log_system.tlogs_for_tag), so one log
+        covering begin for unrelated tags must not mask a hole in another
+        tag's whole replica slot.  With explicit tags the slots are
+        computed exactly; with tags=None (full stream) the tag universe
+        is unknown, so EVERY rf-window of the ring must contain a
+        covering member (any tag lives in some window).  Conservative
+        where the member list's ring order or satellite count is unknown
+        — a spurious raise is loud, a missed gap is silent loss."""
+        from ..flow.knobs import g_knobs
+        from ..server.log_system import tlogs_for_tag
+
+        covers = [
+            c is not None and c <= self.begin for c in self._covered_from
+        ]
+        if all(covers):
+            return True
+        n = len(self.logs)
+        if self.tags is None:
+            rf = min(g_knobs.server.log_replication_factor, n)
+            windows = [
+                [(s + r) % n for r in range(rf)] for s in range(n)
+            ]
+        else:
+            windows = [tlogs_for_tag(t, n) for t in self.tags]
+        return all(any(covers[i] for i in w) for w in windows)
 
     @staticmethod
     def flatten(bundle: Dict[str, list]) -> list:

@@ -253,6 +253,11 @@ class ClusterController:
 
         # LOCKING: stop every surviving old-generation tlog, learn durable
         # ends (a None slot is a replica declared lost after the grace).
+        # A lock that does NOT ack on a live replica FAILS the recovery:
+        # proceeding with that log unlocked would let the old generation
+        # keep acking commits that the epoch cut below then truncates —
+        # acked-data loss (observed risk: CC failover while the old
+        # generation is healthy + a transient partition of one lock reply).
         epoch_end = prev["epoch_end"]
         for w in tlog_ws:
             if w is None:
@@ -260,6 +265,10 @@ class ClusterController:
             lock = await self._try(
                 w.init_role.get_reply(self.process, LockTLog())
             )
+            if lock is None or isinstance(lock, FdbError):
+                raise FdbError("master_tlog_failed")  # _run retries
+            # "no_tlog": live worker, no role installed — its disk is
+            # quiescent; the later InitTLog(recover_from_disk) owns it.
             if isinstance(lock, int):
                 epoch_end = max(epoch_end, lock)
 
@@ -520,12 +529,49 @@ class ClusterController:
             )
 
         entries = []
+        uncovered = []
         for sb, se in segs:
             team = sorted(
                 sid for sid, rs in owned_by.items() if covers(rs, sb)
             )
             if team:
                 entries.append((sb, se, team))
+            elif sb < b"\xff\xff":
+                uncovered.append((sb, se))
+        if uncovered and prev.get("storage_addrs"):
+            # A previously-owned segment with NO surviving replica: the
+            # per-machine loss bound in _wait_workers cannot see per-shard
+            # team membership, so a loss pattern can slip past it.
+            # Proceeding would silently drop the range from the routing
+            # map (acked data unreachable); failing keeps recovery waiting
+            # for the machines, the correct behavior (ref: recovery
+            # waiting on full logs/teams).  A FRESH cluster (no prior
+            # storage_addrs) legitimately has no coverage yet.  This
+            # includes TOTAL loss (entries empty, e.g. every returning
+            # storage lost its data files) — serving an empty map there
+            # would present acked data as an empty database.
+            TraceEvent("RecoveryUncoveredShards", severity=30).detail(
+                "segments", [(b, e) for b, e in uncovered[:8]]
+            ).log()
+            raise FdbError("master_recovery_failed")
+
+        # Tags of storages NOT in this generation (declared lost after the
+        # grace) are unregistered from the logs: a dead consumer's frozen
+        # pop floor would wedge _trim's min-floor and retain every later
+        # entry on disk forever.  A revived storage re-registers on its
+        # next pop; its data gap is DD-heal's business (the same discipline
+        # as exclusion-driven unregistration in dd_role).
+        for dead_sid in sorted(set(server_list) - set(owned_by)):
+            for tlog_if in tlog_ifs:
+                if tlog_if is None:
+                    continue
+                await self._try(
+                    tlog_if.pop.get_reply(
+                        self.process,
+                        TLogPopRequest(tag=dead_sid, unregister=True),
+                    ),
+                    timeout=2.0,
+                )
 
         # Database lock state must survive the generation change: read
         # `\xff/dbLocked` from a storage owning it and inject it with the

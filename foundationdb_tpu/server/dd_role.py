@@ -347,7 +347,17 @@ class DataDistributionRole:
                 test_probe("dd_exclusion_observed")
                 TraceEvent("DDExclusionObserved").detail("id", sid).log()
             self.excluded = now_excluded
-            unregistered &= now_excluded  # re-included: registration is live
+            # Targets: excluded servers AND probe-declared-dead ones.  The
+            # CC unregisters dead tags once at recovery, but that send is
+            # best-effort (a dropped reply would otherwise pin one tlog's
+            # trim floor until an unrelated recovery); this loop is the
+            # convergent owner.  A server dropped from both sets (healthy
+            # again / re-included) leaves `unregistered` so a LATER death
+            # re-unregisters it — re-sending is idempotent, and a revived
+            # storage re-registers itself on its next pop.
+            dead = {s for s in self.failed if s in self.dd.storages}
+            targets = now_excluded | dead
+            unregistered &= targets
             # Unregister a tag only AFTER the team tracker finished draining
             # the server out of the shard map (ref: removeStorageServer at
             # exclusion completion, not observation — unregistering a
@@ -355,7 +365,7 @@ class DataDistributionRole:
             # not applied).  Convergent: retried every round until every
             # tlog acked, so an unreachable tlog can't permanently pin its
             # discard floor on the excluded server's persisted pop floor.
-            pending = sorted(now_excluded - unregistered)
+            pending = sorted(targets - unregistered)
             if pending:
                 try:
                     shard_map = await self.dd.read_shard_map()

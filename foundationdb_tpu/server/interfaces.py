@@ -204,6 +204,13 @@ class TLogPeekRequest:
     lets merge cursors dedupe across replicas by (tag, seq)."""
 
     begin_version: int = 0
+    # Merge-cursor mode: instead of erroring peek_below_begin, serve from
+    # this log's own floor and report it in `served_from` — a FRESH
+    # replacement log (begin = recovery version) holds nothing below by
+    # construction; surviving replicas cover that range, so a merge over
+    # the set must not wedge on the one log that cannot answer (ref: the
+    # best-effort member handling in MergedPeekCursor).
+    allow_below_begin: bool = False
     tags: Optional[List[str]] = field(
         default_factory=lambda: [TAG_DEFAULT, TAG_ALL]
     )
@@ -217,6 +224,9 @@ class TLogPeekReply:
     end_version: int = 0  # exclusive: peeked everything below this
     known_committed: int = 0  # fully-acked watermark (see TLogCommitRequest)
     has_more: bool = False
+    # With allow_below_begin: the effective begin actually served (> the
+    # request's begin_version when this log's floor is above it).
+    served_from: int = 0
 
 
 @dataclass

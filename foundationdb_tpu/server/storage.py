@@ -444,9 +444,13 @@ class StorageServer:
         """Reopen the base engine and resume pulling from its durable
         version (ref: storageServer rollback/restart recovery).  Ownership
         is restored from the durable meta record; keyServers mutations in
-        the replayed log tail re-apply any later changes.  A move that was
-        in flight at the crash is simply absent (AddingShards are not
-        durable) — DD observes "missing" shard state and restarts it.
+        the replayed log tail re-apply any later changes.  A move still
+        FETCHING at the crash is absent after recovery — DD observes
+        "missing" shard state and restarts it.  A move that reached READY
+        is durable (persisted with the fetched rows in one commit by
+        _finish_fetch) and is restored as a READY AddingShard: the source
+        may already have settled and dropped its copy, so re-fetching is
+        not an option (the round-5 write-through fix).
 
         engine: "memory" (WAL+snapshot RAM map, KeyValueStoreMemory.
         actor.cpp analog) or "btree" (COW B+tree, the ssd-class engine —
@@ -665,6 +669,14 @@ class StorageServer:
             - g_knobs.server.max_write_transaction_life_versions,
         )
         if new_durable <= self.durable_version:
+            # No fold progress, but OWNERSHIP changes must not wait for
+            # the version window to advance: a crash after a shard
+            # handoff (fetch WRITE-THROUGH already made the data durable)
+            # would otherwise recover a server whose durable meta never
+            # claimed the shard — unreachable data (round-5 review).
+            if self._meta_dirty:
+                self._persist_meta_locked()
+                await self.kvstore.commit()
             return
         self.durable_version = new_durable
         ops = []
@@ -684,21 +696,26 @@ class StorageServer:
                 self.kvstore.clear_range(a, b)
         self.kvstore.set(VERSION_META_KEY, b"%d" % new_durable)
         if self._meta_dirty:
-            self._meta_dirty = False
-            ready = {
-                id(a): a for _b, _e, a in self.adding.items()
-                if a and a.phase == AddingShard.READY
-            }
-            meta = (
-                [(b, e, v) for b, e, v in self.owned.items()],
-                [(b, e, v) for b, e, v in self.avail.items()],
-                dict(self.server_list),
-                [(a.begin, a.end, a.fetch_version) for a in ready.values()],
-            )
-            self.kvstore.set(OWNED_META_KEY, pickle.dumps(meta, protocol=4))
+            self._persist_meta_locked()
         await self.kvstore.commit()
         self.store.trim(new_durable)
         self._pop_all(new_durable)
+
+    def _persist_meta_locked(self):
+        """Serialize ownership/avail/serverList/READY-shard meta into the
+        engine's write buffer (caller commits)."""
+        self._meta_dirty = False
+        ready = {
+            id(a): a for _b, _e, a in self.adding.items()
+            if a and a.phase == AddingShard.READY
+        }
+        meta = (
+            [(b, e, v) for b, e, v in self.owned.items()],
+            [(b, e, v) for b, e, v in self.avail.items()],
+            dict(self.server_list),
+            [(a.begin, a.end, a.fetch_version) for a in ready.values()],
+        )
+        self.kvstore.set(OWNED_META_KEY, pickle.dumps(meta, protocol=4))
 
     @property
     def queue_bytes(self) -> int:
@@ -806,6 +823,12 @@ class StorageServer:
         reads until the settling record; a destination that lacks the data
         starts an AddingShard fetch (ref: startMoveKeys writing dest into
         keyServers, MoveKeys.actor.cpp)."""
+        if end is None:
+            # The CC seeds the tail keyServers record open-ended; every
+            # byte-comparison downstream (clear_range, fetch paging, the
+            # byte sample) needs a concrete bound or a move of the TAIL
+            # shard dies in a TypeError and wedges FETCHING forever.
+            end = KEYSPACE_END
         if self.storage_id not in dest or self.storage_id in src:
             return
         if all(v for _b, _e, v in self.owned.intersecting(begin, end)):
@@ -924,7 +947,14 @@ class StorageServer:
                 self._apply_point(m, ver, seq)
         shard.buffer = []
         shard.phase = AddingShard.READY
-        self._meta_dirty = True  # READY shards persist with the durable meta
+        self._meta_dirty = True
+        if self.kvstore is not None:
+            # One commit covers the written-through rows AND the READY
+            # claim: after this fsync a crashed destination recovers the
+            # shard complete (the settle's flip persists via the next
+            # meta-only durability pass).
+            self._persist_meta_locked()
+            await self.kvstore.commit()
         if shard.finalized:
             self._flip_to_owned(shard)
 
@@ -937,14 +967,36 @@ class StorageServer:
         self.store.clear_range(shard.begin, shard.end, snap, 0)
         self.input_bytes += len(shard.begin) + len(shard.end) + 16
         self.byte_sample.remove_range(shard.begin, shard.end)
+        # WRITE-THROUGH: fetched rows go straight into the durable base
+        # engine too, fsynced before the shard can report READY.  The
+        # settle that follows READY makes the SOURCE durably drop its
+        # copy, so a destination holding the snapshot only in its RAM
+        # window would leave the data existing NOWHERE durable across a
+        # crash (snapshots never ride the log) — silent loss (ref:
+        # fetchKeys persisting fetched data before the shard turns
+        # readable, storageserver.actor.cpp fetchKeys).  Base rows above
+        # durable_version are benign: window entries shadow them until
+        # trim, and recovery gates reads with the avail floor (= snap).
+        if self.kvstore is not None:
+            self.kvstore.clear_range(shard.begin, shard.end)
         begin = shard.begin
         while True:
             rep: FetchShardReply = await src.fetch_shard.get_reply(
                 self.process,
                 FetchShardRequest(begin=begin, end=shard.end, version=snap),
             )
+            if self.adding[shard.begin] is not shard:
+                # Superseded mid-page by an overlapping move: STOP writing
+                # through — the new fetch's clear_range/sets share the
+                # base-engine commit buffer, and a stale row written after
+                # it would win last-writer-wins durably (served after a
+                # crash even though the RAM window shadows it).  The
+                # caller's top-of-loop check turns this into a return.
+                raise FdbError("fetch_superseded")
             for k, v in rep.data:
                 self.store.set(k, v, snap, 1)
+                if self.kvstore is not None:
+                    self.kvstore.set(k, v)
                 self.input_bytes += len(k) + len(v) + 16
                 self.byte_sample.update(k, len(k) + len(v))
             if not rep.more:

@@ -41,6 +41,11 @@ COMMIT_DELAY = 0.0005
 class TLog:
     SPILL_META_THROUGH = b"\x00meta/spilled_through"
     SPILL_META_POPPED = b"\x00meta/popped"
+    # One marker key per unregistered (dead-consumer) tag.  Durable in
+    # the SPILL store, not the disk queue: the __pop__ unregister record
+    # is trimmed once the floor passes its seq, and forgetting a dead tag
+    # re-opens the unbounded spill leak it exists to stop.
+    SPILL_DEAD_TAG_PREFIX = b"\x00meta/dead_tag/"
 
     def __init__(
         self,
@@ -74,6 +79,10 @@ class TLog:
         # tag -> highest pop seen; entries are discarded below min over tags
         # (ref: per-tag popping, TLogServer.actor.cpp:894).
         self.popped_tags: dict = {}
+        # Tags unregistered as dead consumers: commits may still tag them
+        # until DD heals keyServers, so spill GC must keep collecting their
+        # rows (below the global floor) or the spill store grows forever.
+        self._dead_tags: set = set()
         self.disk_queue = disk_queue  # None = in-memory (simulated fsync)
         # -- spill state (None spill_store = memory-only log, no spill) --
         self.spill_store = spill_store
@@ -125,6 +134,12 @@ class TLog:
         log = cls(process, disk_queue=q, epoch=epoch, spill_store=spill)
         raw = spill.read_value(cls.SPILL_META_THROUGH)
         log.spilled_through = int(raw) if raw else 0
+        for k, _v in spill.read_range(
+            cls.SPILL_DEAD_TAG_PREFIX, cls.SPILL_DEAD_TAG_PREFIX + b"\xff"
+        ):
+            log._dead_tags.add(
+                k[len(cls.SPILL_DEAD_TAG_PREFIX):].decode()
+            )
         for _seq, payload in records:
             rec = pickle.loads(payload)
             if rec[0] == "__truncate__":
@@ -144,6 +159,7 @@ class TLog:
                 _m, tag, ver, unregister = rec
                 if unregister:
                     log.popped_tags.pop(tag, None)
+                    log._dead_tags.add(tag)
                 else:
                     log.popped_tags[tag] = max(
                         log.popped_tags.get(tag, -1), ver
@@ -429,12 +445,20 @@ class TLog:
             if req.begin_version < self.begin_version or (
                 req.begin_version < self.popped
             ):
-                # This log cannot answer below its beginning or below its
-                # popped floor: silently returning only LATER versions would
-                # make the peeker skip data it never saw (loud failure; the
-                # consumer rotates to a replica that still has the range).
-                reply.send_error("peek_below_begin")
-                continue
+                if req.allow_below_begin:
+                    # Merge-cursor mode: serve from our floor; the reply's
+                    # served_from (= the adjusted begin_version) tells the
+                    # merge which range this log did NOT cover, so it can
+                    # verify some replica still holds it.
+                    req.begin_version = max(self.begin_version, self.popped)
+                else:
+                    # This log cannot answer below its beginning or below
+                    # its popped floor: silently returning only LATER
+                    # versions would make the peeker skip data it never
+                    # saw (loud failure; the consumer rotates to a replica
+                    # that still has the range).
+                    reply.send_error("peek_below_begin")
+                    continue
             # BUGGIFY: tiny peek pages force the has_more continuation path
             # in every consumer (ref: buggified reply size limits).
             limit = 2 if buggify("tlog_peek_truncate") else req.limit_versions
@@ -482,6 +506,7 @@ class TLog:
                     else self.versions[j - 1] if j > i else req.begin_version,
                     known_committed=self.known_committed,
                     has_more=j < durable_end,
+                    served_from=req.begin_version,
                 )
             )
 
@@ -554,6 +579,7 @@ class TLog:
             end_version=end,
             known_committed=self.known_committed,
             has_more=more,
+            served_from=req.begin_version,
         )
 
     def _trim(self):
@@ -587,8 +613,20 @@ class TLog:
     async def _spill_gc(self, floor: int):
         """Delete spilled data below the global consumer floor and persist
         the floor (one atomic spill-store commit).  Lazily lagging is safe:
-        a crash rolls the floor back, the log merely retains more."""
-        for tag in list(self.popped_tags) or []:
+        a crash rolls the floor back, the log merely retains more.
+
+        Broadcast tags (TAG_ALL/TAG_DEFAULT) have no registered consumer
+        and never appear in popped_tags, yet EVERY commit spills rows for
+        them — GC'ing only consumer tags grew the spill store without
+        bound.  Below the global floor every consumer is past these rows
+        too, so they are collected together.  Likewise UNREGISTERED (dead)
+        tags: proxies keep tagging commits for a lost storage until DD
+        heals keyServers, and nobody will ever pop those rows."""
+        from .interfaces import TAG_ALL, TAG_DEFAULT
+
+        for tag in (
+            set(self.popped_tags) | self._dead_tags | {TAG_ALL, TAG_DEFAULT}
+        ):
             self.spill_store.clear_range(
                 self._spill_key(tag, 0), self._spill_key(tag, floor + 1)
             )
@@ -605,6 +643,18 @@ class TLog:
             changed = False
             if req.unregister:
                 changed = self.popped_tags.pop(tag, None) is not None
+                # Record the death even if this log never saw a pop for the
+                # tag — it may still hold (and keep receiving) spilled rows.
+                changed = changed or tag not in self._dead_tags
+                self._dead_tags.add(tag)
+                if changed and self.spill_store is not None:
+                    # Durable marker (the __pop__ queue record is trimmed
+                    # once the floor passes it); rides the next spill-store
+                    # commit — losing an unsynced marker only delays GC one
+                    # more unregister/restart cycle, never loses data.
+                    self.spill_store.set(
+                        self.SPILL_DEAD_TAG_PREFIX + tag.encode(), b"1"
+                    )
             elif req.version > self.popped_tags.get(tag, -1):
                 self.popped_tags[tag] = req.version
                 changed = True

@@ -242,7 +242,12 @@ class WorkerServer:
             elif isinstance(req, LockTLog):
                 role: Optional[TLog] = self.roles.get("tlog")
                 if role is None:
-                    reply.send(None)
+                    # Distinguishable from a TIMED-OUT lock (None at the
+                    # caller): no live role means the disk is quiescent —
+                    # safe for recovery to proceed and recover it from
+                    # disk; a timeout is NOT safe (the old role may still
+                    # be acking commits).
+                    reply.send("no_tlog")
                 else:
                     role.locked = True
                     reply.send(role.durable.get())

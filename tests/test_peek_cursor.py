@@ -1,0 +1,210 @@
+"""MergePeekCursor coverage semantics.
+
+Ref: fdbserver/LogSystemPeekCursor.actor.cpp — MergedPeekCursor must
+never emit a gapped stream: a member that cannot serve a range is fine
+only while ANOTHER member covers it; when nobody does, the merge must
+fail loudly (the single-log peek_below_begin discipline), because every
+consumer downstream (backup chunks, DR apply, log routers) assumes the
+stream is complete through the returned horizon.
+"""
+
+import pytest
+
+from foundationdb_tpu.client.types import Mutation, MutationType
+from foundationdb_tpu.flow import EventLoop, set_event_loop
+from foundationdb_tpu.flow.error import FdbError
+from foundationdb_tpu.rpc import SimNetwork
+from foundationdb_tpu.rpc.peek_cursor import MergePeekCursor
+from foundationdb_tpu.server.interfaces import (
+    TAG_ALL,
+    TLogCommitRequest,
+    TLogPopRequest,
+)
+from foundationdb_tpu.server.tlog import TLog
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def _env(seed):
+    loop = EventLoop(seed=seed)
+    set_event_loop(loop)
+    return loop, SimNetwork(loop)
+
+
+def _mut(i):
+    return Mutation(MutationType.SET_VALUE, b"k%04d" % i, b"v%d" % i)
+
+
+async def _commit(iface, proc, version, prev):
+    await iface.commit.get_reply(
+        proc,
+        TLogCommitRequest(
+            version=version,
+            prev_version=prev,
+            tagged={TAG_ALL: [(0, _mut(version))]},
+            epoch=0,
+        ),
+    )
+
+
+async def _pop(iface, proc, tag, version):
+    await iface.pop.get_reply(
+        proc, TLogPopRequest(tag=tag, version=version)
+    )
+
+
+def test_fresh_replacement_log_served_by_survivor():
+    """A merge over [survivor, fresh-replacement] delivers the FULL
+    stream: the replacement (begin_version = recovery point) serves only
+    its own range, the survivor covers below it — no wedge, no gap."""
+    loop, net = _env(11)
+    proc = net.process("c")
+    done = {}
+
+    async def run():
+        survivor = TLog(net.process("t0"))
+        fresh = TLog(
+            net.process("t1"), epoch_begin_version=10, begin_version=10
+        )
+        prev = 0
+        for v in range(1, 21):
+            await _commit(survivor.interface(), proc, v, prev)
+            if v > 10:
+                await _commit(
+                    fresh.interface(), proc, v, prev if prev > 10 else 10
+                )
+            prev = v
+        cur = MergePeekCursor(
+            proc,
+            [survivor.interface(), fresh.interface()],
+            tags=None,
+            begin=0,
+        )
+        got = []
+        while True:
+            entries, horizon = await cur.next_batch()
+            got.extend(v for v, _b in entries)
+            if horizon >= 20:
+                break
+        assert got == list(range(1, 21)), got
+        done["ok"] = True
+
+    loop.run_until(proc.spawn(run(), "t"), timeout_vt=200.0)
+    assert done.get("ok")
+
+
+def test_uncovered_range_raises_not_skips():
+    """EVERY member's floor above the merge begin: the cursor must raise
+    peek_below_begin (nobody holds the range), never silently advance."""
+    loop, net = _env(12)
+    proc = net.process("c")
+    done = {}
+
+    async def run():
+        logs = [TLog(net.process(f"t{i}")) for i in range(2)]
+        prev = 0
+        for v in range(1, 11):
+            for lg in logs:
+                await _commit(lg.interface(), proc, v, prev)
+            prev = v
+        # Both replicas popped to 6: versions 1..6 retained nowhere.
+        for lg in logs:
+            await _pop(lg.interface(), proc, "consumer", 6)
+        cur = MergePeekCursor(
+            proc, [lg.interface() for lg in logs], tags=None, begin=0
+        )
+        with pytest.raises(FdbError) as ei:
+            await cur.next_batch()
+        assert ei.value.name == "peek_below_begin"
+        done["ok"] = True
+
+    loop.run_until(proc.spawn(run(), "t"), timeout_vt=200.0)
+    assert done.get("ok")
+
+
+def test_mid_stream_floor_jump_raises():
+    """The hole check must keep working AFTER the first batch: a cursor
+    that tailed to horizon H, then found every replica's floor above H,
+    must raise — covered_from tracks the CURRENT contiguous segment, not
+    a min-ever that first-batch coverage would pin low forever."""
+    loop, net = _env(13)
+    proc = net.process("c")
+    done = {}
+
+    async def run():
+        logs = [TLog(net.process(f"t{i}")) for i in range(2)]
+        prev = 0
+        for v in range(1, 11):
+            for lg in logs:
+                await _commit(lg.interface(), proc, v, prev)
+            prev = v
+        cur = MergePeekCursor(
+            proc, [lg.interface() for lg in logs], tags=None, begin=0
+        )
+        got = []
+        while cur.begin < 10:
+            entries, _h = await cur.next_batch()
+            got.extend(v for v, _b in entries)
+        assert got == list(range(1, 11))
+        # More commits land; every replica pops past the cursor's resume
+        # point (a lagging consumer that lost the retention race).
+        for v in range(11, 21):
+            for lg in logs:
+                await _commit(lg.interface(), proc, v, prev)
+            prev = v
+        for lg in logs:
+            await _pop(lg.interface(), proc, "consumer", 16)
+        with pytest.raises(FdbError) as ei:
+            await cur.next_batch()
+        assert ei.value.name == "peek_below_begin"
+        done["ok"] = True
+
+    loop.run_until(proc.spawn(run(), "t"), timeout_vt=200.0)
+    assert done.get("ok")
+
+
+def test_tag_slot_hole_not_masked_by_unrelated_log():
+    """Tag-aware coverage: tag ss:c lives on ring logs [0, 1] of 3.  Both
+    its replicas floored above begin must raise peek_below_begin even
+    though the UNRELATED log 2 still covers begin — one log's coverage
+    for other tags must not mask a hole in this tag's whole slot."""
+    loop, net = _env(14)
+    proc = net.process("c")
+    done = {}
+
+    async def run():
+        logs = [TLog(net.process(f"t{i}")) for i in range(3)]
+        prev = 0
+        for v in range(1, 11):
+            # ss:c rides its slot [0, 1]; log 2 carries only broadcast.
+            for i, lg in enumerate(logs):
+                tagged = {TAG_ALL: [(0, _mut(v))]}
+                if i in (0, 1):
+                    tagged["ss:c"] = [(1, _mut(v))]
+                await lg.interface().commit.get_reply(
+                    proc,
+                    TLogCommitRequest(
+                        version=v, prev_version=prev, tagged=tagged, epoch=0
+                    ),
+                )
+            prev = v
+        # Both slot members popped past 6; log 2 untouched.
+        for lg in logs[:2]:
+            await _pop(lg.interface(), proc, "consumer", 6)
+        cur = MergePeekCursor(
+            proc,
+            [lg.interface() for lg in logs],
+            tags=["ss:c"],
+            begin=0,
+        )
+        with pytest.raises(FdbError) as ei:
+            await cur.next_batch()
+        assert ei.value.name == "peek_below_begin"
+        done["ok"] = True
+
+    loop.run_until(proc.spawn(run(), "t"), timeout_vt=200.0)
+    assert done.get("ok")

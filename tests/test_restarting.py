@@ -158,3 +158,146 @@ def test_restart_mid_shard_move():
     c.run_until(db.process.spawn(db.run(check), "chk"), timeout_vt=600.0)
     assert len(out["rows"]) == 20
     assert out["rows"][0] == (b"mv0020", b"val0020")
+
+
+def test_restart_after_fetch_ready_before_fold_loses_nothing():
+    """Crash the DESTINATION right after its fetch reached READY + settled
+    but BEFORE the fetched snapshot folded through the version window.
+    The fetch WRITE-THROUGH (rows into the durable base engine, fsynced
+    with the READY claim in one commit) must let the recovered
+    destination serve the shard: the settle durably DROPS the source's
+    copy, so without the write-through the data would exist nowhere —
+    silent loss (round-5 review finding).  Exercised at the component
+    level: two durable storages, a manual keyServers move, a destination
+    machine crash, StorageServer.recover."""
+    from foundationdb_tpu.fileio import SimFileSystem
+    from foundationdb_tpu.flow.eventloop import EventLoop
+    from foundationdb_tpu.flow import set_event_loop as sel
+    from foundationdb_tpu.rpc.network import SimNetwork
+    from foundationdb_tpu.server.interfaces import (
+        GetKeyValuesRequest,
+        GetShardStateRequest,
+    )
+    from foundationdb_tpu.server.storage import StorageServer
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.server import system_keys as sk
+
+    c = SimCluster(seed=9320, durable=True)  # single durable storage src
+    db = c.database()
+
+    async def fill(tr):
+        for i in range(25):
+            tr.set(b"wt%03d" % i, b"d%d" % i)
+
+    c.run_until(db.process.spawn(db.run(fill), "fill"), timeout_vt=600.0)
+
+    # A SECOND durable storage on its own machine joins as the move dest.
+    proc2 = c.net.process("storage2", machine_id="m_storage2")
+    dst_holder = {}
+
+    async def boot_dst():
+        dst_holder["ss"] = await StorageServer.recover(
+            proc2,
+            [t.interface() for t in c.tlogs],
+            c.fs,
+            "storage2.dq",
+            storage_id="ss2",
+            owned_all=False,
+        )
+
+    c.run_until(proc2.spawn(boot_dst(), "boot2"), timeout_vt=600.0)
+    dst = dst_holder["ss"]
+
+    # Manual MoveKeys: serverList entries + startMove + settle.
+    src_id = c.storage.storage_id
+
+    async def start_move(tr):
+        tr.options["access_system_keys"] = True
+        tr.set(sk.server_list_key(src_id),
+               sk.encode_server_entry(c.storage.interface()))
+        tr.set(sk.server_list_key("ss2"),
+               sk.encode_server_entry(dst.interface()))
+        tr.set(sk.key_servers_key(b"wt"),
+               sk.encode_key_servers([src_id], ["ss2"], b"wu"))
+
+    c.run_until(db.process.spawn(db.run(start_move), "sm"), timeout_vt=600.0)
+
+    async def wait_fetched():
+        for _ in range(400):
+            state = await dst.interface().get_shard_state.get_reply(
+                db.process, GetShardStateRequest(begin=b"wt", end=b"wu")
+            )
+            if state == "fetched":
+                return True
+            await c.loop.delay(0.05)
+        return False
+
+    assert c.run_until(db.process.spawn(wait_fetched(), "wf"),
+                       timeout_vt=2000.0)
+
+    async def settle(tr):
+        tr.options["access_system_keys"] = True
+        tr.set(sk.key_servers_key(b"wt"),
+               sk.encode_key_servers(["ss2"], [], b"wu"))
+
+    c.run_until(db.process.spawn(db.run(settle), "st"), timeout_vt=600.0)
+
+    async def wait_flipped():
+        for _ in range(400):
+            state = await dst.interface().get_shard_state.get_reply(
+                db.process, GetShardStateRequest(begin=b"wt", end=b"wu")
+            )
+            if state == "readable":
+                return True
+            await c.loop.delay(0.05)
+        return False
+
+    assert c.run_until(db.process.spawn(wait_flipped(), "wfl"),
+                       timeout_vt=2000.0)
+
+    # CRASH the destination machine NOW — far below the 5M-version fold
+    # window, so only the write-through can have made the rows durable.
+    proc2.kill()
+    c.fs.crash_machine("m_storage2")
+    proc2.reboot()
+
+    async def recover_and_read():
+        ss2 = await StorageServer.recover(
+            proc2,
+            [t.interface() for t in c.tlogs],
+            c.fs,
+            "storage2.dq",
+            storage_id="ss2",
+            owned_all=False,
+        )
+        # The recovered destination must CLAIM the shard (READY from the
+        # fetch-time durable meta; the settle record replays from the log
+        # tail and flips it readable as the update loop catches up).
+        state = None
+        for _ in range(200):
+            state = await ss2.interface().get_shard_state.get_reply(
+                db.process, GetShardStateRequest(begin=b"wt", end=b"wu")
+            )
+            if state == "readable":
+                break
+            assert state in ("fetched", "adding", "readable"), state
+            await c.loop.delay(0.05)
+        assert state == "readable", state
+        # ...and serve every fetched row at a fresh version.
+        for _ in range(200):
+            v = ss2.version.get()
+            try:
+                rep = await ss2.interface().get_key_values.get_reply(
+                    db.process,
+                    GetKeyValuesRequest(begin=b"wt", end=b"wu", version=v),
+                )
+                if len(rep.data) == 25:
+                    return rep.data
+            except Exception:
+                pass
+            await c.loop.delay(0.05)
+        return None
+
+    rows = c.run_until(db.process.spawn(recover_and_read(), "rr"),
+                       timeout_vt=5000.0)
+    assert rows is not None and rows[7] == (b"wt007", b"d7")

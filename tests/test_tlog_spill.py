@@ -224,3 +224,80 @@ def test_truncate_above_purges_spill():
     loop.run_until(proc.spawn(run()), timeout_vt=5000.0)
     assert state.get("ok")
     set_event_loop(None)
+
+
+def test_unregistered_tag_spill_gc_survives_restart():
+    """A dead consumer's tag keeps receiving commits until DD heals
+    keyServers; its unregistration must keep spill GC collecting those
+    rows ACROSS a tlog restart.  The __pop__ unregister queue record is
+    trimmed once the floor passes it, so durability rides a spill-store
+    marker — forgetting it would silently regrow the spill forever."""
+    loop, net, fs = make_env(31)
+    proc = net.process("tlog")
+    client = net.process("client")
+    state = {}
+
+    async def phase1():
+        log = await TLog.fresh(proc, fs, "t.dq")
+        log.spill_threshold_bytes = 10_000
+        log.spill_keep_versions = 4
+        iface = log.interface()
+        # Register the live consumer, then declare dead1 dead.
+        await iface.pop.get_reply(
+            client, TLogPopRequest(version=0, tag="ss0")
+        )
+        await iface.pop.get_reply(
+            client, TLogPopRequest(tag="dead1", unregister=True)
+        )
+        # Both tags keep receiving rows (DD has not healed keyServers
+        # yet); enough volume to spill (and commit the marker).
+        for v in range(1, 101):
+            await _push(
+                iface, client, v, v - 1,
+                {"ss0": [(0, _mut(v))], "dead1": [(1, _mut(v))]},
+            )
+        for _ in range(200):
+            if not log._spilling:
+                break
+            await loop.delay(0.01)
+        assert log.spilled_through > 0
+        assert "dead1" in log._dead_tags
+
+    loop.run_until(proc.spawn(phase1()), timeout_vt=5000.0)
+    proc.kill()
+    fs.crash_machine("tlog")
+    proc.reboot()
+
+    async def phase2():
+        log = await TLog.recover(proc, fs, "t.dq")
+        assert "dead1" in log._dead_tags, "dead tag forgotten on restart"
+        iface = log.interface()
+        prev = log.durable.get()
+        for v in range(prev + 1, prev + 81):
+            await _push(
+                iface, client, v, v - 1,
+                {"ss0": [(0, _mut(v))], "dead1": [(1, _mut(v))]},
+            )
+        for _ in range(200):
+            if not log._spilling:
+                break
+            await loop.delay(0.01)
+        # The live consumer advances; GC must release dead1's rows below
+        # the floor even though nobody ever pops dead1.
+        floor = prev + 80
+        await iface.pop.get_reply(
+            client, TLogPopRequest(version=floor, tag="ss0")
+        )
+        for _ in range(100):
+            await loop.delay(0.01)
+        left = log.spill_store.read_range(
+            b"t/dead1/", b"t/dead10", limit=10
+        )
+        assert left == [], (
+            f"dead tag's spilled rows survived GC: {left[:3]}"
+        )
+        state["ok"] = True
+
+    loop.run_until(proc.spawn(phase2()), timeout_vt=5000.0)
+    assert state.get("ok")
+    set_event_loop(None)

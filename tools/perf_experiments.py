@@ -34,27 +34,12 @@ rate = bench.bench_jax(rng, h_cap=%(h_cap)d)
 print("RESULT " + json.dumps({"txns_per_sec": round(rate, 1)}))
 """
 
-VARIANTS = [
-    ("baseline", {}, 3407872),
-    ("search2level", {"FDB_TPU_SEARCH": "2level"}, 3407872),
-    # Headroom: between evictions merged rows grow by <= 2*wr_cap per
-    # batch; 3 unevicted batches on top of the 2.87M steady state.
-    ("evict4", {"FDB_TPU_EVICT_EVERY": "4"}, 3407872 + 3 * 2 * 65536),
-    (
-        "both",
-        {"FDB_TPU_SEARCH": "2level", "FDB_TPU_EVICT_EVERY": "4"},
-        3407872 + 3 * 2 * 65536,
-    ),
-    (
-        "both_evict8_stride1k",
-        {
-            "FDB_TPU_SEARCH": "2level",
-            "FDB_TPU_SEARCH_STRIDE": "1024",
-            "FDB_TPU_EVICT_EVERY": "8",
-        },
-        3407872 + 7 * 2 * 65536,
-    ),
-]
+sys.path.insert(0, REPO)
+import bench  # noqa: E402  (the variant table is shared with the driver bench)
+
+# One shared table: every name the A/B can crown in TUNED.json must be
+# attemptable by the driver-time bench (bench.variant_plan sorts by name).
+VARIANTS = list(bench.VARIANTS)
 
 
 def main():
@@ -84,6 +69,23 @@ def main():
             out[name] = {"error": "timeout"}
         print(json.dumps({name: out[name]}), flush=True)
     print(json.dumps({"all": out}), flush=True)
+    # Persist the winner so the driver-time bench tries it FIRST (and its
+    # compile is already in the shared persistent .jax_cache).
+    scored = [
+        (v["txns_per_sec"], k) for k, v in out.items() if "txns_per_sec" in v
+    ]
+    if scored:
+        rate, name = max(scored)
+        with open(os.path.join(REPO, "TUNED.json"), "w") as f:
+            json.dump(
+                {
+                    "variant": name,
+                    "txns_per_sec": rate,
+                    "source": "tools/perf_experiments.py in-session A/B",
+                },
+                f,
+            )
+        print(json.dumps({"tuned": name, "txns_per_sec": rate}), flush=True)
 
 
 if __name__ == "__main__":
