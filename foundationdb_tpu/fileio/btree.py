@@ -22,24 +22,89 @@ story maps exactly onto the simulator's crash model:
   uncommitted in-memory overlay; memory is bounded by an LRU cache of
   parsed nodes plus the overlay — the tree itself can exceed RAM.
 
-Deviations from the reference engine, by design: no per-data-page checksum
-(the header CRC + shadow paging cover torn-commit detection; the sim's
-FULL_CORRUPTION kill mode exercises it), no underfull-node merging and no
-background vacuum (free-list reuse bounds steady-state growth;
-`leaked_pages` counts free-list overflow), count() is exact only between
-commits (its one caller is the status doc).
+Node pages use a STRICT fixed binary format (length-prefixed fields, CRC
+per chunk) and the header body rides the versioned wire codec — a
+corrupted or hostile page fails the schema/CRC check loudly instead of
+deserializing arbitrary objects (ref: the reference's checksummed page
+formats, e.g. sqlite page checksums in KeyValueStoreSQLite.actor.cpp's
+role).  Other deviations from the reference engine, by design: no
+underfull-node merging and no background vacuum (free-list reuse bounds
+steady-state growth; `leaked_pages` counts free-list overflow), count()
+is exact only between commits (its one caller is the status doc).
 """
 
 from __future__ import annotations
 
-import pickle
 import zlib
 from bisect import bisect_left, bisect_right
 from typing import Dict, List, Optional, Tuple
 
+from ..flow.error import FdbError
+from ..rpc.wire import WireDecodeError, decode_frame, encode_frame
+
 PAGE_SIZE = 16384  # one 10KB key + node overhead must fit comfortably
-HEADER_MAGIC = b"FDBTBT01"
+HEADER_MAGIC = b"FDBTBT02"  # bumped: strict node format + chunk CRCs
 MAX_FREE_IN_HEADER = 1024  # beyond this, pages leak (counted, not lost data)
+NODE_FORMAT_V = 1
+
+
+def _encode_node(leaf: bool, keys: list, vals: list) -> bytes:
+    """Strict page body: version, leaf flag, counted length-prefixed keys,
+    then leaf values (length-prefixed) or branch child page ids (8B)."""
+    parts = [
+        bytes((NODE_FORMAT_V, 1 if leaf else 0)),
+        len(keys).to_bytes(4, "big"),
+    ]
+    for k in keys:
+        parts.append(len(k).to_bytes(4, "big"))
+        parts.append(k)
+    if leaf:
+        for v in vals:
+            parts.append(len(v).to_bytes(4, "big"))
+            parts.append(v)
+    else:
+        for v in vals:
+            parts.append(int(v).to_bytes(8, "big"))
+    return b"".join(parts)
+
+
+def _decode_node(data: bytes) -> Tuple[bool, list, list]:
+    """Inverse of _encode_node; every bound is checked — malformed input
+    raises file_corrupt, never produces an undersized node silently."""
+    try:
+        if data[0] != NODE_FORMAT_V or data[1] not in (0, 1):
+            raise ValueError("bad node header")
+        leaf = data[1] == 1
+        n = int.from_bytes(data[2:6], "big")
+        off = 6
+        keys = []
+        for _ in range(n):
+            ln = int.from_bytes(data[off : off + 4], "big")
+            off += 4
+            if off + ln > len(data):
+                raise ValueError("key overruns page")
+            keys.append(data[off : off + ln])
+            off += ln
+        vals = []
+        if leaf:
+            for _ in range(n):
+                ln = int.from_bytes(data[off : off + 4], "big")
+                off += 4
+                if off + ln > len(data):
+                    raise ValueError("value overruns page")
+                vals.append(data[off : off + ln])
+                off += ln
+        else:
+            for _ in range(n + 1):
+                if off + 8 > len(data):
+                    raise ValueError("child id overruns page")
+                vals.append(int.from_bytes(data[off : off + 8], "big"))
+                off += 8
+        if off != len(data):
+            raise ValueError("trailing bytes in node page")
+        return leaf, keys, vals
+    except (ValueError, IndexError) as e:
+        raise FdbError("file_corrupt") from e
 
 
 class _Node:
@@ -114,6 +179,11 @@ class BTreeKeyValueStore:
         return kv
 
     def _parse_header(self, raw: bytes) -> Optional[dict]:
+        if len(raw) >= 8 and raw[:6] == b"FDBTBT" and raw[:8] != HEADER_MAGIC:
+            # A RECOGNIZED older/newer format must refuse loudly: treating
+            # it as "no header" would reinitialize an empty store over real
+            # data (the WAL's counterpart raises file_corrupt likewise).
+            raise FdbError("file_corrupt")
         if len(raw) < 16 or raw[:8] != HEADER_MAGIC:
             return None
         length = int.from_bytes(raw[8:12], "big")
@@ -122,13 +192,16 @@ class BTreeKeyValueStore:
         if len(body) < length or zlib.crc32(body) != crc:
             return None
         try:
-            return pickle.loads(body)
-        except Exception:
+            hdr = decode_frame(body)
+            if not isinstance(hdr, dict):
+                return None
+            return hdr
+        except WireDecodeError:
             return None
 
     async def _write_header(self):
         assert isinstance(self._root, (int, type(None)))
-        body = pickle.dumps(
+        body = encode_frame(
             {
                 "gen": self._gen,
                 "root": self._root,
@@ -136,8 +209,7 @@ class BTreeKeyValueStore:
                 "free": self._free,
                 "leaked": self._leaked,
                 "n_keys": self._n_keys,
-            },
-            protocol=4,
+            }
         )
         raw = (
             HEADER_MAGIC
@@ -180,13 +252,28 @@ class BTreeKeyValueStore:
             return node
         chunks = []
         p = pid
+        seen = set()
         while p is not None:
+            if p in seen:
+                # A corrupted nxt pointer forming a cycle must fail, not
+                # loop forever (the CRC covers the header too, but belt
+                # and braces for a colliding checksum).
+                raise FdbError("file_corrupt")
+            seen.add(p)
             raw = self._file.read_sync(p * self._ps, self._ps)
             clen = int.from_bytes(raw[:4], "big")
             nxt = int.from_bytes(raw[4:12], "big")
-            chunks.append(raw[12 : 12 + clen])
+            crc = int.from_bytes(raw[12:16], "big")
+            if clen > self._ps - 16:
+                raise FdbError("file_corrupt")
+            chunk = raw[16 : 16 + clen]
+            # CRC spans the chunk header (clen, nxt) AND the payload: a
+            # flipped nxt must fail here, not wander the page file.
+            if zlib.crc32(raw[:12] + chunk) != crc:
+                raise FdbError("file_corrupt")
+            chunks.append(chunk)
             p = (nxt - 1) if nxt else None
-        leaf, keys, vals = pickle.loads(b"".join(chunks))
+        leaf, keys, vals = _decode_node(b"".join(chunks))
         node = _Node(leaf, keys, vals)
         self._cache_put(pid, node)
         return node
@@ -196,8 +283,8 @@ class BTreeKeyValueStore:
             "dirty child leaked into serialization; _flush must resolve "
             "children first"
         )
-        data = pickle.dumps((node.leaf, node.keys, node.vals), protocol=4)
-        room = self._ps - 12
+        data = _encode_node(node.leaf, node.keys, node.vals)
+        room = self._ps - 16
         chunks = [data[i : i + room] for i in range(0, len(data), room)] or [b""]
         pids = [self._alloc() for _ in chunks]
         if len(chunks) > 1:
@@ -206,9 +293,10 @@ class BTreeKeyValueStore:
             test_probe("btree_chained_node")
         for i, chunk in enumerate(chunks):
             nxt = (pids[i + 1] + 1) if i + 1 < len(chunks) else 0
+            hdr = len(chunk).to_bytes(4, "big") + nxt.to_bytes(8, "big")
             await self._file.write(
                 pids[i] * self._ps,
-                len(chunk).to_bytes(4, "big") + nxt.to_bytes(8, "big") + chunk,
+                hdr + zlib.crc32(hdr + chunk).to_bytes(4, "big") + chunk,
             )
         self._cache_put(pids[0], node)
         return pids[0]

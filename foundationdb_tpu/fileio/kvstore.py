@@ -9,13 +9,67 @@ queue so the log can be popped).
 
 from __future__ import annotations
 
-import pickle
 from bisect import bisect_left, insort
 from typing import Dict, List, Optional, Tuple
 
+from ..flow.error import FdbError
 from ..rpc.network import SimProcess
 from .diskqueue import DiskQueue
 from .simfile import SimFileSystem
+
+WAL_FORMAT_V = 1
+
+
+def _enc_pairs(tag: bytes, rows, ops: bool) -> bytes:
+    """Strict WAL frame: tag, format version, then length-prefixed pairs
+    (op records carry a 1-byte opcode).  No pickle touches the disk — a
+    corrupted or hostile record fails the bounds check, it never
+    deserializes arbitrary objects (the DiskQueue CRC already covers
+    accidental torn writes)."""
+    parts = [tag, bytes((WAL_FORMAT_V,))]
+    for row in rows:
+        if ops:
+            op, a, b = row
+            parts.append(b"\x00" if op == "set" else b"\x01")
+        else:
+            a, b = row
+        parts.append(len(a).to_bytes(4, "big"))
+        parts.append(a)
+        parts.append(len(b).to_bytes(4, "big"))
+        parts.append(b)
+    return b"".join(parts)
+
+
+def _dec_pairs(payload: bytes, ops: bool):
+    """Inverse of _enc_pairs (minus the tag byte, already dispatched)."""
+    try:
+        if payload[0] != WAL_FORMAT_V:
+            raise ValueError("bad WAL format version")
+        off = 1
+        out = []
+        n = len(payload)
+        while off < n:
+            if ops:
+                code = payload[off]
+                if code > 1:
+                    raise ValueError("bad opcode")
+                off += 1
+            la = int.from_bytes(payload[off : off + 4], "big")
+            off += 4
+            if off + la > n:
+                raise ValueError("field overruns record")
+            a = payload[off : off + la]
+            off += la
+            lb = int.from_bytes(payload[off : off + 4], "big")
+            off += 4
+            if off + lb > n:
+                raise ValueError("field overruns record")
+            b = payload[off : off + lb]
+            off += lb
+            out.append(("set" if code == 0 else "clear", a, b) if ops else (a, b))
+        return out
+    except (ValueError, IndexError) as e:
+        raise FdbError("file_corrupt") from e
 
 
 class IKeyValueStore:
@@ -78,12 +132,12 @@ class KeyValueStoreMemory(IKeyValueStore):
                 snap_idx = i
         start = 0
         if snap_idx is not None:
-            kv._data = dict(pickle.loads(records[snap_idx][1][1:]))
+            kv._data = dict(_dec_pairs(records[snap_idx][1][1:], ops=False))
             start = snap_idx + 1
         for seq, payload in records[start:]:
             if payload[:1] != b"O":
                 continue
-            for op, k, v in pickle.loads(payload[1:]):
+            for op, k, v in _dec_pairs(payload[1:], ops=True):
                 kv._apply(op, k, v)
         kv._keys = sorted(kv._data)
         kv._seq = records[-1][0] if records else queue.popped_seq
@@ -118,7 +172,7 @@ class KeyValueStoreMemory(IKeyValueStore):
         """Durable when returned (ref IKeyValueStore.h:43)."""
         ops, self._uncommitted = self._uncommitted, []
         self._seq += 1
-        payload = b"O" + pickle.dumps(ops, protocol=4)
+        payload = _enc_pairs(b"O", ops, ops=True)
         self._q.push(self._seq, payload)
         self._bytes_since_snapshot += len(payload)
         await self._q.commit()
@@ -136,7 +190,7 @@ class KeyValueStoreMemory(IKeyValueStore):
         """
         self._seq += 1
         self._q.push(
-            self._seq, b"S" + pickle.dumps(list(self._data.items()), protocol=4)
+            self._seq, _enc_pairs(b"S", list(self._data.items()), ops=False)
         )
         await self._q.commit()  # phase 1: snapshot frame durable
         self._q.pop(self._seq - 1)

@@ -16,17 +16,17 @@ Two backup modes:
   snapshot and the last logged chunk (ref: FileBackupAgent's range dumps
   + mutation logs stitched by applyMutations at restore).
 
-The container is a directory of pickled page/log files on the cluster's
+The container is a directory of wire-codec page/log files on the cluster's
 simulated filesystem (the BlobStore stand-in).
 """
 
 from __future__ import annotations
 
-import pickle
 from typing import List, Optional
 
 from ..client.types import key_after
 from ..flow.error import FdbError
+from ..rpc.wire import decode_frame, encode_frame
 from .subspace import Subspace
 from .taskbucket import TaskBucket, TaskBucketExecutor
 
@@ -44,9 +44,9 @@ class BackupContainer:
         self._n = 0
 
     async def _write_blob(self, name: str, obj) -> str:
-        """Length-prefixed pickled blob, synced (the twin of _read_blob)."""
+        """Length-prefixed wire-codec blob, synced (the twin of _read_blob)."""
         f = self.fs.open(self.process, name)
-        blob = pickle.dumps(obj, protocol=4)
+        blob = encode_frame(obj)
         await f.write(0, len(blob).to_bytes(8, "big") + blob)
         await f.sync()
         return name
@@ -72,7 +72,7 @@ class BackupContainer:
         n = int.from_bytes(img[:8], "big")
         if len(img) < 8 + n:
             return None
-        return pickle.loads(img[8 : 8 + n])
+        return decode_frame(img[8 : 8 + n])
 
     async def read_manifest(self) -> Optional[dict]:
         if not self.fs.exists(self.process, f"{self.path}/manifest"):

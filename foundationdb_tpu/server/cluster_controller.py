@@ -17,7 +17,6 @@ are recruited back onto workers whose machines hold their disk files.
 
 from __future__ import annotations
 
-import pickle
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional
 
@@ -28,6 +27,7 @@ from ..flow.knobs import g_knobs
 from ..flow.trace import TraceEvent
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
+from ..rpc.wire import decode_frame, encode_frame
 from .coordination import (
     CoordinatedState,
     CoordinatorInterface,
@@ -193,7 +193,7 @@ class ClusterController:
         # READING_CSTATE
         cstate = CoordinatedState(self.process, self.coordinators)
         raw = await cstate.read()
-        prev = pickle.loads(raw) if raw else {"epoch_end": 0}
+        prev = decode_frame(raw) if raw else {"epoch_end": 0}
         # Follow a quorum move: the fenced old state holds only a forward
         # pointer (ref: MovableCoordinatedState reading MovedFrom).  Bounded
         # hops — a chain of moves is one hop per retired quorum.
@@ -223,7 +223,7 @@ class ClusterController:
                 ]
             cstate = CoordinatedState(self.process, self.coordinators)
             raw = await cstate.read()
-            prev = pickle.loads(raw) if raw else {"epoch_end": 0}
+            prev = decode_frame(raw) if raw else {"epoch_end": 0}
 
         # The epoch/generation is monotone ACROSS controller failovers: it is
         # persisted in the manifest and bumped past any previously persisted
@@ -240,7 +240,7 @@ class ClusterController:
         # even an aborted recovery permanently retires its epoch (a later
         # recovery — ours or another CC's — reads it and goes higher).
         prev["generation"] = self.generation
-        await cstate.set(pickle.dumps(prev, protocol=4))
+        await cstate.set(encode_frame(prev))
 
         # Wait for a usable worker set: stateful roles MUST return to the
         # machines holding their files (recorded in cstate) — recruiting a
@@ -451,20 +451,19 @@ class ClusterController:
         # exactly the fencing the reference gets from MovableCoordinatedState.
         cstate2 = CoordinatedState(self.process, self.coordinators)
         raw2 = await cstate2.read()
-        cur = pickle.loads(raw2) if raw2 else {}
+        cur = decode_frame(raw2) if raw2 else {}
         if cur.get("generation", 0) > self.generation:
             # Another controller locked a newer epoch while we recruited;
             # writing our manifest now would regress the generation chain.
             raise FdbError("recovery_superseded")
         await cstate2.set(
-            pickle.dumps(
+            encode_frame(
                 {
                     "generation": self.generation,
                     "epoch_end": recovery_version,
                     "tlog_addrs": [w.address for w in tlog_ws],
                     "storage_addrs": [w.address for w in storage_ws],
-                },
-                protocol=4,
+                }
             )
         )
 
@@ -880,9 +879,9 @@ class ClusterController:
             self.process, new_ifaces, key=quorum_state_key(list(new_addrs))
         )
         await new_cs.read()
-        await new_cs.set(raw or pickle.dumps({"epoch_end": 0}, protocol=4))
+        await new_cs.set(raw or encode_frame({"epoch_end": 0}))
         await old_cs.set(
-            pickle.dumps({"moved_to": list(new_addrs)}, protocol=4)
+            encode_frame({"moved_to": list(new_addrs)})
         )
         for addr, c in zip(old_addrs, old_cs.coordinators):
             if addr in new_addrs:

@@ -201,15 +201,14 @@ class Coordinator:
         """Recover the generation register from disk, then serve.  Requests
         arriving before recovery park in the streams' queues."""
         if self.fs is not None:
-            import pickle
-
             from ..fileio.kvstore import KeyValueStoreMemory
+            from ..rpc.wire import decode_frame
 
             self._store = await KeyValueStoreMemory.open(
                 self.fs, self.process, self.filename
             )
             for k, v in self._store.read_range(b"", b"\xff" * 16):
-                self.registry[k] = pickle.loads(v)
+                self.registry[k] = decode_frame(v)
             fwd = self.registry.get(FORWARD_KEY)
             if getattr(self, "_forward_cleared", False):
                 # clear_forward ran while this boot was still loading: the
@@ -233,9 +232,9 @@ class Coordinator:
     async def _persist(self, key: bytes):
         if self._store is None:
             return
-        import pickle
+        from ..rpc.wire import encode_frame
 
-        self._store.set(key, pickle.dumps(self.registry[key], protocol=4))
+        self._store.set(key, encode_frame(self.registry[key]))
         await self._store.commit()
 
     def interface(self) -> CoordinatorInterface:

@@ -21,7 +21,6 @@ reads below a fetched shard's snapshot version fail transaction_too_old
 
 from __future__ import annotations
 
-import pickle
 from bisect import bisect_left, bisect_right, insort
 from typing import Dict, List, Optional, Tuple
 
@@ -32,6 +31,7 @@ from ..flow.asyncvar import NotifiedVersion
 from ..flow.error import FdbError
 from ..flow.knobs import g_knobs
 from ..rpc.network import SimProcess
+from ..rpc.wire import decode_frame, encode_frame
 from ..rpc.stream import RequestStream
 from ..utils import RangeMap
 from .interfaces import (
@@ -459,7 +459,7 @@ class StorageServer:
         vmeta = kv.read_value(VERSION_META_KEY)
         durable = int(vmeta.decode()) if vmeta else 0
         owned_meta = kv.read_value(OWNED_META_KEY)
-        meta = pickle.loads(owned_meta) if owned_meta else None
+        meta = decode_frame(owned_meta) if owned_meta else None
         return cls(
             process,
             tlog,
@@ -715,7 +715,7 @@ class StorageServer:
             dict(self.server_list),
             [(a.begin, a.end, a.fetch_version) for a in ready.values()],
         )
-        self.kvstore.set(OWNED_META_KEY, pickle.dumps(meta, protocol=4))
+        self.kvstore.set(OWNED_META_KEY, encode_frame(meta))
 
     @property
     def queue_bytes(self) -> int:

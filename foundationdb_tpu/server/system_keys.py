@@ -13,15 +13,16 @@ applies metadata purely from the mutation stream, so the record must be
 self-contained.  A move in flight is (src, dest, end) with dest non-empty;
 a settled shard is (team, [], end).
 
-`\xff/serverList/<id>` maps a storage id to its pickled interface (ref:
+`\xff/serverList/<id>` maps a storage id to its wire-encoded interface (ref:
 serverListKeyFor SystemData.cpp), letting every role resolve ids to
 endpoints passively from the stream.
 """
 
 from __future__ import annotations
 
-import pickle
 from typing import List, Tuple
+
+from ..rpc.wire import decode_frame, encode_frame
 
 SYSTEM_PREFIX = b"\xff"
 KEY_SERVERS_PREFIX = b"\xff/keyServers/"
@@ -55,11 +56,11 @@ def encode_key_servers(
     """Shard record for [begin, end): settled on `src` when `dest` is empty,
     else a move src -> dest in flight (ref: keyServersValue's src/dest
     encoding, SystemData.cpp)."""
-    return pickle.dumps((list(src), list(dest), end), protocol=4)
+    return encode_frame((list(src), list(dest), end))
 
 
 def decode_key_servers(value: bytes) -> Tuple[List[str], List[str], bytes]:
-    src, dest, end = pickle.loads(value)
+    src, dest, end = decode_frame(value)
     return list(src), list(dest), end
 
 
@@ -73,13 +74,13 @@ def server_list_id(sys_key: bytes) -> str:
 
 
 def encode_server_entry(interface) -> bytes:
-    """Pickled StorageInterface (refs are plain dataclasses of endpoint
-    tokens, so they survive the log's pickle round-trip)."""
-    return pickle.dumps(interface, protocol=4)
+    """Wire-codec StorageInterface (refs are plain dataclasses of
+    endpoint tokens, registered structs in rpc/wire.py)."""
+    return encode_frame(interface)
 
 
 def decode_server_entry(value: bytes):
-    return pickle.loads(value)
+    return decode_frame(value)
 
 
 def bounds_from_split_keys(split_keys: List[bytes]) -> List[tuple]:
@@ -91,11 +92,11 @@ def bounds_from_split_keys(split_keys: List[bytes]) -> List[tuple]:
 
 
 def encode_resolver_split(split_keys: List[bytes]) -> bytes:
-    return pickle.dumps(list(split_keys), protocol=4)
+    return encode_frame(list(split_keys))
 
 
 def decode_resolver_split(value: bytes) -> List[bytes]:
-    return list(pickle.loads(value))
+    return list(decode_frame(value))
 
 
 def parse_metadata_mutation(m):

@@ -25,6 +25,7 @@ from typing import Dict, List, Tuple
 from ..flow.asyncvar import NotifiedVersion
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
+from ..rpc.wire import decode_frame, encode_frame
 from .interfaces import (
     TLogCommitRequest,
     TLogInterface,
@@ -124,8 +125,6 @@ class TLog:
         TLogServer restorePersistentState).  `fast_forward_to` jumps the
         durable chain to the new epoch's begin version so post-recovery
         pushes (whose prevVersion is the recovery version) can land."""
-        import pickle
-
         from ..fileio.btree import BTreeKeyValueStore
         from ..fileio.diskqueue import DiskQueue
 
@@ -141,7 +140,7 @@ class TLog:
                 k[len(cls.SPILL_DEAD_TAG_PREFIX):].decode()
             )
         for _seq, payload in records:
-            rec = pickle.loads(payload)
+            rec = decode_frame(payload)
             if rec[0] == "__truncate__":
                 cut = rec[1]
                 k = bisect_right(log.versions, cut)
@@ -255,14 +254,12 @@ class TLog:
             del self.entries[k:]
             del self._ver_bytes[k:]
         if self.disk_queue is not None:
-            import pickle
-
             # seq = cut+1 so the marker outlives the orphans it erases (the
             # disk queue's recovery drops records with seq <= popped_seq,
             # and consumer floors never exceed the known-committed bound,
             # which is <= cut, until after the new epoch begins).
             self.disk_queue.push(
-                cut + 1, pickle.dumps(("__truncate__", cut), protocol=4)
+                cut + 1, encode_frame(("__truncate__", cut))
             )
             await self.disk_queue.commit()
 
@@ -304,9 +301,7 @@ class TLog:
         if req.known_committed > self.known_committed:
             self.known_committed = req.known_committed
         if self.disk_queue is not None:
-            import pickle
-
-            payload = pickle.dumps((req.version, req.tagged), protocol=4)
+            payload = encode_frame((req.version, req.tagged))
             self._ver_bytes.append(len(payload))
             self._mem_bytes += len(payload)
             self.disk_queue.push(req.version, payload)
@@ -343,8 +338,6 @@ class TLog:
         updatePersistentData TLogServer.actor.cpp:539).  One instance runs
         at a time; consumer trims racing the awaits are re-checked by
         version value, never by index."""
-        import pickle
-
         if self._spilling:
             return
         self._spilling = True
@@ -369,7 +362,7 @@ class TLog:
                     for tag, items in self.entries[k].items():
                         self.spill_store.set(
                             self._spill_key(tag, self.versions[k]),
-                            pickle.dumps(items, protocol=4),
+                            encode_frame(items),
                         )
                 from ..flow.testprobe import test_probe
 
@@ -533,8 +526,6 @@ class TLog:
         tLogPeekMessages).  Per-tag scans each fetch their first `limit`
         versions; any version inside the merged first `limit` is therefore
         complete across tags."""
-        import pickle
-
         from ..flow.testprobe import test_probe
 
         test_probe("tlog_peek_spilled")
@@ -553,7 +544,7 @@ class TLog:
                 lo, hi, limit=limit + 1
             ):
                 v = int.from_bytes(k[-8:], "big")
-                items = pickle.loads(payload)
+                items = decode_frame(payload)
                 if raw:
                     by_ver_tagged.setdefault(v, {})[tag] = items
                 d = by_ver.setdefault(v, {})
@@ -635,8 +626,6 @@ class TLog:
         self._spill_gc_floor = max(self._spill_gc_floor, floor)
 
     async def _serve_pop(self):
-        import pickle
-
         while True:
             req, reply = await self._pop_stream.pop()
             tag = req.tag or "_default"
@@ -666,9 +655,8 @@ class TLog:
                 # tag's own floor <= durable at pop time).
                 self.disk_queue.push(
                     self.durable.get() + 1,
-                    pickle.dumps(
-                        ("__pop__", tag, req.version, req.unregister),
-                        protocol=4,
+                    encode_frame(
+                        ("__pop__", tag, req.version, req.unregister)
                     ),
                 )
             self._trim()

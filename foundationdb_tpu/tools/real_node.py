@@ -82,7 +82,7 @@ def run_server(port: int, datadir: str = "", tls=None) -> None:
         # forward, and resume the storage from its engine's durable
         # version so it replays the log tail (ref: the restart path in
         # SimulatedCluster restartSimulatedSystem + IKeyValueStore.h:43).
-        import pickle
+        from ..rpc.wire import decode_frame
 
         from ..fileio.kvstore_native import NativeKeyValueStore
         from ..fileio.realfile import RealFileSystem
@@ -93,7 +93,7 @@ def run_server(port: int, datadir: str = "", tls=None) -> None:
         vmeta = kv.read_value(VERSION_META_KEY)
         durable = int(vmeta.decode()) if vmeta else 0
         owned_meta = kv.read_value(OWNED_META_KEY)
-        meta = pickle.loads(owned_meta) if owned_meta else None
+        meta = decode_frame(owned_meta) if owned_meta else None
 
         tlog = None
 

@@ -1,0 +1,33 @@
+#!/bin/bash
+# One-command TPU window exploitation: run when the axon tunnel answers.
+#   1. A/B every decision-identical engine variant at the driver bench
+#      config (writes TUNED.json so the driver-time bench tries the
+#      winner first, with its compile already in .jax_cache)
+#   2. phase-level profiler at the real shapes (attributes ms/batch)
+# Outputs land in perf_runs/<timestamp>/ and survive the session.
+set -u
+REPO="$(cd "$(dirname "$0")/.." && pwd)"
+OUT="$REPO/perf_runs/$(date +%Y%m%dT%H%M%S)"
+mkdir -p "$OUT"
+cd "$REPO"
+
+echo "[window] probing device..." | tee "$OUT/log.txt"
+timeout 240 python -c "
+import jax
+ds = jax.devices()
+assert any(d.platform == 'tpu' for d in ds), ds
+print('TPU:', ds)
+" 2>&1 | tee -a "$OUT/log.txt" || { echo "[window] tunnel dead"; exit 1; }
+
+echo "[window] A/B variants (perf_experiments)..." | tee -a "$OUT/log.txt"
+timeout 5400 python tools/perf_experiments.py \
+    > "$OUT/ab.jsonl" 2> >(tee -a "$OUT/log.txt" >&2)
+tail -2 "$OUT/ab.jsonl" | tee -a "$OUT/log.txt"
+
+echo "[window] phase profiler..." | tee -a "$OUT/log.txt"
+timeout 1800 python tools/profile_engine.py \
+    > "$OUT/profile.json" 2> >(tee -a "$OUT/log.txt" >&2)
+cat "$OUT/profile.json" | tee -a "$OUT/log.txt"
+
+echo "[window] done; TUNED.json:" | tee -a "$OUT/log.txt"
+cat TUNED.json 2>/dev/null | tee -a "$OUT/log.txt"
