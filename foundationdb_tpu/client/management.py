@@ -232,3 +232,33 @@ async def get_excluded_servers(db) -> List[str]:
 
     await db.run(txn)
     return out
+
+
+async def version_from_timestamp(db, timestamp: float) -> int:
+    """Map a wall-clock time to the LAST commit version known to be at or
+    before it, from the CC's TimeKeeper samples (ref: fdbbackup's
+    timeKeeperVersionFromDatetime, backup.actor.cpp:1828 — used for
+    `restore --timestamp`).  Raises restore_error when no sample covers
+    the time (cluster younger than the timestamp, or TimeKeeper
+    disabled)."""
+    from ..flow.error import FdbError
+    from ..server.system_keys import (
+        TIME_KEEPER_PREFIX,
+        time_keeper_key,
+    )
+
+    async def txn(tr):
+        tr.options["access_system_keys"] = True
+        tr.options["lock_aware"] = True
+        rows = await tr.get_range(
+            TIME_KEEPER_PREFIX,
+            time_keeper_key(max(0, int(timestamp) + 1)),
+            limit=1,
+            reverse=True,
+        )
+        return int(rows[0][1]) if rows else None
+
+    v = await db.run(txn)
+    if v is None:
+        raise FdbError("restore_error")
+    return v

@@ -97,6 +97,8 @@ _ERRORS: dict[str, int] = {
     "key_too_large": 2102,
     "value_too_large": 2103,
     "unsupported_operation": 2108,
+    "restore_error": 2301,
+    "restore_invalid_version": 2315,
     "internal_error": 4100,
 }
 

@@ -142,6 +142,11 @@ class ServerKnobs(Knobs):
         self._init("dd_move_parallelism", 2)
         self._init("dd_shard_max_bytes", 1 << 20)
         self._init("dd_shard_min_bytes", 16 << 10)
+        # TimeKeeper (ref: ServerKnobs TIME_KEEPER_DELAY=10 /
+        # TIME_KEEPER_MAX_ENTRIES=3600*24*30/10; sim-scaled): the CC's
+        # wall-clock->version sample cadence and retained history bound.
+        self._init("time_keeper_delay", 2.0)
+        self._init("time_keeper_max_entries", 4096)
 
 
 class KnobSet:

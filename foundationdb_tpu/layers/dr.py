@@ -93,11 +93,14 @@ class DRAgent:
         await self._pop_all(version)
         return version
 
-    async def _pop_all(self, version: int):
+    async def _pop_all(self, version: int, unregister: bool = False):
         proc = self.src_db.process
         for tl in self.tlogs:
             await tl.pop.get_reply(
-                proc, TLogPopRequest(version=version, tag=self.tag)
+                proc,
+                TLogPopRequest(
+                    version=version, tag=self.tag, unregister=unregister
+                ),
             )
 
     async def _read_progress(self) -> Optional[int]:
@@ -284,6 +287,24 @@ class DRAgent:
         finally:
             self._running = False
 
+    async def abort(self) -> None:
+        """fdbdr abort (ref: DatabaseBackupAgent::abortBackup; the
+        BackupToDBAbort workload asserts this contract): stop tailing,
+        release the source-side consumer floor (unregister — the logs
+        must not retain forever for a dead DR), and mark the destination
+        state aborted.  The destination KEEPS its data — a consistent
+        prefix of the source (every apply was one whole source version
+        batch) — and is immediately usable for ordinary writes."""
+        loop = self.src_db.process.network.loop
+        self.stopped = True
+        # Wait out an in-flight tail_once in the run() loop (same
+        # discipline as switchover): aborting mid-apply is fine, aborting
+        # mid-bookkeeping would race the state marker write below.
+        while getattr(self, "_running", False):
+            await loop.delay(0.01)
+        await self._pop_all(self.applied, unregister=True)
+        await self._mark_applied(self.applied, state=b"aborted")
+
     async def switchover(self, reverse_tlogs: List) -> "DRAgent":
         """fdbdr switch (ref: DatabaseBackupAgent::atomicSwitchover):
 
@@ -343,13 +364,7 @@ class DRAgent:
         # Release the forward consumer tag: its pop floor is frozen at the
         # drained version and would otherwise retain every post-switch
         # mutation on the old primary's logs forever.
-        for tl in self.tlogs:
-            await tl.pop.get_reply(
-                self.src_db.process,
-                TLogPopRequest(
-                    version=self.applied, tag=self.tag, unregister=True
-                ),
-            )
+        await self._pop_all(self.applied, unregister=True)
         await unlock_database(self.dst_db, dst_uid)
         return rev
 

@@ -184,7 +184,7 @@ class ClusterController:
         # would thrash against the routing rebuild below.  A still-running
         # startup task (seed commit parked on dead proxies) dies with it.
         for t in list(self.process._tasks):
-            if t.name.endswith("cc_start_dd"):
+            if t.name.endswith(("cc_start_dd", "cc_time_keeper")):
                 t.cancel()
         if getattr(self, "dd_role", None) is not None:
             self.dd_role.stop()
@@ -656,6 +656,14 @@ class ClusterController:
             ),
             "cc_config_monitor",
         )
+        # TimeKeeper: wall-clock -> version samples for timestamp-based
+        # restore (ref: the timeKeeper actor,
+        # ClusterController.actor.cpp:1625).  Cancelled at the next
+        # recovery like the DD starter; one writer per generation.
+        self.process.spawn(
+            self._time_keeper(proxy_ifs, storage_ifs[0], self.generation),
+            "cc_time_keeper",
+        )
         TraceEvent("RecoveryComplete").detail("generation", self.generation).detail(
             "recovery_version", recovery_version
         ).log()
@@ -708,6 +716,48 @@ class ClusterController:
             tlogs=list(tlog_ifs),
             active_fn=lambda: self.is_leader.get() and self.generation == gen,
         ).start()
+
+    async def _time_keeper(self, proxy_ifs, storage_if, generation: int):
+        """Write one (wall-clock second -> read version) sample per
+        time_keeper_delay into the timeKeeper map, trimming entries older
+        than delay*max_entries; honors the disable key (ref: timeKeeper,
+        ClusterController.actor.cpp:1625-1661 + timeKeeperDisableKey).
+        Exits when this generation is superseded or leadership is lost —
+        the cancel at the next recovery only covers recoveries run by
+        THIS controller (same guard discipline as _monitor_config)."""
+        from ..client.transaction import Database
+        from .system_keys import (
+            TIME_KEEPER_DISABLE_KEY,
+            time_keeper_key,
+        )
+
+        db = Database(
+            self.process, proxy_ifs[0], storage_if, proxies=list(proxy_ifs)
+        )
+        loop = self.process.network.loop
+        delay = g_knobs.server.time_keeper_delay
+        ttl = delay * g_knobs.server.time_keeper_max_entries
+        while self.generation == generation and self.is_leader.get():
+            now = loop.now()
+
+            async def sample(tr, now=now):
+                tr.options["access_system_keys"] = True
+                tr.options["lock_aware"] = True
+                if await tr.get(TIME_KEEPER_DISABLE_KEY) is not None:
+                    return
+                v = await tr.get_read_version()
+                tr.set(time_keeper_key(int(now)), b"%d" % v)
+                cutoff = int(now - ttl)
+                if cutoff > 0:
+                    tr.clear_range(
+                        time_keeper_key(0), time_keeper_key(cutoff)
+                    )
+
+            try:
+                await db.run(sample)
+            except (FdbError, TimeoutError):
+                pass  # next tick retries; a recovery will replace us
+            await loop.delay(delay)
 
     async def _monitor_config(
         self, proxy_ifs, storage_if, generation: int, recruited_proxies: int

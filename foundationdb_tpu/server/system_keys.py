@@ -40,6 +40,23 @@ RESOLVER_SPLIT_KEY = b"\xff/conf/resolverSplit"
 # parse_metadata_mutation's no-CLEAR-interpretation policy.
 DB_LOCKED_KEY = b"\xff/dbLocked"
 
+# TimeKeeper samples: wall-clock second -> commit version, written by the
+# CC on a fixed cadence (ref: timeKeeperPrefixRange SystemData.cpp:411,
+# the timeKeeper actor ClusterController.actor.cpp:1625).  Maps restore
+# timestamps to versions (fdbbackup's timeKeeperVersionFromDatetime).
+TIME_KEEPER_PREFIX = b"\xff\x02/timeKeeper/map/"
+TIME_KEEPER_END = b"\xff\x02/timeKeeper/map0"
+TIME_KEEPER_DISABLE_KEY = b"\xff\x02/timeKeeper/disable"
+
+
+def time_keeper_key(t: int) -> bytes:
+    return TIME_KEEPER_PREFIX + int(t).to_bytes(8, "big")
+
+
+def time_keeper_time(sys_key: bytes) -> int:
+    assert sys_key.startswith(TIME_KEEPER_PREFIX), sys_key
+    return int.from_bytes(sys_key[len(TIME_KEEPER_PREFIX):], "big")
+
 
 def key_servers_key(key: bytes) -> bytes:
     return KEY_SERVERS_PREFIX + key

@@ -32,6 +32,10 @@ from .sideband import SidebandWorkload
 from .selector_correctness import SelectorCorrectnessWorkload
 from .watches import WatchesWorkload
 from .increment import IncrementWorkload
+from .conflict_range import ConflictRangeWorkload
+from .inventory import InventoryWorkload
+from .queue_push import QueuePushWorkload
+from .time_keeper import TimeKeeperWorkload
 
 __all__ = [
     "TestWorkload",
@@ -63,4 +67,8 @@ __all__ = [
     "SelectorCorrectnessWorkload",
     "WatchesWorkload",
     "IncrementWorkload",
+    "ConflictRangeWorkload",
+    "InventoryWorkload",
+    "QueuePushWorkload",
+    "TimeKeeperWorkload",
 ]

@@ -329,3 +329,71 @@ def test_dr_switchover_unwinds_on_locked_destination():
     assert a.run_until(src.process.spawn(drive()), timeout_vt=30000.0)
     assert out["switch"] == "database_locked"
     assert agent.stopped is False
+
+
+def test_dr_abort_leaves_usable_consistent_destination():
+    """fdbdr abort mid-stream (ref: workloads/BackupToDBAbort.actor.cpp):
+    the destination must be left a CONSISTENT prefix of the source (a
+    valid cycle ring, never a torn mix of versions), immediately usable
+    for ordinary writes, and the source logs must stop retaining for the
+    dead DR tag (its pop floor unregistered)."""
+    loop, a, b = two_clusters(175)
+    src, dst = a.database(), b.database()
+    N = 6
+
+    async def init(tr):
+        for i in range(N):
+            tr.set(b"c%02d" % i, b"%02d" % ((i + 1) % N))
+
+    a.run_all([(src, src.run(init))])
+    agent = DRAgent(src, dst, [t.interface() for t in a.tlogs])
+    a.run_until(src.process.spawn(agent.start()), timeout_vt=5000.0)
+
+    async def churn_and_abort():
+        rng = loop.rng
+        for n in range(40):
+            # Keep the ring valid: rotate three pointers atomically.
+            async def rotate(tr):
+                vals = {}
+                for i in range(N):
+                    vals[i] = int((await tr.get(b"c%02d" % i)).decode())
+                # swap successors of two nodes (stays a single ring only
+                # for adjacent picks; use the 3-node rotation instead)
+                x = int(rng.random_int(0, N))
+                y = vals[x]
+                z = vals[y]
+                w = vals[z]
+                tr.set(b"c%02d" % x, b"%02d" % z)
+                tr.set(b"c%02d" % z, b"%02d" % y)
+                tr.set(b"c%02d" % y, b"%02d" % w)
+
+            await src.run(rotate)
+            if n % 5 == 0:
+                await agent.tail_once()
+        await agent.abort()
+
+    a.run_until(src.process.spawn(churn_and_abort()), timeout_vt=8000.0)
+
+    # Destination: a valid ring (consistent prefix, not torn).
+    rows = dict(read_all(b, dst))
+    ring = {k: v for k, v in rows.items() if k.startswith(b"c")}
+    assert len(ring) == N
+    seen, cur = set(), 0
+    for _ in range(N):
+        assert cur not in seen, f"torn destination ring: {ring}"
+        seen.add(cur)
+        cur = int(ring[b"c%02d" % cur].decode())
+    assert cur == 0
+
+    # Source logs no longer hold a floor for the DR tag.
+    from foundationdb_tpu.layers.dr import DR_TAG
+
+    for t in a.tlogs:
+        assert DR_TAG not in t.popped_tags
+
+    # Destination is usable for ordinary writes after the abort.
+    async def write(tr):
+        tr.set(b"after_abort", b"yes")
+
+    b.run_all([(dst, dst.run(write))])
+    assert dict(read_all(b, dst))[b"after_abort"] == b"yes"

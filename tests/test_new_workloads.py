@@ -14,6 +14,9 @@ from foundationdb_tpu.flow.knobs import g_knobs
 from foundationdb_tpu.server import SimCluster
 from foundationdb_tpu.workloads import (
     AtomicOpsWorkload,
+    ConflictRangeWorkload,
+    InventoryWorkload,
+    QueuePushWorkload,
     ConfigureDatabaseWorkload,
     ConsistencyChecker,
     CycleWorkload,
@@ -239,3 +242,115 @@ def test_backup_correctness_under_chaos(seed):
         quiet=True,
     )
     assert wl.restored_rows > 0
+
+
+def test_conflict_range_exactness():
+    """Conflicts occur exactly when the mutation intersects the OBSERVED
+    read extent — both spurious and missed conflicts fail (ref:
+    workloads/ConflictRange.actor.cpp)."""
+    c = SimCluster(seed=540, n_proxies=2, n_storages=2)
+    wl = ConflictRangeWorkload(iterations=40)
+    run_workloads(c, [wl], timeout_vt=30000.0)
+    assert wl.conflicts > 0 and wl.checked > wl.conflicts
+
+
+def test_inventory_and_queue_push_plain():
+    c = SimCluster(seed=541, n_proxies=2, n_storages=2)
+    run_workloads(
+        c,
+        [
+            InventoryWorkload(products=6, actors=3, moves=10),
+            QueuePushWorkload(actors=4, pushes=6),
+        ],
+        timeout_vt=30000.0,
+    )
+
+
+@pytest.mark.parametrize("seed", [545, 546])
+def test_inventory_queue_push_chaos(seed):
+    """Conservation + dense-queue invariants through clogging/attrition,
+    with the trailing consistency gate."""
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+
+    c = DynamicCluster(seed=seed, n_workers=7, n_proxies=2, n_storages=2,
+                       n_tlogs=2)
+    run_workloads(
+        c,
+        [
+            InventoryWorkload(products=5, actors=2, moves=8),
+            QueuePushWorkload(actors=3, pushes=5),
+            RandomCloggingWorkload(duration=6.0),
+            AttritionWorkload(kills=1),
+            ConsistencyChecker(),
+        ],
+        timeout_vt=60000.0,
+        quiet=True,
+    )
+
+
+def test_time_keeper_correctness():
+    """The CC's timeKeeper map: monotone samples, and timestamp->version
+    mapping never points past versions observed at that time (ref:
+    workloads/TimeKeeperCorrectness.actor.cpp)."""
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+    from foundationdb_tpu.workloads import TimeKeeperWorkload
+
+    c = DynamicCluster(seed=550, n_workers=7, n_proxies=2, n_storages=2)
+    run_workloads(c, [TimeKeeperWorkload(duration=12.0)], timeout_vt=30000.0)
+
+
+def test_restore_to_timestamp_uses_time_keeper():
+    """`fdbbackup restore --timestamp` semantics: map a wall-clock time
+    through the timeKeeper samples to a version, then PITR-restore at it
+    (ref: backup.actor.cpp:1828 timeKeeperVersionFromDatetime)."""
+    from foundationdb_tpu.client.management import version_from_timestamp
+    from foundationdb_tpu.flow.error import FdbError
+
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+
+    old_delay = g_knobs.server.time_keeper_delay
+    g_knobs.server.time_keeper_delay = 0.5
+    c = DynamicCluster(seed=551, n_workers=7, n_proxies=2, n_storages=2)
+    db = c.database()
+    marks = {}
+
+    async def drive():
+        loop = c.loop
+
+        async def w1(tr):
+            tr.set(b"tk/a", b"early")
+
+        await db.run(w1)
+        # Let the timekeeper lay down samples around the mark.  The MVCC
+        # window is ~5 virtual seconds (5M versions at 1M/s), so the whole
+        # mark->read span must stay well inside it.
+        await loop.delay(2.0)
+        marks["t_mid"] = loop.now()
+        await loop.delay(1.0)
+
+        async def w2(tr):
+            tr.set(b"tk/a", b"late")
+            tr.set(b"tk/b", b"new")
+
+        await db.run(w2)
+        await loop.delay(0.5)
+        v_mid = await version_from_timestamp(db, marks["t_mid"])
+        marks["v_mid"] = v_mid
+        # A read AT the mapped version sees the early state only.
+        tr = db.create_transaction()
+        tr.set_read_version(v_mid)
+        rows = await tr.get_range(b"tk/", b"tk0")
+        marks["rows_at_mid"] = rows
+        # Before the first sample: loudly unmappable.
+        try:
+            await version_from_timestamp(db, -1.0)
+            marks["early_raises"] = False
+        except FdbError as e:
+            marks["early_raises"] = e.name == "restore_error"
+
+    try:
+        c.run_until(db.process.spawn(drive(), "tk"), timeout_vt=60000.0)
+    finally:
+        g_knobs.server.time_keeper_delay = old_delay
+    assert marks["rows_at_mid"] == [(b"tk/a", b"early")]
+    assert marks["early_raises"] is True
