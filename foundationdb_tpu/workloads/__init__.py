@@ -36,6 +36,11 @@ from .conflict_range import ConflictRangeWorkload
 from .inventory import InventoryWorkload
 from .queue_push import QueuePushWorkload
 from .time_keeper import TimeKeeperWorkload
+from .ryow import RyowCorrectnessWorkload
+from .watch_and_wait import WatchAndWaitWorkload
+from .low_latency import LowLatencyWorkload
+from .status_workload import StatusWorkload
+from .bulk_load import BulkLoadWorkload
 
 __all__ = [
     "TestWorkload",
@@ -71,4 +76,9 @@ __all__ = [
     "InventoryWorkload",
     "QueuePushWorkload",
     "TimeKeeperWorkload",
+    "RyowCorrectnessWorkload",
+    "WatchAndWaitWorkload",
+    "LowLatencyWorkload",
+    "StatusWorkload",
+    "BulkLoadWorkload",
 ]

@@ -354,3 +354,72 @@ def test_restore_to_timestamp_uses_time_keeper():
         g_knobs.server.time_keeper_delay = old_delay
     assert marks["rows_at_mid"] == [(b"tk/a", b"early")]
     assert marks["early_raises"] is True
+
+
+def test_ryow_watchandwait_bulkload_plain():
+    """Single-txn ordered RYW semantics vs model, mass watches, batched
+    bulk load (ref: RyowCorrectness / WatchAndWait / BulkLoad)."""
+    from foundationdb_tpu.workloads import (
+        BulkLoadWorkload,
+        RyowCorrectnessWorkload,
+        WatchAndWaitWorkload,
+    )
+
+    c = SimCluster(seed=560, n_proxies=2, n_storages=2)
+    run_workloads(
+        c,
+        [
+            RyowCorrectnessWorkload(txns=8, ops_per_txn=20),
+            WatchAndWaitWorkload(watches=12),
+            BulkLoadWorkload(rows=200, batch=40),
+        ],
+        timeout_vt=60000.0,
+    )
+
+
+@pytest.mark.parametrize("seed", [565, 566])
+def test_status_lowlatency_under_chaos(seed):
+    """Status schema holds on every poll and interactive latency stays
+    bounded while clogging churns (ref: StatusWorkload / LowLatency)."""
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+    from foundationdb_tpu.workloads import (
+        BulkLoadWorkload,
+        LowLatencyWorkload,
+        StatusWorkload,
+    )
+
+    c = DynamicCluster(seed=seed, n_workers=7, n_proxies=2, n_storages=2)
+    run_workloads(
+        c,
+        [
+            LowLatencyWorkload(ops=30),
+            StatusWorkload(duration=6.0),
+            BulkLoadWorkload(rows=150, batch=30),
+            RandomCloggingWorkload(duration=4.0),
+            ConsistencyChecker(),
+        ],
+        timeout_vt=60000.0,
+        quiet=True,
+    )
+
+
+@pytest.mark.parametrize("seed", [570, 571])
+def test_ryow_under_chaos(seed):
+    """The ordered-semantics model must hold through retries and
+    recoveries (unknown results disambiguated by per-txn markers)."""
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+    from foundationdb_tpu.workloads import RyowCorrectnessWorkload
+
+    c = DynamicCluster(seed=seed, n_workers=7, n_proxies=2, n_storages=2,
+                       n_tlogs=2)
+    run_workloads(
+        c,
+        [
+            RyowCorrectnessWorkload(txns=6, ops_per_txn=15),
+            RandomCloggingWorkload(duration=3.0),
+            AttritionWorkload(kills=1),
+            ConsistencyChecker(),
+        ],
+        timeout_vt=60000.0,
+        quiet=True,
+    )
