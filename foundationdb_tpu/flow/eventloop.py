@@ -242,7 +242,11 @@ class EventLoop:
             if t > self._now:
                 self._now = t
             self.tasks_run += 1
-            if self.slow_task_threshold is None:
+            # Captured BEFORE the step: the step itself may toggle the
+            # profiler (a workload or the runtime-toggle RPC), and the
+            # comparison below must use the threshold this step ran under.
+            threshold = self.slow_task_threshold
+            if threshold is None:
                 fn()
                 return True
             # Slow-task profiler (ref: Net2's slow task profiling): a
@@ -251,7 +255,7 @@ class EventLoop:
             w0 = _perf_counter()
             fn()
             dt = _perf_counter() - w0
-            if dt >= self.slow_task_threshold:
+            if dt >= threshold:
                 from .trace import TraceEvent
 
                 TraceEvent("SlowTask", severity=20).detail(

@@ -72,6 +72,19 @@ def cluster_status(cluster) -> dict:
         proxy = next(
             (w.roles["proxy"] for w in cluster.workers if "proxy" in w.roles), None
         )
+        # Self-driving DD counters (ref: the data-distribution section of
+        # Status.actor.cpp + the DDMetrics workload reading it).
+        dd = getattr(cc, "dd_role", None) if cc else None
+        if dd is not None:
+            cl["data_distribution"] = {
+                "moves": dd.moves_done,
+                "heals": dd.heals_done,
+                "splits": dd.splits_done,
+                "merges": dd.merges_done,
+                "queued": len(dd._queue),
+                "in_flight": len(dd._inflight),
+                "failed_servers": sorted(dd.failed),
+            }
     else:  # SimCluster
         cl["recovery_state"] = {"name": "fully_recovered", "generation": 1}
         cl["roles"] = {

@@ -41,6 +41,9 @@ from .watch_and_wait import WatchAndWaitWorkload
 from .low_latency import LowLatencyWorkload
 from .status_workload import StatusWorkload
 from .bulk_load import BulkLoadWorkload
+from .slow_task import SlowTaskWorkload
+from .metric_logging import MetricLoggingWorkload
+from .dd_metrics import DDMetricsWorkload
 
 __all__ = [
     "TestWorkload",
@@ -81,4 +84,7 @@ __all__ = [
     "LowLatencyWorkload",
     "StatusWorkload",
     "BulkLoadWorkload",
+    "SlowTaskWorkload",
+    "MetricLoggingWorkload",
+    "DDMetricsWorkload",
 ]

@@ -1,0 +1,63 @@
+"""MetricLogging: TDMetric series land in the database and read back.
+
+Ref: fdbserver/workloads/MetricLogging.actor.cpp — drive counters while
+the metric logger flushes them into the `\xff/metrics` keyspace, then
+read the series back with ordinary transactions and check the
+multi-resolution contract: level-0 records every flush, level i records
+at most one sample per BASE_RESOLUTION*4^i seconds, every level's
+series is time-monotone, and the final value equals the counter.
+"""
+
+from __future__ import annotations
+
+from .base import TestWorkload
+
+
+class MetricLoggingWorkload(TestWorkload):
+    name = "metric_logging"
+
+    def __init__(self, flushes: int = 6):
+        self.flushes = flushes
+
+    async def start(self, db, cluster):
+        from ..client.metric_logger import (
+            BASE_RESOLUTION,
+            log_metrics_once,
+        )
+        from ..flow.stats import CounterCollection
+
+        loop = cluster.loop
+        coll = CounterCollection("wl_metrics")
+        self._coll = coll
+        for n in range(self.flushes):
+            coll.add("ops", 3)
+            coll.add("bytes", 100)
+            await log_metrics_once(db, [coll])
+            await loop.delay(BASE_RESOLUTION)
+
+    async def check(self, db, cluster) -> bool:
+        from ..client.metric_logger import (
+            BASE_RESOLUTION,
+            LEVELS,
+            read_metric_levels,
+            read_metrics,
+        )
+
+        series = await read_metrics(db, "wl_metrics")
+        assert set(series) == {"ops", "bytes"}, sorted(series)
+        ops0 = series["ops"]
+        assert len(ops0) == self.flushes, ops0
+        times = [t for t, _v in ops0]
+        vals = [v for _t, v in ops0]
+        assert times == sorted(times) and vals == sorted(vals)
+        assert vals[-1] == self._coll.counters["ops"].value
+
+        levels = await read_metric_levels(db, "wl_metrics", "ops")
+        assert len(levels) == LEVELS
+        for i, lv in enumerate(levels[1:], start=1):
+            period = BASE_RESOLUTION * (4 ** i)
+            for (t0, _), (t1, _) in zip(lv, lv[1:]):
+                assert t1 - t0 >= period, (
+                    f"level {i} sampled faster than {period}: {lv}"
+                )
+        return True

@@ -1,0 +1,52 @@
+"""SlowTaskWorkload: the slow-task profiler catches reactor hogs.
+
+Ref: fdbserver/workloads/SlowTaskWorkload.actor.cpp — deliberately burn
+the event loop inside one task and assert the runtime's slow-task
+profiler surfaced it (a SlowTask trace event with the wall cost).  The
+profiler is the production tool for "one actor stalls the whole
+process"; this workload is its liveness check.
+"""
+
+from __future__ import annotations
+
+import time
+
+from .base import TestWorkload
+
+
+class SlowTaskWorkload(TestWorkload):
+    name = "slow_task"
+
+    def __init__(self, burn_wall_s: float = 0.01):
+        self.burn_wall_s = burn_wall_s
+
+    async def start(self, db, cluster):
+        from ..flow.trace import global_collector
+
+        loop = cluster.loop
+        self._collector = global_collector()
+        self._before = len(self._collector.find("SlowTask"))
+        old = loop.slow_task_threshold
+        loop.slow_task_threshold = self.burn_wall_s / 4
+        try:
+            # One loop step that burns real wall clock: exactly what the
+            # profiler exists to catch.
+            async def hog():
+                t0 = time.perf_counter()
+                while time.perf_counter() - t0 < self.burn_wall_s:
+                    sum(range(500))
+
+            await db.process.spawn(hog(), "deliberate_hog")
+            await loop.delay(0.01)
+        finally:
+            loop.slow_task_threshold = old
+
+    async def check(self, db, cluster) -> bool:
+        events = self._collector.find("SlowTask")
+        fresh = events[self._before:]
+        assert fresh, "slow-task profiler missed a deliberate reactor hog"
+        assert any(
+            e.get("wall_seconds", 0) >= self.burn_wall_s / 4
+            for e in fresh
+        ), f"SlowTask events lack the wall cost: {fresh[:2]}"
+        return True

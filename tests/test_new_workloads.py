@@ -423,3 +423,31 @@ def test_ryow_under_chaos(seed):
         timeout_vt=60000.0,
         quiet=True,
     )
+
+
+def test_slowtask_metriclogging_plain():
+    """Aux-subsystem workloads: the slow-task profiler catches a
+    deliberate reactor hog; TDMetric series flush into \\xff/metrics and
+    read back with the multi-resolution contract intact (ref:
+    SlowTaskWorkload / MetricLogging workloads)."""
+    from foundationdb_tpu.workloads import (
+        MetricLoggingWorkload,
+        SlowTaskWorkload,
+    )
+
+    c = SimCluster(seed=580, n_proxies=2, n_storages=2)
+    run_workloads(
+        c,
+        [SlowTaskWorkload(), MetricLoggingWorkload(flushes=5)],
+        timeout_vt=60000.0,
+    )
+
+
+def test_dd_metrics_through_status():
+    """DD split/move activity driven by a hot range is visible through
+    the status document (ref: DDMetrics workload)."""
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+    from foundationdb_tpu.workloads import DDMetricsWorkload
+
+    c = DynamicCluster(seed=585, n_workers=7, n_proxies=2, n_storages=2)
+    run_workloads(c, [DDMetricsWorkload()], timeout_vt=60000.0)
