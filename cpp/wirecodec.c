@@ -1,0 +1,577 @@
+/* C accelerator for the versioned tagged wire codec (rpc/wire.py).
+ *
+ * Byte-identical to the Python reference implementation: same tags,
+ * varint/zigzag forms, depth cap, bounds checks, and error taxonomy
+ * (WireEncodeError / WireDecodeError, supplied by Python at configure()).
+ * Values outside the C fast path's range (ints beyond 64 bits) raise the
+ * supplied Fallback exception; the Python wrapper retries the whole frame
+ * with the pure-Python codec, so behavior is unchanged — only speed.
+ *
+ * The registry (struct/enum vocabularies) is handed over as dicts at
+ * configure(); decode constructs data only — struct instantiation is a
+ * positional dataclass call, enum construction a class call, exactly as
+ * the Python decoder does.
+ *
+ * Built on demand into cpp/_fdb_wirecodec.so (see rpc/wire_native.py);
+ * CPython limited-to-this-interpreter API (not abi3) for speed.
+ */
+#define PY_SSIZE_T_CLEAN
+#include <Python.h>
+#include <stdint.h>
+#include <string.h>
+
+enum {
+    T_NONE = 0, T_TRUE = 1, T_FALSE = 2, T_INT = 3, T_FLOAT = 4,
+    T_BYTES = 5, T_STR = 6, T_LIST = 7, T_TUPLE = 8, T_DICT = 9,
+    T_STRUCT = 10, T_ENUM = 11,
+};
+#define WIRE_VERSION 1
+#define MAX_DEPTH 64
+#define MAX_VARINT_BYTES 16
+
+/* configure()-supplied state */
+static PyObject *g_struct_by_id;   /* cid(int) -> (cls, (names...), min_req) */
+static PyObject *g_enum_by_id;     /* cid(int) -> cls */
+static PyObject *g_struct_ids;     /* cls -> (cid, (names...)) */
+static PyObject *g_enum_ids;       /* cls -> cid */
+static PyObject *g_enc_err;        /* WireEncodeError */
+static PyObject *g_dec_err;        /* WireDecodeError */
+static PyObject *g_fallback;       /* _CFallback */
+static PyObject *g_intenum;        /* enum.IntEnum */
+static PyObject *g_is_dataclass;   /* dataclasses.is_dataclass */
+
+/* ---------------- growable output buffer ---------------- */
+
+typedef struct {
+    char *data;
+    Py_ssize_t len, cap;
+} Buf;
+
+static int buf_init(Buf *b, Py_ssize_t cap) {
+    b->data = PyMem_Malloc(cap);
+    if (!b->data) { PyErr_NoMemory(); return -1; }
+    b->len = 0; b->cap = cap;
+    return 0;
+}
+
+static int buf_reserve(Buf *b, Py_ssize_t extra) {
+    if (b->len + extra <= b->cap) return 0;
+    Py_ssize_t ncap = b->cap * 2;
+    while (ncap < b->len + extra) ncap *= 2;
+    char *nd = PyMem_Realloc(b->data, ncap);
+    if (!nd) { PyErr_NoMemory(); return -1; }
+    b->data = nd; b->cap = ncap;
+    return 0;
+}
+
+static inline int buf_byte(Buf *b, unsigned char c) {
+    if (buf_reserve(b, 1) < 0) return -1;
+    b->data[b->len++] = (char)c;
+    return 0;
+}
+
+static inline int buf_write(Buf *b, const char *p, Py_ssize_t n) {
+    if (buf_reserve(b, n) < 0) return -1;
+    memcpy(b->data + b->len, p, n);
+    b->len += n;
+    return 0;
+}
+
+static int buf_varint(Buf *b, uint64_t n) {
+    do {
+        unsigned char c = n & 0x7F;
+        n >>= 7;
+        if (n) c |= 0x80;
+        if (buf_byte(b, c) < 0) return -1;
+    } while (n);
+    return 0;
+}
+
+static inline uint64_t zigzag64(int64_t n) {
+    return ((uint64_t)n << 1) ^ (uint64_t)(n >> 63);
+}
+
+static inline int64_t unzigzag64(uint64_t z) {
+    return (int64_t)(z >> 1) ^ -(int64_t)(z & 1);
+}
+
+/* ---------------- encode ---------------- */
+
+static int enc_value(Buf *b, PyObject *v, int depth);
+
+static int enc_buffer_like(Buf *b, PyObject *v) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(v, &view, PyBUF_SIMPLE) < 0) {
+        /* e.g. a non-contiguous memoryview: the Python reference copies
+         * it via bytes(v); stay behavior-identical through fallback. */
+        PyErr_Clear();
+        PyErr_SetString(g_fallback, "non-simple buffer");
+        return -1;
+    }
+    int rc = -1;
+    if (buf_byte(b, T_BYTES) == 0 &&
+        buf_varint(b, (uint64_t)view.len) == 0 &&
+        buf_write(b, view.buf, view.len) == 0)
+        rc = 0;
+    PyBuffer_Release(&view);
+    return rc;
+}
+
+static int enc_long(Buf *b, PyObject *v) {
+    int overflow = 0;
+    long long n = PyLong_AsLongLongAndOverflow(v, &overflow);
+    if (overflow) {
+        PyErr_SetString(g_fallback, "int beyond 64 bits");
+        return -1;
+    }
+    if (n == -1 && PyErr_Occurred()) return -1;
+    if (buf_byte(b, T_INT) < 0) return -1;
+    return buf_varint(b, zigzag64((int64_t)n));
+}
+
+static int enc_value(Buf *b, PyObject *v, int depth) {
+    if (depth > MAX_DEPTH) {
+        PyErr_SetString(g_enc_err, "nesting too deep");
+        return -1;
+    }
+    if (v == Py_None) return buf_byte(b, T_NONE);
+    if (v == Py_True) return buf_byte(b, T_TRUE);
+    if (v == Py_False) return buf_byte(b, T_FALSE);
+
+    PyTypeObject *tp = Py_TYPE(v);
+    if (PyLong_Check(v)) {
+        if (!PyLong_CheckExact(v)) {
+            /* Registered IntEnum member, or an unregistered one (error) */
+            PyObject *cid = PyDict_GetItem(g_enum_ids, (PyObject *)tp);
+            if (cid != NULL) {
+                long c = PyLong_AsLong(cid);
+                int overflow = 0;
+                long long n = PyLong_AsLongLongAndOverflow(v, &overflow);
+                if (overflow || (n == -1 && PyErr_Occurred())) {
+                    PyErr_SetString(g_fallback, "enum beyond 64 bits");
+                    return -1;
+                }
+                unsigned char hdr[3] = {
+                    T_ENUM, (unsigned char)((c >> 8) & 0xFF),
+                    (unsigned char)(c & 0xFF),
+                };
+                if (buf_write(b, (char *)hdr, 3) < 0) return -1;
+                return buf_varint(b, zigzag64((int64_t)n));
+            }
+            int is_enum = PyObject_IsInstance(v, g_intenum);
+            if (is_enum < 0) return -1;
+            if (is_enum) {
+                PyErr_Format(g_enc_err, "unregistered enum %s",
+                             tp->tp_name);
+                return -1;
+            }
+            /* plain int subclass (incl. bool handled above) */
+        }
+        return enc_long(b, v);
+    }
+    if (PyFloat_Check(v)) {
+        double d = PyFloat_AS_DOUBLE(v);
+        uint64_t u;
+        memcpy(&u, &d, 8);
+        unsigned char be[9];
+        be[0] = T_FLOAT;
+        for (int i = 0; i < 8; i++)
+            be[1 + i] = (unsigned char)((u >> (8 * (7 - i))) & 0xFF);
+        return buf_write(b, (char *)be, 9);
+    }
+    if (PyBytes_Check(v)) {
+        if (buf_byte(b, T_BYTES) < 0) return -1;
+        Py_ssize_t n = PyBytes_GET_SIZE(v);
+        if (buf_varint(b, (uint64_t)n) < 0) return -1;
+        return buf_write(b, PyBytes_AS_STRING(v), n);
+    }
+    if (PyByteArray_Check(v) || PyMemoryView_Check(v))
+        return enc_buffer_like(b, v);
+    if (PyUnicode_Check(v)) {
+        Py_ssize_t n;
+        const char *s = PyUnicode_AsUTF8AndSize(v, &n);
+        if (!s) return -1;
+        if (buf_byte(b, T_STR) < 0) return -1;
+        if (buf_varint(b, (uint64_t)n) < 0) return -1;
+        return buf_write(b, s, n);
+    }
+    if (PyList_Check(v)) {
+        Py_ssize_t n = PyList_GET_SIZE(v);
+        if (buf_byte(b, T_LIST) < 0 || buf_varint(b, (uint64_t)n) < 0)
+            return -1;
+        for (Py_ssize_t i = 0; i < n; i++)
+            if (enc_value(b, PyList_GET_ITEM(v, i), depth + 1) < 0)
+                return -1;
+        return 0;
+    }
+    if (PyTuple_Check(v)) {
+        Py_ssize_t n = PyTuple_GET_SIZE(v);
+        if (buf_byte(b, T_TUPLE) < 0 || buf_varint(b, (uint64_t)n) < 0)
+            return -1;
+        for (Py_ssize_t i = 0; i < n; i++)
+            if (enc_value(b, PyTuple_GET_ITEM(v, i), depth + 1) < 0)
+                return -1;
+        return 0;
+    }
+    if (PyDict_Check(v)) {
+        if (buf_byte(b, T_DICT) < 0 ||
+            buf_varint(b, (uint64_t)PyDict_GET_SIZE(v)) < 0)
+            return -1;
+        PyObject *k, *val;
+        Py_ssize_t pos = 0;
+        while (PyDict_Next(v, &pos, &k, &val)) {
+            if (enc_value(b, k, depth + 1) < 0) return -1;
+            if (enc_value(b, val, depth + 1) < 0) return -1;
+        }
+        return 0;
+    }
+    /* registered struct? */
+    {
+        PyObject *entry = PyDict_GetItem(g_struct_ids, (PyObject *)tp);
+        if (entry != NULL) {
+            long cid = PyLong_AsLong(PyTuple_GET_ITEM(entry, 0));
+            PyObject *names = PyTuple_GET_ITEM(entry, 1);
+            Py_ssize_t n = PyTuple_GET_SIZE(names);
+            unsigned char hdr[3] = {
+                T_STRUCT, (unsigned char)((cid >> 8) & 0xFF),
+                (unsigned char)(cid & 0xFF),
+            };
+            if (buf_write(b, (char *)hdr, 3) < 0) return -1;
+            if (buf_varint(b, (uint64_t)n) < 0) return -1;
+            for (Py_ssize_t i = 0; i < n; i++) {
+                PyObject *fv =
+                    PyObject_GetAttr(v, PyTuple_GET_ITEM(names, i));
+                if (!fv) return -1;
+                int rc = enc_value(b, fv, depth + 1);
+                Py_DECREF(fv);
+                if (rc < 0) return -1;
+            }
+            return 0;
+        }
+    }
+    {
+        PyObject *isdc =
+            PyObject_CallFunctionObjArgs(g_is_dataclass, v, NULL);
+        if (!isdc) return -1;
+        int truthy = PyObject_IsTrue(isdc);
+        Py_DECREF(isdc);
+        if (truthy < 0) return -1;
+        if (truthy) {
+            PyErr_Format(g_enc_err, "unregistered struct %s", tp->tp_name);
+            return -1;
+        }
+    }
+    PyErr_Format(g_enc_err, "type %s is not in the wire vocabulary",
+                 tp->tp_name);
+    return -1;
+}
+
+static PyObject *py_encode(PyObject *self, PyObject *arg) {
+    Buf b;
+    if (buf_init(&b, 256) < 0) return NULL;
+    if (buf_byte(&b, WIRE_VERSION) < 0 || enc_value(&b, arg, 0) < 0) {
+        PyMem_Free(b.data);
+        return NULL;
+    }
+    PyObject *out = PyBytes_FromStringAndSize(b.data, b.len);
+    PyMem_Free(b.data);
+    return out;
+}
+
+/* ---------------- decode ---------------- */
+
+typedef struct {
+    const unsigned char *buf;
+    Py_ssize_t pos, end;
+} Rd;
+
+static int rd_byte(Rd *r, unsigned char *out) {
+    if (r->pos >= r->end) {
+        PyErr_SetString(g_dec_err, "truncated frame");
+        return -1;
+    }
+    *out = r->buf[r->pos++];
+    return 0;
+}
+
+static int rd_take(Rd *r, Py_ssize_t n, const unsigned char **out) {
+    if (n < 0 || r->end - r->pos < n) {
+        PyErr_SetString(g_dec_err, "truncated frame");
+        return -1;
+    }
+    *out = r->buf + r->pos;
+    r->pos += n;
+    return 0;
+}
+
+/* Python accepts varints up to 112 bits (arbitrary-precision result);
+ * the C fast path covers 64 bits and signals fallback beyond. */
+static int rd_varint(Rd *r, uint64_t *out) {
+    uint64_t n = 0;
+    int shift = 0;
+    for (int i = 0; i < MAX_VARINT_BYTES; i++) {
+        unsigned char c;
+        if (rd_byte(r, &c) < 0) return -1;
+        if (shift >= 64 && (c & 0x7F)) {
+            PyErr_SetString(g_fallback, "varint beyond 64 bits");
+            return -1;
+        }
+        if (shift < 64) {
+            if (shift > 0 && (c & 0x7F) &&
+                ((uint64_t)(c & 0x7F) << shift) >> shift !=
+                    (uint64_t)(c & 0x7F)) {
+                PyErr_SetString(g_fallback, "varint beyond 64 bits");
+                return -1;
+            }
+            n |= (uint64_t)(c & 0x7F) << shift;
+        }
+        if (!(c & 0x80)) {
+            *out = n;
+            return 0;
+        }
+        shift += 7;
+    }
+    PyErr_SetString(g_dec_err, "varint too long");
+    return -1;
+}
+
+static PyObject *dec_value(Rd *r, int depth) {
+    if (depth > MAX_DEPTH) {
+        PyErr_SetString(g_dec_err, "nesting too deep");
+        return NULL;
+    }
+    unsigned char tag;
+    if (rd_byte(r, &tag) < 0) return NULL;
+    switch (tag) {
+    case T_NONE: Py_RETURN_NONE;
+    case T_TRUE: Py_RETURN_TRUE;
+    case T_FALSE: Py_RETURN_FALSE;
+    case T_INT: {
+        uint64_t z;
+        if (rd_varint(r, &z) < 0) return NULL;
+        return PyLong_FromLongLong(unzigzag64(z));
+    }
+    case T_FLOAT: {
+        const unsigned char *p;
+        if (rd_take(r, 8, &p) < 0) return NULL;
+        uint64_t u = 0;
+        for (int i = 0; i < 8; i++) u = (u << 8) | p[i];
+        double d;
+        memcpy(&d, &u, 8);
+        return PyFloat_FromDouble(d);
+    }
+    case T_BYTES: {
+        uint64_t n;
+        const unsigned char *p;
+        if (rd_varint(r, &n) < 0) return NULL;
+        if (n > (uint64_t)PY_SSIZE_T_MAX ||
+            rd_take(r, (Py_ssize_t)n, &p) < 0)
+            return n > (uint64_t)PY_SSIZE_T_MAX
+                       ? (PyErr_SetString(g_dec_err, "truncated frame"),
+                          NULL)
+                       : NULL;
+        return PyBytes_FromStringAndSize((const char *)p, (Py_ssize_t)n);
+    }
+    case T_STR: {
+        uint64_t n;
+        const unsigned char *p;
+        if (rd_varint(r, &n) < 0) return NULL;
+        if (n > (uint64_t)PY_SSIZE_T_MAX) {
+            PyErr_SetString(g_dec_err, "truncated frame");
+            return NULL;
+        }
+        if (rd_take(r, (Py_ssize_t)n, &p) < 0) return NULL;
+        PyObject *s =
+            PyUnicode_DecodeUTF8((const char *)p, (Py_ssize_t)n, NULL);
+        if (!s) {
+            PyErr_Clear();
+            PyErr_SetString(g_dec_err, "bad utf-8");
+            return NULL;
+        }
+        return s;
+    }
+    case T_LIST:
+    case T_TUPLE: {
+        uint64_t n;
+        if (rd_varint(r, &n) < 0) return NULL;
+        if (n > (uint64_t)(r->end - r->pos)) {
+            PyErr_SetString(g_dec_err, "length exceeds frame");
+            return NULL;
+        }
+        PyObject *out = (tag == T_LIST) ? PyList_New((Py_ssize_t)n)
+                                        : PyTuple_New((Py_ssize_t)n);
+        if (!out) return NULL;
+        for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {
+            PyObject *item = dec_value(r, depth + 1);
+            if (!item) { Py_DECREF(out); return NULL; }
+            if (tag == T_LIST) PyList_SET_ITEM(out, i, item);
+            else PyTuple_SET_ITEM(out, i, item);
+        }
+        return out;
+    }
+    case T_DICT: {
+        uint64_t n;
+        if (rd_varint(r, &n) < 0) return NULL;
+        if (n > (uint64_t)(r->end - r->pos) / 2 + 1 &&
+            n * 2 > (uint64_t)(r->end - r->pos)) {
+            PyErr_SetString(g_dec_err, "length exceeds frame");
+            return NULL;
+        }
+        PyObject *out = PyDict_New();
+        if (!out) return NULL;
+        for (uint64_t i = 0; i < n; i++) {
+            PyObject *k = dec_value(r, depth + 1);
+            if (!k) { Py_DECREF(out); return NULL; }
+            PyObject *val = dec_value(r, depth + 1);
+            if (!val) { Py_DECREF(k); Py_DECREF(out); return NULL; }
+            int rc = PyDict_SetItem(out, k, val);
+            Py_DECREF(k);
+            Py_DECREF(val);
+            if (rc < 0) {
+                if (PyErr_ExceptionMatches(PyExc_TypeError)) {
+                    PyErr_Clear();
+                    PyErr_SetString(g_dec_err, "bad dict key");
+                }
+                Py_DECREF(out);
+                return NULL;
+            }
+        }
+        return out;
+    }
+    case T_ENUM: {
+        const unsigned char *p;
+        uint64_t z;
+        if (rd_take(r, 2, &p) < 0) return NULL;
+        long cid = ((long)p[0] << 8) | p[1];
+        if (rd_varint(r, &z) < 0) return NULL;
+        PyObject *key = PyLong_FromLong(cid);
+        PyObject *cls = PyDict_GetItem(g_enum_by_id, key); /* borrowed */
+        Py_DECREF(key);
+        if (cls == NULL) {
+            PyErr_Format(g_dec_err, "unknown enum id 0x%x", (unsigned int)cid);
+            return NULL;
+        }
+        PyObject *out =
+            PyObject_CallFunction(cls, "L", (long long)unzigzag64(z));
+        if (!out) {
+            if (PyErr_ExceptionMatches(PyExc_ValueError)) {
+                PyErr_Clear();
+                PyErr_SetString(g_dec_err, "invalid enum value");
+            }
+            return NULL;
+        }
+        return out;
+    }
+    case T_STRUCT: {
+        const unsigned char *p;
+        uint64_t n;
+        if (rd_take(r, 2, &p) < 0) return NULL;
+        long cid = ((long)p[0] << 8) | p[1];
+        PyObject *key = PyLong_FromLong(cid);
+        PyObject *entry = PyDict_GetItem(g_struct_by_id, key);
+        Py_DECREF(key);
+        if (entry == NULL) {
+            PyErr_Format(g_dec_err, "unknown struct id 0x%x", (unsigned int)cid);
+            return NULL;
+        }
+        PyObject *cls = PyTuple_GET_ITEM(entry, 0);
+        PyObject *names = PyTuple_GET_ITEM(entry, 1);
+        long min_req = PyLong_AsLong(PyTuple_GET_ITEM(entry, 2));
+        Py_ssize_t known = PyTuple_GET_SIZE(names);
+        if (rd_varint(r, &n) < 0) return NULL;
+        if ((Py_ssize_t)n > known) {
+            PyErr_Format(g_dec_err,
+                         "%s: peer sent %zd fields, we know %zd",
+                         ((PyTypeObject *)cls)->tp_name, (Py_ssize_t)n,
+                         known);
+            return NULL;
+        }
+        if ((long)n < min_req) {
+            PyErr_Format(g_dec_err, "%s: missing field with no default",
+                         ((PyTypeObject *)cls)->tp_name);
+            return NULL;
+        }
+        PyObject *args = PyTuple_New((Py_ssize_t)n);
+        if (!args) return NULL;
+        for (Py_ssize_t i = 0; i < (Py_ssize_t)n; i++) {
+            PyObject *fv = dec_value(r, depth + 1);
+            if (!fv) { Py_DECREF(args); return NULL; }
+            PyTuple_SET_ITEM(args, i, fv);
+        }
+        PyObject *out = PyObject_CallObject(cls, args);
+        Py_DECREF(args);
+        if (!out) {
+            if (PyErr_ExceptionMatches(PyExc_TypeError) ||
+                PyErr_ExceptionMatches(PyExc_ValueError)) {
+                PyErr_Clear();
+                PyErr_Format(g_dec_err, "%s: construction failed",
+                             ((PyTypeObject *)cls)->tp_name);
+            }
+            return NULL;
+        }
+        return out;
+    }
+    default:
+        PyErr_Format(g_dec_err, "unknown tag %d", (int)tag);
+        return NULL;
+    }
+}
+
+static PyObject *py_decode(PyObject *self, PyObject *arg) {
+    Py_buffer view;
+    if (PyObject_GetBuffer(arg, &view, PyBUF_SIMPLE) < 0) return NULL;
+    Rd r = {(const unsigned char *)view.buf, 0, view.len};
+    unsigned char ver;
+    PyObject *out = NULL;
+    if (rd_byte(&r, &ver) < 0) goto done;
+    if (ver != WIRE_VERSION) {
+        PyErr_Format(g_dec_err, "wire version %d != %d", (int)ver,
+                     WIRE_VERSION);
+        goto done;
+    }
+    out = dec_value(&r, 0);
+    if (out && r.pos != r.end) {
+        Py_CLEAR(out);
+        PyErr_Format(g_dec_err, "%zd trailing bytes", r.end - r.pos);
+    }
+done:
+    PyBuffer_Release(&view);
+    return out;
+}
+
+/* ---------------- configure ---------------- */
+
+static PyObject *py_configure(PyObject *self, PyObject *args) {
+    PyObject *sbi, *ebi, *sid, *eid, *ee, *de, *fb, *ie, *isdc;
+    if (!PyArg_ParseTuple(args, "OOOOOOOOO", &sbi, &ebi, &sid, &eid, &ee,
+                          &de, &fb, &ie, &isdc))
+        return NULL;
+    Py_XDECREF(g_struct_by_id); Py_INCREF(sbi); g_struct_by_id = sbi;
+    Py_XDECREF(g_enum_by_id); Py_INCREF(ebi); g_enum_by_id = ebi;
+    Py_XDECREF(g_struct_ids); Py_INCREF(sid); g_struct_ids = sid;
+    Py_XDECREF(g_enum_ids); Py_INCREF(eid); g_enum_ids = eid;
+    Py_XDECREF(g_enc_err); Py_INCREF(ee); g_enc_err = ee;
+    Py_XDECREF(g_dec_err); Py_INCREF(de); g_dec_err = de;
+    Py_XDECREF(g_fallback); Py_INCREF(fb); g_fallback = fb;
+    Py_XDECREF(g_intenum); Py_INCREF(ie); g_intenum = ie;
+    Py_XDECREF(g_is_dataclass); Py_INCREF(isdc); g_is_dataclass = isdc;
+    Py_RETURN_NONE;
+}
+
+static PyMethodDef methods[] = {
+    {"configure", py_configure, METH_VARARGS,
+     "configure(struct_by_id, enum_by_id, struct_ids, enum_ids, "
+     "WireEncodeError, WireDecodeError, Fallback, IntEnum, is_dataclass)"},
+    {"encode", py_encode, METH_O, "encode(value) -> frame bytes"},
+    {"decode", py_decode, METH_O, "decode(frame bytes) -> value"},
+    {NULL, NULL, 0, NULL},
+};
+
+static struct PyModuleDef module = {
+    PyModuleDef_HEAD_INIT, "_fdb_wirecodec",
+    "C fast path for the fdb-tpu wire codec", -1, methods,
+};
+
+PyMODINIT_FUNC PyInit__fdb_wirecodec(void) {
+    return PyModule_Create(&module);
+}

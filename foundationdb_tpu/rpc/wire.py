@@ -246,12 +246,90 @@ def _encode(out: List[bytes], v: Any, depth: int):
         )
 
 
-def encode_frame(value: Any) -> bytes:
-    """value -> one wire frame body (caller adds the length prefix)."""
+def encode_frame_py(value: Any) -> bytes:
+    """Pure-Python encode (the reference implementation; also the
+    fallback for values outside the C fast path's 64-bit int range)."""
     _build_registry()
     out: List[bytes] = [bytes((WIRE_VERSION,))]
     _encode(out, value, 0)
     return b"".join(out)
+
+
+# --- optional C accelerator ----------------------------------------------
+#
+# cpp/wirecodec.c implements the SAME format; differential-fuzzed against
+# the Python reference (tests/test_wire.py).  Loaded lazily with the
+# registry; registry growth (late register_struct) re-configures it.
+
+import os as _os
+
+_c_mod = None
+_c_stamp = -1
+# Process configuration, read once: set FDB_TPU_WIRE_PY=1 to force the
+# pure-Python codec (A/B baselines, debugging).
+_C_DISABLED = bool(_os.environ.get("FDB_TPU_WIRE_PY"))
+
+
+class _CFallbackSignal(Exception):
+    """Raised by the C codec for frames it cannot represent."""
+
+
+def _c_codec():
+    global _c_mod, _c_stamp, _C_DISABLED
+    if _C_DISABLED:
+        return None
+    stamp = len(_structs_by_id) + len(_enums_by_id)
+    if _c_mod is not None and stamp == _c_stamp:
+        return _c_mod
+    if _c_mod is None:
+        from .wire_native import load
+
+        _c_mod = load()
+        if _c_mod is None:
+            _C_DISABLED = True  # build failed; never retry this process
+            return None
+    import dataclasses as _dc
+    from enum import IntEnum as _IE
+
+    struct_by_id = {}
+    for cid, (cls, flds) in _structs_by_id.items():
+        names = tuple(f.name for f in flds)
+        min_req = 0
+        for i, f in enumerate(flds):
+            if (
+                f.default is _dc.MISSING
+                and f.default_factory is _dc.MISSING
+            ):
+                min_req = i + 1
+        struct_by_id[cid] = (cls, names, min_req)
+    struct_ids = {
+        cls: (cid, struct_by_id[cid][1]) for cls, cid in _struct_ids.items()
+    }
+    _c_mod.configure(
+        struct_by_id,
+        dict(_enums_by_id),
+        struct_ids,
+        dict(_enum_ids),
+        WireEncodeError,
+        WireDecodeError,
+        _CFallbackSignal,
+        _IE,
+        _dc.is_dataclass,
+    )
+    _c_stamp = stamp
+    return _c_mod
+
+
+def encode_frame(value: Any) -> bytes:
+    """value -> one wire frame body (caller adds the length prefix)."""
+    _build_registry()
+    c = _c_codec()
+    if c is not None:
+        try:
+            return c.encode(value)
+        except _CFallbackSignal:
+            pass
+    return encode_frame_py(value)
 
 
 # --- decoding -------------------------------------------------------------
@@ -369,8 +447,8 @@ def _decode(r: _Reader, depth: int) -> Any:
     raise WireDecodeError(f"unknown tag {tag}")
 
 
-def decode_frame(frame: bytes) -> Any:
-    """One frame body -> value.  Raises WireDecodeError and nothing else."""
+def decode_frame_py(frame: bytes) -> Any:
+    """Pure-Python decode (reference implementation / C fallback)."""
     _build_registry()
     r = _Reader(frame)
     ver = r.byte()
@@ -380,3 +458,15 @@ def decode_frame(frame: bytes) -> Any:
     if r.pos != r.end:
         raise WireDecodeError(f"{r.end - r.pos} trailing bytes")
     return v
+
+
+def decode_frame(frame: bytes) -> Any:
+    """One frame body -> value.  Raises WireDecodeError and nothing else."""
+    _build_registry()
+    c = _c_codec()
+    if c is not None:
+        try:
+            return c.decode(frame)
+        except _CFallbackSignal:
+            pass
+    return decode_frame_py(frame)

@@ -1,0 +1,55 @@
+"""Build + load the C wire-codec accelerator (cpp/wirecodec.c).
+
+Same on-demand g++ pattern as the native kv engine.  The extension is
+OPTIONAL: any build or import failure leaves the pure-Python codec in
+charge (correctness never depends on the accelerator).  For values the
+C fast path cannot represent (ints beyond 64 bits), the extension
+raises the fallback signal wire.py hands it at configure()
+(wire._CFallbackSignal), and the frame is retried in pure Python.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+import os
+import subprocess
+import sysconfig
+
+_REPO = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+_SRC = os.path.join(_REPO, "cpp", "wirecodec.c")
+_LIB = os.path.join(_REPO, "cpp", "_fdb_wirecodec.so")
+
+
+def load():
+    """The configured-but-unregistered extension module, or None."""
+    try:
+        if (
+            not os.path.exists(_LIB)
+            or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+        ):
+            inc = sysconfig.get_paths()["include"]
+            # Build to a private temp path and rename into place:
+            # concurrent processes racing an in-place gcc write could
+            # dlopen a half-written .so (and cache the corruption via its
+            # fresh mtime).  rename() is atomic on the same filesystem.
+            tmp = f"{_LIB}.tmp.{os.getpid()}"
+            subprocess.run(
+                [
+                    "gcc", "-O2", "-shared", "-fPIC",
+                    f"-I{inc}", "-o", tmp, _SRC,
+                ],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+            os.replace(tmp, _LIB)
+        spec = importlib.util.spec_from_file_location(
+            "_fdb_wirecodec", _LIB
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None

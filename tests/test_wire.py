@@ -258,3 +258,140 @@ def test_resolver_batch_roundtrip():
         proxy_id="proxy0",
     )
     roundtrip(req)
+
+
+# --- C accelerator differential (cpp/wirecodec.c) -------------------------
+
+
+def _c_active():
+    import foundationdb_tpu.rpc.wire as wire
+
+    encode_frame(0)  # force registry + C load
+    return wire._c_codec() is not None
+
+
+def _rand_value(rng, depth=0):
+    import numpy as np
+
+    kinds = 12 if depth < 4 else 8  # leaves only when deep
+    k = int(rng.integers(kinds))
+    if k == 0:
+        return None
+    if k == 1:
+        return bool(rng.integers(2))
+    if k == 2:
+        # includes 64-bit edges and beyond-64-bit (C falls back)
+        choice = int(rng.integers(5))
+        if choice == 0:
+            return int(rng.integers(-(2**62), 2**62))
+        if choice == 1:
+            return (1 << 63) - 1
+        if choice == 2:
+            return -(1 << 63)
+        if choice == 3:
+            return (1 << 80) + int(rng.integers(100))  # fallback path
+        return int(rng.integers(-100, 100))
+    if k == 3:
+        return float(rng.normal())
+    if k == 4:
+        return bytes(rng.integers(0, 256, int(rng.integers(30)),
+                                  dtype=np.uint8))
+    if k == 5:
+        return "".join(
+            chr(int(rng.integers(1, 0x300))) for _ in range(int(rng.integers(8)))
+        )
+    if k == 6:
+        return Mutation(
+            MutationType(int(rng.integers(0, 2))),
+            bytes(rng.integers(97, 123, 4, dtype=np.uint8)),
+            bytes(rng.integers(97, 123, 6, dtype=np.uint8)),
+        )
+    if k == 7:
+        return MutationType(int(rng.integers(0, 2)))
+    if k == 8:
+        return [_rand_value(rng, depth + 1) for _ in range(int(rng.integers(4)))]
+    if k == 9:
+        return tuple(
+            _rand_value(rng, depth + 1) for _ in range(int(rng.integers(4)))
+        )
+    if k == 10:
+        return {
+            int(rng.integers(1000)): _rand_value(rng, depth + 1)
+            for _ in range(int(rng.integers(4)))
+        }
+    return Endpoint(address="h:%d" % int(rng.integers(9)), token=int(rng.integers(99)))
+
+
+def test_c_codec_differential_fuzz():
+    """The C accelerator must be BYTE-identical to the Python reference on
+    encode and value-identical on decode, across randomized nested values
+    including structs, enums, and beyond-64-bit ints (C fallback path)."""
+    import numpy as np
+
+    from foundationdb_tpu.rpc.wire import decode_frame_py, encode_frame_py
+
+    if not _c_active():
+        pytest.skip("C codec unavailable")
+    rng = np.random.default_rng(20260731)
+    for i in range(500):
+        v = _rand_value(rng)
+        cf = encode_frame(v)  # C (with py fallback for big ints)
+        pf = encode_frame_py(v)
+        assert cf == pf, f"iter {i}: C/py encodings differ for {v!r}"
+        a = decode_frame(pf)  # C decode
+        b = decode_frame_py(pf)
+        assert a == b, f"iter {i}: C/py decode differ"
+
+
+def test_c_codec_malformed_agreement():
+    """On mutated frames, the C and Python decoders must AGREE: both raise
+    WireDecodeError, or both succeed with equal values (the C fallback
+    signal never escapes)."""
+    import numpy as np
+
+    from foundationdb_tpu.rpc.wire import decode_frame_py
+
+    if not _c_active():
+        pytest.skip("C codec unavailable")
+    rng = np.random.default_rng(777)
+    seed = encode_frame(
+        {
+            b"k": [Mutation(MutationType.SET_VALUE, b"a", b"b"), 1.5],
+            "t": (1, None, True, -(1 << 63)),
+        }
+    )
+    for _ in range(3000):
+        base = bytearray(seed)
+        for _ in range(int(rng.integers(1, 6))):
+            base[int(rng.integers(len(base)))] = int(rng.integers(256))
+        frame = bytes(base)
+        try:
+            a = decode_frame(frame)
+            a_err = None
+        except WireDecodeError:
+            a_err = True
+        try:
+            b = decode_frame_py(frame)
+            b_err = None
+        except WireDecodeError:
+            b_err = True
+        assert (a_err is None) == (b_err is None), (
+            f"C/py disagree on malformed frame: {frame.hex()}"
+        )
+        if a_err is None:
+            assert _eq_loose(a, b), f"decoded values differ: {frame.hex()}"
+
+
+def _eq_loose(a, b):
+    # NaN floats compare unequal; treat bitwise-same NaN as equal.
+    import math
+
+    if isinstance(a, float) and isinstance(b, float):
+        return (math.isnan(a) and math.isnan(b)) or a == b
+    if type(a) is not type(b):
+        return a == b
+    if isinstance(a, (list, tuple)):
+        return len(a) == len(b) and all(_eq_loose(x, y) for x, y in zip(a, b))
+    if isinstance(a, dict):
+        return set(a) == set(b) and all(_eq_loose(a[k], b[k]) for k in a)
+    return a == b
