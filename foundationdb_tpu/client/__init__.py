@@ -2,7 +2,7 @@
 atomic ops, wire types."""
 
 from .atomic import apply_atomic, transform_versionstamp
-from .transaction import Database, Transaction
+from .transaction import Database, Transaction, transactional
 from .types import (
     ALL_KEYS,
     CommitTransactionRef,
@@ -18,6 +18,7 @@ __all__ = [
     "transform_versionstamp",
     "Database",
     "Transaction",
+    "transactional",
     "ALL_KEYS",
     "CommitTransactionRef",
     "KeySelector",

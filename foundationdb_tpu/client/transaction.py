@@ -910,3 +910,22 @@ def _coalesce(ranges: List[Range]) -> List[Range]:
         else:
             out.append([b, e])
     return [(b, e) for b, e in out]
+
+
+def transactional(fn):
+    """`@transactional` (ref: the python binding's fdb.transactional,
+    bindings/python/fdb/impl.py): the decorated coroutine's first
+    argument may be a Database (a fresh transaction + the retry loop
+    wraps the call) or a Transaction (the call joins the caller's
+    transaction — no commit, no retry; composability is the point)."""
+    import functools
+
+    @functools.wraps(fn)
+    async def wrapper(db_or_tr, *args, **kwargs):
+        if isinstance(db_or_tr, Transaction):
+            return await fn(db_or_tr, *args, **kwargs)
+        return await db_or_tr.run(
+            lambda tr: fn(tr, *args, **kwargs)
+        )
+
+    return wrapper
