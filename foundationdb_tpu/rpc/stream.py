@@ -105,7 +105,25 @@ class RequestStream:
 
     def _deliver(self, env: _Envelope):
         reply = Reply(self.process.network, self.process, env.reply_to)
+        if getattr(self, "_closed", None) is not None:
+            # A retired role's endpoint: refuse instead of queueing into a
+            # stream nobody will ever pop (the caller re-resolves topology).
+            reply.send_error(self._closed)
+            return
         self._stream.send((env.request, reply))
+
+    def close(self, error_name: str = "broken_promise"):
+        """Tear down the serving side: every PARKED request's reply breaks
+        and every future delivery is refused — the reference's
+        NetNotifiedQueue destruction breaking outstanding getReplys when a
+        role actor dies (fdbrpc.h:192).  Without this, a request parked on
+        a stale generation's role (alive process, role retired) hangs its
+        caller forever."""
+        self._closed = error_name
+        q = self._stream.future_stream._queue
+        pending, q[:] = list(q), []
+        for _req, rep in pending:
+            rep.send_error(error_name)
 
     def pop(self) -> Future:
         """Future of the next (request, Reply)."""
@@ -196,3 +214,15 @@ async def retry_get_reply(
             if e.name != "broken_promise":
                 raise
             await loop.delay(delay)
+
+
+def spawn_owned(role, coro, name: str):
+    """Spawn a per-request handler task OWNED by `role`: recorded in
+    role._owned (pruned of finished tasks) so worker._teardown_role can
+    cancel it with the role.  Handlers can park indefinitely (prevVersion
+    ordering waits, log pushes into a chain hole) and must die with their
+    generation, breaking the replies they hold."""
+    t = role.process.spawn(coro, name)
+    role._owned = [x for x in getattr(role, "_owned", []) if not x.is_ready()]
+    role._owned.append(t)
+    return t

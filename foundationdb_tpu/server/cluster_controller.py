@@ -46,6 +46,7 @@ from .worker import (
     InitStorage,
     InitTLog,
     LockTLog,
+    RetireRoles,
     WorkerInterface,
 )
 
@@ -663,6 +664,27 @@ class ClusterController:
         self.process.spawn(
             self._time_keeper(proxy_ifs, storage_ifs[0], self.generation),
             "cc_time_keeper",
+        )
+        # Retire STALE ephemeral roles cluster-wide: a worker not chosen
+        # this generation may still host the previous proxy/resolver/
+        # sequencer, parking requests forever (e.g. a resolve waiting on a
+        # prevVersion hole from the failed generation).  Best-effort per
+        # worker — an unreachable one gets the same broadcast next
+        # recovery, and its stale roles are epoch-fenced meanwhile.
+        from ..flow.eventloop import wait_for_all
+
+        await wait_for_all(
+            [
+                self.process.spawn(
+                    self._try(
+                        w.init_role.get_reply(
+                            self.process, RetireRoles(epoch=self.generation)
+                        ),
+                        timeout=2.0,
+                    )
+                )
+                for w in list(self.workers.values())
+            ]
         )
         TraceEvent("RecoveryComplete").detail("generation", self.generation).detail(
             "recovery_version", recovery_version

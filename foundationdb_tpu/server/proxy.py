@@ -18,6 +18,7 @@ from ..client.atomic import transform_versionstamp
 from ..client.types import CommitTransactionRef, Mutation, MutationType
 from ..conflict.types import COMMITTED, CONFLICT, TOO_OLD, TransactionConflictInfo
 from ..flow.asyncvar import NotifiedVersion
+from ..flow.error import ActorCancelled
 from ..flow.eventloop import first_of
 from ..flow.knobs import g_knobs
 from ..rpc.network import SimProcess
@@ -104,6 +105,9 @@ class Proxy:
         self._old_bounds: List[Tuple[list, int]] = []
         self.ratekeeper = ratekeeper
         self.n_satellites = n_satellites
+        # Set when the commit pipeline is unrecoverably wedged (a batch
+        # died mid-phase); role_check reports it so the CC recovers.
+        self.broken = False
         self.last_rate_info = None  # latest RateInfo fetched by the GRV loop
         self.committed = NotifiedVersion(epoch_begin_version)
         # Authoritative key -> storage-team map, maintained by intercepting
@@ -176,6 +180,11 @@ class Proxy:
         process.spawn(self._serve_grv(), "proxy_grv")
         process.spawn(self._serve_locations(), "proxy_locations")
         process.spawn(self._serve_load_map(), "proxy_load_map")
+
+    def _spawn_owned(self, coro, name: str):
+        from ..rpc.stream import spawn_owned
+
+        return spawn_owned(self, coro, name)
 
     def interface(self) -> ProxyInterface:
         return ProxyInterface(
@@ -455,7 +464,7 @@ class Proxy:
                 continue
             self._last_batch_cut = loop.now()
             self._local_batches += 1
-            self.process.spawn(
+            self._spawn_owned(
                 self._commit_batch([], self._local_batches), "idle_batch"
             )
 
@@ -495,7 +504,7 @@ class Proxy:
                 batch.append(val)
             self._last_batch_cut = loop.now()
             self._local_batches += 1
-            self.process.spawn(
+            self._spawn_owned(
                 self._commit_batch(batch, self._local_batches), "commit_batch"
             )
 
@@ -503,7 +512,26 @@ class Proxy:
         ctx: dict = {}
         try:
             await self._commit_batch_impl(batch, local_batch, ctx)
-        except Exception:  # noqa: BLE001
+        except ActorCancelled:
+            # Role teardown cancelling an in-flight batch is NOT a pipeline
+            # break: re-raise so the task dies cleanly (Reply.__del__
+            # breaks the clients' promises; the new generation serves
+            # their retries).
+            raise
+        except Exception as e:  # noqa: BLE001
+            # The failed batch's (prev, version) pair is now a PERMANENT
+            # hole in the prevVersion chain: the logs wait for it forever,
+            # wedging every later batch even when the failure was a
+            # transient transport error on a live role.  The reference's
+            # proxy actor dies here (recovery follows); mark this proxy
+            # broken so the CC's role_check starts the recovery the ping
+            # sweep cannot see (the process is alive and pinging fine).
+            self.broken = True
+            from ..flow.trace import TraceEvent
+
+            TraceEvent("ProxyCommitPipelineBroken", severity=30).detail(
+                "proxy", self.proxy_id
+            ).detail("error", getattr(e, "name", repr(e))).log()
             # Unwedge the local chains so later batches don't deadlock
             # behind this one: they fail fast (the same dead role) and their
             # clients get commit_unknown_result instead of hanging until

@@ -95,7 +95,13 @@ class Resolver:
     async def _serve(self):
         while True:
             req, reply = await self._stream.pop()
-            self.process.spawn(self._resolve_one(req, reply), "resolve_batch")
+            # Owned spawn: per-request handlers can park indefinitely (the
+            # prevVersion ordering wait) and MUST die with the role —
+            # teardown cancels owned tasks so their held replies break
+            # instead of wedging callers of a dead generation forever.
+            from ..rpc.stream import spawn_owned
+
+            spawn_owned(self, self._resolve_one(req, reply), "resolve_batch")
 
     def _sample(self, tr):
         for rng in tr.read_ranges:

@@ -106,6 +106,20 @@ class InitCoordinator:
 
 
 @dataclass
+class RetireRoles:
+    """Tear down EPHEMERAL roles of generations older than `epoch`
+    (proxy/resolver/sequencer — their state dies with the generation;
+    tlogs stay locked-but-serving for recovery peeks, storages keep
+    their data).  A stale role on a live worker otherwise keeps parking
+    requests forever — e.g. a resolve waiting on a prevVersion hole —
+    and its well-known endpoints shadow nothing (ref: the reference's
+    role actors dying with the master they registered with, breaking
+    outstanding getReplys via NetNotifiedQueue destruction)."""
+
+    epoch: int = 0
+
+
+@dataclass
 class InitProxy:
     sequencer: SequencerInterface = None
     resolvers: List[ResolverInterface] = field(default_factory=list)
@@ -140,13 +154,31 @@ class WorkerServer:
 
             self.roles["coordinator"] = Coordinator(process, fs=fs)
 
-    def _replace_role(self, name: str, role, tasks):
-        """Install a new generation's role instance, cancelling the previous
-        instance's actors so two generations never run side by side (e.g.
-        two storage servers double-applying to one engine file)."""
+    def _teardown_role(self, name: str):
+        """Cancel a role's actors — construction-time AND owned per-request
+        tasks — and break its parked/future requests, so nothing keeps
+        waiting on a dead generation (ref: role actors dying with their
+        registration, breaking outstanding getReplys)."""
+        role = self.roles.get(name)
         for t in self.role_tasks.get(name, []):
             if not t.is_ready():
                 t.cancel()
+        if role is not None:
+            for t in list(getattr(role, "_owned", [])):
+                if not t.is_ready():
+                    t.cancel()
+            for v in vars(role).values():
+                if isinstance(v, RequestStream):
+                    v.close()
+
+    def _replace_role(self, name: str, role, tasks):
+        """Install a new generation's role instance, tearing the previous
+        instance down so two generations never run side by side (e.g.
+        two storage servers double-applying to one engine file).  NOTE:
+        the new role has already re-registered the well-known endpoints
+        (replace=True at stream construction), so closing the OLD streams
+        here breaks only their parked requests, not new traffic."""
+        self._teardown_role(name)
         self.roles[name] = role
         self.role_tasks[name] = tasks
 
@@ -166,12 +198,18 @@ class WorkerServer:
             reply.send("pong")
 
     async def _serve_role_check(self):
-        """Is a role still installed?  A rebooted worker answers pings but
-        has an empty role table — the controller uses this to detect role
-        death on a live process (ref: per-role waitFailureServer)."""
+        """Is a role still installed AND healthy?  A rebooted worker
+        answers pings but has an empty role table; a role that marked
+        itself `broken` (e.g. a proxy whose commit batch died mid-phase,
+        leaving a hole in the prevVersion chain that wedges every later
+        batch) is equally unusable on a perfectly live process — the
+        reference gets the same recovery because its proxy actor DIES on
+        a batch error (ref: per-role waitFailureServer; commitBatch
+        errors tearing down the proxy)."""
         while True:
             role_name, reply = await self._role_check_stream.pop()
-            reply.send(role_name in self.roles)
+            role = self.roles.get(role_name)
+            reply.send(role is not None and not getattr(role, "broken", False))
 
     async def _serve_init(self):
         while True:
@@ -239,6 +277,18 @@ class WorkerServer:
                     )
                 self._replace_role("tlog", role, new_tasks())
                 reply.send((role.interface(), role.durable.get()))
+            elif isinstance(req, RetireRoles):
+                retired = []
+                for name in ("proxy", "resolver", "sequencer"):
+                    role = self.roles.get(name)
+                    ep = getattr(role, "epoch", None)
+                    if role is None or ep is None or ep >= req.epoch:
+                        continue
+                    self._teardown_role(name)
+                    del self.roles[name]
+                    self.role_tasks.pop(name, None)
+                    retired.append(name)
+                reply.send(retired)
             elif isinstance(req, LockTLog):
                 role: Optional[TLog] = self.roles.get("tlog")
                 if role is None:

@@ -110,3 +110,77 @@ def test_stale_snapshot_too_old_after_recovery():
 
     c.run_all([(db, stale_write())], timeout_vt=500.0)
     assert result["r"] in ("transaction_too_old", "future_version")
+
+
+def test_broken_proxy_pipeline_triggers_recovery():
+    """A commit batch dying mid-phase (e.g. a transient transport error on
+    a live resolver) leaves a permanent hole in the prevVersion chain —
+    the logs wait forever for the missing version.  The proxy must mark
+    itself broken and role_check must surface it, so the CC runs a
+    recovery even though every PROCESS is alive and pinging (ref: the
+    reference proxy actor dying on commitBatch errors)."""
+    from foundationdb_tpu.flow.error import FdbError
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+
+    c = DynamicCluster(seed=930, n_workers=7, n_proxies=1, n_storages=2)
+    db = c.database()
+
+    async def w(tr):
+        tr.set(b"pb/seed", b"1")
+
+    c.run_all([(db, db.run(w))])
+    cc = c.acting_controller()
+    gen0 = cc.generation
+
+    # Force one batch to die mid-phase: patch the impl to raise once.
+    proxy = next(
+        w.roles["proxy"] for w in c.workers if "proxy" in w.roles
+    )
+    orig = proxy._commit_batch_impl
+    state = {"raised": False}
+
+    async def flaky(batch, local_batch, ctx=None):
+        if not state["raised"]:
+            state["raised"] = True
+            # Die AFTER phase 1: the consumed (prev, version) pair is the
+            # chain hole — without the broken flag, every later batch
+            # wedges at the log push forever.
+            await proxy._batch_resolving.when_at_least(local_batch - 1)
+            await proxy.sequencer.get_commit_version.get_reply(
+                proxy.process, proxy.epoch
+            )
+            proxy._batch_resolving.set(local_batch)
+            raise FdbError("connection_failed")
+        return await orig(batch, local_batch, ctx)
+
+    proxy._commit_batch_impl = flaky
+
+    out = {}
+
+    async def drive():
+        loop = c.loop
+        try:
+            async def w2(tr):
+                tr.set(b"pb/x", b"y")
+
+            await db.run(w2)
+        except FdbError:
+            pass  # unknown result for the broken batch is fine
+        # The CC must notice the broken proxy and recover; post-recovery
+        # commits must succeed (the new proxy has a clean chain).
+        for _ in range(400):
+            try:
+                async def w3(tr):
+                    tr.set(b"pb/after", b"ok")
+
+                await db.run(w3)
+                out["done"] = True
+                return
+            except FdbError:
+                await loop.delay(0.1)
+
+    c.run_until(db.process.spawn(drive(), "pb"), timeout_vt=3000.0)
+    assert state["raised"], "patched batch never ran"
+    assert proxy.broken, "proxy did not mark itself broken"
+    assert out.get("done"), "commits never succeeded after the break"
+    assert c.acting_controller().generation > gen0, "no recovery happened"
