@@ -44,6 +44,10 @@ from .bulk_load import BulkLoadWorkload
 from .slow_task import SlowTaskWorkload
 from .metric_logging import MetricLoggingWorkload
 from .dd_metrics import DDMetricsWorkload
+from .commit_bug import CommitBugWorkload
+from .background_selectors import BackgroundSelectorsWorkload
+from .fast_watches import FastTriggeredWatchesWorkload
+from .dd_balance import DDBalanceWorkload
 
 __all__ = [
     "TestWorkload",
@@ -87,4 +91,8 @@ __all__ = [
     "SlowTaskWorkload",
     "MetricLoggingWorkload",
     "DDMetricsWorkload",
+    "CommitBugWorkload",
+    "BackgroundSelectorsWorkload",
+    "FastTriggeredWatchesWorkload",
+    "DDBalanceWorkload",
 ]

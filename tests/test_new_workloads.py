@@ -451,3 +451,67 @@ def test_dd_metrics_through_status():
 
     c = DynamicCluster(seed=585, n_workers=7, n_proxies=2, n_storages=2)
     run_workloads(c, [DDMetricsWorkload()], timeout_vt=60000.0)
+
+
+def test_commitbug_fastwatches_backgroundselectors_plain():
+    """Commit causality/exactly-once probes, prompt watch fires, and
+    churn-proof selector resolution (ref: CommitBugCheck /
+    FastTriggeredWatches / BackgroundSelectors workloads)."""
+    from foundationdb_tpu.workloads import (
+        BackgroundSelectorsWorkload,
+        CommitBugWorkload,
+        FastTriggeredWatchesWorkload,
+    )
+
+    c = SimCluster(seed=590, n_proxies=2, n_storages=2)
+    run_workloads(
+        c,
+        [
+            CommitBugWorkload(iterations=20),
+            FastTriggeredWatchesWorkload(rounds=6),
+            BackgroundSelectorsWorkload(probes=15),
+        ],
+        timeout_vt=60000.0,
+    )
+
+
+@pytest.mark.parametrize("seed", [595, 596])
+def test_commit_bug_under_chaos(seed):
+    """Exactly-once + own-commit visibility must hold through clogging
+    and attrition (the original bugs were recovery-window races)."""
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+    from foundationdb_tpu.workloads import CommitBugWorkload
+
+    c = DynamicCluster(seed=seed, n_workers=7, n_proxies=2, n_storages=2,
+                       n_tlogs=2)
+    run_workloads(
+        c,
+        [
+            CommitBugWorkload(iterations=12),
+            RandomCloggingWorkload(duration=3.0),
+            AttritionWorkload(kills=1),
+            ConsistencyChecker(),
+        ],
+        timeout_vt=60000.0,
+        quiet=True,
+    )
+
+
+def test_dd_balance_converges():
+    """Shard counts converge within tolerance across storages under
+    sim-scaled thresholds (ref: DDBalance workload).  Knob overrides are
+    owned HERE with try/finally so an abandoned run cannot leak them."""
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+    from foundationdb_tpu.workloads import DDBalanceWorkload
+
+    old = (g_knobs.server.dd_shard_max_bytes,
+           g_knobs.server.dd_shard_min_bytes)
+    g_knobs.server.dd_shard_max_bytes = 2500
+    g_knobs.server.dd_shard_min_bytes = 0
+    try:
+        c = DynamicCluster(seed=598, n_workers=8, n_proxies=2,
+                           n_storages=3)
+        run_workloads(c, [DDBalanceWorkload()], timeout_vt=90000.0)
+    finally:
+        (g_knobs.server.dd_shard_max_bytes,
+         g_knobs.server.dd_shard_min_bytes) = old
