@@ -160,7 +160,8 @@ def run_server(port: int, datadir: str = "", tls=None) -> None:
 
 
 def run_client(
-    server: str, client_id: str, ops: int, check_count: int, tls=None
+    server: str, client_id: str, ops: int, check_count: int, tls=None,
+    progress: bool = False,
 ) -> None:
     from ..client.transaction import Database
 
@@ -190,6 +191,10 @@ def run_client(
                 tr.set(b"%s/%04d" % (client_id.encode(), i), b"x")
 
             await db.run(op)
+            if progress:
+                # One line per completed op: lets tests synchronize a
+                # fault injection on REAL progress instead of wall clock.
+                print(f"OP {i}", flush=True)
 
         out = {}
 
@@ -300,6 +305,8 @@ def main(argv=None):
     c.add_argument("--id", default="c1")
     c.add_argument("--ops", type=int, default=20)
     c.add_argument("--check-count", type=int, default=-1)
+    c.add_argument("--progress", action="store_true",
+                   help="print one OP line per completed transaction")
     _add_tls_args(c)
     ns = sub.add_parser("ntserver")
     ns.add_argument("--port", type=int, default=0)
@@ -323,7 +330,7 @@ def main(argv=None):
     else:
         run_client(
             args.server, args.id, args.ops, args.check_count,
-            tls=_tls_config(args),
+            tls=_tls_config(args), progress=getattr(args, "progress", False),
         )
 
 

@@ -79,3 +79,53 @@ def test_real_server_durable_restart(tmp_path):
             server2.wait(timeout=5)
         except subprocess.TimeoutExpired:
             server2.kill()
+
+
+def test_real_server_killed_mid_load(tmp_path):
+    """SIGKILL the server WHILE a client is committing, restart on the
+    same datadir: the client must ride reconnect + unknown-result fencing
+    to completion, and the serializable count must be EXACT — no acked
+    increment lost, no retried increment double-applied (ref: the
+    reconnect discipline in FlowTransport connectionKeeper + the
+    commitDummyTransaction fencing)."""
+    datadir = str(tmp_path / "data")
+    server = spawn_real_node(*["server", "--datadir", datadir])
+    client = None
+    server2 = None
+    try:
+        ready = server.stdout.readline().strip()
+        assert ready.startswith("READY "), ready
+        addr = ready.split()[1]
+        port = addr.rsplit(":", 1)[1]
+
+        client = spawn_real_node(
+            *["client", addr, "--id", "k", "--ops", "20", "--progress"]
+        )
+        # Kill on OBSERVED progress (not wall clock): some ops landed,
+        # more are in flight.
+        for line in client.stdout:
+            if line.startswith("OP 3"):
+                break
+        server.kill()
+        server.wait()
+        # Same address: SO_REUSEADDR lets the restart rebind immediately.
+        server2 = spawn_real_node(
+            *["server", "--datadir", datadir, "--port", port]
+        )
+        ready2 = server2.stdout.readline().strip()
+        assert ready2.startswith("READY "), ready2
+        assert ready2.split()[1] == addr, ready2
+        out, _ = client.communicate(timeout=120)
+        assert client.returncode == 0, out
+
+        c2 = spawn_real_node(
+            "client", addr, "--id", "v", "--ops", "0", "--check-count", "20"
+        )
+        out2, _ = c2.communicate(timeout=90)
+        assert c2.returncode == 0, out2
+        assert "DONE 20" in out2, out2
+    finally:
+        for pr in (server, server2, client):
+            if pr is not None and pr.poll() is None:
+                pr.kill()
+                pr.wait()
