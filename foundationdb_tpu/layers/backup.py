@@ -298,13 +298,19 @@ class FileBackupAgent:
 
 
 async def apply_snapshot_image(
-    db, container: BackupContainer, manifest: dict, batch_rows: int = 500
+    db, container: BackupContainer, manifest: dict, batch_rows: int = 500,
+    lock_aware: bool = False,
 ) -> int:
     """Clear the target range and replay the snapshot pages — the shared
     first half of both restore paths (ref: restore clearing restoreRange
     before applying the range files)."""
 
+    def _opts(tr):
+        if lock_aware:
+            tr.options["lock_aware"] = True
+
     async def clear_txn(tr):
+        _opts(tr)
         tr.clear_range(manifest.get("begin", b""), manifest.get("end", b"\xff"))
 
     await db.run(clear_txn)
@@ -318,6 +324,7 @@ async def apply_snapshot_image(
             chunk = rows[off : off + batch_rows]
 
             async def txn(tr, chunk=chunk):
+                _opts(tr)
                 for k, v in chunk:
                     tr.set(k, v)
 
@@ -482,8 +489,28 @@ class ContinuousBackupAgent:
             if n == 0:
                 await loop.delay(poll)
 
+    async def atomic_restore(self, target_version: int = None,
+                             batch_rows: int = 500) -> int:
+        """Restore that is ATOMIC to every observer (ref: the
+        BackupAgent atomicRestore the AtomicRestore workload drives):
+        lock the database, run the multi-transaction restore lock-aware,
+        unlock.  Non-lock-aware readers and writers fail database_locked
+        for the duration, so no transaction can ever observe (or
+        interleave with) a half-restored range; from the outside the
+        restore happens at one point between the lock and the unlock."""
+        from ..client.management import lock_database, unlock_database
+
+        uid = await lock_database(self.db)
+        try:
+            v = await self.restore(
+                target_version, batch_rows, lock_aware=True
+            )
+        finally:
+            await unlock_database(self.db, uid)
+        return v
+
     async def restore(self, target_version: int = None,
-                      batch_rows: int = 500) -> int:
+                      batch_rows: int = 500, lock_aware: bool = False) -> int:
         """Point-in-time restore: snapshot image + logged mutations
         through `target_version` (default: everything logged).  Returns
         the restore version actually applied."""
@@ -499,7 +526,10 @@ class ContinuousBackupAgent:
             raise FdbError("restore_invalid_version")
         begin, end = manifest.get("begin", b""), manifest.get("end", b"\xff")
         uend = min(end, b"\xff")  # user-keyspace bound
-        await apply_snapshot_image(self.db, self.container, manifest, batch_rows)
+        await apply_snapshot_image(
+            self.db, self.container, manifest, batch_rows,
+            lock_aware=lock_aware,
+        )
 
         def in_scope(m):
             if m.type == MutationType.CLEAR_RANGE:
@@ -526,6 +556,8 @@ class ContinuousBackupAgent:
                     continue
 
                 async def apply(tr, user=user):
+                    if lock_aware:
+                        tr.options["lock_aware"] = True
                     for m in user:
                         if m.type == MutationType.SET_VALUE:
                             tr.set(m.param1, m.param2)

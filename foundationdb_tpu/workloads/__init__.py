@@ -48,6 +48,8 @@ from .commit_bug import CommitBugWorkload
 from .background_selectors import BackgroundSelectorsWorkload
 from .fast_watches import FastTriggeredWatchesWorkload
 from .dd_balance import DDBalanceWorkload
+from .atomic_restore import AtomicRestoreWorkload
+from .index_scan import IndexScanWorkload
 
 __all__ = [
     "TestWorkload",
@@ -95,4 +97,6 @@ __all__ = [
     "BackgroundSelectorsWorkload",
     "FastTriggeredWatchesWorkload",
     "DDBalanceWorkload",
+    "AtomicRestoreWorkload",
+    "IndexScanWorkload",
 ]
