@@ -96,6 +96,9 @@ class MergePeekCursor:
             [self.process.spawn(pull(i), f"merge_pull{i}") for i in range(len(self.logs))]
         )
         if self.logs and not self._coverage_ok():
+            from ..flow.testprobe import test_probe
+
+            test_probe("merge_cursor_uncovered")
             # Some tag's ENTIRE replica slot has coverage starting above
             # the merge begin: a range at/above begin is held by nobody
             # who could have that tag's data — advancing would silently

@@ -122,6 +122,10 @@ class RequestStream:
         self._closed = error_name
         q = self._stream.future_stream._queue
         pending, q[:] = list(q), []
+        if pending:
+            from ..flow.testprobe import test_probe
+
+            test_probe("request_stream_closed_parked")
         for _req, rep in pending:
             rep.send_error(error_name)
 

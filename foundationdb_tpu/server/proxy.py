@@ -527,6 +527,9 @@ class Proxy:
             # broken so the CC's role_check starts the recovery the ping
             # sweep cannot see (the process is alive and pinging fine).
             self.broken = True
+            from ..flow.testprobe import test_probe
+
+            test_probe("proxy_pipeline_broken")
             from ..flow.trace import TraceEvent
 
             TraceEvent("ProxyCommitPipelineBroken", severity=30).detail(

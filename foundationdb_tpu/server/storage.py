@@ -986,6 +986,9 @@ class StorageServer:
                 FetchShardRequest(begin=begin, end=shard.end, version=snap),
             )
             if self.adding[shard.begin] is not shard:
+                from ..flow.testprobe import test_probe
+
+                test_probe("fetch_superseded")
                 # Superseded mid-page by an overlapping move: STOP writing
                 # through — the new fetch's clear_range/sets share the
                 # base-engine commit buffer, and a stale row written after

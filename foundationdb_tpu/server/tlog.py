@@ -615,6 +615,10 @@ class TLog:
         heals keyServers, and nobody will ever pop those rows."""
         from .interfaces import TAG_ALL, TAG_DEFAULT
 
+        if self._dead_tags:
+            from ..flow.testprobe import test_probe
+
+            test_probe("dead_tag_spill_gc")
         for tag in (
             set(self.popped_tags) | self._dead_tags | {TAG_ALL, TAG_DEFAULT}
         ):

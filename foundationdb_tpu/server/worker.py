@@ -288,6 +288,9 @@ class WorkerServer:
                     del self.roles[name]
                     self.role_tasks.pop(name, None)
                     retired.append(name)
+                    from ..flow.testprobe import test_probe
+
+                    test_probe("stale_role_retired")
                 reply.send(retired)
             elif isinstance(req, LockTLog):
                 role: Optional[TLog] = self.roles.get("tlog")

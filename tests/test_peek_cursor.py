@@ -100,6 +100,9 @@ def test_fresh_replacement_log_served_by_survivor():
 def test_uncovered_range_raises_not_skips():
     """EVERY member's floor above the merge begin: the cursor must raise
     peek_below_begin (nobody holds the range), never silently advance."""
+    from foundationdb_tpu.flow import testprobe
+
+    probe_before = testprobe.hit_sites.get("merge_cursor_uncovered", 0)
     loop, net = _env(12)
     proc = net.process("c")
     done = {}
@@ -124,6 +127,9 @@ def test_uncovered_range_raises_not_skips():
 
     loop.run_until(proc.spawn(run(), "t"), timeout_vt=200.0)
     assert done.get("ok")
+    assert (
+        testprobe.hit_sites.get("merge_cursor_uncovered", 0) > probe_before
+    )
 
 
 def test_mid_stream_floor_jump_raises():

@@ -119,9 +119,14 @@ def test_broken_proxy_pipeline_triggers_recovery():
     itself broken and role_check must surface it, so the CC runs a
     recovery even though every PROCESS is alive and pinging (ref: the
     reference proxy actor dying on commitBatch errors)."""
+    from foundationdb_tpu.flow import testprobe
     from foundationdb_tpu.flow.error import FdbError
     from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
 
+    probes_before = {
+        n: testprobe.hit_sites.get(n, 0)
+        for n in ("proxy_pipeline_broken", "stale_role_retired")
+    }
     c = DynamicCluster(seed=930, n_workers=7, n_proxies=1, n_storages=2)
     db = c.database()
 
@@ -182,5 +187,9 @@ def test_broken_proxy_pipeline_triggers_recovery():
     c.run_until(db.process.spawn(drive(), "pb"), timeout_vt=3000.0)
     assert state["raised"], "patched batch never ran"
     assert proxy.broken, "proxy did not mark itself broken"
+    for n, before in probes_before.items():
+        assert testprobe.hit_sites.get(n, 0) > before, (
+            f"probe {n} did not fire IN THIS TEST"
+        )
     assert out.get("done"), "commits never succeeded after the break"
     assert c.acting_controller().generation > gen0, "no recovery happened"

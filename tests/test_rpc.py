@@ -261,3 +261,54 @@ def test_asyncvar_and_notified_version():
     loop.run()
     assert ("av", 3) in log and ("nv", 12) in log
     set_event_loop(None)
+
+
+def test_request_stream_close_breaks_parked_requests():
+    """RequestStream.close(): requests PARKED in the queue (server busy,
+    never popped) must get broken_promise immediately, and later
+    deliveries must be refused — the NetNotifiedQueue-destruction analog
+    role teardown depends on (ref: fdbrpc.h:192)."""
+    from foundationdb_tpu.flow import EventLoop, set_event_loop
+    from foundationdb_tpu.flow import testprobe
+    from foundationdb_tpu.flow.error import FdbError
+    from foundationdb_tpu.rpc import SimNetwork
+    from foundationdb_tpu.rpc.stream import RequestStream
+
+    probe_before = testprobe.hit_sites.get("request_stream_closed_parked", 0)
+    loop = EventLoop(seed=44)
+    set_event_loop(loop)
+    net = SimNetwork(loop)
+    server = net.process("srv")
+    client = net.process("cli")
+    stream = RequestStream(server, "busy_service", well_known=True)
+    out = {}
+
+    async def run():
+        f1 = stream.ref().get_reply(client, "parked-1")
+        f2 = stream.ref().get_reply(client, "parked-2")
+        await loop.delay(0.1)  # both delivered, nobody pops
+        stream.close()
+        for name, f in (("one", f1), ("two", f2)):
+            try:
+                await f
+                out[name] = "no error"
+            except FdbError as e:
+                out[name] = e.name
+        # Post-close delivery refused the same way.
+        try:
+            await stream.ref().get_reply(client, "late")
+            out["late"] = "no error"
+        except FdbError as e:
+            out["late"] = e.name
+
+    loop.run_until(client.spawn(run(), "t"), timeout_vt=100.0)
+    assert out == {
+        "one": "broken_promise",
+        "two": "broken_promise",
+        "late": "broken_promise",
+    }, out
+    assert (
+        testprobe.hit_sites.get("request_stream_closed_parked", 0)
+        > probe_before
+    )
+    set_event_loop(None)

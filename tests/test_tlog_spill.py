@@ -232,6 +232,9 @@ def test_unregistered_tag_spill_gc_survives_restart():
     rows ACROSS a tlog restart.  The __pop__ unregister queue record is
     trimmed once the floor passes it, so durability rides a spill-store
     marker — forgetting it would silently regrow the spill forever."""
+    from foundationdb_tpu.flow import testprobe
+
+    probe_before = testprobe.hit_sites.get("dead_tag_spill_gc", 0)
     loop, net, fs = make_env(31)
     proc = net.process("tlog")
     client = net.process("client")
@@ -295,6 +298,9 @@ def test_unregistered_tag_spill_gc_survives_restart():
         )
         assert left == [], (
             f"dead tag's spilled rows survived GC: {left[:3]}"
+        )
+        assert (
+            testprobe.hit_sites.get("dead_tag_spill_gc", 0) > probe_before
         )
         state["ok"] = True
 
