@@ -9,6 +9,7 @@ queue so the log can be popped).
 
 from __future__ import annotations
 
+import zlib
 from bisect import bisect_left, insort
 from typing import Dict, List, Optional, Tuple
 
@@ -96,6 +97,10 @@ class IKeyValueStore:
 async def open_engine(engine: str, fs, process, filename: str):
     """Engine factory (ref: openKVStore's type dispatch,
     KeyValueStoreMemory.actor.cpp / KeyValueStoreSQLite.actor.cpp)."""
+    if engine.endswith("+compress"):
+        return CompressedKeyValueStore(
+            await open_engine(engine[: -len("+compress")], fs, process, filename)
+        )
     if engine == "memory":
         return await KeyValueStoreMemory.open(fs, process, filename)
     if engine == "btree":
@@ -225,3 +230,54 @@ class KeyValueStoreMemory(IKeyValueStore):
         for k in self._keys[i : min(j, i + limit)]:
             out.append((k, self._data[k]))
         return out
+
+
+class CompressedKeyValueStore(IKeyValueStore):
+    """Value-compressing wrapper over any engine (ref: the
+    KeyValueStoreCompressTestData wrapper, fdbserver/
+    KeyValueStoreCompressTestData.actor.cpp — exercises every caller
+    against values whose stored form differs from their logical form).
+    Keys stay raw (ordering/range semantics untouched); values zlib."""
+
+    _MAGIC = b"\x01z"  # prefix distinguishes compressed from empty
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    # -- writes --
+    def set(self, key: bytes, value: bytes):
+        self.inner.set(key, self._MAGIC + zlib.compress(value, 1))
+
+    def clear_range(self, begin: bytes, end: bytes):
+        self.inner.clear_range(begin, end)
+
+    async def commit(self):
+        await self.inner.commit()
+
+    # -- reads --
+    def _load(self, raw: Optional[bytes]) -> Optional[bytes]:
+        if raw is None:
+            return None
+        if not raw.startswith(self._MAGIC):
+            raise FdbError("file_corrupt")
+        try:
+            return zlib.decompress(raw[len(self._MAGIC):])
+        except zlib.error as e:
+            raise FdbError("file_corrupt") from e
+
+    def read_value(self, key: bytes) -> Optional[bytes]:
+        return self._load(self.inner.read_value(key))
+
+    def read_range(
+        self, begin: bytes, end: bytes, limit: int = 1 << 30
+    ) -> List[Tuple[bytes, bytes]]:
+        return [
+            (k, self._load(v))
+            for k, v in self.inner.read_range(begin, end, limit)
+        ]
+
+    def read_keys_page(self, *a, **kw):
+        return self.inner.read_keys_page(*a, **kw)
+
+    def count(self) -> int:
+        return self.inner.count()

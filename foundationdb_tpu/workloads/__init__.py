@@ -50,6 +50,12 @@ from .fast_watches import FastTriggeredWatchesWorkload
 from .dd_balance import DDBalanceWorkload
 from .atomic_restore import AtomicRestoreWorkload
 from .index_scan import IndexScanWorkload
+from .perf_metrics import (
+    PingWorkload,
+    StreamingReadWorkload,
+    ThroughputWorkload,
+    WriteBandwidthWorkload,
+)
 
 __all__ = [
     "TestWorkload",
@@ -99,4 +105,8 @@ __all__ = [
     "DDBalanceWorkload",
     "AtomicRestoreWorkload",
     "IndexScanWorkload",
+    "ThroughputWorkload",
+    "WriteBandwidthWorkload",
+    "StreamingReadWorkload",
+    "PingWorkload",
 ]

@@ -1,0 +1,238 @@
+"""Perf-measurement workloads: Throughput, WriteBandwidth, StreamingRead,
+Ping — measure, sanity-gate, and PUBLISH into the metrics keyspace.
+
+Ref: fdbserver/workloads/{Throughput,WriteBandwidth,StreamingRead,
+Ping}.actor.cpp — the reference's perf corpus reports metrics through
+getMetrics(); here each workload writes its measured rates into
+`\xff/metrics` via the TDMetric logger, so the numbers are readable
+back through ordinary transactions (and the sanity gates catch a
+collapsed data path even in a correctness-focused sim run).  All rates
+are virtual-time rates: deterministic per seed, comparable across runs.
+"""
+
+from __future__ import annotations
+
+from ..flow.error import FdbError
+from .base import TestWorkload
+
+
+class _PerfBase(TestWorkload):
+    def __init__(self, prefix: bytes):
+        self.prefix = prefix
+        self.metrics: dict = {}
+
+    async def _publish(self, db, cluster):
+        from ..client.metric_logger import log_metrics_once
+        from ..flow.stats import CounterCollection
+
+        coll = CounterCollection(f"wl_{self.name}")
+        for name, value in self.metrics.items():
+            coll.add(name, int(value))
+        await log_metrics_once(db, [coll])
+
+    async def _verify_published(self, db) -> bool:
+        from ..client.metric_logger import read_metrics
+
+        series = await read_metrics(db, f"wl_{self.name}")
+        return set(series) == set(self.metrics) and all(
+            series[k][-1][1] == int(v) for k, v in self.metrics.items()
+        )
+
+
+class ThroughputWorkload(_PerfBase):
+    """Sustained mixed read/write transactions; gates txn/s(vt) > 0 and
+    publishes the measured rate (ref: Throughput.actor.cpp)."""
+
+    name = "throughput"
+
+    def __init__(self, actors: int = 3, txns_per_actor: int = 15,
+                 prefix: bytes = b"tput/"):
+        super().__init__(prefix)
+        self.actors = actors
+        self.txns_per_actor = txns_per_actor
+
+    async def start(self, db, cluster):
+        from ..flow.eventloop import all_of
+
+        loop = cluster.loop
+        rng = loop.rng
+        t0 = loop.now()
+        done = [0]
+
+        async def actor(aid: int):
+            for _i in range(self.txns_per_actor):
+                async def op(tr, aid=aid):
+                    k = self.prefix + b"%02d%04d" % (
+                        aid, int(rng.random_int(0, 50))
+                    )
+                    v = await tr.get(k)
+                    tr.set(k, b"%d" % (int(v or b"0") + 1))
+
+                try:
+                    await db.run(op)
+                    done[0] += 1
+                except FdbError:
+                    pass
+
+        await all_of([
+            db.process.spawn(actor(a), f"tput{a}") for a in range(self.actors)
+        ])
+        dt = max(loop.now() - t0, 1e-9)
+        self.metrics = {
+            "transactions": done[0],
+            "txn_per_vsec_x100": int(done[0] / dt * 100),
+        }
+        await self._publish(db, cluster)
+
+    async def check(self, db, cluster) -> bool:
+        assert self.metrics["transactions"] >= (
+            self.actors * self.txns_per_actor * 3 // 4
+        )
+        assert self.metrics["txn_per_vsec_x100"] > 0
+        return await self._verify_published(db)
+
+
+class WriteBandwidthWorkload(_PerfBase):
+    """Large-value write pressure; gates bytes/vsec > 0 and byte-exact
+    readback of the last round (ref: WriteBandwidth.actor.cpp)."""
+
+    name = "write_bandwidth"
+
+    def __init__(self, rounds: int = 6, keys_per_round: int = 8,
+                 value_len: int = 512, prefix: bytes = b"wbw/"):
+        super().__init__(prefix)
+        self.rounds = rounds
+        self.keys_per_round = keys_per_round
+        self.value_len = value_len
+
+    async def start(self, db, cluster):
+        loop = cluster.loop
+        t0 = loop.now()
+        written = 0
+        for r in range(self.rounds):
+            async def wr(tr, r=r):
+                for i in range(self.keys_per_round):
+                    tr.set(
+                        self.prefix + b"%04d" % i,
+                        (b"r%d-" % r) + b"x" * self.value_len,
+                    )
+
+            try:
+                await db.run(wr)
+                written += self.keys_per_round * (self.value_len + 8)
+            except FdbError:
+                pass
+        dt = max(loop.now() - t0, 1e-9)
+        self.metrics = {
+            "bytes_written": written,
+            "bytes_per_vsec": int(written / dt),
+        }
+        await self._publish(db, cluster)
+
+    async def check(self, db, cluster) -> bool:
+        assert self.metrics["bytes_written"] > 0
+        out = {}
+
+        async def rd(tr):
+            out["rows"] = await tr.get_range(
+                self.prefix, self.prefix + b"\xff"
+            )
+
+        await db.run(rd)
+        last = b"r%d-" % (self.rounds - 1)
+        assert len(out["rows"]) == self.keys_per_round
+        assert all(v.startswith(last) for _k, v in out["rows"])
+        return await self._verify_published(db)
+
+
+class StreamingReadWorkload(_PerfBase):
+    """Sequential paged streaming over a loaded range; gates rows/vsec
+    and byte-exactness (ref: StreamingRead.actor.cpp)."""
+
+    name = "streaming_read"
+
+    def __init__(self, rows: int = 150, page: int = 25,
+                 passes: int = 3, prefix: bytes = b"sr/"):
+        super().__init__(prefix)
+        self.rows = rows
+        self.page = page
+        self.passes = passes
+
+    async def setup(self, db, cluster):
+        for lo in range(0, self.rows, 50):
+            async def fill(tr, lo=lo):
+                for i in range(lo, min(self.rows, lo + 50)):
+                    tr.set(self.prefix + b"%06d" % i, b"s%d" % i)
+
+            await db.run(fill)
+
+    async def start(self, db, cluster):
+        from ..client.types import key_after
+
+        loop = cluster.loop
+        t0 = loop.now()
+        streamed = 0
+        for _p in range(self.passes):
+            cursor = self.prefix
+            while True:
+                async def page(tr, cursor=cursor):
+                    return await tr.get_range(
+                        cursor, self.prefix + b"\xff", limit=self.page
+                    )
+
+                try:
+                    rows = await db.run(page)
+                except FdbError:
+                    break
+                streamed += len(rows)
+                if len(rows) < self.page:
+                    break
+                cursor = key_after(rows[-1][0])
+        dt = max(loop.now() - t0, 1e-9)
+        self.metrics = {
+            "rows_streamed": streamed,
+            "rows_per_vsec": int(streamed / dt),
+        }
+        await self._publish(db, cluster)
+
+    async def check(self, db, cluster) -> bool:
+        assert self.metrics["rows_streamed"] >= self.rows  # >= one full pass
+        return await self._verify_published(db)
+
+
+class PingWorkload(_PerfBase):
+    """GRV round-trip latency distribution: the cheapest full-fabric RPC
+    (client -> proxy -> [rk/sequencer]) — gates p50 under a bound and
+    publishes microsecond percentiles (ref: Ping.actor.cpp)."""
+
+    name = "ping"
+
+    def __init__(self, pings: int = 30):
+        super().__init__(b"ping/")
+        self.pings = pings
+
+    async def start(self, db, cluster):
+        loop = cluster.loop
+        lats = []
+        for _ in range(self.pings):
+            tr = db.create_transaction()
+            t0 = loop.now()
+            try:
+                await tr.get_read_version()
+            except FdbError:
+                continue
+            lats.append(loop.now() - t0)
+            await loop.delay(0.02)
+        lats.sort()
+        if lats:
+            self.metrics = {
+                "pings": len(lats),
+                "p50_us": int(lats[len(lats) // 2] * 1e6),
+                "p99_us": int(lats[min(len(lats) - 1, int(len(lats) * 0.99))] * 1e6),
+            }
+        await self._publish(db, cluster)
+
+    async def check(self, db, cluster) -> bool:
+        assert self.metrics.get("pings", 0) >= self.pings // 2
+        assert self.metrics["p50_us"] < 1_000_000  # < 1 virtual second
+        return await self._verify_published(db)

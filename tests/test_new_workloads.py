@@ -552,3 +552,28 @@ def test_index_scan_through_shard_moves(seed):
         timeout_vt=90000.0,
         quiet=True,
     )
+
+
+def test_perf_workloads_measure_and_publish():
+    """Throughput / WriteBandwidth / StreamingRead / Ping measure
+    virtual-time rates, gate sanity bounds, and publish into
+    \\xff/metrics readable back through ordinary transactions (ref: the
+    reference's perf corpus reporting via getMetrics)."""
+    from foundationdb_tpu.workloads import (
+        PingWorkload,
+        StreamingReadWorkload,
+        ThroughputWorkload,
+        WriteBandwidthWorkload,
+    )
+
+    c = SimCluster(seed=620, n_proxies=2, n_storages=2)
+    run_workloads(
+        c,
+        [
+            ThroughputWorkload(),
+            WriteBandwidthWorkload(),
+            StreamingReadWorkload(),
+            PingWorkload(),
+        ],
+        timeout_vt=60000.0,
+    )
