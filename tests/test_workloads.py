@@ -191,3 +191,50 @@ def test_increment_workload():
 
     c = SimCluster(seed=9530, n_proxies=2)
     run_workloads(c, [IncrementWorkload(counters=3, actors=3, ops=8)])
+
+
+@pytest.mark.parametrize("seed", [8801, 8807])
+def test_kitchen_sink_composition(seed):
+    """The grand CompoundWorkload: a dozen invariant workloads composed
+    SIMULTANEOUSLY with clogging + attrition on a dynamic cluster, ending
+    in quiescence + the consistency gate (ref: multi-workload test specs,
+    tester.actor.cpp CompoundWorkload) — cross-workload interference
+    (shared proxies, ratekeeper budgets, watch maps, metrics keyspace) is
+    the target."""
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+    from foundationdb_tpu.workloads import (
+        AtomicOpsWorkload,
+        BulkLoadWorkload,
+        CommitBugWorkload,
+        IncrementWorkload,
+        InventoryWorkload,
+        LowLatencyWorkload,
+        QueuePushWorkload,
+        StatusWorkload,
+        ThroughputWorkload,
+        VersionStampWorkload,
+    )
+
+    c = DynamicCluster(seed=seed, n_workers=8, n_proxies=2, n_storages=2,
+                       n_tlogs=2)
+    run_workloads(
+        c,
+        [
+            CycleWorkload(nodes=5, ops=8, actors=2),
+            AtomicOpsWorkload(groups=2, actors=2, ops=5),
+            IncrementWorkload(counters=3, actors=2, ops=6),
+            InventoryWorkload(products=4, actors=2, moves=6),
+            QueuePushWorkload(actors=3, pushes=4),
+            CommitBugWorkload(iterations=8),
+            VersionStampWorkload(actors=2, ops=4),
+            BulkLoadWorkload(rows=80, batch=20),
+            StatusWorkload(duration=5.0),
+            LowLatencyWorkload(ops=20),
+            ThroughputWorkload(actors=2, txns_per_actor=8),
+            RandomCloggingWorkload(duration=4.0),
+            AttritionWorkload(kills=1),
+            ConsistencyChecker(),
+        ],
+        timeout_vt=120000.0,
+        quiet=True,
+    )
