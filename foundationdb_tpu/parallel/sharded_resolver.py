@@ -213,6 +213,12 @@ class ShardedJaxConflictSet:
     so the resolver role can swap it in when a mesh is available.
     """
 
+    # Pin-release hysteresis (the hybrid's discipline, api.py): after a
+    # long-key pin, this many consecutive short batches must pass before
+    # the device reloads — alternating workloads must not pay a full
+    # history transfer per flip.
+    AUTHORITY_HYSTERESIS = 8
+
     def __init__(
         self,
         split_keys: Sequence[bytes],
@@ -256,6 +262,8 @@ class ShardedJaxConflictSet:
         self._steps: dict = {}
         self._init_state(oldest_rel=0)
         self.last_iters = 0
+        self._cpu_engines = None
+        self._short_streak = 0
 
     # -- state management (mirrors JaxConflictSet, with a leading shard axis) --
     def _init_state(self, oldest_rel: int):
@@ -273,14 +281,22 @@ class ShardedJaxConflictSet:
 
     @property
     def oldest_version(self) -> int:
+        if self._cpu_engines is not None:
+            # The pinned engines advance their windows per batch; the
+            # device arrays are stale for the pin's duration.
+            return max(e.oldest_version for e in self._cpu_engines)
         return int(np.max(np.asarray(self._oldest))) + self._base
 
     @property
     def boundary_count(self) -> int:
+        if self._cpu_engines is not None:
+            return sum(len(e.keys) for e in self._cpu_engines)
         return int(np.sum(np.asarray(self._hcount)))
 
     def clear(self, version: int):
         self._base = version
+        self._cpu_engines = None
+        self._short_streak = 0
         self._init_state(oldest_rel=0)
 
     def _maybe_grow_or_rebase(self, now: int, wr_cap: int):
@@ -320,12 +336,61 @@ class ShardedJaxConflictSet:
         return step
 
     # -- ConflictSet ABI --
+    def new_batch(self):
+        """Drop-in for the Resolver's ConflictSet surface (api.py): the
+        mesh-sharded set plugs into a live cluster's resolver via
+        `Resolver(conflict_set=...)` (ref: the ConflictSet swap point,
+        Resolver.actor.cpp:140-153)."""
+        from ..conflict.api import ConflictBatch
+
+        return ConflictBatch(self)
+
+    def _detect(self, txns, now, new_oldest_version) -> List[int]:
+        return self.detect(txns, now, new_oldest_version)
+
     def detect(
         self,
         transactions: List[TransactionConflictInfo],
         now: int,
         new_oldest_version: int,
     ) -> List[int]:
+        # Long-key discipline (the hybrid single-chip set's, sharded):
+        # keys beyond the device key width (min of the digitization width
+        # and the conflict_max_device_key_bytes knob, like api.py's
+        # hybrid) cannot ride the device — such batches run on per-shard
+        # CPU engines with the exact multi-resolver semantics against the
+        # SAME logical state, so cluster use with arbitrary byte keys
+        # (system keyspace, markers) is safe.  A long-key WRITE enters
+        # shard HISTORY, which the device arrays cannot represent:
+        # authority pins to the CPU engines until every shard's history
+        # fits again (window eviction ages the long keys out) AND a
+        # hysteresis streak of short batches passes (the hybrid's
+        # AUTHORITY_HYSTERESIS: alternating workloads must not pay a full
+        # history transfer per flip), then the device reloads.
+        from ..flow.knobs import g_knobs
+
+        width = min(
+            g_knobs.server.conflict_max_device_key_bytes,
+            self.key_words * 4,
+        )
+        batch_long = any(
+            len(b) > width
+            for t in transactions
+            for rng in (t.read_ranges, t.write_ranges)
+            for pair in rng
+            for b in pair
+        )
+        if batch_long or self._cpu_engines is not None:
+            if batch_long:
+                from ..flow.testprobe import test_probe
+
+                test_probe("sharded_long_key_fallback")
+                self._short_streak = 0
+            else:
+                self._short_streak += 1
+            return self._fallback_txns(
+                transactions, now, new_oldest_version
+            )
         mt, mr, mw = self.bucket_mins
         pb = PackedBatch.from_transactions(
             transactions, self.key_words, min_txn=mt, min_rr=mr, min_wr=mw
@@ -334,6 +399,12 @@ class ShardedJaxConflictSet:
         return [int(s) for s in statuses[: len(transactions)]]
 
     def detect_packed(self, pb: PackedBatch, now: int, new_oldest_version: int):
+        if self._cpu_engines is not None:
+            # CPU engines hold the authoritative history (long-key pin):
+            # resolving on the stale device arrays would miss every write
+            # committed since the pin.
+            self._short_streak += 1
+            return self._fallback_packed(pb, now, new_oldest_version)
         self._maybe_grow_or_rebase(now, pb.wr_cap)
         clip = lambda v: np.clip(v - self._base, FLOOR_REL + 1, 2**31 - 2)
         step = self._step_for(pb)
@@ -372,22 +443,43 @@ class ShardedJaxConflictSet:
         return np.asarray(statuses)
 
     def _fallback_cpu(self, pb: PackedBatch, now: int, new_oldest_version: int):
-        """Re-run a diverged batch on per-shard CPU engines with the exact
-        multi-resolver semantics of the device path: ranges clipped per
-        shard, each shard commits writes on its LOCAL verdict, verdicts
-        min-combined (ref Resolver.actor.cpp:140-153, proxy :492-499)."""
+        """Diverged-batch path: unpack and re-run on the shard engines.
+        A divergence with NO pin active is a one-off — the device must
+        reload immediately after (no hysteresis hold): the streak is
+        primed so a fitting history unpins at once."""
         from ..flow.trace import TraceEvent
-        from ..conflict.engine_jax import _unpack_transactions
-        from ..conflict.types import COMMITTED
 
         TraceEvent("ConflictFixpointDiverged", severity=30).detail(
             "n_txn", pb.n_txn
         ).detail("sharded", True).log()
-        engines = self._store_shard_engines()
-        txns = _unpack_transactions(pb)
-        bounds = list(
-            zip([b""] + self.split_keys, self.split_keys + [None])
+        if self._cpu_engines is None:
+            self._short_streak = self.AUTHORITY_HYSTERESIS
+        return self._fallback_packed(pb, now, new_oldest_version)
+
+    def _fallback_packed(self, pb: PackedBatch, now: int, new_oldest_version: int):
+        """PackedBatch adapter over _fallback_txns (shared by the pin and
+        divergence paths)."""
+        from ..conflict.engine_jax import _unpack_transactions
+        from ..conflict.types import COMMITTED
+
+        statuses = self._fallback_txns(
+            _unpack_transactions(pb), now, new_oldest_version
         )
+        out = np.full((pb.txn_cap,), COMMITTED, np.int32)
+        out[: pb.n_txn] = statuses
+        return out
+
+    def _fallback_txns(self, txns, now: int, new_oldest_version: int):
+        """Run a batch on per-shard CPU engines with the exact
+        multi-resolver semantics of the device path: ranges clipped per
+        shard, each shard commits writes on its LOCAL verdict, verdicts
+        min-combined (ref Resolver.actor.cpp:140-153, proxy :492-499).
+        The device state is flattened in and reloaded out, so device and
+        CPU batches interleave against ONE logical history.  While any
+        shard's history holds a long key the engines persist host-side
+        (CPU authority) — the device reloads once everything fits."""
+        engines = self._cpu_engines or self._store_shard_engines()
+        bounds = self._shard_bounds()
         verdicts = []
         for (lo, hi), eng in zip(bounds, engines):
             local = []
@@ -412,10 +504,61 @@ class ShardedJaxConflictSet:
                 )
             verdicts.append(eng.detect(local, now, new_oldest_version))
         statuses = [min(v) for v in zip(*verdicts)] if txns else []
-        self._load_shard_engines(engines)
-        out = np.full((pb.txn_cap,), COMMITTED, np.int32)
-        out[: pb.n_txn] = statuses
-        return out
+        if self._short_streak >= self.AUTHORITY_HYSTERESIS and all(
+            keylib.fits(eng.keys, self.key_words) for eng in engines
+        ):
+            self._load_shard_engines(engines)
+            self._cpu_engines = None
+        else:
+            self._cpu_engines = engines  # CPU stays authoritative
+        return statuses
+
+    def _shard_bounds(self):
+        """[(lo, hi_or_None)] per shard — the one definition."""
+        return list(zip([b""] + self.split_keys, self.split_keys + [None]))
+
+    def _flatten_engines_to(self, engines: list, cpu) -> None:
+        """Per-shard CPU engines -> one global step function (the
+        engines-sourced twin of store_to's device flatten): shard 0
+        contributes its full boundary list below hi_0; each later shard
+        re-anchors at lo_s with its value there, then its boundaries
+        strictly inside (lo_s, hi_s)."""
+        bounds = self._shard_bounds()
+        keys: list = []
+        vers: list = []
+        for (lo, hi), eng in zip(bounds, engines):
+            from bisect import bisect_left, bisect_right
+
+            if lo == b"":
+                i0 = 0
+            else:
+                keys.append(lo)
+                vers.append(eng._value_at(lo))
+                i0 = bisect_right(eng.keys, lo)
+            i1 = len(eng.keys) if hi is None else bisect_left(eng.keys, hi)
+            keys.extend(eng.keys[i0:i1])
+            vers.extend(eng.vers[i0:i1])
+        cpu.keys = keys
+        cpu.vers = vers
+        cpu.oldest_version = min(e.oldest_version for e in engines)
+
+    def _split_flat_to_engines(self, cpu) -> list:
+        """One global step function -> per-shard CPU engines (the inverse
+        of _flatten_engines_to; the long-key load_from path)."""
+        from bisect import bisect_left, bisect_right
+
+        from ..conflict.engine_cpu import CpuConflictSet
+
+        bounds = self._shard_bounds()
+        engines = []
+        for lo, hi in bounds:
+            eng = CpuConflictSet(cpu.oldest_version)
+            i0 = bisect_right(cpu.keys, lo)
+            i1 = len(cpu.keys) if hi is None else bisect_left(cpu.keys, hi)
+            eng.keys = [b""] + cpu.keys[i0:i1]
+            eng.vers = [cpu._value_at(lo)] + cpu.vers[i0:i1]
+            engines.append(eng)
+        return engines
 
     def _store_shard_engines(self) -> list:
         """Per-shard CpuConflictSet mirrors of the device state."""
@@ -477,6 +620,12 @@ class ShardedJaxConflictSet:
         so concatenating shards in order — re-anchoring each shard's value at
         lo_s and dropping boundaries outside its ownership — yields the
         global sorted boundary array."""
+        if self._cpu_engines is not None:
+            # The pinned CPU engines ARE the authoritative per-shard
+            # state; exporting the stale device arrays would drop every
+            # write since the pin.
+            self._flatten_engines_to(self._cpu_engines, cpu)
+            return
         from bisect import bisect_right
 
         from ..conflict.engine_cpu import FLOOR_VERSION
@@ -512,6 +661,15 @@ class ShardedJaxConflictSet:
     def load_from(self, cpu) -> None:
         """Scatter the CPU engine's global step function back into per-shard
         slices (inverse of store_to)."""
+        # The loaded state supersedes any long-key pin; if it itself
+        # contains long keys the device cannot hold it — install it as
+        # pinned per-shard engines instead of raising at encode.
+        self._cpu_engines = None
+        self._short_streak = 0
+        if not keylib.fits(cpu.keys, self.key_words):
+            self._cpu_engines = self._split_flat_to_engines(cpu)
+            self._base = cpu.oldest_version
+            return
         from bisect import bisect_left, bisect_right
 
         from ..conflict.engine_cpu import FLOOR_VERSION
