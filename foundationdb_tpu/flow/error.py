@@ -99,6 +99,9 @@ _ERRORS: dict[str, int] = {
     "unsupported_operation": 2108,
     "restore_error": 2301,
     "restore_invalid_version": 2315,
+    # Internal: a shard fetch observed its AddingShard replaced mid-page
+    # (storage._fetch_pages); consumed by the fetch retry loop only.
+    "fetch_superseded": 2317,
     "internal_error": 4100,
 }
 

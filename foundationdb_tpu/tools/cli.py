@@ -51,7 +51,8 @@ class CliProcessor:
         "setclass": "setclass <address> <class> — recruitment class "
         "(stateless|transaction|storage|unset)",
         "backup": "backup <start|status|restore|describe|expire> <path> "
-        "[version] — continuous backup driver (fdbbackup analog)",
+        "[version | --timestamp=T] — continuous backup driver "
+        "(fdbbackup analog)",
         "dr": "dr <start|status|switch> — replicate into the destination "
         "cluster; switch reverses the roles (fdbdr analog)",
         "help": "help — this text",
@@ -181,11 +182,39 @@ class CliProcessor:
         return [f"ERROR: unknown backup subcommand `{sub}'"]
 
     async def _backup_restore(self, agent, path, args):
+        # Resolve the target version FIRST — argument parsing and the
+        # TimeKeeper mapping must not run with the agent paused (a
+        # failure there would strand the backup stopped, and the resume
+        # would race a tailer that never observed the pause).
+        target = None
+        if len(args) > 2:
+            if args[2].startswith("--timestamp="):
+                # Restore-to-timestamp via the TimeKeeper map (ref:
+                # fdbbackup restore --timestamp,
+                # timeKeeperVersionFromDatetime backup.actor.cpp:1828).
+                from ..client.management import version_from_timestamp
+                from ..flow.error import FdbError
+
+                try:
+                    ts = float(args[2].split("=", 1)[1])
+                except ValueError:
+                    return [f"ERROR: bad --timestamp value {args[2]!r}"]
+                try:
+                    target = await version_from_timestamp(self.db, ts)
+                except FdbError as e:
+                    if e.name != "restore_error":
+                        raise  # unrelated failure: report truthfully
+                    return ["ERROR: restore_error: no TimeKeeper sample "
+                            "covers that time"]
+            else:
+                try:
+                    target = int(args[2])
+                except ValueError:
+                    return [f"ERROR: bad version {args[2]!r}"]
         # Pause tailing for the restore, then RESUME it — the backup
         # stays live afterwards (the restore's own writes are logged
         # like any other mutations).
         agent.stopped = True
-        target = int(args[2]) if len(args) > 2 else None
         try:
             v = await agent.restore(target_version=target)
         finally:

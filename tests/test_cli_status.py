@@ -370,3 +370,55 @@ def test_cli_dr_switch():
     assert src.run_until(
         sdb.process.spawn(scenario(), "sc"), timeout_vt=30000.0
     )
+
+
+def test_cli_backup_restore_to_timestamp():
+    """backup restore --timestamp=T maps T through the TimeKeeper samples
+    to a version and PITR-restores there (ref: fdbbackup restore
+    --timestamp, timeKeeperVersionFromDatetime).  Samples are written the
+    way the CC's timekeeper writes them; an uncovered time errors."""
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.server.system_keys import time_keeper_key
+
+    c = SimCluster(seed=76)
+    db = c.database()
+    cli = CliProcessor(c, db)
+    cli.write_mode = True
+
+    async def scenario():
+        loop = c.loop
+        await cli.run_command("set ts_a early")
+        out = await cli.run_command("backup start tsdir")
+        assert out[0].startswith("Backup started"), out
+        await loop.delay(0.5)
+
+        # TimeKeeper sample at the mark (what the CC writes each tick).
+        async def sample(tr):
+            tr.options["access_system_keys"] = True
+            v = await tr.get_read_version()
+            tr.set(time_keeper_key(int(loop.now())), b"%d" % v)
+
+        await db.run(sample)
+        t_mark = loop.now()
+        await loop.delay(1.5)
+        await cli.run_command("set ts_a late")
+        await cli.run_command("set ts_b post-mark")
+        await loop.delay(0.5)  # agent tails past the late writes
+
+        out2 = await cli.run_command(
+            f"backup restore tsdir --timestamp={t_mark}"
+        )
+        assert out2[0].startswith("Restored"), out2
+        rows = await cli.run_command("getrange ts_ ts~ 10")
+        text = "\n".join(rows)
+        assert "early" in text and "late" not in text, rows
+        assert "ts_b" not in text, rows
+
+        # A pre-sample timestamp is loudly unmappable.
+        out3 = await cli.run_command("backup restore tsdir --timestamp=-5")
+        assert out3[0].startswith("ERROR"), out3
+        return True
+
+    assert c.run_until(
+        db.process.spawn(scenario(), "sc"), timeout_vt=20000.0
+    )

@@ -388,3 +388,78 @@ def test_auto_merge_coalesces_small_adjacent_shards():
 
     absorbed2 = c.run_until(db.process.spawn(split_again()), timeout_vt=500.0)
     assert absorbed2 == []
+
+
+def test_superseded_fetch_stops_write_through():
+    """A fetch superseded MID-PAGE by a re-issued move must stop writing
+    through to the destination's base engine: the old snapshot's stale
+    rows racing the new fetch's clear+sets in one commit buffer could win
+    last-writer-wins and surface after a crash (the round-5 review race).
+    Drives it deterministically: tiny fetch pages, re-commit the move
+    record while the first fetch is between pages, assert the probe fired
+    and the final served data is byte-exact."""
+    from foundationdb_tpu.flow import testprobe
+    from foundationdb_tpu.flow.knobs import g_knobs
+
+    probe_before = testprobe.hit_sites.get("fetch_superseded", 0)
+    old_page = g_knobs.server.fetch_shard_page_rows
+    g_knobs.server.fetch_shard_page_rows = 1  # 40 pages: the fetch
+    # spans many RPC roundtrips, so the superseding record lands mid-flight
+    try:
+        c = SimCluster(seed=39, n_storages=2)
+        db = c.database()
+        fill(c, db, n=40, prefix=b"m")
+        dd = c.data_distributor()
+
+        async def place():
+            await dd.register_storages(dd.storages)
+            await dd.seed(["ss0"])
+
+        c.run_until(db.process.spawn(place()), timeout_vt=500.0)
+        settle(c, db)
+
+        async def move(tr):
+            tr.options["access_system_keys"] = True
+            tr.set(
+                sk.key_servers_key(b"m000"),
+                sk.encode_key_servers(["ss0"], ["ss1"], b"m040"),
+            )
+
+        async def move_narrow(tr):
+            tr.options["access_system_keys"] = True
+            tr.set(
+                sk.key_servers_key(b"m000"),
+                sk.encode_key_servers(["ss0"], ["ss1"], b"m020"),
+            )
+            tr.set(
+                sk.key_servers_key(b"m020"),
+                sk.encode_key_servers(["ss0"], [], b"m040"),
+            )
+
+        # First move record: ss1 starts FETCHING in tiny pages.
+        c.run_all([(db, db.run(move))])
+        # An OVERLAPPING move with a different extent supersedes the
+        # in-flight AddingShard (an identical record would be deduped as
+        # a DD retry); the OLD fetch must stop writing through.
+        c.run_all([(db, db.run(move_narrow))])
+        # Restore the full-range move and let it complete.
+        c.run_all([(db, db.run(move))])
+        settle(c, db, 1.0)  # final fetch completes
+
+        # Settle the move; ss1 serves the shard byte-exact.
+        async def finish(tr):
+            tr.options["access_system_keys"] = True
+            tr.set(
+                sk.key_servers_key(b"m000"),
+                sk.encode_key_servers(["ss1"], [], b"m040"),
+            )
+
+        c.run_all([(db, db.run(finish))])
+        settle(c, db, 0.5)
+        rows = read_all(c, db, prefix=b"m")
+        assert rows == [(b"m%03d" % i, b"v%d" % i) for i in range(40)]
+        assert (
+            testprobe.hit_sites.get("fetch_superseded", 0) > probe_before
+        ), "the superseded-fetch path never fired — race untested"
+    finally:
+        g_knobs.server.fetch_shard_page_rows = old_page
