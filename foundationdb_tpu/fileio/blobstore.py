@@ -63,14 +63,23 @@ def read_response(sock: socket.socket) -> Tuple[int, Dict[str, str], bytes]:
     parts = lines[0].split(" ", 2)
     if len(parts) < 2 or not parts[0].startswith("HTTP/"):
         raise FdbError("http_bad_response")
-    status = int(parts[1])
+    try:
+        status = int(parts[1])
+    except ValueError:
+        # A garbage status line must surface as the codec's own error,
+        # not a ValueError escaping the error model (and the caller
+        # drops the now-desynced connection before retrying).
+        raise FdbError("http_bad_response") from None
     headers = {}
     for ln in lines[1:]:
         if ":" in ln:
             k, v = ln.split(":", 1)
             headers[k.strip().lower()] = v.strip()
-    n = int(headers.get("content-length", "0"))
-    if n > MAX_OBJECT_BYTES:
+    try:
+        n = int(headers.get("content-length", "0"))
+    except ValueError:
+        raise FdbError("http_bad_response") from None
+    if n < 0 or n > MAX_OBJECT_BYTES:
         raise FdbError("http_bad_response")
     while len(rest) < n:
         chunk = sock.recv(65536)
@@ -81,19 +90,26 @@ def read_response(sock: socket.socket) -> Tuple[int, Dict[str, str], bytes]:
 
 
 def parse_request(data: bytes) -> Optional[Tuple[str, str, Dict[str, str], bytes, int]]:
-    """(method, path, headers, body, consumed) or None if incomplete."""
+    """(method, path, headers, body, consumed), None if incomplete, or
+    ValueError on a malformed request (bad request line / content-length)
+    — servers answer 400 and close."""
     idx = data.find(b"\r\n\r\n")
     if idx < 0:
         return None
     head = data[:idx].decode("latin-1")
     lines = head.split("\r\n")
-    method, path, _ver = lines[0].split(" ", 2)
+    req_parts = lines[0].split(" ", 2)
+    if len(req_parts) != 3:
+        raise ValueError("malformed request line")
+    method, path, _ver = req_parts
     headers = {}
     for ln in lines[1:]:
         if ":" in ln:
             k, v = ln.split(":", 1)
             headers[k.strip().lower()] = v.strip()
-    n = int(headers.get("content-length", "0"))
+    n = int(headers.get("content-length", "0"))  # ValueError -> 400
+    if n < 0 or n > MAX_OBJECT_BYTES:
+        raise ValueError("bad content-length")
     total = idx + 4 + n
     if len(data) < total:
         return None
@@ -226,7 +242,12 @@ class BlobStoreEndpoint:
                         method, path, {"Host": self.host}, body
                     ))
                     status, headers, data = read_response(s)
-                except (OSError, ConnectionError) as e:
+                except (OSError, FdbError) as e:
+                    # OSError: connection broke.  FdbError (only
+                    # http_bad_response here): the stream is desynced —
+                    # a stale keep-alive socket served by a restarted
+                    # peer, or a corrupted hop.  Same treatment either
+                    # way: drop the socket and retry on a fresh one.
                     self._drop()
                     err = e
                     failed = True
@@ -349,6 +370,17 @@ class BlobStoreServer:
                 method, path, _headers, body, consumed = parsed
                 del buf[:consumed]
                 conn.sendall(self._handle(method, path, body))
+        except ValueError:
+            # Malformed request: answer 400 and close (a real server's
+            # behavior; silently dying desyncs pipelined clients).  The
+            # response must SAY close — promising keep-alive on a socket
+            # about to shut would strand the next pipelined request.
+            try:
+                conn.sendall(
+                    build_response(400, headers={"Connection": "close"})
+                )
+            except OSError:
+                pass
         except OSError:
             pass
         finally:

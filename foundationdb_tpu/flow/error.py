@@ -97,6 +97,7 @@ _ERRORS: dict[str, int] = {
     "key_too_large": 2102,
     "value_too_large": 2103,
     "unsupported_operation": 2108,
+    "http_bad_response": 2150,
     "restore_error": 2301,
     "restore_invalid_version": 2315,
     # Internal: a shard fetch observed its AddingShard replaced mid-page

@@ -144,3 +144,76 @@ def test_snapshot_backup_to_blobstore_and_restore(server):
     assert len(out["rows"]) == 120
     assert out["rows"][5] == (b"bs005", b"val5")
     set_event_loop(None)
+
+
+def test_http_codec_rejects_garbage_loudly():
+    """Codec hardening: garbage status lines / content-lengths surface as
+    http_bad_response (never a raw ValueError escaping the error model),
+    negative lengths are rejected, and the client retries a desynced
+    keep-alive stream on a FRESH connection instead of crashing."""
+    import socket as _socket
+    import threading
+
+    import pytest
+
+    from foundationdb_tpu.fileio.blobstore import (
+        BlobStoreEndpoint,
+        FdbError,
+        build_response,
+        parse_request,
+        read_response,
+    )
+
+    # read_response: malformed frames -> http_bad_response.
+    def respond_with(raw: bytes):
+        a, b = _socket.socketpair()
+        try:
+            a.sendall(raw)
+            a.shutdown(_socket.SHUT_WR)
+            return read_response(b)
+        finally:
+            a.close()
+            b.close()
+
+    for raw in (
+        b"HTTP/1.1 xyz OK\r\nContent-Length: 0\r\n\r\n",
+        b"HTTP/1.1 200 OK\r\nContent-Length: nope\r\n\r\n",
+        b"HTTP/1.1 200 OK\r\nContent-Length: -5\r\n\r\n",
+        b"GARBAGE\r\n\r\n",
+    ):
+        with pytest.raises(FdbError) as ei:
+            respond_with(raw)
+        assert ei.value.name == "http_bad_response", raw
+
+    # parse_request: malformed input raises ValueError (the server
+    # answers 400), never returns a bogus tuple.
+    with pytest.raises(ValueError):
+        parse_request(b"BROKEN\r\n\r\n")
+    with pytest.raises(ValueError):
+        parse_request(b"GET / HTTP/1.1\r\nContent-Length: -1\r\n\r\n")
+
+    # End-to-end: a server that answers ONE garbage response must not
+    # kill the client — it drops the connection and retries fresh.
+    hits = []
+    srv = _socket.socket()
+    srv.bind(("127.0.0.1", 0))
+    srv.listen(4)
+    port = srv.getsockname()[1]
+
+    def serve():
+        while len(hits) < 2:
+            conn, _ = srv.accept()
+            data = conn.recv(65536)
+            hits.append(data[:16])
+            if len(hits) == 1:
+                conn.sendall(b"HTTP/1.1 banana\r\n\r\n")  # desynced garbage
+            else:
+                conn.sendall(build_response(200, b"payload"))
+            conn.close()
+
+    t = threading.Thread(target=serve, daemon=True)
+    t.start()
+    ep = BlobStoreEndpoint("127.0.0.1", port, "b")
+    assert ep.get_object("k") == b"payload"
+    assert len(hits) == 2  # first attempt consumed the garbage, then retried
+    srv.close()
