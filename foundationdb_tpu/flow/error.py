@@ -60,6 +60,11 @@ _ERRORS: dict[str, int] = {
     # (the 6.0 changeQuorum surfaces this as CoordinatorsResult, not an
     # error code).
     "no_such_worker": 1212,
+    # Rebuild-specific: WRITING_CSTATE found a newer generation already
+    # locked — this recovery must abort, not regress the chain (the 6.0
+    # equivalent surfaces via coordinated_state_conflict in
+    # MovableCoordinatedState).
+    "recovery_superseded": 1213,
     # Directory-layer errors (rebuild-specific codes in an unused range;
     # the 6.0 bindings raise language exceptions for these, but the
     # rebuild keeps the one-error-type model).

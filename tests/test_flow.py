@@ -249,3 +249,33 @@ def test_fdb_errors_do_not_fail_simulation():
         return "ok"
 
     assert loop.run_until(loop.spawn(idle(), "idle")) == "ok"
+
+
+def test_every_raised_error_name_is_registered():
+    """Structural gate: every error NAME the codebase raises must exist
+    in the error table — FdbError("unregistered") KeyError-crashes the
+    raising actor instead of erroring, a latent-until-the-rare-path bug
+    class this sweep found THREE of (fetch_superseded,
+    http_bad_response, recovery_superseded)."""
+    import os
+    import re
+
+    from foundationdb_tpu.flow.error import _ERRORS
+
+    names = set()
+    pkg = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__))
+    ) + "/foundationdb_tpu"
+    for root, _d, files in os.walk(pkg):
+        for f in files:
+            if f.endswith(".py"):
+                src = open(os.path.join(root, f)).read()
+                names.update(
+                    re.findall(r'FdbError\(\s*"([a-z_0-9]+)"', src)
+                )
+                names.update(
+                    re.findall(r'send_error\(\s*"([a-z_0-9]+)"', src)
+                )
+    missing = sorted(n for n in names if n not in _ERRORS)
+    assert not missing, f"raised but not in the error table: {missing}"
+    assert len(names) > 20  # the scan actually found the raise sites
