@@ -282,3 +282,61 @@ def test_exclusion_drains_server(fast_dd):
     teams = shard_teams(c, db, dd)
     assert all("ss1" not in t | d for t, d in teams.values())
     role.stop()
+
+
+def test_dd_probe_corpus(fast_dd):
+    """Coverage gate for the DD probe set: the existing scenarios assert
+    OUTCOMES (healed teams, split shards); this gate asserts the probed
+    rare PATHS actually fire — dd_storage_declared_failed, heal/rebalance
+    enqueues, auto split — so a silently-dead path is loud (the TEST()
+    discipline; these probes were write-only before)."""
+    from foundationdb_tpu.flow import testprobe
+
+    before = {
+        n: testprobe.hit_sites.get(n, 0)
+        for n in (
+            "dd_storage_declared_failed",
+            "dd_heal_enqueued",
+            "dd_auto_split_fired",
+        )
+    }
+    fast_dd.dd_shard_max_bytes = 3000
+    fast_dd.dd_shard_min_bytes = 0
+    c = SimCluster(seed=177, n_storages=3)
+    db = c.database()
+    dd = c.data_distributor()
+
+    async def go():
+        await dd.register_storages(dd.storages)
+        await dd.seed(["ss0", "ss1"])
+        await dd.split(b"\xff")
+
+    c.run_until(db.process.spawn(go()), timeout_vt=500.0)
+    c.dd_role(dd)
+
+    # Hot writes trip the split threshold.
+    for j in range(4):
+        async def txn(tr, j=j):
+            for i in range(60):
+                tr.set(b"p%d%03d" % (j, i), b"x" * 40)
+
+        c.run_all([(db, db.run(txn))], timeout_vt=500.0)
+
+    # Kill a team member permanently: failure declaration + heal enqueue.
+    c.storage_procs[1].kill()
+
+    def fired():
+        return all(
+            testprobe.hit_sites.get(n, 0) > b for n, b in before.items()
+        )
+
+    async def wait():
+        for _ in range(2000):
+            if fired():
+                return True
+            await c.loop.delay(0.25)
+        return False
+
+    assert c.run_until(db.process.spawn(wait()), timeout_vt=2000.0), {
+        n: testprobe.hit_sites.get(n, 0) - b for n, b in before.items()
+    }
