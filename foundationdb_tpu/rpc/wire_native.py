@@ -1,6 +1,9 @@
 """Build + load the C wire-codec accelerator (cpp/wirecodec.c).
 
-Same on-demand g++ pattern as the native kv engine.  The extension is
+Ref: the format itself is rpc/wire.py's (the flow/serialize.h analog);
+this module only builds/loads the byte-identical C implementation —
+same on-demand compile pattern as the native kv engine
+(fileio/kvstore_native.py).  The extension is
 OPTIONAL: any build or import failure leaves the pure-Python codec in
 charge (correctness never depends on the accelerator).  For values the
 C fast path cannot represent (ints beyond 64 bits), the extension
