@@ -32,6 +32,9 @@ class CliProcessor:
         "getrange": "getrange <begin> [end] [limit] — read a range",
         "getrangekeys": "getrangekeys <begin> [end] [limit] — keys only",
         "status": "status [json] — cluster status",
+        "consistencycheck": "consistencycheck — compare every "
+        "multi-replica shard across its team (fdbserver -r "
+        "consistencycheck analog)",
         "writemode": "writemode <on|off> — allow writes",
         "begin": "begin — start an explicit transaction",
         "commit": "commit — commit the explicit transaction",
@@ -332,6 +335,22 @@ class CliProcessor:
         (mode,) = args
         self.write_mode = mode == "on"
         return []
+
+    async def _cmd_consistencycheck(self, args):
+        """On-demand cross-replica comparison (ref: the ConsistencyCheck
+        role, fdbserver.actor.cpp role list + workloads/
+        ConsistencyCheck.actor.cpp checkDataConsistency :562): every
+        multi-replica shard read at one version from every team member
+        and compared byte-exact."""
+        from ..workloads.consistency import check_consistency
+
+        try:
+            compared = await check_consistency(self.db, self.cluster)
+        except AssertionError as e:
+            return [f"INCONSISTENT: {e}"]
+        if compared == 0:
+            return ["OK (no multi-replica shards to compare)"]
+        return [f"OK: {compared} replica comparisons matched"]
 
     async def _cmd_status(self, args):
         doc = cluster_status(self.cluster)
