@@ -65,7 +65,7 @@ class ClusterConnectionString:
 
 
 def read_cluster_file(path: str) -> ClusterConnectionString:
-    with open(path, "r", encoding="utf-8") as f:
+    with open(path, "r", encoding="utf-8") as f:  # fdblint: ignore[IO001]: the cluster file is real client-side state (fdb.cluster analog); sim clusters never call this
         return ClusterConnectionString.parse(f.read())
 
 
@@ -73,7 +73,7 @@ def write_cluster_file(path: str, cs: ClusterConnectionString) -> None:
     """Atomic rewrite (ref: the reference rewriting the file when the
     coordinator set changes — never torn, old readers see old or new)."""
     tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
+    with open(tmp, "w", encoding="utf-8") as f:  # fdblint: ignore[IO001]: atomic rewrite of the real on-disk cluster file; write-tmp-then-rename needs direct file access
         f.write(cs.format() + "\n")
         f.flush()
         os.fsync(f.fileno())

@@ -110,10 +110,10 @@ class MultiVersionClient:
     def connect(self, address: str, loop, timeout_s: float = 10.0):
         """(net, proc, db) over the first compatible generation; raises
         incompatible_protocol_version if none matches."""
-        deadline = time.monotonic() + timeout_s
+        deadline = time.monotonic() + timeout_s  # fdblint: ignore[DET001]: connect() probes a REAL cluster over RealNetwork; the deadline bounds real socket connects
         last = "incompatible_protocol_version"
         for gen in self.generations:
-            budget = deadline - time.monotonic()
+            budget = deadline - time.monotonic()  # fdblint: ignore[DET001]: see deadline above — remaining real-time budget for the next generation probe
             if budget <= 0:
                 # The stated timeout is a contract: no per-generation floor
                 # once it has elapsed.

@@ -135,19 +135,26 @@ def build_response(status: int, body: bytes = b"",
 
 
 class TokenBucket:
-    """Wall-clock token bucket; acquire() blocks until the charge is
-    covered.  rate=None disables (unlimited).
+    """Token bucket; acquire() blocks until the charge is covered.
+    rate=None disables (unlimited).
 
     Debt model: a charge larger than the burst is granted once the bucket
     is full and drives the balance negative, delaying later acquires —
     so oversized bodies are paced rather than deadlocked (a strict
-    'tokens >= n' wait could never be satisfied for n > burst)."""
+    'tokens >= n' wait could never be satisfied for n > burst).
 
-    def __init__(self, rate: Optional[float], burst: Optional[float] = None):
+    The clock/sleep pair is injectable (tests pace with fake time); the
+    default is wall time because rate control meters a REAL network —
+    these two lines are the module's only sanctioned wall-clock bindings."""
+
+    def __init__(self, rate: Optional[float], burst: Optional[float] = None,
+                 clock=None, sleep=None):
         self.rate = rate
         self.burst = burst if burst is not None else max(rate or 0, 1.0)
         self.tokens = self.burst
-        self.t = time.monotonic()
+        self._clock = clock or time.monotonic  # fdblint: ignore[DET001]: rate control meters the real network; sim tests leave rate=None or inject a fake clock
+        self._sleep = sleep or time.sleep  # fdblint: ignore[DET001]: see clock above; injectable for deterministic tests
+        self.t = self._clock()
         self._lock = threading.Lock()
 
     def acquire(self, n: float = 1.0):
@@ -155,7 +162,7 @@ class TokenBucket:
             return
         while True:
             with self._lock:
-                now = time.monotonic()
+                now = self._clock()
                 self.tokens = min(
                     self.burst, self.tokens + (now - self.t) * self.rate
                 )
@@ -165,7 +172,7 @@ class TokenBucket:
                     self.tokens -= n  # may go negative: the debt model
                     return
                 need = (need_tokens - self.tokens) / self.rate
-            time.sleep(min(need, 0.05))
+            self._sleep(min(need, 0.05))
 
 
 # --------------------------------------------------------------------------
@@ -188,6 +195,9 @@ class BlobStoreEndpoint:
         self.req_bucket = TokenBucket(requests_per_second)
         self.read_bucket = TokenBucket(read_bytes_per_second)
         self.write_bucket = TokenBucket(write_bytes_per_second)
+        # Injectable retry-backoff sleep (wall by default: it paces real
+        # reconnects; tests stub it to run the retry chain instantly).
+        self._backoff_sleep = time.sleep  # fdblint: ignore[DET001]: backoff paces real socket reconnects; injectable for tests
         self._sock: Optional[socket.socket] = None
         self._lock = threading.Lock()
 
@@ -254,7 +264,7 @@ class BlobStoreEndpoint:
             if failed:
                 # Backoff OUTSIDE the lock: other threads' independent
                 # requests must not stall behind this one's retry chain.
-                time.sleep(min(0.1 * (2 ** attempt), 2.0))
+                self._backoff_sleep(min(0.1 * (2 ** attempt), 2.0))
                 continue
             if method == "GET" and data:
                 self.read_bucket.acquire(len(data))

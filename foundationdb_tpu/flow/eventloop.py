@@ -21,7 +21,7 @@ priority, insertion sequence).
 from __future__ import annotations
 
 import heapq
-from time import perf_counter as _perf_counter
+from time import perf_counter as _perf_counter  # fdblint: ignore[DET001]: slow-task profiling measures REAL step cost; never feeds virtual time
 from typing import Coroutine, Optional
 
 from .error import ActorCancelled, FdbError, SimulationFailure
@@ -91,7 +91,8 @@ class Task(Future):
     inside the actor at its current wait point, synchronously.
     """
 
-    __slots__ = ("_coro", "_loop", "name", "_waiting_on", "_cancelled")
+    __slots__ = ("_coro", "_loop", "name", "_waiting_on", "_cancelled",
+                 "_started")
 
     def __init__(self, loop: "EventLoop", coro: Coroutine, name: str = ""):
         super().__init__()
@@ -100,10 +101,31 @@ class Task(Future):
         self.name = name or getattr(coro, "__name__", "actor")
         self._waiting_on: Optional[Future] = None
         self._cancelled = False
+        self._started = False
+
+    def __del__(self):
+        # A task spawned but never driven (cluster built, loop never run)
+        # holds a never-started coroutine; close it so collection doesn't
+        # emit "coroutine was never awaited" — that warning must stay
+        # meaningful for REAL dropped actors (the fdblint ACT001 class),
+        # not fire for every lazily-constructed role.  close() on a
+        # never-started coroutine just marks it closed (no GeneratorExit
+        # runs), so no cleanup code executes at GC time.  Best-effort by
+        # nature: when Task and coroutine die in one GC *cycle*, CPython
+        # may order the coroutine's warning finalizer first (holding the
+        # coroutine alive from a finalize registry instead would pin the
+        # whole cycle — a leak, strictly worse); residual warnings stay
+        # visible via pytest's warning summary rather than gating.
+        if not self._started and not self._cancelled:
+            try:
+                self._coro.close()
+            except RuntimeError:
+                pass  # already running/closed — nothing to silence
 
     def _step(self, value=None, error: Optional[BaseException] = None):
         if self.is_ready():
             return
+        self._started = True
         self._waiting_on = None
         try:
             if error is not None:
@@ -252,9 +274,9 @@ class EventLoop:
             # Slow-task profiler (ref: Net2's slow task profiling): a
             # single step hogging the reactor is the #1 real-deployment
             # latency smell; surface it with its wall-clock cost.
-            w0 = _perf_counter()
+            w0 = _perf_counter()  # fdblint: ignore[DET001]: measures the step's REAL cpu cost (profiling), not simulated time
             fn()
-            dt = _perf_counter() - w0
+            dt = _perf_counter() - w0  # fdblint: ignore[DET001]: see above — wall delta is the profiler's measurement, virtual time untouched
             if dt >= threshold:
                 from .trace import TraceEvent
 

@@ -18,7 +18,6 @@ from __future__ import annotations
 
 import sys
 import threading
-import time
 from collections import Counter
 from typing import Dict, List, Optional, Tuple
 

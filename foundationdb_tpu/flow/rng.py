@@ -10,7 +10,7 @@ code must never use the global `random` module or wall-clock entropy in sim.
 from __future__ import annotations
 
 import math
-import random as _pyrandom
+import random as _pyrandom  # fdblint: ignore[DET002]: this module IS the sanctioned wrapper — it only ever instantiates seeded Random objects
 
 
 class UID:
@@ -47,7 +47,7 @@ class DeterministicRandom:
 
     def __init__(self, seed: int):
         self.seed = seed
-        self._r = _pyrandom.Random(seed)
+        self._r = _pyrandom.Random(seed)  # fdblint: ignore[DET002]: a seeded private Random instance is the determinism mechanism itself
 
     # --- core API (mirrors flow/IRandom.h) ---
     def random01(self) -> float:

@@ -13,6 +13,18 @@ import time
 from typing import Any, Optional
 
 
+def _event_now() -> float:
+    """Timestamp for an emitted event: ALWAYS the current loop's virtual
+    time when a loop is set — wall clock in a trace would break same-seed
+    trace reproducibility (SURVEY.md §5).  The wall read below is the
+    real-mode fallback for tools that trace before any loop exists."""
+    from .eventloop import _current_loop
+
+    if _current_loop is not None:
+        return _current_loop.now()
+    return time.time()  # fdblint: ignore[DET001]: real-mode fallback only; under simulation a loop is always set and the branch above wins
+
+
 class Severity:
     Debug = 5
     Info = 10
@@ -28,7 +40,7 @@ class TraceCollector:
         self.events: list[dict] = []
         self.path = path
         self.min_severity = min_severity
-        self._fh = open(path, "a") if path else None
+        self._fh = open(path, "a") if path else None  # fdblint: ignore[IO001]: trace spooling writes a real file by definition; sim tests use the in-memory collector (path=None)
         self.counts: dict[str, int] = {}
 
     def emit(self, event: dict):
@@ -109,11 +121,7 @@ class TraceEvent:
             return
         self._emitted = True
         if now is None:
-            # Virtual time when a loop is running — wall clock would break
-            # same-seed trace reproducibility (SURVEY.md §5 determinism).
-            from .eventloop import _current_loop
-
-            now = _current_loop.now() if _current_loop is not None else time.time()
+            now = _event_now()
         ev = {"Type": self.type, "Severity": self.severity, "Time": now}
         ev.update(self.fields)
         self._collector.emit(ev)

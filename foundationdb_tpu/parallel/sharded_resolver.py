@@ -37,7 +37,17 @@ import numpy as np
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+
+    _SHARD_MAP_KW = {}
+except ImportError:  # pre-0.5 releases export it under experimental only;
+    # that signature needs check_rep=False (no replication rule for the
+    # lax.while_loop fixpoint in detect_core) — the kwarg was renamed and
+    # later removed in the public API, so only pass it here.
+    from jax.experimental.shard_map import shard_map
+
+    _SHARD_MAP_KW = {"check_rep": False}
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..conflict import keys as keylib
@@ -182,6 +192,7 @@ def _make_sharded_step(mesh: Mesh, txn_cap, rr_cap, wr_cap, h_cap):
             repl,  # new_oldest_rel
         ),
         out_specs=(shard, shard, shard, shard, shard, shard, shard),
+        **_SHARD_MAP_KW,
     )
 
     def step(*args):

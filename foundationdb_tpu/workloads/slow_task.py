@@ -32,8 +32,8 @@ class SlowTaskWorkload(TestWorkload):
             # One loop step that burns real wall clock: exactly what the
             # profiler exists to catch.
             async def hog():
-                t0 = time.perf_counter()
-                while time.perf_counter() - t0 < self.burn_wall_s:
+                t0 = time.perf_counter()  # fdblint: ignore[DET001]: the workload's PURPOSE is burning real cpu to trip the slow-task profiler; no virtual-time decision depends on it
+                while time.perf_counter() - t0 < self.burn_wall_s:  # fdblint: ignore[DET001]: see above
                     sum(range(500))
 
             await db.process.spawn(hog(), "deliberate_hog")

@@ -63,17 +63,22 @@ def build_actor(loop, rng, depth, trace, label="r"):
 
     r = rng.random01()
     n = int(rng.random_int(2, 4))
-    children = [
-        build_actor(loop, rng, depth - 1, trace, f"{label}.{i}")
-        for i in range(n)
-    ]
+
+    # Children are built LAZILY, inside the combinator bodies: an eagerly
+    # built tree drops pre-built grandchild coroutines when a subtree task
+    # is cancelled before it starts (the "coroutine was never awaited"
+    # class whose blanket pytest ignore was removed; see pytest.ini).  Built-
+    # immediately-spawned coroutines are always owned by a Task, which
+    # closes them if never driven.
+    def build_child(i):
+        return build_actor(loop, rng, depth - 1, trace, f"{label}.{i}")
 
     if r < 0.35:
 
         async def combin_all():
             try:
                 vals = await all_of(
-                    [loop.spawn(c, f"{label}.{i}") for i, c in enumerate(children)]
+                    [loop.spawn(build_child(i), f"{label}.{i}") for i in range(n)]
                 )
                 trace.append((label, f"all{len(vals)}"))
                 return sum(v or 0 for v in vals)
@@ -88,7 +93,7 @@ def build_actor(loop, rng, depth, trace, label="r"):
 
         async def combin_first():
             tasks = [
-                loop.spawn(c, f"{label}.{i}") for i, c in enumerate(children)
+                loop.spawn(build_child(i), f"{label}.{i}") for i in range(n)
             ]
             try:
                 idx, val = await first_of(*tasks)
@@ -111,9 +116,11 @@ def build_actor(loop, rng, depth, trace, label="r"):
 
     async def combin_seq():
         total = 0
-        for i, c in enumerate(children):
+        # Built one at a time, just before its spawn: children after a
+        # mid-sequence cancellation are simply never constructed.
+        for i in range(n):
             try:
-                total += (await loop.spawn(c, f"{label}.{i}")) or 0
+                total += (await loop.spawn(build_child(i), f"{label}.{i}")) or 0
             except ActorCancelled:
                 raise  # cancellation must PROPAGATE, never be swallowed
             except FdbError:

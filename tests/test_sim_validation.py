@@ -1,0 +1,119 @@
+"""flow/sim_validation.py: the simulation-only invariant recorder.
+
+Ref: fdbrpc/sim_validation.{h,cpp} — production code marks promises
+("version V was acked durable") that the simulation later checks; a
+violation must be a loud failure.  Semantics under test: monotone marks,
+checking against the recorded high-water mark, per-loop state isolation
+(concurrent simulated clusters in one process must not interfere), and
+integration with a live simulated cluster.
+"""
+
+import pytest
+
+from foundationdb_tpu.flow.eventloop import EventLoop
+from foundationdb_tpu.flow.sim_validation import (
+    expect_at_least,
+    mark_at_least,
+    marked,
+)
+
+FLOOR = -(1 << 62)
+
+
+def test_marked_default_is_floor():
+    loop = EventLoop(seed=1)
+    assert marked(loop, "never_marked") == FLOOR
+
+
+def test_mark_is_monotone():
+    loop = EventLoop(seed=1)
+    mark_at_least(loop, "acked", 100)
+    assert marked(loop, "acked") == 100
+    # A lower mark must not regress the promise...
+    mark_at_least(loop, "acked", 40)
+    assert marked(loop, "acked") == 100
+    # ...and a higher one advances it.
+    mark_at_least(loop, "acked", 250)
+    assert marked(loop, "acked") == 250
+
+
+def test_expect_at_least_passes_at_and_above_mark():
+    loop = EventLoop(seed=1)
+    mark_at_least(loop, "acked", 100)
+    expect_at_least(loop, "acked", 100)  # equality is covering
+    expect_at_least(loop, "acked", 101)
+
+
+def test_expect_below_mark_is_loud():
+    loop = EventLoop(seed=1)
+    mark_at_least(loop, "durable", 500)
+    with pytest.raises(AssertionError, match="promised 500 but observed 499"):
+        expect_at_least(loop, "durable", 499)
+
+
+def test_expect_includes_context_in_failure():
+    loop = EventLoop(seed=1)
+    mark_at_least(loop, "durable", 7)
+    with pytest.raises(AssertionError, match="recovery epoch cut"):
+        expect_at_least(loop, "durable", 3, context="recovery epoch cut")
+
+
+def test_expect_on_unmarked_key_is_vacuous():
+    # No promise recorded -> nothing to violate (production code checks
+    # unconditionally; only simulation records marks).
+    loop = EventLoop(seed=1)
+    expect_at_least(loop, "never_marked", -(1 << 61))
+
+
+def test_keys_are_independent():
+    loop = EventLoop(seed=1)
+    mark_at_least(loop, "a", 10)
+    mark_at_least(loop, "b", 20)
+    assert marked(loop, "a") == 10
+    assert marked(loop, "b") == 20
+    expect_at_least(loop, "a", 10)
+    with pytest.raises(AssertionError):
+        expect_at_least(loop, "b", 15)
+
+
+def test_multi_loop_isolation():
+    # Two concurrent simulated clusters (two loops) in one test process:
+    # marks recorded against one must be invisible to the other.
+    a, b = EventLoop(seed=1), EventLoop(seed=2)
+    mark_at_least(a, "acked", 1000)
+    assert marked(b, "acked") == FLOOR
+    expect_at_least(b, "acked", 0)  # no promise on b: vacuous
+    with pytest.raises(AssertionError):
+        expect_at_least(a, "acked", 999)
+    mark_at_least(b, "acked", 5)
+    assert marked(a, "acked") == 1000
+    assert marked(b, "acked") == 5
+
+
+def test_state_survives_across_actors_on_one_loop():
+    # Marks made inside actors accumulate on the loop exactly like marks
+    # made from host code, and checks observe them in virtual-time order.
+    from foundationdb_tpu.server.cluster import SimCluster
+
+    cluster = SimCluster(seed=11, buggify=False)
+    loop = cluster.loop
+
+    async def committer(db):
+        for i in range(5):
+            tr = db.create_transaction()
+            tr.set(b"k%d" % i, b"v")
+            v = await tr.commit()
+            mark_at_least(loop, "acked_commit", v)
+
+    async def checker(db):
+        await loop.delay(10.0)
+        tr = db.create_transaction()
+        v = await tr.get_read_version()
+        # A read version must cover every acked commit.
+        expect_at_least(loop, "acked_commit", v, context="grv behind ack")
+        return v
+
+    db = cluster.database()
+    cluster.run_until(db.process.spawn(committer(db), "committer"))
+    got = cluster.run_until(db.process.spawn(checker(db), "checker"))
+    assert marked(loop, "acked_commit") <= got
