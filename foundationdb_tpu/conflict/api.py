@@ -142,6 +142,19 @@ class ConflictSet:
             self._small_streak = 0
         return self._cpu.detect(txns, now, new_oldest_version)
 
+    def device_metrics(self, now=None) -> Optional[dict]:
+        """Kernel-telemetry snapshot of the device engine (retraces,
+        padding occupancy, fixpoint rounds, grow/rebase — see
+        engine_jax.JaxConflictSet.metrics), or None for host-only
+        backends.  Feeds the status doc's tpu section and `cli metrics`."""
+        if self._jax is None:
+            return None
+        snap = self._jax.metrics.snapshot(now=now)
+        snap["last_occupancy"] = dict(self._jax.last_occupancy)
+        snap["distinct_shapes"] = len(self._jax._bucket_dispatches)
+        snap["h_cap"] = self._jax.h_cap
+        return snap
+
     def clear(self, version: int):
         for eng in (self._cpu, self._jax, self._oracle):
             if eng is not None:

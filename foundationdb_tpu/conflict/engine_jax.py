@@ -781,6 +781,26 @@ class JaxConflictSet:
         self._batches_since_evict = 0
         self._init_state(oldest_rel=0)
         self.last_iters = 0
+        # Kernel telemetry (ISSUE 2 tentpole): every signal that decides
+        # whether the device path is winning — retraces per static shape,
+        # padding occupancy, fixpoint rounds, grow/rebase events — into a
+        # MetricsRegistry.  No rng: aggregates only, deterministic without
+        # a loop.  Real dispatch wall cost goes through record_wall (the
+        # wall_metrics discipline) and never enters sim snapshots.
+        from ..flow.metrics import MetricsRegistry
+
+        self.metrics = MetricsRegistry("JaxConflict")
+        for _c in ("retraces", "batches", "transactions", "fixpoint_rounds",
+                   "grows", "rebases", "cpu_fallbacks"):
+            self.metrics.counter(_c)  # pre-create: snapshots list them all
+        # Static-shape key -> dispatch count.  A key's FIRST dispatch is an
+        # XLA trace+compile (the jit cache misses); the counter equalling
+        # len(_bucket_dispatches) is the no-recompile-storm invariant the
+        # telemetry test pins.
+        self._bucket_dispatches: dict = {}
+        # Per-batch padding occupancy (txn/read/write slot utilization of
+        # the padded capacities), refreshed on every dispatch.
+        self.last_occupancy: dict = {}
 
     # -- state management --
     def _init_state(self, oldest_rel: int):
@@ -818,6 +838,7 @@ class JaxConflictSet:
         if now - self._base > REBASE_THRESHOLD:
             d = int(self._oldest)
             if d > 0:
+                self.metrics.counter("rebases").add()
                 self._hvers = jnp.maximum(self._hvers - d, FLOOR_REL)
                 self._oldest = self._oldest - d
                 self._base += d
@@ -830,6 +851,7 @@ class JaxConflictSet:
                 self._grow(max(self.h_cap * 2, self.h_cap + 4 * wr_cap))
 
     def _grow(self, new_cap: int):
+        self.metrics.counter("grows").add()
         kw1 = self.key_words + 1
         pad = new_cap - self.h_cap
         self._hkeys = jnp.concatenate(
@@ -892,11 +914,37 @@ class JaxConflictSet:
         and transfer of batch N+1 under device compute of batch N.  The
         caller must eventually check undecided (see detect_packed)."""
         self._maybe_grow_or_rebase(now, pb.wr_cap)
+        m = self.metrics
+        # Retrace accounting: the jit cache key is the full static-arg
+        # tuple — the PackedBatch.bucket() capacities plus h_cap (growth
+        # recompiles) and the amortized-eviction flag.  First sight of a
+        # key = one XLA trace+compile.
+        amortized = self.evict_every > 1
+        shape_key = (pb.bucket(), self.h_cap, self.key_words + 1, amortized)
+        if shape_key not in self._bucket_dispatches:
+            self._bucket_dispatches[shape_key] = 0
+            m.counter("retraces").add()
+        self._bucket_dispatches[shape_key] += 1
+        m.counter("batches").add()
+        m.counter("transactions").add(pb.n_txn)
+        # Padding occupancy: live rows / padded capacity per axis.  Low
+        # txn occupancy with high retraces = bucket floors set wrong; the
+        # exact tradeoff PERF_NOTES tunes bucket_mins against.
+        self.last_occupancy = {
+            "txn": pb.n_txn / pb.txn_cap,
+            "read": pb.n_r / pb.rr_cap,
+            "write": pb.n_w / pb.wr_cap,
+        }
+        for axis, occ in self.last_occupancy.items():
+            m.histogram(f"{axis}_occupancy").add(occ)
         self._batches_since_evict += 1
         do_evict = 1 if self._batches_since_evict >= self.evict_every else 0
         if do_evict:
             self._batches_since_evict = 0
         blob = self._pack_blob(pb, now, new_oldest_version, do_evict)
+        from ..flow.metrics import wall_now
+
+        _t0 = wall_now()
         (
             self._hkeys,
             self._hvers,
@@ -916,8 +964,12 @@ class JaxConflictSet:
             wr_cap=pb.wr_cap,
             h_cap=self.h_cap,
             kw1=self.key_words + 1,
-            amortized=self.evict_every > 1,
+            amortized=amortized,
         )
+        # Async dispatch wall cost: covers host packing + transfer enqueue
+        # and — on a cache miss — the XLA trace/compile, NOT device
+        # compute (no sync here).  Wall namespace only.
+        m.record_wall("dispatch_seconds", wall_now() - _t0)
         self._last_iters_dev = iters
         self._hcount_bound = min(
             self._hcount_bound + 2 * pb.wr_cap, self.h_cap
@@ -928,6 +980,14 @@ class JaxConflictSet:
         """Run one packed batch; returns numpy statuses [txn_cap]."""
         statuses, undecided = self.dispatch_packed(pb, now, new_oldest_version)
         self.last_iters = int(self._last_iters_dev)
+        # The sync point: iters/undecided are host ints here, so surfacing
+        # the while_loop carry and the true boundary count costs no extra
+        # round-trip beyond the one this method already pays.
+        self.metrics.counter("fixpoint_rounds").add(self.last_iters)
+        self.metrics.histogram("fixpoint_rounds_per_batch").add(
+            self.last_iters
+        )
+        self.metrics.gauge("boundary_count").set(int(self._hcount))
         if int(undecided) != 0:
             # detect_core left the history state untouched in this case;
             # resolve the batch on the CPU engine against pristine state and
@@ -940,6 +1000,7 @@ class JaxConflictSet:
         from ..flow.trace import TraceEvent
         from .engine_cpu import CpuConflictSet
 
+        self.metrics.counter("cpu_fallbacks").add()
         TraceEvent("ConflictFixpointDiverged", severity=30).detail(
             "n_txn", pb.n_txn
         ).detail("now", now).log()

@@ -1,0 +1,220 @@
+"""MetricsRegistry: counters, gauges, and bounded histograms with
+deterministic sim-time snapshots and a periodic trace emitter actor.
+
+Ref: flow/Stats.h — `Counter`/`CounterCollection` :55-63 plus the
+`traceCounters` actor :111 — and Status.actor.cpp's qos section, which
+folds ContinuousSample percentiles into the status doc.  The registry is
+the pipeline's collection point: roles (resolver, proxy) and the device
+conflict engine record into one, the emitter actor periodically turns it
+into a TraceEvent, and `server/status.py` / `tools/cli.py` read
+`snapshot()` directly.
+
+Determinism contract (the property the whole pipeline is gated on):
+`snapshot()` contains ONLY values derived from the simulation — counter
+values, loop-virtual-time timestamps, and histogram aggregates whose
+reservoir sampling flows through the loop's DeterministicRandom.  Two
+same-seed runs therefore produce byte-identical `snapshot_json()` output.
+Wall-clock measurements (real device dispatch cost, rusage) go through
+`record_wall()` into a SEPARATE namespace that `snapshot()` excludes by
+default — the same discipline as `system_monitor.py`'s `wall_metrics`
+flag: real-mode observability must never leak into sim-compared output.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from .stats import ContinuousSample, Counter
+from .trace import TraceEvent
+
+
+def wall_now() -> float:
+    """REAL clock read for wall-namespace measurements (`record_wall`).
+    Centralized here so call sites measuring device dispatch cost don't
+    each carry a determinism pragma; the value must never feed virtual
+    time or a sim-compared snapshot."""
+    import time
+
+    return time.perf_counter()  # fdblint: ignore[DET001]: wall namespace only — record_wall output is excluded from sim snapshots by design
+
+
+class Gauge:
+    """Last-write-wins instantaneous value (ref: the status doc's point-in-
+    time fields, e.g. worst_queue_bytes)."""
+
+    __slots__ = ("name", "value")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.value = 0
+
+    def set(self, v):
+        self.value = v
+
+    def add(self, n=1):
+        self.value += n
+
+
+class BoundedHistogram:
+    """Distribution of a metric, bounded in memory.
+
+    Always maintains exact deterministic aggregates (count/sum/min/max);
+    with an rng (the loop's DeterministicRandom) it additionally keeps a
+    ContinuousSample reservoir for percentile queries.  Without an rng the
+    summary simply omits percentiles — callers that cannot reach a loop
+    rng (the device engine constructed before any loop exists) stay fully
+    deterministic."""
+
+    __slots__ = ("name", "count", "total", "_min", "_max", "_sample")
+
+    def __init__(self, name: str, rng=None, size: int = 500):
+        self.name = name
+        self.count = 0
+        self.total = 0.0
+        self._min = None
+        self._max = None
+        self._sample = ContinuousSample(rng, size) if rng is not None else None
+
+    def add(self, x: float):
+        self.count += 1
+        self.total += x
+        self._min = x if self._min is None else min(self._min, x)
+        self._max = x if self._max is None else max(self._max, x)
+        if self._sample is not None:
+            self._sample.add(x)
+
+    def summary(self) -> dict:
+        out = {
+            "count": self.count,
+            "sum": self.total,
+            "mean": self.total / self.count if self.count else None,
+            "min": self._min,
+            "max": self._max,
+        }
+        if self._sample is not None:
+            out["median"] = self._sample.percentile(0.5)
+            out["p90"] = self._sample.percentile(0.90)
+            out["p99"] = self._sample.percentile(0.99)
+        return out
+
+
+class MetricsRegistry:
+    """Named counters + gauges + histograms for one subsystem.
+
+    `rng` (the loop's DeterministicRandom) enables histogram percentiles;
+    it must never be a wall-seeded source in sim code paths."""
+
+    def __init__(self, name: str, rng=None):
+        self.name = name
+        self.rng = rng
+        self.counters: Dict[str, Counter] = {}
+        self.gauges: Dict[str, Gauge] = {}
+        self.histograms: Dict[str, BoundedHistogram] = {}
+        # Wall-clock namespace: (count, total seconds) per name.  Written
+        # by real-mode measurements only; excluded from sim snapshots.
+        self.wall: Dict[str, list] = {}
+
+    # -- instrument factories (get-or-create, like CounterCollection) --
+    def counter(self, name: str) -> Counter:
+        c = self.counters.get(name)
+        if c is None:
+            c = self.counters[name] = Counter(name)
+        return c
+
+    def adopt(self, counter: Counter) -> Counter:
+        """Register an EXISTING Counter (e.g. one owned by a role's
+        CounterCollection) under its own name, so both surfaces read ONE
+        underlying value — call sites increment once and the two views
+        can never drift.  The adopter must be the counter's only rate
+        emitter (rate_since_last resets a shared baseline)."""
+        self.counters[counter.name] = counter
+        return counter
+
+    def gauge(self, name: str) -> Gauge:
+        g = self.gauges.get(name)
+        if g is None:
+            g = self.gauges[name] = Gauge(name)
+        return g
+
+    def histogram(self, name: str, size: int = 500) -> BoundedHistogram:
+        h = self.histograms.get(name)
+        if h is None:
+            h = self.histograms[name] = BoundedHistogram(
+                name, rng=self.rng, size=size
+            )
+        return h
+
+    def record_wall(self, name: str, seconds: float):
+        """Accumulate a REAL-clock measurement (device dispatch cost and
+        the like).  Lives outside the deterministic snapshot; surfaced
+        only via snapshot(include_wall=True) for real-mode tooling."""
+        ent = self.wall.setdefault(name, [0, 0.0])
+        ent[0] += 1
+        ent[1] += seconds
+
+    # -- snapshots --
+    def snapshot(
+        self, now: Optional[float] = None, include_wall: bool = False
+    ) -> dict:
+        """Deterministic point-in-time view.  The timestamp comes from
+        loop virtual time ONLY: explicit `now`, else the current loop's
+        clock, else no timestamp at all — a wall-clock fallback here would
+        silently break byte-identical same-seed snapshots."""
+        if now is None:
+            from .eventloop import _current_loop
+
+            now = _current_loop.now() if _current_loop is not None else None
+        out: dict = {"name": self.name}
+        if now is not None:
+            out["time"] = now
+        out["counters"] = {
+            k: c.value for k, c in sorted(self.counters.items())
+        }
+        out["gauges"] = {k: g.value for k, g in sorted(self.gauges.items())}
+        out["histograms"] = {
+            k: h.summary() for k, h in sorted(self.histograms.items())
+        }
+        if include_wall:
+            out["wall"] = {
+                k: {"count": v[0], "seconds": v[1]}
+                for k, v in sorted(self.wall.items())
+            }
+        return out
+
+    def snapshot_json(
+        self, now: Optional[float] = None, include_wall: bool = False
+    ) -> str:
+        """Canonical byte form of snapshot() — what the determinism gate
+        compares across same-seed runs."""
+        import json
+
+        return json.dumps(
+            self.snapshot(now=now, include_wall=include_wall),
+            sort_keys=True,
+            separators=(",", ":"),
+        )
+
+
+async def emit_metrics(
+    registry: MetricsRegistry, process, interval: float = 5.0
+):
+    """Periodic emitter actor (ref: traceCounters flow/Stats.h:111): one
+    `<Name>Metrics` TraceEvent per interval carrying every counter (with
+    rate), gauge, and histogram summary.  Virtual-time paced; emits
+    nothing wall-derived, so the trace stream stays seed-reproducible."""
+    loop = process.network.loop
+    while True:
+        await loop.delay(interval)
+        now = loop.now()
+        ev = TraceEvent(f"{registry.name}Metrics")
+        for name, c in sorted(registry.counters.items()):
+            ev.detail(name, c.value)
+            ev.detail(f"{name}Rate", round(c.rate_since_last(now), 3))
+        for name, g in sorted(registry.gauges.items()):
+            ev.detail(name, g.value)
+        for name, h in sorted(registry.histograms.items()):
+            s = h.summary()
+            ev.detail(f"{name}Count", s["count"])
+            ev.detail(f"{name}Mean", s["mean"])
+            ev.detail(f"{name}Max", s["max"])
+        ev.log(now=now)

@@ -1,15 +1,14 @@
-"""Counters with periodic trace emission.
+"""Counters and bounded distribution samples.
 
 Ref: flow/Stats.h — `Counter` :55 (value + rate tracking),
-`CounterCollection` :63, and `traceCounters` :111 (an actor emitting every
-counter as a TraceEvent on an interval, resetting rates).
+`CounterCollection` :63.  The `traceCounters` :111 periodic-emission role
+lives in flow/metrics.py (`emit_metrics`), which emits every counter of a
+MetricsRegistry — registries adopt these Counter objects directly.
 """
 
 from __future__ import annotations
 
 from typing import Dict
-
-from .trace import TraceEvent
 
 
 class Counter:
@@ -19,12 +18,22 @@ class Counter:
         self.name = name
         self.value = 0
         self._last = 0
-        self._last_t = 0.0
+        # Rate baseline is established LAZILY at the first rate query: an
+        # eager 0.0 would make the first rate span "since time zero", which
+        # for a counter created late in a long run reports a wildly diluted
+        # rate (and a bogus large one for time-zero counters observed
+        # early).
+        self._last_t = None
 
     def add(self, n: int = 1):
         self.value += n
 
     def rate_since_last(self, now: float) -> float:
+        if self._last_t is None:
+            # First observation: no span to rate over yet.
+            self._last = self.value
+            self._last_t = now
+            return 0.0
         dt = now - self._last_t
         r = (self.value - self._last) / dt if dt > 0 else 0.0
         self._last = self.value
@@ -106,19 +115,3 @@ class CounterCollection:
 
     def snapshot(self) -> Dict[str, int]:
         return {k: c.value for k, c in self.counters.items()}
-
-
-async def trace_counters(
-    collection: CounterCollection, process, interval: float = 5.0
-):
-    """Emit every counter periodically (ref: traceCounters flow/Stats.h:111
-    — one event per collection with .detail per counter + rates)."""
-    loop = process.network.loop
-    while True:
-        await loop.delay(interval)
-        ev = TraceEvent(f"{collection.name}Metrics")
-        now = loop.now()
-        for name, c in sorted(collection.counters.items()):
-            ev.detail(name, c.value)
-            ev.detail(f"{name}Rate", round(c.rate_since_last(now), 3))
-        ev.log()

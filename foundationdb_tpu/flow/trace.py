@@ -55,9 +55,24 @@ class TraceCollector:
             self.events.append(event)
 
     def find(self, type_: str) -> list[dict]:
+        """Events of one type — IN-MEMORY collectors only.  A file-backed
+        collector spools events to disk without retaining them (see emit),
+        so `find` would silently return [] for events that were emitted;
+        raise instead of lying — query `counts` for per-type totals or
+        read the spool file."""
+        if self.path is not None:
+            raise RuntimeError(
+                "TraceCollector.find() on a file-backed collector: events "
+                f"are spooled to {self.path!r}, not retained; use .counts "
+                "or read the file"
+            )
         return [e for e in self.events if e["Type"] == type_]
 
     def clear(self):
+        """Reset the in-memory view (events + counts).  For file-backed
+        collectors this resets `counts` only; the spool file is an append
+        log and is deliberately left intact (clearing state must not
+        destroy the on-disk record)."""
         self.events.clear()
         self.counts.clear()
 

@@ -153,7 +153,7 @@ class Proxy:
             process, "load_system_map", well_known=True
         )
         # Ref: ProxyStats MasterProxyServer.actor.cpp:45 + traceCounters.
-        from ..flow.stats import CounterCollection, trace_counters
+        from ..flow.stats import CounterCollection
 
         self.stats = CounterCollection(f"Proxy{proxy_id}")
         for _c in ("batches", "committed", "conflicted", "too_old",
@@ -169,7 +169,22 @@ class Proxy:
             "commit": ContinuousSample(_rng),
             "grv": ContinuousSample(_rng),
         }
-        process.spawn(trace_counters(self.stats, process), "proxy_stats")
+        # Registry half of the pipeline (flow/metrics.py): ADOPTS the
+        # stats counters above (one underlying Counter per verdict — call
+        # sites increment once, the surfaces cannot drift) and adds the
+        # batch-size/latency distributions.  One emitter actor replaces
+        # trace_counters: emit_metrics emits the same per-counter
+        # value+rate details under the same event name, plus gauges and
+        # histogram summaries (two raters on one Counter would reset each
+        # other's rate baseline).
+        from ..flow.metrics import MetricsRegistry, emit_metrics
+
+        self.metrics = MetricsRegistry(f"Proxy{proxy_id}", rng=_rng)
+        for _c in self.stats.counters.values():
+            self.metrics.adopt(_c)
+        process.spawn(
+            emit_metrics(self.metrics, process), "proxy_metrics_emit"
+        )
         self._last_batch_cut = process.network.loop.now()
         process.spawn(self._commit_batcher(), "proxy_batcher")
         # Always tick (not just multi-proxy): empty batches advance the
@@ -329,6 +344,8 @@ class Proxy:
                     r, rep = await self._grv_stream.pop()
                     pairs.append((r, rep))
             self.stats.add("grv_requests", len(pairs))
+            if pairs:
+                self.metrics.histogram("grv_batch_size").add(len(pairs))
             if self.locked_uid is not None and pairs:
                 # Ref: GRVs also fail database_locked unless lock-aware.
                 from .interfaces import GRV_FLAG_LOCK_AWARE
@@ -590,6 +607,11 @@ class Proxy:
                     reply.send_error("database_locked")
             batch = kept
         self.stats.add("batches")
+        if batch:
+            # Real batches only: the idle ticker cuts empty batches every
+            # commit_batch_idle_interval, which would bury the size/latency
+            # distributions under zeros (the GRV path guards identically).
+            self.metrics.histogram("commit_batch_size").add(len(batch))
         # Phase 1: commit version from the sequencer, serialized in local
         # batch order so this proxy's versions are monotone in batch order
         # (ref: the localBatchNumber chain :362; GetCommitVersionRequest ->
@@ -820,7 +842,18 @@ class Proxy:
         await self.sequencer.report_committed.get_reply(self.process, version)
         if version > self.committed.get():
             self.committed.set(version)
-        self.latency_samples["commit"].add(loop0.now() - t_start)
+        if batch:
+            # Real batches only (both latency surfaces): the idle ticker's
+            # empty batches run the same pipeline and would dominate the
+            # qos percentiles with no-payload floor samples.
+            self.latency_samples["commit"].add(loop0.now() - t_start)
+            self.metrics.histogram("commit_batch_seconds").add(
+                loop0.now() - t_start
+            )
+        # The stats counters below ARE the registry counters (adopted in
+        # __init__): one increment per verdict, and both telemetry
+        # surfaces read the same value — a lock-rejected txn that resolved
+        # COMMITTED counts as rejected_locked, never committed.
         for t, ((req, reply), status) in enumerate(zip(batch, statuses)):
             trace_batch(
                 "CommitDebug",

@@ -81,9 +81,25 @@ class Resolver:
         self._split_stream = RequestStream(
             process, "resolution_split", well_known=True
         )
+        # Telemetry registry (ref: Resolver.actor.cpp's resolverCounters +
+        # traceCounters): batch sizes, per-verdict counts, and the queue
+        # wait the prevVersion reorder imposes.  The loop rng enables
+        # histogram percentiles deterministically.
+        from ..flow.metrics import MetricsRegistry, emit_metrics
+
+        loop = process.network.loop
+        self.metrics = MetricsRegistry(
+            f"Resolver.{process.name}", rng=loop.rng
+        )
+        for _c in ("batches", "transactions", "committed", "conflicted",
+                   "too_old", "cache_hits", "stale_epoch"):
+            self.metrics.counter(_c)  # pre-create: snapshots list them all
         process.spawn(self._serve(), "resolver")
         process.spawn(self._serve_metrics(), "resolver_metrics")
         process.spawn(self._serve_split(), "resolver_split")
+        process.spawn(
+            emit_metrics(self.metrics, process), "resolver_metrics_emit"
+        )
 
     def interface(self) -> ResolverInterface:
         return ResolverInterface(
@@ -176,6 +192,7 @@ class Resolver:
         from ..flow.trace import trace_batch
 
         if req.epoch != self.epoch:
+            self.metrics.counter("stale_epoch").add()
             reply.send_error("operation_failed")  # stale generation's proxy
             return
         trace_batch(
@@ -195,6 +212,7 @@ class Resolver:
             pinfo = self._proxy_info.get(req.proxy_id)
             cached = pinfo.outstanding.get(req.version) if pinfo else None
             if cached is not None:
+                self.metrics.counter("cache_hits").add()
                 reply.send(cached)
             else:
                 reply.send_error("operation_failed")
@@ -221,6 +239,18 @@ class Resolver:
             now=req.version, new_oldest_version=req.version - window
         )
         self.total_resolved += len(statuses)
+        # Feed the registry: batch size + per-verdict counts (the conflict
+        # rate "The Transactional Conflict Problem" trades against
+        # throughput).
+        from ..conflict.types import CONFLICT, TOO_OLD
+
+        m = self.metrics
+        m.counter("batches").add()
+        m.counter("transactions").add(len(statuses))
+        m.histogram("batch_size").add(len(statuses))
+        m.counter("committed").add(sum(1 for s in statuses if s == COMMITTED))
+        m.counter("conflicted").add(sum(1 for s in statuses if s == CONFLICT))
+        m.counter("too_old").add(sum(1 for s in statuses if s == TOO_OLD))
 
         # Retain this batch's state transactions with their verdicts so the
         # other proxies' next batches learn them (ref :170-181).

@@ -11,6 +11,84 @@ from __future__ import annotations
 from typing import Optional
 
 
+_ROLE_PLURAL = {
+    "proxy": "proxies",
+    "resolver": "resolvers",
+    "tlog": "tlogs",
+    "storage": "storages",
+}
+
+
+def role_objects(cluster, name: str) -> list:
+    """Live role objects of one kind across cluster flavors — the ONE
+    discovery path status and the CLI share (so the two surfaces can
+    never disagree about which roles exist): DynamicCluster
+    current-generation worker roles on live processes, SimCluster plural
+    lists, durable SimCluster singletons."""
+    if hasattr(cluster, "controllers"):  # DynamicCluster
+        try:
+            cc = cluster.acting_controller()
+        except RuntimeError:
+            cc = None
+        # Only THIS generation's recruited roles on live processes: a
+        # spare worker can still hold a frozen role object from an
+        # earlier generation (killed+rebooted, not re-recruited), which
+        # would wedge min-version / queue aggregates forever.
+        # _role_addrs only exists after the first recruitment completes.
+        current = set(getattr(cc, "_role_addrs", {}).values() if cc else ())
+        return [
+            w.roles[name]
+            for w in cluster.workers
+            if name in w.roles
+            and w.process.alive
+            and (not current or w.process.address in current)
+        ]
+    out = list(getattr(cluster, _ROLE_PLURAL[name], None) or [])
+    if not out and getattr(cluster, name, None) is not None:
+        out = [getattr(cluster, name)]  # durable SimCluster singleton
+    return out
+
+
+def _resolver_section(resolver_roles) -> Optional[dict]:
+    """The resolver/tpu status section (ISSUE 2): per-resolver registry
+    snapshots plus, when a device engine is live, its kernel telemetry
+    (retraces, padding occupancy, fixpoint rounds, grow/rebase).  Roles
+    without the telemetry surface (older/foreign conflict sets) degrade
+    to the counters they do have."""
+    roles = [r for r in resolver_roles if r is not None]
+    if not roles:
+        return None
+    sec: dict = {
+        "count": len(roles),
+        "total_resolved": sum(
+            getattr(r, "total_resolved", 0) for r in roles
+        ),
+        "backends": sorted(
+            {
+                getattr(r.conflicts, "backend", type(r.conflicts).__name__)
+                for r in roles
+                if hasattr(r, "conflicts")
+            }
+        ),
+        "resolvers": {},
+    }
+    tpu: dict = {}
+    for r in roles:
+        name = getattr(getattr(r, "process", None), "name", None) or (
+            f"resolver{len(sec['resolvers'])}"
+        )
+        m = getattr(r, "metrics", None)
+        if m is not None:
+            sec["resolvers"][name] = m.snapshot()
+        dm = getattr(getattr(r, "conflicts", None), "device_metrics", None)
+        snap = dm() if callable(dm) else None
+        if snap:
+            tpu[name] = snap
+    if tpu:
+        sec["tpu"] = tpu
+    return sec
+
+
 def cluster_status(cluster) -> dict:
     """Status for a SimCluster or DynamicCluster."""
     doc: dict = {
@@ -49,24 +127,12 @@ def cluster_status(cluster) -> dict:
             for name, role in w.roles.items():
                 roles.setdefault(name, []).append(w.process.address)
         cl["roles"] = roles
-        # Only THIS generation's recruited roles on live processes: a
-        # spare worker can still hold a frozen role object from an earlier
-        # generation (killed+rebooted, not re-recruited), which would wedge
-        # the min-version / queue aggregates forever.
-        # _role_addrs only exists after the first recruitment completes.
-        current = set(getattr(cc, "_role_addrs", {}).values() if cc else ())
-
-        def _live_roles(name):
-            return [
-                w.roles[name]
-                for w in cluster.workers
-                if name in w.roles
-                and w.process.alive
-                and (not current or w.process.address in current)
-            ]
-
-        storages = _live_roles("storage")
-        tlogs = _live_roles("tlog")
+        # Only THIS generation's recruited roles on live processes (see
+        # role_objects — a spare worker can still hold a frozen role
+        # object from an earlier generation, which would wedge the
+        # min-version / queue aggregates forever).
+        storages = role_objects(cluster, "storage")
+        tlogs = role_objects(cluster, "tlog")
         storage = storages[0] if storages else None
         tlog = tlogs[0] if tlogs else None
         proxy = next(
@@ -97,6 +163,10 @@ def cluster_status(cluster) -> dict:
         storages = list(getattr(cluster, "storages", []) or [cluster.storage])
         tlogs = list(getattr(cluster, "tlogs", []) or [cluster.tlog])
         storage, tlog, proxy = cluster.storage, cluster.tlog, cluster.proxy
+
+    rsec = _resolver_section(role_objects(cluster, "resolver"))
+    if rsec is not None:
+        cl["resolver"] = rsec
 
     if storage is not None:
         cl["data"] = {

@@ -31,7 +31,12 @@ class CliProcessor:
         "clearrange": "clearrange <begin> <end> — delete a key range",
         "getrange": "getrange <begin> [end] [limit] — read a range",
         "getrangekeys": "getrangekeys <begin> [end] [limit] — keys only",
-        "status": "status [json] — cluster status",
+        "status": "status [json | --format=json] — cluster status "
+        "(json form includes the resolver/tpu telemetry section)",
+        "metrics": "metrics [--format=json] — metrics-registry snapshots "
+        "(proxy/resolver counters, device kernel telemetry)",
+        "latency": "latency [--format=json] — per-stage commit/GRV "
+        "latency percentiles reassembled from trace_batch debug ids",
         "consistencycheck": "consistencycheck — compare every "
         "multi-replica shard across its team (fdbserver -r "
         "consistencycheck analog)",
@@ -354,7 +359,7 @@ class CliProcessor:
 
     async def _cmd_status(self, args):
         doc = cluster_status(self.cluster)
-        if args and args[0] == "json":
+        if args and args[0] in ("json", "--format=json"):
             from ..flow.eventloop import timeout_after
 
             # The json form runs the ACTIVE probe like the reference's
@@ -415,6 +420,90 @@ class CliProcessor:
                 f"  Workload         - {t['committed']} committed, "
                 f"{t['conflicted']} conflicted"
             )
+        if "resolver" in cl:
+            r = cl["resolver"]
+            lines.append(
+                f"  Resolver         - x{r['count']} "
+                f"({', '.join(r['backends']) or 'unknown'}), "
+                f"{r['total_resolved']} resolved"
+                + (", device telemetry live" if "tpu" in r else "")
+            )
+        return lines
+
+    async def _cmd_metrics(self, args):
+        """Registry snapshots straight off the live roles (the `fdbcli
+        status json` habit of reading counters, but for the ISSUE 2
+        metrics pipeline: proxy/resolver registries + kernel telemetry)."""
+        from ..server.status import role_objects
+
+        doc: dict = {}
+        for p in role_objects(self.cluster, "proxy"):
+            m = getattr(p, "metrics", None)
+            if m is not None:
+                doc.setdefault("proxies", {})[p.proxy_id] = m.snapshot()
+            stats = getattr(p, "stats", None)
+            if stats is not None:
+                doc.setdefault("proxy_counters", {})[
+                    p.proxy_id
+                ] = stats.snapshot()
+        for r in role_objects(self.cluster, "resolver"):
+            m = getattr(r, "metrics", None)
+            if m is not None:
+                doc.setdefault("resolvers", {})[r.process.name] = m.snapshot()
+            dm = getattr(getattr(r, "conflicts", None), "device_metrics", None)
+            snap = dm() if callable(dm) else None
+            if snap:
+                doc.setdefault("tpu", {})[r.process.name] = snap
+        if args and args[0] == "--format=json":
+            return json.dumps(doc, indent=2, default=str).splitlines()
+        lines = []
+        for section in sorted(doc):
+            lines.append(f"{section}:")
+            for name, snap in sorted(doc[section].items()):
+                lines.append(f"  {name}:")
+                for k, v in sorted(snap.items()):
+                    if isinstance(v, dict):
+                        for kk, vv in sorted(v.items()):
+                            lines.append(f"    {k}.{kk} = {vv}")
+                    else:
+                        lines.append(f"    {k} = {v}")
+        return lines or ["(no metrics registries live)"]
+
+    async def _cmd_latency(self, args):
+        """Per-stage commit/GRV latency percentiles, reassembled from the
+        g_traceBatch debug-id chains (flow/latency_chain.py)."""
+        from ..flow.latency_chain import latency_summary
+        from ..flow.trace import global_collector
+
+        col = global_collector()
+        if col.path is not None:
+            return [
+                "ERROR: trace collector is file-backed (events spooled "
+                f"to {col.path}); latency reassembly needs the in-memory "
+                "collector"
+            ]
+        summary = latency_summary(col.events)
+        if args and args[0] == "--format=json":
+            return json.dumps(summary, indent=2, default=str).splitlines()
+        lines = []
+        for chain in ("commit", "grv"):
+            lines.append(f"{chain} pipeline (seconds):")
+            stages = summary[chain]
+            any_sampled = any(s["count"] for s in stages.values())
+            if not any_sampled:
+                lines.append(
+                    "  (no sampled chains; raise "
+                    "client.latency_sample_rate)"
+                )
+                continue
+            for stage, s in stages.items():
+                if not s["count"]:
+                    continue
+                lines.append(
+                    f"  {stage:<18} n={s['count']:<5} "
+                    f"p50={s['p50']:.6f} p90={s['p90']:.6f} "
+                    f"p99={s['p99']:.6f} max={s['max']:.6f}"
+                )
         return lines
 
     async def _probe_swallowing(self):

@@ -38,6 +38,13 @@ IO001   direct ``open()`` / ``socket`` use outside the real backends
         ``rpc/real_network.py``, ``tools/``).  Simulated code does I/O
         through ``SimFileSystem`` / ``SimNetwork`` so faults are injectable
         and replayable.
+TRC001  a ``TraceEvent(...)`` built as a bare statement but never
+        ``.log()``ed and not used as a context manager: unlike the
+        reference (destructor emit, flow/Trace.h), the rebuild emits only
+        on ``.log()`` / ``with`` exit, so the event silently never reaches
+        the collector — the trace-layer mirror of ACT001's dropped future.
+        Statement-level like ACT001: ``ev = TraceEvent(...)`` held in a
+        variable is assumed to be logged later by the holder.
 PRG001  a ``# fdblint: ignore[...]`` pragma with no reason string.  Every
         suppression must say *why* the rule does not apply.
 PRG002  a pragma that suppresses nothing (stale after a refactor).
@@ -84,6 +91,7 @@ RULES: Dict[str, str] = {
     "ACT001": "actor coroutine called but neither awaited nor spawned (dropped future)",
     "JAX001": "host sync or Python side effect inside a jit-traced function",
     "IO001": "direct open()/socket outside the real I/O backends",
+    "TRC001": "TraceEvent constructed but never .log()ed nor used as a context manager (dropped event)",
     "PRG001": "fdblint ignore pragma carries no reason string",
     "PRG002": "fdblint ignore pragma suppresses nothing (stale)",
 }
@@ -143,6 +151,7 @@ DEFAULT_ALLOW: Dict[str, Tuple[str, ...]] = {
     ),
     "ACT001": (),
     "JAX001": (),
+    "TRC001": (),
     "IO001": (
         "fileio/realfile.py",
         "fileio/blobstore.py",
@@ -587,7 +596,33 @@ class ModuleLinter(ast.NodeVisitor):
                     f"coroutine '{dropped}()' is neither awaited nor spawned "
                     f"(dropped actor)",
                 )
+            self._check_dropped_trace_event(node, v)
         self.generic_visit(node)
+
+    def _check_dropped_trace_event(self, stmt: ast.Expr, call: ast.Call):
+        """TRC001: a statement-level TraceEvent(...) builder chain whose
+        outermost call is not .log() — the event is constructed, detailed,
+        and dropped (the rebuild has no destructor emit)."""
+        methods: List[str] = []
+        c: ast.AST = call
+        while isinstance(c, ast.Call):
+            # The root constructor call: its func is a pure Name/Attribute
+            # chain resolving to TraceEvent (bare, aliased, or module-
+            # qualified); builder methods between it and the statement are
+            # Attribute hops over inner Calls, collected in `methods`.
+            path = self.aliases.resolve(c.func)
+            if path is not None and path.split(".")[-1] == "TraceEvent":
+                if "log" not in methods:
+                    self.flag(
+                        "TRC001", stmt,
+                        "TraceEvent built but never .log()ed nor used as "
+                        "a context manager (dropped event)",
+                    )
+                return
+            if not isinstance(c.func, ast.Attribute):
+                return
+            methods.append(c.func.attr)
+            c = c.func.value
 
     def run(self) -> List[Finding]:
         self.prepass()

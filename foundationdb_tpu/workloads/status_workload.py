@@ -47,6 +47,14 @@ class StatusWorkload(TestWorkload):
             assert isinstance(cl["qos"], dict)
         if "processes" in cl:
             assert isinstance(cl["processes"], dict)
+        if "resolver" in cl:
+            r = cl["resolver"]
+            assert isinstance(r.get("count"), int) and r["count"] >= 1
+            assert isinstance(r.get("total_resolved"), int)
+            assert isinstance(r.get("backends"), list)
+            assert isinstance(r.get("resolvers"), dict)
+            for snap in r["resolvers"].values():
+                assert isinstance(snap.get("counters"), dict)
 
     async def start(self, db, cluster):
         from ..server.status import cluster_status

@@ -221,6 +221,39 @@ def test_jax001_jit_call_and_shard_map_targets():
     assert found.count("JAX001") == 2
 
 
+def test_trc001_dropped_trace_event():
+    src = (
+        "from foundationdb_tpu.flow.trace import TraceEvent\n"
+        "def f(err):\n"
+        "    TraceEvent('Dropped')\n"                      # bare: dropped
+        "    TraceEvent('AlsoDropped').detail('K', 1)\n"   # chained: dropped
+        "    TraceEvent('Ok').detail('K', 1).log()\n"      # emitted
+        "    with TraceEvent('CtxOk') as ev:\n"            # context manager
+        "        ev.detail('K', 2)\n"
+        "    e = TraceEvent('Held')\n"                     # held: assumed logged later
+        "    e.detail('K', 3)\n"
+        "    e.log()\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    trc = [f for f in findings if f.rule == "TRC001"]
+    assert [f.line for f in trc] == [3, 4]
+
+
+def test_trc001_respects_aliases_and_pragma():
+    src = (
+        "from foundationdb_tpu.flow import trace\n"
+        "def f():\n"
+        "    trace.TraceEvent('X').detail('a', 1)\n"
+        "    trace.TraceEvent('Y').detail('a', 1)  # fdblint: ignore[TRC001]: handed to a destructor-emit shim in this test\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    assert rules_of(findings) == ["TRC001"]
+    assert [f.line for f in findings if f.rule == "TRC001" and not f.suppressed] == [3]
+    # Unrelated builders named differently never match.
+    src2 = "def f(ev):\n    ev.detail('a', 1)\n    Event('x')\n"
+    assert rules_of(lint_source(src2, "server/x.py")) == []
+
+
 def test_io001_open_and_socket():
     src = (
         "import socket\n"
@@ -380,5 +413,6 @@ def test_pragma_examples_in_docstrings_are_inert():
 
 
 def test_rule_registry_documented():
-    for rule in ("DET001", "DET002", "DET003", "ACT001", "JAX001", "IO001"):
+    for rule in ("DET001", "DET002", "DET003", "ACT001", "JAX001", "IO001",
+                 "TRC001"):
         assert rule in RULES and RULES[rule]

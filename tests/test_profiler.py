@@ -54,6 +54,48 @@ def test_toggle_stops_sampling():
     assert p.total_samples > n
 
 
+def test_report_shape_and_clear():
+    """Report dict shape (what `profile report` renders) + clear()."""
+    p = SamplingProfiler(interval=0.002)
+    rep = p.report()
+    assert rep == {
+        "total_samples": 0,
+        "interval": 0.002,
+        "running": False,
+        "hot_functions": [],
+    }
+    p.start()
+    _busy_marker_fn(time.monotonic() + 0.2)
+    p.stop()
+    rep = p.report(top=3)
+    assert rep["total_samples"] > 0
+    assert len(rep["hot_functions"]) <= 3
+    for h in rep["hot_functions"]:
+        assert set(h) == {"function", "file", "line", "samples", "fraction"}
+        assert 0.0 <= h["fraction"] <= 1.0
+    # Fractions over ALL hot functions sum to <= 1 of total samples.
+    assert sum(h["samples"] for h in rep["hot_functions"]) <= rep[
+        "total_samples"
+    ]
+    p.clear()
+    rep2 = p.report()
+    assert rep2["total_samples"] == 0 and rep2["hot_functions"] == []
+
+
+def test_global_toggle_helpers():
+    """get_profiler() is a process-wide singleton; profiler_toggle drives
+    it (the ProfilerRequest/fdbcli `profile` path)."""
+    p = get_profiler()
+    assert get_profiler() is p
+    state = profiler_toggle(True, interval=0.004)
+    try:
+        assert state["running"] and state["interval"] == 0.004
+        assert p.running
+    finally:
+        state = profiler_toggle(False)
+    assert not state["running"] and not p.running
+
+
 def test_worker_rpc_toggle_and_cli():
     from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
     from foundationdb_tpu.server.worker import ProfilerRequest

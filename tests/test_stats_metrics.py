@@ -129,6 +129,51 @@ def test_slow_task_profiler_fires():
     set_event_loop(None)
 
 
+def test_system_monitor_wall_metrics_gated():
+    """wall_metrics=False (the sim default) keeps every rusage-derived
+    field out of the trace stream — tracing wall values under simulation
+    would break same-seed trace byte-identity; wall_metrics=True (real
+    deployments) adds them (ref: flow/SystemMonitor.cpp's machineMetrics
+    split)."""
+    from foundationdb_tpu.flow.system_monitor import run_system_monitor
+    from foundationdb_tpu.flow.trace import TraceCollector, set_global_collector
+
+    col = TraceCollector()
+    set_global_collector(col)
+    try:
+        c = SimCluster(seed=90)
+        db = c.database()
+        db.process.spawn(run_system_monitor(db.process, interval=0.5), "sm")
+        wall_proc = c.net.process("wallmon")
+        wall_proc.spawn(
+            run_system_monitor(wall_proc, interval=0.5, wall_metrics=True),
+            "sm_wall",
+        )
+
+        async def idle():
+            await c.loop.delay(2.0)
+
+        c.run_until(db.process.spawn(idle(), "idle"), timeout_vt=100.0)
+        evs = col.find("ProcessMetrics")
+        sim_evs = [e for e in evs if e["process"] != "wallmon"]
+        wall_evs = [e for e in evs if e["process"] == "wallmon"]
+        assert sim_evs and wall_evs
+        for e in sim_evs:  # NO wall-derived fields in the sim cadence
+            assert "max_rss_kb" not in e and "cpu_user_s" not in e
+        # Real-mode cadence carries rusage (where the platform has it).
+        assert any("max_rss_kb" in e for e in wall_evs)
+        # Virtual-time pacing: timestamps advance by the interval exactly.
+        times = [e["Time"] for e in sim_evs]
+        assert times == sorted(times)
+        assert all(
+            abs((t2 - t1) - 0.5) < 1e-9
+            for t1, t2 in zip(times, times[1:])
+        )
+    finally:
+        set_global_collector(TraceCollector())
+    set_event_loop(None)
+
+
 def test_metric_levels_multi_resolution():
     """TDMetric-style levels: level 0 records every flush; higher levels
     thin out by 4x per level (flow/TDMetric.actor.h:168)."""
