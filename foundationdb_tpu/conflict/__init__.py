@@ -32,6 +32,13 @@ from .types import (
     result_name,
 )
 from .api import ConflictSet
+from .device_faults import (
+    CompileFailed,
+    DeviceFault,
+    DeviceFaultInjector,
+    DeviceOOM,
+    DeviceUnavailable,
+)
 
 __all__ = [
     "CONFLICT",
@@ -40,4 +47,9 @@ __all__ = [
     "TransactionConflictInfo",
     "result_name",
     "ConflictSet",
+    "DeviceFault",
+    "DeviceUnavailable",
+    "CompileFailed",
+    "DeviceOOM",
+    "DeviceFaultInjector",
 ]

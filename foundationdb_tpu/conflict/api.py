@@ -6,9 +6,21 @@ Backends:
   "cpu"    - engine_cpu.CpuConflictSet (host, exact, low latency)
   "jax"    - engine_jax.JaxConflictSet (device, whole-batch vectorized)
   "oracle" - oracle.OracleConflictSet (test-only brute force)
-  "hybrid" - jax for large batches, cpu for small ones / oversized keys,
-             with state kept authoritative on whichever side last ran
+  "hybrid" - jax for large batches, cpu for small ones / oversized keys
              (the async-offload + fallback design from BASELINE.json)
+
+Device resilience (device_faults.py): whenever a device engine exists,
+the CPU SkipList stays AUTHORITATIVE — every device-served batch's
+committed writes are mirrored into it via apply_batch (cheap: merge +
+evict only, no re-detection), and a DeviceCircuitBreaker gates every
+device attempt.  A batch interrupted by a DeviceFault is re-run on the
+CPU engine inside the same _detect call with bit-identical verdicts (the
+two engines decide identically by construction); N consecutive faults
+open the circuit and route everything host-side; a half-open probe with
+deterministic exponential backoff re-attempts the device and, on
+success, rehydrates device state from the CPU engine (load_from rebuilds
+every boundary newer than oldest_version) before resuming.  No
+DeviceFault ever escapes detect_conflicts.
 
 Usage mirrors the reference ABI:
     cs = ConflictSet(backend="hybrid")
@@ -22,6 +34,7 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..flow.knobs import g_knobs
+from .device_faults import DeviceCircuitBreaker, DeviceFault
 from .engine_cpu import CpuConflictSet
 from .oracle import OracleConflictSet
 from .types import TransactionConflictInfo
@@ -53,16 +66,21 @@ class ConflictSet:
         key_words: Optional[int] = None,
         device=None,
         bucket_mins: tuple = (8, 8, 8),
+        fault_injector=None,
+        h_cap: int = 1 << 16,
     ):
         self.backend = backend
         self._cpu: Optional[CpuConflictSet] = None
         self._jax = None
         self._oracle: Optional[OracleConflictSet] = None
         kw = key_words if key_words is not None else g_knobs.server.conflict_device_key_words
-        if backend in ("cpu", "hybrid"):
+        if backend in ("cpu", "jax", "hybrid"):
+            # Device backends keep the CPU engine too: it is the
+            # authoritative mirror faulted batches fall back to.
             self._cpu = CpuConflictSet(oldest_version)
         if backend == "oracle":
             self._oracle = OracleConflictSet(oldest_version)
+        self._breaker: Optional[DeviceCircuitBreaker] = None
         if backend in ("jax", "hybrid"):
             from .engine_jax import JaxConflictSet  # lazy: jax import is heavy
 
@@ -71,13 +89,28 @@ class ConflictSet:
                 key_words=kw,
                 device=device,
                 bucket_mins=bucket_mins,
+                h_cap=h_cap,
             )
-        # hybrid: which side holds the authoritative history
+            for _c in ("device_faults", "breaker_opens", "breaker_probes",
+                       "breaker_closes", "degraded_batches", "rehydrates"):
+                self._jax.metrics.counter(_c)  # pre-create: stable snapshots
+            self._breaker = DeviceCircuitBreaker(metrics=self._jax.metrics)
+            self._jax.fault_injector = fault_injector
+        # hybrid: which side served the last device-eligible batch
         self._authority = "cpu" if backend == "hybrid" else backend
         self._key_words = kw
         # True once a long-key write range may have entered CPU history;
         # the device cannot represent it, so authority stays on CPU.
         self._history_long_keys = False
+        # Device state is stale whenever the CPU engine has absorbed a
+        # batch the device did not run (small-batch routing, a fault, or
+        # simply never having run); the next device attempt rehydrates
+        # with load_from first.
+        self._device_stale = True
+        # Set when the last batch was device-eligible but served by the
+        # CPU because of a fault or an open circuit; the resolver consumes
+        # it to tag the commit latency path (consume_degraded).
+        self._degraded_last = False
         # Hysteresis: consecutive sub-threshold batches seen while device
         # authority is held.  Authority only returns to the CPU after
         # AUTHORITY_HYSTERESIS of them — an alternating big/small workload
@@ -86,13 +119,27 @@ class ConflictSet:
 
     AUTHORITY_HYSTERESIS = 8
 
+    def install_fault_injector(self, injector) -> None:
+        """Attach a DeviceFaultInjector to the device engine (chaos
+        workloads); no-op for host-only backends."""
+        if self._jax is not None:
+            self._jax.fault_injector = injector
+
+    def consume_degraded(self) -> bool:
+        """True iff the most recent batch was served by the CPU because
+        of a device fault or an open breaker; reading resets the flag."""
+        was, self._degraded_last = self._degraded_last, False
+        return was
+
     def new_batch(self) -> ConflictBatch:
         return ConflictBatch(self)
 
     @property
     def oldest_version(self) -> int:
-        eng = self._engine_for_authority()
-        return eng.oldest_version
+        # The CPU engine, when present, is the authoritative mirror.
+        if self._cpu is not None:
+            return self._cpu.oldest_version
+        return self._engine_for_authority().oldest_version
 
     def _engine_for_authority(self):
         return {"cpu": self._cpu, "jax": self._jax, "oracle": self._oracle}[
@@ -102,12 +149,15 @@ class ConflictSet:
     def _detect(self, txns, now, new_oldest_version) -> List[int]:
         if self.backend == "hybrid":
             return self._detect_hybrid(txns, now, new_oldest_version)
+        if self.backend == "jax":
+            return self._detect_device(txns, now, new_oldest_version)
         return self._engine_for_authority().detect(txns, now, new_oldest_version)
 
-    def _detect_hybrid(self, txns, now, new_oldest_version) -> List[int]:
+    def _device_eligible(self, txns) -> bool:
+        """Every key in the batch fits the device width and no long-key
+        write has pinned history host-side."""
         srv = g_knobs.server
         max_key = min(srv.conflict_max_device_key_bytes, self._key_words * 4)
-        big = len(txns) >= srv.conflict_device_min_batch
         batch_fits = all(
             len(b) <= max_key and len(e) <= max_key
             for tr in txns
@@ -120,39 +170,91 @@ class ConflictSet:
         ):
             # A long-key write may enter history; until the window flushes it
             # the device state cannot represent the step function exactly.
-            # Conservative: pin authority to CPU until clear().
+            # Conservative: pin history to the CPU until clear().
             self._history_long_keys = True
-        device_ok = batch_fits and not self._history_long_keys
+        return batch_fits and not self._history_long_keys
+
+    def _device_serve(self, txns, now, new_oldest_version):
+        """One device attempt under the breaker.  Returns the statuses, or
+        None when the circuit is open or the attempt faulted — the caller
+        then serves the batch from the (authoritative) CPU mirror, which
+        decides bit-identically, so a fault never changes a verdict.  A
+        successful attempt mirrors the committed writes into the CPU
+        engine and is the breaker's half-open probe when one is due."""
+        if not self._breaker.allows_device():
+            self._degraded_last = True
+            return None
+        try:
+            if self._device_stale:
+                # Rehydrate: rebuild the device history (every boundary
+                # newer than oldest_version — older ones were evicted)
+                # from the CPU engine.  load_from can itself fault
+                # (grow/dispatch) — a fault here fails the probe.
+                self._jax.load_from(self._cpu)
+                self._breaker.note_rehydrate()
+                self._device_stale = False
+            statuses = self._jax.detect(txns, now, new_oldest_version)
+        except DeviceFault as e:
+            self._breaker.on_failure(e)
+            self._device_stale = True
+            self._degraded_last = True
+            return None
+        self._breaker.on_success()
+        self._cpu.apply_batch(txns, statuses, now, new_oldest_version)
+        return statuses
+
+    def _detect_device(self, txns, now, new_oldest_version) -> List[int]:
+        """backend="jax": every batch is device-eligible (modulo key
+        width); the CPU mirror absorbs faults and open-circuit windows."""
+        if self._device_eligible(txns):
+            statuses = self._device_serve(txns, now, new_oldest_version)
+            if statuses is not None:
+                return statuses
+        self._device_stale = True
+        return self._cpu.detect(txns, now, new_oldest_version)
+
+    def _detect_hybrid(self, txns, now, new_oldest_version) -> List[int]:
+        big = len(txns) >= g_knobs.server.conflict_device_min_batch
+        device_ok = self._device_eligible(txns)
         if device_ok and self._authority == "jax":
             # Already on device: run there even below the size threshold
             # (device dispatch on a warm small bucket beats a full history
             # transfer); only a sustained small streak flips authority back.
             self._small_streak = 0 if big else self._small_streak + 1
             if self._small_streak < self.AUTHORITY_HYSTERESIS:
-                return self._jax.detect(txns, now, new_oldest_version)
-        if big and device_ok:
-            if self._authority == "cpu":
-                self._jax.load_from(self._cpu)
-                self._authority = "jax"
-                self._small_streak = 0
-            return self._jax.detect(txns, now, new_oldest_version)
+                statuses = self._device_serve(txns, now, new_oldest_version)
+                if statuses is not None:
+                    return statuses
+        elif big and device_ok:
+            self._authority = "jax"
+            self._small_streak = 0
+            statuses = self._device_serve(txns, now, new_oldest_version)
+            if statuses is not None:
+                return statuses
         if self._authority == "jax":
-            self._jax.store_to(self._cpu)
+            # Flip back host-side.  No store_to needed: the mirror already
+            # holds exactly the state the device would export.
             self._authority = "cpu"
             self._small_streak = 0
+        self._device_stale = True
         return self._cpu.detect(txns, now, new_oldest_version)
 
     def device_metrics(self, now=None) -> Optional[dict]:
         """Kernel-telemetry snapshot of the device engine (retraces,
         padding occupancy, fixpoint rounds, grow/rebase — see
-        engine_jax.JaxConflictSet.metrics), or None for host-only
-        backends.  Feeds the status doc's tpu section and `cli metrics`."""
+        engine_jax.JaxConflictSet.metrics) plus the degraded-mode state
+        machine (backend_state: ok|degraded|probing, and the replayable
+        breaker transition log), or None for host-only backends.  Feeds
+        the status doc's tpu section and `cli metrics`."""
         if self._jax is None:
             return None
         snap = self._jax.metrics.snapshot(now=now)
         snap["last_occupancy"] = dict(self._jax.last_occupancy)
         snap["distinct_shapes"] = len(self._jax._bucket_dispatches)
         snap["h_cap"] = self._jax.h_cap
+        if self._breaker is not None:
+            snap["backend_state"] = self._breaker.state
+            snap["breaker"] = self._breaker.snapshot()
         return snap
 
     def clear(self, version: int):
@@ -162,3 +264,8 @@ class ConflictSet:
         if self.backend == "hybrid":
             self._authority = "cpu"
         self._history_long_keys = False
+        # Cleared engines agree, but rehydrating from the (tiny) cleared
+        # mirror is cheap and keeps one invariant: any CPU-side write the
+        # device missed forces a load_from.  Breaker state is NOT reset —
+        # clearing data says nothing about device health.
+        self._device_stale = True

@@ -1,0 +1,287 @@
+"""Device-fault injection + the degraded-mode circuit breaker.
+
+The reference's simulator owns every failure a disk or network can
+produce (fdbrpc/simulator.h ISimulator: killProcess :148, clogPair :264)
+and the code under test must degrade and recover; a run is replayable
+from its seed.  The device path needs the same discipline: XLA dispatch,
+jit compile, and history growth can all fail on real hardware
+(preemption, OOM, driver resets), and the conflict engine — the
+availability-critical serialization point ("The Transactional Conflict
+Problem", PAPERS.md) — must keep answering with bit-identical verdicts.
+
+Two pieces:
+
+``DeviceFaultInjector``
+    makes ``JaxConflictSet`` raise realistic failures at its three choke
+    points — dispatch (``DeviceUnavailable``), compile/retrace
+    (``CompileFailed``), ``_grow``/rebase (``DeviceOOM``) — from either a
+    scripted plan (tests) or BUGGIFY sites driven by the sim RNG (chaos
+    workloads).  Transient faults fire once; persistent faults hold a
+    site down for a drawn number of checks (or until ``end_outage``).
+    Every decision comes from ``DeterministicRandom``, so a run's fault
+    schedule replays from its seed, and ``injected`` logs it.
+
+``DeviceCircuitBreaker``
+    the degraded-mode state machine ``ConflictSet`` consults around every
+    device attempt::
+
+        ok ──(threshold consecutive faults)──> degraded
+        degraded ──(backoff device-eligible batches elapse)──> probing
+        probing ──(attempt succeeds)──> ok        (backoff resets)
+        probing ──(attempt faults)──> degraded    (backoff doubles)
+
+    While not ``ok``, batches are served by the CPU SkipList mirror —
+    which stays authoritative at all times, so verdicts never depend on
+    device health.  Transitions are counted in the engine's
+    MetricsRegistry and appended to a replayable ``transitions`` log
+    (same seed => byte-identical), surfaced through
+    ``ConflictSet.device_metrics()`` and the status doc's ``tpu``
+    section as ``backend_state``.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+
+class DeviceFault(Exception):
+    """Base of every injectable device failure; `site` names the choke
+    point that raised (dispatch/compile/grow/rebase)."""
+
+    transient = True
+
+    def __init__(self, message: str = "", site: str = ""):
+        super().__init__(message or site)
+        self.site = site
+
+
+class DeviceUnavailable(DeviceFault):
+    """XLA dispatch failed (device preempted/reset mid-stream)."""
+
+
+class CompileFailed(DeviceFault):
+    """jit trace/compile of a new static shape failed."""
+
+
+class DeviceOOM(DeviceFault):
+    """Device allocation failed growing or rebasing the history state."""
+
+    transient = False
+
+
+SITES = ("dispatch", "compile", "grow", "rebase")
+
+_SITE_FAULT = {
+    "dispatch": DeviceUnavailable,
+    "compile": CompileFailed,
+    "grow": DeviceOOM,
+    "rebase": DeviceOOM,
+}
+
+
+class DeviceFaultInjector:
+    """Deterministic fault source for the JAX engine's choke points.
+
+    Random mode (chaos): each ``check(site)`` consults the BUGGIFY site
+    ``device_fault_<site>`` at ``fire_probability`` — so fault-site
+    coverage shows up in the buggify coverage report — and on fire draws
+    transient-vs-persistent from the injector's own
+    ``DeterministicRandom`` (fork the loop rng with ``rng.split()`` so
+    the schedule is replayable without perturbing other sim decisions
+    mid-batch).
+
+    Scripted mode (tests): ``script(site, at=n, persist=k)`` faults the
+    n-th check of a site (1-based) and holds it down for k checks;
+    ``begin_outage``/``end_outage`` model an open-ended device loss.
+
+    ``injected`` records every raised fault as ``[seq, site, kind]`` —
+    the replay log the differential gate compares across same-seed runs.
+    """
+
+    def __init__(
+        self,
+        rng=None,
+        fire_probability: float = 0.0,
+        persistent_probability: float = 0.25,
+        max_persistent: int = 4,
+    ):
+        self.rng = rng
+        self.fire_probability = fire_probability
+        self.persistent_probability = persistent_probability
+        self.max_persistent = max_persistent
+        self.checks: Dict[str, int] = {s: 0 for s in SITES}
+        self.injected: List[list] = []  # [seq, site, kind]
+        self._seq = 0
+        self._outage: Dict[str, Optional[int]] = {}  # site -> remaining (None = open-ended)
+        self._scripted: Dict[str, Dict[int, int]] = {}  # site -> {at: persist}
+
+    # -- plans --
+    def script(self, site: str, at: int, persist: int = 1) -> None:
+        """Fault the `at`-th check of `site` (1-based) and keep the site
+        down for `persist` consecutive checks."""
+        assert site in SITES, site
+        assert at > self.checks[site], "cannot script the past"
+        self._scripted.setdefault(site, {})[at] = persist
+
+    def begin_outage(self, site: str) -> None:
+        """Hold `site` down until end_outage (a persistent device loss)."""
+        assert site in SITES, site
+        self._outage[site] = None
+
+    def end_outage(self, site: str) -> None:
+        self._outage.pop(site, None)
+
+    # -- the choke-point hook --
+    def check(self, site: str) -> None:
+        """Called by the engine before mutating state at `site`; raises
+        the site's fault type when the plan says so."""
+        self._seq += 1
+        n = self.checks[site] = self.checks[site] + 1
+        kind = None
+        # Scripted entries are consumed at their check number even when an
+        # outage/persistence window already covers it — overlapping plans
+        # EXTEND the window (max-merge), they never silently vanish.
+        persist = self._scripted.get(site, {}).pop(n, None)
+        remaining = self._outage.get(site, 0)
+        if site in self._outage:
+            if remaining is None:
+                kind = "outage"
+            else:
+                self._outage[site] = remaining - 1
+                if self._outage[site] == 0:
+                    del self._outage[site]
+                kind = "persistent"
+        if persist is not None:
+            if persist > 1:
+                tail = self._outage.get(site, 0)
+                if site in self._outage and tail is None:
+                    pass  # open-ended outage already covers everything
+                else:
+                    self._outage[site] = max(tail, persist - 1)
+            if kind is None:
+                kind = "persistent" if persist > 1 else "transient"
+        if kind is None and self.fire_probability > 0:
+            from ..flow.buggify import buggify_with_prob
+
+            if buggify_with_prob(
+                f"device_fault_{site}", self.fire_probability
+            ):
+                kind = "transient"
+                if (
+                    self.rng is not None
+                    and self.rng.random01() < self.persistent_probability
+                ):
+                    self._outage[site] = int(
+                        self.rng.random_int(1, self.max_persistent)
+                    )
+                    kind = "persistent"
+        if kind is not None:
+            self.injected.append([self._seq, site, kind])
+            raise _SITE_FAULT[site](f"injected {kind} fault", site=site)
+
+
+# Breaker states (the status doc's backend_state values).
+STATE_OK = "ok"
+STATE_DEGRADED = "degraded"
+STATE_PROBING = "probing"
+
+_STATE_GAUGE = {STATE_OK: 0, STATE_DEGRADED: 1, STATE_PROBING: 2}
+
+
+class DeviceCircuitBreaker:
+    """Consecutive-failure circuit breaker with deterministic exponential
+    backoff, counted in device-eligible batches (the only clock every
+    replay of a run agrees on)."""
+
+    def __init__(
+        self,
+        metrics=None,
+        threshold: int = 3,
+        backoff_batches: int = 2,
+        backoff_cap: int = 64,
+    ):
+        self.metrics = metrics
+        self.threshold = threshold
+        self.initial_backoff = backoff_batches
+        self.backoff_cap = backoff_cap
+        self.state = STATE_OK
+        self.consecutive_failures = 0
+        self.backoff = backoff_batches
+        self._cooldown = 0  # device-eligible batches until the next probe
+        self.seq = 0  # device-eligible batches observed
+        self.transitions: List[list] = []  # [seq, from, to, reason]
+        if metrics is not None:
+            metrics.gauge("backend_state").set(_STATE_GAUGE[self.state])
+
+    # -- queries --
+    def allows_device(self) -> bool:
+        """Gate one device-eligible batch; advances the backoff clock and
+        enters `probing` when it elapses.  Call at most once per batch."""
+        self.seq += 1
+        if self.state == STATE_DEGRADED:
+            self._cooldown -= 1
+            if self._cooldown > 0:
+                self._count("degraded_batches")
+                return False
+            self._transition(STATE_PROBING, "backoff_elapsed")
+            self._count("breaker_probes")
+        return True
+
+    # -- outcomes --
+    def on_success(self) -> None:
+        self.consecutive_failures = 0
+        if self.state != STATE_OK:
+            self._transition(STATE_OK, "probe_success")
+            self._count("breaker_closes")
+            self.backoff = self.initial_backoff
+
+    def on_failure(self, fault: DeviceFault) -> None:
+        self.consecutive_failures += 1
+        self._count("device_faults")
+        self._count(f"faults_{fault.site or 'unknown'}")
+        reason = f"{type(fault).__name__}:{fault.site or 'unknown'}"
+        if self.state == STATE_PROBING:
+            self.backoff = min(self.backoff * 2, self.backoff_cap)
+            self._cooldown = self.backoff
+            self._transition(STATE_DEGRADED, f"probe_failed:{reason}")
+        elif (
+            self.state == STATE_OK
+            and self.consecutive_failures >= self.threshold
+        ):
+            self._cooldown = self.backoff
+            self._transition(STATE_DEGRADED, f"threshold:{reason}")
+            self._count("breaker_opens")
+
+    def note_rehydrate(self) -> None:
+        self._count("rehydrates")
+
+    def count_degraded_batch(self) -> None:
+        self._count("degraded_batches")
+
+    # -- plumbing --
+    def _count(self, name: str) -> None:
+        if self.metrics is not None:
+            self.metrics.counter(name).add()
+
+    def _transition(self, to: str, reason: str) -> None:
+        from ..flow.trace import TraceEvent
+
+        frm, self.state = self.state, to
+        self.transitions.append([self.seq, frm, to, reason])
+        if self.metrics is not None:
+            self.metrics.gauge("backend_state").set(_STATE_GAUGE[to])
+        TraceEvent("DeviceBackendStateChange", severity=20).detail(
+            "from", frm
+        ).detail("to", to).detail("reason", reason).detail(
+            "seq", self.seq
+        ).log()
+
+    def snapshot(self) -> dict:
+        """Replayable view for device_metrics(): same seed => the json
+        dump of this dict is byte-identical across runs."""
+        return {
+            "state": self.state,
+            "consecutive_failures": self.consecutive_failures,
+            "backoff": self.backoff,
+            "transitions": [list(t) for t in self.transitions],
+        }

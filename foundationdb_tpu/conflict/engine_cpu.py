@@ -118,6 +118,14 @@ class CpuConflictSet:
             for (wb, we) in tr.write_ranges:
                 active.add(wb, we)
 
+        self._commit_writes(active, now, new_oldest_version)
+        return statuses
+
+    def _commit_writes(
+        self, active: _IntervalSet, now: int, new_oldest_version: int
+    ) -> None:
+        """Phases 3-4 on an already-decided batch: merge the committed
+        write union into history at `now`, then evict below the window."""
         # Phase 3: merge committed writes at `now` (ref mergeWriteConflictRanges)
         # `active` is exactly the union of committed writes, already merged.
         for b, e in zip(active.begins, active.ends):
@@ -137,7 +145,27 @@ class CpuConflictSet:
                 self.keys = [k for k, kp in zip(keys, keep) if kp]
                 self.vers = [v for v, kp in zip(vers, keep) if kp]
 
-        return statuses
+    def apply_batch(
+        self,
+        transactions: List[TransactionConflictInfo],
+        statuses: List[int],
+        now: int,
+        new_oldest_version: int,
+    ) -> None:
+        """Adopt an externally-decided batch (the device engine's verdicts)
+        into this engine's history: the committed transactions' writes are
+        merged and the window advanced EXACTLY as detect() would have —
+        since the device decides bit-identically, the mirrored state is
+        indistinguishable from having run the batch here.  This is how the
+        CPU SkipList stays authoritative under a device-served load, so a
+        device fault can always be absorbed by a host retry."""
+        active = _IntervalSet()
+        for t, tr in enumerate(transactions):
+            if statuses[t] != COMMITTED:
+                continue
+            for (wb, we) in tr.write_ranges:
+                active.add(wb, we)
+        self._commit_writes(active, now, new_oldest_version)
 
     def clear(self, version: int):
         self.keys = [b""]

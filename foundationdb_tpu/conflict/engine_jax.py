@@ -798,6 +798,13 @@ class JaxConflictSet:
         # len(_bucket_dispatches) is the no-recompile-storm invariant the
         # telemetry test pins.
         self._bucket_dispatches: dict = {}
+        # Device-fault hook (conflict/device_faults.py): when set, check()
+        # is consulted at the three choke points — dispatch, compile,
+        # grow/rebase — BEFORE any state mutation, so a raised fault
+        # always leaves the pre-batch history state intact and a host-side
+        # retry (the ConflictSet breaker, or _fallback_cpu's store_to) is
+        # exact.
+        self.fault_injector = None
         # Per-batch padding occupancy (txn/read/write slot utilization of
         # the padded capacities), refreshed on every dispatch.
         self.last_occupancy: dict = {}
@@ -834,10 +841,15 @@ class JaxConflictSet:
     def _rel(self, v: int) -> int:
         return int(np.clip(v - self._base, FLOOR_REL + 1, 2**31 - 2))
 
+    def _check_fault(self, site: str):
+        if self.fault_injector is not None:
+            self.fault_injector.check(site)
+
     def _maybe_grow_or_rebase(self, now: int, wr_cap: int):
         if now - self._base > REBASE_THRESHOLD:
             d = int(self._oldest)
             if d > 0:
+                self._check_fault("rebase")
                 self.metrics.counter("rebases").add()
                 self._hvers = jnp.maximum(self._hvers - d, FLOOR_REL)
                 self._oldest = self._oldest - d
@@ -851,6 +863,7 @@ class JaxConflictSet:
                 self._grow(max(self.h_cap * 2, self.h_cap + 4 * wr_cap))
 
     def _grow(self, new_cap: int):
+        self._check_fault("grow")
         self.metrics.counter("grows").add()
         kw1 = self.key_words + 1
         pad = new_cap - self.h_cap
@@ -913,6 +926,7 @@ class JaxConflictSet:
         undecided_dev) WITHOUT syncing, so callers can pipeline host packing
         and transfer of batch N+1 under device compute of batch N.  The
         caller must eventually check undecided (see detect_packed)."""
+        self._check_fault("dispatch")
         self._maybe_grow_or_rebase(now, pb.wr_cap)
         m = self.metrics
         # Retrace accounting: the jit cache key is the full static-arg
@@ -921,10 +935,14 @@ class JaxConflictSet:
         # key = one XLA trace+compile.
         amortized = self.evict_every > 1
         shape_key = (pb.bucket(), self.h_cap, self.key_words + 1, amortized)
-        if shape_key not in self._bucket_dispatches:
-            self._bucket_dispatches[shape_key] = 0
-            m.counter("retraces").add()
-        self._bucket_dispatches[shape_key] += 1
+        first_dispatch = shape_key not in self._bucket_dispatches
+        if first_dispatch:
+            # Compile faults (injected here, or a real XLA compile error
+            # below) raise before the key registers — registration happens
+            # only after a SUCCESSFUL dispatch — so the retry after
+            # recovery is again a first sight: correctly re-classified and
+            # its recompile correctly counted.
+            self._check_fault("compile")
         m.counter("batches").add()
         m.counter("transactions").add(pb.n_txn)
         # Padding occupancy: live rows / padded capacity per axis.  Low
@@ -945,27 +963,45 @@ class JaxConflictSet:
         from ..flow.metrics import wall_now
 
         _t0 = wall_now()
-        (
-            self._hkeys,
-            self._hvers,
-            self._hcount,
-            self._oldest,
-            statuses,
-            undecided,
-            iters,
-        ) = _blob_step(
-            self._hkeys,
-            self._hvers,
-            self._hcount,
-            self._oldest,
-            jnp.asarray(blob),
-            txn_cap=pb.txn_cap,
-            rr_cap=pb.rr_cap,
-            wr_cap=pb.wr_cap,
-            h_cap=self.h_cap,
-            kw1=self.key_words + 1,
-            amortized=amortized,
-        )
+        try:
+            (
+                self._hkeys,
+                self._hvers,
+                self._hcount,
+                self._oldest,
+                statuses,
+                undecided,
+                iters,
+            ) = _blob_step(
+                self._hkeys,
+                self._hvers,
+                self._hcount,
+                self._oldest,
+                jnp.asarray(blob),
+                txn_cap=pb.txn_cap,
+                rr_cap=pb.rr_cap,
+                wr_cap=pb.wr_cap,
+                h_cap=self.h_cap,
+                kw1=self.key_words + 1,
+                amortized=amortized,
+            )
+        except jax.errors.JaxRuntimeError as e:
+            # Real device failures (and ONLY those — a generic Python
+            # RuntimeError is a bug and must crash loudly, not vanish
+            # into graceful degradation): surface them in the injectable
+            # taxonomy so the breaker's degraded path handles hardware
+            # exactly like the simulation.  NOTE donated buffers may
+            # already be invalidated — callers must treat device state as
+            # stale (rehydrate before reuse).
+            from .device_faults import CompileFailed, DeviceUnavailable
+
+            kind = CompileFailed if first_dispatch else DeviceUnavailable
+            raise kind(f"xla: {e}", site="compile" if first_dispatch
+                       else "dispatch") from e
+        if first_dispatch:
+            self._bucket_dispatches[shape_key] = 0
+            m.counter("retraces").add()
+        self._bucket_dispatches[shape_key] += 1
         # Async dispatch wall cost: covers host packing + transfer enqueue
         # and — on a cache miss — the XLA trace/compile, NOT device
         # compute (no sync here).  Wall namespace only.

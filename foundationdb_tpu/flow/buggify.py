@@ -2,13 +2,21 @@
 
 Ref: flow/flow.h:50-67.  Each BUGGIFY call site is independently "activated"
 with probability 0.25 the first time it is evaluated in a simulation run;
-an activated site then fires with probability 0.25 per evaluation.  Sites
-are keyed by an explicit name (the reference keys by __FILE__:__LINE__).
+an activated site then fires with probability 0.25 per evaluation
+(``BUGGIFY_WITH_PROB`` lets the caller pick the per-evaluation
+probability).  Sites are keyed by an explicit name (the reference keys by
+__FILE__:__LINE__).
+
+Coverage accounting: every activation decision and fire is counted, so a
+chaos run can report WHICH fault sites its seed actually exercised
+(``publish_coverage`` folds the counts into a MetricsRegistry at sim end
+— a run that never fired its device-fault sites proved nothing about the
+degraded path).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
 
 from .knobs import g_knobs
 from .rng import DeterministicRandom
@@ -17,6 +25,7 @@ _enabled = False
 _rng: Optional[DeterministicRandom] = None
 _site_activated: dict[str, bool] = {}
 fired_sites: set[str] = set()
+fired_counts: Dict[str, int] = {}
 
 
 def set_buggify_enabled(enabled: bool, rng: Optional[DeterministicRandom] = None):
@@ -25,10 +34,12 @@ def set_buggify_enabled(enabled: bool, rng: Optional[DeterministicRandom] = None
     _rng = rng
     _site_activated.clear()
     fired_sites.clear()
+    fired_counts.clear()
 
 
-def buggify(site: str) -> bool:
-    """True randomly, only when buggification is on (i.e. in simulation)."""
+def buggify_with_prob(site: str, p: float) -> bool:
+    """BUGGIFY_WITH_PROB (ref flow.h:66): activated like any site, then
+    fires with probability `p` per evaluation.  False outside simulation."""
     if not _enabled or _rng is None:
         return False
     if site not in _site_activated:
@@ -37,7 +48,37 @@ def buggify(site: str) -> bool:
         )
     if not _site_activated[site]:
         return False
-    fired = _rng.random01() < g_knobs.flow.buggify_fired_probability
+    fired = _rng.random01() < p
     if fired:
         fired_sites.add(site)
+        fired_counts[site] = fired_counts.get(site, 0) + 1
     return fired
+
+
+def buggify(site: str) -> bool:
+    """True randomly, only when buggification is on (i.e. in simulation)."""
+    return buggify_with_prob(site, g_knobs.flow.buggify_fired_probability)
+
+
+def coverage() -> dict:
+    """Point-in-time fault-site coverage: how many sites this run SAW,
+    how many the seed activated, and per-site fire counts."""
+    return {
+        "sites_seen": len(_site_activated),
+        "sites_activated": sum(1 for v in _site_activated.values() if v),
+        "sites_fired": len(fired_sites),
+        "fired_counts": dict(sorted(fired_counts.items())),
+    }
+
+
+def publish_coverage(registry) -> dict:
+    """Fold the run's coverage into MetricsRegistry gauges (called at sim
+    end, e.g. by run_workloads): chaos runs report which fault sites they
+    exercised, and the deterministic snapshot carries it."""
+    cov = coverage()
+    registry.gauge("buggify_sites_seen").set(cov["sites_seen"])
+    registry.gauge("buggify_sites_activated").set(cov["sites_activated"])
+    registry.gauge("buggify_sites_fired").set(cov["sites_fired"])
+    for site, n in cov["fired_counts"].items():
+        registry.gauge(f"fired:{site}").set(n)
+    return cov

@@ -48,6 +48,6 @@ async def run_system_monitor(
                 ev.detail("max_rss_kb", ru.ru_maxrss)
                 ev.detail("cpu_user_s", round(ru.ru_utime, 3))
                 ev.detail("cpu_sys_s", round(ru.ru_stime, 3))
-            except Exception:  # pragma: no cover - platform without rusage
+            except Exception:  # pragma: no cover - platform without rusage  # fdblint: ignore[ERR001]: rusage details are optional; the event still logs without them
                 pass
         ev.log(now=loop.now())

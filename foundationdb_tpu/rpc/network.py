@@ -169,11 +169,27 @@ class SimNetwork:
         return 0.0001 + 0.0004 * self.loop.rng.random01()
 
     def clog_pair(self, ip_a: str, ip_b: str, seconds: float):
-        """Hold traffic both ways between two machines (ref:
-        ISimulator::clogPair simulator.h:264)."""
+        """Hold traffic ONE way, ip_a -> ip_b (ref: ISimulator::clogPair
+        simulator.h:264 clogs a single direction — asymmetric grey
+        failures, where requests arrive but replies stall, are exactly
+        the cases symmetric partitions can't reproduce).  Use
+        partition_pair for a full bidirectional cut."""
         until = self.loop.now() + seconds
-        for pair in ((ip_a, ip_b), (ip_b, ip_a)):
-            self._clogged[pair] = max(self._clogged.get(pair, 0.0), until)
+        pair = (ip_a, ip_b)
+        self._clogged[pair] = max(self._clogged.get(pair, 0.0), until)
+
+    def partition_pair(self, ip_a: str, ip_b: str, seconds: float):
+        """Hold traffic BOTH ways between two machines (two directional
+        clogs; the reference composes clogPair both ways for the same
+        effect)."""
+        self.clog_pair(ip_a, ip_b, seconds)
+        self.clog_pair(ip_b, ip_a, seconds)
+
+    def unclog_pair(self, ip_a: str, ip_b: str):
+        """Release one pair early, both directions (ref:
+        ISimulator::unclogPair)."""
+        self._clogged.pop((ip_a, ip_b), None)
+        self._clogged.pop((ip_b, ip_a), None)
 
     def unclog_all(self):
         self._clogged.clear()

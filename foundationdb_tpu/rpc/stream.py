@@ -74,7 +74,7 @@ class Reply:
         if not self._sent and self._reply_to is not None:
             try:
                 self._send((True, "broken_promise"))
-            except Exception:  # noqa: BLE001 - interpreter teardown
+            except Exception:  # noqa: BLE001 - interpreter teardown  # fdblint: ignore[ERR001]: __del__ during interpreter teardown — the network may be half-collected, nothing can surface it
                 pass
 
 

@@ -54,5 +54,5 @@ def load():
         mod = importlib.util.module_from_spec(spec)
         spec.loader.exec_module(mod)
         return mod
-    except Exception:
+    except Exception:  # fdblint: ignore[ERR001]: optional native codec — None selects the pure-python wire format, the handled path
         return None

@@ -151,7 +151,7 @@ class DataDistributionRole:
             return isinstance(v, int)
         except ActorCancelled:
             raise
-        except Exception:
+        except Exception:  # fdblint: ignore[ERR001]: liveness probe — ANY failure IS the negative verdict it reports
             return False
         finally:
             # A wedged-but-alive storage never replies: without this the

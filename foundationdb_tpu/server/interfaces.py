@@ -133,6 +133,10 @@ class ResolveTransactionBatchReply:
     # proxy applies a state txn only if EVERY resolver reports committed
     # (ref: the min-combine at MasterProxyServer.actor.cpp:455).
     state_mutations: List[Tuple[int, list]] = field(default_factory=list)
+    # The batch was resolved on the CPU fallback because a device fault or
+    # an open circuit degraded the device path (conflict/device_faults.py);
+    # the proxy tags its commit latency sample with it.
+    degraded: bool = False
 
 
 @dataclass

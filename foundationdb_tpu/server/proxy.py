@@ -391,7 +391,7 @@ class Proxy:
                         tps = info.tps
                         batch_tps = getattr(info, "batch_tps", info.tps)
                         self.last_rate_info = info  # surfaced by status/qos
-                    except Exception:  # noqa: BLE001 - rk down: keep old rate
+                    except Exception:  # noqa: BLE001 - rk down: keep old rate  # fdblint: ignore[ERR001]: ratekeeper unreachable — keeping the stale rate IS the degraded mode (a throttle beats none)
                         pass
                     last_fetch = loop.now()
                 if tps is not None:
@@ -850,6 +850,13 @@ class Proxy:
             self.metrics.histogram("commit_batch_seconds").add(
                 loop0.now() - t_start
             )
+            if any(getattr(rep, "degraded", False) for rep in replies):
+                # A resolver absorbed a device fault (CPU retry) inside
+                # this batch: tag its latency separately so degraded-mode
+                # cost is visible next to the healthy distribution.
+                self.metrics.histogram("commit_batch_seconds_degraded").add(
+                    loop0.now() - t_start
+                )
         # The stats counters below ARE the registry counters (adopted in
         # __init__): one increment per verdict, and both telemetry
         # surfaces read the same value — a lock-rejected txn that resolved

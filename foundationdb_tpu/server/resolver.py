@@ -92,8 +92,13 @@ class Resolver:
             f"Resolver.{process.name}", rng=loop.rng
         )
         for _c in ("batches", "transactions", "committed", "conflicted",
-                   "too_old", "cache_hits", "stale_epoch"):
+                   "too_old", "cache_hits", "stale_epoch",
+                   "degraded_batches"):
             self.metrics.counter(_c)  # pre-create: snapshots list them all
+        # Set once a raw device conflict set faulted and its state was
+        # exported host-side: the CPU engine then serves every later batch
+        # of this role's life (see _retry_on_cpu).
+        self._cpu_takeover = None
         process.spawn(self._serve(), "resolver")
         process.spawn(self._serve_metrics(), "resolver_metrics")
         process.spawn(self._serve_split(), "resolver_split")
@@ -148,6 +153,38 @@ class Resolver:
                     key=lambda kv: (kv[1], kv[0]),
                 ):
                     del self._key_sample[k]
+
+    def _retry_on_cpu(self, fault, req):
+        """Re-run a device-faulted batch on a host engine built from the
+        conflict set's pre-batch state (injected faults raise BEFORE any
+        device state mutates, so store_to exports exactly the history the
+        batch must be decided against — verdicts stay bit-identical; a
+        REAL XLA fault may have invalidated donated buffers, in which
+        case store_to raises and the actor dies loudly — recovery then
+        re-recruits, which beats deciding against corrupt history).  The
+        CPU engine takes over for the rest of this role's life: handing
+        state back to a faulting device mid-epoch risks a second
+        interruption with no authoritative copy."""
+        from ..conflict.engine_cpu import CpuConflictSet
+        from ..flow.trace import TraceEvent
+
+        store = getattr(self.conflicts, "store_to", None)
+        if store is None:
+            raise fault  # nothing to retry against: let the actor die loudly
+        TraceEvent("ResolverDeviceFaultRetry", severity=20).detail(
+            "error", type(fault).__name__
+        ).detail("site", getattr(fault, "site", "")).detail(
+            "version", req.version
+        ).log()
+        cpu = CpuConflictSet()
+        store(cpu)
+        self._cpu_takeover = cpu
+        window = g_knobs.server.max_write_transaction_life_versions
+        return cpu.detect(
+            req.transactions,
+            now=req.version,
+            new_oldest_version=req.version - window,
+        )
 
     async def _serve_metrics(self):
         while True:
@@ -230,14 +267,48 @@ class Resolver:
         first_unseen = pinfo.last_version + 1
         pinfo.last_version = req.version
 
-        batch = self.conflicts.new_batch()
+        conflicts = self._cpu_takeover or self.conflicts
+        batch = conflicts.new_batch() if self._cpu_takeover is None else None
         for tr in req.transactions:
-            batch.add_transaction(tr)
+            if batch is not None:
+                batch.add_transaction(tr)
             self._sample(tr)
         window = g_knobs.server.max_write_transaction_life_versions
-        statuses = batch.detect_conflicts(
-            now=req.version, new_oldest_version=req.version - window
-        )
+        degraded = False
+        if batch is not None:
+            from ..conflict.device_faults import DeviceFault
+
+            try:
+                statuses = batch.detect_conflicts(
+                    now=req.version, new_oldest_version=req.version - window
+                )
+            except DeviceFault as e:
+                # Last-resort host retry, same resolve call — no error may
+                # escape to the proxy (ConflictSet's breaker normally
+                # absorbs faults below this; raw device sets, e.g. the
+                # mesh-sharded one, surface them here).
+                statuses = self._retry_on_cpu(e, req)
+                degraded = True
+        else:
+            statuses = self._cpu_takeover.detect(
+                req.transactions,
+                now=req.version,
+                new_oldest_version=req.version - window,
+            )
+            degraded = True  # permanent host takeover: still degraded
+        consume = getattr(conflicts, "consume_degraded", None)
+        if consume is not None and consume():
+            degraded = True
+        if degraded:
+            self.metrics.counter("degraded_batches").add()
+            self.metrics.histogram("degraded_batch_size").add(
+                len(req.transactions)
+            )
+            trace_batch(
+                "CommitDebug",
+                "Resolver.resolveBatch.DegradedRetry",
+                req.debug_id,
+            )
         self.total_resolved += len(statuses)
         # Feed the registry: batch size + per-verdict counts (the conflict
         # rate "The Transactional Conflict Problem" trades against
@@ -260,6 +331,7 @@ class Resolver:
             ]
         out = ResolveTransactionBatchReply(
             committed=statuses,
+            degraded=degraded,
             state_mutations=[
                 (v, self._recent_state_txns[v])
                 for v in sorted(self._recent_state_txns)

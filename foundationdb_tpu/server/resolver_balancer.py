@@ -127,5 +127,5 @@ class ResolverBalancer:
                 for r in self.resolvers:
                     try:
                         await r.metrics.get_reply(self.db.process, None)
-                    except Exception:  # noqa: BLE001 - resolver died:
+                    except Exception:  # noqa: BLE001 - resolver died:  # fdblint: ignore[ERR001]: best-effort counter reset on a dying generation — recovery replaces the role anyway
                         pass  # the generation is ending anyway

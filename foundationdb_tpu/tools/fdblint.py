@@ -45,6 +45,14 @@ TRC001  a ``TraceEvent(...)`` built as a bare statement but never
         the collector — the trace-layer mirror of ACT001's dropped future.
         Statement-level like ACT001: ``ev = TraceEvent(...)`` held in a
         variable is assumed to be logged later by the holder.
+ERR001  a broad ``except`` (bare, ``Exception``, or ``BaseException``)
+        whose handler neither re-raises, nor TraceEvents, nor propagates
+        the error (``send_error``/using the bound exception).  Silent
+        swallowing is how degraded modes go unnoticed: the reference
+        routes every unexpected error through ``Error``/TraceEvent, and
+        the device-fault work (conflict/device_faults.py) depends on
+        faults SURFACING so the breaker can count and route them.  The
+        pragma goes on the ``except`` line itself.
 PRG001  a ``# fdblint: ignore[...]`` pragma with no reason string.  Every
         suppression must say *why* the rule does not apply.
 PRG002  a pragma that suppresses nothing (stale after a refactor).
@@ -92,6 +100,7 @@ RULES: Dict[str, str] = {
     "JAX001": "host sync or Python side effect inside a jit-traced function",
     "IO001": "direct open()/socket outside the real I/O backends",
     "TRC001": "TraceEvent constructed but never .log()ed nor used as a context manager (dropped event)",
+    "ERR001": "broad except that neither re-raises, TraceEvents, nor propagates the error (silent swallow)",
     "PRG001": "fdblint ignore pragma carries no reason string",
     "PRG002": "fdblint ignore pragma suppresses nothing (stale)",
 }
@@ -152,6 +161,12 @@ DEFAULT_ALLOW: Dict[str, Tuple[str, ...]] = {
     "ACT001": (),
     "JAX001": (),
     "TRC001": (),
+    "ERR001": (
+        "rpc/real_network.py",   # teardown paths on real sockets: close()
+        #                          best-effort by design
+        "tools/*.py",            # operational programs, not sim-executed
+        "utils/procutil.py",     # post-fork/pre-exec: may not even print
+    ),
     "IO001": (
         "fileio/realfile.py",
         "fileio/blobstore.py",
@@ -345,22 +360,29 @@ class ModuleLinter(ast.NodeVisitor):
         ast.Global, ast.Nonlocal,
     )
 
-    def flag(self, rule: str, node: ast.AST, message: str):
+    def flag(self, rule: str, node: ast.AST, message: str,
+             end_line: Optional[int] = None):
         if self.config.allows(rule, self.relpath):
             return
-        # Pragma scope: through the end of the innermost SIMPLE statement
-        # containing the node (never a compound statement — a def/if body
-        # must not become one giant suppression region).  Falls back to
-        # the node's own span for nodes outside any simple statement
-        # (decorators, if/while tests).
-        end = getattr(node, "end_lineno", None) or node.lineno
-        best = None
-        for s, e in self.stmt_spans:
-            if s <= node.lineno <= e:
-                if best is None or s > best[0] or (s == best[0] and e < best[1]):
-                    best = (s, e)
-        if best is not None:
-            end = max(end, best[1])
+        if end_line is not None:
+            # Caller pinned the pragma scope (ERR001: the `except` line
+            # only — its node span covers the whole handler body, which
+            # must not become one giant suppression region).
+            end = end_line
+        else:
+            # Pragma scope: through the end of the innermost SIMPLE
+            # statement containing the node (never a compound statement —
+            # a def/if body must not become one giant suppression
+            # region).  Falls back to the node's own span for nodes
+            # outside any simple statement (decorators, if/while tests).
+            end = getattr(node, "end_lineno", None) or node.lineno
+            best = None
+            for s, e in self.stmt_spans:
+                if s <= node.lineno <= e:
+                    if best is None or s > best[0] or (s == best[0] and e < best[1]):
+                        best = (s, e)
+            if best is not None:
+                end = max(end, best[1])
         self.findings.append(
             Finding(rule, self.relpath, node.lineno, node.col_offset, message,
                     end_line=end)
@@ -564,6 +586,57 @@ class ModuleLinter(ast.NodeVisitor):
                 "JAX001", node,
                 f"host numpy call '{path}' inside a jit-traced function",
             )
+
+    # -- ERR001: silent broad excepts --
+    _BROAD_EXC = {"Exception", "BaseException",
+                  "builtins.Exception", "builtins.BaseException"}
+
+    def _is_broad_except(self, t: Optional[ast.AST]) -> bool:
+        if t is None:
+            return True  # bare `except:`
+        if isinstance(t, ast.Tuple):
+            return any(self._is_broad_except(e) for e in t.elts)
+        return self.aliases.resolve(t) in self._BROAD_EXC
+
+    def _handler_surfaces_error(self, node: ast.excepthandler) -> bool:
+        """True when the handler visibly deals with the error: re-raises
+        (anywhere in its body, incl. nested cleanup), TraceEvents it,
+        forwards it via send_error, or reads the bound exception name
+        (passing it on IS handling; what ERR001 hunts is the error
+        vanishing without a trace)."""
+        for stmt in node.body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Raise):
+                    return True
+                if (
+                    node.name
+                    and isinstance(n, ast.Name)
+                    and n.id == node.name
+                ):
+                    return True
+                if isinstance(n, ast.Call):
+                    if (
+                        isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "send_error"
+                    ):
+                        return True
+                    path = self.aliases.resolve(n.func)
+                    if path is not None and path.split(".")[-1] == "TraceEvent":
+                        return True
+        return False
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if self._is_broad_except(node.type) and not self._handler_surfaces_error(node):
+            caught = "except:" if node.type is None else (
+                f"except {self.aliases.resolve(node.type) or '...'}"
+            )
+            self.flag(
+                "ERR001", node,
+                f"'{caught}' swallows errors silently "
+                f"(re-raise, TraceEvent, or propagate the error)",
+                end_line=node.lineno,
+            )
+        self.generic_visit(node)
 
     def visit_Global(self, node: ast.Global):
         if self._in_jitted(node):

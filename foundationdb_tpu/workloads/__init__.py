@@ -20,7 +20,11 @@ from .storefront import StorefrontWorkload
 from .unreadable import UnreadableWorkload
 from .remove_servers import RemoveServersSafelyWorkload
 from .targeted_kill import TargetedKillWorkload
-from .chaos import AttritionWorkload, RandomCloggingWorkload
+from .chaos import (
+    AttritionWorkload,
+    DeviceChaosWorkload,
+    RandomCloggingWorkload,
+)
 from .consistency import ConsistencyChecker, check_consistency
 from .config import SimulationConfig
 from .write_during_read import WriteDuringReadWorkload
@@ -61,6 +65,7 @@ __all__ = [
     "TestWorkload",
     "run_workloads",
     "CycleWorkload",
+    "DeviceChaosWorkload",
     "AtomicLedgerWorkload",
     "WriteSkewWorkload",
     "AtomicOpsWorkload",

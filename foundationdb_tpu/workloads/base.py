@@ -66,3 +66,18 @@ def run_workloads(
             timeout_vt=timeout_vt,
         )
         assert ok, f"workload {wl.name} check failed"
+    # Sim-end fault-site coverage (ref: the reference prints BUGGIFY
+    # coverage per run): which chaos sites this seed actually exercised,
+    # as registry gauges on the cluster + one trace event.
+    from ..flow.buggify import publish_coverage
+    from ..flow.metrics import MetricsRegistry
+    from ..flow.trace import TraceEvent
+
+    reg = MetricsRegistry("BuggifyCoverage")
+    cov = publish_coverage(reg)
+    cluster.buggify_coverage = reg
+    TraceEvent("BuggifyCoverage").detail(
+        "sites_seen", cov["sites_seen"]
+    ).detail("sites_activated", cov["sites_activated"]).detail(
+        "sites_fired", cov["sites_fired"]
+    ).log()

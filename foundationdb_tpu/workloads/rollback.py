@@ -57,7 +57,7 @@ class RollbackWorkload(TestWorkload):
                 continue
             for i, t in enumerate(tlogs):
                 if i != ut:
-                    cluster.net.clog_pair(proxy_m, t, self.clog_duration)
+                    cluster.net.partition_pair(proxy_m, t, self.clog_duration)
             self.triggered += 1
             await loop.delay(self.clog_duration / 3)
             # While the partial partition holds, cut off the proxy and the
@@ -66,9 +66,11 @@ class RollbackWorkload(TestWorkload):
             everyone = sorted(cluster.net.machines)
             for m in everyone:
                 if m != proxy_m:
-                    cluster.net.clog_pair(proxy_m, m, self.clog_duration)
+                    cluster.net.partition_pair(proxy_m, m, self.clog_duration)
                 if m != unclogged:
-                    cluster.net.clog_pair(unclogged, m, self.clog_duration)
+                    cluster.net.partition_pair(
+                        unclogged, m, self.clog_duration
+                    )
             await loop.delay(self.clog_duration * 1.5)
         # Let the cluster settle before checks.
         await loop.delay(2.0)

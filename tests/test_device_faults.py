@@ -1,0 +1,520 @@
+"""Device-fault injection & degraded-mode differential gate (ISSUE 3).
+
+The headline invariant: a same-seed simulation with device faults
+injected produces conflict verdicts IDENTICAL to the fault-free CPU-only
+run — the CPU SkipList mirror stays authoritative through every fault,
+open circuit, half-open probe, and rehydration — and the breaker's
+transition log is byte-identical across replays of the same seed.
+
+Shape discipline (1-core CI host): every JaxConflictSet here uses
+key_words=3 + bucket_mins=(32, 128, 64) with h_cap in {1<<9, 1<<10},
+the same static shapes test_conflict_jax compiles — XLA's in-process jit
+cache makes the marginal compile cost of this module near zero in a full
+run.  The cluster tests use SimCluster defaults, sharing test_e2e's
+shapes.
+"""
+
+import json
+
+import pytest
+
+from foundationdb_tpu.conflict.api import ConflictSet
+from foundationdb_tpu.conflict.device_faults import (
+    CompileFailed,
+    DeviceCircuitBreaker,
+    DeviceFault,
+    DeviceFaultInjector,
+    DeviceOOM,
+    DeviceUnavailable,
+)
+from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+from foundationdb_tpu.conflict.types import TransactionConflictInfo as T
+from foundationdb_tpu.flow import DeterministicRandom, set_event_loop
+from foundationdb_tpu.flow.buggify import set_buggify_enabled
+from foundationdb_tpu.flow.knobs import g_knobs
+
+
+@pytest.fixture(autouse=True)
+def _clean_buggify_and_loop():
+    yield
+    set_buggify_enabled(False)
+    set_event_loop(None)
+
+
+def k(i: int) -> bytes:
+    return b"%08d" % i
+
+
+def _random_stream(seed, keyspace, batches, txns_per_batch, snap_lag=25):
+    """(txns, now, new_oldest) batches from a seeded rng (standalone twin
+    of test_conflict_jax's stream: regenerable for a second engine)."""
+    rng = DeterministicRandom(seed)
+    version = 10
+    out = []
+    for _ in range(batches):
+        txns = []
+        for _ in range(rng.random_int(1, txns_per_batch + 1)):
+            tr = T(read_snapshot=max(0, version - rng.random_int(0, snap_lag)))
+            for _ in range(rng.random_int(0, 4)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 8))
+                tr.read_ranges.append((k(a), k(b)))
+            for _ in range(rng.random_int(0, 3)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 8))
+                tr.write_ranges.append((k(a), k(b)))
+            txns.append(tr)
+        version += rng.random_int(1, 10)
+        out.append((txns, version, max(0, version - 40)))
+    return out
+
+
+def _device_set(**kw):
+    kw.setdefault("backend", "jax")
+    kw.setdefault("key_words", 3)
+    kw.setdefault("bucket_mins", (32, 128, 64))
+    kw.setdefault("h_cap", 1 << 10)
+    return ConflictSet(**kw)
+
+
+def _drive(cs, stream):
+    out = []
+    for txns, now, nov in stream:
+        b = cs.new_batch()
+        for t in txns:
+            b.add_transaction(t)
+        out.append(b.detect_conflicts(now, nov))
+    return out
+
+
+def _drive_cpu(stream):
+    cpu = CpuConflictSet()
+    return [cpu.detect(txns, now, nov) for txns, now, nov in stream]
+
+
+# ---------------------------------------------------------------------------
+# Injector + breaker units
+# ---------------------------------------------------------------------------
+
+
+def test_injector_scripted_plan_and_log():
+    inj = DeviceFaultInjector()
+    inj.script("dispatch", at=2)
+    inj.script("grow", at=1, persist=2)
+    inj.check("dispatch")  # 1: clean
+    with pytest.raises(DeviceOOM):
+        inj.check("grow")  # 1: scripted, persists
+    with pytest.raises(DeviceUnavailable):
+        inj.check("dispatch")  # 2: scripted transient
+    with pytest.raises(DeviceOOM):
+        inj.check("grow")  # 2: persistence tail
+    inj.check("grow")  # 3: clean again
+    inj.check("dispatch")  # 3: clean
+    assert [e[1:] for e in inj.injected] == [
+        ["grow", "persistent"],
+        ["dispatch", "transient"],
+        ["grow", "persistent"],
+    ]
+    # Outages hold a site down until released; compile faults have their
+    # own type.
+    inj.begin_outage("compile")
+    with pytest.raises(CompileFailed):
+        inj.check("compile")
+    inj.end_outage("compile")
+    inj.check("compile")
+
+
+def test_injector_overlapping_scripted_windows_extend():
+    """A scripted entry whose check number falls inside an active
+    persistence window is consumed there and EXTENDS the window
+    (max-merge) — overlapping plans never silently vanish."""
+    inj = DeviceFaultInjector()
+    inj.script("dispatch", at=1, persist=2)  # covers checks 1-2
+    inj.script("dispatch", at=2, persist=4)  # lands inside the window
+    for n in (1, 2, 3, 4, 5):  # extended through check 5
+        with pytest.raises(DeviceUnavailable):
+            inj.check("dispatch")
+    inj.check("dispatch")  # 6: clean
+    assert len(inj.injected) == 5
+
+
+def test_injector_random_mode_replays_from_seed():
+    def run(seed):
+        set_buggify_enabled(True, DeterministicRandom(seed))
+        inj = DeviceFaultInjector(
+            rng=DeterministicRandom(seed + 1), fire_probability=0.5
+        )
+        for i in range(60):
+            site = ("dispatch", "grow", "compile", "rebase")[i % 4]
+            try:
+                inj.check(site)
+            except DeviceFault:
+                pass
+        return inj.injected
+
+    a, b = run(7), run(7)
+    assert a == b and a, "same seed must replay the same fault schedule"
+    assert run(7) != run(8), "schedule must actually depend on the seed"
+
+
+def test_breaker_state_machine_unit():
+    br = DeviceCircuitBreaker(threshold=3, backoff_batches=2)
+    fault = DeviceUnavailable("x", site="dispatch")
+    # Two faults: still closed (transient blips).
+    for _ in range(2):
+        assert br.allows_device()
+        br.on_failure(fault)
+    assert br.state == "ok"
+    assert br.allows_device()
+    br.on_success()
+    assert br.consecutive_failures == 0
+    # Three consecutive: opens.
+    for _ in range(3):
+        assert br.allows_device()
+        br.on_failure(fault)
+    assert br.state == "degraded"
+    # Backoff: one blocked batch, then a probe that fails -> backoff
+    # doubles; 3 blocked batches, then a probe that succeeds -> ok.
+    assert not br.allows_device()
+    assert br.allows_device() and br.state == "probing"
+    br.on_failure(fault)
+    assert br.state == "degraded" and br.backoff == 4
+    for _ in range(3):
+        assert not br.allows_device()
+    assert br.allows_device() and br.state == "probing"
+    br.on_success()
+    assert br.state == "ok" and br.backoff == 2
+    assert [(f, t) for _s, f, t, _r in br.transitions] == [
+        ("ok", "degraded"),
+        ("degraded", "probing"),
+        ("probing", "degraded"),
+        ("degraded", "probing"),
+        ("probing", "ok"),
+    ]
+
+
+# ---------------------------------------------------------------------------
+# The differential gate
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [3, 5, 9])
+def test_same_seed_faulty_run_matches_cpu_only_run(seed):
+    """>= 3 seeds: buggify-driven random device faults; verdicts must be
+    identical to the fault-free CPU-only run, and a same-seed replay must
+    produce a byte-identical breaker transition log + fault schedule."""
+    old_act = g_knobs.flow.buggify_activated_probability
+    g_knobs.flow.buggify_activated_probability = 1.0  # every site armed
+    try:
+        def faulty_run():
+            set_buggify_enabled(True, DeterministicRandom(seed))
+            inj = DeviceFaultInjector(
+                rng=DeterministicRandom(seed * 7 + 1), fire_probability=0.3
+            )
+            cs = _device_set(fault_injector=inj)
+            verdicts = _drive(cs, _random_stream(seed, 60, 14, 8))
+            dm = cs.device_metrics()
+            return verdicts, dm, inj.injected
+
+        v1, dm1, log1 = faulty_run()
+        v2, dm2, log2 = faulty_run()
+        want = _drive_cpu(_random_stream(seed, 60, 14, 8))
+        assert v1 == want, "faulty run diverged from the CPU-only run"
+        assert v1 == v2
+        assert log1 == log2 and log1, "fault schedule must replay (and fire)"
+        assert json.dumps(dm1["breaker"]) == json.dumps(dm2["breaker"])
+        assert dm1["counters"]["device_faults"] == len(log1)
+    finally:
+        g_knobs.flow.buggify_activated_probability = old_act
+
+
+def test_faults_mid_grow_and_recovery():
+    """A device OOM raised inside _grow (history at capacity) degrades to
+    the CPU with identical verdicts; once the outage lifts, the probe
+    rehydrates — growing the device history from the CPU state — and the
+    device resumes."""
+    inj = DeviceFaultInjector()
+    cs = _device_set(h_cap=1 << 9, fault_injector=inj)
+    cpu = CpuConflictSet()
+    v = 0
+    outage = False
+    for i in range(10):
+        # 8 txns x 8 disjoint NON-adjacent single-key writes (adjacent
+        # ones would coalesce into one segment): +128 boundaries per
+        # batch with the window pinned at 0, so capacity 512 exhausts at
+        # batch ~3 and growth is forced while the outage holds.
+        txns = [
+            T(
+                read_snapshot=v,
+                write_ranges=[
+                    (
+                        k(10_000 * i + 100 * t + 2 * j),
+                        k(10_000 * i + 100 * t + 2 * j + 1),
+                    )
+                    for j in range(8)
+                ],
+            )
+            for t in range(8)
+        ]
+        if i == 2 and not outage:
+            inj.begin_outage("grow")
+            outage = True
+        if i == 6:
+            inj.end_outage("grow")
+        v += 5
+        b = cs.new_batch()
+        for t in txns:
+            b.add_transaction(t)
+        assert b.detect_conflicts(v, 0) == cpu.detect(txns, v, 0), f"batch {i}"
+    assert any(site == "grow" for _s, site, _k in inj.injected), (
+        "the outage never hit _grow — capacity math drifted"
+    )
+    dm = cs.device_metrics()
+    assert dm["backend_state"] == "ok", dm["breaker"]
+    assert dm["counters"]["faults_grow"] >= 1
+    assert dm["counters"]["rehydrates"] >= 1
+    # The device really did grow past its initial capacity after recovery.
+    assert dm["h_cap"] > (1 << 9)
+    assert cs._jax.boundary_count == cpu.boundary_count
+
+
+def test_fault_during_half_open_probe():
+    """Scripted: 3 consecutive dispatch faults open the circuit; the
+    first half-open probe is faulted too (degraded again, backoff
+    doubles); the second probe succeeds and rehydrates.  The transition
+    sequence is exact and verdicts never diverge."""
+    stream = _random_stream(17, 50, 16, 6)
+
+    def run():
+        inj = DeviceFaultInjector()
+        # Site-check numbering: check #1 is batch 1's dispatch (batch 1
+        # also checks "compile" once — separate counter).  Faults at
+        # dispatch checks 2,3,4 are consecutive failures (batches 2,3,4)
+        # -> circuit opens; check 5 is the first probe -> faulted.
+        for at in (2, 3, 4, 5):
+            inj.script("dispatch", at=at)
+        cs = _device_set(fault_injector=inj)
+        verdicts = _drive(cs, stream)
+        return verdicts, cs.device_metrics()
+
+    verdicts, dm = run()
+    assert verdicts == _drive_cpu(stream)
+    assert [(f, t) for _s, f, t, _r in dm["breaker"]["transitions"]] == [
+        ("ok", "degraded"),
+        ("degraded", "probing"),
+        ("probing", "degraded"),
+        ("degraded", "probing"),
+        ("probing", "ok"),
+    ], dm["breaker"]["transitions"]
+    assert dm["backend_state"] == "ok"
+    assert dm["counters"]["breaker_opens"] == 1
+    assert dm["counters"]["breaker_probes"] == 2
+    assert dm["counters"]["breaker_closes"] == 1
+    # Replay: the transition log is byte-identical.
+    verdicts2, dm2 = run()
+    assert verdicts2 == verdicts
+    assert json.dumps(dm2["breaker"]) == json.dumps(dm["breaker"])
+
+
+def test_hybrid_faults_keep_cpu_agreement():
+    """Hybrid routing (size threshold + authority hysteresis) under
+    faults, including a DeviceOOM raised inside the probe's load_from
+    rehydration: verdicts stay identical to a pure-CPU run."""
+    old_min = g_knobs.server.conflict_device_min_batch
+    g_knobs.server.conflict_device_min_batch = 4
+    try:
+        stream = _random_stream(23, 60, 18, 8)
+        inj = DeviceFaultInjector()
+        for at in (2, 3, 4):  # open the circuit on-device
+            inj.script("dispatch", at=at)
+        inj.script("grow", at=1, persist=1)  # first rehydrate-grow attempt
+        cs = _device_set(backend="hybrid", fault_injector=inj)
+        assert _drive(cs, stream) == _drive_cpu(stream)
+        assert cs.device_metrics()["counters"]["device_faults"] >= 3
+    finally:
+        g_knobs.server.conflict_device_min_batch = old_min
+
+
+# ---------------------------------------------------------------------------
+# Cluster integration: resolver absorption, status/CLI surface, chaos
+# ---------------------------------------------------------------------------
+
+
+def test_cluster_resolver_absorbs_device_outage_and_status_surfaces():
+    """A persistent dispatch outage under live commit traffic: no error
+    ever reaches the proxy (every commit gets a verdict), the breaker
+    walks ok -> degraded -> ... -> ok, and the whole journey is visible
+    in resolver metrics, `ConflictSet.device_metrics()`, the status
+    doc's tpu section, and `status --format=json`."""
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.server.status import cluster_status
+    from foundationdb_tpu.tools.cli import CliProcessor
+
+    c = SimCluster(seed=1234, conflict_backend="jax")
+    db = c.database()
+    cs = c.resolver.conflicts
+    inj = DeviceFaultInjector()
+    cs.install_fault_injector(inj)
+    committed = []
+
+    async def commits(n, tag):
+        for i in range(n):
+            tr = db.create_transaction()
+            tr.set(b"df/%s%02d" % (tag, i), b"v")
+            committed.append(await tr.commit())
+
+    async def scenario():
+        await commits(3, b"a")  # healthy
+        inj.begin_outage("dispatch")
+        await commits(4, b"b")  # degraded: CPU absorbs, nothing escapes
+        inj.end_outage("dispatch")
+        # Let the idle/commit batches walk the breaker through its
+        # backoff to a successful probe.
+        await commits(4, b"c")
+        for _ in range(200):
+            if cs._breaker.state == "ok":
+                break
+            await c.loop.delay(0.1)
+
+    c.run_until(db.process.spawn(scenario(), "scenario"), timeout_vt=5000.0)
+    assert len(committed) == 11 and all(v is not None for v in committed)
+    dm = cs.device_metrics()
+    assert dm["backend_state"] == "ok", dm["breaker"]
+    pairs = [(f, t) for _s, f, t, _r in dm["breaker"]["transitions"]]
+    assert ("ok", "degraded") in pairs and ("probing", "ok") in pairs
+    assert dm["counters"]["device_faults"] >= 3
+    # Resolver-side: the degraded batches were counted and tagged.
+    snap = c.resolver.metrics.snapshot()
+    assert snap["counters"]["degraded_batches"] >= 3
+    assert snap["histograms"]["degraded_batch_size"]["count"] >= 1
+    # Status doc: the tpu sub-section carries backend_state + transitions.
+    doc = cluster_status(c)
+    tpu = doc["cluster"]["resolver"]["tpu"]["resolver"]
+    assert tpu["backend_state"] == "ok"
+    assert tpu["breaker"]["transitions"] == dm["breaker"]["transitions"]
+    # And the operator surface agrees: status --format=json parses.
+    cli = CliProcessor(c, db)
+
+    async def run_cli():
+        return await cli.run_command("status --format=json")
+
+    lines = c.run_until(db.process.spawn(run_cli(), "cli"), timeout_vt=600.0)
+    cli_doc = json.loads("\n".join(lines))
+    assert (
+        cli_doc["cluster"]["resolver"]["tpu"]["resolver"]["backend_state"]
+        == "ok"
+    )
+
+
+def test_resolver_host_retry_for_raw_conflict_set():
+    """A RAW conflict set (store_to but no breaker) that surfaces a
+    DeviceFault mid-resolve: the resolver retries the batch on a host
+    engine built from the set's pre-batch state IN the same resolve call
+    (no error to the proxy), then the CPU engine takes over for the rest
+    of the role's life."""
+    from foundationdb_tpu.conflict.api import ConflictBatch
+    from foundationdb_tpu.server import SimCluster
+
+    class FaultyRawSet:
+        def __init__(self):
+            self._cpu = CpuConflictSet()
+            self.detects = 0
+
+        def new_batch(self):
+            return ConflictBatch(self)
+
+        def _detect(self, txns, now, nov):
+            self.detects += 1
+            if self.detects >= 3:
+                raise DeviceUnavailable("raw set lost its device",
+                                        site="dispatch")
+            return self._cpu.detect(txns, now, nov)
+
+        def store_to(self, cpu):
+            cpu.keys = list(self._cpu.keys)
+            cpu.vers = list(self._cpu.vers)
+            cpu.oldest_version = self._cpu.oldest_version
+
+    raw = FaultyRawSet()
+    c = SimCluster(seed=77, conflict_set=raw)
+    db = c.database()
+    committed = []
+
+    async def commits():
+        for i in range(8):
+            tr = db.create_transaction()
+            tr.set(b"raw/%02d" % i, b"v")
+            committed.append(await tr.commit())
+
+    c.run_until(db.process.spawn(commits(), "commits"), timeout_vt=5000.0)
+    assert len(committed) == 8 and all(v is not None for v in committed)
+    r = c.resolver
+    assert r._cpu_takeover is not None, "host takeover never happened"
+    snap = r.metrics.snapshot()
+    assert snap["counters"]["degraded_batches"] >= 1
+    # The raw set was abandoned at the fault — every later batch was
+    # decided by the takeover engine against the exported state.
+    assert raw.detects == 3
+
+
+def test_device_chaos_workload_composes_with_clogging():
+    """DeviceChaosWorkload + RandomClogging under a Cycle invariant load:
+    serializability holds through combined device faults and network
+    chaos, the workload's own degraded-mode checks pass (run_workloads
+    asserts them), and the sim-end buggify coverage report names the
+    device fault sites."""
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.workloads import (
+        CycleWorkload,
+        DeviceChaosWorkload,
+        RandomCloggingWorkload,
+        SerializabilityWorkload,
+        run_workloads,
+    )
+
+    old_act = g_knobs.flow.buggify_activated_probability
+    g_knobs.flow.buggify_activated_probability = 1.0  # arm every site
+    try:
+        c = SimCluster(seed=424242, conflict_backend="jax", n_proxies=2)
+        chaos = DeviceChaosWorkload(duration=3.0, fire_probability=0.5)
+        run_workloads(
+            c,
+            [
+                CycleWorkload(nodes=6, ops=12, actors=2),
+                SerializabilityWorkload(registers=4, actors=2, ops=5),
+                chaos,
+                RandomCloggingWorkload(duration=2.0),
+            ],
+            timeout_vt=20000.0,
+        )
+        assert chaos.installed, "no device engine found to inject into"
+        fired = [inj.injected for _cs, inj in chaos.installed]
+        assert any(fired), "chaos run never injected a device fault"
+        # Sim-end coverage (satellite): the registry gauges name the
+        # device fault sites the seed exercised.
+        cov = c.buggify_coverage.snapshot()
+        assert cov["gauges"]["buggify_sites_fired"] >= 1
+        assert any(
+            g.startswith("fired:device_fault_") for g in cov["gauges"]
+        ), sorted(cov["gauges"])
+    finally:
+        g_knobs.flow.buggify_activated_probability = old_act
+
+
+def test_degraded_flag_consumed_once():
+    inj = DeviceFaultInjector()
+    inj.script("dispatch", at=1)
+    cs = _device_set(fault_injector=inj)
+    txns = [T(read_snapshot=0, write_ranges=[(k(1), k(2))])]
+    b = cs.new_batch()
+    b.add_transaction(txns[0])
+    b.detect_conflicts(5, 0)
+    assert cs.consume_degraded() is True
+    assert cs.consume_degraded() is False  # reading resets
+    from foundationdb_tpu.conflict.types import CONFLICT
+
+    b2 = cs.new_batch()
+    b2.add_transaction(T(read_snapshot=4, read_ranges=[(k(1), k(2))]))
+    # CONFLICT: the faulted batch's write really landed (CPU authority).
+    assert b2.detect_conflicts(6, 0) == [CONFLICT]
+    assert cs.consume_degraded() is False  # healthy batch

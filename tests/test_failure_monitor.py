@@ -56,6 +56,136 @@ def test_delta_protocol_and_snapshot_fallback():
     loop.run_until(client.spawn(run()), timeout_vt=100.0)
 
 
+def test_long_poll_wakes_on_state_bump():
+    """A consumer that is fully caught up parks in _wait_change; a state
+    bump mid-wait must wake it IMMEDIATELY with the delta — not after the
+    LONG_POLL_TIMEOUT liveness heartbeat."""
+    from foundationdb_tpu.flow.eventloop import EventLoop
+    from foundationdb_tpu.rpc.network import SimNetwork
+    from foundationdb_tpu.server.failure_monitor import LONG_POLL_TIMEOUT
+
+    loop = EventLoop(seed=11)
+    set_event_loop(loop)
+    net = SimNetwork(loop)
+    cc = net.process("cc")
+    client = net.process("client")
+    det = FailureDetector(cc)
+    out = {}
+
+    async def consumer():
+        det.set_state("a:0", True)
+        rep = await det.ref().get_reply(client, 0)
+        assert rep.version == 1
+        # Caught up: the next poll parks.  Bump the state mid-wait.
+        t0 = loop.now()
+
+        async def bump():
+            await loop.delay(0.05)
+            det.set_state("b:0", True)
+
+        client.spawn(bump())
+        rep2 = await det.ref().get_reply(client, rep.version)
+        out["dt"] = loop.now() - t0
+        out["states"] = rep2.states
+        out["version"] = rep2.version
+
+    loop.run_until(client.spawn(consumer()), timeout_vt=30.0)
+    assert out["states"] == [("b:0", True)] and out["version"] == 2
+    # Woken by the bump (0.05s + delivery latencies), not the heartbeat.
+    assert 0.05 <= out["dt"] < LONG_POLL_TIMEOUT / 2, out["dt"]
+
+
+def test_heartbeat_answers_empty_when_nothing_changes():
+    """The bounded long poll: with NO state change, the parked consumer
+    still gets a (delta-free) liveness answer at LONG_POLL_TIMEOUT."""
+    from foundationdb_tpu.flow.eventloop import EventLoop
+    from foundationdb_tpu.rpc.network import SimNetwork
+    from foundationdb_tpu.server.failure_monitor import LONG_POLL_TIMEOUT
+
+    loop = EventLoop(seed=12)
+    set_event_loop(loop)
+    net = SimNetwork(loop)
+    cc = net.process("cc")
+    client = net.process("client")
+    det = FailureDetector(cc)
+    out = {}
+
+    async def consumer():
+        t0 = loop.now()
+        rep = await det.ref().get_reply(client, 0)
+        out["dt"] = loop.now() - t0
+        out["rep"] = rep
+
+    loop.run_until(client.spawn(consumer()), timeout_vt=30.0)
+    assert out["rep"].version == 0 and out["rep"].states == []
+    assert out["dt"] >= LONG_POLL_TIMEOUT, out["dt"]
+
+
+def test_client_survives_monitor_death_mid_wait():
+    """Kill the monitor's host process while a client actor is parked in
+    its long poll: the broken promise must NOT kill the client loop — it
+    resets to version 0 and re-resolves the next generation's detector
+    from ClientDBInfo, then folds the full snapshot."""
+    from foundationdb_tpu.flow.asyncvar import AsyncVar
+    from foundationdb_tpu.flow.eventloop import EventLoop
+    from foundationdb_tpu.rpc.network import SimNetwork
+
+    loop = EventLoop(seed=13)
+    set_event_loop(loop)
+    net = SimNetwork(loop)
+    cc1 = net.process("cc1")
+    client_proc = net.process("client")
+    det1 = FailureDetector(cc1)
+    det1.set_state("a:0", True)
+
+    class _Info:
+        def __init__(self, fm):
+            self.failure_monitor = fm
+
+    class _Db:
+        process = client_proc
+        info_var = AsyncVar(_Info(det1.ref()))
+        failure_states: dict = {}
+
+    db = _Db()
+    client_proc.spawn(run_failure_monitor_client(db), "fm_client")
+
+    async def scenario():
+        # Phase 1: the client folds the first generation's state.
+        for _ in range(200):
+            if db.failure_states.get("a:0"):
+                break
+            await loop.delay(0.05)
+        assert db.failure_states.get("a:0") is True
+
+        # Phase 2: kill the CC while the client is parked in the long
+        # poll.  The client must absorb the broken promise and keep
+        # polling (not crash), re-reading info_var each round.
+        cc1.kill()
+        await loop.delay(1.0)
+
+        # Phase 3: a new generation's detector; enough churn that its
+        # bounded history is trimmed past version 0, so the client's
+        # known-version reset forces a FULL snapshot — which must clear
+        # the dead generation's stale entries (a:0) before folding.
+        cc2 = net.process("cc2")
+        det2 = FailureDetector(cc2)
+        det2.set_state("b:0", True)
+        for i in range(600):  # > HISTORY_LIMIT: trims past known=0
+            det2.set_state(f"x{i}:0", True)
+            det2.set_state(f"x{i}:0", False)
+        db.info_var.set(_Info(det2.ref()))
+        for _ in range(200):
+            if db.failure_states.get("b:0"):
+                break
+            await loop.delay(0.05)
+
+    loop.run_until(client_proc.spawn(scenario()), timeout_vt=600.0)
+    assert db.failure_states.get("b:0") is True
+    # Stale first-generation state was dropped by the snapshot fold.
+    assert db.failure_states.get("a:0") is None
+
+
 def test_read_routes_around_suspect_replica_without_timeout():
     """The VERDICT 'Done' criterion, grey-failure form: partition a
     storage replica from the CC only (it stays reachable from the client,
@@ -88,7 +218,7 @@ def test_read_routes_around_suspect_replica_without_timeout():
         # Long enough for several ping timeouts (PING_TIMEOUT=2.0) to
         # elapse INSIDE the clog window — detection timing is seed
         # dependent and must not race the clog's expiry.
-        c.net.clog_pair(
+        c.net.partition_pair(
             victim.process.machine.machine_id, cc_machine, 8.0
         )
 

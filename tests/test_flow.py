@@ -180,6 +180,51 @@ def test_buggify_gated_and_deterministic():
     set_buggify_enabled(False)
 
 
+def test_buggify_with_prob_and_coverage_report():
+    """BUGGIFY_WITH_PROB: caller-chosen fire probability behind the same
+    activation gate, with fired-site counts surfacing through
+    publish_coverage as MetricsRegistry gauges (chaos-run fault-site
+    coverage, ISSUE 3 satellite)."""
+    from foundationdb_tpu.flow.buggify import (
+        buggify_with_prob,
+        coverage,
+        fired_counts,
+        publish_coverage,
+    )
+    from foundationdb_tpu.flow.knobs import g_knobs
+    from foundationdb_tpu.flow.metrics import MetricsRegistry
+
+    set_buggify_enabled(False)
+    assert not buggify_with_prob("p_site", 1.0)  # gated off outside sim
+
+    old_act = g_knobs.flow.buggify_activated_probability
+    g_knobs.flow.buggify_activated_probability = 1.0
+    try:
+        set_buggify_enabled(True, DeterministicRandom(5))
+        assert all(buggify_with_prob("always", 1.0) for _ in range(20))
+        assert not any(buggify_with_prob("never", 0.0) for _ in range(20))
+        cov = coverage()
+        assert cov["sites_seen"] == 2 and cov["sites_activated"] == 2
+        assert cov["sites_fired"] == 1
+        assert cov["fired_counts"] == {"always": 20}
+        assert fired_counts["always"] == 20
+
+        reg = MetricsRegistry("BuggifyCoverage")
+        publish_coverage(reg)
+        snap = reg.snapshot()
+        assert snap["gauges"]["buggify_sites_fired"] == 1
+        assert snap["gauges"]["fired:always"] == 20
+
+        # p=1 fire replays identically; the plain buggify() rides the
+        # same counters.
+        set_buggify_enabled(True, DeterministicRandom(5))
+        assert buggify("site_b") in (True, False)
+        assert coverage()["sites_seen"] == 1  # reset cleared the old run
+    finally:
+        g_knobs.flow.buggify_activated_probability = old_act
+        set_buggify_enabled(False)
+
+
 def test_unhandled_actor_exception_fails_simulation():
     """A background actor dying with a Python error (a bug, not a simulated
     fault) must surface as SimulationFailure from run_until within one

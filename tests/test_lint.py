@@ -254,6 +254,87 @@ def test_trc001_respects_aliases_and_pragma():
     assert rules_of(lint_source(src2, "server/x.py")) == []
 
 
+def test_err001_silent_broad_excepts():
+    src = (
+        "def f(x):\n"
+        "    try:\n"
+        "        return g(x)\n"
+        "    except Exception:\n"       # silent: flagged
+        "        pass\n"
+        "def g(x):\n"
+        "    try:\n"
+        "        return h(x)\n"
+        "    except:\n"                 # bare: flagged
+        "        return None\n"
+        "def h(x):\n"
+        "    try:\n"
+        "        return x\n"
+        "    except BaseException:\n"   # tuple-free broad: flagged
+        "        x = 1\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    err = [f for f in findings if f.rule == "ERR001"]
+    assert [f.line for f in err] == [4, 9, 14]
+
+
+def test_err001_handled_broad_excepts_are_clean():
+    src = (
+        "from foundationdb_tpu.flow.trace import TraceEvent\n"
+        "def f(x, rep):\n"
+        "    try:\n"
+        "        return g(x)\n"
+        "    except Exception:\n"
+        "        raise\n"                                  # re-raise
+        "def g(x):\n"
+        "    try:\n"
+        "        return x\n"
+        "    except Exception as e:\n"
+        "        TraceEvent('Oops').detail('e', 1).log()\n"  # traced
+        "def h(x, rep):\n"
+        "    try:\n"
+        "        return x\n"
+        "    except Exception:\n"
+        "        rep.send_error('broken_promise')\n"        # propagated
+        "def k(x):\n"
+        "    try:\n"
+        "        return x\n"
+        "    except Exception as e:\n"
+        "        return wrap(e)\n"                          # bound name used
+        "def n(x):\n"
+        "    try:\n"
+        "        return x\n"
+        "    except (ValueError, KeyError):\n"              # narrow: not broad
+        "        return None\n"
+    )
+    assert "ERR001" not in rules_of(lint_source(src, "server/x.py"))
+
+
+def test_err001_pragma_on_except_line_only():
+    # The pragma must sit on the `except` line; one buried in the handler
+    # body does NOT suppress (the body is not a suppression region).
+    good = (
+        "def f(x):\n"
+        "    try:\n"
+        "        return g(x)\n"
+        "    except Exception:  # fdblint: ignore[ERR001]: probe — failure is the result\n"
+        "        return None\n"
+    )
+    findings = lint_source(good, "server/x.py")
+    assert rules_of(findings) == []
+    assert [f.reason for f in findings if f.suppressed] == [
+        "probe — failure is the result"
+    ]
+    bad = (
+        "def f(x):\n"
+        "    try:\n"
+        "        return g(x)\n"
+        "    except Exception:\n"
+        "        return None  # fdblint: ignore[ERR001]: wrong line\n"
+    )
+    found = rules_of(lint_source(bad, "server/x.py"))
+    assert "ERR001" in found and "PRG002" in found  # stale pragma too
+
+
 def test_io001_open_and_socket():
     src = (
         "import socket\n"
@@ -414,5 +495,5 @@ def test_pragma_examples_in_docstrings_are_inert():
 
 def test_rule_registry_documented():
     for rule in ("DET001", "DET002", "DET003", "ACT001", "JAX001", "IO001",
-                 "TRC001"):
+                 "TRC001", "ERR001"):
         assert rule in RULES and RULES[rule]

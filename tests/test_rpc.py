@@ -206,6 +206,53 @@ def test_clog_delays_delivery(net):
     assert times["slow"] >= 5.0 > baseline
 
 
+def test_clog_is_directional_reply_path_stays_clear(net):
+    """clog_pair holds ONE direction (ref ISimulator::clogPair): with only
+    the server->client leg clogged, requests arrive and are processed —
+    the grey failure where work happens but acks stall."""
+    proc = net.process("dserver")
+    rs = RequestStream(proc, "echo")
+    hits = []
+
+    async def server():
+        while True:
+            req, reply = await rs.pop()
+            hits.append((req, net.loop.now()))
+            reply.send(("echo", req))
+
+    proc.spawn(server(), "echo")
+    ref = rs.ref()
+    client = net.process("dclient")
+    times = {}
+
+    async def go(tag):
+        await ref.get_reply(client, tag)
+        times[tag] = net.loop.now()
+
+    # Clog the REPLY direction only.
+    net.clog_pair("dserver", "dclient", 5.0)
+    client.spawn(go("r1"))
+    net.loop.run()
+    assert times["r1"] >= 5.0  # the reply ate the clog...
+    assert hits and hits[0][0] == "r1"  # ...but the request was delivered
+    assert hits[0][1] < 1.0  # promptly, on the unclogged leg
+
+
+def test_partition_pair_and_unclog_pair(net):
+    """partition_pair cuts both directions; unclog_pair releases a single
+    pair early without touching other clogs."""
+    net.partition_pair("ma", "mb", 30.0)
+    net.clog_pair("mc", "md", 30.0)
+    assert net._clog_release("ma", "mb") > 0
+    assert net._clog_release("mb", "ma") > 0
+    net.unclog_pair("ma", "mb")
+    assert net._clog_release("ma", "mb") == 0
+    assert net._clog_release("mb", "ma") == 0
+    # The unrelated one-way clog survived.
+    assert net._clog_release("mc", "md") > 0
+    assert net._clog_release("md", "mc") == 0
+
+
 def test_payload_isolation(net):
     """Mutating a sent payload after send must not affect the receiver."""
     proc = net.process("server")
