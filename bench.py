@@ -183,11 +183,15 @@ def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=3407872, window=WINDOW):
         gen_packed(rng, per_batch, i, KEY_WORDS) for i in range(n_batches + warm)
     ]
     h_cap0 = cs.h_cap
+    d_cap0 = getattr(cs, "d_cap", 0)
     # Warm-up: compile AND fill the MVCC window to steady state.
     for i in range(warm):
         cs.detect_packed(batches[i], now=i + window, new_oldest_version=i)
     if verbose:
-        _log(f"steady-state boundaries: {cs.boundary_count}")
+        # boundary_count_bound, not boundary_count: the exact tiered count
+        # folds the delta host-side (O(rows) Python) — minutes at bench
+        # h_cap, unaffordable inside a tunnel window.
+        _log(f"steady-state boundaries: <= {cs.boundary_count_bound}")
     t0 = time.perf_counter()
     pending = []
     for j in range(warm, warm + n_batches):
@@ -199,11 +203,14 @@ def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=3407872, window=WINDOW):
     for _statuses, undecided in pending:
         assert int(undecided) == 0, "fixpoint diverged mid-bench"
     assert cs.h_cap == h_cap0, "history grew mid-bench; raise h_cap"
+    assert getattr(cs, "d_cap", 0) == d_cap0, (
+        "delta tier grew mid-bench; raise FDB_TPU_DELTA_CAP"
+    )
     if verbose:
         _log(
             f"{n_batches} batches in {dt:.2f}s "
             f"({dt / n_batches * 1e3:.0f} ms/batch), "
-            f"boundaries={cs.boundary_count}"
+            f"boundaries<={cs.boundary_count_bound}"
         )
     return n_batches * per_batch / dt
 
@@ -293,38 +300,76 @@ def probe_device(timeout):
     _log(f"device probe ok: {stdout.strip()}")
 
 
-def wait_for_device(out, errors, deadline):
-    """Retry the killable liveness probe until it succeeds or `deadline`
-    (time.perf_counter() units) passes.  The axon tunnel is known to be down
-    for stretches and come back (BENCH_r01/r03/r04 all lost the lottery with
-    a single-shot probe); a tunnel that comes up at minute 50 of the budget
-    must still yield a device number.  Emits a heartbeat JSON line per
-    attempt so the driver's last-line read always shows progress
-    (probe_attempts / probe_elapsed_s) alongside the best-so-far result.
+def wait_for_device(out, errors, deadline, probe_state=None):
+    """Retry the killable liveness probe until it succeeds, `deadline`
+    (time.perf_counter() units) passes, or — when `probe_state` is given —
+    the TOTAL probe spend cap is hit.  The axon tunnel is known to be down
+    for stretches and come back (BENCH_r01/r03/r04 all lost the lottery
+    with a single-shot probe), but an all-session-dead tunnel must not
+    ride the whole run to a driver kill either (BENCH_SESSION_NOTE shows
+    7 probe attempts eating the budget): `probe_state` caps probing at
+    `max_consecutive_fails` failures in a row AND `budget_s` cumulative
+    UNPRODUCTIVE probe seconds (failed attempts + inter-attempt sleeps;
+    a success resets both counters).  Tradeoff made explicit: at the default caps (2 fails / 25%)
+    a tunnel that is dead at the START of the run forfeits the device
+    phase after ~2 probe cycles — the rc=124 failure mode costs bounded
+    time now, at the price of the old wait-out-the-flap behavior.  A
+    mid-run flap after a SUCCESSFUL probe still retries (success resets
+    the consecutive count); operators who want the old patience raise
+    BENCH_PROBE_MAX_FAILS / BENCH_PROBE_BUDGET_FRAC.  Emits a heartbeat
+    JSON line per attempt so the driver's last-line read always shows
+    progress (probe_attempts / probe_elapsed_s) alongside the
+    best-so-far result.
 
-    Returns True when a probe succeeded, False when the budget ran out."""
+    Returns True when a probe succeeded, False when a budget/cap ran out
+    (probe_state["skipped"] then says which)."""
     # 240s per attempt: a healthy-but-slow tunnel can need minutes to answer
     # (r2's successful init took ~2 min); a dead tunnel hangs and gets
     # killed at the timeout, so the attempt cadence self-adjusts.
     probe_timeout = int(os.environ.get("BENCH_PROBE_TIMEOUT", "240"))
     interval = int(os.environ.get("BENCH_PROBE_INTERVAL", "90"))
-    t_start = time.perf_counter()
+    ps = probe_state if probe_state is not None else {
+        "spent_s": 0.0, "consecutive_fails": 0,
+        "budget_s": float("inf"), "max_consecutive_fails": 1 << 30,
+    }
     while True:
+        if ps.get("skipped"):
+            return False
         remaining = deadline - time.perf_counter()
         if remaining <= 5:
             out.setdefault("probe_last_error", "no attempt fit in budget")
+            return False
+        if ps["spent_s"] >= ps["budget_s"]:
+            ps["skipped"] = (
+                f"probe spend cap: {ps['spent_s']:.0f}s of "
+                f"{ps['budget_s']:.0f}s probe budget used"
+            )
+            return False
+        if ps["consecutive_fails"] >= ps["max_consecutive_fails"]:
+            ps["skipped"] = (
+                f"{ps['consecutive_fails']} consecutive probe failures "
+                f"(cap {ps['max_consecutive_fails']})"
+            )
             return False
         out["probe_attempts"] = out.get("probe_attempts", 0) + 1
         t_attempt = time.perf_counter()
         try:
             probe_device(min(probe_timeout, max(10, int(remaining))))
+            # A successful probe resets BOTH caps: the budget bounds
+            # consecutive UNPRODUCTIVE probing (the dead-tunnel mode),
+            # not the healthy-but-slow tunnel whose ~2-min successful
+            # probes across many variant attempts would otherwise eat it.
+            ps["consecutive_fails"] = 0
+            ps["spent_s"] = 0.0
             out.pop("probe_last_error", None)
-            out["probe_elapsed_s"] = round(time.perf_counter() - t_start, 1)
+            out["probe_elapsed_s"] = round(time.perf_counter() - t_attempt, 1)
             return True
         except Exception as e:
+            ps["spent_s"] += time.perf_counter() - t_attempt
+            ps["consecutive_fails"] += 1
             msg = f"{type(e).__name__}: {str(e)[-300:]}"
             out["probe_last_error"] = msg
-            out["probe_elapsed_s"] = round(time.perf_counter() - t_start, 1)
+            out["probe_elapsed_s"] = round(ps["spent_s"], 1)
             _log(
                 f"device probe attempt {out['probe_attempts']} failed ({msg}); "
                 f"{deadline - time.perf_counter():.0f}s of budget left"
@@ -332,6 +377,9 @@ def wait_for_device(out, errors, deadline):
             emit(out, errors)  # heartbeat: best-so-far + probe progress
             # Cadence-based sleep: attempts START every `interval` seconds;
             # an attempt that burned its timeout re-probes immediately.
+            # The sleep counts toward the probe-spend cap too — probing
+            # wall time is probing wall time, whether the tunnel hangs
+            # (240s timeouts) or fails fast (sleep-dominated).
             attempt_dur = time.perf_counter() - t_attempt
             sleep_s = min(
                 max(0, interval - attempt_dur),
@@ -339,6 +387,7 @@ def wait_for_device(out, errors, deadline):
             )
             if sleep_s > 0:
                 time.sleep(sleep_s)
+                ps["spent_s"] += sleep_s
 
 
 def main():
@@ -388,6 +437,31 @@ BASE_H_CAP = 3407872
 # speed, so the driver-time device phase may honestly report the fastest.
 VARIANTS = [
     ("baseline", {}, BASE_H_CAP),
+    # Two-tier history (ISSUE 4): per-batch phase-5/6 sorts run at delta
+    # size; a major compaction every 4 batches (FDB_TPU_EVICT_EVERY is the
+    # cadence alias in tiered mode) pays the full-H sorts amortized.  The
+    # base keeps sub-window rows between compactions, so h_cap gets the
+    # same headroom as the evict-batching variants; the delta is sized for
+    # 4 batches of 2*64k rows.
+    (
+        "tiered4",
+        {
+            "FDB_TPU_HISTORY": "tiered",
+            "FDB_TPU_EVICT_EVERY": "4",
+            "FDB_TPU_DELTA_CAP": str(5 * 2 * 65536),
+        },
+        BASE_H_CAP + 3 * 2 * 65536,
+    ),
+    (
+        "tiered4_2level",
+        {
+            "FDB_TPU_HISTORY": "tiered",
+            "FDB_TPU_EVICT_EVERY": "4",
+            "FDB_TPU_DELTA_CAP": str(5 * 2 * 65536),
+            "FDB_TPU_SEARCH": "2level",
+        },
+        BASE_H_CAP + 3 * 2 * 65536,
+    ),
     (
         "both_evict8_stride1k",
         {
@@ -410,6 +484,8 @@ _VARIANT_FLAG_KEYS = (
     "FDB_TPU_SEARCH",
     "FDB_TPU_SEARCH_STRIDE",
     "FDB_TPU_EVICT_EVERY",
+    "FDB_TPU_HISTORY",
+    "FDB_TPU_DELTA_CAP",
     "BENCH_H_CAP",
 )
 
@@ -464,6 +540,21 @@ def device_phase(out, errors, cpp_rate, cpu_rate):
     # a worst-case cold compile on this 1-core host.
     run_min = int(os.environ.get("BENCH_RUN_MIN", "1500"))
     max_runs = int(os.environ.get("BENCH_RUN_ATTEMPTS", "6"))
+    # Total device-probe spend cap (ISSUE 4 satellite): a dead tunnel gets
+    # at most BENCH_PROBE_MAX_FAILS consecutive failures or 25% of the
+    # device budget in probe wall time, whichever trips first — then the
+    # device phase is SKIPPED explicitly (device_skipped in the JSON)
+    # instead of riding the whole run into the driver's kill.
+    probe_state = {
+        "spent_s": 0.0,
+        "consecutive_fails": 0,
+        "budget_s": float(os.environ.get("BENCH_PROBE_BUDGET_FRAC", "0.25"))
+        * budget,
+        "max_consecutive_fails": int(
+            os.environ.get("BENCH_PROBE_MAX_FAILS", "2")
+        ),
+    }
+    out["device_skipped"] = False
     # After a first number is on the board, a further variant attempt is
     # worth starting only with this much budget left (cache-warm runs take
     # minutes; a cold-compile attempt that gets killed loses nothing —
@@ -487,7 +578,10 @@ def device_phase(out, errors, cpp_rate, cpu_rate):
             # No number yet and the whole plan failed once through:
             # keep cycling within the budget (tunnel flaps are transient).
             vi = 0
-        if not wait_for_device(out, errors, deadline):
+        if not wait_for_device(out, errors, deadline, probe_state):
+            if probe_state.get("skipped"):
+                out["device_skipped"] = probe_state["skipped"]
+                emit(out, errors)
             break
         name, flags, h_cap = queue[vi]
         for k in _VARIANT_FLAG_KEYS:
@@ -541,7 +635,13 @@ def device_phase(out, errors, cpp_rate, cpu_rate):
         fails_here = 0
     if best is None:
         raise RuntimeError(
-            f"no device number: {out.get('probe_attempts', 0)} probe "
+            f"no device number"
+            + (
+                f" (skipped: {out['device_skipped']})"
+                if out.get("device_skipped")
+                else ""
+            )
+            + f": {out.get('probe_attempts', 0)} probe "
             f"attempts, {run_attempts} run attempts over {budget}s; "
             f"last: {last_err or out.get('probe_last_error')}"
         )

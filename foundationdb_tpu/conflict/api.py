@@ -252,6 +252,19 @@ class ConflictSet:
         snap["last_occupancy"] = dict(self._jax.last_occupancy)
         snap["distinct_shapes"] = len(self._jax._bucket_dispatches)
         snap["h_cap"] = self._jax.h_cap
+        if getattr(self._jax, "tiered", False):
+            # Tier sizes/occupancy (ISSUE 4): delta fill and compaction
+            # counts also live in the counters/gauges/histograms above
+            # (major_compactions, base_boundaries, delta_boundaries,
+            # delta_occupancy); this block carries the host-side shape
+            # facts a snapshot can't derive.
+            snap["tiers"] = {
+                "mode": "tiered",
+                "d_cap": self._jax.d_cap,
+                "compact_every": self._jax.compact_every,
+                "batches_since_major": self._jax._batches_since_major,
+                "delta_bound": self._jax._dcount_bound,
+            }
         if self._breaker is not None:
             snap["backend_state"] = self._breaker.state
             snap["breaker"] = self._breaker.snapshot()

@@ -175,61 +175,84 @@ class PackedBatch:
 # ---------------------------------------------------------------------------
 # The jitted whole-batch step.  Static: capacities + key width; traced: state
 # arrays (donated) + batch tensors.
+#
+# The batch pipeline is factored into history-independent and per-tier
+# pieces so the flat single-tier step (detect_core) and the two-tier step
+# (detect_core_tiered, FDB_TPU_HISTORY=tiered) share one implementation:
+#   _resolve_batch        phases 2-4: point domain, intra-batch fixpoint,
+#                         committed-write segment extraction
+#   _merge_new_segments   phase 5: rank-merge a batch's segments into ONE
+#                         tier's step function (base for flat, delta for
+#                         tiered — the whole point of the tier split is
+#                         that this runs at delta size per batch)
+#   _evict_rule           phase 6's keep predicate (ref removeBefore)
+#   _compact_to           sort-by-target-position compaction
 # ---------------------------------------------------------------------------
 
 
-def detect_core(
-    hkeys,
-    hvers,
-    hcount,
-    oldest,
-    r_begin,
-    r_end,
-    r_txn,
-    r_snap,
-    w_begin,
-    w_end,
-    w_txn,
-    t_snap,
-    t_has_reads,
-    t_valid,
-    now_rel,
-    new_oldest_rel,
-    do_evict=None,
-    *,
-    txn_cap: int,
-    rr_cap: int,
-    wr_cap: int,
-    h_cap: int,
-):
-    import os as _os
+def _compact_to(pos, valid, words, width, fill_vers=None, vers=None,
+                count=None):
+    """Reorder columns of `words` [kw1, N] so column i lands at pos[i];
+    invalid columns drop off the end.  Returns [kw1, width] (+vers).
 
-    _ablate = set(_os.environ.get("FDB_TPU_ABLATE", "").split(","))
-    kw1 = hkeys.shape[0]
-    H = h_cap
+    This is SORT-BY-TARGET-POSITION, not scatter: a single-key int32 sort
+    carrying the payload words runs ~23x faster than the equivalent
+    scatter on TPU (measured v5e, 8M rows: 54ms vs 1250ms).  Rows being
+    dropped get a past-the-end position and fall off the trailing slice;
+    surviving slots beyond the live count are masked to the INF sentinel
+    afterwards (streaming select)."""
+    inf32 = jnp.uint32(keylib.INF_WORD)
+    n = pos.shape[0]
+    dump = jnp.int32(n + width + 2)
+    p = jnp.where(valid, pos.astype(jnp.int32), dump)
+    ops = (p,) + tuple(words[w] for w in range(words.shape[0]))
+    if vers is not None:
+        ops = ops + (vers,)
+    res = jax.lax.sort(ops, num_keys=1, is_stable=True)
+    out = jnp.stack(res[1 : 1 + words.shape[0]])[:, :width]
+    if count is not None:
+        live = jnp.arange(width) < count
+        out = jnp.where(live[None, :], out, inf32)
+        if vers is not None:
+            v = jnp.where(live, res[-1][:width], fill_vers)
+            return out, v
+    if vers is not None:
+        return out, res[-1][:width]
+    return out
+
+
+def _evict_rule(merged_vers, merged_count, new_oldest, width):
+    """Phase-6 window eviction predicate (ref removeBefore wasAbove rule:
+    drop boundary i iff vers[i] and vers[i-1] are both below the window).
+    Returns (keep2, rank2, out_count)."""
+    H = width
+    mvalid = jnp.arange(H) < merged_count
+    prev_v = jnp.concatenate(
+        [jnp.full((1,), FLOOR_REL, jnp.int32), merged_vers[:-1]]
+    )
+    keep2 = mvalid & (
+        (jnp.arange(H) == 0)
+        | (merged_vers >= new_oldest)
+        | (prev_v >= new_oldest)
+    )
+    rank2 = jnp.cumsum(keep2) - 1
+    out_count = jnp.sum(keep2)
+    return keep2, rank2, out_count
+
+
+def _resolve_batch(
+    r_begin, r_end, r_txn, w_begin, w_end, w_txn, t_valid, status0,
+    *, txn_cap, rr_cap, wr_cap, ablate=frozenset(),
+):
+    """Phases 2-4: point domain, intra-batch fixpoint, committed-write
+    segment extraction.  History-independent — shared verbatim by the flat
+    and tiered steps.  Returns (status, iters, undecided_left, ub, ue,
+    seg_valid, nseg)."""
+    kw1 = r_begin.shape[0]
     TXN, RR, WR = txn_cap, rr_cap, wr_cap
     P = 2 * RR + 2 * WR
     p_log2 = max(1, math.ceil(math.log2(P)))
-
-    r_nonempty = lex_less(r_begin, r_end)
     r_valid = r_txn < TXN
-
-    # ---- phase 1: history conflicts (ref checkReadConflictRanges) ----
-    if "nosearch" in _ablate:
-        i0 = (r_begin[0] % jnp.uint32(H)).astype(jnp.int32)
-        j1 = i0
-    else:
-        i0 = searchsorted_words(hkeys, r_begin, "right") - 1
-        j1 = searchsorted_words(hkeys, r_end, "left") - 1
-    maxtab = build_max_table(hvers)
-    m = range_max(maxtab, jnp.clip(i0, 0, H - 1), jnp.clip(j1, 0, H - 1))
-    r_hist = r_valid & r_nonempty & (j1 >= i0) & (m > r_snap)
-    hist_conf = (
-        jnp.zeros((TXN + 1,), bool)
-        .at[jnp.where(r_hist, r_txn, TXN)]
-        .max(r_hist)[:TXN]
-    )
-    too_old = t_valid & t_has_reads & (t_snap < oldest)
 
     # ---- phase 2: point domain (ref sortPoints + KeyInfo ordering) ----
     # categories at equal keys sort end-read(0) < end-write(1) <
@@ -265,10 +288,6 @@ def detect_core(
     w_valid = w_txn < TXN
 
     # ---- phase 3: intra-batch fixpoint (ref checkIntraBatchConflicts) ----
-    status0 = jnp.where(
-        ~t_valid, _COMM, jnp.where(too_old | hist_conf, _CONF, _UNDECIDED)
-    ).astype(jnp.int32)
-
     r_has_slots = re_idx > rb_idx
 
     def agg_txn(flags):
@@ -409,7 +428,7 @@ def detect_core(
         status, it = carry
         return jnp.any(status == _UNDECIDED) & (it < RCAP + 2)
 
-    if "nofix" in _ablate:
+    if "nofix" in ablate:
         status, iters = jnp.where(status0 == _UNDECIDED, _COMM, status0), jnp.int32(1)
     else:
         status, iters = jax.lax.while_loop(
@@ -417,7 +436,7 @@ def detect_core(
         )
     # Residual overflow: treated exactly like fixpoint divergence — the
     # host re-runs the batch on the CPU engine against the UNCHANGED
-    # history state (see the `ok` guard below).
+    # history state (see the `ok` guard in the callers).
     undecided_left = jnp.sum(status == _UNDECIDED) + jnp.where(
         overflow, jnp.int32(1), jnp.int32(0)
     )
@@ -439,38 +458,8 @@ def detect_core(
     seg_of_end = jnp.cumsum(is_end) - 1
     nseg = jnp.sum(is_start)
 
-    # Compactions below are SORT-BY-TARGET-POSITION, not scatter: a
-    # single-key int32 sort carrying the payload words runs ~23x faster
-    # than the equivalent scatter on TPU (measured v5e, 8M rows: 54ms vs
-    # 1250ms).  Rows being dropped get a past-the-end position and fall off
-    # the trailing slice; surviving slots beyond the live count are masked
-    # to the INF sentinel afterwards (streaming select).
-    inf32 = jnp.uint32(keylib.INF_WORD)
-
-    def compact_to(pos, valid, words, width, fill_vers=None, vers=None,
-                   count=None):
-        """Reorder columns of `words` [kw1, N] so column i lands at pos[i];
-        invalid columns drop off the end.  Returns [kw1, width] (+vers)."""
-        n = pos.shape[0]
-        dump = jnp.int32(n + width + 2)
-        p = jnp.where(valid, pos.astype(jnp.int32), dump)
-        ops = (p,) + tuple(words[w] for w in range(words.shape[0]))
-        if vers is not None:
-            ops = ops + (vers,)
-        res = jax.lax.sort(ops, num_keys=1, is_stable=True)
-        out = jnp.stack(res[1 : 1 + words.shape[0]])[:, :width]
-        if count is not None:
-            live = jnp.arange(width) < count
-            out = jnp.where(live[None, :], out, inf32)
-            if vers is not None:
-                v = jnp.where(live, res[-1][:width], fill_vers)
-                return out, v
-        if vers is not None:
-            return out, res[-1][:width]
-        return out
-
-    ub = compact_to(seg_of_start, is_start, sorted_keys, WR, count=nseg)
-    ue = compact_to(seg_of_end, is_end, sorted_keys, WR, count=nseg)
+    ub = _compact_to(seg_of_start, is_start, sorted_keys, WR, count=nseg)
+    ue = _compact_to(seg_of_end, is_end, sorted_keys, WR, count=nseg)
     seg_valid = jnp.arange(WR) < nseg
 
     # Merge touching segments (ue[s-1] == ub[s]): the gap between them is a
@@ -485,26 +474,42 @@ def detect_core(
     chain_id = jnp.cumsum(chain_start) - 1
     is_chain_last = jnp.concatenate([chain_start[1:], jnp.ones((1,), bool)])
     nseg2 = jnp.sum(chain_start & seg_valid)
-    ub = compact_to(chain_id, chain_start & seg_valid, ub, WR, count=nseg2)
-    ue = compact_to(chain_id, is_chain_last & seg_valid, ue, WR, count=nseg2)
+    ub = _compact_to(chain_id, chain_start & seg_valid, ub, WR, count=nseg2)
+    ue = _compact_to(chain_id, is_chain_last & seg_valid, ue, WR, count=nseg2)
     nseg = nseg2
     seg_valid = jnp.arange(WR) < nseg
+    return status, iters, undecided_left, ub, ue, seg_valid, nseg
 
-    # ---- phase 5: rewrite the step function (ref addConflictRanges) ----
-    # TWO combined searches over (ub | ue) serve EVERYTHING downstream:
-    # eq_at_ue, seg_lo/seg_hi, end_val, and — via the new-keys sort
-    # permutation — the sorted-new-keys ranks (t_rank/t_rank_r), which were
-    # previously re-searched.  Each full-width multiword search over H
-    # costs ~10ms at h_cap=4M, so collapsing 5 searches to 2 matters
-    # (PERF_NOTES).
+
+def _merge_new_segments(
+    tkeys, tvers, tcount, ub, ue, seg_valid, nseg, now_rel,
+    *, width, wr_cap, kw1,
+):
+    """Phase 5: rewrite ONE tier's step function (ref addConflictRanges) by
+    rank-merging the batch's committed segments [ub_s, ue_s) at version
+    `now_rel` into the tier (`width`-capped).  For the flat engine the tier
+    is the whole history; for the tiered engine it is the DELTA — end
+    values come from the tier itself (the delta's floor is FLOOR_REL =
+    "uncovered", so max(base, delta) composes exactly; see
+    detect_core_tiered).  Returns (merged_keys, merged_vers, merged_count).
+
+    TWO combined searches over (ub | ue) serve EVERYTHING downstream:
+    eq_at_ue, seg_lo/seg_hi, end_val, and — via the new-keys sort
+    permutation — the sorted-new-keys ranks (t_rank/t_rank_r), which were
+    previously re-searched.  Each full-width multiword search over H
+    costs ~10ms at h_cap=4M, so collapsing 5 searches to 2 matters
+    (PERF_NOTES)."""
+    H = width
+    WR = wr_cap
+    inf32 = jnp.uint32(keylib.INF_WORD)
     both = jnp.concatenate([ub, ue], axis=1)
-    both_left = searchsorted_words(hkeys, both, "left")
-    both_right = searchsorted_words(hkeys, both, "right")
+    both_left = searchsorted_words(tkeys, both, "left")
+    both_right = searchsorted_words(tkeys, both, "right")
     ub_left, ue_left = both_left[:WR], both_left[WR:]
     ub_right, ue_right = both_right[:WR], both_right[WR:]
     rank_right = ue_right
     iv = rank_right - 1
-    end_val = hvers[jnp.clip(iv, 0, H - 1)]
+    end_val = tvers[jnp.clip(iv, 0, H - 1)]
     eq_at_ue = (rank_right - ue_left) > 0
 
     # new boundary entries, interleaved (ub0, ue0, ub1, ue1, ...)
@@ -552,7 +557,7 @@ def detect_core(
     # PER HISTORY ROW into the small tables instead costs H * log(W) random
     # gathers and dominated the whole batch at h_cap = 8M.
     old_iota = jnp.arange(H, dtype=jnp.int32)
-    old_valid = old_iota < hcount
+    old_valid = old_iota < tcount
     # in_seg: old key i lies in some segment [ub_s, ue_s).  Mark +1 at the
     # first old index >= ub_s and -1 at the first >= ue_s; coverage > 0 after
     # a cumsum (segments are disjoint).
@@ -570,7 +575,7 @@ def detect_core(
     cum_keep = jnp.cumsum(keep_old.astype(jnp.int32))  # prefix-inclusive
     kept_rank = cum_keep - 1
     # removed-prefix at rank k = (#valid rows < k) - (#kept rows < k)
-    #                          = min(k, hcount) - cum_keep[k-1]
+    #                          = min(k, tcount) - cum_keep[k-1]
     # — closed form; no second cumsum (PERF_NOTES).
 
     # count_new_less[i] = #new keys strictly below old key i
@@ -582,38 +587,103 @@ def detect_core(
     )
     count_new_less = jnp.cumsum(new_hist[:H])
     pos_old = kept_rank.astype(jnp.int32) + count_new_less
-    removed_at_t = jnp.minimum(t_rank, hcount) - jnp.where(
+    removed_at_t = jnp.minimum(t_rank, tcount) - jnp.where(
         t_rank > 0, cum_keep[jnp.clip(t_rank - 1, 0, H - 1)], 0
     )
     count_kept_less = t_rank - removed_at_t
     pos_new = jnp.arange(n_new_cap, dtype=jnp.int32) + count_kept_less
 
     merged_count = jnp.sum(keep_old) + nnew
-    merged_keys, merged_vers = compact_to(
+    merged_keys, merged_vers = _compact_to(
         jnp.concatenate([pos_old, pos_new]),
         jnp.concatenate([keep_old, new_valid_s]),
-        jnp.concatenate([hkeys, new_keys_s], axis=1),
+        jnp.concatenate([tkeys, new_keys_s], axis=1),
         H,
         fill_vers=jnp.int32(FLOOR_REL),
-        vers=jnp.concatenate([hvers, new_vers_s]),
+        vers=jnp.concatenate([tvers, new_vers_s]),
         count=merged_count,
     )
+    return merged_keys, merged_vers, merged_count
 
-    # ---- phase 6: window eviction (ref removeBefore wasAbove rule) ----
+
+def detect_core(
+    hkeys,
+    hvers,
+    hcount,
+    oldest,
+    r_begin,
+    r_end,
+    r_txn,
+    r_snap,
+    w_begin,
+    w_end,
+    w_txn,
+    t_snap,
+    t_has_reads,
+    t_valid,
+    now_rel,
+    new_oldest_rel,
+    do_evict=None,
+    *,
+    txn_cap: int,
+    rr_cap: int,
+    wr_cap: int,
+    h_cap: int,
+):
+    import os as _os
+
+    _ablate = set(_os.environ.get("FDB_TPU_ABLATE", "").split(","))
+    kw1 = hkeys.shape[0]
+    H = h_cap
+    TXN, RR, WR = txn_cap, rr_cap, wr_cap
+    P = 2 * RR + 2 * WR
+    p_log2 = max(1, math.ceil(math.log2(P)))
+
+    r_nonempty = lex_less(r_begin, r_end)
+    r_valid = r_txn < TXN
+
+    # ---- phase 1: history conflicts (ref checkReadConflictRanges) ----
+    if "nosearch" in _ablate:
+        i0 = (r_begin[0] % jnp.uint32(H)).astype(jnp.int32)
+        j1 = i0
+    else:
+        i0 = searchsorted_words(hkeys, r_begin, "right") - 1
+        j1 = searchsorted_words(hkeys, r_end, "left") - 1
+    maxtab = build_max_table(hvers)
+    m = range_max(maxtab, jnp.clip(i0, 0, H - 1), jnp.clip(j1, 0, H - 1))
+    r_hist = r_valid & r_nonempty & (j1 >= i0) & (m > r_snap)
+    hist_conf = (
+        jnp.zeros((TXN + 1,), bool)
+        .at[jnp.where(r_hist, r_txn, TXN)]
+        .max(r_hist)[:TXN]
+    )
+    too_old = t_valid & t_has_reads & (t_snap < oldest)
+
+    # ---- phases 2-4: point domain, fixpoint, committed segments ----
+    status0 = jnp.where(
+        ~t_valid, _COMM, jnp.where(too_old | hist_conf, _CONF, _UNDECIDED)
+    ).astype(jnp.int32)
+    status, iters, undecided_left, ub, ue, seg_valid, nseg = _resolve_batch(
+        r_begin, r_end, r_txn, w_begin, w_end, w_txn, t_valid, status0,
+        txn_cap=TXN, rr_cap=RR, wr_cap=WR, ablate=_ablate,
+    )
+
+    # ---- phase 5: rewrite the step function (ref addConflictRanges) ----
     if "nomerge" in _ablate:
         out_status = jnp.where(
             too_old, TOO_OLD, jnp.where(status == _COMM, COMMITTED, CONFLICT)
         ).astype(jnp.int32)
         return (hkeys, hvers, hcount, jnp.maximum(oldest, new_oldest_rel).astype(jnp.int32),
                 out_status, undecided_left.astype(jnp.int32), iters)
-    new_oldest = jnp.maximum(oldest, new_oldest_rel)
-    mvalid = jnp.arange(H) < merged_count
-    prev_v = jnp.concatenate([jnp.full((1,), FLOOR_REL, jnp.int32), merged_vers[:-1]])
-    keep2 = mvalid & (
-        (jnp.arange(H) == 0) | (merged_vers >= new_oldest) | (prev_v >= new_oldest)
+    merged_keys, merged_vers, merged_count = _merge_new_segments(
+        hkeys, hvers, hcount, ub, ue, seg_valid, nseg, now_rel,
+        width=H, wr_cap=WR, kw1=kw1,
     )
-    rank2 = jnp.cumsum(keep2) - 1
-    out_count = jnp.sum(keep2)
+
+    # ---- phase 6: window eviction (ref removeBefore wasAbove rule) ----
+    new_oldest = jnp.maximum(oldest, new_oldest_rel)
+    keep2, rank2, out_count = _evict_rule(merged_vers, merged_count,
+                                          new_oldest, H)
     if "noevict" in _ablate:
         out_keys, out_vers, out_count = merged_keys, merged_vers, merged_count
     elif do_evict is not None:
@@ -624,7 +694,7 @@ def detect_core(
         # h_cap headroom for the unevicted batches in between.
         def _evict(ops):
             mk, mv = ops
-            k, v = compact_to(
+            k, v = _compact_to(
                 rank2, keep2, mk, H,
                 fill_vers=jnp.int32(FLOOR_REL), vers=mv, count=out_count,
             )
@@ -638,7 +708,7 @@ def detect_core(
             do_evict != 0, _evict, _keep, (merged_keys, merged_vers)
         )
     else:
-        out_keys, out_vers = compact_to(
+        out_keys, out_vers = _compact_to(
             rank2,
             keep2,
             merged_keys,
@@ -670,6 +740,248 @@ def detect_core(
         out_keys,
         out_vers,
         out_count.astype(jnp.int32),
+        new_oldest.astype(jnp.int32),
+        out_status,
+        undecided_left.astype(jnp.int32),
+        iters,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Two-tier history (FDB_TPU_HISTORY=tiered): a large sorted BASE tier that is
+# FROZEN between major compactions (its sparse max-table is carried across
+# batches instead of rebuilt), plus a small sorted DELTA tier that absorbs
+# each batch's new boundaries with delta-sized sorts.  The delta is a step
+# function whose floor value FLOOR_REL means "uncovered"; because every
+# >floor delta value is a write version issued while the base was frozen, it
+# exceeds every base value, so the logical history is exactly
+#
+#     merged(x) = max(base(x), delta(x))
+#
+# and phase-1 range-max queries combine per-tier answers with max.  Phase 5
+# merges each batch's segments into the DELTA ONLY (end values come from the
+# delta itself — on covered intervals the base is already dominated), so the
+# two full-H compact_to sorts PERF_NOTES round-5 names are gone from the
+# per-batch path.  A major compaction — merge base+delta, evict sub-window
+# rows, rebuild the max-table, reset the delta — runs behind a traced
+# lax.cond when the host says so (delta fills, or every FDB_TPU_EVICT_EVERY
+# batches: the flag is an alias for the compaction cadence in tiered mode).
+# The trigger is computed host-side from deterministic row-count bounds, so
+# no device sync is needed and replays stay bit-identical.
+# ---------------------------------------------------------------------------
+
+
+def _major_compact(hk, hv, hc, dk, dv, dc, new_oldest, *, H, D, kw1):
+    """Merge base+delta into a new base tier and evict sub-window rows.
+
+    Covered delta intervals (value > floor) take the delta row verbatim and
+    drop every base row inside them; uncovered intervals keep their base
+    rows; a floor-valued delta row re-anchors the base's value at its key
+    (dropped when an equal-key base row already provides it).  All per-row
+    quantities derive by rank inversion — delta-sized searches into the
+    base turned into per-base-row values with histograms + cumsums, never
+    one query per history row — so the only H-sized non-streaming ops are
+    the two compact_to sorts whose amortization is this tier's purpose."""
+    NEG = jnp.int32(FLOOR_REL)
+    dvalid = jnp.arange(D) < dc
+    dl = searchsorted_words(hk, dk, "left")
+    dr = searchsorted_words(hk, dk, "right")
+    covered = dvalid & (dv > NEG)
+    # Delta interval j spans base ranks [dl[j], dl[j+1]); the last valid
+    # row's interval extends to the end of the live base.
+    dl_next = jnp.concatenate([dl[1:], jnp.reshape(hc.astype(jnp.int32), (1,))])
+    cov_diff = (
+        jnp.zeros((H + 1,), jnp.int32)
+        .at[jnp.where(covered, dl, H)]
+        .add(jnp.where(covered, 1, 0))
+        .at[jnp.where(covered, dl_next, H)]
+        .add(jnp.where(covered, -1, 0))
+    )
+    in_cov = jnp.cumsum(cov_diff[:H]) > 0
+    base_valid = jnp.arange(H) < hc
+    keep_base = base_valid & ~in_cov
+    ckb = jnp.cumsum(keep_base.astype(jnp.int32))  # prefix-inclusive
+
+    eq = (dr - dl) > 0  # an equal-key base row exists
+    base_at = hv[jnp.clip(dr - 1, 0, H - 1)]  # base value at dk[j]
+    is_end = dvalid & (dv == NEG)
+    keep_delta = dvalid & ((dv > NEG) | ~eq)
+    dvals = jnp.where(is_end, base_at, dv)
+
+    # Merge positions by rank inversion (kept keys never tie: the eq rules
+    # above drop exactly one side of every key collision).
+    dhist = (
+        jnp.zeros((H + 1,), jnp.int32)
+        .at[jnp.where(keep_delta, dl, H)]
+        .add(jnp.where(keep_delta, 1, 0))
+    )
+    cnt_delta_leq = jnp.cumsum(dhist[:H])
+    pos_base = (ckb - 1) + cnt_delta_leq
+    cnt_base_less = jnp.where(dl > 0, ckb[jnp.clip(dl - 1, 0, H - 1)], 0)
+    pos_delta = (jnp.cumsum(keep_delta.astype(jnp.int32)) - 1) + cnt_base_less
+    merged_count = jnp.sum(keep_base) + jnp.sum(keep_delta)
+    mk, mv = _compact_to(
+        jnp.concatenate([pos_base, pos_delta]),
+        jnp.concatenate([keep_base, keep_delta]),
+        jnp.concatenate([hk, dk], axis=1),
+        H,
+        fill_vers=NEG,
+        vers=jnp.concatenate([hv, dvals]),
+        count=merged_count,
+    )
+    keep2, rank2, out_count = _evict_rule(mv, merged_count, new_oldest, H)
+    ok_keys, ok_vers = _compact_to(
+        rank2, keep2, mk, H, fill_vers=NEG, vers=mv, count=out_count
+    )
+    return ok_keys, ok_vers, out_count
+
+
+def detect_core_tiered(
+    hkeys,
+    hvers,
+    hcount,
+    maxtab,
+    dkeys,
+    dvers,
+    dcount,
+    oldest,
+    r_begin,
+    r_end,
+    r_txn,
+    r_snap,
+    w_begin,
+    w_end,
+    w_txn,
+    t_snap,
+    t_has_reads,
+    t_valid,
+    now_rel,
+    new_oldest_rel,
+    do_major,
+    *,
+    txn_cap: int,
+    rr_cap: int,
+    wr_cap: int,
+    h_cap: int,
+    d_cap: int,
+):
+    """Two-tier variant of detect_core; decision-identical by construction
+    (gated by the differential suites under FDB_TPU_HISTORY=tiered).
+
+    Steady-state non-compaction batches do NO H-sized sort and NO H-sized
+    table build: base work is limited to the phase-1 binary-search gathers
+    against the frozen base + carried max-table (the perf_smoke jaxpr gate
+    pins this structurally)."""
+    kw1 = hkeys.shape[0]
+    H, D = h_cap, d_cap
+    TXN = txn_cap
+    WR = wr_cap
+    NEG = jnp.int32(FLOOR_REL)
+
+    r_nonempty = lex_less(r_begin, r_end)
+    r_valid = r_txn < TXN
+
+    # ---- phase 1 over BOTH tiers: merged max = max of per-tier maxes ----
+    i0b = searchsorted_words(hkeys, r_begin, "right") - 1
+    j1b = searchsorted_words(hkeys, r_end, "left") - 1
+    mb = range_max(maxtab, jnp.clip(i0b, 0, H - 1), jnp.clip(j1b, 0, H - 1))
+    i0d = searchsorted_words(dkeys, r_begin, "right") - 1
+    j1d = searchsorted_words(dkeys, r_end, "left") - 1
+    dtab = build_max_table(dvers)
+    md = range_max(dtab, jnp.clip(i0d, 0, D - 1), jnp.clip(j1d, 0, D - 1))
+    m = jnp.maximum(
+        jnp.where(j1b >= i0b, mb, NEG), jnp.where(j1d >= i0d, md, NEG)
+    )
+    r_hist = r_valid & r_nonempty & (m > r_snap)
+    hist_conf = (
+        jnp.zeros((TXN + 1,), bool)
+        .at[jnp.where(r_hist, r_txn, TXN)]
+        .max(r_hist)[:TXN]
+    )
+    too_old = t_valid & t_has_reads & (t_snap < oldest)
+
+    # ---- phases 2-4 (shared) ----
+    status0 = jnp.where(
+        ~t_valid, _COMM, jnp.where(too_old | hist_conf, _CONF, _UNDECIDED)
+    ).astype(jnp.int32)
+    status, iters, undecided_left, ub, ue, seg_valid, nseg = _resolve_batch(
+        r_begin, r_end, r_txn, w_begin, w_end, w_txn, t_valid, status0,
+        txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap,
+    )
+
+    # ---- phase 5 into the DELTA tier (delta-sized sorts) ----
+    d_mk, d_mv, d_mc = _merge_new_segments(
+        dkeys, dvers, dcount, ub, ue, seg_valid, nseg, now_rel,
+        width=D, wr_cap=WR, kw1=kw1,
+    )
+    new_oldest = jnp.maximum(oldest, new_oldest_rel)
+    # ---- phase 6 on the delta only (keeps hot-key deltas compact);
+    # the base is evicted at major compactions ----
+    keep2, rank2, d_oc = _evict_rule(d_mv, d_mc, new_oldest, D)
+    d_ok_keys, d_ok_vers = _compact_to(
+        rank2, keep2, d_mk, D, fill_vers=NEG, vers=d_mv, count=d_oc
+    )
+
+    ok = undecided_left == 0
+
+    # Divergence guard (same contract as detect_core): the batch's delta
+    # merge and the window advance revert BEFORE the compaction cond, so
+    # the host can re-run the batch on the CPU engine against the same
+    # logical state.
+    d_sel_keys = jnp.where(ok, d_ok_keys, dkeys)
+    d_sel_vers = jnp.where(ok, d_ok_vers, dvers)
+    d_sel_count = jnp.where(ok, d_oc.astype(jnp.int32), dcount)
+    new_oldest = jnp.where(ok, new_oldest, oldest)
+
+    # ---- major compaction behind a traced cond ----
+    # The predicate is the HOST's flag alone — never the traced ok — so
+    # the host's deterministic bookkeeping (delta bound reset to 1, base
+    # bound absorbing the delta, major_compactions count) is true even
+    # for a diverged batch: compacting the REVERTED pre-batch delta into
+    # the base is a pure physical rewrite of the same logical step
+    # function (merged(x) is unchanged), so verdict-identity and the
+    # CPU-fallback export both hold.
+    def _major(ops):
+        hk, hv, hc, mt, dk2, dv2, dc2 = ops
+        nk, nv, nc = _major_compact(
+            hk, hv, hc, dk2, dv2, dc2, new_oldest, H=H, D=D, kw1=kw1
+        )
+        nt = build_max_table(nv)
+        ek = (
+            jnp.full((kw1, D), jnp.uint32(keylib.INF_WORD))
+            .at[:, 0]
+            .set(jnp.uint32(0))
+        )
+        ev = jnp.full((D,), FLOOR_REL, jnp.int32)
+        return nk, nv, nc.astype(jnp.int32), nt, ek, ev, jnp.ones((), jnp.int32)
+
+    def _minor(ops):
+        hk, hv, hc, mt, dk2, dv2, dc2 = ops
+        return hk, hv, hc, mt, dk2, dv2, dc2
+
+    out_hk, out_hv, out_hc, out_mt, out_dk, out_dv, out_dc = jax.lax.cond(
+        do_major != 0,
+        _major,
+        _minor,
+        (hkeys, hvers, hcount.astype(jnp.int32), maxtab,
+         d_sel_keys, d_sel_vers, d_sel_count),
+    )
+
+    # ---- final statuses in the reference's enum ----
+    out_status = jnp.where(
+        too_old,
+        TOO_OLD,
+        jnp.where(status == _COMM, COMMITTED, CONFLICT),
+    ).astype(jnp.int32)
+
+    return (
+        out_hk,
+        out_hv,
+        out_hc.astype(jnp.int32),
+        out_mt,
+        out_dk,
+        out_dv,
+        out_dc.astype(jnp.int32),
         new_oldest.astype(jnp.int32),
         out_status,
         undecided_left.astype(jnp.int32),
@@ -751,6 +1063,54 @@ _blob_step = partial(
 )(_blob_core)
 
 
+def _tiered_blob_core(hkeys, hvers, hcount, maxtab, dkeys, dvers, dcount,
+                      oldest, blob, *, txn_cap, rr_cap, wr_cap, h_cap, d_cap,
+                      kw1):
+    """Tiered twin of _blob_core: same single-transfer blob layout; the
+    third scalar slot carries the host's major-compaction decision."""
+    offs, _total = _blob_offsets(txn_cap, rr_cap, wr_cap, kw1)
+    as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
+    r_begin = blob[offs[0] : offs[0] + rr_cap * kw1].reshape(kw1, rr_cap)
+    r_end = blob[offs[1] : offs[1] + rr_cap * kw1].reshape(kw1, rr_cap)
+    w_begin = blob[offs[2] : offs[2] + wr_cap * kw1].reshape(kw1, wr_cap)
+    w_end = blob[offs[3] : offs[3] + wr_cap * kw1].reshape(kw1, wr_cap)
+    r_txn = as_i32(blob[offs[4] : offs[4] + rr_cap])
+    r_snap = as_i32(blob[offs[5] : offs[5] + rr_cap])
+    w_txn = as_i32(blob[offs[6] : offs[6] + wr_cap])
+    t_snap = as_i32(blob[offs[7] : offs[7] + txn_cap])
+    t_flags = blob[offs[8] : offs[8] + txn_cap]
+    t_has_reads = (t_flags & 1) > 0
+    t_valid = (t_flags & 2) > 0
+    scalars = as_i32(blob[offs[9] : offs[9] + 3])
+    return detect_core_tiered(
+        hkeys, hvers, hcount, maxtab, dkeys, dvers, dcount, oldest,
+        r_begin, r_end, r_txn, r_snap,
+        w_begin, w_end, w_txn,
+        t_snap, t_has_reads, t_valid,
+        scalars[0], scalars[1], scalars[2],
+        txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap, h_cap=h_cap,
+        d_cap=d_cap,
+    )
+
+
+_tiered_blob_step = partial(
+    jax.jit,
+    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "d_cap", "kw1"),
+    donate_argnames=("hkeys", "hvers", "hcount", "maxtab", "dkeys", "dvers",
+                     "dcount", "oldest"),
+)(_tiered_blob_core)
+
+
+def _build_max_table_np(values: np.ndarray) -> np.ndarray:
+    """Seed/rebuild the tiered engine's carried base max-table host-side
+    without an extra device program (init, load_from, grow).  Delegates to
+    the ONE shared table builder in ops.rangequery, so the host layout
+    cannot drift from what range_max expects."""
+    from ..ops.rangequery import build_max_table_np
+
+    return build_max_table_np(np.asarray(values, dtype=np.int32))
+
+
 class JaxConflictSet:
     """Host wrapper owning the device-resident history state."""
 
@@ -779,6 +1139,31 @@ class JaxConflictSet:
             1, int(_os.environ.get("FDB_TPU_EVICT_EVERY", "1"))
         )
         self._batches_since_evict = 0
+        # Two-tier history (FDB_TPU_HISTORY=tiered): per-batch work runs at
+        # delta size; a major compaction folds the delta into the base when
+        # the delta fills or every FDB_TPU_EVICT_EVERY batches (the flag is
+        # an ALIAS for the compaction cadence in this mode; unset/1 means
+        # fill-triggered only).  Decision-identical to the flat engine —
+        # gated by the differential suites under the flag — and the default
+        # compile is untouched when the flag is unset (separate jit entry).
+        self.history_mode = _os.environ.get("FDB_TPU_HISTORY", "")
+        self.tiered = self.history_mode == "tiered"
+        self.compact_every = 0
+        self.d_cap = 0
+        if self.tiered:
+            self.compact_every = self.evict_every if self.evict_every > 1 else 0
+            dc_env = int(_os.environ.get("FDB_TPU_DELTA_CAP", "0"))
+            self.d_cap = max(64, dc_env if dc_env > 0 else self.h_cap // 8)
+            if _os.environ.get("FDB_TPU_ABLATE"):
+                # Fail FAST: the ablation seams only exist in the flat
+                # step; silently ignoring the knob would make an in-step
+                # attribution run under the tiered flag report that a
+                # phase costs nothing.
+                raise ValueError(
+                    "FDB_TPU_ABLATE is not supported with "
+                    "FDB_TPU_HISTORY=tiered (the ablation seams live in "
+                    "the flat detect_core only)"
+                )
         self._init_state(oldest_rel=0)
         self.last_iters = 0
         # Kernel telemetry (ISSUE 2 tentpole): every signal that decides
@@ -793,6 +1178,10 @@ class JaxConflictSet:
         for _c in ("retraces", "batches", "transactions", "fixpoint_rounds",
                    "grows", "rebases", "cpu_fallbacks"):
             self.metrics.counter(_c)  # pre-create: snapshots list them all
+        if self.tiered:
+            # Tier telemetry (only in tiered mode, so flat-mode snapshots
+            # stay byte-identical to pre-tier builds).
+            self.metrics.counter("major_compactions")
         # Static-shape key -> dispatch count.  A key's FIRST dispatch is an
         # XLA trace+compile (the jit cache misses); the counter equalling
         # len(_bucket_dispatches) is the no-recompile-storm invariant the
@@ -825,6 +1214,24 @@ class JaxConflictSet:
         # never blocks on the in-flight batch's real count; the true value
         # is synced only when the bound approaches capacity.
         self._hcount_bound = 1
+        if self.tiered:
+            self._reset_delta_state(hvers)
+
+    def _reset_delta_state(self, hvers_np=None):
+        """(Re)build the tiered extras: carried base max-table + an empty
+        delta tier (floor row b"" at FLOOR_REL = "uncovered") + the host
+        bounds that drive compaction/growth without device syncs."""
+        kw1 = self.key_words + 1
+        if hvers_np is None:
+            hvers_np = np.asarray(self._hvers)
+        self._maxtab = jnp.asarray(_build_max_table_np(hvers_np))
+        dkeys = np.full((kw1, self.d_cap), keylib.INF_WORD, np.uint32)
+        dkeys[:, 0] = 0  # b"" floor boundary ("uncovered from the start")
+        self._dkeys = jnp.asarray(dkeys)
+        self._dvers = jnp.asarray(np.full((self.d_cap,), FLOOR_REL, np.int32))
+        self._dcount = jnp.asarray(1, jnp.int32)
+        self._dcount_bound = 1
+        self._batches_since_major = 0
 
     @property
     def oldest_version(self) -> int:
@@ -832,6 +1239,20 @@ class JaxConflictSet:
 
     @property
     def boundary_count(self) -> int:
+        if self.tiered:
+            # Exact logical (merged) count: requires folding the delta
+            # over the base host-side — O(rows) Python work, a
+            # diagnostic/test surface only.  Hot paths (bench logging,
+            # gauges) use the cheap base+delta counts instead.
+            return len(self._merged_host_state()[0])
+        return int(self._hcount)
+
+    @property
+    def boundary_count_bound(self) -> int:
+        """Cheap upper bound on the logical boundary count (exact when the
+        delta is empty — e.g. right after a major compaction)."""
+        if self.tiered:
+            return int(self._hcount) + int(self._dcount) - 1
         return int(self._hcount)
 
     def clear(self, version: int):
@@ -852,8 +1273,15 @@ class JaxConflictSet:
                 self._check_fault("rebase")
                 self.metrics.counter("rebases").add()
                 self._hvers = jnp.maximum(self._hvers - d, FLOOR_REL)
+                if self.tiered:
+                    # Rebase commutes with max, so the carried table and
+                    # the delta shift by the same constant — no rebuild.
+                    self._dvers = jnp.maximum(self._dvers - d, FLOOR_REL)
+                    self._maxtab = jnp.maximum(self._maxtab - d, FLOOR_REL)
                 self._oldest = self._oldest - d
                 self._base += d
+        if self.tiered:
+            return  # tiered growth is decided with the compaction trigger
         if self._hcount_bound + 2 * wr_cap + 2 > self.h_cap:
             # Bound exhausted: sync the true count once (this is the only
             # device round-trip on the dispatch path) and grow if the REAL
@@ -862,7 +1290,50 @@ class JaxConflictSet:
             if self._hcount_bound + 2 * wr_cap + 2 > self.h_cap:
                 self._grow(max(self.h_cap * 2, self.h_cap + 4 * wr_cap))
 
-    def _grow(self, new_cap: int):
+    def _plan_tiered_batch(self, wr_cap: int) -> int:
+        """Host-side compaction/growth planning for one tiered batch;
+        returns do_major (0/1).  Deterministic: driven by row-count UPPER
+        BOUNDS (delta grows by <= 2*wr_cap per batch; the base only grows
+        at compactions, by at most the delta's bound), syncing the true
+        counts only when a bound-based trigger fires."""
+        add = 2 * wr_cap
+        # This batch's merge must fit the delta outright.
+        if 2 * add + 8 > self.d_cap:
+            self._grow_delta(_next_pow2(2 * add + 8, self.d_cap * 2))
+        # Pre-merge must-fit guard for MIXED buckets (review finding): a
+        # batch with a larger wr_cap than the batches that filled the
+        # delta can arrive with dcount + add + 2 > d_cap even though the
+        # same-bucket fill trigger below never fired.  Compaction cannot
+        # save it — the merge runs BEFORE the cond — so sync the true
+        # count once and grow the delta if this batch still cannot fit
+        # (the tiered analog of the flat path's hcount_bound sync+grow).
+        if self._dcount_bound + add + 2 > self.d_cap:
+            self._dcount_bound = int(self._dcount)
+            if self._dcount_bound + add + 2 > self.d_cap:
+                self._grow_delta(
+                    _next_pow2(self._dcount_bound + add + 2, self.d_cap * 2)
+                )
+        do_major = 0
+        if self.compact_every and (
+            self._batches_since_major + 1 >= self.compact_every
+        ):
+            do_major = 1
+        # Fill trigger: compact NOW if the batch AFTER this one might not
+        # fit (so the merge below never truncates).
+        if self._dcount_bound + 2 * add + 2 > self.d_cap:
+            do_major = 1
+        if do_major:
+            need = self._hcount_bound + self._dcount_bound + add + 2
+            if need > self.h_cap:
+                # Sync the true counts once before paying a grow.
+                self._hcount_bound = int(self._hcount)
+                self._dcount_bound = int(self._dcount)
+                need = self._hcount_bound + self._dcount_bound + add + 2
+                if need > self.h_cap:
+                    self._grow(max(self.h_cap * 2, _next_pow2(need, self.h_cap)))
+        return do_major
+
+    def _grow(self, new_cap: int, rebuild_maxtab: bool = True):
         self._check_fault("grow")
         self.metrics.counter("grows").add()
         kw1 = self.key_words + 1
@@ -875,6 +1346,33 @@ class JaxConflictSet:
             [self._hvers, jnp.full((pad,), FLOOR_REL, jnp.int32)]
         )
         self.h_cap = new_cap
+        if self.tiered and rebuild_maxtab:
+            # The carried table's level count is a function of h_cap —
+            # rebuild from the (grown) base versions.  load_from passes
+            # rebuild_maxtab=False: it replaces the whole state and
+            # rebuilds the table itself, so building one here from the
+            # OLD versions would be a discarded device sync + O(H log H)
+            # host pass in the middle of fault recovery.
+            self._maxtab = jnp.asarray(
+                _build_max_table_np(np.asarray(self._hvers))
+            )
+
+    def _grow_delta(self, new_cap: int):
+        """Resize the delta tier (a batch's wr_cap exceeded what the
+        current d_cap can absorb).  Counted as a grow: it is the same
+        recompile-causing reallocation choke point."""
+        self._check_fault("grow")
+        self.metrics.counter("grows").add()
+        kw1 = self.key_words + 1
+        pad = new_cap - self.d_cap
+        self._dkeys = jnp.concatenate(
+            [self._dkeys, jnp.full((kw1, pad), keylib.INF_WORD, jnp.uint32)],
+            axis=1,
+        )
+        self._dvers = jnp.concatenate(
+            [self._dvers, jnp.full((pad,), FLOOR_REL, jnp.int32)]
+        )
+        self.d_cap = new_cap
 
     # -- detection --
     def detect(
@@ -928,13 +1426,24 @@ class JaxConflictSet:
         caller must eventually check undecided (see detect_packed)."""
         self._check_fault("dispatch")
         self._maybe_grow_or_rebase(now, pb.wr_cap)
+        do_major = 0
+        if self.tiered:
+            # Host-decided compaction/growth plan (deterministic bounds —
+            # no device sync, replays bit-identical).  Runs before the
+            # shape key: a grow changes h_cap/d_cap.
+            do_major = self._plan_tiered_batch(pb.wr_cap)
         m = self.metrics
         # Retrace accounting: the jit cache key is the full static-arg
         # tuple — the PackedBatch.bucket() capacities plus h_cap (growth
-        # recompiles) and the amortized-eviction flag.  First sight of a
-        # key = one XLA trace+compile.
+        # recompiles) and the amortized-eviction flag (or, tiered, the
+        # delta capacity).  First sight of a key = one XLA trace+compile.
         amortized = self.evict_every > 1
-        shape_key = (pb.bucket(), self.h_cap, self.key_words + 1, amortized)
+        if self.tiered:
+            shape_key = (pb.bucket(), self.h_cap, self.key_words + 1,
+                         "tiered", self.d_cap)
+        else:
+            shape_key = (pb.bucket(), self.h_cap, self.key_words + 1,
+                         amortized)
         first_dispatch = shape_key not in self._bucket_dispatches
         if first_dispatch:
             # Compile faults (injected here, or a real XLA compile error
@@ -953,38 +1462,77 @@ class JaxConflictSet:
             "read": pb.n_r / pb.rr_cap,
             "write": pb.n_w / pb.wr_cap,
         }
+        if self.tiered:
+            # Delta fill (bound-based: no sync on the dispatch path).
+            self.last_occupancy["delta"] = self._dcount_bound / self.d_cap
         for axis, occ in self.last_occupancy.items():
             m.histogram(f"{axis}_occupancy").add(occ)
-        self._batches_since_evict += 1
-        do_evict = 1 if self._batches_since_evict >= self.evict_every else 0
-        if do_evict:
-            self._batches_since_evict = 0
-        blob = self._pack_blob(pb, now, new_oldest_version, do_evict)
+        if not self.tiered:
+            self._batches_since_evict += 1
+            do_evict = (
+                1 if self._batches_since_evict >= self.evict_every else 0
+            )
+            if do_evict:
+                self._batches_since_evict = 0
+        blob = self._pack_blob(
+            pb, now, new_oldest_version, do_major if self.tiered else do_evict
+        )
         from ..flow.metrics import wall_now
 
         _t0 = wall_now()
         try:
-            (
-                self._hkeys,
-                self._hvers,
-                self._hcount,
-                self._oldest,
-                statuses,
-                undecided,
-                iters,
-            ) = _blob_step(
-                self._hkeys,
-                self._hvers,
-                self._hcount,
-                self._oldest,
-                jnp.asarray(blob),
-                txn_cap=pb.txn_cap,
-                rr_cap=pb.rr_cap,
-                wr_cap=pb.wr_cap,
-                h_cap=self.h_cap,
-                kw1=self.key_words + 1,
-                amortized=amortized,
-            )
+            if self.tiered:
+                (
+                    self._hkeys,
+                    self._hvers,
+                    self._hcount,
+                    self._maxtab,
+                    self._dkeys,
+                    self._dvers,
+                    self._dcount,
+                    self._oldest,
+                    statuses,
+                    undecided,
+                    iters,
+                ) = _tiered_blob_step(
+                    self._hkeys,
+                    self._hvers,
+                    self._hcount,
+                    self._maxtab,
+                    self._dkeys,
+                    self._dvers,
+                    self._dcount,
+                    self._oldest,
+                    jnp.asarray(blob),
+                    txn_cap=pb.txn_cap,
+                    rr_cap=pb.rr_cap,
+                    wr_cap=pb.wr_cap,
+                    h_cap=self.h_cap,
+                    d_cap=self.d_cap,
+                    kw1=self.key_words + 1,
+                )
+            else:
+                (
+                    self._hkeys,
+                    self._hvers,
+                    self._hcount,
+                    self._oldest,
+                    statuses,
+                    undecided,
+                    iters,
+                ) = _blob_step(
+                    self._hkeys,
+                    self._hvers,
+                    self._hcount,
+                    self._oldest,
+                    jnp.asarray(blob),
+                    txn_cap=pb.txn_cap,
+                    rr_cap=pb.rr_cap,
+                    wr_cap=pb.wr_cap,
+                    h_cap=self.h_cap,
+                    kw1=self.key_words + 1,
+                    amortized=amortized,
+                )
         except jax.errors.JaxRuntimeError as e:
             # Real device failures (and ONLY those — a generic Python
             # RuntimeError is a bug and must crash loudly, not vanish
@@ -1007,9 +1555,26 @@ class JaxConflictSet:
         # compute (no sync here).  Wall namespace only.
         m.record_wall("dispatch_seconds", wall_now() - _t0)
         self._last_iters_dev = iters
-        self._hcount_bound = min(
-            self._hcount_bound + 2 * pb.wr_cap, self.h_cap
-        )
+        if self.tiered:
+            if do_major:
+                # The compaction folded the delta (and this batch's rows)
+                # into the base and reset the delta to its floor row.
+                m.counter("major_compactions").add()
+                self._hcount_bound = min(
+                    self._hcount_bound + self._dcount_bound + 2 * pb.wr_cap,
+                    self.h_cap,
+                )
+                self._dcount_bound = 1
+                self._batches_since_major = 0
+            else:
+                self._dcount_bound = min(
+                    self._dcount_bound + 2 * pb.wr_cap, self.d_cap
+                )
+                self._batches_since_major += 1
+        else:
+            self._hcount_bound = min(
+                self._hcount_bound + 2 * pb.wr_cap, self.h_cap
+            )
         return statuses, undecided
 
     def detect_packed(self, pb: PackedBatch, now: int, new_oldest_version: int):
@@ -1023,7 +1588,22 @@ class JaxConflictSet:
         self.metrics.histogram("fixpoint_rounds_per_batch").add(
             self.last_iters
         )
-        self.metrics.gauge("boundary_count").set(int(self._hcount))
+        if self.tiered:
+            base_n, delta_n = int(self._hcount), int(self._dcount)
+            # boundary_count is the merged-history UPPER BOUND in tiered
+            # mode (base + delta rows, minus the delta's floor); the exact
+            # merged count would need a device pass per sync.
+            self.metrics.gauge("boundary_count").set(base_n + delta_n - 1)
+            self.metrics.gauge("base_boundaries").set(base_n)
+            self.metrics.gauge("delta_boundaries").set(delta_n)
+            self.metrics.histogram("delta_occupancy_synced").add(
+                delta_n / self.d_cap
+            )
+            # Tighten the host bounds with the freshly synced truth.
+            self._hcount_bound = base_n
+            self._dcount_bound = delta_n
+        else:
+            self.metrics.gauge("boundary_count").set(int(self._hcount))
         if int(undecided) != 0:
             # detect_core left the history state untouched in this case;
             # resolve the batch on the CPU engine against pristine state and
@@ -1057,7 +1637,10 @@ class JaxConflictSet:
 
         n = len(cpu.keys)
         if n + 8 > self.h_cap:
-            self._grow(_next_pow2(n + 8, self.h_cap * 2))
+            # rebuild_maxtab=False: _reset_delta_state below rebuilds the
+            # carried table from the ADOPTED state in the same call.
+            self._grow(_next_pow2(n + 8, self.h_cap * 2),
+                       rebuild_maxtab=False)
         self._base = cpu.oldest_version
         kw1 = self.key_words + 1
         hkeys = np.full((kw1, self.h_cap), keylib.INF_WORD, np.uint32)
@@ -1073,17 +1656,72 @@ class JaxConflictSet:
         self._hcount = jnp.asarray(n, jnp.int32)
         self._oldest = jnp.asarray(0, jnp.int32)
         self._hcount_bound = n
+        if self.tiered:
+            # Rehydration resets the tier split: the adopted state becomes
+            # the (frozen) base, the delta restarts empty, and the carried
+            # max-table is rebuilt — bit-exact regardless of whether the
+            # fault interrupted a major compaction.
+            self._reset_delta_state(hvers)
 
     def store_to(self, cpu) -> None:
-        """Write device state back into the CPU engine."""
+        """Write device state back into the CPU engine.  In tiered mode
+        the exported step function is the MERGED view (delta folded over
+        the frozen base with the same rules the on-device major compaction
+        applies), so round-tripping through a CPU engine mid-delta is
+        exact."""
+        keys, vers = self._merged_host_state()
+        cpu.keys = keys
+        cpu.vers = vers
+        cpu.oldest_version = self.oldest_version
+
+    def _merged_host_state(self):
+        """Decode the logical step function to host (keys, abs-versions)
+        lists.  Flat mode: the base verbatim.  Tiered mode: covered delta
+        intervals override the base; floor-valued delta rows re-anchor the
+        base's value at their key (dropped when an equal-key base row
+        already provides it) — the host twin of _major_compact's rules,
+        minus eviction (export preserves current state)."""
+        from bisect import bisect_left
+
         from .engine_cpu import FLOOR_VERSION
 
         n = int(self._hcount)
-        hkeys = np.asarray(self._hkeys[:, :n]).T
-        hvers = np.asarray(self._hvers[:n])
-        cpu.keys = [keylib.decode_key(hkeys[i], self.key_words) for i in range(n)]
-        cpu.vers = [
-            FLOOR_VERSION if int(v) == FLOOR_REL else int(v) + self._base
-            for v in hvers
+        bkeys_np = np.asarray(self._hkeys[:, :n]).T
+        bvers_np = np.asarray(self._hvers[:n])
+        bkeys = [
+            keylib.decode_key(bkeys_np[i], self.key_words) for i in range(n)
         ]
-        cpu.oldest_version = self.oldest_version
+
+        def absv(rel):
+            rel = int(rel)
+            return FLOOR_VERSION if rel == FLOOR_REL else rel + self._base
+
+        bvers = [absv(v) for v in bvers_np]
+        if not self.tiered:
+            return bkeys, bvers
+        nd = int(self._dcount)
+        dkeys_np = np.asarray(self._dkeys[:, :nd]).T
+        dvers_np = np.asarray(self._dvers[:nd])
+        dkeys = [
+            keylib.decode_key(dkeys_np[j], self.key_words) for j in range(nd)
+        ]
+        out_k: list = []
+        out_v: list = []
+        for j in range(nd):
+            lo = dkeys[j]
+            hi = dkeys[j + 1] if j + 1 < nd else None
+            vrel = int(dvers_np[j])
+            if vrel != FLOOR_REL:
+                # Covered interval: the delta value dominates everything
+                # beneath (it is a write version issued after base froze).
+                out_k.append(lo)
+                out_v.append(vrel + self._base)
+                continue
+            i0 = bisect_left(bkeys, lo)
+            if not (i0 < n and bkeys[i0] == lo):
+                out_k.append(lo)
+                out_v.append(bvers[max(0, i0 - 1)])
+            i1 = n if hi is None else bisect_left(bkeys, hi)
+            out_k.extend(bkeys[i0:i1])
+            out_v.extend(bvers[i0:i1])
+        return out_k, out_v

@@ -151,25 +151,30 @@ def floor_log2(x: jnp.ndarray) -> jnp.ndarray:
     return 31 - jax.lax.clz(jnp.maximum(x, 1).astype(jnp.int32))
 
 
-def _build_table(values: jnp.ndarray, op) -> jnp.ndarray:
+def _build_table(values, op, xp=jnp):
     """Stacked sparse table [L+1, N]; table[l][i] covers [i, i + 2^l).
 
     The shifted self-combine is expressed as slice + edge-pad (NOT a
     clamped-index gather): XLA lowers slices/pads to pure streaming copies,
     while a gather with computed indices runs orders of magnitude slower on
-    TPU.  Measured on v5e at N=1M: 262ms (gather) -> ~2ms (slice)."""
+    TPU.  Measured on v5e at N=1M: 262ms (gather) -> ~2ms (slice).
+
+    `xp` selects the array module: the tiered conflict engine seeds its
+    CARRIED base max-table host-side (numpy) at init/load_from/grow; one
+    shared implementation keeps the host table's level layout identical to
+    what range_max expects by construction."""
     n = values.shape[0]
     levels = [values]
     span = 1
     lmax = max(1, math.ceil(math.log2(max(n, 2))))
     for _ in range(lmax):
         prev = levels[-1]
-        shifted = jnp.concatenate(
-            [prev[span:], jnp.broadcast_to(prev[-1:], (min(span, n),))]
+        shifted = xp.concatenate(
+            [prev[span:], xp.broadcast_to(prev[-1:], (min(span, n),))]
         )
         levels.append(op(prev, shifted))
         span *= 2
-    return jnp.stack(levels)
+    return xp.stack(levels)
 
 
 def build_max_table(values: jnp.ndarray) -> jnp.ndarray:
@@ -178,6 +183,14 @@ def build_max_table(values: jnp.ndarray) -> jnp.ndarray:
 
 def build_min_table(values: jnp.ndarray) -> jnp.ndarray:
     return _build_table(values, jnp.minimum)
+
+
+def build_max_table_np(values):
+    """Host (numpy) twin of build_max_table — same layout by construction
+    (shared _build_table body)."""
+    import numpy as np
+
+    return _build_table(values, np.maximum, xp=np)
 
 
 def _range_query(table: jnp.ndarray, i: jnp.ndarray, j: jnp.ndarray, op) -> jnp.ndarray:

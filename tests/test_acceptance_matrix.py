@@ -97,7 +97,10 @@ def _run_cycle_multi_resolver(backend: str, seed: int):
     c = SimCluster(
         seed=seed, conflict_backend=backend, n_resolvers=4, n_proxies=2
     )
-    wl = CycleWorkload(nodes=8, ops=25, actors=3)
+    # ops trimmed 25 -> 12 for tier-1 runtime headroom (ISSUE 4 satellite):
+    # the gate still drives 4-resolver sharded contention with identical-
+    # history assertion; the larger soak belongs to the slow sweeps.
+    wl = CycleWorkload(nodes=8, ops=12, actors=3)
     run_workloads(c, [wl], timeout_vt=30000.0)
     state = _final_state(c, wl.prefix)
     set_event_loop(None)

@@ -265,16 +265,22 @@ def test_fixpoint_divergence_falls_back_to_cpu(jcs_factory, monkeypatch):
 
     jcs = jcs_factory()
     ref = CpuConflictSet()
-    real_step = ej._detect_step
+    # Patch the jit entry the engine actually dispatches through (it used
+    # to patch the unused _detect_step alias, which exercised nothing) —
+    # mode-aware, so the FDB_TPU_HISTORY=tiered run of this suite
+    # exercises the tiered store_to/load_from fallback path too.
+    step_name = "_tiered_blob_step" if jcs.tiered else "_blob_step"
+    real_step = getattr(ej, step_name)
 
-    def diverged_step(hkeys, hvers, hcount, oldest, *rest, **caps):
-        # What detect_core returns when the fixpoint cap is hit: pristine
-        # state, garbage statuses, undecided > 0.
-        return (
-            hkeys,
-            hvers,
-            hcount,
-            oldest,
+    def diverged_step(*state_and_blob, **caps):
+        # What the core returns when the fixpoint cap is hit: pristine
+        # state (every arg but the trailing blob — the final state slot is
+        # oldest, doubling as the reverted new_oldest), garbage statuses,
+        # undecided > 0.  Works for both entry points: flat state is
+        # (hkeys, hvers, hcount, oldest), tiered adds (maxtab, dkeys,
+        # dvers, dcount) before oldest.
+        state = state_and_blob[:-1]
+        return state + (
             jnp.zeros((caps["txn_cap"],), jnp.int32),
             jnp.asarray(1, jnp.int32),
             jnp.asarray(caps["txn_cap"] + 2, jnp.int32),
@@ -284,11 +290,11 @@ def test_fixpoint_divergence_falls_back_to_cpu(jcs_factory, monkeypatch):
         _random_stream(31, 40, batches=9, txns_per_batch=12)
     ):
         step = diverged_step if 3 <= bi < 6 else real_step
-        monkeypatch.setattr(ej, "_detect_step", step)
+        monkeypatch.setattr(ej, step_name, step)
         got = jcs.detect(txns, now, new_oldest)
         want = ref.detect(txns, now, new_oldest)
         assert got == want, f"batch {bi}: jax={got} cpu={want}"
-    monkeypatch.setattr(ej, "_detect_step", real_step)
+    monkeypatch.setattr(ej, step_name, real_step)
 
 
 def test_hybrid_authority_hysteresis():

@@ -14,6 +14,12 @@ import pytest
 
 from conftest import REPO_ROOT
 
+# FDB_TPU_SEARCH is read at import, so each SEARCH mode needs its own
+# interpreter; the eviction/history flags are read at ENGINE CONSTRUCTION,
+# so one subprocess differential-gates several of those variants back to
+# back (one jax import instead of one per combo — tier-1 headroom
+# satellite).  h_cap stays 1<<16: exactly _2LEVEL_MIN, so the 2level
+# search path is genuinely active when that mode is under test.
 DIFF = r"""
 import os, sys
 sys.path.insert(0, %(repo)r)
@@ -25,9 +31,10 @@ from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
 from foundationdb_tpu.conflict.engine_jax import JaxConflictSet
 from foundationdb_tpu.conflict.types import TransactionConflictInfo
 
-rng = np.random.default_rng(17)
+CTOR_FLAGS = ("FDB_TPU_EVICT_EVERY", "FDB_TPU_HISTORY", "FDB_TPU_DELTA_CAP")
+variants = %(variants)s
 
-def txn(now):
+def txn(rng, now):
     def rr():
         a = int(rng.integers(0, 3000))
         b = a + 1 + int(rng.integers(0, 25))
@@ -38,39 +45,84 @@ def txn(now):
         write_ranges=[rr() for _ in range(int(rng.integers(0, 3)))],
     )
 
-cpu, dev = CpuConflictSet(), JaxConflictSet(
-    key_words=2, h_cap=1 << 17, bucket_mins=(64, 128, 128)
-)
-now = 100
-for batch in range(10):
-    txns = [txn(now) for _ in range(int(rng.integers(5, 40)))]
-    now += int(rng.integers(1, 25))
-    oldest = max(0, now - 90)
-    got = dev.detect(txns, now=now, new_oldest_version=oldest)
-    want = cpu.detect(txns, now=now, new_oldest_version=oldest)
-    assert got == want, (batch, got, want)
+for flags in variants:
+    for k in CTOR_FLAGS:
+        os.environ.pop(k, None)
+    os.environ.update(flags)
+    rng = np.random.default_rng(17)
+    cpu, dev = CpuConflictSet(), JaxConflictSet(
+        key_words=2, h_cap=1 << 16, bucket_mins=(64, 128, 128)
+    )
+    now = 100
+    for batch in range(10):
+        txns = [txn(rng, now) for _ in range(int(rng.integers(5, 40)))]
+        now += int(rng.integers(1, 25))
+        oldest = max(0, now - 90)
+        got = dev.detect(txns, now=now, new_oldest_version=oldest)
+        want = cpu.detect(txns, now=now, new_oldest_version=oldest)
+        assert got == want, (flags, batch, got, want)
+    print("VARIANT_OK", flags)
 print("OK")
 """
 
 
 @pytest.mark.parametrize(
-    "flags",
+    "search_env,variants",
     [
-        {"FDB_TPU_SEARCH": "2level"},
-        {"FDB_TPU_EVICT_EVERY": "3"},
-        {"FDB_TPU_SEARCH": "2level", "FDB_TPU_EVICT_EVERY": "3"},
+        # flat search: the evict-batching arm and the two-tier history
+        # arm (ISSUE 4: small delta cap + cadence alias so the 10-batch
+        # stream crosses several major compactions; this is the env-flag
+        # end-to-end proof — the in-process tiered edge suite lives in
+        # test_tiered_history.py).
+        (
+            {},
+            [
+                {"FDB_TPU_EVICT_EVERY": "3"},
+                {"FDB_TPU_HISTORY": "tiered", "FDB_TPU_DELTA_CAP": "1024",
+                 "FDB_TPU_EVICT_EVERY": "3"},
+            ],
+        ),
+        # 2level search alone and combined with evict batching.
+        (
+            {"FDB_TPU_SEARCH": "2level"},
+            [{}, {"FDB_TPU_EVICT_EVERY": "3"}],
+        ),
     ],
-    ids=["2level", "evict3", "both"],
+    ids=["evict3+tiered", "2level+both"],
 )
-def test_experiment_flags_decision_identical(flags):
+def test_experiment_flags_decision_identical(search_env, variants):
     env = dict(os.environ)
-    env.update(flags)
+    env.update(search_env)
     env["PYTHONPATH"] = REPO_ROOT
     env["JAX_PLATFORMS"] = "cpu"
     res = subprocess.run(
-        [sys.executable, "-c", DIFF % {"repo": REPO_ROOT}],
+        [sys.executable, "-c",
+         DIFF % {"repo": REPO_ROOT, "variants": repr(variants)}],
         env=env, capture_output=True, text=True, timeout=600, cwd=REPO_ROOT,
     )
-    assert res.returncode == 0 and "OK" in res.stdout, (
-        res.stdout[-500:] + res.stderr[-1500:]
+    assert res.returncode == 0 and "\nOK" in "\n" + res.stdout, (
+        res.stdout[-800:] + res.stderr[-1500:]
     )
+    assert res.stdout.count("VARIANT_OK") == len(variants), res.stdout[-800:]
+
+
+@pytest.mark.slow  # full-suite acceptance gate for the tiered flag: runs the
+# conflict + sharded + device-fault differential suites end-to-end under
+# FDB_TPU_HISTORY=tiered (~5 min on this host; tier-1 carries the same
+# coverage through test_tiered_history + the in-process suites, since the
+# flag is read at engine construction)
+def test_full_differential_suites_under_tiered_flag():
+    env = dict(os.environ)
+    env.update({
+        "FDB_TPU_HISTORY": "tiered",
+        "FDB_TPU_DELTA_CAP": "512",
+        "PYTHONPATH": REPO_ROOT,
+        "JAX_PLATFORMS": "cpu",
+    })
+    res = subprocess.run(
+        [sys.executable, "-m", "pytest", "-q", "-p", "no:cacheprovider",
+         "tests/test_conflict_jax.py", "tests/test_device_faults.py",
+         "tests/test_sharded_resolver.py"],
+        env=env, capture_output=True, text=True, timeout=1800, cwd=REPO_ROOT,
+    )
+    assert res.returncode == 0, res.stdout[-3000:] + res.stderr[-2000:]

@@ -73,6 +73,7 @@ def test_key_at_metric():
     assert s.key_at_metric(k(5), k(8), 30) is None
 
 
+@pytest.mark.slow  # tier-1 headroom (ISSUE 4): scaling sweep
 def test_operations_scale_logarithmically():
     """The review-visible property: point ops on 64k keys must not scan.
     Compare per-op time at 4k vs 64k keys (16x data, ~1.33x log factor;

@@ -6,6 +6,8 @@ restart backoff :274-283, config reload).
 
 import os
 import signal
+
+import pytest
 import subprocess
 import sys
 import time
@@ -29,6 +31,7 @@ def _children_of(pid: int):
     ]
 
 
+@pytest.mark.slow  # tier-1 headroom (ISSUE 4): watchdog integration soak
 def test_monitor_restarts_crashed_server(tmp_path):
     conf = tmp_path / "cluster.conf"
     logdir = tmp_path / "logs"

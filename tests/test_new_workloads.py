@@ -425,6 +425,7 @@ def test_ryow_under_chaos(seed):
     )
 
 
+@pytest.mark.slow  # tier-1 headroom (ISSUE 4): profiler soak
 def test_slowtask_metriclogging_plain():
     """Aux-subsystem workloads: the slow-task profiler catches a
     deliberate reactor hog; TDMetric series flush into \\xff/metrics and

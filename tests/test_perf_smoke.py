@@ -1,0 +1,238 @@
+"""CPU-backend perf smoke gates (ISSUE 4 satellite): recompile storms and
+H-proportional per-batch work must fail tier-1, not show up on hardware.
+
+Two pins:
+
+1. Compile counts: retraces == distinct static shape buckets for both the
+   flat and the tiered engine, across batches that include major
+   compactions — the traced-lax.cond compaction must add NO new compile
+   buckets per batch.
+
+2. Structural (jaxpr) bound on steady-state work: in the tiered step,
+   every H-sized sort/cumsum/concatenate/scatter lives INSIDE the major-
+   compaction cond branch; non-compaction batches touch the base only
+   through read-only gathers (binary search + carried max-table lookups).
+   This is the CPU-assertable form of "per-batch work bounded by delta
+   size, not h_cap" — it needs no hardware timer and cannot flake.
+
+Run alone: pytest -m perf_smoke
+"""
+
+import math
+from functools import partial
+
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+from foundationdb_tpu.conflict.engine_jax import (
+    JaxConflictSet,
+    detect_core,
+    detect_core_tiered,
+)
+from foundationdb_tpu.conflict.types import TransactionConflictInfo as T
+
+pytestmark = pytest.mark.perf_smoke
+
+
+def k(i: int) -> bytes:
+    return b"%08d" % i
+
+
+# ---------------------------------------------------------------------------
+# 1. compile-count pins
+# ---------------------------------------------------------------------------
+
+
+def _drive(cs, batches=10, writes_per_batch=6):
+    cpu = CpuConflictSet()
+    v = 0
+    for i in range(batches):
+        txns = [
+            T(read_snapshot=v,
+              write_ranges=[(k(1000 * i + 4 * j), k(1000 * i + 4 * j + 1))
+                            for j in range(writes_per_batch)]),
+            T(read_snapshot=max(0, v - 3),
+              read_ranges=[(k(1000 * max(0, i - 1)), k(1000 * i + 30))]),
+        ]
+        v += 5
+        assert cs.detect(txns, v, max(0, v - 20)) == cpu.detect(
+            txns, v, max(0, v - 20)
+        ), f"batch {i}"
+
+
+def test_flat_retraces_equal_distinct_buckets():
+    cs = JaxConflictSet(key_words=3, h_cap=1 << 8, bucket_mins=(8, 8, 16))
+    _drive(cs)
+    snap = cs.metrics.snapshot()
+    assert snap["counters"]["batches"] == 10
+    assert snap["counters"]["retraces"] == len(cs._bucket_dispatches) == 1, (
+        "recompile storm: one static bucket must compile exactly once"
+    )
+
+
+def test_tiered_compaction_adds_no_compile_buckets(monkeypatch):
+    """Cadence-2 compactions: 10 batches alternate minor/major through the
+    SAME compiled program (the cond is traced, not re-jitted)."""
+    monkeypatch.setenv("FDB_TPU_HISTORY", "tiered")
+    monkeypatch.setenv("FDB_TPU_DELTA_CAP", "128")
+    monkeypatch.setenv("FDB_TPU_EVICT_EVERY", "2")
+    cs = JaxConflictSet(key_words=3, h_cap=1 << 8, bucket_mins=(8, 8, 16))
+    assert cs.tiered and cs.compact_every == 2
+    _drive(cs)
+    snap = cs.metrics.snapshot()
+    assert snap["counters"]["major_compactions"] >= 4
+    assert snap["counters"]["retraces"] == len(cs._bucket_dispatches) == 1, (
+        "tiered path added compile buckets per batch"
+    )
+
+
+# ---------------------------------------------------------------------------
+# 2. structural jaxpr gate: steady-state work bounded by delta size
+# ---------------------------------------------------------------------------
+
+KW1 = 4
+H_CAP = 4096
+D_CAP = 256
+TXN, RR, WR = 32, 128, 64
+
+# Primitives that do O(n) COMPUTE over their operands (vs read-only
+# gathers, which are how phase 1 legitimately touches the base).
+_WORK_PRIMS = {"sort", "cumsum", "concatenate", "scatter", "scatter-add",
+               "reduce_max", "reduce_min", "reduce_sum"}
+
+
+def _collect(jaxpr, out, in_cond):
+    """(primitive, max operand dim, inside-compaction-cond) per eqn,
+    descending into every sub-jaxpr."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        sub_in_cond = in_cond or name == "cond"
+        for pname, p in eqn.params.items():
+            vals = p if isinstance(p, (list, tuple)) else [p]
+            for v in vals:
+                inner = getattr(v, "jaxpr", None)
+                if inner is not None and hasattr(inner, "eqns"):
+                    _collect(inner, out, sub_in_cond)
+                elif hasattr(v, "eqns"):
+                    _collect(v, out, sub_in_cond)
+        dims = [
+            max(v.aval.shape)
+            for v in eqn.invars
+            if hasattr(v, "aval") and getattr(v.aval, "shape", ())
+        ]
+        out.append((name, max(dims, default=0), in_cond))
+
+
+def _tiered_jaxpr():
+    lmax = max(1, math.ceil(math.log2(H_CAP)))
+    u32 = jnp.uint32
+    i32 = jnp.int32
+    args = (
+        jnp.zeros((KW1, H_CAP), u32),        # hkeys
+        jnp.zeros((H_CAP,), i32),            # hvers
+        jnp.asarray(1, i32),                 # hcount
+        jnp.zeros((lmax + 1, H_CAP), i32),   # maxtab
+        jnp.zeros((KW1, D_CAP), u32),        # dkeys
+        jnp.zeros((D_CAP,), i32),            # dvers
+        jnp.asarray(1, i32),                 # dcount
+        jnp.asarray(0, i32),                 # oldest
+        jnp.zeros((KW1, RR), u32),           # r_begin
+        jnp.zeros((KW1, RR), u32),           # r_end
+        jnp.zeros((RR,), i32),               # r_txn
+        jnp.zeros((RR,), i32),               # r_snap
+        jnp.zeros((KW1, WR), u32),           # w_begin
+        jnp.zeros((KW1, WR), u32),           # w_end
+        jnp.zeros((WR,), i32),               # w_txn
+        jnp.zeros((TXN,), i32),              # t_snap
+        jnp.zeros((TXN,), bool),             # t_has_reads
+        jnp.zeros((TXN,), bool),             # t_valid
+        jnp.asarray(1, i32),                 # now_rel
+        jnp.asarray(0, i32),                 # new_oldest_rel
+        jnp.asarray(0, i32),                 # do_major
+    )
+    fn = partial(detect_core_tiered, txn_cap=TXN, rr_cap=RR, wr_cap=WR,
+                 h_cap=H_CAP, d_cap=D_CAP)
+    return jax.make_jaxpr(fn)(*args)
+
+
+def _flat_jaxpr():
+    u32 = jnp.uint32
+    i32 = jnp.int32
+    args = (
+        jnp.zeros((KW1, H_CAP), u32),
+        jnp.zeros((H_CAP,), i32),
+        jnp.asarray(1, i32),
+        jnp.asarray(0, i32),
+        jnp.zeros((KW1, RR), u32),
+        jnp.zeros((KW1, RR), u32),
+        jnp.zeros((RR,), i32),
+        jnp.zeros((RR,), i32),
+        jnp.zeros((KW1, WR), u32),
+        jnp.zeros((KW1, WR), u32),
+        jnp.zeros((WR,), i32),
+        jnp.zeros((TXN,), i32),
+        jnp.zeros((TXN,), bool),
+        jnp.zeros((TXN,), bool),
+        jnp.asarray(1, i32),
+        jnp.asarray(0, i32),
+    )
+    fn = partial(detect_core, txn_cap=TXN, rr_cap=RR, wr_cap=WR, h_cap=H_CAP)
+    return jax.make_jaxpr(fn)(*args)
+
+
+def test_flat_step_has_h_sized_sorts():
+    """Detector sanity: the flat step's merge+evict ARE H-sized sorts (the
+    very ones the tier split amortizes) and the collector sees them."""
+    entries = []
+    _collect(_flat_jaxpr().jaxpr, entries, in_cond=False)
+    h_sorts = [e for e in entries if e[0] == "sort" and e[1] >= H_CAP]
+    assert len(h_sorts) >= 2, entries
+
+
+def test_tiered_steady_state_has_no_h_sized_work_outside_cond():
+    """The gate: every H-sized work primitive lives inside the compaction
+    cond; the steady-state (non-compaction) batch is bounded by delta/
+    point-domain sizes.  The compaction branch must still contain the
+    H-sized sorts (it exists and does the real merge)."""
+    entries = []
+    _collect(_tiered_jaxpr().jaxpr, entries, in_cond=False)
+    outside = [
+        e for e in entries
+        if not e[2] and e[0] in _WORK_PRIMS and e[1] >= H_CAP
+    ]
+    assert not outside, (
+        f"H-sized work escaped the compaction cond: {outside}"
+    )
+    inside_sorts = [
+        e for e in entries if e[2] and e[0] == "sort" and e[1] >= H_CAP
+    ]
+    assert len(inside_sorts) >= 2, (
+        "the compaction branch lost its H-sized merge/evict sorts"
+    )
+    # And the biggest sort outside the cond is delta/point-domain sized.
+    out_sorts = [e[1] for e in entries if not e[2] and e[0] == "sort"]
+    assert out_sorts and max(out_sorts) < H_CAP
+
+
+def test_host_and_device_max_tables_agree():
+    """The tiered engine's CARRIED base max-table is seeded host-side
+    (numpy) and queried by range_max against the device-built layout;
+    both come from ONE shared builder — pin the parity anyway so a layout
+    change can never silently skew only the host twin."""
+    import numpy as np
+
+    from foundationdb_tpu.ops.rangequery import (
+        build_max_table,
+        build_max_table_np,
+    )
+
+    rng = np.random.default_rng(3)
+    for n in (1, 2, 3, 7, 64, 1000, 4096):
+        v = rng.integers(-(2 ** 30), 2 ** 30, size=(n,)).astype(np.int32)
+        host = build_max_table_np(v)
+        dev = np.asarray(build_max_table(jnp.asarray(v)))
+        assert host.shape == dev.shape, n
+        assert (host == dev).all(), n

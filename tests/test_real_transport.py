@@ -46,6 +46,7 @@ def test_three_process_localhost_cluster():
             server.kill()
 
 
+@pytest.mark.slow  # tier-1 headroom (ISSUE 4): real-OS-process integration soak
 def test_real_server_durable_restart(tmp_path):
     """A real-OS-process server with the native C++ engine: kill it hard,
     restart on the same datadir, and committed data must still be there
@@ -81,6 +82,7 @@ def test_real_server_durable_restart(tmp_path):
             server2.kill()
 
 
+@pytest.mark.slow  # tier-1 headroom (ISSUE 4): real-OS-process integration soak
 def test_real_server_killed_mid_load(tmp_path):
     """SIGKILL the server WHILE a client is committing, restart on the
     same datadir: the client must ride reconnect + unknown-result fencing
@@ -131,6 +133,7 @@ def test_real_server_killed_mid_load(tmp_path):
                 pr.wait()
 
 
+@pytest.mark.slow  # tier-1 headroom (ISSUE 4): real-OS-process integration soak
 def test_kvcheck_verifies_and_detects_corruption(tmp_path):
     """kvcheck (the kvfileintegritycheck role analog): a healthy datadir
     verifies clean; flipping bytes in the engine's durable files makes it

@@ -225,6 +225,7 @@ def test_sharded_global_state_roundtrip(sharded):
             sharded.load_from(flat)
 
 
+@pytest.mark.slow  # tier-1 headroom (ISSUE 4): cluster integration (self-described non-differential)
 def test_sharded_set_serves_a_real_cluster():
     """END-TO-END: the mesh-sharded device conflict set as the CLUSTER's
     resolver engine — workloads commit through it, long keys (system

@@ -174,6 +174,7 @@ def test_system_monitor_wall_metrics_gated():
     set_event_loop(None)
 
 
+@pytest.mark.slow  # tier-1 headroom (ISSUE 4): multi-resolution soak
 def test_metric_levels_multi_resolution():
     """TDMetric-style levels: level 0 records every flush; higher levels
     thin out by 4x per level (flow/TDMetric.actor.h:168)."""

@@ -1,0 +1,393 @@
+"""Two-tier history differential gate (ISSUE 4).
+
+FDB_TPU_HISTORY=tiered must be DECISION-IDENTICAL to the CPU reference
+(and therefore to the flat device engine) across random streams, major
+compactions, base growth/rebase landing on compaction batches, mid-delta
+store_to/load_from round-trips, and device faults firing on the batch
+that would have compacted.
+
+The flag is read at JaxConflictSet construction, so these tests run
+in-process under monkeypatched env (no subprocess per case); the
+full-stream subprocess differential under the flag lives in
+test_engine_experiments.py.
+
+Shape discipline (1-core CI host): one tiered shape bucket —
+key_words=3, bucket_mins=(32, 128, 64), h_cap=1<<10, d_cap=512 — shared
+across the module, so the XLA compile is paid once.  The growth test
+starts at h_cap=1<<9 and grows INTO the shared shape.
+"""
+
+import pytest
+
+from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+from foundationdb_tpu.conflict.engine_jax import REBASE_THRESHOLD, JaxConflictSet
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+from foundationdb_tpu.conflict.types import TransactionConflictInfo as T
+from foundationdb_tpu.flow import DeterministicRandom
+
+D_CAP = 512
+BUCKETS = (32, 128, 64)
+
+
+def k(i: int) -> bytes:
+    return b"%08d" % i
+
+
+@pytest.fixture(autouse=True)
+def _tiered_env(monkeypatch):
+    monkeypatch.setenv("FDB_TPU_HISTORY", "tiered")
+    monkeypatch.setenv("FDB_TPU_DELTA_CAP", str(D_CAP))
+    yield
+
+
+def make(**kw):
+    kw.setdefault("key_words", 3)
+    kw.setdefault("h_cap", 1 << 10)
+    kw.setdefault("bucket_mins", BUCKETS)
+    cs = JaxConflictSet(**kw)
+    assert cs.tiered and cs.d_cap == D_CAP
+    return cs
+
+
+def _random_stream(seed, keyspace, batches, txns_per_batch, snap_lag=25):
+    rng = DeterministicRandom(seed)
+    version = 10
+    out = []
+    for _ in range(batches):
+        txns = []
+        for _ in range(rng.random_int(1, txns_per_batch + 1)):
+            tr = T(read_snapshot=max(0, version - rng.random_int(0, snap_lag)))
+            for _ in range(rng.random_int(0, 4)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 8))
+                tr.read_ranges.append((k(a), k(b)))
+            for _ in range(rng.random_int(0, 3)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 10))
+                tr.write_ranges.append((k(a), k(b)))
+            txns.append(tr)
+        now = version + rng.random_int(1, 10)
+        new_oldest = max(0, version - snap_lag)
+        out.append((txns, now, new_oldest))
+        version = now
+    return out
+
+
+def _majors(cs) -> int:
+    return cs.metrics.snapshot()["counters"]["major_compactions"]
+
+
+@pytest.mark.parametrize("seed,cadence", [(11, 2)], ids=["cadence2"])
+def test_tiered_differential_vs_cpu_vs_oracle(monkeypatch, seed, cadence):
+    """The headline gate: tiered verdicts == CPU == oracle across a
+    random stream, with major compactions exercised through the
+    FDB_TPU_EVICT_EVERY cadence alias (the fill-triggered compaction
+    edge is pinned by test_delta_exactly_full_triggers_compaction)."""
+    monkeypatch.setenv("FDB_TPU_EVICT_EVERY", str(cadence))
+    jcs = make()
+    cpu, orc = CpuConflictSet(), OracleConflictSet()
+    for bi, (txns, now, new_oldest) in enumerate(
+        _random_stream(seed, 40, batches=30, txns_per_batch=16)
+    ):
+        gj = jcs.detect(txns, now, new_oldest)
+        gc = cpu.detect(txns, now, new_oldest)
+        go = orc.detect(txns, now, new_oldest)
+        assert gj == gc == go, f"batch {bi}: jax={gj} cpu={gc} oracle={go}"
+        # The delta tier may never exceed its capacity (host bound math).
+        assert int(jcs._dcount) <= jcs.d_cap
+    assert _majors(jcs) >= 1, "stream never exercised a major compaction"
+    # One shape bucket, one retrace: the traced-cond compaction adds no
+    # compile buckets per batch (the perf_smoke gate pins this harder).
+    snap = jcs.metrics.snapshot()
+    assert snap["counters"]["retraces"] == len(jcs._bucket_dispatches) == 1
+
+
+def test_delta_exactly_full_triggers_compaction():
+    """Delta-fill edge: with single-bucket batches of known write count
+    the host's bound math must fire the compaction exactly when the NEXT
+    batch could overflow — the merge itself never truncates (dcount stays
+    <= d_cap at every sync), and the delta resets to its floor row."""
+    jcs = make()
+    cpu = CpuConflictSet()
+    wr_cap = BUCKETS[2]
+    add = 2 * wr_cap
+    v = 0
+    saw_reset = False
+    for i in range(20):
+        # 16 disjoint NON-adjacent single-key writes (adjacent ones would
+        # coalesce): +32 delta rows per batch, window pinned at 0 so
+        # nothing evicts and the fill is monotone until the compaction.
+        # Read probes over earlier writes keep the verdicts non-trivial
+        # (phase-1 hits on both tiers).
+        txns = [
+            T(read_snapshot=v,
+              write_ranges=[(k(10_000 * i + 4 * j), k(10_000 * i + 4 * j + 1))
+                            for j in range(16)])
+        ] + [
+            T(read_snapshot=max(0, v - lag),
+              read_ranges=[(k(10_000 * max(0, i - back)),
+                            k(10_000 * max(0, i - back) + 70))])
+            for lag, back in ((1, 1), (12, 3), (0, 0))
+        ]
+        pre_bound = jcs._dcount_bound
+        expect_major = pre_bound + 2 * add + 2 > jcs.d_cap
+        v += 5
+        assert jcs.detect(txns, v, 0) == cpu.detect(txns, v, 0), f"batch {i}"
+        dcount = int(jcs._dcount)
+        assert dcount <= jcs.d_cap, "delta overflowed its capacity"
+        if expect_major:
+            assert jcs._batches_since_major == 0, (
+                f"batch {i}: bound math predicted a compaction that "
+                f"did not happen (pre_bound={pre_bound})"
+            )
+            assert dcount == 1, "delta did not reset after compaction"
+            saw_reset = True
+    assert saw_reset and _majors(jcs) >= 2
+    assert jcs.boundary_count == cpu.boundary_count  # post-compaction exact
+
+
+def test_major_compaction_same_batch_as_grow():
+    """Base growth lands ON a compaction batch (the only batch kind that
+    can grow the base in tiered mode): decisions stay identical and the
+    engine re-enters steady state at the grown capacity."""
+    jcs = make(h_cap=1 << 9)
+    cpu = CpuConflictSet()
+    v = 0
+    for i in range(14):
+        txns = [
+            T(read_snapshot=v,
+              write_ranges=[(k(20_000 * i + 100 * t + 2 * j),
+                             k(20_000 * i + 100 * t + 2 * j + 1))
+                            for j in range(8)])
+            for t in range(8)
+        ]
+        v += 5
+        # Window pinned at 0: every boundary is live, so compactions must
+        # eventually exhaust 512 rows of base and grow it.
+        assert jcs.detect(txns, v, 0) == cpu.detect(txns, v, 0), f"batch {i}"
+    snap = jcs.metrics.snapshot()
+    assert snap["counters"]["grows"] >= 1, "base never grew"
+    assert _majors(jcs) >= 1
+    assert jcs.h_cap > (1 << 9)
+    assert jcs.boundary_count == cpu.boundary_count
+
+
+def test_rebase_keeps_tiers_consistent():
+    """A version-offset rebase shifts base versions, delta versions AND
+    the carried max-table by the same constant; verdicts must keep
+    matching the CPU engine straight through it."""
+    jcs = make()
+    cpu = CpuConflictSet()
+    step = REBASE_THRESHOLD // 3 + 7
+    v = 0
+    for i in range(6):
+        txns = [
+            T(read_snapshot=v, write_ranges=[(k(100 * i + 2 * j),
+                                              k(100 * i + 2 * j + 1))
+                                             for j in range(4)]),
+            T(read_snapshot=v, read_ranges=[(k(100 * (i - 1)),
+                                             k(100 * i + 10))]),
+        ]
+        v += step
+        oldest = max(0, v - 2 * step)
+        assert jcs.detect(txns, v, oldest) == cpu.detect(txns, v, oldest), (
+            f"batch {i}"
+        )
+    assert jcs.metrics.snapshot()["counters"]["rebases"] >= 1, (
+        "the stream never crossed REBASE_THRESHOLD"
+    )
+
+
+def test_store_load_roundtrip_mid_delta():
+    """store_to exports the MERGED view while the delta is non-empty;
+    load_from into a fresh tiered engine must continue bit-identically
+    (the PR-3 rehydration path)."""
+    stream = _random_stream(29, 40, batches=26, txns_per_batch=12)
+    jcs = make()
+    cpu = CpuConflictSet()
+    for txns, now, new_oldest in stream[:14]:
+        assert jcs.detect(txns, now, new_oldest) == cpu.detect(
+            txns, now, new_oldest
+        )
+    assert int(jcs._dcount) > 1, "delta empty — round-trip would be trivial"
+    mirror = CpuConflictSet()
+    jcs.store_to(mirror)
+    jcs2 = make()
+    jcs2.load_from(mirror)
+    assert int(jcs2._dcount) == 1  # rehydration restarts the delta
+    for bi, (txns, now, new_oldest) in enumerate(stream[14:]):
+        got = jcs2.detect(txns, now, new_oldest)
+        want = cpu.detect(txns, now, new_oldest)
+        assert got == want, f"post-roundtrip batch {bi}"
+
+
+def test_fault_during_major_compaction_batch(monkeypatch):
+    """DeviceFaultInjector firing at the dispatch of the batch that WOULD
+    have run a major compaction (cadence 4 => batch 4), held down through
+    the first half-open probe: the breaker degrades to the CPU mirror
+    with identical verdicts, recovers, rehydrates through load_from (the
+    delta restarts empty), and the recovered engine compacts and keeps
+    deciding identically."""
+    from foundationdb_tpu.conflict.api import ConflictSet
+    from foundationdb_tpu.conflict.device_faults import DeviceFaultInjector
+
+    monkeypatch.setenv("FDB_TPU_EVICT_EVERY", "4")
+    stream = _random_stream(37, 50, batches=18, txns_per_batch=10)
+
+    def run():
+        inj = DeviceFaultInjector()
+        # Dispatch checks are 1:1 with device-attempted batches: checks
+        # 4-6 are batches 4-6 (batch 4 is the cadence-4 compaction batch;
+        # the fault raises BEFORE any planning/state mutation) — circuit
+        # opens at 3 consecutive; check 7 is the first half-open probe,
+        # also faulted -> backoff doubles; the second probe succeeds and
+        # rehydrates.
+        for at in (4, 5, 6, 7):
+            inj.script("dispatch", at=at)
+        cs = ConflictSet(backend="jax", key_words=3, h_cap=1 << 10,
+                         bucket_mins=BUCKETS, fault_injector=inj)
+        assert cs._jax.tiered
+        verdicts = []
+        for txns, now, nov in stream:
+            b = cs.new_batch()
+            for t in txns:
+                b.add_transaction(t)
+            verdicts.append(b.detect_conflicts(now, nov))
+        return verdicts, cs.device_metrics()
+
+    verdicts, dm = run()
+    cpu = CpuConflictSet()
+    want = [cpu.detect(txns, now, nov) for txns, now, nov in stream]
+    assert verdicts == want, "faulty tiered run diverged from CPU-only run"
+    pairs = [(f, t) for _s, f, t, _r in dm["breaker"]["transitions"]]
+    assert pairs == [
+        ("ok", "degraded"),
+        ("degraded", "probing"),
+        ("probing", "degraded"),
+        ("degraded", "probing"),
+        ("probing", "ok"),
+    ], dm["breaker"]["transitions"]
+    assert dm["counters"]["rehydrates"] >= 1
+    assert dm["backend_state"] == "ok"
+    assert dm["counters"]["major_compactions"] >= 1  # post-recovery cadence
+    assert dm["tiers"]["mode"] == "tiered" and dm["tiers"]["d_cap"] == D_CAP
+    # Replay: byte-identical breaker journey (PR-3 discipline).
+    verdicts2, dm2 = run()
+    import json as _json
+
+    assert verdicts2 == verdicts
+    assert _json.dumps(dm2["breaker"]) == _json.dumps(dm["breaker"])
+
+
+def test_divergence_on_compaction_batch_keeps_bounds_truthful(monkeypatch):
+    """Review regression: a fixpoint-diverged batch landing ON a
+    compaction batch must still reset the delta (the cond fires on the
+    host's flag alone and compacts the REVERTED pre-batch delta — a pure
+    physical rewrite of the same logical function), so the host's
+    pipelined bookkeeping (_dcount_bound=1) stays a true upper bound and
+    later merges can never silently truncate."""
+    from foundationdb_tpu.conflict.engine_jax import PackedBatch
+
+    monkeypatch.setenv("FDB_TPU_EVICT_EVERY", "2")
+    jcs = make()
+    cpu = CpuConflictSet()
+    txns1 = [T(read_snapshot=0,
+               write_ranges=[(k(4 * j), k(4 * j + 1)) for j in range(16)])]
+    assert jcs.detect(txns1, 5, 0) == cpu.detect(txns1, 5, 0)
+    assert int(jcs._dcount) > 1  # delta holds batch 1's rows
+    # Batch 2 = the cadence-2 compaction batch: a read-tripled dependency
+    # chain whose residual (29 undecided txns x 3 reads = 87 slots)
+    # overflows RCAP=64 at this bucket -> undecided > 0 on-device.
+    chain = [T(read_snapshot=5, write_ranges=[(k(1000), k(1001))])]
+    for i in range(1, 31):
+        chain.append(
+            T(read_snapshot=5,
+              read_ranges=[(k(1000 + i - 1), k(1000 + i))] * 3,
+              write_ranges=[(k(1000 + i), k(1000 + i + 1))])
+        )
+    mt, mr, mw = BUCKETS
+    pb = PackedBatch.from_transactions(chain, 3, min_txn=mt, min_rr=mr,
+                                       min_wr=mw)
+    _statuses, undecided = jcs.dispatch_packed(pb, 10, 0)
+    assert int(undecided) > 0, "chain failed to overflow the residual"
+    assert int(jcs._dcount) == 1, "compaction did not reset the delta"
+    assert jcs._dcount_bound == 1, "host bound drifted from device truth"
+    assert int(jcs._hcount) > 2 * 16, "base did not absorb the delta"
+    assert _majors(jcs) == 1
+    # Finish the diverged batch the way detect_packed would, then keep
+    # matching the CPU reference — the logical state never forked.
+    out = jcs._fallback_cpu(pb, 10, 0)
+    assert list(out[: len(chain)]) == cpu.detect(chain, 10, 0)
+    probe = [T(read_snapshot=9, read_ranges=[(k(1000), k(1031))])]
+    assert jcs.detect(probe, 12, 0) == cpu.detect(probe, 12, 0)
+
+
+def test_mixed_bucket_batch_grows_delta_instead_of_truncating(monkeypatch):
+    """Review regression: batches of a LARGER bucket than the ones that
+    filled the delta must not overflow the merge (which runs before the
+    compaction cond, so compaction cannot save it) — the pre-merge guard
+    syncs the true count and grows the delta, and no boundary is lost."""
+    monkeypatch.setenv("FDB_TPU_DELTA_CAP", "512")
+    jcs = JaxConflictSet(key_words=3, h_cap=1 << 11, bucket_mins=(8, 8, 8))
+    cpu = CpuConflictSet()
+    v = 0
+    # Fill with wr_cap=16 batches (16 disjoint writes = 32 delta rows
+    # each): their OWN fill trigger fires only past 512-66 = 446 rows, so
+    # 12 batches legitimately park ~385 rows in the delta uncompacted.
+    for i in range(12):
+        txns = [T(read_snapshot=v,
+                  write_ranges=[(k(10_000 * i + 4 * j),
+                                 k(10_000 * i + 4 * j + 1))
+                                for j in range(16)])]
+        v += 5
+        assert jcs.detect(txns, v, 0) == cpu.detect(txns, v, 0), f"fill {i}"
+    assert int(jcs._dcount) > 300  # the delta really is near-full
+    assert jcs.d_cap == 512
+    # One larger-bucket batch: 2 txns x 20 disjoint writes -> wr_cap 64,
+    # add 128.  The small-bucket grow guard (2*add+8=264 <= 512) does NOT
+    # fire; without the pre-merge must-fit guard the delta merge would
+    # need ~385+130 > 512 rows and silently drop the highest keys.
+    big = [T(read_snapshot=v,
+             write_ranges=[(k(900_000 + 100 * t + 4 * j),
+                            k(900_000 + 100 * t + 4 * j + 1))
+                           for j in range(20)])
+           for t in range(2)]
+    v += 5
+    assert jcs.detect(big, v, 0) == cpu.detect(big, v, 0)
+    assert jcs.d_cap == 1024, "pre-merge guard did not grow the delta"
+    # Nothing was truncated: every written range still conflicts reads.
+    probes = [T(read_snapshot=0, read_ranges=[(k(10_000 * i),
+                                               k(10_000 * i + 70))])
+              for i in range(12)] + [
+        T(read_snapshot=0, read_ranges=[(k(900_000), k(900_300))])]
+    v += 1
+    assert jcs.detect(probes, v, 0) == cpu.detect(probes, v, 0)
+    assert jcs.boundary_count == cpu.boundary_count
+
+
+def test_tiered_metrics_surface():
+    """device_metrics() carries the tier telemetry: sizes, occupancy,
+    compaction count, and the host-side shape facts."""
+    from foundationdb_tpu.conflict.api import ConflictSet
+
+    cs = ConflictSet(backend="jax", key_words=3, h_cap=1 << 10,
+                     bucket_mins=BUCKETS)
+    cpu = CpuConflictSet()
+    for txns, now, nov in _random_stream(41, 40, batches=8, txns_per_batch=10):
+        b = cs.new_batch()
+        for t in txns:
+            b.add_transaction(t)
+        assert b.detect_conflicts(now, nov) == cpu.detect(txns, now, nov)
+    dm = cs.device_metrics()
+    assert dm["tiers"] == {
+        "mode": "tiered",
+        "d_cap": D_CAP,
+        "compact_every": 0,
+        "batches_since_major": cs._jax._batches_since_major,
+        "delta_bound": cs._jax._dcount_bound,
+    }
+    assert dm["gauges"]["base_boundaries"] >= 1
+    assert dm["gauges"]["delta_boundaries"] >= 1
+    assert "delta" in dm["last_occupancy"]
+    assert "major_compactions" in dm["counters"]
+    assert dm["histograms"]["delta_occupancy_synced"]["count"] >= 1

@@ -6,12 +6,17 @@ import), printing one JSON line per variant.  Variants are
 decision-identical to the baseline — verified by the differential suites
 under the same flags — so the only question hardware answers is speed.
 
-Variants:
-  baseline     the shipping configuration
-  search2level FDB_TPU_SEARCH=2level — coarse-then-fine history search
-  evict4       FDB_TPU_EVICT_EVERY=4 — eviction compaction every 4th
-               batch (h_cap gets headroom for the unevicted batches)
-  both         the two combined
+Variants (the one shared table, bench.VARIANTS):
+  baseline        the shipping configuration
+  tiered4         FDB_TPU_HISTORY=tiered + EVICT_EVERY=4 — two-tier
+                  history: per-batch sorts at delta size, a major
+                  compaction (the two full-H sorts, amortized) every 4th
+                  batch behind a traced lax.cond (ISSUE 4)
+  tiered4_2level  tiered + the coarse-then-fine search
+  search2level    FDB_TPU_SEARCH=2level — coarse-then-fine history search
+  evict4          FDB_TPU_EVICT_EVERY=4 — eviction compaction every 4th
+                  batch (h_cap gets headroom for the unevicted batches)
+  both*           2level/evict combinations
 
 Run: python tools/perf_experiments.py   (on the TPU host)
 """

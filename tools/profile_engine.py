@@ -110,6 +110,43 @@ def main():
     bigv = jnp.concatenate([hvers, jnp.zeros((2 * WR,), jnp.int32)])
     timeit("compact_to sort H+128k rows", f5, bigpos, bigk, bigv)
 
+    # --- tiered history (ISSUE 4): steady-state delta work vs the ---
+    # --- amortized major compaction                                ---
+    DCAP = 5 * 2 * WR  # the tiered4 variant's FDB_TPU_DELTA_CAP
+    print(f"tiered pieces: d_cap={DCAP}")
+    dkeys_np = np.sort(
+        rng.integers(0, 2**32, size=(DCAP,), dtype=np.uint32)
+    ).astype(np.uint32)
+    dkeys = jnp.asarray(
+        np.stack([dkeys_np] + [
+            rng.integers(0, 2**32, size=(DCAP,), dtype=np.uint32)
+            for _ in range(KW1 - 1)
+        ])
+    )
+    dvers = jnp.asarray(rng.integers(0, 1 << 20, size=(DCAP,), dtype=np.int32))
+    timeit("tiered: search 64k into delta (x2/batch)", f, dkeys, q)
+    timeit("tiered: build_max_table over delta (1/batch)",
+           jax.jit(build_max_table), dvers)
+    dpos = jnp.asarray(rng.permutation(DCAP + 2 * WR).astype(np.int32))
+    dbigk = jnp.concatenate([dkeys, nk], axis=1)
+    dbigv = jnp.concatenate([dvers, jnp.zeros((2 * WR,), jnp.int32)])
+    f6 = jax.jit(
+        lambda p, k, v: jax.lax.sort(
+            (p,) + tuple(k[w] for w in range(KW1)) + (v,),
+            num_keys=1, is_stable=True,
+        )
+    )
+    timeit("tiered: compact_to sort delta+128k (x2/batch)", f6, dpos,
+           dbigk, dbigv)
+    # _major_compact searches the FULL delta into the base twice (left +
+    # right) — measure at the real D width so cadence/d_cap tuning isn't
+    # made against a ~10x-understated number.
+    timeit("tiered: search full delta into H (x2/compaction)", f, hkeys,
+           dkeys)
+    # The compaction itself is ~2x the full-delta search above + ~2x
+    # "compact_to sort H+128k rows" + one build_max_table over H — read
+    # those rows; divide by the cadence for the amortized per-batch cost.
+
 
 if __name__ == "__main__":
     main()

@@ -2,8 +2,12 @@
 # One-command TPU window exploitation: run when the axon tunnel answers.
 #   1. A/B every decision-identical engine variant at the driver bench
 #      config (writes TUNED.json so the driver-time bench tries the
-#      winner first, with its compile already in .jax_cache)
-#   2. phase-level profiler at the real shapes (attributes ms/batch)
+#      winner first, with its compile already in .jax_cache).  The list
+#      is the ONE shared table bench.VARIANTS — baseline, the two-tier
+#      history arms (tiered4 / tiered4_2level, ISSUE 4), search2level,
+#      and the evict-batching arms.
+#   2. phase-level profiler at the real shapes (attributes ms/batch),
+#      including the tiered per-batch vs major-compaction pieces
 # Outputs land in perf_runs/<timestamp>/ and survive the session.
 set -u
 REPO="$(cd "$(dirname "$0")/.." && pwd)"
