@@ -58,7 +58,7 @@ def _bootstrap_current(address: str, loop, protocol_version: bytes,
     from ..rpc.stream import RequestStreamRef, well_known_token
     from .transaction import Database
 
-    net = RealNetwork(loop, protocol_version=protocol_version)
+    net = RealNetwork(loop, protocol_version=protocol_version)  # fdblint: ignore[DET101]: real-mode bootstrap by identity — drives a wall-clock RealNetwork, never simulator-executed (sim covers this path via SimNetwork clusters)
     proc = net.process("mv_client")
     boot = RequestStreamRef(
         Endpoint(address, well_known_token("bootstrap")), "bootstrap"
@@ -69,7 +69,7 @@ def _bootstrap_current(address: str, loop, protocol_version: bytes,
 
     task = proc.spawn(probe(), "mv_probe")
     try:
-        ifaces = net.run_realtime(until=task, timeout_s=timeout_s)
+        ifaces = net.run_realtime(until=task, timeout_s=timeout_s)  # fdblint: ignore[DET101]: real-mode bootstrap — run_realtime IS the wall-anchored driver; see the ignore on the RealNetwork construction above
     except (FdbError, TimeoutError, RuntimeError) as e:
         conn = net._conns.get(address)
         established = (

@@ -630,9 +630,9 @@ def detect_core(
     wr_cap: int,
     h_cap: int,
 ):
-    import os as _os
+    from ..flow.knobs import g_env
 
-    _ablate = set(_os.environ.get("FDB_TPU_ABLATE", "").split(","))
+    _ablate = set(g_env.get("FDB_TPU_ABLATE").split(","))
     kw1 = hkeys.shape[0]
     H = h_cap
     TXN, RR, WR = txn_cap, rr_cap, wr_cap
@@ -1133,11 +1133,9 @@ class JaxConflictSet:
         self.bucket_mins = bucket_mins
         # Eviction cadence (perf experiment; 1 = every batch, the default
         # semantics).  >1 needs h_cap headroom for the unevicted batches.
-        import os as _os
+        from ..flow.knobs import g_env
 
-        self.evict_every = max(
-            1, int(_os.environ.get("FDB_TPU_EVICT_EVERY", "1"))
-        )
+        self.evict_every = max(1, g_env.get_int("FDB_TPU_EVICT_EVERY"))
         self._batches_since_evict = 0
         # Two-tier history (FDB_TPU_HISTORY=tiered): per-batch work runs at
         # delta size; a major compaction folds the delta into the base when
@@ -1146,15 +1144,15 @@ class JaxConflictSet:
         # fill-triggered only).  Decision-identical to the flat engine —
         # gated by the differential suites under the flag — and the default
         # compile is untouched when the flag is unset (separate jit entry).
-        self.history_mode = _os.environ.get("FDB_TPU_HISTORY", "")
+        self.history_mode = g_env.get("FDB_TPU_HISTORY")
         self.tiered = self.history_mode == "tiered"
         self.compact_every = 0
         self.d_cap = 0
         if self.tiered:
             self.compact_every = self.evict_every if self.evict_every > 1 else 0
-            dc_env = int(_os.environ.get("FDB_TPU_DELTA_CAP", "0"))
+            dc_env = g_env.get_int("FDB_TPU_DELTA_CAP")
             self.d_cap = max(64, dc_env if dc_env > 0 else self.h_cap // 8)
-            if _os.environ.get("FDB_TPU_ABLATE"):
+            if g_env.get("FDB_TPU_ABLATE"):
                 # Fail FAST: the ablation seams only exist in the flat
                 # step; silently ignoring the knob would make an in-step
                 # attribution run under the tiered flag report that a

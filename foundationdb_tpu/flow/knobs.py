@@ -9,6 +9,8 @@ declared (grown as subsystems land).
 
 from __future__ import annotations
 
+import os
+
 
 class Knobs:
     """Attribute-style knobs with string override (set_knob("name", "1.5"))."""
@@ -166,3 +168,71 @@ class KnobSet:
 
 
 g_knobs = KnobSet()
+
+
+class EnvFlags:
+    """FDB_TPU_* process-environment flags, the registry ENV001 enforces.
+
+    Unlike knobs (typed runtime config, overridable per test), env flags
+    select process-wide BUILD/ENGINE variants read at import or
+    engine-construction time (codec backend, search strategy, history
+    layout).  Scattered ``os.environ.get("FDB_TPU_...")`` reads are how
+    config drift happens — a flag renamed in one module keeps silently
+    defaulting in another — so every flag is declared here once, with its
+    default and meaning, and every read goes through ``g_env``; fdblint's
+    ENV001 rejects FDB_TPU_* environment reads anywhere else.
+
+    ``g_env.get()`` consults ``os.environ`` at CALL time; whether a flag
+    is live or frozen is decided by where its one call site sits, exactly
+    as the raw read it replaced: the engine flags (``FDB_TPU_HISTORY``,
+    ``FDB_TPU_DELTA_CAP``, ``FDB_TPU_EVICT_EVERY``, ``FDB_TPU_ABLATE``)
+    are read at engine construction, so monkeypatching the environment
+    before building an engine works — while ``FDB_TPU_WIRE_PY``
+    (rpc/wire.py) and ``FDB_TPU_SEARCH``/``FDB_TPU_SEARCH_STRIDE``
+    (ops/rangequery.py) are module-level process configuration frozen at
+    first import; override those before the module loads (subprocess
+    env, as tests/test_engine_experiments.py does)."""
+
+    def __init__(self):
+        self._decl: dict[str, tuple[str, str]] = {}
+
+    def declare(self, name: str, default: str = "", help: str = ""):
+        if not name.startswith("FDB_TPU_"):
+            raise ValueError(f"env flags are FDB_TPU_*-namespaced: {name}")
+        self._decl[name] = (default, help)
+
+    def get(self, name: str) -> str:
+        """Current value (environment over declared default).  Undeclared
+        names raise: an ad-hoc flag must be registered first."""
+        if name not in self._decl:
+            raise KeyError(f"undeclared env flag {name} (declare it here)")
+        return os.environ.get(name, self._decl[name][0])
+
+    def get_int(self, name: str) -> int:
+        return int(self.get(name))
+
+    def declared(self) -> dict:
+        """name -> (default, help) for docs/status enumeration."""
+        return dict(self._decl)
+
+
+g_env = EnvFlags()
+g_env.declare("FDB_TPU_WIRE_PY", "",
+              help="truthy: force the pure-Python wire codec (A/B baselines, "
+                   "debugging); default uses the C codec when loadable")
+g_env.declare("FDB_TPU_SEARCH", "",
+              help="rangequery search strategy: '' flat binary search, "
+                   "'2level' coarse sampled-table bracket then fine steps")
+g_env.declare("FDB_TPU_SEARCH_STRIDE", "512",
+              help="2level search: columns per coarse-table sample")
+g_env.declare("FDB_TPU_ABLATE", "",
+              help="comma list of conflict-kernel ablations (perf "
+                   "experiments; engine asserts the combination is legal)")
+g_env.declare("FDB_TPU_HISTORY", "",
+              help="conflict-history layout: '' flat, 'tiered' frozen base "
+                   "+ delta tier with major compactions (PR 4)")
+g_env.declare("FDB_TPU_DELTA_CAP", "0",
+              help="tiered history: delta-tier capacity (0 = h_cap/8)")
+g_env.declare("FDB_TPU_EVICT_EVERY", "1",
+              help="evict cadence in batches; in tiered mode the alias "
+                   "for major-compaction cadence")

@@ -50,7 +50,7 @@ def lex_leq(a: jnp.ndarray, b: jnp.ndarray) -> jnp.ndarray:
     return leq
 
 
-import os as _os
+from ..flow.knobs import g_env
 
 # Search strategy for big tables (perf experiment; decisions identical):
 #   ""        flat binary search (default)
@@ -58,8 +58,8 @@ import os as _os
 #             table (one column per SAMPLE_STRIDE) is small enough for the
 #             compiler to keep on-chip, so only the fine log2(stride)
 #             steps gather from the full HBM-resident table.
-SEARCH_MODE = _os.environ.get("FDB_TPU_SEARCH", "")
-SAMPLE_STRIDE = int(_os.environ.get("FDB_TPU_SEARCH_STRIDE", "512"))
+SEARCH_MODE = g_env.get("FDB_TPU_SEARCH")
+SAMPLE_STRIDE = g_env.get_int("FDB_TPU_SEARCH_STRIDE")
 _2LEVEL_MIN = 1 << 16  # below this a flat search wins (coarse build cost)
 
 

@@ -261,13 +261,13 @@ def encode_frame_py(value: Any) -> bytes:
 # the Python reference (tests/test_wire.py).  Loaded lazily with the
 # registry; registry growth (late register_struct) re-configures it.
 
-import os as _os
+from ..flow.knobs import g_env
 
 _c_mod = None
 _c_stamp = -1
 # Process configuration, read once: set FDB_TPU_WIRE_PY=1 to force the
 # pure-Python codec (A/B baselines, debugging).
-_C_DISABLED = bool(_os.environ.get("FDB_TPU_WIRE_PY"))
+_C_DISABLED = bool(g_env.get("FDB_TPU_WIRE_PY"))
 
 
 class _CFallbackSignal(Exception):

@@ -1158,7 +1158,11 @@ class ClusterController:
             if getattr(self, "_config_stale", False):
                 self._config_stale = False
                 return  # back to _run -> recovery with the new topology
-            for role, addr in self._role_addrs.items():
+            # Snapshot: the role table is rebuilt by a concurrent recovery
+            # while this watcher parks on role_check below — iterating the
+            # live dict across those awaits dies with "changed size during
+            # iteration" instead of returning into the new generation.
+            for role, addr in list(self._role_addrs.items()):
                 wi = self.workers.get(addr)
                 if wi is None:
                     TraceEvent("RoleWorkerLost").detail("role", role).log()

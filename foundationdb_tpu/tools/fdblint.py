@@ -1,853 +1,49 @@
-"""fdblint: AST-based determinism & actor-hygiene analyzer.
+"""fdblint CLI shim — the analyzer now lives in the lint/ package.
 
-The reference's actor compiler is not just a code generator — it is a static
-gate: every ``.actor.cpp`` file is rewritten and patterns that would break
-replayable simulation are rejected at build time.  The Python rebuild has no
-compile step, so this analyzer fills the role: it walks the package's ASTs
-and rejects constructs that silently destroy the one property the whole test
-strategy rests on — that a simulation run is bit-reproducible from its seed
-(SURVEY.md §5; README "Determinism").
+Grown in ISSUE 5 from an 853-line single module into a multi-pass
+analysis package (``foundationdb_tpu/tools/lint/``): project loader with
+a per-file AST cache, module-graph + call-graph builder, and per-rule
+passes (the WAIT state-across-await rules, interprocedural DET101 taint,
+RPY001 reply-promise paths, ENV001 env-flag drift — on top of the
+original DET/ACT/JAX/IO/TRC/ERR families).  This module re-exports the
+public API verbatim so the existing gate (``pytest -m lint``), pragma
+syntax, allowlist config and ``python -m foundationdb_tpu.tools.fdblint``
+entry point all keep working.  See ``lint/__init__.py`` for the layout
+and README "Determinism rules" for the rule table."""
 
-Rules
------
-DET001  wall-clock read (``time.time``/``monotonic``/``perf_counter``/
-        ``sleep``, ``datetime.now``, ...) in simulator-executed code.  Use
-        ``loop.now()`` / ``loop.delay()``: virtual time is the only clock
-        actors may observe (ref: INetwork::now, flow/network.h).
-DET002  global entropy (the ``random`` module, ``os.urandom``,
-        ``uuid.uuid4``, ``secrets``) in simulator-executed code.  Use the
-        loop's ``DeterministicRandom`` (``flow/rng.py``), the analog of
-        g_random (flow/DeterministicRandom.h): every random decision must
-        replay from the seed.
-DET003  ``threading`` / ``asyncio`` / ``multiprocessing`` primitives in
-        simulator-executed code.  The simulator is one cooperative thread
-        (the reference's one-network-thread rule); OS-scheduled concurrency
-        makes event order irreproducible.
-ACT001  actor-coroutine call whose result is neither awaited nor handed to
-        a spawn API: the statement ``self._run()`` creates a coroutine
-        object and drops it — the actor never executes (the analog of
-        discarding an ``ACTOR`` Future, which the actor compiler makes
-        impossible to do silently).
-JAX001  host synchronization or Python side effects (``.item()``,
-        ``.tolist()``, ``float()``/``int()``/``bool()``, ``print``, host
-        ``numpy`` calls, ``global`` mutation) inside a ``@jax.jit``-traced
-        function.  These either fail at trace time, silently bake a traced
-        value into the compiled graph, or force a device sync per call.
-IO001   direct ``open()`` / ``socket`` use outside the real backends
-        (``fileio/realfile.py``, ``fileio/blobstore.py``,
-        ``rpc/real_network.py``, ``tools/``).  Simulated code does I/O
-        through ``SimFileSystem`` / ``SimNetwork`` so faults are injectable
-        and replayable.
-TRC001  a ``TraceEvent(...)`` built as a bare statement but never
-        ``.log()``ed and not used as a context manager: unlike the
-        reference (destructor emit, flow/Trace.h), the rebuild emits only
-        on ``.log()`` / ``with`` exit, so the event silently never reaches
-        the collector — the trace-layer mirror of ACT001's dropped future.
-        Statement-level like ACT001: ``ev = TraceEvent(...)`` held in a
-        variable is assumed to be logged later by the holder.
-ERR001  a broad ``except`` (bare, ``Exception``, or ``BaseException``)
-        whose handler neither re-raises, nor TraceEvents, nor propagates
-        the error (``send_error``/using the bound exception).  Silent
-        swallowing is how degraded modes go unnoticed: the reference
-        routes every unexpected error through ``Error``/TraceEvent, and
-        the device-fault work (conflict/device_faults.py) depends on
-        faults SURFACING so the breaker can count and route them.  The
-        pragma goes on the ``except`` line itself.
-PRG001  a ``# fdblint: ignore[...]`` pragma with no reason string.  Every
-        suppression must say *why* the rule does not apply.
-PRG002  a pragma that suppresses nothing (stale after a refactor).
+if __package__ in (None, ""):
+    # Script mode (`python path/to/fdblint.py`): there is no parent
+    # package for the relative import below — bootstrap the repo root
+    # and re-dispatch as if `-m foundationdb_tpu.tools.fdblint` ran.
+    import os
+    import sys
 
-Suppression
------------
-Same-line pragma, reason mandatory::
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))))
+    import foundationdb_tpu.tools  # noqa: F401  (parent for the relative import)
 
-    self.t = time.monotonic()  # fdblint: ignore[DET001]: real-mode token bucket; sim leaves rate=None
+    __package__ = "foundationdb_tpu.tools"
 
-Whole modules that are real-deployment components by identity (the real
-network backend, operational tools) are exempted per-rule in the allowlist
-config instead of pragma-spam; see DEFAULT_ALLOW below and ``--config``.
-
-CLI
----
-``python -m foundationdb_tpu.tools.fdblint [path ...] [--format=text|json]
-[--config FILE] [--list-rules]``; exit 0 iff no unsuppressed findings.
-``tests/test_lint.py`` runs this over the whole package as a tier-1 gate.
-"""
-
-from __future__ import annotations
-
-import argparse
-import ast
-import fnmatch
-import io
-import json
-import os
-import re
-import sys
-import tokenize
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Set, Tuple
-
-# ---------------------------------------------------------------------------
-# Rule registry
-# ---------------------------------------------------------------------------
-
-RULES: Dict[str, str] = {
-    "DET001": "wall-clock read in simulator-executed code (use loop.now())",
-    "DET002": "global entropy source (use the loop's DeterministicRandom, flow/rng.py)",
-    "DET003": "threading/asyncio/multiprocessing primitive in simulator-executed code",
-    "ACT001": "actor coroutine called but neither awaited nor spawned (dropped future)",
-    "JAX001": "host sync or Python side effect inside a jit-traced function",
-    "IO001": "direct open()/socket outside the real I/O backends",
-    "TRC001": "TraceEvent constructed but never .log()ed nor used as a context manager (dropped event)",
-    "ERR001": "broad except that neither re-raises, TraceEvents, nor propagates the error (silent swallow)",
-    "PRG001": "fdblint ignore pragma carries no reason string",
-    "PRG002": "fdblint ignore pragma suppresses nothing (stale)",
-}
-
-# Canonical dotted names considered wall-clock reads.  Referencing one as a
-# value (e.g. ``clock = time.monotonic``) is flagged like calling it: binding
-# the function is how wall time gets smuggled past a call-site-only check.
-WALL_CLOCK = {
-    "time.time", "time.time_ns",
-    "time.monotonic", "time.monotonic_ns",
-    "time.perf_counter", "time.perf_counter_ns",
-    "time.process_time", "time.process_time_ns",
-    "time.sleep",
-    "datetime.datetime.now", "datetime.datetime.utcnow",
-    "datetime.datetime.today", "datetime.date.today",
-}
-
-# Entropy: exact names plus whole-module prefixes.
-ENTROPY_EXACT = {"os.urandom", "uuid.uuid1", "uuid.uuid4"}
-ENTROPY_MODULES = {"random", "secrets"}
-
-THREADING_MODULES = {
-    "threading", "_thread", "asyncio", "multiprocessing", "concurrent.futures",
-}
-
-IO_CALLS = {"open", "os.open", "os.fdopen", "io.open"}
-IO_MODULES = {"socket", "ssl"}
-
-# Modules where JAX001 applies (the jit-traced surface of the repo).
-TRACED_MODULE_GLOBS = ("conflict/engine_jax.py", "ops/*.py", "parallel/*.py")
-
-# Attribute calls that force a device->host sync inside a trace.
-JAX_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
-# Builtins that concretize a traced value (or are pure side effects).
-JAX_BAD_BUILTINS = {"print", "breakpoint", "input", "float", "int", "bool"}
-
-# Per-rule allowlist: package-relative posix globs for modules that are
-# real-deployment components by identity, where the rule does not apply.
-# The IO001 set mirrors the rule text: fileio/ real backends +
-# rpc/real_network.py; tools/ are operational programs (fdbcli, fdbmonitor,
-# real_node) that never run under the simulator.
-DEFAULT_ALLOW: Dict[str, Tuple[str, ...]] = {
-    "DET001": (
-        "rpc/real_network.py",   # wall-anchored loop driver IS its purpose
-        "tools/*.py",            # operational programs (fdbcli/fdbmonitor/
-        #                          real_node analogs) never run under sim
-        "utils/procutil.py",     # OS process plumbing
-    ),
-    "DET002": (),
-    "DET003": (
-        "rpc/real_network.py",
-        "fileio/blobstore.py",   # threaded blocking-socket client/server
-        "fileio/realfile.py",
-        "flow/profiler.py",      # sampling thread = the SIGPROF analog
-        "tools/*.py",
-        "utils/procutil.py",
-    ),
-    "ACT001": (),
-    "JAX001": (),
-    "TRC001": (),
-    "ERR001": (
-        "rpc/real_network.py",   # teardown paths on real sockets: close()
-        #                          best-effort by design
-        "tools/*.py",            # operational programs, not sim-executed
-        "utils/procutil.py",     # post-fork/pre-exec: may not even print
-    ),
-    "IO001": (
-        "fileio/realfile.py",
-        "fileio/blobstore.py",
-        "rpc/real_network.py",
-        "tools/*.py",
-        "utils/procutil.py",
-    ),
-}
-
-# The linter's own modules are not simulator-executed.
-SKIP_MODULE_GLOBS = ("tools/fdblint.py",)
-
-
-def _match_any(relpath: str, globs) -> bool:
-    """Glob match against the relpath or any of its trailing sub-paths, so
-    'rpc/real_network.py' matches whether the scan root was the package dir
-    (relpath 'rpc/real_network.py') or an ancestor (relpath
-    'foundationdb_tpu/rpc/real_network.py', the single-file CLI mode)."""
-    parts = relpath.split("/")
-    tails = ["/".join(parts[i:]) for i in range(len(parts))]
-    return any(fnmatch.fnmatch(t, g) for t in tails for g in globs)
-
-
-@dataclass
-class Finding:
-    rule: str
-    path: str          # package-relative posix path
-    line: int
-    col: int
-    message: str
-    suppressed: bool = False
-    reason: str = ""   # pragma reason when suppressed
-    end_line: int = 0  # last physical line of the flagged node (pragma scope)
-
-    def format(self) -> str:
-        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
-
-    def to_dict(self) -> dict:
-        return {
-            "rule": self.rule, "path": self.path, "line": self.line,
-            "col": self.col, "message": self.message,
-            "suppressed": self.suppressed, "reason": self.reason,
-        }
-
-
-@dataclass
-class LintConfig:
-    allow: Dict[str, Tuple[str, ...]] = field(
-        default_factory=lambda: {k: tuple(v) for k, v in DEFAULT_ALLOW.items()}
-    )
-
-    @classmethod
-    def load(cls, path: str, use_defaults: bool = True) -> "LintConfig":
-        """JSON config {"allow": {"RULE": ["glob", ...]}}, merged over (or
-        replacing, with use_defaults=False) the built-in allowlist."""
-        with open(path, "r", encoding="utf-8") as f:  # fdblint: ignore[IO001]: linter config read; the linter never runs under the simulator
-            raw = json.load(f)
-        base: Dict[str, Tuple[str, ...]] = (
-            {k: tuple(v) for k, v in DEFAULT_ALLOW.items()} if use_defaults else {}
-        )
-        for rule, globs in raw.get("allow", {}).items():
-            if rule not in RULES:
-                raise ValueError(f"config allowlists unknown rule {rule!r}")
-            base[rule] = tuple(base.get(rule, ())) + tuple(globs)
-        return cls(allow=base)
-
-    def allows(self, rule: str, relpath: str) -> bool:
-        return _match_any(relpath, self.allow.get(rule, ()))
-
-
-# ---------------------------------------------------------------------------
-# Pragmas
-# ---------------------------------------------------------------------------
-
-_PRAGMA_RE = re.compile(
-    r"#\s*fdblint:\s*ignore\[(?P<rules>[A-Z0-9,\s]+)\](?:\s*:\s*(?P<reason>.*\S))?"
+from .lint import (  # noqa: F401
+    DEFAULT_ALLOW,
+    Finding,
+    LintConfig,
+    Pragma,
+    Project,
+    RULES,
+    count_by_rule,
+    default_cache_path,
+    format_counts,
+    iter_py_files,
+    lint_file,
+    lint_package,
+    lint_source,
+    main,
+    parse_pragmas,
+    to_sarif,
 )
 
-
-@dataclass
-class Pragma:
-    line: int
-    rules: Set[str]
-    reason: str
-    used: bool = False
-
-
-def parse_pragmas(source: str) -> Dict[int, Pragma]:
-    """Pragmas from REAL comment tokens only: a pragma example quoted in a
-    docstring or string literal must not register (it would then be
-    reported as stale PRG002 with no way to appease it)."""
-    pragmas: Dict[int, Pragma] = {}
-    for tok in tokenize.generate_tokens(io.StringIO(source).readline):
-        if tok.type != tokenize.COMMENT:
-            continue
-        m = _PRAGMA_RE.search(tok.string)
-        if not m:
-            continue
-        line = tok.start[0]
-        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
-        pragmas[line] = Pragma(line, rules, (m.group("reason") or "").strip())
-    return pragmas
-
-
-# ---------------------------------------------------------------------------
-# Symbol resolution: map names/attribute chains to canonical dotted paths
-# ---------------------------------------------------------------------------
-
-
-class _Aliases:
-    """Tracks module-level import bindings so ``t.monotonic`` resolves to
-    ``time.monotonic`` regardless of aliasing.  Function-local imports are
-    folded into the same table — a rename collision between scopes could in
-    principle misattribute, which for a linter errs on the loud side."""
-
-    def __init__(self):
-        self.map: Dict[str, str] = {}
-
-    def add_import(self, node: ast.Import):
-        for a in node.names:
-            self.map[a.asname or a.name.split(".")[0]] = (
-                a.name if a.asname else a.name.split(".")[0]
-            )
-
-    def add_import_from(self, node: ast.ImportFrom):
-        if node.module is None or node.level:
-            return  # relative import: package-internal, never a stdlib clock
-        for a in node.names:
-            if a.name == "*":
-                continue
-            self.map[a.asname or a.name] = f"{node.module}.{a.name}"
-
-    def resolve(self, node: ast.AST) -> Optional[str]:
-        """Dotted canonical path for a Name/Attribute chain, or None."""
-        parts: List[str] = []
-        while isinstance(node, ast.Attribute):
-            parts.append(node.attr)
-            node = node.value
-        if not isinstance(node, ast.Name):
-            return None
-        root = self.map.get(node.id, node.id)
-        return ".".join([root] + list(reversed(parts)))
-
-    def root_bound(self, node: ast.AST) -> bool:
-        """True iff the chain's root name is an import binding.  A local
-        variable that merely *shares* a module name (e.g. a parameter
-        named `random` holding a DeterministicRandom — this repo's core
-        idiom) must not light up module-prefix rules."""
-        while isinstance(node, ast.Attribute):
-            node = node.value
-        return isinstance(node, ast.Name) and node.id in self.map
-
-
-# ---------------------------------------------------------------------------
-# The analyzer
-# ---------------------------------------------------------------------------
-
-
-class ModuleLinter(ast.NodeVisitor):
-    def __init__(self, relpath: str, tree: ast.Module, config: LintConfig):
-        self.relpath = relpath
-        self.tree = tree
-        self.config = config
-        self.aliases = _Aliases()
-        self.findings: List[Finding] = []
-        # ACT001 name scoping: a bare `foo()` statement only matches module-
-        # level async functions; `self.foo()` / `cls.foo()` only async
-        # methods of the ENCLOSING class (per-class spans below).  Matching
-        # any attribute call by name alone drowns real bugs in collisions
-        # with generic names (`set`, `sync`) on unrelated objects, and a
-        # module-wide method set would still cross-fire between classes.
-        self.async_funcs: Set[str] = set()
-        # (class start line, class end line, async method names) per class
-        self.class_spans: List[Tuple[int, int, Set[str]]] = []
-        self.traced = _match_any(relpath, TRACED_MODULE_GLOBS)
-        # Simple-statement line spans: a pragma anywhere on the physical
-        # lines of the statement containing a flagged expression counts
-        # (multi-line expressions put the node's lineno above the spot
-        # where a trailing comment can live).
-        self.stmt_spans: List[Tuple[int, int]] = []
-        # Names of functions that are jit-traced (decorated, jax.jit(f),
-        # partial(jax.jit, ...)(f), or handed to shard_map).
-        self.jitted_names: Set[str] = set()
-        # Line spans of jitted function bodies (incl. nested defs).
-        self.jitted_spans: List[Tuple[int, int]] = []
-
-    # -- emit --
-    _SIMPLE_STMTS = (
-        ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr, ast.Return,
-        ast.Import, ast.ImportFrom, ast.Raise, ast.Assert, ast.Delete,
-        ast.Global, ast.Nonlocal,
-    )
-
-    def flag(self, rule: str, node: ast.AST, message: str,
-             end_line: Optional[int] = None):
-        if self.config.allows(rule, self.relpath):
-            return
-        if end_line is not None:
-            # Caller pinned the pragma scope (ERR001: the `except` line
-            # only — its node span covers the whole handler body, which
-            # must not become one giant suppression region).
-            end = end_line
-        else:
-            # Pragma scope: through the end of the innermost SIMPLE
-            # statement containing the node (never a compound statement —
-            # a def/if body must not become one giant suppression
-            # region).  Falls back to the node's own span for nodes
-            # outside any simple statement (decorators, if/while tests).
-            end = getattr(node, "end_lineno", None) or node.lineno
-            best = None
-            for s, e in self.stmt_spans:
-                if s <= node.lineno <= e:
-                    if best is None or s > best[0] or (s == best[0] and e < best[1]):
-                        best = (s, e)
-            if best is not None:
-                end = max(end, best[1])
-        self.findings.append(
-            Finding(rule, self.relpath, node.lineno, node.col_offset, message,
-                    end_line=end)
-        )
-
-    # -- prepass: aliases, async defs, jitted functions --
-    def prepass(self):
-        for node in ast.walk(self.tree):
-            if isinstance(node, ast.Import):
-                self.aliases.add_import(node)
-            elif isinstance(node, ast.ImportFrom):
-                self.aliases.add_import_from(node)
-            if isinstance(node, self._SIMPLE_STMTS):
-                self.stmt_spans.append(
-                    (node.lineno, node.end_lineno or node.lineno)
-                )
-        self._collect_async_defs(self.tree, in_class=False)
-        if self.traced:
-            self._collect_jitted()
-
-    def _collect_async_defs(self, node: ast.AST, in_class: bool):
-        for child in ast.iter_child_nodes(node):
-            if isinstance(child, ast.AsyncFunctionDef):
-                if not in_class:
-                    self.async_funcs.add(child.name)
-                self._collect_async_defs(child, in_class=False)
-            elif isinstance(child, ast.ClassDef):
-                names = {
-                    m.name for m in child.body
-                    if isinstance(m, ast.AsyncFunctionDef)
-                }
-                self.class_spans.append(
-                    (child.lineno, child.end_lineno or child.lineno, names)
-                )
-                self._collect_async_defs(child, in_class=True)
-            else:
-                self._collect_async_defs(child, in_class=in_class)
-
-    def _enclosing_class_async_methods(self, lineno: int) -> Set[str]:
-        """Async method names of the innermost class containing lineno."""
-        best = None
-        for start, end, names in self.class_spans:
-            if start <= lineno <= end and (best is None or start > best[0]):
-                best = (start, names)
-        return best[1] if best else set()
-
-    def _is_jit(self, node: ast.AST) -> bool:
-        path = self.aliases.resolve(node)
-        return path is not None and (path == "jit" or path.endswith(".jit"))
-
-    def _jit_target_name(self, call: ast.Call) -> Optional[str]:
-        """Name of the function a jit/shard_map call wraps, unwrapping one
-        level of functools.partial around the target."""
-        if not call.args:
-            return None
-        target = call.args[0]
-        if isinstance(target, ast.Call):
-            fn = self.aliases.resolve(target.func)
-            if fn in ("partial", "functools.partial") and target.args:
-                target = target.args[0]
-        if isinstance(target, ast.Name):
-            return target.id
-        return None
-
-    def _collect_jitted(self):
-        for node in ast.walk(self.tree):
-            # @jit / @jax.jit / @partial(jax.jit, ...)
-            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                for dec in node.decorator_list:
-                    if self._is_jit(dec):
-                        self.jitted_names.add(node.name)
-                    elif isinstance(dec, ast.Call):
-                        fn = self.aliases.resolve(dec.func)
-                        if self._is_jit(dec.func) or (
-                            fn in ("partial", "functools.partial")
-                            and dec.args
-                            and self._is_jit(dec.args[0])
-                        ):
-                            self.jitted_names.add(node.name)
-            elif isinstance(node, ast.Call):
-                fn_path = self.aliases.resolve(node.func)
-                # jax.jit(step, ...) / shard_map(body, ...)
-                if self._is_jit(node.func) or (
-                    fn_path is not None
-                    and (fn_path == "shard_map" or fn_path.endswith(".shard_map"))
-                ):
-                    name = self._jit_target_name(node)
-                    if name:
-                        self.jitted_names.add(name)
-                # partial(jax.jit, ...)(detect_core)
-                elif (
-                    isinstance(node.func, ast.Call)
-                    and self.aliases.resolve(node.func.func)
-                    in ("partial", "functools.partial")
-                    and node.func.args
-                    and self._is_jit(node.func.args[0])
-                ):
-                    name = self._jit_target_name(node)
-                    if name:
-                        self.jitted_names.add(name)
-        # Body spans: a def whose name is jitted, anywhere in the module
-        # (nested defs inside a jitted body fall inside its span).
-        for node in ast.walk(self.tree):
-            if (
-                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and node.name in self.jitted_names
-            ):
-                self.jitted_spans.append((node.lineno, node.end_lineno or node.lineno))
-
-    def _in_jitted(self, node: ast.AST) -> bool:
-        ln = getattr(node, "lineno", None)
-        return ln is not None and any(a <= ln <= b for a, b in self.jitted_spans)
-
-    # -- visitors --
-    def visit_Import(self, node: ast.Import):
-        for a in node.names:
-            top = a.name.split(".")[0]
-            full = a.name
-            if top in ENTROPY_MODULES:
-                self.flag("DET002", node, f"import of entropy module '{a.name}'")
-            if top in THREADING_MODULES or full in THREADING_MODULES:
-                self.flag("DET003", node, f"import of '{a.name}'")
-            if top in IO_MODULES:
-                self.flag("IO001", node, f"import of '{a.name}'")
-        self.generic_visit(node)
-
-    def visit_ImportFrom(self, node: ast.ImportFrom):
-        if node.module is not None and not node.level:
-            top = node.module.split(".")[0]
-            if top in ENTROPY_MODULES:
-                self.flag("DET002", node, f"import from entropy module '{node.module}'")
-            if top in THREADING_MODULES or node.module in THREADING_MODULES:
-                self.flag("DET003", node, f"import from '{node.module}'")
-            if top in IO_MODULES:
-                self.flag("IO001", node, f"import from '{node.module}'")
-            for a in node.names:
-                if f"{node.module}.{a.name}" in WALL_CLOCK:
-                    self.flag(
-                        "DET001", node,
-                        f"import of wall-clock '{node.module}.{a.name}'",
-                    )
-        self.generic_visit(node)
-
-    def _check_path_reference(self, node: ast.AST, path: str):
-        if path in WALL_CLOCK:
-            self.flag("DET001", node, f"wall-clock '{path}'")
-        elif path in ENTROPY_EXACT or path.split(".")[0] in ENTROPY_MODULES:
-            self.flag("DET002", node, f"entropy source '{path}'")
-
-    def visit_Attribute(self, node: ast.Attribute):
-        # Attribute *references* (called or not) to wall clocks / entropy —
-        # only chains rooted at an actual import binding (see root_bound).
-        path = self.aliases.resolve(node)
-        if path is not None:
-            # Pure Name/Attribute chain: check it once, don't recurse
-            # (recursing would re-report each prefix of a.b.c).
-            if self.aliases.root_bound(node):
-                self._check_path_reference(node, path)
-        else:
-            # Chain contains calls/subscripts — keep walking to reach them.
-            self.generic_visit(node)
-
-    def visit_Name(self, node: ast.Name):
-        # A bare name bound by `from time import monotonic` style imports.
-        path = self.aliases.resolve(node)
-        if path is not None and path != node.id and self.aliases.root_bound(node):
-            self._check_path_reference(node, path)
-
-    def visit_Call(self, node: ast.Call):
-        path = self.aliases.resolve(node.func)
-        if path is not None and path in IO_CALLS and (
-            path == "open" or self.aliases.root_bound(node.func)
-        ):
-            self.flag("IO001", node, f"direct '{path}()' call")
-        if self._in_jitted(node):
-            self._check_jax_call(node, path)
-        self.generic_visit(node)
-
-    def _check_jax_call(self, node: ast.Call, path: Optional[str]):
-        if isinstance(node.func, ast.Name) and node.func.id in JAX_BAD_BUILTINS:
-            self.flag(
-                "JAX001", node,
-                f"'{node.func.id}()' inside a jit-traced function "
-                f"(host sync / trace-time side effect)",
-            )
-        elif (
-            isinstance(node.func, ast.Attribute)
-            and node.func.attr in JAX_SYNC_METHODS
-        ):
-            self.flag(
-                "JAX001", node,
-                f"'.{node.func.attr}()' forces device sync inside a "
-                f"jit-traced function",
-            )
-        elif (
-            path is not None
-            and path.split(".")[0] in ("numpy", "np")
-            and self.aliases.root_bound(node.func)
-        ):
-            self.flag(
-                "JAX001", node,
-                f"host numpy call '{path}' inside a jit-traced function",
-            )
-
-    # -- ERR001: silent broad excepts --
-    _BROAD_EXC = {"Exception", "BaseException",
-                  "builtins.Exception", "builtins.BaseException"}
-
-    def _is_broad_except(self, t: Optional[ast.AST]) -> bool:
-        if t is None:
-            return True  # bare `except:`
-        if isinstance(t, ast.Tuple):
-            return any(self._is_broad_except(e) for e in t.elts)
-        return self.aliases.resolve(t) in self._BROAD_EXC
-
-    def _handler_surfaces_error(self, node: ast.excepthandler) -> bool:
-        """True when the handler visibly deals with the error: re-raises
-        (anywhere in its body, incl. nested cleanup), TraceEvents it,
-        forwards it via send_error, or reads the bound exception name
-        (passing it on IS handling; what ERR001 hunts is the error
-        vanishing without a trace)."""
-        for stmt in node.body:
-            for n in ast.walk(stmt):
-                if isinstance(n, ast.Raise):
-                    return True
-                if (
-                    node.name
-                    and isinstance(n, ast.Name)
-                    and n.id == node.name
-                ):
-                    return True
-                if isinstance(n, ast.Call):
-                    if (
-                        isinstance(n.func, ast.Attribute)
-                        and n.func.attr == "send_error"
-                    ):
-                        return True
-                    path = self.aliases.resolve(n.func)
-                    if path is not None and path.split(".")[-1] == "TraceEvent":
-                        return True
-        return False
-
-    def visit_ExceptHandler(self, node: ast.ExceptHandler):
-        if self._is_broad_except(node.type) and not self._handler_surfaces_error(node):
-            caught = "except:" if node.type is None else (
-                f"except {self.aliases.resolve(node.type) or '...'}"
-            )
-            self.flag(
-                "ERR001", node,
-                f"'{caught}' swallows errors silently "
-                f"(re-raise, TraceEvent, or propagate the error)",
-                end_line=node.lineno,
-            )
-        self.generic_visit(node)
-
-    def visit_Global(self, node: ast.Global):
-        if self._in_jitted(node):
-            self.flag(
-                "JAX001", node,
-                f"global mutation of {', '.join(node.names)} inside a "
-                f"jit-traced function",
-            )
-        self.generic_visit(node)
-
-    def visit_Expr(self, node: ast.Expr):
-        # ACT001: statement-level call of a module-local async def whose
-        # coroutine object is dropped on the floor.
-        v = node.value
-        if isinstance(v, ast.Call):
-            dropped = None
-            if isinstance(v.func, ast.Name) and v.func.id in self.async_funcs:
-                dropped = v.func.id
-            elif (
-                isinstance(v.func, ast.Attribute)
-                and isinstance(v.func.value, ast.Name)
-                and v.func.value.id in ("self", "cls")
-                and v.func.attr
-                in self._enclosing_class_async_methods(node.lineno)
-            ):
-                dropped = v.func.attr
-            if dropped is not None:
-                self.flag(
-                    "ACT001", node,
-                    f"coroutine '{dropped}()' is neither awaited nor spawned "
-                    f"(dropped actor)",
-                )
-            self._check_dropped_trace_event(node, v)
-        self.generic_visit(node)
-
-    def _check_dropped_trace_event(self, stmt: ast.Expr, call: ast.Call):
-        """TRC001: a statement-level TraceEvent(...) builder chain whose
-        outermost call is not .log() — the event is constructed, detailed,
-        and dropped (the rebuild has no destructor emit)."""
-        methods: List[str] = []
-        c: ast.AST = call
-        while isinstance(c, ast.Call):
-            # The root constructor call: its func is a pure Name/Attribute
-            # chain resolving to TraceEvent (bare, aliased, or module-
-            # qualified); builder methods between it and the statement are
-            # Attribute hops over inner Calls, collected in `methods`.
-            path = self.aliases.resolve(c.func)
-            if path is not None and path.split(".")[-1] == "TraceEvent":
-                if "log" not in methods:
-                    self.flag(
-                        "TRC001", stmt,
-                        "TraceEvent built but never .log()ed nor used as "
-                        "a context manager (dropped event)",
-                    )
-                return
-            if not isinstance(c.func, ast.Attribute):
-                return
-            methods.append(c.func.attr)
-            c = c.func.value
-
-    def run(self) -> List[Finding]:
-        self.prepass()
-        self.visit(self.tree)
-        return self.findings
-
-
-# ---------------------------------------------------------------------------
-# Driver
-# ---------------------------------------------------------------------------
-
-
-def lint_source(
-    source: str, relpath: str, config: Optional[LintConfig] = None
-) -> List[Finding]:
-    """Lint one module's source; findings suppressed by same-line pragmas
-    are returned with suppressed=True.  PRG001/PRG002 police the pragmas
-    themselves and are never suppressible."""
-    config = config or LintConfig()
-    if _match_any(relpath, SKIP_MODULE_GLOBS):
-        return []
-    tree = ast.parse(source, filename=relpath)
-    findings = ModuleLinter(relpath, tree, config).run()
-    pragmas = parse_pragmas(source)
-    out: List[Finding] = []
-    for f in findings:
-        # A pragma anywhere on the flagged statement's physical lines
-        # suppresses it (a multi-line expression puts the node's lineno on
-        # a different line than the trailing comment).
-        for ln in range(f.line, max(f.end_line, f.line) + 1):
-            p = pragmas.get(ln)
-            if p is not None and f.rule in p.rules:
-                p.used = True
-                f.suppressed = True
-                f.reason = p.reason
-                break
-        out.append(f)
-    for p in pragmas.values():
-        unknown = p.rules - set(RULES)
-        if unknown:
-            out.append(Finding(
-                "PRG002", relpath, p.line, 0,
-                f"pragma names unknown rule(s) {sorted(unknown)}",
-            ))
-        if not p.reason:
-            out.append(Finding(
-                "PRG001", relpath, p.line, 0,
-                "ignore pragma carries no reason (append ': why')",
-            ))
-        if not p.used and not unknown:
-            out.append(Finding(
-                "PRG002", relpath, p.line, 0,
-                f"pragma for {sorted(p.rules)} suppresses nothing here",
-            ))
-    out.sort(key=lambda f: (f.path, f.line, f.rule))
-    return out
-
-
-def lint_file(
-    path: str, root: str, config: Optional[LintConfig] = None
-) -> List[Finding]:
-    relpath = os.path.relpath(path, root).replace(os.sep, "/")
-    with open(path, "r", encoding="utf-8") as f:  # fdblint: ignore[IO001]: the linter reads the sources it checks; never simulator-executed
-        source = f.read()
-    return lint_source(source, relpath, config)
-
-
-def iter_py_files(root: str):
-    for dirpath, dirnames, filenames in os.walk(root):
-        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
-        for fn in sorted(filenames):
-            if fn.endswith(".py"):
-                yield os.path.join(dirpath, fn)
-
-
-def lint_package(
-    root: str, config: Optional[LintConfig] = None
-) -> List[Finding]:
-    """Lint every .py under root (root is the package directory; paths in
-    findings are relative to it).  A single .py file is reported relative
-    to its outermost enclosing package, so that allowlist / traced-module
-    globs like 'rpc/real_network.py' keep matching (via _match_any's
-    trailing-sub-path semantics) in single-file mode."""
-    findings: List[Finding] = []
-    if os.path.isfile(root):
-        base = os.path.dirname(os.path.abspath(root))
-        while os.path.exists(os.path.join(base, "__init__.py")):
-            base = os.path.dirname(base)
-        return lint_file(root, base, config)
-    for path in iter_py_files(root):
-        findings.extend(lint_file(path, root, config))
-    return findings
-
-
-def main(argv: Optional[List[str]] = None) -> int:
-    ap = argparse.ArgumentParser(
-        prog="fdblint",
-        description="AST-based determinism & actor-hygiene analyzer "
-                    "(the actor compiler's static-gate role).",
-    )
-    ap.add_argument("paths", nargs="*", default=None,
-                    help="package dirs or .py files (default: foundationdb_tpu)")
-    ap.add_argument("--format", choices=("text", "json"), default="text")
-    ap.add_argument("--config", help="JSON allowlist config to merge over defaults")
-    ap.add_argument("--no-default-config", action="store_true",
-                    help="ignore the built-in allowlist")
-    ap.add_argument("--show-suppressed", action="store_true",
-                    help="also print pragma-suppressed findings")
-    ap.add_argument("--list-rules", action="store_true")
-    args = ap.parse_args(argv)
-
-    if args.list_rules:
-        for rule, desc in RULES.items():
-            print(f"{rule}  {desc}")
-        return 0
-
-    if args.config:
-        config = LintConfig.load(args.config, use_defaults=not args.no_default_config)
-    elif args.no_default_config:
-        config = LintConfig(allow={})
-    else:
-        config = LintConfig()
-
-    paths = args.paths or [
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    ]
-    findings: List[Finding] = []
-    for p in paths:
-        findings.extend(lint_package(p, config))
-
-    unsuppressed = [f for f in findings if not f.suppressed]
-    shown = findings if args.show_suppressed else unsuppressed
-    if args.format == "json":
-        print(json.dumps(
-            {
-                "findings": [f.to_dict() for f in shown],
-                "total": len(findings),
-                "unsuppressed": len(unsuppressed),
-            },
-            indent=2,
-        ))
-    else:
-        for f in shown:
-            tag = " (suppressed: %s)" % f.reason if f.suppressed else ""
-            print(f.format() + tag)
-        n_sup = len(findings) - len(unsuppressed)
-        print(
-            f"fdblint: {len(unsuppressed)} finding(s), {n_sup} suppressed",
-            file=sys.stderr,
-        )
-    return 1 if unsuppressed else 0
-
-
 if __name__ == "__main__":
+    import sys
+
     sys.exit(main())

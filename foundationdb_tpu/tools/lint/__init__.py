@@ -1,0 +1,42 @@
+"""fdblint: multi-pass AST determinism & actor-hygiene analysis package.
+
+The reference's actor compiler (flow/actorcompiler/ActorCompiler.cs) is a
+static gate, not just a code generator: it rejects whole bug classes at
+build time — state held across ``wait()``, dropped reply promises,
+wall-clock reads in simulated code.  The Python rebuild has no compile
+step, so this package fills the role over the repo's ASTs, grown from the
+original single-module linter into per-rule passes over a cached project
+model:
+
+  base.py       rule registry, findings, pragmas, allowlist config
+  local.py      single-module rules: DET001-3, ACT001, JAX001, IO001,
+                TRC001, ERR001, ENV001
+  waitrules.py  WAIT001/WAIT002 — state captured/iterated across await
+  rpy.py        RPY001 — reply-promise path analysis (broken-promise hang)
+  graphs.py     module graph + call graph from per-file summaries
+  det101.py     DET101 — interprocedural determinism taint
+  project.py    project loader, per-file AST/mtime cache, orchestration
+  cli.py        text/json/SARIF output, --changed-only git mode
+
+``foundationdb_tpu/tools/fdblint.py`` stays as the CLI shim; the public
+API (lint_source/lint_package/main/RULES/...) is re-exported here so both
+import paths keep working.  See README "Determinism rules" for the rule
+table and pragma grammar."""
+
+from .base import (  # noqa: F401
+    DEFAULT_ALLOW,
+    Finding,
+    LintConfig,
+    Pragma,
+    RULES,
+    parse_pragmas,
+)
+from .cli import count_by_rule, format_counts, main, to_sarif  # noqa: F401
+from .project import (  # noqa: F401
+    Project,
+    default_cache_path,
+    iter_py_files,
+    lint_file,
+    lint_package,
+    lint_source,
+)

@@ -1,0 +1,407 @@
+"""Shared lint infrastructure: rule registry, findings, pragmas, aliases.
+
+The per-rule passes (local.py, waitrules.py, rpy.py, det101.py) all build
+on the primitives here; project.py orchestrates them over a whole scan
+root.  Nothing in this package is simulator-executed (SKIP_MODULE_GLOBS).
+"""
+
+from __future__ import annotations
+
+import ast
+import fnmatch
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Set, Tuple
+
+# ---------------------------------------------------------------------------
+# Rule registry
+# ---------------------------------------------------------------------------
+
+RULES: Dict[str, str] = {
+    "DET001": "wall-clock read in simulator-executed code (use loop.now())",
+    "DET002": "global entropy source (use the loop's DeterministicRandom, flow/rng.py)",
+    "DET003": "threading/asyncio/multiprocessing primitive in simulator-executed code",
+    "DET101": "function reachable from sim-executed code transitively hits wall clock/entropy",
+    "ACT001": "actor coroutine called but neither awaited nor spawned (dropped future)",
+    "JAX001": "host sync or Python side effect inside a jit-traced function",
+    "IO001": "direct open()/socket outside the real I/O backends",
+    "TRC001": "TraceEvent constructed but never .log()ed nor used as a context manager (dropped event)",
+    "ERR001": "broad except that neither re-raises, TraceEvents, nor propagates the error (silent swallow)",
+    "WAIT001": "shared state captured before an await and dereferenced after it without re-read",
+    "WAIT002": "iteration over shared mutable state whose loop body awaits (reference across wait)",
+    "RPY001": "reply promise path that neither sends, errors, nor hands the reply off (broken-promise hang)",
+    "ENV001": "FDB_TPU_* environment flag read outside the flow/knobs.py registry (config drift)",
+    "PRG001": "fdblint ignore pragma carries no reason string",
+    "PRG002": "fdblint ignore pragma suppresses nothing (stale)",
+}
+
+# Canonical dotted names considered wall-clock reads.  Referencing one as a
+# value (e.g. ``clock = time.monotonic``) is flagged like calling it: binding
+# the function is how wall time gets smuggled past a call-site-only check.
+WALL_CLOCK = {
+    "time.time", "time.time_ns",
+    "time.monotonic", "time.monotonic_ns",
+    "time.perf_counter", "time.perf_counter_ns",
+    "time.process_time", "time.process_time_ns",
+    "time.sleep",
+    "datetime.datetime.now", "datetime.datetime.utcnow",
+    "datetime.datetime.today", "datetime.date.today",
+}
+
+# Entropy: exact names plus whole-module prefixes.
+ENTROPY_EXACT = {"os.urandom", "uuid.uuid1", "uuid.uuid4"}
+ENTROPY_MODULES = {"random", "secrets"}
+
+
+def classify_clock_ref(path: str) -> Optional[str]:
+    """'wall' / 'entropy' / None for a canonical dotted path.  THE one
+    classifier behind both DET001/DET002 direct-site flagging (local.py)
+    and DET101 taint sources (graphs.py): a name added or removed here
+    changes both passes together, so a clock can never be flagged at its
+    direct site yet carry no interprocedural taint (or vice versa)."""
+    if path in WALL_CLOCK:
+        return "wall"
+    if path in ENTROPY_EXACT or path.split(".")[0] in ENTROPY_MODULES:
+        return "entropy"
+    return None
+
+
+class ClockRefVisitorMixin:
+    """Shared visit_Attribute/visit_Name discipline for spotting
+    wall-clock/entropy references whose chain is rooted at an actual
+    import binding.  Subclasses provide ``self.aliases`` (an Aliases) and
+    ``_on_clock_ref(node, path, kind)``; mix in BEFORE ast.NodeVisitor."""
+
+    def visit_Attribute(self, node: ast.Attribute):
+        path = self.aliases.resolve(node)
+        if path is not None:
+            # Pure Name/Attribute chain: check it once, don't recurse
+            # (recursing would re-report each prefix of a.b.c).
+            if self.aliases.root_bound(node):
+                kind = classify_clock_ref(path)
+                if kind is not None:
+                    self._on_clock_ref(node, path, kind)
+        else:
+            # Chain contains calls/subscripts — keep walking to reach them.
+            self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name):
+        # A bare name bound by `from time import monotonic` style imports.
+        path = self.aliases.resolve(node)
+        if path is not None and path != node.id and self.aliases.root_bound(node):
+            kind = classify_clock_ref(path)
+            if kind is not None:
+                self._on_clock_ref(node, path, kind)
+
+THREADING_MODULES = {
+    "threading", "_thread", "asyncio", "multiprocessing", "concurrent.futures",
+}
+
+IO_CALLS = {"open", "os.open", "os.fdopen", "io.open"}
+IO_MODULES = {"socket", "ssl"}
+
+# Modules where JAX001 applies (the jit-traced surface of the repo).
+TRACED_MODULE_GLOBS = ("conflict/engine_jax.py", "ops/*.py", "parallel/*.py")
+
+# Modules where RPY001 applies: the RequestStream-serving layers.
+RPY_MODULE_GLOBS = ("server/*.py", "rpc/*.py")
+
+# The one module allowed to read FDB_TPU_* environment flags (ENV001):
+# the registration point every other module must consult.
+ENV_REGISTRY_GLOBS = ("flow/knobs.py",)
+ENV_FLAG_PREFIX = "FDB_TPU_"
+
+# Per-rule allowlist: package-relative posix globs for modules that are
+# real-deployment components by identity, where the rule does not apply.
+# The IO001 set mirrors the rule text: fileio/ real backends +
+# rpc/real_network.py; tools/ are operational programs (fdbcli, fdbmonitor,
+# real_node) that never run under the simulator.
+DEFAULT_ALLOW: Dict[str, Tuple[str, ...]] = {
+    "DET001": (
+        "rpc/real_network.py",   # wall-anchored loop driver IS its purpose
+        "tools/*.py",            # operational programs (fdbcli/fdbmonitor/
+        #                          real_node analogs) never run under sim
+        "utils/procutil.py",     # OS process plumbing
+    ),
+    "DET002": (),
+    "DET003": (
+        "rpc/real_network.py",
+        "fileio/blobstore.py",   # threaded blocking-socket client/server
+        "fileio/realfile.py",
+        "flow/profiler.py",      # sampling thread = the SIGPROF analog
+        "tools/*.py",
+        "utils/procutil.py",
+    ),
+    # DET101 roots: functions in SIM-SURFACE modules only.  Real-mode
+    # modules may hit wall clocks freely (they still CARRY taint to any
+    # sim-surface caller).  The set is the union of the per-site DET001 /
+    # DET003 real-mode exemptions: those modules run outside the simulator
+    # by identity.
+    "DET101": (
+        "rpc/real_network.py",
+        "fileio/blobstore.py",
+        "fileio/realfile.py",
+        "flow/profiler.py",
+        "tools/*.py",
+        "utils/procutil.py",
+    ),
+    "ACT001": (),
+    "JAX001": (),
+    "TRC001": (),
+    "ERR001": (
+        "rpc/real_network.py",   # teardown paths on real sockets: close()
+        #                          best-effort by design
+        "tools/*.py",            # operational programs, not sim-executed
+        "utils/procutil.py",     # post-fork/pre-exec: may not even print
+    ),
+    "IO001": (
+        "fileio/realfile.py",
+        "fileio/blobstore.py",
+        "rpc/real_network.py",
+        "tools/*.py",
+        "utils/procutil.py",
+    ),
+    # WAIT rules police cooperative actors; the real-mode backends with
+    # OS-thread concurrency (already DET003-exempt) have genuinely
+    # different suspension semantics and are triaged by inspection.
+    "WAIT001": ("rpc/real_network.py", "tools/*.py"),
+    "WAIT002": ("rpc/real_network.py", "tools/*.py"),
+    "RPY001": (),
+    "ENV001": (),
+}
+
+# The linter's own modules are never simulator-executed.
+SKIP_MODULE_GLOBS = ("tools/fdblint.py", "tools/lint/*.py")
+
+
+def _match_any(relpath: str, globs) -> bool:
+    """Glob match against the relpath or any of its trailing sub-paths, so
+    'rpc/real_network.py' matches whether the scan root was the package dir
+    (relpath 'rpc/real_network.py') or an ancestor (relpath
+    'foundationdb_tpu/rpc/real_network.py', the single-file CLI mode)."""
+    parts = relpath.split("/")
+    tails = ["/".join(parts[i:]) for i in range(len(parts))]
+    return any(fnmatch.fnmatch(t, g) for t in tails for g in globs)
+
+
+@dataclass
+class Finding:
+    rule: str
+    path: str          # package-relative posix path
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+    reason: str = ""   # pragma reason when suppressed
+    end_line: int = 0  # last physical line of the flagged node (pragma scope)
+
+    def format(self) -> str:
+        return f"{self.path}:{self.line}:{self.col}: {self.rule} {self.message}"
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "path": self.path, "line": self.line,
+            "col": self.col, "message": self.message,
+            "suppressed": self.suppressed, "reason": self.reason,
+        }
+
+
+@dataclass
+class LintConfig:
+    allow: Dict[str, Tuple[str, ...]] = field(
+        default_factory=lambda: {k: tuple(v) for k, v in DEFAULT_ALLOW.items()}
+    )
+
+    @classmethod
+    def load(cls, path: str, use_defaults: bool = True) -> "LintConfig":
+        """JSON config {"allow": {"RULE": ["glob", ...]}}, merged over (or
+        replacing, with use_defaults=False) the built-in allowlist."""
+        with open(path, "r", encoding="utf-8") as f:
+            raw = json.load(f)
+        base: Dict[str, Tuple[str, ...]] = (
+            {k: tuple(v) for k, v in DEFAULT_ALLOW.items()} if use_defaults else {}
+        )
+        for rule, globs in raw.get("allow", {}).items():
+            if rule not in RULES:
+                raise ValueError(f"config allowlists unknown rule {rule!r}")
+            base[rule] = tuple(base.get(rule, ())) + tuple(globs)
+        return cls(allow=base)
+
+    def allows(self, rule: str, relpath: str) -> bool:
+        return _match_any(relpath, self.allow.get(rule, ()))
+
+
+# ---------------------------------------------------------------------------
+# Pragmas
+# ---------------------------------------------------------------------------
+
+_PRAGMA_RE = re.compile(
+    r"#\s*fdblint:\s*ignore\[(?P<rules>[A-Z0-9,\s]+)\](?:\s*:\s*(?P<reason>.*\S))?"
+)
+
+
+@dataclass
+class Pragma:
+    line: int
+    rules: Set[str]
+    reason: str
+    used: bool = False
+
+
+def parse_pragmas(source: str) -> Dict[int, Pragma]:
+    """Pragmas from REAL comment tokens only: a pragma example quoted in a
+    docstring or string literal must not register (it would then be
+    reported as stale PRG002 with no way to appease it)."""
+    pragmas: Dict[int, Pragma] = {}
+    for tok in tokenize.generate_tokens(io.StringIO(source).readline):
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _PRAGMA_RE.search(tok.string)
+        if not m:
+            continue
+        line = tok.start[0]
+        rules = {r.strip() for r in m.group("rules").split(",") if r.strip()}
+        pragmas[line] = Pragma(line, rules, (m.group("reason") or "").strip())
+    return pragmas
+
+
+def pragma_sanctions(
+    pragmas: Dict[int, Pragma], line: int, rules: Tuple[str, ...]
+) -> bool:
+    """True when `line` carries a pragma for any of `rules` — used by the
+    interprocedural pass to treat pragma'd sites as sanctioned boundaries
+    (a reasoned suppression of a source must also stop its taint: the
+    reason asserts the site is fine, so callers are fine too)."""
+    p = pragmas.get(line)
+    return p is not None and bool(p.rules & set(rules))
+
+
+def apply_pragmas(
+    findings: List[Finding], pragmas: Dict[int, Pragma], relpath: str
+) -> List[Finding]:
+    """Mark findings suppressed by same-line (or same-statement-span)
+    pragmas, then police the pragmas themselves: PRG001 (no reason) and
+    PRG002 (suppresses nothing / unknown rule) are never suppressible.
+    Must run ONCE per file over the findings of EVERY pass, or a pragma
+    that only suppresses an interprocedural finding would look stale."""
+    out: List[Finding] = []
+    for f in findings:
+        # A pragma anywhere on the flagged statement's physical lines
+        # suppresses it (a multi-line expression puts the node's lineno on
+        # a different line than the trailing comment).
+        for ln in range(f.line, max(f.end_line, f.line) + 1):
+            p = pragmas.get(ln)
+            if p is not None and f.rule in p.rules:
+                p.used = True
+                f.suppressed = True
+                f.reason = p.reason
+                break
+        out.append(f)
+    for p in pragmas.values():
+        unknown = p.rules - set(RULES)
+        if unknown:
+            out.append(Finding(
+                "PRG002", relpath, p.line, 0,
+                f"pragma names unknown rule(s) {sorted(unknown)}",
+            ))
+        if not p.reason:
+            out.append(Finding(
+                "PRG001", relpath, p.line, 0,
+                "ignore pragma carries no reason (append ': why')",
+            ))
+        if not p.used and not unknown:
+            out.append(Finding(
+                "PRG002", relpath, p.line, 0,
+                f"pragma for {sorted(p.rules)} suppresses nothing here",
+            ))
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Symbol resolution: map names/attribute chains to canonical dotted paths
+# ---------------------------------------------------------------------------
+
+
+class Aliases:
+    """Tracks module-level import bindings so ``t.monotonic`` resolves to
+    ``time.monotonic`` regardless of aliasing.  Function-local imports are
+    folded into the same table — a rename collision between scopes could in
+    principle misattribute, which for a linter errs on the loud side."""
+
+    def __init__(self):
+        self.map: Dict[str, str] = {}
+
+    def add_import(self, node: ast.Import):
+        for a in node.names:
+            self.map[a.asname or a.name.split(".")[0]] = (
+                a.name if a.asname else a.name.split(".")[0]
+            )
+
+    def add_import_from(self, node: ast.ImportFrom):
+        if node.module is None or node.level:
+            return  # relative import: package-internal, never a stdlib clock
+        for a in node.names:
+            if a.name == "*":
+                continue
+            self.map[a.asname or a.name] = f"{node.module}.{a.name}"
+
+    def resolve(self, node: ast.AST) -> Optional[str]:
+        """Dotted canonical path for a Name/Attribute chain, or None."""
+        parts: List[str] = []
+        while isinstance(node, ast.Attribute):
+            parts.append(node.attr)
+            node = node.value
+        if not isinstance(node, ast.Name):
+            return None
+        root = self.map.get(node.id, node.id)
+        return ".".join([root] + list(reversed(parts)))
+
+    def root_bound(self, node: ast.AST) -> bool:
+        """True iff the chain's root name is an import binding.  A local
+        variable that merely *shares* a module name (e.g. a parameter
+        named `random` holding a DeterministicRandom — this repo's core
+        idiom) must not light up module-prefix rules."""
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        return isinstance(node, ast.Name) and node.id in self.map
+
+
+# Simple (non-compound) statements: the unit of pragma suppression scope —
+# a pragma on any physical line of one covers it, and a def/if body must
+# never become one giant suppression region.
+SIMPLE_STMTS = (
+    ast.Assign, ast.AnnAssign, ast.AugAssign, ast.Expr, ast.Return,
+    ast.Import, ast.ImportFrom, ast.Raise, ast.Assert, ast.Delete,
+    ast.Global, ast.Nonlocal,
+)
+
+
+def innermost_simple_stmt_end(
+    node: ast.AST, stmt_spans: List[Tuple[int, int]]
+) -> int:
+    """End line of the innermost simple statement containing `node`, or
+    the node's own span outside any (decorators, if/while tests)."""
+    end = getattr(node, "end_lineno", None) or node.lineno
+    best = None
+    for s, e in stmt_spans:
+        if s <= node.lineno <= e:
+            if best is None or s > best[0] or (s == best[0] and e < best[1]):
+                best = (s, e)
+    return max(end, best[1]) if best is not None else end
+
+
+def attr_chain(node: ast.AST) -> Optional[List[str]]:
+    """['self', 'x', 'y'] for a pure Name/Attribute chain, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    parts.reverse()
+    return parts

@@ -1,0 +1,225 @@
+"""fdblint CLI: text/json/SARIF output, incremental --changed-only mode.
+
+``python -m foundationdb_tpu.tools.fdblint [paths] [--format=text|json|sarif]
+[--changed-only] [--cache/--no-cache] [--config FILE] [--list-rules]``;
+exit 0 iff no unsuppressed findings survive the filters."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional
+
+from .base import Finding, LintConfig, RULES
+from .project import Project, lint_package
+
+SARIF_SCHEMA = (
+    "https://raw.githubusercontent.com/oasis-tcs/sarif-spec/master/"
+    "Schemata/sarif-schema-2.1.0.json"
+)
+
+
+def count_by_rule(findings: List[Finding]) -> Dict[str, Dict[str, int]]:
+    """{rule: {"flagged": n, "suppressed": m}} for every rule that fired."""
+    out: Dict[str, Dict[str, int]] = {}
+    for f in findings:
+        slot = out.setdefault(f.rule, {"flagged": 0, "suppressed": 0})
+        slot["suppressed" if f.suppressed else "flagged"] += 1
+    return {r: out[r] for r in sorted(out)}
+
+
+def format_counts(findings: List[Finding]) -> str:
+    counts = count_by_rule(findings)
+    if not counts:
+        return "per-rule: (none)"
+    cells = [
+        f"{rule}={c['flagged']}+{c['suppressed']}s" for rule, c in counts.items()
+    ]
+    return "per-rule (flagged+suppressed): " + " ".join(cells)
+
+
+def to_sarif(shown: List[Finding]) -> dict:
+    results = []
+    for f in shown:
+        res = {
+            "ruleId": f.rule,
+            "level": "note" if f.suppressed else "error",
+            "message": {"text": f.message},
+            "locations": [{
+                "physicalLocation": {
+                    "artifactLocation": {"uri": f.path},
+                    "region": {
+                        "startLine": f.line,
+                        "startColumn": max(1, f.col + 1),
+                    },
+                }
+            }],
+        }
+        if f.suppressed:
+            res["suppressions"] = [{
+                "kind": "inSource",
+                "justification": f.reason,
+            }]
+        results.append(res)
+    return {
+        "$schema": SARIF_SCHEMA,
+        "version": "2.1.0",
+        "runs": [{
+            "tool": {"driver": {
+                "name": "fdblint",
+                "informationUri": "README.md#determinism-rules-fdblint",
+                "rules": [
+                    {"id": rule, "shortDescription": {"text": desc}}
+                    for rule, desc in sorted(RULES.items())
+                ],
+            }},
+            "results": results,
+        }],
+    }
+
+
+def changed_files(repo_dir: str) -> Optional[List[str]]:
+    """Absolute paths of files changed vs HEAD plus untracked, or None when
+    not in a git checkout (callers then fall back to a full scan)."""
+    def git(cwd, *args):
+        return subprocess.run(
+            ["git", *args], cwd=cwd, capture_output=True, text=True
+        )
+    try:
+        top = git(repo_dir, "rev-parse", "--show-toplevel")
+    except OSError:
+        return None  # no git binary on this host: full scan
+    if top.returncode != 0:
+        return None
+    root = top.stdout.strip()
+    # Both commands from the TOPLEVEL: `ls-files --others` is CWD-relative
+    # while `diff --name-only` is root-relative — mixing them from a
+    # subdirectory silently mis-joins the untracked paths.
+    names: List[str] = []
+    diff = git(root, "diff", "--name-only", "HEAD", "--")
+    if diff.returncode == 0:
+        names += diff.stdout.splitlines()
+    others = git(root, "ls-files", "--others", "--exclude-standard")
+    if others.returncode == 0:
+        names += others.stdout.splitlines()
+    return [os.path.join(root, n) for n in names if n.endswith(".py")]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="fdblint",
+        description="Multi-pass determinism & actor-hygiene analyzer "
+                    "(the actor compiler's static-gate role).",
+    )
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="package dirs or .py files (default: foundationdb_tpu)")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--config", help="JSON allowlist config to merge over defaults")
+    ap.add_argument("--no-default-config", action="store_true",
+                    help="ignore the built-in allowlist")
+    ap.add_argument("--show-suppressed", action="store_true",
+                    help="also print pragma-suppressed findings")
+    ap.add_argument("--changed-only", action="store_true",
+                    help="report findings only in files changed vs git HEAD "
+                         "(+ untracked); the whole project is still loaded "
+                         "so interprocedural taint stays correct")
+    ap.add_argument("--cache", action="store_true", default=None,
+                    help="per-file analysis cache (default for directory "
+                         "scans; stored in tempdir or $FDBLINT_CACHE)")
+    ap.add_argument("--no-cache", dest="cache", action="store_false")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in RULES.items():
+            print(f"{rule}  {desc}")
+        return 0
+
+    if args.config:
+        config = LintConfig.load(args.config, use_defaults=not args.no_default_config)
+    elif args.no_default_config:
+        config = LintConfig(allow={})
+    else:
+        config = LintConfig()
+
+    paths = args.paths or [
+        os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    ]
+    use_cache = args.cache if args.cache is not None else True
+    # (root-or-None, argument, findings) per argument: --changed-only
+    # filters each directory scan against ITS git checkout; explicit file
+    # arguments and non-git roots fall back to the full result rather than
+    # silently dropping every finding.
+    groups: List[tuple] = []
+    for p in paths:
+        if os.path.isdir(p):
+            groups.append((p, p, Project(p, config, use_cache=use_cache).lint()))
+        else:
+            groups.append((None, p, lint_package(p, config, use_cache=use_cache)))
+    findings = [f for _, _, fs in groups for f in fs]
+
+    if args.changed_only:
+        kept: List[Finding] = []
+        for root, _, fs in groups:
+            got = changed_files(root) if root is not None else None
+            if got is None:
+                kept.extend(fs)  # file arg / not a git checkout: full scan
+                continue
+            keep = set()
+            for c in got:
+                rel = os.path.relpath(os.path.abspath(c), root)
+                rel = rel.replace(os.sep, "/")
+                if not rel.startswith(".."):
+                    keep.add(rel)
+            # Finding paths and `keep` are both root-relative: exact match
+            # only (a suffix fallback would adopt same-named files from
+            # deeper directories).
+            kept.extend(f for f in fs if f.path in keep)
+        findings = kept
+
+    unsuppressed = [f for f in findings if not f.suppressed]
+    shown = findings if args.show_suppressed else unsuppressed
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "findings": [f.to_dict() for f in shown],
+                "total": len(findings),
+                "unsuppressed": len(unsuppressed),
+                "counts": count_by_rule(findings),
+            },
+            indent=2,
+        ))
+    elif args.format == "sarif":
+        # SARIF consumers (GitHub code scanning) resolve URIs against the
+        # REPOSITORY root, not our scan root: a gate run as
+        # `fdblint foundationdb_tpu --format=sarif` from the repo top
+        # would otherwise emit 'server/proxy.py' and every annotation
+        # fails to attach.  Rewrite each finding's path relative to the
+        # CWD the gate runs from (the repo root in CI); a path that
+        # escapes the CWD stays absolute rather than lying with '..'s.
+        cwd = os.getcwd()
+        for root, arg, fs in groups:
+            for f in fs:
+                ap = (
+                    os.path.join(os.path.abspath(root), f.path)
+                    if root is not None
+                    else os.path.abspath(arg)
+                )
+                rel = os.path.relpath(ap, cwd).replace(os.sep, "/")
+                f.path = rel if not rel.startswith("..") else ap.replace(os.sep, "/")
+        print(json.dumps(to_sarif(shown), indent=2))
+    else:
+        for f in shown:
+            tag = " (suppressed: %s)" % f.reason if f.suppressed else ""
+            print(f.format() + tag)
+        n_sup = len(findings) - len(unsuppressed)
+        print(
+            f"fdblint: {len(unsuppressed)} finding(s), {n_sup} suppressed; "
+            + format_counts(findings),
+            file=sys.stderr,
+        )
+    return 1 if unsuppressed else 0

@@ -1,0 +1,141 @@
+"""DET101: interprocedural determinism taint.
+
+Seeds the DET001/DET002 source set (direct wall-clock / entropy
+references per function, from the cached summaries), propagates backward
+through the call graph, and flags every CALL SITE in a sim-surface
+function whose callee transitively reaches a source — so a helper three
+frames below ``Resolver.resolve_batch`` can no longer hide a
+``time.time()``.  Real-mode modules (the DET101 allowlist: tools/,
+rpc/real_network.py, ...) are never flagged but still CARRY taint into
+any sim-surface caller.
+
+Pragma semantics compose: a ``fdblint: ignore[DET001/DET002/DET101]``
+pragma on a source line SANCTIONS it (the reason asserts the site is
+fine, so its callers are fine too), and a DET101 pragma on a call site
+cuts propagation through that edge.  Fixing or pragma-ing the one
+offending frame therefore clears the whole upstream cascade on the next
+run."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Set, Tuple
+
+from .base import Finding, LintConfig, Pragma, pragma_sanctions
+from .graphs import CallGraph, ModuleSummary
+
+Node = Tuple[str, str]  # (relpath, qualname)
+
+# A pragma for any of these on the source/call line sanctions it for taint.
+_SANCTION_RULES = ("DET001", "DET002", "DET101")
+
+
+def run_det101(
+    summaries: Dict[str, ModuleSummary],
+    pragmas_by_file: Dict[str, Dict[int, Pragma]],
+    config: LintConfig,
+    consumed_pragmas: Optional[Dict[str, Set[int]]] = None,
+) -> List[Finding]:
+    """`consumed_pragmas` (relpath -> line set), when given, collects the
+    DET101 pragmas that did their work by CUTTING taint (sanctioning a
+    source or a call edge) — those never see a finding to suppress, so
+    the caller must mark them used or PRG002 would call them stale."""
+    graph = CallGraph(summaries)
+
+    def consume(relpath: str, line: int):
+        if consumed_pragmas is not None:
+            consumed_pragmas.setdefault(relpath, set()).add(line)
+
+    # Per-node unsanctioned direct sources: node -> (dotted, kind).  A
+    # sanctioning pragma counts on ANY physical line of the ref's
+    # enclosing simple statement — the same scope suppression uses, so a
+    # pragma that appeases DET001 always clears the cascade too.
+    sources: Dict[Node, Tuple[str, str]] = {}
+    for ms in summaries.values():
+        pragmas = pragmas_by_file.get(ms.relpath, {})
+        for qual, fs in ms.functions.items():
+            for dotted, line, kind, span_end in fs.refs:
+                span = range(line, span_end + 1)
+                if any(pragma_sanctions(pragmas, ln, _SANCTION_RULES)
+                       for ln in span):
+                    for ln in span:
+                        p = pragmas.get(ln)
+                        if p is not None and "DET101" in p.rules:
+                            consume(ms.relpath, ln)
+                    continue
+                sources.setdefault((ms.relpath, qual), (dotted, kind))
+                break
+
+    # Forward edges, minus pragma-cut call sites (a DET101 pragma on any
+    # physical line of the call expression cuts the edge).  Cut pragmas
+    # are only CONSUMED if the callee turns out tainted — a pragma on a
+    # call to a clean callee did no work and must age into PRG002.
+    fwd: Dict[Node, List[Tuple[Tuple[int, int], Node]]] = {}
+    rev: Dict[Node, List[Node]] = {}
+    cuts: List[Tuple[str, List[int], Node]] = []
+    for caller, span, callee in graph.edges():
+        pragmas = pragmas_by_file.get(caller[0], {})
+        cut_lines = [
+            ln for ln in range(span[0], span[1] + 1)
+            if pragma_sanctions(pragmas, ln, ("DET101",))
+        ]
+        if cut_lines:
+            cuts.append((caller[0], cut_lines, callee))
+            continue
+        fwd.setdefault(caller, []).append((span, callee))
+        rev.setdefault(callee, []).append(caller)
+
+    # Reverse BFS from sources; `via` records each tainted node's next hop
+    # toward a source so findings can print the offending chain.
+    tainted: Set[Node] = set(sources)
+    via: Dict[Node, Node] = {}
+    frontier = sorted(sources)
+    while frontier:
+        nxt: List[Node] = []
+        for node in frontier:
+            for caller in rev.get(node, ()):
+                if caller not in tainted:
+                    tainted.add(caller)
+                    via[caller] = node
+                    nxt.append(caller)
+        frontier = sorted(set(nxt))
+
+    for relpath, cut_lines, callee in cuts:
+        if callee in tainted:
+            for ln in cut_lines:
+                consume(relpath, ln)
+
+    def chain_of(node: Node, limit: int = 6) -> Tuple[List[str], Tuple[str, str]]:
+        names: List[str] = []
+        cur = node
+        while cur in via and len(names) < limit:
+            names.append(cur[1])
+            cur = via[cur]
+        names.append(cur[1])
+        return names, sources.get(cur, ("<source>", "wall"))
+
+    findings: List[Finding] = []
+    seen: Set[Tuple[str, int, Node]] = set()
+    for ms in summaries.values():
+        if config.allows("DET101", ms.relpath):
+            continue  # real-mode module: carrier, never a root
+        for qual, fs in ms.functions.items():
+            node = (ms.relpath, qual)
+            if node in sources:
+                continue  # DET001/DET002 flag the direct site itself
+            for (line, end_line), callee in fwd.get(node, ()):
+                if callee not in tainted:
+                    continue
+                key = (ms.relpath, line, callee)
+                if key in seen:
+                    continue
+                seen.add(key)
+                names, (dotted, kind) = chain_of(callee)
+                what = "wall-clock" if kind == "wall" else "entropy source"
+                findings.append(Finding(
+                    "DET101", ms.relpath, line, 0,
+                    f"'{qual}' calls '{callee[1]}' which transitively "
+                    f"reaches {what} '{dotted}' "
+                    f"(chain: {' -> '.join([qual] + names)})",
+                    end_line=end_line,
+                ))
+    return findings

@@ -1,0 +1,419 @@
+"""Per-module (intra-procedural) rule pass.
+
+DET001/DET002/DET003, ACT001, JAX001, IO001, TRC001, ERR001 from the
+original single-module fdblint, plus ENV001 (FDB_TPU_* environment reads
+outside the flow/knobs.py registry).  Findings are produced UNFILTERED —
+the allowlist config and pragmas are applied by project.py after every
+pass has run, which keeps per-file results cacheable independent of
+config."""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set, Tuple
+
+from .base import (
+    Aliases,
+    ClockRefVisitorMixin,
+    ENTROPY_MODULES,
+    ENV_FLAG_PREFIX,
+    ENV_REGISTRY_GLOBS,
+    Finding,
+    IO_CALLS,
+    IO_MODULES,
+    SIMPLE_STMTS,
+    THREADING_MODULES,
+    TRACED_MODULE_GLOBS,
+    WALL_CLOCK,
+    _match_any,
+    innermost_simple_stmt_end,
+)
+
+# Attribute calls that force a device->host sync inside a trace.
+JAX_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+# Builtins that concretize a traced value (or are pure side effects).
+JAX_BAD_BUILTINS = {"print", "breakpoint", "input", "float", "int", "bool"}
+
+
+class ModuleLinter(ClockRefVisitorMixin, ast.NodeVisitor):
+    def __init__(self, relpath: str, tree: ast.Module):
+        self.relpath = relpath
+        self.tree = tree
+        self.aliases = Aliases()
+        self.findings: List[Finding] = []
+        # ACT001 name scoping: a bare `foo()` statement only matches module-
+        # level async functions; `self.foo()` / `cls.foo()` only async
+        # methods of the ENCLOSING class (per-class spans below).  Matching
+        # any attribute call by name alone drowns real bugs in collisions
+        # with generic names (`set`, `sync`) on unrelated objects, and a
+        # module-wide method set would still cross-fire between classes.
+        self.async_funcs: Set[str] = set()
+        # (class start line, class end line, async method names) per class
+        self.class_spans: List[Tuple[int, int, Set[str]]] = []
+        self.traced = _match_any(relpath, TRACED_MODULE_GLOBS)
+        self.env_registry = _match_any(relpath, ENV_REGISTRY_GLOBS)
+        # Simple-statement line spans: a pragma anywhere on the physical
+        # lines of the statement containing a flagged expression counts
+        # (multi-line expressions put the node's lineno above the spot
+        # where a trailing comment can live).
+        self.stmt_spans: List[Tuple[int, int]] = []
+        # Names of functions that are jit-traced (decorated, jax.jit(f),
+        # partial(jax.jit, ...)(f), or handed to shard_map).
+        self.jitted_names: Set[str] = set()
+        # Line spans of jitted function bodies (incl. nested defs).
+        self.jitted_spans: List[Tuple[int, int]] = []
+
+    # -- emit --
+    _SIMPLE_STMTS = SIMPLE_STMTS
+
+    def flag(self, rule: str, node: ast.AST, message: str,
+             end_line: Optional[int] = None):
+        if end_line is not None:
+            # Caller pinned the pragma scope (ERR001: the `except` line
+            # only — its node span covers the whole handler body, which
+            # must not become one giant suppression region).
+            end = end_line
+        else:
+            # Pragma scope: through the end of the innermost SIMPLE
+            # statement containing the node (see SIMPLE_STMTS).
+            end = innermost_simple_stmt_end(node, self.stmt_spans)
+        self.findings.append(
+            Finding(rule, self.relpath, node.lineno, node.col_offset, message,
+                    end_line=end)
+        )
+
+    # -- prepass: aliases, async defs, jitted functions --
+    def prepass(self):
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                self.aliases.add_import(node)
+            elif isinstance(node, ast.ImportFrom):
+                self.aliases.add_import_from(node)
+            if isinstance(node, self._SIMPLE_STMTS):
+                self.stmt_spans.append(
+                    (node.lineno, node.end_lineno or node.lineno)
+                )
+        self._collect_async_defs(self.tree, in_class=False)
+        if self.traced:
+            self._collect_jitted()
+
+    def _collect_async_defs(self, node: ast.AST, in_class: bool):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.AsyncFunctionDef):
+                if not in_class:
+                    self.async_funcs.add(child.name)
+                self._collect_async_defs(child, in_class=False)
+            elif isinstance(child, ast.ClassDef):
+                names = {
+                    m.name for m in child.body
+                    if isinstance(m, ast.AsyncFunctionDef)
+                }
+                self.class_spans.append(
+                    (child.lineno, child.end_lineno or child.lineno, names)
+                )
+                self._collect_async_defs(child, in_class=True)
+            else:
+                self._collect_async_defs(child, in_class=in_class)
+
+    def _enclosing_class_async_methods(self, lineno: int) -> Set[str]:
+        """Async method names of the innermost class containing lineno."""
+        best = None
+        for start, end, names in self.class_spans:
+            if start <= lineno <= end and (best is None or start > best[0]):
+                best = (start, names)
+        return best[1] if best else set()
+
+    def _is_jit(self, node: ast.AST) -> bool:
+        path = self.aliases.resolve(node)
+        return path is not None and (path == "jit" or path.endswith(".jit"))
+
+    def _jit_target_name(self, call: ast.Call) -> Optional[str]:
+        """Name of the function a jit/shard_map call wraps, unwrapping one
+        level of functools.partial around the target."""
+        if not call.args:
+            return None
+        target = call.args[0]
+        if isinstance(target, ast.Call):
+            fn = self.aliases.resolve(target.func)
+            if fn in ("partial", "functools.partial") and target.args:
+                target = target.args[0]
+        if isinstance(target, ast.Name):
+            return target.id
+        return None
+
+    def _collect_jitted(self):
+        for node in ast.walk(self.tree):
+            # @jit / @jax.jit / @partial(jax.jit, ...)
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if self._is_jit(dec):
+                        self.jitted_names.add(node.name)
+                    elif isinstance(dec, ast.Call):
+                        fn = self.aliases.resolve(dec.func)
+                        if self._is_jit(dec.func) or (
+                            fn in ("partial", "functools.partial")
+                            and dec.args
+                            and self._is_jit(dec.args[0])
+                        ):
+                            self.jitted_names.add(node.name)
+            elif isinstance(node, ast.Call):
+                fn_path = self.aliases.resolve(node.func)
+                # jax.jit(step, ...) / shard_map(body, ...)
+                if self._is_jit(node.func) or (
+                    fn_path is not None
+                    and (fn_path == "shard_map" or fn_path.endswith(".shard_map"))
+                ):
+                    name = self._jit_target_name(node)
+                    if name:
+                        self.jitted_names.add(name)
+                # partial(jax.jit, ...)(detect_core)
+                elif (
+                    isinstance(node.func, ast.Call)
+                    and self.aliases.resolve(node.func.func)
+                    in ("partial", "functools.partial")
+                    and node.func.args
+                    and self._is_jit(node.func.args[0])
+                ):
+                    name = self._jit_target_name(node)
+                    if name:
+                        self.jitted_names.add(name)
+        # Body spans: a def whose name is jitted, anywhere in the module
+        # (nested defs inside a jitted body fall inside its span).
+        for node in ast.walk(self.tree):
+            if (
+                isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and node.name in self.jitted_names
+            ):
+                self.jitted_spans.append((node.lineno, node.end_lineno or node.lineno))
+
+    def _in_jitted(self, node: ast.AST) -> bool:
+        ln = getattr(node, "lineno", None)
+        return ln is not None and any(a <= ln <= b for a, b in self.jitted_spans)
+
+    # -- visitors --
+    def visit_Import(self, node: ast.Import):
+        for a in node.names:
+            top = a.name.split(".")[0]
+            full = a.name
+            if top in ENTROPY_MODULES:
+                self.flag("DET002", node, f"import of entropy module '{a.name}'")
+            if top in THREADING_MODULES or full in THREADING_MODULES:
+                self.flag("DET003", node, f"import of '{a.name}'")
+            if top in IO_MODULES:
+                self.flag("IO001", node, f"import of '{a.name}'")
+        self.generic_visit(node)
+
+    def visit_ImportFrom(self, node: ast.ImportFrom):
+        if node.module is not None and not node.level:
+            top = node.module.split(".")[0]
+            if top in ENTROPY_MODULES:
+                self.flag("DET002", node, f"import from entropy module '{node.module}'")
+            if top in THREADING_MODULES or node.module in THREADING_MODULES:
+                self.flag("DET003", node, f"import from '{node.module}'")
+            if top in IO_MODULES:
+                self.flag("IO001", node, f"import from '{node.module}'")
+            for a in node.names:
+                if f"{node.module}.{a.name}" in WALL_CLOCK:
+                    self.flag(
+                        "DET001", node,
+                        f"import of wall-clock '{node.module}.{a.name}'",
+                    )
+        self.generic_visit(node)
+
+    def _on_clock_ref(self, node: ast.AST, path: str, kind: str):
+        # visit_Attribute/visit_Name come from ClockRefVisitorMixin — the
+        # same walk (and base.classify_clock_ref) that seeds DET101 taint
+        # in graphs.py, so direct flags and taint sources cannot drift.
+        if kind == "wall":
+            self.flag("DET001", node, f"wall-clock '{path}'")
+        else:
+            self.flag("DET002", node, f"entropy source '{path}'")
+
+    def visit_Subscript(self, node: ast.Subscript):
+        # ENV001: os.environ["FDB_TPU_X"] (the call forms are in visit_Call).
+        if not self.env_registry:
+            path = self.aliases.resolve(node.value)
+            if path == "os.environ":
+                self._check_env_key(node, node.slice)
+        self.generic_visit(node)
+
+    def visit_Compare(self, node: ast.Compare):
+        # ENV001: `"FDB_TPU_X" in os.environ` — presence-gating is a read.
+        if not self.env_registry:
+            for op, cmp in zip(node.ops, node.comparators):
+                if (
+                    isinstance(op, (ast.In, ast.NotIn))
+                    and self.aliases.resolve(cmp) == "os.environ"
+                ):
+                    self._check_env_key(node, node.left)
+        self.generic_visit(node)
+
+    def _check_env_key(self, node: ast.AST, key: ast.AST):
+        if (
+            isinstance(key, ast.Constant)
+            and isinstance(key.value, str)
+            and key.value.startswith(ENV_FLAG_PREFIX)
+        ):
+            self.flag(
+                "ENV001", node,
+                f"'{key.value}' read outside flow/knobs.py — register the "
+                f"flag there and read it via g_env (config drift otherwise)",
+            )
+
+    def visit_Call(self, node: ast.Call):
+        path = self.aliases.resolve(node.func)
+        if path is not None and path in IO_CALLS and (
+            path == "open" or self.aliases.root_bound(node.func)
+        ):
+            self.flag("IO001", node, f"direct '{path}()' call")
+        if (
+            not self.env_registry
+            and path in ("os.getenv", "os.environ.get",
+                         "os.environ.setdefault", "os.environ.pop")
+            and node.args
+        ):
+            self._check_env_key(node, node.args[0])
+        if self._in_jitted(node):
+            self._check_jax_call(node, path)
+        self.generic_visit(node)
+
+    def _check_jax_call(self, node: ast.Call, path: Optional[str]):
+        if isinstance(node.func, ast.Name) and node.func.id in JAX_BAD_BUILTINS:
+            self.flag(
+                "JAX001", node,
+                f"'{node.func.id}()' inside a jit-traced function "
+                f"(host sync / trace-time side effect)",
+            )
+        elif (
+            isinstance(node.func, ast.Attribute)
+            and node.func.attr in JAX_SYNC_METHODS
+        ):
+            self.flag(
+                "JAX001", node,
+                f"'.{node.func.attr}()' forces device sync inside a "
+                f"jit-traced function",
+            )
+        elif (
+            path is not None
+            and path.split(".")[0] in ("numpy", "np")
+            and self.aliases.root_bound(node.func)
+        ):
+            self.flag(
+                "JAX001", node,
+                f"host numpy call '{path}' inside a jit-traced function",
+            )
+
+    # -- ERR001: silent broad excepts --
+    _BROAD_EXC = {"Exception", "BaseException",
+                  "builtins.Exception", "builtins.BaseException"}
+
+    def _is_broad_except(self, t: Optional[ast.AST]) -> bool:
+        if t is None:
+            return True  # bare `except:`
+        if isinstance(t, ast.Tuple):
+            return any(self._is_broad_except(e) for e in t.elts)
+        return self.aliases.resolve(t) in self._BROAD_EXC
+
+    def _handler_surfaces_error(self, node: ast.excepthandler) -> bool:
+        """True when the handler visibly deals with the error: re-raises
+        (anywhere in its body, incl. nested cleanup), TraceEvents it,
+        forwards it via send_error, or reads the bound exception name
+        (passing it on IS handling; what ERR001 hunts is the error
+        vanishing without a trace)."""
+        for stmt in node.body:
+            for n in ast.walk(stmt):
+                if isinstance(n, ast.Raise):
+                    return True
+                if (
+                    node.name
+                    and isinstance(n, ast.Name)
+                    and n.id == node.name
+                ):
+                    return True
+                if isinstance(n, ast.Call):
+                    if (
+                        isinstance(n.func, ast.Attribute)
+                        and n.func.attr == "send_error"
+                    ):
+                        return True
+                    path = self.aliases.resolve(n.func)
+                    if path is not None and path.split(".")[-1] == "TraceEvent":
+                        return True
+        return False
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler):
+        if self._is_broad_except(node.type) and not self._handler_surfaces_error(node):
+            caught = "except:" if node.type is None else (
+                f"except {self.aliases.resolve(node.type) or '...'}"
+            )
+            self.flag(
+                "ERR001", node,
+                f"'{caught}' swallows errors silently "
+                f"(re-raise, TraceEvent, or propagate the error)",
+                end_line=node.lineno,
+            )
+        self.generic_visit(node)
+
+    def visit_Global(self, node: ast.Global):
+        if self._in_jitted(node):
+            self.flag(
+                "JAX001", node,
+                f"global mutation of {', '.join(node.names)} inside a "
+                f"jit-traced function",
+            )
+        self.generic_visit(node)
+
+    def visit_Expr(self, node: ast.Expr):
+        # ACT001: statement-level call of a module-local async def whose
+        # coroutine object is dropped on the floor.
+        v = node.value
+        if isinstance(v, ast.Call):
+            dropped = None
+            if isinstance(v.func, ast.Name) and v.func.id in self.async_funcs:
+                dropped = v.func.id
+            elif (
+                isinstance(v.func, ast.Attribute)
+                and isinstance(v.func.value, ast.Name)
+                and v.func.value.id in ("self", "cls")
+                and v.func.attr
+                in self._enclosing_class_async_methods(node.lineno)
+            ):
+                dropped = v.func.attr
+            if dropped is not None:
+                self.flag(
+                    "ACT001", node,
+                    f"coroutine '{dropped}()' is neither awaited nor spawned "
+                    f"(dropped actor)",
+                )
+            self._check_dropped_trace_event(node, v)
+        self.generic_visit(node)
+
+    def _check_dropped_trace_event(self, stmt: ast.Expr, call: ast.Call):
+        """TRC001: a statement-level TraceEvent(...) builder chain whose
+        outermost call is not .log() — the event is constructed, detailed,
+        and dropped (the rebuild has no destructor emit)."""
+        methods: List[str] = []
+        c: ast.AST = call
+        while isinstance(c, ast.Call):
+            # The root constructor call: its func is a pure Name/Attribute
+            # chain resolving to TraceEvent (bare, aliased, or module-
+            # qualified); builder methods between it and the statement are
+            # Attribute hops over inner Calls, collected in `methods`.
+            path = self.aliases.resolve(c.func)
+            if path is not None and path.split(".")[-1] == "TraceEvent":
+                if "log" not in methods:
+                    self.flag(
+                        "TRC001", stmt,
+                        "TraceEvent built but never .log()ed nor used as "
+                        "a context manager (dropped event)",
+                    )
+                return
+            if not isinstance(c.func, ast.Attribute):
+                return
+            methods.append(c.func.attr)
+            c = c.func.value
+
+    def run(self) -> List[Finding]:
+        self.prepass()
+        self.visit(self.tree)
+        return self.findings

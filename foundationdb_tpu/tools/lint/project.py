@@ -1,0 +1,323 @@
+"""Project loader: per-file AST/summary cache + pass orchestration.
+
+A file is reduced once per content version to (raw findings from every
+per-file pass, pragmas, ModuleSummary) and cached — keyed by
+(mtime_ns, size) with a content-sha1 fallback, invalidated wholesale when
+the linter's own sources change.  The interprocedural DET101 pass and all
+config/pragma application run on EVERY lint from the cached per-file
+facts, so a warm full-repo lint does no parsing at all (the tier-1 gate's
+<=5s budget) while cross-file taint stays correct when one file changes.
+
+The cache lives OUTSIDE the repo (a per-user 0700 tempdir subdirectory
+keyed by scan-root path, or $FDBLINT_CACHE) so linting never dirties the
+working tree."""
+
+from __future__ import annotations
+
+import ast
+import copy
+import hashlib
+import os
+import pickle
+import tempfile
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from .base import (
+    Finding,
+    LintConfig,
+    Pragma,
+    SKIP_MODULE_GLOBS,
+    _match_any,
+    apply_pragmas,
+    parse_pragmas,
+)
+from .det101 import run_det101
+from .graphs import ModuleSummary, collect_summary
+from .local import ModuleLinter
+from .rpy import run_rpy001
+from .waitrules import run_wait_rules
+
+CACHE_ENV = "FDBLINT_CACHE"
+
+
+@dataclass
+class FileRecord:
+    sig: Tuple[int, int]            # (mtime_ns, size)
+    digest: str
+    raw_findings: List[Finding]     # all per-file passes, unfiltered
+    pragmas: Dict[int, Pragma]
+    summary: ModuleSummary
+
+
+_FINGERPRINT: Optional[str] = None
+
+
+def _linter_fingerprint() -> str:
+    """sha1 over this package's sources: any linter change invalidates.
+    Memoized per process — the sources cannot change under a running
+    lint, and load+save would otherwise hash them twice per run."""
+    global _FINGERPRINT
+    if _FINGERPRINT is None:
+        here = os.path.dirname(os.path.abspath(__file__))
+        h = hashlib.sha1()
+        for fn in sorted(os.listdir(here)):
+            if fn.endswith(".py"):
+                with open(os.path.join(here, fn), "rb") as f:
+                    h.update(f.read())
+        _FINGERPRINT = h.hexdigest()
+    return _FINGERPRINT
+
+
+def iter_py_files(root: str):
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(d for d in dirnames if d != "__pycache__")
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def default_cache_path(root: str) -> str:
+    """Per-user PRIVATE cache location.  The cache is a pickle, so it must
+    never load from a path another local user could pre-plant: a
+    predictable name directly in the shared tempdir would be arbitrary
+    code execution at load time on a multi-user host.  The per-uid
+    subdirectory is created 0700 and verified owned-and-private; on any
+    doubt we fall back to a fresh mkdtemp (cold cache, never unsafe)."""
+    uid = getattr(os, "getuid", lambda: None)()
+    cache_dir = os.path.join(
+        tempfile.gettempdir(), f"fdblint-{'u' if uid is None else uid}"
+    )
+    try:
+        os.makedirs(cache_dir, mode=0o700, exist_ok=True)
+        st = os.stat(cache_dir)
+        owned = uid is None or getattr(st, "st_uid", uid) == uid
+        if not owned or (st.st_mode & 0o022):
+            cache_dir = tempfile.mkdtemp(prefix="fdblint-")
+    except OSError:
+        cache_dir = tempfile.mkdtemp(prefix="fdblint-")
+    key = hashlib.sha1(os.path.abspath(root).encode()).hexdigest()[:12]
+    return os.path.join(cache_dir, f"{key}.pkl")
+
+
+class Project:
+    def __init__(
+        self,
+        root: str,
+        config: Optional[LintConfig] = None,
+        cache_path: Optional[str] = None,
+        use_cache: bool = True,
+    ):
+        self.root = root
+        self.config = config or LintConfig()
+        self.use_cache = use_cache
+        self.cache_path = (
+            cache_path
+            or os.environ.get(CACHE_ENV)
+            or default_cache_path(root)
+        )
+        # Root package name for normalizing in-package absolute imports.
+        self.root_pkg = (
+            os.path.basename(os.path.abspath(root))
+            if os.path.exists(os.path.join(root, "__init__.py"))
+            else None
+        )
+        self.records: Dict[str, FileRecord] = {}
+        self.stats = {"files": 0, "parsed": 0, "cache_hits": 0}
+
+    # -- cache -------------------------------------------------------------
+    def _load_cache(self) -> Dict[str, FileRecord]:
+        if not self.use_cache:
+            return {}
+        try:
+            with open(self.cache_path, "rb") as f:
+                payload = pickle.load(f)
+            if payload.get("fingerprint") != _linter_fingerprint():
+                return {}
+            return payload.get("records", {})
+        except Exception:
+            # Missing/corrupt/stale-format cache: silently rebuild — the
+            # cache is a pure accelerator, never a correctness input.
+            return {}
+
+    def _save_cache(self):
+        if not self.use_cache:
+            return
+        try:
+            tmp = self.cache_path + ".tmp"
+            with open(tmp, "wb") as f:
+                pickle.dump(
+                    {
+                        "fingerprint": _linter_fingerprint(),
+                        "records": self.records,
+                    },
+                    f,
+                    protocol=pickle.HIGHEST_PROTOCOL,
+                )
+            os.replace(tmp, self.cache_path)
+        except Exception:
+            pass  # read-only tempdir etc.: run uncached
+
+    # -- loading -----------------------------------------------------------
+    def _analyze_file(self, path: str, relpath: str, sig, digest, source) -> FileRecord:
+        tree = ast.parse(source, filename=relpath)
+        findings = ModuleLinter(relpath, tree).run()
+        findings += run_wait_rules(relpath, tree)
+        findings += run_rpy001(relpath, tree)
+        pragmas = parse_pragmas(source)
+        summary = collect_summary(relpath, tree, self.root_pkg)
+        self.stats["parsed"] += 1
+        return FileRecord(sig, digest, findings, pragmas, summary)
+
+    def load(self):
+        cached = self._load_cache()
+        dirty = False  # anything parsed or sig-refreshed -> rewrite cache
+        for path in iter_py_files(self.root):
+            relpath = os.path.relpath(path, self.root).replace(os.sep, "/")
+            if _match_any(relpath, SKIP_MODULE_GLOBS):
+                continue
+            self.stats["files"] += 1
+            st = os.stat(path)
+            sig = (st.st_mtime_ns, st.st_size)
+            rec = cached.get(relpath)
+            if rec is not None and rec.sig == sig:
+                self.stats["cache_hits"] += 1
+                self.records[relpath] = rec
+                continue
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+            digest = hashlib.sha1(source.encode()).hexdigest()
+            dirty = True
+            if rec is not None and rec.digest == digest:
+                # Touched but unchanged (checkout, formatter no-op): reuse
+                # the analysis, refresh the fast-path signature.
+                rec.sig = sig
+                self.stats["cache_hits"] += 1
+                self.records[relpath] = rec
+                continue
+            self.records[relpath] = self._analyze_file(
+                path, relpath, sig, digest, source
+            )
+        # A pure-hit warm run (the tier-1 gate's steady state) learned
+        # nothing: skip the pickle rewrite.  Note a file DELETED since the
+        # last run leaves its stale record in the file, harmlessly — every
+        # lookup is keyed by the files that exist NOW.
+        if dirty or set(self.records) != set(cached):
+            self._save_cache()
+
+    # -- linting -----------------------------------------------------------
+    def lint(self) -> List[Finding]:
+        if not self.records:
+            self.load()
+        summaries = {rp: r.summary for rp, r in self.records.items()}
+        pragmas_by_file = {rp: r.pragmas for rp, r in self.records.items()}
+        consumed: Dict[str, set] = {}
+        det = run_det101(
+            summaries, pragmas_by_file, self.config, consumed_pragmas=consumed
+        )
+        det_by_file: Dict[str, List[Finding]] = {}
+        for f in det:
+            det_by_file.setdefault(f.path, []).append(f)
+        out: List[Finding] = []
+        for rp, rec in sorted(self.records.items()):
+            # Work on copies: cached records must stay pristine (pragma
+            # `used` flags and suppression marks are per-run state).
+            findings = [copy.copy(f) for f in rec.raw_findings]
+            findings += [copy.copy(f) for f in det_by_file.get(rp, [])]
+            findings = [
+                f for f in findings if not self.config.allows(f.rule, rp)
+            ]
+            pragmas = {
+                ln: Pragma(p.line, set(p.rules), p.reason,
+                           used=ln in consumed.get(rp, ()))
+                for ln, p in rec.pragmas.items()
+            }
+            out.extend(apply_pragmas(findings, pragmas, rp))
+        out.sort(key=lambda f: (f.path, f.line, f.rule))
+        return out
+
+
+# ---------------------------------------------------------------------------
+# Single-source / single-file / package entry points (stable public API)
+# ---------------------------------------------------------------------------
+
+
+def lint_source(
+    source: str, relpath: str, config: Optional[LintConfig] = None
+) -> List[Finding]:
+    """Lint one module's source with every per-file pass plus DET101
+    restricted to the module's own call graph; findings suppressed by
+    same-line pragmas are returned with suppressed=True.  PRG001/PRG002
+    police the pragmas themselves and are never suppressible."""
+    config = config or LintConfig()
+    if _match_any(relpath, SKIP_MODULE_GLOBS):
+        return []
+    tree = ast.parse(source, filename=relpath)
+    findings = ModuleLinter(relpath, tree).run()
+    findings += run_wait_rules(relpath, tree)
+    findings += run_rpy001(relpath, tree)
+    pragmas = parse_pragmas(source)
+    summary = collect_summary(relpath, tree, None)
+    consumed: Dict[str, set] = {}
+    findings += run_det101(
+        {relpath: summary}, {relpath: pragmas}, config,
+        consumed_pragmas=consumed,
+    )
+    findings = [f for f in findings if not config.allows(f.rule, relpath)]
+    for ln in consumed.get(relpath, ()):
+        pragmas[ln].used = True
+    return apply_pragmas(findings, pragmas, relpath)
+
+
+def lint_file(
+    path: str, root: str, config: Optional[LintConfig] = None
+) -> List[Finding]:
+    relpath = os.path.relpath(path, root).replace(os.sep, "/")
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    return lint_source(source, relpath, config)
+
+
+def lint_package(
+    root: str,
+    config: Optional[LintConfig] = None,
+    use_cache: bool = False,
+    cache_path: Optional[str] = None,
+) -> List[Finding]:
+    """Lint every .py under root (root is the package directory; paths in
+    findings are relative to it).  A single .py file is reported relative
+    to its outermost enclosing package, so that allowlist / traced-module
+    globs like 'rpc/real_network.py' keep matching (via _match_any's
+    trailing-sub-path semantics) in single-file mode.
+
+    A file INSIDE a package is linted with the whole enclosing package
+    loaded (cache-warm) and the result filtered to that file — the same
+    trick as --changed-only — so interprocedural DET101 context is
+    complete and a pragma cutting a cross-module taint edge is consumed
+    exactly as in a package scan instead of aging into a bogus PRG002
+    (editor/pre-commit integrations lint one file at a time)."""
+    if os.path.isfile(root):
+        path = os.path.abspath(root)
+        d = os.path.dirname(path)
+        pkg_root = None
+        while os.path.exists(os.path.join(d, "__init__.py")):
+            pkg_root = d
+            d = os.path.dirname(d)
+        if pkg_root is None:
+            # Standalone module: no package to load, single-module DET101.
+            return lint_file(root, d, config)
+        rel_in_pkg = os.path.relpath(path, pkg_root).replace(os.sep, "/")
+        prefix = os.path.basename(pkg_root)
+        proj = Project(
+            pkg_root, config, cache_path=cache_path, use_cache=use_cache
+        )
+        out = []
+        for f in proj.lint():
+            if f.path == rel_in_pkg:
+                f = copy.copy(f)
+                f.path = f"{prefix}/{f.path}"
+                out.append(f)
+        return out
+    return Project(
+        root, config, cache_path=cache_path, use_cache=use_cache
+    ).lint()

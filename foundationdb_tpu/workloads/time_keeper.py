@@ -65,7 +65,11 @@ class TimeKeeperWorkload(TestWorkload):
         # been taken anywhere inside that second: the tight bound is that
         # mapping time T must not exceed any version we observed after
         # the NEXT second boundary.
-        for t_obs, _v in self.observed:
+        # Snapshot: the observation actor appends while the mapping reads
+        # below suspend this check — iterating the live list would chase a
+        # moving tail (appends during iteration don't raise, they extend
+        # the walk).  The inner `later` comprehension re-reads on purpose.
+        for t_obs, _v in list(self.observed):
             if t_obs < times[0]:
                 continue
             later = [

@@ -1,0 +1,18 @@
+"""Sim-surface shared helpers: clean-looking, but the bottom frame calls
+into the real-mode clockbox.  Every frame of the chain is flagged at its
+call site — fixing (or pragma-ing) the one offending edge clears the
+cascade on the next run."""
+
+from tools.clockbox import clock_stamp
+
+
+def shape(x):
+    return clock_stamp(x)  # EXPECT: DET101
+
+
+def prep(x):
+    return shape(x)  # EXPECT: DET101
+
+
+def pure(x):
+    return x + 1  # untainted helper: callers stay clean

@@ -1,0 +1,18 @@
+"""Taint through method resolution: the child class never mentions the
+helper chain, but its inherited method reaches it."""
+
+from flow.helpers import prep
+
+
+class Base:
+    def helper(self):
+        return prep(1)  # EXPECT: DET101
+
+
+class Child(Base):
+    async def run(self, loop):
+        await loop.delay(1)
+        return self.helper()  # EXPECT: DET101
+
+    def clean(self):
+        return 7

@@ -1,0 +1,17 @@
+"""Sim-executed role: the clean-looking helper hides time.time() two
+frames down — DET101's acceptance case."""
+
+from flow.helpers import prep, pure
+
+
+async def run(loop):
+    await loop.delay(1)
+    return prep(3)  # EXPECT: DET101
+
+
+def untainted(loop):
+    return pure(4)  # clean: the helper never reaches a clock
+
+
+def sanctioned():
+    return prep(5)  # fdblint: ignore[DET101]: test fixture — deliberate wall stamp on a real-mode-only diagnostics path

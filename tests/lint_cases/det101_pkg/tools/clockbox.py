@@ -1,0 +1,14 @@
+"""Real-mode module (tools/ is DET001/DET101-allowlisted): wall reads are
+legal HERE, but they still taint any sim-surface caller chain."""
+
+import time
+
+
+def clock_stamp(x):
+    return (x, time.time())  # legal here; the hidden source two frames down
+
+
+def wall_only():
+    # Reachable ONLY from real-mode code (real_prog.main): no finding
+    # anywhere — the acceptance criterion's negative half.
+    return time.time()

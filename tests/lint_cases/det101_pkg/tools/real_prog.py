@@ -1,0 +1,10 @@
+"""Real-mode program: calls the shared helper AND the wall-only helper.
+Allowlisted module — no DET101 findings despite reaching wall clocks."""
+
+from flow.helpers import prep
+from tools.clockbox import wall_only
+
+
+def main():
+    prep(2)
+    return wall_only()

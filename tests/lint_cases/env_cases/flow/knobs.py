@@ -1,0 +1,12 @@
+"""The registry module itself: FDB_TPU_* reads are legal here."""
+
+import os
+
+
+def get(name, default=""):
+    return os.environ.get(name if name.startswith("FDB_TPU_") else name,
+                          default)
+
+
+def get_mode():
+    return os.environ.get("FDB_TPU_MODE", "")  # clean: the registry

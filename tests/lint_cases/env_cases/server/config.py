@@ -1,0 +1,13 @@
+"""ENV001 golden corpus: FDB_TPU_* environment reads outside the
+registry module."""
+
+import os
+
+
+def read_flags():
+    a = os.environ.get("FDB_TPU_MODE")  # EXPECT: ENV001
+    b = os.getenv("FDB_TPU_LEVEL", "0")  # EXPECT: ENV001
+    c = os.environ["FDB_TPU_FORCE"]  # EXPECT: ENV001
+    d = os.environ.get("OTHER_PREFIX_FLAG")  # clean: not our namespace
+    e = os.environ.get("FDB_TPU_LEGACY")  # fdblint: ignore[ENV001]: migration shim read during the deprecation window
+    return a, b, c, d, e

@@ -1,0 +1,75 @@
+"""RPY001 golden corpus: reply-promise path analysis.
+
+Positive cases leak a received reply on at least one path; negative cases
+send/error/hand it off on every path (or abandon it by RAISING, which is
+the visible teardown path).  EXPECT markers sit on the ACQUISITION line
+(param -> the def line, pop-unpack -> that statement)."""
+
+
+class Handlers:
+    async def early_return_leak(self, req, reply):  # EXPECT: RPY001
+        if req is None:
+            return  # leak: falls out without touching the reply
+        reply.send(req)
+
+    async def swallowed_except_leak(self, req, reply):  # EXPECT: RPY001
+        try:
+            reply.send(compute(req))
+        except ValueError:
+            return None  # leak: compute may raise before the send
+
+    async def all_paths_send(self, req, reply):
+        if req is None:
+            reply.send_error("operation_failed")
+            return
+        try:
+            reply.send(compute(req))
+        except ValueError:
+            reply.send_error("broken_promise")
+
+    async def raise_is_visible(self, req, reply):
+        if req is None:
+            raise RuntimeError("bad request")  # teardown breaks the reply
+        reply.send(req)
+
+    async def handed_to_spawned_actor(self, stream, process):
+        while True:
+            req, reply = await stream.pop()
+            process.spawn(self.early_return_leak(req, reply), "handler")
+
+    async def serve_loop_sends(self, stream):
+        while True:
+            req, reply = await stream.pop()
+            reply.send(req)
+
+    async def serve_loop_drops_on_continue(self, stream):
+        while True:
+            req, reply = await stream.pop()  # EXPECT: RPY001
+            if req is None:
+                continue  # leak: next pop rebinds, this reply is dropped
+            reply.send(req)
+
+    async def finally_always_answers(self, stream):
+        while True:
+            req, reply = await stream.pop()
+            try:
+                check(req)
+            finally:
+                reply.send(None)
+
+    async def stored_for_later(self, stream, pending):
+        while True:
+            req, reply = await stream.pop()
+            pending.append((req, reply))  # handoff: batcher answers later
+
+    def deferred_closure_handoff(self, req, reply, loop):
+        loop.call_later(0.1, lambda: reply.send(req))  # closure owns it
+
+
+def compute(req):
+    return req
+
+
+def check(req):
+    if req is None:
+        raise ValueError("nope")

@@ -1,0 +1,92 @@
+"""WAIT001/WAIT002 golden corpus: state held across await.
+
+An EXPECT comment marker pins an UNSUPPRESSED finding on its line; every
+other line must stay clean (the negative half of the corpus).  The test
+drives the real CLI over this mini scan root with --format=json and
+compares exactly."""
+
+
+class MutableRole:
+    def __init__(self):
+        self.table = {}
+        self.peers = []
+        self.frozen = {"a": 1}  # only ever assigned here: config-immutable
+
+    def bump(self, k):
+        self.table[k] = self.table.get(k, 0) + 1  # mutation evidence
+
+    def join(self, p):
+        self.peers.append(p)  # mutation evidence
+
+    async def stale_capture(self, loop):
+        snap = self.table
+        await loop.delay(1)
+        return snap["k"]  # EXPECT: WAIT001
+
+    async def reread_after_await(self, loop):
+        snap = self.table
+        await loop.delay(1)
+        snap = self.table  # re-read kills the capture
+        return snap["k"]  # clean: bound after the await
+
+    async def immutable_snapshot(self, loop):
+        cfg = self.frozen  # no mutation evidence anywhere: snapshot
+        await loop.delay(1)
+        return cfg["a"]  # clean
+
+    async def value_use_is_snapshot(self, loop):
+        n = self.table
+        await loop.delay(1)
+        return report(n)  # clean: value use, not a deref
+
+    async def live_view_any_use(self, loop):
+        view = self.table.items()
+        await loop.delay(1)
+        return report(view)  # EXPECT: WAIT001
+
+    async def iterator_across_await(self, loop):
+        it = iter(self.peers)
+        await loop.delay(1)
+        return next(it)  # EXPECT: WAIT001
+
+    async def genexp_across_await(self, loop):
+        gen = (p for p in self.peers)
+        await loop.delay(1)
+        return list(gen)  # EXPECT: WAIT001
+
+    async def iterate_live_dict(self, loop):
+        for k, v in self.table.items():  # EXPECT: WAIT002
+            await loop.delay(v)
+            self.bump(k)
+
+    async def iterate_snapshot(self, loop):
+        for k, v in list(self.table.items()):  # clean: deliberate snapshot
+            await loop.delay(v)
+            self.bump(k)
+
+    async def iterate_sorted_snapshot(self, loop):
+        for p in sorted(self.peers):  # clean: sorted() copies
+            await loop.delay(1)
+        for p in self.peers:  # clean: no await in this body
+            report(p)
+
+    async def nested_async_def(self, loop):
+        async def inner():
+            snap = self.peers
+            await loop.delay(1)
+            return snap[0]  # EXPECT: WAIT001
+
+        return inner()
+
+    async def lambda_capture_is_deferred(self, loop):
+        cb = lambda: self.table["k"]  # noqa: E731 - deliberate closure
+        await loop.delay(1)
+        return cb()  # clean: the closure re-reads at call time
+
+    async def comprehension_is_immediate(self, loop):
+        await loop.delay(1)
+        return [p for p in self.peers]  # clean: iterates NOW, post-await
+
+
+def report(x):
+    return x

@@ -15,13 +15,17 @@ import os
 import shutil
 import subprocess
 import sys
+import time
 
 import pytest
 
 import foundationdb_tpu
 from foundationdb_tpu.tools.fdblint import (
     LintConfig,
+    Project,
     RULES,
+    count_by_rule,
+    format_counts,
     lint_package,
     lint_source,
     main,
@@ -31,6 +35,7 @@ from foundationdb_tpu.tools.fdblint import (
 pytestmark = pytest.mark.lint
 
 PKG_DIR = os.path.dirname(os.path.abspath(foundationdb_tpu.__file__))
+CASES_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)), "lint_cases")
 
 
 def rules_of(findings, suppressed=False):
@@ -41,7 +46,11 @@ def rules_of(findings, suppressed=False):
 def package_findings():
     # One whole-package scan shared by the gate tests (walking + parsing
     # every module 3x over would triple the gate's cost for nothing).
-    return lint_package(PKG_DIR)
+    findings = lint_package(PKG_DIR)
+    # Per-rule counts in the tier-1 output (bypassing capture on purpose:
+    # a rule whose finding count quietly drifts is how regressions hide).
+    print(f"\n[fdblint] {format_counts(findings)}", file=sys.__stderr__)
+    return findings
 
 
 # ---------------------------------------------------------------------------
@@ -82,6 +91,50 @@ def test_module_entrypoint_runs():
         cwd=os.path.dirname(PKG_DIR),
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_script_mode_entrypoint_runs():
+    # `python path/to/fdblint.py` (no -m, arbitrary cwd): the shim
+    # bootstraps the repo root so wrappers/pre-commit hooks that invoke
+    # it by path keep working.
+    proc = subprocess.run(
+        [sys.executable, os.path.join(PKG_DIR, "tools", "fdblint.py"),
+         PKG_DIR],
+        capture_output=True,
+        text=True,
+        cwd="/",
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_wait001_body_pragma_does_not_cover_header_finding():
+    # A compound statement's pragma scope is its HEADER only: a stale
+    # pragma deep in the loop body must not absorb (and silently
+    # consume against) a finding on the `while` test — it suppresses
+    # nothing and ages into PRG002.  On the header line it suppresses.
+    body_pragma = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.d['k'] = 1\n"
+        "    async def f(self, loop):\n"
+        "        lane = self.d\n"
+        "        await loop.delay(1)\n"
+        "        while lane['k']:\n"
+        "            x = 1  # fdblint: ignore[WAIT001]: unrelated\n"
+        "            await loop.delay(1)\n"
+    )
+    findings = lint_source(body_pragma, "server/x.py")
+    wait = [f for f in findings if f.rule == "WAIT001"]
+    assert [f.line for f in wait] == [7] and not wait[0].suppressed
+    assert any(f.rule == "PRG002" for f in findings)
+    header_pragma = body_pragma.replace(
+        "        while lane['k']:\n",
+        "        while lane['k']:  # fdblint: ignore[WAIT001]: singleton\n",
+    ).replace("  # fdblint: ignore[WAIT001]: unrelated", "")
+    findings = lint_source(header_pragma, "server/x.py")
+    wait = [f for f in findings if f.rule == "WAIT001"]
+    assert [f.suppressed for f in wait] == [True]
+    assert not any(f.rule == "PRG002" for f in findings)
 
 
 # ---------------------------------------------------------------------------
@@ -432,6 +485,21 @@ def test_single_file_mode_keeps_allowlist_and_traced_globs():
     assert main([real_net]) == 0
 
 
+def test_single_file_mode_consumes_cross_module_det101_pragmas():
+    # An in-package file is linted with the WHOLE enclosing package loaded
+    # (the --changed-only trick), so a pragma that cuts a cross-module
+    # DET101 taint edge is consumed exactly as in a package scan.
+    # Regression: lint_source saw only the lone module's summary, the edge
+    # into rpc/real_network.py never resolved, and the pragmas were
+    # reported as stale PRG002 with exit 1 — spuriously failing any
+    # editor/pre-commit integration that lints the edited file alone.
+    mv = os.path.join(PKG_DIR, "client", "multi_version.py")
+    findings = lint_package(mv)
+    assert [f for f in findings if not f.suppressed] == []
+    assert "PRG002" not in [f.rule for f in findings]
+    assert main([mv]) == 0
+
+
 def test_det002_not_fooled_by_variable_named_random():
     # A parameter holding a DeterministicRandom is the repo's core idiom
     # (the g_random analog); only the imported module may trip DET002.
@@ -496,4 +564,858 @@ def test_pragma_examples_in_docstrings_are_inert():
 def test_rule_registry_documented():
     for rule in ("DET001", "DET002", "DET003", "ACT001", "JAX001", "IO001",
                  "TRC001", "ERR001"):
+        assert rule in RULES and RULES[rule]
+
+
+# ---------------------------------------------------------------------------
+# New-rule unit tests (WAIT001/WAIT002, RPY001, DET101, ENV001)
+# ---------------------------------------------------------------------------
+
+
+def test_wait001_capture_reread_and_value_use():
+    src = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.d['k'] = 1\n"
+        "    async def bad(self, loop):\n"
+        "        snap = self.d\n"
+        "        await loop.delay(1)\n"
+        "        return snap['k']\n"          # deref after await: flagged
+        "    async def reread(self, loop):\n"
+        "        snap = self.d\n"
+        "        await loop.delay(1)\n"
+        "        snap = self.d\n"             # re-read kills the capture
+        "        return snap['k']\n"
+        "    async def value_use(self, loop):\n"
+        "        snap = self.d\n"
+        "        await loop.delay(1)\n"
+        "        return f(snap)\n"            # value use: snapshot, clean
+    )
+    findings = lint_source(src, "server/x.py")
+    wait = [f for f in findings if f.rule == "WAIT001"]
+    assert [f.line for f in wait] == [7]
+
+
+def test_wait001_needs_mutation_evidence():
+    # Only assigned in __init__: config-immutable, captures never flag.
+    src = (
+        "class R:\n"
+        "    def __init__(self):\n"
+        "        self.cfg = {}\n"
+        "    async def ok(self, loop):\n"
+        "        c = self.cfg\n"
+        "        await loop.delay(1)\n"
+        "        return c['a']\n"
+    )
+    assert rules_of(lint_source(src, "server/x.py")) == []
+
+
+def test_wait001_branch_epoch_is_path_scoped():
+    # A deref on an await-FREE branch must not inherit the sibling
+    # branch's suspension...
+    src = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.d['k'] = 1\n"
+        "    async def ok(self, loop, cond):\n"
+        "        snap = self.d\n"
+        "        if cond:\n"
+        "            await loop.delay(1)\n"
+        "            return None\n"
+        "        return snap['k']\n"          # no await on this path
+        "    async def bad(self, loop, cond):\n"
+        "        snap = self.d\n"
+        "        if cond:\n"
+        "            await loop.delay(1)\n"
+        "        return snap['k']\n"          # await MAY have happened
+    )
+    findings = lint_source(src, "server/x.py")
+    wait = [f for f in findings if f.rule == "WAIT001"]
+    # ...while code AFTER the If still counts either branch's await.
+    assert [f.line for f in wait] == [14]
+
+
+def test_wait001_if_branch_reread_clears_and_pairs_with_its_epoch():
+    # The re-read lives INSIDE the awaiting branch: every real path is
+    # safe (then-path re-reads after its await, else-path never awaits) —
+    # merging one branch's env with the other's epoch must not flag it.
+    src = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.d['k'] = 1\n"
+        "    async def ok(self, loop, cond):\n"
+        "        snap = self.d\n"
+        "        if cond:\n"
+        "            await loop.delay(1)\n"
+        "            snap = self.d\n"
+        "        return snap['k']\n"
+    )
+    assert "WAIT001" not in rules_of(lint_source(src, "server/x.py"))
+
+
+def test_wait001_try_handler_sees_pre_reread_state():
+    # The body can raise AT the await — before the re-read — so the
+    # handler's deref is stale even though the fall-through one is not.
+    src = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.d['k'] = 1\n"
+        "    async def f(self, loop):\n"
+        "        snap = self.d\n"
+        "        try:\n"
+        "            await loop.delay(1)\n"
+        "            snap = self.d\n"
+        "        except Exception as e:\n"
+        "            return (snap['k'], e)\n"   # stale on the raise path
+        "        return snap['k']\n"            # fresh: re-read completed
+    )
+    findings = lint_source(src, "server/x.py")
+    wait = [f for f in findings if f.rule == "WAIT001"]
+    assert [f.line for f in wait] == [10]
+
+
+def test_wait001_except_name_shadowing_capture_is_a_rebind():
+    # `except E as snap:` binds snap to the FRESH exception — a handler
+    # deref of it is not a stale-capture use, same as any other rebind.
+    src = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.d['k'] = 1\n"
+        "    async def f(self, loop, log):\n"
+        "        snap = self.d\n"
+        "        await loop.delay(1)\n"
+        "        try:\n"
+        "            log('x')\n"
+        "        except Exception as snap:\n"
+        "            log(snap.args)\n"
+        "        return 0\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    assert [f for f in findings if f.rule == "WAIT001"] == []
+
+
+def test_wait001_handler_fallthrough_carries_staleness_past_try():
+    # The raise-at-await path swallowed by a falling-through handler
+    # skips the body's re-read: the post-try deref is stale on that path.
+    # A handler that re-reads itself keeps the post-try code clean.
+    src = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.d['k'] = 1\n"
+        "    async def bad(self, loop, log):\n"
+        "        snap = self.d\n"
+        "        try:\n"
+        "            await loop.delay(1)\n"
+        "            snap = self.d\n"
+        "        except Exception as e:\n"
+        "            log(e)\n"
+        "        return snap['k']\n"
+        "    async def ok(self, loop, log):\n"
+        "        snap = self.d\n"
+        "        try:\n"
+        "            await loop.delay(1)\n"
+        "            snap = self.d\n"
+        "        except Exception as e:\n"
+        "            log(e)\n"
+        "            snap = self.d\n"
+        "        return snap['k']\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    wait = [f for f in findings if f.rule == "WAIT001"]
+    assert [f.line for f in wait] == [11]
+
+
+def test_wait002_live_iteration_vs_snapshot():
+    src = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.d.update({})\n"
+        "    async def bad(self, loop):\n"
+        "        for k in self.d:\n"          # live dict + awaiting body
+        "            await loop.delay(1)\n"
+        "    async def ok(self, loop):\n"
+        "        for k in list(self.d):\n"    # snapshot
+        "            await loop.delay(1)\n"
+        "    async def no_await(self, loop):\n"
+        "        for k in self.d:\n"          # no suspension: clean
+        "            f(k)\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    w2 = [f for f in findings if f.rule == "WAIT002"]
+    assert [f.line for f in w2] == [5]
+
+
+def test_wait_rules_async_for_header_and_walrus_capture():
+    # `async for` suspends at every __anext__ even with an await-free
+    # body, and a walrus capture is the same stale-deref class as the
+    # two-line spelling.
+    src = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.q.update({})\n"
+        "    async def bad_iter(self):\n"
+        "        async for req in self.q:\n"
+        "            handle(req)\n"
+        "    async def bad_walrus(self, loop):\n"
+        "        if (snap := self.q):\n"
+        "            await loop.delay(1)\n"
+        "            return snap['k']\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    assert any(f.rule == "WAIT002" and f.line == 5 for f in findings)
+    assert any(f.rule == "WAIT001" and f.line == 10 for f in findings)
+
+
+def test_rpy001_leak_send_and_handoff():
+    src = (
+        "class H:\n"
+        "    async def leak(self, req, reply):\n"
+        "        if req is None:\n"
+        "            return\n"                      # leak path
+        "        reply.send(req)\n"
+        "    async def ok(self, req, reply):\n"
+        "        if req is None:\n"
+        "            reply.send_error('x')\n"
+        "            return\n"
+        "        reply.send(req)\n"
+        "    async def spawned(self, stream, proc):\n"
+        "        while True:\n"
+        "            req, reply = await stream.pop()\n"
+        "            proc.spawn(self.ok(req, reply), 'h')\n"  # handoff
+    )
+    findings = lint_source(src, "server/x.py")
+    rpy = [f for f in findings if f.rule == "RPY001"]
+    assert [f.line for f in rpy] == [2]
+
+
+def test_rpy001_only_in_server_and_rpc():
+    src = (
+        "async def leak(req, reply):\n"
+        "    return None\n"
+    )
+    assert "RPY001" in rules_of(lint_source(src, "server/x.py"))
+    assert "RPY001" in rules_of(lint_source(src, "rpc/x.py"))
+    assert "RPY001" not in rules_of(lint_source(src, "layers/x.py"))
+
+
+def test_rpy001_swallowed_except_with_in_try_acquisition():
+    # The headline serve-loop shape: pop INSIDE the try, awaits between
+    # pop and send, handler swallows — the raise-after-acquire path drops
+    # the reply.  A bare pop as the try's last statement cannot fail
+    # after binding, so recover-and-resend stays clean.
+    src = (
+        "class H:\n"
+        "    async def leaky(self, stream, log):\n"
+        "        while True:\n"
+        "            try:\n"
+        "                req, reply = await stream.pop()\n"
+        "                data = await compute(req)\n"
+        "                reply.send(data)\n"
+        "            except Exception as e:\n"
+        "                log(e)\n"                       # reply dropped
+        "    async def ok(self, stream, log):\n"
+        "        while True:\n"
+        "            try:\n"
+        "                req, reply = await stream.pop()\n"
+        "            except Exception as e:\n"
+        "                log(e)\n"                       # nothing acquired
+        "                continue\n"
+        "            reply.send(req)\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    rpy = [f for f in findings if f.rule == "RPY001"]
+    assert [f.line for f in rpy] == [5]
+
+
+def test_rpy001_while_test_mention_does_not_resolve():
+    # A loop test is a bare branch test like If's: `while reply.pending()`
+    # inspects the reply without resolving it — the exit path still
+    # leaks (an in-body send alone would not either: the zero-iteration
+    # path skips it).  A send after the loop covers every path.
+    src = (
+        "class H:\n"
+        "    async def leaky(self, stream, tick):\n"
+        "        req, reply = await stream.pop()\n"
+        "        while reply.pending():\n"
+        "            await tick()\n"
+        "        return None\n"
+        "    async def ok(self, stream, tick):\n"
+        "        req, reply = await stream.pop()\n"
+        "        while reply.pending():\n"
+        "            await tick()\n"
+        "        reply.send(req)\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    rpy = [f for f in findings if f.rule == "RPY001"]
+    assert [f.line for f in rpy] == [3]
+
+
+def test_wait001_tuple_assignment_capture_is_tracked():
+    # `snap, other = self.d, 1` is the two-line capture in one statement
+    # — element-wise binding must track it.  Starred/mismatched unpacks
+    # kill conservatively (no flag).
+    src = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.d['k'] = 1\n"
+        "    async def bad(self, loop):\n"
+        "        snap, other = self.d, 1\n"
+        "        await loop.delay(1)\n"
+        "        return snap['k'], other\n"
+        "    async def unpack_ok(self, loop):\n"
+        "        a, b = self.d\n"
+        "        await loop.delay(1)\n"
+        "        return a\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    wait = [f for f in findings if f.rule == "WAIT001"]
+    assert [f.line for f in wait] == [7]
+
+
+def test_wait002_alias_of_shared_state_is_still_live():
+    # One local rebinding must not hide the invalidated-iterator class
+    # (the exact cluster_controller._watch_roles shape, via an alias).
+    src = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.d.update({})\n"
+        "    async def bad(self, loop):\n"
+        "        snap = self.d\n"
+        "        for k in snap:\n"
+        "            await loop.delay(1)\n"
+        "    async def ok(self, loop):\n"
+        "        snap = list(self.d)\n"
+        "        for k in snap:\n"
+        "            await loop.delay(1)\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    w2 = [f for f in findings if f.rule == "WAIT002"]
+    assert [f.line for f in w2] == [6]
+
+
+def test_wait_rules_reach_nested_and_factory_local_classes():
+    # A role class built inside a factory, and a class nested in another
+    # class, are each their OWN shared-state scope — both were invisible
+    # to a top-level-only walk.
+    src = (
+        "def make():\n"
+        "    class R:\n"
+        "        def mut(self):\n"
+        "            self.d['k'] = 1\n"
+        "        async def bad(self, loop):\n"
+        "            snap = self.d\n"
+        "            await loop.delay(1)\n"
+        "            return snap['k']\n"
+        "    return R\n"
+        "class Outer:\n"
+        "    class Inner:\n"
+        "        def mut(self):\n"
+        "            self.d['k'] = 1\n"
+        "        async def bad(self, loop):\n"
+        "            snap = self.d\n"
+        "            await loop.delay(1)\n"
+        "            return snap['k']\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    wait = [f for f in findings if f.rule == "WAIT001"]
+    assert [f.line for f in wait] == [8, 17]
+
+
+def test_wait001_while_test_reevaluates_after_body_await():
+    src = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.d['k'] = 1\n"
+        "    async def bad(self, loop):\n"
+        "        snap = self.d\n"
+        "        while snap['k']:\n"   # re-evaluated after the await
+        "            await loop.delay(1)\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    assert any(f.rule == "WAIT001" and f.line == 6 for f in findings)
+
+
+def test_rpy001_loop_else_acquisition():
+    src = (
+        "class H:\n"
+        "    async def leak(self, stream, items):\n"
+        "        for it in items:\n"
+        "            use(it)\n"
+        "        else:\n"
+        "            req, reply = await stream.pop()\n"
+        "            return None\n"                  # reply dropped
+        "    async def ok(self, stream, items):\n"
+        "        for it in items:\n"
+        "            use(it)\n"
+        "        else:\n"
+        "            req, reply = await stream.pop()\n"
+        "        reply.send(req)\n"                  # resolved after loop
+    )
+    findings = lint_source(src, "server/x.py")
+    rpy = [f for f in findings if f.rule == "RPY001"]
+    assert [f.line for f in rpy] == [6]
+
+
+def test_env001_presence_checks_and_mutating_reads():
+    src = (
+        "import os\n"
+        "if 'FDB_TPU_HISTORY' in os.environ:\n"
+        "    pass\n"
+        "os.environ.setdefault('FDB_TPU_X', '1')\n"
+        "os.environ.pop('FDB_TPU_Y', None)\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    env = [f for f in findings if f.rule == "ENV001"]
+    assert [f.line for f in env] == [2, 4, 5]
+
+
+def test_wait001_zero_iteration_loop_does_not_clear_staleness():
+    # The loop body may run zero times: its re-read must not clear the
+    # pre-loop capture on the loop-skipped path.  `while True:` always
+    # enters, so its body re-read genuinely covers every path.
+    src = (
+        "class R:\n"
+        "    def mut(self):\n"
+        "        self.d['k'] = 1\n"
+        "    async def bad(self, loop, items):\n"
+        "        snap = self.d\n"
+        "        await loop.delay(1)\n"
+        "        for it in items:\n"
+        "            snap = self.d\n"
+        "        return snap['k']\n"       # stale when items is empty
+        "    async def ok(self, loop):\n"
+        "        snap = self.d\n"
+        "        await loop.delay(1)\n"
+        "        while True:\n"
+        "            snap = self.d\n"
+        "            break\n"
+        "        return snap['k']\n"       # always re-read
+    )
+    findings = lint_source(src, "server/x.py")
+    wait = [f for f in findings if f.rule == "WAIT001"]
+    assert [f.line for f in wait] == [9]
+
+
+def test_rpy001_break_then_resolve_after_loop():
+    # break carries the reply out of the loop: resolved after it = clean;
+    # forgotten after it = the leak.
+    src = (
+        "class H:\n"
+        "    async def ok(self, stream):\n"
+        "        while True:\n"
+        "            req, reply = await stream.pop()\n"
+        "            if req is None:\n"
+        "                break\n"
+        "            reply.send(req)\n"
+        "        reply.send_error('shutdown')\n"
+        "    async def leak(self, stream):\n"
+        "        while True:\n"
+        "            req, reply = await stream.pop()\n"
+        "            if req is None:\n"
+        "                break\n"
+        "            reply.send(req)\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    rpy = [f for f in findings if f.rule == "RPY001"]
+    assert [f.line for f in rpy] == [11]
+
+
+def test_changed_only_survives_missing_git(monkeypatch, tmp_path, capsys):
+    # No git binary at all (raises OSError) must mean full scan, not a
+    # traceback and not a silently-green gate.
+    from foundationdb_tpu.tools.lint import cli as cli_mod
+
+    def no_git(*a, **k):
+        raise FileNotFoundError("git not installed")
+
+    monkeypatch.setattr(cli_mod.subprocess, "run", no_git)
+    pkg = tmp_path / "pkg" / "server"
+    pkg.mkdir(parents=True)
+    (pkg / "cfg.py").write_text(
+        "import os\nA = os.environ.get('FDB_TPU_X')\n"
+    )
+    rc = main([str(tmp_path / "pkg"), "--format=json", "--no-cache",
+               "--changed-only"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["path"] for f in out["findings"]} == {"server/cfg.py"}
+
+
+def test_rpy001_while_one_is_infinite_too():
+    # `while 1:` serves forever exactly like `while True:` — the
+    # unreachable fall-through must not read as a leaked reply.
+    src = (
+        "class H:\n"
+        "    async def serve(self, stream):\n"
+        "        while 1:\n"
+        "            req, reply = await stream.pop()\n"
+        "            reply.send(req)\n"
+    )
+    assert "RPY001" not in rules_of(lint_source(src, "server/x.py"))
+
+
+def test_det101_intramodule_chain_and_pragma_cut():
+    src = (
+        "import time\n"
+        "def low():\n"
+        "    return time.time()\n"        # DET001 flags the direct site
+        "def mid():\n"
+        "    return low()\n"              # DET101: clean-looking carrier
+        "def top():\n"
+        "    return mid()\n"              # DET101: two frames above
+    )
+    findings = lint_source(src, "server/x.py")
+    det101 = [f for f in findings if f.rule == "DET101"]
+    assert [f.line for f in det101] == [5, 7]
+    assert "DET001" in rules_of(findings)
+    # Sanctioning the SOURCE clears the whole cascade (and the pragma is
+    # consumed, not stale).
+    src_ok = src.replace(
+        "    return time.time()\n",
+        "    return time.time()  # fdblint: ignore[DET001]: real-mode stamp\n",
+    )
+    clean = lint_source(src_ok, "server/x.py")
+    assert rules_of(clean) == []
+
+
+def test_det101_source_sanction_spans_multiline_statement():
+    # The pragma sits on the statement's LAST line (the only place it can
+    # on a multiline call): it must clear the DET001 finding AND the
+    # upstream DET101 cascade with the same scope.
+    src = (
+        "import time\n"
+        "def low():\n"
+        "    return (\n"
+        "        time.time()\n"
+        "    )  # fdblint: ignore[DET001]: real-mode stamp\n"
+        "def top():\n"
+        "    return low()\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    assert rules_of(findings) == []
+    assert "DET001" in rules_of(findings, suppressed=True)
+
+
+def test_det101_pragma_on_clean_edge_goes_stale():
+    # An edge-cutting pragma is only CONSUMED when the callee is actually
+    # tainted: once the helper is fixed, the leftover pragma must age
+    # into PRG002 instead of silently sanctioning forever.
+    src = (
+        "def helper(x):\n"
+        "    return x + 1\n"
+        "def top():\n"
+        "    return helper(2)  # fdblint: ignore[DET101]: was tainted once\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    assert "PRG002" in rules_of(findings)
+    assert "DET101" not in rules_of(findings)
+
+
+def test_env001_variants_and_registry_exemption():
+    src = (
+        "import os\n"
+        "def f():\n"
+        "    a = os.environ.get('FDB_TPU_MODE')\n"
+        "    b = os.getenv('FDB_TPU_X', '1')\n"
+        "    c = os.environ['FDB_TPU_Y']\n"
+        "    d = os.environ.get('HOME')\n"
+        "    return a, b, c, d\n"
+    )
+    found = rules_of(lint_source(src, "server/x.py"))
+    assert found.count("ENV001") == 3
+    # The registry module itself is exempt.
+    assert "ENV001" not in rules_of(lint_source(src, "flow/knobs.py"))
+
+
+def test_env_flags_registry_reads_environ_at_call_time(monkeypatch):
+    from foundationdb_tpu.flow.knobs import g_env
+
+    monkeypatch.delenv("FDB_TPU_SEARCH_STRIDE", raising=False)
+    assert g_env.get_int("FDB_TPU_SEARCH_STRIDE") == 512  # declared default
+    monkeypatch.setenv("FDB_TPU_SEARCH_STRIDE", "64")
+    assert g_env.get_int("FDB_TPU_SEARCH_STRIDE") == 64
+    with pytest.raises(KeyError):
+        g_env.get("FDB_TPU_NOT_DECLARED")
+    # Declarations carry docs for status/README enumeration.
+    assert all(h for _d, h in g_env.declared().values())
+
+
+# ---------------------------------------------------------------------------
+# Golden-file corpus: every case dir is a mini scan root; EXPECT markers
+# pin the exact unsuppressed findings, asserted through the real CLI's
+# --format=json output.
+# ---------------------------------------------------------------------------
+
+
+def _expected_markers(case_dir):
+    expected = set()
+    for dirpath, _dirs, files in os.walk(case_dir):
+        for fn in sorted(files):
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(dirpath, fn)
+            rel = os.path.relpath(path, case_dir).replace(os.sep, "/")
+            with open(path, encoding="utf-8") as f:
+                for i, line in enumerate(f, 1):
+                    if "# EXPECT:" in line:
+                        for rule in line.split("# EXPECT:")[1].split(","):
+                            expected.add((rel, i, rule.strip()))
+    return expected
+
+
+@pytest.mark.parametrize(
+    "case", ["wait_rules", "rpy_cases", "det101_pkg", "env_cases"]
+)
+def test_golden_corpus(case, capsys):
+    case_dir = os.path.join(CASES_DIR, case)
+    expected = _expected_markers(case_dir)
+    assert expected, f"corpus case {case} has no EXPECT markers"
+    rc = main([case_dir, "--format=json", "--no-cache"])
+    out = json.loads(capsys.readouterr().out)
+    got = {
+        (f["path"], f["line"], f["rule"])
+        for f in out["findings"]
+        if not f["suppressed"]
+    }
+    assert got == expected, (
+        f"{case}: findings != EXPECT markers\n"
+        f"  unexpected: {sorted(got - expected)}\n"
+        f"  missing:    {sorted(expected - got)}"
+    )
+    assert rc == 1  # every corpus case plants at least one violation
+
+
+def test_det101_interprocedural_acceptance(capsys):
+    """The acceptance criterion verbatim: a sim-reachable function calling
+    a clean-looking helper that calls time.time() two levels down is
+    flagged; the same source reachable only from real-mode backends is
+    not flagged anywhere."""
+    case_dir = os.path.join(CASES_DIR, "det101_pkg")
+    main([case_dir, "--format=json", "--no-cache"])
+    out = json.loads(capsys.readouterr().out)
+    det = [f for f in out["findings"] if f["rule"] == "DET101"]
+    # The sim role's call site is flagged with the full chain spelled out.
+    sim = [f for f in det if f["path"] == "server/sim_role.py"]
+    assert len(sim) == 1 and "time.time" in sim[0]["message"]
+    assert "prep -> shape -> clock_stamp" in sim[0]["message"]
+    # Method-resolution taint: the inherited helper taints Child.run.
+    roles = [f for f in det if f["path"] == "server/roles.py"]
+    assert {f["line"] for f in roles} == {9, 15}
+    # Real-mode modules carry taint but are never flagged; wall_only is
+    # reachable ONLY from real-mode code and appears nowhere.
+    assert not [f for f in out["findings"] if f["path"].startswith("tools/")]
+    assert not any("wall_only" in f["message"] for f in out["findings"])
+
+
+def test_det101_pragma_on_bottom_edge_clears_cascade(tmp_path, capsys):
+    """Compositional pragmas: sanctioning the ONE offending edge (the
+    shape -> clock_stamp call) un-taints every frame above it."""
+    src_dir = os.path.join(CASES_DIR, "det101_pkg")
+    dst = tmp_path / "pkg"
+    shutil.copytree(src_dir, dst)
+    helpers = dst / "flow" / "helpers.py"
+    text = helpers.read_text().replace(
+        "    return clock_stamp(x)  # EXPECT: DET101",
+        "    return clock_stamp(x)  # fdblint: ignore[DET101]: wall stamp is part of the exported record format, not control flow",
+    )
+    helpers.write_text(text)
+    rc = main([str(dst), "--format=json", "--no-cache"])
+    out = json.loads(capsys.readouterr().out)
+    det = [f for f in out["findings"] if f["rule"] == "DET101"]
+    assert det == [], det
+    # The bottom-edge pragma cut a genuinely tainted edge: consumed.  The
+    # UPSTREAM sanctioning pragma in sim_role.py now cuts a clean edge —
+    # redundant, so it ages into PRG002 instead of lingering forever.
+    prg = [f for f in out["findings"] if f["rule"] == "PRG002"]
+    assert [(f["path"], f["line"]) for f in prg] == [("server/sim_role.py", 17)]
+
+
+# ---------------------------------------------------------------------------
+# Project cache: correctness under edits + the tier-1 warm-time budget
+# ---------------------------------------------------------------------------
+
+
+def test_cache_reuses_unchanged_files_and_sees_cross_file_edits(tmp_path):
+    src_dir = os.path.join(CASES_DIR, "det101_pkg")
+    work = tmp_path / "pkg"
+    shutil.copytree(src_dir, work)
+    cache = str(tmp_path / "lint.pkl")
+
+    p1 = Project(str(work), cache_path=cache, use_cache=True)
+    first = p1.lint()
+    assert p1.stats["parsed"] == p1.stats["files"] > 0
+    n_det = len([f for f in first if f.rule == "DET101" and not f.suppressed])
+    assert n_det == 5
+
+    # Warm: same findings, zero parses.
+    p2 = Project(str(work), cache_path=cache, use_cache=True)
+    second = p2.lint()
+    assert p2.stats["parsed"] == 0
+    assert p2.stats["cache_hits"] == p2.stats["files"]
+    assert [f.format() for f in second] == [f.format() for f in first]
+
+    # Cross-file correctness: fix the SOURCE file only — every cached
+    # upstream file's DET101 findings must disappear (the interprocedural
+    # pass runs on cached summaries, it is not per-file-cached).
+    clockbox = work / "tools" / "clockbox.py"
+    clockbox.write_text(
+        "def clock_stamp(x):\n    return (x, 0.0)\n"
+        "def wall_only():\n    return 0.0\n"
+    )
+    p3 = Project(str(work), cache_path=cache, use_cache=True)
+    third = p3.lint()
+    assert p3.stats["parsed"] == 1  # only the edited file re-analyzed
+    assert not [f for f in third if f.rule == "DET101"]
+
+
+def test_touched_but_unchanged_file_stays_cached(tmp_path):
+    src_dir = os.path.join(CASES_DIR, "env_cases")
+    work = tmp_path / "pkg"
+    shutil.copytree(src_dir, work)
+    cache = str(tmp_path / "lint.pkl")
+    Project(str(work), cache_path=cache, use_cache=True).lint()
+    # Touch without changing content: content-hash fallback must hit.
+    target = work / "server" / "config.py"
+    os.utime(target, ns=(1, 1))
+    p = Project(str(work), cache_path=cache, use_cache=True)
+    p.lint()
+    assert p.stats["parsed"] == 0
+
+
+def test_full_repo_warm_lint_under_5s(tmp_path):
+    """The acceptance budget: full-repo lint <= 5s with a warm cache."""
+    cache = str(tmp_path / "repo.pkl")
+    Project(PKG_DIR, cache_path=cache, use_cache=True).lint()  # warm it
+    t0 = time.perf_counter()
+    p = Project(PKG_DIR, cache_path=cache, use_cache=True)
+    findings = p.lint()
+    wall = time.perf_counter() - t0
+    assert p.stats["parsed"] == 0, "cache miss on an unchanged repo"
+    assert not [f for f in findings if not f.suppressed]
+    assert wall <= 5.0, f"warm full-repo lint took {wall:.2f}s (budget 5s)"
+    print(f"\n[fdblint] warm full-repo lint: {wall:.2f}s "
+          f"({p.stats['files']} files cached)", file=sys.__stderr__)
+
+
+def test_per_rule_counts_surface(package_findings):
+    counts = count_by_rule(package_findings)
+    # The suppressed real-mode findings keep these families visible.
+    assert counts["DET001"]["suppressed"] >= 1
+    assert counts["WAIT001"]["suppressed"] >= 1
+    text = format_counts(package_findings)
+    assert "DET001=" in text and "WAIT001=" in text
+
+
+# ---------------------------------------------------------------------------
+# CLI: SARIF output + --changed-only git mode
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_output_shape(capsys):
+    case_dir = os.path.join(CASES_DIR, "env_cases")
+    rc = main([case_dir, "--format=sarif", "--no-cache", "--show-suppressed"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["version"] == "2.1.0"
+    run = out["runs"][0]
+    assert run["tool"]["driver"]["name"] == "fdblint"
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert {"ENV001", "DET101", "WAIT001", "RPY001"} <= rule_ids
+    results = run["results"]
+    flagged = [r for r in results if r["level"] == "error"]
+    assert {r["ruleId"] for r in flagged} == {"ENV001"}
+    loc = flagged[0]["locations"][0]["physicalLocation"]
+    # URIs are CWD-relative (the repo root in CI), NOT scan-root-relative:
+    # GitHub code scanning resolves them against the repository root, so a
+    # 'server/config.py' uri from a subdirectory scan would never attach.
+    expect = os.path.relpath(
+        os.path.join(case_dir, "server", "config.py"), os.getcwd()
+    ).replace(os.sep, "/")
+    assert loc["artifactLocation"]["uri"] == expect
+    assert loc["region"]["startLine"] >= 1
+    # The pragma-suppressed read rides along as a justified suppression.
+    sup = [r for r in results if r.get("suppressions")]
+    assert sup and sup[0]["suppressions"][0]["justification"]
+
+
+def test_changed_only_filters_to_git_diff(tmp_path, capsys):
+    git = shutil.which("git")
+    if git is None:
+        pytest.skip("git unavailable")
+    repo = tmp_path / "repo"
+    pkg = repo / "pkg" / "server"
+    pkg.mkdir(parents=True)
+
+    def run_git(*args):
+        return subprocess.run(
+            [git, "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=repo, capture_output=True, text=True, check=True,
+        )
+
+    clean = pkg / "committed.py"
+    clean.write_text("import os\nA = os.environ.get('FDB_TPU_OLD')\n")
+    run_git("init", "-q")
+    run_git("add", "-A")
+    run_git("commit", "-qm", "seed")
+    # A NEW (untracked) violating file: the only thing reported.
+    dirty = pkg / "fresh.py"
+    dirty.write_text("import os\nB = os.environ.get('FDB_TPU_NEW')\n")
+
+    root = str(repo / "pkg")
+    rc = main([root, "--format=json", "--no-cache", "--changed-only"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    paths = {f["path"] for f in out["findings"]}
+    assert paths == {"server/fresh.py"}
+    # Without the flag, the committed violation reports too.
+    main([root, "--format=json", "--no-cache"])
+    out_full = json.loads(capsys.readouterr().out)
+    assert {f["path"] for f in out_full["findings"]} == {
+        "server/fresh.py", "server/committed.py"
+    }
+
+
+def test_changed_only_does_not_adopt_same_named_deeper_files(tmp_path, capsys):
+    git = shutil.which("git")
+    if git is None:
+        pytest.skip("git unavailable")
+    repo = tmp_path / "repo"
+    pkg = repo / "pkg"
+    (pkg / "server").mkdir(parents=True)
+    # UNCHANGED deeper file with a violation; its path is a suffix of the
+    # changed clean top-level file's path — it must NOT be reported.
+    (pkg / "server" / "config.py").write_text(
+        "import os\nA = os.environ.get('FDB_TPU_DEEP')\n"
+    )
+    subprocess.run([git, "init", "-q"], cwd=repo, check=True)
+    subprocess.run([git, "add", "-A"], cwd=repo, check=True)
+    subprocess.run(
+        [git, "-c", "user.email=t@t", "-c", "user.name=t",
+         "commit", "-qm", "seed"], cwd=repo, check=True,
+    )
+    (pkg / "config.py").write_text("X = 1\n")  # changed, clean
+    rc = main([str(pkg), "--format=json", "--no-cache", "--changed-only"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert out["findings"] == []
+
+
+def test_changed_only_outside_git_falls_back_to_full_scan(tmp_path, capsys):
+    # A scan root that is NOT a git checkout (exported tarball, bare
+    # worktree in CI) must fall back to the full scan — silently dropping
+    # every finding would turn the gate permanently green.
+    pkg = tmp_path / "pkg" / "server"
+    pkg.mkdir(parents=True)
+    (pkg / "cfg.py").write_text(
+        "import os\nA = os.environ.get('FDB_TPU_X')\n"
+    )
+    rc = main([str(tmp_path / "pkg"), "--format=json", "--no-cache",
+               "--changed-only"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert {f["path"] for f in out["findings"]} == {"server/cfg.py"}
+
+
+def test_new_rules_registered_and_documented():
+    for rule in ("WAIT001", "WAIT002", "DET101", "RPY001", "ENV001"):
         assert rule in RULES and RULES[rule]
