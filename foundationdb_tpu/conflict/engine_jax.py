@@ -33,9 +33,11 @@ and verified by differential tests (tests/test_conflict_jax.py).
 
 from __future__ import annotations
 
+import inspect
 import math
+import os
 from functools import partial
-from typing import List, Optional
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -211,7 +213,10 @@ def _compact_to(pos, valid, words, width, fill_vers=None, vers=None,
     res = jax.lax.sort(ops, num_keys=1, is_stable=True)
     out = jnp.stack(res[1 : 1 + words.shape[0]])[:, :width]
     if count is not None:
-        live = jnp.arange(width) < count
+        # Explicit 32-bit index math here and below (jaxcheck JXP004):
+        # bare arange/cumsum/sum default to 64-bit under x64 and would
+        # silently double every H-sized index buffer.
+        live = jnp.arange(width, dtype=jnp.int32) < count
         out = jnp.where(live[None, :], out, inf32)
         if vers is not None:
             v = jnp.where(live, res[-1][:width], fill_vers)
@@ -226,17 +231,18 @@ def _evict_rule(merged_vers, merged_count, new_oldest, width):
     drop boundary i iff vers[i] and vers[i-1] are both below the window).
     Returns (keep2, rank2, out_count)."""
     H = width
-    mvalid = jnp.arange(H) < merged_count
+    idx = jnp.arange(H, dtype=jnp.int32)
+    mvalid = idx < merged_count
     prev_v = jnp.concatenate(
         [jnp.full((1,), FLOOR_REL, jnp.int32), merged_vers[:-1]]
     )
     keep2 = mvalid & (
-        (jnp.arange(H) == 0)
+        (idx == 0)
         | (merged_vers >= new_oldest)
         | (prev_v >= new_oldest)
     )
-    rank2 = jnp.cumsum(keep2) - 1
-    out_count = jnp.sum(keep2)
+    rank2 = jnp.cumsum(keep2, dtype=jnp.int32) - 1
+    out_count = jnp.sum(keep2, dtype=jnp.int32)
     return keep2, rank2, out_count
 
 
@@ -535,8 +541,8 @@ def _merge_new_segments(
     nperm = nres[-1]
     new_keys_s = jnp.stack(nres[:kw1])
     new_vers_s = new_vers[nperm]
-    nnew = jnp.sum(new_vld)
-    new_valid_s = jnp.arange(n_new_cap) < nnew
+    nnew = jnp.sum(new_vld, dtype=jnp.int32)
+    new_valid_s = jnp.arange(n_new_cap, dtype=jnp.int32) < nnew
     # Ranks of the SORTED new keys by permuting the interleaved ranks
     # (invalid rows carry their raw ub/ue rank instead of an INF rank —
     # harmless, they are masked by new_valid_s at every use).
@@ -593,7 +599,7 @@ def _merge_new_segments(
     count_kept_less = t_rank - removed_at_t
     pos_new = jnp.arange(n_new_cap, dtype=jnp.int32) + count_kept_less
 
-    merged_count = jnp.sum(keep_old) + nnew
+    merged_count = jnp.sum(keep_old, dtype=jnp.int32) + nnew
     merged_keys, merged_vers = _compact_to(
         jnp.concatenate([pos_old, pos_new]),
         jnp.concatenate([keep_old, new_valid_s]),
@@ -783,7 +789,7 @@ def _major_compact(hk, hv, hc, dk, dv, dc, new_oldest, *, H, D, kw1):
     one query per history row — so the only H-sized non-streaming ops are
     the two compact_to sorts whose amortization is this tier's purpose."""
     NEG = jnp.int32(FLOOR_REL)
-    dvalid = jnp.arange(D) < dc
+    dvalid = jnp.arange(D, dtype=jnp.int32) < dc
     dl = searchsorted_words(hk, dk, "left")
     dr = searchsorted_words(hk, dk, "right")
     covered = dvalid & (dv > NEG)
@@ -798,7 +804,7 @@ def _major_compact(hk, hv, hc, dk, dv, dc, new_oldest, *, H, D, kw1):
         .add(jnp.where(covered, -1, 0))
     )
     in_cov = jnp.cumsum(cov_diff[:H]) > 0
-    base_valid = jnp.arange(H) < hc
+    base_valid = jnp.arange(H, dtype=jnp.int32) < hc
     keep_base = base_valid & ~in_cov
     ckb = jnp.cumsum(keep_base.astype(jnp.int32))  # prefix-inclusive
 
@@ -819,7 +825,8 @@ def _major_compact(hk, hv, hc, dk, dv, dc, new_oldest, *, H, D, kw1):
     pos_base = (ckb - 1) + cnt_delta_leq
     cnt_base_less = jnp.where(dl > 0, ckb[jnp.clip(dl - 1, 0, H - 1)], 0)
     pos_delta = (jnp.cumsum(keep_delta.astype(jnp.int32)) - 1) + cnt_base_less
-    merged_count = jnp.sum(keep_base) + jnp.sum(keep_delta)
+    merged_count = (jnp.sum(keep_base, dtype=jnp.int32)
+                    + jnp.sum(keep_delta, dtype=jnp.int32))
     mk, mv = _compact_to(
         jnp.concatenate([pos_base, pos_delta]),
         jnp.concatenate([keep_base, keep_delta]),
@@ -989,14 +996,44 @@ def detect_core_tiered(
     )
 
 
-# Jitted single-device entry point; detect_core stays undecorated so the
-# sharded resolver (parallel/sharded_resolver.py) can call it inside
-# shard_map with per-shard clipped inputs.
-_detect_step = partial(
-    jax.jit,
-    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap"),
-    donate_argnames=("hkeys", "hvers", "hcount", "oldest"),
-)(detect_core)
+# NOTE detect_core stays undecorated so the sharded resolver
+# (parallel/sharded_resolver.py) can call it inside shard_map with
+# per-shard clipped inputs; the jitted single-device entries are the blob
+# steps below (the old `_detect_step` alias was dead code and is gone).
+
+
+# ---------------------------------------------------------------------------
+# Carried-state maintenance bodies.  These used to be eager jnp ops on the
+# host wrapper; as jitted, registered entry points they are (a) donation-
+# audited by jaxcheck (JXP003 — rebase now reuses the carried buffer in
+# place instead of holding old + temp + new H-sized arrays live at once,
+# the HBM-doubling class) and (b) fingerprinted, so a change to their
+# compiled shape shows up in the committed baseline diff like any other
+# device program.
+# ---------------------------------------------------------------------------
+
+
+def _rebase_core(vers, d):
+    """Window rebase: shift a carried version array down by `d`, clamping
+    at the floor.  Rebase commutes with max, so one body serves hvers,
+    the delta tier, and the carried max-table."""
+    return jnp.maximum(vers - d, FLOOR_REL)
+
+
+_rebase_step = partial(jax.jit, donate_argnames=("vers",))(_rebase_core)
+
+
+def _grow_core(buf, *, pad, fill):
+    """Capacity growth: extend a carried array's minor axis by `pad`
+    sentinel-filled columns.  XLA cannot alias a donated buffer into an
+    output of a different shape, so the input is deliberately NOT donated
+    — the transient old+new residency is inherent to reallocation (see
+    the jaxcheck pragma at the registration builder)."""
+    ext = jnp.full(buf.shape[:-1] + (pad,), fill, buf.dtype)
+    return jnp.concatenate([buf, ext], axis=-1)
+
+
+_grow_step = partial(jax.jit, static_argnames=("pad", "fill"))(_grow_core)
 
 
 def _blob_offsets(txn_cap: int, rr_cap: int, wr_cap: int, kw1: int):
@@ -1099,6 +1136,269 @@ _tiered_blob_step = partial(
     donate_argnames=("hkeys", "hvers", "hcount", "maxtab", "dkeys", "dvers",
                      "dcount", "oldest"),
 )(_tiered_blob_core)
+
+
+# ---------------------------------------------------------------------------
+# Device entry-point registry (jaxcheck, tools/lint/jaxir.py).  Every jitted
+# program that runs against carried engine state registers here with enough
+# metadata to be traced ON CPU (no device needed), statically audited
+# (JXP001-005: H-sized work placement, host transfers, donation, dtype
+# widenings, shape bucketing) and structurally fingerprinted against the
+# committed baselines in tests/jax_fingerprints/.  Registration records a
+# BUILDER and is free at import; tracing happens only when the analysis
+# asks for it.
+# ---------------------------------------------------------------------------
+
+# Canonical trace shapes for registered entry points: modest, CPU-traceable,
+# H strictly above every batch-domain dim so size-classing is unambiguous.
+# Tracing cost depends on graph size, not these values.
+EP_TXN, EP_RR, EP_WR = 32, 128, 64
+EP_H, EP_D, EP_KW1 = 4096, 256, 4
+EP_BUCKET_MIN = 8  # PackedBatch bucket floor (bucket_mins default)
+
+
+class DeviceEntryPoint:
+    """One registered device program.
+
+    `builder() -> (fn, jitted_or_None, example_args, static_kwargs)`:
+    `fn` is the UNJITTED callable (make_jaxpr), `jitted` the real jit
+    wrapper whose lowering is the donation ground truth (None for bodies
+    that only run inside another entry, e.g. the compaction body).
+
+    The static contract jaxcheck enforces:
+      carried           arg names of mutable carried state: MUST be donated
+      pinned            arg names of carried read-only state (reused next
+                        step): must NOT be donated
+      size_classes      ((name, threshold) descending) for the fingerprint
+                        histogram's size-class axis
+      h_threshold       the "H-sized" line for JXP001/JXP004
+      compaction_gated  True: work prims >= h_threshold must live inside a
+                        lax.cond branch (the tiered steady-state bound)
+      work_bound        max legitimate work-prim operand dim anywhere
+                        (catches per-shard code touching global-width data)
+      bucket_dims       {name: (value, pow2_floor)} static dims that form
+                        the jit cache key — JXP005 rejects un-bucketed ones
+
+    Findings attach to the builder's def lines, so a
+    `# jaxcheck: ignore[JXP...]: reason` pragma anywhere on the builder
+    suppresses for exactly that one entry.
+    """
+
+    def __init__(self, name: str, builder: Callable, *, arg_names,
+                 carried=(), pinned=(), size_classes, h_threshold: int,
+                 compaction_gated: bool = False, work_bound=None,
+                 bucket_dims=None):
+        self.name = name
+        self.builder = builder
+        self.arg_names = tuple(arg_names)
+        self.carried = tuple(carried)
+        self.pinned = tuple(pinned)
+        self.size_classes = tuple(size_classes)
+        self.h_threshold = h_threshold
+        self.compaction_gated = compaction_gated
+        self.work_bound = work_bound
+        self.bucket_dims = dict(bucket_dims or {})
+        src = inspect.getsourcefile(builder) or "<unknown>"
+        try:
+            lines, lineno = inspect.getsourcelines(builder)
+        except OSError:
+            lines, lineno = [], 0
+        pkg_dir = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        rel = os.path.relpath(os.path.abspath(src), pkg_dir)
+        self.path = (
+            rel.replace(os.sep, "/")
+            if not rel.startswith("..")
+            else os.path.abspath(src).replace(os.sep, "/")
+        )
+        self.line = lineno
+        self.end_line = lineno + max(0, len(lines) - 1)
+        self._built = None
+        self._jaxpr = None
+        self._jaxpr_x64 = None
+        self._donation = None
+
+    def built(self):
+        if self._built is None:
+            self._built = self.builder()
+        return self._built
+
+    def jaxpr(self):
+        if self._jaxpr is None:
+            fn, _jitted, args, statics = self.built()
+            self._jaxpr = jax.make_jaxpr(partial(fn, **statics))(*args)
+        return self._jaxpr
+
+    def jaxpr_x64(self):
+        """Re-trace under enable_x64 — the widening audit's (JXP004) view:
+        dtype-less index math that silently stays 32-bit in the default
+        config widens H-sized buffers to 64-bit here."""
+        if self._jaxpr_x64 is None:
+            fn, _jitted, args, statics = self.built()
+            with jax.experimental.enable_x64():
+                self._jaxpr_x64 = jax.make_jaxpr(partial(fn, **statics))(*args)
+        return self._jaxpr_x64
+
+    def donation(self) -> Optional[Dict[str, bool]]:
+        """arg name -> donated, read from the ACTUAL jit wrapper's lowering
+        (ground truth, not a redeclaration); None when there is no jit
+        wrapper of its own."""
+        if self._donation is None:
+            import warnings
+
+            _fn, jitted, args, statics = self.built()
+            if jitted is None:
+                return None
+            with warnings.catch_warnings():
+                # A mis-donated program is exactly what the audit reports
+                # as a JXP003 finding; jax's own donation UserWarning
+                # during this analysis lowering is duplicate noise.
+                warnings.simplefilter("ignore")
+                lowered = jitted.lower(*args, **statics)
+            leaves = jax.tree_util.tree_leaves(lowered.args_info)
+            assert len(leaves) == len(self.arg_names), (
+                self.name, len(leaves), self.arg_names)
+            self._donation = {
+                n: bool(info.donated)
+                for n, info in zip(self.arg_names, leaves)
+            }
+        return self._donation
+
+
+DEVICE_ENTRY_POINTS: Dict[str, DeviceEntryPoint] = {}
+
+
+def register_entry_point(name: str, builder: Callable, *, registry=None,
+                         **meta) -> DeviceEntryPoint:
+    ep = DeviceEntryPoint(name, builder, **meta)
+    (DEVICE_ENTRY_POINTS if registry is None else registry)[name] = ep
+    return ep
+
+
+def _ep_blob_sds():
+    _offs, total = _blob_offsets(EP_TXN, EP_RR, EP_WR, EP_KW1)
+    return jax.ShapeDtypeStruct((total,), jnp.uint32)
+
+
+def _ep_flat_step():
+    sds = jax.ShapeDtypeStruct
+    args = (
+        sds((EP_KW1, EP_H), jnp.uint32),   # hkeys
+        sds((EP_H,), jnp.int32),           # hvers
+        sds((), jnp.int32),                # hcount
+        sds((), jnp.int32),                # oldest
+        _ep_blob_sds(),                    # blob
+    )
+    statics = dict(txn_cap=EP_TXN, rr_cap=EP_RR, wr_cap=EP_WR, h_cap=EP_H,
+                   kw1=EP_KW1, amortized=False)
+    return _blob_core, _blob_step, args, statics
+
+
+def _ep_tiered_step():
+    sds = jax.ShapeDtypeStruct
+    lmax = max(1, math.ceil(math.log2(EP_H)))
+    args = (
+        sds((EP_KW1, EP_H), jnp.uint32),       # hkeys
+        sds((EP_H,), jnp.int32),               # hvers
+        sds((), jnp.int32),                    # hcount
+        sds((lmax + 1, EP_H), jnp.int32),      # maxtab (carried)
+        sds((EP_KW1, EP_D), jnp.uint32),       # dkeys
+        sds((EP_D,), jnp.int32),               # dvers
+        sds((), jnp.int32),                    # dcount
+        sds((), jnp.int32),                    # oldest
+        _ep_blob_sds(),                        # blob
+    )
+    statics = dict(txn_cap=EP_TXN, rr_cap=EP_RR, wr_cap=EP_WR, h_cap=EP_H,
+                   d_cap=EP_D, kw1=EP_KW1)
+    return _tiered_blob_core, _tiered_blob_step, args, statics
+
+
+def _ep_compact_body():
+    sds = jax.ShapeDtypeStruct
+    args = (
+        sds((EP_KW1, EP_H), jnp.uint32), sds((EP_H,), jnp.int32),
+        sds((), jnp.int32),
+        sds((EP_KW1, EP_D), jnp.uint32), sds((EP_D,), jnp.int32),
+        sds((), jnp.int32),
+        sds((), jnp.int32),               # new_oldest
+    )
+    return _major_compact, None, args, dict(H=EP_H, D=EP_D, kw1=EP_KW1)
+
+
+def _ep_rebase_body():
+    sds = jax.ShapeDtypeStruct
+    return _rebase_core, _rebase_step, (
+        sds((EP_H,), jnp.int32), sds((), jnp.int32)), {}
+
+
+def _ep_grow_body():  # jaxcheck: ignore[JXP003]: growth reallocates to a larger shape — XLA cannot alias donated buffers across shapes, so the transient old+new residency is inherent to _grow
+    sds = jax.ShapeDtypeStruct
+    return _grow_core, _grow_step, (
+        sds((EP_KW1, EP_H), jnp.uint32),), dict(pad=EP_H,
+                                                fill=int(keylib.INF_WORD))
+
+
+_EP_BUCKETS = {
+    "txn_cap": (EP_TXN, EP_BUCKET_MIN),
+    "rr_cap": (EP_RR, EP_BUCKET_MIN),
+    "wr_cap": (EP_WR, EP_BUCKET_MIN),
+    "h_cap": (EP_H, 64),
+}
+
+register_entry_point(
+    "flat_step", _ep_flat_step,
+    arg_names=("hkeys", "hvers", "hcount", "oldest", "blob"),
+    carried=("hkeys", "hvers", "hcount", "oldest"),
+    size_classes=(("H", EP_H), ("P", 2 * (EP_RR + EP_WR)), ("batch", EP_TXN)),
+    h_threshold=EP_H,
+    # The flat engine IS full-width by design (merge sorts over H + 2*WR);
+    # the bound still rejects anything beyond that legitimate width.
+    work_bound=EP_H + 4 * EP_WR,
+    bucket_dims=_EP_BUCKETS,
+)
+
+register_entry_point(
+    "tiered_step", _ep_tiered_step,
+    arg_names=("hkeys", "hvers", "hcount", "maxtab", "dkeys", "dvers",
+               "dcount", "oldest", "blob"),
+    carried=("hkeys", "hvers", "hcount", "maxtab", "dkeys", "dvers",
+             "dcount", "oldest"),
+    size_classes=(("H", EP_H), ("P", 2 * (EP_RR + EP_WR)), ("D", EP_D),
+                  ("batch", EP_TXN)),
+    h_threshold=EP_H,
+    compaction_gated=True,  # steady state is delta-bounded (perf_smoke)
+    work_bound=EP_H + EP_D + 4 * EP_WR,
+    bucket_dims=dict(_EP_BUCKETS, d_cap=(EP_D, 64)),
+)
+
+register_entry_point(
+    "compact_body", _ep_compact_body,
+    arg_names=("hk", "hv", "hc", "dk", "dv", "dc", "new_oldest"),
+    # Runs only inside the tiered step's cond, which owns donation.
+    size_classes=(("H", EP_H), ("D", EP_D), ("batch", EP_TXN)),
+    h_threshold=EP_H,
+    work_bound=EP_H + EP_D,
+    bucket_dims=dict(h_cap=(EP_H, 64), d_cap=(EP_D, 64)),
+)
+
+register_entry_point(
+    "rebase_body", _ep_rebase_body,
+    arg_names=("vers", "d"),
+    carried=("vers",),
+    size_classes=(("H", EP_H),),
+    h_threshold=EP_H,
+    work_bound=EP_H,
+    bucket_dims=dict(h_cap=(EP_H, 64)),
+)
+
+register_entry_point(
+    "grow_body", _ep_grow_body,
+    arg_names=("buf",),
+    carried=("buf",),
+    size_classes=(("H", EP_H),),
+    h_threshold=EP_H,
+    work_bound=2 * EP_H,  # the reallocation concat's output IS old+pad
+    bucket_dims=dict(h_cap=(EP_H, 64)),
+)
 
 
 def _build_max_table_np(values: np.ndarray) -> np.ndarray:
@@ -1270,12 +1570,15 @@ class JaxConflictSet:
             if d > 0:
                 self._check_fault("rebase")
                 self.metrics.counter("rebases").add()
-                self._hvers = jnp.maximum(self._hvers - d, FLOOR_REL)
+                # _rebase_step donates, so the shift rewrites the carried
+                # arrays in place instead of holding old+temp+new H-sized
+                # buffers live at once (jaxcheck JXP003).
+                self._hvers = _rebase_step(self._hvers, d)
                 if self.tiered:
                     # Rebase commutes with max, so the carried table and
                     # the delta shift by the same constant — no rebuild.
-                    self._dvers = jnp.maximum(self._dvers - d, FLOOR_REL)
-                    self._maxtab = jnp.maximum(self._maxtab - d, FLOOR_REL)
+                    self._dvers = _rebase_step(self._dvers, d)
+                    self._maxtab = _rebase_step(self._maxtab, d)
                 self._oldest = self._oldest - d
                 self._base += d
         if self.tiered:
@@ -1334,15 +1637,10 @@ class JaxConflictSet:
     def _grow(self, new_cap: int, rebuild_maxtab: bool = True):
         self._check_fault("grow")
         self.metrics.counter("grows").add()
-        kw1 = self.key_words + 1
         pad = new_cap - self.h_cap
-        self._hkeys = jnp.concatenate(
-            [self._hkeys, jnp.full((kw1, pad), keylib.INF_WORD, jnp.uint32)],
-            axis=1,
-        )
-        self._hvers = jnp.concatenate(
-            [self._hvers, jnp.full((pad,), FLOOR_REL, jnp.int32)]
-        )
+        self._hkeys = _grow_step(self._hkeys, pad=pad,
+                                 fill=int(keylib.INF_WORD))
+        self._hvers = _grow_step(self._hvers, pad=pad, fill=FLOOR_REL)
         self.h_cap = new_cap
         if self.tiered and rebuild_maxtab:
             # The carried table's level count is a function of h_cap —
@@ -1361,15 +1659,10 @@ class JaxConflictSet:
         recompile-causing reallocation choke point."""
         self._check_fault("grow")
         self.metrics.counter("grows").add()
-        kw1 = self.key_words + 1
         pad = new_cap - self.d_cap
-        self._dkeys = jnp.concatenate(
-            [self._dkeys, jnp.full((kw1, pad), keylib.INF_WORD, jnp.uint32)],
-            axis=1,
-        )
-        self._dvers = jnp.concatenate(
-            [self._dvers, jnp.full((pad,), FLOOR_REL, jnp.int32)]
-        )
+        self._dkeys = _grow_step(self._dkeys, pad=pad,
+                                 fill=int(keylib.INF_WORD))
+        self._dvers = _grow_step(self._dvers, pad=pad, fill=FLOOR_REL)
         self.d_cap = new_cap
 
     # -- detection --

@@ -236,3 +236,6 @@ g_env.declare("FDB_TPU_DELTA_CAP", "0",
 g_env.declare("FDB_TPU_EVICT_EVERY", "1",
               help="evict cadence in batches; in tiered mode the alias "
                    "for major-compaction cadence")
+g_env.declare("FDB_TPU_JAXCHECK_DIR", "",
+              help="jaxcheck fingerprint baseline directory override "
+                   "(default: tests/jax_fingerprints next to the package)")

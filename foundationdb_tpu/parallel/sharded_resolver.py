@@ -52,11 +52,18 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..conflict import keys as keylib
 from ..conflict.engine_jax import (
+    EP_KW1,
+    EP_RR,
+    EP_TXN,
+    EP_WR,
     FLOOR_REL,
     REBASE_THRESHOLD,
     PackedBatch,
+    _grow_step,
     _next_pow2,
+    _rebase_step,
     detect_core,
+    register_entry_point,
 )
 from ..conflict.types import TransactionConflictInfo
 from ..ops.rangequery import lex_less
@@ -314,27 +321,23 @@ class ShardedJaxConflictSet:
         if now - self._base > REBASE_THRESHOLD:
             d = int(np.min(np.asarray(self._oldest)))
             if d > 0:
-                self._hvers = jnp.maximum(self._hvers - d, FLOOR_REL)
+                # Donating rebase body shared with the single-device
+                # engine (jaxcheck-registered: rebase_body).
+                self._hvers = _rebase_step(self._hvers, d)
                 self._oldest = self._oldest - d
                 self._base += d
         if int(np.max(np.asarray(self._hcount))) + 2 * wr_cap + 2 > self.h_cap:
             self._grow(max(self.h_cap * 2, self.h_cap + 4 * wr_cap))
 
     def _grow(self, new_cap: int):
-        S, kw1 = self.n_shards, self.key_words + 1
         pad = new_cap - self.h_cap
         put = partial(jax.device_put, device=self._shardspec)
+        # Shared grow body (jaxcheck-registered: grow_body); the minor
+        # axis is the per-shard history for both state blocks.
         self._hkeys = put(
-            jnp.concatenate(
-                [self._hkeys, jnp.full((S, kw1, pad), keylib.INF_WORD, jnp.uint32)],
-                axis=2,
-            )
+            _grow_step(self._hkeys, pad=pad, fill=int(keylib.INF_WORD))
         )
-        self._hvers = put(
-            jnp.concatenate(
-                [self._hvers, jnp.full((S, pad), FLOOR_REL, jnp.int32)], axis=1
-            )
-        )
+        self._hvers = put(_grow_step(self._hvers, pad=pad, fill=FLOOR_REL))
         self.h_cap = new_cap
         self._steps.clear()
 
@@ -723,3 +726,73 @@ class ShardedJaxConflictSet:
         self._hvers = put(jnp.asarray(hvers))
         self._hcount = put(jnp.asarray(counts))
         self._oldest = put(jnp.zeros((S,), jnp.int32))
+
+
+# ---------------------------------------------------------------------------
+# jaxcheck entry-point registration (tools/lint/jaxir.py): the shard_map
+# step is traced at a canonical 2-shard mesh on virtual CPU devices, so the
+# per-shard structural invariants — no work primitive wider than ONE
+# shard's history slice (a global-width op inside shard_map would show up
+# as S*h_cap-sized), carried state donated, pinned shard bounds NOT
+# donated — hold statically before any multi-chip run (ROADMAP item 2's
+# static down-payment).
+# ---------------------------------------------------------------------------
+
+EP_SHARDS, EP_SHARD_H = 2, 2048
+
+
+def _ep_sharded_step():
+    devs = jax.devices()
+    if len(devs) < EP_SHARDS:
+        raise RuntimeError(
+            f"sharded_step entry needs >= {EP_SHARDS} devices to trace; "
+            "set XLA_FLAGS=--xla_force_host_platform_device_count=8 "
+            "(tests/conftest.py and the jaxir CLI both do)"
+        )
+    mesh = Mesh(np.array(devs[:EP_SHARDS]), (AXIS,))
+    jitted = _make_sharded_step(mesh, EP_TXN, EP_RR, EP_WR, EP_SHARD_H)
+    sds = jax.ShapeDtypeStruct
+    S, kw1 = EP_SHARDS, EP_KW1
+    u32, i32 = jnp.uint32, jnp.int32
+    args = (
+        sds((S, kw1), u32),                 # lo
+        sds((S, kw1), u32),                 # hi
+        sds((S, kw1, EP_SHARD_H), u32),     # hkeys
+        sds((S, EP_SHARD_H), i32),          # hvers
+        sds((S,), i32),                     # hcount
+        sds((S,), i32),                     # oldest
+        sds((kw1, EP_RR), u32),             # r_begin
+        sds((kw1, EP_RR), u32),             # r_end
+        sds((EP_RR,), i32),                 # r_txn
+        sds((EP_RR,), i32),                 # r_snap
+        sds((kw1, EP_WR), u32),             # w_begin
+        sds((kw1, EP_WR), u32),             # w_end
+        sds((EP_WR,), i32),                 # w_txn
+        sds((EP_TXN,), i32),                # t_snap
+        sds((EP_TXN,), jnp.bool_),          # t_valid
+        sds((), i32),                       # now_rel
+        sds((), i32),                       # new_oldest_rel
+    )
+    return jitted.__wrapped__, jitted, args, {}
+
+
+register_entry_point(
+    "sharded_step", _ep_sharded_step,
+    arg_names=("lo", "hi", "hkeys", "hvers", "hcount", "oldest",
+               "r_begin", "r_end", "r_txn", "r_snap",
+               "w_begin", "w_end", "w_txn",
+               "t_snap", "t_valid", "now_rel", "new_oldest_rel"),
+    carried=("hkeys", "hvers", "hcount", "oldest"),
+    pinned=("lo", "hi"),
+    size_classes=(("H", EP_SHARD_H), ("P", 2 * (EP_RR + EP_WR)),
+                  ("batch", EP_TXN)),
+    h_threshold=EP_SHARD_H,
+    # Per-shard width bound: the flat engine's legitimate full-width merge
+    # at ONE shard's h_cap.  Anything wider means a primitive is touching
+    # globally-sized (S*h_cap) data inside the shard_map body.
+    work_bound=EP_SHARD_H + 4 * EP_WR,
+    bucket_dims={
+        "txn_cap": (EP_TXN, 8), "rr_cap": (EP_RR, 8), "wr_cap": (EP_WR, 8),
+        "h_cap": (EP_SHARD_H, 64),
+    },
+)

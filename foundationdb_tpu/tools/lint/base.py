@@ -216,16 +216,22 @@ class LintConfig:
     )
 
     @classmethod
-    def load(cls, path: str, use_defaults: bool = True) -> "LintConfig":
+    def load(
+        cls, path: str, use_defaults: bool = True,
+        rules: Optional[Dict[str, str]] = None,
+    ) -> "LintConfig":
         """JSON config {"allow": {"RULE": ["glob", ...]}}, merged over (or
-        replacing, with use_defaults=False) the built-in allowlist."""
+        replacing, with use_defaults=False) the built-in allowlist.
+        `rules` is the rule universe to validate against (default: the
+        source-level RULES registry; jaxcheck passes JAX_RULES)."""
         with open(path, "r", encoding="utf-8") as f:
             raw = json.load(f)
         base: Dict[str, Tuple[str, ...]] = (
             {k: tuple(v) for k, v in DEFAULT_ALLOW.items()} if use_defaults else {}
         )
+        known = set(RULES if rules is None else rules)
         for rule, globs in raw.get("allow", {}).items():
-            if rule not in RULES:
+            if rule not in known:
                 raise ValueError(f"config allowlists unknown rule {rule!r}")
             base[rule] = tuple(base.get(rule, ())) + tuple(globs)
         return cls(allow=base)
@@ -238,9 +244,22 @@ class LintConfig:
 # Pragmas
 # ---------------------------------------------------------------------------
 
-_PRAGMA_RE = re.compile(
-    r"#\s*fdblint:\s*ignore\[(?P<rules>[A-Z0-9,\s]+)\](?:\s*:\s*(?P<reason>.*\S))?"
-)
+# One pragma grammar, two tool namespaces: source-level findings use
+# `# fdblint: ignore[...]`, jaxpr-level findings (tools/lint/jaxir.py) use
+# `# jaxcheck: ignore[...]`.  Separate markers keep the two passes from
+# policing each other's pragmas as stale (each pass only parses its own).
+_PRAGMA_RES: Dict[str, "re.Pattern"] = {}
+
+
+def _pragma_re(tool: str) -> "re.Pattern":
+    pat = _PRAGMA_RES.get(tool)
+    if pat is None:
+        pat = re.compile(
+            r"#\s*" + re.escape(tool)
+            + r":\s*ignore\[(?P<rules>[A-Z0-9,\s]+)\](?:\s*:\s*(?P<reason>.*\S))?"
+        )
+        _PRAGMA_RES[tool] = pat
+    return pat
 
 
 @dataclass
@@ -251,15 +270,16 @@ class Pragma:
     used: bool = False
 
 
-def parse_pragmas(source: str) -> Dict[int, Pragma]:
+def parse_pragmas(source: str, tool: str = "fdblint") -> Dict[int, Pragma]:
     """Pragmas from REAL comment tokens only: a pragma example quoted in a
     docstring or string literal must not register (it would then be
     reported as stale PRG002 with no way to appease it)."""
+    pat = _pragma_re(tool)
     pragmas: Dict[int, Pragma] = {}
     for tok in tokenize.generate_tokens(io.StringIO(source).readline):
         if tok.type != tokenize.COMMENT:
             continue
-        m = _PRAGMA_RE.search(tok.string)
+        m = pat.search(tok.string)
         if not m:
             continue
         line = tok.start[0]
@@ -280,13 +300,17 @@ def pragma_sanctions(
 
 
 def apply_pragmas(
-    findings: List[Finding], pragmas: Dict[int, Pragma], relpath: str
+    findings: List[Finding], pragmas: Dict[int, Pragma], relpath: str,
+    rules: Optional[Dict[str, str]] = None,
 ) -> List[Finding]:
     """Mark findings suppressed by same-line (or same-statement-span)
     pragmas, then police the pragmas themselves: PRG001 (no reason) and
     PRG002 (suppresses nothing / unknown rule) are never suppressible.
     Must run ONCE per file over the findings of EVERY pass, or a pragma
-    that only suppresses an interprocedural finding would look stale."""
+    that only suppresses an interprocedural finding would look stale.
+    `rules` is the rule universe the unknown-rule check validates against
+    (default: the source-level RULES registry; jaxcheck passes its own)."""
+    known = set(RULES if rules is None else rules)
     out: List[Finding] = []
     for f in findings:
         # A pragma anywhere on the flagged statement's physical lines
@@ -301,7 +325,7 @@ def apply_pragmas(
                 break
         out.append(f)
     for p in pragmas.values():
-        unknown = p.rules - set(RULES)
+        unknown = p.rules - known
         if unknown:
             out.append(Finding(
                 "PRG002", relpath, p.line, 0,

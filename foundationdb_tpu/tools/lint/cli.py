@@ -41,7 +41,18 @@ def format_counts(findings: List[Finding]) -> str:
     return "per-rule (flagged+suppressed): " + " ".join(cells)
 
 
-def to_sarif(shown: List[Finding]) -> dict:
+_TOOL_DOCS = {
+    "fdblint": "README.md#determinism-rules-fdblint",
+    "jaxcheck": "README.md#jaxpr-structural-rules-jaxcheck",
+}
+
+
+def to_sarif(
+    shown: List[Finding],
+    rules: Optional[Dict[str, str]] = None,
+    tool: str = "fdblint",
+) -> dict:
+    rules = RULES if rules is None else rules
     results = []
     for f in shown:
         res = {
@@ -69,11 +80,12 @@ def to_sarif(shown: List[Finding]) -> dict:
         "version": "2.1.0",
         "runs": [{
             "tool": {"driver": {
-                "name": "fdblint",
-                "informationUri": "README.md#determinism-rules-fdblint",
+                "name": tool,
+                "informationUri": _TOOL_DOCS.get(
+                    tool, _TOOL_DOCS["fdblint"]),
                 "rules": [
                     {"id": rule, "shortDescription": {"text": desc}}
-                    for rule, desc in sorted(RULES.items())
+                    for rule, desc in sorted(rules.items())
                 ],
             }},
             "results": results,

@@ -1,0 +1,195 @@
+"""Committed structural fingerprints for registered device entry points.
+
+Each entry in `conflict/engine_jax.py`'s DEVICE_ENTRY_POINTS registry gets
+a JSON fingerprint under tests/jax_fingerprints/: a primitive x
+size-class eqn histogram (split by compaction-cond membership) plus the
+donation and transfer summaries and the canonical abstract signature.
+The jaxcheck gate (tests/test_jaxcheck.py, `pytest -m jaxcheck`) diffs
+the current CPU traces against the committed files, so any kernel or
+sharding PR that changes a compiled program's shape must SAY SO in the
+diff by running the explicit update flow and committing the result:
+
+    python -m foundationdb_tpu.tools.lint.jaxfingerprint --update-baselines
+
+Rewrites are deterministic (sorted keys, fixed layout) — same source +
+same jax version produce byte-identical files, so the diff is exactly
+the structural change.  A registered entry with no baseline is an ERROR
+(not a skip: that is how a new entry point ships un-fingerprinted), and
+a baseline with no registered entry is flagged stale.
+
+The baseline directory resolves to tests/jax_fingerprints next to the
+package, overridable via the registered ``FDB_TPU_JAXCHECK_DIR`` env
+flag (flow/knobs.py g_env).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional
+
+from .jaxir import TRANSFER_PRIMS, _PKG_DIR, _ensure_cpu, default_registry, walk_jaxpr
+
+
+def size_class(dim: int, size_classes) -> str:
+    """Name for a dimension against the entry's descending thresholds."""
+    if dim <= 0:
+        return "scalar"
+    for name, thr in size_classes:
+        if dim >= thr:
+            return f">={name}"
+    return "small"
+
+
+def fingerprint(entry) -> dict:
+    """Structural fingerprint of one entry point's canonical CPU trace."""
+    walked = walk_jaxpr(entry.jaxpr())
+    counts: Dict[str, int] = {}
+    transfers: Dict[str, int] = {}
+    for e in walked:
+        key = f"{e.prim}|{size_class(e.max_dim, entry.size_classes)}"
+        if e.in_cond:
+            key += "|cond"
+        counts[key] = counts.get(key, 0) + 1
+        if e.prim in TRANSFER_PRIMS:
+            transfers[e.prim] = transfers.get(e.prim, 0) + 1
+    don = entry.donation()
+    _fn, _jitted, args, statics = entry.built()
+    return {
+        "entry": entry.name,
+        "path": entry.path,
+        "static": {
+            k: (v if isinstance(v, (int, str, bool)) else str(v))
+            for k, v in sorted(statics.items())
+        },
+        "signature": [
+            f"{a.dtype}[{','.join(str(d) for d in a.shape)}]" for a in args
+        ],
+        "eqn_count": len(walked),
+        "eqns": dict(sorted(counts.items())),
+        "donation": None if don is None else {
+            "donated": sorted(n for n, d in don.items() if d),
+            "not_donated": sorted(n for n, d in don.items() if not d),
+        },
+        "carried": list(entry.carried),
+        "pinned": list(entry.pinned),
+        "transfers": dict(sorted(transfers.items())),
+    }
+
+
+def render(fp: dict) -> str:
+    """Canonical byte-stable serialization (the committed file format)."""
+    return json.dumps(fp, indent=2, sort_keys=True) + "\n"
+
+
+def baseline_dir() -> str:
+    from ...flow.knobs import g_env
+
+    override = g_env.get("FDB_TPU_JAXCHECK_DIR")
+    if override:
+        return override
+    return os.path.join(os.path.dirname(_PKG_DIR), "tests",
+                        "jax_fingerprints")
+
+
+def write_baselines(registry=None, dirpath: Optional[str] = None
+                    ) -> List[str]:
+    """The --update-baselines flow: rewrite every registered entry's
+    fingerprint file.  Returns the written paths (sorted by entry)."""
+    reg = default_registry() if registry is None else registry
+    d = dirpath or baseline_dir()
+    os.makedirs(d, exist_ok=True)
+    written = []
+    for name in sorted(reg):
+        path = os.path.join(d, f"{name}.json")
+        with open(path, "w", encoding="utf-8") as fh:
+            fh.write(render(fingerprint(reg[name])))
+        written.append(path)
+    return written
+
+
+def _flatten(d: dict, prefix: str = "") -> Dict[str, object]:
+    out: Dict[str, object] = {}
+    for k, v in d.items():
+        key = f"{prefix}{k}"
+        if isinstance(v, dict):
+            out.update(_flatten(v, key + "."))
+        else:
+            out[key] = v
+    return out
+
+
+def diff_fingerprints(base: dict, cur: dict) -> List[str]:
+    """Human-readable field-level diff (empty = identical)."""
+    fb, fc = _flatten(base), _flatten(cur)
+    lines: List[str] = []
+    for k in sorted(set(fb) | set(fc)):
+        if k not in fb:
+            lines.append(f"+ {k} = {fc[k]!r} (not in baseline)")
+        elif k not in fc:
+            lines.append(f"- {k} = {fb[k]!r} (gone from current trace)")
+        elif fb[k] != fc[k]:
+            lines.append(f"~ {k}: baseline {fb[k]!r} -> current {fc[k]!r}")
+    return lines
+
+
+def check_baselines(registry=None, dirpath: Optional[str] = None
+                    ) -> List[str]:
+    """Diff every registered entry against its committed baseline.
+    Returns problem lines (empty = clean).  Missing baselines and stale
+    baseline files are both errors."""
+    reg = default_registry() if registry is None else registry
+    d = dirpath or baseline_dir()
+    problems: List[str] = []
+    expected = set()
+    for name in sorted(reg):
+        expected.add(f"{name}.json")
+        path = os.path.join(d, f"{name}.json")
+        if not os.path.exists(path):
+            problems.append(
+                f"{name}: MISSING baseline {path} — a registered entry "
+                f"point must ship a committed fingerprint "
+                f"(--update-baselines, then commit)")
+            continue
+        with open(path, "r", encoding="utf-8") as fh:
+            base = json.load(fh)
+        for line in diff_fingerprints(base, fingerprint(reg[name])):
+            problems.append(f"{name}: {line}")
+    if os.path.isdir(d):
+        for fn in sorted(os.listdir(d)):
+            if fn.endswith(".json") and fn not in expected:
+                problems.append(
+                    f"{fn}: STALE baseline (no registered entry point — "
+                    f"delete it or re-register the entry)")
+    return problems
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jaxfingerprint",
+        description="Check or rewrite the committed structural "
+                    "fingerprints of registered device entry points.",
+    )
+    ap.add_argument("--update-baselines", action="store_true")
+    ap.add_argument("--dir", dest="dirpath",
+                    help="baseline directory (default: "
+                         "tests/jax_fingerprints, or $FDB_TPU_JAXCHECK_DIR)")
+    args = ap.parse_args(argv)
+    _ensure_cpu()
+    if args.update_baselines:
+        for p in write_baselines(dirpath=args.dirpath):
+            print(f"wrote {p}")
+        return 0
+    problems = check_baselines(dirpath=args.dirpath)
+    for line in problems:
+        print(line)
+    if problems:
+        print("fingerprints diverged — if intentional, rerun with "
+              "--update-baselines and commit the diff", file=sys.stderr)
+    return 1 if problems else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via -m
+    sys.exit(main())

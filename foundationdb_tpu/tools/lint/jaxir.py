@@ -1,0 +1,446 @@
+"""jaxcheck — jaxpr/IR structural analysis for registered device programs.
+
+flowcheck (the rest of tools/lint/) gates the SOURCE level; the programs
+that actually run on the TPU are jaxprs, and the regressions that matter
+there — H-sized work leaking out of the compaction cond, host callbacks
+baked into traced code, carried state silently not donated (the
+HBM-doubling class), dtype widenings, un-bucketed static shapes
+(recompile storms) — are invisible to AST analysis.  jaxcheck traces
+every entry point in `conflict/engine_jax.py`'s DEVICE_ENTRY_POINTS
+registry (flat + tiered blob steps, the sharded shard_map step,
+grow/rebase/compaction bodies) ON CPU — no device needed — walks the
+full eqn tree including sub-jaxprs of cond/while/scan/shard_map/pjit
+with ONE shared visitor (`walk_jaxpr`, also used by
+tests/test_perf_smoke.py so the perf gate and jaxcheck cannot drift),
+and enforces the JXP rule family with the same
+Finding/pragma/allowlist/SARIF machinery as flowcheck.
+
+Rules:
+
+  JXP001  work primitive (sort/cumsum/concatenate/scatter/reduce) at or
+          above the entry's H threshold outside the compaction cond
+          (compaction-gated entries), or above the entry's declared
+          width bound anywhere (full-width entries; inside shard_map
+          this catches per-shard code touching globally-sized operands)
+  JXP002  host callback/transfer primitive inside traced code
+          (pure_callback/io_callback/debug prints/infeed — every one is
+          a per-batch device stall)
+  JXP003  carried engine state not donated across steps, or pinned
+          (reused read-only) state donated
+  JXP004  64-bit widening on an H-sized buffer when the entry is traced
+          under x64 — dtype-less index math (bare `jnp.arange(H)`,
+          `cumsum(bool_mask)`) that silently stays 32-bit in the default
+          config but doubles HBM the moment x64 is enabled
+  JXP005  static-signature dimension outside the registered shape-bucket
+          table (every un-bucketed dim is a fresh jit cache key — a
+          recompile storm caught before runtime)
+
+Pragmas use the `# jaxcheck: ignore[JXP...]: reason` namespace —
+distinct from fdblint's marker so neither pass polices the other's
+pragmas as stale — and attach to the entry's BUILDER function: a pragma
+anywhere on the builder's def lines suppresses, scoped to exactly that
+entry.  Structural fingerprints are the companion gate
+(tools/lint/jaxfingerprint.py): committed baselines under
+tests/jax_fingerprints/ are diffed on every run, with an explicit
+``--update-baselines`` flow.
+
+CLI: ``python -m foundationdb_tpu.tools.lint.jaxir
+[--format=text|json|sarif] [--show-suppressed] [--update-baselines]
+[--no-fingerprints] [--list-rules]``; exit 0 iff no unsuppressed
+findings and every fingerprint matches its committed baseline.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Tuple
+
+from .base import Finding, LintConfig, apply_pragmas, parse_pragmas
+
+JAX_RULES: Dict[str, str] = {
+    "JXP001": "H-sized work primitive outside the compaction cond / above the entry's width bound",
+    "JXP002": "host callback/transfer primitive inside a traced device program",
+    "JXP003": "carried state not donated across steps (or pinned state donated)",
+    "JXP004": "64-bit widening on an H-sized buffer under x64 tracing",
+    "JXP005": "static-signature dimension outside the registered shape-bucket table",
+    "PRG001": "jaxcheck ignore pragma carries no reason string",
+    "PRG002": "jaxcheck ignore pragma suppresses nothing (stale)",
+}
+
+# Primitives that do O(n) COMPUTE over their operands (vs read-only
+# gathers, which are how phase 1 legitimately touches the base tier).
+# THE one definition: test_perf_smoke.py's structural gate imports it too.
+WORK_PRIMS = frozenset({
+    "sort", "cumsum", "concatenate", "scatter", "scatter-add",
+    "reduce_max", "reduce_min", "reduce_sum",
+})
+
+# Primitives that move data/control between host and device from inside
+# traced code.
+TRANSFER_PRIMS = frozenset({
+    "pure_callback", "io_callback", "debug_callback", "callback",
+    "outside_call", "infeed", "outfeed", "device_put", "copy_to_host",
+})
+
+_64BIT = frozenset({"int64", "uint64", "float64", "complex128"})
+
+_PKG_DIR = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+# ---------------------------------------------------------------------------
+# The shared jaxpr visitor
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class EqnEntry:
+    """One flattened equation: primitive name, the largest dimension it
+    touches (operands AND results — a concat BUILDING an H-sized array
+    from small pieces is H-sized work), and where it sits in the control
+    tree."""
+
+    prim: str
+    max_dim: int
+    in_cond: bool          # inside any lax.cond branch
+    in_while: bool         # inside a while_loop body/cond
+    depth: int             # sub-jaxpr nesting depth
+    out_dtypes: Tuple[str, ...]
+    wide64_dim: int        # max dim over 64-bit results (0 = none)
+    wide64_dtypes: Tuple[str, ...]
+
+
+def _sub_jaxprs(params):
+    """Every (Closed)Jaxpr reachable from an eqn's params: cond carries
+    `branches`, while `cond_jaxpr`/`body_jaxpr`, scan/pjit a ClosedJaxpr
+    under `jaxpr`, shard_map a raw Jaxpr under `jaxpr`."""
+    for p in params.values():
+        vals = p if isinstance(p, (list, tuple)) else [p]
+        for v in vals:
+            inner = getattr(v, "jaxpr", None)
+            if inner is not None and hasattr(inner, "eqns"):
+                yield inner
+            elif hasattr(v, "eqns"):
+                yield v
+
+
+def walk_jaxpr(jaxpr, *, in_cond: bool = False, in_while: bool = False,
+               depth: int = 0, out: Optional[List[EqnEntry]] = None
+               ) -> List[EqnEntry]:
+    """Flatten a Jaxpr or ClosedJaxpr into EqnEntry rows, descending into
+    every sub-jaxpr and tracking compaction-cond membership."""
+    if out is None:
+        out = []
+    inner = getattr(jaxpr, "jaxpr", None)
+    if inner is not None and hasattr(inner, "eqns"):
+        jaxpr = inner
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        sub_cond = in_cond or name == "cond"
+        sub_while = in_while or name == "while"
+        for sub in _sub_jaxprs(eqn.params):
+            walk_jaxpr(sub, in_cond=sub_cond, in_while=sub_while,
+                       depth=depth + 1, out=out)
+        dims = [
+            max(v.aval.shape)
+            for v in list(eqn.invars) + list(eqn.outvars)
+            if hasattr(v, "aval") and getattr(v.aval, "shape", ())
+        ]
+        outs = [
+            v for v in eqn.outvars
+            if hasattr(v, "aval") and getattr(v.aval, "shape", None) is not None
+        ]
+        wide = sorted({
+            str(v.aval.dtype) for v in outs if str(v.aval.dtype) in _64BIT
+        })
+        wide_dims = [
+            max(v.aval.shape) for v in outs
+            if v.aval.shape and str(v.aval.dtype) in _64BIT
+        ]
+        out.append(EqnEntry(
+            prim=name,
+            max_dim=max(dims, default=0),
+            in_cond=in_cond,
+            in_while=in_while,
+            depth=depth,
+            out_dtypes=tuple(str(v.aval.dtype) for v in outs),
+            wide64_dim=max(wide_dims, default=0),
+            wide64_dtypes=tuple(wide),
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The JXP rule family
+# ---------------------------------------------------------------------------
+
+
+def _finding(entry, rule: str, msg: str) -> Finding:
+    return Finding(rule, entry.path, entry.line, 0,
+                   f"[{entry.name}] {msg}", end_line=entry.end_line)
+
+
+def run_jxp_rules(entries) -> List[Finding]:
+    """Trace each registered entry point and apply JXP001-005.  Raw
+    findings (pragma/allowlist application happens in run_jaxcheck)."""
+    # THE engine's bucketing rule, not a copy: JXP005's alignment check
+    # must follow PackedBatch's real policy if it ever changes.  Lazy so
+    # importing jaxir (e.g. for walk_jaxpr alone) stays jax-free.
+    from ...conflict.engine_jax import _next_pow2
+
+    out: List[Finding] = []
+    for entry in entries:
+        walked = walk_jaxpr(entry.jaxpr())
+
+        # JXP001 — H-sized work placement.
+        for e in walked:
+            if e.prim not in WORK_PRIMS:
+                continue
+            if (entry.compaction_gated and not e.in_cond
+                    and e.max_dim >= entry.h_threshold):
+                out.append(_finding(
+                    entry, "JXP001",
+                    f"H-sized work outside the compaction cond: {e.prim} "
+                    f"over dim {e.max_dim} (H threshold "
+                    f"{entry.h_threshold})"))
+            elif (entry.work_bound is not None
+                    and e.max_dim > entry.work_bound):
+                out.append(_finding(
+                    entry, "JXP001",
+                    f"work primitive above the entry's width bound: "
+                    f"{e.prim} over dim {e.max_dim} (bound "
+                    f"{entry.work_bound})"))
+
+        # JXP002 — host transfers/callbacks.
+        seen: Dict[str, int] = {}
+        for e in walked:
+            if e.prim in TRANSFER_PRIMS:
+                seen[e.prim] = seen.get(e.prim, 0) + 1
+        for prim, n in sorted(seen.items()):
+            out.append(_finding(
+                entry, "JXP002",
+                f"host transfer/callback primitive in traced code: "
+                f"{prim} x{n}"))
+
+        # JXP003 — donation discipline (SNIPPETS pjit donation internals:
+        # carried state must alias in place or HBM holds old+new copies).
+        don = entry.donation()
+        if don is not None:
+            for nm in entry.carried:
+                if not don.get(nm, False):
+                    out.append(_finding(
+                        entry, "JXP003",
+                        f"carried state {nm!r} is not donated across "
+                        f"steps (HBM holds old+new copies)"))
+            for nm in entry.pinned:
+                if don.get(nm, False):
+                    out.append(_finding(
+                        entry, "JXP003",
+                        f"pinned state {nm!r} is donated (it is reused "
+                        f"on the next step after invalidation)"))
+
+        # JXP004 — x64 widenings on H-sized buffers.
+        agg: Dict[Tuple[str, Tuple[str, ...]], List[int]] = {}
+        for e in walk_jaxpr(entry.jaxpr_x64()):
+            if e.wide64_dim >= entry.h_threshold:
+                slot = agg.setdefault((e.prim, e.wide64_dtypes), [0, 0])
+                slot[0] += 1
+                slot[1] = max(slot[1], e.wide64_dim)
+        for (prim, dts), (n, dim) in sorted(agg.items()):
+            out.append(_finding(
+                entry, "JXP004",
+                f"64-bit widening under x64: {prim} -> {','.join(dts)} "
+                f"over dim {dim} (x{n}) — give the index math an "
+                f"explicit 32-bit dtype"))
+
+        # JXP005 — shape-bucket table membership.  Two halves: the
+        # registered static dims must be bucket-aligned (pow2 >= floor:
+        # the PackedBatch bucketing that bounds the jit cache key space),
+        # AND each declared dim must actually appear in the traced
+        # signature or static kwargs — a declaration the trace no longer
+        # uses is the registry drifting from the real program, and a
+        # green check against stale constants guarantees nothing.
+        _fn2, _j2, args2, statics2 = entry.built()
+        sig_dims = {d for a in args2 for d in a.shape}
+        sig_dims |= {v for v in statics2.values() if isinstance(v, int)}
+        for nm, (val, floor) in sorted(entry.bucket_dims.items()):
+            if _next_pow2(val, floor) != val:
+                out.append(_finding(
+                    entry, "JXP005",
+                    f"static dim {nm}={val} is outside the shape-bucket "
+                    f"table (pow2 >= {floor}); every distinct value is a "
+                    f"fresh XLA trace+compile"))
+            elif val not in sig_dims:
+                out.append(_finding(
+                    entry, "JXP005",
+                    f"declared bucket dim {nm}={val} appears nowhere in "
+                    f"the entry's traced signature {sorted(sig_dims)} — "
+                    f"the registry has drifted from the real program"))
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Pass orchestration
+# ---------------------------------------------------------------------------
+
+
+def default_registry():
+    """The real registry: importing the modules registers their entries."""
+    from ...conflict.engine_jax import DEVICE_ENTRY_POINTS
+    from ...parallel import sharded_resolver  # noqa: F401  (sharded_step)
+
+    return DEVICE_ENTRY_POINTS
+
+
+def run_jaxcheck(registry=None, config: Optional[LintConfig] = None,
+                 sources: Optional[Dict[str, str]] = None) -> List[Finding]:
+    """Full jaxcheck pass over a registry: trace, apply JXP rules, filter
+    through the allowlist, then apply `# jaxcheck:` pragmas (and police
+    them: PRG001/PRG002) per source file.  `sources` optionally overrides
+    file contents by finding path (tests)."""
+    reg = default_registry() if registry is None else registry
+    config = config or LintConfig(allow={})
+    entries = [reg[k] for k in sorted(reg)]
+    findings = [
+        f for f in run_jxp_rules(entries)
+        if not config.allows(f.rule, f.path)
+    ]
+    by_path: Dict[str, List[Finding]] = {}
+    for f in findings:
+        by_path.setdefault(f.path, []).append(f)
+    out: List[Finding] = []
+    # Every file that DEFINES an entry gets its pragmas policed, even when
+    # it produced no findings — that is how a stale pragma ages into
+    # PRG002 instead of lingering forever.
+    for path in sorted({e.path for e in entries} | set(by_path)):
+        src = (sources or {}).get(path)
+        if src is None:
+            full = path if os.path.isabs(path) else os.path.join(
+                _PKG_DIR, path)
+            try:
+                with open(full, "r", encoding="utf-8") as fh:
+                    src = fh.read()
+            except OSError:
+                src = ""
+        pragmas = parse_pragmas(src, tool="jaxcheck")
+        out.extend(apply_pragmas(by_path.get(path, []), pragmas, path,
+                                 rules=JAX_RULES))
+    out.sort(key=lambda f: (f.path, f.line, f.rule, f.message))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _ensure_cpu(n: int = 8) -> None:
+    """Trace on CPU with enough virtual devices for the sharded entry.
+    Must run before the first backend touch (tests/conftest.py does the
+    equivalent; this host's sitecustomize would otherwise pick the axon
+    TPU plugin for a pure static-analysis run)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}"
+        ).strip()
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="jaxcheck",
+        description="jaxpr/IR structural analyzer for registered device "
+                    "entry points (JXP rules + committed fingerprints).",
+    )
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--config",
+                    help="JSON allowlist config {'allow': {'JXP00x': [globs]}}")
+    ap.add_argument("--no-fingerprints", action="store_true",
+                    help="skip the baseline fingerprint diff")
+    ap.add_argument("--update-baselines", action="store_true",
+                    help="rewrite the committed fingerprints from the "
+                         "current traces instead of diffing")
+    ap.add_argument("--baseline-dir",
+                    help="fingerprint directory (default: "
+                         "tests/jax_fingerprints, or $FDB_TPU_JAXCHECK_DIR)")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for rule, desc in JAX_RULES.items():
+            print(f"{rule}  {desc}")
+        return 0
+
+    _ensure_cpu()
+    from . import jaxfingerprint as jfp
+
+    config = (
+        LintConfig.load(args.config, use_defaults=False, rules=JAX_RULES)
+        if args.config else LintConfig(allow={})
+    )
+    findings = run_jaxcheck(config=config)
+    unsuppressed = [f for f in findings if not f.suppressed]
+    shown = findings if args.show_suppressed else unsuppressed
+
+    rc = 1 if unsuppressed else 0
+    if args.format == "json":
+        from .cli import count_by_rule
+
+        print(json.dumps(
+            {
+                "findings": [f.to_dict() for f in shown],
+                "total": len(findings),
+                "unsuppressed": len(unsuppressed),
+                "counts": count_by_rule(findings),
+            },
+            indent=2,
+        ))
+    elif args.format == "sarif":
+        from .cli import to_sarif
+
+        print(json.dumps(
+            to_sarif(shown, rules=JAX_RULES, tool="jaxcheck"), indent=2))
+    else:
+        from .cli import format_counts
+
+        for f in shown:
+            tag = " (suppressed: %s)" % f.reason if f.suppressed else ""
+            print(f.format() + tag)
+        print(
+            f"jaxcheck: {len(unsuppressed)} finding(s), "
+            f"{len(findings) - len(unsuppressed)} suppressed; "
+            + format_counts(findings),
+            file=sys.stderr,
+        )
+
+    if args.update_baselines:
+        for p in jfp.write_baselines(dirpath=args.baseline_dir):
+            print(f"jaxcheck: wrote {p}", file=sys.stderr)
+    elif not args.no_fingerprints:
+        problems = jfp.check_baselines(dirpath=args.baseline_dir)
+        for line in problems:
+            print(f"jaxcheck fingerprint: {line}", file=sys.stderr)
+        if problems:
+            print(
+                "jaxcheck: fingerprint baselines diverged — if the program "
+                "change is intentional, rerun with --update-baselines and "
+                "commit the diff",
+                file=sys.stderr,
+            )
+            rc = 1
+    return rc
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via -m
+    sys.exit(main())

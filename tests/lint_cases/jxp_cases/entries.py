@@ -1,0 +1,178 @@
+"""jaxcheck golden corpus: scratch device entry points that each JXP rule
+must fire on (positives) and stay silent on (negatives), plus the pragma
+cases.  Loaded by tests/test_jaxcheck.py via importlib so pragma parsing
+and finding spans run against this REAL file, exactly as they do for the
+package's registered entries.  `make_registry()` returns a private
+registry — the corpus never pollutes DEVICE_ENTRY_POINTS.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from foundationdb_tpu.conflict.engine_jax import register_entry_point
+
+H = 512  # the corpus "history" width (small: traces must stay cheap)
+SC = (("H", H),)
+
+
+def _sds(shape, dtype=jnp.int32):
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+# -- JXP001: H-sized work placement -----------------------------------------
+
+
+def _sort_outside(x):
+    return jnp.sort(x)
+
+
+def _ep_jxp001_pos():
+    """Positive: H-sized sort in the steady-state path of a
+    compaction-gated entry."""
+    return _sort_outside, None, (_sds((H,)),), {}
+
+
+def _sort_inside_cond(x, flag):
+    return jax.lax.cond(flag != 0, lambda v: jnp.sort(v), lambda v: v, x)
+
+
+def _ep_jxp001_neg():
+    """Must-not-flag: the H-sized sort lives inside the compaction cond."""
+    return _sort_inside_cond, None, (_sds((H,)), _sds(())), {}
+
+
+def _double_width(x):
+    return jnp.sort(jnp.concatenate([x, x]))
+
+
+def _ep_jxp001_bound_pos():
+    """Positive: a work primitive above the entry's declared width bound
+    (the per-shard-code-touching-global-data class)."""
+    return _double_width, None, (_sds((H,)),), {}
+
+
+# -- JXP002: host transfers/callbacks ---------------------------------------
+
+
+def _callback(x):
+    return jax.pure_callback(
+        lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x
+    )
+
+
+def _ep_jxp002_pos():
+    return _callback, None, (_sds((H,)),), {}
+
+
+# -- JXP003: donation discipline --------------------------------------------
+
+
+def _step(state, delta):
+    return state + delta
+
+
+_donating = partial(jax.jit, donate_argnames=("state",))(_step)
+_nondonating = jax.jit(_step)
+_overdonating = partial(jax.jit, donate_argnames=("state", "delta"))(_step)
+
+
+def _ep_jxp003_pos():
+    """Positive: carried state not donated — the HBM-doubling class (the
+    lint_cases-style pin for the grow/rebase burn-down)."""
+    return _step, _nondonating, (_sds((H,)), _sds((H,))), {}
+
+
+def _ep_jxp003_neg():
+    return _step, _donating, (_sds((H,)), _sds((H,))), {}
+
+
+def _ep_jxp003_pinned_pos():
+    """Positive: pinned (reused read-only) state donated."""
+    return _step, _overdonating, (_sds((H,)), _sds((H,))), {}
+
+
+def _ep_jxp003_pragma():  # jaxcheck: ignore[JXP003]: corpus: deliberate non-donated carry, reasoned
+    return _step, _nondonating, (_sds((H,)), _sds((H,))), {}
+
+
+def _ep_noreason_pragma():  # jaxcheck: ignore[JXP003]
+    return _step, _nondonating, (_sds((H,)), _sds((H,))), {}
+
+
+def _ep_stale_pragma():  # jaxcheck: ignore[JXP001]: corpus: suppresses nothing and must age into PRG002
+    return _step, _donating, (_sds((H,)), _sds((H,))), {}
+
+
+# -- JXP004: x64 widenings ---------------------------------------------------
+
+
+def _widen(mask):
+    # The pre-burn-down engine idiom: dtype-less index math that silently
+    # stays 32-bit by default but doubles under x64.
+    return jnp.cumsum(mask) * (jnp.arange(mask.shape[0]) + 1)
+
+
+def _ep_jxp004_pos():
+    return _widen, None, (_sds((H,), jnp.bool_),), {}
+
+
+def _widen_fixed(mask):
+    return jnp.cumsum(mask, dtype=jnp.int32) * (
+        jnp.arange(mask.shape[0], dtype=jnp.int32) + 1
+    )
+
+
+def _ep_jxp004_neg():
+    return _widen_fixed, None, (_sds((H,), jnp.bool_),), {}
+
+
+# -- JXP005: shape-bucket table ---------------------------------------------
+
+
+def _ep_jxp005_pos():
+    return _widen_fixed, None, (_sds((100,), jnp.bool_),), {}
+
+
+def _ep_jxp005_drift_pos():
+    """Positive: a bucket-aligned declaration the traced signature no
+    longer contains (registry drifted from the real program)."""
+    return _widen_fixed, None, (_sds((H,), jnp.bool_),), {}
+
+
+def make_registry():
+    reg = {}
+
+    def add(name, builder, **meta):
+        meta.setdefault("size_classes", SC)
+        meta.setdefault("h_threshold", H)
+        register_entry_point(name, builder, registry=reg, **meta)
+
+    add("jxp001_pos", _ep_jxp001_pos, arg_names=("x",),
+        compaction_gated=True)
+    add("jxp001_neg", _ep_jxp001_neg, arg_names=("x", "flag"),
+        compaction_gated=True)
+    add("jxp001_bound_pos", _ep_jxp001_bound_pos, arg_names=("x",),
+        work_bound=H)
+    add("jxp002_pos", _ep_jxp002_pos, arg_names=("x",))
+    add("jxp003_pos", _ep_jxp003_pos, arg_names=("state", "delta"),
+        carried=("state",))
+    add("jxp003_neg", _ep_jxp003_neg, arg_names=("state", "delta"),
+        carried=("state",), pinned=("delta",))
+    add("jxp003_pinned_pos", _ep_jxp003_pinned_pos,
+        arg_names=("state", "delta"), carried=("state",),
+        pinned=("delta",))
+    add("jxp003_pragma", _ep_jxp003_pragma, arg_names=("state", "delta"),
+        carried=("state",))
+    add("noreason_pragma", _ep_noreason_pragma,
+        arg_names=("state", "delta"), carried=("state",))
+    add("stale_pragma", _ep_stale_pragma, arg_names=("state", "delta"),
+        carried=("state",))
+    add("jxp004_pos", _ep_jxp004_pos, arg_names=("mask",))
+    add("jxp004_neg", _ep_jxp004_neg, arg_names=("mask",))
+    add("jxp005_pos", _ep_jxp005_pos, arg_names=("mask",),
+        bucket_dims={"h_cap": (100, 64)})
+    add("jxp005_drift_pos", _ep_jxp005_drift_pos, arg_names=("mask",),
+        bucket_dims={"h_cap": (1024, 64)})
+    return reg

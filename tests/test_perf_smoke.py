@@ -33,6 +33,7 @@ from foundationdb_tpu.conflict.engine_jax import (
     detect_core_tiered,
 )
 from foundationdb_tpu.conflict.types import TransactionConflictInfo as T
+from foundationdb_tpu.tools.lint.jaxir import WORK_PRIMS, walk_jaxpr
 
 pytestmark = pytest.mark.perf_smoke
 
@@ -98,32 +99,11 @@ H_CAP = 4096
 D_CAP = 256
 TXN, RR, WR = 32, 128, 64
 
-# Primitives that do O(n) COMPUTE over their operands (vs read-only
-# gathers, which are how phase 1 legitimately touches the base).
-_WORK_PRIMS = {"sort", "cumsum", "concatenate", "scatter", "scatter-add",
-               "reduce_max", "reduce_min", "reduce_sum"}
-
-
-def _collect(jaxpr, out, in_cond):
-    """(primitive, max operand dim, inside-compaction-cond) per eqn,
-    descending into every sub-jaxpr."""
-    for eqn in jaxpr.eqns:
-        name = eqn.primitive.name
-        sub_in_cond = in_cond or name == "cond"
-        for pname, p in eqn.params.items():
-            vals = p if isinstance(p, (list, tuple)) else [p]
-            for v in vals:
-                inner = getattr(v, "jaxpr", None)
-                if inner is not None and hasattr(inner, "eqns"):
-                    _collect(inner, out, sub_in_cond)
-                elif hasattr(v, "eqns"):
-                    _collect(v, out, sub_in_cond)
-        dims = [
-            max(v.aval.shape)
-            for v in eqn.invars
-            if hasattr(v, "aval") and getattr(v.aval, "shape", ())
-        ]
-        out.append((name, max(dims, default=0), in_cond))
+# The shared jaxpr visitor + work-primitive set live in tools/lint/jaxir.py
+# (jaxcheck) — ONE walker serves this gate and the JXP rule family, so the
+# perf_smoke invariant and jaxcheck can never drift apart.  Note
+# EqnEntry.max_dim spans operands AND results (a concat BUILDING an
+# H-sized array from small pieces is H-sized work).
 
 
 def _tiered_jaxpr():
@@ -185,10 +165,9 @@ def _flat_jaxpr():
 
 def test_flat_step_has_h_sized_sorts():
     """Detector sanity: the flat step's merge+evict ARE H-sized sorts (the
-    very ones the tier split amortizes) and the collector sees them."""
-    entries = []
-    _collect(_flat_jaxpr().jaxpr, entries, in_cond=False)
-    h_sorts = [e for e in entries if e[0] == "sort" and e[1] >= H_CAP]
+    very ones the tier split amortizes) and the shared visitor sees them."""
+    entries = walk_jaxpr(_flat_jaxpr())
+    h_sorts = [e for e in entries if e.prim == "sort" and e.max_dim >= H_CAP]
     assert len(h_sorts) >= 2, entries
 
 
@@ -197,23 +176,25 @@ def test_tiered_steady_state_has_no_h_sized_work_outside_cond():
     cond; the steady-state (non-compaction) batch is bounded by delta/
     point-domain sizes.  The compaction branch must still contain the
     H-sized sorts (it exists and does the real merge)."""
-    entries = []
-    _collect(_tiered_jaxpr().jaxpr, entries, in_cond=False)
+    entries = walk_jaxpr(_tiered_jaxpr())
     outside = [
         e for e in entries
-        if not e[2] and e[0] in _WORK_PRIMS and e[1] >= H_CAP
+        if not e.in_cond and e.prim in WORK_PRIMS and e.max_dim >= H_CAP
     ]
     assert not outside, (
         f"H-sized work escaped the compaction cond: {outside}"
     )
     inside_sorts = [
-        e for e in entries if e[2] and e[0] == "sort" and e[1] >= H_CAP
+        e for e in entries
+        if e.in_cond and e.prim == "sort" and e.max_dim >= H_CAP
     ]
     assert len(inside_sorts) >= 2, (
         "the compaction branch lost its H-sized merge/evict sorts"
     )
     # And the biggest sort outside the cond is delta/point-domain sized.
-    out_sorts = [e[1] for e in entries if not e[2] and e[0] == "sort"]
+    out_sorts = [
+        e.max_dim for e in entries if not e.in_cond and e.prim == "sort"
+    ]
     assert out_sorts and max(out_sorts) < H_CAP
 
 
