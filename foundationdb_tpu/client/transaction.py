@@ -768,6 +768,11 @@ class Transaction:
                 ),
             )
         except FdbError as e:
+            # Close the latency chain on the error path too: the
+            # ratekeeper's CommitChainSampler ages OPEN chains as a
+            # pipeline-stall signal, so a failed attempt must not
+            # masquerade as a forever-wedged commit.
+            trace_batch("CommitDebug", "NativeAPI.commit.Error", debug_id)
             if e.name in ("commit_unknown_result", "broken_promise"):
                 # The commit may still be in flight.  Before surfacing the
                 # unknown result, commit a conflicting dummy transaction

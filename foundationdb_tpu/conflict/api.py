@@ -92,7 +92,8 @@ class ConflictSet:
                 h_cap=h_cap,
             )
             for _c in ("device_faults", "breaker_opens", "breaker_probes",
-                       "breaker_closes", "degraded_batches", "rehydrates"):
+                       "breaker_closes", "degraded_batches", "rehydrates",
+                       "cpu_fallback_txns"):
                 self._jax.metrics.counter(_c)  # pre-create: stable snapshots
             self._breaker = DeviceCircuitBreaker(metrics=self._jax.metrics)
             self._jax.fault_injector = fault_injector
@@ -101,7 +102,14 @@ class ConflictSet:
         self._key_words = kw
         # True once a long-key write range may have entered CPU history;
         # the device cannot represent it, so authority stays on CPU.
+        # NOT permanent (ISSUE 8): once the last long-key write ages out
+        # of the MVCC window and no long key remains as a mirror boundary,
+        # the pin lifts and the device path resumes (see _device_eligible)
+        # — one oversized write must degrade the device for a window, not
+        # for the resolver's lifetime (a DynamicCluster's system-keyspace
+        # metadata writes would otherwise disable the device forever).
         self._history_long_keys = False
+        self._long_key_version = -1  # version of the last long-key write
         # Device state is stale whenever the CPU engine has absorbed a
         # batch the device did not run (small-batch routing, a fault, or
         # simply never having run); the next device attempt rehydrates
@@ -116,6 +124,19 @@ class ConflictSet:
         # AUTHORITY_HYSTERESIS of them — an alternating big/small workload
         # must not pay a full history transfer per flip (ADVICE r1).
         self._small_streak = 0
+        # CPU-fallback throughput measurement: transactions decided by the
+        # CPU mirror BECAUSE the device path was degraded (fault or open
+        # circuit — by-design CPU routing doesn't count), and the wall
+        # seconds those detects took.  Feeds backend_signal() so admission
+        # control can contract the TPS limit to what the mirror actually
+        # sustains.  The tps estimate uses a sliding WINDOW of recent
+        # fallback batches, not a lifetime average — an early warm-history
+        # episode must not inflate the cap during a later, slower one.
+        # Wall-derived: never enters a deterministic snapshot.
+        from collections import deque
+
+        self._cpu_fallback_txns = 0  # cumulative (deterministic counter)
+        self._cpu_fallback_recent = deque(maxlen=32)  # (txns, wall_seconds)
 
     AUTHORITY_HYSTERESIS = 8
 
@@ -153,11 +174,26 @@ class ConflictSet:
             return self._detect_device(txns, now, new_oldest_version)
         return self._engine_for_authority().detect(txns, now, new_oldest_version)
 
-    def _device_eligible(self, txns) -> bool:
+    def _device_eligible(self, txns, now: int = 0) -> bool:
         """Every key in the batch fits the device width and no long-key
         write has pinned history host-side."""
         srv = g_knobs.server
         max_key = min(srv.conflict_max_device_key_bytes, self._key_words * 4)
+        if (
+            self._history_long_keys
+            and self._long_key_version < self._cpu.oldest_version
+        ):
+            # The last long-key write aged out of the MVCC window.  It may
+            # STILL survive as a boundary (removeBefore keeps a below-
+            # window boundary whose predecessor is hot — it is the right
+            # edge of that range), so verify the mirror is clean before
+            # lifting the pin: one O(keys) scan at most per window
+            # passage, on the detect path — never the metrics sample loop.
+            # Belt-and-braces: load_from raises loudly on any long key.
+            if all(len(k) <= max_key for k in self._cpu.keys):
+                self._history_long_keys = False
+            else:
+                self._long_key_version = now  # re-check next window
         batch_fits = all(
             len(b) <= max_key and len(e) <= max_key
             for tr in txns
@@ -168,10 +204,11 @@ class ConflictSet:
             for tr in txns
             for (b, e) in tr.write_ranges
         ):
-            # A long-key write may enter history; until the window flushes it
-            # the device state cannot represent the step function exactly.
-            # Conservative: pin history to the CPU until clear().
+            # A long-key write may enter history; until the window flushes
+            # it (and the boundary leaves the mirror) the device state
+            # cannot represent the step function exactly.
             self._history_long_keys = True
+            self._long_key_version = now
         return batch_fits and not self._history_long_keys
 
     def _device_serve(self, txns, now, new_oldest_version):
@@ -203,31 +240,51 @@ class ConflictSet:
         self._cpu.apply_batch(txns, statuses, now, new_oldest_version)
         return statuses
 
+    def _cpu_detect_fallback(self, txns, now, new_oldest_version):
+        """CPU-mirror detect for a DEGRADED device-eligible batch, timed on
+        the wall clock so backend_signal() can report the mirror's real
+        throughput (wall namespace only — see flow/metrics.py
+        record_wall; the deterministic counter tracks txn counts)."""
+        from ..flow.metrics import wall_now
+
+        t0 = wall_now()
+        statuses = self._cpu.detect(txns, now, new_oldest_version)
+        self._cpu_fallback_txns += len(txns)
+        self._cpu_fallback_recent.append((len(txns), wall_now() - t0))
+        if self._jax is not None:
+            self._jax.metrics.counter("cpu_fallback_txns").add(len(txns))
+        return statuses
+
     def _detect_device(self, txns, now, new_oldest_version) -> List[int]:
         """backend="jax": every batch is device-eligible (modulo key
         width); the CPU mirror absorbs faults and open-circuit windows."""
-        if self._device_eligible(txns):
+        if self._device_eligible(txns, now):
             statuses = self._device_serve(txns, now, new_oldest_version)
             if statuses is not None:
                 return statuses
+            self._device_stale = True
+            return self._cpu_detect_fallback(txns, now, new_oldest_version)
         self._device_stale = True
         return self._cpu.detect(txns, now, new_oldest_version)
 
     def _detect_hybrid(self, txns, now, new_oldest_version) -> List[int]:
         big = len(txns) >= g_knobs.server.conflict_device_min_batch
-        device_ok = self._device_eligible(txns)
+        device_ok = self._device_eligible(txns, now)
+        attempted = False  # a device serve was due but faulted/open-circuit
         if device_ok and self._authority == "jax":
             # Already on device: run there even below the size threshold
             # (device dispatch on a warm small bucket beats a full history
             # transfer); only a sustained small streak flips authority back.
             self._small_streak = 0 if big else self._small_streak + 1
             if self._small_streak < self.AUTHORITY_HYSTERESIS:
+                attempted = True
                 statuses = self._device_serve(txns, now, new_oldest_version)
                 if statuses is not None:
                     return statuses
         elif big and device_ok:
             self._authority = "jax"
             self._small_streak = 0
+            attempted = True
             statuses = self._device_serve(txns, now, new_oldest_version)
             if statuses is not None:
                 return statuses
@@ -237,7 +294,32 @@ class ConflictSet:
             self._authority = "cpu"
             self._small_streak = 0
         self._device_stale = True
+        if attempted:
+            # Degraded serve (not by-design small-batch routing): measure
+            # the mirror's throughput for admission control.
+            return self._cpu_detect_fallback(txns, now, new_oldest_version)
         return self._cpu.detect(txns, now, new_oldest_version)
+
+    def backend_signal(self) -> dict:
+        """O(1) admission-control probe (ISSUE 8 satellite): the PR-3
+        breaker's backend_state plus measured CPU-fallback throughput —
+        NO per-row host work and no histogram snapshotting (contrast
+        device_metrics(), which walks every instrument; this follows the
+        boundary_count_bound discipline and is safe on every ratekeeper
+        sample).  cpu_mirror_tps is wall-clock-derived (0.0 = nothing
+        measured yet) and MUST NOT feed deterministic decisions in sim —
+        the ratekeeper only consults it under
+        ratekeeper_use_measured_cpu_tps."""
+        state = self._breaker.state if self._breaker is not None else "ok"
+        tps = 0.0
+        wall = sum(w for _n, w in self._cpu_fallback_recent)
+        if wall > 0.0:
+            tps = sum(n for n, _w in self._cpu_fallback_recent) / wall
+        return {
+            "backend_state": state,
+            "cpu_mirror_tps": tps,
+            "cpu_fallback_txns": self._cpu_fallback_txns,
+        }
 
     def device_metrics(self, now=None) -> Optional[dict]:
         """Kernel-telemetry snapshot of the device engine (retraces,
@@ -277,6 +359,7 @@ class ConflictSet:
         if self.backend == "hybrid":
             self._authority = "cpu"
         self._history_long_keys = False
+        self._long_key_version = -1
         # Cleared engines agree, but rehydrating from the (tiny) cleared
         # mirror is cheap and keeps one invariant: any CPU-side write the
         # device missed forces a load_from.  Breaker state is NOT reset —

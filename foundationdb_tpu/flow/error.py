@@ -38,6 +38,13 @@ _ERRORS: dict[str, int] = {
     "accessed_unreadable": 1036,
     "process_behind": 1037,
     "database_locked": 1038,
+    # Proxy GRV admission shedding (ref: proxy_memory_limit_exceeded /
+    # batch_transaction_throttled in later error_definitions.h revisions):
+    # the default lane sheds with the former, the batch-priority lane —
+    # which starves first under overload — with the latter.  Both are
+    # retryable; clients back off exponentially with jitter.
+    "proxy_memory_limit_exceeded": 1042,
+    "batch_transaction_throttled": 1051,
     "broken_promise": 1100,
     "actor_cancelled": 1101,  # reference name: operation_cancelled
     "recruitment_failed": 1200,
@@ -142,6 +149,8 @@ class FdbError(Exception):
             "future_version",
             "process_behind",
             "database_locked",
+            "proxy_memory_limit_exceeded",
+            "batch_transaction_throttled",
         )
 
 

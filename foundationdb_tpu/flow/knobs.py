@@ -133,6 +133,35 @@ class ServerKnobs(Knobs):
         # Batch-priority lane: same springs at this fraction of the targets
         # (ref: the separate batch limiter with lower TARGET_BYTES_*_BATCH).
         self._init("ratekeeper_batch_target_fraction", 0.5)
+        # Overload-aware springs (ISSUE 8): the stack's actual bottleneck is
+        # the resolver/TPU conflict path, which the reference's SS/TLog-only
+        # signals never see.  Queue depth counts resolve batches in flight
+        # or parked on the prevVersion chain; latency targets are virtual
+        # seconds from the resolver's resolve_seconds window and the
+        # latency_chain commit totals.
+        self._init("ratekeeper_target_resolver_queue", 8)
+        self._init("ratekeeper_spring_resolver_queue", 16)
+        self._init("ratekeeper_target_resolve_p99", 0.25)
+        self._init("ratekeeper_spring_resolve_p99", 0.5)
+        self._init("ratekeeper_target_commit_p99", 0.5)
+        self._init("ratekeeper_spring_commit_p99", 1.0)
+        # Degraded device backend (PR-3 breaker open / CPU takeover): the
+        # TPS limit contracts to this fraction of max so the GRV lane stops
+        # piling requests onto the CPU mirror.  With
+        # ratekeeper_use_measured_cpu_tps (real deployments; wall-clock
+        # derived, so OFF in sim where rate decisions must replay from the
+        # seed) the cap additionally clamps to 80% of the measured
+        # CPU-mirror throughput from ConflictSet.backend_signal().
+        self._init("ratekeeper_degraded_tps_fraction", 0.25)
+        self._init("ratekeeper_use_measured_cpu_tps", False)
+        # Proxy-side GRV admission queue bound: beyond this many queued
+        # read-version requests the proxy SHEDS deterministically instead
+        # of queueing without limit — the batch-priority lane starves first
+        # (batch_transaction_throttled), then the default lane
+        # (proxy_memory_limit_exceeded); both are retryable, and clients
+        # back off exponentially with DeterministicRandom jitter (ref: the
+        # proxy memory-limit rejection in transactionStarter).
+        self._init("ratekeeper_grv_queue_max", 2048)
         # Self-driving DataDistribution (ref: DataDistribution.actor.cpp
         # teamTracker + DataDistributionTracker cadences + the queue's
         # RELOCATION_PARALLELISM_PER_SOURCE_SERVER; byte thresholds are
@@ -239,3 +268,24 @@ g_env.declare("FDB_TPU_EVICT_EVERY", "1",
 g_env.declare("FDB_TPU_JAXCHECK_DIR", "",
               help="jaxcheck fingerprint baseline directory override "
                    "(default: tests/jax_fingerprints next to the package)")
+# Soak-harness defaults (workloads/soak.py via `cli soak` and the
+# slow-marked soak test).  CLI arguments override these; the env flags
+# exist so CI/bench drivers can retune the soak without editing argv.
+g_env.declare("FDB_TPU_SOAK_MINUTES", "1",
+              help="soak length in SIM minutes (virtual time) for the "
+                   "slow soak test and the cli soak default; raise for "
+                   "bench-driver runs (1 sim-minute of a dynamic-cluster "
+                   "jax soak costs ~5 real minutes on the 1-core CI host)")
+g_env.declare("FDB_TPU_SOAK_SEED", "1",
+              help="soak DeterministicRandom seed (same seed => "
+                   "byte-identical ratekeeper/breaker transition logs)")
+g_env.declare("FDB_TPU_SOAK_TPS", "80",
+              help="open-loop arrival rate (txn/s of virtual time) at the "
+                   "soak's peak phase; ramp phases scale from it")
+g_env.declare("FDB_TPU_SOAK_KEYS", "512",
+              help="distinct keys in the soak keyspace (Zipf-skewed)")
+g_env.declare("FDB_TPU_SOAK_THETA", "0.9",
+              help="Zipf skew exponent for soak keys (0 = uniform)")
+g_env.declare("FDB_TPU_SOAK_BACKEND", "jax",
+              help="conflict backend for the soak cluster resolvers "
+                   "(cpu|jax|hybrid; device-outage faults need jax/hybrid)")

@@ -411,6 +411,9 @@ class ClusterController:
             tlog_ifaces=list(tlog_ifs),
             storage_ifaces=list(storage_ifs),
             fs=self.fs,  # enables the disk-free spring in recruited mode
+            # Resolver-path springs (ISSUE 8): queue depth, resolve p99,
+            # and the device backend_state over the cheap `signals` probe.
+            resolver_ifaces=[res_if],
         )
         rk_if = self.ratekeeper.interface()
 

@@ -68,6 +68,22 @@ class GetReadVersionRequest:
 
 
 @dataclass
+class GetRateInfoRequest:
+    """The proxy's report riding its rate fetch (ref: GetRateInfoRequest
+    carrying totalReleasedTransactions so the ratekeeper sees demand, not
+    just supply).  `None` requests remain accepted (legacy probes)."""
+
+    proxy_id: str = "proxy0"
+    # Read-version requests queued at the proxy when it fetched (the bound
+    # the shed policy enforces; surfaced through status qos).
+    grv_queue_depth: int = 0
+    # The proxy's passive commit-latency p99 sample (virtual seconds) —
+    # the recruited-mode fallback when the ratekeeper has no in-memory
+    # trace collector to reassemble latency chains from.
+    commit_p99: float = 0.0
+
+
+@dataclass
 class GetKeyServersLocationsRequest:
     """Key -> storage-team lookup (ref: GetKeyServersLocationsRequest
     MasterProxyInterface.h:36; served from the proxy's interception of
@@ -148,6 +164,23 @@ class ResolutionMetricsReply:
 
 
 @dataclass
+class ResolverSignalsReply:
+    """Cheap admission-control probe (ISSUE 8) — the resolver-side signals
+    the ratekeeper springs on, all O(1) to produce (no conflict-set row
+    walks; see ConflictSet.backend_signal): batches in flight or parked on
+    the prevVersion chain, the recent-window resolve-latency p99 in virtual
+    seconds, and the PR-3 breaker's backend state.  cpu_mirror_tps is the
+    wall-clock-measured CPU-fallback throughput (0.0 = no measurement); sim
+    ratekeepers ignore it unless ratekeeper_use_measured_cpu_tps."""
+
+    queue_depth: int = 0
+    resolve_p99: float = 0.0
+    backend_state: str = "ok"  # ok | degraded | probing
+    cpu_mirror_tps: float = 0.0
+    degraded_batches: int = 0
+
+
+@dataclass
 class ResolutionSplitRequest:
     """Find the key splitting this resolver's sampled load in [begin, end)
     at `fraction` of its mass (ref: ResolutionSplitRequest
@@ -163,6 +196,11 @@ class ResolverInterface:
     resolve: RequestStreamRef = None
     metrics: RequestStreamRef = None
     split: RequestStreamRef = None
+    # Ratekeeper signal probe (ResolverSignalsReply) — separate from
+    # `metrics` because that stream's ops counter is reset-on-read for the
+    # split balancer; two consumers on one reset stream would starve each
+    # other.
+    signals: RequestStreamRef = None
 
 
 # --- tlog (ref fdbserver/TLogInterface.h) ---

@@ -29,6 +29,7 @@ from .interfaces import (
     TAG_DEFAULT,
     GetCommitVersionReply,
     GetKeyServersLocationsReply,
+    GetRateInfoRequest,
     ProxyInterface,
     ResolveTransactionBatchRequest,
     ResolverInterface,
@@ -157,7 +158,8 @@ class Proxy:
 
         self.stats = CounterCollection(f"Proxy{proxy_id}")
         for _c in ("batches", "committed", "conflicted", "too_old",
-                   "grv_requests", "rejected_locked"):
+                   "grv_requests", "rejected_locked",
+                   "grv_shed_batch", "grv_shed_default"):
             self.stats.counter(_c)  # pre-create: snapshots list them all
         # Proxy-observed latency distributions (batch arrival -> reply),
         # surfaced as status qos percentiles (ref: the commit/GRV latency
@@ -378,6 +380,32 @@ class Proxy:
                 if r is not None and r.flags & GRV_FLAG_PRIORITY_BATCH
             ]
             deferred = []
+            # Bounded admission queue (ISSUE 8): beyond the configured
+            # depth the proxy SHEDS deterministically instead of queueing
+            # without bound.  The batch-priority lane starves first (its
+            # newest arrivals go first within the lane — FIFO for what
+            # stays); only when the default lane alone overflows does it
+            # shed too.  Both errors are retryable: clients re-enter with
+            # exponential backoff + DeterministicRandom jitter (ref: the
+            # proxy memory-limit rejection in transactionStarter).
+            qmax = g_knobs.server.ratekeeper_grv_queue_max
+            if len(batch) + len(lane) > qmax:
+                from ..flow.testprobe import test_probe
+
+                test_probe("grv_shed")
+                keep_lane = max(0, qmax - len(batch))
+                shed_lane, lane = lane[keep_lane:], lane[:keep_lane]
+                shed_batch: list = []
+                if len(batch) > qmax:
+                    shed_batch, batch = batch[qmax:], batch[:qmax]
+                for rep in shed_lane:
+                    self.stats.add("grv_shed_batch")
+                    grv_meta.pop(id(rep), None)
+                    rep.send_error("batch_transaction_throttled")
+                for rep in shed_batch:
+                    self.stats.add("grv_shed_default")
+                    grv_meta.pop(id(rep), None)
+                    rep.send_error("proxy_memory_limit_exceeded")
             if buggify("proxy_grv_delay"):
                 # BUGGIFY: stale-but-causal read versions (the committed
                 # floor only rises) — exercises waitForVersion fast paths.
@@ -385,8 +413,22 @@ class Proxy:
             if self.ratekeeper is not None:
                 if loop.now() - last_fetch > 0.1:
                     try:
+                        # The fetch carries this proxy's demand report
+                        # (GetRateInfoRequest): queue depth for the status
+                        # qos surface, and the passive commit p99 as the
+                        # ratekeeper's fallback when no in-memory trace
+                        # collector exists to reassemble latency chains.
                         info = await self.ratekeeper.get_rate.get_reply(
-                            self.process, None
+                            self.process,
+                            GetRateInfoRequest(
+                                proxy_id=self.proxy_id,
+                                grv_queue_depth=len(batch) + len(lane),
+                                commit_p99=(
+                                    self.latency_samples["commit"]
+                                    .percentile(0.99)
+                                    or 0.0
+                                ),
+                            ),
                         )
                         tps = info.tps
                         batch_tps = getattr(info, "batch_tps", info.tps)

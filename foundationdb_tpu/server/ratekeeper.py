@@ -12,12 +12,34 @@ storage applied version): storage falling behind the log is exactly the
 condition the reference's MVCC window protects (reads older than the window
 die with transaction_too_old), so admission slows before the window is
 overrun.
+
+Overload-aware springs (ISSUE 8) extend the reference's SS/TLog-only view
+to the stack's actual bottleneck, the resolver/TPU conflict path:
+
+  resolver_queue   resolve batches in flight or parked on the prevVersion
+                   chain (Resolver.queue_depth / the `signals` RPC)
+  resolve_latency  recent-window resolve p99 in virtual seconds
+  commit_latency   commit p99 reassembled INCREMENTALLY from the
+                   latency_chain CommitDebug events (CommitChainSampler);
+                   falls back to the proxies' reported sample when the
+                   trace collector is file-backed (real mode)
+  backend_degraded the PR-3 circuit breaker's backend_state: when verdicts
+                   fall back to the CPU mirror the TPS limit contracts to
+                   ratekeeper_degraded_tps_fraction of max (optionally
+                   clamped to the MEASURED CPU-mirror throughput from
+                   ConflictSet.backend_signal() — real mode only, the
+                   measurement is wall-clock derived)
+
+`limiting` names whichever signal set the rate; every change of the
+binding signal is appended to a replayable `transitions` log (same seed =>
+byte-identical), the admission-control analog of the breaker's transition
+log, consumed by the soak harness's same-seed replay gate.
 """
 
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import List
+from typing import List, Optional
 
 from ..flow.knobs import g_knobs
 from ..rpc.network import SimProcess
@@ -32,12 +54,118 @@ class RateInfo:
     worst_ss_queue_bytes: int = 0
     worst_tlog_queue_bytes: int = 0
     min_free_bytes: int = 1 << 62
+    # Overload-aware signals (ISSUE 8): worst across resolvers/proxies.
+    resolver_queue_depth: int = 0
+    resolve_p99: float = 0.0
+    commit_p99: float = 0.0
+    backend_state: str = "ok"  # ok | degraded | probing (worst resolver)
+    grv_queue_depth: int = 0  # worst proxy-reported GRV admission queue
     limiting: str = "none"  # which signal set the rate (for status/qos)
 
 
 @dataclass
 class RatekeeperInterface:
     get_rate: RequestStreamRef = None
+
+
+@dataclass
+class Signals:
+    """One sample of every spring input (see _collect_signals)."""
+
+    lag: int = 0
+    ss_queue: int = 0
+    tlog_queue: int = 0
+    free: int = 1 << 62
+    resolver_queue: int = 0
+    resolve_p99: float = 0.0
+    commit_p99: float = 0.0
+    backend_state: str = "ok"
+    cpu_mirror_tps: float = 0.0  # measured; 0.0 = unknown
+    grv_queue_depth: int = 0
+    # RPC mode only: a whole commit-critical role class (every tlog, or
+    # every storage) is unreachable — the cluster is mid-recovery.
+    unreachable: bool = False
+
+
+class CommitChainSampler:
+    """Incremental latency_chain consumer: reassembles the CommitDebug
+    total stage (client Before -> After) from the global IN-MEMORY trace
+    collector, one pass over only the events that arrived since the last
+    sample, into a sliding window whose exact p99 feeds the
+    commit_latency spring.  Deterministic by construction (virtual-time
+    event stamps, no reservoir).  Returns None when the collector is
+    file-backed (events spooled, not retained — real mode) or nothing
+    observed yet.
+
+    OPEN chains are a signal too: a commit whose Before has no After yet
+    is IN the pipeline, and during a grey failure (one-directional clog:
+    the request landed, the reply is stalled) the completed-duration
+    window goes quiet exactly when latency is worst.  With `now`, the age
+    of the oldest open chain folds into the p99 (max-combine), so a
+    wedged pipeline registers while it is wedged.  Failed attempts close
+    their chain via NativeAPI.commit.Error (never entering the completed
+    window), and opens older than `horizon` are pruned — an abandoned
+    chain (client killed mid-commit) cannot hold the signal up forever."""
+
+    WINDOW = 128
+    FROM = "NativeAPI.commit.Before"
+    TO = "NativeAPI.commit.After"
+    ERR = "NativeAPI.commit.Error"
+
+    def __init__(self):
+        from collections import deque
+
+        self._col = None
+        self._cursor = 0
+        self._open: dict = {}  # debug id -> Before time
+        self._window = deque(maxlen=self.WINDOW)
+
+    def sample(
+        self, now: Optional[float] = None, horizon: Optional[float] = None
+    ) -> Optional[float]:
+        from ..flow.latency_chain import percentile
+        from ..flow.trace import global_collector
+
+        col = global_collector()
+        if col.path is not None:
+            return None
+        if col is not self._col or len(col.events) < self._cursor:
+            # New or cleared collector: restart the incremental scan.
+            self._col, self._cursor = col, 0
+            self._open.clear()
+            self._window.clear()
+        events = col.events
+        for i in range(self._cursor, len(events)):
+            e = events[i]
+            if e.get("Type") != "CommitDebug":
+                continue
+            did, loc = e.get("ID"), e.get("Location")
+            if did is None:
+                continue
+            if loc == self.FROM:
+                self._open.setdefault(did, e["Time"])
+            elif loc == self.TO:
+                t0 = self._open.pop(did, None)
+                if t0 is not None and e["Time"] >= t0:
+                    self._window.append(e["Time"] - t0)
+            elif loc == self.ERR:
+                self._open.pop(did, None)  # attempt failed: not a wedge
+        self._cursor = len(events)
+        if now is not None and horizon is not None:
+            for k in [
+                k for k, t0 in self._open.items() if now - t0 > horizon
+            ]:
+                del self._open[k]
+        if len(self._open) > 1024:
+            # Commits that never resolved (client died mid-pipeline):
+            # drop the oldest half, deterministically (insertion order).
+            for k in list(self._open)[: len(self._open) - 512]:
+                del self._open[k]
+        p99 = percentile(list(self._window), 0.99)
+        if now is not None and self._open:
+            oldest_age = now - min(self._open.values())
+            p99 = max(p99 or 0.0, oldest_age)
+        return p99
 
 
 class Ratekeeper:
@@ -51,21 +179,66 @@ class Ratekeeper:
         tlog_ifaces: List[object] = (),  # RPC mode (recruited ratekeeper):
         storage_ifaces: List[object] = (),  # polls metrics like the ref's
         # trackStorageServerQueueInfo / trackTLogQueueInfo actors.
+        resolvers: List[object] = (),  # Resolver role objects (in-process)
+        resolver_ifaces: List[object] = (),  # RPC mode: `signals` probes
+        proxies: List[object] = (),  # Proxy role objects (in-process)
     ):
         self.process = process
         self.tlogs = list(tlogs)
         self.storages = list(storages)
         self.tlog_ifaces = list(tlog_ifaces)
         self.storage_ifaces = list(storage_ifaces)
+        self.resolvers = list(resolvers)
+        self.resolver_ifaces = list(resolver_ifaces)
+        self.proxies = list(proxies)
         self.fs = fs
         self.sample_interval = sample_interval
         self.rate = RateInfo(tps=g_knobs.server.ratekeeper_max_tps)
+        self._chain_sampler = CommitChainSampler()
+        # Latest per-proxy report riding the rate fetch, stamped with its
+        # arrival time: proxy_id -> (loop.now(), GetRateInfoRequest).  A
+        # proxy that stops fetching (removed, dead generation) must not
+        # leave a stale incident-era report driving the commit_latency
+        # spring forever — reports expire after _REPORT_TTL seconds.
+        self._proxy_reports: dict = {}
+        # Replayable admission log: [sample_seq, from_limiting, to_limiting,
+        # tps rounded] appended whenever the binding signal changes.  Same
+        # seed => byte-identical (the soak harness's replay gate).  Bounded:
+        # a week-scale real deployment flapping at a spring target must not
+        # grow memory forever — the deque drops the oldest entries, and
+        # same-seed runs cap identically so the replay gate still holds.
+        from collections import deque
+
+        self.sample_seq = 0
+        self.transitions = deque(maxlen=4096)
         self._stream = RequestStream(process, "rk_get_rate", well_known=True)
         process.spawn(self._update_loop(), "rk_update")
         process.spawn(self._serve(), "rk_serve")
 
+    # Proxies fetch at most every 0.1s (the GRV loop's fetch throttle);
+    # several missed intervals means the proxy is gone, not slow.
+    _REPORT_TTL = 2.0
+
     def interface(self) -> RatekeeperInterface:
         return RatekeeperInterface(get_rate=self._stream.ref())
+
+    def _live_reports(self, now: float) -> list:
+        """Un-expired proxy reports; expired entries are dropped in place."""
+        dead = [
+            pid
+            for pid, (t, _r) in self._proxy_reports.items()
+            if now - t > self._REPORT_TTL
+        ]
+        for pid in dead:
+            del self._proxy_reports[pid]
+        return [r for _t, r in self._proxy_reports.values()]
+
+    def transition_log_json(self) -> str:
+        """Canonical byte form of the admission transition log — what the
+        soak same-seed replay gate compares."""
+        import json
+
+        return json.dumps(list(self.transitions), separators=(",", ":"))
 
     @staticmethod
     def _spring(x: float, target: float, spring: float) -> float:
@@ -86,26 +259,30 @@ class Ratekeeper:
             return 0.0
         return (free - minimum) / (target - minimum)
 
-    async def _signals(self):
-        """(lag, worst_ss_queue, worst_tlog_queue, min_free_bytes) from
-        direct role objects (in-process mode) and/or RPC metric probes
-        (recruited mode — ref trackStorageServerQueueInfo :138 /
-        trackTLogQueueInfo :179)."""
+    async def _collect_signals(self) -> Signals:
+        """Every spring input in one sample, from direct role objects
+        (in-process mode) and/or RPC metric probes (recruited mode — ref
+        trackStorageServerQueueInfo :138 / trackTLogQueueInfo :179; the
+        resolver probes use the cheap `signals` stream)."""
         from ..flow.error import FdbError
         from .interfaces import GetStorageMetricsRequest
 
         srv = g_knobs.server
+        sig = Signals()
         log_vs = [t.durable.get() for t in self.tlogs]
         ss_vs = [s.version.get() for s in self.storages]
         ss_qs = [s.queue_bytes for s in self.storages]
         tl_qs = [getattr(t, "_mem_bytes", 0) for t in self.tlogs]
+        tl_ok = 0
         for tl in self.tlog_ifaces:
             try:
                 m = await tl.metrics.get_reply(self.process, None)
                 log_vs.append(m.durable_version)
                 tl_qs.append(m.queue_bytes)
+                tl_ok += 1
             except FdbError:
                 continue  # unreachable log: recovery is the real handler
+        ss_ok = 0
         for ss in self.storage_ifaces:
             try:
                 m = await ss.get_storage_metrics.get_reply(
@@ -114,14 +291,25 @@ class Ratekeeper:
                 )
                 ss_vs.append(m.version)
                 ss_qs.append(m.queue_bytes)
+                ss_ok += 1
             except FdbError:
                 continue
+        # A WHOLE commit-critical role class unreachable (every log, or
+        # every storage we poll) means the cluster is mid-recovery: floor
+        # admission instead of keeping the last healthy rate — the GRV
+        # lane must not pile a backlog onto a generation that is being
+        # replaced (the springs cannot see a stall their probes can't
+        # reach).  RPC (recruited) mode only; in-process mode reads role
+        # objects directly and never loses them.
+        sig.unreachable = bool(
+            (self.tlog_ifaces and tl_ok == 0)
+            or (self.storage_ifaces and ss_ok == 0)
+        )
         log_v = max(log_vs, default=0)
         ss_v = min(ss_vs, default=log_v)
-        lag = max(0, log_v - ss_v)
-        ss_q = max(ss_qs, default=0)
-        tl_q = max(tl_qs, default=0)
-        free = 1 << 62
+        sig.lag = max(0, log_v - ss_v)
+        sig.ss_queue = max(ss_qs, default=0)
+        sig.tlog_queue = max(tl_qs, default=0)
         if self.fs is not None:
             used: dict = {}
             for (mid, _name), f in self.fs._files.items():
@@ -134,67 +322,176 @@ class Ratekeeper:
             } or set(used)
             cap = srv.sim_disk_capacity_bytes
             for mid in roles:
-                free = min(free, max(0, cap - used.get(mid, 0)))
-        return lag, ss_q, tl_q, free
+                sig.free = min(sig.free, max(0, cap - used.get(mid, 0)))
+        # Resolver signals: worst queue/latency, worst backend state,
+        # SLOWEST measured CPU mirror (the binding one when degraded).
+        states = {"ok": 0, "probing": 1, "degraded": 2}
+        worst_state = "ok"
+        mirror_tps = 0.0
+        snaps = [r.signal_snapshot() for r in self.resolvers]
+        for ri in self.resolver_ifaces:
+            if getattr(ri, "signals", None) is None:
+                continue
+            try:
+                snaps.append(await ri.signals.get_reply(self.process, None))
+            except FdbError:
+                continue  # dead resolver: recovery replaces it
+        for s in snaps:
+            sig.resolver_queue = max(sig.resolver_queue, s.queue_depth)
+            sig.resolve_p99 = max(sig.resolve_p99, s.resolve_p99)
+            if states[s.backend_state] > states[worst_state]:
+                worst_state = s.backend_state
+            if s.backend_state != "ok" and s.cpu_mirror_tps > 0:
+                mirror_tps = (
+                    s.cpu_mirror_tps
+                    if mirror_tps == 0.0
+                    else min(mirror_tps, s.cpu_mirror_tps)
+                )
+        sig.backend_state = worst_state
+        sig.cpu_mirror_tps = mirror_tps
+        # Commit latency: the incremental latency_chain reassembly when the
+        # in-memory collector is live; else the proxies' passive samples
+        # (direct role objects, or the reports riding their rate fetches).
+        # The horizon bounds how long an open (wedged/abandoned) chain can
+        # age the signal: past it the chain is pruned, so the spring
+        # releases within one horizon of the stall resolving.
+        loop = self.process.network.loop
+        horizon = 2.0 * (
+            srv.ratekeeper_target_commit_p99
+            + srv.ratekeeper_spring_commit_p99
+        )
+        p99 = self._chain_sampler.sample(now=loop.now(), horizon=horizon)
+        reports = self._live_reports(loop.now())
+        if p99 is None:
+            candidates = [r.commit_p99 for r in reports if r.commit_p99 > 0]
+            for p in self.proxies:
+                sample = getattr(p, "latency_samples", {}).get("commit")
+                v = sample.percentile(0.99) if sample is not None else None
+                if v:
+                    candidates.append(v)
+            p99 = max(candidates, default=0.0)
+        sig.commit_p99 = p99 or 0.0
+        sig.grv_queue_depth = max(
+            (r.grv_queue_depth for r in reports), default=0
+        )
+        return sig
 
-    def _limit(self, lag, ss_q, tl_q, free, target_frac: float):
+    def _limit(self, sig: Signals, target_frac: float):
         """TPS limit for one priority lane: min over every signal's spring
         at `target_frac` of the configured targets (the batch lane runs the
         same springs at tighter targets — ref the separate batch limiter)."""
         srv = g_knobs.server
         factors = {
             "ss_lag": self._spring(
-                lag,
+                sig.lag,
                 srv.ratekeeper_target_lag_versions * target_frac,
                 srv.ratekeeper_spring_lag_versions * target_frac,
             ),
             "ss_queue": self._spring(
-                ss_q,
+                sig.ss_queue,
                 srv.ratekeeper_target_ss_queue_bytes * target_frac,
                 srv.ratekeeper_spring_ss_queue_bytes * target_frac,
             ),
             "tlog_queue": self._spring(
-                tl_q,
+                sig.tlog_queue,
                 srv.ratekeeper_target_tlog_queue_bytes * target_frac,
                 srv.ratekeeper_spring_tlog_queue_bytes * target_frac,
             ),
             # Free space springs the other way: LOW free compresses.  The
             # batch lane throttles EARLIER (at a higher free watermark).
             "disk_free": self._free_factor(
-                free,
+                sig.free,
                 srv.ratekeeper_target_free_bytes / target_frac,
                 srv.ratekeeper_min_free_bytes,
             ),
+            # Resolver-path springs (ISSUE 8): queue depth in batches and
+            # the recent-window resolve p99 in virtual seconds.
+            "resolver_queue": self._spring(
+                sig.resolver_queue,
+                srv.ratekeeper_target_resolver_queue * target_frac,
+                srv.ratekeeper_spring_resolver_queue * target_frac,
+            ),
+            "resolve_latency": self._spring(
+                sig.resolve_p99,
+                srv.ratekeeper_target_resolve_p99 * target_frac,
+                srv.ratekeeper_spring_resolve_p99 * target_frac,
+            ),
+            "commit_latency": self._spring(
+                sig.commit_p99,
+                srv.ratekeeper_target_commit_p99 * target_frac,
+                srv.ratekeeper_spring_commit_p99 * target_frac,
+            ),
+            "backend_degraded": self._degraded_factor(sig, target_frac),
+            # Mid-recovery floor (see _collect_signals.unreachable): 0.0
+            # compresses the lane to ratekeeper_min_tps until a healthy
+            # generation's ratekeeper replaces this one.
+            "recovering": 0.0 if sig.unreachable else 1.0,
         }
         limiting = min(factors, key=lambda k: factors[k])
         factor = factors[limiting]
         tps = max(srv.ratekeeper_min_tps, srv.ratekeeper_max_tps * factor)
         return tps, (limiting if factor < 1.0 else "none")
 
+    @staticmethod
+    def _degraded_factor(sig: Signals, target_frac: float) -> float:
+        """Not a spring but a cap: while the device circuit is open (or
+        probing) and verdicts fall back to the CPU mirror, the lane's rate
+        contracts to ratekeeper_degraded_tps_fraction of max — the GRV
+        lane must not pile requests onto a degraded resolver.  With
+        ratekeeper_use_measured_cpu_tps (real mode; the measurement is
+        wall-clock derived and would break same-seed replay in sim) the
+        cap additionally clamps to 80% of the measured CPU-mirror
+        throughput so admission tracks what the mirror actually
+        sustains."""
+        if sig.backend_state == "ok":
+            return 1.0
+        srv = g_knobs.server
+        frac = srv.ratekeeper_degraded_tps_fraction
+        if srv.ratekeeper_use_measured_cpu_tps and sig.cpu_mirror_tps > 0:
+            frac = min(
+                frac, 0.8 * sig.cpu_mirror_tps / srv.ratekeeper_max_tps
+            )
+        return max(0.0, frac * target_frac)
+
     async def _update_loop(self):
         """Ref updateRate :251-340: springs on worst storage queue, worst
-        tlog queue, version lag, and free disk; a separate tighter batch
-        lane."""
+        tlog queue, version lag, free disk, and the resolver/device path;
+        a separate tighter batch lane."""
         loop = self.process.network.loop
         while True:
             await loop.delay(self.sample_interval)
-            lag, ss_q, tl_q, free = await self._signals()
-            tps, limiting = self._limit(lag, ss_q, tl_q, free, 1.0)
+            sig = await self._collect_signals()
+            tps, limiting = self._limit(sig, 1.0)
             batch_tps, _ = self._limit(
-                lag, ss_q, tl_q, free,
-                g_knobs.server.ratekeeper_batch_target_fraction,
+                sig, g_knobs.server.ratekeeper_batch_target_fraction
             )
+            self.sample_seq += 1
+            if limiting != self.rate.limiting:
+                self.transitions.append(
+                    [self.sample_seq, self.rate.limiting, limiting,
+                     round(tps, 3)]
+                )
             self.rate = RateInfo(
                 tps=tps,
                 batch_tps=batch_tps,
-                lag_versions=lag,
-                worst_ss_queue_bytes=ss_q,
-                worst_tlog_queue_bytes=tl_q,
-                min_free_bytes=free,
+                lag_versions=sig.lag,
+                worst_ss_queue_bytes=sig.ss_queue,
+                worst_tlog_queue_bytes=sig.tlog_queue,
+                min_free_bytes=sig.free,
+                resolver_queue_depth=sig.resolver_queue,
+                resolve_p99=sig.resolve_p99,
+                commit_p99=sig.commit_p99,
+                backend_state=sig.backend_state,
+                grv_queue_depth=sig.grv_queue_depth,
                 limiting=limiting,
             )
 
     async def _serve(self):
+        loop = self.process.network.loop
         while True:
-            _req, reply = await self._stream.pop()
+            req, reply = await self._stream.pop()
+            if req is not None:
+                # The proxy's demand report rides its fetch (ref:
+                # GetRateInfoRequest.totalReleasedTransactions).
+                self._proxy_reports[req.proxy_id] = (loop.now(), req)
             reply.send(self.rate)

@@ -24,6 +24,7 @@ from ..rpc.stream import RequestStream
 from .interfaces import (
     ResolutionMetricsReply,
     ResolutionSplitRequest,
+    ResolverSignalsReply,
     ResolveTransactionBatchReply,
     ResolveTransactionBatchRequest,
     ResolverInterface,
@@ -81,6 +82,9 @@ class Resolver:
         self._split_stream = RequestStream(
             process, "resolution_split", well_known=True
         )
+        self._signals_stream = RequestStream(
+            process, "resolver_signals", well_known=True
+        )
         # Telemetry registry (ref: Resolver.actor.cpp's resolverCounters +
         # traceCounters): batch sizes, per-verdict counts, and the queue
         # wait the prevVersion reorder imposes.  The loop rng enables
@@ -99,9 +103,20 @@ class Resolver:
         # exported host-side: the CPU engine then serves every later batch
         # of this role's life (see _retry_on_cpu).
         self._cpu_takeover = None
+        # Admission-control signals (ISSUE 8): batches in flight or parked
+        # on the prevVersion chain, and a sliding window of recent resolve
+        # durations (virtual seconds, entry -> reply).  A bounded window —
+        # not the cumulative histogram — so the ratekeeper's spring sees a
+        # latency SPIKE instead of a lifetime-diluted reservoir.
+        self._inflight = 0
+        from collections import deque
+
+        self._recent_resolve = deque(maxlen=64)
+        self.metrics.gauge("queue_depth").set(0)
         process.spawn(self._serve(), "resolver")
         process.spawn(self._serve_metrics(), "resolver_metrics")
         process.spawn(self._serve_split(), "resolver_split")
+        process.spawn(self._serve_signals(), "resolver_signals")
         process.spawn(
             emit_metrics(self.metrics, process), "resolver_metrics_emit"
         )
@@ -111,7 +126,47 @@ class Resolver:
             resolve=self._stream.ref(),
             metrics=self._metrics_stream.ref(),
             split=self._split_stream.ref(),
+            signals=self._signals_stream.ref(),
         )
+
+    @property
+    def queue_depth(self) -> int:
+        """Resolve batches in flight or parked on the prevVersion chain."""
+        return self._inflight
+
+    def resolve_p99_recent(self) -> float:
+        """Exact p99 over the recent resolve-duration window (virtual
+        seconds); 0.0 before any batch completed."""
+        from ..flow.latency_chain import percentile
+
+        return percentile(list(self._recent_resolve), 0.99) or 0.0
+
+    def signal_snapshot(self) -> ResolverSignalsReply:
+        """The admission-control probe (served by the `signals` stream and
+        read directly by in-process ratekeepers).  All O(1)/O(window) —
+        never O(history rows)."""
+        state, mirror_tps = "ok", 0.0
+        bs = getattr(self.conflicts, "backend_signal", None)
+        if callable(bs):
+            sig = bs()
+            state = sig.get("backend_state", "ok")
+            mirror_tps = sig.get("cpu_mirror_tps", 0.0)
+        if self._cpu_takeover is not None:
+            state = "degraded"  # permanent host takeover (raw device set)
+        return ResolverSignalsReply(
+            queue_depth=self._inflight,
+            resolve_p99=self.resolve_p99_recent(),
+            backend_state=state,
+            cpu_mirror_tps=mirror_tps,
+            degraded_batches=int(
+                self.metrics.counter("degraded_batches").value
+            ),
+        )
+
+    async def _serve_signals(self):
+        while True:
+            _req, reply = await self._signals_stream.pop()
+            reply.send(self.signal_snapshot())
 
     async def _serve(self):
         while True:
@@ -225,13 +280,29 @@ class Resolver:
         return best_key
 
     async def _resolve_one(self, req: ResolveTransactionBatchRequest, reply):
-        from ..flow.buggify import buggify
-        from ..flow.trace import trace_batch
-
         if req.epoch != self.epoch:
             self.metrics.counter("stale_epoch").add()
             reply.send_error("operation_failed")  # stale generation's proxy
             return
+        # Queue-depth accounting (ISSUE 8): a batch counts from arrival —
+        # including time parked on the prevVersion chain, which is exactly
+        # where an overloaded resolver's backlog lives — until its reply.
+        loop = self.process.network.loop
+        t_enter = loop.now()
+        self._inflight += 1
+        self.metrics.gauge("queue_depth").set(self._inflight)
+        try:
+            await self._resolve_one_impl(req, reply, t_enter)
+        finally:
+            self._inflight -= 1
+            self.metrics.gauge("queue_depth").set(self._inflight)
+
+    async def _resolve_one_impl(
+        self, req: ResolveTransactionBatchRequest, reply, t_enter: float
+    ):
+        from ..flow.buggify import buggify
+        from ..flow.trace import trace_batch
+
         trace_batch(
             "CommitDebug", "Resolver.resolveBatch.Before", req.debug_id
         )
@@ -350,4 +421,11 @@ class Resolver:
 
         self.version.set(req.version)
         trace_batch("CommitDebug", "Resolver.resolveBatch.After", req.debug_id)
+        # Resolve latency (arrival -> reply, virtual seconds): the sliding
+        # window the ratekeeper's resolve_latency spring reads, plus the
+        # cumulative histogram for status/metrics.  Real resolves only —
+        # cache-hit/stale replies above return early and never dilute it.
+        dt = self.process.network.loop.now() - t_enter
+        self._recent_resolve.append(dt)
+        self.metrics.histogram("resolve_seconds").add(dt)
         reply.send(out)

@@ -237,6 +237,23 @@ def cluster_status(cluster) -> dict:
             )
             qos["released_transactions_behind"] = info.lag_versions
             qos["performance_limited_by"] = getattr(info, "limiting", "none")
+            # Overload-aware signals (ISSUE 8): the resolver/TPU-path
+            # springs the reference's SS/TLog-only qos never carried.
+            qos["worst_resolver_queue_depth"] = getattr(
+                info, "resolver_queue_depth", 0
+            )
+            qos["resolve_latency_p99_seconds"] = getattr(
+                info, "resolve_p99", 0.0
+            )
+            qos["commit_latency_p99_seconds"] = getattr(
+                info, "commit_p99", 0.0
+            )
+            qos["conflict_backend_state"] = getattr(
+                info, "backend_state", "ok"
+            )
+            qos["worst_grv_queue_depth"] = getattr(
+                info, "grv_queue_depth", 0
+            )
         cl["qos"] = qos
         # Passive latency distributions from the proxy's ContinuousSamples
         # (ref: the commit/GRV latency bands in Status.actor.cpp's qos; the

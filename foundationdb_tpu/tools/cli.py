@@ -61,6 +61,9 @@ class CliProcessor:
         "backup": "backup <start|status|restore|describe|expire> <path> "
         "[version | --timestamp=T] — continuous backup driver "
         "(fdbbackup analog)",
+        "soak": "soak — the chaos-soak harness runs its OWN rated "
+        "cluster: invoke as `python -m foundationdb_tpu.tools.cli soak "
+        "[--format=json] ...` (see --help for load/fault options)",
         "dr": "dr <start|status|switch> — replicate into the destination "
         "cluster; switch reverses the roles (fdbdr analog)",
         "help": "help — this text",
@@ -644,9 +647,128 @@ class CliProcessor:
         version = await fut
         return [f"`{key}' changed at version {version}"]
 
+    async def _cmd_soak(self, args):
+        # The soak builds (and tears down) its own rated cluster + event
+        # loop; running it from inside THIS cluster's loop would nest two
+        # simulations.  Point the operator at the subcommand instead.
+        return [
+            "ERROR: soak runs its own rated cluster — invoke it as a "
+            "subcommand: python -m foundationdb_tpu.tools.cli soak "
+            "[--format=json] (see --help)"
+        ]
 
-def main():  # pragma: no cover - interactive entry
+
+def soak_main(argv=None) -> int:
+    """`cli soak`: run the chaos-soak harness (workloads/soak.py) and emit
+    a BENCH-style JSON artifact (goodput, p99s, throttle/shed counts,
+    fault timeline) so future BENCH_r0*.json rounds get a soak arm.
+    Defaults come from the FDB_TPU_SOAK_* env flags (flow/knobs.py
+    g_env); argv overrides them."""
+    import argparse
+
+    from ..flow.knobs import g_env
+    from ..workloads.soak import default_config, run_soak
+
+    ap = argparse.ArgumentParser(
+        prog="cli soak",
+        description="sustained chaos-soak: ramped Zipf load + scripted "
+        "fault matrix against a rated simulated cluster",
+    )
+    ap.add_argument("--minutes", type=float,
+                    default=float(g_env.get("FDB_TPU_SOAK_MINUTES")),
+                    help="soak length in SIM minutes (virtual time)")
+    ap.add_argument("--seed", type=int,
+                    default=g_env.get_int("FDB_TPU_SOAK_SEED"))
+    ap.add_argument("--tps", type=float,
+                    default=float(g_env.get("FDB_TPU_SOAK_TPS")),
+                    help="peak-phase open-loop arrival rate (txn/s)")
+    ap.add_argument("--keys", type=int,
+                    default=g_env.get_int("FDB_TPU_SOAK_KEYS"))
+    ap.add_argument("--theta", type=float,
+                    default=float(g_env.get("FDB_TPU_SOAK_THETA")),
+                    help="Zipf skew exponent (0 = uniform)")
+    ap.add_argument("--backend",
+                    default=g_env.get("FDB_TPU_SOAK_BACKEND"),
+                    choices=("cpu", "jax", "hybrid"))
+    ap.add_argument("--cluster", choices=("sim", "dynamic"), default="sim",
+                    help="dynamic adds recovery-capable process kills")
+    ap.add_argument("--mode", choices=("open", "closed"), default="open")
+    ap.add_argument("--no-faults", action="store_true",
+                    help="pure load run (baseline arm)")
+    ap.add_argument("--format", choices=("text", "json"), default="text")
+    ap.add_argument("--out", default="",
+                    help="also write the JSON artifact to this path")
+    args = ap.parse_args(argv)
+
+    config = default_config(
+        minutes=args.minutes,
+        peak_tps=args.tps,
+        seed=args.seed,
+        cluster=args.cluster,
+        backend=args.backend,
+        mode=args.mode,
+        keys=args.keys,
+        zipf_theta=args.theta,
+        faults=not args.no_faults,
+    )
+    report = run_soak(config)
+    artifact = soak_artifact(report)
+    blob = json.dumps(artifact, indent=2, sort_keys=True)
+    if args.format == "json":
+        print(blob)
+    else:
+        t = report["totals"]
+        print(
+            f"soak: {t['committed']} committed / {t['attempts']} attempts "
+            f"in {t['sim_seconds']}s sim ({t['goodput_tps']} txn/s goodput)"
+        )
+        for ph in report["phases"]:
+            print(
+                f"  {ph['name']:<9} goodput={ph['goodput_tps']:<8} "
+                f"(floor {ph['goodput_floor_tps']}) "
+                f"p99={ph['commit_p99_chain']} "
+                f"throttled={ph['throttled']} "
+                f"{'OK' if ph['slo_ok'] else 'SLO-MISS'}"
+            )
+        for t0, kind, detail, t1 in report["faults"]:
+            print(f"  fault {kind} [{t0:.2f}s..{t1:.2f}s] {detail}")
+        print(f"  slo: {'OK' if report['slo']['ok'] else 'MISSED'}")
+    if args.out:
+        with open(args.out, "w", encoding="utf-8") as f:
+            f.write(blob + "\n")
+    return 0 if report["slo"]["ok"] else 1
+
+
+def soak_artifact(report: dict) -> dict:
+    """BENCH-style artifact shape (one headline metric + the structured
+    evidence), mirroring bench.py's {"metric", "value", "unit", ...}
+    convention so the driver's BENCH_r0*.json collection can absorb it."""
+    t = report["totals"]
+    return {
+        "metric": "soak_goodput_txn_per_sec",
+        "value": t["goodput_tps"],
+        "unit": "txn/s",
+        "sim_seconds": t["sim_seconds"],
+        "committed": t["committed"],
+        "attempts": t["attempts"],
+        "seed": report["config"]["seed"],
+        "cluster": report["config"]["cluster"],
+        "backend": report["config"]["backend"],
+        "phases": report["phases"],
+        "throttle_shed": report["throttle_shed"],
+        "fault_timeline": report["faults"],
+        "ratekeeper_transitions": report["ratekeeper"]["admission_log"],
+        "breaker_transitions": report["breakers"],
+        "slo": report["slo"],
+    }
+
+
+def main(argv=None):  # pragma: no cover - interactive entry
     import sys
+
+    argv = list(sys.argv[1:]) if argv is None else list(argv)
+    if argv and argv[0] == "soak":
+        return soak_main(argv[1:])
 
     from ..server import SimCluster
 
@@ -669,7 +791,10 @@ def main():  # pragma: no cover - interactive entry
         out = cluster.loop.run_until(task, timeout_vt=60.0)
         for ln in out:
             print(ln)
+    return 0
 
 
 if __name__ == "__main__":  # pragma: no cover
-    main()
+    import sys
+
+    sys.exit(main() or 0)

@@ -155,8 +155,19 @@ def run_server(port: int, datadir: str = "", tls=None) -> None:
 
     loop.slow_task_threshold = 0.25
     proc.spawn(run_system_monitor(proc, wall_metrics=True), "system_monitor")
+    # Graceful SIGTERM (ISSUE 8 satellite): first TERM stops the reactor
+    # so the transport closes and we exit 0 below; a second TERM SIGKILLs
+    # the whole process group (procutil ladder) — multi-process soak
+    # teardown can neither leak orphans nor hang on a wedged shutdown.
+    from ..utils.procutil import install_graceful_term
+
+    install_graceful_term(net.stop)
     print(f"READY {net.address}", flush=True)
     net.run_realtime()
+    net.close()
+    if datadir:
+        kv.close()  # flush the native engine's WAL handle cleanly
+    print("SHUTDOWN", flush=True)
 
 
 def run_client(
@@ -233,8 +244,13 @@ def run_ntserver(port: int, tls=None) -> None:
             reply.send(payload)
 
     proc.spawn(serve(), "networktest_serve")
+    from ..utils.procutil import install_graceful_term
+
+    install_graceful_term(net.stop)
     print(f"READY {net.address}", flush=True)
     net.run_realtime()
+    net.close()
+    print("SHUTDOWN", flush=True)
 
 
 def run_ntclient(server: str, requests: int, parallel: int, size: int,

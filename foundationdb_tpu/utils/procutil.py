@@ -64,6 +64,41 @@ def reap_group_on_term() -> None:
     signal.signal(signal.SIGTERM, _h)
 
 
+def install_graceful_term(stop_fn) -> None:
+    """Graceful-then-hard SIGTERM ladder for long-running real-mode
+    servers (real_node): the FIRST SIGTERM calls `stop_fn()` (e.g.
+    RealNetwork.stop) so the reactor unwinds, the transport closes, and
+    the process exits 0 — multi-process soak teardown sees an orderly
+    shutdown instead of a kill -9 corpse.  A SECOND SIGTERM escalates to
+    the reap_group_on_term() big hammer (SIGKILL the whole process group,
+    exit 143), so a wedged shutdown can never leak orphans either."""
+    import os
+
+    state = {"termed": False}
+
+    def _h(signum, frame):
+        if state["termed"]:
+            try:
+                # killpg(0) only when WE lead the group: a spawner that
+                # did not give us our own group (plain Popen) shares its
+                # group with us, and nuking it would SIGKILL the test
+                # session / soak driver itself.  Non-leaders exit alone —
+                # their own children die via PDEATHSIG when they do.
+                if os.getpid() == os.getpgrp():
+                    os.killpg(0, signal.SIGKILL)
+            finally:  # pragma: no cover - killpg(0) includes ourselves
+                os._exit(143)
+        state["termed"] = True
+        try:
+            stop_fn()
+        except Exception:
+            # Post-signal context: stopping failed, the second TERM (or
+            # the spawner's PDEATHSIG) is the recovery path.
+            pass
+
+    signal.signal(signal.SIGTERM, _h)
+
+
 def device_probe_argv(repo_root):
     """argv for a killable child that answers `jax.devices()` or dies at
     the caller's timeout — the ONLY safe way to test TPU-tunnel liveness on

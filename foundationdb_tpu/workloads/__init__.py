@@ -60,6 +60,13 @@ from .perf_metrics import (
     ThroughputWorkload,
     WriteBandwidthWorkload,
 )
+from .soak import (
+    FaultEvent,
+    SoakConfig,
+    SoakPhase,
+    default_config as default_soak_config,
+    run_soak,
+)
 
 __all__ = [
     "TestWorkload",
@@ -114,4 +121,9 @@ __all__ = [
     "WriteBandwidthWorkload",
     "StreamingReadWorkload",
     "PingWorkload",
+    "FaultEvent",
+    "SoakConfig",
+    "SoakPhase",
+    "default_soak_config",
+    "run_soak",
 ]

@@ -1,0 +1,737 @@
+"""Sustained chaos-soak harness: the millions-of-users rehearsal (ISSUE 8).
+
+Every chaos and perf claim before this was a short sim run or a
+single-process bench; this module proves the system *stays up* under
+sustained load while faults fire.  It drives configurable open/closed-loop
+load with Zipf hot-key skew, mixed transaction shapes, and ramping arrival
+rates against a rated cluster (SimCluster + Ratekeeper, or a DynamicCluster
+whose controller recruits one), layers a scripted fault matrix on top —
+process kills, one-directional clogs, a mid-soak device outage via
+DeviceFaultInjector, recovery — and reports per-phase **goodput**
+(committed transactions, not attempts; the metric PAPERS.md's
+contention-management line says matters under overload), latency-chain
+p99s, throttle/shed counts, the fault timeline, and the ratekeeper +
+breaker transition logs.
+
+Everything is virtual-time + DeterministicRandom: two same-seed runs
+produce byte-identical reports (the replay gate tests/test_soak.py pins).
+This harness is the regression gate later perf PRs (Pallas kernels,
+multi-chip) reuse: `cli soak --format=json` emits a BENCH-style artifact.
+"""
+
+from __future__ import annotations
+
+import bisect
+import json
+from dataclasses import dataclass, field
+from typing import List, Optional
+
+from ..flow.error import FdbError
+from ..flow.knobs import g_knobs
+from ..flow.latency_chain import (
+    COMMIT_CHAIN,
+    GRV_CHAIN,
+    percentile,
+    summarize_stages,
+)
+
+
+@dataclass
+class SoakPhase:
+    """One load phase.  Open loop: transactions ARRIVE at `arrival_tps`
+    regardless of completions (the overload-capable mode); closed loop:
+    `actors` clients each keep one transaction in flight."""
+
+    name: str = "phase"
+    duration: float = 5.0  # sim seconds
+    arrival_tps: float = 50.0
+    actors: int = 8
+    # Shape mix (remainder = blind writes): fractions of arrivals.
+    read_fraction: float = 0.25
+    rmw_fraction: float = 0.5
+
+
+@dataclass
+class FaultEvent:
+    """One scripted fault.  kinds: "kill" (process kill + revive; dynamic
+    clusters only), "clog" (ONE-directional network clog — the grey
+    failure where requests land but replies stall), "device_outage"
+    (persistent dispatch outage on one resolver's device engine via
+    DeviceFaultInjector.begin_outage/end_outage)."""
+
+    at: float = 0.0  # sim seconds from soak start
+    kind: str = "clog"
+    duration: float = 1.5  # clog/outage hold; kills recover via recruitment
+    target: str = ""  # kill: role name (default storage0)
+
+
+@dataclass
+class SoakConfig:
+    seed: int = 1
+    cluster: str = "sim"  # sim | dynamic (kills need dynamic)
+    backend: str = "jax"  # conflict backend (device faults need jax/hybrid)
+    mode: str = "open"  # open | closed
+    keys: int = 512
+    zipf_theta: float = 0.9  # 0 = uniform
+    value_bytes: int = 32
+    # Distinct client Database handles the load fans over.  One handle's
+    # GRV batcher coalesces concurrent read-version fetches into a single
+    # in-flight request, so proxy-side admission (queue depth, shed) only
+    # sees real pressure when many CLIENTS contend — the thing a
+    # millions-of-users rehearsal is about.
+    clients: int = 4
+    phases: List[SoakPhase] = field(default_factory=list)
+    faults: List[FaultEvent] = field(default_factory=list)
+    max_in_flight: int = 512  # open-loop client-side cap (memory bound)
+    max_attempts: int = 8  # per-transaction retry budget
+    drain_timeout: float = 15.0  # sim seconds to wait for stragglers
+    rk_sample_interval: float = 0.1
+    n_resolvers: int = 1
+    buggify: bool = False  # scripted faults only, by default
+    # SLO: commit-chain p99 bound (sim seconds) and per-phase goodput
+    # floor as a fraction of that phase's arrival rate (open loop) or an
+    # absolute committed/s floor (closed loop).
+    slo_commit_p99: float = 2.0
+    goodput_floor_frac: float = 0.3
+    goodput_floor_tps: float = 1.0
+    # Knob overrides applied for the run (None = leave as configured).
+    max_tps: Optional[float] = None
+    grv_queue_max: Optional[int] = None
+    degraded_tps_fraction: Optional[float] = None
+    # Device key budget: a DynamicCluster's system-keyspace metadata keys
+    # (\xff/keyServers/..., \xff/serverList/...) exceed the default
+    # 16-byte device width, which would route every mixed batch to the
+    # CPU mirror; widen so the device path actually serves the soak.
+    device_key_words: Optional[int] = None
+    device_key_bytes: Optional[int] = None
+
+
+def default_phases(peak_tps: float, total_seconds: float) -> List[SoakPhase]:
+    """The ramp the ISSUE asks for: warm -> ramp -> peak -> cooldown, with
+    the peak phase taking half the budget (where the fault matrix fires)."""
+    return [
+        SoakPhase("warm", total_seconds * 0.15, peak_tps * 0.3),
+        SoakPhase("ramp", total_seconds * 0.2, peak_tps * 0.6),
+        SoakPhase("peak", total_seconds * 0.5, peak_tps),
+        SoakPhase("cooldown", total_seconds * 0.15, peak_tps * 0.4),
+    ]
+
+
+def default_faults(
+    total_seconds: float, kills: bool
+) -> List[FaultEvent]:
+    """The scripted matrix: a process kill early in the peak phase, a
+    one-directional clog mid-peak, a device outage late-peak — each with
+    recovery room before the next (the test asserts the ratekeeper
+    throttles DURING each window and releases after)."""
+    out = []
+    if kills:
+        out.append(FaultEvent(at=total_seconds * 0.40, kind="kill",
+                              target="tlog0",
+                              duration=min(2.5, total_seconds * 0.05)))
+    out.append(FaultEvent(at=total_seconds * 0.55, kind="clog",
+                          duration=min(2.0, total_seconds * 0.06)))
+    out.append(FaultEvent(at=total_seconds * 0.75, kind="device_outage",
+                          duration=min(2.0, total_seconds * 0.06)))
+    return out
+
+
+def default_config(
+    minutes: float = 2.0,
+    peak_tps: float = 120.0,
+    seed: int = 1,
+    cluster: str = "sim",
+    backend: str = "jax",
+    mode: str = "open",
+    keys: int = 512,
+    zipf_theta: float = 0.9,
+    faults: bool = True,
+) -> SoakConfig:
+    total = minutes * 60.0
+    return SoakConfig(
+        seed=seed,
+        cluster=cluster,
+        backend=backend,
+        mode=mode,
+        keys=keys,
+        zipf_theta=zipf_theta,
+        phases=default_phases(peak_tps, total),
+        faults=default_faults(total, kills=(cluster == "dynamic"))
+        if faults
+        else [],
+        # Dynamic clusters mix system-keyspace metadata into the same
+        # resolver: widen the device key budget so those batches stay
+        # device-eligible (see SoakConfig.device_key_words).
+        device_key_words=16 if cluster == "dynamic" else None,
+        device_key_bytes=64 if cluster == "dynamic" else None,
+    )
+
+
+def zipf_cdf(n: int, theta: float) -> List[float]:
+    """Cumulative Zipf(theta) weights over ranks 1..n (theta=0 uniform).
+    O(n) once per soak; sampling is a binary search per draw."""
+    total = 0.0
+    cdf = []
+    for k in range(1, n + 1):
+        total += k ** (-theta) if theta > 0 else 1.0
+        cdf.append(total)
+    return [c / total for c in cdf]
+
+
+def zipf_pick(rng, cdf: List[float]) -> int:
+    """Rank index in [0, len(cdf)) — low indexes are the hot keys."""
+    return bisect.bisect_left(cdf, rng.random01())
+
+
+class _PhaseStats:
+    """Mutable per-phase tallies (attributed to the phase a transaction
+    STARTED in, so cross-boundary completions aren't double-counted)."""
+
+    FIELDS = ("arrivals", "client_shed", "attempts", "committed",
+              "conflicted", "too_old", "throttled", "other_errors",
+              "failed", "exhausted")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.counts = {f: 0 for f in self.FIELDS}
+        self.latencies: List[float] = []  # client-observed commit seconds
+        self.t_start = 0.0
+        self.t_end = 0.0
+        self.ev_start = 0  # trace-collector event cursor at phase start
+        self.ev_end = 0
+
+
+class SoakRun:
+    """One soak execution against a prepared cluster.  Use run_soak()
+    unless you are composing the harness into a larger test."""
+
+    def __init__(self, config: SoakConfig, cluster, dbs):
+        self.config = config
+        self.cluster = cluster
+        self.dbs = list(dbs)
+        self.db = self.dbs[0]  # driver actors run on the first client
+        self._next_client = 0
+        self.loop = cluster.loop
+        # The soak's own random stream: forked from the loop rng so fault
+        # scheduling never perturbs role-level sim decisions mid-run.
+        self.rng = self.loop.rng.split()
+        self.cdf = zipf_cdf(config.keys, config.zipf_theta)
+        self.stats = [_PhaseStats(p.name) for p in config.phases]
+        self.in_flight = 0
+        self.fault_timeline: List[list] = []  # [t, kind, detail, t_end]
+        # Sampled admission log: [t, limiting, tps] whenever the CURRENT
+        # ratekeeper's binding signal changes — generation-proof (a
+        # DynamicCluster recruits a fresh Ratekeeper per recovery, whose
+        # own transitions log resets; this one spans the whole soak).
+        self.admission_log: List[list] = []
+        self._stop = False
+
+    # -- cluster accessors ------------------------------------------------
+    def current_ratekeeper(self):
+        cluster = self.cluster
+        if hasattr(cluster, "controllers"):
+            try:
+                return getattr(
+                    cluster.acting_controller(), "ratekeeper", None
+                )
+            except RuntimeError:
+                return None
+        return getattr(cluster, "_soak_ratekeeper", None)
+
+    def _resolver_conflict_sets(self):
+        from ..server.status import role_objects
+
+        out = []
+        for r in role_objects(self.cluster, "resolver"):
+            cs = getattr(r, "conflicts", None)
+            if cs is not None and getattr(cs, "_jax", None) is not None:
+                out.append((r, cs))
+        return out
+
+    # -- transaction plans ------------------------------------------------
+    def _key(self, idx: int) -> bytes:
+        return b"soak/%06d" % idx
+
+    def _plan_txn(self, rng, phase: SoakPhase):
+        """Decide shape + keys AT ARRIVAL (one deterministic draw order,
+        independent of task interleaving)."""
+        r = rng.random01()
+        if r < phase.read_fraction:
+            kind = "read"
+        elif r < phase.read_fraction + phase.rmw_fraction:
+            kind = "rmw"
+        else:
+            kind = "write"
+        nkeys = 1 + int(rng.random_int(0, 3))
+        keys = sorted({zipf_pick(rng, self.cdf) for _ in range(nkeys)})
+        return kind, keys, int(rng.random_int(0, 1 << 30))
+
+    async def _apply(self, tr, plan):
+        kind, keys, salt = plan
+        pad = max(1, self.config.value_bytes)
+        if kind == "read":
+            for ki in keys:
+                await tr.get(self._key(ki))
+        elif kind == "rmw":
+            for ki in keys:
+                v = await tr.get(self._key(ki))
+                n = int(v.split(b":")[0]) if v else 0
+                tr.set(
+                    self._key(ki),
+                    b"%d:%s" % (n + 1, b"x" * (pad - 1)),
+                )
+        else:
+            for ki in keys:
+                tr.set(self._key(ki), b"%d:%s" % (salt, b"w" * (pad - 1)))
+
+    def _classify(self, st: _PhaseStats, e: FdbError):
+        c = st.counts
+        if e.name == "not_committed":
+            c["conflicted"] += 1
+        elif e.name == "transaction_too_old":
+            c["too_old"] += 1
+        elif e.name in (
+            "batch_transaction_throttled",
+            "proxy_memory_limit_exceeded",
+        ):
+            c["throttled"] += 1
+        else:
+            c["other_errors"] += 1
+
+    async def _run_txn(self, db, plan, pi: int):
+        st = self.stats[pi]
+        loop = self.loop
+        t0 = loop.now()
+        tr = db.create_transaction()
+        try:
+            for _attempt in range(self.config.max_attempts):
+                st.counts["attempts"] += 1
+                try:
+                    await self._apply(tr, plan)
+                    await tr.commit()
+                    st.counts["committed"] += 1
+                    st.latencies.append(loop.now() - t0)
+                    return
+                except FdbError as e:
+                    self._classify(st, e)
+                    try:
+                        # Exponential backoff + DeterministicRandom jitter
+                        # (Transaction.on_error) — exactly how throttled
+                        # clients are supposed to retreat.
+                        await tr.on_error(e)
+                    except FdbError:
+                        st.counts["failed"] += 1
+                        return
+            st.counts["exhausted"] += 1
+        finally:
+            self.in_flight -= 1
+
+    # -- drivers ----------------------------------------------------------
+    async def _load_driver(self):
+        from ..flow.eventloop import all_of
+        from ..flow.trace import global_collector
+
+        loop = self.loop
+        col = global_collector()
+        for pi, phase in enumerate(self.config.phases):
+            st = self.stats[pi]
+            st.t_start = loop.now()
+            st.ev_start = len(col.events)
+            end = loop.now() + phase.duration
+            if self.config.mode == "open":
+                rate = max(phase.arrival_tps, 1e-6)
+                while loop.now() < end:
+                    await loop.delay(1.0 / rate)
+                    st.counts["arrivals"] += 1
+                    if self.in_flight >= self.config.max_in_flight:
+                        # Client-side cap: an overloaded open loop bounds
+                        # its own memory; the drop is COUNTED, never
+                        # silent (no-silent-caps discipline).
+                        st.counts["client_shed"] += 1
+                        continue
+                    plan = self._plan_txn(self.rng, phase)
+                    db = self.dbs[self._next_client]
+                    self._next_client = (
+                        self._next_client + 1
+                    ) % len(self.dbs)
+                    self.in_flight += 1
+                    db.process.spawn(
+                        self._run_txn(db, plan, pi), "soak_txn"
+                    )
+            else:
+                tasks = [
+                    self.db.process.spawn(
+                        self._closed_actor(
+                            self.dbs[ai % len(self.dbs)], pi, phase, end
+                        ),
+                        f"soak_actor{ai}",
+                    )
+                    for ai in range(phase.actors)
+                ]
+                await all_of(tasks)
+            st.t_end = loop.now()
+            st.ev_end = len(col.events)
+        # Drain stragglers (bounded): goodput counts completions, and a
+        # hung tail must fail the SLO rather than hang the harness.
+        deadline = loop.now() + self.config.drain_timeout
+        while self.in_flight > 0 and loop.now() < deadline:
+            await loop.delay(0.05)
+        self._stop = True
+
+    async def _closed_actor(self, db, pi: int, phase: SoakPhase, end: float):
+        loop = self.loop
+        rng = self.rng.split()
+        while loop.now() < end:
+            st = self.stats[pi]
+            st.counts["arrivals"] += 1
+            plan = self._plan_txn(rng, phase)
+            self.in_flight += 1
+            await self._run_txn(db, plan, pi)
+
+    async def _fault_driver(self):
+        loop = self.loop
+        t0 = loop.now()
+        for ev in sorted(self.config.faults, key=lambda e: (e.at, e.kind)):
+            dt = t0 + ev.at - loop.now()
+            if dt > 0:
+                await loop.delay(dt)
+            if ev.kind == "kill":
+                await self._fault_kill(ev)
+            elif ev.kind == "clog":
+                await self._fault_clog(ev)
+            elif ev.kind == "device_outage":
+                await self._fault_device_outage(ev)
+            else:
+                raise ValueError(f"unknown fault kind {ev.kind!r}")
+
+    async def _fault_kill(self, ev: FaultEvent):
+        """Process kill with the machine HELD DOWN for ev.duration, then
+        revive: a sustained role outage, not a blink.  The CC's recovery
+        must wait for the stateful machine (it cannot recruit an empty
+        replacement without losing acked data), so the commit pipeline
+        stalls for the window and the OLD generation's ratekeeper — whose
+        role probes now all fail — floors admission (`recovering`) until
+        the recovered generation's fresh ratekeeper takes over
+        (DynamicCluster only)."""
+        from .chaos import revive_worker
+
+        cluster = self.cluster
+        if not hasattr(cluster, "controllers"):
+            raise ValueError("kill faults need cluster='dynamic'")
+        role = ev.target or "tlog0"
+        t = self.loop.now()
+        try:
+            proc = cluster.kill_role_process(role)
+        except (KeyError, RuntimeError):
+            self.fault_timeline.append([t, "kill", f"{role}:unrecruited", t])
+            return
+        cluster.fs.crash_machine(proc.machine.machine_id)
+        if ev.duration > 0:
+            await self.loop.delay(ev.duration)
+        revive_worker(cluster, proc)
+        self.fault_timeline.append([t, "kill", role, self.loop.now()])
+
+    def _clog_endpoints(self):
+        """(src, dst) machine ids for the one-directional clog: tlog ->
+        storage, so log-stream pulls stall, the storage falls behind, and
+        the ss_lag spring visibly binds."""
+        from ..server.status import role_objects
+
+        tlogs = role_objects(self.cluster, "tlog")
+        storages = role_objects(self.cluster, "storage")
+        if tlogs and storages:
+            return (
+                tlogs[0].process.machine.machine_id,
+                storages[0].process.machine.machine_id,
+            )
+        machines = sorted(self.cluster.net.machines)
+        return machines[0], machines[-1]
+
+    async def _fault_clog(self, ev: FaultEvent):
+        src, dst = self._clog_endpoints()
+        t = self.loop.now()
+        self.cluster.net.clog_pair(src, dst, ev.duration)
+        self.fault_timeline.append(
+            [t, "clog", f"{src}->{dst}", t + ev.duration]
+        )
+
+    async def _fault_device_outage(self, ev: FaultEvent):
+        """Persistent dispatch outage on ONE resolver's device engine: the
+        PR-3 breaker opens, verdicts fall back to the CPU mirror, the
+        ratekeeper contracts (backend_degraded), then the outage lifts and
+        the half-open probe recovers."""
+        from ..conflict.device_faults import DeviceFaultInjector
+
+        sets = self._resolver_conflict_sets()
+        t = self.loop.now()
+        if not sets:
+            self.fault_timeline.append([t, "device_outage", "no-device", t])
+            return
+        r, cs = sets[0]
+        inj = cs._jax.fault_injector
+        if inj is None:
+            inj = DeviceFaultInjector(rng=self.rng.split())
+            cs.install_fault_injector(inj)
+        inj.begin_outage("dispatch")
+        await self.loop.delay(ev.duration)
+        inj.end_outage("dispatch")
+        self.fault_timeline.append(
+            [t, "device_outage", r.process.name, self.loop.now()]
+        )
+
+    async def _admission_monitor(self):
+        """Sample the CURRENT ratekeeper's binding signal; log changes.
+        Spans generations (see admission_log comment)."""
+        loop = self.loop
+        last = None
+        while not self._stop:
+            await loop.delay(self.config.rk_sample_interval)
+            rk = self.current_ratekeeper()
+            if rk is None:
+                continue
+            limiting = rk.rate.limiting
+            if limiting != last:
+                self.admission_log.append(
+                    [round(loop.now(), 4), limiting, round(rk.rate.tps, 3)]
+                )
+                last = limiting
+
+    async def main(self):
+        from ..flow.eventloop import all_of
+
+        mon = self.db.process.spawn(self._admission_monitor(), "soak_rkmon")
+        faults = self.db.process.spawn(self._fault_driver(), "soak_faults")
+        await self._load_driver()
+        await all_of([faults])
+        await all_of([mon])
+        return self.report()
+
+    # -- reporting --------------------------------------------------------
+    def _phase_chain_p99(self, st: _PhaseStats, chain, type_):
+        from ..flow.trace import global_collector
+
+        events = global_collector().events[st.ev_start:st.ev_end]
+        summary = summarize_stages(events, type_, chain)
+        return summary.get("total", {}).get("p99")
+
+    def report(self) -> dict:
+        cfg = self.config
+        phases = []
+        worst_p99 = 0.0
+        slo_ok = True
+        for st, phase in zip(self.stats, cfg.phases):
+            dur = max(st.t_end - st.t_start, 1e-9)
+            goodput = st.counts["committed"] / dur
+            chain_p99 = self._phase_chain_p99(st, COMMIT_CHAIN, "CommitDebug")
+            grv_p99 = self._phase_chain_p99(st, GRV_CHAIN, "TransactionDebug")
+            client_p99 = percentile(st.latencies, 0.99)
+            floor = (
+                phase.arrival_tps * cfg.goodput_floor_frac
+                if cfg.mode == "open"
+                else cfg.goodput_floor_tps
+            )
+            ok = goodput >= floor and (
+                chain_p99 is None or chain_p99 <= cfg.slo_commit_p99
+            )
+            slo_ok = slo_ok and ok
+            if chain_p99 is not None:
+                worst_p99 = max(worst_p99, chain_p99)
+            phases.append(
+                {
+                    "name": st.name,
+                    "duration": round(dur, 4),
+                    **st.counts,
+                    "goodput_tps": round(goodput, 3),
+                    "goodput_floor_tps": round(floor, 3),
+                    "commit_p99_chain": chain_p99,
+                    "grv_p99_chain": grv_p99,
+                    "commit_p99_client": client_p99,
+                    "slo_ok": ok,
+                }
+            )
+        totals = {
+            f: sum(st.counts[f] for st in self.stats)
+            for f in _PhaseStats.FIELDS
+        }
+        wall_span = (
+            self.stats[-1].t_end - self.stats[0].t_start
+            if self.stats
+            else 0.0
+        )
+        # Proxy-side shed counters (the enforcement half of throttling).
+        from ..server.status import role_objects
+
+        shed = {"grv_shed_batch": 0, "grv_shed_default": 0}
+        for p in role_objects(self.cluster, "proxy"):
+            stats = getattr(p, "stats", None)
+            if stats is None:
+                continue
+            snap = stats.snapshot()
+            for k in shed:
+                shed[k] += snap.get(k, 0)
+        rk = self.current_ratekeeper()
+        breakers = {}
+        for r, cs in self._resolver_conflict_sets():
+            if cs._breaker is not None:
+                breakers[r.process.name] = [
+                    list(tr) for tr in cs._breaker.transitions
+                ]
+        return {
+            "config": {
+                "seed": cfg.seed,
+                "cluster": cfg.cluster,
+                "backend": cfg.backend,
+                "mode": cfg.mode,
+                "keys": cfg.keys,
+                "zipf_theta": cfg.zipf_theta,
+                "phases": [
+                    {"name": p.name, "duration": p.duration,
+                     "arrival_tps": p.arrival_tps}
+                    for p in cfg.phases
+                ],
+                "faults": [
+                    {"at": f.at, "kind": f.kind, "duration": f.duration,
+                     "target": f.target}
+                    for f in cfg.faults
+                ],
+            },
+            "phases": phases,
+            "totals": {
+                **totals,
+                "sim_seconds": round(wall_span, 4),
+                "goodput_tps": round(
+                    totals["committed"] / max(wall_span, 1e-9), 3
+                ),
+            },
+            "throttle_shed": {
+                **shed,
+                "client_throttled": totals["throttled"],
+            },
+            "faults": [list(f) for f in self.fault_timeline],
+            "ratekeeper": {
+                "admission_log": [list(e) for e in self.admission_log],
+                "transitions": (
+                    [list(t) for t in rk.transitions] if rk else []
+                ),
+            },
+            "breakers": breakers,
+            "slo": {
+                "commit_p99_bound": cfg.slo_commit_p99,
+                "worst_phase_commit_p99": worst_p99 or None,
+                "ok": slo_ok,
+            },
+        }
+
+
+def transition_logs_json(report: dict) -> str:
+    """Canonical byte form of the replay-gated logs: the admission log,
+    the (current-generation) ratekeeper transitions, and every breaker
+    transition log.  Same seed => byte-identical."""
+    return json.dumps(
+        {
+            "admission": report["ratekeeper"]["admission_log"],
+            "ratekeeper": report["ratekeeper"]["transitions"],
+            "breakers": report["breakers"],
+            "faults": report["faults"],
+        },
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+
+
+def run_soak(config: SoakConfig) -> dict:
+    """Build a rated cluster per `config`, run the soak, return the
+    report.  Owns loop/collector/knob lifecycle: installs a fresh
+    in-memory trace collector (latency chains + determinism isolation)
+    and restores every knob it touches."""
+    from ..flow.eventloop import set_event_loop
+    from ..flow.trace import TraceCollector, set_global_collector
+
+    srv = g_knobs.server
+    saved = {
+        "sample_rate": g_knobs.client.latency_sample_rate,
+        "max_tps": srv.ratekeeper_max_tps,
+        "grv_queue_max": srv.ratekeeper_grv_queue_max,
+        "degraded_frac": srv.ratekeeper_degraded_tps_fraction,
+        "key_words": srv.conflict_device_key_words,
+        "key_bytes": srv.conflict_max_device_key_bytes,
+    }
+    from ..flow.trace import global_collector
+
+    old_col = global_collector()
+    set_global_collector(TraceCollector())
+    try:
+        # Sample every transaction: the soak's SLO gate IS the latency
+        # chain, and the harness owns its own (fresh) collector.
+        g_knobs.client.latency_sample_rate = 1.0
+        if config.max_tps is not None:
+            srv.ratekeeper_max_tps = config.max_tps
+        if config.grv_queue_max is not None:
+            srv.ratekeeper_grv_queue_max = config.grv_queue_max
+        if config.degraded_tps_fraction is not None:
+            srv.ratekeeper_degraded_tps_fraction = (
+                config.degraded_tps_fraction
+            )
+        if config.device_key_words is not None:
+            srv.conflict_device_key_words = config.device_key_words
+        if config.device_key_bytes is not None:
+            srv.conflict_max_device_key_bytes = config.device_key_bytes
+        cluster, dbs = _build_cluster(config)
+        run = SoakRun(config, cluster, dbs)
+        db = dbs[0]
+        total = sum(p.duration for p in config.phases)
+        task = db.process.spawn(run.main(), "soak_main")
+        report = cluster.run_until(
+            task, timeout_vt=total * 20 + config.drain_timeout + 600.0
+        )
+        return report
+    finally:
+        g_knobs.client.latency_sample_rate = saved["sample_rate"]
+        srv.ratekeeper_max_tps = saved["max_tps"]
+        srv.ratekeeper_grv_queue_max = saved["grv_queue_max"]
+        srv.ratekeeper_degraded_tps_fraction = saved["degraded_frac"]
+        srv.conflict_device_key_words = saved["key_words"]
+        srv.conflict_max_device_key_bytes = saved["key_bytes"]
+        set_global_collector(old_col)
+        set_event_loop(None)
+
+
+def _build_cluster(config: SoakConfig):
+    """A rated cluster + primed client Database handles."""
+    n_clients = max(1, config.clients)
+    if config.cluster == "dynamic":
+        from ..server.dynamic_cluster import DynamicCluster
+
+        cluster = DynamicCluster(
+            seed=config.seed,
+            conflict_backend=config.backend,
+            buggify=config.buggify,
+        )
+        dbs = [cluster.database(f"soak{i}") for i in range(n_clients)]
+
+        async def prime(tr):
+            tr.set(b"soak/boot", b"1")
+
+        cluster.run_all([(dbs[0], dbs[0].run(prime))], timeout_vt=600.0)
+        return cluster, dbs
+    from ..server import SimCluster
+    from ..server.ratekeeper import Ratekeeper
+
+    cluster = SimCluster(
+        seed=config.seed,
+        conflict_backend=config.backend,
+        n_resolvers=config.n_resolvers,
+        buggify=config.buggify,
+    )
+    rk = Ratekeeper(
+        cluster.master_proc,
+        cluster.tlogs,
+        cluster.storages,
+        sample_interval=config.rk_sample_interval,
+        resolvers=cluster.resolvers,
+        proxies=cluster.proxies,
+    )
+    for p in cluster.proxies:
+        p.ratekeeper = rk.interface()
+    cluster._soak_ratekeeper = rk
+    return cluster, [cluster.database(f"soak{i}") for i in range(n_clients)]

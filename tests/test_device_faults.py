@@ -501,6 +501,117 @@ def test_device_chaos_workload_composes_with_clogging():
         g_knobs.flow.buggify_activated_probability = old_act
 
 
+def test_backend_signal_cheap_probe():
+    """ISSUE 8 satellite: ConflictSet.backend_signal() is the O(1)
+    ratekeeper probe — breaker state + measured CPU-mirror throughput —
+    with no history-row walks and no histogram snapshotting.  Degraded
+    batches feed the measurement; healthy ones don't."""
+    sig = _device_set().backend_signal()
+    assert sig == {
+        "backend_state": "ok",
+        "cpu_mirror_tps": 0.0,
+        "cpu_fallback_txns": 0,
+    }
+    # CPU-only sets answer trivially-ok too (uniform resolver plumbing).
+    assert ConflictSet(backend="cpu").backend_signal()["backend_state"] == "ok"
+
+    inj = DeviceFaultInjector()
+    for at in (1, 2, 3, 4):
+        inj.script("dispatch", at=at)
+    cs = _device_set(fault_injector=inj)
+    for i in range(4):
+        b = cs.new_batch()
+        b.add_transaction(
+            T(read_snapshot=9 + i, write_ranges=[(k(i), k(i + 1))])
+        )
+        b.detect_conflicts(10 + i, 0)
+    sig = cs.backend_signal()
+    assert sig["backend_state"] == "degraded"  # 3 consecutive faults opened
+    assert sig["cpu_fallback_txns"] == 4  # every faulted batch measured
+    assert sig["cpu_mirror_tps"] > 0.0  # wall-measured mirror throughput
+    # The deterministic counter surface carries the txn count too.
+    assert cs._jax.metrics.counter("cpu_fallback_txns").value == 4
+
+
+def test_long_key_pin_lifts_after_window(monkeypatch):
+    """ISSUE 8 regression: a long-key write pins history to the CPU
+    mirror, but only until the write ages out of the MVCC window AND its
+    boundary leaves the mirror — NOT for the resolver's lifetime (a
+    DynamicCluster's system-keyspace metadata writes would otherwise
+    disable the device path forever)."""
+    from foundationdb_tpu.conflict.types import COMMITTED
+
+    cs = _device_set()
+    max_key = min(
+        g_knobs.server.conflict_max_device_key_bytes, 3 * 4
+    )
+    long_key = b"L" * (max_key + 4)
+
+    def short_batch(now, nov):
+        b = cs.new_batch()
+        b.add_transaction(
+            T(read_snapshot=now - 1, write_ranges=[(k(now), k(now + 1))])
+        )
+        return b.detect_conflicts(now, nov)
+
+    assert short_batch(10, 0) == [COMMITTED]
+    before = cs._jax.metrics.counter("batches").value
+    assert before >= 1  # device served the short batch
+
+    # Long-key write at version 20: pins the device path.
+    b = cs.new_batch()
+    b.add_transaction(
+        T(read_snapshot=19, write_ranges=[(long_key, long_key + b"\x00")])
+    )
+    b.detect_conflicts(20, 0)
+    assert cs._history_long_keys and cs._long_key_version == 20
+    assert short_batch(25, 0) == [COMMITTED]  # still CPU-served
+    assert cs._jax.metrics.counter("batches").value == before
+
+    # Window passes the long-key write: eviction drops the boundary (its
+    # predecessor is also below-window), the pin lifts, the device
+    # rehydrates and serves again.
+    assert short_batch(60, 30) == [COMMITTED]  # evicts; scan next batch
+    assert short_batch(61, 31) == [COMMITTED]
+    assert not cs._history_long_keys
+    assert cs._jax.metrics.counter("batches").value > before
+    assert all(len(key) <= max_key for key in cs._cpu.keys)
+
+
+def test_long_key_pin_persists_while_boundary_survives():
+    """The sound half of the un-pin: a long-key boundary that outlives
+    the window (as the right edge of a hot predecessor range) keeps the
+    pin until it is really gone — load_from must never see it."""
+    from foundationdb_tpu.conflict.types import COMMITTED
+
+    cs = _device_set()
+    max_key = min(g_knobs.server.conflict_max_device_key_bytes, 3 * 4)
+    long_key = b"L" * (max_key + 4)
+    # A range whose END is the long key: the long boundary marks the
+    # right edge, and rewriting the range start keeps it load-bearing.
+    b = cs.new_batch()
+    b.add_transaction(
+        T(read_snapshot=9, write_ranges=[(b"A", long_key)])
+    )
+    b.detect_conflicts(10, 0)
+    assert cs._history_long_keys
+
+    def hot_rewrite(now, nov):
+        bb = cs.new_batch()
+        bb.add_transaction(
+            T(read_snapshot=now - 1, write_ranges=[(b"A", b"B")])
+        )
+        return bb.detect_conflicts(now, nov)
+
+    # Window passes version 10, but the hot predecessor keeps the long
+    # boundary alive (removeBefore keeps a below-window boundary whose
+    # predecessor is in-window) — the pin must hold.
+    for now, nov in ((40, 20), (70, 50), (100, 80)):
+        assert hot_rewrite(now, nov) == [COMMITTED]
+    if any(len(key) > max_key for key in cs._cpu.keys):
+        assert cs._history_long_keys  # boundary alive => pinned
+
+
 def test_degraded_flag_consumed_once():
     inj = DeviceFaultInjector()
     inj.script("dispatch", at=1)

@@ -117,11 +117,13 @@ def test_batch_priority_lane_throttles_first():
     try:
         # Construct moderate lag: above the batch target (frac*target) but
         # below the default target.
+        from foundationdb_tpu.server.ratekeeper import Signals
+
         g_knobs.server.ratekeeper_target_lag_versions = 1000
         g_knobs.server.ratekeeper_spring_lag_versions = 1000
         lag = 1400  # batch target 500, spring 500 -> batch heavily cut
-        tps, limiting = rk._limit(lag, 0, 0, 1 << 62, 1.0)
-        btps, _ = rk._limit(lag, 0, 0, 1 << 62, 0.5)
+        tps, limiting = rk._limit(Signals(lag=lag), 1.0)
+        btps, _ = rk._limit(Signals(lag=lag), 0.5)
         assert tps > 0.5 * 1000.0  # default lane mostly open
         assert btps < tps  # batch lane strictly behind
         assert limiting == "ss_lag"
@@ -169,6 +171,233 @@ def test_batch_priority_grv_deferred_under_throttle():
         assert done["default"][-1] < done["batch"][-1]
     finally:
         g_knobs.server.ratekeeper_max_tps = old
+
+
+def test_spring_monotonicity_every_signal():
+    """ISSUE 8 satellite: for EACH signal — ss queue, tlog queue, version
+    lag, resolver queue, resolve latency, commit latency — a worse input
+    yields a non-increasing TPS limit, and `limiting` names the binding
+    signal once the spring engages."""
+    from foundationdb_tpu.server.ratekeeper import Signals
+
+    c, rk, old = make_rated_cluster(71, max_tps=10000.0)
+    try:
+        srv = g_knobs.server
+        cases = {
+            "ss_lag": lambda v: Signals(
+                lag=int(v * srv.ratekeeper_target_lag_versions)
+            ),
+            "ss_queue": lambda v: Signals(
+                ss_queue=int(v * srv.ratekeeper_target_ss_queue_bytes)
+            ),
+            "tlog_queue": lambda v: Signals(
+                tlog_queue=int(v * srv.ratekeeper_target_tlog_queue_bytes)
+            ),
+            "resolver_queue": lambda v: Signals(
+                resolver_queue=int(v * srv.ratekeeper_target_resolver_queue)
+            ),
+            "resolve_latency": lambda v: Signals(
+                resolve_p99=v * srv.ratekeeper_target_resolve_p99
+            ),
+            "commit_latency": lambda v: Signals(
+                commit_p99=v * srv.ratekeeper_target_commit_p99
+            ),
+        }
+        for name, mk in cases.items():
+            last = None
+            for severity in (0.0, 0.5, 1.0, 1.5, 2.0, 4.0, 8.0, 100.0):
+                tps, _limiting = rk._limit(mk(severity), 1.0)
+                if last is not None:
+                    assert tps <= last, (name, severity, tps, last)
+                last = tps
+            tps, limiting = rk._limit(mk(2.0), 1.0)
+            assert limiting == name, (name, limiting)
+            assert tps < srv.ratekeeper_max_tps
+        # Degraded device backend: worse state => non-increasing, named.
+        tps_ok, _ = rk._limit(Signals(), 1.0)
+        tps_deg, limiting = rk._limit(Signals(backend_state="degraded"), 1.0)
+        assert tps_deg <= tps_ok and limiting == "backend_degraded"
+        assert tps_deg <= (
+            srv.ratekeeper_max_tps * srv.ratekeeper_degraded_tps_fraction
+        )
+        tps_prob, limiting = rk._limit(Signals(backend_state="probing"), 1.0)
+        assert tps_prob == tps_deg and limiting == "backend_degraded"
+        # Disk free springs the other way: LESS free => non-increasing.
+        last = None
+        for free in (1 << 62, srv.ratekeeper_target_free_bytes,
+                     srv.ratekeeper_target_free_bytes // 2,
+                     srv.ratekeeper_min_free_bytes, 0):
+            tps, _ = rk._limit(Signals(free=free), 1.0)
+            if last is not None:
+                assert tps <= last, (free, tps, last)
+            last = tps
+        # Mid-recovery floor: every role probe failing floors admission.
+        tps_rec, limiting = rk._limit(Signals(unreachable=True), 1.0)
+        assert tps_rec == srv.ratekeeper_min_tps
+        assert limiting == "recovering"
+    finally:
+        g_knobs.server.ratekeeper_max_tps = old
+
+
+def test_degraded_cap_tracks_measured_cpu_mirror_tps():
+    """With ratekeeper_use_measured_cpu_tps (real mode), the degraded cap
+    clamps to 80% of the measured CPU-mirror throughput — admission
+    contracts proportionally to what the mirror actually sustains."""
+    from foundationdb_tpu.server.ratekeeper import Signals
+
+    c, rk, old = make_rated_cluster(72, max_tps=10000.0)
+    old_use = g_knobs.server.ratekeeper_use_measured_cpu_tps
+    try:
+        g_knobs.server.ratekeeper_use_measured_cpu_tps = True
+        # Measured mirror slower than the configured fraction: it binds.
+        sig = Signals(backend_state="degraded", cpu_mirror_tps=500.0)
+        tps, limiting = rk._limit(sig, 1.0)
+        assert limiting == "backend_degraded"
+        assert tps == pytest.approx(0.8 * 500.0)
+        # Measured mirror faster: the configured fraction binds.
+        sig = Signals(backend_state="degraded", cpu_mirror_tps=1e9)
+        tps, _ = rk._limit(sig, 1.0)
+        assert tps == pytest.approx(
+            10000.0 * g_knobs.server.ratekeeper_degraded_tps_fraction
+        )
+        # Sim default: measurement ignored (wall-derived — replay safety).
+        g_knobs.server.ratekeeper_use_measured_cpu_tps = False
+        sig = Signals(backend_state="degraded", cpu_mirror_tps=500.0)
+        tps, _ = rk._limit(sig, 1.0)
+        assert tps == pytest.approx(
+            10000.0 * g_knobs.server.ratekeeper_degraded_tps_fraction
+        )
+    finally:
+        g_knobs.server.ratekeeper_max_tps = old
+        g_knobs.server.ratekeeper_use_measured_cpu_tps = old_use
+
+
+def test_resolver_signals_feed_ratekeeper():
+    """End-to-end: the resolver's signal_snapshot + the RPC `signals`
+    stream expose queue depth / resolve p99 / backend state, and the
+    ratekeeper folds them into RateInfo (and the status qos section)."""
+    from foundationdb_tpu.server.status import cluster_status
+
+    c, rk, old = make_rated_cluster(73, max_tps=100000.0)
+    try:
+        # Wire the resolver signals in (make_rated_cluster predates them).
+        rk.resolvers = list(c.resolvers)
+        db = c.database()
+
+        async def writes():
+            for i in range(20):
+                tr = db.create_transaction()
+                tr.set(b"rs%02d" % i, b"v")
+                await tr.commit()
+            await c.loop.delay(0.6)  # two rk samples
+
+        c.run_all([(db, writes())], timeout_vt=100.0)
+        snap = c.resolver.signal_snapshot()
+        assert snap.backend_state == "ok"
+        assert snap.queue_depth == 0  # quiesced
+        # An idle sim resolves in ZERO virtual seconds — the signal is
+        # that the window is populated, not that latency is nonzero.
+        assert c.resolver.metrics.histogram("resolve_seconds").count >= 1
+        assert c.resolver.resolve_p99_recent() >= 0.0
+        assert rk.rate.backend_state == "ok"
+
+        # The RPC probe answers with the same snapshot shape.
+        out = {}
+
+        async def probe():
+            out["sig"] = await c.resolver.interface().signals.get_reply(
+                db.process, None
+            )
+
+        c.run_until(db.process.spawn(probe(), "probe"), timeout_vt=50.0)
+        assert out["sig"].backend_state == "ok"
+        assert out["sig"].resolve_p99 == snap.resolve_p99
+
+        # Status qos carries the new fields.
+        doc = cluster_status(c)
+        qos = doc["cluster"]["qos"]
+        for key in (
+            "worst_resolver_queue_depth",
+            "resolve_latency_p99_seconds",
+            "commit_latency_p99_seconds",
+            "conflict_backend_state",
+            "worst_grv_queue_depth",
+        ):
+            assert key in qos, sorted(qos)
+        assert qos["conflict_backend_state"] == "ok"
+    finally:
+        g_knobs.server.ratekeeper_max_tps = old
+
+
+def test_grv_queue_shed_batch_lane_starves_first():
+    """Bounded GRV admission queue (ISSUE 8): beyond the depth bound the
+    proxy sheds deterministically — batch-priority requests first with
+    batch_transaction_throttled, then default-lane ones with
+    proxy_memory_limit_exceeded; both retryable."""
+    from foundationdb_tpu.flow.error import FdbError
+    from foundationdb_tpu.server.interfaces import (
+        GRV_FLAG_PRIORITY_BATCH,
+        GetReadVersionRequest,
+    )
+    from foundationdb_tpu.server.ratekeeper import RateInfo
+
+    old_q = g_knobs.server.ratekeeper_grv_queue_max
+    g_knobs.server.ratekeeper_grv_queue_max = 8
+    c, rk, old = make_rated_cluster(74, max_tps=100000.0)
+    try:
+        # Pin a tiny rate so the first iteration's budget wait queues the
+        # rest of the burst for one oversized drain.
+        for t in list(c.master_proc._tasks):
+            if "rk_update" in t.name:
+                t.cancel()
+        rk.rate = RateInfo(tps=2.0, batch_tps=1.0)
+        iface = c.proxy.interface()
+        proc = c.net.process("grv_burst")
+        results = {"ok": 0, "batch_throttled": 0, "default_shed": 0}
+
+        async def one(flags):
+            try:
+                await iface.get_consistent_read_version.get_reply(
+                    proc, GetReadVersionRequest(flags=flags)
+                )
+                results["ok"] += 1
+            except FdbError as e:
+                if e.name == "batch_transaction_throttled":
+                    results["batch_throttled"] += 1
+                elif e.name == "proxy_memory_limit_exceeded":
+                    results["default_shed"] += 1
+                else:
+                    raise
+
+        async def burst():
+            from foundationdb_tpu.flow.eventloop import all_of
+
+            tasks = []
+            for i in range(15):
+                tasks.append(proc.spawn(one(0), f"d{i}"))
+                tasks.append(
+                    proc.spawn(one(GRV_FLAG_PRIORITY_BATCH), f"b{i}")
+                )
+            await all_of(tasks)
+
+        c.run_until(proc.spawn(burst(), "burst"), timeout_vt=400.0)
+        assert results["batch_throttled"] > 0, results
+        # Batch lane starved harder than the default lane.
+        assert results["batch_throttled"] >= results["default_shed"], results
+        assert results["ok"] + results["batch_throttled"] + results[
+            "default_shed"
+        ] == 30
+        snap = c.proxy.stats.snapshot()
+        assert snap["grv_shed_batch"] == results["batch_throttled"]
+        assert snap["grv_shed_default"] == results["default_shed"]
+        # Both shed errors are client-retryable (exponential backoff +
+        # DeterministicRandom jitter in Transaction.on_error).
+        for name in ("batch_transaction_throttled",
+                     "proxy_memory_limit_exceeded"):
+            assert FdbError(name).is_retryable_in_transaction()
+    finally:
+        g_knobs.server.ratekeeper_max_tps = old
+        g_knobs.server.ratekeeper_grv_queue_max = old_q
 
 
 def test_saturation_stays_inside_mvcc_window():

@@ -1,0 +1,109 @@
+"""real_node graceful shutdown (ISSUE 8 satellite): SIGTERM closes the
+transport cleanly and exits 0, so multi-process soak teardown can't leak
+orphans or flake CI on kill -9 corpses."""
+
+import os
+import signal
+import time
+
+from conftest import spawn_real_node
+
+
+def _read_ready(proc, timeout_s=30.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        line = proc.stdout.readline()
+        if line.startswith("READY "):
+            return line.split()[1]
+    raise AssertionError("server never printed READY")
+
+
+def test_server_sigterm_clean_exit():
+    proc = spawn_real_node("server", "--port", "0")
+    try:
+        _read_ready(proc)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=20)
+        assert proc.returncode == 0, (proc.returncode, out)
+        assert "SHUTDOWN" in out, out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_ntserver_sigterm_clean_exit():
+    proc = spawn_real_node("ntserver", "--port", "0")
+    try:
+        _read_ready(proc)
+        proc.send_signal(signal.SIGTERM)
+        out, _ = proc.communicate(timeout=20)
+        assert proc.returncode == 0, (proc.returncode, out)
+        assert "SHUTDOWN" in out, out
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
+
+
+def test_server_serves_then_shuts_down_cleanly():
+    """End-to-end: a client completes real transactions, THEN the server
+    is terminated — the shutdown path must not corrupt an active server's
+    exit (transport close after live connections)."""
+    server = spawn_real_node("server", "--port", "0")
+    client = None
+    try:
+        addr = _read_ready(server)
+        client = spawn_real_node(
+            "client", addr, "--id", "c1", "--ops", "5", "--check-count", "5"
+        )
+        cout, _ = client.communicate(timeout=60)
+        assert client.returncode == 0, cout
+        assert "DONE 5" in cout, cout
+        server.send_signal(signal.SIGTERM)
+        out, _ = server.communicate(timeout=20)
+        assert server.returncode == 0, (server.returncode, out)
+        assert "SHUTDOWN" in out, out
+    finally:
+        for p in (client, server):
+            if p is not None and p.poll() is None:
+                p.kill()
+                p.wait()
+
+
+def test_second_sigterm_escalates():
+    """The procutil ladder: install_graceful_term's second TERM SIGKILLs
+    the process group (exit 143) — a wedged shutdown can't hang forever.
+    Driven via a child whose stop_fn deliberately wedges."""
+    import subprocess
+    import sys
+
+    from conftest import REPO_ROOT
+
+    code = (
+        "import signal, time, sys;"
+        "sys.path.insert(0, %r);"
+        "from foundationdb_tpu.utils.procutil import install_graceful_term;"
+        "install_graceful_term(lambda: None);"  # stop that stops nothing
+        "print('ARMED', flush=True);"
+        "time.sleep(60)"
+    ) % REPO_ROOT
+    proc = subprocess.Popen(
+        [sys.executable, "-c", code],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+        start_new_session=True,  # its own group: killpg(0) can't hit us
+    )
+    try:
+        assert proc.stdout.readline().startswith("ARMED")
+        proc.send_signal(signal.SIGTERM)  # graceful: wedges (sleep goes on)
+        time.sleep(0.2)
+        assert proc.poll() is None  # still alive: stop_fn did nothing
+        proc.send_signal(signal.SIGTERM)  # escalation: killpg + exit
+        proc.wait(timeout=10)
+        assert proc.returncode in (143, -signal.SIGKILL), proc.returncode
+    finally:
+        if proc.poll() is None:
+            os.killpg(proc.pid, signal.SIGKILL)
+            proc.wait()
