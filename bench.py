@@ -159,6 +159,139 @@ def bench_cpu(rng, n_batches=20, per_batch=2500):
     return n_batches * per_batch / dt
 
 
+# Mirror A/B arms (ISSUE 9) — the CPU-side companion to VARIANTS below:
+# the always-on mirror's maintenance cost (amortized apply_batch) and the
+# breaker-probe rehydration host cost, flat array vs batched-snapshot
+# engine.  FDB_TPU_MIRROR_ENGINE is the production selector; bench_mirror
+# runs both arms in-process (no device needed, so this phase always
+# produces numbers even when the tunnel is down).  Shared by bench.main
+# and `tools/perf_experiments.py --mirror`.
+MIRROR_VARIANTS = [
+    ("mirror_chunked", {}),  # engine_cpu.CpuConflictSet (the default)
+    ("mirror_flat", {"FDB_TPU_MIRROR_ENGINE": "flat"}),
+]
+
+
+def bench_mirror(rng, n_batches=30, per_batch=2500, degraded_batches=4):
+    """Flat vs chunked mirror A/B at the skipListTest stream shape:
+
+      apply_txns_per_sec      mirror maintenance — adopting device-decided
+                              batches (apply_batch), the always-on cost
+      detect_txns_per_sec     degraded-mode serving — what the ratekeeper's
+                              measured-cpu-tps clamp sees (cpu_mirror_tps
+                              honesty for ratekeeper_use_measured_cpu_tps)
+      rehydrate_host_s        the probe's host-side key-encode cost after a
+                              `degraded_batches`-batch mirror-only window
+                              (chunked: only chunks changed since the last
+                              device sync re-encode; flat: the full O(H)
+                              legacy path)
+    """
+    from foundationdb_tpu.conflict import keys as keylib
+    from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+    from foundationdb_tpu.conflict.engine_cpu_flat import FlatCpuConflictSet
+
+    from foundationdb_tpu.conflict.types import TransactionConflictInfo
+
+    batches = [
+        txns_from_packed(gen_packed(rng, per_batch, i, KEY_WORDS), per_batch)
+        for i in range(n_batches)
+    ]
+    # Verdicts decided ONCE so both arms adopt identical inputs.
+    dec = FlatCpuConflictSet()
+    decided = [
+        list(dec.detect(txns, now=i + WINDOW, new_oldest_version=i))
+        for i, txns in enumerate(batches)
+    ]
+    # Degraded-window stream: throttled (ratekeeper_degraded_tps_fraction
+    # of peak) and drawn from a 1/64 keyspace band — one identical copy
+    # consumed by both arms.
+    band = KEYSPACE // 64
+    base = int(rng.integers(0, KEYSPACE - band))
+    degraded_stream = []
+    for j in range(degraded_batches):
+        i = n_batches + j
+        a = rng.integers(0, band, per_batch // 8, dtype=np.int64) + base
+        txns = [
+            TransactionConflictInfo(
+                read_snapshot=i,
+                write_ranges=[
+                    (int(x).to_bytes(KEY_BYTES, "big"),
+                     int(x + 1).to_bytes(KEY_BYTES, "big"))
+                ],
+            )
+            for x in a
+        ]
+        degraded_stream.append((txns, i + WINDOW, i))
+    out = {}
+    for name, flags in MIRROR_VARIANTS:
+        # The flags dict IS the selector, exactly as it would be in the
+        # process environment (FDB_TPU_MIRROR_ENGINE semantics).
+        eng_cls = (
+            FlatCpuConflictSet
+            if flags.get("FDB_TPU_MIRROR_ENGINE") == "flat"
+            else CpuConflictSet
+        )
+        # Arm 1: apply_batch (mirror maintenance under device authority).
+        eng = eng_cls()
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            eng.apply_batch(batches[i], decided[i], now=i + WINDOW,
+                            new_oldest_version=i)
+        apply_dt = time.perf_counter() - t0
+        # Sync point: the device has applied everything so far.  Chunked:
+        # warm the per-chunk encode cache exactly as note_synced would.
+        chunked = hasattr(eng, "snapshot")
+        if chunked:
+            for ch in eng.snapshot().chunks:
+                ch.enc = {
+                    KEY_WORDS: (
+                        keylib.encode_keys(ch.keys, KEY_WORDS),
+                        np.asarray(ch.vers, dtype=np.int64),
+                    )
+                }
+        # Degraded window: the mirror alone serves a few batches.  The
+        # window is REALISTIC, i.e. throttled and localized — the PR-7
+        # ratekeeper contracts admission to the degraded fraction the
+        # moment the breaker opens, so a degraded window sees a fraction
+        # of peak load, not full-rate uniform sprays (which would touch
+        # every chunk and flatten the proportionality lever on purpose).
+        for txns, now_, nov in degraded_stream:
+            eng.detect(txns, now=now_, new_oldest_version=nov)
+        # Probe rehydration, host-side: the per-key encode work load_from
+        # pays (the device-transfer memcpy is the same for both arms).
+        t0 = time.perf_counter()
+        if chunked:
+            ents, enc_keys = [], 0
+            for ch in eng.snapshot().chunks:
+                cached = ch.enc.get(KEY_WORDS) if ch.enc else None
+                if cached is not None:
+                    ents.append(cached[0])
+                else:
+                    e = keylib.encode_keys(ch.keys, KEY_WORDS)
+                    ents.append(e)
+                    enc_keys += len(ch.keys)
+            np.concatenate(ents, axis=0)
+        else:
+            enc_keys = len(eng.keys)
+            keylib.encode_keys(eng.keys, KEY_WORDS)
+        rehydrate_dt = time.perf_counter() - t0
+        # Arm 2: degraded-mode detect throughput (fresh engine, same
+        # stream) — the measured-mirror-tps the ratekeeper clamps to.
+        eng2 = eng_cls()
+        t0 = time.perf_counter()
+        for i in range(n_batches):
+            eng2.detect(batches[i], now=i + WINDOW, new_oldest_version=i)
+        detect_dt = time.perf_counter() - t0
+        out[name] = {
+            "apply_txns_per_sec": round(n_batches * per_batch / apply_dt, 1),
+            "detect_txns_per_sec": round(n_batches * per_batch / detect_dt, 1),
+            "rehydrate_host_s": round(rehydrate_dt, 6),
+            "rehydrate_keys_encoded": enc_keys,
+            "boundaries": eng.boundary_count,
+        }
+    return out
+
+
 def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=3407872, window=WINDOW):
     """Steady-state device throughput at the BASELINE.json 64k-batch config,
     with the reference's full 50-batch live window (skipListTest detects at
@@ -421,6 +554,13 @@ def main():
         out["vs_baseline"] = round(cpu_rate / cpp_rate, 3) if cpp_rate else 1.0
     except Exception as e:
         errors.append(f"cpu: {type(e).__name__}: {e}")
+    emit(out, errors)
+    try:
+        _log("mirror A/B: flat vs chunked apply/rehydrate (ISSUE 9)...")
+        out["mirror"] = bench_mirror(np.random.default_rng(2024))
+        _log(f"mirror: {json.dumps(out['mirror'])}")
+    except Exception as e:
+        errors.append(f"mirror: {type(e).__name__}: {e}")
     emit(out, errors)
     try:
         device_phase(out, errors, cpp_rate, cpu_rate)

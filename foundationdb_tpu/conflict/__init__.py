@@ -18,10 +18,16 @@ Semantics implemented identically by every backend here (see engine docs):
     (exact for all queries with snapshot >= oldestVersion)
 
 Backends:
-  oracle     - brute force, obviously correct, test-only
-  engine_cpu - bisect/step-function host engine (production small-batch path)
-  engine_jax - whole-batch vectorized engine for TPU (production large-batch
-               path), differentially tested against the others
+  oracle          - brute force, obviously correct, test-only
+  engine_cpu      - chunked batch-update snapshot engine (ISSUE 9): the
+                    production small-batch path AND the always-on
+                    authoritative mirror behind the device breaker —
+                    O(1) immutable snapshots, copy-on-write batch sweeps
+  engine_cpu_flat - the pre-ISSUE-9 flat array, kept as the bit-identical
+                    differential oracle + FDB_TPU_MIRROR_ENGINE=flat arm
+  engine_jax      - whole-batch vectorized engine for TPU (production
+                    large-batch path), differentially tested against the
+                    others
 """
 
 from .types import (

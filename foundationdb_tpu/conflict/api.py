@@ -18,9 +18,15 @@ CPU engine inside the same _detect call with bit-identical verdicts (the
 two engines decide identically by construction); N consecutive faults
 open the circuit and route everything host-side; a half-open probe with
 deterministic exponential backoff re-attempts the device and, on
-success, rehydrates device state from the CPU engine (load_from rebuilds
-every boundary newer than oldest_version) before resuming.  No
-DeviceFault ever escapes detect_conflicts.
+success, rehydrates device state from an immutable mirror SNAPSHOT
+(ISSUE 9: load_from takes a MirrorSnapshot handoff — host work
+proportional to chunks changed since the last device sync, and a fault
+mid-probe can neither observe nor corrupt a half-mutated mirror) before
+resuming.  No DeviceFault ever escapes detect_conflicts.  A periodic
+consistency check (mirror_check, driven by the resolver's mirror-check
+actor and `cli mirror-check`) diffs a live mirror snapshot against the
+device export and treats confirmed divergence as a device fault that
+opens the breaker.
 
 Usage mirrors the reference ABI:
     cs = ConflictSet(backend="hybrid")
@@ -33,9 +39,9 @@ from __future__ import annotations
 
 from typing import List, Optional
 
-from ..flow.knobs import g_knobs
+from ..flow.knobs import g_env, g_knobs
 from .device_faults import DeviceCircuitBreaker, DeviceFault
-from .engine_cpu import CpuConflictSet
+from .engine_cpu import CpuConflictSet, FlatCpuConflictSet
 from .oracle import OracleConflictSet
 from .types import TransactionConflictInfo
 
@@ -76,8 +82,20 @@ class ConflictSet:
         kw = key_words if key_words is not None else g_knobs.server.conflict_device_key_words
         if backend in ("cpu", "jax", "hybrid"):
             # Device backends keep the CPU engine too: it is the
-            # authoritative mirror faulted batches fall back to.
-            self._cpu = CpuConflictSet(oldest_version)
+            # authoritative mirror faulted batches fall back to.  The
+            # chunked batch-update snapshot engine is the default
+            # (ISSUE 9); FDB_TPU_MIRROR_ENGINE=flat selects the
+            # pre-ISSUE-9 flat array (A/B arm + escape hatch) — the two
+            # are decision- and state-identical by differential gate,
+            # but the flat mirror has no snapshot()/chunk identity, so
+            # rehydration degrades to the legacy O(H) encode and the
+            # consistency check still works off its flat view.
+            mirror_cls = (
+                FlatCpuConflictSet
+                if g_env.get("FDB_TPU_MIRROR_ENGINE") == "flat"
+                else CpuConflictSet
+            )
+            self._cpu = mirror_cls(oldest_version)
         if backend == "oracle":
             self._oracle = OracleConflictSet(oldest_version)
         self._breaker: Optional[DeviceCircuitBreaker] = None
@@ -93,7 +111,8 @@ class ConflictSet:
             )
             for _c in ("device_faults", "breaker_opens", "breaker_probes",
                        "breaker_closes", "degraded_batches", "rehydrates",
-                       "cpu_fallback_txns"):
+                       "cpu_fallback_txns", "mirror_checks",
+                       "mirror_divergence", "mirror_mismatch_keys"):
                 self._jax.metrics.counter(_c)  # pre-create: stable snapshots
             self._breaker = DeviceCircuitBreaker(metrics=self._jax.metrics)
             self._jax.fault_injector = fault_injector
@@ -137,6 +156,9 @@ class ConflictSet:
 
         self._cpu_fallback_txns = 0  # cumulative (deterministic counter)
         self._cpu_fallback_recent = deque(maxlen=32)  # (txns, wall_seconds)
+        # Last consistency-check report (mirror_check): surfaced through
+        # device_metrics()["mirror"] and `cli mirror-check`.
+        self._last_mirror_check: Optional[dict] = None
 
     AUTHORITY_HYSTERESIS = 8
 
@@ -221,13 +243,26 @@ class ConflictSet:
         if not self._breaker.allows_device():
             self._degraded_last = True
             return None
+        snapshot = getattr(self._cpu, "snapshot", None)
+        take_fresh = getattr(self._cpu, "take_fresh_chunks", None)
         try:
             if self._device_stale:
                 # Rehydrate: rebuild the device history (every boundary
                 # newer than oldest_version — older ones were evicted)
-                # from the CPU engine.  load_from can itself fault
-                # (grow/dispatch) — a fault here fails the probe.
-                self._jax.load_from(self._cpu)
+                # from the mirror.  Snapshot handoff (ISSUE 9): the
+                # immutable MirrorSnapshot means a fault mid-probe can
+                # neither observe nor corrupt a half-mutated mirror, and
+                # the chunk encode cache makes the host work proportional
+                # to chunks changed since the last device sync (asserted
+                # via rehydrate_keys_encoded telemetry).  load_from can
+                # itself fault (grow) — a fault here fails the probe.
+                self._jax.load_from(
+                    snapshot() if snapshot is not None else self._cpu
+                )
+                if take_fresh is not None:
+                    # load_from just encoded every live chunk; the fresh
+                    # backlog from the degraded window is now moot.
+                    take_fresh()
                 self._breaker.note_rehydrate()
                 self._device_stale = False
             statuses = self._jax.detect(txns, now, new_oldest_version)
@@ -238,6 +273,16 @@ class ConflictSet:
             return None
         self._breaker.on_success()
         self._cpu.apply_batch(txns, statuses, now, new_oldest_version)
+        if snapshot is not None:
+            # The device applied the same batch: record the post-batch
+            # mirror snapshot as the synced point and pre-encode the
+            # chunks this batch created — O(chunks created this batch)
+            # via the mirror's take_fresh_chunks hint — so a fault at ANY
+            # later batch leaves the probe a cheap diff.
+            self._jax.note_synced(
+                snapshot(),
+                take_fresh() if take_fresh is not None else None,
+            )
         return statuses
 
     def _cpu_detect_fallback(self, txns, now, new_oldest_version):
@@ -319,7 +364,94 @@ class ConflictSet:
             "backend_state": state,
             "cpu_mirror_tps": tps,
             "cpu_fallback_txns": self._cpu_fallback_txns,
+            "mirror_divergence": (
+                int(self._jax.metrics.counter("mirror_divergence").value)
+                if self._jax is not None
+                else 0
+            ),
         }
+
+    def mirror_check(self) -> Optional[dict]:
+        """Consistency check (ISSUE 9): diff a live mirror snapshot
+        against the device's exported state without stopping the
+        resolver.  Returns None for host-only backends; otherwise a
+        report dict ({status: ok|diverged|skipped, ...}).  Confirmed
+        divergence is treated as a device fault: counted, traced, and the
+        breaker OPENS (the mirror stays authoritative, the device is
+        marked stale so recovery rehydrates from a snapshot) — today
+        divergence outside the fixpoint check would be silently
+        authoritative-by-fiat.  Cost: O(H) host decode of the device
+        export, which is why it runs on a period (the resolver's
+        mirror-check actor / `cli mirror-check`), never per batch."""
+        if self._jax is None:
+            return None
+        m = self._jax.metrics
+        if self._device_stale or (
+            self._breaker is not None and self._breaker.state != "ok"
+        ):
+            # The device is not expected to match the mirror right now
+            # (never hydrated, mid-outage, or mid-backoff): nothing to
+            # confirm.  O(1) — safe on every period even while degraded.
+            report = {
+                "status": "skipped",
+                "reason": (
+                    "device_stale"
+                    if self._device_stale
+                    else f"breaker_{self._breaker.state}"
+                ),
+            }
+            self._last_mirror_check = report
+            return report
+        m.counter("mirror_checks").add()
+        snap = getattr(self._cpu, "snapshot", None)
+        if snap is not None:
+            s = snap()
+            mk, mv = s.to_flat()
+            stamp = s.stamp
+            m_oldest = s.oldest_version
+        else:  # flat mirror (FDB_TPU_MIRROR_ENGINE=flat): live flat view
+            mk, mv = list(self._cpu.keys), list(self._cpu.vers)
+            stamp = None
+            m_oldest = self._cpu.oldest_version
+        dk, dv = self._jax._merged_host_state()
+        d_oldest = self._jax.oldest_version
+        mismatch = 0
+        if m_oldest != d_oldest:
+            mismatch += 1
+        if mk != dk or mv != dv:
+            mirror = dict(zip(mk, mv))
+            device = dict(zip(dk, dv))
+            for key in mirror.keys() | device.keys():
+                if mirror.get(key) != device.get(key):
+                    mismatch += 1
+        report = {
+            "status": "ok" if mismatch == 0 else "diverged",
+            "boundaries": len(mk),
+            "device_boundaries": len(dk),
+            "mismatch_keys": mismatch,
+            "stamp": stamp,
+        }
+        if mismatch:
+            from ..flow.trace import TraceEvent
+
+            m.counter("mirror_divergence").add()
+            m.counter("mirror_mismatch_keys").add(mismatch)
+            TraceEvent("MirrorDivergence", severity=40).detail(
+                "mismatch_keys", mismatch
+            ).detail("mirror_boundaries", len(mk)).detail(
+                "device_boundaries", len(dk)
+            ).detail("mirror_oldest", m_oldest).detail(
+                "device_oldest", d_oldest
+            ).log()
+            if self._breaker is not None:
+                self._breaker.on_divergence(f"mismatch_keys={mismatch}")
+            # The mirror is authoritative by design; the device state is
+            # now suspect — force a snapshot rehydration before it serves
+            # again (after the breaker's backoff walks to a probe).
+            self._device_stale = True
+            self._degraded_last = True
+        self._last_mirror_check = report
+        return report
 
     def device_metrics(self, now=None) -> Optional[dict]:
         """Kernel-telemetry snapshot of the device engine (retraces,
@@ -350,6 +482,22 @@ class ConflictSet:
         if self._breaker is not None:
             snap["backend_state"] = self._breaker.state
             snap["breaker"] = self._breaker.snapshot()
+        # Snapshot-mirror block (ISSUE 9): chunked-engine maintenance
+        # facts + the last consistency-check report.  All O(1) reads.
+        mirror: dict = {
+            "engine": type(self._cpu).__name__,
+            "last_check": self._last_mirror_check,
+        }
+        if hasattr(self._cpu, "chunk_count"):
+            mirror.update(
+                chunks=self._cpu.chunk_count,
+                boundary_count=self._cpu.boundary_count,
+                stamp=self._cpu.stamp,
+                chunks_rebuilt=self._cpu.chunks_rebuilt,
+                evict_scans=self._cpu.evict_scans,
+                evict_skips=self._cpu.evict_skips,
+            )
+        snap["mirror"] = mirror
         return snap
 
     def clear(self, version: int):

@@ -252,6 +252,22 @@ class DeviceCircuitBreaker:
             self._transition(STATE_DEGRADED, f"threshold:{reason}")
             self._count("breaker_opens")
 
+    def on_divergence(self, detail: str) -> None:
+        """Confirmed mirror/device divergence (the consistency checker's
+        verdict, ISSUE 9): treated as a device fault that opens the
+        circuit IMMEDIATELY — no consecutive-failure threshold, because
+        divergence is corrupt state, never a transient blip.  The caller
+        marks the device stale, so the eventual half-open probe
+        rehydrates from a mirror snapshot before the device serves
+        again.  Only meaningful from `ok` (the checker skips while the
+        device is stale or the circuit is already open)."""
+        self._count("device_faults")
+        self._count("faults_mirror")
+        if self.state == STATE_OK:
+            self._cooldown = self.backoff
+            self._transition(STATE_DEGRADED, f"mirror_divergence:{detail}")
+            self._count("breaker_opens")
+
     def note_rehydrate(self) -> None:
         self._count("rehydrates")
 

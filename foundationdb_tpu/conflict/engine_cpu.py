@@ -1,92 +1,312 @@
-"""Host conflict engine: sorted step-function with binary search.
+"""Host conflict engine: chunked step function with batch updates and
+O(1) immutable snapshots (ISSUE 9, the Jiffy blueprint).
 
-Production CPU fallback path (small batches, oversized keys — see
-api.ConflictSet).  Replaces the reference's versioned skip list
-(fdbserver/SkipList.cpp SkipList::detectConflicts :524, addConflictRanges
-:511) with a flat sorted boundary array: keys[i] starts the range
-[keys[i], keys[i+1]) whose last-committed-write version is vers[i]; the
-final entry extends to +infinity and keys[0] is always b"" (the floor).
+Production CPU path AND the always-authoritative mirror behind the
+device circuit breaker (api.ConflictSet).  Same data model as every
+other engine — keys[i] starts the range [keys[i], keys[i+1)) whose
+last-committed-write version is vers[i]; keys[0] is always b"" (the
+floor) — but the flat sorted array is split into a sequence of IMMUTABLE
+chunks (the batch-update skip-list nodes of Jiffy, "A Lock-free Skip
+List with Batch Updates and Snapshots", PAPERS.md):
 
-This is the same data model the JAX engine keeps on device, so the two
-backends stay in lockstep by construction and differ only in how they
-batch the queries.
+  - ``detect``/``apply_batch`` apply a batch's whole committed write
+    union as ONE sweep: only chunks an interval touches are rewritten
+    (copy-on-write), untouched chunks keep their identity.  No per-range
+    O(H) list splices.
+  - window eviction (ref SkipList::removeBefore) rewrites only chunks
+    that actually hold a droppable boundary, decided from a per-chunk
+    ``min_pair`` precomputed at chunk build time — when nothing is below
+    the window the advance is an O(chunks) scan with ZERO rebuilds
+    (``evict_skips`` counts them), not the flat engine's O(H) keep pass.
+  - ``snapshot()`` is O(1): the chunk sequence is already an immutable
+    tuple, so a snapshot is just a handle to it.  Snapshots taken every
+    batch cost nothing; a handed-off snapshot can never observe a
+    half-mutated mirror (the breaker's probe-rehydration safety).
+  - ``boundary_count`` is an O(1) maintained count.
+
+Chunk identity is the incremental-sync currency: the device engine
+caches per-chunk key encodings on the chunk object itself
+(engine_jax.note_synced / load_from), so probe rehydration re-encodes
+only chunks created since the last device sync.
+
+The pre-ISSUE-9 flat engine survives as engine_cpu_flat.FlatCpuConflictSet,
+the differential oracle this engine is gated bit-identical against
+(verdicts AND exported state) and the FDB_TPU_MIRROR_ENGINE=flat A/B arm.
 """
 
 from __future__ import annotations
 
 from bisect import bisect_left, bisect_right
-from typing import List
+from typing import List, Optional, Tuple
 
+from .engine_cpu_flat import (  # re-exported: the shared pieces
+    FLOOR_VERSION,
+    FlatCpuConflictSet,
+    _IntervalSet,
+)
 from .types import CONFLICT, COMMITTED, TOO_OLD, TransactionConflictInfo
 
-FLOOR_VERSION = -(2**62)  # never conflicts with any snapshot
+__all__ = [
+    "CpuConflictSet",
+    "FlatCpuConflictSet",
+    "MirrorSnapshot",
+    "FLOOR_VERSION",
+]
+
+_PAIR_INF = 1 << 63  # "no droppable pair here" sentinel
 
 
-class _IntervalSet:
-    """Merged, sorted, half-open intervals; the intra-batch committed-write
-    accumulator (plays the reference's MiniConflictSet role,
-    SkipList.cpp:1028-1131, but keyed on bytes instead of point indices)."""
+class _Chunk:
+    """One immutable run of (key, version) boundaries.  ``keys``/``vers``
+    are plain lists treated as frozen after construction (copy-on-write:
+    a mutation builds a new chunk).  ``min_pair`` is the smallest
+    max(vers[i-1], vers[i]) over INTERNAL adjacent pairs — a boundary is
+    evictable iff its pair-max is below the window, so a chunk whose
+    min_pair is at or above the window provably holds nothing to drop
+    (the cross-chunk first pair is checked by the caller, which knows
+    the previous chunk's last version).  ``enc`` holds device-encoding
+    caches keyed by key_words (engine_jax), computed at most once per
+    chunk lifetime because chunks never mutate."""
 
-    __slots__ = ("begins", "ends")
+    __slots__ = ("keys", "vers", "max_ver", "min_pair", "enc")
 
-    def __init__(self):
-        self.begins: list[bytes] = []
-        self.ends: list[bytes] = []
+    def __init__(self, keys: list, vers: list):
+        self.keys = keys
+        self.vers = vers
+        self.max_ver = max(vers)
+        mp = _PAIR_INF
+        prev = None
+        for v in vers:
+            if prev is not None:
+                p = prev if prev > v else v
+                if p < mp:
+                    mp = p
+            prev = v
+        self.min_pair = mp
+        self.enc = None
 
-    def intersects(self, b: bytes, e: bytes) -> bool:
-        if b >= e:
-            return False
-        idx = bisect_right(self.begins, b) - 1
-        if idx >= 0 and self.ends[idx] > b:
-            return True
-        nxt = idx + 1
-        return nxt < len(self.begins) and self.begins[nxt] < e
+    def __len__(self):
+        return len(self.keys)
 
-    def add(self, b: bytes, e: bytes) -> None:
-        if b >= e:
-            return
-        lo = bisect_right(self.begins, b) - 1
-        if lo >= 0 and self.ends[lo] >= b:
-            b = self.begins[lo]
-        else:
-            lo += 1
-        hi = bisect_right(self.begins, e)
-        if hi > lo:
-            e = max(e, self.ends[hi - 1])
-        self.begins[lo:hi] = [b]
-        self.ends[lo:hi] = [e]
+
+class MirrorSnapshot:
+    """O(1) immutable view of a CpuConflictSet at one instant.  Holding
+    one is free (chunk refs are shared with the live engine and with
+    every other snapshot); the live engine's later mutations replace
+    chunks instead of editing them, so the view never changes.  ``stamp``
+    increases with every mutation of the source engine — equal stamps
+    mean identical state, and chunk identity across two snapshots means
+    that key range did not change (the device sync diff)."""
+
+    __slots__ = ("chunks", "oldest_version", "stamp", "boundary_count")
+
+    def __init__(self, chunks: tuple, oldest_version: int, stamp: int,
+                 boundary_count: int):
+        self.chunks = chunks
+        self.oldest_version = oldest_version
+        self.stamp = stamp
+        self.boundary_count = boundary_count
+
+    def to_flat(self) -> Tuple[list, list]:
+        """Materialize (keys, vers) lists — O(H), diagnostic/diff use."""
+        ks: list = []
+        vs: list = []
+        for ch in self.chunks:
+            ks.extend(ch.keys)
+            vs.extend(ch.vers)
+        return ks, vs
+
+
+def _default_chunk_size() -> int:
+    from ..flow.knobs import g_env
+
+    return max(4, g_env.get_int("FDB_TPU_MIRROR_CHUNK"))
 
 
 class CpuConflictSet:
-    """Exact reference-semantics engine over a flat sorted step function."""
+    """Exact reference-semantics engine over chunked immutable runs.
 
-    def __init__(self, oldest_version: int = 0):
+    Decision- and state-identical to FlatCpuConflictSet (gated by
+    tests/test_mirror_snapshot.py's differential fuzz); only the update
+    cost model differs.  ``chunk`` is the target chunk size (default
+    FDB_TPU_MIRROR_CHUNK); tests pass tiny values to force multi-chunk
+    structures on small histories."""
+
+    def __init__(self, oldest_version: int = 0, chunk: Optional[int] = None):
         self.oldest_version = oldest_version
-        self.keys: list[bytes] = [b""]
-        self.vers: list[int] = [FLOOR_VERSION]
+        self.chunk_size = chunk if chunk is not None else _default_chunk_size()
+        self._chunks: tuple = (_Chunk([b""], [FLOOR_VERSION]),)
+        self._starts: list = [b""]
+        self._count = 1
+        self._stamp = 0
+        self._flat: Optional[Tuple[list, list]] = None
+        # Staged halves of a flat (keys, vers) adoption — see the property
+        # setters: store_to-style callers assign .keys then .vers.
+        self._staged_keys: Optional[list] = None
+        # Maintenance telemetry (deterministic ints, read by tests/bench/
+        # device_metrics): batches that rewrote at least one chunk, chunks
+        # rewritten, window advances that dropped nothing (the flat
+        # engine's O(H) keep pass, skipped).
+        self.chunks_rebuilt = 0
+        self.evict_scans = 0
+        self.evict_skips = 0
+        # Chunks created since the last take_fresh_chunks(): the device
+        # sync hint (engine_jax.note_synced encodes ONLY these instead of
+        # walking every chunk).  Bounded: past _FRESH_CAP the list is
+        # dropped and the consumer falls back to a full walk.
+        self._fresh: list = []
+        self._fresh_overflow = False
+
+    _FRESH_CAP = 8192
+
+    def _new_chunk(self, keys: list, vers: list) -> _Chunk:
+        ch = _Chunk(keys, vers)
+        if not self._fresh_overflow:
+            if len(self._fresh) >= self._FRESH_CAP:
+                self._fresh_overflow = True
+                self._fresh = []
+            else:
+                self._fresh.append(ch)
+        return ch
+
+    def take_fresh_chunks(self):
+        """(chunks created since the last take, complete) — the device's
+        incremental-sync hint.  complete=False means the backlog
+        overflowed _FRESH_CAP and the consumer must fall back to a full
+        walk.  Entries may already be dead (replaced/evicted since) —
+        consumers treat the list as a superset hint, never as live
+        state."""
+        self._apply_staged()
+        fresh, overflow = self._fresh, self._fresh_overflow
+        self._fresh, self._fresh_overflow = [], False
+        return fresh, not overflow
+
+    # -- snapshots --
+    def snapshot(self) -> MirrorSnapshot:
+        """O(1): the chunk tuple is already immutable."""
+        self._apply_staged()
+        return MirrorSnapshot(
+            self._chunks, self.oldest_version, self._stamp, self._count
+        )
+
+    @property
+    def stamp(self) -> int:
+        return self._stamp
+
+    @property
+    def chunk_count(self) -> int:
+        self._apply_staged()
+        return len(self._chunks)
+
+    # -- flat views (compat with the store_to/load_from flat contract) --
+    def _apply_staged(self) -> None:
+        """Flush a pending keys-only assignment (the vers half never
+        arrived before the next read/mutation): pair the staged keys
+        with the old versions, padded — the flat engine's transiently-
+        torn state, made visible at the same points."""
+        if self._staged_keys is None:
+            return
+        ks, self._staged_keys = self._staged_keys, None
+        vs = self._materialize()[1]
+        n = len(ks)
+        vs = list(vs[:n]) + [FLOOR_VERSION] * (n - len(vs))
+        self._rebuild_from_flat(ks, vs)
+
+    def _materialize(self) -> Tuple[list, list]:
+        self._apply_staged()
+        if self._flat is None:
+            ks: list = []
+            vs: list = []
+            for ch in self._chunks:
+                ks.extend(ch.keys)
+                vs.extend(ch.vers)
+            self._flat = (ks, vs)
+        return self._flat
+
+    @property
+    def keys(self) -> list:
+        """Flat boundary-key list (READ-ONLY view; cached, O(H) on first
+        access after a mutation).  Assigning it (store_to-style adoption)
+        rebuilds the chunk structure."""
+        return self._materialize()[0]
+
+    @property
+    def vers(self) -> list:
+        return self._materialize()[1]
+
+    @keys.setter
+    def keys(self, new_keys):
+        # store_to assigns .keys then .vers: STAGE the keys and rebuild
+        # once when the matching vers arrive (one O(H) chunk build per
+        # adoption, not two).  Any read or mutation before then flushes
+        # the stage (_apply_staged), reproducing the flat engine's
+        # transiently-torn keys-with-old-vers state at the same points.
+        self._staged_keys = list(new_keys)
+
+    @vers.setter
+    def vers(self, new_vers):
+        new_vers = list(new_vers)
+        if (
+            self._staged_keys is not None
+            and len(self._staged_keys) == len(new_vers)
+        ):
+            ks, self._staged_keys = self._staged_keys, None
+        else:
+            self._apply_staged()  # mismatched halves: flush, then pair
+            ks = list(self._materialize()[0][: len(new_vers)])
+        self._rebuild_from_flat(ks, new_vers)
+
+    def _rebuild_from_flat(self, ks: list, vs: list) -> None:
+        assert ks and len(ks) == len(vs), "flat adoption needs paired lists"
+        assert ks[0] == b"", "history floor boundary must be b''"
+        c = self.chunk_size
+        chunks = [
+            self._new_chunk(ks[i : i + c], vs[i : i + c])
+            for i in range(0, len(ks), c)
+        ]
+        self._set_chunks(tuple(chunks))
+
+    def _set_chunks(self, chunks: tuple) -> None:
+        self._chunks = chunks
+        self._starts = [ch.keys[0] for ch in chunks]
+        self._count = sum(len(ch) for ch in chunks)
+        self._stamp += 1
+        self._flat = None
 
     # -- history step function --
+    def _loc_le(self, k: bytes) -> Tuple[int, int]:
+        """(chunk, index) of the greatest boundary <= k."""
+        self._apply_staged()
+        c = bisect_right(self._starts, k) - 1
+        ch = self._chunks[c]
+        return c, bisect_right(ch.keys, k) - 1
+
+    def _loc_lt(self, k: bytes) -> Tuple[int, int]:
+        """(chunk, index) of the greatest boundary < k; requires k > b""."""
+        self._apply_staged()
+        c = bisect_left(self._starts, k) - 1
+        ch = self._chunks[c]
+        return c, bisect_left(ch.keys, k) - 1
+
     def _range_max(self, b: bytes, e: bytes) -> int:
-        """Max version over [b, e); requires b < e."""
-        i = bisect_right(self.keys, b) - 1
-        j = bisect_left(self.keys, e) - 1
-        return max(self.vers[i : j + 1])
+        """Max version over [b, e); requires b < e.  Spanning chunks use
+        the precomputed chunk max instead of walking rows."""
+        ci, ii = self._loc_le(b)
+        cj, jj = self._loc_lt(e)
+        chunks = self._chunks
+        if ci == cj:
+            return max(chunks[ci].vers[ii : jj + 1])
+        m = max(chunks[ci].vers[ii:])
+        for c in range(ci + 1, cj):
+            mv = chunks[c].max_ver
+            if mv > m:
+                m = mv
+        mj = max(chunks[cj].vers[: jj + 1])
+        return m if m > mj else mj
 
     def _value_at(self, k: bytes) -> int:
-        return self.vers[bisect_right(self.keys, k) - 1]
-
-    def _overwrite(self, b: bytes, e: bytes, version: int) -> None:
-        """Set the step function to `version` on [b, e)."""
-        end_val = self._value_at(e)
-        i0 = bisect_left(self.keys, b)
-        i1 = bisect_left(self.keys, e)
-        new_keys = [b]
-        new_vers = [version]
-        if not (i1 < len(self.keys) and self.keys[i1] == e):
-            new_keys.append(e)
-            new_vers.append(end_val)
-        self.keys[i0:i1] = new_keys
-        self.vers[i0:i1] = new_vers
+        c, i = self._loc_le(k)
+        return self._chunks[c].vers[i]
 
     # -- ConflictSet ABI (ref fdbserver/ConflictSet.h) --
     def detect(
@@ -121,30 +341,6 @@ class CpuConflictSet:
         self._commit_writes(active, now, new_oldest_version)
         return statuses
 
-    def _commit_writes(
-        self, active: _IntervalSet, now: int, new_oldest_version: int
-    ) -> None:
-        """Phases 3-4 on an already-decided batch: merge the committed
-        write union into history at `now`, then evict below the window."""
-        # Phase 3: merge committed writes at `now` (ref mergeWriteConflictRanges)
-        # `active` is exactly the union of committed writes, already merged.
-        for b, e in zip(active.begins, active.ends):
-            self._overwrite(b, e, now)
-
-        # Phase 4: window eviction (ref SkipList::removeBefore — drop a
-        # boundary iff it and its original predecessor are both below window)
-        if new_oldest_version > self.oldest_version:
-            self.oldest_version = new_oldest_version
-            old = self.oldest_version
-            keys, vers = self.keys, self.vers
-            keep = [
-                i == 0 or vers[i] >= old or vers[i - 1] >= old
-                for i in range(len(keys))
-            ]
-            if not all(keep):
-                self.keys = [k for k, kp in zip(keys, keep) if kp]
-                self.vers = [v for v, kp in zip(vers, keep) if kp]
-
     def apply_batch(
         self,
         transactions: List[TransactionConflictInfo],
@@ -152,13 +348,10 @@ class CpuConflictSet:
         now: int,
         new_oldest_version: int,
     ) -> None:
-        """Adopt an externally-decided batch (the device engine's verdicts)
-        into this engine's history: the committed transactions' writes are
-        merged and the window advanced EXACTLY as detect() would have —
-        since the device decides bit-identically, the mirrored state is
-        indistinguishable from having run the batch here.  This is how the
-        CPU SkipList stays authoritative under a device-served load, so a
-        device fault can always be absorbed by a host retry."""
+        """Adopt an externally-decided batch (the device engine's
+        verdicts): merge the committed writes and advance the window
+        EXACTLY as detect() would have — one batched chunk sweep, the
+        amortized cost ISSUE 9 is about."""
         active = _IntervalSet()
         for t, tr in enumerate(transactions):
             if statuses[t] != COMMITTED:
@@ -167,11 +360,184 @@ class CpuConflictSet:
                 active.add(wb, we)
         self._commit_writes(active, now, new_oldest_version)
 
+    def _commit_writes(
+        self, active: _IntervalSet, now: int, new_oldest_version: int
+    ) -> None:
+        """Phases 3-4: one batched overwrite sweep for the whole committed
+        write union, then the chunk-skipping window eviction."""
+        self._apply_staged()
+        if active.begins:
+            self._apply_intervals(active.begins, active.ends, now)
+        if new_oldest_version > self.oldest_version:
+            self.oldest_version = new_oldest_version
+            self._evict(new_oldest_version)
+
+    # -- phase 3: batched interval overwrite --
+    def _apply_intervals(
+        self, begins: list, ends: list, now: int
+    ) -> None:
+        """Set the step function to `now` on every [begins[i], ends[i]).
+        Intervals are sorted, disjoint and non-touching (the _IntervalSet
+        invariant), so end values can be resolved against the PRE state
+        and the whole union applies as one left-to-right sweep.  Chunks
+        no interval touches are reused by reference (identity preserved
+        for snapshot diffing and the device encode cache)."""
+        # Flat-equivalent edit per interval (engine_cpu_flat._overwrite):
+        # delete boundaries in [b, e), insert (b, now), insert
+        # (e, value_at(e)) unless a boundary already sits at e.
+        end_vals = [self._value_at(e) for e in ends]
+        chunks = self._chunks
+        starts = self._starts
+        n_chunks = len(chunks)
+        n_int = len(begins)
+        out: list = []  # new chunk sequence
+        buf_k: list = []  # materialized pairs of the current touched run
+        buf_v: list = []
+        i = 0  # interval cursor
+        in_del = False  # an interval's deletion range is open
+        cur_e = b""
+        cur_ev = 0
+        for c in range(n_chunks):
+            ch = chunks[c]
+            s = starts[c]
+            nxt = starts[c + 1] if c + 1 < n_chunks else None
+            if in_del:
+                if cur_e <= s:
+                    # The open deletion ends exactly at this chunk's start
+                    # boundary (cur_e >= previous nxt == s): that boundary
+                    # exists, so no insert — close and fall through.
+                    in_del = False
+                    i += 1
+                elif nxt is not None and cur_e >= nxt:
+                    # Every boundary in [s, nxt) is inside [b, e): the
+                    # whole chunk is deleted without materializing it.
+                    continue
+            if not in_del and not (
+                i < n_int and (nxt is None or begins[i] < nxt)
+            ):
+                # Untouched: reuse by reference.
+                self._flush_pairs(out, buf_k, buf_v)
+                out.append(ch)
+                continue
+            # Touched (or a deletion closes inside it): materialize.
+            keys, vers = ch.keys, ch.vers
+            m = len(keys)
+            j = 0
+            while j < m:
+                k = keys[j]
+                if in_del:
+                    if k < cur_e:
+                        j += 1  # deleted
+                        continue
+                    if k != cur_e:
+                        buf_k.append(cur_e)
+                        buf_v.append(cur_ev)
+                    in_del = False
+                    i += 1
+                    continue  # re-examine k outside the deletion
+                if i < n_int and begins[i] <= k:
+                    buf_k.append(begins[i])
+                    buf_v.append(now)
+                    in_del = True
+                    cur_e = ends[i]
+                    cur_ev = end_vals[i]
+                    continue  # re-examine k under the new deletion
+                buf_k.append(k)
+                buf_v.append(vers[j])
+                j += 1
+            # Tail: intervals starting after the chunk's last boundary but
+            # before the next chunk (or anywhere, for the last chunk).
+            while True:
+                if in_del:
+                    if nxt is not None and cur_e >= nxt:
+                        break  # deletion spans into the next chunk
+                    buf_k.append(cur_e)
+                    buf_v.append(cur_ev)
+                    in_del = False
+                    i += 1
+                elif i < n_int and (nxt is None or begins[i] < nxt):
+                    buf_k.append(begins[i])
+                    buf_v.append(now)
+                    in_del = True
+                    cur_e = ends[i]
+                    cur_ev = end_vals[i]
+                else:
+                    break
+        self._flush_pairs(out, buf_k, buf_v)
+        assert not in_del and i == n_int, "interval sweep failed to converge"
+        self._set_chunks(tuple(out))
+
+    def _flush_pairs(self, out: list, buf_k: list, buf_v: list) -> None:
+        """Re-chunk a run's accumulated (key, ver) pairs into
+        ~chunk_size even pieces, append them to `out`, clear the
+        buffers, and count the rebuilds — the shared tail of both
+        sweeps (_apply_intervals, _evict)."""
+        if not buf_k:
+            return
+        c = self.chunk_size
+        pieces = max(1, (len(buf_k) + c - 1) // c)
+        step = (len(buf_k) + pieces - 1) // pieces
+        for o in range(0, len(buf_k), step):
+            out.append(
+                self._new_chunk(buf_k[o : o + step], buf_v[o : o + step])
+            )
+            self.chunks_rebuilt += 1
+        del buf_k[:], buf_v[:]
+
+    # -- phase 4: window eviction --
+    def _evict(self, old: int) -> None:
+        """Drop boundary i (i > 0) iff vers[i] < old and ORIGINAL
+        vers[i-1] < old (ref SkipList::removeBefore).  Chunks whose
+        min_pair (and cross-chunk first pair) are >= old provably drop
+        nothing and are reused by reference; a window advance with no
+        droppable boundary anywhere rebuilds NOTHING (evict_skips).
+        Survivors of a contiguous run of rewritten chunks are re-chunked
+        TOGETHER (the Jiffy node-merge), so heavy eviction coalesces
+        shrunken chunks instead of fragmenting toward per-boundary
+        chunks over a long-running window."""
+        chunks = self._chunks
+        self.evict_scans += 1
+        out: list = []
+        buf_k: list = []  # survivors of the current rewritten run
+        buf_v: list = []
+        changed = False
+        prev_last: Optional[int] = None  # original last version of prev chunk
+        for ch in chunks:
+            first_pair = _PAIR_INF
+            if prev_last is not None:
+                v0 = ch.vers[0]
+                first_pair = prev_last if prev_last > v0 else v0
+            if ch.min_pair >= old and first_pair >= old:
+                self._flush_pairs(out, buf_k, buf_v)
+                out.append(ch)
+            else:
+                keys, vers = ch.keys, ch.vers
+                for idx in range(len(keys)):
+                    v = vers[idx]
+                    prev = prev_last if idx == 0 else vers[idx - 1]
+                    if prev is None or v >= old or prev >= old:
+                        buf_k.append(keys[idx])
+                        buf_v.append(v)
+                changed = True
+            prev_last = ch.vers[-1]
+        self._flush_pairs(out, buf_k, buf_v)
+        if changed:
+            self._set_chunks(tuple(out))
+        else:
+            self.evict_skips += 1
+            # No chunk changed, but oldest_version DID advance (the
+            # caller's gate): bump the stamp so "equal stamps mean
+            # identical state" stays true for snapshot consumers.
+            self._stamp += 1
+
     def clear(self, version: int):
-        self.keys = [b""]
-        self.vers = [FLOOR_VERSION]
+        self._staged_keys = None  # clear overrides a pending adoption
+        self._set_chunks((self._new_chunk([b""], [FLOOR_VERSION]),))
         self.oldest_version = version
 
     @property
     def boundary_count(self) -> int:
-        return len(self.keys)
+        """O(1): maintained alongside the chunk sequence (ISSUE 9
+        satellite; the flat engine pays len(keys))."""
+        self._apply_staged()
+        return self._count

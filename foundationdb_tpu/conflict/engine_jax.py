@@ -1474,7 +1474,13 @@ class JaxConflictSet:
 
         self.metrics = MetricsRegistry("JaxConflict")
         for _c in ("retraces", "batches", "transactions", "fixpoint_rounds",
-                   "grows", "rebases", "cpu_fallbacks"):
+                   "grows", "rebases", "cpu_fallbacks",
+                   # Snapshot-mirror sync telemetry (ISSUE 9): probe
+                   # rehydration must do work proportional to changes
+                   # since the last device sync — rehydrate_keys_encoded
+                   # vs rehydrate_keys_total is the asserted evidence.
+                   "rehydrate_keys_total", "rehydrate_keys_encoded",
+                   "mirror_sync_keys_encoded"):
             self.metrics.counter(_c)  # pre-create: snapshots list them all
         if self.tiered:
             # Tier telemetry (only in tiered mode, so flat-mode snapshots
@@ -1495,6 +1501,11 @@ class JaxConflictSet:
         # Per-batch padding occupancy (txn/read/write slot utilization of
         # the padded capacities), refreshed on every dispatch.
         self.last_occupancy: dict = {}
+        # Mirror-snapshot sync bookkeeping (ISSUE 9): the stamp of the
+        # last MirrorSnapshot this device state equals (note_synced /
+        # load_from).  Chunk encodings live on the snapshot's immutable
+        # chunks, so they are shared across snapshots for free.
+        self._synced_stamp: Optional[int] = None
 
     # -- state management --
     def _init_state(self, oldest_rel: int):
@@ -1921,32 +1932,111 @@ class JaxConflictSet:
         out[: pb.n_txn] = statuses
         return out
 
-    # -- hybrid state exchange with the CPU engine --
-    def load_from(self, cpu) -> None:
-        """Adopt the CPU engine's step function as device state."""
+    # -- hybrid state exchange with the CPU mirror --
+    def _chunk_encoding(self, ch):
+        """(encoded keys [n, kw1] uint32, abs versions int64) for one
+        immutable mirror chunk, cached ON the chunk (computed at most
+        once per chunk lifetime — chunks never mutate).  Returns
+        (entry, keys_encoded_now)."""
+        cache = ch.enc
+        if cache is None:
+            cache = ch.enc = {}
+        ent = cache.get(self.key_words)
+        if ent is not None:
+            return ent, 0
+        ent = (
+            keylib.encode_keys(ch.keys, self.key_words),
+            np.asarray(ch.vers, dtype=np.int64),
+        )
+        cache[self.key_words] = ent
+        return ent, len(ch.keys)
+
+    def note_synced(self, snap, fresh=None) -> None:
+        """Record that this device state now equals MirrorSnapshot `snap`
+        (called by ConflictSet after every successful device-served
+        batch), pre-encoding any chunk not yet in the encode cache so a
+        LATER half-open probe's load_from pays only for chunks created
+        after the fault.  `fresh` is the mirror's (chunks, complete)
+        hint from take_fresh_chunks(): with it the walk is O(chunks
+        created since the last sync) — the hint may include
+        already-dead chunks (superset semantics; an unencodable dead
+        long-key chunk is skipped, a LIVE one cannot exist while the
+        device serves).  Without it, or when the hint overflowed
+        (complete=False), falls back to walking every chunk of `snap`.
+        An unchanged mirror is an O(1) stamp compare either way."""
+        if snap.stamp == self._synced_stamp:
+            return
+        candidates = snap.chunks
+        if fresh is not None:
+            chunks, complete = fresh
+            if complete:
+                candidates = chunks
+        encoded = 0
+        for ch in candidates:
+            cache = ch.enc
+            if cache is None or self.key_words not in cache:
+                try:
+                    _ent, n = self._chunk_encoding(ch)
+                except ValueError:
+                    continue  # dead long-key chunk from the hint
+                encoded += n
+        if encoded:
+            self.metrics.counter("mirror_sync_keys_encoded").add(encoded)
+        self._synced_stamp = snap.stamp
+
+    def load_from(self, src) -> None:
+        """Adopt a CPU-mirror state as device state.  `src` is either a
+        MirrorSnapshot (engine_cpu.CpuConflictSet.snapshot(): immutable —
+        a fault mid-rehydration can neither observe nor corrupt a
+        half-mutated mirror — and chunk-cached encodings make the host
+        work proportional to chunks changed since the last note_synced)
+        or any flat engine exposing keys/vers/oldest_version (the legacy
+        O(H)-encode contract, kept for FlatCpuConflictSet mirrors and the
+        sharded test rig)."""
         from .engine_cpu import FLOOR_VERSION
 
-        n = len(cpu.keys)
+        chunks = getattr(src, "chunks", None)
+        if chunks is not None:
+            n = src.boundary_count
+            encoded = 0
+            ents = []
+            for ch in chunks:
+                ent, enc_n = self._chunk_encoding(ch)
+                ents.append(ent)
+                encoded += enc_n
+            self.metrics.counter("rehydrate_keys_total").add(n)
+            self.metrics.counter("rehydrate_keys_encoded").add(encoded)
+            keys_enc = np.concatenate([e[0] for e in ents], axis=0)
+            vers_abs = np.concatenate([e[1] for e in ents])
+            synced_stamp = src.stamp
+            oldest = src.oldest_version
+        else:
+            n = len(src.keys)
+            keys_enc = keylib.encode_keys(src.keys, self.key_words)
+            vers_abs = np.asarray(src.vers, dtype=np.int64)
+            self.metrics.counter("rehydrate_keys_total").add(n)
+            self.metrics.counter("rehydrate_keys_encoded").add(n)
+            synced_stamp = None
+            oldest = src.oldest_version
         if n + 8 > self.h_cap:
             # rebuild_maxtab=False: _reset_delta_state below rebuilds the
             # carried table from the ADOPTED state in the same call.
             self._grow(_next_pow2(n + 8, self.h_cap * 2),
                        rebuild_maxtab=False)
-        self._base = cpu.oldest_version
+        self._base = oldest
         kw1 = self.key_words + 1
         hkeys = np.full((kw1, self.h_cap), keylib.INF_WORD, np.uint32)
-        hkeys[:, :n] = keylib.encode_keys(cpu.keys, self.key_words).T
+        hkeys[:, :n] = keys_enc.T
         hvers = np.full((self.h_cap,), FLOOR_REL, np.int32)
-        rel = np.clip(
-            np.array(cpu.vers, dtype=np.int64) - self._base, FLOOR_REL, 2**31 - 2
-        )
-        rel[np.array(cpu.vers) == FLOOR_VERSION] = FLOOR_REL
+        rel = np.clip(vers_abs - self._base, FLOOR_REL, 2**31 - 2)
+        rel[vers_abs == FLOOR_VERSION] = FLOOR_REL
         hvers[:n] = rel.astype(np.int32)
         self._hkeys = jnp.asarray(hkeys)
         self._hvers = jnp.asarray(hvers)
         self._hcount = jnp.asarray(n, jnp.int32)
         self._oldest = jnp.asarray(0, jnp.int32)
         self._hcount_bound = n
+        self._synced_stamp = synced_stamp
         if self.tiered:
             # Rehydration resets the tier split: the adopted state becomes
             # the (frozen) base, the delta restarts empty, and the carried

@@ -268,6 +268,22 @@ g_env.declare("FDB_TPU_EVICT_EVERY", "1",
 g_env.declare("FDB_TPU_JAXCHECK_DIR", "",
               help="jaxcheck fingerprint baseline directory override "
                    "(default: tests/jax_fingerprints next to the package)")
+# Batch-update snapshot mirror (ISSUE 9): the chunked CPU engine behind
+# the device circuit breaker and its live consistency check.
+g_env.declare("FDB_TPU_MIRROR_ENGINE", "",
+              help="CPU mirror engine: '' chunked batch-update snapshot "
+                   "engine (engine_cpu), 'flat' the pre-ISSUE-9 flat "
+                   "array (engine_cpu_flat; A/B arm + escape hatch) — "
+                   "decision- and state-identical by differential gate")
+g_env.declare("FDB_TPU_MIRROR_CHUNK", "256",
+              help="target boundaries per immutable mirror chunk (the "
+                   "batch-update node size; smaller = finer copy-on-write "
+                   "granularity, more chunk overhead)")
+g_env.declare("FDB_TPU_MIRROR_CHECK_SECONDS", "10",
+              help="period of the resolver's mirror consistency-check "
+                   "actor (virtual seconds in sim): diffs a live mirror "
+                   "snapshot against the device export and opens the "
+                   "breaker on confirmed divergence; 0 disables")
 # Soak-harness defaults (workloads/soak.py via `cli soak` and the
 # slow-marked soak test).  CLI arguments override these; the env flags
 # exist so CI/bench drivers can retune the soak without editing argv.

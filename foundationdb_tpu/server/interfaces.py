@@ -178,6 +178,11 @@ class ResolverSignalsReply:
     backend_state: str = "ok"  # ok | degraded | probing
     cpu_mirror_tps: float = 0.0
     degraded_batches: int = 0
+    # Total confirmed mirror/device divergences this resolver's
+    # consistency checker has caught (ISSUE 9).  Informational for
+    # status/qos: each divergence already opened the breaker, so
+    # backend_state carries the admission-control consequence.
+    mirror_divergence: int = 0
 
 
 @dataclass

@@ -60,6 +60,7 @@ class RateInfo:
     commit_p99: float = 0.0
     backend_state: str = "ok"  # ok | degraded | probing (worst resolver)
     grv_queue_depth: int = 0  # worst proxy-reported GRV admission queue
+    mirror_divergence: int = 0  # total confirmed mirror divergences
     limiting: str = "none"  # which signal set the rate (for status/qos)
 
 
@@ -82,6 +83,10 @@ class Signals:
     backend_state: str = "ok"
     cpu_mirror_tps: float = 0.0  # measured; 0.0 = unknown
     grv_queue_depth: int = 0
+    # Summed confirmed mirror/device divergences across resolvers
+    # (ISSUE 9).  Informational — each one already opened that
+    # resolver's breaker, so backend_state carries the spring.
+    mirror_divergence: int = 0
     # RPC mode only: a whole commit-critical role class (every tlog, or
     # every storage) is unreachable — the cluster is mid-recovery.
     unreachable: bool = False
@@ -339,6 +344,7 @@ class Ratekeeper:
         for s in snaps:
             sig.resolver_queue = max(sig.resolver_queue, s.queue_depth)
             sig.resolve_p99 = max(sig.resolve_p99, s.resolve_p99)
+            sig.mirror_divergence += getattr(s, "mirror_divergence", 0)
             if states[s.backend_state] > states[worst_state]:
                 worst_state = s.backend_state
             if s.backend_state != "ok" and s.cpu_mirror_tps > 0:
@@ -483,6 +489,7 @@ class Ratekeeper:
                 commit_p99=sig.commit_p99,
                 backend_state=sig.backend_state,
                 grv_queue_depth=sig.grv_queue_depth,
+                mirror_divergence=sig.mirror_divergence,
                 limiting=limiting,
             )
 

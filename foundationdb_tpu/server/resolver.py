@@ -120,6 +120,20 @@ class Resolver:
         process.spawn(
             emit_metrics(self.metrics, process), "resolver_metrics_emit"
         )
+        # Mirror consistency-check actor (ISSUE 9): periodically diff a
+        # live mirror snapshot against the device's exported state;
+        # confirmed divergence opens the breaker (ConflictSet.mirror_check
+        # counts/traces and degrades).  Deterministic: virtual-time
+        # cadence, synchronous check — same seed, same transition log.
+        from ..flow.knobs import g_env
+
+        period = float(g_env.get("FDB_TPU_MIRROR_CHECK_SECONDS"))
+        if period > 0 and callable(
+            getattr(self.conflicts, "mirror_check", None)
+        ):
+            process.spawn(
+                self._mirror_check_loop(period), "resolver_mirror_check"
+            )
 
     def interface(self) -> ResolverInterface:
         return ResolverInterface(
@@ -161,12 +175,26 @@ class Resolver:
             degraded_batches=int(
                 self.metrics.counter("degraded_batches").value
             ),
+            mirror_divergence=(
+                sig.get("mirror_divergence", 0) if callable(bs) else 0
+            ),
         )
 
     async def _serve_signals(self):
         while True:
             _req, reply = await self._signals_stream.pop()
             reply.send(self.signal_snapshot())
+
+    async def _mirror_check_loop(self, period: float):
+        """Run ConflictSet.mirror_check() every `period` virtual seconds.
+        The check itself is synchronous (no await inside), so it can
+        never observe a half-applied batch; a host-only backend returns
+        None on the first call and the actor retires."""
+        loop = self.process.network.loop
+        while True:
+            await loop.delay(period)
+            if self.conflicts.mirror_check() is None:
+                return  # no device engine behind this conflict set
 
     async def _serve(self):
         while True:

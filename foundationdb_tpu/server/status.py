@@ -254,6 +254,13 @@ def cluster_status(cluster) -> dict:
             qos["worst_grv_queue_depth"] = getattr(
                 info, "grv_queue_depth", 0
             )
+            # Mirror consistency (ISSUE 9): total confirmed mirror/device
+            # divergences across resolvers.  Non-zero means a breaker
+            # opened on corrupt device state at some point; the current
+            # consequence (if any) shows in conflict_backend_state.
+            qos["conflict_mirror_divergence"] = getattr(
+                info, "mirror_divergence", 0
+            )
         cl["qos"] = qos
         # Passive latency distributions from the proxy's ContinuousSamples
         # (ref: the commit/GRV latency bands in Status.actor.cpp's qos; the

@@ -35,6 +35,10 @@ class CliProcessor:
         "(json form includes the resolver/tpu telemetry section)",
         "metrics": "metrics [--format=json] — metrics-registry snapshots "
         "(proxy/resolver counters, device kernel telemetry)",
+        "mirror-check": "mirror-check [--format=json] — on-demand live "
+        "diff of each resolver's CPU mirror snapshot against its device "
+        "export (the consistency check the periodic resolver actor runs; "
+        "confirmed divergence opens the circuit breaker)",
         "latency": "latency [--format=json] — per-stage commit/GRV "
         "latency percentiles reassembled from trace_batch debug ids",
         "consistencycheck": "consistencycheck — compare every "
@@ -91,7 +95,8 @@ class CliProcessor:
         if not parts:
             return []
         cmd, *args = parts
-        handler = getattr(self, f"_cmd_{cmd}", None)
+        # Hyphenated commands (mirror-check) map onto underscore handlers.
+        handler = getattr(self, f"_cmd_{cmd.replace('-', '_')}", None)
         if handler is None:
             return [f"ERROR: unknown command `{cmd}'; type `help' for help"]
         try:
@@ -471,6 +476,49 @@ class CliProcessor:
                     else:
                         lines.append(f"    {k} = {v}")
         return lines or ["(no metrics registries live)"]
+
+    async def _cmd_mirror_check(self, args):
+        """On-demand mirror consistency check (ISSUE 9): run
+        ConflictSet.mirror_check() on every live resolver and report the
+        verdicts.  Text form is one line per resolver; --format=json
+        returns the raw report dicts (status ok|diverged|skipped)."""
+        from ..server.status import role_objects
+
+        doc: dict = {}
+        for r in role_objects(self.cluster, "resolver"):
+            mc = getattr(getattr(r, "conflicts", None), "mirror_check", None)
+            if not callable(mc):
+                continue
+            rep = mc()
+            name = getattr(getattr(r, "process", None), "name", None) or (
+                f"resolver{len(doc)}"
+            )
+            doc[name] = (
+                rep if rep is not None else {"status": "no_device_engine"}
+            )
+        if args and args[0] == "--format=json":
+            return json.dumps(doc, indent=2, default=str).splitlines()
+        if not doc:
+            return ["(no resolvers live)"]
+        lines = []
+        for name, rep in sorted(doc.items()):
+            status = rep.get("status", "?")
+            if status == "ok":
+                lines.append(
+                    f"{name}: OK ({rep['boundaries']} boundaries match)"
+                )
+            elif status == "diverged":
+                lines.append(
+                    f"{name}: DIVERGED ({rep['mismatch_keys']} mismatched "
+                    f"keys over {rep['boundaries']} mirror / "
+                    f"{rep['device_boundaries']} device boundaries) — "
+                    "breaker opened, device will rehydrate from snapshot"
+                )
+            elif status == "skipped":
+                lines.append(f"{name}: skipped ({rep.get('reason', '?')})")
+            else:
+                lines.append(f"{name}: {status}")
+        return lines
 
     async def _cmd_latency(self, args):
         """Per-stage commit/GRV latency percentiles, reassembled from the

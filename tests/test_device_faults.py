@@ -511,6 +511,7 @@ def test_backend_signal_cheap_probe():
         "backend_state": "ok",
         "cpu_mirror_tps": 0.0,
         "cpu_fallback_txns": 0,
+        "mirror_divergence": 0,
     }
     # CPU-only sets answer trivially-ok too (uniform resolver plumbing).
     assert ConflictSet(backend="cpu").backend_signal()["backend_state"] == "ok"

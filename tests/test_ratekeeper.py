@@ -322,9 +322,11 @@ def test_resolver_signals_feed_ratekeeper():
             "commit_latency_p99_seconds",
             "conflict_backend_state",
             "worst_grv_queue_depth",
+            "conflict_mirror_divergence",
         ):
             assert key in qos, sorted(qos)
         assert qos["conflict_backend_state"] == "ok"
+        assert qos["conflict_mirror_divergence"] == 0
     finally:
         g_knobs.server.ratekeeper_max_tps = old
 

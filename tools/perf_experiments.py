@@ -48,6 +48,15 @@ VARIANTS = list(bench.VARIANTS)
 
 
 def main():
+    if "--mirror" in sys.argv:
+        # Host-side mirror A/B (ISSUE 9; bench.MIRROR_VARIANTS): no
+        # device needed, runs anywhere — flat vs batched-snapshot mirror
+        # apply/detect/rehydrate cost at the skipListTest stream shape.
+        import numpy as np
+
+        print(json.dumps(bench.bench_mirror(np.random.default_rng(2024)),
+                         indent=2))
+        return
     out = {}
     for name, flags, h_cap in VARIANTS:
         env = dict(os.environ)
