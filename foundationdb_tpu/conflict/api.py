@@ -445,6 +445,30 @@ class ConflictSet:
             ).log()
             if self._breaker is not None:
                 self._breaker.on_divergence(f"mismatch_keys={mismatch}")
+            # Flight-recorder trigger (ISSUE 10): divergence is corrupt
+            # state — freeze the telemetry window that led here.  After
+            # on_divergence, so the artifact's transition log contains
+            # the breaker-open transition this divergence caused.
+            from ..flow.flight_recorder import maybe_trigger
+
+            breaker = self._breaker
+            maybe_trigger(
+                "mirror_divergence",
+                detail={"mismatch_keys": mismatch,
+                        "mirror_boundaries": len(mk),
+                        "device_boundaries": len(dk)},
+                # Thunk: copied only if the cooldown admits the capture.
+                transitions=(
+                    (lambda: [list(t) for t in breaker.transitions])
+                    if breaker is not None
+                    else None
+                ),
+                # Per-breaker cooldown, not global (construction-order
+                # id: deterministic, never address-reused).
+                source=(
+                    breaker.breaker_id if breaker is not None else None
+                ),
+            )
             # The mirror is authoritative by design; the device state is
             # now suspect — force a snapshot rehydration before it serves
             # again (after the breaker's backoff walks to a probe).
@@ -498,6 +522,21 @@ class ConflictSet:
                 evict_skips=self._cpu.evict_skips,
             )
         snap["mirror"] = mirror
+        # Device program cost accounting (ISSUE 10): one block per
+        # DEVICE_ENTRY_POINTS entry — carried-buffer bytes, temp/output
+        # allocation, FLOPs per batch (engine_jax.program_cost_table).
+        # Compiling every program costs ~15s, so the block is included
+        # eagerly only under FDB_TPU_PROGRAM_COSTS; otherwise it appears
+        # once some surface (perf_experiments --programs, the perf_smoke
+        # gate) has computed the cached table.
+        from .engine_jax import cached_program_costs, program_cost_table
+
+        if g_env.get("FDB_TPU_PROGRAM_COSTS") not in ("", "0"):
+            snap["programs"] = program_cost_table()
+        else:
+            progs = cached_program_costs()
+            if progs is not None:
+                snap["programs"] = progs
         return snap
 
     def clear(self, version: int):

@@ -188,6 +188,13 @@ STATE_PROBING = "probing"
 _STATE_GAUGE = {STATE_OK: 0, STATE_DEGRADED: 1, STATE_PROBING: 2}
 
 
+import itertools
+
+# Construction-order ids (deterministic under the sim, unlike id()):
+# the flight-recorder cooldown key for concurrent distinct breakers.
+_BREAKER_SEQ = itertools.count()
+
+
 class DeviceCircuitBreaker:
     """Consecutive-failure circuit breaker with deterministic exponential
     backoff, counted in device-eligible batches (the only clock every
@@ -200,6 +207,7 @@ class DeviceCircuitBreaker:
         backoff_batches: int = 2,
         backoff_cap: int = 64,
     ):
+        self.breaker_id = next(_BREAKER_SEQ)
         self.metrics = metrics
         self.threshold = threshold
         self.initial_backoff = backoff_batches
@@ -291,6 +299,25 @@ class DeviceCircuitBreaker:
         ).detail("to", to).detail("reason", reason).detail(
             "seq", self.seq
         ).log()
+        if frm == STATE_OK and to == STATE_DEGRADED:
+            # Breaker OPEN (threshold faults or confirmed divergence —
+            # not a failed probe re-opening an already-degraded circuit):
+            # freeze the flight-recorder window, transitions included, so
+            # the incident's lead-up survives the incident.  After the
+            # TraceEvent above, so the capture's recent-events ring
+            # contains the triggering transition itself.
+            from ..flow.flight_recorder import maybe_trigger
+
+            maybe_trigger(
+                "breaker_open",
+                detail={"reason": reason, "seq": self.seq},
+                # Thunk: copied only if the cooldown admits the capture.
+                transitions=lambda: [list(t) for t in self.transitions],
+                # Two breakers opening at once are two incidents, not a
+                # flap — each gets its own cooldown key (construction-
+                # order id: deterministic, never address-reused).
+                source=self.breaker_id,
+            )
 
     def snapshot(self) -> dict:
         """Replayable view for device_metrics(): same seed => the json

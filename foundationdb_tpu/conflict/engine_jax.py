@@ -1238,6 +1238,28 @@ class DeviceEntryPoint:
                 self._jaxpr_x64 = jax.make_jaxpr(partial(fn, **statics))(*args)
         return self._jaxpr_x64
 
+    def arg_nbytes(self) -> Dict[str, int]:
+        """arg name -> buffer bytes, computed from the example args'
+        shapes/dtypes (no trace, no compile — pure shape math, so the
+        perf_smoke pin can check it against h_cap/d_cap arithmetic on
+        CPU)."""
+        _fn, _jitted, args, _statics = self.built()
+        leaves = jax.tree_util.tree_leaves(args)
+        assert len(leaves) == len(self.arg_names), (
+            self.name, len(leaves), self.arg_names)
+        return {
+            n: int(np.prod(x.shape, dtype=np.int64))
+            * np.dtype(x.dtype).itemsize
+            for n, x in zip(self.arg_names, leaves)
+        }
+
+    def carried_bytes(self) -> Dict[str, int]:
+        """Per-buffer bytes of the CARRIED (device-resident across steps)
+        state — the HBM footprint ROADMAP item 1's Pallas kernels will be
+        judged against."""
+        sizes = self.arg_nbytes()
+        return {n: sizes[n] for n in self.carried}
+
     def donation(self) -> Optional[Dict[str, bool]]:
         """arg name -> donated, read from the ACTUAL jit wrapper's lowering
         (ground truth, not a redeclaration); None when there is no jit
@@ -1399,6 +1421,121 @@ register_entry_point(
     work_bound=2 * EP_H,  # the reallocation concat's output IS old+pad
     bucket_dims=dict(h_cap=(EP_H, 64)),
 )
+
+
+# ---------------------------------------------------------------------------
+# Device program cost accounting (ISSUE 10): the baseline dataset the
+# Pallas-kernel work (ROADMAP item 1) will be judged against.
+# ---------------------------------------------------------------------------
+
+# name -> deterministic cost block.  XLA compile of every entry costs
+# ~15s on the 1-core CI host, so the table is computed lazily (first
+# program_cost_table() call — tools/perf_experiments.py --programs, the
+# perf_smoke gate, or device_metrics under FDB_TPU_PROGRAM_COSTS) and
+# cached for the process.
+_PROGRAM_COSTS: Dict[str, dict] = {}
+# name -> compile wall seconds (REAL clock; kept out of _PROGRAM_COSTS
+# so the deterministic blocks never carry wall-derived values — the
+# record_wall discipline, flow/metrics.py).
+_PROGRAM_COMPILE_WALL: Dict[str, float] = {}
+_COMPILE_WALL_HIST = None  # BoundedHistogram, lazy
+
+
+def compile_wall_histogram():
+    """Process-wide histogram of entry-point compile wall costs (wall
+    namespace: real-mode tooling only, never a sim-compared surface)."""
+    global _COMPILE_WALL_HIST
+    if _COMPILE_WALL_HIST is None:
+        from ..flow.metrics import BoundedHistogram
+
+        _COMPILE_WALL_HIST = BoundedHistogram("program_compile_wall")
+    return _COMPILE_WALL_HIST
+
+
+def _cost_block(ep: DeviceEntryPoint) -> dict:
+    """Compile one registered program at its canonical trace shapes and
+    account it: carried/pinned buffer bytes (shape math), XLA
+    memory_analysis (temp/output/argument allocation) and cost_analysis
+    (FLOPs + bytes accessed per batch).  Deterministic for a fixed
+    program + jax version; the compile WALL cost goes to the separate
+    wall-namespace histogram."""
+    import warnings
+
+    from ..flow.metrics import wall_now
+
+    sizes = ep.arg_nbytes()
+    carried = ep.carried_bytes()
+    blk: dict = {
+        "entry": ep.name,
+        "carried_bytes": carried,
+        "carried_bytes_total": sum(carried.values()),
+        "pinned_bytes_total": sum(sizes[n] for n in ep.pinned),
+        "argument_bytes_total": sum(sizes.values()),
+    }
+    fn, jitted, args, statics = ep.built()
+    if jitted is None:
+        # Inner bodies (e.g. the compaction body) have no jit wrapper of
+        # their own; account them as a standalone compile of the body.
+        jitted, statics = jax.jit(partial(fn, **statics)), {}
+    t0 = wall_now()
+    with warnings.catch_warnings():
+        # Donation mismatches are JXP003's finding; duplicate noise here.
+        warnings.simplefilter("ignore")
+        compiled = jitted.lower(*args, **statics).compile()
+    dt = wall_now() - t0
+    _PROGRAM_COMPILE_WALL[ep.name] = dt
+    compile_wall_histogram().add(dt)
+    ma = compiled.memory_analysis()
+    if ma is not None:
+        blk["memory"] = {
+            k: int(getattr(ma, f"{k}_size_in_bytes", 0) or 0)
+            for k in ("argument", "output", "temp", "alias",
+                      "generated_code")
+        }
+    ca = compiled.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else None
+    if isinstance(ca, dict):
+        blk["flops_per_batch"] = ca.get("flops")
+        blk["bytes_accessed_per_batch"] = ca.get("bytes accessed")
+    return blk
+
+
+def program_cost_table(registry=None, include_wall: bool = False) -> dict:
+    """name -> cost block for every registered device program (cached
+    after the first call; entries registered later — e.g. the sharded
+    step on parallel import — are accounted on the next call).  A
+    builder that cannot run in this environment (the sharded entry
+    without enough devices) yields an {"error": ...} block rather than
+    sinking the table.  include_wall adds per-entry compile wall seconds
+    + the process histogram (real-mode tooling only)."""
+    eps = DEVICE_ENTRY_POINTS if registry is None else registry
+    for name, ep in sorted(eps.items()):
+        if name in _PROGRAM_COSTS:
+            continue
+        try:
+            _PROGRAM_COSTS[name] = _cost_block(ep)
+        except Exception as e:  # noqa: BLE001 - recorded in the block itself, per-entry isolation
+            _PROGRAM_COSTS[name] = {
+                "entry": name,
+                "error": f"{type(e).__name__}: {e}",
+            }
+    out = {n: dict(_PROGRAM_COSTS[n]) for n in sorted(eps) if n in _PROGRAM_COSTS}
+    if include_wall:
+        for n in out:
+            if n in _PROGRAM_COMPILE_WALL:
+                out[n]["compile_wall_seconds"] = _PROGRAM_COMPILE_WALL[n]
+        out["_compile_wall"] = compile_wall_histogram().summary()
+    return out
+
+
+def cached_program_costs() -> Optional[dict]:
+    """The already-computed table (deterministic blocks only), or None
+    when nothing has been accounted yet — device_metrics() includes the
+    block lazily so a status call never pays the compile."""
+    if not _PROGRAM_COSTS:
+        return None
+    return {n: dict(b) for n, b in sorted(_PROGRAM_COSTS.items())}
 
 
 def _build_max_table_np(values: np.ndarray) -> np.ndarray:

@@ -1,0 +1,209 @@
+"""Flight recorder: triggered black-box capture of the telemetry window
+around an incident (ISSUE 10 tentpole, layer 3 of 3).
+
+When the breaker opens or the ratekeeper starts throttling mid-soak, the
+point-in-time surfaces show the aftermath; the window of history that
+explains WHY is gone.  This module is the reference's "trace spool +
+status history" analog in bounded memory: on a trigger it freezes one
+deterministic JSON artifact —
+
+    {trigger, time, detail, transitions,
+     timeseries:    last-N window of every TimeSeriesHub series,
+     recent_events: last-N ring of the global TraceCollector}
+
+— into a bounded capture ring, surfaced via `cli flightrec`, the status
+doc's `flight_recorder` section, and per-fault-window captures in
+`workloads/soak.py`.
+
+Trigger sites (the four transition-log owners):
+  breaker open        DeviceCircuitBreaker._transition (ok -> degraded)
+  mirror_divergence   ConflictSet.mirror_check confirmed divergence
+  ratekeeper_limiting Ratekeeper._update_loop binding-signal change
+  slo_breach          soak report: a phase missed its SLO
+
+All call `maybe_trigger(kind, ...)`, which applies a per-kind
+virtual-time cooldown (FDB_TPU_FLIGHTREC_COOLDOWN) and no-ops when
+FDB_TPU_FLIGHTREC=0; explicit `capture()` calls (the soak's
+fault-window captures) bypass both.
+
+Determinism contract: artifacts contain only virtual-time stamps,
+registry deltas, trace events, and transition logs — `artifact_json()`
+is byte-identical across same-seed runs (the acceptance gate).  The
+global recorder is swappable per run (`set_global_flight_recorder`),
+exactly like the trace collector and the time-series hub.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import deque
+from typing import Dict, Optional
+
+from .knobs import g_env
+
+
+def _vt_now() -> Optional[float]:
+    """Capture timestamp: the current loop's virtual time, else None —
+    NEVER wall clock (an artifact must replay byte-identical).  None
+    means there is no meaningful clock to base a cooldown on."""
+    from .eventloop import _current_loop
+
+    return _current_loop.now() if _current_loop is not None else None
+
+
+def artifact_json(artifact: dict) -> str:
+    """Canonical byte form of one capture — what the same-seed gate
+    compares."""
+    return json.dumps(artifact, sort_keys=True, separators=(",", ":"))
+
+
+class FlightRecorder:
+    """Bounded ring of incident captures + per-kind trigger cooldowns."""
+
+    def __init__(
+        self,
+        max_captures: Optional[int] = None,
+        window: Optional[int] = None,
+        cooldown: Optional[float] = None,
+    ):
+        self.window = (
+            window
+            if window is not None
+            else max(1, g_env.get_int("FDB_TPU_FLIGHTREC_WINDOW"))
+        )
+        self.cooldown = (
+            cooldown
+            if cooldown is not None
+            else float(g_env.get("FDB_TPU_FLIGHTREC_COOLDOWN"))
+        )
+        n = (
+            max_captures
+            if max_captures is not None
+            else max(1, g_env.get_int("FDB_TPU_FLIGHTREC_CAPTURES"))
+        )
+        self.captures: deque = deque(maxlen=n)
+        self.capture_seq = 0  # lifetime count (ring may have dropped some)
+        self.trigger_counts: Dict[str, int] = {}
+        self._last_trigger_time: Dict[str, float] = {}
+
+    # -- capture ----------------------------------------------------------
+    def capture(
+        self,
+        trigger: str,
+        detail=None,
+        transitions=None,
+        now: Optional[float] = None,
+    ) -> dict:
+        """Freeze one artifact NOW (no cooldown, no enable gate): the
+        last-N time-series window, the recent trace events, the caller's
+        transition-log snapshot, and the trigger context."""
+        from .timeseries import global_timeseries
+        from .trace import global_collector
+
+        if now is None:
+            now = _vt_now()
+            if now is None:
+                now = 0.0
+        if callable(transitions):
+            # Lazily-built transition snapshot (see trigger): resolve it
+            # only for captures that actually happen.
+            transitions = transitions()
+        self.capture_seq += 1
+        artifact = {
+            "capture_seq": self.capture_seq,
+            "trigger": trigger,
+            "time": now,
+            "detail": detail,
+            "transitions": transitions,
+            "timeseries": global_timeseries().window_dict(
+                last_n=self.window
+            ),
+            "recent_events": global_collector().recent_events()[
+                -self.window:
+            ],
+        }
+        self.captures.append(artifact)
+        return artifact
+
+    def trigger(
+        self, kind: str, detail=None, transitions=None, source=None
+    ) -> Optional[dict]:
+        """Cooldown-gated capture: at most one capture per (kind,
+        source) per FDB_TPU_FLIGHTREC_COOLDOWN virtual seconds (a
+        FLAPPING signal must not churn the whole ring — but two DISTINCT
+        sources degrading simultaneously are two incidents, so call
+        sites pass their identity as `source` and each gets its own
+        cooldown).  Suppressed triggers still count.  `transitions` may
+        be a zero-arg callable — it is only resolved for captures the
+        cooldown lets through, so flapping call sites don't pay a log
+        copy per suppressed trigger.  The cooldown only applies with a
+        loop set AND a non-decreasing stamp: no loop means no meaningful
+        clock (never suppress), and a stamp that went BACKWARDS means a
+        new run's virtual time restarted in this process (a real
+        incident of the new run must not be swallowed by the old run's
+        stamp)."""
+        self.trigger_counts[kind] = self.trigger_counts.get(kind, 0) + 1
+        now = _vt_now()
+        if now is not None:
+            key = (kind, source)
+            last = self._last_trigger_time.get(key)
+            if last is not None and 0 <= now - last < self.cooldown:
+                return None
+            self._last_trigger_time[key] = now
+        return self.capture(kind, detail=detail, transitions=transitions, now=now)
+
+    # -- surfaces ---------------------------------------------------------
+    def status_section(self) -> dict:
+        """The status doc's `flight_recorder` block: capture inventory,
+        never the (large) artifacts themselves — `cli flightrec` dumps
+        those."""
+        return {
+            "captures": len(self.captures),
+            "total_triggers": dict(sorted(self.trigger_counts.items())),
+            "capture_seq": self.capture_seq,
+            "window": self.window,
+            "last_capture": (
+                {
+                    "trigger": self.captures[-1]["trigger"],
+                    "time": self.captures[-1]["time"],
+                    "capture_seq": self.captures[-1]["capture_seq"],
+                }
+                if self.captures
+                else None
+            ),
+        }
+
+    def clear(self):
+        self.captures.clear()
+        self.trigger_counts.clear()
+        self._last_trigger_time.clear()
+        self.capture_seq = 0
+
+
+_global_recorder = FlightRecorder()
+
+
+def set_global_flight_recorder(rec: FlightRecorder):
+    global _global_recorder
+    _global_recorder = rec
+
+
+def global_flight_recorder() -> FlightRecorder:
+    return _global_recorder
+
+
+def maybe_trigger(
+    kind: str, detail=None, transitions=None, source=None
+) -> Optional[dict]:
+    """The trigger-site entry point: no-op when FDB_TPU_FLIGHTREC=0,
+    else a cooldown-gated capture on the CURRENT global recorder.  Call
+    sites (breaker/mirror/ratekeeper/soak) pass their own transition-log
+    snapshot (or a thunk building it) so the artifact carries the
+    triggering transition, and their own identity as `source` so
+    simultaneous incidents from distinct objects don't share one
+    cooldown."""
+    if g_env.get("FDB_TPU_FLIGHTREC") in ("", "0"):
+        return None
+    return _global_recorder.trigger(
+        kind, detail=detail, transitions=transitions, source=source
+    )

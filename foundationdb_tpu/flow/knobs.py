@@ -305,3 +305,39 @@ g_env.declare("FDB_TPU_SOAK_THETA", "0.9",
 g_env.declare("FDB_TPU_SOAK_BACKEND", "jax",
               help="conflict backend for the soak cluster resolvers "
                    "(cpu|jax|hybrid; device-outage faults need jax/hybrid)")
+# Time-series telemetry + flight recorder (ISSUE 10): bounded-memory
+# history behind the point-in-time metrics/status surfaces.
+g_env.declare("FDB_TPU_TIMESERIES", "1",
+              help="0 disables the per-role time-series sampler actors "
+                   "(flow/timeseries.py); default on — the sampler is "
+                   "read-only and virtual-time paced")
+g_env.declare("FDB_TPU_TIMESERIES_INTERVAL", "1.0",
+              help="time-series sample cadence in VIRTUAL seconds")
+g_env.declare("FDB_TPU_TIMESERIES_WINDOW", "240",
+              help="samples retained per role series (ring buffer "
+                   "maxlen; 240 x 1s = a 4-sim-minute window)")
+g_env.declare("FDB_TPU_TRACE_RECENT", "512",
+              help="TraceCollector recent-events ring bound (most recent "
+                   "N emitted events kept in memory in BOTH collector "
+                   "modes; what find() searches on a file-backed "
+                   "collector and the flight recorder dumps)")
+g_env.declare("FDB_TPU_FLIGHTREC", "1",
+              help="0 disables flight-recorder trigger captures "
+                   "(flow/flight_recorder.py); explicit capture() calls "
+                   "still work")
+g_env.declare("FDB_TPU_FLIGHTREC_CAPTURES", "16",
+              help="captured artifacts retained (ring buffer maxlen)")
+g_env.declare("FDB_TPU_FLIGHTREC_COOLDOWN", "5.0",
+              help="min VIRTUAL seconds between trigger captures of the "
+                   "same kind (a flapping ratekeeper signal must not "
+                   "churn the capture ring); explicit capture() ignores it")
+g_env.declare("FDB_TPU_FLIGHTREC_WINDOW", "64",
+              help="time-series samples and trace events included per "
+                   "capture (the last-N window of each)")
+g_env.declare("FDB_TPU_PROGRAM_COSTS", "",
+              help="truthy: device_metrics()/status tpu eagerly compile "
+                   "+ cost-account every DEVICE_ENTRY_POINTS program "
+                   "(engine_jax.program_cost_table; ~15s of XLA compile "
+                   "on first call, cached).  Default lazy: the programs "
+                   "block appears once the table has been computed "
+                   "(tools/perf_experiments.py --programs, tests)")

@@ -1,0 +1,182 @@
+"""Deterministic time-series telemetry: bounded ring-buffer history of
+each role's MetricsRegistry (ISSUE 10 tentpole, layer 2 of 3).
+
+The reference ships always-on trace spooling precisely so incidents are
+diagnosable after the fact; our point-in-time surfaces (metrics/status,
+ISSUE 2) answer "what is the counter NOW" but not "what was it doing in
+the thirty seconds before the breaker opened".  This module closes that
+gap without unbounded memory: each role's registry is sampled on a
+virtual-time cadence into a fixed-size ring of DELTAS —
+
+    sample = {time, counters: {name: delta since last sample},
+              gauges: {name: value},
+              histograms: {name: {count/sum deltas + current quantiles}}}
+
+Determinism contract (inherited from MetricsRegistry.snapshot): samples
+observe only virtual time and registry state, so two same-seed runs
+produce byte-identical `window_json()` output — the property the flight
+recorder's artifact gate pins.  Wall-namespace measurements
+(`record_wall`) are never sampled.
+
+Wiring: resolver/proxy/ratekeeper spawn `sample_loop` actors at
+construction (behind the FDB_TPU_TIMESERIES_* g_env knobs); the actors
+write into the process-global `TimeSeriesHub` (swap it per run with
+`set_global_timeseries`, exactly like the global trace collector).  A
+series keyed by a name resets whenever a DIFFERENT registry object
+starts reporting under that name (a re-recruited generation's fresh role
+must not produce negative deltas against its predecessor's totals).
+"""
+
+from __future__ import annotations
+
+import json
+from collections import deque
+from typing import Dict, List, Optional
+
+from .knobs import g_env
+
+# Histogram quantile keys carried per sample when the registry's
+# histograms have an rng-backed reservoir (see BoundedHistogram.summary).
+_QUANTILES = ("median", "p90", "p99")
+
+
+def snapshot_delta(prev: Optional[dict], cur: dict) -> dict:
+    """Delta between two MetricsRegistry.snapshot() dicts: counter value
+    deltas, histogram count/sum deltas (+ the CURRENT quantiles — a
+    reservoir has no subtractable form), gauges as-is.  `prev=None`
+    means "no baseline": every delta is the current total.  Shared by
+    the sampler and `cli metrics --diff` so the two can never disagree
+    about what a delta is."""
+    pc = prev.get("counters", {}) if prev else {}
+    ph = prev.get("histograms", {}) if prev else {}
+    out: dict = {
+        "counters": {
+            k: v - pc.get(k, 0) for k, v in cur.get("counters", {}).items()
+        },
+        "gauges": dict(cur.get("gauges", {})),
+        "histograms": {},
+    }
+    for k, h in cur.get("histograms", {}).items():
+        p = ph.get(k, {})
+        d = {
+            "count": h["count"] - p.get("count", 0),
+            "sum": h["sum"] - p.get("sum", 0.0),
+        }
+        for q in _QUANTILES:
+            if q in h:
+                d[q] = h[q]
+        out["histograms"][k] = d
+    return out
+
+
+class TimeSeries:
+    """One role's bounded sample history + the previous-snapshot baseline
+    the next delta is computed against."""
+
+    __slots__ = ("name", "samples", "_prev", "_source", "resets")
+
+    def __init__(self, name: str, window: int):
+        self.name = name
+        self.samples: deque = deque(maxlen=window)
+        self._prev: Optional[dict] = None
+        # The registry object the baseline belongs to — held as a STRONG
+        # reference (one registry per series name, trivial memory): an
+        # `id()` comparison would miss the reset when the predecessor is
+        # garbage-collected and CPython reuses its address, producing
+        # negative deltas against a dead generation's totals.
+        self._source = None
+        self.resets = 0  # source-object changes observed (diagnostic)
+
+    def record(self, registry, now: Optional[float]) -> dict:
+        if self._source is not None and self._source is not registry:
+            # A different registry object took this name (re-recruit, or a
+            # second cluster in one process): restart the delta baseline.
+            self.samples.clear()
+            self._prev = None
+            self.resets += 1
+        self._source = registry
+        snap = registry.snapshot(now=now)
+        sample = snapshot_delta(self._prev, snap)
+        sample["time"] = snap.get("time")
+        self._prev = snap
+        self.samples.append(sample)
+        return sample
+
+
+class TimeSeriesHub:
+    """name -> TimeSeries, the process-global collection point (swap per
+    run like the global trace collector)."""
+
+    def __init__(self, window: Optional[int] = None):
+        self.window = (
+            window
+            if window is not None
+            else max(2, g_env.get_int("FDB_TPU_TIMESERIES_WINDOW"))
+        )
+        self.series: Dict[str, TimeSeries] = {}
+
+    def record(self, name: str, registry, now: Optional[float] = None) -> dict:
+        ts = self.series.get(name)
+        if ts is None:
+            ts = self.series[name] = TimeSeries(name, self.window)
+        return ts.record(registry, now)
+
+    def window_dict(self, last_n: Optional[int] = None) -> dict:
+        """name -> [sample, ...] (oldest first), optionally only the last
+        N samples of each series — the flight recorder's capture shape."""
+        out: Dict[str, List[dict]] = {}
+        for name in sorted(self.series):
+            samples = list(self.series[name].samples)
+            if last_n is not None:
+                samples = samples[-last_n:]
+            out[name] = samples
+        return out
+
+    def window_json(self, last_n: Optional[int] = None) -> str:
+        """Canonical byte form — what the same-seed determinism gate
+        compares."""
+        return json.dumps(
+            self.window_dict(last_n=last_n),
+            sort_keys=True,
+            separators=(",", ":"),
+        )
+
+    def clear(self):
+        self.series.clear()
+
+
+_global_hub = TimeSeriesHub()
+
+
+def set_global_timeseries(hub: TimeSeriesHub):
+    global _global_hub
+    _global_hub = hub
+
+
+def global_timeseries() -> TimeSeriesHub:
+    return _global_hub
+
+
+def timeseries_enabled() -> bool:
+    return g_env.get("FDB_TPU_TIMESERIES") not in ("", "0")
+
+
+async def sample_loop(name: str, registry, process):
+    """Periodic sampler actor: one delta sample of `registry` into the
+    CURRENT global hub per FDB_TPU_TIMESERIES_INTERVAL virtual seconds.
+    Read-only and rng-free, so spawning it perturbs no sim decision; it
+    re-reads the global hub each tick so a harness that swaps in a fresh
+    hub (soak, tests) starts collecting immediately."""
+    loop = process.network.loop
+    interval = max(0.05, float(g_env.get("FDB_TPU_TIMESERIES_INTERVAL")))
+    while True:
+        await loop.delay(interval)
+        global_timeseries().record(name, registry, now=loop.now())
+
+
+def spawn_sampler(process, name: str, registry):
+    """Spawn the sampler actor for one role registry unless disabled by
+    FDB_TPU_TIMESERIES=0.  Returns the task (or None when disabled)."""
+    if not timeseries_enabled():
+        return None
+    return process.spawn(sample_loop(name, registry, process), f"ts:{name}")

@@ -34,47 +34,64 @@ class Severity:
 
 
 class TraceCollector:
-    """Destination for trace events (per process or global)."""
+    """Destination for trace events (per process or global).
+
+    Both modes additionally keep a BOUNDED recent-events ring (deque,
+    maxlen = FDB_TPU_TRACE_RECENT at construction): the most recent N
+    emitted events, in order.  It is what `find()` searches on a
+    file-backed collector (the spool remains the durable record; the
+    ring is the diagnosable window) and what the flight recorder dumps
+    into incident captures."""
 
     def __init__(self, path: Optional[str] = None, min_severity: int = Severity.Info):
+        from collections import deque
+
+        from .knobs import g_env
+
         self.events: list[dict] = []
         self.path = path
         self.min_severity = min_severity
         self._fh = open(path, "a") if path else None  # fdblint: ignore[IO001]: trace spooling writes a real file by definition; sim tests use the in-memory collector (path=None)
         self.counts: dict[str, int] = {}
+        self.recent_maxlen = max(1, g_env.get_int("FDB_TPU_TRACE_RECENT"))
+        self.recent: deque = deque(maxlen=self.recent_maxlen)
 
     def emit(self, event: dict):
         if event["Severity"] < self.min_severity:
             return
         self.counts[event["Type"]] = self.counts.get(event["Type"], 0) + 1
+        self.recent.append(event)
         if self._fh:
             # File-backed: spool only, so long runs stay bounded in memory
-            # (the reference rolls trace files for the same reason).
+            # (the reference rolls trace files for the same reason); the
+            # bounded `recent` ring above is the only retention.
             self._fh.write(json.dumps(event) + "\n")
         else:
             self.events.append(event)
 
     def find(self, type_: str) -> list[dict]:
-        """Events of one type — IN-MEMORY collectors only.  A file-backed
-        collector spools events to disk without retaining them (see emit),
-        so `find` would silently return [] for events that were emitted;
-        raise instead of lying — query `counts` for per-type totals or
-        read the spool file."""
+        """Events of one type.  In-memory collectors search the full
+        retained list; file-backed collectors search the bounded
+        `recent` ring ONLY (the last FDB_TPU_TRACE_RECENT emitted
+        events) — an event older than the ring is on disk, not here, so
+        compare against `counts[type_]` when completeness matters."""
         if self.path is not None:
-            raise RuntimeError(
-                "TraceCollector.find() on a file-backed collector: events "
-                f"are spooled to {self.path!r}, not retained; use .counts "
-                "or read the file"
-            )
+            return [e for e in self.recent if e["Type"] == type_]
         return [e for e in self.events if e["Type"] == type_]
 
+    def recent_events(self) -> list[dict]:
+        """The bounded most-recent window (both modes, oldest first) —
+        the flight recorder's per-capture event dump."""
+        return list(self.recent)
+
     def clear(self):
-        """Reset the in-memory view (events + counts).  For file-backed
-        collectors this resets `counts` only; the spool file is an append
-        log and is deliberately left intact (clearing state must not
-        destroy the on-disk record)."""
+        """Reset the in-memory view (events + counts + recent ring).  For
+        file-backed collectors the spool file is an append log and is
+        deliberately left intact (clearing state must not destroy the
+        on-disk record)."""
         self.events.clear()
         self.counts.clear()
+        self.recent.clear()
 
     def close(self):
         if self._fh:

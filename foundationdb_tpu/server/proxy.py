@@ -187,6 +187,11 @@ class Proxy:
         process.spawn(
             emit_metrics(self.metrics, process), "proxy_metrics_emit"
         )
+        # Time-series sampler (ISSUE 10): bounded delta history of this
+        # proxy's registry into the global hub (flow/timeseries.py).
+        from ..flow.timeseries import spawn_sampler
+
+        spawn_sampler(process, self.metrics.name, self.metrics)
         self._last_batch_cut = process.network.loop.now()
         process.spawn(self._commit_batcher(), "proxy_batcher")
         # Always tick (not just multi-proxy): empty batches advance the

@@ -173,6 +173,13 @@ class CommitChainSampler:
         return p99
 
 
+# Construction-order ids (deterministic under the sim, unlike id()):
+# the flight-recorder cooldown key for concurrent distinct generations.
+import itertools
+
+_RK_SEQ = itertools.count()
+
+
 class Ratekeeper:
     def __init__(
         self,
@@ -189,6 +196,7 @@ class Ratekeeper:
         proxies: List[object] = (),  # Proxy role objects (in-process)
     ):
         self.process = process
+        self.rk_id = next(_RK_SEQ)
         self.tlogs = list(tlogs)
         self.storages = list(storages)
         self.tlog_ifaces = list(tlog_ifaces)
@@ -216,9 +224,23 @@ class Ratekeeper:
 
         self.sample_seq = 0
         self.transitions = deque(maxlen=4096)
+        # Admission telemetry registry (ISSUE 10): the rate decision and
+        # every spring input as gauges, sampled into the time-series ring
+        # so a flight-recorder capture shows what admission was doing in
+        # the window BEFORE a trigger — not just the post-incident rate.
+        from ..flow.metrics import MetricsRegistry
+        from ..flow.timeseries import spawn_sampler
+
+        self.metrics = MetricsRegistry("Ratekeeper", rng=process.network.loop.rng)
+        self.metrics.counter("limiting_changes")
+        for _g in ("tps", "batch_tps", "lag_versions", "ss_queue_bytes",
+                   "tlog_queue_bytes", "resolver_queue_depth",
+                   "grv_queue_depth", "commit_p99_ms", "resolve_p99_ms"):
+            self.metrics.gauge(_g)
         self._stream = RequestStream(process, "rk_get_rate", well_known=True)
         process.spawn(self._update_loop(), "rk_update")
         process.spawn(self._serve(), "rk_serve")
+        spawn_sampler(process, "Ratekeeper", self.metrics)
 
     # Proxies fetch at most every 0.1s (the GRV loop's fetch throttle);
     # several missed intervals means the proxy is gone, not slow.
@@ -477,6 +499,37 @@ class Ratekeeper:
                     [self.sample_seq, self.rate.limiting, limiting,
                      round(tps, 3)]
                 )
+                self.metrics.counter("limiting_changes").add()
+                # Flight-recorder trigger (ISSUE 10): the binding signal
+                # changed — freeze the window that explains why.  The
+                # per-kind cooldown keeps a flapping spring from churning
+                # the capture ring; "-> none" (release) never triggers.
+                if limiting != "none":
+                    from ..flow.flight_recorder import maybe_trigger
+
+                    maybe_trigger(
+                        "ratekeeper_limiting",
+                        detail={"from": self.rate.limiting, "to": limiting,
+                                "tps": round(tps, 3)},
+                        # Thunk: the (up to 4096-entry) log is copied only
+                        # for captures the cooldown lets through.
+                        transitions=lambda: [
+                            list(t) for t in self.transitions
+                        ],
+                        source=self.rk_id,  # per-generation cooldown
+                    )
+            g = self.metrics.gauge
+            g("tps").set(round(tps, 3))
+            g("batch_tps").set(round(batch_tps, 3))
+            g("lag_versions").set(sig.lag)
+            g("ss_queue_bytes").set(sig.ss_queue)
+            g("tlog_queue_bytes").set(sig.tlog_queue)
+            g("resolver_queue_depth").set(sig.resolver_queue)
+            g("grv_queue_depth").set(sig.grv_queue_depth)
+            # Milliseconds rounded: a gauge sampled into the time series
+            # should not carry float noise digits.
+            g("commit_p99_ms").set(round(sig.commit_p99 * 1e3, 3))
+            g("resolve_p99_ms").set(round(sig.resolve_p99 * 1e3, 3))
             self.rate = RateInfo(
                 tps=tps,
                 batch_tps=batch_tps,

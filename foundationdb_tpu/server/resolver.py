@@ -120,6 +120,18 @@ class Resolver:
         process.spawn(
             emit_metrics(self.metrics, process), "resolver_metrics_emit"
         )
+        # Time-series sampler actors (ISSUE 10): bounded delta history of
+        # this role's registry — and of the device engine's kernel
+        # telemetry when one is live — into the global hub, the window
+        # the flight recorder freezes on a trigger.
+        from ..flow.timeseries import spawn_sampler
+
+        spawn_sampler(process, self.metrics.name, self.metrics)
+        dev = getattr(self.conflicts, "_jax", None)
+        if dev is not None:
+            spawn_sampler(
+                process, f"JaxConflict.{process.name}", dev.metrics
+            )
         # Mirror consistency-check actor (ISSUE 9): periodically diff a
         # live mirror snapshot against the device's exported state;
         # confirmed divergence opens the breaker (ConflictSet.mirror_check

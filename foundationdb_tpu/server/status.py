@@ -168,6 +168,13 @@ def cluster_status(cluster) -> dict:
     if rsec is not None:
         cl["resolver"] = rsec
 
+    # Flight-recorder inventory (ISSUE 10): capture counts + the last
+    # trigger, never the artifacts themselves (`cli flightrec` dumps
+    # those).  Process-global, like the trace collector it spans.
+    from ..flow.flight_recorder import global_flight_recorder
+
+    cl["flight_recorder"] = global_flight_recorder().status_section()
+
     if storage is not None:
         cl["data"] = {
             "storage_version": storage.version.get(),

@@ -33,8 +33,14 @@ class CliProcessor:
         "getrangekeys": "getrangekeys <begin> [end] [limit] — keys only",
         "status": "status [json | --format=json] — cluster status "
         "(json form includes the resolver/tpu telemetry section)",
-        "metrics": "metrics [--format=json] — metrics-registry snapshots "
-        "(proxy/resolver counters, device kernel telemetry)",
+        "metrics": "metrics [--diff] [--format=json] — metrics-registry "
+        "snapshots (proxy/resolver counters, device kernel telemetry); "
+        "--diff prints counter/histogram deltas since the previous "
+        "metrics command instead of lifetime totals",
+        "flightrec": "flightrec [--format=json] — flight-recorder "
+        "captures (triggered black-box windows: time-series deltas, "
+        "recent trace events, transition logs); text form lists the "
+        "capture inventory, json dumps the artifacts",
         "mirror-check": "mirror-check [--format=json] — on-demand live "
         "diff of each resolver's CPU mirror snapshot against its device "
         "export (the consistency check the periodic resolver actor runs; "
@@ -441,14 +447,21 @@ class CliProcessor:
     async def _cmd_metrics(self, args):
         """Registry snapshots straight off the live roles (the `fdbcli
         status json` habit of reading counters, but for the ISSUE 2
-        metrics pipeline: proxy/resolver registries + kernel telemetry)."""
+        metrics pipeline: proxy/resolver registries + kernel telemetry).
+        `--diff` replaces the registry snapshots with counter/histogram
+        DELTAS against the previous metrics command (same math as the
+        time-series sampler, flow/timeseries.snapshot_delta) — lifetime
+        totals hide what changed in the last thirty seconds."""
         from ..server.status import role_objects
 
         doc: dict = {}
+        registries: dict = {}  # (section, name) -> registry snapshot
         for p in role_objects(self.cluster, "proxy"):
             m = getattr(p, "metrics", None)
             if m is not None:
-                doc.setdefault("proxies", {})[p.proxy_id] = m.snapshot()
+                snap = m.snapshot()
+                doc.setdefault("proxies", {})[p.proxy_id] = snap
+                registries[("proxies", p.proxy_id)] = snap
             stats = getattr(p, "stats", None)
             if stats is not None:
                 doc.setdefault("proxy_counters", {})[
@@ -457,14 +470,35 @@ class CliProcessor:
         for r in role_objects(self.cluster, "resolver"):
             m = getattr(r, "metrics", None)
             if m is not None:
-                doc.setdefault("resolvers", {})[r.process.name] = m.snapshot()
+                snap = m.snapshot()
+                doc.setdefault("resolvers", {})[r.process.name] = snap
+                registries[("resolvers", r.process.name)] = snap
             dm = getattr(getattr(r, "conflicts", None), "device_metrics", None)
             snap = dm() if callable(dm) else None
             if snap:
                 doc.setdefault("tpu", {})[r.process.name] = snap
-        if args and args[0] == "--format=json":
+                registries[("tpu", r.process.name)] = snap
+        diff = "--diff" in args
+        if diff:
+            from ..flow.timeseries import snapshot_delta
+
+            prev = getattr(self, "_metrics_prev", {})
+            for (section, name), snap in registries.items():
+                # Replace ONLY the registry keys (counters/gauges/
+                # histograms) with deltas; instantaneous diagnostic
+                # blocks (backend_state, breaker, mirror, tiers,
+                # programs, ...) are not lifetime totals and pass
+                # through unchanged — an operator diagnosing a degraded
+                # device must not lose them in the diff view.
+                delta = snapshot_delta(prev.get((section, name)), snap)
+                doc[section][name] = {**snap, **delta}
+        # Baseline for the NEXT --diff: every metrics command resets it,
+        # so two successive `metrics --diff` calls show the in-between
+        # window.
+        self._metrics_prev = registries
+        if "--format=json" in args:
             return json.dumps(doc, indent=2, default=str).splitlines()
-        lines = []
+        lines = ["(deltas since previous metrics command)"] if diff else []
         for section in sorted(doc):
             lines.append(f"{section}:")
             for name, snap in sorted(doc[section].items()):
@@ -476,6 +510,43 @@ class CliProcessor:
                     else:
                         lines.append(f"    {k} = {v}")
         return lines or ["(no metrics registries live)"]
+
+    async def _cmd_flightrec(self, args):
+        """Flight-recorder surface (ISSUE 10): list captures (text) or
+        dump the full artifacts (--format=json) from the process-global
+        recorder — the black-box record of breaker opens, mirror
+        divergence, and admission throttling."""
+        from ..flow.flight_recorder import global_flight_recorder
+
+        rec = global_flight_recorder()
+        if args and args[0] == "--format=json":
+            doc = {
+                "status": rec.status_section(),
+                "captures": list(rec.captures),
+            }
+            return json.dumps(doc, indent=2, default=str).splitlines()
+        if not rec.captures:
+            counts = rec.trigger_counts
+            return [
+                "flight recorder: no captures"
+                + (f" ({sum(counts.values())} triggers suppressed by "
+                   "cooldown)" if counts else "")
+            ]
+        lines = [
+            f"flight recorder: {len(rec.captures)} capture(s) retained "
+            f"({rec.capture_seq} lifetime)"
+        ]
+        for cap in rec.captures:
+            series = cap.get("timeseries", {})
+            n_samples = sum(len(s) for s in series.values())
+            lines.append(
+                f"  #{cap['capture_seq']} t={cap['time']:.3f} "
+                f"{cap['trigger']}: {len(series)} series / "
+                f"{n_samples} samples, "
+                f"{len(cap.get('recent_events', []))} trace events"
+                + (f", detail={cap['detail']}" if cap.get("detail") else "")
+            )
+        return lines
 
     async def _cmd_mirror_check(self, args):
         """On-demand mirror consistency check (ISSUE 9): run
@@ -808,6 +879,9 @@ def soak_artifact(report: dict) -> dict:
         "ratekeeper_transitions": report["ratekeeper"]["admission_log"],
         "breaker_transitions": report["breakers"],
         "slo": report["slo"],
+        "flight_recorder": report.get("flight_recorder", {}).get(
+            "status", {}
+        ),
     }
 
 

@@ -25,7 +25,11 @@ class SlowTaskWorkload(TestWorkload):
 
         loop = cluster.loop
         self._collector = global_collector()
-        self._before = len(self._collector.find("SlowTask"))
+        # Baseline on the COMPLETE per-type tally, not an index into
+        # find(): on a file-backed collector find() answers from the
+        # bounded recent ring, so index slicing would mis-slice once the
+        # ring rotates (flow/trace.py, ISSUE 10).
+        self._before = self._collector.counts.get("SlowTask", 0)
         old = loop.slow_task_threshold
         loop.slow_task_threshold = self.burn_wall_s / 4
         try:
@@ -42,8 +46,12 @@ class SlowTaskWorkload(TestWorkload):
             loop.slow_task_threshold = old
 
     async def check(self, db, cluster) -> bool:
+        n_new = self._collector.counts.get("SlowTask", 0) - self._before
+        assert n_new > 0, "slow-task profiler missed a deliberate reactor hog"
+        # The still-retained tail of the new events (all of them for an
+        # in-memory collector; the recent-ring remainder for file-backed).
         events = self._collector.find("SlowTask")
-        fresh = events[self._before:]
+        fresh = events[max(0, len(events) - n_new):]
         assert fresh, "slow-task profiler missed a deliberate reactor hog"
         assert any(
             e.get("wall_seconds", 0) >= self.burn_wall_s / 4

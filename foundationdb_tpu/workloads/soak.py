@@ -404,6 +404,20 @@ class SoakRun:
             else:
                 raise ValueError(f"unknown fault kind {ev.kind!r}")
 
+    async def _capture_fault_window(self, delay: float, kind: str, detail):
+        """Automatic per-fault-window flight-recorder capture (ISSUE 10):
+        freeze the telemetry window once the fault's hold has elapsed, so
+        the artifact contains the whole degraded window plus whatever
+        trigger captures (breaker open, ratekeeper throttle) fired inside
+        it.  Explicit capture — bypasses the trigger cooldown by design."""
+        from ..flow.flight_recorder import global_flight_recorder
+
+        if delay > 0:
+            await self.loop.delay(delay)
+        global_flight_recorder().capture(
+            f"fault_window:{kind}", detail=detail, now=self.loop.now()
+        )
+
     async def _fault_kill(self, ev: FaultEvent):
         """Process kill with the machine HELD DOWN for ev.duration, then
         revive: a sustained role outage, not a blink.  The CC's recovery
@@ -430,6 +444,7 @@ class SoakRun:
             await self.loop.delay(ev.duration)
         revive_worker(cluster, proc)
         self.fault_timeline.append([t, "kill", role, self.loop.now()])
+        await self._capture_fault_window(0.0, "kill", {"target": role})
 
     def _clog_endpoints(self):
         """(src, dst) machine ids for the one-directional clog: tlog ->
@@ -454,6 +469,14 @@ class SoakRun:
         self.fault_timeline.append(
             [t, "clog", f"{src}->{dst}", t + ev.duration]
         )
+        # The clog holds asynchronously; capture once its window closes
+        # (without stalling the fault driver's schedule).
+        self.db.process.spawn(
+            self._capture_fault_window(
+                ev.duration, "clog", {"pair": f"{src}->{dst}"}
+            ),
+            "soak_fault_capture",
+        )
 
     async def _fault_device_outage(self, ev: FaultEvent):
         """Persistent dispatch outage on ONE resolver's device engine: the
@@ -477,6 +500,9 @@ class SoakRun:
         inj.end_outage("dispatch")
         self.fault_timeline.append(
             [t, "device_outage", r.process.name, self.loop.now()]
+        )
+        await self._capture_fault_window(
+            0.0, "device_outage", {"resolver": r.process.name}
         )
 
     async def _admission_monitor(self):
@@ -533,6 +559,29 @@ class SoakRun:
             ok = goodput >= floor and (
                 chain_p99 is None or chain_p99 <= cfg.slo_commit_p99
             )
+            if not ok:
+                # SLO breach trigger (ISSUE 10): the fourth transition-log
+                # owner — a phase missing its goodput floor or p99 bound
+                # freezes the window, admission log attached.
+                from ..flow.flight_recorder import maybe_trigger
+
+                maybe_trigger(
+                    "slo_breach",
+                    detail={"phase": st.name,
+                            "goodput_tps": round(goodput, 3),
+                            "goodput_floor_tps": round(floor, 3),
+                            "commit_p99_chain": chain_p99,
+                            "commit_p99_bound": cfg.slo_commit_p99},
+                    # Thunk: copied only if the cooldown admits it.
+                    transitions=lambda: [
+                        list(e) for e in self.admission_log
+                    ],
+                    # report() evaluates every phase at ONE virtual
+                    # instant; a per-phase source keeps a second
+                    # breaching phase from being cooldown-swallowed by
+                    # the first.
+                    source=st.name,
+                )
             slo_ok = slo_ok and ok
             if chain_p99 is not None:
                 worst_p99 = max(worst_p99, chain_p99)
@@ -570,6 +619,9 @@ class SoakRun:
             for k in shed:
                 shed[k] += snap.get(k, 0)
         rk = self.current_ratekeeper()
+        from ..flow.flight_recorder import global_flight_recorder
+
+        _rec = global_flight_recorder()
         breakers = {}
         for r, cs in self._resolver_conflict_sets():
             if cs._breaker is not None:
@@ -620,6 +672,15 @@ class SoakRun:
                 "worst_phase_commit_p99": worst_p99 or None,
                 "ok": slo_ok,
             },
+            # The run's flight-recorder captures (ISSUE 10): fault-window
+            # artifacts + whatever triggers fired (breaker opens,
+            # ratekeeper throttles, SLO breaches).  run_soak installed a
+            # fresh recorder, so these are THIS run's only — and, like
+            # everything above, byte-identical across same-seed runs.
+            "flight_recorder": {
+                "status": _rec.status_section(),
+                "captures": [dict(c) for c in _rec.captures],
+            },
         }
 
 
@@ -645,6 +706,16 @@ def run_soak(config: SoakConfig) -> dict:
     in-memory trace collector (latency chains + determinism isolation)
     and restores every knob it touches."""
     from ..flow.eventloop import set_event_loop
+    from ..flow.flight_recorder import (
+        FlightRecorder,
+        global_flight_recorder,
+        set_global_flight_recorder,
+    )
+    from ..flow.timeseries import (
+        TimeSeriesHub,
+        global_timeseries,
+        set_global_timeseries,
+    )
     from ..flow.trace import TraceCollector, set_global_collector
 
     srv = g_knobs.server
@@ -660,6 +731,13 @@ def run_soak(config: SoakConfig) -> dict:
 
     old_col = global_collector()
     set_global_collector(TraceCollector())
+    # Fresh time-series hub + flight recorder (ISSUE 10): the soak's
+    # samplers and triggers must write into rings THIS run owns — both
+    # for the byte-identical replay gate and so the report's captures
+    # aren't polluted by an earlier run in the same process.
+    old_hub, old_rec = global_timeseries(), global_flight_recorder()
+    set_global_timeseries(TimeSeriesHub())
+    set_global_flight_recorder(FlightRecorder())
     try:
         # Sample every transaction: the soak's SLO gate IS the latency
         # chain, and the harness owns its own (fresh) collector.
@@ -693,6 +771,8 @@ def run_soak(config: SoakConfig) -> dict:
         srv.conflict_device_key_words = saved["key_words"]
         srv.conflict_max_device_key_bytes = saved["key_bytes"]
         set_global_collector(old_col)
+        set_global_timeseries(old_hub)
+        set_global_flight_recorder(old_rec)
         set_event_loop(None)
 
 

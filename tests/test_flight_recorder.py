@@ -1,0 +1,408 @@
+"""Time-series telemetry + flight recorder (ISSUE 10 tentpole): delta
+math, bounded rings, same-seed byte-identical windows and artifacts,
+trigger wiring through the breaker and the ratekeeper, and the
+status/CLI surfaces (`flightrec`, `metrics --diff`)."""
+
+import json
+
+import pytest
+
+from foundationdb_tpu.flow import set_event_loop
+from foundationdb_tpu.flow.flight_recorder import (
+    FlightRecorder,
+    artifact_json,
+    global_flight_recorder,
+    maybe_trigger,
+    set_global_flight_recorder,
+)
+from foundationdb_tpu.flow.knobs import g_env, g_knobs
+from foundationdb_tpu.flow.metrics import MetricsRegistry
+from foundationdb_tpu.flow.timeseries import (
+    TimeSeriesHub,
+    global_timeseries,
+    set_global_timeseries,
+    snapshot_delta,
+)
+from foundationdb_tpu.flow.trace import (
+    TraceCollector,
+    TraceEvent,
+    global_collector,
+    set_global_collector,
+)
+
+pytestmark = pytest.mark.metrics
+
+
+@pytest.fixture(autouse=True)
+def _fresh_globals():
+    """Every test runs against its own hub/recorder/collector and leaves
+    the process-globals as it found them."""
+    old_hub, old_rec, old_col = (
+        global_timeseries(),
+        global_flight_recorder(),
+        global_collector(),
+    )
+    set_global_timeseries(TimeSeriesHub())
+    set_global_flight_recorder(FlightRecorder())
+    set_global_collector(TraceCollector())
+    yield
+    set_global_timeseries(old_hub)
+    set_global_flight_recorder(old_rec)
+    set_global_collector(old_col)
+    set_event_loop(None)
+
+
+# ---------------------------------------------------------------------------
+# delta math + ring semantics
+# ---------------------------------------------------------------------------
+
+
+def test_snapshot_delta_counters_histograms_gauges():
+    reg = MetricsRegistry("X")
+    reg.counter("c").add(3)
+    reg.gauge("g").set(7)
+    reg.histogram("h").add(2.0)
+    s1 = reg.snapshot()
+    reg.counter("c").add(5)
+    reg.gauge("g").set(9)
+    reg.histogram("h").add(4.0)
+    reg.histogram("h").add(6.0)
+    s2 = reg.snapshot()
+    d = snapshot_delta(s1, s2)
+    assert d["counters"] == {"c": 5}
+    assert d["gauges"] == {"g": 9}  # gauges are values, not deltas
+    assert d["histograms"]["h"]["count"] == 2
+    assert d["histograms"]["h"]["sum"] == 10.0
+    # No baseline: the delta IS the total.
+    d0 = snapshot_delta(None, s2)
+    assert d0["counters"] == {"c": 8}
+    assert d0["histograms"]["h"]["count"] == 3
+
+
+def test_hub_ring_bound_and_source_change_reset():
+    hub = TimeSeriesHub(window=4)
+    reg = MetricsRegistry("R")
+    reg.counter("c")
+    for i in range(10):
+        reg.counter("c").add(1)
+        hub.record("R", reg, now=float(i))
+    ts = hub.series["R"]
+    assert len(ts.samples) == 4  # bounded
+    assert all(s["counters"]["c"] == 1 for s in ts.samples)  # deltas
+    # A DIFFERENT registry under the same name resets the baseline —
+    # no negative deltas against the predecessor's totals.
+    reg2 = MetricsRegistry("R")
+    reg2.counter("c").add(2)
+    s = hub.record("R", reg2, now=99.0)
+    assert s["counters"]["c"] == 2
+    assert ts.resets == 1 and len(ts.samples) == 1
+
+
+def test_wall_namespace_never_sampled():
+    hub = TimeSeriesHub(window=4)
+    reg = MetricsRegistry("W")
+    reg.record_wall("disp", 0.5)
+    s = hub.record("W", reg, now=1.0)
+    assert "wall" not in json.dumps(s)
+
+
+def test_window_json_byte_identical_for_same_inputs():
+    def build():
+        hub = TimeSeriesHub(window=8)
+        reg = MetricsRegistry("A")
+        for i in range(5):
+            reg.counter("n").add(i)
+            reg.histogram("h").add(float(i))
+            hub.record("A", reg, now=float(i))
+        return hub.window_json()
+
+    assert build() == build()
+
+
+# ---------------------------------------------------------------------------
+# recorder: capture shape, cooldown, bounded ring, determinism
+# ---------------------------------------------------------------------------
+
+
+def test_capture_contains_window_events_and_transitions():
+    hub = global_timeseries()
+    reg = MetricsRegistry("A")
+    reg.counter("n").add(1)
+    hub.record("A", reg, now=1.0)
+    TraceEvent("Incident").detail("k", 1).log(now=1.5)
+    rec = global_flight_recorder()
+    art = rec.capture(
+        "unit", detail={"why": "test"},
+        transitions=[[1, "ok", "degraded", "r"]], now=2.0,
+    )
+    assert art["trigger"] == "unit" and art["time"] == 2.0
+    assert art["timeseries"]["A"][0]["counters"]["n"] == 1
+    assert art["recent_events"][-1]["Type"] == "Incident"
+    assert art["transitions"] == [[1, "ok", "degraded", "r"]]
+    # Canonical bytes round-trip.
+    assert json.loads(artifact_json(art)) == art
+
+
+def test_trigger_cooldown_and_capture_ring_bound():
+    from foundationdb_tpu.flow.eventloop import EventLoop
+
+    set_event_loop(EventLoop(seed=1))  # cooldown needs a virtual clock
+    rec = FlightRecorder(max_captures=2, window=4, cooldown=5.0)
+    set_global_flight_recorder(rec)
+    assert maybe_trigger("kind_a") is not None
+    assert maybe_trigger("kind_a") is None  # inside cooldown (vt 0.0)
+    assert maybe_trigger("kind_b") is not None  # per-kind cooldowns
+    assert rec.trigger_counts == {"kind_a": 2, "kind_b": 1}
+    # Ring bound: explicit captures bypass the cooldown and rotate.
+    for i in range(5):
+        rec.capture(f"c{i}")
+    assert len(rec.captures) == 2
+    assert [c["trigger"] for c in rec.captures] == ["c3", "c4"]
+    assert rec.capture_seq == 7
+    sec = rec.status_section()
+    assert sec["captures"] == 2 and sec["last_capture"]["trigger"] == "c4"
+    # A transitions THUNK is resolved only for admitted captures.
+    resolved = []
+    art = rec.trigger("kind_c", transitions=lambda: resolved.append(1) or [[1]])
+    assert art["transitions"] == [[1]] and resolved == [1]
+    assert rec.trigger("kind_c", transitions=lambda: resolved.append(1)) is None
+    assert resolved == [1]  # suppressed trigger never built the copy
+    # Distinct SOURCES are distinct incidents, not a flap: each gets its
+    # own cooldown key (two breakers opening simultaneously must both
+    # be captured).
+    assert rec.trigger("kind_d", source=1) is not None
+    assert rec.trigger("kind_d", source=2) is not None
+    assert rec.trigger("kind_d", source=1) is None
+
+
+def test_trigger_cooldown_clock_edges():
+    from foundationdb_tpu.flow.eventloop import EventLoop
+
+    rec = FlightRecorder(max_captures=8, window=4, cooldown=5.0)
+    set_global_flight_recorder(rec)
+    # No loop set: no meaningful clock — triggers are never suppressed
+    # (real mode must not swallow the second incident forever).
+    assert maybe_trigger("k") is not None
+    assert maybe_trigger("k") is not None
+    # Virtual time RESTARTS (a new run in the same process): the old
+    # run's stamp must not suppress the new run's first incident.
+    loop = EventLoop(seed=1)
+    set_event_loop(loop)
+    loop._now = 300.0
+    assert maybe_trigger("k") is not None
+    set_event_loop(EventLoop(seed=2))  # fresh run, vt back to 0.0
+    assert maybe_trigger("k") is not None  # backwards stamp => capture
+    assert maybe_trigger("k") is None  # same-run cooldown still holds
+
+
+def test_flightrec_env_kill_switch(monkeypatch):
+    monkeypatch.setenv("FDB_TPU_FLIGHTREC", "0")
+    assert maybe_trigger("anything") is None
+    assert global_flight_recorder().captures.maxlen == 16
+    assert len(global_flight_recorder().captures) == 0
+
+
+def test_env_flags_registered():
+    """ENV001 satellite discipline: the ISSUE 10 flag family is declared
+    in g_env with defaults and help strings."""
+    decl = g_env.declared()
+    for name in (
+        "FDB_TPU_TIMESERIES", "FDB_TPU_TIMESERIES_INTERVAL",
+        "FDB_TPU_TIMESERIES_WINDOW", "FDB_TPU_TRACE_RECENT",
+        "FDB_TPU_FLIGHTREC", "FDB_TPU_FLIGHTREC_CAPTURES",
+        "FDB_TPU_FLIGHTREC_COOLDOWN", "FDB_TPU_FLIGHTREC_WINDOW",
+        "FDB_TPU_PROGRAM_COSTS",
+    ):
+        _default, help_ = decl[name]
+        assert help_ != "", name
+
+
+# ---------------------------------------------------------------------------
+# trigger wiring: breaker open captures the incident window
+# ---------------------------------------------------------------------------
+
+
+def _write_txns(i, n=1):
+    from foundationdb_tpu.conflict.types import TransactionConflictInfo as T
+
+    return [
+        T(read_snapshot=0,
+          write_ranges=[(b"%06d" % (100 * i + 2 * j),
+                         b"%06d" % (100 * i + 2 * j + 1))])
+        for j in range(n)
+    ]
+
+
+def test_breaker_open_triggers_capture_with_transition():
+    """The acceptance shape: a breaker open yields a capture whose window
+    contains the triggering transition, the surrounding time-series
+    deltas, and the recent trace events."""
+    from foundationdb_tpu.conflict.api import ConflictSet
+    from foundationdb_tpu.conflict.device_faults import DeviceFaultInjector
+
+    inj = DeviceFaultInjector()
+    cs = ConflictSet(backend="jax", fault_injector=inj)
+    hub = global_timeseries()
+    now = 100
+    for i in range(3):
+        cs._detect(_write_txns(i), now, 0)
+        hub.record("JaxConflict.unit", cs._jax.metrics, now=float(now))
+        now += 10
+    inj.begin_outage("dispatch")
+    for i in range(3, 7):
+        cs._detect(_write_txns(i), now, 0)
+        now += 10
+    inj.end_outage("dispatch")
+    rec = global_flight_recorder()
+    opens = [c for c in rec.captures if c["trigger"] == "breaker_open"]
+    assert len(opens) == 1
+    cap = opens[0]
+    # The triggering transition is IN the artifact...
+    assert cap["transitions"][-1][1:3] == ["ok", "degraded"]
+    assert cap["detail"]["reason"].startswith("threshold:")
+    # ...with the surrounding time-series deltas...
+    samples = cap["timeseries"]["JaxConflict.unit"]
+    assert samples and samples[0]["counters"]["batches"] >= 1
+    # ...and the recent trace events, including the state change itself.
+    assert any(
+        e["Type"] == "DeviceBackendStateChange"
+        for e in cap["recent_events"]
+    )
+    # A probe failure re-opening the circuit is NOT a fresh open trigger.
+    assert rec.trigger_counts.get("breaker_open", 0) == 1
+
+
+def test_breaker_open_artifacts_byte_identical_across_runs():
+    """Same-seed determinism at the unit level: two identical runs of
+    the scripted-outage scenario produce byte-identical artifacts."""
+
+    def run():
+        from foundationdb_tpu.conflict.api import ConflictSet
+        from foundationdb_tpu.conflict.device_faults import (
+            DeviceFaultInjector,
+        )
+        from foundationdb_tpu.flow.eventloop import EventLoop
+
+        # A loop must be set so trace events stamp VIRTUAL time (the
+        # wall fallback is for real-mode tools only; under simulation a
+        # loop always exists).
+        set_event_loop(EventLoop(seed=1))
+        set_global_timeseries(TimeSeriesHub())
+        set_global_flight_recorder(FlightRecorder())
+        set_global_collector(TraceCollector())
+        inj = DeviceFaultInjector()
+        inj.script("dispatch", at=4, persist=4)
+        cs = ConflictSet(backend="jax", fault_injector=inj)
+        now = 100
+        for i in range(8):
+            cs._detect(_write_txns(i), now, 0)
+            global_timeseries().record(
+                "JaxConflict.unit", cs._jax.metrics, now=float(now)
+            )
+            now += 10
+        return [
+            artifact_json(c) for c in global_flight_recorder().captures
+        ]
+
+    a, b = run(), run()
+    assert a and a == b
+
+
+# ---------------------------------------------------------------------------
+# cluster surfaces: sampler actors, status section, CLI commands
+# ---------------------------------------------------------------------------
+
+
+def _drive(c, db, cli, line):
+    return c.loop.run_until(
+        db.process.spawn(cli.run_command(line)), timeout_vt=60.0
+    )
+
+
+def test_cluster_samplers_status_and_cli():
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.server.status import cluster_status
+    from foundationdb_tpu.tools.cli import CliProcessor
+
+    saved = g_knobs.client.latency_sample_rate
+    g_knobs.client.latency_sample_rate = 1.0
+    try:
+        c = SimCluster(seed=5150)
+        db = c.database("fr")
+        cli = CliProcessor(c, db)
+
+        async def load():
+            for i in range(6):
+                tr = db.create_transaction()
+                tr.set(b"fr%02d" % i, b"v")
+                await tr.commit()
+            await c.loop.delay(3.0)  # > 2 sampler intervals
+
+        c.run_until(db.process.spawn(load(), "load"), timeout_vt=1000.0)
+        # Resolver + proxy sampler actors populated the hub.
+        hub = global_timeseries()
+        assert "Resolver.resolver" in hub.series
+        assert any(n.startswith("Proxy") for n in hub.series)
+        total_committed = sum(
+            s["counters"].get("committed", 0)
+            for s in hub.series["Resolver.resolver"].samples
+        )
+        assert total_committed >= 6  # deltas sum back to the total
+
+        # Status carries the recorder inventory.
+        sec = cluster_status(c)["cluster"]["flight_recorder"]
+        assert sec["captures"] == 0 and sec["last_capture"] is None
+
+        # cli flightrec: empty inventory, then a capture shows up.
+        assert _drive(c, db, cli, "flightrec")[0].startswith(
+            "flight recorder: no captures"
+        )
+        global_flight_recorder().capture(
+            "manual", detail={"via": "test"}, now=c.loop.now()
+        )
+        text = "\n".join(_drive(c, db, cli, "flightrec"))
+        assert "1 capture(s)" in text and "manual" in text
+        doc = json.loads(
+            "\n".join(_drive(c, db, cli, "flightrec --format=json"))
+        )
+        assert doc["status"]["captures"] == 1
+        assert doc["captures"][0]["trigger"] == "manual"
+        assert doc["captures"][0]["timeseries"]["Resolver.resolver"]
+
+        # metrics --diff: second call shows only the in-between window.
+        _drive(c, db, cli, "metrics")
+
+        async def one_more():
+            tr = db.create_transaction()
+            tr.set(b"frx", b"v")
+            await tr.commit()
+
+        c.run_until(db.process.spawn(one_more(), "m"), timeout_vt=500.0)
+        diff = json.loads(
+            "\n".join(_drive(c, db, cli, "metrics --diff --format=json"))
+        )
+        assert diff["resolvers"]["resolver"]["counters"]["committed"] == 1
+        # Non-registry keys pass through the diff view unchanged (the
+        # tpu section's backend_state/breaker/mirror blocks etc.).
+        assert diff["resolvers"]["resolver"]["name"] == "Resolver.resolver"
+        text = "\n".join(_drive(c, db, cli, "metrics --diff"))
+        assert text.startswith("(deltas since previous metrics command)")
+    finally:
+        g_knobs.client.latency_sample_rate = saved
+
+
+def test_timeseries_disabled_by_env(monkeypatch):
+    from foundationdb_tpu.server import SimCluster
+
+    monkeypatch.setenv("FDB_TPU_TIMESERIES", "0")
+    c = SimCluster(seed=5151)
+    db = c.database("off")
+
+    async def load():
+        tr = db.create_transaction()
+        tr.set(b"k", b"v")
+        await tr.commit()
+        await c.loop.delay(3.0)
+
+    c.run_until(db.process.spawn(load(), "load"), timeout_vt=1000.0)
+    assert global_timeseries().series == {}

@@ -517,30 +517,43 @@ def test_counter_rate_first_call_has_no_time_zero_skew():
     assert c.rate_since_last(55.0) == 0.0
 
 
-def test_file_backed_collector_find_raises_counts_survive(tmp_path):
+def test_file_backed_collector_find_uses_bounded_recent_ring(tmp_path, monkeypatch):
+    """ISSUE 10 satellite: a file-backed collector keeps a BOUNDED
+    recent-events ring (FDB_TPU_TRACE_RECENT), so find() works on the
+    recent window instead of raising; the spool stays the durable
+    record, memory stays bounded, clear() leaves the disk log intact."""
     from foundationdb_tpu.flow.trace import TraceCollector, TraceEvent
 
+    monkeypatch.setenv("FDB_TPU_TRACE_RECENT", "4")
     p = tmp_path / "trace.jsonl"
     col = TraceCollector(path=str(p))
-    TraceEvent("Spooled", collector=col).detail("k", 1).log(now=1.0)
-    TraceEvent("Spooled", collector=col).log(now=2.0)
-    # Spooled, not retained: find() must refuse rather than lie with [].
-    with pytest.raises(RuntimeError, match="spooled"):
-        col.find("Spooled")
-    assert col.counts["Spooled"] == 2
+    assert col.recent_maxlen == 4
+    for i in range(6):
+        TraceEvent("Spooled", collector=col).detail("i", i).log(now=float(i))
+    # find() answers from the recent window: only the last 4 of 6.
+    found = col.find("Spooled")
+    assert [e["i"] for e in found] == [2, 3, 4, 5]
+    # counts is still the COMPLETE tally — the window bound is visible.
+    assert col.counts["Spooled"] == 6
+    assert len(col.recent_events()) == 4
     col.close()
+    # The spool holds everything: retention on disk is not the ring's job.
     lines = [json.loads(ln) for ln in p.read_text().splitlines()]
-    assert [e["Type"] for e in lines] == ["Spooled", "Spooled"]
-    # clear() resets counts but leaves the on-disk record intact.
+    assert [e["i"] for e in lines] == list(range(6))
+    # clear() resets counts + ring but leaves the on-disk record intact.
     col2 = TraceCollector(path=str(p))
-    TraceEvent("More", collector=col2).log(now=3.0)
+    TraceEvent("More", collector=col2).log(now=7.0)
     col2.clear()
-    assert col2.counts == {}
+    assert col2.counts == {} and col2.recent_events() == []
+    assert col2.find("More") == []
     col2.close()
-    assert len(p.read_text().splitlines()) == 3
-    # In-memory collectors keep the symmetric find()/clear() behavior.
+    assert len(p.read_text().splitlines()) == 7
+    # In-memory collectors: find() stays FULL retention (events list),
+    # while the recent ring mirrors the bounded tail for the recorder.
     mem = TraceCollector()
-    TraceEvent("M", collector=mem).log(now=1.0)
-    assert len(mem.find("M")) == 1
+    for i in range(6):
+        TraceEvent("M", collector=mem).detail("i", i).log(now=float(i))
+    assert len(mem.find("M")) == 6
+    assert [e["i"] for e in mem.recent_events()] == [2, 3, 4, 5]
     mem.clear()
-    assert mem.find("M") == []
+    assert mem.find("M") == [] and mem.recent_events() == []

@@ -198,6 +198,87 @@ def test_tiered_steady_state_has_no_h_sized_work_outside_cond():
     assert out_sorts and max(out_sorts) < H_CAP
 
 
+# ---------------------------------------------------------------------------
+# 3. device program cost accounting (ISSUE 10)
+# ---------------------------------------------------------------------------
+
+
+def test_carried_buffer_bytes_match_capacity_shape_math():
+    """CPU-assertable pin: each entry point's REPORTED carried-buffer
+    byte accounting equals independent h_cap/d_cap arithmetic — a silent
+    footprint regression (a widened dtype, an extra carried buffer) must
+    fail here, no TPU needed.  arg_nbytes is pure shape math (no trace,
+    no compile)."""
+    from foundationdb_tpu.conflict.engine_jax import (
+        DEVICE_ENTRY_POINTS,
+        EP_D,
+        EP_H,
+        EP_KW1,
+    )
+
+    kw1 = EP_KW1  # already the key-words+1 (length-word) form
+    lmax = max(1, math.ceil(math.log2(EP_H)))
+    expected = {
+        # hkeys (kw1, H) u32 + hvers (H,) i32 + hcount + oldest scalars
+        "flat_step": 4 * kw1 * EP_H + 4 * EP_H + 4 + 4,
+        # + maxtab (lmax+1, H) i32 + delta tier (dkeys/dvers/dcount)
+        "tiered_step": (4 * kw1 * EP_H + 4 * EP_H + 4
+                        + 4 * (lmax + 1) * EP_H
+                        + 4 * kw1 * EP_D + 4 * EP_D + 4 + 4),
+        "compact_body": 0,  # inner body: donation/carry owned by the cond
+        "rebase_body": 4 * EP_H,
+        "grow_body": 4 * kw1 * EP_H,
+    }
+    for name, want in expected.items():
+        ep = DEVICE_ENTRY_POINTS[name]
+        got = sum(ep.carried_bytes().values())
+        assert got == want, (name, got, want)
+        # And every carried name is accounted individually.
+        assert set(ep.carried_bytes()) == set(ep.carried), name
+
+
+def test_program_cost_table_covers_every_entry_point():
+    """Acceptance gate (ISSUE 10): device_metrics()["programs"] has a
+    cost block for every DEVICE_ENTRY_POINTS entry — carried bytes,
+    memory_analysis allocation, FLOPs per batch — once the table is
+    computed (lazily; FDB_TPU_PROGRAM_COSTS makes it eager).  Compiles
+    each program once at its canonical trace shapes (cached for the
+    process)."""
+    from foundationdb_tpu.conflict.api import ConflictSet
+    from foundationdb_tpu.conflict.engine_jax import (
+        DEVICE_ENTRY_POINTS,
+        program_cost_table,
+    )
+
+    table = program_cost_table()
+    for name, ep in DEVICE_ENTRY_POINTS.items():
+        blk = table[name]
+        assert "error" not in blk, (name, blk)
+        assert blk["carried_bytes_total"] == sum(
+            ep.carried_bytes().values()
+        )
+        assert blk["memory"]["argument"] > 0, name
+        # The step programs do real arithmetic; pure data movement
+        # (grow) may legitimately report no flops.
+        if name in ("flat_step", "tiered_step", "compact_body"):
+            assert blk["flops_per_batch"] and blk["flops_per_batch"] > 0
+            assert blk["memory"]["temp"] > 0, name
+    # Deterministic blocks only: compile wall lives in the separate
+    # include_wall view (the record_wall discipline).
+    assert all("compile_wall_seconds" not in b for b in table.values())
+    wall = program_cost_table(include_wall=True)
+    assert wall["_compile_wall"]["count"] >= len(DEVICE_ENTRY_POINTS)
+    assert all(
+        "compile_wall_seconds" in wall[n] for n in DEVICE_ENTRY_POINTS
+    )
+    # The cached table now surfaces through the ConflictSet API.
+    cs = ConflictSet(backend="jax")
+    dm = cs.device_metrics()
+    assert set(DEVICE_ENTRY_POINTS) <= set(dm["programs"])
+    for blk in dm["programs"].values():
+        assert "compile_wall_seconds" not in blk
+
+
 def test_host_and_device_max_tables_agree():
     """The tiered engine's CARRIED base max-table is seeded host-side
     (numpy) and queried by range_max against the device-built layout;

@@ -435,3 +435,165 @@ def test_saturation_stays_inside_mvcc_window():
         assert stats["too_old"] <= 5, stats
     finally:
         g_knobs.server.ratekeeper_max_tps = old
+
+
+# ---------------------------------------------------------------------------
+# CommitChainSampler direct unit tests (ISSUE 10 satellite): the PR-7
+# incremental path — open-chain aging and abandoned-open horizon pruning —
+# previously exercised only indirectly through the cluster tests above.
+# ---------------------------------------------------------------------------
+
+
+def _commit_ev(loc, did, t):
+    return {"Type": "CommitDebug", "Location": loc, "ID": did, "Time": t}
+
+
+def _chain_fixture():
+    """A fresh in-memory global collector + sampler; returns (collector,
+    sampler, emit) with the old collector restored by the caller's
+    fixture-free try/finally (tests below use _with_collector)."""
+    from foundationdb_tpu.flow.trace import TraceCollector
+
+    return TraceCollector()
+
+
+def _with_collector(fn):
+    from foundationdb_tpu.flow.trace import (
+        global_collector,
+        set_global_collector,
+    )
+
+    old = global_collector()
+    col = _chain_fixture()
+    set_global_collector(col)
+    try:
+        fn(col)
+    finally:
+        set_global_collector(old)
+
+
+def test_chain_sampler_incremental_window_and_err_close():
+    from foundationdb_tpu.server.ratekeeper import CommitChainSampler
+
+    def scenario(col):
+        s = CommitChainSampler()
+        # Two completed chains: durations 1.0 and 3.0.
+        col.events += [
+            _commit_ev(s.FROM, "a", 10.0), _commit_ev(s.TO, "a", 11.0),
+            _commit_ev(s.FROM, "b", 10.0), _commit_ev(s.TO, "b", 13.0),
+        ]
+        assert s.sample() == 3.0
+        # Incremental: only NEW events are scanned; window accumulates.
+        col.events += [
+            _commit_ev(s.FROM, "c", 20.0), _commit_ev(s.TO, "c", 25.0),
+        ]
+        assert s.sample() == 5.0
+        assert s._cursor == len(col.events)
+        # A failed attempt closes its chain via .Error: it neither enters
+        # the completed window nor ages as an open chain.
+        col.events += [
+            _commit_ev(s.FROM, "fail", 30.0),
+            _commit_ev(s.ERR, "fail", 30.5),
+        ]
+        assert s.sample(now=100.0, horizon=1000.0) == 5.0
+        assert "fail" not in s._open
+
+    _with_collector(scenario)
+
+
+def test_chain_sampler_open_chain_ages_signal():
+    """A commit whose Before has no After IS the signal during a grey
+    failure: its age max-combines into the p99 while it is wedged, and
+    the signal releases the moment the chain completes."""
+    from foundationdb_tpu.server.ratekeeper import CommitChainSampler
+
+    def scenario(col):
+        s = CommitChainSampler()
+        col.events += [
+            _commit_ev(s.FROM, "x", 10.0), _commit_ev(s.TO, "x", 10.5),
+            _commit_ev(s.FROM, "wedged", 11.0),
+        ]
+        # Completed window alone says 0.5; the open chain is older.
+        assert s.sample(now=20.0, horizon=100.0) == 9.0
+        # Still wedged: the signal keeps growing with virtual time.
+        assert s.sample(now=31.0, horizon=100.0) == 20.0
+        # Without `now` there is no aging — pure completed-window p99.
+        assert s.sample() == 0.5
+        # The wedge resolves: back to the completed window (which now
+        # includes the long commit).
+        col.events.append(_commit_ev(s.TO, "wedged", 41.0))
+        assert s.sample(now=42.0, horizon=100.0) == 30.0
+
+    _with_collector(scenario)
+
+
+def test_chain_sampler_horizon_prunes_abandoned_opens():
+    """An abandoned chain (client killed mid-commit) cannot hold the
+    signal up forever: opens older than the horizon are pruned, and the
+    spring releases within one horizon of the stall resolving."""
+    from foundationdb_tpu.server.ratekeeper import CommitChainSampler
+
+    def scenario(col):
+        s = CommitChainSampler()
+        col.events += [
+            _commit_ev(s.FROM, "x", 10.0), _commit_ev(s.TO, "x", 10.5),
+            _commit_ev(s.FROM, "abandoned", 10.0),
+        ]
+        # Inside the horizon the open ages the signal...
+        assert s.sample(now=12.0, horizon=5.0) == 2.0
+        # ...past it the open is pruned: the signal RELEASES.
+        assert s.sample(now=16.0, horizon=5.0) == 0.5
+        assert "abandoned" not in s._open
+        # A late After for a pruned chain is ignored (its Before is
+        # gone), so it cannot inject a bogus 30s duration.
+        col.events.append(_commit_ev(s.TO, "abandoned", 40.0))
+        assert s.sample(now=41.0, horizon=5.0) == 0.5
+
+    _with_collector(scenario)
+
+
+def test_chain_sampler_open_map_bounded_and_collector_reset():
+    from foundationdb_tpu.server.ratekeeper import CommitChainSampler
+    from foundationdb_tpu.flow.trace import (
+        TraceCollector,
+        set_global_collector,
+    )
+
+    def scenario(col):
+        s = CommitChainSampler()
+        # >1024 never-resolving opens: the map drops to 512, oldest
+        # first, deterministically (insertion order).
+        col.events += [
+            _commit_ev(s.FROM, "d%04d" % i, float(i)) for i in range(1100)
+        ]
+        s.sample()
+        assert len(s._open) == 512
+        assert "d0000" not in s._open and "d1099" in s._open
+        # A swapped (or cleared) collector restarts the incremental scan
+        # instead of reading a stale cursor past the end.
+        col2 = TraceCollector()
+        set_global_collector(col2)
+        col2.events += [
+            _commit_ev(s.FROM, "n", 1.0), _commit_ev(s.TO, "n", 3.0),
+        ]
+        assert s.sample() == 2.0
+        assert len(s._open) == 0
+
+    _with_collector(scenario)
+
+
+def test_chain_sampler_returns_none_for_file_backed_collector(tmp_path):
+    from foundationdb_tpu.flow.trace import (
+        TraceCollector,
+        global_collector,
+        set_global_collector,
+    )
+    from foundationdb_tpu.server.ratekeeper import CommitChainSampler
+
+    old = global_collector()
+    set_global_collector(TraceCollector(path=str(tmp_path / "t.jsonl")))
+    try:
+        assert CommitChainSampler().sample(now=1.0, horizon=1.0) is None
+    finally:
+        global_collector().close()
+        set_global_collector(old)

@@ -138,6 +138,26 @@ def test_soak_device_outage_degrades_throttles_recovers():
     # Verdicts kept flowing on the CPU mirror: goodput never went to zero.
     assert rep["totals"]["committed"] > 0
     assert rep["totals"]["failed"] == 0 and rep["totals"]["exhausted"] == 0
+    # Flight recorder (ISSUE 10): the scripted breaker-open mid-soak
+    # yields a capture whose window contains the triggering transition,
+    # the surrounding time-series deltas, and the recent trace events;
+    # the fault window itself is captured automatically.
+    fr = rep["flight_recorder"]
+    triggers = [c["trigger"] for c in fr["captures"]]
+    assert "breaker_open" in triggers, triggers
+    assert "fault_window:device_outage" in triggers, triggers
+    cap = next(c for c in fr["captures"] if c["trigger"] == "breaker_open")
+    assert cap["transitions"][-1][1:3] == ["ok", "degraded"]
+    assert t0 <= cap["time"] <= t1 + 0.5, (cap["time"], t0, t1)
+    series = cap["timeseries"]
+    assert any(n.startswith("JaxConflict") for n in series), series.keys()
+    assert "Ratekeeper" in series and "Resolver.resolver" in series
+    dev = next(v for k, v in series.items() if k.startswith("JaxConflict"))
+    assert sum(s["counters"].get("batches", 0) for s in dev) > 0
+    assert any(
+        e["Type"] == "DeviceBackendStateChange" for e in cap["recent_events"]
+    ), [e["Type"] for e in cap["recent_events"]][-10:]
+    assert fr["status"]["captures"] == len(fr["captures"])
 
 
 def test_soak_overload_sheds_and_clients_recover():

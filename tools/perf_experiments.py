@@ -48,6 +48,27 @@ VARIANTS = list(bench.VARIANTS)
 
 
 def main():
+    if "--programs" in sys.argv:
+        # Device program cost accounting (ISSUE 10): compile every
+        # DEVICE_ENTRY_POINTS entry at its canonical trace shapes and
+        # dump the cost table — carried-buffer bytes, temp/output
+        # allocation, FLOPs per batch, compile wall — the baseline
+        # dataset Pallas-kernel PRs (ROADMAP item 1) are judged against.
+        # Runs anywhere (CPU analysis; no device needed).
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=8"
+        )
+        from foundationdb_tpu.conflict.engine_jax import program_cost_table
+
+        try:
+            # Optional: registers the sharded_step entry too.
+            import foundationdb_tpu.parallel.sharded_resolver  # noqa: F401
+        except Exception as e:  # noqa: BLE001 - optional entry; table notes the absence
+            print(json.dumps({"sharded_step_import": str(e)}),
+                  file=sys.stderr)
+        print(json.dumps(program_cost_table(include_wall=True), indent=2,
+                         sort_keys=True))
+        return
     if "--mirror" in sys.argv:
         # Host-side mirror A/B (ISSUE 9; bench.MIRROR_VARIANTS): no
         # device needed, runs anywhere — flat vs batched-snapshot mirror
