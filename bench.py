@@ -348,6 +348,130 @@ def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=3407872, window=WINDOW):
     return n_batches * per_batch / dt
 
 
+def bench_pipeline(rng, depth, n_batches=24, per_batch=65536,
+                   h_cap=3407872, window=WINDOW):
+    """Full resolve-loop throughput at pipeline depth `depth` (ISSUE 11):
+    per batch, host pack/encode + device dispatch + verdict readback +
+    authoritative-mirror apply_batch, through the production ConflictSet
+    pipeline (depth 1 == the synchronous resolve path — the before arm).
+    Unlike bench_jax (dispatch-only, unbounded pipelining, no mirror),
+    this prices the host phases the resolver actually pays per batch, so
+    the depth-2-vs-1 ratio is meaningful on ANY host: with JAX's async
+    dispatch the mirror apply of batch N-1 and the pack/encode of batch
+    N+1 run under device (or XLA-CPU) compute of batch N."""
+    from foundationdb_tpu.conflict.api import ConflictSet
+
+    prev = os.environ.get("FDB_TPU_PIPELINE_DEPTH")
+    os.environ["FDB_TPU_PIPELINE_DEPTH"] = str(depth)
+    try:
+        cs = ConflictSet(backend="jax", key_words=KEY_WORDS, h_cap=h_cap)
+    finally:
+        if prev is None:
+            os.environ.pop("FDB_TPU_PIPELINE_DEPTH", None)
+        else:
+            os.environ["FDB_TPU_PIPELINE_DEPTH"] = prev
+    warm = window + 2
+    streams = [
+        txns_from_packed(gen_packed(rng, per_batch, i, KEY_WORDS), per_batch)
+        for i in range(n_batches + warm)
+    ]
+    h_cap0 = cs._jax.h_cap
+
+    def run_one(i):
+        e = cs.pipeline_submit(streams[i], i + window, i)
+        while cs.pipeline_inflight > depth - 1:
+            cs.pipeline_complete_oldest()
+        return e
+
+    for i in range(warm):
+        run_one(i)
+    cs.pipeline_drain()
+    t0 = time.perf_counter()
+    entries = [run_one(warm + j) for j in range(n_batches)]
+    cs.pipeline_drain()
+    dt = time.perf_counter() - t0
+    assert all(e.done and not e.degraded for e in entries)
+    assert cs._jax.h_cap == h_cap0, "history grew mid-bench; raise h_cap"
+    return n_batches * per_batch / dt
+
+
+def _pipeline_phase_costs(rng, n_batches, per_batch, h_cap, window=WINDOW):
+    """Serialized per-phase wall costs at the same stream shape: the two
+    host phases the pipeline can hide (pack/encode, mirror apply) vs the
+    device step it cannot.  The decomposition that makes the depth-sweep
+    ratio auditable."""
+    from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+    from foundationdb_tpu.conflict.engine_jax import (
+        JaxConflictSet,
+        PackedBatch,
+    )
+
+    cs = JaxConflictSet(key_words=KEY_WORDS, h_cap=h_cap)
+    mirror = CpuConflictSet()
+    warm = window + 2
+    streams = [
+        txns_from_packed(gen_packed(rng, per_batch, i, KEY_WORDS), per_batch)
+        for i in range(n_batches + warm)
+    ]
+    encode_s = step_s = apply_s = 0.0
+    for i, txns in enumerate(streams):
+        t0 = time.perf_counter()
+        pb = PackedBatch.from_transactions(txns, KEY_WORDS)
+        t1 = time.perf_counter()
+        statuses = cs.detect_packed(pb, now=i + window, new_oldest_version=i)
+        t2 = time.perf_counter()
+        mirror.apply_batch(
+            txns, [int(s) for s in statuses[: len(txns)]],
+            now=i + window, new_oldest_version=i,
+        )
+        t3 = time.perf_counter()
+        if i >= warm:
+            encode_s += t1 - t0
+            step_s += t2 - t1
+            apply_s += t3 - t2
+    return {
+        "encode_ms_per_batch": round(1e3 * encode_s / n_batches, 2),
+        "device_step_ms_per_batch": round(1e3 * step_s / n_batches, 2),
+        "mirror_apply_ms_per_batch": round(1e3 * apply_s / n_batches, 2),
+        "overlappable_fraction": round(
+            (encode_s + apply_s) / max(1e-9, encode_s + step_s + apply_s), 3
+        ),
+    }
+
+
+def bench_pipeline_cpu(depths=(1, 2, 3), n_batches=30, per_batch=2500,
+                       h_cap=1 << 19):
+    """CPU-phase pipeline sweep (ISSUE 11 satellite; prices on any host,
+    tunnel or no tunnel): the resolve loop at the skipListTest stream
+    shape (2500-txn batches, 20M keyspace, 50-batch window) under each
+    depth, plus the serialized phase decomposition.  The acceptance
+    gate reads ratio_2v1."""
+    # Persistent compile cache (same dir as the device bench): the sweep
+    # compiles one shape per history mode; repeat runs are cache-warm.
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    out = {"shape": {"per_batch": per_batch, "n_batches": n_batches,
+                     "h_cap": h_cap, "window": WINDOW}}
+    out["phases_serialized"] = _pipeline_phase_costs(
+        np.random.default_rng(2024), n_batches, per_batch, h_cap
+    )
+    for d in depths:
+        rate = bench_pipeline(
+            np.random.default_rng(2024), d,
+            n_batches=n_batches, per_batch=per_batch, h_cap=h_cap,
+        )
+        out[f"pipeline{d}"] = {"txns_per_sec": round(rate, 1)}
+    if "pipeline1" in out and "pipeline2" in out:
+        out["ratio_2v1"] = round(
+            out["pipeline2"]["txns_per_sec"]
+            / out["pipeline1"]["txns_per_sec"], 3,
+        )
+    return out
+
+
 def emit(out, errors):
     """Print the full best-so-far result as one JSON line and flush, so a
     mid-run kill still leaves the best partial result on stdout (the driver
@@ -391,7 +515,14 @@ def device_phase_main():
     _log(f"device bench: 24 batches x 65536 txns, window=50, h_cap={h_cap} "
          "(first compile may take minutes on this 1-core host)...")
     rng = np.random.default_rng(2024)
-    res["jax_txns_per_sec"] = round(bench_jax(rng, h_cap=h_cap), 1)
+    depth_flag = os.environ.get("FDB_TPU_PIPELINE_DEPTH")
+    if depth_flag:
+        # Pipeline variants price the full resolve loop (ISSUE 11).
+        res["jax_txns_per_sec"] = round(
+            bench_pipeline(rng, int(depth_flag), h_cap=h_cap), 1
+        )
+    else:
+        res["jax_txns_per_sec"] = round(bench_jax(rng, h_cap=h_cap), 1)
     _log(f"device: {res['jax_txns_per_sec']:,.0f} txn/s")
     print(json.dumps(res), flush=True)
 
@@ -618,6 +749,15 @@ VARIANTS = [
     ),
     ("search2level", {"FDB_TPU_SEARCH": "2level"}, BASE_H_CAP),
     ("evict4", {"FDB_TPU_EVICT_EVERY": "4"}, BASE_H_CAP + 3 * 2 * 65536),
+    # Pipeline depth sweep (ISSUE 11): the FULL resolve loop (encode +
+    # dispatch + readback + mirror apply) via bench_pipeline — a
+    # depth-flagged variant runs that loop instead of the dispatch-only
+    # bench_jax, so the arm prices exactly what the resolver pays.
+    # pipeline1 is the synchronous before-arm; deeper arms overlap the
+    # host phases under device compute.
+    ("pipeline1", {"FDB_TPU_PIPELINE_DEPTH": "1"}, BASE_H_CAP),
+    ("pipeline2", {"FDB_TPU_PIPELINE_DEPTH": "2"}, BASE_H_CAP),
+    ("pipeline3", {"FDB_TPU_PIPELINE_DEPTH": "3"}, BASE_H_CAP),
 ]
 
 _VARIANT_FLAG_KEYS = (
@@ -626,6 +766,7 @@ _VARIANT_FLAG_KEYS = (
     "FDB_TPU_EVICT_EVERY",
     "FDB_TPU_HISTORY",
     "FDB_TPU_DELTA_CAP",
+    "FDB_TPU_PIPELINE_DEPTH",
     "BENCH_H_CAP",
 )
 

@@ -40,7 +40,11 @@ from __future__ import annotations
 from typing import List, Optional
 
 from ..flow.knobs import g_env, g_knobs
-from .device_faults import DeviceCircuitBreaker, DeviceFault
+from .device_faults import (
+    DeviceCircuitBreaker,
+    DeviceFault,
+    DeviceUnavailable,
+)
 from .engine_cpu import CpuConflictSet, FlatCpuConflictSet
 from .oracle import OracleConflictSet
 from .types import TransactionConflictInfo
@@ -62,6 +66,44 @@ class ConflictBatch:
 
     def detect_conflicts(self, now: int, new_oldest_version: int) -> List[int]:
         return self._cs._detect(self._txns, now, new_oldest_version)
+
+
+class InflightBatch:
+    """One batch in the double-buffered resolver pipeline (ISSUE 11).
+
+    Created by ConflictSet.pipeline_submit, completed — always in submit
+    order — by pipeline_complete_oldest / pipeline_drain or the breaker's
+    mid-pipeline mirror replay.  Callers poll `done` / read `statuses`
+    after driving completion (the resolver parks its actor on its own
+    _ParkedResolve future; bench and tests read the fields directly).
+    CPU-served batches come back pre-completed (the pipeline only parks
+    device work)."""
+
+    __slots__ = ("txns", "ticket", "now", "new_oldest_version",
+                 "statuses", "degraded")
+
+    def __init__(self, txns, ticket, now, new_oldest_version):
+        self.txns = txns
+        self.ticket = ticket
+        self.now = now
+        self.new_oldest_version = new_oldest_version
+        self.statuses: Optional[List[int]] = None
+        self.degraded = False
+
+    @classmethod
+    def completed(cls, statuses: List[int], degraded: bool = False):
+        e = cls(None, None, 0, 0)
+        e.statuses = statuses
+        e.degraded = degraded
+        return e
+
+    @property
+    def done(self) -> bool:
+        return self.statuses is not None
+
+    def _resolve(self, statuses: List[int], degraded: bool) -> None:
+        self.statuses = statuses
+        self.degraded = degraded
 
 
 class ConflictSet:
@@ -99,6 +141,14 @@ class ConflictSet:
         if backend == "oracle":
             self._oracle = OracleConflictSet(oldest_version)
         self._breaker: Optional[DeviceCircuitBreaker] = None
+        # Double-buffered pipeline (ISSUE 11): batches dispatched to the
+        # device and not yet synced, oldest first.  Depth 1 disables the
+        # pipelined path entirely (today's synchronous resolve); read at
+        # construction like the other engine-variant env flags.
+        from collections import deque as _deque
+
+        self.pipeline_depth = max(1, g_env.get_int("FDB_TPU_PIPELINE_DEPTH"))
+        self._pipe: "_deque[InflightBatch]" = _deque()
         if backend in ("jax", "hybrid"):
             from .engine_jax import JaxConflictSet  # lazy: jax import is heavy
 
@@ -112,7 +162,8 @@ class ConflictSet:
             for _c in ("device_faults", "breaker_opens", "breaker_probes",
                        "breaker_closes", "degraded_batches", "rehydrates",
                        "cpu_fallback_txns", "mirror_checks",
-                       "mirror_divergence", "mirror_mismatch_keys"):
+                       "mirror_divergence", "mirror_mismatch_keys",
+                       "pipeline_dispatches", "pipeline_replayed_batches"):
                 self._jax.metrics.counter(_c)  # pre-create: stable snapshots
             self._breaker = DeviceCircuitBreaker(metrics=self._jax.metrics)
             self._jax.fault_injector = fault_injector
@@ -190,6 +241,11 @@ class ConflictSet:
         ]
 
     def _detect(self, txns, now, new_oldest_version) -> List[int]:
+        if self._pipe:
+            # A synchronous detect with batches still parked in the
+            # pipeline (mixed-driver safety net): the mirror must be
+            # current before it can decide or absorb this batch.
+            self.pipeline_drain()
         if self.backend == "hybrid":
             return self._detect_hybrid(txns, now, new_oldest_version)
         if self.backend == "jax":
@@ -247,24 +303,7 @@ class ConflictSet:
         take_fresh = getattr(self._cpu, "take_fresh_chunks", None)
         try:
             if self._device_stale:
-                # Rehydrate: rebuild the device history (every boundary
-                # newer than oldest_version — older ones were evicted)
-                # from the mirror.  Snapshot handoff (ISSUE 9): the
-                # immutable MirrorSnapshot means a fault mid-probe can
-                # neither observe nor corrupt a half-mutated mirror, and
-                # the chunk encode cache makes the host work proportional
-                # to chunks changed since the last device sync (asserted
-                # via rehydrate_keys_encoded telemetry).  load_from can
-                # itself fault (grow) — a fault here fails the probe.
-                self._jax.load_from(
-                    snapshot() if snapshot is not None else self._cpu
-                )
-                if take_fresh is not None:
-                    # load_from just encoded every live chunk; the fresh
-                    # backlog from the degraded window is now moot.
-                    take_fresh()
-                self._breaker.note_rehydrate()
-                self._device_stale = False
+                self._rehydrate_from_mirror(snapshot, take_fresh)
             statuses = self._jax.detect(txns, now, new_oldest_version)
         except DeviceFault as e:
             self._breaker.on_failure(e)
@@ -284,6 +323,28 @@ class ConflictSet:
                 take_fresh() if take_fresh is not None else None,
             )
         return statuses
+
+    def _rehydrate_from_mirror(self, snapshot, take_fresh) -> None:
+        """Rebuild the device history (every boundary newer than
+        oldest_version — older ones were evicted) from the mirror, for
+        BOTH serve paths (_device_serve and _pipeline_dispatch — one
+        implementation so the probe semantics can never drift).
+        Snapshot handoff (ISSUE 9): the immutable MirrorSnapshot means a
+        fault mid-probe can neither observe nor corrupt a half-mutated
+        mirror, and the chunk encode cache makes the host work
+        proportional to chunks changed since the last device sync
+        (asserted via rehydrate_keys_encoded telemetry).  load_from can
+        itself fault (grow) — a fault here fails the probe (the caller's
+        except block handles it)."""
+        self._jax.load_from(
+            snapshot() if snapshot is not None else self._cpu
+        )
+        if take_fresh is not None:
+            # load_from just encoded every live chunk; the fresh backlog
+            # from the degraded window is now moot.
+            take_fresh()
+        self._breaker.note_rehydrate()
+        self._device_stale = False
 
     def _cpu_detect_fallback(self, txns, now, new_oldest_version):
         """CPU-mirror detect for a DEGRADED device-eligible batch, timed on
@@ -312,24 +373,30 @@ class ConflictSet:
         self._device_stale = True
         return self._cpu.detect(txns, now, new_oldest_version)
 
-    def _detect_hybrid(self, txns, now, new_oldest_version) -> List[int]:
+    def _hybrid_wants_device(self, txns, now) -> bool:
+        """Hybrid routing decision (+ its hysteresis state updates),
+        shared by the synchronous path and the pipelined path so the two
+        can never drift: True iff a device serve is due for this batch.
+        While device authority is held, sub-threshold batches still run
+        on device (dispatch on a warm small bucket beats a full history
+        transfer); only a sustained small streak flips authority back.
+        When this returns False the caller flips authority host-side and
+        marks the device stale (the CPU engine absorbs the batch)."""
         big = len(txns) >= g_knobs.server.conflict_device_min_batch
-        device_ok = self._device_eligible(txns, now)
-        attempted = False  # a device serve was due but faulted/open-circuit
-        if device_ok and self._authority == "jax":
-            # Already on device: run there even below the size threshold
-            # (device dispatch on a warm small bucket beats a full history
-            # transfer); only a sustained small streak flips authority back.
+        if not self._device_eligible(txns, now):
+            return False
+        if self._authority == "jax":
             self._small_streak = 0 if big else self._small_streak + 1
-            if self._small_streak < self.AUTHORITY_HYSTERESIS:
-                attempted = True
-                statuses = self._device_serve(txns, now, new_oldest_version)
-                if statuses is not None:
-                    return statuses
-        elif big and device_ok:
+            return self._small_streak < self.AUTHORITY_HYSTERESIS
+        if big:
             self._authority = "jax"
             self._small_streak = 0
-            attempted = True
+            return True
+        return False
+
+    def _detect_hybrid(self, txns, now, new_oldest_version) -> List[int]:
+        attempted = self._hybrid_wants_device(txns, now)
+        if attempted:
             statuses = self._device_serve(txns, now, new_oldest_version)
             if statuses is not None:
                 return statuses
@@ -344,6 +411,212 @@ class ConflictSet:
             # the mirror's throughput for admission control.
             return self._cpu_detect_fallback(txns, now, new_oldest_version)
         return self._cpu.detect(txns, now, new_oldest_version)
+
+    # -- double-buffered pipeline (ISSUE 11) ------------------------------
+    @property
+    def pipeline_inflight(self) -> int:
+        """Batches dispatched to the device and not yet synced."""
+        return len(self._pipe)
+
+    def pipeline_submit(self, txns, now, new_oldest_version) -> InflightBatch:
+        """Admit one batch into the double-buffered pipeline.
+
+        Device-routed batches are packed + dispatched WITHOUT syncing and
+        come back as a parked InflightBatch; the caller must complete
+        oldest entries (pipeline_complete_oldest) until pipeline_inflight
+        is back under its depth bound, and eventually drain the tail.
+        CPU-routed batches (host-only backend, hybrid small-batch
+        routing, ineligible keys, open circuit, or a dispatch fault)
+        first drain the pipeline — the mirror must be current before it
+        decides — and return pre-completed.  Routing and hysteresis
+        decisions are the exact ones the synchronous path makes
+        (_hybrid_wants_device / _device_eligible), so verdict streams are
+        bit-identical across depths."""
+        wants_device = False
+        if self._jax is not None and self.pipeline_depth > 1:
+            if self.backend == "jax":
+                wants_device = self._device_eligible(txns, now)
+            elif self.backend == "hybrid":
+                wants_device = self._hybrid_wants_device(txns, now)
+        if wants_device:
+            entry = self._pipeline_dispatch(txns, now, new_oldest_version)
+            if entry is not None:
+                return entry
+            # A device serve was due but the circuit is open or the
+            # dispatch faulted (in-flight batches are already replayed on
+            # the mirror): degraded CPU serve, measured for admission
+            # control — the synchronous path's exact fallback.
+            if self.backend == "hybrid" and self._authority == "jax":
+                self._authority = "cpu"
+                self._small_streak = 0
+            self._device_stale = True
+            statuses = self._cpu_detect_fallback(
+                txns, now, new_oldest_version
+            )
+            self.consume_degraded()  # folded into the entry's flag
+            return InflightBatch.completed(statuses, degraded=True)
+        if self._jax is not None and self.pipeline_depth > 1:
+            # Routing above chose the CPU (ineligible keys or hybrid
+            # small-batch): do the sync path's post-routing bookkeeping
+            # directly — going back through _detect would re-run routing
+            # and advance the hysteresis state twice for one batch.  The
+            # mirror must be current before it decides, hence the drain.
+            self.pipeline_drain()
+            if self.backend == "hybrid" and self._authority == "jax":
+                self._authority = "cpu"
+                self._small_streak = 0
+            self._device_stale = True
+            statuses = self._cpu.detect(txns, now, new_oldest_version)
+            return InflightBatch.completed(
+                statuses, degraded=self.consume_degraded()
+            )
+        # Depth 1 or host-only backend: the synchronous path decides,
+        # against a drained (current) mirror.
+        statuses = self._detect(txns, now, new_oldest_version)
+        return InflightBatch.completed(
+            statuses, degraded=self.consume_degraded()
+        )
+
+    def _pipeline_dispatch(
+        self, txns, now, new_oldest_version
+    ) -> Optional[InflightBatch]:
+        """One device dispatch under the breaker WITHOUT syncing — the
+        pipelined twin of _device_serve.  Returns the parked entry, or
+        None when the circuit is open or the dispatch faulted (the
+        in-flight tail is then already replayed on the mirror).  Injected
+        faults raise at the dispatch choke points BEFORE any device or
+        host state mutates, so the mirror replay decides every in-flight
+        batch against exactly the history it must be decided against."""
+        if not self._breaker.allows_device():
+            # An open circuit implies the opening fault already drained
+            # the pipeline; nothing can be parked here.
+            self._degraded_last = True
+            return None
+        snapshot = getattr(self._cpu, "snapshot", None)
+        take_fresh = getattr(self._cpu, "take_fresh_chunks", None)
+        try:
+            if self._device_stale:
+                # Rehydration needs the mirror current: a stale device
+                # means the mirror served the preceding batches, so the
+                # pipeline is empty (faults drain it; CPU routing drains
+                # before deciding).
+                assert not self._pipe, "rehydrating around parked batches"
+                self._rehydrate_from_mirror(snapshot, take_fresh)
+            ticket = self._jax.dispatch_txns(txns, now, new_oldest_version)
+        except DeviceFault as e:
+            self._breaker.on_failure(e)
+            self._device_stale = True
+            self._degraded_last = True
+            self._pipeline_replay_on_mirror()
+            return None
+        # NOTE: breaker.on_success is deferred to the SYNC
+        # (pipeline_complete_oldest) — on real hardware async failures
+        # surface at the readback, and crediting a success at dispatch
+        # would reset consecutive_failures before the batch is verified,
+        # keeping the circuit from ever opening on a sync-faulting device.
+        self._jax.metrics.counter("pipeline_dispatches").add()
+        entry = InflightBatch(txns, ticket, now, new_oldest_version)
+        self._pipe.append(entry)
+        return entry
+
+    def pipeline_complete_oldest(self) -> None:
+        """Sync + retire the OLDEST in-flight batch: block until its
+        device statuses are ready (later dispatches keep the device
+        busy behind it), apply its committed writes to the authoritative
+        mirror, and record the post-batch snapshot as the synced point
+        for cheap probe rehydration.  A fault surfacing at the sync (a
+        real async XLA failure) or a fixpoint divergence drains the
+        WHOLE pipeline onto the mirror instead — bit-identical verdicts
+        either way, device marked stale for the next submit."""
+        entry = self._pipe[0]
+        try:
+            statuses, diverged = self._jax.sync_ticket(entry.ticket)
+        except DeviceFault as e:
+            self._breaker.on_failure(e)
+            self._device_stale = True
+            self._degraded_last = True
+            self._pipeline_replay_on_mirror()
+            return
+        except Exception as e:  # real async XLA failure at the sync point
+            import jax as _jax_mod
+
+            if not isinstance(e, _jax_mod.errors.JaxRuntimeError):
+                raise  # a Python bug must crash loudly, not degrade
+            # site="sync": keep readback-time failures distinguishable
+            # from dispatch-time ones in the breaker's fault counters
+            # and transition reasons (incident triage).
+            fault = DeviceUnavailable(f"sync: {e}", site="sync")
+            self._breaker.on_failure(fault)
+            self._device_stale = True
+            self._degraded_last = True
+            self._pipeline_replay_on_mirror()
+            return
+        if diverged:
+            # The fixpoint left this batch undecided: detect_core left
+            # the device history UNCHANGED for it, so every later
+            # dispatch decided against stale history.  The mirror —
+            # current through the previous completion — re-decides this
+            # batch and the parked tail bit-identically; the next device
+            # submit rehydrates from the mirror snapshot.  Like the sync
+            # path's _fallback_cpu: no breaker involvement (the device
+            # answered, just not decisively) and NOT a degraded serve —
+            # depth 1 resolves the same batch as a normal success, and
+            # the reply's degraded tag must not depend on depth.
+            self._device_stale = True
+            self._pipeline_replay_on_mirror(degraded=False)
+            return
+        # The batch's verdicts are real only now: credit the breaker at
+        # the verified sync, never at dispatch (see _pipeline_dispatch).
+        self._breaker.on_success()
+        self._pipe.popleft()
+        statuses_list = [int(s) for s in statuses[: len(entry.txns)]]
+        self._cpu.apply_batch(
+            entry.txns, statuses_list, entry.now, entry.new_oldest_version
+        )
+        snapshot = getattr(self._cpu, "snapshot", None)
+        take_fresh = getattr(self._cpu, "take_fresh_chunks", None)
+        if snapshot is not None:
+            self._jax.note_synced(
+                snapshot(),
+                take_fresh() if take_fresh is not None else None,
+            )
+        entry._resolve(statuses_list, degraded=False)
+
+    def _pipeline_replay_on_mirror(self, degraded: bool = True) -> None:
+        """Drain every in-flight batch onto the authoritative mirror, in
+        order (the breaker's mid-pipeline fault path).  The mirror is
+        current through the last completed batch and the engines decide
+        identically by construction, so the replay is exact — the same
+        guarantee the synchronous fault path gives one batch, extended
+        to the parked tail.  `degraded` tags the entries' replies: True
+        for fault-driven replays (the sync path's degraded fallback),
+        False for fixpoint divergence (depth 1 serves that batch as a
+        normal success, and the reply's degraded tag must not depend on
+        depth)."""
+        while self._pipe:
+            entry = self._pipe.popleft()
+            if self._jax is not None:
+                self._jax.metrics.counter("pipeline_replayed_batches").add()
+            if degraded:
+                statuses = self._cpu_detect_fallback(
+                    entry.txns, entry.now, entry.new_oldest_version
+                )
+            else:
+                # Divergence replay: a by-design CPU re-decide, not a
+                # degraded serve — keep it out of the admission-control
+                # fallback window (cpu_mirror_tps honesty: the depth-1
+                # path's _fallback_cpu records neither).
+                statuses = self._cpu.detect(
+                    entry.txns, entry.now, entry.new_oldest_version
+                )
+            entry._resolve(statuses, degraded=degraded)
+        self._degraded_last = False  # per-entry flags carry it instead
+
+    def pipeline_drain(self) -> None:
+        """Complete every in-flight batch (idle flush / pre-CPU-serve
+        barrier / teardown)."""
+        while self._pipe:
+            self.pipeline_complete_oldest()
 
     def backend_signal(self) -> dict:
         """O(1) admission-control probe (ISSUE 8 satellite): the PR-3
@@ -386,6 +659,16 @@ class ConflictSet:
         if self._jax is None:
             return None
         m = self._jax.metrics
+        if self._pipe:
+            # Batches parked in the pipeline: the mirror is legitimately
+            # behind the device by exactly those batches' host applies —
+            # nothing to confirm until they complete.  O(1).  Direct
+            # callers (cli mirror-check) may hit this under load; the
+            # resolver's periodic check actor drains the pipeline first,
+            # so the guarantee-bearing path never starves.
+            report = {"status": "skipped", "reason": "pipeline_inflight"}
+            self._last_mirror_check = report
+            return report
         if self._device_stale or (
             self._breaker is not None and self._breaker.state != "ok"
         ):
@@ -506,6 +789,12 @@ class ConflictSet:
         if self._breaker is not None:
             snap["backend_state"] = self._breaker.state
             snap["breaker"] = self._breaker.snapshot()
+        # Pipeline facts (ISSUE 11): configured depth + current in-flight
+        # occupancy.  O(1) reads.
+        snap["pipeline"] = {
+            "depth": self.pipeline_depth,
+            "inflight": len(self._pipe),
+        }
         # Snapshot-mirror block (ISSUE 9): chunked-engine maintenance
         # facts + the last consistency-check report.  All O(1) reads.
         mirror: dict = {
@@ -540,6 +829,7 @@ class ConflictSet:
         return snap
 
     def clear(self, version: int):
+        self.pipeline_drain()  # parked verdicts must land before the wipe
         for eng in (self._cpu, self._jax, self._oracle):
             if eng is not None:
                 eng.clear(version)

@@ -100,6 +100,32 @@ def _unpack_transactions(pb: "PackedBatch") -> List[TransactionConflictInfo]:
     return txns
 
 
+class DispatchTicket:
+    """One in-flight dispatched batch (the double-buffered resolver
+    pipeline's device-side handle, ISSUE 11): the packed batch plus the
+    dispatch's device arrays — statuses/undecided/fixpoint-iteration
+    carry and the post-batch history counts.  Holding a ticket costs
+    nothing host-side; syncing it (JaxConflictSet.sync_ticket) blocks
+    only until ITS program finished, never on later dispatches (the
+    arrays are that program's own outputs, and device programs execute
+    in dispatch order)."""
+
+    __slots__ = ("pb", "statuses", "undecided", "iters", "hcount",
+                 "dcount", "d_cap", "now", "new_oldest_version")
+
+    def __init__(self, pb, statuses, undecided, iters, hcount, dcount,
+                 d_cap, now, new_oldest_version):
+        self.pb = pb
+        self.statuses = statuses
+        self.undecided = undecided
+        self.iters = iters
+        self.hcount = hcount
+        self.dcount = dcount
+        self.d_cap = d_cap  # delta capacity AT dispatch (may grow later)
+        self.now = now
+        self.new_oldest_version = new_oldest_version
+
+
 class PackedBatch:
     """Host-side (numpy) dense form of a transaction batch.
 
@@ -1099,6 +1125,22 @@ _blob_step = partial(
     donate_argnames=("hkeys", "hvers", "hcount", "oldest"),
 )(_blob_core)
 
+# Non-donated twins (ISSUE 11): identical jaxpr, XLA just cannot alias
+# the carried inputs into the outputs.  Donation stays the contract on
+# real accelerators (HBM is scarce; jaxcheck's JXP003 audit + the
+# committed fingerprints pin it on the DEVICE_ENTRY_POINTS wrappers
+# above) — but jaxlib's CPU runtime executes donated programs
+# SYNCHRONOUSLY (the dispatch blocks for the whole step, measured
+# ~full-step wall on jax 0.4.37), which would serialize the resolver
+# pipeline's dispatch and erase the mirror-apply/encode overlap.  The
+# CPU backend therefore dispatches through these twins; see
+# _use_donated_steps / FDB_TPU_DONATE.
+_blob_step_nodonate = partial(
+    jax.jit,
+    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "kw1",
+                     "amortized"),
+)(_blob_core)
+
 
 def _tiered_blob_core(hkeys, hvers, hcount, maxtab, dkeys, dvers, dcount,
                       oldest, blob, *, txn_cap, rr_cap, wr_cap, h_cap, d_cap,
@@ -1136,6 +1178,27 @@ _tiered_blob_step = partial(
     donate_argnames=("hkeys", "hvers", "hcount", "maxtab", "dkeys", "dvers",
                      "dcount", "oldest"),
 )(_tiered_blob_core)
+
+_tiered_blob_step_nodonate = partial(
+    jax.jit,
+    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "d_cap", "kw1"),
+)(_tiered_blob_core)
+
+
+def _use_donated_steps() -> bool:
+    """Whether runtime dispatch goes through the donated step wrappers.
+    FDB_TPU_DONATE=1 forces donation, =0 forces the non-donated twins,
+    default '' is platform-auto: donate everywhere except the CPU
+    backend, whose runtime turns donated dispatch synchronous (see the
+    _blob_step_nodonate comment).  Decision-identical either way."""
+    from ..flow.knobs import g_env
+
+    flag = g_env.get("FDB_TPU_DONATE")
+    if flag == "1":
+        return True
+    if flag == "0":
+        return False
+    return jax.default_backend() != "cpu"
 
 
 # ---------------------------------------------------------------------------
@@ -1582,6 +1645,9 @@ class JaxConflictSet:
         # gated by the differential suites under the flag — and the default
         # compile is untouched when the flag is unset (separate jit entry).
         self.history_mode = g_env.get("FDB_TPU_HISTORY")
+        # Donated vs non-donated step wrappers, decided once per engine
+        # (FDB_TPU_DONATE / platform-auto; see _use_donated_steps).
+        self._donate_steps = _use_donated_steps()
         self.tiered = self.history_mode == "tiered"
         self.compact_every = 0
         self.d_cap = 0
@@ -1919,6 +1985,13 @@ class JaxConflictSet:
         from ..flow.metrics import wall_now
 
         _t0 = wall_now()
+        tiered_step = (
+            _tiered_blob_step if self._donate_steps
+            else _tiered_blob_step_nodonate
+        )
+        flat_step = (
+            _blob_step if self._donate_steps else _blob_step_nodonate
+        )
         try:
             if self.tiered:
                 (
@@ -1933,7 +2006,7 @@ class JaxConflictSet:
                     statuses,
                     undecided,
                     iters,
-                ) = _tiered_blob_step(
+                ) = tiered_step(
                     self._hkeys,
                     self._hvers,
                     self._hcount,
@@ -1959,7 +2032,7 @@ class JaxConflictSet:
                     statuses,
                     undecided,
                     iters,
-                ) = _blob_step(
+                ) = flat_step(
                     self._hkeys,
                     self._hvers,
                     self._hcount,
@@ -2050,6 +2123,81 @@ class JaxConflictSet:
             # pathological batch (BASELINE.json's CPU-fallback requirement).
             return self._fallback_cpu(pb, now, new_oldest_version)
         return np.asarray(statuses)
+
+    # -- pipelined dispatch (ISSUE 11) --
+    def dispatch_txns(
+        self,
+        transactions: List[TransactionConflictInfo],
+        now: int,
+        new_oldest_version: int,
+    ) -> "DispatchTicket":
+        """Pack + dispatch one batch WITHOUT syncing: the pipelined twin
+        of detect().  Returns a DispatchTicket whose device arrays become
+        ready when THIS batch's program finishes — later dispatches keep
+        the device busy behind it.  The carried history advances on
+        device in dispatch order, so a ticket's successor already decides
+        against this batch's committed writes (commit-order exactness);
+        only the host-side sync/mirror work is deferred to sync_ticket."""
+        mt, mr, mw = self.bucket_mins
+        pb = PackedBatch.from_transactions(
+            transactions, self.key_words, min_txn=mt, min_rr=mr, min_wr=mw
+        )
+        statuses, undecided = self.dispatch_packed(pb, now, new_oldest_version)
+        # COPY the carried count scalars: the carried arrays themselves
+        # are donated into the next dispatch (reading them after a
+        # successor dispatches would hit a deleted buffer); statuses/
+        # undecided/iters are per-dispatch outputs, never re-donated.
+        return DispatchTicket(
+            pb=pb,
+            statuses=statuses,
+            undecided=undecided,
+            iters=self._last_iters_dev,
+            hcount=jnp.add(self._hcount, 0),
+            dcount=jnp.add(self._dcount, 0) if self.tiered else None,
+            d_cap=self.d_cap,
+            now=now,
+            new_oldest_version=new_oldest_version,
+        )
+
+    def sync_ticket(self, ticket: "DispatchTicket"):
+        """Sync ONE in-flight dispatch: blocks until the ticket's program
+        finished (not on later dispatches — its arrays are that program's
+        own outputs) and performs detect_packed's per-batch telemetry.
+        Returns (statuses ndarray [txn_cap], diverged): diverged=True
+        means the fixpoint left this batch undecided — detect_core left
+        the device history UNCHANGED for it, so every later dispatch
+        decided against stale history; the caller (ConflictSet's
+        pipeline) must re-decide this batch and the parked tail on the
+        authoritative mirror and mark the device stale.  Unlike
+        detect_packed, host capacity bounds are NOT tightened here:
+        later batches may already be dispatched, so the additive upper
+        bounds must stand."""
+        iters = int(ticket.iters)
+        self.last_iters = iters
+        m = self.metrics
+        m.counter("fixpoint_rounds").add(iters)
+        m.histogram("fixpoint_rounds_per_batch").add(iters)
+        if self.tiered:
+            base_n, delta_n = int(ticket.hcount), int(ticket.dcount)
+            m.gauge("boundary_count").set(base_n + delta_n - 1)
+            m.gauge("base_boundaries").set(base_n)
+            m.gauge("delta_boundaries").set(delta_n)
+            # Against the ticket's d_cap, not self.d_cap: a later
+            # dispatch may have grown the delta tier mid-pipeline.
+            m.histogram("delta_occupancy_synced").add(
+                delta_n / ticket.d_cap
+            )
+        else:
+            m.gauge("boundary_count").set(int(ticket.hcount))
+        if int(ticket.undecided) != 0:
+            from ..flow.trace import TraceEvent
+
+            m.counter("cpu_fallbacks").add()
+            TraceEvent("ConflictFixpointDiverged", severity=30).detail(
+                "n_txn", ticket.pb.n_txn
+            ).detail("now", ticket.now).detail("pipelined", 1).log()
+            return None, True
+        return np.asarray(ticket.statuses), False
 
     def _fallback_cpu(self, pb: PackedBatch, now: int, new_oldest_version: int):
         from ..flow.trace import TraceEvent

@@ -178,6 +178,18 @@ class ServerKnobs(Knobs):
         # wall-clock->version sample cadence and retained history bound.
         self._init("time_keeper_delay", 2.0)
         self._init("time_keeper_max_entries", 4096)
+        # Pipelined resolver (ISSUE 11): how long a dispatched batch may
+        # stay parked (virtual seconds) waiting for a successor to push it
+        # out of the double buffer before the owner drains it itself — the
+        # idle-tail flush that bounds reply latency when traffic pauses.
+        # Sized a little above commit_transaction_batch_interval so steady
+        # proxy traffic keeps the pipeline occupied across arrivals.
+        self._init("resolver_pipeline_flush_seconds", 0.005)
+        # Consecutive flush-drained (host-stalled) batches before the
+        # resolver freezes a flight-recorder artifact: a pipeline that is
+        # ON but achieving zero overlap for this many batches in a row is
+        # a perf incident worth a black box (cooldown-gated per resolver).
+        self._init("resolver_pipeline_stall_batches", 12)
 
 
 class KnobSet:
@@ -334,6 +346,27 @@ g_env.declare("FDB_TPU_FLIGHTREC_COOLDOWN", "5.0",
 g_env.declare("FDB_TPU_FLIGHTREC_WINDOW", "64",
               help="time-series samples and trace events included per "
                    "capture (the last-N window of each)")
+# Double-buffered async resolver pipeline (ISSUE 11): overlap the host
+# phases (mirror apply of batch N-1, pack/encode of batch N+1) with
+# device compute of batch N.
+g_env.declare("FDB_TPU_DONATE", "",
+              help="carried-buffer donation in the conflict step "
+                   "programs: '' auto (donate everywhere except the CPU "
+                   "backend, whose runtime executes donated programs "
+                   "synchronously and would serialize the pipeline's "
+                   "dispatch), '1' force donation, '0' force the "
+                   "non-donated twins.  Decision-identical either way; "
+                   "the jaxcheck donation audit + fingerprints pin the "
+                   "DEVICE_ENTRY_POINTS (donated) wrappers regardless")
+g_env.declare("FDB_TPU_PIPELINE_DEPTH", "2",
+              help="resolver pipeline depth: max batches dispatched to "
+                   "the device and not yet synced.  1 = today's fully "
+                   "synchronous resolve path; 2 (default) = double "
+                   "buffering — while the device resolves batch N the "
+                   "host applies batch N-1's verdicts to the mirror and "
+                   "encodes batch N+1.  Verdict streams are bit-identical "
+                   "across depths (the device history advances in commit "
+                   "order either way; only host-side work is deferred)")
 g_env.declare("FDB_TPU_PROGRAM_COSTS", "",
               help="truthy: device_metrics()/status tpu eagerly compile "
                    "+ cost-account every DEVICE_ENTRY_POINTS program "

@@ -8,6 +8,16 @@ reply :240-256) and state-transaction retention for the other proxies
 (`recentStateTransactions` :170-190).  The conflict backend is pluggable
 (conflict.api.ConflictSet): "cpu", "jax", "hybrid", or a mesh-sharded set
 from parallel/ — the north-star swap point (BASELINE.json).
+
+Async offload (ISSUE 11; ref: Resolver.actor.cpp's pipelined
+yieldedFuture resolve loop): with a device backend and
+FDB_TPU_PIPELINE_DEPTH > 1, a batch's device dispatch advances the
+prevVersion chain immediately and its host-side completion (verdict
+sync, mirror apply, reply) is deferred into a bounded double buffer —
+while the device resolves batch N, the host applies batch N-1's
+verdicts to the chunked mirror and packs/encodes batch N+1.  Verdict
+streams are bit-identical to the synchronous path (depth 1): the
+carried device history advances in commit order either way.
 """
 
 from __future__ import annotations
@@ -45,6 +55,42 @@ class _ProxyInfo:
     outstanding: Dict[int, ResolveTransactionBatchReply] = field(
         default_factory=dict
     )
+
+
+class _ParkedResolve:
+    """Resolver-side context of one batch parked in the double-buffered
+    pipeline (ISSUE 11): everything the completion phase — verdict
+    bookkeeping, state-txn retention, reply — needs, carried from the
+    submit phase.  Completions run strictly in version order (the deque
+    order), by whichever handler drives the pump."""
+
+    __slots__ = ("entry", "req", "reply", "first_unseen", "t_enter",
+                 "finished", "_promise")
+
+    def __init__(self, entry, req, reply, first_unseen: int, t_enter: float):
+        self.entry = entry
+        self.req = req
+        self.reply = reply
+        self.first_unseen = first_unseen
+        self.t_enter = t_enter
+        self.finished = False
+        self._promise = None
+
+    @property
+    def future(self):
+        """Fires when this context's resolve is FINISHED (reply sent)."""
+        if self._promise is None:
+            from ..flow.future import Promise
+
+            self._promise = Promise()
+            if self.finished:
+                self._promise.send(None)
+        return self._promise.future
+
+    def _mark_finished(self):
+        self.finished = True
+        if self._promise is not None and not self._promise.is_set():
+            self._promise.send(None)
 
 
 class Resolver:
@@ -113,6 +159,23 @@ class Resolver:
 
         self._recent_resolve = deque(maxlen=64)
         self.metrics.gauge("queue_depth").set(0)
+        # Double-buffered pipeline (ISSUE 11): contexts of batches
+        # dispatched to the device whose host-side completion (verdict
+        # sync, mirror apply, reply) is deferred, oldest first.  Active
+        # only when the conflict set supports pipelining and
+        # FDB_TPU_PIPELINE_DEPTH > 1; depth 1 keeps today's synchronous
+        # path bit-for-bit.
+        self._pipe_ctx = deque()
+        self._flush_streak = 0  # consecutive idle-flush completions
+        self._pipeline_on = (
+            getattr(self.conflicts, "pipeline_depth", 1) > 1
+            and callable(getattr(self.conflicts, "pipeline_submit", None))
+            and getattr(self.conflicts, "_jax", None) is not None
+        )
+        self.metrics.gauge("pipeline_occupancy").set(0)
+        for _c in ("pipeline_device_stalls", "pipeline_host_stalls"):
+            self.metrics.counter(_c)  # pre-create: snapshots list them all
+        self.metrics.histogram("pipeline_inflight_depth")
         process.spawn(self._serve(), "resolver")
         process.spawn(self._serve_metrics(), "resolver_metrics")
         process.spawn(self._serve_split(), "resolver_split")
@@ -201,10 +264,17 @@ class Resolver:
         """Run ConflictSet.mirror_check() every `period` virtual seconds.
         The check itself is synchronous (no await inside), so it can
         never observe a half-applied batch; a host-only backend returns
-        None on the first call and the actor retires."""
+        None on the first call and the actor retires.  Parked pipelined
+        batches are completed first (ISSUE 11): under sustained traffic
+        the double buffer holds an entry almost always, and the
+        divergence checker must not starve behind it — the drain just
+        finishes deferred host work (replies included) a little early,
+        in order, so it is always safe."""
         loop = self.process.network.loop
         while True:
             await loop.delay(period)
+            if self._pipe_ctx:
+                self._pipeline_pump(0, "drain")
             if self.conflicts.mirror_check() is None:
                 return  # no device engine behind this conflict set
 
@@ -356,9 +426,25 @@ class Resolver:
         await self.version.when_at_least(req.prev_version)
         if self.version.get() != req.prev_version:
             # Duplicate/replayed batch (proxy retry after timeout): answer
-            # from the per-proxy reply cache (ref :240-256).
+            # from the per-proxy reply cache (ref :240-256).  The chain
+            # advances at DISPATCH in pipelined mode, so the original may
+            # still be parked — wait out its completion, then the cache
+            # has the reply.
             pinfo = self._proxy_info.get(req.proxy_id)
             cached = pinfo.outstanding.get(req.version) if pinfo else None
+            if cached is None:
+                parked = next(
+                    (c for c in self._pipe_ctx
+                     if c.req.proxy_id == req.proxy_id
+                     and c.req.version == req.version),
+                    None,
+                )
+                if parked is not None:
+                    await parked.future
+                    pinfo = self._proxy_info.get(req.proxy_id)
+                    cached = (
+                        pinfo.outstanding.get(req.version) if pinfo else None
+                    )
             if cached is not None:
                 self.metrics.counter("cache_hits").add()
                 reply.send(cached)
@@ -378,13 +464,21 @@ class Resolver:
         first_unseen = pinfo.last_version + 1
         pinfo.last_version = req.version
 
-        conflicts = self._cpu_takeover or self.conflicts
-        batch = conflicts.new_batch() if self._cpu_takeover is None else None
         for tr in req.transactions:
-            if batch is not None:
-                batch.add_transaction(tr)
             self._sample(tr)
         window = g_knobs.server.max_write_transaction_life_versions
+        if self._pipeline_on and self._cpu_takeover is None:
+            # ISSUE 11: the double-buffered async offload path (ref: the
+            # pipelined yieldedFuture resolve loop of Resolver.actor.cpp).
+            await self._resolve_pipelined(
+                req, reply, first_unseen, t_enter, window
+            )
+            return
+        conflicts = self._cpu_takeover or self.conflicts
+        batch = conflicts.new_batch() if self._cpu_takeover is None else None
+        if batch is not None:
+            for tr in req.transactions:
+                batch.add_transaction(tr)
         degraded = False
         if batch is not None:
             from ..conflict.device_faults import DeviceFault
@@ -410,11 +504,31 @@ class Resolver:
         consume = getattr(conflicts, "consume_degraded", None)
         if consume is not None and consume():
             degraded = True
+        # version.set before the shared completion (the pipelined path
+        # sets it at dispatch): NotifiedVersion wakes waiters through the
+        # loop's ready queue, never synchronously, so no actor can
+        # interleave before this handler's reply either way.
+        self.version.set(req.version)
+        self._complete_resolve(
+            req, reply, statuses, degraded, first_unseen, t_enter
+        )
+
+    def _complete_resolve(
+        self, req, reply, statuses, degraded: bool, first_unseen: int,
+        t_enter: float,
+    ):
+        """Post-verdict completion shared by the synchronous path and the
+        pipeline's _finish_resolve — verdict accounting, state-txn
+        retention + reply-cache insert, GC, trace, the latency window,
+        and the reply itself live in ONE place so the two paths can
+        never drift."""
+        from ..conflict.types import CONFLICT, TOO_OLD
+        from ..flow.trace import trace_batch
+
+        m = self.metrics
         if degraded:
-            self.metrics.counter("degraded_batches").add()
-            self.metrics.histogram("degraded_batch_size").add(
-                len(req.transactions)
-            )
+            m.counter("degraded_batches").add()
+            m.histogram("degraded_batch_size").add(len(req.transactions))
             trace_batch(
                 "CommitDebug",
                 "Resolver.resolveBatch.DegradedRetry",
@@ -424,9 +538,6 @@ class Resolver:
         # Feed the registry: batch size + per-verdict counts (the conflict
         # rate "The Transactional Conflict Problem" trades against
         # throughput).
-        from ..conflict.types import CONFLICT, TOO_OLD
-
-        m = self.metrics
         m.counter("batches").add()
         m.counter("transactions").add(len(statuses))
         m.histogram("batch_size").add(len(statuses))
@@ -449,6 +560,7 @@ class Resolver:
                 if first_unseen <= v < req.version
             ],
         )
+        pinfo = self._proxy_info[req.proxy_id]
         pinfo.outstanding[req.version] = out
 
         # GC retained state txns below every proxy's lastVersion — only once
@@ -456,16 +568,147 @@ class Resolver:
         # (ref :196-218 requiring proxyInfoMap complete).
         if len(self._proxy_info) >= self.n_proxies:
             oldest = min(p.last_version for p in self._proxy_info.values())
+            # last_version advances at SUBMIT in pipelined mode, so a
+            # still-parked batch may have bumped its proxy past state txns
+            # its own reply (built at completion) still needs: clamp the
+            # GC below the oldest parked context's first_unseen.
+            # Retaining longer is always safe; _pipe_ctx is empty on the
+            # synchronous path, where bump, reply build, and GC run with
+            # no await between them.
+            if self._pipe_ctx:
+                oldest = min(
+                    oldest,
+                    min(c.first_unseen for c in self._pipe_ctx) - 1,
+                )
             for v in [v for v in self._recent_state_txns if v <= oldest]:
                 del self._recent_state_txns[v]
 
-        self.version.set(req.version)
         trace_batch("CommitDebug", "Resolver.resolveBatch.After", req.debug_id)
         # Resolve latency (arrival -> reply, virtual seconds): the sliding
         # window the ratekeeper's resolve_latency spring reads, plus the
         # cumulative histogram for status/metrics.  Real resolves only —
-        # cache-hit/stale replies above return early and never dilute it.
+        # cache-hit/stale replies never reach here and never dilute it.
         dt = self.process.network.loop.now() - t_enter
         self._recent_resolve.append(dt)
-        self.metrics.histogram("resolve_seconds").add(dt)
+        m.histogram("resolve_seconds").add(dt)
         reply.send(out)
+
+    # -- double-buffered pipeline (ISSUE 11) ------------------------------
+    async def _resolve_pipelined(
+        self, req, reply, first_unseen: int, t_enter: float, window: int
+    ):
+        """The async offload path: admit the batch into the conflict
+        set's pipeline and advance the prevVersion chain at DISPATCH —
+        the carried device history advances in commit order on device,
+        so batch N+1's phase-1 searches already see batch N's committed
+        writes while only N's host-side work (verdict sync, mirror
+        apply, reply) is deferred.  Completions run strictly in version
+        order: a successor's submit pushes the oldest out once the
+        pipeline exceeds its depth bound (its sync overlaps OUR device
+        compute, its mirror apply runs under it too), and the idle
+        flush drains the tail when traffic pauses."""
+        entry = self.conflicts.pipeline_submit(
+            req.transactions, req.version, req.version - window
+        )
+        ctx = _ParkedResolve(entry, req, reply, first_unseen, t_enter)
+        self._pipe_ctx.append(ctx)
+        self.version.set(req.version)
+        self.metrics.histogram("pipeline_inflight_depth").add(
+            len(self._pipe_ctx)
+        )
+        self.metrics.gauge("pipeline_occupancy").set(len(self._pipe_ctx))
+        # Submit-then-complete: the host packed/encoded THIS batch while
+        # the device computed its predecessors; completing the oldest now
+        # syncs it (overlapped) and applies its mirror writes under our
+        # own device compute.
+        self._pipeline_pump(self.conflicts.pipeline_depth - 1, "device")
+        if ctx.finished:
+            return
+        loop = self.process.network.loop
+        flush = g_knobs.server.resolver_pipeline_flush_seconds
+        from ..flow.eventloop import first_of
+
+        while not ctx.finished:
+            timer = loop.delay(flush)
+            await first_of(ctx.future, timer)
+            loop.cancel_timer(timer)
+            if not ctx.finished:
+                # Idle flush: no successor pushed us out within the
+                # deadline — drain (in order) through our own batch.
+                self._pipeline_flush_through(ctx)
+
+    def _pipeline_flush_through(self, ctx: _ParkedResolve):
+        while not ctx.finished:
+            self._pipeline_pump(len(self._pipe_ctx) - 1, "flush")
+
+    def _pipeline_pump(self, bound: int, cause: str):
+        """Finish parked resolves oldest-first until at most `bound`
+        remain.  `cause` feeds the stall accounting: "device" = a
+        submit's depth bound forced the completion (the host blocked on
+        a device sync — the steady-state overlap), "flush" = the idle
+        flush drained it (the device sat idle waiting for host/traffic)."""
+        self._pipeline_sweep(cause)
+        while len(self._pipe_ctx) > bound:
+            # The conflict set completes its OLDEST in-flight batch (a
+            # mid-pipeline fault replay may complete several at once);
+            # the sweep then finishes every context whose verdicts
+            # landed, preserving version order.
+            self.conflicts.pipeline_complete_oldest()
+            self._pipeline_sweep(cause)
+
+    def _pipeline_sweep(self, cause: str):
+        while self._pipe_ctx and self._pipe_ctx[0].entry.done:
+            ctx = self._pipe_ctx.popleft()
+            self._finish_resolve(ctx, cause)
+
+    def _finish_resolve(self, ctx: _ParkedResolve, cause: str):
+        """Completion phase of one pipelined resolve: the synchronous
+        path's shared post-verdict bookkeeping (_complete_resolve — one
+        implementation, no drift) plus the pipeline's stall accounting.
+        Runs synchronously inside whichever handler drives the pump, so
+        no other actor can interleave between verdict landing and reply."""
+        self._complete_resolve(
+            ctx.req, ctx.reply, ctx.entry.statuses, ctx.entry.degraded,
+            ctx.first_unseen, ctx.t_enter,
+        )
+        # Stall accounting + the wedged-pipeline black box: a pipeline
+        # that is ON but only ever drains by the idle flush achieves zero
+        # overlap — after a sustained streak, freeze a flight-recorder
+        # artifact (cooldown-gated per resolver) so the state that led
+        # here survives the incident.  Only batches that actually went
+        # through the device pipeline count (ticket set): CPU-routed
+        # pre-completed entries neither stalled on a device sync nor say
+        # anything about overlap, so they must not inflate device_stalls
+        # or break a flush streak.  "drain" completions (the mirror-check
+        # barrier) are neither stall kind and leave the streak alone.
+        m = self.metrics
+        if ctx.entry.ticket is None:
+            pass
+        elif cause == "flush":
+            m.counter("pipeline_host_stalls").add()
+            self._flush_streak += 1
+            if (
+                self._flush_streak
+                >= g_knobs.server.resolver_pipeline_stall_batches
+            ):
+                from ..flow.flight_recorder import maybe_trigger
+
+                captured = maybe_trigger(
+                    "pipeline_stall",
+                    detail={
+                        "streak": self._flush_streak,
+                        "depth": getattr(self.conflicts, "pipeline_depth", 1),
+                        "version": ctx.req.version,
+                    },
+                    source=self.metrics.name,
+                )
+                if captured is not None:
+                    # Reset only on an ACTUAL capture: a cooldown-
+                    # suppressed attempt must retry at the very next
+                    # flush completion, not after another full streak.
+                    self._flush_streak = 0
+        elif cause == "device":
+            m.counter("pipeline_device_stalls").add()
+            self._flush_streak = 0
+        m.gauge("pipeline_occupancy").set(len(self._pipe_ctx))
+        ctx._mark_finished()

@@ -623,11 +623,33 @@ class SoakRun:
 
         _rec = global_flight_recorder()
         breakers = {}
+        pipeline = {}
         for r, cs in self._resolver_conflict_sets():
             if cs._breaker is not None:
                 breakers[r.process.name] = [
                     list(tr) for tr in cs._breaker.transitions
                 ]
+            # Pipeline engagement per resolver (ISSUE 11): the soak's
+            # goodput floors are now held WITH the double-buffered path
+            # on by default — record the facts that prove it ran and how
+            # it completed (bound-pushed vs idle-flushed).
+            if getattr(r, "_pipeline_on", False) and cs._jax is not None:
+                rsnap = r.metrics.snapshot()
+                pipeline[r.process.name] = {
+                    "depth": cs.pipeline_depth,
+                    "dispatches": int(
+                        cs._jax.metrics.counter("pipeline_dispatches").value
+                    ),
+                    "replayed_batches": int(
+                        cs._jax.metrics.counter(
+                            "pipeline_replayed_batches"
+                        ).value
+                    ),
+                    "device_stalls": rsnap["counters"][
+                        "pipeline_device_stalls"
+                    ],
+                    "host_stalls": rsnap["counters"]["pipeline_host_stalls"],
+                }
         return {
             "config": {
                 "seed": cfg.seed,
@@ -667,6 +689,7 @@ class SoakRun:
                 ),
             },
             "breakers": breakers,
+            "pipeline": pipeline,
             "slo": {
                 "commit_p99_bound": cfg.slo_commit_p99,
                 "worst_phase_commit_p99": worst_p99 or None,

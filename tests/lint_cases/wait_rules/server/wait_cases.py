@@ -88,5 +88,44 @@ class MutableRole:
         return [p for p in self.peers]  # clean: iterates NOW, post-await
 
 
+class PipelinedResolver:
+    """ISSUE 11: the overlap state machine's capture discipline.  The
+    real pipeline parks an actor across the dispatch await while other
+    handlers mutate the in-flight deque and the mirror — a live view (or
+    element capture) of either, deref'd after the await, is exactly the
+    state-across-wait class; snapshot-then-apply stays clean."""
+
+    def __init__(self):
+        self.pipe = []
+        self.mirror = {}
+
+    def submit(self, b):
+        self.pipe.append(b)  # mutation evidence
+
+    def apply(self, k):
+        self.mirror[k] = self.mirror.get(k, 0) + 1  # mutation evidence
+
+    async def snapshot_then_apply(self, loop):
+        parked = list(self.pipe)  # deliberate snapshot before suspending
+        await loop.delay(1)
+        return parked[0]  # clean: the snapshot is ours alone
+
+    async def live_head_across_dispatch(self, loop):
+        head = self.pipe[0]
+        await loop.delay(1)  # the dispatch await: other handlers ran
+        return head.statuses  # EXPECT: WAIT001
+
+    async def reread_head_after_dispatch(self, loop):
+        head = self.pipe[0]
+        await loop.delay(1)
+        head = self.pipe[0]  # re-read after the suspension
+        return head.statuses  # clean: bound after the await
+
+    async def drain_live_pipe(self, loop):
+        for b in self.pipe:  # EXPECT: WAIT002
+            await loop.delay(1)
+            self.apply(b)
+
+
 def report(x):
     return x

@@ -64,8 +64,14 @@ def _run_wdr(backend: str, seed: int, conflict_set=None):
     return wl, state
 
 
-def test_write_during_read_differential_cpu_vs_jax():
-    """Config 2: the high-contention RYW workload, identical histories."""
+def test_write_during_read_differential_cpu_vs_jax(monkeypatch):
+    """Config 2: the high-contention RYW workload, identical histories.
+
+    Pinned to pipeline depth 1: cross-BACKEND history identity includes
+    reply timing, and the ISSUE-11 async offload defers jax-backend
+    replies by design.  Verdict/state identity of the pipelined path
+    itself is gated across depths by tests/test_resolver_pipeline.py."""
+    monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", "1")
     cpu_wl, cpu_state = _run_wdr("cpu", seed=9001)
     jax_wl, jax_state = _run_wdr("jax", seed=9001)
     assert not cpu_wl.mismatches and not jax_wl.mismatches
@@ -85,8 +91,10 @@ def _run_rrw(backend: str, seed: int):
     return wl, state
 
 
-def test_random_read_write_differential_cpu_vs_jax():
-    """Config 3: uniform keys, low contention, identical histories."""
+def test_random_read_write_differential_cpu_vs_jax(monkeypatch):
+    """Config 3: uniform keys, low contention, identical histories.
+    (Depth 1 for cross-backend timing comparability — see config 2.)"""
+    monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", "1")
     cpu_wl, cpu_state = _run_rrw("cpu", seed=9002)
     jax_wl, jax_state = _run_rrw("jax", seed=9002)
     assert cpu_wl.committed == jax_wl.committed == 18
@@ -107,8 +115,10 @@ def _run_cycle_multi_resolver(backend: str, seed: int):
     return state
 
 
-def test_cycle_multi_resolver_differential_cpu_vs_jax():
-    """Config 4: resolvers=4 with KeyRangeMap sharding, Cycle invariant."""
+def test_cycle_multi_resolver_differential_cpu_vs_jax(monkeypatch):
+    """Config 4: resolvers=4 with KeyRangeMap sharding, Cycle invariant.
+    (Depth 1 for cross-backend timing comparability — see config 2.)"""
+    monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", "1")
     cpu_state = _run_cycle_multi_resolver("cpu", seed=9003)
     jax_state = _run_cycle_multi_resolver("jax", seed=9003)
     assert cpu_state == jax_state

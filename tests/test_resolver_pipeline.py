@@ -1,0 +1,570 @@
+"""Double-buffered async resolver pipeline (ISSUE 11).
+
+The headline invariants:
+
+1. Same-seed verdict AND exported-state identity between
+   FDB_TPU_PIPELINE_DEPTH=1 (the synchronous resolve path) and depth >= 2
+   across seeds — the pipeline defers only host-side work (mirror apply,
+   encode, reply); the carried device history advances in commit order at
+   dispatch, so batch N+1 always decides against batch N's committed
+   writes.
+2. Mid-pipeline device faults (scripted DeviceFaultInjector plans firing
+   while batches are parked) drain the pipeline onto the authoritative
+   mirror with bit-identical verdicts and a byte-identical breaker
+   transition log across same-seed replays.
+3. Admission-control honesty: parked batches count in the resolver's
+   queue_depth (what the PR-7 ratekeeper rides), and a sustained
+   zero-overlap state leaves a flight-recorder artifact.
+
+Shape discipline (1-core CI host): key_words=3 + bucket_mins=(32, 128,
+64) + h_cap=1<<10, the same static shapes test_device_faults compiles —
+the in-process jit cache makes this module's marginal compile cost near
+zero in a full run.
+"""
+
+import json
+
+import pytest
+
+from foundationdb_tpu.conflict.api import ConflictSet
+from foundationdb_tpu.conflict.device_faults import DeviceFaultInjector
+from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+from foundationdb_tpu.conflict.types import TransactionConflictInfo as T
+from foundationdb_tpu.flow import DeterministicRandom, set_event_loop
+from foundationdb_tpu.flow.knobs import g_knobs
+
+WINDOW = 40
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def k(i: int) -> bytes:
+    return b"%08d" % i
+
+
+def _random_stream(seed, keyspace, batches, txns_per_batch, snap_lag=25):
+    """(txns, now, new_oldest) batches from a seeded rng (regenerable for
+    a second engine; twin of test_device_faults._random_stream)."""
+    rng = DeterministicRandom(seed)
+    version = 10
+    out = []
+    for _ in range(batches):
+        txns = []
+        for _ in range(rng.random_int(1, txns_per_batch + 1)):
+            tr = T(read_snapshot=max(0, version - rng.random_int(0, snap_lag)))
+            for _ in range(rng.random_int(0, 4)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 8))
+                tr.read_ranges.append((k(a), k(b)))
+            for _ in range(rng.random_int(0, 3)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 8))
+                tr.write_ranges.append((k(a), k(b)))
+            txns.append(tr)
+        version += rng.random_int(1, 10)
+        out.append((txns, version, max(0, version - WINDOW)))
+    return out
+
+
+def _device_set(monkeypatch, depth, **kw):
+    monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", str(depth))
+    kw.setdefault("backend", "jax")
+    kw.setdefault("key_words", 3)
+    kw.setdefault("bucket_mins", (32, 128, 64))
+    kw.setdefault("h_cap", 1 << 10)
+    return ConflictSet(**kw)
+
+
+def _drive_sync(cs, stream):
+    out = []
+    for txns, now, nov in stream:
+        b = cs.new_batch()
+        for t in txns:
+            b.add_transaction(t)
+        out.append(b.detect_conflicts(now, nov))
+    return out
+
+
+def _drive_pipelined(cs, stream, depth, drain_every=0):
+    """The resolver's submit-then-complete discipline: dispatch, then
+    retire oldest entries until the pipeline is back under its depth
+    bound; `drain_every` adds periodic full drains (the idle flush) to
+    vary completion interleavings."""
+    entries = []
+    for i, (txns, now, nov) in enumerate(stream):
+        entries.append(cs.pipeline_submit(txns, now, nov))
+        while cs.pipeline_inflight > depth - 1:
+            cs.pipeline_complete_oldest()
+        if drain_every and i % drain_every == drain_every - 1:
+            cs.pipeline_drain()
+    cs.pipeline_drain()
+    assert all(e.done for e in entries)
+    return [e.statuses for e in entries]
+
+
+def _exported_state(cs):
+    """(mirror keys/vers/oldest, device-export keys/vers/oldest) — the
+    store_to identity the acceptance criteria pin."""
+    mirror = (list(cs._cpu.keys), list(cs._cpu.vers), cs._cpu.oldest_version)
+    export = CpuConflictSet()
+    cs._jax.store_to(export)
+    device = (list(export.keys), list(export.vers), export.oldest_version)
+    return mirror, device
+
+
+# ---------------------------------------------------------------------------
+# 1. sync-vs-pipelined differential: verdicts AND exported state
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [3, 5, 9])
+@pytest.mark.parametrize("depth", [2, 3])
+def test_pipeline_verdicts_and_state_match_sync(monkeypatch, seed, depth):
+    stream = _random_stream(seed, 60, 16, 8)
+    sync_cs = _device_set(monkeypatch, 1)
+    want = _drive_sync(sync_cs, stream)
+    want_state = _exported_state(sync_cs)
+
+    cs = _device_set(monkeypatch, depth)
+    got = _drive_pipelined(cs, stream, depth, drain_every=5)
+    assert got == want, "pipelined verdicts diverged from the sync path"
+    assert _exported_state(cs) == want_state
+    dm = cs.device_metrics()
+    assert dm["counters"]["pipeline_dispatches"] == len(stream)
+    assert dm["pipeline"]["depth"] == depth
+    assert dm["pipeline"]["inflight"] == 0
+
+
+def test_pipeline_tiered_history_matches_sync(monkeypatch):
+    """Tiered mode under the pipeline: the per-ticket dcount copy and
+    the no-bound-tightening rule (sync_ticket) must keep compaction
+    planning exact with batches in flight."""
+    monkeypatch.setenv("FDB_TPU_HISTORY", "tiered")
+    monkeypatch.setenv("FDB_TPU_DELTA_CAP", "128")
+    monkeypatch.setenv("FDB_TPU_EVICT_EVERY", "2")
+    stream = _random_stream(5, 60, 16, 8)
+    sync_cs = _device_set(monkeypatch, 1)
+    assert sync_cs._jax.tiered
+    want = _drive_sync(sync_cs, stream)
+    want_state = _exported_state(sync_cs)
+    cs = _device_set(monkeypatch, 3)
+    got = _drive_pipelined(cs, stream, 3)
+    assert got == want
+    assert _exported_state(cs) == want_state
+    assert cs.device_metrics()["counters"]["major_compactions"] >= 2
+
+
+def test_pipeline_hybrid_small_batch_routing_drains(monkeypatch):
+    """Hybrid routing mid-stream: small batches route to the CPU, which
+    must see a CURRENT mirror — the submit drains the pipeline first.
+    Verdicts stay identical to the sync hybrid run."""
+    old_min = g_knobs.server.conflict_device_min_batch
+    g_knobs.server.conflict_device_min_batch = 4
+    try:
+        stream = _random_stream(23, 60, 18, 8)  # sizes straddle the min
+        want = _drive_sync(_device_set(monkeypatch, 1, backend="hybrid"),
+                           stream)
+        cs = _device_set(monkeypatch, 2, backend="hybrid")
+        got = _drive_pipelined(cs, stream, 2)
+        assert got == want
+    finally:
+        g_knobs.server.conflict_device_min_batch = old_min
+
+
+# ---------------------------------------------------------------------------
+# 2. mid-pipeline faults: mirror replay, breaker-log byte identity
+# ---------------------------------------------------------------------------
+
+
+def test_mid_pipeline_fault_replays_parked_batches(monkeypatch):
+    """A scripted dispatch fault fires while two batches are parked
+    (depth 3): the pipeline drains onto the mirror with verdicts
+    identical to the CPU-only run, the replay is counted, the breaker
+    log replays byte-identically, and the device recovers."""
+    stream = _random_stream(11, 60, 24, 8)
+    cpu = CpuConflictSet()
+    want = [cpu.detect(t, n, v) for t, n, v in stream]
+
+    def run():
+        inj = DeviceFaultInjector()
+        # Dispatch check #6: batches 1-5 dispatched; with depth 3 the
+        # submit of batch 6 finds 2 parked entries (3, 4 completed by
+        # the bound) — the fault must replay both plus serve batch 6
+        # degraded.  Three more consecutive faults open the circuit.
+        for at in (6, 7, 8, 9):
+            inj.script("dispatch", at=at)
+        cs = _device_set(monkeypatch, 3, fault_injector=inj)
+        got = _drive_pipelined(cs, stream, 3)
+        return got, cs.device_metrics(), inj.injected
+
+    got, dm, log = run()
+    assert got == want, "fault-window verdicts diverged from CPU-only"
+    assert dm["counters"]["pipeline_replayed_batches"] == 2
+    assert dm["counters"]["device_faults"] >= 4
+    assert dm["backend_state"] == "ok", dm["breaker"]
+    got2, dm2, log2 = run()
+    assert got2 == got and log2 == log and log
+    assert json.dumps(dm2["breaker"]) == json.dumps(dm["breaker"])
+
+
+def test_sync_surfacing_faults_open_the_breaker(monkeypatch):
+    """Faults that surface only at the SYNC (the dominant real-hardware
+    mode under async dispatch) must still walk the breaker: success is
+    credited at the verified sync, never at dispatch, so consecutive
+    sync faults reach the threshold and open the circuit — and verdicts
+    still never diverge (mirror replay absorbs each faulted tail)."""
+    from foundationdb_tpu.conflict.device_faults import DeviceUnavailable
+    from foundationdb_tpu.conflict.engine_jax import JaxConflictSet
+
+    stream = _random_stream(37, 60, 20, 6)
+    cpu = CpuConflictSet()
+    want = [cpu.detect(t, n, v) for t, n, v in stream]
+    cs = _device_set(monkeypatch, 2)
+    real_sync = JaxConflictSet.sync_ticket
+    state = {"n": 0}
+
+    def flaky_sync(self, ticket):
+        state["n"] += 1
+        if 4 <= state["n"] <= 6:  # three consecutive sync-time faults
+            raise DeviceUnavailable("injected sync fault", site="dispatch")
+        return real_sync(self, ticket)
+
+    monkeypatch.setattr(JaxConflictSet, "sync_ticket", flaky_sync)
+    got = _drive_pipelined(cs, stream, 2)
+    assert got == want
+    dm = cs.device_metrics()
+    assert dm["counters"]["breaker_opens"] >= 1, dm["breaker"]
+    assert dm["backend_state"] == "ok", dm["breaker"]  # probe recovered
+
+
+def test_fixpoint_divergence_mid_pipeline_replays(monkeypatch):
+    """A fixpoint divergence reported at the SYNC of a parked batch (the
+    deferred analog of detect_packed's undecided fallback) marks the
+    device stale and replays the whole in-flight tail on the mirror —
+    verdicts identical, later batches rehydrate and agree."""
+    from foundationdb_tpu.conflict.engine_jax import JaxConflictSet
+
+    stream = _random_stream(31, 60, 16, 8)
+    cpu = CpuConflictSet()
+    want = [cpu.detect(t, n, v) for t, n, v in stream]
+
+    cs = _device_set(monkeypatch, 3)
+    real_sync = JaxConflictSet.sync_ticket
+    fired = {"n": 0}
+
+    def fake_sync(self, ticket):
+        statuses, diverged = real_sync(self, ticket)
+        if fired["n"] == 0 and len(cs._pipe) >= 2:
+            fired["n"] += 1
+            return None, True  # planted divergence with a parked tail
+        return statuses, diverged
+
+    monkeypatch.setattr(JaxConflictSet, "sync_ticket", fake_sync)
+    got = _drive_pipelined(cs, stream, 3)
+    assert fired["n"] == 1, "the planted divergence never fired"
+    assert got == want
+    dm = cs.device_metrics()
+    assert dm["counters"]["pipeline_replayed_batches"] >= 2
+    assert dm["counters"]["rehydrates"] >= 1  # the next submit reloaded
+
+
+# ---------------------------------------------------------------------------
+# 3. the pipelined Resolver role: verdict streams across depths, faults,
+#    queue-depth honesty, duplicate replies, stall artifact
+# ---------------------------------------------------------------------------
+
+
+def _resolver_rig(seed, depth, monkeypatch, fault_script=()):
+    """EventLoop + SimNetwork + one jax-backed Resolver + a driver
+    process; returns (loop, resolver, driver_process, injector)."""
+    from foundationdb_tpu.flow.eventloop import EventLoop
+    from foundationdb_tpu.rpc.network import SimNetwork
+    from foundationdb_tpu.server.resolver import Resolver
+
+    monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", str(depth))
+    loop = EventLoop(seed)
+    set_event_loop(loop)
+    net = SimNetwork(loop)
+    inj = DeviceFaultInjector()
+    for site, at in fault_script:
+        inj.script(site, at=at)
+    cs = ConflictSet(
+        backend="jax", key_words=3, bucket_mins=(32, 128, 64),
+        h_cap=1 << 10, fault_injector=inj,
+    )
+    r = Resolver(net.process("resolver"), conflict_set=cs)
+    return loop, r, net.process("driver"), inj
+
+
+def _drive_resolver(loop, resolver, dproc, stream, cadence=0.002):
+    """Send the scripted batch stream at a fixed virtual-time cadence
+    WITHOUT awaiting each reply (overlapping requests are what the
+    pipeline overlaps); returns the ordered reply verdict lists."""
+    from foundationdb_tpu.server.interfaces import (
+        ResolveTransactionBatchRequest,
+    )
+
+    iface = resolver.interface()
+
+    async def drive():
+        prev = 0
+        futs = []
+        for txns, now, _nov in stream:
+            futs.append(iface.resolve.get_reply(
+                dproc,
+                ResolveTransactionBatchRequest(
+                    prev_version=prev, version=now,
+                    last_received_version=prev, transactions=txns,
+                    proxy_id="p0",
+                ),
+            ))
+            prev = now
+            await loop.delay(cadence)
+        return [(await f).committed for f in futs]
+
+    return loop.run_until(dproc.spawn(drive(), "drive"), timeout_vt=600.0)
+
+
+@pytest.mark.parametrize("seed", [3, 5, 9])
+def test_resolver_verdict_stream_identical_across_depths(monkeypatch, seed):
+    """The acceptance gate at the role level: the reply verdict stream
+    and the exported conflict-set state are identical for depth 1 (sync)
+    and depths 2/3, same seed, same scripted arrivals."""
+    stream = _random_stream(seed, 60, 14, 8)
+    results, states = {}, {}
+    for depth in (1, 2, 3):
+        loop, r, dproc, _ = _resolver_rig(seed, depth, monkeypatch)
+        results[depth] = _drive_resolver(loop, r, dproc, stream)
+        states[depth] = _exported_state(r.conflicts)
+        set_event_loop(None)
+    assert results[2] == results[1] and results[3] == results[1]
+    assert states[2] == states[1] and states[3] == states[1]
+
+
+def test_resolver_pipelined_fault_matches_sync(monkeypatch):
+    """Scripted dispatch faults land mid-pipeline under the role (batch
+    N faulted while N-1's apply is pending and N+1 arrives): the reply
+    stream still matches the synchronous run, and the breaker log
+    replays byte-identically."""
+    stream = _random_stream(7, 60, 16, 8)
+    script = (("dispatch", 5), ("dispatch", 6))
+
+    def run(depth):
+        loop, r, dproc, inj = _resolver_rig(7, depth, monkeypatch,
+                                            fault_script=script)
+        verdicts = _drive_resolver(loop, r, dproc, stream)
+        dm = r.conflicts.device_metrics()
+        set_event_loop(None)
+        return verdicts, dm, inj.injected
+
+    v1, dm1, log1 = run(1)
+    v2, dm2, log2 = run(2)
+    assert v2 == v1
+    assert log2 == log1 and log1
+    v2b, dm2b, _ = run(2)
+    assert v2b == v2
+    assert json.dumps(dm2b["breaker"]) == json.dumps(dm2["breaker"])
+
+
+def test_queue_depth_counts_pipelined_parked_batches(monkeypatch):
+    """Admission-control honesty (the PR-7 ratekeeper rides
+    queue_depth): batches parked in the pipeline still count, in the
+    property, the signals reply, and the registry gauge."""
+    old_flush = g_knobs.server.resolver_pipeline_flush_seconds
+    g_knobs.server.resolver_pipeline_flush_seconds = 5.0  # park visibly
+    try:
+        stream = _random_stream(13, 60, 2, 6)
+        loop, r, dproc, _ = _resolver_rig(13, 3, monkeypatch)
+        from foundationdb_tpu.server.interfaces import (
+            ResolveTransactionBatchRequest,
+        )
+
+        iface = r.interface()
+        seen = {}
+
+        async def drive():
+            prev = 0
+            futs = []
+            for txns, now, _nov in stream:
+                futs.append(iface.resolve.get_reply(
+                    dproc,
+                    ResolveTransactionBatchRequest(
+                        prev_version=prev, version=now,
+                        last_received_version=prev, transactions=txns,
+                        proxy_id="p0",
+                    ),
+                ))
+                prev = now
+                await loop.delay(0.002)
+            await loop.delay(0.05)  # well under the 5s flush
+            seen["parked"] = r.conflicts.pipeline_inflight
+            seen["queue_depth"] = r.queue_depth
+            seen["signals"] = r.signal_snapshot().queue_depth
+            seen["gauge"] = r.metrics.gauge("pipeline_occupancy").value
+            seen["replied"] = sum(1 for f in futs if f.is_ready())
+            return [await f for f in futs]
+
+        replies = loop.run_until(dproc.spawn(drive(), "drive"),
+                                 timeout_vt=600.0)
+        assert seen["parked"] == 2, seen
+        assert seen["queue_depth"] == 2, seen
+        assert seen["signals"] == 2
+        assert seen["gauge"] == 2
+        assert seen["replied"] == 0, "parked batches must not have replied"
+        assert len(replies) == 2  # the idle flush drained the tail
+        assert r.queue_depth == 0
+        snap = r.metrics.snapshot()
+        assert snap["counters"]["pipeline_host_stalls"] >= 1
+        assert snap["histograms"]["pipeline_inflight_depth"]["max"] == 2
+    finally:
+        g_knobs.server.resolver_pipeline_flush_seconds = old_flush
+
+
+def test_state_txn_retention_survives_parked_gc(monkeypatch):
+    """Regression: last_version advances at SUBMIT, so the retention GC
+    running at an earlier batch's COMPLETION must not delete state
+    transactions a still-parked batch's reply (built later) needs.
+    Proxy A resolves v3, proxy B resolves v5 WITH state txns, proxy A
+    resolves v9 — A's v9 reply must carry v5's state mutations even
+    though v9's submit bumped A.last_version past the GC horizon while
+    v5 was still completing."""
+    old_flush = g_knobs.server.resolver_pipeline_flush_seconds
+    g_knobs.server.resolver_pipeline_flush_seconds = 0.05
+    try:
+        from foundationdb_tpu.flow.eventloop import EventLoop
+        from foundationdb_tpu.rpc.network import SimNetwork
+        from foundationdb_tpu.server.interfaces import (
+            ResolveTransactionBatchRequest,
+        )
+        from foundationdb_tpu.server.resolver import Resolver
+
+        monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", "2")
+        loop = EventLoop(21)
+        set_event_loop(loop)
+        net = SimNetwork(loop)
+        cs = ConflictSet(backend="jax", key_words=3,
+                         bucket_mins=(32, 128, 64), h_cap=1 << 10)
+        r = Resolver(net.process("resolver"), conflict_set=cs, n_proxies=2)
+        dproc = net.process("driver")
+        iface = r.interface()
+        wtxn = T(read_snapshot=0, write_ranges=[(k(1), k(2))])
+
+        async def drive():
+            f1 = iface.resolve.get_reply(dproc, ResolveTransactionBatchRequest(
+                prev_version=0, version=3, transactions=[wtxn],
+                proxy_id="pA"))
+            f2 = iface.resolve.get_reply(dproc, ResolveTransactionBatchRequest(
+                prev_version=3, version=5, transactions=[wtxn],
+                state_txns=[(0, [("set", b"\xffk", b"v")])], proxy_id="pB"))
+            f3 = iface.resolve.get_reply(dproc, ResolveTransactionBatchRequest(
+                prev_version=5, version=9, transactions=[wtxn],
+                proxy_id="pA"))
+            return await f1, await f2, await f3
+
+        r1, r2, r3 = loop.run_until(dproc.spawn(drive(), "drive"),
+                                    timeout_vt=600.0)
+        assert [v for v, _m in r3.state_mutations] == [5], (
+            "v9's reply lost v5's state transactions to the parked GC"
+        )
+    finally:
+        g_knobs.server.resolver_pipeline_flush_seconds = old_flush
+
+
+def test_duplicate_request_while_parked_waits_for_cache(monkeypatch):
+    """A proxy retry for a version still parked in the pipeline must get
+    the SAME reply (via the per-proxy cache after completion), never
+    operation_failed."""
+    old_flush = g_knobs.server.resolver_pipeline_flush_seconds
+    g_knobs.server.resolver_pipeline_flush_seconds = 0.05
+    try:
+        stream = _random_stream(17, 60, 1, 6)
+        txns, now, _ = stream[0]
+        loop, r, dproc, _ = _resolver_rig(17, 2, monkeypatch)
+        from foundationdb_tpu.server.interfaces import (
+            ResolveTransactionBatchRequest,
+        )
+
+        iface = r.interface()
+
+        async def drive():
+            req = ResolveTransactionBatchRequest(
+                prev_version=0, version=now, last_received_version=0,
+                transactions=txns, proxy_id="p0",
+            )
+            f1 = iface.resolve.get_reply(dproc, req)
+            await loop.delay(0.002)  # original is parked (flush at 50ms)
+            assert not f1.is_ready()
+            f2 = iface.resolve.get_reply(dproc, req)  # the retry
+            return (await f1), (await f2)
+
+        r1, r2 = loop.run_until(dproc.spawn(drive(), "drive"),
+                                timeout_vt=600.0)
+        assert r1.committed == r2.committed
+        assert r.metrics.counter("cache_hits").value == 1
+    finally:
+        g_knobs.server.resolver_pipeline_flush_seconds = old_flush
+
+
+def test_sustained_stall_freezes_flight_recorder_artifact(monkeypatch):
+    """Zero-overlap operation (every batch drained by the idle flush)
+    for resolver_pipeline_stall_batches in a row leaves a black-box
+    artifact tagged pipeline_stall."""
+    from foundationdb_tpu.flow.flight_recorder import (
+        FlightRecorder,
+        global_flight_recorder,
+        set_global_flight_recorder,
+    )
+
+    old_stall = g_knobs.server.resolver_pipeline_stall_batches
+    g_knobs.server.resolver_pipeline_stall_batches = 3
+    old_rec = global_flight_recorder()
+    set_global_flight_recorder(FlightRecorder())
+    try:
+        stream = _random_stream(19, 60, 6, 6)
+        loop, r, dproc, _ = _resolver_rig(19, 2, monkeypatch)
+        # Arrivals far apart (50ms >> the 5ms flush): every batch parks,
+        # no successor ever pushes it out — the flush drains each one.
+        _drive_resolver(loop, r, dproc, stream, cadence=0.05)
+        rec = global_flight_recorder()
+        assert any(c["trigger"] == "pipeline_stall" for c in rec.captures), (
+            rec.status_section()
+        )
+        snap = r.metrics.snapshot()
+        assert snap["counters"]["pipeline_host_stalls"] >= 3
+    finally:
+        g_knobs.server.resolver_pipeline_stall_batches = old_stall
+        set_global_flight_recorder(old_rec)
+
+
+def test_cluster_commits_engage_the_pipeline(monkeypatch):
+    """End-to-end smoke: a SimCluster with a jax resolver at the default
+    depth serves live commit traffic through the pipelined path (the
+    dispatch counter proves engagement) with every commit answered."""
+    monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", "2")
+    from foundationdb_tpu.server import SimCluster
+
+    c = SimCluster(seed=4321, conflict_backend="jax")
+    db = c.database()
+    committed = []
+
+    async def commits():
+        for i in range(8):
+            tr = db.create_transaction()
+            tr.set(b"pl/%02d" % i, b"v")
+            committed.append(await tr.commit())
+
+    c.run_until(db.process.spawn(commits(), "commits"), timeout_vt=5000.0)
+    assert len(committed) == 8 and all(v is not None for v in committed)
+    dm = c.resolver.conflicts.device_metrics()
+    assert dm["counters"]["pipeline_dispatches"] >= 1
+    assert dm["pipeline"]["inflight"] == 0  # idle flush drained the tail
+    snap = c.resolver.metrics.snapshot()
+    assert (
+        snap["counters"]["pipeline_device_stalls"]
+        + snap["counters"]["pipeline_host_stalls"]
+        >= 1
+    )

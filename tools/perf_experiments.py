@@ -17,8 +17,14 @@ Variants (the one shared table, bench.VARIANTS):
   evict4          FDB_TPU_EVICT_EVERY=4 — eviction compaction every 4th
                   batch (h_cap gets headroom for the unevicted batches)
   both*           2level/evict combinations
+  pipeline1/2/3   FDB_TPU_PIPELINE_DEPTH sweep (ISSUE 11) — the FULL
+                  resolve loop (encode + dispatch + readback + mirror
+                  apply) at each depth; pipeline1 is the synchronous
+                  before-arm
 
 Run: python tools/perf_experiments.py   (on the TPU host)
+     python tools/perf_experiments.py --pipeline   (CPU overlap sweep,
+     any host)
 """
 
 import json
@@ -29,13 +35,19 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 RUNNER = r"""
-import json, sys, time
+import json, os, sys, time
 sys.path.insert(0, %(repo)r)
 import numpy as np
 import bench
 
 rng = np.random.default_rng(2024)
-rate = bench.bench_jax(rng, h_cap=%(h_cap)d)
+depth = os.environ.get("FDB_TPU_PIPELINE_DEPTH")
+if depth:
+    # Pipeline variants (ISSUE 11) price the FULL resolve loop: encode +
+    # dispatch + readback + mirror apply at the given depth.
+    rate = bench.bench_pipeline(rng, int(depth), h_cap=%(h_cap)d)
+else:
+    rate = bench.bench_jax(rng, h_cap=%(h_cap)d)
 print("RESULT " + json.dumps({"txns_per_sec": round(rate, 1)}))
 """
 
@@ -68,6 +80,15 @@ def main():
                   file=sys.stderr)
         print(json.dumps(program_cost_table(include_wall=True), indent=2,
                          sort_keys=True))
+        return
+    if "--pipeline" in sys.argv:
+        # CPU-phase pipeline overlap microbench (ISSUE 11): the resolve
+        # loop at the skipListTest stream shape under depths 1/2/3, plus
+        # the serialized phase decomposition (encode / device step /
+        # mirror apply) showing what the overlap hides.  No device
+        # needed — JAX's async CPU dispatch provides the compute thread
+        # the host phases overlap with, so the win prices on any host.
+        print(json.dumps(bench.bench_pipeline_cpu(), indent=2))
         return
     if "--mirror" in sys.argv:
         # Host-side mirror A/B (ISSUE 9; bench.MIRROR_VARIANTS): no
