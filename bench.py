@@ -358,8 +358,20 @@ def bench_pipeline(rng, depth, n_batches=24, per_batch=65536,
     this prices the host phases the resolver actually pays per batch, so
     the depth-2-vs-1 ratio is meaningful on ANY host: with JAX's async
     dispatch the mirror apply of batch N-1 and the pack/encode of batch
-    N+1 run under device (or XLA-CPU) compute of batch N."""
+    N+1 run under device (or XLA-CPU) compute of batch N.
+
+    Returns (txns_per_sec, overlap) where overlap is the span-layer
+    pipeline overlap-efficiency metric (ISSUE 12: overlapped device
+    time / total device time over the measured batches' device
+    in-flight spans) on both the wall axis (the real number) and the
+    deterministic event-sequence axis."""
     from foundationdb_tpu.conflict.api import ConflictSet
+    from foundationdb_tpu.flow.spans import (
+        SpanHub,
+        global_span_hub,
+        overlap_efficiency,
+        set_global_span_hub,
+    )
 
     prev = os.environ.get("FDB_TPU_PIPELINE_DEPTH")
     os.environ["FDB_TPU_PIPELINE_DEPTH"] = str(depth)
@@ -386,13 +398,26 @@ def bench_pipeline(rng, depth, n_batches=24, per_batch=65536,
     for i in range(warm):
         run_one(i)
     cs.pipeline_drain()
-    t0 = time.perf_counter()
-    entries = [run_one(warm + j) for j in range(n_batches)]
-    cs.pipeline_drain()
-    dt = time.perf_counter() - t0
+    # Fresh span hub for the MEASURED region only: the overlap metric
+    # must price these n_batches, not the warmup's compile-skewed spans.
+    old_hub = global_span_hub()
+    set_global_span_hub(SpanHub())
+    try:
+        t0 = time.perf_counter()
+        entries = [run_one(warm + j) for j in range(n_batches)]
+        cs.pipeline_drain()
+        dt = time.perf_counter() - t0
+        dev_spans = global_span_hub().spans(name="device")
+        overlap = {
+            "wall": round(overlap_efficiency(dev_spans, axis="wall"), 4),
+            "seq": round(overlap_efficiency(dev_spans, axis="seq"), 4),
+            "device_spans": len(dev_spans),
+        }
+    finally:
+        set_global_span_hub(old_hub)
     assert all(e.done and not e.degraded for e in entries)
     assert cs._jax.h_cap == h_cap0, "history grew mid-bench; raise h_cap"
-    return n_batches * per_batch / dt
+    return n_batches * per_batch / dt, overlap
 
 
 def _pipeline_phase_costs(rng, n_batches, per_batch, h_cap, window=WINDOW):
@@ -459,17 +484,95 @@ def bench_pipeline_cpu(depths=(1, 2, 3), n_batches=30, per_batch=2500,
         np.random.default_rng(2024), n_batches, per_batch, h_cap
     )
     for d in depths:
-        rate = bench_pipeline(
+        rate, overlap = bench_pipeline(
             np.random.default_rng(2024), d,
             n_batches=n_batches, per_batch=per_batch, h_cap=h_cap,
         )
-        out[f"pipeline{d}"] = {"txns_per_sec": round(rate, 1)}
+        out[f"pipeline{d}"] = {
+            "txns_per_sec": round(rate, 1),
+            # ISSUE 12: overlapped device time / total device time off
+            # the span layer — the structural explanation of the ratio
+            # below (depth 1 is 0 by construction).
+            "overlap_efficiency_wall": overlap["wall"],
+            "overlap_efficiency_seq": overlap["seq"],
+        }
     if "pipeline1" in out and "pipeline2" in out:
         out["ratio_2v1"] = round(
             out["pipeline2"]["txns_per_sec"]
             / out["pipeline1"]["txns_per_sec"], 3,
         )
     return out
+
+
+def bench_timeline(out_path="TIMELINE.json", depth=2, n_batches=16,
+                   per_batch=2500, h_cap=1 << 19):
+    """Timeline artifact for the next device window (ISSUE 12 satellite):
+    a short pipelined resolve run with span recording, the
+    phase-attribution harness hung off the last dispatch span, and the
+    whole thing exported as a Perfetto / Chrome trace-event JSON file —
+    so BENCH numbers ship WITH the timeline that explains them."""
+    from foundationdb_tpu.conflict.api import ConflictSet
+    from foundationdb_tpu.conflict.phase_attribution import attribute_phases
+    from foundationdb_tpu.flow.spans import (
+        SpanHub,
+        global_span_hub,
+        overlap_efficiency,
+        set_global_span_hub,
+    )
+    from foundationdb_tpu.flow.trace_export import (
+        perfetto_trace,
+        validate_perfetto,
+    )
+
+    rng = np.random.default_rng(2024)
+    prev = os.environ.get("FDB_TPU_PIPELINE_DEPTH")
+    os.environ["FDB_TPU_PIPELINE_DEPTH"] = str(depth)
+    try:
+        cs = ConflictSet(backend="jax", key_words=KEY_WORDS, h_cap=h_cap)
+    finally:
+        if prev is None:
+            os.environ.pop("FDB_TPU_PIPELINE_DEPTH", None)
+        else:
+            os.environ["FDB_TPU_PIPELINE_DEPTH"] = prev
+    streams = [
+        txns_from_packed(gen_packed(rng, per_batch, i, KEY_WORDS), per_batch)
+        for i in range(n_batches)
+    ]
+    old_hub = global_span_hub()
+    set_global_span_hub(SpanHub())
+    try:
+        for i, txns in enumerate(streams):
+            cs.pipeline_submit(txns, i + WINDOW, i)
+            while cs.pipeline_inflight > depth - 1:
+                cs.pipeline_complete_oldest()
+        cs.pipeline_drain()
+        attribution = attribute_phases(
+            cs._jax, streams[-1], measure=True, repeats=2
+        )
+        hub = global_span_hub()
+        dev_spans = hub.spans(name="device")
+        doc = perfetto_trace(hub, include_wall=True)
+        errors = validate_perfetto(doc)
+        with open(out_path, "w", encoding="utf-8") as f:
+            json.dump(doc, f, sort_keys=True, separators=(",", ":"))
+            f.write("\n")
+        return {
+            "metric": "pipeline_overlap_efficiency",
+            "value": round(overlap_efficiency(dev_spans, axis="wall"), 4),
+            "unit": "overlapped/total device time (wall)",
+            "depth": depth,
+            "n_batches": n_batches,
+            "per_batch": per_batch,
+            "spans": sum(len(r) for r in hub.rings.values()),
+            "timeline_path": out_path,
+            "schema_errors": errors,
+            "phase_attribution": {
+                "phases": attribution["phases"],
+                "measured": attribution.get("measured"),
+            },
+        }
+    finally:
+        set_global_span_hub(old_hub)
 
 
 def emit(out, errors):
@@ -518,9 +621,9 @@ def device_phase_main():
     depth_flag = os.environ.get("FDB_TPU_PIPELINE_DEPTH")
     if depth_flag:
         # Pipeline variants price the full resolve loop (ISSUE 11).
-        res["jax_txns_per_sec"] = round(
-            bench_pipeline(rng, int(depth_flag), h_cap=h_cap), 1
-        )
+        rate, overlap = bench_pipeline(rng, int(depth_flag), h_cap=h_cap)
+        res["jax_txns_per_sec"] = round(rate, 1)
+        res["overlap_efficiency_wall"] = overlap["wall"]
     else:
         res["jax_txns_per_sec"] = round(bench_jax(rng, h_cap=h_cap), 1)
     _log(f"device: {res['jax_txns_per_sec']:,.0f} txn/s")

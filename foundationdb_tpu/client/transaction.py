@@ -144,6 +144,14 @@ class Database:
             while lane["pending"]:  # fdblint: ignore[WAIT001]: lane dicts are per-flag singletons — the loop test re-reads the live channel on purpose
                 batch, lane["pending"] = lane["pending"], []  # fdblint: ignore[WAIT001]: lane dicts are per-flag singletons (setdefault once, never replaced); the alias IS the shared channel with start-GRV callers
                 debug_id = self._sample_debug_id()
+                from ..flow.spans import NULL_SPAN, begin_span
+
+                gspan = (
+                    begin_span("grv", role="client",
+                               attrs={"debug_id": str(debug_id)})
+                    if debug_id is not None
+                    else NULL_SPAN
+                )
                 trace_batch(
                     "TransactionDebug",
                     "NativeAPI.getConsistentReadVersion.Before",
@@ -158,6 +166,7 @@ class Database:
                         GetReadVersionRequest(flags=flags, debug_id=debug_id),
                     )
                     self.latency_samples["grv"].add(loop.now() - t0)
+                    gspan.end(attrs={"version": version})
                     trace_batch(
                         "TransactionDebug",
                         "NativeAPI.getConsistentReadVersion.After",
@@ -169,6 +178,7 @@ class Database:
                     raise  # process dying: waiters die with it
                 except FdbError as e:
                     # Each waiter retries through its own on_error loop.
+                    gspan.end(attrs={"error": e.name})
                     for p in batch:
                         p.send_error(FdbError(e.name))
                 except Exception:  # noqa: BLE001
@@ -177,6 +187,7 @@ class Database:
                     # silent hang — before batching, each caller saw its
                     # own exception.  Fail them retryably and keep
                     # draining.
+                    gspan.end(attrs={"error": "broken_promise"})
                     for p in batch:
                         p.send_error(FdbError("broken_promise"))
         finally:
@@ -749,10 +760,19 @@ class Transaction:
             write_conflict_ranges=write,
             mutations=list(self.mutations),
         )
+        from ..flow.spans import NULL_SPAN, begin_span
         from ..flow.trace import trace_batch
 
         loop = self.db.process.network.loop
         debug_id = self.db._sample_debug_id()
+        # Commit span (ISSUE 12): sampled transactions only — the same
+        # volume bound as the trace_batch chain it sits beside.
+        cspan = (
+            begin_span("commit", role="client",
+                       attrs={"debug_id": str(debug_id)})
+            if debug_id is not None
+            else NULL_SPAN
+        )
         trace_batch("CommitDebug", "NativeAPI.commit.Before", debug_id)
         t0 = loop.now()
         from ..server.interfaces import COMMIT_FLAG_LOCK_AWARE
@@ -772,6 +792,7 @@ class Transaction:
             # ratekeeper's CommitChainSampler ages OPEN chains as a
             # pipeline-stall signal, so a failed attempt must not
             # masquerade as a forever-wedged commit.
+            cspan.end(attrs={"error": e.name})
             trace_batch("CommitDebug", "NativeAPI.commit.Error", debug_id)
             if e.name in ("commit_unknown_result", "broken_promise"):
                 # The commit may still be in flight.  Before surfacing the
@@ -791,6 +812,7 @@ class Transaction:
                 raise FdbError("commit_unknown_result")
             raise
         self.db.latency_samples["commit"].add(loop.now() - t0)
+        cspan.end(attrs={"version": version})
         trace_batch("CommitDebug", "NativeAPI.commit.After", debug_id)
         self.committed_version = version
         self._launch_watches(version)

@@ -80,7 +80,7 @@ class InflightBatch:
     device work)."""
 
     __slots__ = ("txns", "ticket", "now", "new_oldest_version",
-                 "statuses", "degraded")
+                 "statuses", "degraded", "span", "device_span")
 
     def __init__(self, txns, ticket, now, new_oldest_version):
         self.txns = txns
@@ -89,6 +89,12 @@ class InflightBatch:
         self.new_oldest_version = new_oldest_version
         self.statuses: Optional[List[int]] = None
         self.degraded = False
+        # Span layer (ISSUE 12): the owning batch span (the resolver's
+        # resolve_batch, captured off the hub stack at dispatch) and the
+        # device in-flight span [dispatch done -> sync returned] whose
+        # overlap with its siblings IS the pipeline overlap metric.
+        self.span = None
+        self.device_span = None
 
     @classmethod
     def completed(cls, statuses: List[int], degraded: bool = False):
@@ -296,32 +302,44 @@ class ConflictSet:
         decides bit-identically, so a fault never changes a verdict.  A
         successful attempt mirrors the committed writes into the CPU
         engine and is the breaker's half-open probe when one is due."""
+        from ..flow.spans import begin_span
+
         if not self._breaker.allows_device():
             self._degraded_last = True
             return None
         snapshot = getattr(self._cpu, "snapshot", None)
         take_fresh = getattr(self._cpu, "take_fresh_chunks", None)
+        # Device span on the synchronous path too (dispatch + sync in
+        # one detect): depth-1 streams then carry the same span names as
+        # the pipelined path, with zero overlap by construction — the
+        # before-arm of the overlap-efficiency bench number.
+        dspan = begin_span("device", attrs={"version": now})
         try:
             if self._device_stale:
                 self._rehydrate_from_mirror(snapshot, take_fresh)
             statuses = self._jax.detect(txns, now, new_oldest_version)
         except DeviceFault as e:
+            dspan.end(attrs={"fault": 1})
             self._breaker.on_failure(e)
             self._device_stale = True
             self._degraded_last = True
             return None
+        dspan.end()
         self._breaker.on_success()
-        self._cpu.apply_batch(txns, statuses, now, new_oldest_version)
-        if snapshot is not None:
-            # The device applied the same batch: record the post-batch
-            # mirror snapshot as the synced point and pre-encode the
-            # chunks this batch created — O(chunks created this batch)
-            # via the mirror's take_fresh_chunks hint — so a fault at ANY
-            # later batch leaves the probe a cheap diff.
-            self._jax.note_synced(
-                snapshot(),
-                take_fresh() if take_fresh is not None else None,
-            )
+        with begin_span("apply", attrs={"version": now,
+                                        "n_txn": len(txns)}):
+            self._cpu.apply_batch(txns, statuses, now, new_oldest_version)
+            if snapshot is not None:
+                # The device applied the same batch: record the
+                # post-batch mirror snapshot as the synced point and
+                # pre-encode the chunks this batch created — O(chunks
+                # created this batch) via the mirror's take_fresh_chunks
+                # hint — so a fault at ANY later batch leaves the probe a
+                # cheap diff.
+                self._jax.note_synced(
+                    snapshot(),
+                    take_fresh() if take_fresh is not None else None,
+                )
         return statuses
 
     def _rehydrate_from_mirror(self, snapshot, take_fresh) -> None:
@@ -336,9 +354,12 @@ class ConflictSet:
         (asserted via rehydrate_keys_encoded telemetry).  load_from can
         itself fault (grow) — a fault here fails the probe (the caller's
         except block handles it)."""
-        self._jax.load_from(
-            snapshot() if snapshot is not None else self._cpu
-        )
+        from ..flow.spans import begin_span
+
+        with begin_span("rehydrate"):
+            self._jax.load_from(
+                snapshot() if snapshot is not None else self._cpu
+            )
         if take_fresh is not None:
             # load_from just encoded every live chunk; the fresh backlog
             # from the degraded window is now moot.
@@ -516,6 +537,16 @@ class ConflictSet:
         # keeping the circuit from ever opening on a sync-faulting device.
         self._jax.metrics.counter("pipeline_dispatches").add()
         entry = InflightBatch(txns, ticket, now, new_oldest_version)
+        # Span layer (ISSUE 12): remember the owning batch span (the
+        # resolver pushed it for this synchronous submit) so the deferred
+        # completion's sync/apply spans parent correctly, and open the
+        # device in-flight span — it closes at sync_ticket, so two of
+        # these overlapping on one resolver is the pipeline overlap the
+        # efficiency gauge measures.
+        from ..flow.spans import begin_span, current_span
+
+        entry.span = current_span()
+        entry.device_span = begin_span("device", attrs={"version": now})
         self._pipe.append(entry)
         return entry
 
@@ -528,10 +559,20 @@ class ConflictSet:
         real async XLA failure) or a fixpoint divergence drains the
         WHOLE pipeline onto the mirror instead — bit-identical verdicts
         either way, device marked stale for the next submit."""
+        from ..flow.spans import begin_span
+
         entry = self._pipe[0]
+        # Sync span under the owning batch span; the device in-flight
+        # span (open since dispatch) closes when the sync returns — on
+        # every path, so a fault can't leak an open span.
+        sspan = begin_span("sync", parent=entry.span,
+                           attrs={"version": entry.now})
         try:
             statuses, diverged = self._jax.sync_ticket(entry.ticket)
         except DeviceFault as e:
+            sspan.end(attrs={"error": type(e).__name__})
+            if entry.device_span is not None:
+                entry.device_span.end(attrs={"fault": 1})
             self._breaker.on_failure(e)
             self._device_stale = True
             self._degraded_last = True
@@ -545,12 +586,18 @@ class ConflictSet:
             # site="sync": keep readback-time failures distinguishable
             # from dispatch-time ones in the breaker's fault counters
             # and transition reasons (incident triage).
+            sspan.end(attrs={"error": "JaxRuntimeError"})
+            if entry.device_span is not None:
+                entry.device_span.end(attrs={"fault": 1})
             fault = DeviceUnavailable(f"sync: {e}", site="sync")
             self._breaker.on_failure(fault)
             self._device_stale = True
             self._degraded_last = True
             self._pipeline_replay_on_mirror()
             return
+        sspan.end()
+        if entry.device_span is not None:
+            entry.device_span.end(attrs={"diverged": 1} if diverged else None)
         if diverged:
             # The fixpoint left this batch undecided: detect_core left
             # the device history UNCHANGED for it, so every later
@@ -570,16 +617,24 @@ class ConflictSet:
         self._breaker.on_success()
         self._pipe.popleft()
         statuses_list = [int(s) for s in statuses[: len(entry.txns)]]
-        self._cpu.apply_batch(
-            entry.txns, statuses_list, entry.now, entry.new_oldest_version
-        )
-        snapshot = getattr(self._cpu, "snapshot", None)
-        take_fresh = getattr(self._cpu, "take_fresh_chunks", None)
-        if snapshot is not None:
-            self._jax.note_synced(
-                snapshot(),
-                take_fresh() if take_fresh is not None else None,
+        # Mirror apply span (ISSUE 12): the host phase the pipeline hides
+        # under a successor's device compute — its seq interval lands
+        # inside the successor's device span, which is exactly the
+        # "overlapping dispatch/apply sibling spans" the timeline shows.
+        with begin_span("apply", parent=entry.span,
+                        attrs={"version": entry.now,
+                               "n_txn": len(entry.txns)}):
+            self._cpu.apply_batch(
+                entry.txns, statuses_list, entry.now,
+                entry.new_oldest_version,
             )
+            snapshot = getattr(self._cpu, "snapshot", None)
+            take_fresh = getattr(self._cpu, "take_fresh_chunks", None)
+            if snapshot is not None:
+                self._jax.note_synced(
+                    snapshot(),
+                    take_fresh() if take_fresh is not None else None,
+                )
         entry._resolve(statuses_list, degraded=False)
 
     def _pipeline_replay_on_mirror(self, degraded: bool = True) -> None:
@@ -595,6 +650,10 @@ class ConflictSet:
         depth)."""
         while self._pipe:
             entry = self._pipe.popleft()
+            if entry.device_span is not None:
+                # The parked batch never reached its sync: close the
+                # in-flight span on the replay path too.
+                entry.device_span.end(attrs={"replayed": 1})
             if self._jax is not None:
                 self._jax.metrics.counter("pipeline_replayed_batches").add()
             if degraded:

@@ -294,6 +294,14 @@ class DeviceCircuitBreaker:
         self.transitions.append([self.seq, frm, to, reason])
         if self.metrics is not None:
             self.metrics.gauge("backend_state").set(_STATE_GAUGE[to])
+        # Marker span (ISSUE 12): breaker/probe walks on the same
+        # timeline as the batch spans they degrade.
+        from ..flow.spans import instant
+
+        instant(
+            f"breaker.{to}", role="DeviceBreaker",
+            attrs={"from": frm, "reason": reason, "seq": self.seq},
+        )
         TraceEvent("DeviceBackendStateChange", severity=20).detail(
             "from", frm
         ).detail("to", to).detail("reason", reason).detail(

@@ -1704,6 +1704,10 @@ class JaxConflictSet:
         # Per-batch padding occupancy (txn/read/write slot utilization of
         # the padded capacities), refreshed on every dispatch.
         self.last_occupancy: dict = {}
+        # Most recent completed "dispatch" span (ISSUE 12): the parent
+        # the phase-attribution harness attaches its per-phase child
+        # spans to.  None until the first dispatch (or spans disabled).
+        self.last_dispatch_span = None
         # Mirror-snapshot sync bookkeeping (ISSUE 9): the stamp of the
         # last MirrorSnapshot this device state equals (note_synced /
         # load_from).  Chunk encodings live on the snapshot's immutable
@@ -1886,10 +1890,14 @@ class JaxConflictSet:
         now: int,
         new_oldest_version: int,
     ) -> List[int]:
+        from ..flow.spans import begin_span
+
         mt, mr, mw = self.bucket_mins
-        pb = PackedBatch.from_transactions(
-            transactions, self.key_words, min_txn=mt, min_rr=mr, min_wr=mw
-        )
+        with begin_span("encode", attrs={"n_txn": len(transactions)}):
+            pb = PackedBatch.from_transactions(
+                transactions, self.key_words,
+                min_txn=mt, min_rr=mr, min_wr=mw,
+            )
         statuses = self.detect_packed(pb, now, new_oldest_version)
         return [int(s) for s in statuses[: len(transactions)]]
 
@@ -1983,7 +1991,18 @@ class JaxConflictSet:
             pb, now, new_oldest_version, do_major if self.tiered else do_evict
         )
         from ..flow.metrics import wall_now
+        from ..flow.spans import begin_span
 
+        # Dispatch span (ISSUE 12): host transfer enqueue + (on a cache
+        # miss) the XLA trace/compile — NOT device compute (no sync
+        # here).  Parents to the resolver's batch span when one is on
+        # the hub stack; the phase-attribution harness hangs its
+        # per-phase child spans off `last_dispatch_span`.
+        _dspan = begin_span(
+            "dispatch",
+            attrs={"n_txn": pb.n_txn, "version": now,
+                   "first_dispatch": int(first_dispatch)},
+        )
         _t0 = wall_now()
         tiered_step = (
             _tiered_blob_step if self._donate_steps
@@ -2055,9 +2074,12 @@ class JaxConflictSet:
             # stale (rehydrate before reuse).
             from .device_faults import CompileFailed, DeviceUnavailable
 
+            _dspan.end(attrs={"error": "JaxRuntimeError"})
             kind = CompileFailed if first_dispatch else DeviceUnavailable
             raise kind(f"xla: {e}", site="compile" if first_dispatch
                        else "dispatch") from e
+        _dspan.end()
+        self.last_dispatch_span = _dspan
         if first_dispatch:
             self._bucket_dispatches[shape_key] = 0
             m.counter("retraces").add()
@@ -2138,10 +2160,14 @@ class JaxConflictSet:
         device in dispatch order, so a ticket's successor already decides
         against this batch's committed writes (commit-order exactness);
         only the host-side sync/mirror work is deferred to sync_ticket."""
+        from ..flow.spans import begin_span
+
         mt, mr, mw = self.bucket_mins
-        pb = PackedBatch.from_transactions(
-            transactions, self.key_words, min_txn=mt, min_rr=mr, min_wr=mw
-        )
+        with begin_span("encode", attrs={"n_txn": len(transactions)}):
+            pb = PackedBatch.from_transactions(
+                transactions, self.key_words,
+                min_txn=mt, min_rr=mr, min_wr=mw,
+            )
         statuses, undecided = self.dispatch_packed(pb, now, new_oldest_version)
         # COPY the carried count scalars: the carried arrays themselves
         # are donated into the next dispatch (reading them after a

@@ -1,0 +1,271 @@
+"""Device phase-attribution harness (ISSUE 12): apportion one conflict
+step's cost across the engine's phases via the in-step FDB_TPU_ABLATE
+discipline, and hang the result off the dispatch span as child spans.
+
+PERF_NOTES' failed-detour rule stands: standalone per-phase microbenches
+lie (XLA fuses across phase boundaries, so a phase benched alone prices
+materializations the fused program never pays).  The honest form is
+subtractive IN-STEP ablation — the seams already cut into the flat
+``detect_core`` for the round-5/6 experiments:
+
+    phase      ablation   what the ablated program skips
+    search     nosearch   phase 1's history binary searches + range-max
+    fixpoint   nofix      phases 2-4's intra-batch fixpoint iteration
+    merge      nomerge    phases 5-6 entirely (merge + evict)
+    evict      noevict    phase 6's eviction compaction sort
+
+``attribute_phases`` traces the full program and each ablated twin with
+a FRESH jit wrapper per arm (the ablation flag is read at trace time, so
+sharing the module-level wrapper's cache would silently reuse the wrong
+graph) and attributes per phase as full − ablated, on two axes:
+
+* **static FLOPs** from XLA's cost analysis — deterministic for a fixed
+  program + jax version, cross-checked against ``program_cost_table()``
+  (same analysis, canonical shapes): these drive the recorded child
+  spans and survive the byte-identical artifact gates;
+* optionally (``measure=True``) **measured wall seconds** per executed
+  arm — the realized-phase-time number ROADMAP item 1's kernel work is
+  judged against.  Wall values stay out of the deterministic report
+  block (the record_wall discipline).
+
+Tiered mode raises, exactly like the engine does for FDB_TPU_ABLATE:
+the ablation seams live in the flat step only.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..flow.knobs import g_env
+from ..flow.metrics import wall_now
+from .types import TransactionConflictInfo
+
+# (phase name, FDB_TPU_ABLATE token).  Order matters: "merge" covers
+# phases 5-6, so the evict share is carved out of it below.
+PHASE_ABLATIONS = (
+    ("search", "nosearch"),
+    ("fixpoint", "nofix"),
+    ("merge", "nomerge"),
+    ("evict", "noevict"),
+)
+
+
+class _ablation:
+    """Set FDB_TPU_ABLATE for one arm's trace and restore it after."""
+
+    def __init__(self, token: str):
+        self.token = token
+        self._prev: Optional[str] = None
+
+    def __enter__(self):
+        self._prev = (
+            os.environ["FDB_TPU_ABLATE"]  # fdblint: ignore[ENV001]: the harness restores the declared flag it temporarily sets; steady-state reads go through g_env
+            if "FDB_TPU_ABLATE" in os.environ  # fdblint: ignore[ENV001]: presence check for exact restore (unset vs empty)
+            else None
+        )
+        os.environ["FDB_TPU_ABLATE"] = self.token  # fdblint: ignore[ENV001]: the ablation arm IS the declared flag's documented use; set around one trace, restored in __exit__
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._prev is None:
+            os.environ.pop("FDB_TPU_ABLATE", None)  # fdblint: ignore[ENV001]: restoring the pre-arm state
+        else:
+            os.environ["FDB_TPU_ABLATE"] = self._prev  # fdblint: ignore[ENV001]: restoring the pre-arm state
+        return False
+
+
+def _synthetic_txns(n: int = 24, keyspace: int = 512) -> List[
+        TransactionConflictInfo]:
+    """Deterministic batch for shape-only callers (no live stream)."""
+    from ..flow.rng import DeterministicRandom
+
+    def k(i: int) -> bytes:
+        return b"%08d" % i
+
+    rng = DeterministicRandom(1)
+    out = []
+    for _ in range(n):
+        tr = TransactionConflictInfo(read_snapshot=5)
+        a = rng.random_int(0, keyspace)
+        tr.read_ranges.append((k(a), k(a + 1 + rng.random_int(0, 16))))
+        a = rng.random_int(0, keyspace)
+        tr.write_ranges.append((k(a), k(a + 1 + rng.random_int(0, 8))))
+        out.append(tr)
+    return out
+
+
+def _cost(lowered) -> dict:
+    """{flops, bytes} from XLA's analysis of one lowered arm.  The
+    unoptimized-HLO analysis is enough for SUBTRACTIVE attribution and
+    avoids a full backend compile per arm; both numbers are
+    deterministic for a fixed program + jax version."""
+    ca = lowered.cost_analysis()
+    if isinstance(ca, list):
+        ca = ca[0] if ca else None
+    if not isinstance(ca, dict):
+        ca = lowered.compile().cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0] if ca else None
+    ca = ca or {}
+    return {
+        "flops": float(ca.get("flops", 0.0) or 0.0),
+        "bytes": float(ca.get("bytes accessed", 0.0) or 0.0),
+    }
+
+
+def attribute_phases(engine, transactions=None, *, measure: bool = False,
+                     repeats: int = 3, record: bool = True) -> dict:
+    """Attribute one step's cost across the engine phases.
+
+    engine: a flat-history JaxConflictSet (tiered raises — the ablation
+    seams exist in flat detect_core only).  The engine's CURRENT carried
+    state supplies the history arrays; non-donated fresh jit wrappers
+    leave them untouched, so running this against a live engine is safe.
+
+    Returns a report whose deterministic block (phases/full/shares/
+    cost_table) is byte-stable per seed; measured wall seconds appear
+    under "measured" only when measure=True.  With record=True the
+    static shares are recorded as ``phase.<name>`` child spans of the
+    engine's last dispatch span (the timeline artifact's device
+    phase-attribution lanes)."""
+    from .engine_jax import (
+        EP_H,
+        EP_KW1,
+        EP_RR,
+        EP_TXN,
+        EP_WR,
+        PackedBatch,
+        _blob_core,
+        cached_program_costs,
+    )
+
+    if getattr(engine, "tiered", False):
+        raise ValueError(
+            "phase attribution needs the flat engine: the FDB_TPU_ABLATE "
+            "seams live in detect_core only (same restriction as the "
+            "engine's own tiered+ABLATE rejection)"
+        )
+    if g_env.get("FDB_TPU_ABLATE"):
+        raise ValueError(
+            "FDB_TPU_ABLATE is already set — the harness owns the flag "
+            "for the duration of its arms"
+        )
+    mt, mr, mw = engine.bucket_mins
+    txns = transactions if transactions is not None else _synthetic_txns()
+    pb = PackedBatch.from_transactions(
+        txns, engine.key_words, min_txn=mt, min_rr=mr, min_wr=mw
+    )
+    now = engine.oldest_version + 8
+    blob = jnp.asarray(engine._pack_blob(pb, now, engine.oldest_version, 1))
+    args = (engine._hkeys, engine._hvers, engine._hcount, engine._oldest,
+            blob)
+    statics = dict(txn_cap=pb.txn_cap, rr_cap=pb.rr_cap, wr_cap=pb.wr_cap,
+                   h_cap=engine.h_cap, kw1=engine.key_words + 1,
+                   amortized=False)
+    static_names = tuple(statics)
+
+    arms: dict = {}
+    _keep = []  # hold every arm's callable: a GC'd one could recycle
+    #             its id() into a later arm's cache key
+    for phase, token in (("full", ""),) + PHASE_ABLATIONS:
+        with _ablation(token):
+            # Fresh FUNCTION OBJECT per arm, not just a fresh jit
+            # wrapper: jax's trace cache keys on the underlying
+            # callable's identity, so jit(_blob_core) under a different
+            # ablation flag would silently hand back the first arm's
+            # graph (the flag is read at TRACE time).
+
+            def _arm_core(*a, **kw):
+                return _blob_core(*a, **kw)
+
+            _keep.append(_arm_core)
+            step = jax.jit(_arm_core, static_argnames=static_names)
+            lowered = step.lower(*args, **statics)
+            arm = dict(_cost(lowered))
+            if measure:
+                compiled = lowered.compile()
+                jax.block_until_ready(compiled(*args))  # warm first run
+                t0 = wall_now()
+                for _ in range(repeats):
+                    jax.block_until_ready(compiled(*args))
+                arm["wall_seconds"] = (wall_now() - t0) / repeats
+            arms[phase] = arm
+
+    full = arms["full"]
+    phases = []
+    for phase, _token in PHASE_ABLATIONS:
+        d_flops = max(0.0, full["flops"] - arms[phase]["flops"])
+        phases.append({"phase": phase, "flops": d_flops})
+    # merge's ablation skips phases 5-6 wholesale; carve evict out so the
+    # shares partition instead of double-counting.
+    by_name = {p["phase"]: p for p in phases}
+    by_name["merge"]["flops"] = max(
+        0.0, by_name["merge"]["flops"] - by_name["evict"]["flops"]
+    )
+    attributed = sum(p["flops"] for p in phases)
+    for p in phases:
+        p["share"] = round(p["flops"] / full["flops"], 4) if full[
+            "flops"] else 0.0
+    report: dict = {
+        "shapes": dict(statics),
+        "full": full if not measure else {
+            k: v for k, v in full.items() if k != "wall_seconds"
+        },
+        "phases": phases,
+        "residual_flops": max(0.0, full["flops"] - attributed),
+    }
+    # Cross-check against program_cost_table(): at the registry's
+    # canonical trace shapes the two analyses price the SAME program, so
+    # the flat_step block's flops must agree with our full arm.
+    table = cached_program_costs() or {}
+    flat_blk = table.get("flat_step")
+    canonical = (pb.txn_cap, pb.rr_cap, pb.wr_cap, engine.h_cap,
+                 engine.key_words + 1) == (EP_TXN, EP_RR, EP_WR, EP_H,
+                                           EP_KW1)
+    if flat_blk and flat_blk.get("flops_per_batch") is not None:
+        report["cost_table"] = {
+            "flat_step_flops": flat_blk["flops_per_batch"],
+            "canonical_shapes": canonical,
+            "ratio_vs_full": round(
+                full["flops"] / flat_blk["flops_per_batch"], 4
+            ) if flat_blk["flops_per_batch"] else None,
+        }
+    if measure:
+        measured = {}
+        t_full = arms["full"]["wall_seconds"]
+        for phase, _token in PHASE_ABLATIONS:
+            measured[phase] = round(
+                max(0.0, t_full - arms[phase]["wall_seconds"]), 6
+            )
+        measured["evict"] = min(measured["evict"], measured["merge"])
+        measured["merge"] = round(
+            max(0.0, measured["merge"] - measured["evict"]), 6
+        )
+        report["measured"] = {
+            "full_wall_seconds": round(t_full, 6),
+            "phase_wall_seconds": measured,
+            "repeats": repeats,
+        }
+    if record:
+        _record_phase_spans(engine, phases)
+    return report
+
+
+def _record_phase_spans(engine, phases) -> None:
+    """Child spans of the engine's last dispatch span, one per phase,
+    carrying the static attribution (deterministic attrs only — wall
+    numbers live in the report, never in exported spans)."""
+    from ..flow.spans import begin_span
+
+    parent = getattr(engine, "last_dispatch_span", None)
+    for p in phases:
+        sp = begin_span(
+            f"phase.{p['phase']}",
+            parent=parent,
+            attrs={"flops": p["flops"], "share": p["share"]},
+        )
+        sp.end()

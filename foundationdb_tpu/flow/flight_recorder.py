@@ -95,8 +95,10 @@ class FlightRecorder:
         now: Optional[float] = None,
     ) -> dict:
         """Freeze one artifact NOW (no cooldown, no enable gate): the
-        last-N time-series window, the recent trace events, the caller's
-        transition-log snapshot, and the trigger context."""
+        last-N time-series window, the recent trace events, the recent
+        span window (ISSUE 12), the caller's transition-log snapshot,
+        and the trigger context."""
+        from .spans import global_span_hub
         from .timeseries import global_timeseries
         from .trace import global_collector
 
@@ -121,6 +123,9 @@ class FlightRecorder:
             "recent_events": global_collector().recent_events()[
                 -self.window:
             ],
+            # Deterministic by construction (wall fields excluded by
+            # Span.to_dict) — the artifact stays byte-identical per seed.
+            "spans": global_span_hub().window_dict(last_n=self.window),
         }
         self.captures.append(artifact)
         return artifact

@@ -346,6 +346,20 @@ g_env.declare("FDB_TPU_FLIGHTREC_COOLDOWN", "5.0",
 g_env.declare("FDB_TPU_FLIGHTREC_WINDOW", "64",
               help="time-series samples and trace events included per "
                    "capture (the last-N window of each)")
+# Commit-path span tracing (ISSUE 12): structured begin/end intervals
+# over client GRV/commit, proxy batch assembly, resolver pipeline
+# stages, tlog push — flow/spans.py + the Perfetto export
+# (flow/trace_export.py, `cli trace-export`).
+g_env.declare("FDB_TPU_SPANS", "1",
+              help="0 disables commit-path span recording "
+                   "(flow/spans.py); default on — spans observe virtual "
+                   "time and a monotonic event counter only, never the "
+                   "loop rng, so recording perturbs no sim decision")
+g_env.declare("FDB_TPU_SPANS_PER_ROLE", "4096",
+              help="completed spans retained per role track (bounded "
+                   "ring maxlen on the global SpanHub); the Perfetto "
+                   "export, flight-recorder span windows, and `cli "
+                   "latency` stage percentiles all read this ring")
 # Double-buffered async resolver pipeline (ISSUE 11): overlap the host
 # phases (mirror apply of batch N-1, pack/encode of batch N+1) with
 # device compute of batch N.

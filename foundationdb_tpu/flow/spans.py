@@ -1,0 +1,435 @@
+"""Structured commit-path spans: first-class begin/end intervals over the
+whole commit pipeline (ISSUE 12 tentpole, the layer the reference's
+CommitDebug/TransactionDebug trace-point chains approximate by joining
+point events on debug ids after the fact).
+
+A Span is (name, role, parent, start/end in loop-virtual time, a pair of
+monotonic event-sequence stamps, attributes).  Roles are tracks — one per
+instrumented role object (Resolver.res0, Proxyproxy0, TLog.tlog0,
+client, ...) — and parent links make the per-batch stage structure
+explicit: a resolver batch span owns its encode/dispatch/device/sync/
+apply/reply children, and two overlapping device spans on one resolver
+ARE the pipeline overlap ISSUE 11 built.
+
+Two clocks, one discipline (the PR-2 `record_wall` split):
+
+* ``start``/``stop`` are loop-virtual time and ``seq``/``end_seq`` are
+  the hub's monotonic event counter — both deterministic, so same-seed
+  runs produce byte-identical ``spans_json()`` (the acceptance gate).
+  The seq pair matters because virtual time does not advance during
+  synchronous host work: host-phase spans are vt-instantaneous, and the
+  sequence counter is the interleaving clock that still shows batch
+  N+1's encode running strictly inside batch N's device window.
+* ``wall_start``/``wall_end`` are real perf_counter reads for real-mode
+  timing (bench, perf_experiments).  They are EXCLUDED from
+  ``to_dict()``/``spans_json()``/the Perfetto export by default — wall
+  values in a sim-compared artifact would break byte identity.
+
+Parenting uses an explicit argument OR the hub's current-span stack.
+The stack is only valid across SYNCHRONOUS sections: ``with`` a span (or
+``use_span``) around code that never awaits; a span that must outlive an
+await (a proxy phase, a parked pipeline batch, the device in-flight
+window) is held by reference and ``.end()``ed explicitly.  flowcheck's
+SPN001 rejects a ``begin_span()`` result that is neither context-managed
+nor ``.end()``ed nor stored (a leaked open span — TRC001's span-layer
+mirror).
+
+Completed spans land in a bounded per-role ring on the global SpanHub
+(swap per run with ``set_global_span_hub``, exactly like the trace
+collector and the time-series hub); open spans are never exported.
+Span ids fork from the run's seed: the hub stamps the current loop's
+DeterministicRandom seed (read, never drawn from — recording a span
+must not perturb one sim decision) into the json header, and ids are
+the hub's deterministic begin-order sequence.
+"""
+
+from __future__ import annotations
+
+import json
+from collections import deque
+from typing import Dict, List, Optional
+
+from .knobs import g_env
+from .metrics import wall_now
+
+
+def _vt_now() -> float:
+    """Span timestamp: the current loop's virtual time; 0.0 without a
+    loop (bench/tools — the seq counter and wall clocks carry timing
+    there) so spans_json never contains a wall-derived stamp."""
+    from .eventloop import _current_loop
+
+    return _current_loop.now() if _current_loop is not None else 0.0
+
+
+class Span:
+    """One interval.  Begin via ``begin_span``/``span_hub().begin``; end
+    via ``.end()`` or by using the span as a context manager (which also
+    pushes it on the hub's current-span stack for child parenting)."""
+
+    __slots__ = ("span_id", "parent_id", "name", "role", "start", "stop",
+                 "seq", "end_seq", "attrs", "wall_start", "wall_end",
+                 "_hub")
+
+    def __init__(self, hub, span_id, parent_id, name, role, start, seq,
+                 attrs):
+        self._hub = hub
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.name = name
+        self.role = role
+        self.start = start
+        self.seq = seq
+        self.stop = None
+        self.end_seq = None
+        self.attrs: dict = attrs if attrs is not None else {}
+        self.wall_start = wall_now()
+        self.wall_end = None
+
+    @property
+    def done(self) -> bool:
+        return self.stop is not None
+
+    def annotate(self, key: str, value) -> "Span":
+        self.attrs[key] = value
+        return self
+
+    def end(self, attrs: Optional[dict] = None) -> None:
+        """Close the span and commit it to the hub's per-role ring.
+        Idempotent: the first end wins (a fault path and its cleanup may
+        both try)."""
+        if self.stop is not None:
+            return
+        if attrs:
+            self.attrs.update(attrs)
+        self._hub._finish(self)
+
+    def to_dict(self, include_wall: bool = False) -> dict:
+        out = {
+            "id": self.span_id,
+            "parent": self.parent_id,
+            "name": self.name,
+            "role": self.role,
+            "start": self.start,
+            "end": self.stop,
+            "seq": self.seq,
+            "end_seq": self.end_seq,
+            "attrs": dict(self.attrs),
+        }
+        if include_wall:
+            out["wall_start"] = self.wall_start
+            out["wall_end"] = self.wall_end
+        return out
+
+    # -- context-manager form: push/pop the hub stack, end on exit -------
+    def __enter__(self) -> "Span":
+        self._hub._push(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        self._hub._pop(self)
+        if exc is not None and "error" not in self.attrs:
+            self.attrs["error"] = type(exc).__name__
+        self.end()
+        return False
+
+
+class _NullSpan:
+    """Inert stand-in returned while spans are disabled (FDB_TPU_SPANS=0)
+    so call sites need no branches.  Shared singleton; every operation is
+    a no-op."""
+
+    __slots__ = ()
+    span_id = None
+    parent_id = None
+    name = role = ""
+    start = stop = None
+    seq = end_seq = None
+    wall_start = wall_end = None
+    attrs: dict = {}
+    done = True
+
+    def annotate(self, key, value):
+        return self
+
+    def end(self, attrs=None):
+        pass
+
+    def to_dict(self, include_wall: bool = False) -> dict:
+        return {}
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+
+NULL_SPAN = _NullSpan()
+
+
+class SpanHub:
+    """Per-role bounded rings of COMPLETED spans + the current-span stack
+    + the monotonic event-sequence counter (the interleaving clock)."""
+
+    def __init__(self, per_role: Optional[int] = None):
+        self.per_role = (
+            per_role
+            if per_role is not None
+            else max(16, g_env.get_int("FDB_TPU_SPANS_PER_ROLE"))
+        )
+        self.rings: Dict[str, deque] = {}
+        self._stack: List[Span] = []
+        self._seq = 0
+        self.begun = 0  # lifetime spans begun (rings may have dropped)
+        self.seed: Optional[int] = None  # stamped from the loop's rng
+
+    # -- lifecycle -------------------------------------------------------
+    def begin(self, name: str, role: Optional[str] = None,
+              parent: Optional[Span] = None,
+              attrs: Optional[dict] = None) -> Span:
+        if self.seed is None:
+            from .eventloop import _current_loop
+
+            if _current_loop is not None:
+                # READ the seed only — drawing from the rng here would
+                # shift every downstream sim decision by one sample.
+                self.seed = getattr(_current_loop.rng, "seed", None)
+        if parent is None and self._stack:
+            parent = self._stack[-1]
+        if isinstance(parent, _NullSpan):
+            parent = None
+        if role is None:
+            role = parent.role if parent is not None else "span"
+        self._seq += 1
+        self.begun += 1
+        return Span(
+            self, self.begun,
+            parent.span_id if parent is not None else None,
+            name, role, _vt_now(), self._seq, attrs,
+        )
+
+    def _finish(self, span: Span) -> None:
+        self._seq += 1
+        span.end_seq = self._seq
+        span.stop = _vt_now()
+        span.wall_end = wall_now()
+        ring = self.rings.get(span.role)
+        if ring is None:
+            ring = self.rings[span.role] = deque(maxlen=self.per_role)
+        ring.append(span)
+
+    # -- current-span stack (synchronous sections ONLY) ------------------
+    def _push(self, span: Span) -> None:
+        self._stack.append(span)
+
+    def _pop(self, span: Span) -> None:
+        if self._stack and self._stack[-1] is span:
+            self._stack.pop()
+        elif span in self._stack:  # tolerate mismatched exits
+            self._stack.remove(span)
+
+    def current(self) -> Optional[Span]:
+        return self._stack[-1] if self._stack else None
+
+    # -- read surfaces ---------------------------------------------------
+    def spans(self, role: Optional[str] = None,
+              name: Optional[str] = None) -> List[Span]:
+        """Completed spans, oldest first — one role's ring, or every
+        ring in sorted role order; optionally filtered by span name."""
+        if role is not None:
+            out = list(self.rings.get(role, ()))
+        else:
+            out = [s for r in sorted(self.rings) for s in self.rings[r]]
+        if name is not None:
+            out = [s for s in out if s.name == name]
+        return out
+
+    def window_dict(self, last_n: Optional[int] = None,
+                    include_wall: bool = False) -> dict:
+        """role -> [span dict, ...] (oldest first), optionally the last N
+        per role — the flight recorder's capture shape."""
+        out: Dict[str, List[dict]] = {}
+        for role in sorted(self.rings):
+            spans = list(self.rings[role])
+            if last_n is not None:
+                spans = spans[-last_n:]
+            out[role] = [s.to_dict(include_wall=include_wall) for s in spans]
+        return out
+
+    def spans_json(self, last_n: Optional[int] = None) -> str:
+        """Canonical byte form — what the same-seed determinism gate
+        compares.  Wall fields are excluded by construction."""
+        return json.dumps(
+            {"seed": self.seed, "spans": self.window_dict(last_n=last_n)},
+            sort_keys=True,
+            separators=(",", ":"),
+        )
+
+    def status_section(self) -> dict:
+        return {
+            "roles": {r: len(ring) for r, ring in sorted(self.rings.items())},
+            "begun": self.begun,
+            "per_role": self.per_role,
+        }
+
+    def clear(self) -> None:
+        self.rings.clear()
+        self._stack.clear()
+        self._seq = 0
+        self.begun = 0
+        self.seed = None
+
+
+_global_hub = SpanHub()
+
+
+def set_global_span_hub(hub: SpanHub) -> None:
+    global _global_hub
+    _global_hub = hub
+
+
+def global_span_hub() -> SpanHub:
+    return _global_hub
+
+
+def spans_enabled() -> bool:
+    return g_env.get("FDB_TPU_SPANS") not in ("", "0")
+
+
+def begin_span(name: str, role: Optional[str] = None,
+               parent: Optional[Span] = None,
+               attrs: Optional[dict] = None):
+    """Begin one span on the CURRENT global hub (the instrumentation
+    entry point).  Returns NULL_SPAN when spans are disabled, so call
+    sites carry no enable branches.  The result must be context-managed,
+    ``.end()``ed, or stored for a later end — flowcheck SPN001 flags a
+    dropped result as a leaked open span."""
+    if not spans_enabled():
+        return NULL_SPAN
+    return _global_hub.begin(name, role=role, parent=parent, attrs=attrs)
+
+
+def current_span() -> Optional[Span]:
+    """The innermost span pushed by a ``with`` block on the current hub
+    (None outside any).  Synchronous sections only — see module doc."""
+    return _global_hub.current()
+
+
+class use_span:
+    """Push an EXISTING (still-open) span for a synchronous section so
+    nested ``begin_span`` calls parent to it — WITHOUT ending it on exit
+    (unlike the span's own context-manager form).  ``use_span(None)`` is
+    a no-op, so completion paths need no branches."""
+
+    __slots__ = ("_span",)
+
+    def __init__(self, span: Optional[Span]):
+        self._span = (
+            None if span is None or isinstance(span, _NullSpan) else span
+        )
+
+    def __enter__(self):
+        if self._span is not None:
+            self._span._hub._push(self._span)
+        return self._span
+
+    def __exit__(self, exc_type, exc, tb):
+        if self._span is not None:
+            self._span._hub._pop(self._span)
+        return False
+
+
+def instant(name: str, role: Optional[str] = None,
+            attrs: Optional[dict] = None) -> None:
+    """Zero-width marker span (breaker/ratekeeper transitions): begins
+    and ends immediately, landing in the ring like any completed span."""
+    sp = begin_span(name, role=role, attrs=attrs)
+    sp.end()
+
+
+# ---------------------------------------------------------------------------
+# Derived metrics: pipeline overlap efficiency + span-based latency stages
+# ---------------------------------------------------------------------------
+
+
+def interval_overlap(intervals: List[tuple]) -> tuple:
+    """(total, union) measure of a list of (begin, end) intervals.  The
+    pipeline overlap-efficiency metric is (total - union) / total: the
+    fraction of device time during which ANOTHER device interval was
+    also open (0.0 for a synchronous depth-1 stream, approaching 0.5 for
+    a perfectly double-buffered one)."""
+    total = 0.0
+    union = 0.0
+    hwm = None
+    for b, e in sorted(intervals):
+        d = e - b
+        if d <= 0:
+            continue
+        total += d
+        if hwm is None or b >= hwm:
+            union += d
+            hwm = e
+        elif e > hwm:
+            union += e - hwm
+            hwm = e
+    return total, union
+
+
+def overlap_efficiency(spans: List[Span], axis: str = "seq") -> float:
+    """Overlapped device time / total device time over the given spans.
+    axis="seq" uses the hub's deterministic event-sequence stamps (the
+    sim clock that still advances during synchronous host work — the
+    byte-identical gauge); axis="wall" uses real perf_counter reads (the
+    bench/PERF_NOTES number); axis="vt" uses loop-virtual time."""
+    keys = {
+        "seq": lambda s: (s.seq, s.end_seq),
+        "wall": lambda s: (s.wall_start, s.wall_end),
+        "vt": lambda s: (s.start, s.stop),
+    }[axis]
+    intervals = [keys(s) for s in spans
+                 if s.done and keys(s)[0] is not None]
+    total, union = interval_overlap(intervals)
+    if total <= 0:
+        return 0.0
+    return (total - union) / total
+
+
+def span_latency_summary(hub: Optional[SpanHub] = None,
+                         axis: str = "vt") -> dict:
+    """role -> span name -> {count, p50, p90, p99, max} over completed
+    spans' durations — `cli latency`'s default source (the latency_chain
+    reassembly stays for trace-file-only inputs).  Virtual-time
+    durations: host-synchronous stages read 0 in sim by construction
+    (virtual time does not advance without an await); the stages that
+    matter for admission — resolve_batch, device, proxy phases, client
+    commit/GRV — all cross awaits and carry real virtual durations."""
+    from .latency_chain import percentile
+
+    hub = hub if hub is not None else _global_hub
+    out: Dict[str, dict] = {}
+    for role in sorted(hub.rings):
+        by_name: Dict[str, List[float]] = {}
+        for s in hub.rings[role]:
+            if not s.done:
+                continue
+            if axis == "wall":
+                d = (s.wall_end - s.wall_start
+                     if s.wall_end is not None else None)
+            else:
+                d = s.stop - s.start if s.stop is not None else None
+            if d is None:
+                continue
+            by_name.setdefault(s.name, []).append(d)
+        stages = {}
+        for name in sorted(by_name):
+            samples = by_name[name]
+            stages[name] = {
+                "count": len(samples),
+                "p50": percentile(samples, 0.5),
+                "p90": percentile(samples, 0.90),
+                "p99": percentile(samples, 0.99),
+                "max": max(samples),
+            }
+        out[role] = stages
+    return out

@@ -1,0 +1,215 @@
+"""Chrome trace-event / Perfetto export of the span layer (ISSUE 12).
+
+Turns the SpanHub's completed spans into one canonical trace-event JSON
+artifact loadable by ui.perfetto.dev / chrome://tracing:
+
+* one PROCESS (pid) per span role, named via "M" (metadata) events —
+  pids are assigned by sorted role name, so they are stable per role
+  within an artifact and identical across same-seed runs;
+* B/E duration-event pairs per span.  The timestamp axis is
+  ``vt_microseconds + seq * 1e-3``: virtual time carries the real
+  ordering, and the hub's event-sequence stamp breaks the ties that
+  virtual time cannot (synchronous host work is vt-instantaneous), so
+  every B strictly precedes its E and nesting is well defined;
+* tids are LANES assigned greedily per pid: a span nests into the
+  innermost open span that contains it, otherwise it opens the first
+  free lane — which is exactly how two overlapping pipeline batches of
+  one resolver land on separate lanes with their stage children nested
+  under them (the "pipeline overlap is visible" requirement), while a
+  synchronous depth-1 stream stays on one lane.
+
+Determinism: the artifact is built from deterministic span fields only
+(vt, seq, role, name, attrs) unless ``include_wall=True`` explicitly
+opts wall durations into the args — so ``perfetto_json()`` of a
+same-seed run is byte-identical (the acceptance gate).
+
+``validate_perfetto`` is the schema gate the tests pin: every B has a
+matching E (same pid/tid/name, properly nested), pids are stable per
+role, and every pid carries exactly one process_name metadata event.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Optional
+
+from .spans import SpanHub, global_span_hub
+
+
+def _ts(vt: float, seq: int) -> float:
+    """Trace timestamp in microseconds: virtual seconds scaled, with the
+    event-sequence stamp as a sub-microsecond tiebreak (1ns per event)
+    so equal-vt events keep their true order and B < E always holds."""
+    return round(vt * 1e6 + seq * 1e-3, 6)
+
+
+def _assign_lanes(spans: List) -> Dict[int, int]:
+    """span_id -> lane (tid) for one role's spans.
+
+    Parent-aware: a span goes to its PARENT's lane whenever it still
+    nests there (stage children under their own batch slice — a purely
+    geometric first-fit would nest batch N+1's encode, which begins
+    inside batch N's window, under the WRONG batch).  Roots only take a
+    lane that is EMPTY at their begin (two concurrent pipelined batches
+    are siblings side by side, never one inside the other), else open a
+    new lane.  A non-root whose parent is unknown (ring-dropped) falls
+    back to geometric nesting.  Every placement is checked against the
+    lane's open stack, so B/E nesting stays valid by construction."""
+    lanes: List[List[float]] = []  # per lane: stack of open spans' end ts
+    out: Dict[int, int] = {}
+    order = sorted(spans, key=lambda s: (_ts(s.start, s.seq),
+                                         -_ts(s.stop, s.end_seq)))
+    for sp in order:
+        b, e = _ts(sp.start, sp.seq), _ts(sp.stop, sp.end_seq)
+        for stack in lanes:
+            while stack and stack[-1] <= b:
+                stack.pop()
+
+        def _fits(stack):
+            return not stack or e <= stack[-1]
+
+        placed = None
+        parent_lane = out.get(sp.parent_id)
+        if parent_lane is not None and _fits(lanes[parent_lane]):
+            placed = parent_lane
+        if placed is None:
+            for li, stack in enumerate(lanes):
+                if sp.parent_id is None:
+                    if not stack:  # roots never nest under another span
+                        placed = li
+                        break
+                elif _fits(stack):
+                    placed = li
+                    break
+        if placed is None:
+            lanes.append([])
+            placed = len(lanes) - 1
+        lanes[placed].append(e)
+        out[sp.span_id] = placed
+    return out
+
+
+def perfetto_trace(hub: Optional[SpanHub] = None,
+                   include_wall: bool = False,
+                   last_n: Optional[int] = None) -> dict:
+    """Build the trace-event document from the hub's completed spans."""
+    hub = hub if hub is not None else global_span_hub()
+    roles = sorted(hub.rings)
+    events: List[dict] = []
+    for pid, role in enumerate(roles, start=1):
+        spans = list(hub.rings[role])
+        if last_n is not None:
+            spans = spans[-last_n:]
+        spans = [s for s in spans if s.done]
+        if not spans:
+            continue
+        events.append({
+            "ph": "M", "name": "process_name", "pid": pid, "tid": 0,
+            "args": {"name": role},
+        })
+        lanes = _assign_lanes(spans)
+        for sp in spans:
+            tid = lanes[sp.span_id] + 1
+            args = {"span": sp.span_id, **sp.attrs}
+            if sp.parent_id is not None:
+                args["parent"] = sp.parent_id
+            if include_wall and sp.wall_end is not None:
+                args["wall_ms"] = round(
+                    (sp.wall_end - sp.wall_start) * 1e3, 4
+                )
+            events.append({
+                "ph": "B", "name": sp.name, "cat": role, "pid": pid,
+                "tid": tid, "ts": _ts(sp.start, sp.seq), "args": args,
+            })
+            events.append({
+                "ph": "E", "name": sp.name, "cat": role, "pid": pid,
+                "tid": tid, "ts": _ts(sp.stop, sp.end_seq),
+            })
+    # Global ts order (metadata events lead their pid: ts absent sorts
+    # first via the (pid, is-not-meta, ts) key).
+    events.sort(key=lambda e: (e["pid"], e["ph"] != "M", e.get("ts", 0.0)))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "otherData": {
+            "source": "foundationdb_tpu spans (flow/spans.py)",
+            "seed": hub.seed,
+            "spans": sum(1 for e in events if e["ph"] == "B"),
+        },
+    }
+
+
+def perfetto_json(hub: Optional[SpanHub] = None,
+                  include_wall: bool = False,
+                  last_n: Optional[int] = None) -> str:
+    """Canonical byte form of the artifact — what the same-seed gate
+    compares (sort_keys orders dict keys only; the event array keeps its
+    deterministic order)."""
+    return json.dumps(
+        perfetto_trace(hub=hub, include_wall=include_wall, last_n=last_n),
+        sort_keys=True,
+        separators=(",", ":"),
+    )
+
+
+def validate_perfetto(doc: dict) -> List[str]:
+    """Schema gate: returns a list of violations (empty = valid).
+    Checks B/E pairing + nesting per (pid, tid), name matches on E,
+    one process_name per pid, and a stable role -> pid mapping."""
+    errors: List[str] = []
+    events = doc.get("traceEvents")
+    if not isinstance(events, list):
+        return ["traceEvents missing or not a list"]
+    stacks: Dict[tuple, List[dict]] = {}
+    names_by_pid: Dict[int, List[str]] = {}
+    role_pid: Dict[str, int] = {}
+    last_ts: Dict[tuple, float] = {}
+    for i, e in enumerate(events):
+        ph = e.get("ph")
+        if ph == "M":
+            if e.get("name") == "process_name":
+                names_by_pid.setdefault(e["pid"], []).append(
+                    e["args"]["name"]
+                )
+            continue
+        if ph not in ("B", "E"):
+            errors.append(f"event {i}: unexpected ph {ph!r}")
+            continue
+        key = (e.get("pid"), e.get("tid"))
+        ts = e.get("ts")
+        if ts is None:
+            errors.append(f"event {i}: missing ts")
+            continue
+        if last_ts.get(key, float("-inf")) > ts:
+            errors.append(f"event {i}: ts not monotonic within {key}")
+        last_ts[key] = ts
+        if ph == "B":
+            role = e.get("cat")
+            if role is not None:
+                prev = role_pid.setdefault(role, e["pid"])
+                if prev != e["pid"]:
+                    errors.append(
+                        f"role {role!r} spans pids {prev} and {e['pid']}"
+                    )
+            stacks.setdefault(key, []).append(e)
+        else:
+            stack = stacks.get(key)
+            if not stack:
+                errors.append(f"event {i}: E with empty stack on {key}")
+                continue
+            b = stack.pop()
+            if b.get("name") != e.get("name"):
+                errors.append(
+                    f"event {i}: E name {e.get('name')!r} closes B "
+                    f"{b.get('name')!r} on {key}"
+                )
+    for key, stack in stacks.items():
+        if stack:
+            errors.append(
+                f"{len(stack)} unclosed B event(s) on {key}: "
+                f"{[b.get('name') for b in stack]}"
+            )
+    for pid, names in names_by_pid.items():
+        if len(names) != 1:
+            errors.append(f"pid {pid} has {len(names)} process_name events")
+    return errors

@@ -480,6 +480,15 @@ class Proxy:
             batch = batch + lane
             if not batch:
                 continue
+            # GRV reply span (ISSUE 12): the causal-floor read + replies
+            # for this drained batch.  Detached (the sequencer read
+            # awaits); ended on both exits.
+            from ..flow.spans import begin_span
+
+            gspan = begin_span(
+                "grv_batch", role=self.metrics.name,
+                attrs={"n": len(batch)},
+            )
             version = self.committed.get()
             if self.n_proxies > 1:
                 # Another proxy may have committed (and acked) beyond this
@@ -501,6 +510,7 @@ class Proxy:
                     for rep in batch:
                         grv_meta.pop(id(rep), None)
                         rep.send_error("broken_promise")
+                    gspan.end(attrs={"error": "broken_promise"})
                     continue
             for rep in batch:
                 did, t_arr = grv_meta.pop(id(rep), (None, loop.now()))
@@ -511,6 +521,7 @@ class Proxy:
                     did,
                 )
                 rep.send(version)
+            gspan.end(attrs={"version": version})
 
     async def _idle_batch_ticker(self):
         """Cut an EMPTY commit batch when no real batch has gone out for a
@@ -624,6 +635,7 @@ class Proxy:
         self, batch: List[Tuple], local_batch: int, ctx: dict = None
     ):
         from ..flow.eventloop import wait_for_all
+        from ..flow.spans import NULL_SPAN, begin_span
         from ..flow.trace import trace_batch
 
         loop0 = self.process.network.loop
@@ -634,6 +646,26 @@ class Proxy:
             (req.debug_id for req, _r in batch if req.debug_id is not None),
             None,
         )
+        # Batch span (ISSUE 12): real batches only — the idle ticker cuts
+        # an empty batch every commit_batch_idle_interval, which would
+        # bury the ring in no-payload spans.  Phase children are created
+        # with EXPLICIT parents (each crosses awaits, where the hub's
+        # current-span stack is not valid).
+        bspan = (
+            begin_span(
+                "commit_batch", role=self.metrics.name,
+                attrs={"n_txn": len(batch), "local_batch": local_batch},
+            )
+            if batch
+            else NULL_SPAN
+        )
+        def _phase(name):
+            # Phase child span — only under a real batch span (an empty
+            # idle batch records nothing).
+            if bspan is NULL_SPAN:
+                return NULL_SPAN
+            return begin_span(name, parent=bspan)
+
         trace_batch(
             "CommitDebug", "MasterProxyServer.commitBatch.Before", batch_debug
         )
@@ -663,11 +695,14 @@ class Proxy:
         # batch order so this proxy's versions are monotone in batch order
         # (ref: the localBatchNumber chain :362; GetCommitVersionRequest ->
         # masterserver getVersion :783).
+        pspan = _phase("get_version")
         await self._batch_resolving.when_at_least(local_batch - 1)
         gv: GetCommitVersionReply = await self.sequencer.get_commit_version.get_reply(
             self.process, self.epoch  # fenced: only this generation is served
         )
         version, prev = gv.version, gv.prev_version
+        pspan.end(attrs={"version": version})
+        bspan.annotate("version", version)
         trace_batch(
             "CommitDebug",
             "MasterProxyServer.commitBatch.GotCommitVersion",
@@ -737,6 +772,7 @@ class Proxy:
                 )
             return out
 
+        pspan = _phase("resolution")
         replies = await wait_for_all(
             [
                 r.resolve.get_reply(
@@ -758,6 +794,7 @@ class Proxy:
         statuses = [
             min(rep.committed[t] for rep in replies) for t in range(len(batch))
         ]
+        pspan.end(attrs={"n_resolvers": len(self.resolvers)})
         trace_batch(
             "CommitDebug",
             "MasterProxyServer.commitBatch.AfterResolution",
@@ -853,6 +890,7 @@ class Proxy:
             # in the ack set — the remote region's recovery source).
             for li in range(routing_n, n):
                 per_log[li][tag] = muts
+        pspan = _phase("log_push")
         await wait_for_all(
             [
                 tl.commit.get_reply(
@@ -869,6 +907,7 @@ class Proxy:
                 for li, tl in enumerate(self.tlogs)
             ]
         )
+        pspan.end(attrs={"n_logs": len(self.tlogs)})
         trace_batch(
             "CommitDebug",
             "MasterProxyServer.commitBatch.AfterLogPush",
@@ -908,6 +947,8 @@ class Proxy:
         # __init__): one increment per verdict, and both telemetry
         # surfaces read the same value — a lock-rejected txn that resolved
         # COMMITTED counts as rejected_locked, never committed.
+        pspan = _phase("reply")
+        n_committed = 0
         for t, ((req, reply), status) in enumerate(zip(batch, statuses)):
             trace_batch(
                 "CommitDebug",
@@ -919,6 +960,7 @@ class Proxy:
                 reply.send_error("database_locked")
             elif status == COMMITTED:
                 self.stats.add("committed")
+                n_committed += 1
                 reply.send(version)
             elif status == TOO_OLD:
                 self.stats.add("too_old")
@@ -926,3 +968,5 @@ class Proxy:
             else:
                 self.stats.add("conflicted")
                 reply.send_error("not_committed")
+        pspan.end(attrs={"committed": n_committed})
+        bspan.end(attrs={"committed": n_committed})

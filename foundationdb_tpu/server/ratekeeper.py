@@ -500,6 +500,15 @@ class Ratekeeper:
                      round(tps, 3)]
                 )
                 self.metrics.counter("limiting_changes").add()
+                # Marker span (ISSUE 12): admission transitions on the
+                # same timeline as the commit-path spans they throttle.
+                from ..flow.spans import instant
+
+                instant(
+                    "ratekeeper.limiting", role="Ratekeeper",
+                    attrs={"from": self.rate.limiting, "to": limiting,
+                           "tps": round(tps, 3)},
+                )
                 # Flight-recorder trigger (ISSUE 10): the binding signal
                 # changed — freeze the window that explains why.  The
                 # per-kind cooldown keeps a flapping spring from churning

@@ -65,9 +65,10 @@ class _ParkedResolve:
     order), by whichever handler drives the pump."""
 
     __slots__ = ("entry", "req", "reply", "first_unseen", "t_enter",
-                 "finished", "_promise")
+                 "finished", "_promise", "span")
 
-    def __init__(self, entry, req, reply, first_unseen: int, t_enter: float):
+    def __init__(self, entry, req, reply, first_unseen: int, t_enter: float,
+                 span=None):
         self.entry = entry
         self.req = req
         self.reply = reply
@@ -75,6 +76,7 @@ class _ParkedResolve:
         self.t_enter = t_enter
         self.finished = False
         self._promise = None
+        self.span = span  # the batch's resolve_batch span (ISSUE 12)
 
     @property
     def future(self):
@@ -143,8 +145,18 @@ class Resolver:
         )
         for _c in ("batches", "transactions", "committed", "conflicted",
                    "too_old", "cache_hits", "stale_epoch",
-                   "degraded_batches"):
+                   "degraded_batches", "witness_aborts"):
             self.metrics.counter(_c)  # pre-create: snapshots list them all
+        # Conflict-witness telemetry (ISSUE 12 satellite, the
+        # observability seed of ROADMAP item 4): per-batch aborted-txn
+        # counts plus a bounded top-K of the key ranges aborted
+        # transactions were contending on.  Phase 1 computes the precise
+        # range each loser lost to and throws it away on device; until
+        # that surfaces through the reply (item 4 proper), the aborted
+        # txns' own first conflict ranges are the honest host-side
+        # approximation of where contention lives.
+        self._witness_ranges: Dict[tuple, int] = {}
+        self.metrics.gauge("conflict_witness_topk").set("[]")
         # Set once a raw device conflict set faulted and its state was
         # exported host-side: the CPU engine then serves every later batch
         # of this role's life (see _retry_on_cpu).
@@ -176,6 +188,19 @@ class Resolver:
         for _c in ("pipeline_device_stalls", "pipeline_host_stalls"):
             self.metrics.counter(_c)  # pre-create: snapshots list them all
         self.metrics.histogram("pipeline_inflight_depth")
+        # Pipeline overlap efficiency (ISSUE 12): overlapped device time
+        # / total device time over completed device in-flight spans,
+        # measured on the span hub's EVENT-SEQUENCE clock (deterministic:
+        # virtual time does not advance during synchronous host work, so
+        # seq is the clock that still shows batch N+1's dispatch running
+        # inside batch N's device window).  Incremental union: device
+        # spans complete in dispatch order, so one high-water mark
+        # suffices.  The wall twin goes through record_wall only.
+        self.metrics.gauge("pipeline_overlap_efficiency").set(0.0)
+        self._dev_seq_total = 0
+        self._dev_seq_union = 0
+        self._dev_seq_hwm = None
+        self._dev_wall_hwm = None
         process.spawn(self._serve(), "resolver")
         process.spawn(self._serve_metrics(), "resolver_metrics")
         process.spawn(self._serve_split(), "resolver_split")
@@ -467,11 +492,25 @@ class Resolver:
         for tr in req.transactions:
             self._sample(tr)
         window = g_knobs.server.max_write_transaction_life_versions
+        # Batch span (ISSUE 12): arrival-ordered root of this batch's
+        # stage tree (encode/dispatch/device/sync/apply/reply children).
+        # Detached — it outlives awaits on the pipelined path — and ended
+        # by the shared completion (_complete_resolve).
+        from ..flow.spans import begin_span, use_span
+
+        bspan = begin_span(
+            "resolve_batch", role=self.metrics.name,
+            attrs={"version": req.version,
+                   "n_txn": len(req.transactions),
+                   "pipelined": int(
+                       self._pipeline_on and self._cpu_takeover is None
+                   )},
+        )
         if self._pipeline_on and self._cpu_takeover is None:
             # ISSUE 11: the double-buffered async offload path (ref: the
             # pipelined yieldedFuture resolve loop of Resolver.actor.cpp).
             await self._resolve_pipelined(
-                req, reply, first_unseen, t_enter, window
+                req, reply, first_unseen, t_enter, window, bspan
             )
             return
         conflicts = self._cpu_takeover or self.conflicts
@@ -484,9 +523,11 @@ class Resolver:
             from ..conflict.device_faults import DeviceFault
 
             try:
-                statuses = batch.detect_conflicts(
-                    now=req.version, new_oldest_version=req.version - window
-                )
+                with use_span(bspan):  # stage spans parent to the batch
+                    statuses = batch.detect_conflicts(
+                        now=req.version,
+                        new_oldest_version=req.version - window,
+                    )
             except DeviceFault as e:
                 # Last-resort host retry, same resolve call — no error may
                 # escape to the proxy (ConflictSet's breaker normally
@@ -510,18 +551,21 @@ class Resolver:
         # interleave before this handler's reply either way.
         self.version.set(req.version)
         self._complete_resolve(
-            req, reply, statuses, degraded, first_unseen, t_enter
+            req, reply, statuses, degraded, first_unseen, t_enter,
+            span=bspan,
         )
 
     def _complete_resolve(
         self, req, reply, statuses, degraded: bool, first_unseen: int,
-        t_enter: float,
+        t_enter: float, span=None,
     ):
         """Post-verdict completion shared by the synchronous path and the
         pipeline's _finish_resolve — verdict accounting, state-txn
         retention + reply-cache insert, GC, trace, the latency window,
         and the reply itself live in ONE place so the two paths can
-        never drift."""
+        never drift.  `span` is the batch's resolve_batch span: the
+        reply child span nests under it and it is ENDED here (the one
+        place both paths funnel through)."""
         from ..conflict.types import CONFLICT, TOO_OLD
         from ..flow.trace import trace_batch
 
@@ -538,12 +582,19 @@ class Resolver:
         # Feed the registry: batch size + per-verdict counts (the conflict
         # rate "The Transactional Conflict Problem" trades against
         # throughput).
+        n_conflicted = sum(1 for s in statuses if s == CONFLICT)
         m.counter("batches").add()
         m.counter("transactions").add(len(statuses))
         m.histogram("batch_size").add(len(statuses))
         m.counter("committed").add(sum(1 for s in statuses if s == COMMITTED))
-        m.counter("conflicted").add(sum(1 for s in statuses if s == CONFLICT))
+        m.counter("conflicted").add(n_conflicted)
         m.counter("too_old").add(sum(1 for s in statuses if s == TOO_OLD))
+        # Conflict-witness counters (ISSUE 12 satellite): aborted-txn
+        # count per batch + the contended key ranges (see __init__).
+        if n_conflicted:
+            m.counter("witness_aborts").add(n_conflicted)
+            m.histogram("aborted_per_batch").add(n_conflicted)
+            self._witness_record(req.transactions, statuses)
 
         # Retain this batch's state transactions with their verdicts so the
         # other proxies' next batches learn them (ref :170-181).
@@ -583,19 +634,79 @@ class Resolver:
             for v in [v for v in self._recent_state_txns if v <= oldest]:
                 del self._recent_state_txns[v]
 
-        trace_batch("CommitDebug", "Resolver.resolveBatch.After", req.debug_id)
-        # Resolve latency (arrival -> reply, virtual seconds): the sliding
-        # window the ratekeeper's resolve_latency spring reads, plus the
-        # cumulative histogram for status/metrics.  Real resolves only —
-        # cache-hit/stale replies never reach here and never dilute it.
-        dt = self.process.network.loop.now() - t_enter
-        self._recent_resolve.append(dt)
-        m.histogram("resolve_seconds").add(dt)
-        reply.send(out)
+        from ..flow.spans import begin_span, use_span
+
+        with use_span(span):
+            with begin_span("reply", attrs={"version": req.version}):
+                trace_batch(
+                    "CommitDebug", "Resolver.resolveBatch.After",
+                    req.debug_id,
+                )
+                # Resolve latency (arrival -> reply, virtual seconds):
+                # the sliding window the ratekeeper's resolve_latency
+                # spring reads, plus the cumulative histogram for
+                # status/metrics.  Real resolves only — cache-hit/stale
+                # replies never reach here and never dilute it.
+                dt = self.process.network.loop.now() - t_enter
+                self._recent_resolve.append(dt)
+                m.histogram("resolve_seconds").add(dt)
+                reply.send(out)
+        if span is not None:
+            span.end(attrs={"degraded": int(degraded),
+                            "aborted": n_conflicted})
+
+    WITNESS_MAX_RANGES = 512  # bounded contended-range sample (decayed)
+    WITNESS_TOP_K = 8
+
+    def _witness_record(self, txns, statuses):
+        """Bump the contended-range sample with every aborted txn's first
+        conflict range (write ranges preferred: first-committer-wins
+        means a loser's own write range is where it collided), decaying
+        like the split-balancer key sample so hot ranges survive and
+        one-offs shed.  Publishes the top-K as a canonical-JSON gauge —
+        deterministic, so it rides snapshots/timeseries/soak reports
+        without breaking byte identity."""
+        from ..conflict.types import CONFLICT
+
+        w = self._witness_ranges
+        for tr, s in zip(txns, statuses):
+            if s != CONFLICT:
+                continue
+            ranges = tr.write_ranges or tr.read_ranges
+            if not ranges:
+                continue
+            key = (ranges[0][0], ranges[0][1])
+            w[key] = w.get(key, 0) + 1
+        if len(w) > self.WITNESS_MAX_RANGES:
+            w = {k: v // 2 for k, v in w.items() if v >= 2}
+            self._witness_ranges = w
+        import json as _json
+
+        top = sorted(w.items(), key=lambda kv: (-kv[1], kv[0]))
+        top = top[: self.WITNESS_TOP_K]
+        self.metrics.gauge("conflict_witness_topk").set(
+            _json.dumps(
+                [[b.hex(), e.hex(), n] for (b, e), n in top],
+                separators=(",", ":"),
+            )
+        )
+
+    def conflict_witness(self) -> dict:
+        """Status/soak surface: aborted-txn total + decoded top-K
+        contended ranges."""
+        import json as _json
+
+        return {
+            "aborts": int(self.metrics.counter("witness_aborts").value),
+            "topk": _json.loads(
+                self.metrics.gauge("conflict_witness_topk").value or "[]"
+            ),
+        }
 
     # -- double-buffered pipeline (ISSUE 11) ------------------------------
     async def _resolve_pipelined(
-        self, req, reply, first_unseen: int, t_enter: float, window: int
+        self, req, reply, first_unseen: int, t_enter: float, window: int,
+        bspan=None,
     ):
         """The async offload path: admit the batch into the conflict
         set's pipeline and advance the prevVersion chain at DISPATCH —
@@ -607,10 +718,16 @@ class Resolver:
         pipeline exceeds its depth bound (its sync overlaps OUR device
         compute, its mirror apply runs under it too), and the idle
         flush drains the tail when traffic pauses."""
-        entry = self.conflicts.pipeline_submit(
-            req.transactions, req.version, req.version - window
-        )
-        ctx = _ParkedResolve(entry, req, reply, first_unseen, t_enter)
+        from ..flow.spans import use_span
+
+        with use_span(bspan):
+            # Synchronous section: the submit's encode/dispatch/device
+            # spans (engine + ConflictSet) parent to this batch's span.
+            entry = self.conflicts.pipeline_submit(
+                req.transactions, req.version, req.version - window
+            )
+        ctx = _ParkedResolve(entry, req, reply, first_unseen, t_enter,
+                             span=bspan)
         self._pipe_ctx.append(ctx)
         self.version.set(req.version)
         self.metrics.histogram("pipeline_inflight_depth").add(
@@ -669,8 +786,9 @@ class Resolver:
         no other actor can interleave between verdict landing and reply."""
         self._complete_resolve(
             ctx.req, ctx.reply, ctx.entry.statuses, ctx.entry.degraded,
-            ctx.first_unseen, ctx.t_enter,
+            ctx.first_unseen, ctx.t_enter, span=ctx.span,
         )
+        self._note_device_span(ctx.entry)
         # Stall accounting + the wedged-pipeline black box: a pipeline
         # that is ON but only ever drains by the idle flush achieves zero
         # overlap — after a sustained streak, freeze a flight-recorder
@@ -712,3 +830,47 @@ class Resolver:
             self._flush_streak = 0
         m.gauge("pipeline_occupancy").set(len(self._pipe_ctx))
         ctx._mark_finished()
+
+    def _note_device_span(self, entry) -> None:
+        """Fold one completed device in-flight span into the pipeline
+        overlap-efficiency gauge (ISSUE 12): overlapped device time /
+        total device time, on the span hub's deterministic event-
+        sequence clock.  Device spans complete in dispatch order, so the
+        union is maintained with one high-water mark.  The wall-clock
+        twin accumulates in the record_wall namespace only (real-mode
+        tooling; never a sim-compared snapshot)."""
+        sp = getattr(entry, "device_span", None)
+        if sp is None or sp.seq is None or sp.end_seq is None:
+            return
+        if any(k in sp.attrs for k in ("fault", "replayed", "diverged")):
+            # Fault/divergence paths end parked device spans at DRAIN
+            # time — near-identical intervals whose mutual "overlap" is
+            # mirror-replay bookkeeping, not overlapped device compute.
+            # Folding them in would report high efficiency exactly when
+            # the device did no useful work.
+            return
+        m = self.metrics
+        b, e = sp.seq, sp.end_seq
+        self._dev_seq_total += e - b
+        hwm = self._dev_seq_hwm
+        self._dev_seq_union += e - b if (hwm is None or b >= hwm) else max(
+            0, e - hwm
+        )
+        self._dev_seq_hwm = e if hwm is None else max(hwm, e)
+        if self._dev_seq_total > 0:
+            m.gauge("pipeline_overlap_efficiency").set(
+                round(
+                    (self._dev_seq_total - self._dev_seq_union)
+                    / self._dev_seq_total,
+                    4,
+                )
+            )
+        if sp.wall_end is not None:
+            wb, we = sp.wall_start, sp.wall_end
+            whwm = self._dev_wall_hwm
+            covered = we - wb if (whwm is None or wb >= whwm) else max(
+                0.0, we - whwm
+            )
+            self._dev_wall_hwm = we if whwm is None else max(whwm, we)
+            m.record_wall("device_span_seconds", we - wb)
+            m.record_wall("device_overlap_seconds", (we - wb) - covered)

@@ -175,6 +175,12 @@ def cluster_status(cluster) -> dict:
 
     cl["flight_recorder"] = global_flight_recorder().status_section()
 
+    # Span-layer inventory (ISSUE 12): per-role ring sizes + lifetime
+    # count, never the spans themselves (`cli trace-export` dumps those).
+    from ..flow.spans import global_span_hub
+
+    cl["spans"] = global_span_hub().status_section()
+
     if storage is not None:
         cl["data"] = {
             "storage_version": storage.version.get(),
@@ -268,6 +274,27 @@ def cluster_status(cluster) -> dict:
             qos["conflict_mirror_divergence"] = getattr(
                 info, "mirror_divergence", 0
             )
+        # Conflict witnesses (ISSUE 12 satellite; ROADMAP item 4's
+        # observability seed): total aborted txns + the merged top-K
+        # contended key ranges across resolvers — the qos view of WHERE
+        # hot-key contention is burning goodput right now.
+        w_aborts = 0
+        merged: dict = {}
+        for r in role_objects(cluster, "resolver"):
+            cw = getattr(r, "conflict_witness", None)
+            if not callable(cw):
+                continue
+            w = cw()
+            w_aborts += w["aborts"]
+            for b, e, n in w["topk"]:
+                merged[(b, e)] = merged.get((b, e), 0) + n
+        qos["conflict_witness_aborts"] = w_aborts
+        qos["conflict_witness_topk"] = [
+            [b, e, n]
+            for (b, e), n in sorted(
+                merged.items(), key=lambda kv: (-kv[1], kv[0])
+            )[:8]
+        ]
         cl["qos"] = qos
         # Passive latency distributions from the proxy's ContinuousSamples
         # (ref: the commit/GRV latency bands in Status.actor.cpp's qos; the

@@ -282,18 +282,31 @@ class TLog:
             # where a kill strands un-acked data (the epoch-cut path).
             loop = self.process.network.loop
             await loop.delay(loop.rng.random01() * 0.02)
+        from ..flow.spans import NULL_SPAN, begin_span
         from ..flow.trace import trace_batch
 
         trace_batch(
             "CommitDebug", "TLog.tLogCommit.BeforeWaitForVersion", req.debug_id
         )
+        # Push span (ISSUE 12): prevVersion park + append + fsync for one
+        # real push (idle batches carry no payload and record nothing).
+        tspan = (
+            begin_span(
+                "tlog_push", role=f"TLog.{self.process.name}",
+                attrs={"version": req.version},
+            )
+            if req.tagged
+            else NULL_SPAN
+        )
         # Versions are committed in the sequencer's order (ref: TLogServer
         # waits version ordering before appending).
         await self.durable.when_at_least(req.prev_version)
         if self.locked:
+            tspan.end(attrs={"error": "tlog_stopped"})
             reply.send_error("tlog_stopped")
             return
         if req.version <= self.durable.get():
+            tspan.end(attrs={"duplicate": 1})
             reply.send(self.durable.get())  # duplicate
             return
         self.versions.append(req.version)
@@ -316,6 +329,7 @@ class TLog:
             self._mem_bytes += size
             await self.process.network.loop.delay(COMMIT_DELAY)  # fsync stand-in
         self.durable.set(req.version)
+        tspan.end()
         trace_batch(
             "CommitDebug", "TLog.tLogCommit.AfterTLogCommit", req.debug_id
         )

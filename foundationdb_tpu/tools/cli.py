@@ -45,8 +45,15 @@ class CliProcessor:
         "diff of each resolver's CPU mirror snapshot against its device "
         "export (the consistency check the periodic resolver actor runs; "
         "confirmed divergence opens the circuit breaker)",
-        "latency": "latency [--format=json] — per-stage commit/GRV "
-        "latency percentiles reassembled from trace_batch debug ids",
+        "latency": "latency [--chains] [--format=json] — per-stage "
+        "latency percentiles from the span layer (default); --chains "
+        "uses the legacy trace_batch debug-id chain reassembly "
+        "(in-memory collectors only — the trace-file-only input path)",
+        "trace-export": "trace-export [--out=PATH] [--include-wall] — "
+        "export the span layer as a Chrome trace-event / Perfetto JSON "
+        "artifact (one track per role, pipeline batches as nested "
+        "slices); byte-identical across same-seed runs unless "
+        "--include-wall adds real-clock durations",
         "consistencycheck": "consistencycheck — compare every "
         "multi-replica shard across its team (fdbserver -r "
         "consistencycheck analog)",
@@ -479,10 +486,16 @@ class CliProcessor:
                 doc.setdefault("tpu", {})[r.process.name] = snap
                 registries[("tpu", r.process.name)] = snap
         diff = "--diff" in args
+        no_baseline = False
         if diff:
             from ..flow.timeseries import snapshot_delta
 
             prev = getattr(self, "_metrics_prev", {})
+            if not prev:
+                # First invocation: there is nothing to diff against.
+                # Say so clearly (and still show lifetime totals) instead
+                # of presenting totals that LOOK like a window delta.
+                no_baseline = True
             for (section, name), snap in registries.items():
                 # Replace ONLY the registry keys (counters/gauges/
                 # histograms) with deltas; instantaneous diagnostic
@@ -496,9 +509,20 @@ class CliProcessor:
         # so two successive `metrics --diff` calls show the in-between
         # window.
         self._metrics_prev = registries
+        note = (
+            "no prior snapshot — showing lifetime totals; run "
+            "`metrics --diff` again for the in-between window"
+        )
         if "--format=json" in args:
+            if no_baseline:
+                doc = {"note": note, **doc}
             return json.dumps(doc, indent=2, default=str).splitlines()
-        lines = ["(deltas since previous metrics command)"] if diff else []
+        if no_baseline:
+            lines = [f"({note})"]
+        elif diff:
+            lines = ["(deltas since previous metrics command)"]
+        else:
+            lines = []
         for section in sorted(doc):
             lines.append(f"{section}:")
             for name, snap in sorted(doc[section].items()):
@@ -592,8 +616,34 @@ class CliProcessor:
         return lines
 
     async def _cmd_latency(self, args):
-        """Per-stage commit/GRV latency percentiles, reassembled from the
-        g_traceBatch debug-id chains (flow/latency_chain.py)."""
+        """Per-stage latency percentiles.  Default source is the span
+        layer (ISSUE 12): exact per-role stage durations straight off
+        the resolver/proxy/client/tlog span rings — no chain
+        reassembly, and it works on file-backed trace collectors too.
+        `--chains` keeps the legacy g_traceBatch debug-id reassembly
+        (flow/latency_chain.py) for trace-file-only inputs."""
+        from ..flow.spans import global_span_hub, span_latency_summary
+
+        use_chains = "--chains" in args
+        hub = global_span_hub()
+        if not use_chains and hub.rings:
+            summary = span_latency_summary(hub)
+            if "--format=json" in args:
+                return json.dumps(
+                    summary, indent=2, default=str
+                ).splitlines()
+            lines = ["per-stage span latency (virtual seconds):"]
+            for role, stages in summary.items():
+                if not stages:
+                    continue
+                lines.append(f"{role}:")
+                for stage, s in stages.items():
+                    lines.append(
+                        f"  {stage:<16} n={s['count']:<5} "
+                        f"p50={s['p50']:.6f} p90={s['p90']:.6f} "
+                        f"p99={s['p99']:.6f} max={s['max']:.6f}"
+                    )
+            return lines
         from ..flow.latency_chain import latency_summary
         from ..flow.trace import global_collector
 
@@ -601,11 +651,12 @@ class CliProcessor:
         if col.path is not None:
             return [
                 "ERROR: trace collector is file-backed (events spooled "
-                f"to {col.path}); latency reassembly needs the in-memory "
-                "collector"
+                f"to {col.path}); chain reassembly needs the in-memory "
+                "collector — the span layer (`latency` without "
+                "--chains) works regardless"
             ]
         summary = latency_summary(col.events)
-        if args and args[0] == "--format=json":
+        if "--format=json" in args:
             return json.dumps(summary, indent=2, default=str).splitlines()
         lines = []
         for chain in ("commit", "grv"):
@@ -627,6 +678,32 @@ class CliProcessor:
                     f"p99={s['p99']:.6f} max={s['max']:.6f}"
                 )
         return lines
+
+    async def _cmd_trace_export(self, args):
+        """Perfetto / Chrome trace-event export of the span layer
+        (ISSUE 12): one process per role, pipeline batches as nested
+        slices, device phase-attribution children under their dispatch
+        span.  Canonical compact JSON — byte-identical across same-seed
+        runs unless --include-wall opts real-clock durations in."""
+        from ..flow.spans import global_span_hub
+        from ..flow.trace_export import perfetto_json
+
+        include_wall = "--include-wall" in args
+        out_path = next(
+            (a.split("=", 1)[1] for a in args if a.startswith("--out=")),
+            None,
+        )
+        blob = perfetto_json(include_wall=include_wall)
+        if out_path:
+            with open(out_path, "w", encoding="utf-8") as f:
+                f.write(blob + "\n")
+            hub = global_span_hub()
+            return [
+                f"wrote {out_path} "
+                f"({sum(len(r) for r in hub.rings.values())} spans, "
+                f"{len(hub.rings)} role tracks)"
+            ]
+        return [blob]
 
     async def _probe_swallowing(self):
         from ..server.status import latency_probe

@@ -10,7 +10,7 @@ model:
 
   base.py       rule registry, findings, pragmas, allowlist config
   local.py      single-module rules: DET001-3, ACT001, JAX001, IO001,
-                TRC001, ERR001, ENV001
+                TRC001, SPN001, ERR001, ENV001
   waitrules.py  WAIT001/WAIT002 — state captured/iterated across await
   rpy.py        RPY001 — reply-promise path analysis (broken-promise hang)
   graphs.py     module graph + call graph from per-file summaries

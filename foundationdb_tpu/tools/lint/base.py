@@ -29,6 +29,7 @@ RULES: Dict[str, str] = {
     "JAX001": "host sync or Python side effect inside a jit-traced function",
     "IO001": "direct open()/socket outside the real I/O backends",
     "TRC001": "TraceEvent constructed but never .log()ed nor used as a context manager (dropped event)",
+    "SPN001": "begin_span() result neither context-managed, .end()ed, nor stored (leaked open span)",
     "ERR001": "broad except that neither re-raises, TraceEvents, nor propagates the error (silent swallow)",
     "WAIT001": "shared state captured before an await and dereferenced after it without re-read",
     "WAIT002": "iteration over shared mutable state whose loop body awaits (reference across wait)",
@@ -151,6 +152,7 @@ DEFAULT_ALLOW: Dict[str, Tuple[str, ...]] = {
     "ACT001": (),
     "JAX001": (),
     "TRC001": (),
+    "SPN001": (),
     "ERR001": (
         "rpc/real_network.py",   # teardown paths on real sockets: close()
         #                          best-effort by design

@@ -2,7 +2,8 @@
 
 DET001/DET002/DET003, ACT001, JAX001, IO001, TRC001, ERR001 from the
 original single-module fdblint, plus ENV001 (FDB_TPU_* environment reads
-outside the flow/knobs.py registry).  Findings are produced UNFILTERED —
+outside the flow/knobs.py registry) and SPN001 (leaked open spans —
+TRC001's span-layer mirror).  Findings are produced UNFILTERED —
 the allowlist config and pragmas are applied by project.py after every
 pass has run, which keeps per-file results cacheable independent of
 config."""
@@ -386,6 +387,7 @@ class ModuleLinter(ClockRefVisitorMixin, ast.NodeVisitor):
                     f"(dropped actor)",
                 )
             self._check_dropped_trace_event(node, v)
+            self._check_leaked_span(node, v)
         self.generic_visit(node)
 
     def _check_dropped_trace_event(self, stmt: ast.Expr, call: ast.Call):
@@ -406,6 +408,30 @@ class ModuleLinter(ClockRefVisitorMixin, ast.NodeVisitor):
                         "TRC001", stmt,
                         "TraceEvent built but never .log()ed nor used as "
                         "a context manager (dropped event)",
+                    )
+                return
+            if not isinstance(c.func, ast.Attribute):
+                return
+            methods.append(c.func.attr)
+            c = c.func.value
+
+    def _check_leaked_span(self, stmt: ast.Expr, call: ast.Call):
+        """SPN001 (TRC001's span-layer mirror): a statement-level
+        begin_span(...) builder chain whose outermost call is not .end()
+        — the open span is dropped on the floor, never closes, and never
+        reaches a ring.  Stored results (`sp = begin_span(...)`) and the
+        context-manager form (`with begin_span(...)`, an ast.With) are
+        the legitimate deferred-end shapes and never arrive here."""
+        methods: List[str] = []
+        c: ast.AST = call
+        while isinstance(c, ast.Call):
+            path = self.aliases.resolve(c.func)
+            if path is not None and path.split(".")[-1] == "begin_span":
+                if "end" not in methods:
+                    self.flag(
+                        "SPN001", stmt,
+                        "begin_span(...) result neither context-managed, "
+                        ".end()ed, nor stored (leaked open span)",
                     )
                 return
             if not isinstance(c.func, ast.Attribute):

@@ -224,6 +224,11 @@ class SoakRun:
         # DynamicCluster recruits a fresh Ratekeeper per recovery, whose
         # own transitions log resets; this one spans the whole soak).
         self.admission_log: List[list] = []
+        # Per-phase conflict-witness snapshots (ISSUE 12 satellite):
+        # phase name -> {resolver: {aborts, topk}} captured at phase
+        # end, so the report shows WHERE contention lived per phase
+        # (the Zipf hot-key phases are the interesting rows).
+        self.phase_witness: dict = {}
         self._stop = False
 
     # -- cluster accessors ------------------------------------------------
@@ -246,6 +251,19 @@ class SoakRun:
             cs = getattr(r, "conflicts", None)
             if cs is not None and getattr(cs, "_jax", None) is not None:
                 out.append((r, cs))
+        return out
+
+    def _witness_snapshot(self) -> dict:
+        """resolver -> conflict_witness() at this instant (cumulative
+        counters; per-phase deltas are derivable from successive phase
+        rows).  Deterministic: counts + canonical-JSON top-K only."""
+        from ..server.status import role_objects
+
+        out = {}
+        for r in role_objects(self.cluster, "resolver"):
+            cw = getattr(r, "conflict_witness", None)
+            if callable(cw):
+                out[r.process.name] = cw()
         return out
 
     # -- transaction plans ------------------------------------------------
@@ -371,6 +389,7 @@ class SoakRun:
                 await all_of(tasks)
             st.t_end = loop.now()
             st.ev_end = len(col.events)
+            self.phase_witness[st.name] = self._witness_snapshot()
         # Drain stragglers (bounded): goodput counts completions, and a
         # hung tail must fail the SLO rather than hang the harness.
         deadline = loop.now() + self.config.drain_timeout
@@ -533,6 +552,25 @@ class SoakRun:
         return self.report()
 
     # -- reporting --------------------------------------------------------
+    def _spans_section(self) -> dict:
+        from ..flow.spans import global_span_hub, span_latency_summary
+        from ..server.status import role_objects
+
+        hub = global_span_hub()
+        overlap = 0.0
+        for r in role_objects(self.cluster, "resolver"):
+            m = getattr(r, "metrics", None)
+            if m is not None and "pipeline_overlap_efficiency" in m.gauges:
+                overlap = max(
+                    overlap, m.gauges["pipeline_overlap_efficiency"].value
+                )
+        return {
+            "status": hub.status_section(),
+            "stage_latency": span_latency_summary(hub),
+            "pipeline_overlap_efficiency": overlap,
+            "window": hub.window_dict(last_n=8),
+        }
+
     def _phase_chain_p99(self, st: _PhaseStats, chain, type_):
         from ..flow.trace import global_collector
 
@@ -596,6 +634,12 @@ class SoakRun:
                     "grv_p99_chain": grv_p99,
                     "commit_p99_client": client_p99,
                     "slo_ok": ok,
+                    # Where contention lived this phase (ISSUE 12):
+                    # aborted-txn totals + top-K contended ranges per
+                    # resolver, snapshotted at phase end.
+                    "conflict_witness": self.phase_witness.get(
+                        st.name, {}
+                    ),
                 }
             )
         totals = {
@@ -690,6 +734,12 @@ class SoakRun:
             },
             "breakers": breakers,
             "pipeline": pipeline,
+            # Span layer (ISSUE 12): per-role ring inventory, the recent
+            # window, per-stage latency percentiles off the spans, and
+            # the worst pipeline overlap-efficiency gauge.  All
+            # deterministic (wall fields excluded by construction), so
+            # the byte-identical replay gate extends over this section.
+            "spans": self._spans_section(),
             "slo": {
                 "commit_p99_bound": cfg.slo_commit_p99,
                 "worst_phase_commit_p99": worst_p99 or None,
@@ -761,6 +811,12 @@ def run_soak(config: SoakConfig) -> dict:
     old_hub, old_rec = global_timeseries(), global_flight_recorder()
     set_global_timeseries(TimeSeriesHub())
     set_global_flight_recorder(FlightRecorder())
+    # Fresh span hub (ISSUE 12): the report's spans section and the
+    # captures' span windows must belong to THIS run only.
+    from ..flow.spans import SpanHub, global_span_hub, set_global_span_hub
+
+    old_spans = global_span_hub()
+    set_global_span_hub(SpanHub())
     try:
         # Sample every transaction: the soak's SLO gate IS the latency
         # chain, and the harness owns its own (fresh) collector.
@@ -796,6 +852,7 @@ def run_soak(config: SoakConfig) -> dict:
         set_global_collector(old_col)
         set_global_timeseries(old_hub)
         set_global_flight_recorder(old_rec)
+        set_global_span_hub(old_spans)
         set_event_loop(None)
 
 

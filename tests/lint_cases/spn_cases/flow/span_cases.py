@@ -1,0 +1,57 @@
+"""SPN001 golden corpus: leaked open spans vs the legitimate shapes.
+
+A `begin_span()` result that is neither context-managed, `.end()`ed,
+nor stored never closes — it silently vanishes without ever reaching a
+ring (TRC001's span-layer mirror).
+"""
+
+from foundationdb_tpu.flow.spans import begin_span
+from foundationdb_tpu.flow import spans as spanmod
+from foundationdb_tpu.flow.spans import begin_span as start_span
+
+
+def leaked_bare():
+    begin_span("resolve_batch")  # EXPECT: SPN001
+
+
+def leaked_builder_chain():
+    # Detailed but never ended: still a leak.
+    begin_span("resolve_batch").annotate("version", 7)  # EXPECT: SPN001
+
+
+def leaked_module_qualified():
+    spanmod.begin_span("dispatch", role="Resolver")  # EXPECT: SPN001
+
+
+def leaked_aliased():
+    start_span("encode")  # EXPECT: SPN001
+
+
+def leaked_with_pragma():
+    begin_span("probe")  # fdblint: ignore[SPN001]: handed to a test harness that ends every open span at teardown
+
+
+def ok_context_managed():
+    with begin_span("encode"):
+        pass
+
+
+def ok_explicit_end():
+    begin_span("reply").end()
+
+
+def ok_end_after_annotate():
+    begin_span("reply").annotate("n", 1).end()
+
+
+def ok_stored_for_later(ctx):
+    # Stored: the deferred-end shape (a parked pipeline batch holds its
+    # span across awaits and ends it at completion).
+    ctx.span = begin_span("device")
+    sp = begin_span("sync")
+    return sp
+
+
+def ok_not_a_span(event):
+    # Same statement shape, different callee: not ours to police.
+    event.begin_edit("x")

@@ -17,6 +17,11 @@ from foundationdb_tpu.flow.flight_recorder import (
 )
 from foundationdb_tpu.flow.knobs import g_env, g_knobs
 from foundationdb_tpu.flow.metrics import MetricsRegistry
+from foundationdb_tpu.flow.spans import (
+    SpanHub,
+    global_span_hub,
+    set_global_span_hub,
+)
 from foundationdb_tpu.flow.timeseries import (
     TimeSeriesHub,
     global_timeseries,
@@ -37,18 +42,21 @@ pytestmark = pytest.mark.metrics
 def _fresh_globals():
     """Every test runs against its own hub/recorder/collector and leaves
     the process-globals as it found them."""
-    old_hub, old_rec, old_col = (
+    old_hub, old_rec, old_col, old_spans = (
         global_timeseries(),
         global_flight_recorder(),
         global_collector(),
+        global_span_hub(),
     )
     set_global_timeseries(TimeSeriesHub())
     set_global_flight_recorder(FlightRecorder())
     set_global_collector(TraceCollector())
+    set_global_span_hub(SpanHub())
     yield
     set_global_timeseries(old_hub)
     set_global_flight_recorder(old_rec)
     set_global_collector(old_col)
+    set_global_span_hub(old_spans)
     set_event_loop(None)
 
 
@@ -290,6 +298,7 @@ def test_breaker_open_artifacts_byte_identical_across_runs():
         set_global_timeseries(TimeSeriesHub())
         set_global_flight_recorder(FlightRecorder())
         set_global_collector(TraceCollector())
+        set_global_span_hub(SpanHub())  # captures embed the span window
         inj = DeviceFaultInjector()
         inj.script("dispatch", at=4, persist=4)
         cs = ConflictSet(backend="jax", fault_injector=inj)

@@ -563,8 +563,39 @@ def test_pragma_examples_in_docstrings_are_inert():
 
 def test_rule_registry_documented():
     for rule in ("DET001", "DET002", "DET003", "ACT001", "JAX001", "IO001",
-                 "TRC001", "ERR001"):
+                 "TRC001", "SPN001", "ERR001"):
         assert rule in RULES and RULES[rule]
+
+
+def test_spn001_leaked_vs_handled_spans():
+    """SPN001 (TRC001's span-layer mirror): statement-level begin_span
+    chains without .end() are leaks; `with`, explicit end, and stored
+    results are the legitimate shapes."""
+    src = (
+        "from foundationdb_tpu.flow.spans import begin_span\n"
+        "def bad():\n"
+        "    begin_span('x')\n"
+        "    begin_span('y').annotate('k', 1)\n"
+        "def good(ctx):\n"
+        "    with begin_span('a'):\n"
+        "        pass\n"
+        "    begin_span('b').end()\n"
+        "    sp = begin_span('c')\n"
+        "    ctx.span = begin_span('d')\n"
+        "    return sp\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    spn = [f for f in findings if f.rule == "SPN001"]
+    assert [f.line for f in spn] == [3, 4]
+    # Pragma with a reason suppresses; the suppression is counted.
+    src2 = (
+        "from foundationdb_tpu.flow.spans import begin_span\n"
+        "def f():\n"
+        "    begin_span('x')  # fdblint: ignore[SPN001]: harness ends every open span at teardown\n"
+    )
+    assert not [
+        f for f in lint_source(src2, "server/x.py") if not f.suppressed
+    ]
 
 
 # ---------------------------------------------------------------------------
@@ -1164,7 +1195,8 @@ def _expected_markers(case_dir):
 
 
 @pytest.mark.parametrize(
-    "case", ["wait_rules", "rpy_cases", "det101_pkg", "env_cases"]
+    "case", ["wait_rules", "rpy_cases", "det101_pkg", "env_cases",
+             "spn_cases"]
 )
 def test_golden_corpus(case, capsys):
     case_dir = os.path.join(CASES_DIR, case)

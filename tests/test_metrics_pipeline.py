@@ -380,11 +380,16 @@ def test_status_json_has_resolver_section_and_cli_commands():
     text = "\n".join(_drive(c, db, cli, "status"))
     assert "Resolver" in text
 
-    # latency: per-stage percentiles, text + json.
+    # latency: default reads the SPAN layer (ISSUE 12) — per-role stage
+    # percentiles; --chains keeps the debug-id chain reassembly.
     lat_text = "\n".join(_drive(c, db, cli, "latency"))
-    assert "commit pipeline" in lat_text and "p50=" in lat_text
+    assert "per-stage span latency" in lat_text and "p50=" in lat_text
     assert "p90=" in lat_text and "p99=" in lat_text
-    lat = json.loads("\n".join(_drive(c, db, cli, "latency --format=json")))
+    chain_text = "\n".join(_drive(c, db, cli, "latency --chains"))
+    assert "commit pipeline" in chain_text
+    lat = json.loads(
+        "\n".join(_drive(c, db, cli, "latency --chains --format=json"))
+    )
     assert lat["commit"]["total"]["count"] >= 1
 
     # metrics: registry snapshots, text + json.

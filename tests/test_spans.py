@@ -1,0 +1,581 @@
+"""Commit-path span tracing + Perfetto timeline export (ISSUE 12).
+
+The headline gates:
+
+1. Same-seed byte identity: `spans_json` AND the `cli trace-export`
+   Perfetto artifact of a pipelined resolve run are byte-identical
+   across two same-seed runs, and diverge across seeds.
+2. Pipeline overlap is VISIBLE: a depth-2 run produces overlapping
+   dispatch/apply sibling spans (batch N's mirror apply inside batch
+   N+1's device in-flight window on the event-sequence clock) and a
+   pipeline_overlap_efficiency gauge > 0; depth 1 stays at 0.
+3. The flight recorder embeds the recent span window in captures.
+4. Perfetto schema: every ph:B has a matching, properly nested ph:E and
+   pids/tids are stable per role (flow/trace_export.validate_perfetto).
+5. Phase attribution: the FDB_TPU_ABLATE subtractive harness yields a
+   deterministic per-phase FLOP split recorded as child spans of the
+   dispatch span.
+
+Shape discipline (1-core CI host): key_words=3 + bucket_mins=(32, 128,
+64) + h_cap=1<<10 — the static shapes test_device_faults and
+test_resolver_pipeline already compile, so this module's marginal
+compile cost in a full run is near zero.
+"""
+
+import json
+
+import pytest
+
+from foundationdb_tpu.conflict.api import ConflictSet
+from foundationdb_tpu.conflict.types import TransactionConflictInfo as T
+from foundationdb_tpu.flow import DeterministicRandom, set_event_loop
+from foundationdb_tpu.flow.knobs import g_env
+from foundationdb_tpu.flow.spans import (
+    NULL_SPAN,
+    SpanHub,
+    begin_span,
+    global_span_hub,
+    interval_overlap,
+    overlap_efficiency,
+    set_global_span_hub,
+    span_latency_summary,
+    use_span,
+)
+from foundationdb_tpu.flow.trace_export import (
+    perfetto_json,
+    perfetto_trace,
+    validate_perfetto,
+)
+
+pytestmark = pytest.mark.spans
+
+WINDOW = 40
+
+
+@pytest.fixture(autouse=True)
+def _fresh_hub():
+    old = global_span_hub()
+    set_global_span_hub(SpanHub())
+    yield
+    set_global_span_hub(old)
+    set_event_loop(None)
+
+
+def k(i: int) -> bytes:
+    return b"%08d" % i
+
+
+def _random_stream(seed, keyspace, batches, txns_per_batch, snap_lag=25):
+    rng = DeterministicRandom(seed)
+    version = 10
+    out = []
+    for _ in range(batches):
+        txns = []
+        for _ in range(rng.random_int(1, txns_per_batch + 1)):
+            tr = T(read_snapshot=max(0, version - rng.random_int(0, snap_lag)))
+            for _ in range(rng.random_int(0, 3)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 8))
+                tr.read_ranges.append((k(a), k(b)))
+            for _ in range(rng.random_int(0, 3)):
+                a = rng.random_int(0, keyspace // 4)
+                b = a + 1 + rng.random_int(0, 4)
+                tr.write_ranges.append((k(a), k(b)))
+            txns.append(tr)
+        version += rng.random_int(1, 10)
+        out.append((txns, version, max(0, version - WINDOW)))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# unit: span core, overlap math, disabled mode
+# ---------------------------------------------------------------------------
+
+
+def test_span_parenting_stack_and_rings():
+    hub = global_span_hub()
+    with begin_span("outer", role="R") as outer:
+        with begin_span("inner") as inner:
+            assert inner.parent_id == outer.span_id
+            assert inner.role == "R"  # inherited from the stack parent
+        detached = begin_span("held", parent=outer)
+    detached.end({"k": 1})
+    ring = hub.spans(role="R")
+    assert [s.name for s in ring] == ["inner", "outer", "held"]
+    assert ring[-1].attrs == {"k": 1}
+    # seq pairs are strictly ordered begin<end and unique.
+    stamps = sorted(x for s in ring for x in (s.seq, s.end_seq))
+    assert stamps == sorted(set(stamps))
+    assert all(s.seq < s.end_seq for s in ring)
+    # Ring bound holds.
+    small = SpanHub(per_role=16)
+    set_global_span_hub(small)
+    for i in range(50):
+        begin_span("x", role="A").end()
+    assert len(small.rings["A"]) == 16 and small.begun == 50
+
+
+def test_spans_disabled_by_env(monkeypatch):
+    monkeypatch.setenv("FDB_TPU_SPANS", "0")
+    sp = begin_span("x", role="A")
+    assert sp is NULL_SPAN
+    with sp:
+        with use_span(sp):
+            sp.annotate("k", 1).end()
+    assert global_span_hub().rings == {}
+
+
+def test_interval_overlap_math():
+    # Disjoint: no overlap.
+    assert interval_overlap([(0, 2), (2, 4)]) == (4.0, 4.0)
+    # Fully double-buffered: half the total is overlapped.
+    total, union = interval_overlap([(0, 2), (0, 2)])
+    assert (total, union) == (4.0, 2.0)
+    # Partial, unsorted input.
+    total, union = interval_overlap([(3, 7), (0, 4)])
+    assert (total, union) == (8.0, 7.0)
+    assert interval_overlap([]) == (0.0, 0.0)
+
+
+def test_env_flags_registered():
+    decl = g_env.declared()
+    for name in ("FDB_TPU_SPANS", "FDB_TPU_SPANS_PER_ROLE"):
+        _default, help_ = decl[name]
+        assert help_ != "", name
+
+
+# ---------------------------------------------------------------------------
+# ConflictSet pipeline: determinism, overlap, schema
+# ---------------------------------------------------------------------------
+
+
+def _device_set(monkeypatch, depth, **kw):
+    monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", str(depth))
+    kw.setdefault("backend", "jax")
+    kw.setdefault("key_words", 3)
+    kw.setdefault("bucket_mins", (32, 128, 64))
+    kw.setdefault("h_cap", 1 << 10)
+    return ConflictSet(**kw)
+
+
+def _drive_pipelined(cs, stream, depth):
+    entries = []
+    for txns, now, nov in stream:
+        entries.append(cs.pipeline_submit(txns, now, nov))
+        while cs.pipeline_inflight > depth - 1:
+            cs.pipeline_complete_oldest()
+    cs.pipeline_drain()
+    assert all(e.done for e in entries)
+    return entries
+
+
+def test_pipeline_spans_json_and_perfetto_byte_identical(monkeypatch):
+    def run(seed):
+        set_global_span_hub(SpanHub())
+        cs = _device_set(monkeypatch, 2)
+        _drive_pipelined(cs, _random_stream(seed, 60, 10, 8), 2)
+        hub = global_span_hub()
+        return hub.spans_json(), perfetto_json(hub)
+
+    a_spans, a_trace = run(3)
+    b_spans, b_trace = run(3)
+    assert a_spans == b_spans
+    assert a_trace == b_trace
+    c_spans, c_trace = run(5)
+    assert c_spans != a_spans and c_trace != a_trace
+
+
+def test_pipeline_device_spans_overlap_at_depth2(monkeypatch):
+    cs = _device_set(monkeypatch, 2)
+    _drive_pipelined(cs, _random_stream(3, 60, 10, 8), 2)
+    hub = global_span_hub()
+    dev = hub.spans(name="device")
+    assert len(dev) == 10
+    assert overlap_efficiency(dev, axis="seq") > 0.0
+    assert overlap_efficiency(dev, axis="wall") > 0.0
+    # Depth 1 (the synchronous before-arm): zero overlap by construction.
+    set_global_span_hub(SpanHub())
+    cs1 = _device_set(monkeypatch, 1)
+    for txns, now, nov in _random_stream(3, 60, 10, 8):
+        b = cs1.new_batch()
+        for t in txns:
+            b.add_transaction(t)
+        b.detect_conflicts(now, nov)
+    dev1 = global_span_hub().spans(name="device")
+    assert dev1 and overlap_efficiency(dev1, axis="seq") == 0.0
+
+
+def test_perfetto_schema_and_stable_pids(monkeypatch):
+    cs = _device_set(monkeypatch, 2)
+    _drive_pipelined(cs, _random_stream(7, 60, 8, 8), 2)
+    doc = perfetto_trace(global_span_hub())
+    assert validate_perfetto(doc) == []
+    events = doc["traceEvents"]
+    assert sum(1 for e in events if e["ph"] == "B") == sum(
+        1 for e in events if e["ph"] == "E"
+    ) > 0
+    # role -> pid mapping is injective and each pid is named once.
+    role_pids = {}
+    for e in events:
+        if e["ph"] == "B":
+            role_pids.setdefault(e["cat"], set()).add(e["pid"])
+    assert all(len(p) == 1 for p in role_pids.values())
+    # A corrupted doc fails the gate.
+    bad = json.loads(json.dumps(doc))
+    for e in bad["traceEvents"]:
+        if e["ph"] == "E":
+            bad["traceEvents"].remove(e)
+            break
+    assert validate_perfetto(bad) != []
+
+
+def test_lane_assignment_is_parent_aware(monkeypatch):
+    """Regression (review): stage children must render on their OWN
+    batch's lane.  Batch N+1's encode begins inside batch N's window —
+    a purely geometric first-fit nested it under batch N's slice."""
+    stream = _random_stream(3, 60, 10, 8)
+    loop, r, dproc = _resolver_rig(3, 2, monkeypatch)
+    _drive_resolver(loop, r, dproc, stream)
+    hub = global_span_hub()
+    doc = perfetto_trace(hub)
+    assert validate_perfetto(doc) == []
+    lane = {e["args"]["span"]: e["tid"] for e in doc["traceEvents"]
+            if e["ph"] == "B"}
+    by_id = {s.span_id: s for s in hub.spans()}
+    checked = 0
+    for s in by_id.values():
+        p = by_id.get(s.parent_id)
+        if p is None:
+            continue
+        if s.seq < p.end_seq:  # child begins inside its parent's window
+            assert lane[s.span_id] == lane[p.span_id], (
+                f"{s.name} (batch {s.attrs.get('version')}) on lane "
+                f"{lane[s.span_id]}, parent {p.name} on {lane[p.span_id]}"
+            )
+            checked += 1
+    assert checked > 0
+    # Concurrent ROOT batch spans stay side by side, never nested.
+    role = r.metrics.name
+    roots = [s for s in hub.spans(role=role, name="resolve_batch")]
+    overlapping = [
+        (a, b) for a in roots for b in roots
+        if a.span_id < b.span_id and b.seq < a.end_seq
+    ]
+    assert overlapping, "no concurrent batch spans — rig not pipelining"
+    assert all(lane[a.span_id] != lane[b.span_id] for a, b in overlapping)
+
+
+# ---------------------------------------------------------------------------
+# Resolver role: stage tree, overlap gauge, sibling overlap, witnesses
+# ---------------------------------------------------------------------------
+
+
+def _resolver_rig(seed, depth, monkeypatch):
+    from foundationdb_tpu.flow.eventloop import EventLoop
+    from foundationdb_tpu.rpc.network import SimNetwork
+    from foundationdb_tpu.server.resolver import Resolver
+
+    monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", str(depth))
+    loop = EventLoop(seed)
+    set_event_loop(loop)
+    net = SimNetwork(loop)
+    cs = ConflictSet(
+        backend="jax", key_words=3, bucket_mins=(32, 128, 64),
+        h_cap=1 << 10,
+    )
+    r = Resolver(net.process("resolver"), conflict_set=cs)
+    return loop, r, net.process("driver")
+
+
+def _drive_resolver(loop, resolver, dproc, stream, cadence=0.002):
+    from foundationdb_tpu.server.interfaces import (
+        ResolveTransactionBatchRequest,
+    )
+
+    iface = resolver.interface()
+
+    async def drive():
+        prev = 0
+        futs = []
+        for txns, now, _nov in stream:
+            futs.append(iface.resolve.get_reply(
+                dproc,
+                ResolveTransactionBatchRequest(
+                    prev_version=prev, version=now,
+                    last_received_version=prev, transactions=txns,
+                    proxy_id="p0",
+                ),
+            ))
+            prev = now
+            await loop.delay(cadence)
+        return [(await f).committed for f in futs]
+
+    return loop.run_until(dproc.spawn(drive(), "drive"), timeout_vt=600.0)
+
+
+def test_resolver_stage_tree_overlap_gauge_and_witnesses(monkeypatch):
+    stream = _random_stream(7, 60, 12, 8)
+    loop, r, dproc = _resolver_rig(7, 2, monkeypatch)
+    _drive_resolver(loop, r, dproc, stream)
+    hub = global_span_hub()
+    role = r.metrics.name
+    names = {s.name for s in hub.spans(role=role)}
+    # The full per-batch stage set rides the resolver's track.
+    assert {"resolve_batch", "encode", "dispatch", "device", "sync",
+            "apply", "reply"} <= names
+    # Stage spans are CHILDREN of their batch span (parent links).
+    batches = {s.span_id: s for s in hub.spans(role=role,
+                                               name="resolve_batch")}
+    for name in ("encode", "dispatch", "device", "sync", "apply", "reply"):
+        staged = hub.spans(role=role, name=name)
+        assert staged and all(s.parent_id in batches for s in staged), name
+    # Overlap: the gauge is live and > 0, and batch N's apply span sits
+    # INSIDE a different batch's device window on the seq clock — the
+    # "overlapping dispatch/apply sibling spans" shape.
+    snap = r.metrics.snapshot()
+    assert snap["gauges"]["pipeline_overlap_efficiency"] > 0.0
+    devs = hub.spans(role=role, name="device")
+    applies = hub.spans(role=role, name="apply")
+    assert any(
+        d.attrs["version"] != a.attrs["version"]
+        and d.seq < a.seq < d.end_seq
+        for d in devs for a in applies
+    ), "no apply span overlapped another batch's device window"
+    # Conflict witnesses: the Zipf-ish write keyspace forces aborts.
+    assert snap["counters"]["witness_aborts"] > 0
+    topk = json.loads(snap["gauges"]["conflict_witness_topk"])
+    assert topk and all(len(row) == 3 for row in topk)
+    w = r.conflict_witness()
+    assert w["aborts"] == snap["counters"]["witness_aborts"]
+    assert w["topk"] == topk
+    # Depth 1: same stream, gauge stays 0 (no device span ever overlaps).
+    set_global_span_hub(SpanHub())
+    set_event_loop(None)
+    loop1, r1, dproc1 = _resolver_rig(7, 1, monkeypatch)
+    _drive_resolver(loop1, r1, dproc1, stream)
+    assert r1.metrics.snapshot()["gauges"][
+        "pipeline_overlap_efficiency"] == 0.0
+
+
+def test_overlap_gauge_excludes_faulted_and_replayed_spans(monkeypatch):
+    """Regression (review): mirror-replayed device spans all end at
+    DRAIN time with near-identical intervals — folding their mutual
+    'overlap' into the gauge would report high efficiency exactly when
+    the device did no useful work."""
+    from foundationdb_tpu.conflict.device_faults import DeviceFaultInjector
+
+    from foundationdb_tpu.flow.eventloop import EventLoop
+    from foundationdb_tpu.rpc.network import SimNetwork
+    from foundationdb_tpu.server.resolver import Resolver
+
+    monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", "3")
+    loop = EventLoop(11)
+    set_event_loop(loop)
+    net = SimNetwork(loop)
+    inj = DeviceFaultInjector()
+    # Every dispatch from #2 on faults: parked batches drain onto the
+    # mirror, so NO device span ever completes a verified sync.
+    for at in range(2, 40):
+        inj.script("dispatch", at=at, persist=1)
+    cs = ConflictSet(
+        backend="jax", key_words=3, bucket_mins=(32, 128, 64),
+        h_cap=1 << 10, fault_injector=inj,
+    )
+    r = Resolver(net.process("resolver"), conflict_set=cs)
+    _drive_resolver(loop, r, net.process("driver"),
+                    _random_stream(11, 60, 10, 8))
+    snap = r.metrics.snapshot()
+    assert snap["counters"]["degraded_batches"] > 0  # faults really hit
+    assert snap["gauges"]["pipeline_overlap_efficiency"] == 0.0
+
+
+def test_flight_recorder_capture_embeds_span_window(monkeypatch):
+    from foundationdb_tpu.flow.flight_recorder import (
+        FlightRecorder,
+        global_flight_recorder,
+        set_global_flight_recorder,
+    )
+
+    old_rec = global_flight_recorder()
+    set_global_flight_recorder(FlightRecorder())
+    try:
+        cs = _device_set(monkeypatch, 2)
+        _drive_pipelined(cs, _random_stream(3, 60, 6, 8), 2)
+        art = global_flight_recorder().capture("unit", now=1.0)
+        assert "spans" in art
+        all_spans = [s for spans in art["spans"].values() for s in spans]
+        assert any(s["name"] == "device" for s in all_spans)
+        # Wall fields never enter the artifact (byte-identity contract).
+        assert "wall_start" not in json.dumps(art)
+    finally:
+        set_global_flight_recorder(old_rec)
+
+
+def test_span_latency_summary_shapes(monkeypatch):
+    stream = _random_stream(9, 60, 8, 6)
+    loop, r, dproc = _resolver_rig(9, 2, monkeypatch)
+    _drive_resolver(loop, r, dproc, stream)
+    summary = span_latency_summary(global_span_hub())
+    stages = summary[r.metrics.name]
+    assert stages["resolve_batch"]["count"] == len(stream)
+    for key in ("p50", "p90", "p99", "max"):
+        assert stages["resolve_batch"][key] is not None
+    # device spans cross awaits at depth 2: nonzero virtual duration.
+    assert stages["device"]["max"] > 0.0
+
+
+# ---------------------------------------------------------------------------
+# phase attribution (the FDB_TPU_ABLATE subtractive harness)
+# ---------------------------------------------------------------------------
+
+
+def test_phase_attribution_deterministic_and_recorded(monkeypatch):
+    from foundationdb_tpu.conflict.phase_attribution import attribute_phases
+
+    cs = _device_set(monkeypatch, 1)
+    stream = _random_stream(3, 60, 3, 8)
+    for txns, now, nov in stream:
+        b = cs.new_batch()
+        for t in txns:
+            b.add_transaction(t)
+        b.detect_conflicts(now, nov)
+    rep1 = attribute_phases(cs._jax, stream[-1][0])
+    rep2 = attribute_phases(cs._jax, stream[-1][0], record=False)
+
+    def det(r):
+        return json.dumps(
+            {k: r[k] for k in ("phases", "full", "residual_flops")},
+            sort_keys=True,
+        )
+
+    assert det(rep1) == det(rep2)
+    assert rep1["full"]["flops"] > 0
+    # Shares partition (no double count) and something was attributed.
+    assert sum(p["flops"] for p in rep1["phases"]) > 0
+    assert sum(p["share"] for p in rep1["phases"]) <= 1.001
+    # Child spans landed under the engine's last dispatch span.
+    hub = global_span_hub()
+    dispatch_id = cs._jax.last_dispatch_span.span_id
+    phase_spans = [s for s in hub.spans() if s.name.startswith("phase.")]
+    assert {s.name for s in phase_spans} == {
+        "phase.search", "phase.fixpoint", "phase.merge", "phase.evict"
+    }
+    assert all(s.parent_id == dispatch_id for s in phase_spans)
+
+
+def test_phase_attribution_rejects_tiered(monkeypatch):
+    from foundationdb_tpu.conflict.phase_attribution import attribute_phases
+
+    class _Tiered:
+        tiered = True
+
+    with pytest.raises(ValueError):
+        attribute_phases(_Tiered())
+
+
+# ---------------------------------------------------------------------------
+# acceptance: cli trace-export of a pipelined cluster run
+# ---------------------------------------------------------------------------
+
+
+def _cluster_run(seed, n_commits=6):
+    """One SimCluster run at the default pipeline depth (2): commits,
+    phase attribution on the live engine, then the CLI export.  Returns
+    (export blob, spans_json, status doc, latency lines, metrics-diff
+    first line)."""
+    from foundationdb_tpu.conflict.phase_attribution import attribute_phases
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.server.status import cluster_status
+    from foundationdb_tpu.tools.cli import CliProcessor
+
+    set_global_span_hub(SpanHub())
+    c = SimCluster(seed=seed, conflict_backend="jax")
+    db = c.database("sp")
+    cli = CliProcessor(c, db)
+
+    async def load():
+        for i in range(n_commits):
+            tr = db.create_transaction()
+            tr.set(b"sp/%02d" % i, b"v")
+            await tr.commit()
+        await c.loop.delay(1.0)  # idle flush drains the pipeline tail
+
+    c.run_until(db.process.spawn(load(), "load"), timeout_vt=5000.0)
+    attribute_phases(c.resolver.conflicts._jax)  # device phase children
+
+    def drive(line):
+        return c.loop.run_until(
+            db.process.spawn(cli.run_command(line)), timeout_vt=60.0
+        )
+
+    export = drive("trace-export")
+    assert len(export) == 1
+    latency = drive("latency")
+    diff_first = drive("metrics --diff")[0]
+    doc = cluster_status(c)
+    out = (export[0], global_span_hub().spans_json(), doc, latency,
+           diff_first)
+    set_event_loop(None)
+    return out
+
+
+def test_cli_trace_export_acceptance(monkeypatch):
+    """The acceptance criterion: `cli trace-export` of a pipelined
+    resolve run is valid Chrome trace-event JSON, byte-identical across
+    same-seed runs, with the per-batch stage spans and the device
+    phase-attribution child spans present."""
+    monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", "2")
+    blob1, spans1, status1, latency1, diff_first = _cluster_run(4242)
+    blob2, spans2, _s, _l, _d = _cluster_run(4242)
+    assert blob1 == blob2, "same-seed trace-export is not byte-identical"
+    assert spans1 == spans2
+    doc = json.loads(blob1)
+    assert validate_perfetto(doc) == []
+    names = {e["name"] for e in doc["traceEvents"] if e["ph"] == "B"}
+    for required in ("resolve_batch", "encode", "dispatch", "device",
+                     "sync", "apply", "reply", "commit_batch",
+                     "get_version", "resolution", "log_push", "tlog_push",
+                     "phase.search", "phase.fixpoint", "phase.merge",
+                     "phase.evict"):
+        assert required in names, f"span {required!r} missing from export"
+    # Different seed diverges.
+    blob3, _sp, _st, _la, _di = _cluster_run(4243)
+    assert blob3 != blob1
+    # Status carries the span inventory + qos witness fields.
+    cl = status1["cluster"]
+    assert cl["spans"]["begun"] > 0 and cl["spans"]["roles"]
+    assert "conflict_witness_aborts" in cl["qos"]
+    assert "conflict_witness_topk" in cl["qos"]
+    # cli latency defaults to the span layer.
+    assert latency1[0].startswith("per-stage span latency")
+    assert any("resolve_batch" in ln for ln in latency1)
+    # metrics --diff with no prior snapshot says so.
+    assert diff_first.startswith("(no prior snapshot")
+
+
+# ---------------------------------------------------------------------------
+# bench: the overlap metric rides the pipeline arms
+# ---------------------------------------------------------------------------
+
+
+def test_bench_pipeline_reports_overlap(monkeypatch):
+    import sys
+
+    sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+    import numpy as np
+
+    import bench
+
+    rate, overlap = bench.bench_pipeline(
+        np.random.default_rng(7), 2, n_batches=4, per_batch=48,
+        h_cap=1 << 12, window=4,
+    )
+    assert rate > 0
+    assert set(overlap) == {"wall", "seq", "device_spans"}
+    assert overlap["device_spans"] == 4
+    assert overlap["seq"] > 0.0
+    rate1, overlap1 = bench.bench_pipeline(
+        np.random.default_rng(7), 1, n_batches=4, per_batch=48,
+        h_cap=1 << 12, window=4,
+    )
+    assert overlap1["seq"] == 0.0

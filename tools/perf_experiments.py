@@ -25,6 +25,8 @@ Variants (the one shared table, bench.VARIANTS):
 Run: python tools/perf_experiments.py   (on the TPU host)
      python tools/perf_experiments.py --pipeline   (CPU overlap sweep,
      any host)
+     python tools/perf_experiments.py --timeline  (short pipelined run
+     -> TIMELINE.json Perfetto artifact + phase attribution, any host)
 """
 
 import json
@@ -44,11 +46,14 @@ rng = np.random.default_rng(2024)
 depth = os.environ.get("FDB_TPU_PIPELINE_DEPTH")
 if depth:
     # Pipeline variants (ISSUE 11) price the FULL resolve loop: encode +
-    # dispatch + readback + mirror apply at the given depth.
-    rate = bench.bench_pipeline(rng, int(depth), h_cap=%(h_cap)d)
+    # dispatch + readback + mirror apply at the given depth; the span
+    # layer's overlap-efficiency metric rides along (ISSUE 12).
+    rate, overlap = bench.bench_pipeline(rng, int(depth), h_cap=%(h_cap)d)
+    print("RESULT " + json.dumps({"txns_per_sec": round(rate, 1),
+                                  "overlap_efficiency_wall": overlap["wall"]}))
 else:
     rate = bench.bench_jax(rng, h_cap=%(h_cap)d)
-print("RESULT " + json.dumps({"txns_per_sec": round(rate, 1)}))
+    print("RESULT " + json.dumps({"txns_per_sec": round(rate, 1)}))
 """
 
 sys.path.insert(0, REPO)
@@ -80,6 +85,14 @@ def main():
                   file=sys.stderr)
         print(json.dumps(program_cost_table(include_wall=True), indent=2,
                          sort_keys=True))
+        return
+    if "--timeline" in sys.argv:
+        # Timeline artifact (ISSUE 12): a short pipelined run with span
+        # recording + in-step phase attribution, exported as a Perfetto
+        # JSON (TIMELINE.json) — so the next device window ships a
+        # timeline alongside its BENCH numbers.  Runs anywhere (the CPU
+        # backend's async dispatch provides the overlap).
+        print(json.dumps(bench.bench_timeline(), indent=2))
         return
     if "--pipeline" in sys.argv:
         # CPU-phase pipeline overlap microbench (ISSUE 11): the resolve
