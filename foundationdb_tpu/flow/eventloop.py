@@ -21,6 +21,7 @@ priority, insertion sequence).
 from __future__ import annotations
 
 import heapq
+import weakref
 from time import perf_counter as _perf_counter  # fdblint: ignore[DET001]: slow-task profiling measures REAL step cost; never feeds virtual time
 from typing import Coroutine, Optional
 
@@ -204,6 +205,10 @@ class EventLoop:
         # (actor name, exception) for tasks that died with a non-FdbError
         # exception: genuine bugs, surfaced as SimulationFailure by run_until.
         self.failed_actors: list = []
+        # Every task ever spawned, weakly: sim_validation's orphaned-wait
+        # teardown check (and the ran-dry diagnostic below) scan it for
+        # tasks parked on futures whose promise has been dropped.
+        self._spawned: "weakref.WeakSet[Task]" = weakref.WeakSet()
 
     def _note_actor_failure(self, name: str, err: BaseException):
         """Record an actor crash that is a bug (Python error), not a
@@ -250,6 +255,7 @@ class EventLoop:
 
     def spawn(self, coro: Coroutine, name: str = "", priority: int = TaskPriority.DefaultOnMainThread) -> Task:
         task = Task(self, coro, name)
+        self._spawned.add(task)
         self._schedule(priority, task._step)
         return task
 
@@ -303,7 +309,20 @@ class EventLoop:
                     f"virtual-time deadline {deadline} exceeded (now={self._now})"
                 )
             if not self.run_one():
-                raise RuntimeError("event loop ran dry awaiting future")
+                # Name the tasks parked on dropped promises (needs
+                # track_promise_refs; empty otherwise): a dry loop with a
+                # pending future is almost always THIS hang class.
+                from .sim_validation import orphaned_waits
+
+                orphans = orphaned_waits(self)
+                detail = (
+                    "; tasks parked on dropped promises: "
+                    + ", ".join(name for name, _w in orphans[:5])
+                    if orphans else ""
+                )
+                raise RuntimeError(
+                    "event loop ran dry awaiting future" + detail
+                )
         if future.is_error():
             # The awaited future's own error is observed by the caller via
             # get(); don't re-raise it as a SimulationFailure later.

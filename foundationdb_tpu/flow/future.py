@@ -13,6 +13,7 @@ mirroring how flow delivers to actor callbacks through task priorities.
 
 from __future__ import annotations
 
+import weakref
 from typing import Any, Callable, Generator, Optional
 
 from .error import ActorCancelled, FdbError
@@ -21,9 +22,30 @@ _PENDING = 0
 _VALUE = 1
 _ERROR = 2
 
+# Test-only bookkeeping behind flow/sim_validation's orphaned-wait check
+# (the dynamic twin of fdblint PRM001/PRM002): when on, every Future
+# remembers its paired Promise by WEAK reference, so teardown checks can
+# tell "parked on a promise somebody still holds" from "parked on a
+# promise that was dropped — zero remaining senders".  Off by default:
+# promises are hot-path objects and the weakref is pure diagnostics.
+_TRACK_REFS = False
+
+
+def track_promise_refs(on: bool):
+    """Enable/disable Promise weakref bookkeeping.  Must be on BEFORE the
+    scenario under test creates its promises (sim_validation's
+    expect_no_orphaned_waits guards against the forgotten call)."""
+    global _TRACK_REFS
+    _TRACK_REFS = bool(on)
+
+
+def promise_tracking_enabled() -> bool:
+    return _TRACK_REFS
+
 
 class Future:
-    __slots__ = ("_state", "_result", "_callbacks", "priority", "timer_cell")
+    __slots__ = ("_state", "_result", "_callbacks", "priority", "timer_cell",
+                 "promise_ref", "__weakref__")
 
     def __init__(self, priority: Optional[int] = None):
         self._state = _PENDING
@@ -33,6 +55,8 @@ class Future:
         self.priority = priority
         # Set by EventLoop.delay so pending timers can be cancelled.
         self.timer_cell = None
+        # weakref to the paired Promise (only under track_promise_refs).
+        self.promise_ref = None
 
     # -- inspection --
     def is_ready(self) -> bool:
@@ -92,10 +116,12 @@ class Future:
 class Promise:
     """Write side of a Future; ref flow/flow.h:705."""
 
-    __slots__ = ("future",)
+    __slots__ = ("future", "__weakref__")
 
     def __init__(self, priority: Optional[int] = None):
         self.future = Future(priority)
+        if _TRACK_REFS:
+            self.future.promise_ref = weakref.ref(self)
 
     def send(self, value=None):
         self.future._set(value)

@@ -388,3 +388,11 @@ g_env.declare("FDB_TPU_PROGRAM_COSTS", "",
                    "on first call, cached).  Default lazy: the programs "
                    "block appears once the table has been computed "
                    "(tools/perf_experiments.py --programs, tests)")
+g_env.declare("FDB_TPU_CHECK_ORPHANED_WAITS", "",
+              help="truthy: sim_validation.expect_no_orphaned_waits "
+                   "asserts at sim shutdown that no task is still parked "
+                   "on a future whose paired Promise was dropped (zero "
+                   "remaining senders) — the test-only dynamic twin of "
+                   "fdblint PRM001/PRM002.  Requires "
+                   "flow.future.track_promise_refs(True) before the "
+                   "scenario builds its promises")

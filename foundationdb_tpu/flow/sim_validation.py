@@ -9,6 +9,9 @@ process do not interfere.
 
 from __future__ import annotations
 
+import gc
+from typing import List, Tuple
+
 
 def _state(loop) -> dict:
     st = getattr(loop, "_sim_validation", None)
@@ -35,5 +38,81 @@ def expect_at_least(loop, key: str, value: int, context: str = ""):
     if m is not None and value < m:
         raise AssertionError(
             f"sim_validation: {key} promised {m} but observed {value}"
+            + (f" ({context})" if context else "")
+        )
+
+
+# ---------------------------------------------------------------------------
+# Orphaned-wait teardown check: the DYNAMIC twin of fdblint PRM001/PRM002.
+#
+# The static pass proves "no reachable code can send to this promise" from
+# the ASTs; this check observes the same condition at runtime: a Task still
+# parked on a future whose paired Promise has been garbage-collected has
+# ZERO remaining senders — nothing can ever wake it (the reference would
+# have delivered broken_promise from the Promise destructor; our rebuild
+# has no destructor backstop, which is exactly why both checks exist).
+# Needs flow.future.track_promise_refs(True) BEFORE the scenario builds its
+# promises; the assertion itself is gated on FDB_TPU_CHECK_ORPHANED_WAITS
+# so production/bench runs pay nothing.
+# ---------------------------------------------------------------------------
+
+
+def orphaned_waits(loop) -> List[Tuple[str, str]]:
+    """[(task_name, description)] for live tasks parked on a future whose
+    paired Promise was dropped.  Futures with a live pending timer are
+    excluded (the loop would have fired them had it kept running); tasks
+    awaiting futures with no recorded promise (timers, other Tasks) are
+    skipped — the check under-approximates, like the static pass.  Empty
+    when track_promise_refs is off."""
+    # Snapshot STRONG references before collecting: a fire-and-forget
+    # task parked on a dropped promise is itself only reachable through
+    # the task<->future callback cycle, and gc.collect() would reap it
+    # out of the WeakSet before the scan — silently missing exactly the
+    # dropped-handle orphan class this check exists for.  The collect
+    # still runs (after the snapshot) so a dropped PROMISE held only by
+    # a cycle reads as dead.
+    tasks = list(getattr(loop, "_spawned", ()))
+    gc.collect()
+    out: List[Tuple[str, str]] = []
+    for t in tasks:
+        if t.is_ready():
+            continue
+        f = getattr(t, "_waiting_on", None)
+        if f is None or f.is_ready():
+            continue
+        cell = getattr(f, "timer_cell", None)
+        if cell is not None and cell[0] is not None:
+            continue  # live timer: would fire
+        ref = getattr(f, "promise_ref", None)
+        if ref is not None and ref() is None:
+            out.append((t.name, "promise dropped; zero remaining senders"))
+    out.sort()
+    return out
+
+
+def expect_no_orphaned_waits(loop, context: str = ""):
+    """Loop-teardown assertion: no task may still be parked on a future
+    with zero remaining senders at sim shutdown.  No-op unless the
+    FDB_TPU_CHECK_ORPHANED_WAITS env flag is truthy (test-only — see
+    flow/knobs.py); raises if the flag is set but promise tracking was
+    never enabled, so the check can't silently pass while blind."""
+    from .knobs import g_env
+
+    if not g_env.get("FDB_TPU_CHECK_ORPHANED_WAITS"):
+        return
+    from .future import promise_tracking_enabled
+
+    if not promise_tracking_enabled():
+        raise AssertionError(
+            "sim_validation: FDB_TPU_CHECK_ORPHANED_WAITS is set but "
+            "flow.future.track_promise_refs(True) was not called before "
+            "the scenario — the check would be blind"
+        )
+    orphans = orphaned_waits(loop)
+    if orphans:
+        names = "; ".join(f"{n} ({w})" for n, w in orphans[:8])
+        raise AssertionError(
+            f"sim_validation: {len(orphans)} task(s) parked on futures "
+            f"with zero remaining senders at shutdown: {names}"
             + (f" ({context})" if context else "")
         )

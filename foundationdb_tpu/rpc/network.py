@@ -24,9 +24,21 @@ from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Tuple
 
 from ..flow.asyncvar import AsyncVar
-from ..flow.error import FdbError
+from ..flow.error import ActorCancelled, FdbError
 from ..flow.eventloop import EventLoop, Task, TaskPriority
 from ..flow.trace import TraceEvent
+
+
+def _trace_task_death(f):
+    """Completion observer attached by spawn_observed: errors other than
+    cancellation become a trace event instead of vanishing with the
+    dropped Task."""
+    err = f.error()
+    if err is None or isinstance(err, ActorCancelled):
+        return
+    TraceEvent("SpawnedTaskDied", severity=20).detail(
+        "task", getattr(f, "name", "?")
+    ).detail("error", repr(err)).log()
 
 
 @dataclass(frozen=True)
@@ -79,6 +91,20 @@ class SimProcess:
         t = self.network.loop.spawn(coro, name=f"{self.name}/{name}")
         self._tasks.append(t)
         self._tasks = [x for x in self._tasks if not x.is_ready()]
+        return t
+
+    def spawn_observed(self, coro, name: str = "") -> Task:
+        """spawn + death observation, for fire-and-forget actors whose Task
+        nobody holds (serve loops, tickers, per-request handlers): an
+        FdbError killing such a task otherwise vanishes — the loop only
+        surfaces non-FdbError crashes, so a role quietly stops serving
+        (the grey-failure wedge fdblint TSK001 polices).  Only
+        CANCELLATION is quiet; every other death — broken_promise from a
+        closed generation's stream included — emits SpawnedTaskDied by
+        design, because "which generation's actor died when" is exactly
+        what a recovery post-mortem needs."""
+        t = self.spawn(coro, name)
+        t.add_callback(_trace_task_death)
         return t
 
     # -- endpoints --

@@ -85,6 +85,16 @@ class RealProcess:
         self._tasks = [x for x in self._tasks if not x.is_ready()]
         return t
 
+    def spawn_observed(self, coro, name: str = "") -> Task:
+        """SimProcess.spawn_observed's surface on the real transport: the
+        role code is identical on either network (the load-bearing Sim2/
+        Net2 design), so fire-and-forget actor deaths trace here too."""
+        from .network import _trace_task_death
+
+        t = self.spawn(coro, name)
+        t.add_callback(_trace_task_death)
+        return t
+
     def make_endpoint(
         self,
         receiver: Callable,

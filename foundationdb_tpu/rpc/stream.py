@@ -128,6 +128,15 @@ class RequestStream:
             test_probe("request_stream_closed_parked")
         for _req, rep in pending:
             rep.send_error(error_name)
+        # The CONSUMER side must break too: a serve actor parked in
+        # `await stream.pop()` when its generation retires would otherwise
+        # stay parked forever — nothing can ever push (deliveries are
+        # refused above), so the task and everything it closes over leak
+        # silently until process death (the fdblint PRM001 orphaned-wait
+        # class, observed dynamically by sim_validation's
+        # expect_no_orphaned_waits).  Erroring the stream wakes it with
+        # broken_promise and it exits with its generation.
+        self._stream.send_error(FdbError(error_name))
 
     def pop(self) -> Future:
         """Future of the next (request, Reply)."""
@@ -225,8 +234,10 @@ def spawn_owned(role, coro, name: str):
     role._owned (pruned of finished tasks) so worker._teardown_role can
     cancel it with the role.  Handlers can park indefinitely (prevVersion
     ordering waits, log pushes into a chain hole) and must die with their
-    generation, breaking the replies they hold."""
-    t = role.process.spawn(coro, name)
+    generation, breaking the replies they hold.  Observed (spawn_observed
+    semantics): ownership covers cancellation, not error observation — a
+    handler dying on an FdbError mid-request must trace, not vanish."""
+    t = role.process.spawn_observed(coro, name)
     role._owned = [x for x in getattr(role, "_owned", []) if not x.is_ready()]
     role._owned.append(t)
     return t

@@ -104,7 +104,7 @@ class ClusterController:
         from .failure_monitor import FailureDetector
 
         self.failure_detector = FailureDetector(process)
-        process.spawn(self._failure_ping_sweep(), "cc_failure_sweep")
+        process.spawn_observed(self._failure_ping_sweep(), "cc_failure_sweep")
         change_id = process.network.loop.rng.random_int(1, 1 << 31)
         self._leader_info = LeaderInfo(
             priority=0,
@@ -112,14 +112,14 @@ class ClusterController:
             address=process.address,
             payload={"register_worker": self._register_stream.ref()},
         )
-        process.spawn(
+        process.spawn_observed(
             try_become_leader(
                 process, coordinators, self._leader_info, self.is_leader
             ),
             "cc_candidacy",
         )
-        process.spawn(self._serve_register(), "cc_register")
-        process.spawn(self._serve_client_info(), "cc_info")
+        process.spawn_observed(self._serve_register(), "cc_register")
+        process.spawn_observed(self._serve_client_info(), "cc_info")
         process.spawn(self._run(), "cc_run")
 
     # --- worker registry (ref RegisterWorkerRequest handling) ---

@@ -195,7 +195,7 @@ class Coordinator:
         self._cd = RequestStream(process, "coord_candidacy", well_known=True)
         self._gl = RequestStream(process, "coord_get_leader", well_known=True)
         self._fw = RequestStream(process, "coord_set_forward", well_known=True)
-        process.spawn(self._boot(), "coord_boot")
+        process.spawn_observed(self._boot(), "coord_boot")
 
     async def _boot(self):
         """Recover the generation register from disk, then serve.  Requests
@@ -222,12 +222,12 @@ class Coordinator:
                 # old quorum (ref: forward is durable in the reference too).
                 self.forward = fwd[0].decode().split(",")
         p = self.process
-        p.spawn(self._serve_gen_read(), "coord_gr")
-        p.spawn(self._serve_gen_write(), "coord_gw")
-        p.spawn(self._serve_candidacy(), "coord_cd")
-        p.spawn(self._serve_get_leader(), "coord_gl")
-        p.spawn(self._serve_set_forward(), "coord_fw")
-        p.spawn(self._nominee_tick(), "coord_tick")
+        p.spawn_observed(self._serve_gen_read(), "coord_gr")
+        p.spawn_observed(self._serve_gen_write(), "coord_gw")
+        p.spawn_observed(self._serve_candidacy(), "coord_cd")
+        p.spawn_observed(self._serve_get_leader(), "coord_gl")
+        p.spawn_observed(self._serve_set_forward(), "coord_fw")
+        p.spawn_observed(self._nominee_tick(), "coord_tick")
 
     async def _persist(self, key: bytes):
         if self._store is None:

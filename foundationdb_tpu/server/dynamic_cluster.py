@@ -105,7 +105,7 @@ class DynamicCluster:
             w = WorkerServer(proc, self.fs)
             self.workers.append(w)
             leader_var = AsyncVar(None)
-            proc.spawn(
+            proc.spawn_observed(
                 monitor_leader(proc, CoordinatorSet(boot_addrs), leader_var),
                 "leader_mon",
             )
@@ -146,7 +146,7 @@ class DynamicCluster:
         leader_var = AsyncVar(None)
         # Own connection-file view (snapshot of the cluster-level one);
         # coordinator forwards retarget it if the quorum moves later.
-        proc.spawn(
+        proc.spawn_observed(
             monitor_leader(
                 proc, CoordinatorSet(list(self.coord_set.addresses)), leader_var
             ),

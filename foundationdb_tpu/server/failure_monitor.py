@@ -46,7 +46,7 @@ class FailureDetector:
         self._stream = RequestStream(
             process, "failure_monitor", well_known=True
         )
-        process.spawn(self._serve(), "failure_monitor_serve")
+        process.spawn_observed(self._serve(), "failure_monitor_serve")
 
     def ref(self):
         return self._stream.ref()

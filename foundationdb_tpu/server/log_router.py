@@ -54,7 +54,7 @@ class LogRouter:
         # floor passed us): the operator/recovery must re-point or rebuild
         # this router — retrying would spin forever.
         self.broken: Optional[FdbError] = None
-        process.spawn(self._main(), "lr_main")
+        process.spawn_observed(self._main(), "lr_main")
         process.spawn(self._floor_loop(), "lr_floor")
 
     async def _main(self):

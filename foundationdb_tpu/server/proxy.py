@@ -193,15 +193,15 @@ class Proxy:
 
         spawn_sampler(process, self.metrics.name, self.metrics)
         self._last_batch_cut = process.network.loop.now()
-        process.spawn(self._commit_batcher(), "proxy_batcher")
+        process.spawn_observed(self._commit_batcher(), "proxy_batcher")
         # Always tick (not just multi-proxy): empty batches advance the
         # committed version with virtual time, which TaskBucket leases and
         # MVCC-window expiry depend on (ref: the master's version clock
         # advancing with wall time, masterserver getVersion :800-809).
-        process.spawn(self._idle_batch_ticker(), "proxy_idle_tick")
+        process.spawn_observed(self._idle_batch_ticker(), "proxy_idle_tick")
         process.spawn(self._serve_grv(), "proxy_grv")
-        process.spawn(self._serve_locations(), "proxy_locations")
-        process.spawn(self._serve_load_map(), "proxy_load_map")
+        process.spawn_observed(self._serve_locations(), "proxy_locations")
+        process.spawn_observed(self._serve_load_map(), "proxy_load_map")
 
     def _spawn_owned(self, coro, name: str):
         from ..rpc.stream import spawn_owned

@@ -238,8 +238,8 @@ class Ratekeeper:
                    "grv_queue_depth", "commit_p99_ms", "resolve_p99_ms"):
             self.metrics.gauge(_g)
         self._stream = RequestStream(process, "rk_get_rate", well_known=True)
-        process.spawn(self._update_loop(), "rk_update")
-        process.spawn(self._serve(), "rk_serve")
+        process.spawn_observed(self._update_loop(), "rk_update")
+        process.spawn_observed(self._serve(), "rk_serve")
         spawn_sampler(process, "Ratekeeper", self.metrics)
 
     # Proxies fetch at most every 0.1s (the GRV loop's fetch throttle);

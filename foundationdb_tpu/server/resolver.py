@@ -201,10 +201,10 @@ class Resolver:
         self._dev_seq_union = 0
         self._dev_seq_hwm = None
         self._dev_wall_hwm = None
-        process.spawn(self._serve(), "resolver")
-        process.spawn(self._serve_metrics(), "resolver_metrics")
-        process.spawn(self._serve_split(), "resolver_split")
-        process.spawn(self._serve_signals(), "resolver_signals")
+        process.spawn_observed(self._serve(), "resolver")
+        process.spawn_observed(self._serve_metrics(), "resolver_metrics")
+        process.spawn_observed(self._serve_split(), "resolver_split")
+        process.spawn_observed(self._serve_signals(), "resolver_signals")
         process.spawn(
             emit_metrics(self.metrics, process), "resolver_metrics_emit"
         )
@@ -231,7 +231,7 @@ class Resolver:
         if period > 0 and callable(
             getattr(self.conflicts, "mirror_check", None)
         ):
-            process.spawn(
+            process.spawn_observed(
                 self._mirror_check_loop(period), "resolver_mirror_check"
             )
 

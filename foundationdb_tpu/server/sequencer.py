@@ -31,9 +31,9 @@ class Sequencer:
         self._commit_stream = RequestStream(process, "get_commit_version", well_known=True)
         self._report_stream = RequestStream(process, "report_committed", well_known=True)
         self._read_stream = RequestStream(process, "get_committed_version", well_known=True)
-        process.spawn(self._serve_commit_versions(), "sequencer_commit")
-        process.spawn(self._serve_reports(), "sequencer_report")
-        process.spawn(self._serve_reads(), "sequencer_read")
+        process.spawn_observed(self._serve_commit_versions(), "sequencer_commit")
+        process.spawn_observed(self._serve_reports(), "sequencer_report")
+        process.spawn_observed(self._serve_reads(), "sequencer_read")
 
     def interface(self) -> SequencerInterface:
         return SequencerInterface(

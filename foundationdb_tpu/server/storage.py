@@ -421,14 +421,14 @@ class StorageServer:
                 ),
             )
         process.spawn(self._update_loop(), "ss_update")
-        process.spawn(self._serve_get_value(), "ss_get_value")
-        process.spawn(self._serve_metrics(), "ss_metrics")
-        process.spawn(self._serve_get_key_values(), "ss_get_key_values")
-        process.spawn(self._serve_get_version(), "ss_get_version")
-        process.spawn(self._serve_watch_value(), "ss_watch")
-        process.spawn(self._serve_fetch_shard(), "ss_fetch")
-        process.spawn(self._serve_get_shard_state(), "ss_shard_state")
-        process.spawn(self._serve_get_owned_meta(), "ss_owned_meta")
+        process.spawn_observed(self._serve_get_value(), "ss_get_value")
+        process.spawn_observed(self._serve_metrics(), "ss_metrics")
+        process.spawn_observed(self._serve_get_key_values(), "ss_get_key_values")
+        process.spawn_observed(self._serve_get_version(), "ss_get_version")
+        process.spawn_observed(self._serve_watch_value(), "ss_watch")
+        process.spawn_observed(self._serve_fetch_shard(), "ss_fetch")
+        process.spawn_observed(self._serve_get_shard_state(), "ss_shard_state")
+        process.spawn_observed(self._serve_get_owned_meta(), "ss_owned_meta")
 
     @classmethod
     async def recover(
@@ -1034,7 +1034,7 @@ class StorageServer:
     async def _serve_get_owned_meta(self):
         while True:
             req, reply = await self._owned_meta_stream.pop()
-            self.process.spawn(self._owned_meta_one(req, reply), "ss_om_one")
+            self.process.spawn_observed(self._owned_meta_one(req, reply), "ss_om_one")
 
     async def _owned_meta_one(self, req, reply):
         # Answer only once the replayed log tail (with any settled handoffs)

@@ -106,11 +106,11 @@ class TLog:
         self._metrics_stream = RequestStream(
             process, "tlog_metrics", well_known=True
         )
-        process.spawn(self._serve_commit(), "tlog_commit")
-        process.spawn(self._serve_peek(), "tlog_peek")
-        process.spawn(self._serve_pop(), "tlog_pop")
-        process.spawn(self._serve_confirm(), "tlog_confirm")
-        process.spawn(self._serve_metrics(), "tlog_metrics")
+        process.spawn_observed(self._serve_commit(), "tlog_commit")
+        process.spawn_observed(self._serve_peek(), "tlog_peek")
+        process.spawn_observed(self._serve_pop(), "tlog_pop")
+        process.spawn_observed(self._serve_confirm(), "tlog_confirm")
+        process.spawn_observed(self._serve_metrics(), "tlog_metrics")
 
     @classmethod
     async def recover(
@@ -339,7 +339,7 @@ class TLog:
             and not self._spilling
             and self._mem_bytes > self.spill_threshold_bytes
         ):
-            self.process.spawn(self._spill_task(), "tlog_spill")
+            self.process.spawn_observed(self._spill_task(), "tlog_spill")
         reply.send(req.version)
 
     @staticmethod
@@ -613,7 +613,7 @@ class TLog:
                 and self.spilled_through > 0
                 and self._spill_gc_floor < self.spilled_through
             ):
-                self.process.spawn(self._spill_gc(floor), "tlog_spill_gc")
+                self.process.spawn_observed(self._spill_gc(floor), "tlog_spill_gc")
 
     async def _spill_gc(self, floor: int):
         """Delete spilled data below the global consumer floor and persist

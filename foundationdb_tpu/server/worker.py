@@ -142,9 +142,9 @@ class WorkerServer:
         self._role_check_stream = RequestStream(
             process, "worker_role_check", well_known=True
         )
-        process.spawn(self._serve_init(), "worker_init")
-        process.spawn(self._serve_ping(), "worker_ping")
-        process.spawn(self._serve_role_check(), "worker_role_check")
+        process.spawn_observed(self._serve_init(), "worker_init")
+        process.spawn_observed(self._serve_ping(), "worker_ping")
+        process.spawn_observed(self._serve_role_check(), "worker_role_check")
         if fs is not None and fs.exists(process, "coordination.dq"):
             # A worker that served coordination (post-quorum-change) must
             # resume it AT BOOT, before any controller exists — elections

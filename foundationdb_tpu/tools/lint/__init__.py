@@ -15,6 +15,8 @@ model:
   rpy.py        RPY001 — reply-promise path analysis (broken-promise hang)
   graphs.py     module graph + call graph from per-file summaries
   det101.py     DET101 — interprocedural determinism taint
+  promises.py   PRM001-004/TSK001 — promise lifecycle + wait-graph
+                deadlock analysis (hangcheck; ISSUE 13)
   project.py    project loader, per-file AST/mtime cache, orchestration
   cli.py        text/json/SARIF output, --changed-only git mode
 

@@ -34,6 +34,11 @@ RULES: Dict[str, str] = {
     "WAIT001": "shared state captured before an await and dereferenced after it without re-read",
     "WAIT002": "iteration over shared mutable state whose loop body awaits (reference across wait)",
     "RPY001": "reply promise path that neither sends, errors, nor hands the reply off (broken-promise hang)",
+    "PRM001": "future awaited where no reachable code can send to its paired promise (orphaned wait / static hang)",
+    "PRM002": "promise abandoned on some path without send/send_error/close (dropped promise, interprocedural)",
+    "PRM003": "wait-cycle in the actor wait-graph with no external sender (static deadlock)",
+    "PRM004": "consumer loop over a stream whose producers can all terminate without closing it",
+    "TSK001": "spawned Task dropped while its coroutine can raise with neither handler nor TraceEvent",
     "ENV001": "FDB_TPU_* environment flag read outside the flow/knobs.py registry (config drift)",
     "PRG001": "fdblint ignore pragma carries no reason string",
     "PRG002": "fdblint ignore pragma suppresses nothing (stale)",
@@ -115,6 +120,14 @@ RPY_MODULE_GLOBS = ("server/*.py", "rpc/*.py")
 ENV_REGISTRY_GLOBS = ("flow/knobs.py",)
 ENV_FLAG_PREFIX = "FDB_TPU_"
 
+# Modules that run outside the simulator by identity (real-mode backends
+# with OS-thread concurrency + operational programs): the shared
+# exemption set for the cooperative-actor rule families.
+_REAL_MODE_MODULES = (
+    "rpc/real_network.py", "fileio/blobstore.py", "fileio/realfile.py",
+    "flow/profiler.py", "tools/*.py", "utils/procutil.py",
+)
+
 # Per-rule allowlist: package-relative posix globs for modules that are
 # real-deployment components by identity, where the rule does not apply.
 # The IO001 set mirrors the rule text: fileio/ real backends +
@@ -172,6 +185,15 @@ DEFAULT_ALLOW: Dict[str, Tuple[str, ...]] = {
     "WAIT001": ("rpc/real_network.py", "tools/*.py"),
     "WAIT002": ("rpc/real_network.py", "tools/*.py"),
     "RPY001": (),
+    # The PRM/TSK promise-lifecycle rules police cooperative-actor
+    # ownership; the real-mode, OS-threaded backends (already DET003-
+    # exempt) hand promises across threads with genuinely different
+    # suspension semantics, and tools/ are operational programs.
+    "PRM001": _REAL_MODE_MODULES,
+    "PRM002": _REAL_MODE_MODULES,
+    "PRM003": _REAL_MODE_MODULES,
+    "PRM004": _REAL_MODE_MODULES,
+    "TSK001": _REAL_MODE_MODULES,
     "ENV001": (),
 }
 

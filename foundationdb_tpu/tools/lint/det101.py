@@ -34,12 +34,15 @@ def run_det101(
     pragmas_by_file: Dict[str, Dict[int, Pragma]],
     config: LintConfig,
     consumed_pragmas: Optional[Dict[str, Set[int]]] = None,
+    graph: Optional[CallGraph] = None,
 ) -> List[Finding]:
     """`consumed_pragmas` (relpath -> line set), when given, collects the
     DET101 pragmas that did their work by CUTTING taint (sanctioning a
     source or a call edge) — those never see a finding to suppress, so
     the caller must mark them used or PRG002 would call them stale."""
-    graph = CallGraph(summaries)
+    # `graph` lets the orchestrator share ONE CallGraph with the promise
+    # pass (both link the same summaries every lint).
+    graph = CallGraph(summaries) if graph is None else graph
 
     def consume(relpath: str, line: int):
         if consumed_pragmas is not None:

@@ -33,8 +33,13 @@ from .base import (
     parse_pragmas,
 )
 from .det101 import run_det101
-from .graphs import ModuleSummary, collect_summary
+from .graphs import CallGraph, ModuleSummary, collect_summary
 from .local import ModuleLinter
+from .promises import (
+    ModulePromiseFacts,
+    collect_promise_facts,
+    run_promise_rules,
+)
 from .rpy import run_rpy001
 from .waitrules import run_wait_rules
 
@@ -48,6 +53,7 @@ class FileRecord:
     raw_findings: List[Finding]     # all per-file passes, unfiltered
     pragmas: Dict[int, Pragma]
     summary: ModuleSummary
+    facts: ModulePromiseFacts       # promise-lifecycle facts (PRM/TSK)
 
 
 _FINGERPRINT: Optional[str] = None
@@ -166,8 +172,9 @@ class Project:
         findings += run_rpy001(relpath, tree)
         pragmas = parse_pragmas(source)
         summary = collect_summary(relpath, tree, self.root_pkg)
+        facts = collect_promise_facts(relpath, tree)
         self.stats["parsed"] += 1
-        return FileRecord(sig, digest, findings, pragmas, summary)
+        return FileRecord(sig, digest, findings, pragmas, summary, facts)
 
     def load(self):
         cached = self._load_cache()
@@ -210,11 +217,15 @@ class Project:
         if not self.records:
             self.load()
         summaries = {rp: r.summary for rp, r in self.records.items()}
+        facts = {rp: r.facts for rp, r in self.records.items()}
         pragmas_by_file = {rp: r.pragmas for rp, r in self.records.items()}
         consumed: Dict[str, set] = {}
+        graph = CallGraph(summaries)  # ONE linker shared by both passes
         det = run_det101(
-            summaries, pragmas_by_file, self.config, consumed_pragmas=consumed
+            summaries, pragmas_by_file, self.config,
+            consumed_pragmas=consumed, graph=graph,
         )
+        det += run_promise_rules(summaries, facts, graph=graph)
         det_by_file: Dict[str, List[Finding]] = {}
         for f in det:
             det_by_file.setdefault(f.path, []).append(f)
@@ -243,12 +254,19 @@ class Project:
 
 
 def lint_source(
-    source: str, relpath: str, config: Optional[LintConfig] = None
+    source: str, relpath: str, config: Optional[LintConfig] = None,
+    whole_project: bool = True,
 ) -> List[Finding]:
     """Lint one module's source with every per-file pass plus DET101
     restricted to the module's own call graph; findings suppressed by
     same-line pragmas are returned with suppressed=True.  PRG001/PRG002
-    police the pragmas themselves and are never suppressible."""
+    police the pragmas themselves and are never suppressible.
+
+    `whole_project` controls the PRM attr-entity rules' frame: True (the
+    default, right for self-contained sources) treats this module as the
+    entire project, so "no code in the project sends" can fire; False
+    (the standalone-FILE path, lint_file) assumes unseen sibling files
+    may send and runs only the function-local entity rules."""
     config = config or LintConfig()
     if _match_any(relpath, SKIP_MODULE_GLOBS):
         return []
@@ -259,9 +277,14 @@ def lint_source(
     pragmas = parse_pragmas(source)
     summary = collect_summary(relpath, tree, None)
     consumed: Dict[str, set] = {}
+    graph = CallGraph({relpath: summary})
     findings += run_det101(
         {relpath: summary}, {relpath: pragmas}, config,
-        consumed_pragmas=consumed,
+        consumed_pragmas=consumed, graph=graph,
+    )
+    findings += run_promise_rules(
+        {relpath: summary}, {relpath: collect_promise_facts(relpath, tree)},
+        whole_project=whole_project, graph=graph,
     )
     findings = [f for f in findings if not config.allows(f.rule, relpath)]
     for ln in consumed.get(relpath, ()):
@@ -275,7 +298,10 @@ def lint_file(
     relpath = os.path.relpath(path, root).replace(os.sep, "/")
     with open(path, "r", encoding="utf-8") as f:
         source = f.read()
-    return lint_source(source, relpath, config)
+    # A real file linted alone: sibling files exist but are not loaded,
+    # so the project-global PRM attr rules must not claim "no code in
+    # the project sends" from this restricted view.
+    return lint_source(source, relpath, config, whole_project=False)
 
 
 def lint_package(

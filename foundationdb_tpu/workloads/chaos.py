@@ -28,7 +28,7 @@ def revive_worker(cluster, proc):
         x for x in cluster.workers if x.process is not proc
     ] + [w]
     leader_var = AsyncVar(None)
-    proc.spawn(
+    proc.spawn_observed(
         monitor_leader(proc, getattr(cluster, "coord_set", cluster.coord_ifaces), leader_var),
         "leader_mon",
     )

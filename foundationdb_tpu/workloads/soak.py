@@ -490,7 +490,7 @@ class SoakRun:
         )
         # The clog holds asynchronously; capture once its window closes
         # (without stalling the fault driver's schedule).
-        self.db.process.spawn(
+        self.db.process.spawn_observed(
             self._capture_fault_window(
                 ev.duration, "clog", {"pair": f"{src}->{dst}"}
             ),

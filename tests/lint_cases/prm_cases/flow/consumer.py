@@ -1,0 +1,18 @@
+"""Cross-file negative: the consumer side of a producer/consumer promise
+pair.  `Handshake.ready` is awaited here and sent ONLY from
+server/producer.py — no finding while the producer keeps its send; the
+cache-correctness test edits the producer out and the PRM001 finding
+must appear HERE, from warm cache, with only the producer re-parsed
+(the recovery re-recruit handoff shape: a consumer parked on a promise
+another role's file fulfills).
+"""
+
+from foundationdb_tpu.flow.future import Promise
+
+
+class Handshake:
+    def __init__(self):
+        self.ready = Promise()
+
+    async def wait_ready(self):
+        await self.ready.future

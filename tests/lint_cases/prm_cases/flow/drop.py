@@ -1,0 +1,70 @@
+"""PRM002 corpus: promises abandoned on some path without
+send/send_error/close — RPY001's broken-promise analysis generalized to
+all promises, including the interprocedural handoff shape.
+"""
+
+from foundationdb_tpu.flow.future import Promise
+
+
+def early_return_drop(cond):
+    p = Promise()  # EXPECT: PRM002
+    if cond:
+        return None  # the promise is dropped here
+    p.send(1)
+    return p.future
+
+
+def swallowed_except_drop(risky):
+    p = Promise()  # EXPECT: PRM002
+    try:
+        p.send(risky())
+    except ValueError:
+        return None  # the raise-inside-send path abandons p
+    return p.future
+
+
+def finally_send_is_clean(risky):
+    p = Promise()
+    try:
+        risky()
+    finally:
+        p.send_error(ValueError("done"))
+    return p.future
+
+
+class Holder:
+    def __init__(self):
+        self.kept = None
+
+    def stored_for_later_is_clean(self):
+        p = Promise()
+        self.kept = p  # ownership transferred to the object
+        return p.future
+
+
+def handoff_to_leaky_spawn(loop, req):
+    # The promise's ONLY use is handing it into a spawned handler that
+    # can itself drop it (return-without-send on the None path).
+    p = Promise()
+    loop.spawn(leaky_handler(req, p), "handler")  # EXPECT: PRM002
+    return None
+
+
+async def leaky_handler(req, done):
+    if req is None:
+        return  # drops `done`
+    done.send(req)
+
+
+def handoff_to_clean_spawn(loop, req):
+    # Same shape, but the callee resolves on every path — no finding.
+    p = Promise()
+    loop.spawn(clean_handler(req, p), "handler")
+    return None
+
+
+async def clean_handler(req, done):
+    if req is None:
+        done.send_error(ValueError("empty"))
+        return
+    done.send(req)

@@ -1,0 +1,61 @@
+"""PRM001 corpus: orphaned waits — futures nothing can ever send to.
+
+Positives park forever; negatives have a sender, an escape (someone we
+cannot see may send), or a handoff into a callee that sends.
+"""
+
+from foundationdb_tpu.flow.future import Promise
+
+
+class Forgotten:
+    """Creates a promise, awaits it — and NOTHING in the corpus ever
+    sends to `.gate` or lets it escape: the static hang."""
+
+    def __init__(self):
+        self.gate = Promise()
+
+    async def parked_forever(self):
+        await self.gate.future  # EXPECT: PRM001
+
+
+async def local_orphan():
+    p = Promise()
+    await p.future  # EXPECT: PRM001
+
+
+async def escaped_is_unknown(registry):
+    # Stored into a container: an unseen holder may send — no finding.
+    p = Promise()
+    registry.append(p)
+    await p.future
+
+
+async def handoff_to_sender(loop):
+    # Handed into a spawned actor that sends on every path — no finding
+    # (the call-graph resolves the callee's param to a sender).
+    p = Promise()
+    loop.spawn(fulfiller(p), "fulfiller")
+    await p.future
+
+
+async def fulfiller(prom):
+    prom.send(1)
+
+
+class StoredForLater:
+    """The resolver _ParkedResolve shape (pipeline park/drain): the
+    promise is created lazily, the future handed out, and a DIFFERENT
+    method sends at completion — no finding on either side."""
+
+    def __init__(self):
+        self.parked_done = Promise()
+
+    def future(self):
+        return self.parked_done.future
+
+    def mark_finished(self):
+        if not self.parked_done.is_set():
+            self.parked_done.send(None)
+
+    async def drain_wait(self):
+        await self.parked_done.future

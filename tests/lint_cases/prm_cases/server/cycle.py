@@ -1,0 +1,41 @@
+"""PRM003 corpus: wait-cycles in the actor wait-graph.
+
+`Deadlocked.first` awaits a future only `Deadlocked.second` sends, and
+conversely — an SCC with no external sender.  `Breakable` has the same
+internal cycle plus an external sender, so it is live.
+"""
+
+from foundationdb_tpu.flow.future import Promise
+
+
+class Deadlocked:
+    def __init__(self):
+        self.cx = Promise()
+        self.cy = Promise()
+
+    async def first(self):
+        await self.cy.future  # EXPECT: PRM003
+        self.cx.send(1)
+
+    async def second(self):
+        await self.cx.future  # EXPECT: PRM003
+        self.cy.send(1)
+
+
+class Breakable:
+    def __init__(self):
+        self.lx = Promise()
+        self.ly = Promise()
+
+    async def first(self):
+        await self.ly.future
+        self.lx.send(1)
+
+    async def second(self):
+        await self.lx.future
+        self.ly.send(1)
+
+    def external_kick(self):
+        # An external sender outside the cycle: the recruit/handoff
+        # "recovery kicks the parked generation" shape — no finding.
+        self.ly.send(0)

@@ -1,0 +1,66 @@
+"""Pragma corpus for the PRM/TSK family: one reasoned suppression per
+rule (these appear as suppressed, not unsuppressed, findings) plus a
+stale pragma per rule that suppresses nothing and ages into PRG002.
+"""
+
+from foundationdb_tpu.flow.future import Promise, PromiseStream
+
+
+class DeliberatePark:
+    def __init__(self):
+        self.never_sent = Promise()
+
+    async def parked(self):
+        await self.never_sent.future  # fdblint: ignore[PRM001]: corpus — harness fulfills via debug hook
+
+
+def deliberate_drop(cond):
+    p = Promise()  # fdblint: ignore[PRM002]: corpus — probe promise, abandonment is the measured outcome
+    if cond:
+        return None
+    p.send(1)
+    return p.future
+
+
+class DeliberateCycle:
+    def __init__(self):
+        self.px = Promise()
+        self.py = Promise()
+
+    async def first(self):
+        await self.py.future  # fdblint: ignore[PRM003]: corpus — lockstep pair driven externally in the harness
+        self.px.send(1)
+
+    async def second(self):
+        await self.px.future  # fdblint: ignore[PRM003]: corpus — lockstep pair driven externally in the harness
+        self.py.send(1)
+
+
+class DeliberateDrain:
+    def __init__(self):
+        self.drain_q = PromiseStream()
+
+    async def consume(self):
+        while True:
+            item = await self.drain_q.pop()  # fdblint: ignore[PRM004]: corpus — consumer cancelled with its role at teardown
+            del item
+
+    async def produce(self, items):
+        for it in items:
+            self.drain_q.send(it)
+
+
+async def flaky(loop):
+    await loop.delay(1)
+
+
+def fire_and_forget(loop):
+    loop.spawn(flaky(loop), "flaky")  # fdblint: ignore[TSK001]: corpus — best-effort prefetch, errors are acceptable
+
+
+# Stale pragmas: nothing on these lines fires, so each ages into PRG002.
+A = 1  # fdblint: ignore[PRM001]: stale  # EXPECT: PRG002
+B = 2  # fdblint: ignore[PRM002]: stale  # EXPECT: PRG002
+C = 3  # fdblint: ignore[PRM003]: stale  # EXPECT: PRG002
+D = 4  # fdblint: ignore[PRM004]: stale  # EXPECT: PRG002
+E = 5  # fdblint: ignore[TSK001]: stale  # EXPECT: PRG002

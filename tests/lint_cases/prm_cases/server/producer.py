@@ -1,0 +1,8 @@
+"""Cross-file negative: the producer side — the only sender of
+flow/consumer.py's `Handshake.ready`.  Removing `kick` (the
+cache-correctness test does) must surface PRM001 on the consumer side.
+"""
+
+
+def kick(handshake):
+    handshake.ready.send(1)

@@ -1,0 +1,62 @@
+"""PRM004 corpus: consumer loops over streams whose producers can all
+terminate without closing them (the pipeline idle-flush/drain shape).
+"""
+
+from foundationdb_tpu.flow.future import PromiseStream
+
+
+class LeakyPipe:
+    def __init__(self):
+        self.leaky_q = PromiseStream()
+
+    async def consume(self):
+        while True:
+            item = await self.leaky_q.pop()  # EXPECT: PRM004
+            del item
+
+    async def produce(self, items):
+        # Terminates after the loop without ever closing the stream: once
+        # it finishes, the consumer parks forever.
+        for it in items:
+            self.leaky_q.send(it)
+
+
+class ClosingPipe:
+    def __init__(self):
+        self.closed_q = PromiseStream()
+
+    async def consume(self):
+        while True:
+            item = await self.closed_q.pop()
+            del item
+
+    async def produce(self, items):
+        for it in items:
+            self.closed_q.send(it)
+        # close-in-producer: the consumer observes end-of-stream.
+        self.closed_q.send_error(ValueError("end_of_stream"))
+
+
+class ForeverPipe:
+    def __init__(self):
+        self.forever_q = PromiseStream()
+
+    async def consume(self):
+        while True:
+            item = await self.forever_q.pop()
+            del item
+
+    async def produce(self, source):
+        # The producer itself never terminates (unbroken while True):
+        # the consumer can always expect more — no finding.
+        while True:
+            self.forever_q.send(source())
+
+
+async def local_stream_loop(items):
+    ps = PromiseStream()
+    for it in items:
+        ps.send(it)
+    while True:
+        item = await ps.pop()  # EXPECT: PRM004
+        del item

@@ -1,0 +1,44 @@
+"""TSK001 corpus: statement-level spawns whose Task is dropped while the
+coroutine can raise with neither a handler nor a TraceEvent.
+"""
+
+from foundationdb_tpu.flow.trace import TraceEvent
+
+
+async def fragile(loop):
+    await loop.delay(1)  # any await can deliver an FdbError
+
+
+async def guarded(loop):
+    try:
+        await loop.delay(1)
+    except ValueError:
+        return None
+
+
+async def traced(loop):
+    await loop.delay(1)
+    TraceEvent("TracedDone").log()
+
+
+def start_unobserved(loop):
+    loop.spawn(fragile(loop), "fragile")  # EXPECT: TSK001
+
+
+def start_with_handler(loop):
+    loop.spawn(guarded(loop), "guarded")
+
+
+def start_with_trace(loop):
+    loop.spawn(traced(loop), "traced")
+
+
+def start_held(loop):
+    # The Task is held: the caller observes the error — no finding.
+    t = loop.spawn(fragile(loop), "held")
+    return t
+
+
+def start_observed(process, loop):
+    # spawn_observed attaches a death observer by construction.
+    process.spawn_observed(fragile(loop), "observed")

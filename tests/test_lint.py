@@ -1196,7 +1196,7 @@ def _expected_markers(case_dir):
 
 @pytest.mark.parametrize(
     "case", ["wait_rules", "rpy_cases", "det101_pkg", "env_cases",
-             "spn_cases"]
+             "spn_cases", "prm_cases"]
 )
 def test_golden_corpus(case, capsys):
     case_dir = os.path.join(CASES_DIR, case)

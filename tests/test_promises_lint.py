@@ -1,0 +1,567 @@
+"""PRM/TSK promise-lifecycle lint family: rule units, interprocedural
+cache correctness, CLI modes, and the tier-1 per-rule count surface.
+
+The golden corpus (tests/lint_cases/prm_cases) runs through the shared
+test_golden_corpus runner in test_lint.py; this module covers what the
+corpus cannot: warm-cache cross-file correctness (editing only a
+producer file must clear/raise a consumer-side PRM001), --changed-only
+and single-file modes over the new interprocedural facts, SARIF shape,
+and the conservative three-valued behaviors on planted sources.
+
+Runnable alone: pytest -m lint tests/test_promises_lint.py
+"""
+
+import json
+import os
+import shutil
+import sys
+
+import pytest
+
+import foundationdb_tpu
+from foundationdb_tpu.tools.fdblint import (
+    RULES,
+    Project,
+    count_by_rule,
+    lint_package,
+    lint_source,
+    main,
+)
+
+pytestmark = pytest.mark.lint
+
+PKG_DIR = os.path.dirname(os.path.abspath(foundationdb_tpu.__file__))
+CASES_DIR = os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), "lint_cases"
+)
+PRM_RULES = ("PRM001", "PRM002", "PRM003", "PRM004", "TSK001")
+
+
+def rules_of(findings, suppressed=False):
+    return [f.rule for f in findings if f.suppressed == suppressed]
+
+
+def test_prm_rules_registered_and_documented():
+    for rule in PRM_RULES:
+        assert rule in RULES and RULES[rule]
+
+
+# ---------------------------------------------------------------------------
+# PRM001 — orphaned waits
+# ---------------------------------------------------------------------------
+
+
+def test_prm001_attr_and_local_orphans():
+    src = (
+        "from foundationdb_tpu.flow.future import Promise\n"
+        "class G:\n"
+        "    def __init__(self):\n"
+        "        self.gate = Promise()\n"
+        "    async def w(self):\n"
+        "        await self.gate.future\n"
+        "async def lo():\n"
+        "    p = Promise()\n"
+        "    await p.future\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    prm = [f for f in findings if f.rule == "PRM001"]
+    assert [f.line for f in prm] == [6, 9]
+
+
+def test_prm001_sender_anywhere_clears():
+    src = (
+        "from foundationdb_tpu.flow.future import Promise\n"
+        "class G:\n"
+        "    def __init__(self):\n"
+        "        self.gate = Promise()\n"
+        "    async def w(self):\n"
+        "        await self.gate.future\n"
+        "def kick(g):\n"
+        "    g.gate.send(1)\n"
+    )
+    assert "PRM001" not in rules_of(lint_source(src, "server/x.py"))
+
+
+def test_prm001_escape_is_three_valued_unknown():
+    # Aliasing or storing the entity voids tracking: someone unseen may
+    # send — conservative no-finding, never a guess.
+    src = (
+        "from foundationdb_tpu.flow.future import Promise\n"
+        "class G:\n"
+        "    def __init__(self):\n"
+        "        self.gate = Promise()\n"
+        "    def share(self, reg):\n"
+        "        reg.append(self.gate)\n"
+        "    async def w(self):\n"
+        "        await self.gate.future\n"
+    )
+    assert "PRM001" not in rules_of(lint_source(src, "server/x.py"))
+
+
+def test_prm001_handoff_resolved_through_call_graph():
+    # The local promise is handed into a callee; whether PRM001 fires is
+    # decided by whether code reachable through that param can send.
+    sender = (
+        "from foundationdb_tpu.flow.future import Promise\n"
+        "def fulfill(prom):\n"
+        "    prom.send(1)\n"
+        "async def w(loop):\n"
+        "    p = Promise()\n"
+        "    fulfill(p)\n"
+        "    await p.future\n"
+    )
+    assert "PRM001" not in rules_of(lint_source(sender, "server/x.py"))
+    nonsender = sender.replace("    prom.send(1)\n", "    return prom.future\n")
+    found = rules_of(lint_source(nonsender, "server/x.py"))
+    assert "PRM001" in found
+
+
+def test_prm001_transitive_param_forwarding():
+    # fulfill() forwards to a helper two frames down that sends: the
+    # fixpoint must carry "may send" back through the chain.
+    src = (
+        "from foundationdb_tpu.flow.future import Promise\n"
+        "def deep(x):\n"
+        "    x.send(1)\n"
+        "def mid(prom):\n"
+        "    deep(prom)\n"
+        "async def w():\n"
+        "    p = Promise()\n"
+        "    mid(p)\n"
+        "    await p.future\n"
+    )
+    assert "PRM001" not in rules_of(lint_source(src, "server/x.py"))
+
+
+def test_prm001_pragma_suppresses_with_reason():
+    src = (
+        "from foundationdb_tpu.flow.future import Promise\n"
+        "class G:\n"
+        "    def __init__(self):\n"
+        "        self.gate = Promise()\n"
+        "    async def w(self):\n"
+        "        await self.gate.future  # fdblint: ignore[PRM001]: debug hook sends in tests\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    assert not [f for f in findings if not f.suppressed]
+    assert [f.reason for f in findings if f.suppressed] == [
+        "debug hook sends in tests"
+    ]
+
+
+# ---------------------------------------------------------------------------
+# PRM002 — dropped promises
+# ---------------------------------------------------------------------------
+
+
+def test_prm002_paths_and_negatives():
+    src = (
+        "from foundationdb_tpu.flow.future import Promise\n"
+        "def drop(cond):\n"
+        "    p = Promise()\n"
+        "    if cond:\n"
+        "        return None\n"
+        "    p.send(1)\n"
+        "def fin(risky):\n"
+        "    p = Promise()\n"
+        "    try:\n"
+        "        risky()\n"
+        "    finally:\n"
+        "        p.send_error(ValueError('x'))\n"
+        "    return p.future\n"
+        "class H:\n"
+        "    def keep(self):\n"
+        "        p = Promise()\n"
+        "        self.kept = p\n"
+        "        return p.future\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    prm = [f for f in findings if f.rule == "PRM002"]
+    assert [f.line for f in prm] == [3]
+
+
+def test_prm002_handoff_to_leaky_callee():
+    src = (
+        "from foundationdb_tpu.flow.future import Promise\n"
+        "async def leaky(req, done):\n"
+        "    if req is None:\n"
+        "        return\n"
+        "    done.send(req)\n"
+        "def hand(loop, req):\n"
+        "    p = Promise()\n"
+        "    loop.spawn(leaky(req, p), 'h')\n"
+        "    return None\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    prm = [f for f in findings if f.rule == "PRM002"]
+    assert [f.line for f in prm] == [8]
+    assert "leaky" in prm[0].message and "'done'" in prm[0].message
+    fixed = src.replace("        return\n", "        done.send_error('e')\n        return\n")
+    assert "PRM002" not in rules_of(lint_source(fixed, "server/x.py"))
+
+
+def test_prm002_shared_ownership_not_flagged():
+    # The caller keeps using the promise after the handoff: ownership is
+    # shared, the handoff alone must not be called a drop.
+    src = (
+        "from foundationdb_tpu.flow.future import Promise\n"
+        "async def leaky(req, done):\n"
+        "    if req is None:\n"
+        "        return\n"
+        "    done.send(req)\n"
+        "def hand(loop, req):\n"
+        "    p = Promise()\n"
+        "    loop.spawn(leaky(req, p), 'h')\n"
+        "    return p.future\n"
+    )
+    assert "PRM002" not in rules_of(lint_source(src, "server/x.py"))
+
+
+# ---------------------------------------------------------------------------
+# PRM003 — wait-cycles
+# ---------------------------------------------------------------------------
+
+
+def test_prm003_cycle_and_external_sender():
+    src = (
+        "from foundationdb_tpu.flow.future import Promise\n"
+        "class P:\n"
+        "    def __init__(self):\n"
+        "        self.x = Promise()\n"
+        "        self.y = Promise()\n"
+        "    async def a(self):\n"
+        "        await self.y.future\n"
+        "        self.x.send(1)\n"
+        "    async def b(self):\n"
+        "        await self.x.future\n"
+        "        self.y.send(1)\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    prm = [f for f in findings if f.rule == "PRM003"]
+    assert [f.line for f in prm] == [7, 10]
+    live = src + "    def kick(self):\n        self.y.send(0)\n"
+    assert "PRM003" not in rules_of(lint_source(live, "server/x.py"))
+
+
+def test_prm003_unresolvable_receiver_is_conservative():
+    # The waiter reaches the peer through a parameter — statically
+    # unattributable, so no edge and no finding (three-valued unknown).
+    src = (
+        "from foundationdb_tpu.flow.future import Promise\n"
+        "class A:\n"
+        "    def __init__(self):\n"
+        "        self.ap = Promise()\n"
+        "    async def run(self, peer):\n"
+        "        await peer.bp.future\n"
+        "        self.ap.send(1)\n"
+        "class B:\n"
+        "    def __init__(self):\n"
+        "        self.bp = Promise()\n"
+        "    async def run(self, peer):\n"
+        "        await peer.ap.future\n"
+        "        self.bp.send(1)\n"
+    )
+    assert "PRM003" not in rules_of(lint_source(src, "server/x.py"))
+
+
+# ---------------------------------------------------------------------------
+# PRM004 — producerless stream loops
+# ---------------------------------------------------------------------------
+
+
+def test_prm004_terminating_vs_infinite_vs_closing_producers():
+    base = (
+        "from foundationdb_tpu.flow.future import PromiseStream\n"
+        "class Pipe:\n"
+        "    def __init__(self):\n"
+        "        self.q = PromiseStream()\n"
+        "    async def consume(self):\n"
+        "        while True:\n"
+        "            item = await self.q.pop()\n"
+        "    async def produce(self, items):\n"
+        "        for it in items:\n"
+        "            self.q.send(it)\n"
+    )
+    findings = lint_source(base, "server/x.py")
+    assert [f.line for f in findings if f.rule == "PRM004"] == [7]
+    closing = base + "    def drain(self):\n        self.q.send_error(ValueError('eos'))\n"
+    assert "PRM004" not in rules_of(lint_source(closing, "server/x.py"))
+    forever = base.replace(
+        "        for it in items:\n            self.q.send(it)\n",
+        "        while True:\n            self.q.send(items())\n",
+    )
+    assert "PRM004" not in rules_of(lint_source(forever, "server/x.py"))
+
+
+# ---------------------------------------------------------------------------
+# TSK001 — unobserved spawned tasks
+# ---------------------------------------------------------------------------
+
+
+def test_tsk001_dropped_vs_held_vs_guarded():
+    src = (
+        "async def fragile(loop):\n"
+        "    await loop.delay(1)\n"
+        "async def guarded(loop):\n"
+        "    try:\n"
+        "        await loop.delay(1)\n"
+        "    except ValueError:\n"
+        "        return None\n"
+        "def go(loop):\n"
+        "    loop.spawn(fragile(loop), 'f')\n"
+        "    loop.spawn(guarded(loop), 'g')\n"
+        "    t = loop.spawn(fragile(loop), 'h')\n"
+        "    loop.spawn_observed(fragile(loop), 'o')\n"
+        "    return t\n"
+    )
+    findings = lint_source(src, "server/x.py")
+    tsk = [f for f in findings if f.rule == "TSK001"]
+    assert [f.line for f in tsk] == [9]
+
+
+def test_tsk001_nonraising_coroutine_is_clean():
+    src = (
+        "async def pure():\n"
+        "    return 1\n"
+        "def go(loop):\n"
+        "    loop.spawn(pure(), 'p')\n"
+    )
+    assert "TSK001" not in rules_of(lint_source(src, "server/x.py"))
+
+
+# ---------------------------------------------------------------------------
+# Interprocedural cache correctness: the producer-edit scenario
+# ---------------------------------------------------------------------------
+
+
+def test_editing_producer_clears_and_raises_consumer_prm001(tmp_path):
+    """PR 5's DET101 cache-correctness discipline for the PRM facts: the
+    consumer-side PRM001 must appear/disappear when ONLY the producer
+    file changes, with the consumer's record served from warm cache."""
+    src_dir = os.path.join(CASES_DIR, "prm_cases")
+    work = tmp_path / "pkg"
+    shutil.copytree(src_dir, work)
+    cache = str(tmp_path / "lint.pkl")
+
+    p1 = Project(str(work), cache_path=cache, use_cache=True)
+    first = p1.lint()
+    assert p1.stats["parsed"] == p1.stats["files"] > 0
+    assert not [
+        f for f in first
+        if f.rule == "PRM001" and f.path == "flow/consumer.py"
+    ]
+
+    # Remove the only sender: the cached consumer must now flag.
+    producer = work / "server" / "producer.py"
+    producer.write_text("def kick(handshake):\n    return None\n")
+    p2 = Project(str(work), cache_path=cache, use_cache=True)
+    second = p2.lint()
+    assert p2.stats["parsed"] == 1  # only the producer re-analyzed
+    consumer_hits = [
+        f for f in second
+        if f.rule == "PRM001" and f.path == "flow/consumer.py"
+        and not f.suppressed
+    ]
+    assert len(consumer_hits) == 1
+
+    # Restore the send: the finding clears again, still from cache.
+    producer.write_text(
+        "def kick(handshake):\n    handshake.ready.send(1)\n"
+    )
+    p3 = Project(str(work), cache_path=cache, use_cache=True)
+    third = p3.lint()
+    assert p3.stats["parsed"] == 1
+    assert not [
+        f for f in third
+        if f.rule == "PRM001" and f.path == "flow/consumer.py"
+    ]
+
+
+def test_changed_only_reports_consumer_side_finding(tmp_path, capsys):
+    """--changed-only with only the producer edited: the whole project is
+    still loaded, so the consumer-side PRM001 exists — and the filter
+    keeps only the changed file's findings, exactly like DET101."""
+    git = shutil.which("git")
+    if git is None:
+        pytest.skip("git unavailable")
+    import subprocess
+
+    repo = tmp_path / "repo"
+    shutil.copytree(os.path.join(CASES_DIR, "prm_cases"), repo / "pkg")
+
+    def run_git(*args):
+        subprocess.run(
+            [git, "-c", "user.email=t@t", "-c", "user.name=t", *args],
+            cwd=repo, capture_output=True, text=True, check=True,
+        )
+
+    run_git("init", "-q")
+    run_git("add", "-A")
+    run_git("commit", "-qm", "seed")
+    (repo / "pkg" / "server" / "producer.py").write_text(
+        "def kick(handshake):\n    return None\n"
+    )
+    rc = main([str(repo / "pkg"), "--format=json", "--no-cache",
+               "--changed-only"])
+    out = json.loads(capsys.readouterr().out)
+    # DET101 semantics carried over: the filter keeps only the CHANGED
+    # file's findings (the clean producer), so the gate passes here —
+    # but the whole project was loaded, and the full scan must show the
+    # consumer-side PRM001 the edit introduced.
+    assert rc == 0 and out["findings"] == []
+    rc_full = main([str(repo / "pkg"), "--format=json", "--no-cache"])
+    full = json.loads(capsys.readouterr().out)
+    assert rc_full == 1
+    assert any(
+        f["rule"] == "PRM001" and f["path"] == "flow/consumer.py"
+        for f in full["findings"]
+    )
+
+
+def test_single_file_mode_sees_cross_file_senders():
+    """Linting one real module alone must load the enclosing package so
+    cross-file senders keep clearing PRM001 (the editor/pre-commit
+    integration path)."""
+    res = os.path.join(PKG_DIR, "server", "resolver.py")
+    findings = lint_package(res)
+    assert not [f for f in findings if not f.suppressed], [
+        f.format() for f in findings if not f.suppressed
+    ]
+    assert main([res]) == 0
+
+
+# ---------------------------------------------------------------------------
+# Gate surfaces: SARIF, per-rule counts, package cleanliness
+# ---------------------------------------------------------------------------
+
+
+def test_sarif_declares_prm_rules(capsys):
+    case_dir = os.path.join(CASES_DIR, "prm_cases")
+    rc = main([case_dir, "--format=sarif", "--no-cache", "--show-suppressed"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    run = out["runs"][0]
+    rule_ids = {r["id"] for r in run["tool"]["driver"]["rules"]}
+    assert set(PRM_RULES) <= rule_ids
+    flagged = {r["ruleId"] for r in run["results"] if r["level"] == "error"}
+    assert set(PRM_RULES) <= flagged
+    # Reasoned suppressions ride along as justified SARIF suppressions.
+    sup = [r for r in run["results"] if r.get("suppressions")]
+    assert sup and all(
+        s["suppressions"][0]["justification"] for s in sup
+    )
+
+
+def test_package_clean_and_prm_counts_printed():
+    """The tier-1 surface: the whole package holds zero unsuppressed
+    PRM/TSK findings, and the per-rule counts (zero or not) are printed
+    to the tier-1 log so drift is visible."""
+    findings = lint_package(PKG_DIR)
+    counts = count_by_rule(findings)
+    cells = []
+    for rule in PRM_RULES:
+        c = counts.get(rule, {"flagged": 0, "suppressed": 0})
+        assert c["flagged"] == 0, (
+            f"{rule}: {[f.format() for f in findings if f.rule == rule]}"
+        )
+        cells.append(f"{rule}={c['flagged']}+{c['suppressed']}s")
+    print(
+        "\n[fdblint] promise-lifecycle (flagged+suppressed): "
+        + " ".join(cells),
+        file=sys.__stderr__,
+    )
+
+
+def test_pipeline_and_recovery_paths_lint_clean_single_file():
+    """The acceptance-named paths, linted individually through the real
+    single-file CLI mode: the pipeline park/drain completion promises
+    (server/resolver.py) and the recovery re-recruit handoffs
+    (server/cluster_controller.py) are tested NEGATIVES — promise-clean
+    under the full interprocedural fact set."""
+    for mod in ("resolver.py", "cluster_controller.py",
+                "failure_monitor.py"):
+        path = os.path.join(PKG_DIR, "server", mod)
+        bad = [f for f in lint_package(path) if not f.suppressed]
+        assert not bad, [f.format() for f in bad]
+
+
+# ---------------------------------------------------------------------------
+# Review regressions
+# ---------------------------------------------------------------------------
+
+
+def test_prm004_nested_break_does_not_make_producer_terminating():
+    # A break belonging to a NESTED loop does not exit the producer's
+    # `while True:` — the producer never terminates, so the consumer
+    # loop must not flag (review regression: ast.walk found the inner
+    # break and classified the while-True as breakable).
+    src = (
+        "from foundationdb_tpu.flow.future import PromiseStream\n"
+        "class Q:\n"
+        "    def __init__(self):\n"
+        "        self.s = PromiseStream()\n"
+        "    async def consumer(self):\n"
+        "        while True:\n"
+        "            item = await self.s.pop()\n"
+        "    async def producer(self):\n"
+        "        while True:\n"
+        "            for item in self.batch():\n"
+        "                if item is None:\n"
+        "                    break\n"
+        "                self.s.send(item)\n"
+    )
+    assert "PRM004" not in rules_of(lint_source(src, "server/x.py"))
+    # ...while a break that DOES exit the while-True keeps it a
+    # terminating producer, and the consumer flags.
+    own_break = src.replace(
+        "            for item in self.batch():\n"
+        "                if item is None:\n"
+        "                    break\n"
+        "                self.s.send(item)\n",
+        "            item = self.batch()\n"
+        "            if item is None:\n"
+        "                break\n"
+        "            self.s.send(item)\n",
+    )
+    assert "PRM004" in rules_of(lint_source(own_break, "server/x.py"))
+
+
+def test_standalone_file_mode_skips_project_global_attr_rules(tmp_path):
+    """A real .py OUTSIDE any package, linted alone (lint_package's
+    standalone fallback): sibling files were not loaded, so the
+    attr-entity rules must not claim "no code in the project sends" —
+    while the function-LOCAL entity rules (unreachable from other
+    files) still run."""
+    mod = tmp_path / "standalone.py"
+    mod.write_text(
+        "from foundationdb_tpu.flow.future import Promise\n"
+        "class G:\n"
+        "    def __init__(self):\n"
+        "        self.gate = Promise()\n"
+        "    async def w(self):\n"
+        "        await self.gate.future\n"  # a sibling file may send
+        "async def lo():\n"
+        "    p = Promise()\n"
+        "    await p.future\n"              # provably local: still flags
+    )
+    findings = [f for f in lint_package(str(mod)) if f.rule == "PRM001"]
+    assert [f.line for f in findings] == [9]
+
+
+def test_prm004_local_stream_infinite_producer_is_clean():
+    # Review regression: the LOCAL-stream branch must apply the same
+    # infinite-producer exemption as the attr branch — a closure
+    # producer sending inside an unbroken `while True:` never
+    # terminates, so the consumer loop is legitimate.
+    src = (
+        "from foundationdb_tpu.flow.future import PromiseStream\n"
+        "async def pump(loop):\n"
+        "    ps = PromiseStream()\n"
+        "    async def producer():\n"
+        "        while True:\n"
+        "            ps.send(1)\n"
+        "    loop.spawn(producer(), 'prod')\n"
+        "    while True:\n"
+        "        item = await ps.pop()\n"
+    )
+    assert "PRM004" not in rules_of(lint_source(src, "server/x.py"))

@@ -359,3 +359,100 @@ def test_request_stream_close_breaks_parked_requests():
         > probe_before
     )
     set_event_loop(None)
+
+
+# ---------------------------------------------------------------------------
+# PRM/TSK burn-down fixes: close() waking parked consumers, observed spawns
+# ---------------------------------------------------------------------------
+
+
+def test_close_wakes_parked_serve_actor(net):
+    """A serve actor parked in `await stream.pop()` when its generation
+    retires must wake with the close error and exit — before the fix it
+    stayed parked forever on a stream nothing could ever push to again
+    (the orphaned-wait leak class: the retired role's whole object graph
+    pinned by one silent task)."""
+    proc = net.process("server")
+    rs = RequestStream(proc, "svc")
+    state = {}
+
+    async def server():
+        try:
+            while True:
+                req, reply = await rs.pop()
+                reply.send(req)
+        except FdbError as e:
+            state["died"] = e.name
+            raise
+
+    t = proc.spawn(server(), "svc_serve")
+    net.loop.run()
+    assert not t.is_ready()  # parked on pop, nothing delivered yet
+    rs.close()
+    net.loop.run()
+    assert state["died"] == "broken_promise"
+    assert t.is_ready() and t.is_error()
+
+
+def test_close_still_breaks_queued_requests(net):
+    # The pre-existing close contract is untouched: queued (undelivered-
+    # to-actor) requests break with the close error at their callers.
+    proc = net.process("server")
+    rs = RequestStream(proc, "svc2")
+    client = net.process("client")
+    got = {}
+
+    async def call():
+        try:
+            await rs.ref().get_reply(client, 1)
+        except FdbError as e:
+            got["err"] = e.name
+
+    client.spawn(call(), "caller")
+    net.loop.run()  # delivered into the stream queue; no server popping
+    rs.close()
+    net.loop.run()
+    assert got["err"] == "broken_promise"
+
+
+def test_spawn_observed_traces_fdb_error_death(net):
+    """spawn_observed (the TSK001 remedy): an FdbError killing a dropped
+    fire-and-forget task emits SpawnedTaskDied instead of vanishing —
+    the EventLoop only surfaces non-FdbError crashes."""
+    from foundationdb_tpu.flow.trace import global_collector
+
+    collector = global_collector()
+    collector.clear()
+    proc = net.process("p")
+
+    async def doomed():
+        raise FdbError("transaction_too_old")
+
+    async def clean():
+        return 1
+
+    proc.spawn_observed(doomed(), "doomed")
+    proc.spawn_observed(clean(), "clean")
+    net.loop.run()
+    died = collector.find("SpawnedTaskDied")
+    assert len(died) == 1
+    assert "transaction_too_old" in died[0]["error"]
+    assert died[0]["task"].endswith("/doomed")
+
+
+def test_spawn_observed_is_quiet_on_cancel(net):
+    from foundationdb_tpu.flow.trace import global_collector
+
+    collector = global_collector()
+    collector.clear()
+    proc = net.process("p")
+
+    async def forever(loop):
+        while True:
+            await loop.delay(1.0)
+
+    t = proc.spawn_observed(forever(net.loop), "forever")
+    net.loop.run(max_events=5)
+    t.cancel()
+    net.loop.run(max_events=5)
+    assert collector.find("SpawnedTaskDied") == []

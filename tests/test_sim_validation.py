@@ -117,3 +117,153 @@ def test_state_survives_across_actors_on_one_loop():
     cluster.run_until(db.process.spawn(committer(db), "committer"))
     got = cluster.run_until(db.process.spawn(checker(db), "checker"))
     assert marked(loop, "acked_commit") <= got
+
+
+# ---------------------------------------------------------------------------
+# Orphaned-wait teardown check: the dynamic twin of fdblint PRM001/PRM002.
+# A Task still parked on a future whose Promise was dropped has zero
+# remaining senders — the condition the static pass proves from the ASTs,
+# observed here at runtime (behind FDB_TPU_CHECK_ORPHANED_WAITS).
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def orphan_tracking(monkeypatch):
+    from foundationdb_tpu.flow.future import track_promise_refs
+
+    monkeypatch.setenv("FDB_TPU_CHECK_ORPHANED_WAITS", "1")
+    track_promise_refs(True)
+    yield
+    track_promise_refs(False)
+
+
+def test_orphaned_wait_trips_at_teardown(orphan_tracking):
+    from foundationdb_tpu.flow.future import Promise
+    from foundationdb_tpu.flow.sim_validation import expect_no_orphaned_waits
+
+    loop = EventLoop(seed=1)
+
+    async def waiter(f):
+        await f
+
+    p = Promise()
+    t = loop.spawn(waiter(p.future), "orphan_waiter")
+    loop.run(max_events=10)
+    del p  # the only sender is gone: the task can never wake
+    with pytest.raises(AssertionError, match="orphan_waiter"):
+        expect_no_orphaned_waits(loop, "teardown")
+    t.cancel()
+
+
+def test_live_and_timer_waits_are_clean(orphan_tracking):
+    from foundationdb_tpu.flow.future import Promise
+    from foundationdb_tpu.flow.sim_validation import expect_no_orphaned_waits
+
+    loop = EventLoop(seed=1)
+
+    async def waiter(f):
+        await f
+
+    held = Promise()  # promise alive: a sender still exists
+    t1 = loop.spawn(waiter(held.future), "live_waiter")
+    t2 = loop.spawn(waiter(loop.delay(50.0)), "timer_waiter")
+    loop.run(max_events=4)
+    expect_no_orphaned_waits(loop, "mid-run")
+    held.send(1)
+    loop.run()
+    assert t1.is_ready() and t2.is_ready()
+
+
+def test_check_is_noop_without_flag(monkeypatch):
+    from foundationdb_tpu.flow.future import Promise, track_promise_refs
+    from foundationdb_tpu.flow.sim_validation import expect_no_orphaned_waits
+
+    monkeypatch.delenv("FDB_TPU_CHECK_ORPHANED_WAITS", raising=False)
+    track_promise_refs(True)
+    try:
+        loop = EventLoop(seed=1)
+
+        async def waiter(f):
+            await f
+
+        p = Promise()
+        loop.spawn(waiter(p.future), "orphan")
+        loop.run(max_events=10)
+        del p
+        expect_no_orphaned_waits(loop)  # flag off: silent by design
+    finally:
+        track_promise_refs(False)
+
+
+def test_flag_without_tracking_is_loud(monkeypatch):
+    # The check must refuse to run blind: flag set, bookkeeping off.
+    from foundationdb_tpu.flow.sim_validation import expect_no_orphaned_waits
+
+    monkeypatch.setenv("FDB_TPU_CHECK_ORPHANED_WAITS", "1")
+    loop = EventLoop(seed=1)
+    with pytest.raises(AssertionError, match="track_promise_refs"):
+        expect_no_orphaned_waits(loop)
+
+
+def test_cluster_workload_shutdown_has_no_orphans(orphan_tracking):
+    """The tier-1 cross-validation: a real simulated cluster runs a
+    commit workload — including the resolver's pipeline park/drain path
+    — and at shutdown no task is parked on a dropped promise.  This is
+    the dynamic side of the static burn-down's clean bill: the pipeline
+    completion promises (_ParkedResolve) and recruit handoffs all keep a
+    live sender until resolution."""
+    from foundationdb_tpu.flow.sim_validation import expect_no_orphaned_waits
+    from foundationdb_tpu.server.cluster import SimCluster
+
+    cluster = SimCluster(seed=23, buggify=False)
+    db = cluster.database()
+
+    async def commits(db):
+        for i in range(8):
+            tr = db.create_transaction()
+            tr.set(b"ow%d" % i, b"v")
+            await tr.commit()
+
+    cluster.run_until(db.process.spawn(commits(db), "committer"))
+    expect_no_orphaned_waits(cluster.loop, "cluster shutdown")
+
+
+def test_run_until_dry_loop_names_orphans(orphan_tracking):
+    from foundationdb_tpu.flow.future import Promise
+
+    loop = EventLoop(seed=1)
+
+    async def waiter(f):
+        await f
+
+    p = Promise()
+    t = loop.spawn(waiter(p.future), "doomed")
+    out = Promise()
+    fut = out.future
+    loop.run(max_events=10)
+    del p
+    with pytest.raises(RuntimeError, match="doomed"):
+        loop.run_until(fut)
+    t.cancel()
+
+
+def test_dropped_handle_orphan_is_still_detected(orphan_tracking):
+    """Review regression: a fire-and-forget spawn (Task handle dropped)
+    parked on a dropped promise is only reachable through the
+    task<->future callback cycle — the checker must snapshot the weak
+    task registry BEFORE collecting, or gc reaps the task and the check
+    passes blind on exactly the shape TSK001 polices."""
+    from foundationdb_tpu.flow.future import Promise
+    from foundationdb_tpu.flow.sim_validation import expect_no_orphaned_waits
+
+    loop = EventLoop(seed=1)
+
+    async def waiter(f):
+        await f
+
+    p = Promise()
+    loop.spawn(waiter(p.future), "dropped_handle_orphan")  # handle dropped
+    loop.run(max_events=10)
+    del p
+    with pytest.raises(AssertionError, match="dropped_handle_orphan"):
+        expect_no_orphaned_waits(loop, "teardown")
