@@ -93,6 +93,24 @@ KEY_WORDS = 2
 WINDOW = 50  # detect at now=i+50, evict below i => 50-batch live window
 
 
+def _base_h_cap() -> int:
+    """Device-bench history capacity: the FDB_TPU_H_CAP g_env knob, else
+    the dropped default (ISSUE 14 satellite / PERF_NOTES lever 2) —
+    3145728 = 2.87M live boundaries at window 50 + ~10% headroom (was
+    3407872 / +19%; every H-proportional pass scales with it, and the
+    engine's must-fit guard grows rather than truncates if a workload
+    outruns it — tests/test_kernels.py pins the guard).  Knob values
+    arrive rounded up to a 256-row multiple (api.env_h_cap) so the
+    Pallas kernels keep their full tile."""
+    from foundationdb_tpu.conflict.api import env_h_cap
+
+    env = env_h_cap()
+    return env if env > 0 else 3145728
+
+
+BASE_H_CAP = _base_h_cap()
+
+
 def gen_packed(rng, n_txn, batch_index, key_words):
     """Vectorized PackedBatch generation (1 read + 1 write range per txn)."""
     from foundationdb_tpu.conflict.engine_jax import PackedBatch, _next_pow2
@@ -292,7 +310,7 @@ def bench_mirror(rng, n_batches=30, per_batch=2500, degraded_batches=4):
     return out
 
 
-def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=3407872, window=WINDOW):
+def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=None, window=WINDOW):
     """Steady-state device throughput at the BASELINE.json 64k-batch config,
     with the reference's full 50-batch live window (skipListTest detects at
     now=i+50, evicts below i — SkipList.cpp:1473-1475).
@@ -309,6 +327,8 @@ def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=3407872, window=WINDOW):
 
     from foundationdb_tpu.conflict.engine_jax import JaxConflictSet
 
+    if h_cap is None:
+        h_cap = BASE_H_CAP
     verbose = bool(os.environ.get("BENCH_VERBOSE"))
     cs = JaxConflictSet(key_words=KEY_WORDS, h_cap=h_cap)
     warm = window + 2
@@ -349,7 +369,7 @@ def bench_jax(rng, n_batches=24, per_batch=65536, h_cap=3407872, window=WINDOW):
 
 
 def bench_pipeline(rng, depth, n_batches=24, per_batch=65536,
-                   h_cap=3407872, window=WINDOW):
+                   h_cap=None, window=WINDOW):
     """Full resolve-loop throughput at pipeline depth `depth` (ISSUE 11):
     per batch, host pack/encode + device dispatch + verdict readback +
     authoritative-mirror apply_batch, through the production ConflictSet
@@ -504,6 +524,104 @@ def bench_pipeline_cpu(depths=(1, 2, 3), n_batches=30, per_batch=2500,
     return out
 
 
+def bench_kernels_cpu(n_batches=16, per_batch=512, h_cap=1 << 12,
+                      seeds=(2024, 2025, 2026)):
+    """CPU-phase kernel A/B (ISSUE 14 satellite; prices on any host):
+    the Pallas arms run in INTERPRET mode here, so the wall numbers are
+    the emulator's, not Mosaic's — the artifact's job is (a) the
+    cross-seed verdict+state identity evidence at a realistic stream
+    shape, and (b) the deterministic in-step FLOP attribution
+    (phase_attribution's `nokernel` A/B), which IS the structural story
+    the device run will price.  Emits a BENCH-style dict
+    (`tools/perf_experiments.py --kernels`)."""
+    import jax
+
+    from foundationdb_tpu.conflict.engine_jax import JaxConflictSet
+
+    os.makedirs(CACHE_DIR, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", CACHE_DIR)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+    out = {
+        "metric": "kernels_cpu_ab",
+        "mode": "interpret",  # honest: Pallas emulation off-TPU
+        "shape": {"per_batch": per_batch, "n_batches": n_batches,
+                  "h_cap": h_cap, "window": WINDOW, "seeds": list(seeds)},
+    }
+
+    def run_arm(kflag, history, seed):
+        env_keys = ("FDB_TPU_KERNELS", "FDB_TPU_HISTORY",
+                    "FDB_TPU_DELTA_CAP", "FDB_TPU_EVICT_EVERY")
+        saved = {k: os.environ.get(k) for k in env_keys}
+        os.environ["FDB_TPU_KERNELS"] = kflag
+        if history:
+            os.environ["FDB_TPU_HISTORY"] = history
+            os.environ["FDB_TPU_DELTA_CAP"] = str(h_cap // 8)
+            os.environ["FDB_TPU_EVICT_EVERY"] = "4"
+        else:
+            for k in env_keys[1:]:
+                os.environ.pop(k, None)
+        try:
+            rng = np.random.default_rng(seed)
+            cs = JaxConflictSet(key_words=KEY_WORDS, h_cap=h_cap)
+            batches = [gen_packed(rng, per_batch, i, KEY_WORDS)
+                       for i in range(n_batches)]
+            cs.detect_packed(batches[0], now=WINDOW, new_oldest_version=0)
+            t0 = time.perf_counter()
+            verdicts = [
+                tuple(cs.detect_packed(b, now=i + 1 + WINDOW,
+                                       new_oldest_version=i + 1).tolist())
+                for i, b in enumerate(batches[1:])
+            ]
+            dt = time.perf_counter() - t0
+            from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+
+            cpu = CpuConflictSet()
+            cs.store_to(cpu)
+            exported = (tuple(cpu.keys), tuple(cpu.vers))
+            return verdicts, exported, dt
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    for history in ("", "tiered"):
+        label = "tiered" if history else "flat"
+        identical = True
+        walls = {"kernels": 0.0, "xla": 0.0}
+        for seed in seeds:
+            kv, ks, kdt = run_arm("1", history, seed)
+            xv, xs, xdt = run_arm("0", history, seed)
+            identical &= (kv == xv and ks == xs)
+            walls["kernels"] += kdt
+            walls["xla"] += xdt
+        out[label] = {
+            "bit_identical": identical,
+            "wall_seconds_interpret": {k: round(v, 3)
+                                       for k, v in walls.items()},
+        }
+        assert identical, f"kernel arm diverged from XLA arm ({label})"
+    # Deterministic in-step attribution with the nokernel A/B block.
+    from foundationdb_tpu.conflict.phase_attribution import attribute_phases
+
+    saved = os.environ.get("FDB_TPU_KERNELS")
+    os.environ["FDB_TPU_KERNELS"] = "1"
+    try:
+        cs = JaxConflictSet(key_words=KEY_WORDS, h_cap=h_cap)
+        rep = attribute_phases(cs, record=False)
+        out["attribution"] = {
+            "phases": rep["phases"],
+            "kernel_ab": rep["kernel_ab"],
+        }
+    finally:
+        if saved is None:
+            os.environ.pop("FDB_TPU_KERNELS", None)
+        else:
+            os.environ["FDB_TPU_KERNELS"] = saved
+    return out
+
+
 def bench_timeline(out_path="TIMELINE.json", depth=2, n_batches=16,
                    per_batch=2500, h_cap=1 << 19):
     """Timeline artifact for the next device window (ISSUE 12 satellite):
@@ -614,7 +732,7 @@ def device_phase_main():
     platform = setup_jax()
     res["platform"] = platform
     warm_compile_probe()
-    h_cap = int(os.environ.get("BENCH_H_CAP", "3407872"))
+    h_cap = int(os.environ.get("BENCH_H_CAP", str(BASE_H_CAP)))
     _log(f"device bench: 24 batches x 65536 txns, window=50, h_cap={h_cap} "
          "(first compile may take minutes on this 1-core host)...")
     rng = np.random.default_rng(2024)
@@ -803,8 +921,6 @@ def main():
     emit(out, errors)
 
 
-BASE_H_CAP = 3407872
-
 # Engine variants, all DECISION-IDENTICAL to the default compile (verified
 # by the differential suites run under each flag set — tests/
 # test_engine_experiments.py); the only question hardware answers is
@@ -861,6 +977,21 @@ VARIANTS = [
     ("pipeline1", {"FDB_TPU_PIPELINE_DEPTH": "1"}, BASE_H_CAP),
     ("pipeline2", {"FDB_TPU_PIPELINE_DEPTH": "2"}, BASE_H_CAP),
     ("pipeline3", {"FDB_TPU_PIPELINE_DEPTH": "3"}, BASE_H_CAP),
+    # Pallas fused kernels (ISSUE 14): merge/evict as ONE streaming pass +
+    # the phase-1 searches over VMEM-resident tiles.  On the TPU backend
+    # '1' compiles real Mosaic kernels; decision-identical to the XLA
+    # arms by the tests/test_kernels.py differential gate.
+    ("kernels", {"FDB_TPU_KERNELS": "1"}, BASE_H_CAP),
+    (
+        "tiered4_kernels",
+        {
+            "FDB_TPU_KERNELS": "1",
+            "FDB_TPU_HISTORY": "tiered",
+            "FDB_TPU_EVICT_EVERY": "4",
+            "FDB_TPU_DELTA_CAP": str(5 * 2 * 65536),
+        },
+        BASE_H_CAP + 3 * 2 * 65536,
+    ),
 ]
 
 _VARIANT_FLAG_KEYS = (
@@ -870,6 +1001,7 @@ _VARIANT_FLAG_KEYS = (
     "FDB_TPU_HISTORY",
     "FDB_TPU_DELTA_CAP",
     "FDB_TPU_PIPELINE_DEPTH",
+    "FDB_TPU_KERNELS",
     "BENCH_H_CAP",
 )
 

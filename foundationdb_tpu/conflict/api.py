@@ -112,6 +112,18 @@ class InflightBatch:
         self.degraded = degraded
 
 
+def env_h_cap() -> int:
+    """FDB_TPU_H_CAP knob value rounded UP to a 256-row multiple (0 when
+    unset).  The Pallas kernels tile at powers of two up to 256
+    (conflict/kernels._tile, which requires the tile to divide the
+    width); an unrounded odd cap would degrade the tile toward 1 and
+    turn the fused merge kernel into a per-row sequential grid — a
+    practical hang, not an error.  Rounding up keeps the knob's
+    'always safe' contract (more rows never truncates)."""
+    cap = g_env.get_int("FDB_TPU_H_CAP")
+    return -(-cap // 256) * 256 if cap > 0 else 0
+
+
 class ConflictSet:
     def __init__(
         self,
@@ -121,8 +133,16 @@ class ConflictSet:
         device=None,
         bucket_mins: tuple = (8, 8, 8),
         fault_injector=None,
-        h_cap: int = 1 << 16,
+        h_cap: Optional[int] = None,
     ):
+        # Device history capacity: explicit arg > FDB_TPU_H_CAP g_env
+        # knob > built-in default.  Dropping the knob is always safe —
+        # the engine's must-fit guard syncs the true count and grows
+        # before any merge could truncate (PERF_NOTES lever 2;
+        # tests/test_kernels.py pins the guard).
+        if h_cap is None:
+            _env_cap = env_h_cap()
+            h_cap = _env_cap if _env_cap > 0 else (1 << 16)
         self.backend = backend
         self._cpu: Optional[CpuConflictSet] = None
         self._jax = None
@@ -832,6 +852,14 @@ class ConflictSet:
         snap["last_occupancy"] = dict(self._jax.last_occupancy)
         snap["distinct_shapes"] = len(self._jax._bucket_dispatches)
         snap["h_cap"] = self._jax.h_cap
+        if getattr(self._jax, "_use_kernels", False):
+            # Pallas kernel routing (ISSUE 14) — key present only when
+            # on, so kernel-off snapshots stay byte-identical to
+            # pre-kernel builds.
+            snap["kernels"] = {
+                "enabled": True,
+                "interpret": bool(self._jax._kernel_interpret),
+            }
         if getattr(self._jax, "tiered", False):
             # Tier sizes/occupancy (ISSUE 4): delta fill and compaction
             # counts also live in the counters/gauges/histograms above

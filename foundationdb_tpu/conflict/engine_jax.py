@@ -513,17 +513,17 @@ def _resolve_batch(
     return status, iters, undecided_left, ub, ue, seg_valid, nseg
 
 
-def _merge_new_segments(
+def _merge_prep(
     tkeys, tvers, tcount, ub, ue, seg_valid, nseg, now_rel,
     *, width, wr_cap, kw1,
 ):
-    """Phase 5: rewrite ONE tier's step function (ref addConflictRanges) by
-    rank-merging the batch's committed segments [ub_s, ue_s) at version
-    `now_rel` into the tier (`width`-capped).  For the flat engine the tier
-    is the whole history; for the tiered engine it is the DELTA — end
-    values come from the tier itself (the delta's floor is FLOOR_REL =
-    "uncovered", so max(base, delta) composes exactly; see
-    detect_core_tiered).  Returns (merged_keys, merged_vers, merged_count).
+    """Phase-5 rank-inversion prep, shared by the sort-by-target path
+    (_merge_new_segments) and the fused Pallas kernel path
+    (_merge_evict_fused): build the sorted new-boundary rows and derive
+    every row's merged position by rank inversion — streaming cumsums
+    and small-into-big searches, never a full-width sort.  Returns
+    (new_keys_s, new_vers_s, new_valid_s, keep_old, pos_old, pos_new,
+    merged_count).
 
     TWO combined searches over (ub | ue) serve EVERYTHING downstream:
     eq_at_ue, seg_lo/seg_hi, end_val, and — via the new-keys sort
@@ -626,6 +626,32 @@ def _merge_new_segments(
     pos_new = jnp.arange(n_new_cap, dtype=jnp.int32) + count_kept_less
 
     merged_count = jnp.sum(keep_old, dtype=jnp.int32) + nnew
+    return (new_keys_s, new_vers_s, new_valid_s, keep_old, pos_old,
+            pos_new, merged_count)
+
+
+def _merge_new_segments(
+    tkeys, tvers, tcount, ub, ue, seg_valid, nseg, now_rel,
+    *, width, wr_cap, kw1,
+):
+    """Phase 5: rewrite ONE tier's step function (ref addConflictRanges) by
+    rank-merging the batch's committed segments [ub_s, ue_s) at version
+    `now_rel` into the tier (`width`-capped).  For the flat engine the tier
+    is the whole history; for the tiered engine it is the DELTA — end
+    values come from the tier itself (the delta's floor is FLOOR_REL =
+    "uncovered", so max(base, delta) composes exactly; see
+    detect_core_tiered).  Returns (merged_keys, merged_vers, merged_count).
+
+    This is the SORT-BY-TARGET arm: positions from _merge_prep feed one
+    full-width _compact_to.  The FDB_TPU_KERNELS arm replaces it (and the
+    phase-6 eviction sort) with the fused streaming kernel
+    (_merge_evict_fused / conflict/kernels.py)."""
+    H = width
+    (new_keys_s, new_vers_s, new_valid_s, keep_old, pos_old, pos_new,
+     merged_count) = _merge_prep(
+        tkeys, tvers, tcount, ub, ue, seg_valid, nseg, now_rel,
+        width=width, wr_cap=wr_cap, kw1=kw1,
+    )
     merged_keys, merged_vers = _compact_to(
         jnp.concatenate([pos_old, pos_new]),
         jnp.concatenate([keep_old, new_valid_s]),
@@ -636,6 +662,69 @@ def _merge_new_segments(
         count=merged_count,
     )
     return merged_keys, merged_vers, merged_count
+
+
+def _merge_evict_fused(
+    tkeys, tvers, tcount, ub, ue, seg_valid, nseg, now_rel, window,
+    *, width, wr_cap, kw1, interpret,
+):
+    """Kernelized phases 5+6 (ISSUE 14 tentpole): ONE streaming pass —
+    merge the batch's segment rows into the tier AND apply the
+    removeBefore eviction rule in-stream — instead of the two full-width
+    sort-by-target passes.  `window` is the eviction floor as a traced
+    value: new_oldest evicts (the default semantics), FLOOR_REL keeps
+    everything (the noevict ablation and the amortized do_evict=0 arm —
+    the traced-cond eviction skip becomes a plain value select).
+    Bit-identical to _merge_new_segments + _evict_rule + _compact_to by
+    construction (same prep, same rule; gated by tests/test_kernels.py).
+    """
+    from .kernels import fused_merge_evict
+
+    (new_keys_s, new_vers_s, new_valid_s, keep_old, pos_old, pos_new,
+     merged_count) = _merge_prep(
+        tkeys, tvers, tcount, ub, ue, seg_valid, nseg, now_rel,
+        width=width, wr_cap=wr_cap, kw1=kw1,
+    )
+    ok_keys, ok_vers, out_count = fused_merge_evict(
+        tkeys, tvers, keep_old, pos_old,
+        new_keys_s, new_vers_s, new_valid_s, pos_new,
+        merged_count, window,
+        width=width, kw1=kw1, interpret=interpret,
+    )
+    inf32 = jnp.uint32(keylib.INF_WORD)
+    live = jnp.arange(width, dtype=jnp.int32) < out_count
+    out_keys = jnp.where(live[None, :], ok_keys, inf32)
+    out_vers = jnp.where(live, ok_vers, jnp.int32(FLOOR_REL))
+    return out_keys, out_vers, out_count.astype(jnp.int32)
+
+
+def _finish_flat(hkeys, hvers, hcount, oldest, out_keys, out_vers,
+                 out_count, new_oldest, too_old, status, undecided_left,
+                 iters):
+    """Shared tail of the flat step (both the sort and kernel arms):
+    statuses in the reference's enum plus the divergence guard — if the
+    fixpoint failed to converge the statuses are unreliable and so is the
+    write merge derived from them, so the history state reverts UNCHANGED
+    and the host re-runs the batch on the CPU engine."""
+    out_status = jnp.where(
+        too_old,
+        TOO_OLD,
+        jnp.where(status == _COMM, COMMITTED, CONFLICT),
+    ).astype(jnp.int32)
+    ok = undecided_left == 0
+    out_keys = jnp.where(ok, out_keys, hkeys)
+    out_vers = jnp.where(ok, out_vers, hvers)
+    out_count = jnp.where(ok, out_count, hcount)
+    new_oldest = jnp.where(ok, new_oldest, oldest)
+    return (
+        out_keys,
+        out_vers,
+        out_count.astype(jnp.int32),
+        new_oldest.astype(jnp.int32),
+        out_status,
+        undecided_left.astype(jnp.int32),
+        iters,
+    )
 
 
 def detect_core(
@@ -661,10 +750,16 @@ def detect_core(
     rr_cap: int,
     wr_cap: int,
     h_cap: int,
+    kernels: bool = False,
+    kernel_interpret: bool = False,
 ):
     from ..flow.knobs import g_env
 
     _ablate = set(g_env.get("FDB_TPU_ABLATE").split(","))
+    # The in-step kernel ablation arm (phase_attribution's `nokernel`):
+    # price the Pallas kernels against the XLA fallback INSIDE the same
+    # program, never as a standalone microbench.
+    _kern = kernels and "nokernel" not in _ablate
     kw1 = hkeys.shape[0]
     H = h_cap
     TXN, RR, WR = txn_cap, rr_cap, wr_cap
@@ -678,6 +773,11 @@ def detect_core(
     if "nosearch" in _ablate:
         i0 = (r_begin[0] % jnp.uint32(H)).astype(jnp.int32)
         j1 = i0
+    elif _kern:
+        from .kernels import phase1_search
+
+        i0, j1 = phase1_search(hkeys, r_begin, r_end,
+                               interpret=kernel_interpret)
     else:
         i0 = searchsorted_words(hkeys, r_begin, "right") - 1
         j1 = searchsorted_words(hkeys, r_end, "left") - 1
@@ -707,13 +807,35 @@ def detect_core(
         ).astype(jnp.int32)
         return (hkeys, hvers, hcount, jnp.maximum(oldest, new_oldest_rel).astype(jnp.int32),
                 out_status, undecided_left.astype(jnp.int32), iters)
+    new_oldest = jnp.maximum(oldest, new_oldest_rel)
+    if _kern:
+        # Fused kernel arm: merge + evict + compact in one streaming
+        # pass.  The amortized-eviction traced cond collapses into a
+        # window-value select (window = FLOOR_REL means "evict nothing"
+        # — every version is >= the floor, so the rule keeps all rows).
+        if "noevict" in _ablate:
+            window = jnp.int32(FLOOR_REL)
+        elif do_evict is not None:
+            window = jnp.where(
+                do_evict != 0, new_oldest, jnp.int32(FLOOR_REL)
+            ).astype(jnp.int32)
+        else:
+            window = new_oldest.astype(jnp.int32)
+        out_keys, out_vers, out_count = _merge_evict_fused(
+            hkeys, hvers, hcount, ub, ue, seg_valid, nseg, now_rel,
+            window, width=H, wr_cap=WR, kw1=kw1,
+            interpret=kernel_interpret,
+        )
+        return _finish_flat(
+            hkeys, hvers, hcount, oldest, out_keys, out_vers, out_count,
+            new_oldest, too_old, status, undecided_left, iters,
+        )
     merged_keys, merged_vers, merged_count = _merge_new_segments(
         hkeys, hvers, hcount, ub, ue, seg_valid, nseg, now_rel,
         width=H, wr_cap=WR, kw1=kw1,
     )
 
     # ---- phase 6: window eviction (ref removeBefore wasAbove rule) ----
-    new_oldest = jnp.maximum(oldest, new_oldest_rel)
     keep2, rank2, out_count = _evict_rule(merged_vers, merged_count,
                                           new_oldest, H)
     if "noevict" in _ablate:
@@ -750,32 +872,9 @@ def detect_core(
             count=out_count,
         )
 
-    # ---- final statuses in the reference's enum ----
-    out_status = jnp.where(
-        too_old,
-        TOO_OLD,
-        jnp.where(status == _COMM, COMMITTED, CONFLICT),
-    ).astype(jnp.int32)
-
-    # If the fixpoint failed to converge (cannot happen for well-formed
-    # batches — the iteration cap exceeds the longest dependency chain — but
-    # guarded anyway), the statuses are unreliable and so is the write merge
-    # derived from them: keep the history state UNCHANGED so the host can
-    # re-run the batch on the CPU engine against pristine state.
-    ok = undecided_left == 0
-    out_keys = jnp.where(ok, out_keys, hkeys)
-    out_vers = jnp.where(ok, out_vers, hvers)
-    out_count = jnp.where(ok, out_count, hcount)
-    new_oldest = jnp.where(ok, new_oldest, oldest)
-
-    return (
-        out_keys,
-        out_vers,
-        out_count.astype(jnp.int32),
-        new_oldest.astype(jnp.int32),
-        out_status,
-        undecided_left.astype(jnp.int32),
-        iters,
+    return _finish_flat(
+        hkeys, hvers, hcount, oldest, out_keys, out_vers, out_count,
+        new_oldest, too_old, status, undecided_left, iters,
     )
 
 
@@ -803,7 +902,8 @@ def detect_core(
 # ---------------------------------------------------------------------------
 
 
-def _major_compact(hk, hv, hc, dk, dv, dc, new_oldest, *, H, D, kw1):
+def _major_compact(hk, hv, hc, dk, dv, dc, new_oldest, *, H, D, kw1,
+                   kernels: bool = False, kernel_interpret: bool = False):
     """Merge base+delta into a new base tier and evict sub-window rows.
 
     Covered delta intervals (value > floor) take the delta row verbatim and
@@ -813,7 +913,9 @@ def _major_compact(hk, hv, hc, dk, dv, dc, new_oldest, *, H, D, kw1):
     quantities derive by rank inversion — delta-sized searches into the
     base turned into per-base-row values with histograms + cumsums, never
     one query per history row — so the only H-sized non-streaming ops are
-    the two compact_to sorts whose amortization is this tier's purpose."""
+    the two compact_to sorts whose amortization is this tier's purpose;
+    under FDB_TPU_KERNELS even those collapse into ONE streaming pass of
+    the fused merge-evict kernel (conflict/kernels.py)."""
     NEG = jnp.int32(FLOOR_REL)
     dvalid = jnp.arange(D, dtype=jnp.int32) < dc
     dl = searchsorted_words(hk, dk, "left")
@@ -853,6 +955,20 @@ def _major_compact(hk, hv, hc, dk, dv, dc, new_oldest, *, H, D, kw1):
     pos_delta = (jnp.cumsum(keep_delta.astype(jnp.int32)) - 1) + cnt_base_less
     merged_count = (jnp.sum(keep_base, dtype=jnp.int32)
                     + jnp.sum(keep_delta, dtype=jnp.int32))
+    if kernels:
+        from .kernels import fused_merge_evict
+
+        k_keys, k_vers, out_count = fused_merge_evict(
+            hk, hv, keep_base, pos_base,
+            dk, dvals, keep_delta, pos_delta,
+            merged_count, new_oldest.astype(jnp.int32),
+            width=H, kw1=kw1, interpret=kernel_interpret,
+        )
+        inf32 = jnp.uint32(keylib.INF_WORD)
+        live = jnp.arange(H, dtype=jnp.int32) < out_count
+        ok_keys = jnp.where(live[None, :], k_keys, inf32)
+        ok_vers = jnp.where(live, k_vers, NEG)
+        return ok_keys, ok_vers, out_count.astype(jnp.int32)
     mk, mv = _compact_to(
         jnp.concatenate([pos_base, pos_delta]),
         jnp.concatenate([keep_base, keep_delta]),
@@ -897,6 +1013,8 @@ def detect_core_tiered(
     wr_cap: int,
     h_cap: int,
     d_cap: int,
+    kernels: bool = False,
+    kernel_interpret: bool = False,
 ):
     """Two-tier variant of detect_core; decision-identical by construction
     (gated by the differential suites under FDB_TPU_HISTORY=tiered).
@@ -904,7 +1022,11 @@ def detect_core_tiered(
     Steady-state non-compaction batches do NO H-sized sort and NO H-sized
     table build: base work is limited to the phase-1 binary-search gathers
     against the frozen base + carried max-table (the perf_smoke jaxpr gate
-    pins this structurally)."""
+    pins this structurally).  Under FDB_TPU_KERNELS the phase-1 searches
+    run tier-combined through the streaming Pallas kernel and the
+    delta-merge/compaction sorts through the fused merge-evict kernel —
+    NO sort-by-target pass at any tier width remains anywhere in the
+    program (perf_smoke pins that too)."""
     kw1 = hkeys.shape[0]
     H, D = h_cap, d_cap
     TXN = txn_cap
@@ -915,11 +1037,20 @@ def detect_core_tiered(
     r_valid = r_txn < TXN
 
     # ---- phase 1 over BOTH tiers: merged max = max of per-tier maxes ----
-    i0b = searchsorted_words(hkeys, r_begin, "right") - 1
-    j1b = searchsorted_words(hkeys, r_end, "left") - 1
+    if kernels:
+        from .kernels import phase1_search_tiers
+
+        # Tier-combined: both tiers' streaming searches share ONE
+        # query sort and ONE un-permute sort (phase1_search_tiers).
+        (i0b, j1b), (i0d, j1d) = phase1_search_tiers(
+            (hkeys, dkeys), r_begin, r_end, interpret=kernel_interpret
+        )
+    else:
+        i0b = searchsorted_words(hkeys, r_begin, "right") - 1
+        j1b = searchsorted_words(hkeys, r_end, "left") - 1
+        i0d = searchsorted_words(dkeys, r_begin, "right") - 1
+        j1d = searchsorted_words(dkeys, r_end, "left") - 1
     mb = range_max(maxtab, jnp.clip(i0b, 0, H - 1), jnp.clip(j1b, 0, H - 1))
-    i0d = searchsorted_words(dkeys, r_begin, "right") - 1
-    j1d = searchsorted_words(dkeys, r_end, "left") - 1
     dtab = build_max_table(dvers)
     md = range_max(dtab, jnp.clip(i0d, 0, D - 1), jnp.clip(j1d, 0, D - 1))
     m = jnp.maximum(
@@ -942,18 +1073,26 @@ def detect_core_tiered(
         txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap,
     )
 
-    # ---- phase 5 into the DELTA tier (delta-sized sorts) ----
-    d_mk, d_mv, d_mc = _merge_new_segments(
-        dkeys, dvers, dcount, ub, ue, seg_valid, nseg, now_rel,
-        width=D, wr_cap=WR, kw1=kw1,
-    )
+    # ---- phase 5 into the DELTA tier (delta-sized sorts, or ONE
+    # delta-sized streaming pass under FDB_TPU_KERNELS) + phase 6 on the
+    # delta only (keeps hot-key deltas compact); the base is evicted at
+    # major compactions ----
     new_oldest = jnp.maximum(oldest, new_oldest_rel)
-    # ---- phase 6 on the delta only (keeps hot-key deltas compact);
-    # the base is evicted at major compactions ----
-    keep2, rank2, d_oc = _evict_rule(d_mv, d_mc, new_oldest, D)
-    d_ok_keys, d_ok_vers = _compact_to(
-        rank2, keep2, d_mk, D, fill_vers=NEG, vers=d_mv, count=d_oc
-    )
+    if kernels:
+        d_ok_keys, d_ok_vers, d_oc = _merge_evict_fused(
+            dkeys, dvers, dcount, ub, ue, seg_valid, nseg, now_rel,
+            new_oldest.astype(jnp.int32),
+            width=D, wr_cap=WR, kw1=kw1, interpret=kernel_interpret,
+        )
+    else:
+        d_mk, d_mv, d_mc = _merge_new_segments(
+            dkeys, dvers, dcount, ub, ue, seg_valid, nseg, now_rel,
+            width=D, wr_cap=WR, kw1=kw1,
+        )
+        keep2, rank2, d_oc = _evict_rule(d_mv, d_mc, new_oldest, D)
+        d_ok_keys, d_ok_vers = _compact_to(
+            rank2, keep2, d_mk, D, fill_vers=NEG, vers=d_mv, count=d_oc
+        )
 
     ok = undecided_left == 0
 
@@ -977,7 +1116,8 @@ def detect_core_tiered(
     def _major(ops):
         hk, hv, hc, mt, dk2, dv2, dc2 = ops
         nk, nv, nc = _major_compact(
-            hk, hv, hc, dk2, dv2, dc2, new_oldest, H=H, D=D, kw1=kw1
+            hk, hv, hc, dk2, dv2, dc2, new_oldest, H=H, D=D, kw1=kw1,
+            kernels=kernels, kernel_interpret=kernel_interpret,
         )
         nt = build_max_table(nv)
         ek = (
@@ -1088,7 +1228,8 @@ def _blob_offsets(txn_cap: int, rr_cap: int, wr_cap: int, kw1: int):
 
 
 def _blob_core(hkeys, hvers, hcount, oldest, blob, *, txn_cap, rr_cap,
-               wr_cap, h_cap, kw1, amortized=False):
+               wr_cap, h_cap, kw1, amortized=False, kernels=False,
+               kernel_interpret=False):
     offs, _total = _blob_offsets(txn_cap, rr_cap, wr_cap, kw1)
     as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
     # Key fields are packed word-major (kw1, N): see rangequery.py on TPU
@@ -1115,13 +1256,14 @@ def _blob_core(hkeys, hvers, hcount, oldest, blob, *, txn_cap, rr_cap,
         # graph when enabled, so the default compile is byte-identical.
         scalars[2] if amortized else None,
         txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap, h_cap=h_cap,
+        kernels=kernels, kernel_interpret=kernel_interpret,
     )
 
 
 _blob_step = partial(
     jax.jit,
     static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "kw1",
-                     "amortized"),
+                     "amortized", "kernels", "kernel_interpret"),
     donate_argnames=("hkeys", "hvers", "hcount", "oldest"),
 )(_blob_core)
 
@@ -1138,13 +1280,13 @@ _blob_step = partial(
 _blob_step_nodonate = partial(
     jax.jit,
     static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "kw1",
-                     "amortized"),
+                     "amortized", "kernels", "kernel_interpret"),
 )(_blob_core)
 
 
 def _tiered_blob_core(hkeys, hvers, hcount, maxtab, dkeys, dvers, dcount,
                       oldest, blob, *, txn_cap, rr_cap, wr_cap, h_cap, d_cap,
-                      kw1):
+                      kw1, kernels=False, kernel_interpret=False):
     """Tiered twin of _blob_core: same single-transfer blob layout; the
     third scalar slot carries the host's major-compaction decision."""
     offs, _total = _blob_offsets(txn_cap, rr_cap, wr_cap, kw1)
@@ -1168,20 +1310,22 @@ def _tiered_blob_core(hkeys, hvers, hcount, maxtab, dkeys, dvers, dcount,
         t_snap, t_has_reads, t_valid,
         scalars[0], scalars[1], scalars[2],
         txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap, h_cap=h_cap,
-        d_cap=d_cap,
+        d_cap=d_cap, kernels=kernels, kernel_interpret=kernel_interpret,
     )
 
 
 _tiered_blob_step = partial(
     jax.jit,
-    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "d_cap", "kw1"),
+    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "d_cap", "kw1",
+                     "kernels", "kernel_interpret"),
     donate_argnames=("hkeys", "hvers", "hcount", "maxtab", "dkeys", "dvers",
                      "dcount", "oldest"),
 )(_tiered_blob_core)
 
 _tiered_blob_step_nodonate = partial(
     jax.jit,
-    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "d_cap", "kw1"),
+    static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "d_cap", "kw1",
+                     "kernels", "kernel_interpret"),
 )(_tiered_blob_core)
 
 
@@ -1422,6 +1566,25 @@ def _ep_grow_body():  # jaxcheck: ignore[JXP003]: growth reallocates to a larger
                                                 fill=int(keylib.INF_WORD))
 
 
+def _ep_flat_step_kernels():
+    """Kernelized flat step (FDB_TPU_KERNELS): same signature, the
+    merge/evict sorts and phase-1 searches replaced by the Pallas
+    kernels.  Canonically traced in interpret mode (CPU analysis; on a
+    real TPU only the pallas_call params differ, never the structure)."""
+    fn, _jitted, args, statics = _ep_flat_step()
+    statics = dict(statics, kernels=True, kernel_interpret=True)
+    return fn, _blob_step, args, statics
+
+
+def _ep_tiered_step_kernels():
+    """Kernelized tiered step: delta merges and the in-cond major
+    compaction run through the fused merge-evict kernel, phase 1 through
+    the tier-combined streaming search kernel."""
+    fn, _jitted, args, statics = _ep_tiered_step()
+    statics = dict(statics, kernels=True, kernel_interpret=True)
+    return fn, _tiered_blob_step, args, statics
+
+
 _EP_BUCKETS = {
     "txn_cap": (EP_TXN, EP_BUCKET_MIN),
     "rr_cap": (EP_RR, EP_BUCKET_MIN),
@@ -1451,6 +1614,36 @@ register_entry_point(
                   ("batch", EP_TXN)),
     h_threshold=EP_H,
     compaction_gated=True,  # steady state is delta-bounded (perf_smoke)
+    work_bound=EP_H + EP_D + 4 * EP_WR,
+    bucket_dims=dict(_EP_BUCKETS, d_cap=(EP_D, 64)),
+)
+
+register_entry_point(
+    "flat_step_kernels", _ep_flat_step_kernels,
+    arg_names=("hkeys", "hvers", "hcount", "oldest", "blob"),
+    carried=("hkeys", "hvers", "hcount", "oldest"),
+    size_classes=(("H", EP_H), ("P", 2 * (EP_RR + EP_WR)), ("batch", EP_TXN)),
+    h_threshold=EP_H,
+    # The kernelized flat step keeps H-sized STREAMING work (the rank-
+    # inversion cumsums) but no H-sized sort; in-kernel work primitives
+    # are tile-sized.  Same legitimate width bound as the sort arm.
+    work_bound=EP_H + 4 * EP_WR,
+    bucket_dims=_EP_BUCKETS,
+)
+
+register_entry_point(
+    "tiered_step_kernels", _ep_tiered_step_kernels,
+    arg_names=("hkeys", "hvers", "hcount", "maxtab", "dkeys", "dvers",
+               "dcount", "oldest", "blob"),
+    carried=("hkeys", "hvers", "hcount", "maxtab", "dkeys", "dvers",
+             "dcount", "oldest"),
+    size_classes=(("H", EP_H), ("P", 2 * (EP_RR + EP_WR)), ("D", EP_D),
+                  ("batch", EP_TXN)),
+    h_threshold=EP_H,
+    # Steady state stays delta-bounded with kernels on: the SAME
+    # compaction-gating contract as the sort arm, now with zero H-sized
+    # sorts even inside the cond (perf_smoke's kernel gate).
+    compaction_gated=True,
     work_bound=EP_H + EP_D + 4 * EP_WR,
     bucket_dims=dict(_EP_BUCKETS, d_cap=(EP_D, 64)),
 )
@@ -1535,6 +1728,15 @@ def _cost_block(ep: DeviceEntryPoint) -> dict:
         "pinned_bytes_total": sum(sizes[n] for n in ep.pinned),
         "argument_bytes_total": sum(sizes.values()),
     }
+    # pallas_call-bearing entries (ISSUE 14): mark them explicitly.  XLA's
+    # analyses see the kernel as a black-box custom call, so when they
+    # come back empty the block still carries the shape-math byte
+    # accounting instead of going silently missing (perf_smoke pins
+    # coverage for every entry either way).
+    from ..tools.lint.jaxir import walk_jaxpr as _walk
+
+    if any(e.prim == "pallas_call" for e in _walk(ep.jaxpr())):
+        blk["kernel"] = True
     fn, jitted, args, statics = ep.built()
     if jitted is None:
         # Inner bodies (e.g. the compaction body) have no jit wrapper of
@@ -1648,6 +1850,18 @@ class JaxConflictSet:
         # Donated vs non-donated step wrappers, decided once per engine
         # (FDB_TPU_DONATE / platform-auto; see _use_donated_steps).
         self._donate_steps = _use_donated_steps()
+        # Pallas kernel routing (ISSUE 14), decided once per engine like
+        # the other engine-variant flags: '' / 'auto' selects kernels on
+        # the TPU backend only; '1' forces them everywhere (interpret-
+        # mode Pallas off-TPU — the CPU differential-gating arm); '0'
+        # forces the XLA fallback (the A/B arm).  Static jit args, so a
+        # kernels-on engine and a kernels-off engine never share a
+        # compiled program.
+        from .kernels import resolve_kernel_flag
+
+        self._use_kernels, self._kernel_interpret = resolve_kernel_flag(
+            jax.default_backend()
+        )
         self.tiered = self.history_mode == "tiered"
         self.compact_every = 0
         self.d_cap = 0
@@ -2041,6 +2255,8 @@ class JaxConflictSet:
                     h_cap=self.h_cap,
                     d_cap=self.d_cap,
                     kw1=self.key_words + 1,
+                    kernels=self._use_kernels,
+                    kernel_interpret=self._kernel_interpret,
                 )
             else:
                 (
@@ -2063,6 +2279,8 @@ class JaxConflictSet:
                     h_cap=self.h_cap,
                     kw1=self.key_words + 1,
                     amortized=amortized,
+                    kernels=self._use_kernels,
+                    kernel_interpret=self._kernel_interpret,
                 )
         except jax.errors.JaxRuntimeError as e:
             # Real device failures (and ONLY those — a generic Python

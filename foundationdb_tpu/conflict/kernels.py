@@ -1,0 +1,617 @@
+"""Pallas TPU kernels for the two remaining hot phases (ISSUE 14,
+ROADMAP open item 1): the merge/evict compaction and the phase-1 history
+search.
+
+PR 12's in-step phase attribution recorded the inference round-5 made: at
+bench shape the two ``_compact_to`` sort-by-target passes (merge 75% +
+evict 25% of attributed FLOPs) ARE the device step, and phase 1 is 24
+binary-search rounds x kw1 words of random gathers into a ~96MB
+HBM-resident history table.  Both are replaced here by streaming Pallas
+kernels behind the ``FDB_TPU_KERNELS`` g_env flag (flow/knobs.py):
+
+**Fused merge-evict-compact** (``fused_merge_evict``): the inputs of every
+compaction site are ALREADY SORTED — the frozen base tier, the sorted
+delta, and the batch's sorted segment rows — and the engine's rank-
+inversion prep (streaming cumsums/histograms, no sort) already knows each
+row's merged position.  So the rewrite is a single sequential-grid pass:
+
+  phase A/B   locally compact each tier's surviving rows into a dense
+              scratch stream (one-hot MXU placement — a (T,T) selection
+              matmul on 16-bit-split words, exact for all 32-bit values —
+              written at an SMEM write cursor; TPU grids run sequentially,
+              so the cursor is race-free)
+  merge       for each output tile, DMA one contiguous slice of each
+              dense stream (positions partition the tile, so the slice
+              starts are pure arithmetic), place rows by position,
+              apply the reference removeBefore eviction rule IN-STREAM
+              (the predecessor version is a carried SMEM scalar), and
+              write the surviving rows at the output cursor
+
+One pass over VMEM-resident tiles replaces the two full-width
+sort-by-target passes (O(N) data movement instead of O(N log^2 N) sorting
+network passes), and the eviction filter rides the same pass.  The same
+kernel serves the flat per-batch merge (width = h_cap), the tiered
+steady-state delta merge (width = d_cap), and the major compaction inside
+the traced cond (width = h_cap) — so with kernels on there is NO
+sort-by-target pass at history width anywhere in the program
+(tests/test_perf_smoke.py pins this structurally).
+
+**Fused phase-1 search** (``phase1_ranks``): queries are sorted once
+(batch-domain sort), then a sequential grid walks the history ONE TILE AT
+A TIME, keeping the tile VMEM-resident and answering every query that
+completes inside it with a broadcast compare + row-count — the
+tier-combined binary searches become one linear streaming pass over the
+table at DMA bandwidth instead of log2(H) rounds of latency-bound HBM
+gathers.  Sorted queries resolve in order, so a single SMEM cursor tracks
+progress and tiles containing no pending query skip the compare entirely.
+
+Both kernels are bit-identical to the XLA fallback by construction (they
+consume the same rank-inversion prep and implement the same removeBefore
+rule) and are differential-gated on CPU in interpret mode
+(tests/test_kernels.py): verdicts AND exported state across seeds x
+flat/tiered/sharded modes, with scripted device faults on kernelized
+batches.  The XLA path remains the default fallback and the A/B arm;
+``FDB_TPU_KERNELS`` auto-selects kernels on the TPU backend only.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from . import keys as keylib
+
+POS_MAX = 2**31 - 1  # python int: kernel bodies must not capture tracers
+_INF = keylib.INF_WORD
+
+
+def kernels_requested(flag: str, backend: str) -> bool:
+    """Resolve the FDB_TPU_KERNELS g_env value against a jax backend name.
+
+    ''/'auto'  kernels on the TPU backend only (compiled Mosaic)
+    '1'        kernels everywhere (interpret-mode Pallas off-TPU — the
+               differential-gating arm on CPU)
+    'interpret' kernels everywhere, interpreter forced even on TPU
+    '0'        XLA fallback everywhere (the default A/B arm)
+    """
+    if flag in ("", "auto"):
+        return backend == "tpu"
+    if flag in ("1", "interpret"):
+        return True
+    return False
+
+
+def kernel_interpret(flag: str, backend: str) -> bool:
+    """Whether pallas_call should run interpreted (trace-time static)."""
+    if flag == "interpret":
+        return True
+    return backend != "tpu"
+
+
+def resolve_kernel_flag(backend: str) -> tuple:
+    """Validate FDB_TPU_KERNELS (g_env) against a jax backend name and
+    resolve it to (use_kernels, interpret).  The ONE entry for every
+    engine constructor — an unrecognized value raises here, so a typo'd
+    flag can never silently select the XLA fallback."""
+    from ..flow.knobs import g_env
+
+    flag = g_env.get("FDB_TPU_KERNELS")
+    if flag not in ("", "auto", "0", "1", "interpret"):
+        raise ValueError(
+            f"FDB_TPU_KERNELS={flag!r}: expected ''/'auto'/'0'/'1'"
+            f"/'interpret'"
+        )
+    return kernels_requested(flag, backend), kernel_interpret(flag, backend)
+
+
+def _tile(width: int, *divisors: int, cap: int = 256) -> int:
+    """Largest power-of-two tile <= cap dividing width and every divisor.
+    Engine buffer widths are pow2 multiples (PackedBatch bucketing,
+    _next_pow2 growth, h_cap defaults), so this is >= 8 in practice."""
+    t = 1
+    while t * 2 <= cap and width % (t * 2) == 0 and all(
+        d % (t * 2) == 0 for d in divisors
+    ):
+        t *= 2
+    return t
+
+
+def _split16(x_u32):
+    """(..., T) uint32 -> (hi, lo) float32 halves, exact for all 32-bit
+    values (each half <= 65535 < 2^24)."""
+    hi = (x_u32 >> jnp.uint32(16)).astype(jnp.float32)
+    lo = (x_u32 & jnp.uint32(0xFFFF)).astype(jnp.float32)
+    return hi, lo
+
+
+def _combine16(hi_f32, lo_f32):
+    """Inverse of _split16 (exact integer halves back to uint32)."""
+    return (hi_f32.astype(jnp.uint32) * jnp.uint32(65536)
+            + lo_f32.astype(jnp.uint32))
+
+
+def _i32_as_u32(x):
+    return jax.lax.bitcast_convert_type(x, jnp.uint32)
+
+
+def _u32_as_i32(x):
+    return jax.lax.bitcast_convert_type(x, jnp.int32)
+
+
+def _place(lhs_f32, slot, mask, T):
+    """One-hot MXU placement: out[:, j] = lhs[:, i] where slot[i] == j and
+    mask[i], else 0.  slot/mask are (T,) int32/bool; lhs (R, T) f32 rows
+    of 16-bit word halves.  A (T, T) f32 selection matmul — the TPU-native
+    form of a unique-target local scatter (slots are unique where masked).
+
+    precision=HIGHEST is load-bearing: the MXU's default f32 precision
+    truncates inputs to bf16 (8-bit mantissa), which rounds halves like
+    0x8001 — corrupting keys exactly on the one backend where the
+    kernels run compiled, invisibly to the CPU interpret-mode gate
+    (interpret f32 is exact either way).  HIGHEST keeps every 16-bit
+    half exact (<= 65535 < 2^24).
+    """
+    j = jax.lax.broadcasted_iota(jnp.int32, (T, T), 0)  # out slot per row
+    m = ((slot[None, :] == j) & mask[None, :]).astype(jnp.float32)
+    return jax.lax.dot_general(
+        lhs_f32, m, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32,
+        precision=jax.lax.Precision.HIGHEST,
+    )
+
+
+def _pack_rows(keys_u32, vers_i32, pos_i32=None):
+    """Stack (kw1, T) key words + (T,) vers (+ optional pos) into the
+    16-bit-split f32 row matrix _place consumes."""
+    rows = []
+    kw1 = keys_u32.shape[0]
+    for w in range(kw1):
+        hi, lo = _split16(keys_u32[w])
+        rows.append(hi)
+        rows.append(lo)
+    vh, vl = _split16(_i32_as_u32(vers_i32))
+    rows.append(vh)
+    rows.append(vl)
+    if pos_i32 is not None:
+        ph, plo = _split16(_i32_as_u32(pos_i32))
+        rows.append(ph)
+        rows.append(plo)
+    return jnp.stack(rows)
+
+
+def _unpack_rows(placed, kw1, with_pos=False):
+    """Inverse of _pack_rows on the placed (R, T) f32 matrix."""
+    keys = jnp.stack([
+        _combine16(placed[2 * w], placed[2 * w + 1]) for w in range(kw1)
+    ])
+    vers = _u32_as_i32(_combine16(placed[2 * kw1], placed[2 * kw1 + 1]))
+    if not with_pos:
+        return keys, vers, None
+    pos = _u32_as_i32(_combine16(placed[2 * kw1 + 2], placed[2 * kw1 + 3]))
+    return keys, vers, pos
+
+
+# ---------------------------------------------------------------------------
+# Fused merge-evict-compact
+# ---------------------------------------------------------------------------
+
+
+def _merge_kernel_body(
+    kw1, T, nA, nB, nM, width,
+    # refs (order mirrors pallas_call wiring below)
+    scal, startb,
+    a_keys, a_vers, a_keep, a_pos,
+    b_keys, b_vers, b_keep, b_pos,
+    out_keys, out_vers, out_count,
+    da_keys, da_vers, da_pos,
+    db_keys, db_vers, db_pos,
+    k1, v1, m1, p1, k2, v2, p2, ko, vo, po, cur, sems,
+):
+    # Explicit int32: program_id traces 64-bit under enable_x64 (the
+    # JXP004 audit re-trace), and every cursor/SMEM slot here is int32.
+    pid = pl.program_id(0).astype(jnp.int32)
+    PA, SA, PB, SB, PM = 0, nA, nA + 1, nA + 1 + nB, nA + 1 + nB + 1
+    merged_count = scal[0]
+    window = scal[1]
+
+    @pl.when(pid == 0)
+    def _init():
+        cur[0] = 0  # dense-A write cursor
+        cur[1] = 0  # dense-B write cursor
+        cur[2] = 0  # output write cursor
+        cur[3] = jnp.int32(-(2**30))  # prev merged version carry
+
+    def compact_tile(t, sk, sv, skp, sp, dk, dv, dp, slot):
+        """One source tile -> dense stream at the cursor (phase A/B)."""
+        c0 = pltpu.make_async_copy(sk.at[:, pl.ds(t * T, T)], k1, sems.at[0])
+        c1 = pltpu.make_async_copy(sv.at[pl.ds(t * T, T)], v1, sems.at[1])
+        c2 = pltpu.make_async_copy(skp.at[pl.ds(t * T, T)], m1, sems.at[2])
+        c3 = pltpu.make_async_copy(sp.at[pl.ds(t * T, T)], p1, sems.at[3])
+        c0.start(); c1.start(); c2.start(); c3.start()
+        c0.wait(); c1.wait(); c2.wait(); c3.wait()
+        keep = m1[:] != 0
+        rank = jnp.cumsum(keep, dtype=jnp.int32) - 1
+        kcnt = jnp.sum(keep, dtype=jnp.int32)
+        placed = _place(_pack_rows(k1[:], v1[:], p1[:]), rank, keep, T)
+        pk, pv, pp = _unpack_rows(placed, kw1, with_pos=True)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)[:, 0]
+        ko[:] = pk
+        vo[:] = pv
+        # Slots past the tile's survivor count carry the placement
+        # matmul's zeros — a VALID position — so they are overwritten
+        # with the sentinel the merge phase masks on.
+        po[:] = jnp.where(iota < kcnt, pp, POS_MAX)
+        w = cur[slot]
+        o0 = pltpu.make_async_copy(ko, dk.at[:, pl.ds(w, T)], sems.at[4])
+        o1 = pltpu.make_async_copy(vo, dv.at[pl.ds(w, T)], sems.at[5])
+        o2 = pltpu.make_async_copy(po, dp.at[pl.ds(w, T)], sems.at[6])
+        o0.start(); o1.start(); o2.start()
+        o0.wait(); o1.wait(); o2.wait()
+        cur[slot] = w + kcnt
+
+    def sentinel_tile(dp, slot):
+        """After a stream's last tile: one sentinel-position tile at the
+        final cursor, so merge-phase reads of [start, start+T) never see
+        an unwritten position row (start <= live count <= cursor)."""
+        po[:] = jnp.full((T,), POS_MAX, jnp.int32)
+        w = cur[slot]
+        o2 = pltpu.make_async_copy(po, dp.at[pl.ds(w, T)], sems.at[6])
+        o2.start(); o2.wait()
+
+    @pl.when(pid < SA)
+    def _phase_a():
+        compact_tile(pid - PA, a_keys, a_vers, a_keep, a_pos,
+                     da_keys, da_vers, da_pos, 0)
+
+    @pl.when(pid == SA)
+    def _sent_a():
+        sentinel_tile(da_pos, 0)
+
+    @pl.when((pid > SA) & (pid < SB))
+    def _phase_b():
+        compact_tile(pid - PB, b_keys, b_vers, b_keep, b_pos,
+                     db_keys, db_vers, db_pos, 1)
+
+    @pl.when(pid == SB)
+    def _sent_b():
+        sentinel_tile(db_pos, 1)
+
+    @pl.when(pid >= PM)
+    def _phase_merge():
+        t = pid - PM
+        base = t * T
+        # Positions partition [0, merged_count): the dense-A slice for
+        # this tile starts where the dense-B slice leaves off.
+        nm_iota = jax.lax.broadcasted_iota(jnp.int32, (nM, 1), 0)[:, 0]
+        b0 = jnp.sum(jnp.where(nm_iota == t, startb[:], 0), dtype=jnp.int32)
+        a0 = base - b0
+        c0 = pltpu.make_async_copy(da_keys.at[:, pl.ds(a0, T)], k1, sems.at[0])
+        c1 = pltpu.make_async_copy(da_vers.at[pl.ds(a0, T)], v1, sems.at[1])
+        c2 = pltpu.make_async_copy(da_pos.at[pl.ds(a0, T)], p1, sems.at[2])
+        c3 = pltpu.make_async_copy(db_keys.at[:, pl.ds(b0, T)], k2, sems.at[3])
+        c4 = pltpu.make_async_copy(db_vers.at[pl.ds(b0, T)], v2, sems.at[4])
+        c5 = pltpu.make_async_copy(db_pos.at[pl.ds(b0, T)], p2, sems.at[5])
+        c0.start(); c1.start(); c2.start(); c3.start(); c4.start(); c5.start()
+        c0.wait(); c1.wait(); c2.wait(); c3.wait(); c4.wait(); c5.wait()
+        slot_a = p1[:] - base
+        slot_b = p2[:] - base
+        in_a = (slot_a >= 0) & (slot_a < T)
+        in_b = (slot_b >= 0) & (slot_b < T)
+        merged = (
+            _place(_pack_rows(k1[:], v1[:]), slot_a, in_a, T)
+            + _place(_pack_rows(k2[:], v2[:]), slot_b, in_b, T)
+        )
+        mk, mv, _ = _unpack_rows(merged, kw1)
+        iota = jax.lax.broadcasted_iota(jnp.int32, (T, 1), 0)[:, 0]
+        gpos = base + iota
+        occ = gpos < merged_count
+        prev = jnp.concatenate(
+            [jnp.broadcast_to(cur[3], (1,)).astype(jnp.int32), mv[:-1]]
+        )
+        # The reference removeBefore wasAbove rule, streamed: drop row p
+        # iff p > 0 and both it and its merged-order predecessor sit
+        # below the window.  The no-evict arms pass window = FLOOR (every
+        # version >= it), which reduces this to keep = occ — the merge
+        # result verbatim.
+        ev = occ & (gpos > 0) & (mv < window) & (prev < window)
+        keep = occ & ~ev
+        cur[3] = jnp.where(occ[T - 1], mv[T - 1], cur[3])
+        rank = jnp.cumsum(keep, dtype=jnp.int32) - 1
+        n = jnp.sum(keep, dtype=jnp.int32)
+        placed = _place(merged, rank, keep, T)
+        pk, pv, _ = _unpack_rows(placed, kw1)
+        ko[:] = pk
+        vo[:] = pv
+        w = cur[2]
+        o0 = pltpu.make_async_copy(ko, out_keys.at[:, pl.ds(w, T)], sems.at[6])
+        o1 = pltpu.make_async_copy(vo, out_vers.at[pl.ds(w, T)], sems.at[7])
+        o0.start(); o1.start()
+        o0.wait(); o1.wait()
+        cur[2] = w + n
+
+        @pl.when(t == nM - 1)
+        def _final():
+            out_count[0] = cur[2]
+
+
+def fused_merge_evict(
+    a_keys, a_vers, a_keep, a_pos,
+    b_keys, b_vers, b_keep, b_pos,
+    merged_count, window,
+    *, width: int, kw1: int, tile: int = 256, interpret: bool = False,
+):
+    """Merge two position-annotated sorted streams, evict by the
+    removeBefore rule against ``window``, and compact — one streaming
+    pass.
+
+    a_*: the big tier (NA rows): keys (kw1, NA) u32, vers (NA,) i32,
+    keep (NA,) i32 mask, pos (NA,) i32 pre-eviction merged position
+    (only read where keep).  b_*: the small stream likewise.  Kept
+    positions must partition [0, merged_count).  window = FLOOR_REL
+    disables eviction (keep = merge).  Returns (out_keys (kw1, width)
+    u32, out_vers (width,) i32, out_count i32 scalar); rows at and above
+    out_count are UNDEFINED — callers mask with the live count exactly
+    like the sort path's _compact_to does.
+    """
+    NA = a_keys.shape[1]
+    NB = b_keys.shape[1]
+    T = _tile(width, NA, NB, cap=tile)
+    nA, nB, nM = NA // T, NB // T, width // T
+    # Dense-slice starts: start_b[t] = kept B rows with pos < t*T, via a
+    # small histogram (NB items) + exclusive cumsum — never an H-sized
+    # scatter.
+    b_bins = (
+        jnp.zeros((nM + 1,), jnp.int32)
+        .at[jnp.where(b_keep != 0, jnp.clip(b_pos // T, 0, nM), nM)]
+        .add(jnp.where(b_keep != 0, 1, 0))
+    )
+    start_b = jnp.concatenate(
+        [jnp.zeros((1,), jnp.int32), jnp.cumsum(b_bins[:nM], dtype=jnp.int32)]
+    )[:nM]
+    scal = jnp.stack([merged_count.astype(jnp.int32),
+                      window.astype(jnp.int32)])
+
+    grid = (nA + 1 + nB + 1 + nM,)
+    kernel = functools.partial(_merge_kernel_body, kw1, T, nA, nB, nM, width)
+    out_shapes = (
+        jax.ShapeDtypeStruct((kw1, width + T), jnp.uint32),   # out_keys
+        jax.ShapeDtypeStruct((width + T,), jnp.int32),        # out_vers
+        jax.ShapeDtypeStruct((1,), jnp.int32),                # out_count
+        jax.ShapeDtypeStruct((kw1, NA + 2 * T), jnp.uint32),  # dense A
+        jax.ShapeDtypeStruct((NA + 2 * T,), jnp.int32),
+        jax.ShapeDtypeStruct((NA + 2 * T,), jnp.int32),
+        jax.ShapeDtypeStruct((kw1, NB + 2 * T), jnp.uint32),  # dense B
+        jax.ShapeDtypeStruct((NB + 2 * T,), jnp.int32),
+        jax.ShapeDtypeStruct((NB + 2 * T,), jnp.int32),
+    )
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    smem_spec = pl.BlockSpec(memory_space=pltpu.SMEM)
+    vmem_spec = pl.BlockSpec(memory_space=pltpu.VMEM)
+    outs = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[smem_spec, vmem_spec] + [any_spec] * 8,
+        out_specs=(any_spec, any_spec, smem_spec) + (any_spec,) * 6,
+        out_shape=out_shapes,
+        scratch_shapes=[
+            pltpu.VMEM((kw1, T), jnp.uint32),   # k1
+            pltpu.VMEM((T,), jnp.int32),        # v1
+            pltpu.VMEM((T,), jnp.int32),        # m1 (keep)
+            pltpu.VMEM((T,), jnp.int32),        # p1
+            pltpu.VMEM((kw1, T), jnp.uint32),   # k2
+            pltpu.VMEM((T,), jnp.int32),        # v2
+            pltpu.VMEM((T,), jnp.int32),        # p2
+            pltpu.VMEM((kw1, T), jnp.uint32),   # ko
+            pltpu.VMEM((T,), jnp.int32),        # vo
+            pltpu.VMEM((T,), jnp.int32),        # po
+            pltpu.SMEM((4,), jnp.int32),        # cursors + prev carry
+            pltpu.SemaphoreType.DMA((8,)),
+        ],
+        interpret=interpret,
+    )(
+        scal, start_b,
+        a_keys, a_vers, a_keep.astype(jnp.int32), a_pos,
+        b_keys, b_vers, b_keep.astype(jnp.int32), b_pos,
+    )
+    out_keys, out_vers, out_count = outs[0], outs[1], outs[2]
+    return out_keys[:, :width], out_vers[:width], out_count[0]
+
+
+# ---------------------------------------------------------------------------
+# Fused phase-1 search
+# ---------------------------------------------------------------------------
+
+
+def _search_kernel_body(
+    kw1, TH, TQ, nH, M,
+    q_keys, q_side,
+    h_keys,
+    ranks,
+    ht, qk, qs, ro, cur, sems,
+):
+    pid = pl.program_id(0).astype(jnp.int32)  # int32 under x64 too
+    last_tile = pid == nH - 1
+
+    @pl.when(pid == 0)
+    def _init():
+        cur[0] = 0          # queries fully resolved so far
+        cur[1] = 0          # next-pending-query cache valid?
+        for w in range(kw1 + 1):
+            cur[2 + w] = 0  # next query's words + side
+
+    c0 = pltpu.make_async_copy(h_keys.at[:, pl.ds(pid * TH, TH)], ht,
+                               sems.at[0])
+    c0.start(); c0.wait()
+
+    # Scalar guard: skip the whole tile when the next pending query
+    # cannot complete here (its rank lies beyond this tile).  Lex compare
+    # of the cached next-query words against the tile's last key.
+    def next_q_completes():
+        lt = jnp.bool_(False)
+        eq = jnp.bool_(True)
+        for w in range(kw1):
+            kw_ = ht[w, TH - 1]
+            qw = _i32_as_u32(cur[2 + w])
+            lt = lt | (eq & (qw < kw_))
+            eq = eq & (qw == kw_)
+        # side 0 (left, counts <) completes when q <= last; side 1
+        # (right, counts <=) needs q < last strictly.
+        is_left = cur[2 + kw1] == 0
+        return lt | (eq & is_left)
+
+    pending = cur[0] < M
+    enter = pending & (last_tile | (cur[1] == 0) | next_q_completes())
+
+    @pl.when(enter)
+    def _scan():
+        def body(carry):
+            qc, _cont = carry
+            d0 = pltpu.make_async_copy(q_keys.at[:, pl.ds(qc, TQ)], qk,
+                                       sems.at[1])
+            d1 = pltpu.make_async_copy(q_side.at[pl.ds(qc, TQ)], qs,
+                                       sems.at[2])
+            d0.start(); d1.start()
+            d0.wait(); d1.wait()
+            # (TQ, TH) pairwise lex compares, trailing word first so the
+            # most significant word decides last (rangequery.lex_less).
+            lt = jnp.zeros((TQ, TH), bool)
+            le = jnp.ones((TQ, TH), bool)
+            for w in range(kw1 - 1, -1, -1):
+                hw = ht[w][None, :]
+                qw = qk[w][:, None]
+                lt = (hw < qw) | ((hw == qw) & lt)
+                le = (hw < qw) | ((hw == qw) & le)
+            right = qs[:] != 0
+            cnt = jnp.sum(
+                jnp.where(right[:, None], le, lt), axis=1, dtype=jnp.int32
+            )
+            ro[:] = pid * TH + cnt
+            iota = jax.lax.broadcasted_iota(jnp.int32, (TQ, 1), 0)[:, 0]
+            valid = (qc + iota) < M
+            # Completion: strictly-below-last for right-side counts,
+            # at-or-below for left — monotone over the sorted query
+            # stream, so completions form a prefix of the chunk.
+            lt_last = jnp.zeros((TQ,), bool)
+            eq_last = jnp.ones((TQ,), bool)
+            for w in range(kw1 - 1, -1, -1):
+                hw = ht[w, TH - 1]
+                qw = qk[w]
+                lt_last = (qw < hw) | ((qw == hw) & lt_last)
+                eq_last = eq_last & (qw == hw)
+            fin = valid & (last_tile | lt_last | (eq_last & ~right))
+            n_fin = jnp.sum(fin, dtype=jnp.int32)
+            o0 = pltpu.make_async_copy(ro, ranks.at[pl.ds(qc, TQ)],
+                                       sems.at[3])
+            o0.start(); o0.wait()
+            # Cache the first unresolved query for the next tile's guard.
+            sel = (iota == n_fin).astype(jnp.int32)
+            for w in range(kw1):
+                cur[2 + w] = jnp.sum(sel * _u32_as_i32(qk[w]), dtype=jnp.int32)
+            cur[2 + kw1] = jnp.sum(sel * qs[:], dtype=jnp.int32)
+            cur[1] = 1
+            cur[0] = qc + n_fin
+            cont = (n_fin == TQ) & (qc + n_fin < M)
+            return qc + n_fin, cont
+
+        def cond(carry):
+            return carry[1]
+
+        jax.lax.while_loop(cond, body, (cur[0], jnp.bool_(True)))
+
+
+def phase1_ranks(h_keys, q_keys, q_side, *, tile_h: int = 512,
+                 tile_q: int = 128, interpret: bool = False):
+    """Insertion ranks of PRE-SORTED queries into sorted history keys by
+    one streaming pass over the table.
+
+    h_keys (kw1, N) u32 word-major (INF-padded past the live count, like
+    the carried history buffers); q_keys (kw1, M) SORTED ascending with
+    q_side as the least-significant sort key; q_side (M,) i32 — 0: left
+    rank (count of rows < q), 1: right rank (count of rows <= q).
+    Returns ranks (M,) i32 in the sorted order — bit-identical to
+    ops.rangequery.searchsorted_words over the same width.
+    """
+    kw1, N = h_keys.shape
+    M = q_keys.shape[1]
+    TH = _tile(N, cap=tile_h)
+    TQ = _tile(M, cap=tile_q)
+    nH = N // TH
+    # Pad the query stream by one chunk: the cursor advances by the
+    # completed-prefix length, so a chunk DMA at an unaligned cursor may
+    # read past M — the pad keeps it in bounds (padded rows are never
+    # counted: the in-kernel valid mask cuts at M).
+    q_keys = jnp.concatenate(
+        [q_keys, jnp.zeros((kw1, TQ), jnp.uint32)], axis=1
+    )
+    q_side = jnp.concatenate([q_side, jnp.zeros((TQ,), jnp.int32)])
+    kernel = functools.partial(_search_kernel_body, kw1, TH, TQ, nH, M)
+    any_spec = pl.BlockSpec(memory_space=pltpu.ANY)
+    out = pl.pallas_call(
+        kernel,
+        grid=(nH,),
+        in_specs=[any_spec] * 3,
+        out_specs=any_spec,
+        out_shape=jax.ShapeDtypeStruct((M + TQ,), jnp.int32),
+        scratch_shapes=[
+            pltpu.VMEM((kw1, TH), jnp.uint32),  # history tile
+            pltpu.VMEM((kw1, TQ), jnp.uint32),  # query chunk
+            pltpu.VMEM((TQ,), jnp.int32),       # query sides
+            pltpu.VMEM((TQ,), jnp.int32),       # rank staging
+            pltpu.SMEM((2 + kw1 + 1,), jnp.int32),
+            pltpu.SemaphoreType.DMA((4,)),
+        ],
+        interpret=interpret,
+    )(q_keys, q_side, h_keys)
+    return out[:M]
+
+
+def phase1_search_tiers(tiers, r_begin, r_end, *, interpret: bool = False):
+    """Kernelized phase 1: (i0, j1) rank pairs for EVERY history tier
+    from ONE shared batch-domain query sort.
+
+    Matches detect_core's XLA pair bit-for-bit per tier:
+      i0 = searchsorted_words(tier, r_begin, 'right') - 1
+      j1 = searchsorted_words(tier, r_end, 'left') - 1
+    The two query sets are sorted together once (side is the least-
+    significant key so equal-key left queries complete first), every
+    tier's streaming kernel consumes the same sorted stream, and ONE
+    multi-operand small sort un-permutes all tiers' ranks — the tiered
+    engine's base+delta searches share both sorts instead of paying
+    them per tier.  Returns [(i0, j1), ...] aligned with `tiers`.
+    """
+    kw1, R = r_begin.shape[0], r_begin.shape[1]
+    M = 2 * R
+    q = jnp.concatenate([r_end, r_begin], axis=1)
+    side = jnp.concatenate(
+        [jnp.zeros((R,), jnp.int32), jnp.ones((R,), jnp.int32)]
+    )
+    iota = jnp.arange(M, dtype=jnp.int32)
+    ops = tuple(q[w] for w in range(kw1)) + (side, iota)
+    res = jax.lax.sort(ops, num_keys=kw1 + 1, is_stable=True)
+    q_sorted = jnp.stack(res[:kw1])
+    side_sorted = res[kw1]
+    perm = res[kw1 + 1]
+    ranks_sorted = [
+        phase1_ranks(h, q_sorted, side_sorted, interpret=interpret)
+        for h in tiers
+    ]
+    # Un-permute: sort (perm, ranks...) by perm — one second small sort
+    # for every tier together, no scatter.
+    back = jax.lax.sort((perm, *ranks_sorted), num_keys=1, is_stable=True)
+    out = []
+    for t in range(len(tiers)):
+        ranks = back[1 + t]
+        out.append((ranks[R:] - 1, ranks[:R] - 1))
+    return out
+
+
+def phase1_search(h_keys, r_begin, r_end, *, interpret: bool = False):
+    """Single-tier convenience wrapper over phase1_search_tiers."""
+    ((i0, j1),) = phase1_search_tiers(
+        (h_keys,), r_begin, r_end, interpret=interpret
+    )
+    return i0, j1

@@ -13,6 +13,10 @@ subtractive IN-STEP ablation — the seams already cut into the flat
     fixpoint   nofix      phases 2-4's intra-batch fixpoint iteration
     merge      nomerge    phases 5-6 entirely (merge + evict)
     evict      noevict    phase 6's eviction compaction sort
+    (kernels)  nokernel   FDB_TPU_KERNELS routing — the ablated program
+                          runs the XLA fallback in the SAME step, so the
+                          Pallas kernels are priced in-step too (ISSUE
+                          14; see the kernel_ab report block)
 
 ``attribute_phases`` traces the full program and each ablated twin with
 a FRESH jit wrapper per arm (the ablation flag is read at trace time, so
@@ -52,6 +56,17 @@ PHASE_ABLATIONS = (
     ("merge", "nomerge"),
     ("evict", "noevict"),
 )
+
+# The kernel A/B token (ISSUE 14): `nokernel` routes a kernels-enabled
+# program through the XLA fallback INSIDE the same step, so the harness
+# prices the Pallas kernels in-step (the failed-detour rule: standalone
+# kernel microbenches lie exactly like standalone phase benches).  When
+# the engine runs with kernels, every arm is traced twice — with and
+# without the kernels — and the per-phase deltas land in the report's
+# `kernel_ab` block.  NOTE off-TPU the kernel arms price interpret-mode
+# Pallas (the emulation, not Mosaic) — directional only; the honest
+# device numbers come from the bench arms on a live tunnel.
+NOKERNEL = "nokernel"
 
 
 class _ablation:
@@ -163,15 +178,25 @@ def attribute_phases(engine, transactions=None, *, measure: bool = False,
     blob = jnp.asarray(engine._pack_blob(pb, now, engine.oldest_version, 1))
     args = (engine._hkeys, engine._hvers, engine._hcount, engine._oldest,
             blob)
+    use_kern = bool(getattr(engine, "_use_kernels", False))
     statics = dict(txn_cap=pb.txn_cap, rr_cap=pb.rr_cap, wr_cap=pb.wr_cap,
                    h_cap=engine.h_cap, kw1=engine.key_words + 1,
-                   amortized=False)
+                   amortized=False, kernels=use_kern,
+                   kernel_interpret=bool(
+                       getattr(engine, "_kernel_interpret", False)))
     static_names = tuple(statics)
 
+    arm_list = [("full", "")] + list(PHASE_ABLATIONS)
+    if use_kern:
+        # The nokernel twins: same arms, XLA fallback in-step.
+        arm_list += [
+            (f"xla_{ph}", ",".join(t for t in (NOKERNEL, tok) if t))
+            for ph, tok in arm_list[: 1 + len(PHASE_ABLATIONS)]
+        ]
     arms: dict = {}
     _keep = []  # hold every arm's callable: a GC'd one could recycle
     #             its id() into a later arm's cache key
-    for phase, token in (("full", ""),) + PHASE_ABLATIONS:
+    for phase, token in arm_list:
         with _ablation(token):
             # Fresh FUNCTION OBJECT per arm, not just a fresh jit
             # wrapper: jax's trace cache keys on the underlying
@@ -218,6 +243,27 @@ def attribute_phases(engine, transactions=None, *, measure: bool = False,
         "phases": phases,
         "residual_flops": max(0.0, full["flops"] - attributed),
     }
+    if use_kern:
+        # Kernel-vs-XLA per phase, priced in-step (satellite of ISSUE
+        # 14): for each phase, what the kernels change about its
+        # subtractive attribution.  Deterministic (static analysis).
+        xla_full = arms["xla_full"]
+        per_phase: dict = {}
+        for ph, _tok in PHASE_ABLATIONS:
+            kf = max(0.0, full["flops"] - arms[ph]["flops"])
+            xf = max(0.0, xla_full["flops"] - arms[f"xla_{ph}"]["flops"])
+            per_phase[ph] = {"kernels_flops": kf, "xla_flops": xf}
+        report["kernel_ab"] = {
+            "full_flops": {"kernels": full["flops"],
+                           "xla": xla_full["flops"]},
+            "phase_flops": per_phase,
+            "interpreted": bool(statics["kernel_interpret"]),
+        }
+        if measure:
+            report["kernel_ab"]["measured_full_wall_seconds"] = {
+                "kernels": round(arms["full"]["wall_seconds"], 6),
+                "xla": round(arms["xla_full"]["wall_seconds"], 6),
+            }
     # Cross-check against program_cost_table(): at the registry's
     # canonical trace shapes the two analyses price the SAME program, so
     # the flat_step block's flops must agree with our full arm.

@@ -277,6 +277,30 @@ g_env.declare("FDB_TPU_DELTA_CAP", "0",
 g_env.declare("FDB_TPU_EVICT_EVERY", "1",
               help="evict cadence in batches; in tiered mode the alias "
                    "for major-compaction cadence")
+# Pallas fused kernels (ISSUE 14, ROADMAP item 1): the merge/evict
+# compaction and phase-1 search hot paths as streaming TPU kernels.
+g_env.declare("FDB_TPU_KERNELS", "",
+              help="Pallas kernel routing for the conflict step's hot "
+                   "phases (merge/evict compaction + phase-1 search): "
+                   "''/'auto' kernels on the TPU backend only, '1' "
+                   "kernels everywhere (interpret-mode Pallas off-TPU — "
+                   "the CPU differential-gating arm), 'interpret' force "
+                   "the interpreter even on TPU, '0' XLA fallback "
+                   "everywhere (the A/B arm).  Decision-identical in "
+                   "every mode (tests/test_kernels.py)")
+g_env.declare("FDB_TPU_H_CAP", "0",
+              help="device history capacity override, in rows, for any "
+                   "ConflictSet constructed WITHOUT an explicit h_cap "
+                   "(0 = each caller's built-in default: 65536 for "
+                   "api.ConflictSet, 3145728 for bench.py's device "
+                   "arms = 2.87M live boundaries at window 50 + ~10% "
+                   "headroom — PERF_NOTES lever 2).  Setting it "
+                   "applies to EVERY such set in the process, sim "
+                   "resolvers included — size accordingly.  Values are "
+                   "rounded UP to a 256-row multiple (the Pallas "
+                   "kernels' tile; api.env_h_cap).  Always safe to "
+                   "drop: the engine's must-fit guard syncs and grows "
+                   "when a live set outruns the cap, never truncates")
 g_env.declare("FDB_TPU_JAXCHECK_DIR", "",
               help="jaxcheck fingerprint baseline directory override "
                    "(default: tests/jax_fingerprints next to the package)")

@@ -105,6 +105,8 @@ def _shard_body(
     rr_cap: int,
     wr_cap: int,
     h_cap: int,
+    kernels: bool = False,
+    kernel_interpret: bool = False,
 ):
     """Per-device block: clip the replicated batch to this shard's bounds and
     run the single-device engine on the local history slice.
@@ -147,6 +149,8 @@ def _shard_body(
         rr_cap=rr_cap,
         wr_cap=wr_cap,
         h_cap=h_cap,
+        kernels=kernels,
+        kernel_interpret=kernel_interpret,
     )
     (out_keys, out_vers, out_count, new_oldest, status, undecided, iters) = out
     # Convergence is all-or-nothing across the mesh: if ANY shard's fixpoint
@@ -170,9 +174,12 @@ def _shard_body(
     )
 
 
-def _make_sharded_step(mesh: Mesh, txn_cap, rr_cap, wr_cap, h_cap):
+def _make_sharded_step(mesh: Mesh, txn_cap, rr_cap, wr_cap, h_cap,
+                       kernels: bool = False,
+                       kernel_interpret: bool = False):
     body = partial(
-        _shard_body, txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap, h_cap=h_cap
+        _shard_body, txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap,
+        h_cap=h_cap, kernels=kernels, kernel_interpret=kernel_interpret,
     )
     shard = P(AXIS)
     repl = P()
@@ -278,6 +285,17 @@ class ShardedJaxConflictSet:
         self._lo = jax.device_put(jnp.asarray(lo), self._shardspec)
         self._hi = jax.device_put(jnp.asarray(hi), self._shardspec)
         self._steps: dict = {}
+        # Pallas kernel routing inside the shard_map body (ISSUE 14),
+        # resolved once per set exactly like JaxConflictSet (invalid
+        # flag values raise): per-shard detect_core runs its fused
+        # merge/search kernels on each device's history slice; the
+        # differential gate covers the sharded mode on CPU interpret
+        # (tests/test_kernels.py).
+        from ..conflict.kernels import resolve_kernel_flag
+
+        self._use_kernels, self._kernel_interpret = resolve_kernel_flag(
+            jax.default_backend()
+        )
         self._init_state(oldest_rel=0)
         self.last_iters = 0
         self._cpu_engines = None
@@ -345,7 +363,10 @@ class ShardedJaxConflictSet:
         key = (pb.txn_cap, pb.rr_cap, pb.wr_cap, self.h_cap)
         step = self._steps.get(key)
         if step is None:
-            step = _make_sharded_step(self.mesh, *key)
+            step = _make_sharded_step(
+                self.mesh, *key, kernels=self._use_kernels,
+                kernel_interpret=self._kernel_interpret,
+            )
             self._steps[key] = step
         return step
 

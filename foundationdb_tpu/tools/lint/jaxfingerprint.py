@@ -52,6 +52,11 @@ def fingerprint(entry) -> dict:
         key = f"{e.prim}|{size_class(e.max_dim, entry.size_classes)}"
         if e.in_cond:
             key += "|cond"
+        if e.in_kernel:
+            # Pallas kernel-body eqns (ISSUE 14): fingerprinted under
+            # their own axis so a kernel rewrite shows in the baseline
+            # diff like any other program-shape change.
+            key += "|kernel"
         counts[key] = counts.get(key, 0) + 1
         if e.prim in TRANSFER_PRIMS:
             transfers[e.prim] = transfers.get(e.prim, 0) + 1

@@ -21,7 +21,10 @@ Rules:
           above the entry's H threshold outside the compaction cond
           (compaction-gated entries), or above the entry's declared
           width bound anywhere (full-width entries; inside shard_map
-          this catches per-shard code touching globally-sized operands)
+          this catches per-shard code touching globally-sized operands).
+          Applies INSIDE pallas_call kernel jaxprs too — kernel work
+          must stay tile-bounded, and pl.when's lowered cond does not
+          count as the compaction cond (see walk_jaxpr)
   JXP002  host callback/transfer primitive inside traced code
           (pure_callback/io_callback/debug prints/infeed — every one is
           a per-batch device stall)
@@ -113,6 +116,7 @@ class EqnEntry:
     out_dtypes: Tuple[str, ...]
     wide64_dim: int        # max dim over 64-bit results (0 = none)
     wide64_dtypes: Tuple[str, ...]
+    in_kernel: bool = False  # inside a pallas_call kernel jaxpr
 
 
 def _sub_jaxprs(params):
@@ -130,10 +134,19 @@ def _sub_jaxprs(params):
 
 
 def walk_jaxpr(jaxpr, *, in_cond: bool = False, in_while: bool = False,
-               depth: int = 0, out: Optional[List[EqnEntry]] = None
-               ) -> List[EqnEntry]:
+               in_kernel: bool = False, depth: int = 0,
+               out: Optional[List[EqnEntry]] = None) -> List[EqnEntry]:
     """Flatten a Jaxpr or ClosedJaxpr into EqnEntry rows, descending into
-    every sub-jaxpr and tracking compaction-cond membership."""
+    every sub-jaxpr (cond/while/scan/shard_map/pjit AND pallas_call
+    kernel jaxprs) and tracking compaction-cond membership.
+
+    Inside a pallas_call kernel, `cond` stops counting as the compaction
+    cond: pl.when predication lowers to lax.cond, and letting it confer
+    compaction-gating would let an H-sized work primitive hide inside
+    any kernel's predicated region.  Kernel eqns keep the in_cond state
+    of the pallas_call SITE (a kernel invoked from the real compaction
+    branch is still gated) and carry in_kernel=True so the width rules
+    and fingerprints can see kernel structure explicitly."""
     if out is None:
         out = []
     inner = getattr(jaxpr, "jaxpr", None)
@@ -141,11 +154,12 @@ def walk_jaxpr(jaxpr, *, in_cond: bool = False, in_while: bool = False,
         jaxpr = inner
     for eqn in jaxpr.eqns:
         name = eqn.primitive.name
-        sub_cond = in_cond or name == "cond"
+        sub_cond = in_cond or (name == "cond" and not in_kernel)
         sub_while = in_while or name == "while"
+        sub_kernel = in_kernel or name == "pallas_call"
         for sub in _sub_jaxprs(eqn.params):
             walk_jaxpr(sub, in_cond=sub_cond, in_while=sub_while,
-                       depth=depth + 1, out=out)
+                       in_kernel=sub_kernel, depth=depth + 1, out=out)
         dims = [
             max(v.aval.shape)
             for v in list(eqn.invars) + list(eqn.outvars)
@@ -171,6 +185,7 @@ def walk_jaxpr(jaxpr, *, in_cond: bool = False, in_while: bool = False,
             out_dtypes=tuple(str(v.aval.dtype) for v in outs),
             wide64_dim=max(wide_dims, default=0),
             wide64_dtypes=tuple(wide),
+            in_kernel=in_kernel,
         ))
     return out
 

@@ -1,0 +1,382 @@
+"""Pallas kernel differential gate (ISSUE 14).
+
+FDB_TPU_KERNELS=1 (interpret-mode Pallas on this CPU host) must be
+DECISION- and STATE-identical to the XLA fallback and to the CPU
+reference across random streams in every engine mode — flat, tiered
+(steady-state delta merges + in-cond major compactions), and the
+sharded shard_map entry — including a scripted DeviceFaultInjector
+fault landing ON a kernelized batch (breaker degrades to the mirror,
+replays bit-identically, same-seed transition logs byte-identical).
+
+Unit layer: the two kernels against brute-force oracles — the fused
+merge-evict-compact against a numpy merge + removeBefore walk, the
+streaming phase-1 search against ops.rangequery.searchsorted_words.
+
+Shape discipline (1-core CI host): one small bucket per mode so each
+interpret-mode compile is paid once.
+
+Run alone: pytest -m kernels
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+from foundationdb_tpu.conflict.engine_jax import JaxConflictSet
+from foundationdb_tpu.conflict.types import TransactionConflictInfo as T
+from foundationdb_tpu.flow import DeterministicRandom
+
+pytestmark = pytest.mark.kernels
+
+FLOOR = -(2**30)
+INF = 0xFFFFFFFF
+BUCKETS = (32, 128, 64)
+
+
+def k(i: int) -> bytes:
+    return b"%08d" % i
+
+
+def _random_stream(seed, keyspace, batches, txns_per_batch, snap_lag=25):
+    rng = DeterministicRandom(seed)
+    version = 10
+    out = []
+    for _ in range(batches):
+        txns = []
+        for _ in range(rng.random_int(1, txns_per_batch + 1)):
+            tr = T(read_snapshot=max(0, version - rng.random_int(0, snap_lag)))
+            for _ in range(rng.random_int(0, 4)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 8))
+                tr.read_ranges.append((k(a), k(b)))
+            for _ in range(rng.random_int(0, 3)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 10))
+                tr.write_ranges.append((k(a), k(b)))
+            txns.append(tr)
+        now = version + rng.random_int(1, 10)
+        new_oldest = max(0, version - snap_lag)
+        out.append((txns, now, new_oldest))
+        version = now
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. kernel unit oracles
+# ---------------------------------------------------------------------------
+
+
+def _merge_case(width, NA, NB, liveA, liveB, seed, window):
+    from foundationdb_tpu.conflict.kernels import fused_merge_evict
+
+    r = np.random.default_rng(seed)
+    keepA = np.zeros(NA, bool)
+    keepA[r.choice(NA, size=liveA, replace=False)] = True
+    keepB = np.zeros(NB, bool)
+    keepB[r.choice(NB, size=liveB, replace=False)] = True
+    mc = liveA + liveB
+    assert mc <= width
+    a_slots = np.sort(r.choice(mc, size=liveA, replace=False))
+    b_slots = np.setdiff1d(np.arange(mc), a_slots)
+    posA = np.full(NA, 123456789, np.int32)
+    posA[np.where(keepA)[0]] = a_slots
+    posB = np.full(NB, 987654321, np.int32)
+    posB[np.where(keepB)[0]] = b_slots
+    versA = r.integers(-100, 100, NA).astype(np.int32)
+    versB = r.integers(-100, 100, NB).astype(np.int32)
+    kA = r.integers(0, 2**32, (3, NA), dtype=np.uint32)
+    kB = r.integers(0, 2**32, (3, NB), dtype=np.uint32)
+
+    ok, ov, oc = fused_merge_evict(
+        jnp.asarray(kA), jnp.asarray(versA), jnp.asarray(keepA),
+        jnp.asarray(posA),
+        jnp.asarray(kB), jnp.asarray(versB), jnp.asarray(keepB),
+        jnp.asarray(posB),
+        jnp.asarray(mc, jnp.int32), jnp.asarray(window, jnp.int32),
+        width=width, kw1=3, interpret=True,
+    )
+    ok, ov, oc = np.asarray(ok), np.asarray(ov), int(oc)
+
+    # Oracle: materialize the merge, then the removeBefore walk.
+    mk = np.zeros((3, mc), np.uint32)
+    mv = np.zeros(mc, np.int32)
+    mk[:, a_slots] = kA[:, keepA]
+    mv[a_slots] = versA[keepA]
+    mk[:, b_slots] = kB[:, keepB]
+    mv[b_slots] = versB[keepB]
+    prev = np.concatenate([[FLOOR], mv[:-1]])
+    ev = (np.arange(mc) > 0) & (mv < window) & (prev < window)
+    keep = ~ev
+    want_k, want_v = mk[:, keep], mv[keep]
+    n = want_v.shape[0]
+    assert oc == n, (oc, n)
+    assert (ov[:n] == want_v).all()
+    assert (ok[:, :n] == want_k).all()
+
+
+def test_fused_merge_evict_vs_oracle():
+    for seed, (w, na, nb, la, lb) in enumerate([
+        (512, 512, 64, 300, 40),
+        (256, 256, 16, 100, 10),
+        (1024, 1024, 128, 777, 100),
+        (256, 256, 16, 0, 0),       # empty
+        (256, 256, 16, 1, 16),      # singleton A, full B
+    ]):
+        _merge_case(w, na, nb, la, lb, seed + 1, window=0)
+
+
+def test_fused_merge_evict_floor_window_keeps_everything():
+    # window = FLOOR disables eviction (the noevict / amortized-skip arm).
+    _merge_case(512, 512, 64, 300, 40, seed=9, window=FLOOR)
+
+
+def test_phase1_search_vs_searchsorted_words():
+    from foundationdb_tpu.conflict.kernels import phase1_search
+    from foundationdb_tpu.ops.rangequery import searchsorted_words
+
+    for seed, (N, live, R) in enumerate(
+        [(1024, 700, 64), (512, 1, 16), (2048, 2048, 256)]
+    ):
+        r = np.random.default_rng(seed + 1)
+        hk = np.full((3, N), INF, np.uint32)
+        vals = np.sort(r.choice(2**20, size=live, replace=False)).astype(
+            np.uint32)
+        hk[0, :live] = vals >> 10
+        hk[1, :live] = vals & 1023
+        hk[2, :live] = 7
+
+        def enc(q):
+            out = np.zeros((3, R), np.uint32)
+            out[0], out[1], out[2] = q >> 10, q & 1023, 7
+            return out
+
+        rb = enc(r.choice(2**20, size=R).astype(np.uint32))
+        re_ = enc(r.choice(2**20, size=R).astype(np.uint32))
+        rb[:, -2:] = INF  # padding-row queries rank too
+        re_[:, -1:] = INF
+        i0, j1 = phase1_search(jnp.asarray(hk), jnp.asarray(rb),
+                               jnp.asarray(re_), interpret=True)
+        want_i0 = searchsorted_words(jnp.asarray(hk), jnp.asarray(rb),
+                                     "right") - 1
+        want_j1 = searchsorted_words(jnp.asarray(hk), jnp.asarray(re_),
+                                     "left") - 1
+        assert (np.asarray(i0) == np.asarray(want_i0)).all(), (N, live, R)
+        assert (np.asarray(j1) == np.asarray(want_j1)).all(), (N, live, R)
+
+
+# ---------------------------------------------------------------------------
+# 2. engine differentials: kernels vs XLA fallback vs CPU, state included
+# ---------------------------------------------------------------------------
+
+
+def _run_engine(stream, monkeypatch, kernels: bool, tiered: bool):
+    if kernels:
+        monkeypatch.setenv("FDB_TPU_KERNELS", "1")
+    else:
+        monkeypatch.setenv("FDB_TPU_KERNELS", "0")
+    if tiered:
+        monkeypatch.setenv("FDB_TPU_HISTORY", "tiered")
+        monkeypatch.setenv("FDB_TPU_DELTA_CAP", "512")
+        monkeypatch.setenv("FDB_TPU_EVICT_EVERY", "3")
+    else:
+        monkeypatch.delenv("FDB_TPU_HISTORY", raising=False)
+    cs = JaxConflictSet(key_words=3, h_cap=1 << 10, bucket_mins=BUCKETS)
+    assert cs._use_kernels is kernels
+    assert cs.tiered is tiered
+    verdicts = [cs.detect(txns, now, nov) for txns, now, nov in stream]
+    exported = CpuConflictSet()
+    cs.store_to(exported)
+    if tiered:
+        assert cs.metrics.snapshot()["counters"]["major_compactions"] >= 2
+    return verdicts, (exported.keys, exported.vers,
+                      exported.oldest_version)
+
+
+@pytest.mark.parametrize("seed", [11, 23, 47])
+@pytest.mark.parametrize("tiered", [False, True],
+                         ids=["flat", "tiered"])
+def test_kernel_vs_fallback_differential(monkeypatch, seed, tiered):
+    """The acceptance gate: verdicts AND exported state bit-identical,
+    kernels vs XLA, across >= 3 seeds x flat/tiered — and both match
+    the CPU reference."""
+    stream = _random_stream(seed, 50, batches=12, txns_per_batch=10)
+    kv, kstate = _run_engine(stream, monkeypatch, kernels=True,
+                             tiered=tiered)
+    xv, xstate = _run_engine(stream, monkeypatch, kernels=False,
+                             tiered=tiered)
+    assert kv == xv
+    assert kstate == xstate
+    cpu = CpuConflictSet()
+    want = [cpu.detect(txns, now, nov) for txns, now, nov in stream]
+    assert kv == want
+
+
+@pytest.mark.parametrize("seed", [5, 19, 31])
+def test_kernel_sharded_differential(monkeypatch, seed):
+    """Kernels inside the shard_map entry: per-shard detect_core runs the
+    fused kernels on each device's slice; verdicts match the XLA-sharded
+    run bit-for-bit AND the multi-resolver CPU oracle (the sharded
+    semantic is per-shard clipping + min-combine — the reference's
+    multi-resolver behavior, test_sharded_resolver's oracle)."""
+    from test_sharded_resolver import MultiResolverCpuOracle
+
+    from foundationdb_tpu.parallel.sharded_resolver import (
+        ShardedJaxConflictSet,
+    )
+
+    stream = _random_stream(seed, 60, batches=8, txns_per_batch=8)
+    splits = [k(20), k(40)]
+
+    def run(kernels):
+        monkeypatch.setenv("FDB_TPU_KERNELS", "1" if kernels else "0")
+        cs = ShardedJaxConflictSet(
+            splits, key_words=3, h_cap=1 << 9, bucket_mins=BUCKETS,
+        )
+        assert cs._use_kernels is kernels
+        return [cs.detect(txns, now, nov) for txns, now, nov in stream]
+
+    kv = run(True)
+    assert kv == run(False)
+    oracle = MultiResolverCpuOracle(splits)
+    assert kv == [oracle.detect(txns, now, nov) for txns, now, nov in stream]
+
+
+# ---------------------------------------------------------------------------
+# 3. device fault ON a kernelized batch (breaker + mirror replay)
+# ---------------------------------------------------------------------------
+
+
+def test_scripted_fault_on_kernelized_batch(monkeypatch):
+    """DeviceFaultInjector firing on kernelized batches (incl. the first
+    half-open probe): breaker degrades, the mirror replays those batches
+    bit-identically, recovery rehydrates, and a same-seed rerun produces
+    a byte-identical transition log."""
+    from foundationdb_tpu.conflict.api import ConflictSet
+    from foundationdb_tpu.conflict.device_faults import DeviceFaultInjector
+
+    monkeypatch.setenv("FDB_TPU_KERNELS", "1")
+    monkeypatch.setenv("FDB_TPU_HISTORY", "tiered")
+    monkeypatch.setenv("FDB_TPU_DELTA_CAP", "512")
+    monkeypatch.setenv("FDB_TPU_EVICT_EVERY", "4")
+    stream = _random_stream(41, 50, batches=18, txns_per_batch=10)
+
+    def run():
+        inj = DeviceFaultInjector()
+        for at in (4, 5, 6, 7):  # batch 4 = the compaction batch
+            inj.script("dispatch", at=at)
+        cs = ConflictSet(backend="jax", key_words=3, h_cap=1 << 10,
+                         bucket_mins=BUCKETS, fault_injector=inj)
+        assert cs._jax._use_kernels and cs._jax.tiered
+        verdicts = []
+        for txns, now, nov in stream:
+            b = cs.new_batch()
+            for t in txns:
+                b.add_transaction(t)
+            verdicts.append(b.detect_conflicts(now, nov))
+        return verdicts, cs.device_metrics()
+
+    verdicts, dm = run()
+    cpu = CpuConflictSet()
+    want = [cpu.detect(txns, now, nov) for txns, now, nov in stream]
+    assert verdicts == want, "faulty kernelized run diverged from CPU"
+    pairs = [(f, t) for _s, f, t, _r in dm["breaker"]["transitions"]]
+    assert pairs == [
+        ("ok", "degraded"),
+        ("degraded", "probing"),
+        ("probing", "degraded"),
+        ("degraded", "probing"),
+        ("probing", "ok"),
+    ], dm["breaker"]["transitions"]
+    assert dm["counters"]["rehydrates"] >= 1
+    assert dm["backend_state"] == "ok"
+    verdicts2, dm2 = run()
+    assert verdicts2 == verdicts
+    assert json.dumps(dm2["breaker"]) == json.dumps(dm["breaker"])
+
+
+# ---------------------------------------------------------------------------
+# 4. FDB_TPU_KERNELS / FDB_TPU_H_CAP flag plumbing
+# ---------------------------------------------------------------------------
+
+
+def test_kernels_flag_validated_at_construction(monkeypatch):
+    from foundationdb_tpu.parallel.sharded_resolver import (
+        ShardedJaxConflictSet,
+    )
+
+    monkeypatch.setenv("FDB_TPU_KERNELS", "banana")
+    with pytest.raises(ValueError, match="FDB_TPU_KERNELS"):
+        JaxConflictSet(key_words=3, h_cap=1 << 8)
+    # The sharded set validates through the SAME resolve helper — a
+    # typo'd flag raises rather than silently selecting the fallback.
+    with pytest.raises(ValueError, match="FDB_TPU_KERNELS"):
+        ShardedJaxConflictSet([k(20)], key_words=3, h_cap=1 << 8,
+                              bucket_mins=BUCKETS)
+
+
+def test_kernels_flag_auto_is_backend_gated():
+    from foundationdb_tpu.conflict.kernels import (
+        kernel_interpret,
+        kernels_requested,
+    )
+
+    assert kernels_requested("", "tpu") and not kernels_requested("", "cpu")
+    assert kernels_requested("auto", "tpu")
+    assert kernels_requested("1", "cpu") and kernels_requested("1", "tpu")
+    assert not kernels_requested("0", "tpu")
+    assert kernel_interpret("1", "cpu") and not kernel_interpret("1", "tpu")
+    assert kernel_interpret("interpret", "tpu")
+
+
+def test_h_cap_knob_must_fit_grow_guard(monkeypatch):
+    """Satellite (PERF_NOTES lever 2): the default h_cap drop rides the
+    FDB_TPU_H_CAP knob, and the engine's must-fit guard makes any drop
+    safe — a live boundary set outrunning the knob's cap triggers a
+    sync+grow, never truncation, with verdicts identical to the CPU
+    reference throughout.  Exercised under kernels so the grown shape
+    recompiles the kernelized program too."""
+    from foundationdb_tpu.conflict.api import ConflictSet
+    from foundationdb_tpu.flow.knobs import g_env
+
+    assert "FDB_TPU_H_CAP" in g_env.declared()
+    monkeypatch.setenv("FDB_TPU_H_CAP", "256")
+    monkeypatch.setenv("FDB_TPU_KERNELS", "1")
+    cs = ConflictSet(backend="jax", key_words=3, bucket_mins=BUCKETS)
+    assert cs._jax.h_cap == 256
+    cpu = CpuConflictSet()
+    v = 0
+    # Dense distinct writes: ~64 boundaries/batch, overrunning 256 rows.
+    for i in range(8):
+        txns = [T(read_snapshot=v,
+                  write_ranges=[(k(1000 * i + 3 * j), k(1000 * i + 3 * j + 1))
+                                for j in range(32)]),
+                T(read_snapshot=v,
+                  read_ranges=[(k(1000 * i), k(1000 * i + 120))])]
+        v += 5
+        b = cs.new_batch()
+        for t in txns:
+            b.add_transaction(t)
+        assert b.detect_conflicts(v, 0) == cpu.detect(txns, v, 0), i
+    assert cs._jax.h_cap > 256, "must-fit guard never grew"
+    assert cs._jax.metrics.snapshot()["counters"]["grows"] >= 1
+    assert cs._jax.boundary_count == cpu.boundary_count
+
+
+def test_h_cap_knob_rounds_to_kernel_tile(monkeypatch):
+    """An arbitrary knob value is rounded UP to a 256-row multiple so
+    the kernels' power-of-two tile never degrades toward a per-row
+    sequential grid (api.env_h_cap)."""
+    from foundationdb_tpu.conflict.api import ConflictSet, env_h_cap
+    from foundationdb_tpu.conflict.kernels import _tile
+
+    monkeypatch.setenv("FDB_TPU_H_CAP", "1000001")
+    assert env_h_cap() == 1000192  # next multiple of 256
+    assert _tile(env_h_cap()) == 256
+    cs = ConflictSet(backend="jax", key_words=3, bucket_mins=BUCKETS)
+    assert cs._jax.h_cap == 1000192
+    monkeypatch.setenv("FDB_TPU_H_CAP", "0")
+    assert env_h_cap() == 0

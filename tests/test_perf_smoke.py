@@ -106,7 +106,7 @@ TXN, RR, WR = 32, 128, 64
 # H-sized array from small pieces is H-sized work).
 
 
-def _tiered_jaxpr():
+def _tiered_jaxpr(kernels=False):
     lmax = max(1, math.ceil(math.log2(H_CAP)))
     u32 = jnp.uint32
     i32 = jnp.int32
@@ -134,11 +134,12 @@ def _tiered_jaxpr():
         jnp.asarray(0, i32),                 # do_major
     )
     fn = partial(detect_core_tiered, txn_cap=TXN, rr_cap=RR, wr_cap=WR,
-                 h_cap=H_CAP, d_cap=D_CAP)
+                 h_cap=H_CAP, d_cap=D_CAP, kernels=kernels,
+                 kernel_interpret=kernels)
     return jax.make_jaxpr(fn)(*args)
 
 
-def _flat_jaxpr():
+def _flat_jaxpr(kernels=False):
     u32 = jnp.uint32
     i32 = jnp.int32
     args = (
@@ -159,7 +160,8 @@ def _flat_jaxpr():
         jnp.asarray(1, i32),
         jnp.asarray(0, i32),
     )
-    fn = partial(detect_core, txn_cap=TXN, rr_cap=RR, wr_cap=WR, h_cap=H_CAP)
+    fn = partial(detect_core, txn_cap=TXN, rr_cap=RR, wr_cap=WR, h_cap=H_CAP,
+                 kernels=kernels, kernel_interpret=kernels)
     return jax.make_jaxpr(fn)(*args)
 
 
@@ -196,6 +198,58 @@ def test_tiered_steady_state_has_no_h_sized_work_outside_cond():
         e.max_dim for e in entries if not e.in_cond and e.prim == "sort"
     ]
     assert out_sorts and max(out_sorts) < H_CAP
+
+
+def test_kernel_mode_has_no_h_sized_sort_anywhere():
+    """ISSUE 14 acceptance gate: kernelized merge+evict runs as ONE pass.
+
+    With FDB_TPU_KERNELS on, the fused Pallas kernel replaces BOTH
+    sort-by-target passes — so the flat step has NO H-sized sort at all,
+    and the tiered step's compaction cond (which held the two full-H
+    sorts, pinned above) holds ZERO.  Remaining sorts are batch-domain
+    (point sort, new-boundary sort, kernel query sort) — all < H."""
+    flat = walk_jaxpr(_flat_jaxpr(kernels=True))
+    flat_h_sorts = [e for e in flat
+                    if e.prim == "sort" and e.max_dim >= H_CAP]
+    assert not flat_h_sorts, flat_h_sorts
+    tiered = walk_jaxpr(_tiered_jaxpr(kernels=True))
+    cond_h_sorts = [
+        e for e in tiered
+        if e.in_cond and e.prim == "sort" and e.max_dim >= H_CAP
+    ]
+    assert not cond_h_sorts, (
+        f"kernel mode left an H-sized sort in the compaction cond: "
+        f"{cond_h_sorts}"
+    )
+    any_h_sorts = [e for e in tiered
+                   if e.prim == "sort" and e.max_dim >= H_CAP]
+    assert not any_h_sorts, any_h_sorts
+    # The pallas kernels are actually IN the program (one fused-merge
+    # call per compaction site + the tier-combined searches).
+    assert sum(e.prim == "pallas_call" for e in tiered) >= 3
+    assert sum(e.prim == "pallas_call" for e in flat) >= 2
+
+
+def test_kernel_mode_tiered_steady_state_stays_delta_bounded():
+    """Same contract as the sort-path gate: with kernels on, NO H-sized
+    work primitive outside the compaction cond — including INSIDE kernel
+    bodies (walk_jaxpr descends pallas_call sub-jaxprs, and pl.when's
+    lowered cond deliberately does not count as the compaction cond)."""
+    entries = walk_jaxpr(_tiered_jaxpr(kernels=True))
+    outside = [
+        e for e in entries
+        if not e.in_cond and e.prim in WORK_PRIMS and e.max_dim >= H_CAP
+    ]
+    assert not outside, (
+        f"H-sized work escaped the compaction cond under kernels: {outside}"
+    )
+    # In-kernel work primitives are tile-bounded (the whole point of the
+    # VMEM-resident design): far below one tier's width.
+    in_kernel_work = [
+        e.max_dim for e in entries
+        if e.in_kernel and e.prim in WORK_PRIMS
+    ]
+    assert in_kernel_work and max(in_kernel_work) <= 1024
 
 
 # ---------------------------------------------------------------------------
@@ -263,6 +317,15 @@ def test_program_cost_table_covers_every_entry_point():
         if name in ("flat_step", "tiered_step", "compact_body"):
             assert blk["flops_per_batch"] and blk["flops_per_batch"] > 0
             assert blk["memory"]["temp"] > 0, name
+        # pallas_call-bearing entries (ISSUE 14) are never silently
+        # missing: the explicit kernel marker plus either a real
+        # cost-analysis block or the shape-math byte accounting.
+        if name.endswith("_kernels"):
+            assert blk.get("kernel") is True, (name, blk)
+            assert (blk.get("flops_per_batch")
+                    or blk["argument_bytes_total"] > 0), (name, blk)
+        else:
+            assert "kernel" not in blk, name
     # Deterministic blocks only: compile wall lives in the separate
     # include_wall view (the record_wall discipline).
     assert all("compile_wall_seconds" not in b for b in table.values())

@@ -21,8 +21,15 @@ Variants (the one shared table, bench.VARIANTS):
                   resolve loop (encode + dispatch + readback + mirror
                   apply) at each depth; pipeline1 is the synchronous
                   before-arm
+  kernels         FDB_TPU_KERNELS=1 (ISSUE 14) — Pallas fused
+                  merge/evict + phase-1 search kernels, flat history
+  tiered4_kernels kernels + the tiered history (the expected shipping
+                  combination: delta-bounded batches AND one-pass
+                  compactions)
 
 Run: python tools/perf_experiments.py   (on the TPU host)
+     python tools/perf_experiments.py --kernels   (CPU kernel A/B:
+     interpret-mode bit-identity + in-step nokernel attribution)
      python tools/perf_experiments.py --pipeline   (CPU overlap sweep,
      any host)
      python tools/perf_experiments.py --timeline  (short pipelined run
@@ -102,6 +109,15 @@ def main():
         # needed — JAX's async CPU dispatch provides the compute thread
         # the host phases overlap with, so the win prices on any host.
         print(json.dumps(bench.bench_pipeline_cpu(), indent=2))
+        return
+    if "--kernels" in sys.argv:
+        # Pallas kernel A/B on the CPU (ISSUE 14 satellite): interpret-
+        # mode Pallas vs the XLA fallback — cross-seed bit-identity
+        # evidence + the deterministic in-step nokernel FLOP attribution.
+        # Runs anywhere; the honest device walls come from the `kernels`
+        # / `tiered4_kernels` variants on a live tunnel.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        print(json.dumps(bench.bench_kernels_cpu(), indent=2))
         return
     if "--mirror" in sys.argv:
         # Host-side mirror A/B (ISSUE 9; bench.MIRROR_VARIANTS): no
