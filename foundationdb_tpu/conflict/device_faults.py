@@ -21,6 +21,17 @@ Two pieces:
     Every decision comes from ``DeterministicRandom``, so a run's fault
     schedule replays from its seed, and ``injected`` logs it.
 
+    Shard targeting (ISSUE 15): every plan/check accepts an optional
+    ``shard`` index, scoping the fault to ONE chip of a mesh-sharded
+    resolver (``parallel.sharded_resolver.ShardedJaxConflictSet`` checks
+    each choke point per shard).  Shard-scoped sites keep their own check
+    counters, their own BUGGIFY site names (``device_fault_<site>_s<k>``,
+    so per-shard fault coverage shows in the buggify report), and their
+    own persistence draws from a ``DeterministicRandom`` forked per shard
+    — one shard's draw never perturbs another's schedule, and replays
+    stay byte-identical.  ``shard=None`` keeps the exact pre-ISSUE-15
+    behavior (the single-device engine's un-scoped sites).
+
 ``DeviceCircuitBreaker``
     the degraded-mode state machine ``ConflictSet`` consults around every
     device attempt::
@@ -110,73 +121,96 @@ class DeviceFaultInjector:
         self.persistent_probability = persistent_probability
         self.max_persistent = max_persistent
         self.checks: Dict[str, int] = {s: 0 for s in SITES}
-        self.injected: List[list] = []  # [seq, site, kind]
+        self.injected: List[list] = []  # [seq, site_key, kind]
         self._seq = 0
         self._outage: Dict[str, Optional[int]] = {}  # site -> remaining (None = open-ended)
         self._scripted: Dict[str, Dict[int, int]] = {}  # site -> {at: persist}
+        # Per-shard persistence rngs, forked from self.rng at first touch
+        # of each shard (check order is deterministic in sim, so lazy
+        # forking replays byte-identically).
+        self._shard_rngs: Dict[int, object] = {}
+
+    @staticmethod
+    def _site_key(site: str, shard) -> str:
+        assert site in SITES, site
+        return site if shard is None else f"{site}#s{int(shard)}"
+
+    def _rng_for(self, shard):
+        if shard is None or self.rng is None:
+            return self.rng
+        r = self._shard_rngs.get(int(shard))
+        if r is None:
+            r = self._shard_rngs[int(shard)] = self.rng.split()
+        return r
 
     # -- plans --
-    def script(self, site: str, at: int, persist: int = 1) -> None:
-        """Fault the `at`-th check of `site` (1-based) and keep the site
-        down for `persist` consecutive checks."""
-        assert site in SITES, site
-        assert at > self.checks[site], "cannot script the past"
-        self._scripted.setdefault(site, {})[at] = persist
+    def script(self, site: str, at: int, persist: int = 1,
+               shard=None) -> None:
+        """Fault the `at`-th check of `site` (1-based; per-shard counter
+        when `shard` is given) and keep the site down for `persist`
+        consecutive checks."""
+        key = self._site_key(site, shard)
+        assert at > self.checks.get(key, 0), "cannot script the past"
+        self._scripted.setdefault(key, {})[at] = persist
 
-    def begin_outage(self, site: str) -> None:
-        """Hold `site` down until end_outage (a persistent device loss)."""
-        assert site in SITES, site
-        self._outage[site] = None
+    def begin_outage(self, site: str, shard=None) -> None:
+        """Hold `site` (on one shard when given) down until end_outage (a
+        persistent device/chip loss)."""
+        self._outage[self._site_key(site, shard)] = None
 
-    def end_outage(self, site: str) -> None:
-        self._outage.pop(site, None)
+    def end_outage(self, site: str, shard=None) -> None:
+        self._outage.pop(self._site_key(site, shard), None)
 
     # -- the choke-point hook --
-    def check(self, site: str) -> None:
-        """Called by the engine before mutating state at `site`; raises
+    def check(self, site: str, shard=None) -> None:
+        """Called by the engine before mutating state at `site` (scoped to
+        one shard of a mesh-sharded engine when `shard` is given); raises
         the site's fault type when the plan says so."""
+        key = self._site_key(site, shard)
         self._seq += 1
-        n = self.checks[site] = self.checks[site] + 1
+        n = self.checks[key] = self.checks.get(key, 0) + 1
         kind = None
         # Scripted entries are consumed at their check number even when an
         # outage/persistence window already covers it — overlapping plans
         # EXTEND the window (max-merge), they never silently vanish.
-        persist = self._scripted.get(site, {}).pop(n, None)
-        remaining = self._outage.get(site, 0)
-        if site in self._outage:
+        persist = self._scripted.get(key, {}).pop(n, None)
+        remaining = self._outage.get(key, 0)
+        if key in self._outage:
             if remaining is None:
                 kind = "outage"
             else:
-                self._outage[site] = remaining - 1
-                if self._outage[site] == 0:
-                    del self._outage[site]
+                self._outage[key] = remaining - 1
+                if self._outage[key] == 0:
+                    del self._outage[key]
                 kind = "persistent"
         if persist is not None:
             if persist > 1:
-                tail = self._outage.get(site, 0)
-                if site in self._outage and tail is None:
+                tail = self._outage.get(key, 0)
+                if key in self._outage and tail is None:
                     pass  # open-ended outage already covers everything
                 else:
-                    self._outage[site] = max(tail, persist - 1)
+                    self._outage[key] = max(tail, persist - 1)
             if kind is None:
                 kind = "persistent" if persist > 1 else "transient"
         if kind is None and self.fire_probability > 0:
             from ..flow.buggify import buggify_with_prob
 
+            suffix = "" if shard is None else f"_s{int(shard)}"
             if buggify_with_prob(
-                f"device_fault_{site}", self.fire_probability
+                f"device_fault_{site}{suffix}", self.fire_probability
             ):
                 kind = "transient"
+                rng = self._rng_for(shard)
                 if (
-                    self.rng is not None
-                    and self.rng.random01() < self.persistent_probability
+                    rng is not None
+                    and rng.random01() < self.persistent_probability
                 ):
-                    self._outage[site] = int(
-                        self.rng.random_int(1, self.max_persistent)
+                    self._outage[key] = int(
+                        rng.random_int(1, self.max_persistent)
                     )
                     kind = "persistent"
         if kind is not None:
-            self.injected.append([self._seq, site, kind])
+            self.injected.append([self._seq, key, kind])
             raise _SITE_FAULT[site](f"injected {kind} fault", site=site)
 
 
@@ -206,12 +240,21 @@ class DeviceCircuitBreaker:
         threshold: int = 3,
         backoff_batches: int = 2,
         backoff_cap: int = 64,
+        label: str = "",
+        counter_prefix: str = "",
     ):
         self.breaker_id = next(_BREAKER_SEQ)
         self.metrics = metrics
         self.threshold = threshold
         self.initial_backoff = backoff_batches
         self.backoff_cap = backoff_cap
+        # Shard-granular fault domains (ISSUE 15): `label` names this
+        # breaker's domain (e.g. "shard3") in traces/spans/flight-recorder
+        # details, `counter_prefix` namespaces its counters/gauge inside a
+        # shared registry (e.g. "shard3_breaker_opens").  Both default
+        # empty so single-device snapshots stay byte-identical.
+        self.label = label
+        self._prefix = counter_prefix
         self.state = STATE_OK
         self.consecutive_failures = 0
         self.backoff = backoff_batches
@@ -219,7 +262,9 @@ class DeviceCircuitBreaker:
         self.seq = 0  # device-eligible batches observed
         self.transitions: List[list] = []  # [seq, from, to, reason]
         if metrics is not None:
-            metrics.gauge("backend_state").set(_STATE_GAUGE[self.state])
+            metrics.gauge(f"{counter_prefix}backend_state").set(
+                _STATE_GAUGE[self.state]
+            )
 
     # -- queries --
     def allows_device(self) -> bool:
@@ -285,7 +330,7 @@ class DeviceCircuitBreaker:
     # -- plumbing --
     def _count(self, name: str) -> None:
         if self.metrics is not None:
-            self.metrics.counter(name).add()
+            self.metrics.counter(f"{self._prefix}{name}").add()
 
     def _transition(self, to: str, reason: str) -> None:
         from ..flow.trace import TraceEvent
@@ -293,20 +338,25 @@ class DeviceCircuitBreaker:
         frm, self.state = self.state, to
         self.transitions.append([self.seq, frm, to, reason])
         if self.metrics is not None:
-            self.metrics.gauge("backend_state").set(_STATE_GAUGE[to])
+            self.metrics.gauge(f"{self._prefix}backend_state").set(
+                _STATE_GAUGE[to]
+            )
         # Marker span (ISSUE 12): breaker/probe walks on the same
         # timeline as the batch spans they degrade.
         from ..flow.spans import instant
 
-        instant(
-            f"breaker.{to}", role="DeviceBreaker",
-            attrs={"from": frm, "reason": reason, "seq": self.seq},
-        )
-        TraceEvent("DeviceBackendStateChange", severity=20).detail(
+        attrs = {"from": frm, "reason": reason, "seq": self.seq}
+        if self.label:
+            attrs["domain"] = self.label
+        instant(f"breaker.{to}", role="DeviceBreaker", attrs=attrs)
+        ev = TraceEvent("DeviceBackendStateChange", severity=20).detail(
             "from", frm
         ).detail("to", to).detail("reason", reason).detail(
             "seq", self.seq
-        ).log()
+        )
+        if self.label:
+            ev.detail("domain", self.label)
+        ev.log()
         if frm == STATE_OK and to == STATE_DEGRADED:
             # Breaker OPEN (threshold faults or confirmed divergence —
             # not a failed probe re-opening an already-degraded circuit):
@@ -316,9 +366,14 @@ class DeviceCircuitBreaker:
             # contains the triggering transition itself.
             from ..flow.flight_recorder import maybe_trigger
 
+            detail = {"reason": reason, "seq": self.seq}
+            if self.label:
+                # Shard-granular domain (ISSUE 15): a shard-breaker open
+                # names the sick shard in the black-box artifact.
+                detail["domain"] = self.label
             maybe_trigger(
                 "breaker_open",
-                detail={"reason": reason, "seq": self.seq},
+                detail=detail,
                 # Thunk: copied only if the cooldown admits the capture.
                 transitions=lambda: [list(t) for t in self.transitions],
                 # Two breakers opening at once are two incidents, not a

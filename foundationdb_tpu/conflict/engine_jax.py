@@ -752,6 +752,7 @@ def detect_core(
     h_cap: int,
     kernels: bool = False,
     kernel_interpret: bool = False,
+    undecided_combine=None,
 ):
     from ..flow.knobs import g_env
 
@@ -799,6 +800,14 @@ def detect_core(
         r_begin, r_end, r_txn, w_begin, w_end, w_txn, t_valid, status0,
         txn_cap=TXN, rr_cap=RR, wr_cap=WR, ablate=_ablate,
     )
+    if undecided_combine is not None:
+        # Cross-shard convergence gate (ISSUE 15): under shard_map the
+        # caller combines every ACTIVE shard's undecided count (psum), so
+        # the divergence revert below is all-or-nothing across the mesh —
+        # the host then re-decides the whole batch on the per-shard
+        # mirrors consistently.  None (single device) leaves the traced
+        # program byte-identical to the pre-hook compile.
+        undecided_left = undecided_combine(undecided_left)
 
     # ---- phase 5: rewrite the step function (ref addConflictRanges) ----
     if "nomerge" in _ablate:
@@ -1015,6 +1024,7 @@ def detect_core_tiered(
     d_cap: int,
     kernels: bool = False,
     kernel_interpret: bool = False,
+    undecided_combine=None,
 ):
     """Two-tier variant of detect_core; decision-identical by construction
     (gated by the differential suites under FDB_TPU_HISTORY=tiered).
@@ -1072,6 +1082,12 @@ def detect_core_tiered(
         r_begin, r_end, r_txn, w_begin, w_end, w_txn, t_valid, status0,
         txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap,
     )
+    if undecided_combine is not None:
+        # Cross-shard convergence gate (ISSUE 15; see detect_core): the
+        # revert below — which runs BEFORE the compaction cond, so a
+        # compaction still rewrites the reverted delta physically —
+        # becomes all-or-nothing across the mesh's active shards.
+        undecided_left = undecided_combine(undecided_left)
 
     # ---- phase 5 into the DELTA tier (delta-sized sorts, or ONE
     # delta-sized streaming pass under FDB_TPU_KERNELS) + phase 6 on the
@@ -2463,22 +2479,9 @@ class JaxConflictSet:
 
     # -- hybrid state exchange with the CPU mirror --
     def _chunk_encoding(self, ch):
-        """(encoded keys [n, kw1] uint32, abs versions int64) for one
-        immutable mirror chunk, cached ON the chunk (computed at most
-        once per chunk lifetime — chunks never mutate).  Returns
-        (entry, keys_encoded_now)."""
-        cache = ch.enc
-        if cache is None:
-            cache = ch.enc = {}
-        ent = cache.get(self.key_words)
-        if ent is not None:
-            return ent, 0
-        ent = (
-            keylib.encode_keys(ch.keys, self.key_words),
-            np.asarray(ch.vers, dtype=np.int64),
-        )
-        cache[self.key_words] = ent
-        return ent, len(ch.keys)
+        """See module-level chunk_encoding (shared with the sharded
+        resolver's per-shard mirrors, ISSUE 15)."""
+        return chunk_encoding(ch, self.key_words)
 
     def note_synced(self, snap, fresh=None) -> None:
         """Record that this device state now equals MirrorSnapshot `snap`
@@ -2591,8 +2594,6 @@ class JaxConflictSet:
         base's value at their key (dropped when an equal-key base row
         already provides it) — the host twin of _major_compact's rules,
         minus eviction (export preserves current state)."""
-        from bisect import bisect_left
-
         from .engine_cpu import FLOOR_VERSION
 
         n = int(self._hcount)
@@ -2615,23 +2616,61 @@ class JaxConflictSet:
         dkeys = [
             keylib.decode_key(dkeys_np[j], self.key_words) for j in range(nd)
         ]
-        out_k: list = []
-        out_v: list = []
-        for j in range(nd):
-            lo = dkeys[j]
-            hi = dkeys[j + 1] if j + 1 < nd else None
-            vrel = int(dvers_np[j])
-            if vrel != FLOOR_REL:
-                # Covered interval: the delta value dominates everything
-                # beneath (it is a write version issued after base froze).
-                out_k.append(lo)
-                out_v.append(vrel + self._base)
-                continue
-            i0 = bisect_left(bkeys, lo)
-            if not (i0 < n and bkeys[i0] == lo):
-                out_k.append(lo)
-                out_v.append(bvers[max(0, i0 - 1)])
-            i1 = n if hi is None else bisect_left(bkeys, hi)
-            out_k.extend(bkeys[i0:i1])
-            out_v.extend(bvers[i0:i1])
-        return out_k, out_v
+        return fold_delta_over_base(
+            bkeys, bvers, dkeys, dvers_np, self._base
+        )
+
+
+def chunk_encoding(ch, key_words: int):
+    """(encoded keys [n, kw1] uint32, abs versions int64) for one
+    immutable mirror chunk, cached ON the chunk (computed at most once
+    per chunk lifetime — chunks never mutate; the cache is the currency
+    that makes probe rehydration O(chunks changed since the last sync)).
+    Returns (entry, keys_encoded_now).  Shared by JaxConflictSet and the
+    sharded resolver's per-shard mirror slices (ISSUE 15)."""
+    cache = ch.enc
+    if cache is None:
+        cache = ch.enc = {}
+    ent = cache.get(key_words)
+    if ent is not None:
+        return ent, 0
+    ent = (
+        keylib.encode_keys(ch.keys, key_words),
+        np.asarray(ch.vers, dtype=np.int64),
+    )
+    cache[key_words] = ent
+    return ent, len(ch.keys)
+
+
+def fold_delta_over_base(bkeys, bvers, dkeys, dvers_rel, base):
+    """Fold a decoded delta tier over a decoded base tier into the merged
+    logical step function (keys, abs-versions) — the host twin of
+    _major_compact's rules, minus eviction.  `bvers` are ABSOLUTE
+    versions, `dvers_rel` relative (FLOOR_REL = uncovered).  Shared by
+    JaxConflictSet._merged_host_state and the sharded resolver's
+    per-shard consistency check (ISSUE 15), so the two folds can never
+    drift."""
+    from bisect import bisect_left
+
+    n = len(bkeys)
+    nd = len(dkeys)
+    out_k: list = []
+    out_v: list = []
+    for j in range(nd):
+        lo = dkeys[j]
+        hi = dkeys[j + 1] if j + 1 < nd else None
+        vrel = int(dvers_rel[j])
+        if vrel != FLOOR_REL:
+            # Covered interval: the delta value dominates everything
+            # beneath (it is a write version issued after base froze).
+            out_k.append(lo)
+            out_v.append(vrel + base)
+            continue
+        i0 = bisect_left(bkeys, lo)
+        if not (i0 < n and bkeys[i0] == lo):
+            out_k.append(lo)
+            out_v.append(bvers[max(0, i0 - 1)])
+        i1 = n if hi is None else bisect_left(bkeys, hi)
+        out_k.extend(bkeys[i0:i1])
+        out_v.extend(bvers[i0:i1])
+    return out_k, out_v

@@ -175,7 +175,7 @@ class ResolverSignalsReply:
 
     queue_depth: int = 0
     resolve_p99: float = 0.0
-    backend_state: str = "ok"  # ok | degraded | probing
+    backend_state: str = "ok"  # ok | degraded | probing (worst shard)
     cpu_mirror_tps: float = 0.0
     degraded_batches: int = 0
     # Total confirmed mirror/device divergences this resolver's
@@ -183,6 +183,13 @@ class ResolverSignalsReply:
     # status/qos: each divergence already opened the breaker, so
     # backend_state carries the admission-control consequence.
     mirror_divergence: int = 0
+    # Shard-granular fault domains (ISSUE 15): a mesh-sharded resolver
+    # reports how many of its shards are degraded/probing, so the
+    # ratekeeper can contract the lane PROPORTIONALLY (one sick chip out
+    # of 8 is ~1/8 of capacity, not a global degraded clamp).  0/0 for
+    # single-device resolvers — the pre-ISSUE-15 spring is unchanged.
+    shards_total: int = 0
+    shards_degraded: int = 0
 
 
 @dataclass

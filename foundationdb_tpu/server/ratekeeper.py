@@ -61,6 +61,12 @@ class RateInfo:
     backend_state: str = "ok"  # ok | degraded | probing (worst resolver)
     grv_queue_depth: int = 0  # worst proxy-reported GRV admission queue
     mirror_divergence: int = 0  # total confirmed mirror divergences
+    # Shard-granular fault domains (ISSUE 15): the BINDING degraded
+    # resolver's (degraded, total) shard counts; 0/0 when nothing is
+    # degraded OR the binding degraded resolver is single-device (the
+    # whole-lane clamp then applies).
+    shards_degraded: int = 0
+    shards_total: int = 0
     limiting: str = "none"  # which signal set the rate (for status/qos)
 
 
@@ -87,6 +93,12 @@ class Signals:
     # (ISSUE 9).  Informational — each one already opened that
     # resolver's breaker, so backend_state carries the spring.
     mirror_divergence: int = 0
+    # Shard-granular degradation (ISSUE 15): the BINDING degraded
+    # resolver's shard counts (_binding_shard_fraction) — the degraded
+    # cap then contracts only the sick fraction of the keyspace instead
+    # of the whole lane; 0/0 = whole-lane clamp.
+    shards_degraded: int = 0
+    shards_total: int = 0
     # RPC mode only: a whole commit-critical role class (every tlog, or
     # every storage) is unreachable — the cluster is mid-recovery.
     unreachable: bool = False
@@ -377,6 +389,11 @@ class Ratekeeper:
                 )
         sig.backend_state = worst_state
         sig.cpu_mirror_tps = mirror_tps
+        # Shard-granular detail (ISSUE 15): the BINDING degraded
+        # resolver's sick fraction (see _binding_shard_fraction).
+        sig.shards_degraded, sig.shards_total = (
+            self._binding_shard_fraction(snaps)
+        )
         # Commit latency: the incremental latency_chain reassembly when the
         # in-memory collector is live; else the proxies' passive samples
         # (direct role objects, or the reports riding their rate fetches).
@@ -461,6 +478,28 @@ class Ratekeeper:
         return tps, (limiting if factor < 1.0 else "none")
 
     @staticmethod
+    def _binding_shard_fraction(snaps) -> tuple:
+        """(shards_degraded, shards_total) of the BINDING degraded
+        resolver — the one whose sick fraction is largest — considering
+        only resolvers that are actually degraded/probing: a HEALTHY
+        mesh-sharded resolver's 0/N detail must never dilute another
+        resolver's clamp.  A degraded resolver WITHOUT shard detail
+        (single-device) is the whole lane — returns (0, 0), which
+        _degraded_factor treats as the plain whole-lane clamp, the most
+        conservative, so it overrides any proportional detail."""
+        best = None  # (deg, tot) of the worst sick fraction seen
+        for s in snaps:
+            if s.backend_state == "ok":
+                continue
+            tot = getattr(s, "shards_total", 0)
+            deg = getattr(s, "shards_degraded", 0)
+            if tot <= 0:
+                return (0, 0)  # whole lane: nothing binds harder
+            if best is None or deg * best[1] > best[0] * tot:
+                best = (deg, tot)
+        return best if best is not None else (0, 0)
+
+    @staticmethod
     def _degraded_factor(sig: Signals, target_frac: float) -> float:
         """Not a spring but a cap: while the device circuit is open (or
         probing) and verdicts fall back to the CPU mirror, the lane's rate
@@ -470,7 +509,14 @@ class Ratekeeper:
         wall-clock derived and would break same-seed replay in sim) the
         cap additionally clamps to 80% of the measured CPU-mirror
         throughput so admission tracks what the mirror actually
-        sustains."""
+        sustains.
+
+        Shard-granular fault domains (ISSUE 15): when the degraded
+        resolver is mesh-sharded, only shards_degraded of shards_total
+        key ranges fell back to their mirrors — the healthy shards keep
+        full device throughput — so the cap contracts PROPORTIONALLY:
+        ((total - degraded) + degraded * frac) / total.  A single-device
+        resolver (shards_total == 0) keeps the whole-lane clamp."""
         if sig.backend_state == "ok":
             return 1.0
         srv = g_knobs.server
@@ -479,6 +525,11 @@ class Ratekeeper:
             frac = min(
                 frac, 0.8 * sig.cpu_mirror_tps / srv.ratekeeper_max_tps
             )
+        if sig.shards_total > 0:
+            deg = min(sig.shards_degraded, sig.shards_total)
+            frac = (
+                (sig.shards_total - deg) + deg * frac
+            ) / sig.shards_total
         return max(0.0, frac * target_frac)
 
     async def _update_loop(self):
@@ -552,6 +603,8 @@ class Ratekeeper:
                 backend_state=sig.backend_state,
                 grv_queue_depth=sig.grv_queue_depth,
                 mirror_divergence=sig.mirror_divergence,
+                shards_degraded=sig.shards_degraded,
+                shards_total=sig.shards_total,
                 limiting=limiting,
             )
 

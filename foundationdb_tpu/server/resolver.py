@@ -220,6 +220,16 @@ class Resolver:
             spawn_sampler(
                 process, f"JaxConflict.{process.name}", dev.metrics
             )
+        elif getattr(self.conflicts, "metrics", None) is not None:
+            # First-class raw conflict set with its own registry (the
+            # mesh-sharded set, ISSUE 15): its per-shard breaker walk
+            # rides the same time-series rings the flight recorder
+            # freezes on a shard-breaker open.
+            spawn_sampler(
+                process,
+                f"{self.conflicts.metrics.name}.{process.name}",
+                self.conflicts.metrics,
+            )
         # Mirror consistency-check actor (ISSUE 9): periodically diff a
         # live mirror snapshot against the device's exported state;
         # confirmed divergence opens the breaker (ConflictSet.mirror_check
@@ -259,12 +269,10 @@ class Resolver:
         """The admission-control probe (served by the `signals` stream and
         read directly by in-process ratekeepers).  All O(1)/O(window) —
         never O(history rows)."""
-        state, mirror_tps = "ok", 0.0
         bs = getattr(self.conflicts, "backend_signal", None)
-        if callable(bs):
-            sig = bs()
-            state = sig.get("backend_state", "ok")
-            mirror_tps = sig.get("cpu_mirror_tps", 0.0)
+        sig = bs() if callable(bs) else {}
+        state = sig.get("backend_state", "ok")
+        mirror_tps = sig.get("cpu_mirror_tps", 0.0)
         if self._cpu_takeover is not None:
             state = "degraded"  # permanent host takeover (raw device set)
         return ResolverSignalsReply(
@@ -275,9 +283,11 @@ class Resolver:
             degraded_batches=int(
                 self.metrics.counter("degraded_batches").value
             ),
-            mirror_divergence=(
-                sig.get("mirror_divergence", 0) if callable(bs) else 0
-            ),
+            mirror_divergence=sig.get("mirror_divergence", 0),
+            # Shard-granular detail (ISSUE 15): 0/0 unless the conflict
+            # set is mesh-sharded with per-shard breakers.
+            shards_total=sig.get("shards_total", 0),
+            shards_degraded=sig.get("shards_degraded", 0),
         )
 
     async def _serve_signals(self):

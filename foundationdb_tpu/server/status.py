@@ -274,6 +274,14 @@ def cluster_status(cluster) -> dict:
             qos["conflict_mirror_divergence"] = getattr(
                 info, "mirror_divergence", 0
             )
+            # Shard-granular fault domains (ISSUE 15): the BINDING
+            # degraded resolver's (degraded, total) shard counts.  Keys
+            # present only when that resolver is mesh-sharded, so
+            # single-device clusters' status docs are unchanged (and a
+            # whole-lane degrade shows in conflict_backend_state alone).
+            if getattr(info, "shards_total", 0) > 0:
+                qos["conflict_shards_total"] = info.shards_total
+                qos["conflict_shards_degraded"] = info.shards_degraded
         # Conflict witnesses (ISSUE 12 satellite; ROADMAP item 4's
         # observability seed): total aborted txns + the merged top-K
         # contended key ranges across resolvers — the qos view of WHERE

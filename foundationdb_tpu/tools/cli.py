@@ -883,30 +883,57 @@ def soak_main(argv=None) -> int:
     ap.add_argument("--theta", type=float,
                     default=float(g_env.get("FDB_TPU_SOAK_THETA")),
                     help="Zipf skew exponent (0 = uniform)")
-    ap.add_argument("--backend",
-                    default=g_env.get("FDB_TPU_SOAK_BACKEND"),
-                    choices=("cpu", "jax", "hybrid"))
+    ap.add_argument("--backend", default=None,
+                    choices=("cpu", "jax", "hybrid", "sharded"),
+                    help="conflict backend (default: FDB_TPU_SOAK_BACKEND)")
     ap.add_argument("--cluster", choices=("sim", "dynamic"), default="sim",
                     help="dynamic adds recovery-capable process kills")
     ap.add_argument("--mode", choices=("open", "closed"), default="open")
     ap.add_argument("--no-faults", action="store_true",
                     help="pure load run (baseline arm)")
+    ap.add_argument("--shard-outage", action="store_true",
+                    help="ISSUE 15: the shard-outage phase family on the "
+                    "mesh-sharded backend — one shard's chip dies for the "
+                    "middle phase while the survivors hold the floor")
     ap.add_argument("--format", choices=("text", "json"), default="text")
     ap.add_argument("--out", default="",
                     help="also write the JSON artifact to this path")
     args = ap.parse_args(argv)
 
-    config = default_config(
-        minutes=args.minutes,
-        peak_tps=args.tps,
-        seed=args.seed,
-        cluster=args.cluster,
-        backend=args.backend,
-        mode=args.mode,
-        keys=args.keys,
-        zipf_theta=args.theta,
-        faults=not args.no_faults,
-    )
+    if args.shard_outage:
+        # The shard-outage family fixes backend/cluster/faults by
+        # construction — reject flags it would silently contradict.
+        if args.cluster != "sim":
+            ap.error("--shard-outage runs on the sim cluster only "
+                     "(the sharded backend is SimCluster's conflict_set "
+                     "seam)")
+        if args.no_faults:
+            ap.error("--shard-outage IS the shard_kill fault; "
+                     "--no-faults contradicts it")
+        # None = not given explicitly (env/default backends are
+        # overridden by this purpose-built mode, never contradicted).
+        if args.backend not in (None, "sharded"):
+            ap.error("--shard-outage implies --backend sharded")
+        from ..workloads.soak import shard_outage_config
+
+        config = shard_outage_config(
+            minutes=args.minutes, peak_tps=args.tps, seed=args.seed
+        )
+        config.keys = args.keys
+        config.zipf_theta = args.theta
+        config.mode = args.mode
+    else:
+        config = default_config(
+            minutes=args.minutes,
+            peak_tps=args.tps,
+            seed=args.seed,
+            cluster=args.cluster,
+            backend=args.backend or g_env.get("FDB_TPU_SOAK_BACKEND"),
+            mode=args.mode,
+            keys=args.keys,
+            zipf_theta=args.theta,
+            faults=not args.no_faults,
+        )
     report = run_soak(config)
     artifact = soak_artifact(report)
     blob = json.dumps(artifact, indent=2, sort_keys=True)

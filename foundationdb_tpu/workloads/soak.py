@@ -57,12 +57,17 @@ class FaultEvent:
     clusters only), "clog" (ONE-directional network clog — the grey
     failure where requests land but replies stall), "device_outage"
     (persistent dispatch outage on one resolver's device engine via
-    DeviceFaultInjector.begin_outage/end_outage)."""
+    DeviceFaultInjector.begin_outage/end_outage), "shard_kill" (ISSUE 15:
+    the device outage scoped to ONE shard of a mesh-sharded resolver —
+    only that shard's breaker opens and serves degraded off its mirror
+    while the surviving shards keep the goodput floor on device;
+    backend="sharded")."""
 
     at: float = 0.0  # sim seconds from soak start
     kind: str = "clog"
     duration: float = 1.5  # clog/outage hold; kills recover via recruitment
     target: str = ""  # kill: role name (default storage0)
+    shard: int = 0  # shard_kill: which shard's chip dies
 
 
 @dataclass
@@ -104,6 +109,10 @@ class SoakConfig:
     # CPU mirror; widen so the device path actually serves the soak.
     device_key_words: Optional[int] = None
     device_key_bytes: Optional[int] = None
+    # backend="sharded" (ISSUE 15): shard count for the mesh-sharded
+    # resolver 0 conflict set (sim clusters only; capped to the visible
+    # device count).
+    sharded_shards: int = 4
 
 
 def default_phases(peak_tps: float, total_seconds: float) -> List[SoakPhase]:
@@ -134,6 +143,41 @@ def default_faults(
     out.append(FaultEvent(at=total_seconds * 0.75, kind="device_outage",
                           duration=min(2.0, total_seconds * 0.06)))
     return out
+
+
+def shard_outage_phases(peak_tps: float, total_seconds: float) -> List[SoakPhase]:
+    """The shard-outage phase family (ISSUE 15): steady load before,
+    during, and after a one-shard chip loss — the during-phase goodput
+    floor is the surviving-shards claim (one sick chip out of S costs
+    ~1/S of capacity, NOT the lane)."""
+    return [
+        SoakPhase("pre_outage", total_seconds * 0.3, peak_tps),
+        SoakPhase("shard_outage", total_seconds * 0.4, peak_tps),
+        SoakPhase("recovery", total_seconds * 0.3, peak_tps),
+    ]
+
+
+def shard_outage_config(
+    minutes: float = 0.5,
+    peak_tps: float = 80.0,
+    seed: int = 1,
+    shard: int = 1,
+    n_shards: int = 4,
+) -> SoakConfig:
+    """A soak whose only fault is a shard_kill covering the whole
+    "shard_outage" phase (sim cluster, backend="sharded")."""
+    total = minutes * 60.0
+    cfg = default_config(
+        minutes=minutes, peak_tps=peak_tps, seed=seed,
+        cluster="sim", backend="sharded", faults=False,
+    )
+    cfg.phases = shard_outage_phases(peak_tps, total)
+    cfg.faults = [
+        FaultEvent(at=total * 0.3, kind="shard_kill",
+                   duration=total * 0.4, shard=shard)
+    ]
+    cfg.sharded_shards = n_shards
+    return cfg
 
 
 def default_config(
@@ -420,6 +464,8 @@ class SoakRun:
                 await self._fault_clog(ev)
             elif ev.kind == "device_outage":
                 await self._fault_device_outage(ev)
+            elif ev.kind == "shard_kill":
+                await self._fault_shard_kill(ev)
             else:
                 raise ValueError(f"unknown fault kind {ev.kind!r}")
 
@@ -522,6 +568,47 @@ class SoakRun:
         )
         await self._capture_fault_window(
             0.0, "device_outage", {"resolver": r.process.name}
+        )
+
+    def _sharded_sets(self):
+        """(resolver, mesh-sharded conflict set) pairs — resolvers whose
+        raw conflict set has per-shard fault domains (ISSUE 15)."""
+        from ..server.status import role_objects
+
+        out = []
+        for r in role_objects(self.cluster, "resolver"):
+            cs = getattr(r, "conflicts", None)
+            if cs is not None and getattr(cs, "n_shards", 0) > 1:
+                out.append((r, cs))
+        return out
+
+    async def _fault_shard_kill(self, ev: FaultEvent):
+        """Chip loss scoped to ONE shard of a mesh-sharded resolver
+        (ISSUE 15): a persistent dispatch outage on shard ev.shard only —
+        its breaker opens and its slice serves degraded off its mirror,
+        the other shards keep serving on device, and when the outage
+        lifts the half-open probe rehydrates only that shard."""
+        from ..conflict.device_faults import DeviceFaultInjector
+
+        sets = self._sharded_sets()
+        t = self.loop.now()
+        if not sets:
+            self.fault_timeline.append([t, "shard_kill", "no-shards", t])
+            return
+        r, cs = sets[0]
+        shard = ev.shard % cs.n_shards
+        inj = cs.fault_injector
+        if inj is None:
+            inj = DeviceFaultInjector(rng=self.rng.split())
+            cs.install_fault_injector(inj)
+        inj.begin_outage("dispatch", shard=shard)
+        await self.loop.delay(ev.duration)
+        inj.end_outage("dispatch", shard=shard)
+        detail = f"{r.process.name}:shard{shard}"
+        self.fault_timeline.append([t, "shard_kill", detail, self.loop.now()])
+        await self._capture_fault_window(
+            0.0, "shard_kill",
+            {"resolver": r.process.name, "shard": shard},
         )
 
     async def _admission_monitor(self):
@@ -668,6 +755,22 @@ class SoakRun:
         _rec = global_flight_recorder()
         breakers = {}
         pipeline = {}
+        shards = {}
+        # Shard-granular fault domains (ISSUE 15): per-shard breaker
+        # transition logs (the replay gate covers them — byte-identical
+        # across same-seed runs) plus the shard state summary.
+        for r, cs in self._sharded_sets():
+            for s in range(cs.n_shards):
+                breakers[f"{r.process.name}.shard{s}"] = [
+                    list(tr) for tr in cs._breakers[s].transitions
+                ]
+            shards[r.process.name] = {
+                "total": cs.n_shards,
+                "states": [b.state for b in cs._breakers],
+                "degraded_shard_serves": int(
+                    cs.metrics.counter("degraded_shard_serves").value
+                ),
+            }
         for r, cs in self._resolver_conflict_sets():
             if cs._breaker is not None:
                 breakers[r.process.name] = [
@@ -709,7 +812,7 @@ class SoakRun:
                 ],
                 "faults": [
                     {"at": f.at, "kind": f.kind, "duration": f.duration,
-                     "target": f.target}
+                     "target": f.target, "shard": f.shard}
                     for f in cfg.faults
                 ],
             },
@@ -733,6 +836,7 @@ class SoakRun:
                 ),
             },
             "breakers": breakers,
+            "shards": shards,
             "pipeline": pipeline,
             # Span layer (ISSUE 12): per-role ring inventory, the recent
             # window, per-stage latency percentiles off the spans, and
@@ -860,6 +964,11 @@ def _build_cluster(config: SoakConfig):
     """A rated cluster + primed client Database handles."""
     n_clients = max(1, config.clients)
     if config.cluster == "dynamic":
+        assert config.backend != "sharded", (
+            "backend='sharded' is a sim-cluster seam (SimCluster's "
+            "conflict_set); DynamicCluster recruits resolvers by backend "
+            "name only"
+        )
         from ..server.dynamic_cluster import DynamicCluster
 
         cluster = DynamicCluster(
@@ -877,11 +986,48 @@ def _build_cluster(config: SoakConfig):
     from ..server import SimCluster
     from ..server.ratekeeper import Ratekeeper
 
+    conflict_set = None
+    backend = config.backend
+    if backend == "sharded":
+        # Mesh-sharded resolver 0 (ISSUE 15): a ShardedJaxConflictSet over
+        # the visible devices (virtual CPU mesh in tests), split evenly
+        # across the soak key space so every shard sees load.  The
+        # resolver swaps it in via SimCluster's conflict_set seam.
+        import os
+
+        flags = os.environ.get("XLA_FLAGS", "")
+        if "xla_force_host_platform_device_count" not in flags:
+            # Effective only before the first backend init (tests set it
+            # in conftest; the CLI lands here first) — if the backend is
+            # already up with one device, the shard-count assert below
+            # explains the failure.
+            os.environ["XLA_FLAGS"] = (
+                flags + " --xla_force_host_platform_device_count=8"
+            ).strip()
+        import jax
+
+        from ..parallel.sharded_resolver import ShardedJaxConflictSet
+
+        n = max(2, min(config.sharded_shards, len(jax.devices())))
+        split = [
+            b"soak/%06d" % (config.keys * s // n) for s in range(1, n)
+        ]
+        conflict_set = ShardedJaxConflictSet(
+            split,
+            key_words=8,  # 16-byte effective width covers soak/ and the
+            # sim cluster's \xff/SC/ self-conflict keys; anything longer
+            # rides the exact-semantics mirror pin by design
+            h_cap=1 << 12,
+            devices=jax.devices()[:n],
+            bucket_mins=(64, 128, 128),
+        )
+        backend = "cpu"  # the other resolvers (if any) stay host-only
     cluster = SimCluster(
         seed=config.seed,
-        conflict_backend=config.backend,
+        conflict_backend=backend,
         n_resolvers=config.n_resolvers,
         buggify=config.buggify,
+        conflict_set=conflict_set,
     )
     rk = Ratekeeper(
         cluster.master_proc,

@@ -252,6 +252,69 @@ def test_kernel_mode_tiered_steady_state_stays_delta_bounded():
     assert in_kernel_work and max(in_kernel_work) <= 1024
 
 
+def test_sharded_step_per_shard_work_bounded_at_production_shape():
+    """ISSUE 15 structural pin: in the mesh-sharded step — traced at a
+    PRODUCTION per-shard width with the kernels flag on — every work
+    primitive stays bounded by ONE shard's slice.  A primitive sized
+    S*h_cap would mean something is touching globally-sized data inside
+    the shard_map body (the per-shard fault domain would then not bound
+    per-shard work)."""
+    from foundationdb_tpu.parallel.sharded_resolver import (
+        AXIS,
+        _make_sharded_step,
+    )
+    from jax.sharding import Mesh
+    import numpy as np
+
+    S = 2
+    SHARD_H = 1 << 19  # ~ BASE_H_CAP / 8: the production per-shard slice
+    mesh = Mesh(np.array(jax.devices()[:S]), (AXIS,))
+    step = _make_sharded_step(
+        mesh, TXN, RR, WR, SHARD_H, kernels=True, kernel_interpret=True
+    )
+    sds = jax.ShapeDtypeStruct
+    u32, i32 = jnp.uint32, jnp.int32
+    args = (
+        sds((S, KW1), u32),            # lo
+        sds((S, KW1), u32),            # hi
+        sds((S,), jnp.bool_),          # active
+        sds((S, KW1, SHARD_H), u32),   # hkeys
+        sds((S, SHARD_H), i32),        # hvers
+        sds((S,), i32),                # hcount
+        sds((S,), i32),                # oldest
+        sds((KW1, RR), u32),           # r_begin
+        sds((KW1, RR), u32),           # r_end
+        sds((RR,), i32),               # r_txn
+        sds((RR,), i32),               # r_snap
+        sds((KW1, WR), u32),           # w_begin
+        sds((KW1, WR), u32),           # w_end
+        sds((WR,), i32),               # w_txn
+        sds((TXN,), i32),              # t_snap
+        sds((TXN,), jnp.bool_),        # t_valid
+        sds((), i32),                  # now_rel
+        sds((), i32),                  # new_oldest_rel
+    )
+    entries = walk_jaxpr(jax.make_jaxpr(step)(*args))
+    bound = SHARD_H + 4 * WR  # the flat engine's legitimate full-width
+    # merge at ONE shard's h_cap (the jaxcheck work_bound contract)
+    too_wide = [
+        e for e in entries
+        if e.prim in WORK_PRIMS and e.max_dim > bound
+    ]
+    assert not too_wide, (
+        f"work primitives exceeded the per-shard slice bound {bound}: "
+        f"{too_wide}"
+    )
+    # With kernels on there is no H-sized sort at all (the ISSUE-14
+    # one-pass contract holds inside the shard body too) and the fused
+    # kernels are actually in the program.
+    h_sorts = [
+        e for e in entries if e.prim == "sort" and e.max_dim >= SHARD_H
+    ]
+    assert not h_sorts, h_sorts
+    assert sum(e.prim == "pallas_call" for e in entries) >= 2
+
+
 # ---------------------------------------------------------------------------
 # 3. device program cost accounting (ISSUE 10)
 # ---------------------------------------------------------------------------

@@ -272,6 +272,78 @@ def test_degraded_cap_tracks_measured_cpu_mirror_tps():
         g_knobs.server.ratekeeper_use_measured_cpu_tps = old_use
 
 
+def test_degraded_cap_contracts_proportionally_for_sharded_resolvers():
+    """Shard-granular fault domains (ISSUE 15): when the degraded
+    resolver is mesh-sharded, only shards_degraded of shards_total key
+    ranges fell back to their mirrors — the cap contracts by the SICK
+    FRACTION, not the whole lane, and more sick shards means a lower
+    rate (monotone down to the whole-lane clamp at S/S degraded)."""
+    from foundationdb_tpu.server.ratekeeper import Signals
+
+    c, rk, old = make_rated_cluster(73, max_tps=10000.0)
+    try:
+        frac = g_knobs.server.ratekeeper_degraded_tps_fraction
+        whole, limiting = rk._limit(Signals(backend_state="degraded"), 1.0)
+        assert limiting == "backend_degraded"
+        last = None
+        for deg in (1, 2, 4, 7, 8):
+            sig = Signals(
+                backend_state="degraded", shards_total=8, shards_degraded=deg
+            )
+            tps, limiting = rk._limit(sig, 1.0)
+            assert limiting == "backend_degraded"
+            expect = 10000.0 * ((8 - deg) + deg * frac) / 8
+            assert tps == pytest.approx(expect), (deg, tps)
+            if last is not None:
+                assert tps < last, (deg, tps, last)
+            last = tps
+        # One sick chip out of 8 keeps most of the lane...
+        one, _ = rk._limit(
+            Signals(backend_state="degraded", shards_total=8,
+                    shards_degraded=1), 1.0
+        )
+        assert one > 0.8 * 10000.0 > whole
+        # ...and ALL shards degraded equals the whole-lane clamp.
+        allm, _ = rk._limit(
+            Signals(backend_state="degraded", shards_total=8,
+                    shards_degraded=8), 1.0
+        )
+        assert allm == pytest.approx(whole)
+        # Single-device resolvers (0/0) keep the pre-ISSUE-15 clamp.
+        single, _ = rk._limit(Signals(backend_state="degraded"), 1.0)
+        assert single == pytest.approx(10000.0 * frac)
+    finally:
+        g_knobs.server.ratekeeper_max_tps = old
+
+
+def test_binding_shard_fraction_ignores_healthy_sharded_resolvers():
+    """The merge regression: a HEALTHY mesh-sharded resolver's 0/N shard
+    detail must not neutralize the whole-lane clamp owed to a DIFFERENT
+    degraded single-device resolver — only degraded resolvers
+    contribute, and a degraded single-device resolver (no shard detail)
+    binds as the whole lane."""
+    from foundationdb_tpu.server.interfaces import ResolverSignalsReply
+    from foundationdb_tpu.server.ratekeeper import Ratekeeper
+
+    def reply(state, tot=0, deg=0):
+        return ResolverSignalsReply(
+            backend_state=state, shards_total=tot, shards_degraded=deg
+        )
+
+    f = Ratekeeper._binding_shard_fraction
+    # Healthy sharded + degraded single-device: whole lane (0/0), NOT 0/8.
+    assert f([reply("ok", tot=8), reply("degraded")]) == (0, 0)
+    # Degraded sharded alone: its fraction.
+    assert f([reply("degraded", tot=8, deg=1), reply("ok")]) == (1, 8)
+    # Degraded single-device overrides any proportional detail.
+    assert f([reply("degraded", tot=8, deg=1), reply("degraded")]) == (0, 0)
+    # Worst sick fraction wins among degraded sharded resolvers.
+    assert f([reply("degraded", tot=8, deg=1),
+              reply("probing", tot=4, deg=2)]) == (2, 4)
+    # Nothing degraded: no shard detail reported.
+    assert f([reply("ok", tot=8), reply("ok")]) == (0, 0)
+
+
 def test_resolver_signals_feed_ratekeeper():
     """End-to-end: the resolver's signal_snapshot + the RPC `signals`
     stream expose queue depth / resolve p99 / backend state, and the

@@ -1,0 +1,232 @@
+"""Shard-granular fault-domain differential gate (ISSUE 15).
+
+The tentpole contract: scripted faults on shard k — including
+mid-compaction (tiered cadence) and mid-probe (the rehydrate choke
+point) — across >= 3 seeds x flat/tiered/kernels modes produce verdicts
+bit-identical to the fault-free CPU-only multi-resolver oracle, with
+ONLY shard k's breaker walking ok -> degraded -> probing -> ok and the
+per-shard transition logs byte-identical across same-seed replays.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+import jax
+
+from foundationdb_tpu.conflict.device_faults import DeviceFaultInjector
+from foundationdb_tpu.parallel.sharded_resolver import (
+    ShardedJaxConflictSet,
+    uniform_int_split_keys,
+)
+from test_sharded_resolver import (
+    KEY_BYTES,
+    N_SHARDS,
+    MultiResolverCpuOracle,
+    random_txn,
+)
+
+SICK = 2  # the faulted shard; every other shard must stay untouched
+
+# Engine modes (the bench VARIANTS' decision-identical axes): flat,
+# two-tier history with a 3-batch compaction cadence (so the scripted
+# fault window covers a compaction batch), and Pallas kernels in
+# interpret mode (the CPU differential arm of ISSUE 14).
+MODES = [
+    ("flat", {}),
+    (
+        "tiered",
+        {
+            "FDB_TPU_HISTORY": "tiered",
+            "FDB_TPU_EVICT_EVERY": "3",
+            "FDB_TPU_DELTA_CAP": "2048",
+        },
+    ),
+    ("kernels", {"FDB_TPU_KERNELS": "interpret"}),
+]
+
+
+def _make_set(fault_plans=()):
+    split = uniform_int_split_keys(N_SHARDS, 2000, KEY_BYTES)
+    cs = ShardedJaxConflictSet(
+        split,
+        key_words=3,
+        h_cap=1 << 12,
+        devices=jax.devices()[:N_SHARDS],
+        bucket_mins=(64, 128, 128),
+    )
+    inj = DeviceFaultInjector()
+    for site, at, persist, shard in fault_plans:
+        inj.script(site, at=at, persist=persist, shard=shard)
+    cs.install_fault_injector(inj)
+    return cs, inj
+
+
+def _batches(seed, n_batches=14):
+    rng = np.random.default_rng(seed)
+    now = 100
+    out = []
+    for _ in range(n_batches):
+        txns = [random_txn(rng, now) for _ in range(int(rng.integers(1, 30)))]
+        now += int(rng.integers(1, 30))
+        out.append((txns, now, max(0, now - 120)))
+    return out
+
+
+# The scripted plan: 3 consecutive dispatch faults starting at shard
+# SICK's 3rd device batch (>= breaker threshold, so the circuit opens; in
+# tiered mode batch 3 IS a compaction batch at cadence 3 — the fault
+# lands mid-compaction), plus a fault on the FIRST rehydrate attempt
+# (site grow = the rehydration choke point), so the half-open probe
+# itself fails once before recovering.
+PLANS = (
+    ("dispatch", 3, 3, SICK),
+    ("grow", 1, 1, SICK),
+)
+
+
+def _run(seed, plans):
+    cs, inj = _make_set(plans)
+    verdicts = [
+        cs.detect(txns, now, oldest)
+        for txns, now, oldest in _batches(seed)
+    ]
+    return cs, inj, verdicts
+
+
+@pytest.mark.parametrize("mode,env", MODES, ids=[m for m, _ in MODES])
+@pytest.mark.parametrize("seed", [11, 12, 13])
+def test_shard_fault_differential_gate(monkeypatch, mode, env, seed):
+    for k, v in env.items():
+        monkeypatch.setenv(k, v)
+    split = uniform_int_split_keys(N_SHARDS, 2000, KEY_BYTES)
+    oracle = MultiResolverCpuOracle(split)
+    want = [
+        oracle.detect(txns, now, oldest)
+        for txns, now, oldest in _batches(seed)
+    ]
+
+    cs, inj, got = _run(seed, PLANS)
+    assert got == want, f"{mode}/seed={seed}: verdicts diverged from oracle"
+    assert inj.injected, "the scripted plan never fired"
+
+    # Fault-domain isolation: ONLY shard SICK's breaker walked.
+    for s in range(N_SHARDS):
+        br = cs._breakers[s]
+        if s == SICK:
+            continue
+        assert br.state == "ok" and br.transitions == [], (
+            f"{mode}/seed={seed}: healthy shard {s} breaker moved: "
+            f"{br.transitions}"
+        )
+    sick = cs._breakers[SICK]
+    pairs = [(f, t) for _seq, f, t, _r in sick.transitions]
+    # Full legal walk incl. the failed probe:
+    # ok -> degraded (threshold) -> probing -> degraded (probe_failed,
+    # the scripted mid-probe grow fault) -> probing -> ok.
+    assert pairs == [
+        ("ok", "degraded"),
+        ("degraded", "probing"),
+        ("probing", "degraded"),
+        ("degraded", "probing"),
+        ("probing", "ok"),
+    ], sick.transitions
+    assert sick.transitions[0][3].startswith("threshold:")
+    assert sick.transitions[2][3].startswith("probe_failed:")
+    assert sick.state == "ok"
+    assert cs.metrics.counter("degraded_shard_serves").value > 0
+
+    # Same-seed replay: per-shard transition logs AND the injected fault
+    # schedule are byte-identical.
+    cs2, inj2, got2 = _run(seed, PLANS)
+    assert got2 == got
+    assert json.dumps(inj2.injected) == json.dumps(inj.injected)
+    for s in range(N_SHARDS):
+        assert json.dumps(cs2._breakers[s].transitions) == json.dumps(
+            cs._breakers[s].transitions
+        ), f"{mode}/seed={seed}: shard {s} transition log not replayable"
+
+
+def test_metrics_snapshot_shape_is_fault_independent():
+    """The PR-4 flat-snapshot discipline, shard-granular: every per-shard
+    breaker instrument is pre-created at construction, so WHICH shards
+    fault can never change the snapshot's key set."""
+    _, _, _ = None, None, None
+    cs_clean, _inj, _ = _run(5, ())
+    cs_faulty, inj, _ = _run(5, PLANS)
+    assert inj.injected
+    clean = cs_clean.device_metrics()
+    faulty = cs_faulty.device_metrics()
+    assert set(clean["counters"]) == set(faulty["counters"])
+    assert set(clean["gauges"]) == set(faulty["gauges"])
+    for s in range(N_SHARDS):
+        assert f"shard{s}_breaker_opens" in clean["counters"]
+        assert f"shard{s}_backend_state" in clean["gauges"]
+
+
+def test_backend_signal_carries_shard_counts():
+    """backend_signal() reports (shards_degraded, shards_total) so the
+    ratekeeper can contract the lane proportionally — one sick chip out
+    of N, not a whole-lane degraded clamp."""
+    cs, inj = _make_set()
+    inj.begin_outage("dispatch", shard=SICK)
+    for txns, now, oldest in _batches(21, n_batches=4):
+        cs.detect(txns, now, oldest)
+    sig = cs.backend_signal()
+    assert sig["shards_total"] == N_SHARDS
+    assert sig["shards_degraded"] == 1
+    assert sig["backend_state"] == "degraded"
+    dm = cs.device_metrics()
+    assert dm["shards"]["states"][SICK] == "degraded"
+    assert dm["shards"]["degraded"] == 1
+    inj.end_outage("dispatch", shard=SICK)
+
+
+def test_injector_per_shard_sites_are_scoped_and_replayable():
+    """Per-shard scripted plans keep their own check counters (shard
+    k's 2nd check is independent of shard j's), and the injected log
+    names the shard-scoped site key."""
+    inj = DeviceFaultInjector()
+    inj.script("dispatch", at=2, shard=1)
+    # Interleaved checks: shard 0 never faults, shard 1 faults on ITS
+    # second check regardless of shard 0's traffic.
+    inj.check("dispatch", shard=0)
+    inj.check("dispatch", shard=1)
+    inj.check("dispatch", shard=0)
+    with pytest.raises(Exception):
+        inj.check("dispatch", shard=1)
+    inj.check("dispatch", shard=0)
+    assert [e[1] for e in inj.injected] == ["dispatch#s1"]
+
+
+def test_mid_probe_fault_reopens_only_sick_shard_tiered(monkeypatch):
+    """Tiered mode: a persistent outage spanning several compactions,
+    lifted mid-run — recovery rehydrates ONLY the sick shard (its delta
+    resets, its base rebuilds from the mirror snapshot) and verdicts
+    stay oracle-identical throughout."""
+    monkeypatch.setenv("FDB_TPU_HISTORY", "tiered")
+    monkeypatch.setenv("FDB_TPU_EVICT_EVERY", "3")
+    monkeypatch.setenv("FDB_TPU_DELTA_CAP", "2048")
+    split = uniform_int_split_keys(N_SHARDS, 2000, KEY_BYTES)
+    oracle = MultiResolverCpuOracle(split)
+    cs, inj = _make_set()
+    rehydrates_before = cs.metrics.counter(
+        f"shard{SICK}_rehydrates"
+    ).value
+    batches = _batches(31, n_batches=16)
+    for i, (txns, now, oldest) in enumerate(batches):
+        if i == 2:
+            inj.begin_outage("dispatch", shard=SICK)
+        if i == 10:
+            inj.end_outage("dispatch", shard=SICK)
+        got = cs.detect(txns, now, oldest)
+        assert got == oracle.detect(txns, now, oldest), f"batch {i}"
+    assert cs._breakers[SICK].state == "ok"
+    assert (
+        cs.metrics.counter(f"shard{SICK}_rehydrates").value
+        > rehydrates_before
+    )
+    for s in range(N_SHARDS):
+        if s != SICK:
+            assert cs._breakers[s].transitions == []

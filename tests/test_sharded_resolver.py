@@ -172,13 +172,13 @@ def test_sharded_divergence_falls_back_to_cpu(sharded, monkeypatch):
     real_step_for = type(sharded)._step_for
 
     def diverged_step_for(self, pb):
-        def step(lo, hi, hkeys, hvers, hcount, oldest, *rest):
+        def step(lo, hi, active, hkeys, hvers, hcount, oldest, *rest):
             return (
                 hkeys,
                 hvers,
                 hcount,
                 oldest,
-                jnp.zeros((pb.txn_cap,), jnp.int32),
+                jnp.zeros((hcount.shape[0], pb.txn_cap), jnp.int32),
                 jnp.asarray(1, jnp.int32),
                 jnp.asarray(0, jnp.int32),
             )

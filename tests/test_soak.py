@@ -160,6 +160,75 @@ def test_soak_device_outage_degrades_throttles_recovers():
     assert fr["status"]["captures"] == len(fr["captures"])
 
 
+def test_soak_shard_kill_survivors_hold_floor():
+    """Shard-kill fault (ISSUE 15): a chip loss scoped to one shard of
+    the mesh-sharded resolver.  Only that shard's breaker opens (and
+    serves degraded off its mirror), the surviving shards hold every
+    phase's goodput floor, admission contracts PROPORTIONALLY (one sick
+    shard out of N — not the whole-lane degraded clamp), and recovery
+    rehydrates only the sick shard."""
+    from foundationdb_tpu.workloads.soak import shard_outage_config
+
+    cfg = shard_outage_config(
+        minutes=0.15, peak_tps=60.0, seed=17, shard=1, n_shards=4
+    )
+    cfg.keys = 64
+    cfg.drain_timeout = 5.0
+    cfg.max_tps = 60.0
+    cfg.degraded_tps_fraction = 0.0  # whole-lane clamp would zero the
+    # rate; the proportional cap must keep ~3/4 of it instead
+    rep = run_soak(cfg)
+    # Every phase — INCLUDING shard_outage — held its goodput floor.
+    assert rep["slo"]["ok"], rep["slo"]
+    (t0, kind, detail, t1), = rep["faults"]
+    assert kind == "shard_kill" and detail.endswith(":shard1"), rep["faults"]
+    # Only shard 1's breaker walked, and it ended recovered.
+    (rname, sh) = detail.split(":")
+    for key, transitions in rep["breakers"].items():
+        if key == f"{rname}.shard1":
+            assert transitions and transitions[0][1:3] == ["ok", "degraded"]
+            assert transitions[-1][2] == "ok", transitions
+        else:
+            assert transitions == [], (key, transitions)
+    shards = rep["shards"][rname]
+    assert shards["total"] == 4
+    assert shards["states"] == ["ok"] * 4  # all recovered by soak end
+    assert shards["degraded_shard_serves"] > 0
+    # Proportional admission (the ratekeeper's shard-granular cap): while
+    # shard 1 was down, the binding backend_degraded rate stayed near
+    # 3/4 of max_tps — NOT the zeroed whole-lane degraded clamp.
+    window = _limiting_within(rep["ratekeeper"]["admission_log"], t0, t1 + 0.5)
+    deg = [e for e in window if e[1] == "backend_degraded"]
+    assert deg, rep["ratekeeper"]["admission_log"]
+    assert all(e[2] >= 0.5 * cfg.max_tps for e in deg), deg
+    assert rep["ratekeeper"]["admission_log"][-1][1] == "none"
+    # The shard-breaker open is a flight-recorder trigger naming the
+    # sick shard's domain.
+    fr = rep["flight_recorder"]
+    triggers = [c["trigger"] for c in fr["captures"]]
+    assert "breaker_open" in triggers and "fault_window:shard_kill" in triggers
+    cap = next(c for c in fr["captures"] if c["trigger"] == "breaker_open")
+    assert cap["detail"]["domain"] == "shard1", cap["detail"]
+
+
+def test_soak_shard_kill_same_seed_byte_identical():
+    """The shard-outage soak is replayable: same seed => byte-identical
+    full reports (per-shard transition logs included)."""
+    from foundationdb_tpu.workloads.soak import shard_outage_config
+
+    def go():
+        cfg = shard_outage_config(
+            minutes=0.1, peak_tps=40.0, seed=23, shard=2, n_shards=4
+        )
+        cfg.keys = 32
+        cfg.drain_timeout = 5.0
+        return run_soak(cfg)
+
+    a, b = go(), go()
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    assert transition_logs_json(a) == transition_logs_json(b)
+
+
 def test_soak_overload_sheds_and_clients_recover():
     """Open-loop overload far beyond a tiny TPS cap with a small GRV
     queue bound: the proxy sheds (counted, deterministic), shed clients
