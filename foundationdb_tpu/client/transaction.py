@@ -354,7 +354,13 @@ class Transaction:
                 if self.options.get("priority_batch")
                 else 0
             ) | (GRV_FLAG_LOCK_AWARE if self.options.get("lock_aware") else 0)
-            self._read_version = await self.db.batched_read_version(flags)
+            version = await self.db.batched_read_version(flags)
+            # Re-check after the await: a concurrent get_read_version (or a
+            # set_read_version) resolved while this one was suspended, and
+            # overwriting it would split the transaction's reads across two
+            # snapshot versions.  First resolution wins; everyone returns it.
+            if self._read_version is None:
+                self._read_version = version
         return self._read_version
 
     def set_read_version(self, version: int):

@@ -112,12 +112,17 @@ class DiskQueue:
         self._pending = []
         for w_off, data in writes:
             await self._file.write(w_off, data)
-        self._tail = off
+        self._tail = off  # fdblint: ignore[RACE001]: _commit_locked is serialized by the commit chain gate; appends land in _pending, never move _tail
         if self._header_dirty:
+            # Clear the flag BEFORE the write's await: a pop() landing
+            # while the header is in flight re-dirties it and the NEXT
+            # commit persists the newer popped_seq.  Clearing after the
+            # await erased that mark — the pop's progress was silently
+            # dropped until some unrelated future pop re-dirtied the flag.
+            self._header_dirty = False
             body = struct.pack("<QQ", self.popped_seq, self._tail)
             hdr = body + struct.pack("<I", zlib.crc32(body) & 0xFFFFFFFF)
             await self._file.write(0, hdr)
-            self._header_dirty = False
         await self._file.sync()
 
     def pop(self, up_to_seq: int):

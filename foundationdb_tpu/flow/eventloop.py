@@ -16,6 +16,14 @@ Coroutines ("actors") are driven by Task.  `await future` suspends until the
 future is set; resumption goes through the loop's queue at a task priority,
 never synchronously, so event ordering is fully determined by (time,
 priority, insertion sequence).
+
+Scheduler-perturbation fuzz (FDB_TPU_SCHED_FUZZ=<int>): a DeterministicRandom
+forked from (seed, fuzz) injects a tie-break between priority and insertion
+sequence, permuting pick order among equal-(time, priority) entries — the
+orderings the contract leaves unspecified.  Same (seed, fuzz) replays
+byte-identically; a different fuzz explores a different LEGAL interleaving
+(ref: sim2/BUGGIFY task-order jitter), which is what the differential replay
+gates re-run under to flush latent ordering assumptions.
 """
 
 from __future__ import annotations
@@ -27,6 +35,7 @@ from typing import Coroutine, Optional
 
 from .error import ActorCancelled, FdbError, SimulationFailure
 from .future import Future, Promise
+from .knobs import g_env
 from .rng import DeterministicRandom
 
 
@@ -128,24 +137,30 @@ class Task(Future):
             return
         self._started = True
         self._waiting_on = None
+        loop = self._loop
+        prev_task = loop.current_task
+        loop.current_task = self
         try:
-            if error is not None:
-                awaited = self._coro.throw(error)
-            else:
-                awaited = self._coro.send(value)
-        except StopIteration as stop:
-            self._set(stop.value)
-            return
-        except BaseException as e:  # noqa: BLE001 - errors flow into the future
-            self._set_error(e)
-            self._loop._note_actor_failure(self.name, e)
-            return
-        # The coroutine yielded a Future it is waiting on.
-        assert isinstance(awaited, Future), (
-            f"actor {self.name} awaited a non-Future: {awaited!r}"
-        )
-        self._waiting_on = awaited
-        awaited.add_callback(self._on_ready)
+            try:
+                if error is not None:
+                    awaited = self._coro.throw(error)
+                else:
+                    awaited = self._coro.send(value)
+            except StopIteration as stop:
+                self._set(stop.value)
+                return
+            except BaseException as e:  # noqa: BLE001 - errors flow into the future
+                self._set_error(e)
+                loop._note_actor_failure(self.name, e)
+                return
+            # The coroutine yielded a Future it is waiting on.
+            assert isinstance(awaited, Future), (
+                f"actor {self.name} awaited a non-Future: {awaited!r}"
+            )
+            self._waiting_on = awaited
+            awaited.add_callback(self._on_ready)
+        finally:
+            loop.current_task = prev_task
 
     def _on_ready(self, fut: Future):
         prio = fut.priority if fut.priority is not None else TaskPriority.DefaultOnMainThread
@@ -194,8 +209,25 @@ class EventLoop:
         self.rng = DeterministicRandom(seed)
         self._now = 0.0
         self._seq = 0
-        # Heap entries: (time, -priority, seq, fn)
+        # Heap entries: (time, -priority, tie, seq, fn-cell).  `tie` is 0
+        # unless FDB_TPU_SCHED_FUZZ is set, in which case it is a draw from
+        # a rng forked from (seed, fuzz) — permuting pick order among
+        # equal-(time, priority) entries, the orderings the scheduling
+        # contract leaves unspecified (see module docstring).
         self._heap: list = []
+        fuzz = g_env.get("FDB_TPU_SCHED_FUZZ")
+        self._fuzz_rng = (
+            DeterministicRandom((seed * 1000003 + int(fuzz)) & ((1 << 63) - 1))
+            if fuzz
+            else None
+        )
+        # Bumps once per run_one step: the state sanitizer's interleaving
+        # clock — two accesses at the same epoch cannot have had another
+        # task run between them (see flow/state_sanitizer.py).
+        self.await_epoch = 0
+        # The Task currently being stepped (None between steps / for plain
+        # callbacks): audit attribution for the state sanitizer.
+        self.current_task: Optional[Task] = None
         self._stopped = False
         self.tasks_run = 0
         # Slow-task profiler threshold in WALL seconds (None = off; the
@@ -230,7 +262,12 @@ class EventLoop:
         self._seq += 1
         t = self._now if at is None else at
         cell = [fn]
-        heapq.heappush(self._heap, (t, -priority, self._seq, cell))
+        tie = (
+            self._fuzz_rng.random_int(0, 1 << 30)
+            if self._fuzz_rng is not None
+            else 0
+        )
+        heapq.heappush(self._heap, (t, -priority, tie, self._seq, cell))
         return cell
 
     def delay(self, seconds: float, priority: int = TaskPriority.DefaultDelay) -> Future:
@@ -263,13 +300,14 @@ class EventLoop:
     def run_one(self) -> bool:
         """Run the next event, advancing virtual time. False if none left."""
         while self._heap and not self._stopped:
-            t, _negprio, _seq, cell = heapq.heappop(self._heap)
+            t, _negprio, _tie, _seq, cell = heapq.heappop(self._heap)
             fn = cell[0]
             if fn is None:  # cancelled timer
                 continue
             if t > self._now:
                 self._now = t
             self.tasks_run += 1
+            self.await_epoch += 1
             # Captured BEFORE the step: the step itself may toggle the
             # profiler (a workload or the runtime-toggle RPC), and the
             # comparison below must use the threshold this step ran under.

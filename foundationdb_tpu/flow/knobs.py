@@ -301,7 +301,7 @@ g_env.declare("FDB_TPU_H_CAP", "0",
                    "kernels' tile; api.env_h_cap).  Always safe to "
                    "drop: the engine's must-fit guard syncs and grows "
                    "when a live set outruns the cap, never truncates")
-g_env.declare("FDB_TPU_JAXCHECK_DIR", "",
+g_env.declare("FDB_TPU_JAXCHECK_DIR", "",  # fdblint: ignore[ENV002]: read by the jaxcheck pass itself (tools/lint/jaxir.py), which the scan skips as linter-internal
               help="jaxcheck fingerprint baseline directory override "
                    "(default: tests/jax_fingerprints next to the package)")
 # Batch-update snapshot mirror (ISSUE 9): the chunked CPU engine behind
@@ -412,6 +412,24 @@ g_env.declare("FDB_TPU_PROGRAM_COSTS", "",
                    "on first call, cached).  Default lazy: the programs "
                    "block appears once the table has been computed "
                    "(tools/perf_experiments.py --programs, tests)")
+g_env.declare("FDB_TPU_STATE_SANITIZER", "",
+              help="truthy: flow.state_sanitizer audits shared dicts — "
+                   "every keyed read/write recorded as (task, "
+                   "await-epoch) — and expect_clean_shared_state raises "
+                   "at sim shutdown on any stale-read→write pair (a "
+                   "lost update that actually interleaved).  The "
+                   "test-only dynamic twin of fdblint RACE001-004; off "
+                   "by default, audited_dict() degrades to a plain dict")
+g_env.declare("FDB_TPU_SCHED_FUZZ", "",
+              help="integer: perturb the event loop's pick order among "
+                   "equal-(time, priority) heap entries with draws from "
+                   "a DeterministicRandom forked from (seed, fuzz) — "
+                   "the orderings the scheduling contract leaves "
+                   "unspecified.  Same (seed, fuzz) replays "
+                   "byte-identically; different fuzz values explore "
+                   "different LEGAL interleavings (the "
+                   "scheduler-perturbation replay gate, ref sim2/"
+                   "BUGGIFY task jitter).  '' = stable FIFO tie-break")
 g_env.declare("FDB_TPU_CHECK_ORPHANED_WAITS", "",
               help="truthy: sim_validation.expect_no_orphaned_waits "
                    "asserts at sim shutdown that no task is still parked "

@@ -247,7 +247,7 @@ class DRAgent:
 
             if user:
                 await self.dst_db.run(apply)
-            self.applied = version
+            self.applied = version  # fdblint: ignore[RACE004]: applied is owned by the single tail loop; start() writes it only before spawning the loop (phase-ordered, never concurrent)
             n += 1
             if new_tag:
                 # Later versions in THIS batch may be missing the new

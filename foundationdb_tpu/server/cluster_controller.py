@@ -24,6 +24,7 @@ from ..flow.asyncvar import AsyncVar
 from ..flow.error import ActorCancelled, FdbError
 from ..flow.eventloop import timeout_after
 from ..flow.knobs import g_knobs
+from ..flow.state_sanitizer import audited_dict
 from ..flow.trace import TraceEvent
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream, RequestStreamRef
@@ -87,7 +88,12 @@ class ClusterController:
         self.n_tlogs = n_tlogs
         self.n_storages = n_storages
         self.n_proxies = n_proxies
-        self.workers: Dict[str, WorkerInterface] = {}
+        # Audited under FDB_TPU_STATE_SANITIZER: written by the register
+        # serve loop, the per-worker ping actors and recruitment — the
+        # multi-writer shape racecheck RACE004 flags statically.
+        self.workers: Dict[str, WorkerInterface] = audited_dict(
+            process.network.loop, "cluster_controller.workers"
+        )
         # address -> process class (ref: ProcessClass); fed by the config
         # monitor, consulted by the next generation's recruitment.
         self.process_classes: Dict[str, str] = {}
@@ -1099,7 +1105,12 @@ class ClusterController:
             )
             if pong == "pong":
                 out.append(wi)
-            else:
+            elif self.workers.get(wi.address) is wi:
+                # Identity re-check after the ping await: a worker that
+                # re-registered during the suspension installed a FRESH
+                # interface under this address — evicting by key alone
+                # would delete the live registration because the old one
+                # timed out.
                 del self.workers[wi.address]
         # Deterministic order (registration dict order varies with timing).
         out.sort(key=lambda w: w.address)

@@ -21,6 +21,7 @@ from ..flow.asyncvar import NotifiedVersion
 from ..flow.error import ActorCancelled
 from ..flow.eventloop import first_of
 from ..flow.knobs import g_knobs
+from ..flow.state_sanitizer import audited_dict
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
 from ..utils import RangeMap
@@ -123,12 +124,19 @@ class Proxy:
         # Non-None while `\xff/dbLocked` holds a UID (ref: databaseLockedKey;
         # learned via the mutation stream or recovery-time map injection).
         self.locked_uid = None
-        self.server_list: dict = {}
+        # Audited under FDB_TPU_STATE_SANITIZER: written by the commit
+        # path's metadata intercept and recovery-time injection, read by
+        # the read-routing path — a cross-actor shared map.
+        self.server_list: dict = audited_dict(
+            process.network.loop, "proxy.server_list"
+        )
         if system_map is not None:
             entries, server_list = system_map
             for b, e, team in entries:
                 self.key_servers.set_range(b, e, (tuple(team), tuple(team)))
-            self.server_list = dict(server_list)
+            self.server_list = audited_dict(
+                process.network.loop, "proxy.server_list", server_list
+            )
         # Metadata applies in version order across THIS proxy's overlapped
         # batches (the own-version chain); versions granted to other proxies
         # in between are covered by the resolvers' state-mutation replies

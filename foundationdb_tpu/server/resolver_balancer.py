@@ -38,8 +38,18 @@ class ResolverBalancer:
 
     async def run_once(self) -> Optional[List[bytes]]:
         """One balancing round; returns the new split list if a boundary
-        moved, else None."""
+        moved, else None.
+
+        The whole round is a read-modify-write of the partition spanning
+        several awaits (metrics polls, the split RPC, the commit), so the
+        plan is computed from one snapshot (`base`), the commit validates
+        the durable partition against it with a conflict-checked read
+        (a concurrent mover aborts exactly like any MVCC write-write
+        conflict), and the in-memory view is only adopted if no one else
+        repartitioned while we were suspended — a stale plan is dropped,
+        never stomped over a newer one."""
         proc = self.db.process
+        base = self.split_keys  # the snapshot this round's plan is built on
         ops = []
         for r in self.resolvers:
             rep = await r.metrics.get_reply(proc, None)
@@ -62,7 +72,7 @@ class ResolverBalancer:
             return None
         i = best
         oi, oj = ops[i], ops[i + 1]
-        bounds = sk.bounds_from_split_keys(self.split_keys)
+        bounds = sk.bounds_from_split_keys(base)
         target = (oi + oj) / 2.0
         if oi > oj:
             # Donor on the left: keep its first `target/oi` of mass; the
@@ -88,19 +98,31 @@ class ResolverBalancer:
             )
         if new_key is None or new_key in (b"",):
             return None
-        old = self.split_keys[i]
+        old = base[i]  # fdblint: ignore[WAIT001]: deliberate snapshot — the commit txn re-validates the durable partition against base and drops a stale plan (see docstring)
         if new_key == old:
             return None
-        new_splits = list(self.split_keys)
+        new_splits = list(base)
         new_splits[i] = new_key
         if sorted(set(new_splits)) != new_splits or b"" in new_splits:
             return None  # refuse a degenerate partition
 
+        stale = []
+
         async def txn(tr):
             tr.options["access_system_keys"] = True
+            # Conflict-checked read: if another mover committed while this
+            # round was suspended, either we see its value here and abort
+            # the plan, or the resolver aborts one of the two commits —
+            # the durable partition is never built from a stale snapshot.
+            cur = await tr.get(sk.RESOLVER_SPLIT_KEY)
+            if cur is not None and sk.decode_resolver_split(cur) != list(base):
+                stale.append(True)
+                return
             tr.set(sk.RESOLVER_SPLIT_KEY, sk.encode_resolver_split(new_splits))
 
         await self.db.run(txn)
+        if stale or self.split_keys is not base:
+            return None  # someone repartitioned during our awaits
         self.split_keys = new_splits
         self.moves += 1
         return new_splits

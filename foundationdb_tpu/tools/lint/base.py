@@ -40,6 +40,11 @@ RULES: Dict[str, str] = {
     "PRM004": "consumer loop over a stream whose producers can all terminate without closing it",
     "TSK001": "spawned Task dropped while its coroutine can raise with neither handler nor TraceEvent",
     "ENV001": "FDB_TPU_* environment flag read outside the flow/knobs.py registry (config drift)",
+    "ENV002": "FDB_TPU_* flag declared in the registry but never read anywhere in the project (dead config)",
+    "RACE001": "read-modify-write of shared state spanning an await (lost update)",
+    "RACE002": "check-then-act: guard on shared state evaluated before an await that the guarded action outlives",
+    "RACE003": "two attrs co-written atomically elsewhere split across an await (torn invariant)",
+    "RACE004": "attr written by >=2 actor functions with >=1 write await-separated from its read (multi-writer race)",
     "PRG001": "fdblint ignore pragma carries no reason string",
     "PRG002": "fdblint ignore pragma suppresses nothing (stale)",
 }
@@ -195,6 +200,14 @@ DEFAULT_ALLOW: Dict[str, Tuple[str, ...]] = {
     "PRM004": _REAL_MODE_MODULES,
     "TSK001": _REAL_MODE_MODULES,
     "ENV001": (),
+    "ENV002": (),
+    # RACE rules police cooperative-actor atomicity; the OS-threaded
+    # real-mode backends have genuinely different suspension semantics
+    # (locks, not awaits) and are triaged by inspection like WAIT/PRM.
+    "RACE001": _REAL_MODE_MODULES,
+    "RACE002": _REAL_MODE_MODULES,
+    "RACE003": _REAL_MODE_MODULES,
+    "RACE004": _REAL_MODE_MODULES,
 }
 
 # The linter's own modules are never simulator-executed.

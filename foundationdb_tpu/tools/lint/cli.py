@@ -31,8 +31,17 @@ def count_by_rule(findings: List[Finding]) -> Dict[str, Dict[str, int]]:
     return {r: out[r] for r in sorted(out)}
 
 
+# Always shown in the counts line, zero or not: a RACE/ENV002 count that
+# silently vanished from the tier-1 output is how a burned-down family
+# quietly regrows (the racecheck PR's explicit gate).
+_ALWAYS_COUNTED = ("ENV002", "RACE001", "RACE002", "RACE003", "RACE004")
+
+
 def format_counts(findings: List[Finding]) -> str:
     counts = count_by_rule(findings)
+    for rule in _ALWAYS_COUNTED:
+        counts.setdefault(rule, {"flagged": 0, "suppressed": 0})
+    counts = {r: counts[r] for r in sorted(counts)}
     if not counts:
         return "per-rule: (none)"
     cells = [

@@ -40,6 +40,7 @@ from .promises import (
     collect_promise_facts,
     run_promise_rules,
 )
+from .races import ModuleRaceFacts, collect_race, run_race_rules
 from .rpy import run_rpy001
 from .waitrules import run_wait_rules
 
@@ -54,6 +55,7 @@ class FileRecord:
     pragmas: Dict[int, Pragma]
     summary: ModuleSummary
     facts: ModulePromiseFacts       # promise-lifecycle facts (PRM/TSK)
+    races: ModuleRaceFacts          # atomicity/lost-update facts (RACE/ENV002)
 
 
 _FINGERPRINT: Optional[str] = None
@@ -170,11 +172,13 @@ class Project:
         findings = ModuleLinter(relpath, tree).run()
         findings += run_wait_rules(relpath, tree)
         findings += run_rpy001(relpath, tree)
+        race_findings, races = collect_race(relpath, tree)
+        findings += race_findings
         pragmas = parse_pragmas(source)
         summary = collect_summary(relpath, tree, self.root_pkg)
         facts = collect_promise_facts(relpath, tree)
         self.stats["parsed"] += 1
-        return FileRecord(sig, digest, findings, pragmas, summary, facts)
+        return FileRecord(sig, digest, findings, pragmas, summary, facts, races)
 
     def load(self):
         cached = self._load_cache()
@@ -226,6 +230,8 @@ class Project:
             consumed_pragmas=consumed, graph=graph,
         )
         det += run_promise_rules(summaries, facts, graph=graph)
+        races = {rp: r.races for rp, r in self.records.items()}
+        det += run_race_rules(summaries, races, graph=graph)
         det_by_file: Dict[str, List[Finding]] = {}
         for f in det:
             det_by_file.setdefault(f.path, []).append(f)
@@ -274,6 +280,8 @@ def lint_source(
     findings = ModuleLinter(relpath, tree).run()
     findings += run_wait_rules(relpath, tree)
     findings += run_rpy001(relpath, tree)
+    race_findings, races = collect_race(relpath, tree)
+    findings += race_findings
     pragmas = parse_pragmas(source)
     summary = collect_summary(relpath, tree, None)
     consumed: Dict[str, set] = {}
@@ -284,6 +292,10 @@ def lint_source(
     )
     findings += run_promise_rules(
         {relpath: summary}, {relpath: collect_promise_facts(relpath, tree)},
+        whole_project=whole_project, graph=graph,
+    )
+    findings += run_race_rules(
+        {relpath: summary}, {relpath: races},
         whole_project=whole_project, graph=graph,
     )
     findings = [f for f in findings if not config.allows(f.rule, relpath)]

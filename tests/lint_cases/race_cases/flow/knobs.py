@@ -1,0 +1,12 @@
+"""Mini env-flag registry for the ENV002 corpus: one flag with a real
+call-time read elsewhere in the scan root, one dead declaration."""
+
+
+class _Env:
+    def declare(self, name, default, help=""):
+        pass
+
+
+g_env = _Env()
+g_env.declare("FDB_TPU_CASE_LIVE", "", help="read by server/reader.py")
+g_env.declare("FDB_TPU_CASE_DEAD", "", help="never read anywhere")  # EXPECT: ENV002

@@ -1,0 +1,22 @@
+"""RACE002 corpus: check-then-act across an await."""
+
+
+class Registry:
+    def __init__(self):
+        self.leader = None
+        self.version = 0
+
+    async def elect(self, loop, who):
+        if self.leader is None:
+            await loop.delay(0.1)
+            self.leader = who  # EXPECT: RACE002
+
+    async def elect_recheck_negative(self, loop, who):
+        if self.leader is None:
+            await loop.delay(0.1)
+            if self.leader is None:
+                self.leader = who
+
+    async def no_guard_negative(self, loop, who):
+        await loop.delay(0.1)
+        self.leader = who

@@ -1,0 +1,27 @@
+"""RACE001 interprocedural corpus: the RMW's read or write side goes
+through a resolvable helper (call-graph may-await summaries)."""
+
+
+class Spiller:
+    def __init__(self):
+        self.mem_bytes = 0
+
+    def _load(self):
+        return self.mem_bytes
+
+    def _store(self, v):
+        self.mem_bytes = v
+
+    async def spill(self, loop):
+        v = self._load()
+        await loop.delay(0.1)
+        self.mem_bytes = v - 100  # EXPECT: RACE001
+
+    async def drain(self, loop):
+        v = self.mem_bytes
+        await loop.delay(0.1)
+        self._store(v)  # EXPECT: RACE001
+
+    async def sync_negative(self):
+        v = self._load()
+        self._store(v - 100)

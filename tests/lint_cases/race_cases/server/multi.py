@@ -1,0 +1,29 @@
+"""RACE004 corpus: an attr written by two actor functions with an
+await-separated write; plus the lock-free single-writer negative."""
+
+
+class Shared:
+    def __init__(self):
+        self.table = ()
+        self.owned = ()
+
+    async def rebuild(self, loop):
+        size = len(self.table)
+        await loop.delay(0.1)
+        self.table = tuple(range(size))  # EXPECT: RACE004
+
+    async def install(self, loop, t):
+        self.table = t
+
+    async def single_writer_negative(self, loop):
+        n = len(self.owned)
+        await loop.delay(0.1)
+        self.owned = (n,)
+
+
+class Observer:
+    def __init__(self, shared):
+        self.shared = shared
+
+    def peek(self):
+        return self.shared.owned

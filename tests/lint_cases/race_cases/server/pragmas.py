@@ -1,0 +1,17 @@
+"""Pragma corpus for the RACE family: one reasoned suppression (appears
+as suppressed, not unsuppressed) plus a stale pragma that suppresses
+nothing and ages into PRG002."""
+
+
+class Deliberate:
+    def __init__(self):
+        self.cursor = 0
+
+    async def advance(self, loop):
+        cached = self.cursor
+        await loop.delay(0.1)
+        self.cursor = cached + 1  # fdblint: ignore[RACE001]: single caller by protocol — the drive loop never overlaps advance calls
+
+    async def clean(self, loop):
+        await loop.delay(0.1)
+        self.cursor = 7  # fdblint: ignore[RACE001]: stale — nothing here spans an await  # EXPECT: PRG002

@@ -1,0 +1,7 @@
+"""The live flag's call-time read site (any literal mention counts)."""
+
+from ..flow.knobs import g_env
+
+
+def backend_choice():
+    return g_env.get("FDB_TPU_CASE_LIVE")

@@ -1,0 +1,36 @@
+"""RACE001 corpus: read-modify-write of shared state spanning an await."""
+
+
+async def fetch(x):
+    return x
+
+
+class Counter:
+    def __init__(self):
+        self.n = 0
+        self.log = []
+
+    async def lost_update(self, loop):
+        cached = self.n
+        await loop.delay(0.1)
+        self.n = cached + 1  # EXPECT: RACE001
+
+    async def direct_span(self, loop):
+        self.n = await fetch(self.n)  # EXPECT: RACE001
+
+    async def recheck_negative(self, loop):
+        cached = self.n
+        await loop.delay(0.1)
+        self.n = self.n + 1  # re-read in the write step: sanctioned
+        self.log.append(cached)
+
+    async def atomic_negative(self, loop):
+        await loop.delay(0.1)
+        self.n += 1  # one step: no window
+
+    async def finally_write(self, loop):
+        cached = self.n
+        try:
+            await loop.delay(0.1)
+        finally:
+            self.n = cached + 1  # EXPECT: RACE001

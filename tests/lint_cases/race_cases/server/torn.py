@@ -1,0 +1,21 @@
+"""RACE003 corpus: two attrs co-written atomically everywhere else get
+split across an await in one function (torn invariant)."""
+
+
+class Ledger:
+    def __init__(self):
+        self.entries = []
+        self.total = 0
+
+    async def credit(self, loop, amount):
+        self.entries = self.entries + [amount]
+        self.total = self.total + amount
+
+    async def debit(self, loop, amount):
+        self.entries = self.entries + [-amount]
+        self.total = self.total - amount
+
+    async def torn(self, loop, amount):
+        self.entries = self.entries + [amount]
+        await loop.delay(0.1)
+        self.total = self.total + amount  # EXPECT: RACE003

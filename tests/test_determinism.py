@@ -65,3 +65,76 @@ def test_kill_recovery_identical_across_hash_seeds():
     b = _run("2")
     assert "rows: 120" in a
     assert a == b, f"nondeterminism across interpreters:\nA:\n{a}\nB:\n{b}"
+
+
+# ---------------------------------------------------------------------------
+# Telemetry byte-identity across interpreter hash seeds (racecheck PR): the
+# spans log, the per-role metrics snapshots and a short soak report are the
+# artifacts the replay gates diff — if any of them ever iterates an id-hashed
+# container, the divergence shows up here first.
+# ---------------------------------------------------------------------------
+
+TELEMETRY_SCRIPT = r"""
+import json
+import sys
+sys.path.insert(0, %r)
+import jax
+jax.config.update("jax_platforms", "cpu")
+from foundationdb_tpu.flow.eventloop import all_of
+from foundationdb_tpu.flow.spans import SpanHub, set_global_span_hub, global_span_hub
+from foundationdb_tpu.server import SimCluster
+
+set_global_span_hub(SpanHub())
+c = SimCluster(seed=211, n_proxies=2)
+db = c.database()
+
+async def actor(aid):
+    for r in range(3):
+        async def op(tr, aid=aid, r=r):
+            cur = await tr.get(b"shared")
+            tr.set(b"shared", (cur or b"") + b"%%d" %% aid)
+            tr.set(b"t%%02d/%%02d" %% (aid, r), b"v")
+        await db.run(op)
+
+async def drive():
+    await all_of([db.process.spawn(actor(i), "wl_%%d" %% i) for i in range(4)])
+
+c.run_all([(db, drive())], timeout_vt=3000.0)
+now = c.loop.now()
+print("spans:", global_span_hub().spans_json())
+print("resolver:", c.resolver.metrics.snapshot_json(now=now))
+print("proxy:", c.proxy.metrics.snapshot_json(now=now))
+
+from foundationdb_tpu.flow import set_event_loop
+set_event_loop(None)
+from foundationdb_tpu.workloads.soak import SoakConfig, SoakPhase, run_soak
+
+cfg = SoakConfig(
+    seed=5, cluster="sim", backend="cpu", mode="open", keys=32,
+    phases=[SoakPhase("warm", 0.8, 30.0)], faults=[], drain_timeout=5.0,
+)
+print("soak:", json.dumps(run_soak(cfg), sort_keys=True))
+""" % (REPO,)
+
+
+def _run_telemetry(hashseed: str) -> str:
+    env = dict(os.environ)
+    env["PYTHONHASHSEED"] = hashseed
+    env["JAX_PLATFORMS"] = "cpu"
+    p = subprocess.run(
+        [sys.executable, "-c", TELEMETRY_SCRIPT],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        env=env,
+        cwd=REPO,
+    )
+    assert p.returncode == 0, p.stderr[-2000:]
+    return p.stdout
+
+
+def test_telemetry_byte_identical_across_hash_seeds():
+    a = _run_telemetry("1")
+    b = _run_telemetry("2")
+    assert "spans:" in a and "soak:" in a
+    assert a == b, f"telemetry nondeterminism across interpreters:\nA:\n{a[:2000]}\nB:\n{b[:2000]}"

@@ -1196,7 +1196,7 @@ def _expected_markers(case_dir):
 
 @pytest.mark.parametrize(
     "case", ["wait_rules", "rpy_cases", "det101_pkg", "env_cases",
-             "spn_cases", "prm_cases"]
+             "spn_cases", "prm_cases", "race_cases"]
 )
 def test_golden_corpus(case, capsys):
     case_dir = os.path.join(CASES_DIR, case)
@@ -1336,6 +1336,12 @@ def test_per_rule_counts_surface(package_findings):
     assert counts["WAIT001"]["suppressed"] >= 1
     text = format_counts(package_findings)
     assert "DET001=" in text and "WAIT001=" in text
+    # The RACE family + ENV002 surface in the counts line EVEN AT ZERO:
+    # a burned-down family that silently vanished from the output is how
+    # it quietly regrows.
+    for rule in ("RACE001", "RACE002", "RACE003", "RACE004", "ENV002"):
+        assert f"{rule}=" in text, text
+    assert "RACE003=" in format_counts([])  # zero findings still shows it
 
 
 # ---------------------------------------------------------------------------
@@ -1449,5 +1455,6 @@ def test_changed_only_outside_git_falls_back_to_full_scan(tmp_path, capsys):
 
 
 def test_new_rules_registered_and_documented():
-    for rule in ("WAIT001", "WAIT002", "DET101", "RPY001", "ENV001"):
+    for rule in ("WAIT001", "WAIT002", "DET101", "RPY001", "ENV001",
+                 "RACE001", "RACE002", "RACE003", "RACE004", "ENV002"):
         assert rule in RULES and RULES[rule]

@@ -1,0 +1,317 @@
+"""Dynamic twins of the racecheck static pass (tools/lint/races.py).
+
+Three gates ride here:
+
+- the FDB_TPU_STATE_SANITIZER shared-state sanitizer must catch a PLANTED
+  lost update within one run, and stay quiet on a clean full SimCluster
+  commit workload (pipelined commits included) with real production dicts
+  audited;
+- the FDB_TPU_SCHED_FUZZ scheduler-perturbation mode must replay
+  byte-identically for the same (seed, fuzz) and keep the differential
+  commit gates green across >=3 fuzz seeds (each a different LEGAL
+  interleaving);
+- the structural fixes the static pass forced (resolver_balancer's
+  validated repartition commit, the transaction GRV first-resolution-wins
+  re-check, DiskQueue's header-dirty ordering) are regression-pinned.
+"""
+
+import pytest
+
+from foundationdb_tpu.fileio import DiskQueue, SimFileSystem
+from foundationdb_tpu.flow import EventLoop, set_event_loop
+from foundationdb_tpu.flow.eventloop import all_of
+from foundationdb_tpu.flow.state_sanitizer import (
+    audited_dict,
+    expect_clean_shared_state,
+)
+from foundationdb_tpu.rpc import SimNetwork
+from foundationdb_tpu.server import SimCluster
+from foundationdb_tpu.server import system_keys as sk
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+# ---------------------------------------------------------------------------
+# State sanitizer
+# ---------------------------------------------------------------------------
+
+
+def test_sanitizer_catches_planted_lost_update(monkeypatch):
+    """Two actors read-modify-write the same key across an await: the
+    classic lost update.  The sanitizer must report the stale-read→write
+    pair in the same run (and the data really is wrong: 1, not 2)."""
+    monkeypatch.setenv("FDB_TPU_STATE_SANITIZER", "1")
+    loop = EventLoop(seed=7)
+    set_event_loop(loop)
+    shared = audited_dict(loop, "planted.counter", {"n": 0})
+
+    async def bump():
+        val = shared["n"]  # read ...
+        await loop.delay(0.01)  # ... suspension: the other bump runs ...
+        shared["n"] = val + 1  # ... write from the stale read
+
+    done = all_of([loop.spawn(bump(), "bump_a"), loop.spawn(bump(), "bump_b")])
+    loop.run_until(done, timeout_vt=10.0)
+    assert shared["n"] == 1  # one increment was lost
+    san = loop._state_sanitizer
+    assert len(san.violations) == 1, san.violations
+    assert "planted.counter['n']" in san.violations[0]
+    assert "lost update" in san.violations[0]
+    with pytest.raises(AssertionError, match="stale-read→write"):
+        expect_clean_shared_state(loop, "planted")
+
+
+def test_sanitizer_ignores_recheck_discipline(monkeypatch):
+    """Read → await → RE-READ → write is the sanctioned shape (the
+    re-check refreshes the reader's knowledge): no violation."""
+    monkeypatch.setenv("FDB_TPU_STATE_SANITIZER", "1")
+    loop = EventLoop(seed=8)
+    set_event_loop(loop)
+    shared = audited_dict(loop, "clean.counter", {"n": 0})
+
+    async def bump():
+        _ = shared["n"]
+        await loop.delay(0.01)
+        shared["n"] = shared["n"] + 1  # re-read in the write step
+
+    done = all_of([loop.spawn(bump(), "bump_a"), loop.spawn(bump(), "bump_b")])
+    loop.run_until(done, timeout_vt=10.0)
+    assert shared["n"] == 2
+    expect_clean_shared_state(loop, "recheck")  # must not raise
+
+
+def test_sanitizer_blindness_check(monkeypatch):
+    """Flag set but nothing audited: the shutdown check must refuse to
+    silently pass (mirrors expect_no_orphaned_waits' tracking guard)."""
+    monkeypatch.setenv("FDB_TPU_STATE_SANITIZER", "1")
+    loop = EventLoop(seed=9)
+    with pytest.raises(AssertionError, match="blind"):
+        expect_clean_shared_state(loop)
+
+
+def test_sanitizer_off_is_plain_dict(monkeypatch):
+    monkeypatch.delenv("FDB_TPU_STATE_SANITIZER", raising=False)
+    loop = EventLoop(seed=10)
+    d = audited_dict(loop, "anything", {"k": 1})
+    assert type(d) is dict
+    assert getattr(loop, "_state_sanitizer", None) is None
+    expect_clean_shared_state(loop)  # no-op with the flag off
+
+
+def _commit_workload(c: SimCluster, rounds: int = 3, actors: int = 4):
+    """Concurrent committing actors (conflicting + disjoint keys): drives
+    the proxy's pipelined commit path (park/drain at depth 2) plus GRV
+    batching and the CC's registration/ping registry."""
+    db = c.database()
+    out = {}
+
+    async def actor(aid):
+        for r in range(rounds):
+            async def op(tr, aid=aid, r=r):
+                cur = await tr.get(b"shared")
+                tr.set(b"shared", (cur or b"") + b"%d" % aid)
+                tr.set(b"a%02d/%02d" % (aid, r), b"v")
+
+            await db.run(op)
+
+    async def check(tr):
+        out["shared"] = await tr.get(b"shared")
+        out["rows"] = await tr.get_range(b"a", b"b")
+
+    async def drive():
+        await all_of(
+            [db.process.spawn(actor(i), f"wl_{i}") for i in range(actors)]
+        )
+
+    c.run_all([(db, drive())], timeout_vt=3000.0)
+    c.run_all([(db, db.run(check))], timeout_vt=1000.0)
+    assert len(out["shared"]) == rounds * actors  # every commit landed
+    assert len(out["rows"]) == rounds * actors
+    return out
+
+
+def test_sanitizer_quiet_on_full_commit_workload(monkeypatch):
+    """Cross-validation: production audited dicts (the CC worker registry,
+    the proxy server-list map) stay clean on a full SimCluster commit
+    workload — the structural disciplines racecheck enforced really do
+    hold at runtime."""
+    monkeypatch.setenv("FDB_TPU_STATE_SANITIZER", "1")
+    from foundationdb_tpu.server.dynamic_cluster import DynamicCluster
+
+    c = DynamicCluster(seed=41, n_workers=5)
+    _commit_workload(c)
+    san = getattr(c.loop, "_state_sanitizer", None)
+    assert san is not None and "cluster_controller.workers" in san.names
+    assert "proxy.server_list" in san.names
+    expect_clean_shared_state(c.loop, "commit workload shutdown")
+
+
+# ---------------------------------------------------------------------------
+# Scheduler-perturbation replay gates (FDB_TPU_SCHED_FUZZ)
+# ---------------------------------------------------------------------------
+
+
+def _fingerprint(c: SimCluster, out) -> tuple:
+    return (
+        out["shared"],
+        tuple(out["rows"]),
+        c.loop.tasks_run,
+        round(c.loop.now(), 9),
+        round(c.loop.rng.random01(), 12),
+    )
+
+
+def _fuzzed_run(seed: int, fuzz: int) -> tuple:
+    c = SimCluster(seed=seed, n_proxies=2)
+    out = _commit_workload(c)
+    fp = _fingerprint(c, out)
+    set_event_loop(None)
+    return fp
+
+
+@pytest.mark.parametrize("fuzz", [1, 2, 3])
+def test_commit_gate_green_and_replayable_under_sched_fuzz(monkeypatch, fuzz):
+    """Each fuzz value is a different legal interleaving: the commit
+    workload's invariants must hold (asserted inside _commit_workload),
+    and the same (seed, fuzz) must replay to an identical fingerprint."""
+    monkeypatch.setenv("FDB_TPU_SCHED_FUZZ", str(fuzz))
+    a = _fuzzed_run(113, fuzz)
+    b = _fuzzed_run(113, fuzz)
+    assert a == b, f"same (seed, fuzz={fuzz}) must replay byte-identically"
+
+
+def test_sched_fuzz_perturbs_the_interleaving(monkeypatch):
+    """Different fuzz values must actually explore different schedules
+    (else the gate is a no-op): the run fingerprints cannot all agree."""
+    fps = []
+    for fuzz in ("", "1", "2", "3"):
+        if fuzz:
+            monkeypatch.setenv("FDB_TPU_SCHED_FUZZ", fuzz)
+        else:
+            monkeypatch.delenv("FDB_TPU_SCHED_FUZZ", raising=False)
+        fps.append(_fuzzed_run(113, int(fuzz or 0)))
+    assert len({fp[2:] for fp in fps}) > 1, fps
+
+
+# ---------------------------------------------------------------------------
+# Regression pins for the structural fixes racecheck forced
+# ---------------------------------------------------------------------------
+
+
+def test_balancer_drops_stale_plan_instead_of_stomping(monkeypatch):
+    """RACE001/WAIT001 fix pin: a competing repartition landing while
+    run_once is suspended must abort this round — the durable partition
+    and the in-memory view are never rebuilt from the stale snapshot."""
+    c = SimCluster(seed=102, n_resolvers=2)
+    assert c.split_keys == [b"\x80"]
+    db = c.database()
+
+    async def load():
+        for i in range(60):
+            async def op(tr, i=i):
+                k = b"hot/%03d" % (i % 20)
+                await tr.get(k)
+                tr.set(k, b"x%d" % i)
+
+            await db.run(op)
+
+    c.run_all([(db, load())], timeout_vt=4000.0)
+    bal = c.resolver_balancer(min_ops=20, ratio=1.5)
+
+    competing = [b"\x40"]
+    orig_run = bal.db.run
+
+    async def hijack(txn):
+        # A competing mover commits a different partition just before the
+        # balancer's own commit (i.e. during its await window).
+        async def other(tr):
+            tr.options["access_system_keys"] = True
+            tr.set(
+                sk.RESOLVER_SPLIT_KEY, sk.encode_resolver_split(competing)
+            )
+
+        await orig_run(other)
+        bal.db.run = orig_run  # only the balancer's commit is hijacked
+        return await orig_run(txn)
+
+    bal.db.run = hijack
+    moved = c.run_until(db.process.spawn(bal.run_once()), timeout_vt=1000.0)
+    assert moved is None
+    assert bal.moves == 0
+    # The stale plan was dropped, not stomped over the competing one.
+    assert bal.split_keys == [b"\x80"]
+
+    async def read_durable(tr):
+        tr.options["access_system_keys"] = True
+        return await tr.get(sk.RESOLVER_SPLIT_KEY)
+
+    durable = c.run_until(
+        db.process.spawn(orig_run(read_durable)), timeout_vt=1000.0
+    )
+    assert sk.decode_resolver_split(durable) == competing
+
+
+def test_grv_concurrent_requests_one_snapshot(monkeypatch):
+    """RACE001 fix pin: two get_read_version calls racing on one
+    transaction must resolve to ONE snapshot version (first resolution
+    wins) — never split the transaction's reads across two versions."""
+    c = SimCluster(seed=43)
+    db = c.database()
+    out = {}
+
+    async def go(tr):
+        t1 = db.process.spawn(tr.get_read_version(), "grv1")
+        t2 = db.process.spawn(tr.get_read_version(), "grv2")
+        a, b = await all_of([t1, t2])
+        out["versions"] = (a, b, tr._read_version)
+
+    c.run_all([(db, db.run(go))], timeout_vt=1000.0)
+    a, b, cached = out["versions"]
+    assert a == b == cached
+
+
+def test_diskqueue_pop_during_header_write_not_lost():
+    """RACE001 fix pin: a pop() landing while the header write is in
+    flight must re-dirty the header so the NEXT commit persists the newer
+    popped_seq (the old ordering cleared the flag after the await and
+    silently dropped the pop's progress)."""
+    loop = EventLoop(seed=11)
+    set_event_loop(loop)
+    net = SimNetwork(loop)
+    fs = SimFileSystem(net)
+    proc = net.process("node")
+    state = {}
+
+    async def scenario():
+        q, rec = await DiskQueue.open(fs, proc, "hdr.dq")
+        for s in range(1, 4):
+            q.push(s, b"p%d" % s)
+        await q.commit()
+        q.pop(1)
+
+        # Interleave a pop exactly when the header write is issued.
+        real_write = q._file.write
+
+        async def write_hook(offset, data):
+            if offset == 0 and "late_pop" not in state:
+                state["late_pop"] = True
+                q.pop(2)  # lands while the header write is in flight
+            await real_write(offset, data)
+
+        q._file.write = write_hook
+        await q.commit()  # persists popped=1; pop(2) arrives mid-write
+        q._file.write = real_write
+        assert q._header_dirty  # the late pop re-dirtied the header
+        await q.commit()  # must persist popped=2
+
+        q2, rec2 = await DiskQueue.open(fs, proc, "hdr.dq")
+        state["popped"] = q2.popped_seq
+        state["recovered"] = [s for s, _p in rec2]
+
+    loop.run_until(proc.spawn(scenario()), timeout_vt=100.0)
+    assert state["popped"] == 2
+    assert state["recovered"] == [3]
