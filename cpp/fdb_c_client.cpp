@@ -377,7 +377,12 @@ struct Connection {
         throw WireError("bad reply");
       bool is_err = reply->items[0]->tag == T_TRUE;
       if (is_err) {
-        *err_name = reply->items[1]->bytes;  // error name string
+        // Bare errors are (True, name); a structured cause widens to
+        // (True, (name, detail)).  The C ABI surfaces numeric codes
+        // only, so take the name and drop the detail.
+        auto ev = reply->items[1];
+        if (ev->tag == T_TUPLE && !ev->items.empty()) ev = ev->items[0];
+        *err_name = ev->bytes;  // error name string
         return nullptr;
       }
       err_name->clear();

@@ -101,12 +101,19 @@ class Database:
             "grv": ContinuousSample(rng),
             "commit": ContinuousSample(rng),
         }
+        # Retries that skipped the GRV round-trip because a structured
+        # not_committed carried a witness retry hint (ISSUE 17) — the
+        # soak's A/B arm reads this to attribute goodput.
+        self.witness_hint_retries = 0
         if info_var is not None:
             from ..server.failure_monitor import run_failure_monitor_client
 
             process.spawn(
                 run_failure_monitor_client(self), "failure_monitor_client"
             )
+
+    def _note_hint_retry(self) -> None:
+        self.witness_hint_retries += 1
 
     def _sample_debug_id(self) -> Optional[str]:
         """A fresh debug id for the latency trace chain, or None when the
@@ -871,21 +878,48 @@ class Transaction:
             )
 
     async def on_error(self, e: FdbError):
-        """Backoff + reset if retryable, else re-raise (ref: onError)."""
+        """Backoff + reset if retryable, else re-raise (ref: onError).
+
+        Witness-guided retry (ISSUE 17): a structured not_committed
+        carries the combined abort witness, including retry_version —
+        the version the aborting batch resolved at, i.e. the newest
+        snapshot at which the lost conflict is fully visible.  With
+        FDB_TPU_WITNESS_RETRY on, the next attempt seeds its read
+        version there instead of paying a fresh GRV round-trip, and
+        skips the blind backoff: the backoff exists because an
+        UNINFORMED retry risks stampeding with the same stale view,
+        but a hinted retry is guaranteed to observe the write that
+        aborted us, so the livelock it guards against cannot recur
+        (reference clients always back off and re-GRV; fdbserver
+        returns only the bare error)."""
         if not (
             e.is_retryable_in_transaction() or e.name == "broken_promise"
         ):
             raise e
+        from ..flow.knobs import g_env
+
+        hint = None
+        if (
+            e.name == "not_committed"
+            and isinstance(e.detail, dict)
+            and e.detail.get("retry_version") is not None
+            and g_env.get("FDB_TPU_WITNESS_RETRY") not in ("", "0")
+        ):
+            hint = int(e.detail["retry_version"])
         ck = g_knobs.client
         delay = min(
             ck.max_retry_delay,
             ck.initial_retry_delay * (2 ** min(self._retries, 30)),
         )
         self._retries += 1
-        await self.db.process.network.loop.delay(
-            delay * self.db.process.network.loop.rng.random01()
-        )
+        if hint is None:
+            await self.db.process.network.loop.delay(
+                delay * self.db.process.network.loop.rng.random01()
+            )
         self.reset()
+        if hint is not None:
+            self._read_version = hint
+            self.db._note_hint_retry()
 
     def reset(self):
         self._read_version = None

@@ -80,7 +80,7 @@ class InflightBatch:
     device work)."""
 
     __slots__ = ("txns", "ticket", "now", "new_oldest_version",
-                 "statuses", "degraded", "span", "device_span")
+                 "statuses", "degraded", "span", "device_span", "witness")
 
     def __init__(self, txns, ticket, now, new_oldest_version):
         self.txns = txns
@@ -89,6 +89,10 @@ class InflightBatch:
         self.new_oldest_version = new_oldest_version
         self.statuses: Optional[List[int]] = None
         self.degraded = False
+        # Per-txn abort witness (ISSUE 17): (version, read-range ordinal)
+        # or None per txn, set with statuses at completion; [] when
+        # witness emission is off.
+        self.witness: list = []
         # Span layer (ISSUE 12): the owning batch span (the resolver's
         # resolve_batch, captured off the hub stack at dispatch) and the
         # device in-flight span [dispatch done -> sync returned] whose
@@ -97,19 +101,23 @@ class InflightBatch:
         self.device_span = None
 
     @classmethod
-    def completed(cls, statuses: List[int], degraded: bool = False):
+    def completed(cls, statuses: List[int], degraded: bool = False,
+                  witness: Optional[list] = None):
         e = cls(None, None, 0, 0)
         e.statuses = statuses
         e.degraded = degraded
+        e.witness = witness if witness is not None else []
         return e
 
     @property
     def done(self) -> bool:
         return self.statuses is not None
 
-    def _resolve(self, statuses: List[int], degraded: bool) -> None:
+    def _resolve(self, statuses: List[int], degraded: bool,
+                 witness: Optional[list] = None) -> None:
         self.statuses = statuses
         self.degraded = degraded
+        self.witness = witness if witness is not None else []
 
 
 def env_h_cap() -> int:
@@ -236,6 +244,12 @@ class ConflictSet:
         # Last consistency-check report (mirror_check): surfaced through
         # device_metrics()["mirror"] and `cli mirror-check`.
         self._last_mirror_check: Optional[dict] = None
+        # Abort-witness provenance (ISSUE 17): whichever engine serves a
+        # batch, its per-txn witness lands here (and on the pipeline
+        # entry) — degraded and replayed batches report bit-identical
+        # provenance because every engine computes the identical rule.
+        self._witness = g_env.get("FDB_TPU_WITNESS") not in ("", "0")
+        self.last_witness: list = []
 
     AUTHORITY_HYSTERESIS = 8
 
@@ -276,7 +290,16 @@ class ConflictSet:
             return self._detect_hybrid(txns, now, new_oldest_version)
         if self.backend == "jax":
             return self._detect_device(txns, now, new_oldest_version)
-        return self._engine_for_authority().detect(txns, now, new_oldest_version)
+        eng = self._engine_for_authority()
+        statuses = eng.detect(txns, now, new_oldest_version)
+        self.last_witness = self._witness_of(eng)
+        return statuses
+
+    def _witness_of(self, engine) -> list:
+        """The serving engine's per-txn witness for the batch it just
+        decided — the one place the surface reads it, so every serve
+        path (device, mirror fallback, replay) reports identically."""
+        return list(engine.last_witness) if self._witness else []
 
     def _device_eligible(self, txns, now: int = 0) -> bool:
         """Every key in the batch fits the device width and no long-key
@@ -408,11 +431,16 @@ class ConflictSet:
         if self._device_eligible(txns, now):
             statuses = self._device_serve(txns, now, new_oldest_version)
             if statuses is not None:
+                self.last_witness = self._witness_of(self._jax)
                 return statuses
             self._device_stale = True
-            return self._cpu_detect_fallback(txns, now, new_oldest_version)
+            statuses = self._cpu_detect_fallback(txns, now, new_oldest_version)
+            self.last_witness = self._witness_of(self._cpu)
+            return statuses
         self._device_stale = True
-        return self._cpu.detect(txns, now, new_oldest_version)
+        statuses = self._cpu.detect(txns, now, new_oldest_version)
+        self.last_witness = self._witness_of(self._cpu)
+        return statuses
 
     def _hybrid_wants_device(self, txns, now) -> bool:
         """Hybrid routing decision (+ its hysteresis state updates),
@@ -440,6 +468,7 @@ class ConflictSet:
         if attempted:
             statuses = self._device_serve(txns, now, new_oldest_version)
             if statuses is not None:
+                self.last_witness = self._witness_of(self._jax)
                 return statuses
         if self._authority == "jax":
             # Flip back host-side.  No store_to needed: the mirror already
@@ -450,8 +479,11 @@ class ConflictSet:
         if attempted:
             # Degraded serve (not by-design small-batch routing): measure
             # the mirror's throughput for admission control.
-            return self._cpu_detect_fallback(txns, now, new_oldest_version)
-        return self._cpu.detect(txns, now, new_oldest_version)
+            statuses = self._cpu_detect_fallback(txns, now, new_oldest_version)
+        else:
+            statuses = self._cpu.detect(txns, now, new_oldest_version)
+        self.last_witness = self._witness_of(self._cpu)
+        return statuses
 
     # -- double-buffered pipeline (ISSUE 11) ------------------------------
     @property
@@ -494,8 +526,11 @@ class ConflictSet:
             statuses = self._cpu_detect_fallback(
                 txns, now, new_oldest_version
             )
+            self.last_witness = self._witness_of(self._cpu)
             self.consume_degraded()  # folded into the entry's flag
-            return InflightBatch.completed(statuses, degraded=True)
+            return InflightBatch.completed(
+                statuses, degraded=True, witness=self.last_witness
+            )
         if self._jax is not None and self.pipeline_depth > 1:
             # Routing above chose the CPU (ineligible keys or hybrid
             # small-batch): do the sync path's post-routing bookkeeping
@@ -508,14 +543,17 @@ class ConflictSet:
                 self._small_streak = 0
             self._device_stale = True
             statuses = self._cpu.detect(txns, now, new_oldest_version)
+            self.last_witness = self._witness_of(self._cpu)
             return InflightBatch.completed(
-                statuses, degraded=self.consume_degraded()
+                statuses, degraded=self.consume_degraded(),
+                witness=self.last_witness,
             )
         # Depth 1 or host-only backend: the synchronous path decides,
         # against a drained (current) mirror.
         statuses = self._detect(txns, now, new_oldest_version)
         return InflightBatch.completed(
-            statuses, degraded=self.consume_degraded()
+            statuses, degraded=self.consume_degraded(),
+            witness=self.last_witness,
         )
 
     def _pipeline_dispatch(
@@ -655,7 +693,9 @@ class ConflictSet:
                     snapshot(),
                     take_fresh() if take_fresh is not None else None,
                 )
-        entry._resolve(statuses_list, degraded=False)
+        self.last_witness = self._witness_of(self._jax)
+        entry._resolve(statuses_list, degraded=False,
+                       witness=self.last_witness)
 
     def _pipeline_replay_on_mirror(self, degraded: bool = True) -> None:
         """Drain every in-flight batch onto the authoritative mirror, in
@@ -688,7 +728,9 @@ class ConflictSet:
                 statuses = self._cpu.detect(
                     entry.txns, entry.now, entry.new_oldest_version
                 )
-            entry._resolve(statuses, degraded=degraded)
+            self.last_witness = self._witness_of(self._cpu)
+            entry._resolve(statuses, degraded=degraded,
+                           witness=self.last_witness)
         self._degraded_last = False  # per-entry flags carry it instead
 
     def pipeline_drain(self) -> None:

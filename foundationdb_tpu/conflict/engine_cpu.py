@@ -140,6 +140,8 @@ class CpuConflictSet:
         self._count = 1
         self._stamp = 0
         self._flat: Optional[Tuple[list, list]] = None
+        # Per-txn abort witness of the most recent detect() (ISSUE 17).
+        self.last_witness: list = []
         # Staged halves of a flat (keys, vers) adoption — see the property
         # setters: store_to-style callers assign .keys then .vers.
         self._staged_keys: Optional[list] = None
@@ -316,28 +318,49 @@ class CpuConflictSet:
         new_oldest_version: int,
     ) -> List[int]:
         statuses: list[int] = [COMMITTED] * len(transactions)
+        # Abort witness (ISSUE 17): per txn, (conflicting write version,
+        # losing read-range index into tr.read_ranges) — None unless the
+        # final status is CONFLICT.  The device engine reproduces these
+        # bit-identically; history conflicts take the FIRST conflicting
+        # range and the max committed version inside it, intra-batch
+        # conflicts take the first range intersecting the in-batch write
+        # union at version `now`.
+        witness: list = [None] * len(transactions)
 
         # Phase 1: too-old + history conflicts (ref checkReadConflictRanges)
         for t, tr in enumerate(transactions):
             if tr.read_snapshot < self.oldest_version and tr.read_ranges:
                 statuses[t] = TOO_OLD
                 continue
-            for (rb, re_) in tr.read_ranges:
-                if rb < re_ and self._range_max(rb, re_) > tr.read_snapshot:
-                    statuses[t] = CONFLICT
-                    break
+            for i, (rb, re_) in enumerate(tr.read_ranges):
+                if rb < re_:
+                    m = self._range_max(rb, re_)
+                    if m > tr.read_snapshot:
+                        statuses[t] = CONFLICT
+                        witness[t] = (m, i)
+                        break
 
         # Phase 2: intra-batch, in order (ref checkIntraBatchConflicts)
         active = _IntervalSet()
         for t, tr in enumerate(transactions):
             if statuses[t] != COMMITTED:
                 continue
-            if any(active.intersects(rb, re_) for (rb, re_) in tr.read_ranges):
+            hit = next(
+                (
+                    i
+                    for i, (rb, re_) in enumerate(tr.read_ranges)
+                    if active.intersects(rb, re_)
+                ),
+                None,
+            )
+            if hit is not None:
                 statuses[t] = CONFLICT
+                witness[t] = (now, hit)
                 continue
             for (wb, we) in tr.write_ranges:
                 active.add(wb, we)
 
+        self.last_witness = witness
         self._commit_writes(active, now, new_oldest_version)
         return statuses
 

@@ -70,6 +70,8 @@ class FlatCpuConflictSet:
         self.oldest_version = oldest_version
         self.keys: list[bytes] = [b""]
         self.vers: list[int] = [FLOOR_VERSION]
+        # Per-txn abort witness of the most recent detect() (ISSUE 17).
+        self.last_witness: list = []
 
     # -- history step function --
     def _range_max(self, b: bytes, e: bytes) -> int:
@@ -102,28 +104,45 @@ class FlatCpuConflictSet:
         new_oldest_version: int,
     ) -> List[int]:
         statuses: list[int] = [COMMITTED] * len(transactions)
+        # Abort witness (ISSUE 17): (version, read-range index) per
+        # CONFLICT txn, None otherwise — identical rule to CpuConflictSet
+        # so the two mirrors stay differential-gate-identical.
+        witness: list = [None] * len(transactions)
 
         # Phase 1: too-old + history conflicts (ref checkReadConflictRanges)
         for t, tr in enumerate(transactions):
             if tr.read_snapshot < self.oldest_version and tr.read_ranges:
                 statuses[t] = TOO_OLD
                 continue
-            for (rb, re_) in tr.read_ranges:
-                if rb < re_ and self._range_max(rb, re_) > tr.read_snapshot:
-                    statuses[t] = CONFLICT
-                    break
+            for i, (rb, re_) in enumerate(tr.read_ranges):
+                if rb < re_:
+                    m = self._range_max(rb, re_)
+                    if m > tr.read_snapshot:
+                        statuses[t] = CONFLICT
+                        witness[t] = (m, i)
+                        break
 
         # Phase 2: intra-batch, in order (ref checkIntraBatchConflicts)
         active = _IntervalSet()
         for t, tr in enumerate(transactions):
             if statuses[t] != COMMITTED:
                 continue
-            if any(active.intersects(rb, re_) for (rb, re_) in tr.read_ranges):
+            hit = next(
+                (
+                    i
+                    for i, (rb, re_) in enumerate(tr.read_ranges)
+                    if active.intersects(rb, re_)
+                ),
+                None,
+            )
+            if hit is not None:
                 statuses[t] = CONFLICT
+                witness[t] = (now, hit)
                 continue
             for (wb, we) in tr.write_ranges:
                 active.add(wb, we)
 
+        self.last_witness = witness
         self._commit_writes(active, now, new_oldest_version)
         return statuses
 

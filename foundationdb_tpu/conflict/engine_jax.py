@@ -60,6 +60,10 @@ from .types import COMMITTED, CONFLICT, TOO_OLD, TransactionConflictInfo
 FLOOR_REL = -(2**30)  # below every representable snapshot
 REBASE_THRESHOLD = 2**29
 
+# Abort-witness sentinels (ISSUE 17): per-txn witness slots for txns whose
+# final status is not CONFLICT carry (FLOOR_REL, WITNESS_NONE_RANGE).
+WITNESS_NONE_RANGE = 2**31 - 1
+
 _UNDECIDED = 0
 _COMM = 1
 _CONF = 2
@@ -100,6 +104,26 @@ def _unpack_transactions(pb: "PackedBatch") -> List[TransactionConflictInfo]:
     return txns
 
 
+def decode_witness(pb, statuses, w_ver, w_rng, base):
+    """Decode device witness vectors to the host form: per live txn,
+    (absolute conflicting version, read-range ordinal within that txn) —
+    or None for non-CONFLICT txns.  The packed read index is global
+    (r_txn is ascending and from_transactions packs EVERY read range,
+    empty ones included), so the per-txn ordinal is the global index
+    minus the txn's first packed row."""
+    wv = np.asarray(w_ver)
+    wr = np.asarray(w_rng)
+    r_txn = pb.r_txn[: pb.n_r]
+    out: list = []
+    for t in range(pb.n_txn):
+        if int(statuses[t]) == CONFLICT and int(wr[t]) < WITNESS_NONE_RANGE:
+            first = int(np.searchsorted(r_txn, t, side="left"))
+            out.append((int(wv[t]) + base, int(wr[t]) - first))
+        else:
+            out.append(None)
+    return out
+
+
 class DispatchTicket:
     """One in-flight dispatched batch (the double-buffered resolver
     pipeline's device-side handle, ISSUE 11): the packed batch plus the
@@ -111,10 +135,10 @@ class DispatchTicket:
     in dispatch order)."""
 
     __slots__ = ("pb", "statuses", "undecided", "iters", "hcount",
-                 "dcount", "d_cap", "now", "new_oldest_version")
+                 "dcount", "d_cap", "now", "new_oldest_version", "witness")
 
     def __init__(self, pb, statuses, undecided, iters, hcount, dcount,
-                 d_cap, now, new_oldest_version):
+                 d_cap, now, new_oldest_version, witness=None):
         self.pb = pb
         self.statuses = statuses
         self.undecided = undecided
@@ -124,6 +148,9 @@ class DispatchTicket:
         self.d_cap = d_cap  # delta capacity AT dispatch (may grow later)
         self.now = now
         self.new_oldest_version = new_oldest_version
+        # (w_ver_dev, w_rng_dev, base) at dispatch time, or None.  Carries
+        # its own base: a later dispatch may rebase before the sync.
+        self.witness = witness
 
 
 class PackedBatch:
@@ -274,12 +301,14 @@ def _evict_rule(merged_vers, merged_count, new_oldest, width):
 
 def _resolve_batch(
     r_begin, r_end, r_txn, w_begin, w_end, w_txn, t_valid, status0,
-    *, txn_cap, rr_cap, wr_cap, ablate=frozenset(),
+    *, txn_cap, rr_cap, wr_cap, ablate=frozenset(), witness=False,
 ):
     """Phases 2-4: point domain, intra-batch fixpoint, committed-write
     segment extraction.  History-independent — shared verbatim by the flat
     and tiered steps.  Returns (status, iters, undecided_left, ub, ue,
-    seg_valid, nseg)."""
+    seg_valid, nseg, ib_flag) — ib_flag is the per-read-range intra-batch
+    conflict flag (the abort-witness input, ISSUE 17) when `witness`,
+    else None so the default compile is byte-identical."""
     kw1 = r_begin.shape[0]
     TXN, RR, WR = txn_cap, rr_cap, wr_cap
     P = 2 * RR + 2 * WR
@@ -473,6 +502,20 @@ def _resolve_batch(
         overflow, jnp.int32(1), jnp.int32(0)
     )
 
+    # Abort witness input (ISSUE 17): with the fixpoint settled, one more
+    # full-width stabbing over the FINAL committed writers answers, per
+    # read range, whether an EARLIER committed txn's write intersects it —
+    # exactly the CPU engine's phase-2 `active.intersects` predicate
+    # (sequentially, the active set when txn t is checked is the write
+    # union of committed txns < t, and every final-committed writer < t
+    # is in it).
+    ib_flag = None
+    if witness:
+        w_stat_fin = status[jnp.clip(w_txn, 0, TXN - 1)]
+        com_fin = w_valid & (w_stat_fin == _COMM)
+        e_fin = read_query(stabbing_min(wb_idx, we_idx, w_txn, com_fin, p_log2))
+        ib_flag = r_valid & (e_fin < r_txn)
+
     # ---- phase 4: committed-write union via point-domain coverage ----
     com_w = w_valid & (status[jnp.clip(w_txn, 0, TXN - 1)] == _COMM)
     delta = (
@@ -510,7 +553,7 @@ def _resolve_batch(
     ue = _compact_to(chain_id, is_chain_last & seg_valid, ue, WR, count=nseg2)
     nseg = nseg2
     seg_valid = jnp.arange(WR) < nseg
-    return status, iters, undecided_left, ub, ue, seg_valid, nseg
+    return status, iters, undecided_left, ub, ue, seg_valid, nseg, ib_flag
 
 
 def _merge_prep(
@@ -727,6 +770,52 @@ def _finish_flat(hkeys, hvers, hcount, oldest, out_keys, out_vers,
     )
 
 
+def _witness_vectors(m, r_hist, hist_conf, ib_flag, r_txn, t_valid, too_old,
+                     status, now_rel, *, txn_cap, rr_cap, witness,
+                     witness_combine=None):
+    """Per-txn abort witness (ISSUE 17): (conflicting version, losing
+    read-range index) for every final-CONFLICT txn, sentinels elsewhere.
+
+    Selection rule — identical to the CPU engines by construction:
+      history conflict     FIRST flagged read range (min packed index;
+                           packing is contiguous per txn in order, so the
+                           min packed index IS the first per-txn ordinal)
+                           at that range's history range-max `m`
+      intra-batch conflict first read range intersecting an earlier
+                           final-committed writer's write, at `now_rel`
+    The two are mutually exclusive per txn (hist-conflicted txns enter
+    the fixpoint pre-decided), so the per-range eligibility just selects
+    by the txn's hist_conf bit.  `witness_combine`, under shard_map,
+    reduces the per-shard vectors into the mesh-global witness (min range
+    index across conflicting shards, max version among its holders).
+    Returns () when `witness` is off — the default compile is untouched.
+    """
+    if not witness:
+        return ()
+    TXN, RR = txn_cap, rr_cap
+    BIG = jnp.int32(WITNESS_NONE_RANGE)
+    r_idx = jnp.arange(RR, dtype=jnp.int32)
+    hist_conf_r = hist_conf[jnp.clip(r_txn, 0, TXN - 1)]
+    elig = jnp.where(hist_conf_r, r_hist, ib_flag)
+    sel = (
+        jnp.full((TXN + 1,), BIG, jnp.int32)
+        .at[jnp.where(elig, r_txn, TXN)]
+        .min(jnp.where(elig, r_idx, BIG))[:TXN]
+    )
+    sel_ok = sel < BIG
+    m_sel = m[jnp.clip(sel, 0, RR - 1)]
+    is_conf = t_valid & ~too_old & (status != _COMM) & sel_ok
+    w_ver = jnp.where(
+        is_conf,
+        jnp.where(hist_conf, m_sel, now_rel),
+        jnp.int32(FLOOR_REL),
+    ).astype(jnp.int32)
+    w_rng = jnp.where(is_conf, sel, BIG)
+    if witness_combine is not None:
+        w_ver, w_rng = witness_combine(w_ver, w_rng)
+    return (w_ver, w_rng)
+
+
 def detect_core(
     hkeys,
     hvers,
@@ -753,6 +842,8 @@ def detect_core(
     kernels: bool = False,
     kernel_interpret: bool = False,
     undecided_combine=None,
+    witness: bool = False,
+    witness_combine=None,
 ):
     from ..flow.knobs import g_env
 
@@ -796,9 +887,12 @@ def detect_core(
     status0 = jnp.where(
         ~t_valid, _COMM, jnp.where(too_old | hist_conf, _CONF, _UNDECIDED)
     ).astype(jnp.int32)
-    status, iters, undecided_left, ub, ue, seg_valid, nseg = _resolve_batch(
-        r_begin, r_end, r_txn, w_begin, w_end, w_txn, t_valid, status0,
-        txn_cap=TXN, rr_cap=RR, wr_cap=WR, ablate=_ablate,
+    status, iters, undecided_left, ub, ue, seg_valid, nseg, ib_flag = (
+        _resolve_batch(
+            r_begin, r_end, r_txn, w_begin, w_end, w_txn, t_valid, status0,
+            txn_cap=TXN, rr_cap=RR, wr_cap=WR, ablate=_ablate,
+            witness=witness,
+        )
     )
     if undecided_combine is not None:
         # Cross-shard convergence gate (ISSUE 15): under shard_map the
@@ -809,13 +903,19 @@ def detect_core(
         # program byte-identical to the pre-hook compile.
         undecided_left = undecided_combine(undecided_left)
 
+    w_extra = _witness_vectors(
+        m, r_hist, hist_conf, ib_flag, r_txn, t_valid, too_old, status,
+        now_rel, txn_cap=TXN, rr_cap=RR, witness=witness,
+        witness_combine=witness_combine,
+    )
+
     # ---- phase 5: rewrite the step function (ref addConflictRanges) ----
     if "nomerge" in _ablate:
         out_status = jnp.where(
             too_old, TOO_OLD, jnp.where(status == _COMM, COMMITTED, CONFLICT)
         ).astype(jnp.int32)
         return (hkeys, hvers, hcount, jnp.maximum(oldest, new_oldest_rel).astype(jnp.int32),
-                out_status, undecided_left.astype(jnp.int32), iters)
+                out_status, undecided_left.astype(jnp.int32), iters) + w_extra
     new_oldest = jnp.maximum(oldest, new_oldest_rel)
     if _kern:
         # Fused kernel arm: merge + evict + compact in one streaming
@@ -838,7 +938,7 @@ def detect_core(
         return _finish_flat(
             hkeys, hvers, hcount, oldest, out_keys, out_vers, out_count,
             new_oldest, too_old, status, undecided_left, iters,
-        )
+        ) + w_extra
     merged_keys, merged_vers, merged_count = _merge_new_segments(
         hkeys, hvers, hcount, ub, ue, seg_valid, nseg, now_rel,
         width=H, wr_cap=WR, kw1=kw1,
@@ -884,7 +984,7 @@ def detect_core(
     return _finish_flat(
         hkeys, hvers, hcount, oldest, out_keys, out_vers, out_count,
         new_oldest, too_old, status, undecided_left, iters,
-    )
+    ) + w_extra
 
 
 # ---------------------------------------------------------------------------
@@ -1025,6 +1125,8 @@ def detect_core_tiered(
     kernels: bool = False,
     kernel_interpret: bool = False,
     undecided_combine=None,
+    witness: bool = False,
+    witness_combine=None,
 ):
     """Two-tier variant of detect_core; decision-identical by construction
     (gated by the differential suites under FDB_TPU_HISTORY=tiered).
@@ -1078,9 +1180,11 @@ def detect_core_tiered(
     status0 = jnp.where(
         ~t_valid, _COMM, jnp.where(too_old | hist_conf, _CONF, _UNDECIDED)
     ).astype(jnp.int32)
-    status, iters, undecided_left, ub, ue, seg_valid, nseg = _resolve_batch(
-        r_begin, r_end, r_txn, w_begin, w_end, w_txn, t_valid, status0,
-        txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap,
+    status, iters, undecided_left, ub, ue, seg_valid, nseg, ib_flag = (
+        _resolve_batch(
+            r_begin, r_end, r_txn, w_begin, w_end, w_txn, t_valid, status0,
+            txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap, witness=witness,
+        )
     )
     if undecided_combine is not None:
         # Cross-shard convergence gate (ISSUE 15; see detect_core): the
@@ -1088,6 +1192,12 @@ def detect_core_tiered(
         # compaction still rewrites the reverted delta physically —
         # becomes all-or-nothing across the mesh's active shards.
         undecided_left = undecided_combine(undecided_left)
+
+    w_extra = _witness_vectors(
+        m, r_hist, hist_conf, ib_flag, r_txn, t_valid, too_old, status,
+        now_rel, txn_cap=TXN, rr_cap=rr_cap, witness=witness,
+        witness_combine=witness_combine,
+    )
 
     # ---- phase 5 into the DELTA tier (delta-sized sorts, or ONE
     # delta-sized streaming pass under FDB_TPU_KERNELS) + phase 6 on the
@@ -1175,7 +1285,7 @@ def detect_core_tiered(
         out_status,
         undecided_left.astype(jnp.int32),
         iters,
-    )
+    ) + w_extra
 
 
 # NOTE detect_core stays undecorated so the sharded resolver
@@ -1245,7 +1355,7 @@ def _blob_offsets(txn_cap: int, rr_cap: int, wr_cap: int, kw1: int):
 
 def _blob_core(hkeys, hvers, hcount, oldest, blob, *, txn_cap, rr_cap,
                wr_cap, h_cap, kw1, amortized=False, kernels=False,
-               kernel_interpret=False):
+               kernel_interpret=False, witness=False):
     offs, _total = _blob_offsets(txn_cap, rr_cap, wr_cap, kw1)
     as_i32 = lambda x: jax.lax.bitcast_convert_type(x, jnp.int32)
     # Key fields are packed word-major (kw1, N): see rangequery.py on TPU
@@ -1273,13 +1383,14 @@ def _blob_core(hkeys, hvers, hcount, oldest, blob, *, txn_cap, rr_cap,
         scalars[2] if amortized else None,
         txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap, h_cap=h_cap,
         kernels=kernels, kernel_interpret=kernel_interpret,
+        witness=witness,
     )
 
 
 _blob_step = partial(
     jax.jit,
     static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "kw1",
-                     "amortized", "kernels", "kernel_interpret"),
+                     "amortized", "kernels", "kernel_interpret", "witness"),
     donate_argnames=("hkeys", "hvers", "hcount", "oldest"),
 )(_blob_core)
 
@@ -1296,13 +1407,14 @@ _blob_step = partial(
 _blob_step_nodonate = partial(
     jax.jit,
     static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "kw1",
-                     "amortized", "kernels", "kernel_interpret"),
+                     "amortized", "kernels", "kernel_interpret", "witness"),
 )(_blob_core)
 
 
 def _tiered_blob_core(hkeys, hvers, hcount, maxtab, dkeys, dvers, dcount,
                       oldest, blob, *, txn_cap, rr_cap, wr_cap, h_cap, d_cap,
-                      kw1, kernels=False, kernel_interpret=False):
+                      kw1, kernels=False, kernel_interpret=False,
+                      witness=False):
     """Tiered twin of _blob_core: same single-transfer blob layout; the
     third scalar slot carries the host's major-compaction decision."""
     offs, _total = _blob_offsets(txn_cap, rr_cap, wr_cap, kw1)
@@ -1327,13 +1439,14 @@ def _tiered_blob_core(hkeys, hvers, hcount, maxtab, dkeys, dvers, dcount,
         scalars[0], scalars[1], scalars[2],
         txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap, h_cap=h_cap,
         d_cap=d_cap, kernels=kernels, kernel_interpret=kernel_interpret,
+        witness=witness,
     )
 
 
 _tiered_blob_step = partial(
     jax.jit,
     static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "d_cap", "kw1",
-                     "kernels", "kernel_interpret"),
+                     "kernels", "kernel_interpret", "witness"),
     donate_argnames=("hkeys", "hvers", "hcount", "maxtab", "dkeys", "dvers",
                      "dcount", "oldest"),
 )(_tiered_blob_core)
@@ -1341,7 +1454,7 @@ _tiered_blob_step = partial(
 _tiered_blob_step_nodonate = partial(
     jax.jit,
     static_argnames=("txn_cap", "rr_cap", "wr_cap", "h_cap", "d_cap", "kw1",
-                     "kernels", "kernel_interpret"),
+                     "kernels", "kernel_interpret", "witness"),
 )(_tiered_blob_core)
 
 
@@ -1533,8 +1646,10 @@ def _ep_flat_step():
         sds((), jnp.int32),                # oldest
         _ep_blob_sds(),                    # blob
     )
+    # witness=True is the canonical trace: FDB_TPU_WITNESS defaults on,
+    # so the committed fingerprints pin the witness-emitting program.
     statics = dict(txn_cap=EP_TXN, rr_cap=EP_RR, wr_cap=EP_WR, h_cap=EP_H,
-                   kw1=EP_KW1, amortized=False)
+                   kw1=EP_KW1, amortized=False, witness=True)
     return _blob_core, _blob_step, args, statics
 
 
@@ -1553,7 +1668,7 @@ def _ep_tiered_step():
         _ep_blob_sds(),                        # blob
     )
     statics = dict(txn_cap=EP_TXN, rr_cap=EP_RR, wr_cap=EP_WR, h_cap=EP_H,
-                   d_cap=EP_D, kw1=EP_KW1)
+                   d_cap=EP_D, kw1=EP_KW1, witness=True)
     return _tiered_blob_core, _tiered_blob_step, args, statics
 
 
@@ -1879,6 +1994,14 @@ class JaxConflictSet:
             jax.default_backend()
         )
         self.tiered = self.history_mode == "tiered"
+        # Abort-witness emission (ISSUE 17): a static jit arg like the
+        # other engine-variant flags, read once at construction.  Default
+        # ON (FDB_TPU_WITNESS=0 restores the witness-free program).
+        self._witness = g_env.get("FDB_TPU_WITNESS") not in ("", "0")
+        # Per-txn (absolute version, read-range ordinal) pairs — or None —
+        # for the most recent decided batch; [] when witness is off.
+        self.last_witness: list = []
+        self._last_witness_dev = None
         self.compact_every = 0
         self.d_cap = 0
         if self.tiered:
@@ -2243,19 +2366,7 @@ class JaxConflictSet:
         )
         try:
             if self.tiered:
-                (
-                    self._hkeys,
-                    self._hvers,
-                    self._hcount,
-                    self._maxtab,
-                    self._dkeys,
-                    self._dvers,
-                    self._dcount,
-                    self._oldest,
-                    statuses,
-                    undecided,
-                    iters,
-                ) = tiered_step(
+                out = tiered_step(
                     self._hkeys,
                     self._hvers,
                     self._hcount,
@@ -2273,17 +2384,24 @@ class JaxConflictSet:
                     kw1=self.key_words + 1,
                     kernels=self._use_kernels,
                     kernel_interpret=self._kernel_interpret,
+                    witness=self._witness,
                 )
-            else:
                 (
                     self._hkeys,
                     self._hvers,
                     self._hcount,
+                    self._maxtab,
+                    self._dkeys,
+                    self._dvers,
+                    self._dcount,
                     self._oldest,
                     statuses,
                     undecided,
                     iters,
-                ) = flat_step(
+                ) = out[:11]
+                wit = out[11:]
+            else:
+                out = flat_step(
                     self._hkeys,
                     self._hvers,
                     self._hcount,
@@ -2297,7 +2415,18 @@ class JaxConflictSet:
                     amortized=amortized,
                     kernels=self._use_kernels,
                     kernel_interpret=self._kernel_interpret,
+                    witness=self._witness,
                 )
+                (
+                    self._hkeys,
+                    self._hvers,
+                    self._hcount,
+                    self._oldest,
+                    statuses,
+                    undecided,
+                    iters,
+                ) = out[:7]
+                wit = out[7:]
         except jax.errors.JaxRuntimeError as e:
             # Real device failures (and ONLY those — a generic Python
             # RuntimeError is a bug and must crash loudly, not vanish
@@ -2343,6 +2472,12 @@ class JaxConflictSet:
             self._hcount_bound = min(
                 self._hcount_bound + 2 * pb.wr_cap, self.h_cap
             )
+        # Witness device arrays travel with the dispatch-time base: a
+        # LATER dispatch may rebase before this batch is synced, and the
+        # rel->abs conversion must use the base the program saw.
+        self._last_witness_dev = (
+            (wit[0], wit[1], self._base) if wit else None
+        )
         return statuses, undecided
 
     def detect_packed(self, pb: PackedBatch, now: int, new_oldest_version: int):
@@ -2378,7 +2513,14 @@ class JaxConflictSet:
             # adopt its result — the resolver must never die on a
             # pathological batch (BASELINE.json's CPU-fallback requirement).
             return self._fallback_cpu(pb, now, new_oldest_version)
-        return np.asarray(statuses)
+        statuses_np = np.asarray(statuses)
+        if self._witness and self._last_witness_dev is not None:
+            self.last_witness = self._witness_host(
+                pb, statuses_np, *self._last_witness_dev
+            )
+        else:
+            self.last_witness = []
+        return statuses_np
 
     # -- pipelined dispatch (ISSUE 11) --
     def dispatch_txns(
@@ -2417,6 +2559,7 @@ class JaxConflictSet:
             d_cap=self.d_cap,
             now=now,
             new_oldest_version=new_oldest_version,
+            witness=self._last_witness_dev,
         )
 
     def sync_ticket(self, ticket: "DispatchTicket"):
@@ -2457,7 +2600,14 @@ class JaxConflictSet:
                 "n_txn", ticket.pb.n_txn
             ).detail("now", ticket.now).detail("pipelined", 1).log()
             return None, True
-        return np.asarray(ticket.statuses), False
+        statuses_np = np.asarray(ticket.statuses)
+        if self._witness and ticket.witness is not None:
+            self.last_witness = self._witness_host(
+                ticket.pb, statuses_np, *ticket.witness
+            )
+        else:
+            self.last_witness = []
+        return statuses_np, False
 
     def _fallback_cpu(self, pb: PackedBatch, now: int, new_oldest_version: int):
         from ..flow.trace import TraceEvent
@@ -2473,9 +2623,15 @@ class JaxConflictSet:
             _unpack_transactions(pb), now=now, new_oldest_version=new_oldest_version
         )
         self.load_from(cpu)
+        # _unpack_transactions preserves read-range order, so the CPU
+        # witness ordinals (and its absolute versions) adopt directly.
+        self.last_witness = cpu.last_witness if self._witness else []
         out = np.full((pb.txn_cap,), COMMITTED, np.int32)
         out[: pb.n_txn] = statuses
         return out
+
+    def _witness_host(self, pb: PackedBatch, statuses, w_ver, w_rng, base):
+        return decode_witness(pb, statuses, w_ver, w_rng, base)
 
     # -- hybrid state exchange with the CPU mirror --
     def _chunk_encoding(self, ch):

@@ -17,6 +17,8 @@ class OracleConflictSet:
         self.oldest_version = oldest_version
         # (begin, end, version) of every committed write still in the window
         self.history: list[tuple[bytes, bytes, int]] = []
+        # Per-txn abort witness of the most recent detect() (ISSUE 17).
+        self.last_witness: list = []
 
     def detect(
         self,
@@ -25,31 +27,46 @@ class OracleConflictSet:
         new_oldest_version: int,
     ) -> List[int]:
         statuses: list[int] = []
+        # Abort witness (ISSUE 17), same rule as the production engines:
+        # first conflicting read range; history conflicts report the max
+        # committed version intersecting that range (== the step
+        # function's range max), intra-batch conflicts report `now`.
+        witness: list = []
         # Writes of in-batch committed txns, visible to later txns only.
         batch_writes: list[tuple[bytes, bytes]] = []
         for tr in transactions:
             # ref SkipList.cpp:985 addTransaction: tooOld needs read ranges
             if tr.read_snapshot < self.oldest_version and tr.read_ranges:
                 statuses.append(TOO_OLD)
+                witness.append(None)
                 continue
-            conflict = False
-            for r in tr.read_ranges:
-                for (b, e, v) in self.history:
-                    if v > tr.read_snapshot and intersects(r, (b, e)):
-                        conflict = True
-                        break
-                if conflict:
+            wtn = None
+            for i, r in enumerate(tr.read_ranges):
+                if any(
+                    v > tr.read_snapshot and intersects(r, (b, e))
+                    for (b, e, v) in self.history
+                ):
+                    wtn = (
+                        max(
+                            v
+                            for (b, e, v) in self.history
+                            if intersects(r, (b, e))
+                        ),
+                        i,
+                    )
                     break
-            if not conflict:
-                for r in tr.read_ranges:
+            if wtn is None:
+                for i, r in enumerate(tr.read_ranges):
                     if any(intersects(r, w) for w in batch_writes):
-                        conflict = True
+                        wtn = (now, i)
                         break
-            if conflict:
+            witness.append(wtn)
+            if wtn is not None:
                 statuses.append(CONFLICT)
             else:
                 statuses.append(COMMITTED)
                 batch_writes.extend(tr.write_ranges)
+        self.last_witness = witness
         self.history.extend((b, e, now) for (b, e) in batch_writes)
         if new_oldest_version > self.oldest_version:
             self.oldest_version = new_oldest_version

@@ -126,17 +126,26 @@ def error_code(name: str) -> int:
 
 
 class FdbError(Exception):
-    """An error with a stable numeric code, as in the reference's Error class."""
+    """An error with a stable numeric code, as in the reference's Error class.
 
-    __slots__ = ("code", "name")
+    `detail` is an optional structured cause riding the error (ISSUE 17) —
+    the reference's Error carries only the code, and fdbserver reports a
+    conflict as a bare not_committed; here the proxy attaches the combined
+    abort witness {"version", "range", "range_index"} so the client's
+    on_error can retry AT the conflicting version instead of paying a
+    fresh GRV round-trip.  Absent (None) on every pre-witness error path:
+    the wire format and equality of bare errors are unchanged."""
 
-    def __init__(self, name_or_code):
+    __slots__ = ("code", "name", "detail")
+
+    def __init__(self, name_or_code, detail=None):
         if isinstance(name_or_code, int):
             self.code = name_or_code
             self.name = _CODE_TO_NAME.get(name_or_code, f"error_{name_or_code}")
         else:
             self.name = name_or_code
             self.code = _ERRORS[name_or_code]
+        self.detail = detail
         super().__init__(f"{self.name} ({self.code})")
 
     def is_retryable_in_transaction(self) -> bool:

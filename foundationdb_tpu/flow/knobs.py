@@ -190,6 +190,21 @@ class ServerKnobs(Knobs):
         # ON but achieving zero overlap for this many batches in a row is
         # a perf incident worth a black box (cooldown-gated per resolver).
         self._init("resolver_pipeline_stall_batches", 12)
+        # Contention explorer (ISSUE 17).  The contended-range sample
+        # decays by halving once per this many CONFLICT-bearing batches —
+        # batch-driven, never time-driven, so a quiescent cluster's top-K
+        # holds steady between soak phases instead of silently emptying.
+        self._init("resolver_witness_decay_batches", 64)
+        # Per-batch abort-timeline ring length: the per-range contention
+        # history `cli contention` joins against span rings and the
+        # decayed top-K.
+        self._init("resolver_contention_ring", 128)
+        # Sustained-contention flight recorder: freeze a black box once
+        # the abort fraction stays at or above the ratio for this many
+        # consecutive batches (cooldown-gated per resolver, like the
+        # pipeline-stall trigger).
+        self._init("resolver_contention_spike_ratio", 0.5)
+        self._init("resolver_contention_spike_batches", 8)
 
 
 class KnobSet:
@@ -252,6 +267,24 @@ class EnvFlags:
     def get_int(self, name: str) -> int:
         return int(self.get(name))
 
+    def override(self, name: str, value):
+        """Set (str) or clear (None) a DECLARED flag in the process
+        environment — the harness-side twin of get(), for A/B arms that
+        toggle a live flag between same-process runs (e.g. the soak's
+        witness-guided vs blind retry comparison).  Returns the previous
+        raw environment value (None = was unset) so callers can restore.
+        Lives here so ENV001 keeps every environment access in this
+        module; only meaningful for flags read at CALL time (see the
+        class docstring's live-vs-frozen discussion)."""
+        if name not in self._decl:
+            raise KeyError(f"undeclared env flag {name} (declare it here)")
+        prev = os.environ.get(name)
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = str(value)
+        return prev
+
     def declared(self) -> dict:
         """name -> (default, help) for docs/status enumeration."""
         return dict(self._decl)
@@ -288,6 +321,22 @@ g_env.declare("FDB_TPU_KERNELS", "",
                    "the interpreter even on TPU, '0' XLA fallback "
                    "everywhere (the A/B arm).  Decision-identical in "
                    "every mode (tests/test_kernels.py)")
+# Abort-witness provenance (ISSUE 17): per-txn (conflicting version,
+# losing read range) from device phase-1 to the client retry hint.
+g_env.declare("FDB_TPU_WITNESS", "1",
+              help="emit per-transaction abort witnesses (conflicting "
+                   "write version + losing read-range ordinal) from the "
+                   "conflict engines: a static jit arg, so '0' restores "
+                   "the witness-free device program byte-for-byte.  "
+                   "Witnesses are bit-identical across the XLA/Pallas "
+                   "arms, the CPU mirror, and the sharded step "
+                   "(tests/test_witness.py differential gate)")
+g_env.declare("FDB_TPU_WITNESS_RETRY", "1",
+              help="client-side witness-guided retry: on a structured "
+                   "not_committed cause, Transaction.on_error seeds the "
+                   "next attempt's read version at the witnessed "
+                   "conflicting version instead of paying a fresh GRV "
+                   "round-trip.  '0' = blind retry (the A/B soak arm)")
 g_env.declare("FDB_TPU_H_CAP", "0",
               help="device history capacity override, in rows, for any "
                    "ConflictSet constructed WITHOUT an explicit h_cap "

@@ -87,6 +87,7 @@ from ..conflict.engine_jax import (
     EP_WR,
     FLOOR_REL,
     REBASE_THRESHOLD,
+    WITNESS_NONE_RANGE,
     PackedBatch,
     _build_max_table_np,
     _grow_step,
@@ -94,12 +95,13 @@ from ..conflict.engine_jax import (
     _rebase_step,
     _unpack_transactions,
     chunk_encoding,
+    decode_witness,
     detect_core,
     detect_core_tiered,
     fold_delta_over_base,
     register_entry_point,
 )
-from ..conflict.types import COMMITTED, TransactionConflictInfo
+from ..conflict.types import COMMITTED, CONFLICT, TransactionConflictInfo
 from ..ops.rangequery import lex_less
 
 AXIS = "resolvers"
@@ -142,6 +144,53 @@ def _active_combine(act):
     )
 
 
+def _witness_combine(act):
+    """Cross-shard witness combiner (ISSUE 17), the in-core twin of the
+    proxy's multi-resolver rule: losing range = MIN packed read index
+    over conflicting ACTIVE shards (packed indices are global, so min in
+    packed space == min in per-txn-ordinal space), version = MAX over
+    the shards reporting that minimal range (a range spanning shards may
+    carry a different local range-max on each).  A masked shard's vector
+    is stale garbage and contributes nothing."""
+    BIG = jnp.int32(WITNESS_NONE_RANGE)
+
+    def comb(w_ver, w_rng):
+        rng = jnp.where(act, w_rng, BIG)
+        rng_g = jax.lax.pmin(rng, AXIS)
+        ver = jnp.where(
+            act & (w_rng == rng_g), w_ver, jnp.int32(FLOOR_REL)
+        )
+        return jax.lax.pmax(ver, AXIS), rng_g
+
+    return comb
+
+
+def _translate_witness(wit, rmap):
+    """Per-shard mirror witness ordinals (indices into the CLIPPED read
+    list — _clip_txns_for drops empty clips) back to ordinals into the
+    transaction's original read_ranges."""
+    return [
+        None if w is None else (w[0], rmap[t][w[1]])
+        for t, w in enumerate(wit)
+    ]
+
+
+def _combine_witness(parts, statuses):
+    """The witness combine rule, host-side (mirror-served and mixed
+    device/mirror batches): min losing ordinal across conflicting
+    shards' contributions, version = max among the holders of that
+    ordinal — bit-identical to _witness_combine's in-core pmin/pmax."""
+    out: list = []
+    for t, st in enumerate(statuses):
+        cands = [p[t] for p in parts if p[t] is not None]
+        if int(st) != CONFLICT or not cands:
+            out.append(None)
+            continue
+        rng = min(c[1] for c in cands)
+        out.append((max(c[0] for c in cands if c[1] == rng), rng))
+    return out
+
+
 def _shard_body(
     lo,
     hi,
@@ -168,6 +217,7 @@ def _shard_body(
     h_cap: int,
     kernels: bool = False,
     kernel_interpret: bool = False,
+    witness: bool = False,
 ):
     """Per-device block (flat history): clip the replicated batch to this
     shard's bounds and run the single-device engine on the local history
@@ -203,10 +253,12 @@ def _shard_body(
         kernels=kernels,
         kernel_interpret=kernel_interpret,
         undecided_combine=_active_combine(act),
+        witness=witness,
+        witness_combine=_witness_combine(act) if witness else None,
     )
-    (out_keys, out_vers, out_count, new_oldest, status, undecided, iters) = out
+    (out_keys, out_vers, out_count, new_oldest, status, undecided, iters) = out[:7]
     keep = lambda new, old: jnp.where(act, new, old)
-    return (
+    res = (
         keep(out_keys, hkeys[0])[None],
         keep(out_vers, hvers[0])[None],
         keep(out_count, hcount[0])[None],
@@ -215,6 +267,11 @@ def _shard_body(
         undecided[None],
         iters[None],
     )
+    if witness:
+        # Already cross-shard combined in-core: every shard's row is the
+        # same replicated (version, range) vector.
+        res += (out[7][None], out[8][None])
+    return res
 
 
 def _shard_body_tiered(
@@ -249,6 +306,7 @@ def _shard_body_tiered(
     d_cap: int,
     kernels: bool = False,
     kernel_interpret: bool = False,
+    witness: bool = False,
 ):
     """Tiered twin of _shard_body (ROADMAP item 3's mesh-sharded tiered
     history): every shard carries its own frozen base + max-table + delta
@@ -289,10 +347,12 @@ def _shard_body_tiered(
         kernels=kernels,
         kernel_interpret=kernel_interpret,
         undecided_combine=_active_combine(act),
+        witness=witness,
+        witness_combine=_witness_combine(act) if witness else None,
     )
-    (ohk, ohv, ohc, omt, odk, odv, odc, new_oldest, status, undec, iters) = out
+    (ohk, ohv, ohc, omt, odk, odv, odc, new_oldest, status, undec, iters) = out[:11]
     keep = lambda new, old: jnp.where(act, new, old)
-    return (
+    res = (
         keep(ohk, hkeys[0])[None],
         keep(ohv, hvers[0])[None],
         keep(ohc, hcount[0])[None],
@@ -305,35 +365,43 @@ def _shard_body_tiered(
         undec[None],
         iters[None],
     )
+    if witness:
+        res += (out[11][None], out[12][None])
+    return res
 
 
 def _make_sharded_step(mesh: Mesh, txn_cap, rr_cap, wr_cap, h_cap,
                        tiered: bool = False, d_cap: int = 0,
                        kernels: bool = False,
-                       kernel_interpret: bool = False):
+                       kernel_interpret: bool = False,
+                       witness: bool = False):
     """One jitted shard_map step.  Outputs are PER-SHARD (statuses
     included): the host substitutes a degraded shard's verdict row from
     its mirror and min-combines (ref MasterProxyServer.actor.cpp:492-499
-    — Conflict(0) < TooOld(1) < Committed(2))."""
+    — Conflict(0) < TooOld(1) < Committed(2)).  With `witness` the step
+    appends the cross-shard-combined (version, range) witness vectors
+    (replicated rows; the donation indices are untouched)."""
     shard = P(AXIS)
     repl = P()
     batch_specs = (repl,) * 11
+    wit_extra = (shard,) * 2 if witness else ()
     if tiered:
         body = partial(
             _shard_body_tiered, txn_cap=txn_cap, rr_cap=rr_cap,
             wr_cap=wr_cap, h_cap=h_cap, d_cap=d_cap, kernels=kernels,
-            kernel_interpret=kernel_interpret,
+            kernel_interpret=kernel_interpret, witness=witness,
         )
         in_specs = (shard, shard, shard) + (shard,) * 8 + batch_specs + (repl,)
-        out_specs = (shard,) * 11
+        out_specs = (shard,) * 11 + wit_extra
         donate = tuple(range(3, 11))
     else:
         body = partial(
             _shard_body, txn_cap=txn_cap, rr_cap=rr_cap, wr_cap=wr_cap,
             h_cap=h_cap, kernels=kernels, kernel_interpret=kernel_interpret,
+            witness=witness,
         )
         in_specs = (shard, shard, shard) + (shard,) * 4 + batch_specs
-        out_specs = (shard,) * 7
+        out_specs = (shard,) * 7 + wit_extra
         donate = (3, 4, 5, 6)
     mapped = shard_map(
         body,
@@ -442,6 +510,13 @@ class ShardedJaxConflictSet:
             jax.default_backend()
         )
         self.tiered = g_env.get("FDB_TPU_HISTORY") == "tiered"
+        # Abort-witness emission (ISSUE 17), resolved once like the other
+        # engine-variant flags; JaxConflictSet's exact semantics.
+        self._witness = g_env.get("FDB_TPU_WITNESS") not in ("", "0")
+        # Per-txn (absolute version, read-range ordinal) pairs — or None —
+        # for the most recent decided batch; [] when witness is off.
+        self.last_witness: list = []
+        self._last_witness_dev = ()
         self.evict_every = max(1, g_env.get_int("FDB_TPU_EVICT_EVERY"))
         self.compact_every = 0
         self.d_cap = 0
@@ -711,6 +786,7 @@ class ShardedJaxConflictSet:
                 tiered=self.tiered, d_cap=self.d_cap,
                 kernels=self._use_kernels,
                 kernel_interpret=self._kernel_interpret,
+                witness=self._witness,
             )
             self._steps[key] = step
         return step
@@ -720,25 +796,32 @@ class ShardedJaxConflictSet:
         """[(lo, hi_or_None)] per shard — the one definition."""
         return list(zip([b""] + self.split_keys, self.split_keys + [None]))
 
-    def _clip_txns_for(self, txns, s: int):
+    def _clip_txns_for(self, txns, s: int, with_read_map: bool = False):
         """This shard's view of the batch: every range clipped to
         [lo_s, hi_s), empty clips dropped (the host twin of the device
         body's _clip_batch — TooOld then only applies where reads
-        survive, exactly like the device's t_has_reads mask)."""
+        survive, exactly like the device's t_has_reads mask).  With
+        `with_read_map`, also returns per txn the ORIGINAL read-range
+        ordinal of each surviving clipped range, so a shard mirror's
+        witness (indexed into the clipped list) translates back."""
         lo, hi = self._shard_bounds()[s]
         out = []
+        rmap: list = []
         for tr in txns:
             rr, wr = [], []
-            for (b, e) in tr.read_ranges:
+            rmap_t: list = []
+            for i, (b, e) in enumerate(tr.read_ranges):
                 cb = b if b >= lo else lo
                 ce = e if hi is None or e <= hi else hi
                 if cb < ce:
                     rr.append((cb, ce))
+                    rmap_t.append(i)
             for (b, e) in tr.write_ranges:
                 cb = b if b >= lo else lo
                 ce = e if hi is None or e <= hi else hi
                 if cb < ce:
                     wr.append((cb, ce))
+            rmap.append(rmap_t)
             out.append(
                 TransactionConflictInfo(
                     read_snapshot=tr.read_snapshot,
@@ -746,6 +829,8 @@ class ShardedJaxConflictSet:
                     write_ranges=wr,
                 )
             )
+        if with_read_map:
+            return out, rmap
         return out
 
     def _committed_writes_per_shard(self, txns, rows, shards):
@@ -1003,14 +1088,23 @@ class ShardedJaxConflictSet:
         """Run a whole batch on the per-shard mirrors with the exact
         multi-resolver semantics: ranges clipped per shard, each shard
         commits writes on its LOCAL verdict, verdicts min-combined (ref
-        Resolver.actor.cpp:140-153, proxy :492-499)."""
-        verdicts = [
-            self._mirrors[s].detect(
-                self._clip_txns_for(txns, s), now, new_oldest_version
+        Resolver.actor.cpp:140-153, proxy :492-499).  Witnesses combine
+        under the same rule as the device step (_combine_witness)."""
+        verdicts = []
+        parts = []
+        for s in range(self.n_shards):
+            clipped, rmap = self._clip_txns_for(txns, s, with_read_map=True)
+            verdicts.append(
+                self._mirrors[s].detect(clipped, now, new_oldest_version)
             )
-            for s in range(self.n_shards)
-        ]
-        return [min(v) for v in zip(*verdicts)] if txns else []
+            if self._witness:
+                parts.append(
+                    _translate_witness(self._mirrors[s].last_witness, rmap)
+                )
+        combined = [min(v) for v in zip(*verdicts)] if txns else []
+        if self._witness:
+            self.last_witness = _combine_witness(parts, combined)
+        return combined
 
     def _serve(self, txns, pb: PackedBatch, now: int, new_oldest_version: int):
         """One short-key batch through the shard-granular serve path:
@@ -1072,6 +1166,7 @@ class ShardedJaxConflictSet:
                 out[: len(res)] = res
                 return out
         mirror_shards = [s for s in range(S) if not allowed[s]]
+        mirror_wit: list = []
         if mirror_shards:
             # Degraded serving, scoped to the sick shards: each re-runs
             # ONLY its slice of the batch on its mirror (bit-identical by
@@ -1083,11 +1178,18 @@ class ShardedJaxConflictSet:
             t0 = wall_now()
             for s in mirror_shards:
                 row = np.full((pb.txn_cap,), COMMITTED, np.int32)
+                clipped, rmap = self._clip_txns_for(
+                    txns, s, with_read_map=True
+                )
                 local = self._mirrors[s].detect(
-                    self._clip_txns_for(txns, s), now, new_oldest_version
+                    clipped, now, new_oldest_version
                 )
                 row[: len(local)] = local
                 rows[s] = row
+                if self._witness:
+                    mirror_wit.append(_translate_witness(
+                        self._mirrors[s].last_witness, rmap
+                    ))
             self._cpu_fallback_txns += len(txns)
             self._cpu_fallback_recent.append((len(txns), wall_now() - t0))
             m.counter("cpu_fallback_txns").add(len(txns))
@@ -1105,7 +1207,24 @@ class ShardedJaxConflictSet:
                         s, per[s], now, new_oldest_version
                     )
                     self._note_synced_shard(s)
-        return np.min(np.stack(rows, axis=0), axis=0).astype(np.int32)
+        combined = np.min(np.stack(rows, axis=0), axis=0).astype(np.int32)
+        if self._witness:
+            # Join the device step's in-core-combined witness (covers the
+            # ACTIVE shards; every row replicated — take row 0) with each
+            # mirror-served shard's translated witness under the one
+            # combine rule.  Pure-device batches reduce to the device
+            # vector; pure-mirror batches to the host combine.
+            parts = list(mirror_wit)
+            if device_shards and self._last_witness_dev:
+                wv, wr = self._last_witness_dev
+                parts.append(decode_witness(
+                    pb, combined, np.asarray(wv)[0], np.asarray(wr)[0],
+                    self._base,
+                ))
+            self.last_witness = _combine_witness(
+                parts, [int(v) for v in combined[: pb.n_txn]]
+            )
+        return combined
 
     def _device_serve(self, txns, pb, now, new_oldest_version, allowed,
                       do_major, rows) -> bool:
@@ -1136,25 +1255,29 @@ class ShardedJaxConflictSet:
         )
         with begin_span("device", attrs={"version": now}):
             if self.tiered:
-                (
-                    self._hkeys, self._hvers, self._hcount, self._maxtab,
-                    self._dkeys, self._dvers, self._dcount, self._oldest,
-                    status_s, undec_s, iters_s,
-                ) = step(
+                out = step(
                     self._lo, self._hi, active,
                     self._hkeys, self._hvers, self._hcount, self._maxtab,
                     self._dkeys, self._dvers, self._dcount, self._oldest,
                     *batch_args, jnp.asarray(do_major, jnp.int32),
                 )
-            else:
                 (
-                    self._hkeys, self._hvers, self._hcount, self._oldest,
+                    self._hkeys, self._hvers, self._hcount, self._maxtab,
+                    self._dkeys, self._dvers, self._dcount, self._oldest,
                     status_s, undec_s, iters_s,
-                ) = step(
+                ) = out[:11]
+                self._last_witness_dev = out[11:]
+            else:
+                out = step(
                     self._lo, self._hi, active,
                     self._hkeys, self._hvers, self._hcount, self._oldest,
                     *batch_args,
                 )
+                (
+                    self._hkeys, self._hvers, self._hcount, self._oldest,
+                    status_s, undec_s, iters_s,
+                ) = out[:7]
+                self._last_witness_dev = out[7:]
             undecided = int(np.max(np.asarray(undec_s)))
             self.last_iters = int(np.max(np.asarray(iters_s)))
         m.counter("device_batches").add()
@@ -1501,8 +1624,10 @@ def _sharded_ep_mesh():
 
 
 def _ep_sharded_step():
+    # witness=True is the canonical trace (FDB_TPU_WITNESS defaults on),
+    # matching the single-device entry points.
     jitted = _make_sharded_step(
-        _sharded_ep_mesh(), EP_TXN, EP_RR, EP_WR, EP_SHARD_H
+        _sharded_ep_mesh(), EP_TXN, EP_RR, EP_WR, EP_SHARD_H, witness=True
     )
     return jitted.__wrapped__, jitted, _sharded_ep_args(), {}
 
@@ -1514,7 +1639,7 @@ def _ep_sharded_step_kernels():
     pallas_call params differ, never the structure)."""
     jitted = _make_sharded_step(
         _sharded_ep_mesh(), EP_TXN, EP_RR, EP_WR, EP_SHARD_H,
-        kernels=True, kernel_interpret=True,
+        kernels=True, kernel_interpret=True, witness=True,
     )
     return jitted.__wrapped__, jitted, _sharded_ep_args(), {}
 
@@ -1524,7 +1649,7 @@ def _ep_sharded_step_tiered():
     max-table + delta tier, one shared host-driven compaction cadence."""
     jitted = _make_sharded_step(
         _sharded_ep_mesh(), EP_TXN, EP_RR, EP_WR, EP_SHARD_H,
-        tiered=True, d_cap=EP_SHARD_D,
+        tiered=True, d_cap=EP_SHARD_D, witness=True,
     )
     return jitted.__wrapped__, jitted, _sharded_ep_args(tiered=True), {}
 

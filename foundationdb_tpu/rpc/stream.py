@@ -59,8 +59,12 @@ class Reply:
     def send(self, value=None):
         self._send((False, value))
 
-    def send_error(self, name: str):
-        self._send((True, name))
+    def send_error(self, name: str, detail=None):
+        # Structured cause (ISSUE 17): only a detail-bearing error widens
+        # the wire to (True, (name, detail)) — bare errors keep the
+        # original (True, name) shape, so every existing path and replay
+        # stays byte-identical.
+        self._send((True, (name, detail) if detail is not None else name))
 
     def _send(self, wire):
         if self._sent or self._reply_to is None:
@@ -191,7 +195,10 @@ class RequestStreamRef:
                 return
             is_err, value = wire
             if is_err:
-                out.send_error(FdbError(value))
+                if isinstance(value, tuple):  # (name, detail) — ISSUE 17
+                    out.send_error(FdbError(value[0], detail=value[1]))
+                else:
+                    out.send_error(FdbError(value))
             else:
                 out.send(value)
 

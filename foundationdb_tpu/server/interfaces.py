@@ -153,6 +153,14 @@ class ResolveTransactionBatchReply:
     # an open circuit degraded the device path (conflict/device_faults.py);
     # the proxy tags its commit latency sample with it.
     degraded: bool = False
+    # Per-transaction abort witnesses (ISSUE 17), parallel to `committed`:
+    # None for non-CONFLICT txns, else (conflicting_write_version,
+    # losing_read_range_index) — the provenance phase 1 computes on device
+    # and would otherwise throw away.  The proxy max/min-combines these
+    # across resolvers into the structured not_committed cause the client's
+    # retry hint reads.  Empty when witness emission is off
+    # (FDB_TPU_WITNESS=0); the proxy then falls back to the bare error.
+    witnesses: List = field(default_factory=list)
 
 
 @dataclass

@@ -780,6 +780,14 @@ class Proxy:
                 )
             return out
 
+        # Clipped per-resolver transaction views, retained past the
+        # resolve round-trip: an abort witness names a read-range ordinal
+        # WITHIN the clipped txn the owning resolver saw, so decoding it
+        # back to key bytes needs exactly this list (ISSUE 17).
+        clipped = [
+            [clip_for(ri, tr) for tr in infos]
+            for ri in range(len(self.resolvers))
+        ]
         pspan = _phase("resolution")
         replies = await wait_for_all(
             [
@@ -789,7 +797,7 @@ class Proxy:
                         prev_version=prev,
                         version=version,
                         last_received_version=self._last_received,
-                        transactions=[clip_for(ri, tr) for tr in infos],
+                        transactions=clipped[ri],
                         state_txns=state_txns,
                         proxy_id=self.proxy_id,
                         epoch=self.epoch,
@@ -975,6 +983,46 @@ class Proxy:
                 reply.send_error("transaction_too_old")
             else:
                 self.stats.add("conflicted")
-                reply.send_error("not_committed")
+                reply.send_error(
+                    "not_committed",
+                    detail=self._conflict_cause(t, replies, clipped, version),
+                )
         pspan.end(attrs={"committed": n_committed})
         bspan.end(attrs={"committed": n_committed})
+
+    def _conflict_cause(self, t, replies, clipped, batch_version):
+        """Combine txn `t`'s abort witnesses across the resolvers into the
+        structured not_committed cause (ISSUE 17): version = MAX
+        conflicting write version over the resolvers that aborted it (the
+        txn must re-read past ALL of them), range = the losing read range
+        reported by the lowest-indexed conflicting resolver — the same
+        deterministic tie-break the sharded set's in-core combine uses,
+        decoded to key bytes via that resolver's clipped view.
+        retry_version is the BATCH version: the newest version at which
+        this conflict decision is complete (it includes the winning write
+        and every commit before it, and is reported committed before the
+        error reply is sent), so a retry reading there observes
+        everything that aborted us without a fresh GRV round-trip.  None
+        when no witness arrived (FDB_TPU_WITNESS=0 or pre-witness
+        resolvers): the client then sees the reference's bare
+        not_committed."""
+        version = None
+        first = None
+        for ri, rep in enumerate(replies):
+            wits = rep.witnesses or []
+            wit = wits[t] if t < len(wits) else None
+            if wit is None or rep.committed[t] != CONFLICT:
+                continue
+            version = wit[0] if version is None else max(version, wit[0])
+            if first is None:
+                first = (ri, wit[1])
+        if first is None:
+            return None
+        ri, idx = first
+        rr = clipped[ri][t].read_ranges
+        rng = rr[idx] if idx < len(rr) else None
+        return {
+            "version": int(version),
+            "retry_version": int(batch_version),
+            "range": (rng[0], rng[1]) if rng is not None else None,
+        }

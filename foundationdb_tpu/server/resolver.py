@@ -145,7 +145,8 @@ class Resolver:
         )
         for _c in ("batches", "transactions", "committed", "conflicted",
                    "too_old", "cache_hits", "stale_epoch",
-                   "degraded_batches", "witness_aborts"):
+                   "degraded_batches", "witness_aborts",
+                   "contention_spikes"):
             self.metrics.counter(_c)  # pre-create: snapshots list them all
         # Conflict-witness telemetry (ISSUE 12 satellite, the
         # observability seed of ROADMAP item 4): per-batch aborted-txn
@@ -157,6 +158,28 @@ class Resolver:
         # approximation of where contention lives.
         self._witness_ranges: Dict[tuple, int] = {}
         self.metrics.gauge("conflict_witness_topk").set("[]")
+        # End-to-end provenance (ISSUE 17): with FDB_TPU_WITNESS on, the
+        # conflict engines report the precise (conflicting version, losing
+        # read range) per abort, the reply carries it to the proxy, and
+        # the contended-range sample above records the EXACT range each
+        # loser lost on instead of the first-write-range approximation.
+        from ..flow.knobs import g_env as _g_env
+
+        self._witness_on = _g_env.get("FDB_TPU_WITNESS") not in ("", "0")
+        # Contended-range decay advances once per
+        # resolver_witness_decay_batches CONFLICT-bearing batches — a
+        # batch counter, deliberately not a timer, so idle virtual time
+        # never drains the top-K (pinned by test_witness_decay).
+        self._witness_batches = 0
+        # Per-batch abort timeline (the contention explorer's raw feed):
+        # (version, n_txn, n_aborted, [[begin_hex, end_hex, count], ...]).
+        from collections import deque as _deque
+
+        self._contention_ring = _deque(
+            maxlen=int(g_knobs.server.resolver_contention_ring)
+        )
+        # Consecutive batches at/above the spike abort fraction.
+        self._contention_streak = 0
         # Set once a raw device conflict set faulted and its state was
         # exported host-side: the CPU engine then serves every later batch
         # of this role's life (see _retry_on_cpu).
@@ -555,6 +578,12 @@ class Resolver:
         consume = getattr(conflicts, "consume_degraded", None)
         if consume is not None and consume():
             degraded = True
+        # Provenance: whichever engine actually decided the batch holds
+        # its witness — the CPU takeover after a device fault (set inside
+        # _retry_on_cpu), else the serving conflict set.
+        witness = self._batch_witness(
+            self._cpu_takeover or conflicts, len(statuses)
+        )
         # version.set before the shared completion (the pipelined path
         # sets it at dispatch): NotifiedVersion wakes waiters through the
         # loop's ready queue, never synchronously, so no actor can
@@ -562,12 +591,22 @@ class Resolver:
         self.version.set(req.version)
         self._complete_resolve(
             req, reply, statuses, degraded, first_unseen, t_enter,
-            span=bspan,
+            span=bspan, witness=witness,
         )
+
+    def _batch_witness(self, engine, n: int) -> list:
+        """Per-txn abort witnesses for the batch `engine` just decided
+        (ISSUE 17), or [] when provenance is off or the engine predates
+        it.  Length is pinned to the batch so a stale list from an
+        earlier batch can never be attributed to this one."""
+        if not self._witness_on:
+            return []
+        wit = list(getattr(engine, "last_witness", []) or [])
+        return wit if len(wit) == n else []
 
     def _complete_resolve(
         self, req, reply, statuses, degraded: bool, first_unseen: int,
-        t_enter: float, span=None,
+        t_enter: float, span=None, witness=None,
     ):
         """Post-verdict completion shared by the synchronous path and the
         pipeline's _finish_resolve — verdict accounting, state-txn
@@ -604,7 +643,42 @@ class Resolver:
         if n_conflicted:
             m.counter("witness_aborts").add(n_conflicted)
             m.histogram("aborted_per_batch").add(n_conflicted)
-            self._witness_record(req.transactions, statuses)
+            self._witness_record(
+                req.transactions, statuses, witness, req.version
+            )
+        # Sustained-contention black box: consecutive batches whose abort
+        # fraction clears the spike ratio arm the flight recorder; one
+        # sub-threshold batch disarms it.  Same cooldown/reset discipline
+        # as the pipeline-stall trigger — only an ACTUAL capture resets
+        # the streak, so a cooldown-suppressed attempt retries next batch.
+        if statuses:
+            sk = g_knobs.server
+            if n_conflicted >= sk.resolver_contention_spike_ratio * len(
+                statuses
+            ):
+                self._contention_streak += 1
+                if (
+                    self._contention_streak
+                    >= sk.resolver_contention_spike_batches
+                ):
+                    from ..flow.flight_recorder import maybe_trigger
+
+                    captured = maybe_trigger(
+                        "contention_spike",
+                        detail={
+                            "streak": self._contention_streak,
+                            "version": req.version,
+                            "aborted": n_conflicted,
+                            "batch": len(statuses),
+                            "topk": self.conflict_witness()["topk"],
+                        },
+                        source=self.metrics.name,
+                    )
+                    if captured is not None:
+                        m.counter("contention_spikes").add()
+                        self._contention_streak = 0
+            else:
+                self._contention_streak = 0
 
         # Retain this batch's state transactions with their verdicts so the
         # other proxies' next batches learn them (ref :170-181).
@@ -614,6 +688,7 @@ class Resolver:
             ]
         out = ResolveTransactionBatchReply(
             committed=statuses,
+            witnesses=list(witness) if witness else [],
             degraded=degraded,
             state_mutations=[
                 (v, self._recent_state_txns[v])
@@ -668,26 +743,45 @@ class Resolver:
     WITNESS_MAX_RANGES = 512  # bounded contended-range sample (decayed)
     WITNESS_TOP_K = 8
 
-    def _witness_record(self, txns, statuses):
-        """Bump the contended-range sample with every aborted txn's first
-        conflict range (write ranges preferred: first-committer-wins
-        means a loser's own write range is where it collided), decaying
-        like the split-balancer key sample so hot ranges survive and
-        one-offs shed.  Publishes the top-K as a canonical-JSON gauge —
-        deterministic, so it rides snapshots/timeseries/soak reports
-        without breaking byte identity."""
+    def _witness_record(self, txns, statuses, witness=None, version=0):
+        """Bump the contended-range sample with every aborted txn's losing
+        range — the PRECISE read range its witness names (ISSUE 17) when
+        provenance is on, else the first-write-range approximation the
+        pre-witness sample used (first-committer-wins means a loser's own
+        write range is where it usually collided).  Decays like the
+        split-balancer key sample so hot ranges survive and one-offs
+        shed; the decay clock is REAL batches only — once per
+        resolver_witness_decay_batches calls here, plus the overflow
+        halving — never a timer, so a quiescent cluster's top-K holds
+        byte-identical between soak phases.  Publishes the top-K as a
+        canonical-JSON gauge and appends this batch's per-range abort
+        counts to the contention timeline ring — both deterministic, so
+        they ride snapshots/timeseries/soak reports without breaking
+        byte identity."""
         from ..conflict.types import CONFLICT
 
         w = self._witness_ranges
-        for tr, s in zip(txns, statuses):
+        batch_ranges: Dict[tuple, int] = {}
+        n_aborted = 0
+        for t, (tr, s) in enumerate(zip(txns, statuses)):
             if s != CONFLICT:
                 continue
-            ranges = tr.write_ranges or tr.read_ranges
-            if not ranges:
-                continue
-            key = (ranges[0][0], ranges[0][1])
+            n_aborted += 1
+            wtn = witness[t] if witness and t < len(witness) else None
+            if wtn is not None and wtn[1] < len(tr.read_ranges):
+                rng = tr.read_ranges[wtn[1]]
+            else:
+                ranges = tr.write_ranges or tr.read_ranges
+                if not ranges:
+                    continue
+                rng = ranges[0]
+            key = (rng[0], rng[1])
             w[key] = w.get(key, 0) + 1
-        if len(w) > self.WITNESS_MAX_RANGES:
+            batch_ranges[key] = batch_ranges.get(key, 0) + 1
+        self._witness_batches += 1
+        decay_every = int(g_knobs.server.resolver_witness_decay_batches)
+        if (decay_every > 0 and self._witness_batches % decay_every == 0) \
+                or len(w) > self.WITNESS_MAX_RANGES:
             w = {k: v // 2 for k, v in w.items() if v >= 2}
             self._witness_ranges = w
         import json as _json
@@ -700,10 +794,21 @@ class Resolver:
                 separators=(",", ":"),
             )
         )
+        self._contention_ring.append((
+            int(version),
+            len(statuses),
+            n_aborted,
+            sorted(
+                [[b.hex(), e.hex(), n] for (b, e), n in batch_ranges.items()],
+                key=lambda r: (-r[2], r[0], r[1]),
+            ),
+        ))
 
     def conflict_witness(self) -> dict:
-        """Status/soak surface: aborted-txn total + decoded top-K
-        contended ranges."""
+        """Status/soak surface: aborted-txn total, decoded top-K contended
+        ranges, and the contention block (ISSUE 17) — the per-batch abort
+        timeline ring plus spike-trigger state — everything `cli
+        contention` joins against the span rings."""
         import json as _json
 
         return {
@@ -711,6 +816,22 @@ class Resolver:
             "topk": _json.loads(
                 self.metrics.gauge("conflict_witness_topk").value or "[]"
             ),
+            "contention": {
+                "witness_batches": self._witness_batches,
+                "streak": self._contention_streak,
+                "spikes": int(
+                    self.metrics.counter("contention_spikes").value
+                ),
+                "timeline": [
+                    {
+                        "version": v,
+                        "batch": b,
+                        "aborted": a,
+                        "ranges": rngs,
+                    }
+                    for (v, b, a, rngs) in self._contention_ring
+                ],
+            },
         }
 
     # -- double-buffered pipeline (ISSUE 11) ------------------------------
@@ -797,6 +918,10 @@ class Resolver:
         self._complete_resolve(
             ctx.req, ctx.reply, ctx.entry.statuses, ctx.entry.degraded,
             ctx.first_unseen, ctx.t_enter, span=ctx.span,
+            witness=(
+                getattr(ctx.entry, "witness", None)
+                if self._witness_on else None
+            ),
         )
         self._note_device_span(ctx.entry)
         # Stall accounting + the wedged-pipeline black box: a pipeline

@@ -288,6 +288,13 @@ def cluster_status(cluster) -> dict:
         # hot-key contention is burning goodput right now.
         w_aborts = 0
         merged: dict = {}
+        # Contention block (ISSUE 17): spike-trigger state + a bounded
+        # tail of the per-batch abort timeline, merged across resolvers
+        # in (version, resolver) order — deterministic, so same-seed
+        # status docs stay byte-identical.
+        contention = {"streak": 0, "spikes": 0, "timeline_batches": 0,
+                      "recent": []}
+        recent: list = []
         for r in role_objects(cluster, "resolver"):
             cw = getattr(r, "conflict_witness", None)
             if not callable(cw):
@@ -296,6 +303,16 @@ def cluster_status(cluster) -> dict:
             w_aborts += w["aborts"]
             for b, e, n in w["topk"]:
                 merged[(b, e)] = merged.get((b, e), 0) + n
+            block = w.get("contention")
+            if block:
+                contention["streak"] = max(
+                    contention["streak"], block["streak"]
+                )
+                contention["spikes"] += block["spikes"]
+                contention["timeline_batches"] += len(block["timeline"])
+                recent.extend(block["timeline"])
+        recent.sort(key=lambda t: t["version"])
+        contention["recent"] = recent[-8:]
         qos["conflict_witness_aborts"] = w_aborts
         qos["conflict_witness_topk"] = [
             [b, e, n]
@@ -303,6 +320,7 @@ def cluster_status(cluster) -> dict:
                 merged.items(), key=lambda kv: (-kv[1], kv[0])
             )[:8]
         ]
+        qos["contention"] = contention
         cl["qos"] = qos
         # Passive latency distributions from the proxy's ContinuousSamples
         # (ref: the commit/GRV latency bands in Status.actor.cpp's qos; the

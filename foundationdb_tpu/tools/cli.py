@@ -45,6 +45,12 @@ class CliProcessor:
         "diff of each resolver's CPU mirror snapshot against its device "
         "export (the consistency check the periodic resolver actor runs; "
         "confirmed divergence opens the circuit breaker)",
+        "contention": "contention [--format=json] [--limit=N] — conflict "
+        "provenance explorer: joins the per-abort witness records "
+        "(conflicting write version + losing read range) with the "
+        "resolver span rings and the decayed top-K into per-range "
+        "abort timelines; lists contention-spike flight-recorder "
+        "captures",
         "latency": "latency [--chains] [--format=json] — per-stage "
         "latency percentiles from the span layer (default); --chains "
         "uses the legacy trace_batch debug-id chain reassembly "
@@ -704,6 +710,116 @@ class CliProcessor:
                 f"{len(hub.rings)} role tracks)"
             ]
         return [blob]
+
+    async def _cmd_contention(self, args):
+        """Conflict provenance explorer (ISSUE 17): joins each resolver's
+        per-abort witness records — the per-batch contention timeline
+        ring and the decayed top-K — into per-range abort timelines,
+        alongside the resolver span-stage percentiles (the latency cost
+        of the contention the witnesses attribute) and the
+        contention_spike flight-recorder captures.  All inputs are
+        virtual-time deterministic, so --format=json (canonical, sorted
+        keys) is byte-identical across same-seed runs."""
+        from ..flow.flight_recorder import global_flight_recorder
+        from ..flow.spans import global_span_hub, span_latency_summary
+        from ..server.status import role_objects
+
+        limit = next(
+            (int(a.split("=", 1)[1]) for a in args
+             if a.startswith("--limit=")),
+            8,
+        )
+        doc: dict = {"resolvers": {}}
+        for r in role_objects(self.cluster, "resolver"):
+            cw = getattr(r, "conflict_witness", None)
+            if not callable(cw):
+                continue
+            rep = cw()
+            name = getattr(getattr(r, "process", None), "name", None) or (
+                f"resolver{len(doc['resolvers'])}"
+            )
+            # Fold the per-batch timeline into per-range abort series:
+            # every batch that witnessed aborts against a range
+            # contributes one [commit_version, aborts] point, so an
+            # operator reads WHEN a range got hot, not just that it did.
+            ranges: dict = {}
+            for entry in rep["contention"]["timeline"]:
+                for b, e, n in entry["ranges"]:
+                    slot = ranges.setdefault(
+                        f"{b}..{e}", {"aborts": 0, "timeline": []}
+                    )
+                    slot["aborts"] += n
+                    slot["timeline"].append([entry["version"], n])
+            top = sorted(
+                ranges.items(), key=lambda kv: (-kv[1]["aborts"], kv[0])
+            )[:limit]
+            doc["resolvers"][name] = {
+                "aborts": rep["aborts"],
+                "topk": rep["topk"][:limit],
+                "witness_batches": rep["contention"]["witness_batches"],
+                "streak": rep["contention"]["streak"],
+                "spikes": rep["contention"]["spikes"],
+                "ranges": dict(top),
+            }
+        hub = global_span_hub()
+        summary = span_latency_summary(hub) if hub.rings else {}
+        # Ring keys are "Resolver.<name>" — strip the role prefix so the
+        # span block keys line up with the witness block above.
+        doc["spans"] = {
+            role.split(".", 1)[1]: stages
+            for role, stages in summary.items()
+            if role.startswith("Resolver.")
+        }
+        rec = global_flight_recorder()
+        doc["captures"] = [
+            {
+                "capture_seq": c["capture_seq"],
+                "time": c["time"],
+                "detail": c.get("detail"),
+            }
+            for c in rec.captures
+            if c.get("trigger") == "contention_spike"
+        ]
+        if "--format=json" in args:
+            return json.dumps(
+                doc, indent=2, sort_keys=True, default=str
+            ).splitlines()
+        if not doc["resolvers"]:
+            return ["(no resolvers live)"]
+        lines = []
+        for name, rr in sorted(doc["resolvers"].items()):
+            lines.append(
+                f"{name}: {rr['aborts']} witnessed aborts over "
+                f"{rr['witness_batches']} batches "
+                f"(streak {rr['streak']}, {rr['spikes']} spike(s))"
+            )
+            for key, slot in sorted(
+                rr["ranges"].items(),
+                key=lambda kv: (-kv[1]["aborts"], kv[0]),
+            ):
+                tl = slot["timeline"]
+                lines.append(
+                    f"  [{key}]  {slot['aborts']} aborts over "
+                    f"{len(tl)} batches, last @v{tl[-1][0]}"
+                )
+            if not rr["ranges"]:
+                lines.append("  (no witnessed aborts in the timeline ring)")
+        for name, stages in sorted(doc["spans"].items()):
+            if not stages:
+                continue
+            lines.append(f"{name} span stages (virtual seconds):")
+            for stage, s in stages.items():
+                lines.append(
+                    f"  {stage:<16} n={s['count']:<5} "
+                    f"p50={s['p50']:.6f} p99={s['p99']:.6f}"
+                )
+        if doc["captures"]:
+            lines.append(
+                f"contention spike captures: "
+                f"{len(doc['captures'])} "
+                f"(`flightrec --format=json` for the artifacts)"
+            )
+        return lines
 
     async def _probe_swallowing(self):
         from ..server.status import latency_probe

@@ -113,6 +113,10 @@ class SoakConfig:
     # resolver 0 conflict set (sim clusters only; capped to the visible
     # device count).
     sharded_shards: int = 4
+    # Witness-guided retry arm (ISSUE 17): None leaves the live
+    # FDB_TPU_WITNESS_RETRY flag alone; True/False overrides it for the
+    # run (restored after) — the A/B seam run_contention_ab drives.
+    witness_retry: Optional[bool] = None
 
 
 def default_phases(peak_tps: float, total_seconds: float) -> List[SoakPhase]:
@@ -178,6 +182,44 @@ def shard_outage_config(
     ]
     cfg.sharded_shards = n_shards
     return cfg
+
+
+def contention_config(
+    minutes: float = 0.25,
+    peak_tps: float = 120.0,
+    seed: int = 1,
+    keys: int = 8,
+    zipf_theta: float = 1.2,
+    backend: str = "jax",
+    witness_retry: Optional[bool] = None,
+) -> SoakConfig:
+    """High-contention Zipf soak (ISSUE 17): a tiny hot key set and an
+    RMW-heavy mix drive the abort fraction past the contention-spike
+    threshold, so the run exercises the whole provenance chain — device
+    witnesses, the structured not_committed cause, the client retry
+    hint, the contention report block, and the contention_spike flight-
+    recorder capture.  No faults: contention IS the incident here."""
+    total = minutes * 60.0
+    hot = dict(read_fraction=0.0, rmw_fraction=1.0)
+    return SoakConfig(
+        seed=seed,
+        cluster="sim",
+        backend=backend,
+        mode="open",
+        keys=keys,
+        zipf_theta=zipf_theta,
+        phases=[
+            SoakPhase("warm", total * 0.2, peak_tps * 0.5, **hot),
+            SoakPhase("hot", total * 0.6, peak_tps, **hot),
+            SoakPhase("cooldown", total * 0.2, peak_tps * 0.4, **hot),
+        ],
+        faults=[],
+        # Contention arms score RELATIVE goodput (guided vs blind); a
+        # same-key RMW storm legitimately aborts most attempts, so the
+        # absolute floor only guards against total collapse.
+        goodput_floor_frac=0.02,
+        witness_retry=witness_retry,
+    )
 
 
 def default_config(
@@ -639,6 +681,34 @@ class SoakRun:
         return self.report()
 
     # -- reporting --------------------------------------------------------
+    def _contention_section(self, rec) -> dict:
+        """The report's contention explorer block (ISSUE 17)."""
+        from ..flow.knobs import g_env
+        from ..server.status import role_objects
+
+        resolvers = {}
+        for r in role_objects(self.cluster, "resolver"):
+            cw = getattr(r, "conflict_witness", None)
+            if callable(cw):
+                w = cw()
+                resolvers[r.process.name] = {
+                    "aborts": w["aborts"],
+                    "topk": w["topk"],
+                    **w["contention"],
+                }
+        return {
+            "witness_retry": (
+                g_env.get("FDB_TPU_WITNESS_RETRY") not in ("", "0")
+            ),
+            "hint_retries": sum(
+                getattr(db, "witness_hint_retries", 0) for db in self.dbs
+            ),
+            "spike_captures": sum(
+                1 for c in rec.captures if c["trigger"] == "contention_spike"
+            ),
+            "resolvers": resolvers,
+        }
+
     def _spans_section(self) -> dict:
         from ..flow.spans import global_span_hub, span_latency_summary
         from ..server.status import role_objects
@@ -838,6 +908,12 @@ class SoakRun:
             "breakers": breakers,
             "shards": shards,
             "pipeline": pipeline,
+            # Contention explorer (ISSUE 17): per-resolver abort
+            # timelines + spike state, the client-side witness-hint
+            # retry count, and the contention_spike captures this run
+            # froze.  Deterministic like everything above — the replay
+            # gate extends over this block.
+            "contention": self._contention_section(_rec),
             # Span layer (ISSUE 12): per-role ring inventory, the recent
             # window, per-stage latency percentiles off the spans, and
             # the worst pipeline overlap-efficiency gauge.  All
@@ -921,7 +997,19 @@ def run_soak(config: SoakConfig) -> dict:
 
     old_spans = global_span_hub()
     set_global_span_hub(SpanHub())
+    from ..flow.knobs import g_env
+
+    wr_prev, wr_overridden = None, False
     try:
+        if config.witness_retry is not None:
+            # A/B seam (ISSUE 17): the flag is read live by the client's
+            # on_error, so a process-env override scoped to this run is
+            # exact — restored below whatever happens.
+            wr_prev = g_env.override(
+                "FDB_TPU_WITNESS_RETRY",
+                "1" if config.witness_retry else "0",
+            )
+            wr_overridden = True
         # Sample every transaction: the soak's SLO gate IS the latency
         # chain, and the harness owns its own (fresh) collector.
         g_knobs.client.latency_sample_rate = 1.0
@@ -947,6 +1035,8 @@ def run_soak(config: SoakConfig) -> dict:
         )
         return report
     finally:
+        if wr_overridden:
+            g_env.override("FDB_TPU_WITNESS_RETRY", wr_prev)
         g_knobs.client.latency_sample_rate = saved["sample_rate"]
         srv.ratekeeper_max_tps = saved["max_tps"]
         srv.ratekeeper_grv_queue_max = saved["grv_queue_max"]
@@ -958,6 +1048,52 @@ def run_soak(config: SoakConfig) -> dict:
         set_global_flight_recorder(old_rec)
         set_global_span_hub(old_spans)
         set_event_loop(None)
+
+
+def run_contention_ab(
+    minutes: float = 0.25,
+    peak_tps: float = 120.0,
+    seed: int = 1,
+    keys: int = 8,
+    zipf_theta: float = 1.2,
+    backend: str = "jax",
+) -> dict:
+    """Witness-guided vs blind retry A/B on the high-contention Zipf arm
+    (ISSUE 17's acceptance comparison).  Same seed, same load plan, same
+    fault-free cluster build — the ONLY difference is the client's
+    FDB_TPU_WITNESS_RETRY flag, so any goodput gap is the retry hint's.
+    Scored on goodput (committed txn/s), retry counts, and commit p99;
+    full per-arm reports ride along for the explorer."""
+    arms = {}
+    for arm, flag in (("guided", True), ("blind", False)):
+        cfg = contention_config(
+            minutes=minutes, peak_tps=peak_tps, seed=seed, keys=keys,
+            zipf_theta=zipf_theta, backend=backend, witness_retry=flag,
+        )
+        arms[arm] = run_soak(cfg)
+
+    def score(rep: dict) -> dict:
+        t = rep["totals"]
+        started = t["arrivals"] - t["client_shed"]
+        return {
+            "goodput_tps": t["goodput_tps"],
+            "committed": t["committed"],
+            "conflicted": t["conflicted"],
+            "attempts": t["attempts"],
+            "retries": t["attempts"] - started,
+            "hint_retries": rep["contention"]["hint_retries"],
+            "commit_p99": rep["slo"]["worst_phase_commit_p99"],
+        }
+
+    g, b = score(arms["guided"]), score(arms["blind"])
+    return {
+        "guided": g,
+        "blind": b,
+        "goodput_ratio": round(
+            g["goodput_tps"] / max(b["goodput_tps"], 1e-9), 4
+        ),
+        "reports": arms,
+    }
 
 
 def _build_cluster(config: SoakConfig):

@@ -24,6 +24,25 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+# Persistent XLA compilation cache — the same directory bench.py uses.
+# The suite compiles many IDENTICAL programs into fresh engine instances
+# (sharded sets per test, fault-injection rebuilds, the program-cost-table
+# AOT pass re-lowering entry points the conflict suites already compiled)
+# and on this 1-core host each duplicate XLA compile costs tens of
+# seconds.  The cache dedupes them within a single run (and warms across
+# runs); entries are keyed on HLO + compile options, so a hit returns the
+# byte-identical executable XLA would have produced.
+_CACHE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"
+)
+os.makedirs(_CACHE_DIR, exist_ok=True)
+jax.config.update("jax_compilation_cache_dir", _CACHE_DIR)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+try:
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+except Exception:
+    pass  # knob name varies across jax versions; cache still works
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 # --- per-test wall-clock timeout (no pytest-timeout in this image) ---

@@ -1,0 +1,725 @@
+"""Conflict provenance gates (ISSUE 17): per-abort witnesses end-to-end.
+
+The witness rule — (conflicting write version, losing read-range
+ordinal) for every CONFLICT verdict, None otherwise; phase-1 conflicts
+name the FIRST conflicting read range and the history step function's
+range max over it, intra-batch conflicts name the first range
+intersecting an earlier committed writer and report `now` — must be
+BIT-IDENTICAL across every arm that can decide a batch: the CPU chunked
+mirror, the flat CPU engine, the device program (XLA and Pallas kernels,
+flat and tiered history), the shard_map sharded step, and the brute
+force reimplemented here from scratch.  Faulted streams (breaker open
+mid-batch, mirror replay) must report the same provenance as a
+fault-free run, and the operator surfaces built on it — the structured
+not_committed cause, the client retry hint, `cli contention`, and the
+soak contention block — must be deterministic under same-seed replay.
+
+Shape discipline (1-core CI host): key_words=3 + bucket_mins=(32,128,64)
+with h_cap in {1<<9, 1<<10} and the test_kernels sharded splits — the
+same static shapes the other device suites compile, so XLA's in-process
+jit cache makes this module's marginal compile cost near zero.
+"""
+
+import json
+
+import pytest
+
+from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+from foundationdb_tpu.conflict.engine_cpu_flat import FlatCpuConflictSet
+from foundationdb_tpu.conflict.oracle import OracleConflictSet
+from foundationdb_tpu.conflict.types import (
+    COMMITTED,
+    CONFLICT,
+    TOO_OLD,
+    TransactionConflictInfo as T,
+)
+from foundationdb_tpu.flow import DeterministicRandom, set_event_loop
+from foundationdb_tpu.flow.error import FdbError
+from foundationdb_tpu.flow.knobs import g_knobs
+
+BUCKETS = (32, 128, 64)
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def k(i: int) -> bytes:
+    return b"%08d" % i
+
+
+def _random_stream(seed, keyspace, batches, txns_per_batch, snap_lag=25):
+    rng = DeterministicRandom(seed)
+    version = 10
+    out = []
+    for _ in range(batches):
+        txns = []
+        for _ in range(rng.random_int(1, txns_per_batch + 1)):
+            tr = T(read_snapshot=max(0, version - rng.random_int(0, snap_lag)))
+            for _ in range(rng.random_int(0, 4)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 8))
+                tr.read_ranges.append((k(a), k(b)))
+            for _ in range(rng.random_int(0, 3)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 10))
+                tr.write_ranges.append((k(a), k(b)))
+            txns.append(tr)
+        now = version + rng.random_int(1, 10)
+        out.append((txns, now, max(0, version - snap_lag)))
+        version = now
+    return out
+
+
+def _brute_force(stream):
+    """Witness + verdicts recomputed from scratch — independent of both
+    the oracle and the engines (its own overlap test, its own history
+    walk) so a shared bug cannot hide."""
+    history = []  # (begin, end, version)
+    oldest = 0
+    out = []
+
+    def overlaps(a, b):
+        return a[0] < b[1] and b[0] < a[1]
+
+    for txns, now, new_oldest in stream:
+        statuses, witness = [], []
+        batch_writes = []
+        for tr in txns:
+            if tr.read_snapshot < oldest and tr.read_ranges:
+                statuses.append(TOO_OLD)
+                witness.append(None)
+                continue
+            wtn = None
+            for i, r in enumerate(tr.read_ranges):
+                hits = [v for (b, e, v) in history if overlaps(r, (b, e))]
+                if any(v > tr.read_snapshot for v in hits):
+                    wtn = (max(hits), i)
+                    break
+            if wtn is None:
+                for i, r in enumerate(tr.read_ranges):
+                    if any(overlaps(r, w) for w in batch_writes):
+                        wtn = (now, i)
+                        break
+            witness.append(wtn)
+            if wtn is None:
+                statuses.append(COMMITTED)
+                batch_writes.extend(tr.write_ranges)
+            else:
+                statuses.append(CONFLICT)
+        history.extend((b, e, now) for (b, e) in batch_writes)
+        if new_oldest > oldest:
+            oldest = new_oldest
+            history = [h for h in history if h[2] >= oldest]
+        out.append((statuses, witness))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. the rule itself
+# ---------------------------------------------------------------------------
+
+
+def test_witness_rule_handcrafted():
+    """Phase-1 names the FIRST conflicting read range and the range max;
+    intra-batch names the first range under an earlier committed writer
+    and reports `now`; TOO_OLD and COMMITTED report None."""
+    cs = CpuConflictSet()
+    assert cs.detect(
+        [T(read_snapshot=0, write_ranges=[(k(10), k(20))])], 100, 0
+    ) == [COMMITTED]
+    assert cs.last_witness == [None]
+    s = cs.detect(
+        [
+            # range 0 misses, range 1 conflicts -> ordinal 1, version 100
+            T(read_snapshot=99,
+              read_ranges=[(k(30), k(31)), (k(15), k(16)), (k(12), k(13))]),
+            T(read_snapshot=100, read_ranges=[(k(15), k(16))]),  # strict >
+        ],
+        101,
+        0,
+    )
+    assert s == [CONFLICT, COMMITTED]
+    assert cs.last_witness == [(100, 1), None]
+    # Intra-batch: t0 writes x, t1 reads (y-miss, x-hit) -> (now, 1).
+    s = cs.detect(
+        [
+            T(read_snapshot=101, write_ranges=[(b"x", b"x\x00")]),
+            T(read_snapshot=101,
+              read_ranges=[(b"y", b"y\x00"), (b"x", b"x\x00")]),
+        ],
+        110,
+        0,
+    )
+    assert s == [COMMITTED, CONFLICT]
+    assert cs.last_witness == [None, (110, 1)]
+    # TOO_OLD: no witness (there is no specific conflicting write).
+    old = CpuConflictSet(oldest_version=50)
+    assert old.detect(
+        [T(read_snapshot=10, read_ranges=[(k(1), k(2))])], 60, 50
+    ) == [TOO_OLD]
+    assert old.last_witness == [None]
+
+
+@pytest.mark.parametrize("seed", [1, 2, 3, 4])
+def test_witness_cpu_engines_match_brute_force(seed):
+    """Chunked mirror == flat engine == oracle == from-scratch brute
+    force, witnesses AND verdicts, across random streams."""
+    stream = _random_stream(seed, 40, batches=30, txns_per_batch=10)
+    want = _brute_force(stream)
+    for eng in (CpuConflictSet(), FlatCpuConflictSet(), OracleConflictSet()):
+        got = []
+        for txns, now, nov in stream:
+            statuses = eng.detect(txns, now, nov)
+            got.append((statuses, list(eng.last_witness)))
+        assert got == want, type(eng).__name__
+
+
+# ---------------------------------------------------------------------------
+# 2. device differential: flat/tiered x kernels on/off
+# ---------------------------------------------------------------------------
+
+
+def _run_device(stream, monkeypatch, kernels: bool, tiered: bool):
+    from foundationdb_tpu.conflict.engine_jax import JaxConflictSet
+
+    monkeypatch.setenv("FDB_TPU_KERNELS", "1" if kernels else "0")
+    if tiered:
+        monkeypatch.setenv("FDB_TPU_HISTORY", "tiered")
+        monkeypatch.setenv("FDB_TPU_DELTA_CAP", "512")
+        monkeypatch.setenv("FDB_TPU_EVICT_EVERY", "3")
+    else:
+        monkeypatch.delenv("FDB_TPU_HISTORY", raising=False)
+    cs = JaxConflictSet(key_words=3, h_cap=1 << 10, bucket_mins=BUCKETS)
+    assert cs._use_kernels is kernels and cs.tiered is tiered
+    return [
+        (cs.detect(txns, now, nov), list(cs.last_witness))
+        for txns, now, nov in stream
+    ]
+
+
+@pytest.mark.parametrize("kernels", [False, True], ids=["xla", "kernels"])
+@pytest.mark.parametrize("tiered", [False, True], ids=["flat", "tiered"])
+@pytest.mark.parametrize("seed", [11, 23, 47])
+def test_witness_device_differential(monkeypatch, seed, tiered, kernels):
+    """The tentpole gate: the device program's witness (decoded through
+    the dispatch ticket) is bit-identical to the CPU reference and the
+    brute force, in both the XLA and Pallas arms, flat and tiered."""
+    stream = _random_stream(seed, 50, batches=8, txns_per_batch=8)
+    got = _run_device(stream, monkeypatch, kernels=kernels, tiered=tiered)
+    assert got == _brute_force(stream)
+
+
+# One seed rides tier-1; the other two are slow-marked — each seed pays
+# two uncompiled-cached ShardedJaxConflictSet builds (~35s apiece on the
+# 1-core host), which busts the tier-1 budget at three seeds.  The full
+# >=3-seed matrix runs under `-m slow` (and the flat/tiered device
+# differential above keeps all three seeds in tier-1: JaxConflictSet
+# compiles ARE cached across instances).
+@pytest.mark.parametrize(
+    "seed",
+    [5,
+     pytest.param(19, marks=pytest.mark.slow),
+     pytest.param(31, marks=pytest.mark.slow)],
+)
+def test_witness_sharded_differential(monkeypatch, seed):
+    """The shard_map step: per-shard witnesses against clipped views,
+    min-ordinal/max-version combined and translated back to the
+    transaction's ORIGINAL read-range ordinals — kernels on == off ==
+    a per-shard oracle combined by the same (host-twin) rule."""
+    from foundationdb_tpu.parallel.sharded_resolver import (
+        ShardedJaxConflictSet,
+        _combine_witness,
+        _translate_witness,
+    )
+
+    stream = _random_stream(seed, 60, batches=8, txns_per_batch=8)
+    splits = [k(20), k(40)]
+
+    def run(kernels):
+        monkeypatch.setenv("FDB_TPU_KERNELS", "1" if kernels else "0")
+        cs = ShardedJaxConflictSet(
+            splits, key_words=3, h_cap=1 << 9, bucket_mins=BUCKETS,
+        )
+        return [
+            (cs.detect(txns, now, nov), list(cs.last_witness))
+            for txns, now, nov in stream
+        ]
+
+    # Reference: clip per shard, witness per shard via the oracle,
+    # translate ordinals, combine — the multi-resolver semantic.
+    def clip(rng, lo, hi):
+        b, e = rng
+        cb = max(b, lo)
+        ce = e if hi is None else min(e, hi)
+        return (cb, ce) if cb < ce else None
+
+    lows = [b""] + splits
+    highs = splits + [None]
+    engines = [OracleConflictSet() for _ in lows]
+    want = []
+    for txns, now, nov in stream:
+        parts, verdicts = [], []
+        for (lo, hi), eng in zip(zip(lows, highs), engines):
+            local, rmap = [], []
+            for tr in txns:
+                rr, rm = [], []
+                for i, r in enumerate(tr.read_ranges):
+                    c = clip(r, lo, hi)
+                    if c is not None:
+                        rr.append(c)
+                        rm.append(i)
+                wr = [c for r in tr.write_ranges
+                      if (c := clip(r, lo, hi)) is not None]
+                local.append(T(read_snapshot=tr.read_snapshot,
+                               read_ranges=rr, write_ranges=wr))
+                rmap.append(rm)
+            verdicts.append(eng.detect(local, now, nov))
+            parts.append(_translate_witness(eng.last_witness, rmap))
+        statuses = [min(v) for v in zip(*verdicts)]
+        want.append((statuses, _combine_witness(parts, statuses)))
+
+    on = run(True)
+    assert on == run(False)
+    assert on == want
+
+
+# ---------------------------------------------------------------------------
+# 3. faulted streams: breaker open mid-stream, mirror replay
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [3, 5, 9])
+def test_witness_through_faults_matches_fault_free(monkeypatch, seed):
+    """Scripted dispatch faults open the breaker mid-stream (including
+    the first half-open probe): the batches the mirror absorbs and the
+    replayed recovery batches report witnesses BIT-IDENTICAL to a
+    fault-free brute-force run, and a same-seed faulted rerun is
+    byte-identical — the differential gate extended from verdicts to
+    witnesses."""
+    from foundationdb_tpu.conflict.api import ConflictSet
+    from foundationdb_tpu.conflict.device_faults import DeviceFaultInjector
+
+    monkeypatch.setenv("FDB_TPU_KERNELS", "0")
+    stream = _random_stream(seed, 50, batches=14, txns_per_batch=8)
+
+    def run():
+        inj = DeviceFaultInjector()
+        for at in (4, 5, 6, 7):  # 3 consecutive opens + a faulted probe
+            inj.script("dispatch", at=at)
+        cs = ConflictSet(backend="jax", key_words=3, h_cap=1 << 10,
+                         bucket_mins=BUCKETS, fault_injector=inj)
+        out = []
+        for txns, now, nov in stream:
+            b = cs.new_batch()
+            for t in txns:
+                b.add_transaction(t)
+            statuses = b.detect_conflicts(now, nov)
+            out.append((statuses, list(cs.last_witness)))
+        return out, cs.device_metrics()
+
+    got, dm = run()
+    assert got == _brute_force(stream)
+    assert dm["counters"]["device_faults"] >= 3  # the breaker really opened
+    got2, dm2 = run()
+    assert got2 == got
+    assert json.dumps(dm2["breaker"]) == json.dumps(dm["breaker"])
+
+
+def test_witness_off_surfaces_empty(monkeypatch):
+    """FDB_TPU_WITNESS=0: engines still decide identically but the
+    surface reports no witnesses — last_witness is [] on the api set."""
+    from foundationdb_tpu.conflict.api import ConflictSet
+
+    monkeypatch.setenv("FDB_TPU_WITNESS", "0")
+    cs = ConflictSet(backend="cpu")
+    b = cs.new_batch()
+    b.add_transaction(T(read_snapshot=0, write_ranges=[(k(1), k(2))]))
+    b.detect_conflicts(10, 0)
+    b2 = cs.new_batch()
+    b2.add_transaction(T(read_snapshot=5, read_ranges=[(k(1), k(2))]))
+    assert b2.detect_conflicts(20, 0) == [CONFLICT]
+    assert cs.last_witness == []
+
+
+# ---------------------------------------------------------------------------
+# 4. wire + proxy + client: the structured cause and the retry hint
+# ---------------------------------------------------------------------------
+
+
+def _lost_conflict(c, db):
+    """Run a read-modify-write race: returns (loser FdbError, winner's
+    commit version).  The loser read before the winner committed."""
+    out = {}
+
+    async def go():
+        t1 = db.create_transaction()
+        await t1.get(b"wk")
+        t2 = db.create_transaction()
+        t2.set(b"wk", b"winner")
+        out["win_version"] = await t2.commit()
+        t1.set(b"wk", b"loser")
+        try:
+            await t1.commit()
+        except FdbError as e:
+            out["err"] = e
+            out["tr"] = t1
+
+    c.run_until(db.process.spawn(go(), "race"), timeout_vt=500.0)
+    return out
+
+
+def test_structured_not_committed_cause():
+    """The proxy decodes the winning resolver's witness into a
+    structured cause: the conflicting write version, the exact key
+    range, and the batch's resolve version as the safe retry point."""
+    from foundationdb_tpu.server import SimCluster
+
+    c = SimCluster(seed=101)
+    out = _lost_conflict(c, c.database())
+    e = out["err"]
+    assert e.name == "not_committed"
+    d = e.detail
+    assert isinstance(d, dict), d
+    assert d["version"] == out["win_version"]
+    assert d["retry_version"] >= out["win_version"]
+    assert d["range"] == (b"wk", b"wk\x00")
+
+
+def test_structured_cause_cross_resolver_boundary():
+    """A conflict whose read spans resolver boundaries still names the
+    conflicting range — decoded against the CLIPPED per-resolver view
+    the witness ordinal refers to."""
+    from foundationdb_tpu.server import SimCluster
+
+    c = SimCluster(seed=56, n_resolvers=4)
+    db1, db2 = c.database(), c.database()
+    out = {}
+
+    def make(db, me, key):
+        async def go():
+            tr = db.create_transaction()
+            try:
+                await tr.get_range(b"\x10", b"\xf0", limit=5)
+                tr.set(key, b"x")
+                await tr.commit()
+                out[me] = "committed"
+            except FdbError as e:
+                out[me] = e
+
+        return go()
+
+    c.run_all(
+        [(db1, make(db1, 1, b"\x20k")), (db2, make(db2, 2, b"\xe0k"))],
+        timeout_vt=500.0,
+    )
+    err = next(v for v in out.values() if isinstance(v, FdbError))
+    d = err.detail
+    assert isinstance(d, dict) and d["range"] is not None
+    b, e_ = d["range"]
+    # The named range is inside the loser's read and covers the winner's
+    # write — the clipped per-resolver view decoded back to key bytes.
+    assert b"\x10" <= b < e_ <= b"\xf0"
+    win_key = b"\x20k" if out[1] == "committed" else b"\xe0k"
+    assert b <= win_key < e_, (d, out)
+
+
+def test_retry_hint_seeds_read_version():
+    """on_error with a structured cause seeds the next attempt's read
+    version at retry_version (no fresh GRV) and skips the blind backoff;
+    FDB_TPU_WITNESS_RETRY=0 keeps the blind path."""
+    from foundationdb_tpu.server import SimCluster
+
+    c = SimCluster(seed=102)
+    db = c.database()
+    out = _lost_conflict(c, db)
+    e, tr = out["err"], out["tr"]
+
+    async def retry():
+        await tr.on_error(e)
+        out["seeded"] = tr._read_version
+        out["rv"] = await tr.get_read_version()
+
+    c.run_until(db.process.spawn(retry(), "retry"), timeout_vt=500.0)
+    assert out["seeded"] == e.detail["retry_version"]
+    assert out["rv"] == e.detail["retry_version"]  # no GRV round-trip
+    assert db.witness_hint_retries == 1
+
+
+def test_retry_hint_disabled_stays_blind(monkeypatch):
+    from foundationdb_tpu.server import SimCluster
+
+    monkeypatch.setenv("FDB_TPU_WITNESS_RETRY", "0")
+    c = SimCluster(seed=103)
+    db = c.database()
+    out = _lost_conflict(c, db)
+    e, tr = out["err"], out["tr"]
+
+    async def retry():
+        await tr.on_error(e)
+        out["seeded"] = tr._read_version
+
+    c.run_until(db.process.spawn(retry(), "retry"), timeout_vt=500.0)
+    assert out["seeded"] is None
+    assert getattr(db, "witness_hint_retries", 0) == 0
+
+
+def test_witness_off_bare_not_committed(monkeypatch):
+    """FDB_TPU_WITNESS=0: the reply carries no witnesses, the proxy
+    sends the reference's bare not_committed (detail None), and the
+    client falls back to the blind retry — the wire format is
+    backward-compatible in both directions."""
+    from foundationdb_tpu.server import SimCluster
+
+    monkeypatch.setenv("FDB_TPU_WITNESS", "0")
+    c = SimCluster(seed=104)
+    db = c.database()
+    out = _lost_conflict(c, db)
+    e, tr = out["err"], out["tr"]
+    assert e.name == "not_committed" and e.detail is None
+
+    async def retry():
+        await tr.on_error(e)
+        out["seeded"] = tr._read_version
+
+    c.run_until(db.process.spawn(retry(), "retry"), timeout_vt=500.0)
+    assert out["seeded"] is None
+
+
+# ---------------------------------------------------------------------------
+# 5. resolver sample decay (the satellite fix) + contention ring
+# ---------------------------------------------------------------------------
+
+
+def test_topk_decays_on_real_batches_only():
+    """The decay clock is conflict-bearing batches, never idle time:
+    conflict-free traffic and quiescent virtual time leave the top-K
+    gauge byte-identical; the decay_batches-th REAL batch halves it."""
+    from foundationdb_tpu.server import SimCluster
+
+    c = SimCluster(seed=105)
+    r = c.resolver
+    gauge = r.metrics.gauge("conflict_witness_topk")
+    every = int(g_knobs.server.resolver_witness_decay_batches)
+    txn = T(read_snapshot=0, read_ranges=[(b"a", b"b")])
+    for _ in range(every - 1):
+        r._witness_record([txn], [CONFLICT], [(5, 0)], version=10)
+    assert json.loads(gauge.value) == [["61", "62", every - 1]]
+    before = gauge.value
+
+    # Conflict-free live traffic + idle virtual time: no decay tick.
+    db = c.database()
+
+    async def quiet():
+        for i in range(5):
+            tr = db.create_transaction()
+            tr.set(b"q%d" % i, b"v")
+            await tr.commit()
+        await c.loop.delay(300.0)
+
+    c.run_until(db.process.spawn(quiet(), "quiet"), timeout_vt=5000.0)
+    assert gauge.value == before, "idle/conflict-free traffic decayed top-K"
+    assert r._witness_batches == every - 1
+
+    # The next REAL conflict batch crosses the boundary: counts halve
+    # (the new abort lands, then 64 // 2).
+    r._witness_record([txn], [CONFLICT], [(5, 0)], version=11)
+    assert json.loads(gauge.value) == [["61", "62", every // 2]]
+
+
+def test_contention_ring_and_conflict_witness_block():
+    """_witness_record appends one timeline entry per conflict-bearing
+    batch — version, batch size, abort count, per-range counts — and
+    conflict_witness() surfaces ring + streak + spike counters."""
+    from foundationdb_tpu.server import SimCluster
+
+    c = SimCluster(seed=106)
+    r = c.resolver
+    txns = [
+        T(read_snapshot=0, read_ranges=[(b"a", b"b")]),
+        T(read_snapshot=0, read_ranges=[(b"c", b"d")]),
+        T(read_snapshot=0, write_ranges=[(b"e", b"f")]),
+    ]
+    r._witness_record(
+        txns, [CONFLICT, CONFLICT, COMMITTED], [(5, 0), (7, 0), None],
+        version=42,
+    )
+    cw = r.conflict_witness()
+    assert cw["aborts"] == 0  # counter is _complete_resolve's; ring is ours
+    (entry,) = cw["contention"]["timeline"]
+    assert entry == {
+        "version": 42,
+        "batch": 3,
+        "aborted": 2,
+        "ranges": [["61", "62", 1], ["63", "64", 1]],
+    }
+    assert cw["contention"]["witness_batches"] == 1
+    assert cw["contention"]["spikes"] == 0
+
+
+# ---------------------------------------------------------------------------
+# 6. the operator surfaces: cli contention, status qos, soak
+# ---------------------------------------------------------------------------
+
+
+def _fresh_globals():
+    from foundationdb_tpu.flow.flight_recorder import (
+        FlightRecorder,
+        set_global_flight_recorder,
+    )
+    from foundationdb_tpu.flow.spans import SpanHub, set_global_span_hub
+    from foundationdb_tpu.flow.timeseries import (
+        TimeSeriesHub,
+        set_global_timeseries,
+    )
+
+    set_global_flight_recorder(FlightRecorder())
+    set_global_span_hub(SpanHub())
+    set_global_timeseries(TimeSeriesHub())
+
+
+def _contention_cli_run(seed):
+    """Hot-key contention on a fresh 2-resolver cluster, then `cli
+    contention --format=json` — returns the exact text."""
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.tools.cli import CliProcessor
+
+    _fresh_globals()
+    c = SimCluster(seed=seed, n_resolvers=2)
+    db = c.database()
+
+    async def one(db, i):
+        tr = db.create_transaction()
+        while True:
+            try:
+                await tr.get(b"hot")
+                tr.set(b"hot", b"%d" % i)
+                await tr.commit()
+                return
+            except FdbError as e:
+                await tr.on_error(e)
+
+    c.run_all([(db, one(db, i)) for i in range(12)], timeout_vt=500.0)
+
+    async def show(db):
+        cli = CliProcessor(c, db)
+        return await cli.run_command("contention --format=json")
+
+    lines = c.run_until(db.process.spawn(show(db), "cli"), timeout_vt=60.0)
+    set_event_loop(None)
+    return "\n".join(lines)
+
+
+def test_cli_contention_same_seed_byte_identical():
+    """`cli contention --format=json` joins witness timelines, span
+    percentiles, and spike captures into one canonical document —
+    byte-identical across same-seed runs, divergent across seeds."""
+    a = _contention_cli_run(7)
+    b = _contention_cli_run(7)
+    assert a == b
+    doc = json.loads(a)
+    (res,) = [r for r in doc["resolvers"].values() if r["aborts"] > 0]
+    assert res["witness_batches"] > 0 and res["topk"]
+    (rng_key, slot) = next(iter(res["ranges"].items()))
+    assert ".." in rng_key and slot["aborts"] > 0 and slot["timeline"]
+    # The span join is present for every resolver, exact stage names.
+    assert set(doc["spans"]) == set(doc["resolvers"])
+    for stages in doc["spans"].values():
+        assert "resolve_batch" in stages
+    assert _contention_cli_run(8) != a
+
+
+def test_status_qos_contention_block():
+    """status json carries the merged contention block: max streak,
+    summed spikes, and the cross-resolver recent timeline."""
+    from foundationdb_tpu.server import SimCluster
+    from foundationdb_tpu.server.status import cluster_status
+
+    _fresh_globals()
+    c = SimCluster(seed=9)
+    db = c.database()
+
+    async def one(db, i):
+        tr = db.create_transaction()
+        while True:
+            try:
+                await tr.get(b"hot")
+                tr.set(b"hot", b"%d" % i)
+                await tr.commit()
+                return
+            except FdbError as e:
+                await tr.on_error(e)
+
+    c.run_all([(db, one(db, i)) for i in range(8)], timeout_vt=500.0)
+    qos = cluster_status(c)["cluster"]["qos"]
+    ct = qos["contention"]
+    assert ct["timeline_batches"] > 0
+    assert ct["recent"] and all("version" in t for t in ct["recent"])
+    assert qos["conflict_witness_aborts"] > 0
+
+
+def test_soak_contention_block_spike_capture_and_replay():
+    """The high-contention Zipf soak arm: the report's contention block
+    is populated (witness batches, per-range timeline, decayed top-K,
+    hint-guided retries), the flight recorder's contention_spike capture
+    fires EXACTLY once (cooldown suppresses the sustained tail), and two
+    same-seed runs are byte-identical."""
+    from foundationdb_tpu.workloads.soak import contention_config, run_soak
+
+    old = g_knobs.server.resolver_contention_spike_batches
+    g_knobs.server.resolver_contention_spike_batches = 3
+    try:
+        def go():
+            return run_soak(contention_config(
+                minutes=0.05, peak_tps=100.0, seed=3, witness_retry=True,
+            ))
+
+        rep = go()
+        ct = rep["contention"]
+        assert ct["witness_retry"] is True
+        assert ct["hint_retries"] > 0
+        (res,) = [r for r in ct["resolvers"].values() if r["aborts"] > 0]
+        assert res["witness_batches"] > 0 and res["topk"] and res["timeline"]
+        # Exactly one capture: the spike is sustained, the cooldown
+        # swallows every re-trigger inside this (short) run.
+        assert ct["spike_captures"] == 1
+        caps = [c for c in rep["flight_recorder"]["captures"]
+                if c["trigger"] == "contention_spike"]
+        assert len(caps) == 1
+        assert caps[0]["detail"]["streak"] >= 3
+        assert res["spikes"] == 1
+        assert json.dumps(go(), sort_keys=True) == json.dumps(
+            rep, sort_keys=True
+        )
+    finally:
+        g_knobs.server.resolver_contention_spike_batches = old
+
+
+@pytest.mark.slow
+@pytest.mark.soak
+def test_contention_ab_guided_beats_blind():
+    """THE acceptance arm (slow-marked): witness-guided retry — seed the
+    retry read version at the abort's resolve version, skip the blind
+    backoff — beats blind retry on goodput under the high-contention
+    Zipf load, with fewer conflict aborts per committed txn."""
+    from foundationdb_tpu.workloads.soak import run_contention_ab
+
+    ab = run_contention_ab(minutes=0.1, peak_tps=100.0, seed=3)
+    g, b = ab["guided"], ab["blind"]
+    assert g["hint_retries"] > 0 and b["hint_retries"] == 0
+    assert ab["goodput_ratio"] >= 1.0, ab
+    assert g["goodput_tps"] >= b["goodput_tps"], ab
+    assert g["conflicted"] < b["conflicted"], ab
+
+
+def test_witness_env_flags_registered():
+    """ENV001 satellite: the witness flags are declared in g_env with
+    defaults and help text."""
+    from foundationdb_tpu.flow.knobs import g_env
+
+    decl = g_env.declared()
+    for name in ("FDB_TPU_WITNESS", "FDB_TPU_WITNESS_RETRY"):
+        default, help_ = decl[name]
+        assert default == "1" and help_ != "", name

@@ -34,6 +34,8 @@ Run: python tools/perf_experiments.py   (on the TPU host)
      any host)
      python tools/perf_experiments.py --timeline  (short pipelined run
      -> TIMELINE.json Perfetto artifact + phase attribution, any host)
+     python tools/perf_experiments.py --contention  (witness-guided vs
+     blind retry Zipf A/B -> CONTENTION_AB.json, any host)
 """
 
 import json
@@ -165,6 +167,47 @@ def main():
         else:
             artifact["tail"] = (res.stdout + res.stderr)[-800:]
         out_path = os.path.join(REPO, "MULTICHIP_r06.json")
+        with open(out_path, "w") as f:
+            json.dump(artifact, f, indent=2, sort_keys=True)
+        print(json.dumps(artifact, indent=2, sort_keys=True))
+        print(f"wrote {out_path}", file=sys.stderr)
+        return
+    if "--contention" in sys.argv:
+        # Witness-guided vs blind retry A/B (ISSUE 17): the
+        # high-contention Zipf soak arm twice under identical seeds —
+        # once with FDB_TPU_WITNESS_RETRY seeding the retry read version
+        # from the abort witness, once blind (fresh GRV + backoff) —
+        # scored on goodput, retry count, and commit p99.  Runs anywhere
+        # (simulated cluster, virtual time); a fresh subprocess keeps the
+        # process-global span hub / flight recorder out of the score.
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = REPO
+        code = (
+            "import json, sys; sys.path.insert(0, %r)\n"
+            "from foundationdb_tpu.workloads.soak import run_contention_ab\n"
+            "ab = run_contention_ab(minutes=0.1, peak_tps=100.0, seed=3)\n"
+            "ab.pop('reports', None)  # scores only; soak owns the blobs\n"
+            "print('RESULT ' + json.dumps(ab))\n"
+        ) % REPO
+        res = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=1800,
+        )
+        line = next(
+            (l for l in res.stdout.splitlines() if l.startswith("RESULT ")),
+            None,
+        )
+        artifact = {
+            "rc": res.returncode,
+            "ok": res.returncode == 0 and line is not None,
+            "arm": "contention_zipf_ab",
+        }
+        if line is not None:
+            artifact.update(json.loads(line[len("RESULT "):]))
+        else:
+            artifact["tail"] = (res.stdout + res.stderr)[-800:]
+        out_path = os.path.join(REPO, "CONTENTION_AB.json")
         with open(out_path, "w") as f:
             json.dump(artifact, f, indent=2, sort_keys=True)
         print(json.dumps(artifact, indent=2, sort_keys=True))
