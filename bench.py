@@ -762,9 +762,9 @@ def bench_timeline(out_path="TIMELINE.json", depth=2, n_batches=16,
         dev_spans = hub.spans(name="device")
         doc = perfetto_trace(hub, include_wall=True)
         errors = validate_perfetto(doc)
-        with open(out_path, "w", encoding="utf-8") as f:
-            json.dump(doc, f, sort_keys=True, separators=(",", ":"))
-            f.write("\n")
+        atomic_write_json(
+            out_path, doc, sort_keys=True, separators=(",", ":")
+        )
         return {
             "metric": "pipeline_overlap_efficiency",
             "value": round(overlap_efficiency(dev_spans, axis="wall"), 4),
@@ -782,6 +782,40 @@ def bench_timeline(out_path="TIMELINE.json", depth=2, n_batches=16,
         }
     finally:
         set_global_span_hub(old_hub)
+
+
+def _persist_arms(out):
+    """Tunnel-resilient per-arm artifact (ISSUE 18 satellite): after each
+    device arm completes (or fails), the variants-so-far land atomically
+    in BENCH_ARMS.json — a mid-campaign tunnel death leaves every
+    finished arm on disk instead of a lost session."""
+    atomic_write_json(
+        os.path.join(
+            os.path.dirname(os.path.abspath(__file__)), "BENCH_ARMS.json"
+        ),
+        {
+            "variants": out.get("variants", {}),
+            "best_variant": out.get("variant"),
+            "best_txns_per_sec": out.get("value"),
+            "run_attempts": out.get("run_attempts", 0),
+        },
+        indent=2,
+        sort_keys=True,
+    )
+
+
+def atomic_write_json(path, doc, **dump_kwargs):
+    """Write a JSON artifact via tmp + os.replace (ISSUE 18 satellite):
+    a mid-campaign tunnel death leaves either the previous artifact or
+    the complete new one on disk, never a torn half-write — so partial
+    bench sessions keep every arm that finished."""
+    tmp = f"{path}.tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(doc, f, **dump_kwargs)
+        f.write("\n")
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
 
 
 def emit(out, errors):
@@ -1224,6 +1258,7 @@ def device_phase(out, errors, cpp_rate, cpu_rate):
                  f"{deadline - time.perf_counter():.0f}s of budget left")
             out["variants"][name] = {"error": str(e)[-200:]}
             emit(out, errors)
+            _persist_arms(out)
             fails_here += 1
             if best is not None or fails_here >= 2:
                 # With a number on the board a failing EXTRA variant is
@@ -1252,6 +1287,7 @@ def device_phase(out, errors, cpp_rate, cpu_rate):
             elif cpu_rate:
                 out["vs_baseline"] = round(jax_rate / cpu_rate, 3)
         emit(out, errors)
+        _persist_arms(out)
         vi += 1
         fails_here = 0
     if best is None:

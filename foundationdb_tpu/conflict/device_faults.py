@@ -80,13 +80,18 @@ class DeviceOOM(DeviceFault):
     transient = False
 
 
-SITES = ("dispatch", "compile", "grow", "rebase")
+SITES = ("dispatch", "compile", "grow", "rebase", "reshard")
 
 _SITE_FAULT = {
     "dispatch": DeviceUnavailable,
     "compile": CompileFailed,
     "grow": DeviceOOM,
     "rebase": DeviceOOM,
+    # Live split-point migration (ISSUE 18): a fault at the reshard site
+    # models the device going away mid-handoff.  The move defers (the old
+    # partition stays whole — the snapshot cut is immutable, so nothing
+    # is torn) and the shard's breaker counts the failure.
+    "reshard": DeviceUnavailable,
 }
 
 

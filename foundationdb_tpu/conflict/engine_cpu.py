@@ -51,6 +51,8 @@ __all__ = [
     "FlatCpuConflictSet",
     "MirrorSnapshot",
     "FLOOR_VERSION",
+    "slice_snapshot_chunks",
+    "engine_from_handoff",
 ]
 
 _PAIR_INF = 1 << 63  # "no droppable pair here" sentinel
@@ -564,3 +566,69 @@ class CpuConflictSet:
         satellite; the flat engine pays len(keys))."""
         self._apply_staged()
         return self._count
+
+
+# -- live reshard handoff (ISSUE 18) --
+def slice_snapshot_chunks(
+    snap: MirrorSnapshot, lo: bytes, hi: Optional[bytes]
+) -> Tuple[int, list]:
+    """(version in force at `lo`, chunks of `snap` restricted to the open
+    interval (lo, hi)); hi=None means +inf.  The reshard handoff
+    primitive: chunks wholly inside the interval are adopted BY
+    REFERENCE — their identity (and the per-chunk device encode caches
+    riding on ``_Chunk.enc``) survives the move, so rehydrating a moved
+    shard re-encodes only the split boundary chunks, O(moved ranges) —
+    while chunks straddling `lo`/`hi` are split into fresh chunks.  The
+    snapshot is immutable, so a fault landing mid-handoff cannot tear
+    the cut."""
+    floor = FLOOR_VERSION
+    out: list = []
+    for ch in snap.chunks:
+        keys = ch.keys
+        if keys[-1] <= lo:
+            # Entire chunk at or below lo: only its last version can be
+            # the one in force at lo so far.
+            floor = ch.vers[-1]
+            continue
+        i = 0
+        if keys[0] <= lo:
+            i = bisect_right(keys, lo)  # first boundary strictly > lo
+            floor = ch.vers[i - 1]
+        if hi is not None and keys[-1] >= hi:
+            j = bisect_left(keys, hi)  # first boundary >= hi (next shard's)
+        else:
+            j = len(keys)
+        if i == 0 and j == len(keys):
+            out.append(ch)  # wholly inside: adopt by reference
+        elif i < j:
+            out.append(_Chunk(keys[i:j], ch.vers[i:j]))
+        if hi is not None and keys[-1] >= hi:
+            break
+    return floor, out
+
+
+def engine_from_handoff(
+    parts, oldest_version: int, chunk: Optional[int] = None
+) -> "CpuConflictSet":
+    """Build a shard engine for a NEW key range from immutable snapshot
+    cuts of the old shards (ISSUE 18 live split-point migration).
+
+    ``parts`` is ``[(snapshot, lo, hi)]`` in global key order, covering
+    the new shard's range contiguously (hi=None = +inf); per the
+    shard-engine convention the result is re-anchored at ``b""`` with
+    the version in force at the first part's ``lo`` as the floor.
+    Interior chunks keep their identity (encode caches included); only
+    boundary chunks at moved split points are rebuilt."""
+    eng = CpuConflictSet(oldest_version, chunk=chunk)
+    chunks: list = []
+    first_floor: Optional[int] = None
+    for snap, lo, hi in parts:
+        floor, chs = slice_snapshot_chunks(snap, lo, hi)
+        if first_floor is None:
+            first_floor = floor
+        chunks.extend(chs)
+    head = eng._new_chunk(
+        [b""], [FLOOR_VERSION if first_floor is None else first_floor]
+    )
+    eng._set_chunks(tuple([head] + chunks))
+    return eng

@@ -369,6 +369,12 @@ g_env.declare("FDB_TPU_MIRROR_CHECK_SECONDS", "10",
                    "actor (virtual seconds in sim): diffs a live mirror "
                    "snapshot against the device export and opens the "
                    "breaker on confirmed divergence; 0 disables")
+g_env.declare("FDB_TPU_SHARD_BALANCE_SECONDS", "0",
+              help="period of the resolver's shard-balancer actor "
+                   "(virtual seconds in sim): evaluates per-shard "
+                   "occupancy + contention skew and migrates split "
+                   "points live (ShardBalancer over "
+                   "ShardedJaxConflictSet.reshard); 0 disables")
 # Soak-harness defaults (workloads/soak.py via `cli soak` and the
 # slow-marked soak test).  CLI arguments override these; the env flags
 # exist so CI/bench drivers can retune the soak without editing argv.

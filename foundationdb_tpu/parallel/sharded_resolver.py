@@ -79,7 +79,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..conflict import keys as keylib
 from ..conflict.device_faults import DeviceCircuitBreaker, DeviceFault
-from ..conflict.engine_cpu import CpuConflictSet, FLOOR_VERSION
+from ..conflict.engine_cpu import (
+    CpuConflictSet,
+    FLOOR_VERSION,
+    engine_from_handoff,
+)
 from ..conflict.engine_jax import (
     EP_KW1,
     EP_RR,
@@ -430,8 +434,8 @@ def uniform_int_split_keys(
 # untouched when sharding is off.
 _BREAKER_COUNTERS = (
     "device_faults", "faults_dispatch", "faults_compile", "faults_grow",
-    "faults_rebase", "faults_mirror", "breaker_opens", "breaker_probes",
-    "breaker_closes", "degraded_batches", "rehydrates",
+    "faults_rebase", "faults_mirror", "faults_reshard", "breaker_opens",
+    "breaker_probes", "breaker_closes", "degraded_batches", "rehydrates",
 )
 
 
@@ -464,6 +468,7 @@ class ShardedJaxConflictSet:
         devices: Optional[Sequence] = None,
         bucket_mins: tuple = (8, 8, 8),
         fault_injector=None,
+        max_shards: Optional[int] = None,
     ):
         self.n_shards = len(split_keys) + 1
         if mesh is None:
@@ -473,21 +478,26 @@ class ShardedJaxConflictSet:
                 f"got {len(devs)}"
             )
             mesh = Mesh(np.array(devs[: self.n_shards]), (AXIS,))
+        else:
+            devs = list(mesh.devices.flat)
         assert mesh.devices.size == self.n_shards, (
             f"mesh has {mesh.devices.size} devices but split_keys implies "
             f"{self.n_shards} shards"
         )
         self.mesh = mesh
+        # Elastic resharding (ISSUE 18): reshard()/the split balancer may
+        # later scale the shard count up to `max_shards` (bounded by the
+        # devices handed in).  Every per-shard instrument is pre-created
+        # up to that bound, so a mid-run scale-up never mints new metric
+        # names (the PR-4 flat-snapshot discipline, extended to scaling).
+        self._devices = devs
+        self.max_shards = min(
+            len(devs), max(self.n_shards, int(max_shards or self.n_shards))
+        )
         self.key_words = key_words
         self.h_cap = h_cap
         self._base = oldest_version
-        kw1 = key_words + 1
-        lo = np.zeros((self.n_shards, kw1), np.uint32)
-        hi = np.full((self.n_shards, kw1), keylib.INF_WORD, np.uint32)
-        if split_keys:
-            enc = keylib.encode_keys(list(split_keys), key_words)
-            lo[1:] = enc
-            hi[:-1] = enc
+        lo, hi = self._partition_arrays(list(split_keys))
         self.bucket_mins = bucket_mins
         # Decoded shard bounds, for host-side state exchange (mirrors,
         # resharding): split_keys[s-1] is shard s's inclusive lower bound.
@@ -540,14 +550,18 @@ class ShardedJaxConflictSet:
                    "degraded_shard_serves", "long_key_pins",
                    "rehydrate_keys_total", "rehydrate_keys_encoded",
                    "mirror_sync_keys_encoded", "mirror_checks",
-                   "mirror_divergence", "mirror_mismatch_keys"):
+                   "mirror_divergence", "mirror_mismatch_keys",
+                   "reshards", "reshard_moved_shards", "reshard_deferred",
+                   "reshard_degraded"):
             self.metrics.counter(_c)
         if self.tiered:
             self.metrics.counter("major_compactions")
         # Per-shard fault domain state: breaker + authoritative mirror +
-        # device-slice staleness + mirror-sync stamp.
+        # device-slice staleness + mirror-sync stamp.  Instruments (and
+        # breakers — construction-order ids) cover max_shards so a later
+        # scale-up finds its fault domain already wired.
         self._breakers: List[DeviceCircuitBreaker] = []
-        for s in range(self.n_shards):
+        for s in range(self.max_shards):
             prefix = f"shard{s}_"
             for name in _BREAKER_COUNTERS:
                 self.metrics.counter(prefix + name)
@@ -573,8 +587,26 @@ class ShardedJaxConflictSet:
         self._cpu_fallback_recent = deque(maxlen=32)  # (txns, wall_seconds)
         self._last_mirror_check: Optional[dict] = None
         self.fault_injector = fault_injector
+        # Replayable split-point move log (ISSUE 18): every reshard —
+        # committed, deferred, or degraded — appends one entry; same seed
+        # => json dump byte-identical.
+        self.move_log: list = []
         self._init_state(oldest_rel=0)
         self.last_iters = 0
+
+    def _partition_arrays(self, split_keys: list):
+        """Encoded per-shard [lo, hi) bound arrays for a split-key list —
+        shared by construction and reshard() (the one definition of the
+        device-side partition)."""
+        kw1 = self.key_words + 1
+        S = len(split_keys) + 1
+        lo = np.zeros((S, kw1), np.uint32)
+        hi = np.full((S, kw1), keylib.INF_WORD, np.uint32)
+        if split_keys:
+            enc = keylib.encode_keys(list(split_keys), self.key_words)
+            lo[1:] = enc
+            hi[:-1] = enc
+        return lo, hi
 
     # -- compat: the long-key pin's legacy surface (tests/old callers) --
     @property
@@ -1117,7 +1149,9 @@ class ShardedJaxConflictSet:
         m = self.metrics
         m.counter("batches").add()
         m.counter("transactions").add(pb.n_txn)
-        allowed = [br.allows_device() for br in self._breakers]
+        allowed = [
+            br.allows_device() for br in self._breakers[: self.n_shards]
+        ]
         for s in range(S):
             if not allowed[s]:
                 continue
@@ -1312,7 +1346,7 @@ class ShardedJaxConflictSet:
         order = {"ok": 0, "probing": 1, "degraded": 2}
         worst = "ok"
         degraded = 0
-        for b in self._breakers:
+        for b in self._breakers[: self.n_shards]:
             if b.state != "ok":
                 degraded += 1
             if order[b.state] > order[worst]:
@@ -1343,14 +1377,21 @@ class ShardedJaxConflictSet:
         snap["backend_state"] = sig["backend_state"]
         snap["shards"] = {
             "total": self.n_shards,
+            "max": self.max_shards,
             "degraded": sig["shards_degraded"],
-            "states": [b.state for b in self._breakers],
+            "states": [
+                b.state for b in self._breakers[: self.n_shards]
+            ],
             "stale": [bool(x) for x in self._stale],
             "pinned": self._pinned,
+            "split_keys": [k.hex() for k in self.split_keys],
+            "occupancy": self.shard_occupancy(),
+            "moves": len(self.move_log),
+            "last_move": self.last_move,
         }
         snap["shard_breakers"] = {
             f"shard{s}": self._breakers[s].snapshot()
-            for s in range(self.n_shards)
+            for s in range(self.max_shards)
         }
         if self._use_kernels:
             snap["kernels"] = {
@@ -1553,6 +1594,239 @@ class ShardedJaxConflictSet:
         self._short_streak = 0
         self._pinned = not keylib.fits(cpu.keys, self.key_words)
         self._stale = [True] * self.n_shards
+
+    # -- live split-point migration (ISSUE 18) ----------------------------
+    def shard_occupancy(self) -> list:
+        """Per-shard mirror boundary counts — the balancer's occupancy
+        gauge.  O(1) per shard (the mirrors maintain the count), always
+        exact even mid-outage (mirrors are authoritative)."""
+        return [m.boundary_count for m in self._mirrors]
+
+    @property
+    def last_move(self) -> Optional[dict]:
+        """The most recent move-log entry (status/cli `shards` block)."""
+        return self.move_log[-1] if self.move_log else None
+
+    def balance_split_keys(self, n_shards: Optional[int] = None) -> list:
+        """Quantile split points equalizing mirror boundary counts across
+        `n_shards` (default: the current count).  Candidates are the
+        ACTUAL boundary keys of the global step function (flattened per
+        the store_to convention), so an unchanged quantile reproduces an
+        existing split point exactly — reshard() then reuses that shard's
+        mirror by identity.  Returns the CURRENT split keys when the
+        history is too small to cut n ways (the balancer's no-op)."""
+        from bisect import bisect_left, bisect_right
+
+        n = self.n_shards if n_shards is None else int(n_shards)
+        ks_all: list = []
+        for (lo, hi), eng in zip(self._shard_bounds(), self._mirrors):
+            ks = eng.keys
+            if lo == b"":
+                i0 = 1  # the b"" floor boundary is not a cuttable key
+            else:
+                ks_all.append(lo)
+                i0 = bisect_right(ks, lo)
+            i1 = len(ks) if hi is None else bisect_left(ks, hi)
+            ks_all.extend(ks[i0:i1])
+        if len(ks_all) < n:
+            return list(self.split_keys)
+        out: list = []
+        for j in range(1, n):
+            k = ks_all[(len(ks_all) * j) // n]
+            if k != b"" and (not out or k > out[-1]):
+                out.append(k)
+        if len(out) != n - 1:
+            return list(self.split_keys)
+        return out
+
+    def reshard(self, new_split_keys: Sequence[bytes],
+                reason: str = "manual") -> dict:
+        """Live split-point migration: re-partition the mesh along
+        `new_split_keys` WITHOUT stopping the resolver, and return the
+        appended move-log entry.
+
+        The commit is a synchronous host step between batches, so every
+        batch resolves against a complete, validated partition — the old
+        one up to the commit, the new one after — never a torn mix (the
+        multi-resolver min-combine is partition-independent, so verdicts
+        and witnesses stay bit-identical to the single-set oracle across
+        the move).  Mechanics:
+
+          - one immutable ``MirrorSnapshot`` cut per old shard;
+          - a new shard whose range is UNCHANGED adopts the old mirror by
+            identity (encode caches, sync stamp and device slice ride
+            along); a moved shard's mirror is rebuilt by CHUNK handoff
+            (``engine_from_handoff``): interior chunks by reference, only
+            boundary chunks at moved split points re-chunked — O(moved
+            ranges), and the per-chunk encode caches survive;
+          - moved shards go stale; their device slices rebuild lazily via
+            the per-shard rehydrate (``_replace_slice`` by-reference
+            swaps), exactly like a probe recovery;
+          - a shard-count change (2→4→8 scaling, bounded by
+            ``max_shards``) rebuilds the mesh and re-inits device state;
+            every shard then rehydrates from its repartitioned mirror.
+
+        Fault legality (tentpole part 4): the ``reshard`` choke point is
+        checked per moved shard BEFORE any state mutates — a scripted
+        fault DEFERS the whole move (the snapshot cuts are immutable and
+        unadopted, so the authoritative mirrors stay exact) and replays
+        byte-identically.  A moved shard with an open breaker completes
+        the move degraded-on-mirror: the handoff needs no device, and the
+        rebuilt shard stays mirror-served until its breaker closes."""
+        from ..flow.flight_recorder import maybe_trigger
+        from ..flow.spans import instant
+        from ..flow.trace import TraceEvent
+
+        new = [bytes(k) for k in new_split_keys]
+        n_new = len(new) + 1
+        assert all(
+            new[i] < new[i + 1] for i in range(len(new) - 1)
+        ) and all(k != b"" for k in new), (
+            "split keys must be strictly increasing and non-empty"
+        )
+        assert n_new <= self.max_shards, (
+            f"{n_new} shards exceed max_shards={self.max_shards} "
+            "(per-shard fault domains are pre-created at construction)"
+        )
+        if not keylib.fits(new, self.key_words):
+            raise ValueError(
+                "split keys must fit the device key width "
+                f"({self.key_words * 4} bytes)"
+            )
+        old = list(self.split_keys)
+        m = self.metrics
+        entry: dict = {
+            "seq": len(self.move_log),
+            "reason": reason,
+            "from": [k.hex() for k in old],
+            "to": [k.hex() for k in new],
+            "shards": [len(old) + 1, n_new],
+        }
+        if new == old:
+            entry["action"] = "noop"
+            entry["moved"] = []
+            self.move_log.append(entry)
+            return entry
+        old_bounds = self._shard_bounds()
+        new_bounds = list(zip([b""] + new, new + [None]))
+        scaling = n_new != self.n_shards
+        moved = (
+            list(range(max(self.n_shards, n_new)))
+            if scaling
+            else [s for s in range(n_new) if old_bounds[s] != new_bounds[s]]
+        )
+        entry["moved"] = moved
+        # Choke point BEFORE any mutation: a fault defers the whole move.
+        for s in moved:
+            if s >= self.n_shards:
+                continue  # not materialized yet: no device to fault
+            try:
+                self._check_fault("reshard", s)
+            except DeviceFault as e:
+                self._shard_fault(s, e)
+                m.counter("reshard_deferred").add()
+                entry["action"] = "deferred"
+                entry["fault_shard"] = s
+                self.move_log.append(entry)
+                TraceEvent("ShardReshardDeferred", severity=20).detail(
+                    "shard", s
+                ).detail("reason", reason).log()
+                return entry
+        degraded = [
+            s for s in moved
+            if s < self.n_shards and self._breakers[s].state != "ok"
+        ]
+        entry["action"] = "degraded_on_mirror" if degraded else "live"
+        if degraded:
+            entry["degraded_shards"] = degraded
+            m.counter("reshard_degraded").add()
+        # Immutable cuts: nothing after this point can tear the handoff.
+        snaps = [mir.snapshot() for mir in self._mirrors]
+        chunk = self._mirrors[0].chunk_size
+        by_bounds = {old_bounds[s]: s for s in range(self.n_shards)}
+        new_mirrors: list = []
+        new_stale: list = []
+        new_synced: list = []
+        reused = 0
+        for s, (lo, hi) in enumerate(new_bounds):
+            t = by_bounds.get((lo, hi))
+            if t is not None:
+                # Unchanged range: the mirror moves BY IDENTITY.  Its
+                # device slice survives only when the index also holds
+                # (same physical chip) and the mesh is not rebuilt.
+                keep_dev = (not scaling) and t == s
+                new_mirrors.append(self._mirrors[t])
+                new_stale.append(bool(self._stale[t]) or not keep_dev)
+                new_synced.append(
+                    self._synced_stamp[t] if keep_dev else None
+                )
+                reused += 1
+                continue
+            parts = []
+            for t2, (olo, ohi) in enumerate(old_bounds):
+                if hi is not None and olo >= hi:
+                    break
+                if ohi is not None and ohi <= lo:
+                    continue
+                plo = olo if olo > lo else lo
+                if ohi is None:
+                    phi = hi
+                elif hi is None:
+                    phi = ohi
+                else:
+                    phi = ohi if ohi < hi else hi
+                parts.append((snaps[t2], plo, phi))
+            oldest = max(p[0].oldest_version for p in parts)
+            new_mirrors.append(
+                engine_from_handoff(parts, oldest, chunk=chunk)
+            )
+            new_stale.append(True)
+            new_synced.append(None)
+        # Commit: the partition flips atomically between batches.
+        if scaling:
+            self.n_shards = n_new
+            self.mesh = Mesh(np.array(self._devices[:n_new]), (AXIS,))
+            self._shardspec = NamedSharding(self.mesh, P(AXIS))
+            self._steps.clear()
+        self.split_keys = new
+        self._mirrors = new_mirrors
+        self._stale = new_stale
+        self._synced_stamp = new_synced
+        lo_np, hi_np = self._partition_arrays(new)
+        self._lo = jax.device_put(jnp.asarray(lo_np), self._shardspec)
+        self._hi = jax.device_put(jnp.asarray(hi_np), self._shardspec)
+        if scaling:
+            # Fresh device state at the new mesh width; every shard
+            # rehydrates lazily from its repartitioned mirror.
+            self._init_state(oldest_rel=0)
+        m.counter("reshards").add()
+        m.counter("reshard_moved_shards").add(len(moved))
+        entry["reused_mirrors"] = reused
+        self.move_log.append(entry)
+        instant(
+            "reshard",
+            role="ShardedConflict",
+            attrs={"seq": entry["seq"], "reason": reason,
+                   "moved": len(moved), "shards": n_new},
+        )
+        TraceEvent("ShardReshard", severity=20).detail(
+            "seq", entry["seq"]
+        ).detail("reason", reason).detail("action", entry["action"]).detail(
+            "moved", len(moved)
+        ).detail("shards", n_new).log()
+        # Flight-recorder `reshard` capture kind (ISSUE 18 satellite):
+        # every COMMITTED split-point change freezes the timeseries
+        # window with the move log attached, under the per-kind cooldown
+        # (deferred moves are faults — the breaker path captures those).
+        maybe_trigger(
+            "reshard",
+            detail={"seq": entry["seq"], "reason": reason,
+                    "action": entry["action"], "moved": moved,
+                    "shards": n_new},
+            transitions=lambda: [dict(e) for e in self.move_log],
+            source="resharder",
+        )
+        return entry
 
 
 # ---------------------------------------------------------------------------

@@ -267,6 +267,25 @@ class Resolver:
             process.spawn_observed(
                 self._mirror_check_loop(period), "resolver_mirror_check"
             )
+        # Shard-balancer actor (ISSUE 18): periodically evaluate per-shard
+        # occupancy + decayed contention skew and migrate split points
+        # live (ShardedJaxConflictSet.reshard).  Deterministic: the tick
+        # is virtual-time, the evaluation synchronous, the inputs
+        # (occupancy, witness sample, queue-depth pressure) seed-stable —
+        # same seed, byte-identical decision log.
+        self.shard_balancer = None
+        bal_period = float(g_env.get("FDB_TPU_SHARD_BALANCE_SECONDS"))
+        if bal_period > 0 and callable(
+            getattr(self.conflicts, "reshard", None)
+        ):
+            from .resolver_balancer import ShardBalancer
+
+            self.shard_balancer = ShardBalancer(
+                self.conflicts, load_fn=self._shard_load_sample
+            )
+            process.spawn_observed(
+                self._shard_balance_loop(bal_period), "resolver_shard_balance"
+            )
 
     def interface(self) -> ResolverInterface:
         return ResolverInterface(
@@ -335,6 +354,36 @@ class Resolver:
                 self._pipeline_pump(0, "drain")
             if self.conflicts.mirror_check() is None:
                 return  # no device engine behind this conflict set
+
+    def _shard_load_sample(self):
+        """Per-shard contention load from the decayed witness-range
+        sample (ISSUE 12): each contended range is charged to the shard
+        owning its begin key under the CURRENT partition.  Seed-stable —
+        the sample itself is deterministic and the mapping is a pure
+        function of it plus split_keys."""
+        from bisect import bisect_right
+
+        cs = self.conflicts
+        ks = [bytes(k) for k in cs.split_keys]
+        loads = [0] * cs.n_shards
+        for (begin, _end), hits in self._witness_ranges.items():
+            loads[bisect_right(ks, bytes(begin))] += int(hits)
+        return loads
+
+    async def _shard_balance_loop(self, period: float):
+        """Tick the ShardBalancer every `period` virtual seconds.  The
+        evaluation (and any reshard it commits) is synchronous, so a
+        boundary can never move under a batch mid-resolve — batches see
+        the old partition or the new one, never a torn one.  Pressure is
+        the queue-depth fraction of the batch-concurrency target, the
+        same signal the ratekeeper throttles on."""
+        loop = self.process.network.loop
+        while True:
+            await loop.delay(period)
+            if self._pipe_ctx:
+                self._pipeline_pump(0, "drain")
+            pressure = min(1.0, self._inflight / 16.0)
+            self.shard_balancer.evaluate(pressure=pressure)
 
     async def _serve(self):
         while True:

@@ -321,6 +321,35 @@ def cluster_status(cluster) -> dict:
             )[:8]
         ]
         qos["contention"] = contention
+        # Shard-mesh block (ISSUE 18): split points + last reshard move
+        # per mesh-sharded resolver, so an operator reads the current
+        # partition (and who moved it last) straight from status.  Key
+        # present only when a mesh-sharded conflict set is live.
+        shards: dict = {}
+        for r in role_objects(cluster, "resolver"):
+            dm = getattr(getattr(r, "conflicts", None), "device_metrics",
+                         None)
+            if not callable(dm):
+                continue
+            block = (dm() or {}).get("shards")
+            if block is None:
+                continue
+            name = getattr(getattr(r, "process", None), "name", None) or (
+                f"resolver{len(shards)}"
+            )
+            bal = getattr(r, "shard_balancer", None)
+            shards[name] = {
+                "total": block["total"],
+                "max": block["max"],
+                "degraded": block["degraded"],
+                "occupancy": block["occupancy"],
+                "split_keys": block["split_keys"],
+                "moves": block["moves"],
+                "last_move": block.get("last_move"),
+                "balancer_ticks": 0 if bal is None else len(bal.decisions),
+            }
+        if shards:
+            qos["shards"] = shards
         cl["qos"] = qos
         # Passive latency distributions from the proxy's ContinuousSamples
         # (ref: the commit/GRV latency bands in Status.actor.cpp's qos; the

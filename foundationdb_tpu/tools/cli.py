@@ -51,6 +51,10 @@ class CliProcessor:
         "resolver span rings and the decayed top-K into per-range "
         "abort timelines; lists contention-spike flight-recorder "
         "captures",
+        "shards": "shards [--format=json] — shard-mesh explorer: split "
+        "points, per-shard occupancy/boundary counts, breaker states, "
+        "the balancer decision log, and the reshard move log in one "
+        "canonical sorted-keys doc (byte-identical per seed)",
         "latency": "latency [--chains] [--format=json] — per-stage "
         "latency percentiles from the span layer (default); --chains "
         "uses the legacy trace_batch debug-id chain reassembly "
@@ -817,6 +821,97 @@ class CliProcessor:
             lines.append(
                 f"contention spike captures: "
                 f"{len(doc['captures'])} "
+                f"(`flightrec --format=json` for the artifacts)"
+            )
+        return lines
+
+    async def _cmd_shards(self, args):
+        """Shard-mesh explorer (ISSUE 18): the elastic-resharding twin of
+        `contention` — per-resolver split points, occupancy gauges,
+        breaker states, the ShardBalancer decision log, and the conflict
+        set's reshard move log, plus the reshard flight-recorder
+        captures.  All inputs are virtual-time deterministic, so
+        --format=json (canonical, sorted keys) is byte-identical across
+        same-seed runs."""
+        from ..flow.flight_recorder import global_flight_recorder
+        from ..server.status import role_objects
+
+        doc: dict = {"resolvers": {}}
+        for r in role_objects(self.cluster, "resolver"):
+            cs = getattr(r, "conflicts", None)
+            dm = getattr(cs, "device_metrics", None)
+            if not callable(dm):
+                continue
+            shards = (dm() or {}).get("shards")
+            if shards is None:
+                continue
+            name = getattr(getattr(r, "process", None), "name", None) or (
+                f"resolver{len(doc['resolvers'])}"
+            )
+            bal = getattr(r, "shard_balancer", None)
+            doc["resolvers"][name] = {
+                "shards": shards,
+                "move_log": [dict(e) for e in getattr(cs, "move_log", [])],
+                "balancer": None
+                if bal is None
+                else {
+                    "moves": bal.moves,
+                    "decisions": [dict(d) for d in bal.decisions],
+                },
+            }
+        rec = global_flight_recorder()
+        doc["captures"] = [
+            {
+                "capture_seq": c["capture_seq"],
+                "time": c["time"],
+                "detail": c.get("detail"),
+            }
+            for c in rec.captures
+            if c.get("trigger") == "reshard"
+        ]
+        if "--format=json" in args:
+            return json.dumps(
+                doc, indent=2, sort_keys=True, default=str
+            ).splitlines()
+        if not doc["resolvers"]:
+            return ["(no mesh-sharded resolvers live)"]
+        lines = []
+        for name, rr in sorted(doc["resolvers"].items()):
+            sh = rr["shards"]
+            lines.append(
+                f"{name}: {sh['total']}/{sh['max']} shards "
+                f"({sh['degraded']} degraded, {len(rr['move_log'])} "
+                f"move(s))"
+            )
+            lines.append(f"  states:    {' '.join(sh['states'])}")
+            lines.append(
+                "  occupancy: "
+                + " ".join(str(o) for o in sh["occupancy"])
+            )
+            lines.append(
+                "  splits:    "
+                + (" ".join(sh["split_keys"]) or "(none)")
+            )
+            lm = sh.get("last_move")
+            if lm:
+                lines.append(
+                    f"  last move: seq={lm['seq']} action={lm['action']} "
+                    f"reason={lm['reason']} shards={lm['shards']}"
+                )
+            bal = rr["balancer"]
+            if bal is not None:
+                acted = [
+                    d for d in bal["decisions"]
+                    if d["action"] in ("move", "scale")
+                ]
+                lines.append(
+                    f"  balancer:  {len(bal['decisions'])} tick(s), "
+                    f"{bal['moves']} committed move(s), "
+                    f"{len(acted)} decision(s) to act"
+                )
+        if doc["captures"]:
+            lines.append(
+                f"reshard captures: {len(doc['captures'])} "
                 f"(`flightrec --format=json` for the artifacts)"
             )
         return lines

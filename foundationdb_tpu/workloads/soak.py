@@ -61,13 +61,17 @@ class FaultEvent:
     the device outage scoped to ONE shard of a mesh-sharded resolver —
     only that shard's breaker opens and serves degraded off its mirror
     while the surviving shards keep the goodput floor on device;
-    backend="sharded")."""
+    backend="sharded"), "shard_move" (ISSUE 18: a scripted live reshard
+    — split points recomputed from occupancy quantiles and migrated
+    mid-stream; composes with shard_kill to exercise the
+    reshard-during-fault legality rules, deferred/degraded per seed)."""
 
     at: float = 0.0  # sim seconds from soak start
     kind: str = "clog"
     duration: float = 1.5  # clog/outage hold; kills recover via recruitment
     target: str = ""  # kill: role name (default storage0)
-    shard: int = 0  # shard_kill: which shard's chip dies
+    shard: int = 0  # shard_kill: which shard's chip dies; shard_move:
+    # target shard count for the scripted reshard (0 = keep current)
 
 
 @dataclass
@@ -113,6 +117,20 @@ class SoakConfig:
     # resolver 0 conflict set (sim clusters only; capped to the visible
     # device count).
     sharded_shards: int = 4
+    # Elastic resharding (ISSUE 18): ceiling for live shard-count
+    # scaling (None = frozen at sharded_shards; >sharded_shards hands
+    # the conflict set the full device list so the balancer can scale).
+    sharded_max_shards: Optional[int] = None
+    # Period of the soak's ShardBalancer driver (sim seconds; None/0
+    # disables).  The driver feeds the balancer the ratekeeper's binding
+    # signal as admission pressure and the resolver's decayed
+    # witness-range sample as per-shard load.
+    shard_balance_seconds: Optional[float] = None
+    # Rotate the Zipf ranks through key space: hot rank r maps to key
+    # index (r + hot_offset) % keys, so the hot set can be pinned to a
+    # chosen shard's interior instead of always key 0 (default 0 keeps
+    # every existing workload byte-identical).
+    hot_offset: int = 0
     # Witness-guided retry arm (ISSUE 17): None leaves the live
     # FDB_TPU_WITNESS_RETRY flag alone; True/False overrides it for the
     # run (restored after) — the A/B seam run_contention_ab drives.
@@ -182,6 +200,76 @@ def shard_outage_config(
     ]
     cfg.sharded_shards = n_shards
     return cfg
+
+
+def hot_key_rebalance_phases(
+    peak_tps: float, total_seconds: float
+) -> List[SoakPhase]:
+    """The hot-key rebalance phase family (ISSUE 18): RMW-heavy Zipf
+    load pins one shard, a scripted shard_kill degrades it, and the
+    balancer's live reshard migrates the hot range onto healthy
+    devices — the "recovered" phase is where the per-shard goodput
+    claim is scored."""
+    hot = dict(read_fraction=0.1, rmw_fraction=0.8)
+    return [
+        SoakPhase("warm", total_seconds * 0.15, peak_tps * 0.5, **hot),
+        SoakPhase("hot_pin", total_seconds * 0.35, peak_tps, **hot),
+        SoakPhase("rebalance", total_seconds * 0.3, peak_tps, **hot),
+        SoakPhase("recovered", total_seconds * 0.2, peak_tps, **hot),
+    ]
+
+
+def hot_key_rebalance_config(
+    minutes: float = 0.5,
+    peak_tps: float = 80.0,
+    seed: int = 1,
+    n_shards: int = 4,
+    max_shards: int = 8,
+    zipf_theta: float = 1.2,
+    balance_seconds: Optional[float] = 1.0,
+    outage: bool = True,
+) -> SoakConfig:
+    """A soak where a Zipf hot-key set is pinned to ONE shard's interior
+    (hot_offset lands the hot ranks mid-keyspace, inside shard
+    n_shards//4's range... i.e. away from the keyspace floor, which
+    shard 0 owns forever), that shard's chip dies for most of the run,
+    and the ShardBalancer — fed ratekeeper pressure + the witness
+    contention sample — reshards/scales the mesh so the hot range
+    migrates onto healthy devices.  balance_seconds=None is the
+    "pinned" A/B arm: same seed, same load, same fault, no balancer."""
+    total = minutes * 60.0
+    cfg = default_config(
+        minutes=minutes, peak_tps=peak_tps, seed=seed,
+        cluster="sim", backend="sharded", faults=False,
+        zipf_theta=zipf_theta,
+    )
+    cfg.phases = hot_key_rebalance_phases(peak_tps, total)
+    cfg.sharded_shards = n_shards
+    cfg.sharded_max_shards = max_shards
+    cfg.shard_balance_seconds = balance_seconds
+    # Hot ranks sit in the interior of shard n_shards//4 + 1's range —
+    # a shard whose DEVICE keeps its index across a scale-up, so the
+    # scripted outage stays scoped to it while the hot RANGE is free to
+    # migrate onto healthy devices.
+    cfg.hot_offset = (cfg.keys // n_shards) * (n_shards // 4) + (
+        cfg.keys // (2 * n_shards)
+    )
+    if outage:
+        hot_shard = (cfg.hot_offset * n_shards) // cfg.keys
+        cfg.faults = [
+            FaultEvent(at=total * 0.2, kind="shard_kill",
+                       duration=total * 0.7, shard=hot_shard),
+        ]
+    return cfg
+
+
+def hot_zipf_weights(keys: int, theta: float, offset: int) -> List[float]:
+    """Per-key-index Zipf traffic weight under the hot_offset rotation
+    (index i carries rank (i - offset) % keys's mass).  The scorer's
+    side of _plan_txn's draw — deterministic, sums to 1."""
+    cdf = zipf_cdf(keys, theta)
+    mass = [cdf[0]] + [cdf[r] - cdf[r - 1] for r in range(1, keys)]
+    return [mass[(i - offset) % keys] for i in range(keys)]
 
 
 def contention_config(
@@ -315,6 +403,11 @@ class SoakRun:
         # end, so the report shows WHERE contention lived per phase
         # (the Zipf hot-key phases are the interesting rows).
         self.phase_witness: dict = {}
+        # Per-phase shard-mesh cuts (ISSUE 18): phase name -> partition +
+        # breaker states + per-shard shed counters at phase end — the
+        # hot_key_rebalance A/B scorer's input.
+        self.phase_shards: dict = {}
+        self.balancer = None  # ShardBalancer when _balance_driver runs
         self._stop = False
 
     # -- cluster accessors ------------------------------------------------
@@ -367,7 +460,10 @@ class SoakRun:
         else:
             kind = "write"
         nkeys = 1 + int(rng.random_int(0, 3))
-        keys = sorted({zipf_pick(rng, self.cdf) for _ in range(nkeys)})
+        off, nk = self.config.hot_offset, self.config.keys
+        keys = sorted(
+            {(zipf_pick(rng, self.cdf) + off) % nk for _ in range(nkeys)}
+        )
         return kind, keys, int(rng.random_int(0, 1 << 30))
 
     async def _apply(self, tr, plan):
@@ -476,6 +572,7 @@ class SoakRun:
             st.t_end = loop.now()
             st.ev_end = len(col.events)
             self.phase_witness[st.name] = self._witness_snapshot()
+            self.phase_shards[st.name] = self._shard_snapshot()
         # Drain stragglers (bounded): goodput counts completions, and a
         # hung tail must fail the SLO rather than hang the harness.
         deadline = loop.now() + self.config.drain_timeout
@@ -508,6 +605,8 @@ class SoakRun:
                 await self._fault_device_outage(ev)
             elif ev.kind == "shard_kill":
                 await self._fault_shard_kill(ev)
+            elif ev.kind == "shard_move":
+                await self._fault_shard_move(ev)
             else:
                 raise ValueError(f"unknown fault kind {ev.kind!r}")
 
@@ -653,6 +752,95 @@ class SoakRun:
             {"resolver": r.process.name, "shard": shard},
         )
 
+    async def _fault_shard_move(self, ev: FaultEvent):
+        """Scripted live reshard (ISSUE 18): recompute split points from
+        the occupancy quantiles and migrate them mid-stream — the direct
+        (balancer-less) way to land a reshard inside another fault's
+        window, exercising the during-fault legality rules (an open
+        breaker on a moved shard completes degraded-on-mirror; a
+        scripted reshard-site fault defers the whole move)."""
+        sets = self._sharded_sets()
+        t = self.loop.now()
+        if not sets:
+            self.fault_timeline.append([t, "shard_move", "no-shards", t])
+            return
+        r, cs = sets[0]
+        n_target = ev.shard if ev.shard > 1 else cs.n_shards
+        n_target = min(n_target, cs.max_shards)
+        try:
+            entry = cs.reshard(
+                cs.balance_split_keys(n_target), reason="fault_shard_move"
+            )
+            detail = f"{r.process.name}:{entry['action']}"
+        except ValueError as e:
+            detail = f"{r.process.name}:rejected:{e}"
+        self.fault_timeline.append([t, "shard_move", detail, self.loop.now()])
+        await self._capture_fault_window(
+            0.0, "shard_move", {"resolver": r.process.name, "detail": detail}
+        )
+
+    async def _balance_driver(self):
+        """Tick a ShardBalancer over the mesh-sharded conflict set
+        (ISSUE 18).  Pressure is the ratekeeper's binding signal — 1.0
+        whenever admission is limited (the scale-up driver the ISSUE
+        names), else the client-side in-flight fraction; per-shard load
+        is the resolver's decayed witness-range sample.  Every input is
+        virtual-time deterministic, so two same-seed soaks produce
+        byte-identical decision logs."""
+        period = self.config.shard_balance_seconds
+        if not period:
+            return
+        sets = self._sharded_sets()
+        if not sets:
+            return
+        from ..server.resolver_balancer import ShardBalancer
+
+        r, cs = sets[0]
+        load_fn = getattr(r, "_shard_load_sample", None)
+        self.balancer = ShardBalancer(
+            cs, ratio=2.0, hysteresis=2, cooldown=2,
+            min_boundaries=16, load_fn=load_fn,
+        )
+        while not self._stop:
+            await self.loop.delay(period)
+            rk = self.current_ratekeeper()
+            limiting = getattr(
+                getattr(rk, "rate", None), "limiting", "none"
+            ) if rk else "none"
+            if limiting not in (None, "", "none"):
+                pressure = 1.0
+            else:
+                pressure = min(
+                    1.0, self.in_flight / max(1, self.config.max_in_flight)
+                )
+            self.balancer.evaluate(pressure=pressure)
+
+    def _shard_snapshot(self) -> dict:
+        """Per-phase shard-mesh cut (ISSUE 18): partition + breaker
+        states + per-shard degraded-serve (shed) counters, enough for
+        the A/B scorer to attribute each phase's hot-range traffic to
+        device-serving vs mirror-degraded shards."""
+        out = {}
+        for r, cs in self._sharded_sets():
+            out[r.process.name] = {
+                "shards": cs.n_shards,
+                "split_keys": [k.hex() for k in cs.split_keys],
+                "occupancy": cs.shard_occupancy(),
+                "states": [
+                    b.state for b in cs._breakers[: cs.n_shards]
+                ],
+                "degraded_batches": [
+                    int(
+                        cs.metrics.counter(
+                            f"shard{s}_degraded_batches"
+                        ).value
+                    )
+                    for s in range(cs.n_shards)
+                ],
+                "moves": len(cs.move_log),
+            }
+        return out
+
     async def _admission_monitor(self):
         """Sample the CURRENT ratekeeper's binding signal; log changes.
         Spans generations (see admission_log comment)."""
@@ -675,9 +863,10 @@ class SoakRun:
 
         mon = self.db.process.spawn(self._admission_monitor(), "soak_rkmon")
         faults = self.db.process.spawn(self._fault_driver(), "soak_faults")
+        bal = self.db.process.spawn(self._balance_driver(), "soak_balance")
         await self._load_driver()
         await all_of([faults])
-        await all_of([mon])
+        await all_of([mon, bal])
         return self.report()
 
     # -- reporting --------------------------------------------------------
@@ -707,6 +896,38 @@ class SoakRun:
                 1 for c in rec.captures if c["trigger"] == "contention_spike"
             ),
             "resolvers": resolvers,
+        }
+
+    def _resharding_section(self) -> dict:
+        """The report's elastic-resharding block (ISSUE 18): the final
+        partition + move log per mesh-sharded resolver, the balancer's
+        full decision log, and the per-phase shard cuts.  Deterministic
+        (counts, hex keys, virtual-time stamps only), so the
+        byte-identical replay gate extends over it."""
+        resolvers = {}
+        for r, cs in self._sharded_sets():
+            resolvers[r.process.name] = {
+                "shards": cs.n_shards,
+                "max_shards": cs.max_shards,
+                "split_keys": [k.hex() for k in cs.split_keys],
+                "occupancy": cs.shard_occupancy(),
+                "move_log": [dict(e) for e in cs.move_log],
+                "reshards": int(cs.metrics.counter("reshards").value),
+                "deferred": int(
+                    cs.metrics.counter("reshard_deferred").value
+                ),
+                "degraded": int(
+                    cs.metrics.counter("reshard_degraded").value
+                ),
+            }
+        bal = self.balancer
+        return {
+            "resolvers": resolvers,
+            "balancer": None if bal is None else {
+                "moves": bal.moves,
+                "decisions": [dict(d) for d in bal.decisions],
+            },
+            "phase_shards": self.phase_shards,
         }
 
     def _spans_section(self) -> dict:
@@ -914,6 +1135,10 @@ class SoakRun:
             # froze.  Deterministic like everything above — the replay
             # gate extends over this block.
             "contention": self._contention_section(_rec),
+            # Elastic resharding (ISSUE 18): final partition, move logs,
+            # the balancer decision log, and per-phase shard cuts — the
+            # hot_key_rebalance scorer and the replay gate read these.
+            "resharding": self._resharding_section(),
             # Span layer (ISSUE 12): per-role ring inventory, the recent
             # window, per-stage latency percentiles off the spans, and
             # the worst pipeline overlap-efficiency gauge.  All
@@ -939,14 +1164,24 @@ class SoakRun:
 
 def transition_logs_json(report: dict) -> str:
     """Canonical byte form of the replay-gated logs: the admission log,
-    the (current-generation) ratekeeper transitions, and every breaker
-    transition log.  Same seed => byte-identical."""
+    the (current-generation) ratekeeper transitions, every breaker
+    transition log, and (ISSUE 18) the balancer decision + reshard move
+    logs.  Same seed => byte-identical."""
+    resharding = report.get("resharding", {})
+    bal = resharding.get("balancer")
     return json.dumps(
         {
             "admission": report["ratekeeper"]["admission_log"],
             "ratekeeper": report["ratekeeper"]["transitions"],
             "breakers": report["breakers"],
             "faults": report["faults"],
+            "balancer": [] if bal is None else bal["decisions"],
+            "moves": {
+                name: blk["move_log"]
+                for name, blk in sorted(
+                    resharding.get("resolvers", {}).items()
+                )
+            },
         },
         sort_keys=True,
         separators=(",", ":"),
@@ -1096,6 +1331,90 @@ def run_contention_ab(
     }
 
 
+def _hot_device_goodput(report: dict, cfg: SoakConfig) -> dict:
+    """Per-phase hot-range DEVICE goodput: the phase's committed tps
+    weighted by the Zipf traffic mass whose owning shard (under that
+    phase's partition cut) was serving on device, not degraded on its
+    mirror.  Virtual time charges mirror serves nothing, so the
+    traffic-weighted device fraction is the honest per-shard goodput
+    measure the virtual-mesh A/B compares: a pinned hot range on a sick
+    chip scores ~0, the same range rebalanced onto healthy chips scores
+    its full committed rate."""
+    weights = hot_zipf_weights(cfg.keys, cfg.zipf_theta, cfg.hot_offset)
+    out = {}
+    shards_by_phase = report["resharding"]["phase_shards"]
+    for ph in report["phases"]:
+        snap = shards_by_phase.get(ph["name"], {})
+        if not snap:
+            out[ph["name"]] = None
+            continue
+        blk = next(iter(snap.values()))
+        splits = [bytes.fromhex(h) for h in blk["split_keys"]]
+        states = blk["states"]
+        frac = 0.0
+        for i, w in enumerate(weights):
+            s = bisect.bisect_right(splits, b"soak/%06d" % i)
+            if states[s] == "ok":
+                frac += w
+        out[ph["name"]] = {
+            "goodput_tps": ph["goodput_tps"],
+            "device_fraction": round(frac, 4),
+            "hot_device_goodput_tps": round(ph["goodput_tps"] * frac, 3),
+            "degraded_batches": blk["degraded_batches"],
+            "moves": blk["moves"],
+        }
+    return out
+
+
+def run_hot_key_rebalance_ab(
+    minutes: float = 0.5,
+    peak_tps: float = 80.0,
+    seed: int = 1,
+    n_shards: int = 4,
+    max_shards: int = 8,
+    zipf_theta: float = 1.2,
+    balance_seconds: float = 1.0,
+) -> dict:
+    """Balancer-on vs balancer-off A/B on the hot-key-pinned soak
+    (ISSUE 18's acceptance comparison).  Same seed, same Zipf load
+    pinned to one shard's interior, same scripted chip loss on that
+    shard — the ONLY difference is the ShardBalancer driver, so any
+    hot-range device-goodput gap is the live reshard's.  The pinned
+    arm's outage-window minimum is the pre-rebalance floor; recovery is
+    the balanced arm's final ("recovered") phase."""
+    arms, cfgs = {}, {}
+    for arm, bal in (("balanced", balance_seconds), ("pinned", None)):
+        cfg = hot_key_rebalance_config(
+            minutes=minutes, peak_tps=peak_tps, seed=seed,
+            n_shards=n_shards, max_shards=max_shards,
+            zipf_theta=zipf_theta, balance_seconds=bal,
+        )
+        cfgs[arm] = cfg
+        arms[arm] = run_soak(cfg)
+    scores = {a: _hot_device_goodput(arms[a], cfgs[a]) for a in arms}
+    floor = min(
+        (
+            s["hot_device_goodput_tps"]
+            for name, s in scores["pinned"].items()
+            if s is not None and name != "warm"
+        ),
+        default=0.0,
+    )
+    recovered = scores["balanced"].get("recovered") or {}
+    rec = recovered.get("hot_device_goodput_tps", 0.0)
+    bal_block = arms["balanced"]["resharding"]["balancer"] or {}
+    return {
+        "phases": scores,
+        "pre_rebalance_floor_tps": round(floor, 3),
+        "recovered_hot_goodput_tps": rec,
+        "recovery_ratio": round(rec / max(floor, 1e-9), 3),
+        "balancer_moves": bal_block.get("moves", 0),
+        "slo_ok": arms["balanced"]["slo"]["ok"]
+        and arms["pinned"]["slo"]["ok"],
+        "reports": arms,
+    }
+
+
 def _build_cluster(config: SoakConfig):
     """A rated cluster + primed client Database handles."""
     n_clients = max(1, config.clients)
@@ -1148,14 +1467,20 @@ def _build_cluster(config: SoakConfig):
         split = [
             b"soak/%06d" % (config.keys * s // n) for s in range(1, n)
         ]
+        # Scaling headroom (ISSUE 18): with a max_shards ceiling the set
+        # keeps the FULL device list so the balancer can scale the mesh
+        # live; without one the visible devices are trimmed to the shard
+        # count exactly as before.
+        n_max = config.sharded_max_shards
         conflict_set = ShardedJaxConflictSet(
             split,
             key_words=8,  # 16-byte effective width covers soak/ and the
             # sim cluster's \xff/SC/ self-conflict keys; anything longer
             # rides the exact-semantics mirror pin by design
             h_cap=1 << 12,
-            devices=jax.devices()[:n],
+            devices=jax.devices() if n_max else jax.devices()[:n],
             bucket_mins=(64, 128, 128),
+            max_shards=n_max,
         )
         backend = "cpu"  # the other resolvers (if any) stay host-only
     cluster = SimCluster(

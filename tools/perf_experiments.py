@@ -167,8 +167,8 @@ def main():
         else:
             artifact["tail"] = (res.stdout + res.stderr)[-800:]
         out_path = os.path.join(REPO, "MULTICHIP_r06.json")
-        with open(out_path, "w") as f:
-            json.dump(artifact, f, indent=2, sort_keys=True)
+        bench.atomic_write_json(out_path, artifact, indent=2,
+                                sort_keys=True)
         print(json.dumps(artifact, indent=2, sort_keys=True))
         print(f"wrote {out_path}", file=sys.stderr)
         return
@@ -208,8 +208,8 @@ def main():
         else:
             artifact["tail"] = (res.stdout + res.stderr)[-800:]
         out_path = os.path.join(REPO, "CONTENTION_AB.json")
-        with open(out_path, "w") as f:
-            json.dump(artifact, f, indent=2, sort_keys=True)
+        bench.atomic_write_json(out_path, artifact, indent=2,
+                                sort_keys=True)
         print(json.dumps(artifact, indent=2, sort_keys=True))
         print(f"wrote {out_path}", file=sys.stderr)
         return
@@ -247,6 +247,20 @@ def main():
         except subprocess.TimeoutExpired:
             out[name] = {"error": "timeout"}
         print(json.dumps({name: out[name]}), flush=True)
+        # Tunnel-resilient per-arm artifact (ISSUE 18 satellite): every
+        # finished arm lands atomically before the next one starts, so a
+        # mid-campaign tunnel death leaves a partial AB_ARMS.json instead
+        # of a lost session.
+        bench.atomic_write_json(
+            os.path.join(REPO, "AB_ARMS.json"),
+            {"arms": out, "complete": False},
+            indent=2, sort_keys=True,
+        )
+    bench.atomic_write_json(
+        os.path.join(REPO, "AB_ARMS.json"),
+        {"arms": out, "complete": True},
+        indent=2, sort_keys=True,
+    )
     print(json.dumps({"all": out}), flush=True)
     # Persist the winner so the driver-time bench tries it FIRST (and its
     # compile is already in the shared persistent .jax_cache).
@@ -255,15 +269,14 @@ def main():
     ]
     if scored:
         rate, name = max(scored)
-        with open(os.path.join(REPO, "TUNED.json"), "w") as f:
-            json.dump(
-                {
-                    "variant": name,
-                    "txns_per_sec": rate,
-                    "source": "tools/perf_experiments.py in-session A/B",
-                },
-                f,
-            )
+        bench.atomic_write_json(
+            os.path.join(REPO, "TUNED.json"),
+            {
+                "variant": name,
+                "txns_per_sec": rate,
+                "source": "tools/perf_experiments.py in-session A/B",
+            },
+        )
         print(json.dumps({"tuned": name, "txns_per_sec": rate}), flush=True)
 
 
