@@ -185,7 +185,9 @@ def bench_cpu(rng, n_batches=20, per_batch=2500):
 # produces numbers even when the tunnel is down).  Shared by bench.main
 # and `tools/perf_experiments.py --mirror`.
 MIRROR_VARIANTS = [
-    ("mirror_chunked", {}),  # engine_cpu.CpuConflictSet (the default)
+    # engine_cpu.CpuConflictSet (the default) — columnar chunks since
+    # ISSUE 19 (searchsorted sweeps over encoded-key columns).
+    ("mirror_columnar", {}),
     ("mirror_flat", {"FDB_TPU_MIRROR_ENGINE": "flat"}),
 ]
 
@@ -250,7 +252,15 @@ def bench_mirror(rng, n_batches=30, per_batch=2500, degraded_batches=4):
             else CpuConflictSet
         )
         # Arm 1: apply_batch (mirror maintenance under device authority).
-        eng = eng_cls()
+        # The columnar engine gets the bench key width so its chunks'
+        # primary ek encoding IS the device encoding (chunk_encoding
+        # then re-encodes nothing, exactly as in production where the
+        # api passes the device key_words through).
+        eng = (
+            eng_cls()
+            if eng_cls is FlatCpuConflictSet
+            else eng_cls(key_words=KEY_WORDS)
+        )
         t0 = time.perf_counter()
         for i in range(n_batches):
             eng.apply_batch(batches[i], decided[i], now=i + WINDOW,
@@ -260,13 +270,10 @@ def bench_mirror(rng, n_batches=30, per_batch=2500, degraded_batches=4):
         # warm the per-chunk encode cache exactly as note_synced would.
         chunked = hasattr(eng, "snapshot")
         if chunked:
+            from foundationdb_tpu.conflict.engine_cpu import chunk_encoding
+
             for ch in eng.snapshot().chunks:
-                ch.enc = {
-                    KEY_WORDS: (
-                        keylib.encode_keys(ch.keys, KEY_WORDS),
-                        np.asarray(ch.vers, dtype=np.int64),
-                    )
-                }
+                chunk_encoding(ch, KEY_WORDS)
         # Degraded window: the mirror alone serves a few batches.  The
         # window is REALISTIC, i.e. throttled and localized — the PR-7
         # ratekeeper contracts admission to the degraded fraction the
@@ -279,15 +286,13 @@ def bench_mirror(rng, n_batches=30, per_batch=2500, degraded_batches=4):
         # pays (the device-transfer memcpy is the same for both arms).
         t0 = time.perf_counter()
         if chunked:
+            from foundationdb_tpu.conflict.engine_cpu import chunk_encoding
+
             ents, enc_keys = [], 0
             for ch in eng.snapshot().chunks:
-                cached = ch.enc.get(KEY_WORDS) if ch.enc else None
-                if cached is not None:
-                    ents.append(cached[0])
-                else:
-                    e = keylib.encode_keys(ch.keys, KEY_WORDS)
-                    ents.append(e)
-                    enc_keys += len(ch.keys)
+                ent, n = chunk_encoding(ch, KEY_WORDS)
+                ents.append(ent[0])
+                enc_keys += n
             np.concatenate(ents, axis=0)
         else:
             enc_keys = len(eng.keys)
@@ -445,6 +450,7 @@ def _pipeline_phase_costs(rng, n_batches, per_batch, h_cap, window=WINDOW):
     host phases the pipeline can hide (pack/encode, mirror apply) vs the
     device step it cannot.  The decomposition that makes the depth-sweep
     ratio auditable."""
+    from foundationdb_tpu.conflict.api import env_coalesce_window
     from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
     from foundationdb_tpu.conflict.engine_jax import (
         JaxConflictSet,
@@ -452,7 +458,11 @@ def _pipeline_phase_costs(rng, n_batches, per_batch, h_cap, window=WINDOW):
     )
 
     cs = JaxConflictSet(key_words=KEY_WORDS, h_cap=h_cap)
-    mirror = CpuConflictSet()
+    mirror = CpuConflictSet(key_words=KEY_WORDS)
+    # FDB_TPU_MIRROR_COALESCE rides the variant flags: a coalescing arm
+    # amortizes the fold across K batches (the per-batch average is the
+    # honest number; folds are lumpy by design).
+    mirror.coalesce_window = env_coalesce_window()
     warm = window + 2
     streams = [
         txns_from_packed(gen_packed(rng, per_batch, i, KEY_WORDS), per_batch)
@@ -474,13 +484,23 @@ def _pipeline_phase_costs(rng, n_batches, per_batch, h_cap, window=WINDOW):
             encode_s += t1 - t0
             step_s += t2 - t1
             apply_s += t3 - t2
+    # Settle any queued coalesced batches INSIDE the accounted apply cost
+    # so a coalescing arm cannot hide its final partial fold.
+    t0 = time.perf_counter()
+    _ = mirror.boundary_count
+    apply_s += time.perf_counter() - t0
+    host_fraction = round(
+        (encode_s + apply_s) / max(1e-9, encode_s + step_s + apply_s), 3
+    )
     return {
         "encode_ms_per_batch": round(1e3 * encode_s / n_batches, 2),
         "device_step_ms_per_batch": round(1e3 * step_s / n_batches, 2),
         "mirror_apply_ms_per_batch": round(1e3 * apply_s / n_batches, 2),
-        "overlappable_fraction": round(
-            (encode_s + apply_s) / max(1e-9, encode_s + step_s + apply_s), 3
-        ),
+        # Same ratio, two lenses: what depth-2 can hide under device
+        # compute, and the host share of the serialized loop (the
+        # ISSUE-19 gate reads host_fraction <= 0.10).
+        "overlappable_fraction": host_fraction,
+        "host_fraction": host_fraction,
     }
 
 
@@ -863,7 +883,19 @@ def device_phase_main():
     rng = np.random.default_rng(2024)
     depth_flag = os.environ.get("FDB_TPU_PIPELINE_DEPTH")
     mc_flag = os.environ.get("BENCH_MULTICHIP")
-    if mc_flag:
+    hp_flag = os.environ.get("BENCH_HOSTPATH")
+    if hp_flag:
+        # Serialized host-path decomposition (ISSUE 19) at the round-11
+        # stream shape: 30 x 2500-txn batches against h_cap history.
+        phases = _pipeline_phase_costs(rng, 30, 2500, h_cap)
+        res["hostpath"] = phases
+        total_ms = (
+            phases["encode_ms_per_batch"]
+            + phases["device_step_ms_per_batch"]
+            + phases["mirror_apply_ms_per_batch"]
+        )
+        res["jax_txns_per_sec"] = round(2500 * 1e3 / max(1e-9, total_ms), 1)
+    elif mc_flag:
         # Mesh-sharded variant (ISSUE 15): the full shard-granular
         # resolve loop over the visible devices.
         rate, info = bench_multichip(rng, int(mc_flag), h_cap=h_cap)
@@ -1109,6 +1141,12 @@ VARIANTS = [
     ("pipeline1", {"FDB_TPU_PIPELINE_DEPTH": "1"}, BASE_H_CAP),
     ("pipeline2", {"FDB_TPU_PIPELINE_DEPTH": "2"}, BASE_H_CAP),
     ("pipeline3", {"FDB_TPU_PIPELINE_DEPTH": "3"}, BASE_H_CAP),
+    # Serialized host-path decomposition (ISSUE 19): per-phase wall costs
+    # (encode / device step / mirror apply) at the round-11 stream shape
+    # — the arm that records the host_fraction the columnar mirror and
+    # coalesced apply drive down.  Not a throughput contender: its
+    # jax_txns_per_sec is the serialized loop, reported for context.
+    ("hostpath", {"BENCH_HOSTPATH": "1"}, 1 << 19),
     # Pallas fused kernels (ISSUE 14): merge/evict as ONE streaming pass +
     # the phase-1 searches over VMEM-resident tiles.  On the TPU backend
     # '1' compiles real Mosaic kernels; decision-identical to the XLA
@@ -1141,6 +1179,8 @@ _VARIANT_FLAG_KEYS = (
     "FDB_TPU_PIPELINE_DEPTH",
     "FDB_TPU_KERNELS",
     "BENCH_MULTICHIP",
+    "BENCH_HOSTPATH",
+    "FDB_TPU_MIRROR_COALESCE",
     "BENCH_H_CAP",
 )
 
@@ -1275,6 +1315,8 @@ def device_phase(out, errors, cpp_rate, cpu_rate):
         out["platform"] = res.get("platform")
         jax_rate = res["jax_txns_per_sec"]
         out["variants"][name] = {"txns_per_sec": jax_rate}
+        if "hostpath" in res:
+            out["variants"][name]["hostpath"] = res["hostpath"]
         # vs_baseline is the north-star ratio: device throughput over the
         # NATIVE C++ skiplist on this host (BASELINE.md:30-35).  Best
         # variant wins — all variants compute identical verdicts.

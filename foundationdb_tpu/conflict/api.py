@@ -132,6 +132,20 @@ def env_h_cap() -> int:
     return -(-cap // 256) * 256 if cap > 0 else 0
 
 
+def env_coalesce_window() -> int:
+    """FDB_TPU_MIRROR_COALESCE as a fold window K (1 = per-batch apply).
+    'auto' ties K to the pipeline depth — one mirror fold per full
+    pipeline turn, the default the ISSUE-19 coalescing was sized for."""
+    raw = g_env.get("FDB_TPU_MIRROR_COALESCE") or "0"
+    if raw == "auto":
+        return max(1, g_env.get_int("FDB_TPU_PIPELINE_DEPTH"))
+    try:
+        k = int(raw)
+    except ValueError:
+        return 1
+    return max(1, k)
+
+
 class ConflictSet:
     def __init__(
         self,
@@ -166,12 +180,13 @@ class ConflictSet:
             # but the flat mirror has no snapshot()/chunk identity, so
             # rehydration degrades to the legacy O(H) encode and the
             # consistency check still works off its flat view.
-            mirror_cls = (
-                FlatCpuConflictSet
-                if g_env.get("FDB_TPU_MIRROR_ENGINE") == "flat"
-                else CpuConflictSet
-            )
-            self._cpu = mirror_cls(oldest_version)
+            if g_env.get("FDB_TPU_MIRROR_ENGINE") == "flat":
+                self._cpu = FlatCpuConflictSet(oldest_version)
+            else:
+                # key_words makes the columnar chunks' primary encoding
+                # the device width, so chunk_encoding re-encodes nothing.
+                self._cpu = CpuConflictSet(oldest_version, key_words=kw)
+                self._cpu.coalesce_window = env_coalesce_window()
         if backend == "oracle":
             self._oracle = OracleConflictSet(oldest_version)
         self._breaker: Optional[DeviceCircuitBreaker] = None
@@ -371,19 +386,31 @@ class ConflictSet:
         self._breaker.on_success()
         with begin_span("apply", attrs={"version": now,
                                         "n_txn": len(txns)}):
-            self._cpu.apply_batch(txns, statuses, now, new_oldest_version)
-            if snapshot is not None:
+            with begin_span("mirror_apply",
+                            attrs={"n_txn": len(txns)}) as msp:
+                self._cpu.apply_batch(txns, statuses, now, new_oldest_version)
+            self._jax._note_host_span(msp)
+            if snapshot is not None and not self._coalesce_pending():
                 # The device applied the same batch: record the
                 # post-batch mirror snapshot as the synced point and
                 # pre-encode the chunks this batch created — O(chunks
                 # created this batch) via the mirror's take_fresh_chunks
                 # hint — so a fault at ANY later batch leaves the probe a
-                # cheap diff.
+                # cheap diff.  With coalescing on, a queued (unfolded)
+                # batch makes snapshot() force the fold — so the synced
+                # point is only recorded on fold boundaries, one
+                # snapshot round per K batches.
                 self._jax.note_synced(
                     snapshot(),
                     take_fresh() if take_fresh is not None else None,
                 )
         return statuses
+
+    def _coalesce_pending(self) -> bool:
+        """True while the mirror holds queued coalesced batches — the
+        windows where recording a synced snapshot would force the fold
+        early (snapshot() is a settle barrier)."""
+        return getattr(self._cpu, "pending_batches", 0) > 0
 
     def _rehydrate_from_mirror(self, snapshot, take_fresh) -> None:
         """Rebuild the device history (every boundary newer than
@@ -682,13 +709,16 @@ class ConflictSet:
         with begin_span("apply", parent=entry.span,
                         attrs={"version": entry.now,
                                "n_txn": len(entry.txns)}):
-            self._cpu.apply_batch(
-                entry.txns, statuses_list, entry.now,
-                entry.new_oldest_version,
-            )
+            with begin_span("mirror_apply",
+                            attrs={"n_txn": len(entry.txns)}) as msp:
+                self._cpu.apply_batch(
+                    entry.txns, statuses_list, entry.now,
+                    entry.new_oldest_version,
+                )
+            self._jax._note_host_span(msp)
             snapshot = getattr(self._cpu, "snapshot", None)
             take_fresh = getattr(self._cpu, "take_fresh_chunks", None)
-            if snapshot is not None:
+            if snapshot is not None and not self._coalesce_pending():
                 self._jax.note_synced(
                     snapshot(),
                     take_fresh() if take_fresh is not None else None,
@@ -738,6 +768,14 @@ class ConflictSet:
         barrier / teardown)."""
         while self._pipe:
             self.pipeline_complete_oldest()
+
+    @property
+    def host_phase_seq(self) -> int:
+        """Cumulative span-seq extent spent in host phases (encode +
+        mirror_apply + readback) — deterministic (hub sequence numbers,
+        never wall), so the resolver's derived host_fraction gauge is
+        byte-identical per seed.  0 for host-only backends."""
+        return self._jax.host_phase_seq if self._jax is not None else 0
 
     def backend_signal(self) -> dict:
         """O(1) admission-control probe (ISSUE 8 satellite): the PR-3

@@ -197,29 +197,38 @@ class PackedBatch:
             _next_pow2(nw, min_wr),
             key_words,
         )
-        rb, re_, wb, we = [], [], [], []
-        ri, wi = 0, 0
-        for t, tr in enumerate(txns):
-            pb.t_snap[t] = tr.read_snapshot
-            pb.t_has_reads[t] = bool(tr.read_ranges)
-            pb.t_valid[t] = True
-            for (b, e) in tr.read_ranges:
-                rb.append(b)
-                re_.append(e)
-                pb.r_txn[ri] = t
-                pb.r_snap[ri] = tr.read_snapshot
-                ri += 1
-            for (b, e) in tr.write_ranges:
-                wb.append(b)
-                we.append(e)
-                pb.w_txn[wi] = t
-                wi += 1
-        if rb:
-            pb.r_begin[: len(rb)] = keylib.encode_keys(rb, key_words)
-            pb.r_end[: len(re_)] = keylib.encode_keys(re_, key_words)
-        if wb:
-            pb.w_begin[: len(wb)] = keylib.encode_keys(wb, key_words)
-            pb.w_end[: len(we)] = keylib.encode_keys(we, key_words)
+        # One bulk pass (ISSUE 19): per-txn range counts drive np.repeat
+        # for the ownership/snapshot columns, and each side's begin+end
+        # keys digitize in ONE concatenated encode_keys call — no
+        # per-txn/per-range Python loops, no per-range array writes.
+        rr_counts = np.fromiter(
+            (len(t.read_ranges) for t in txns), np.int64, count=n
+        )
+        wr_counts = np.fromiter(
+            (len(t.write_ranges) for t in txns), np.int64, count=n
+        )
+        snaps = np.fromiter(
+            (t.read_snapshot for t in txns), np.int64, count=n
+        )
+        pb.t_snap[:n] = snaps
+        pb.t_has_reads[:n] = rr_counts > 0
+        pb.t_valid[:n] = True
+        if nr:
+            owner = np.repeat(np.arange(n, dtype=np.int32), rr_counts)
+            pb.r_txn[:nr] = owner
+            pb.r_snap[:nr] = snaps[owner]
+            rkeys = [b for t in txns for (b, _e) in t.read_ranges]
+            rkeys += [e for t in txns for (_b, e) in t.read_ranges]
+            enc = keylib.encode_keys(rkeys, key_words)
+            pb.r_begin[:nr] = enc[:nr]
+            pb.r_end[:nr] = enc[nr:]
+        if nw:
+            pb.w_txn[:nw] = np.repeat(np.arange(n, dtype=np.int32), wr_counts)
+            wkeys = [b for t in txns for (b, _e) in t.write_ranges]
+            wkeys += [e for t in txns for (_b, e) in t.write_ranges]
+            enc = keylib.encode_keys(wkeys, key_words)
+            pb.w_begin[:nw] = enc[:nw]
+            pb.w_end[:nw] = enc[nw:]
         pb.n_txn, pb.n_r, pb.n_w = n, nr, nw
         return pb
 
@@ -2066,6 +2075,20 @@ class JaxConflictSet:
         # load_from).  Chunk encodings live on the snapshot's immutable
         # chunks, so they are shared across snapshots for free.
         self._synced_stamp: Optional[int] = None
+        # Blob staging ring (ISSUE 19): per blob length, a rotation of
+        # preallocated uint32 buffers _pack_blob writes into instead of
+        # np.concatenate-allocating per batch.  Ring length covers the
+        # pipeline depth plus one, so encoding batch N+1 never aliases
+        # batch N's in-flight blob (jnp.asarray on the CPU backend may
+        # share the host buffer zero-copy).  Sized lazily on first use
+        # from FDB_TPU_ENCODE_STAGING.
+        self._blob_ring: dict = {}
+        self._blob_ring_size: Optional[int] = None
+        # Deterministic host-phase accumulator (ISSUE 19): sum of
+        # seq-extent of this engine's encode/readback spans.  The
+        # resolver folds it (plus the ConflictSet's mirror_apply share)
+        # into the host_fraction gauge.
+        self.host_phase_seq = 0
 
     # -- state management --
     def _init_state(self, oldest_rel: int):
@@ -2246,18 +2269,64 @@ class JaxConflictSet:
         from ..flow.spans import begin_span
 
         mt, mr, mw = self.bucket_mins
-        with begin_span("encode", attrs={"n_txn": len(transactions)}):
+        with begin_span("encode", attrs={"n_txn": len(transactions)}) as esp:
             pb = PackedBatch.from_transactions(
                 transactions, self.key_words,
                 min_txn=mt, min_rr=mr, min_wr=mw,
             )
+        self._note_host_span(esp)
         statuses = self.detect_packed(pb, now, new_oldest_version)
         return [int(s) for s in statuses[: len(transactions)]]
+
+    def _note_host_span(self, sp) -> None:
+        """Fold a host-phase span (encode/readback) into the deterministic
+        host_phase_seq accumulator — seq extent only, never wall, so the
+        derived host_fraction gauge is byte-identical per seed.  NULL
+        spans (FDB_TPU_SPANS=0) contribute nothing."""
+        if sp.seq is not None and sp.end_seq is not None:
+            self.host_phase_seq += sp.end_seq - sp.seq
+
+    def _staging_blob(self, nwords: int) -> np.ndarray:
+        """Reusable uint32 staging buffer for one blob length, rotated
+        round-robin through a ring sized past the pipeline depth
+        (ISSUE 19): a buffer is handed out again only after every
+        dispatch that could still alias it has been superseded.
+        FDB_TPU_ENCODE_STAGING: 'auto' sizes the ring pipeline-depth+1
+        (min 2 — double-buffered even unpipelined), an integer forces a
+        ring length, '0' disables staging (fresh allocation per blob,
+        the pre-ISSUE-19 behavior)."""
+        size = self._blob_ring_size
+        if size is None:
+            from ..flow.knobs import g_env
+
+            raw = g_env.get("FDB_TPU_ENCODE_STAGING") or "auto"
+            if raw == "auto":
+                depth = max(1, g_env.get_int("FDB_TPU_PIPELINE_DEPTH"))
+                size = depth + 1
+            else:
+                size = int(raw)
+            size = self._blob_ring_size = max(0, size)
+        if size == 0:
+            return np.empty((nwords,), np.uint32)
+        ring = self._blob_ring.get(nwords)
+        if ring is None:
+            ring = self._blob_ring[nwords] = (
+                [np.empty((nwords,), np.uint32) for _ in range(max(2, size))],
+                [0],
+            )
+        bufs, pos = ring
+        buf = bufs[pos[0]]
+        pos[0] = (pos[0] + 1) % len(bufs)
+        return buf
 
     def _pack_blob(self, pb: PackedBatch, now: int, new_oldest_version: int,
                    do_evict: int = 1):
         """Single contiguous uint32 blob for one-copy dispatch (see
-        _blob_offsets)."""
+        _blob_offsets).  Field layout (the blob ABI) is unchanged since
+        ISSUE 11; since ISSUE 19 the fields are written straight into a
+        double-buffered staging ring instead of np.concatenate
+        reallocating ~1MB per batch — the word-major key transposes land
+        via strided copyto with no intermediate contiguous copy."""
         rel = self._rel
         r_snap = np.clip(
             pb.r_snap - self._base, FLOOR_REL + 1, 2**31 - 2
@@ -2268,22 +2337,31 @@ class JaxConflictSet:
         t_flags = pb.t_has_reads.astype(np.uint32) | (
             pb.t_valid.astype(np.uint32) << 1
         )
-        return np.concatenate(
-            [
-                np.ascontiguousarray(pb.r_begin.T).reshape(-1),
-                np.ascontiguousarray(pb.r_end.T).reshape(-1),
-                np.ascontiguousarray(pb.w_begin.T).reshape(-1),
-                np.ascontiguousarray(pb.w_end.T).reshape(-1),
-                pb.r_txn.view(np.uint32),
-                r_snap.view(np.uint32),
-                pb.w_txn.view(np.uint32),
-                t_snap.view(np.uint32),
-                t_flags,
-                np.array(
-                    [rel(now), rel(new_oldest_version), do_evict], np.int32
-                ).view(np.uint32),
-            ]
-        )
+        kw1 = self.key_words + 1
+        rr, wr, tc = pb.rr_cap, pb.wr_cap, pb.txn_cap
+        nwords = 2 * kw1 * (rr + wr) + 2 * rr + wr + 2 * tc + 3
+        blob = self._staging_blob(nwords)
+        o = 0
+        for arr in (pb.r_begin, pb.r_end):
+            np.copyto(blob[o : o + kw1 * rr].reshape(kw1, rr), arr.T)
+            o += kw1 * rr
+        for arr in (pb.w_begin, pb.w_end):
+            np.copyto(blob[o : o + kw1 * wr].reshape(kw1, wr), arr.T)
+            o += kw1 * wr
+        for arr in (
+            pb.r_txn.view(np.uint32),
+            r_snap.view(np.uint32),
+            pb.w_txn.view(np.uint32),
+            t_snap.view(np.uint32),
+            t_flags,
+        ):
+            blob[o : o + arr.shape[0]] = arr
+            o += arr.shape[0]
+        blob[o : o + 3] = np.array(
+            [rel(now), rel(new_oldest_version), do_evict], np.int32
+        ).view(np.uint32)
+        assert o + 3 == nwords
+        return blob
 
     def dispatch_packed(self, pb: PackedBatch, now: int, new_oldest_version: int):
         """Asynchronously dispatch one batch; returns (statuses_dev,
@@ -2482,7 +2560,17 @@ class JaxConflictSet:
 
     def detect_packed(self, pb: PackedBatch, now: int, new_oldest_version: int):
         """Run one packed batch; returns numpy statuses [txn_cap]."""
+        from ..flow.spans import begin_span
+
         statuses, undecided = self.dispatch_packed(pb, now, new_oldest_version)
+        rsp = begin_span("readback", attrs={"n_txn": pb.n_txn})
+        try:
+            return self._readback_packed(pb, statuses, undecided, now, new_oldest_version)
+        finally:
+            rsp.end()
+            self._note_host_span(rsp)
+
+    def _readback_packed(self, pb, statuses, undecided, now, new_oldest_version):
         self.last_iters = int(self._last_iters_dev)
         # The sync point: iters/undecided are host ints here, so surfacing
         # the while_loop carry and the true boundary count costs no extra
@@ -2539,11 +2627,12 @@ class JaxConflictSet:
         from ..flow.spans import begin_span
 
         mt, mr, mw = self.bucket_mins
-        with begin_span("encode", attrs={"n_txn": len(transactions)}):
+        with begin_span("encode", attrs={"n_txn": len(transactions)}) as esp:
             pb = PackedBatch.from_transactions(
                 transactions, self.key_words,
                 min_txn=mt, min_rr=mr, min_wr=mw,
             )
+        self._note_host_span(esp)
         statuses, undecided = self.dispatch_packed(pb, now, new_oldest_version)
         # COPY the carried count scalars: the carried arrays themselves
         # are donated into the next dispatch (reading them after a
@@ -2575,6 +2664,16 @@ class JaxConflictSet:
         detect_packed, host capacity bounds are NOT tightened here:
         later batches may already be dispatched, so the additive upper
         bounds must stand."""
+        from ..flow.spans import begin_span
+
+        rsp = begin_span("readback", attrs={"n_txn": ticket.pb.n_txn})
+        try:
+            return self._sync_ticket_body(ticket)
+        finally:
+            rsp.end()
+            self._note_host_span(rsp)
+
+    def _sync_ticket_body(self, ticket: "DispatchTicket"):
         iters = int(ticket.iters)
         self.last_iters = iters
         m = self.metrics
@@ -2617,7 +2716,7 @@ class JaxConflictSet:
         TraceEvent("ConflictFixpointDiverged", severity=30).detail(
             "n_txn", pb.n_txn
         ).detail("now", now).log()
-        cpu = CpuConflictSet()
+        cpu = CpuConflictSet(key_words=self.key_words)
         self.store_to(cpu)
         statuses = cpu.detect(
             _unpack_transactions(pb), now=now, new_oldest_version=new_oldest_version
@@ -2777,25 +2876,10 @@ class JaxConflictSet:
         )
 
 
-def chunk_encoding(ch, key_words: int):
-    """(encoded keys [n, kw1] uint32, abs versions int64) for one
-    immutable mirror chunk, cached ON the chunk (computed at most once
-    per chunk lifetime — chunks never mutate; the cache is the currency
-    that makes probe rehydration O(chunks changed since the last sync)).
-    Returns (entry, keys_encoded_now).  Shared by JaxConflictSet and the
-    sharded resolver's per-shard mirror slices (ISSUE 15)."""
-    cache = ch.enc
-    if cache is None:
-        cache = ch.enc = {}
-    ent = cache.get(key_words)
-    if ent is not None:
-        return ent, 0
-    ent = (
-        keylib.encode_keys(ch.keys, key_words),
-        np.asarray(ch.vers, dtype=np.int64),
-    )
-    cache[key_words] = ent
-    return ent, len(ch.keys)
+# chunk_encoding moved to engine_cpu (it is pure numpy over mirror
+# chunks — the columnar ek fast path made engine_cpu its natural home);
+# re-exported here for the sharded resolver and any older import sites.
+from .engine_cpu import chunk_encoding  # noqa: E402
 
 
 def fold_delta_over_base(bkeys, bvers, dkeys, dvers_rel, base):

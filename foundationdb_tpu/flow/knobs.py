@@ -364,6 +364,21 @@ g_env.declare("FDB_TPU_MIRROR_CHUNK", "256",
               help="target boundaries per immutable mirror chunk (the "
                    "batch-update node size; smaller = finer copy-on-write "
                    "granularity, more chunk overhead)")
+g_env.declare("FDB_TPU_MIRROR_COALESCE", "0",
+              help="coalesce committed-write mirror folds: accumulate "
+                   "per-batch unions and replay them into the chunked "
+                   "mirror once per K batches ('auto' ties K to "
+                   "FDB_TPU_PIPELINE_DEPTH; 0/1 applies per batch). "
+                   "Every mirror read settles pending folds first, so "
+                   "reads stay bit-exact and same-seed replay is "
+                   "byte-identical")
+g_env.declare("FDB_TPU_ENCODE_STAGING", "auto",
+              help="reusable packed-blob staging ring in the batch "
+                   "encoder: 'auto' sizes the per-blob-length ring to "
+                   "pipeline depth + 1 (so encoding batch N+1 never "
+                   "aliases batch N's in-flight blob), an integer "
+                   "forces the ring size, 0 disables reuse (fresh "
+                   "allocation per dispatch)")
 g_env.declare("FDB_TPU_MIRROR_CHECK_SECONDS", "10",
               help="period of the resolver's mirror consistency-check "
                    "actor (virtual seconds in sim): diffs a live mirror "

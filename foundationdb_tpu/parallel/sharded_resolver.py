@@ -573,7 +573,8 @@ class ShardedJaxConflictSet:
                 )
             )
         self._mirrors = [
-            CpuConflictSet(oldest_version) for _ in range(self.n_shards)
+            CpuConflictSet(oldest_version, key_words=self.key_words)
+            for _ in range(self.n_shards)
         ]
         self._stale = [False] * self.n_shards
         self._synced_stamp: list = [m.stamp for m in self._mirrors]
@@ -657,7 +658,8 @@ class ShardedJaxConflictSet:
         self._pinned = False
         self._short_streak = 0
         self._mirrors = [
-            CpuConflictSet(version) for _ in range(self.n_shards)
+            CpuConflictSet(version, key_words=self.key_words)
+            for _ in range(self.n_shards)
         ]
         self._init_state(oldest_rel=0)
         # Cleared device state == cleared mirrors, so no rehydration is
@@ -1566,7 +1568,8 @@ class ShardedJaxConflictSet:
         bounds = self._shard_bounds()
         engines = []
         for lo, hi in bounds:
-            eng = CpuConflictSet(cpu.oldest_version)
+            eng = CpuConflictSet(cpu.oldest_version,
+                                 key_words=self.key_words)
             i0 = bisect_right(cpu.keys, lo)
             i1 = len(cpu.keys) if hi is None else bisect_left(cpu.keys, hi)
             eng.keys = [b""] + cpu.keys[i0:i1]
@@ -1618,21 +1621,63 @@ class ShardedJaxConflictSet:
         from bisect import bisect_left, bisect_right
 
         n = self.n_shards if n_shards is None else int(n_shards)
-        ks_all: list = []
+        if not all(
+            hasattr(m, "boundary_locate") for m in self._mirrors
+        ):
+            # Flat mirrors store bytes natively: the list path is the
+            # cheap one there.
+            ks_all: list = []
+            for (lo, hi), eng in zip(self._shard_bounds(), self._mirrors):
+                ks = eng.keys
+                if lo == b"":
+                    i0 = 1  # the b"" floor boundary is not a cuttable key
+                else:
+                    ks_all.append(lo)
+                    i0 = bisect_right(ks, lo)
+                i1 = len(ks) if hi is None else bisect_left(ks, hi)
+                ks_all.extend(ks[i0:i1])
+            if len(ks_all) < n:
+                return list(self.split_keys)
+            out: list = []
+            for j in range(1, n):
+                k = ks_all[(len(ks_all) * j) // n]
+                if k != b"" and (not out or k > out[-1]):
+                    out.append(k)
+            if len(out) != n - 1:
+                return list(self.split_keys)
+            return out
+        # Columnar mirrors (ISSUE 19): same candidate sequence, but as
+        # per-shard (engine, offset, count) segments over the chunked
+        # columns — only the n-1 selected quantile keys are ever decoded
+        # to bytes, instead of materializing every boundary.
+        segs: list = []  # ("key", k, 0, 1) | ("eng", eng, i0, count)
+        total = 0
         for (lo, hi), eng in zip(self._shard_bounds(), self._mirrors):
-            ks = eng.keys
             if lo == b"":
                 i0 = 1  # the b"" floor boundary is not a cuttable key
             else:
-                ks_all.append(lo)
-                i0 = bisect_right(ks, lo)
-            i1 = len(ks) if hi is None else bisect_left(ks, hi)
-            ks_all.extend(ks[i0:i1])
-        if len(ks_all) < n:
+                segs.append(("key", lo, 0, 1))
+                total += 1
+                i0 = eng.boundary_locate(lo, "right")
+            i1 = (
+                eng.boundary_count if hi is None
+                else eng.boundary_locate(hi, "left")
+            )
+            c = i1 - i0
+            if c > 0:
+                segs.append(("eng", eng, i0, c))
+                total += c
+        if total < n:
             return list(self.split_keys)
-        out: list = []
+        out = []
         for j in range(1, n):
-            k = ks_all[(len(ks_all) * j) // n]
+            g = (total * j) // n
+            k = b""
+            for kind, obj, i0, c in segs:
+                if g < c:
+                    k = obj if kind == "key" else obj.boundary_key_at(i0 + g)
+                    break
+                g -= c
             if k != b"" and (not out or k > out[-1]):
                 out.append(k)
         if len(out) != n - 1:
@@ -1778,7 +1823,8 @@ class ShardedJaxConflictSet:
                 parts.append((snaps[t2], plo, phi))
             oldest = max(p[0].oldest_version for p in parts)
             new_mirrors.append(
-                engine_from_handoff(parts, oldest, chunk=chunk)
+                engine_from_handoff(parts, oldest, chunk=chunk,
+                                    key_words=self.key_words)
             )
             new_stale.append(True)
             new_synced.append(None)

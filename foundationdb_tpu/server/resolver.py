@@ -224,6 +224,11 @@ class Resolver:
         self._dev_seq_union = 0
         self._dev_seq_hwm = None
         self._dev_wall_hwm = None
+        # Host fraction (ISSUE 19): seq extent of the host phases
+        # (encode + mirror_apply + readback, accumulated by the engine)
+        # over host + device extent — the deterministic twin of the
+        # wall-clock host-path share the hostpath bench arm measures.
+        self.metrics.gauge("host_fraction").set(0.0)
         process.spawn_observed(self._serve(), "resolver")
         process.spawn_observed(self._serve_metrics(), "resolver_metrics")
         process.spawn_observed(self._serve_split(), "resolver_split")
@@ -1048,6 +1053,11 @@ class Resolver:
                     / self._dev_seq_total,
                     4,
                 )
+            )
+        host = getattr(self.conflicts, "host_phase_seq", 0)
+        if host + self._dev_seq_total > 0:
+            m.gauge("host_fraction").set(
+                round(host / (host + self._dev_seq_total), 4)
             )
         if sp.wall_end is not None:
             wb, we = sp.wall_start, sp.wall_end

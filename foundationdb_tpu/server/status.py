@@ -313,6 +313,16 @@ def cluster_status(cluster) -> dict:
                 recent.extend(block["timeline"])
         recent.sort(key=lambda t: t["version"])
         contention["recent"] = recent[-8:]
+        # Host-phase share (ISSUE 19): worst resolver's deterministic
+        # host_fraction gauge — encode + mirror_apply + readback seq
+        # extent over host + device extent.  The number the columnar
+        # mirror / coalesced apply work drives down.
+        hf = 0.0
+        for r in role_objects(cluster, "resolver"):
+            m = getattr(r, "metrics", None)
+            if m is not None and "host_fraction" in m.gauges:
+                hf = max(hf, m.gauges["host_fraction"].value)
+        qos["conflict_host_fraction"] = hf
         qos["conflict_witness_aborts"] = w_aborts
         qos["conflict_witness_topk"] = [
             [b, e, n]

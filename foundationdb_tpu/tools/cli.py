@@ -653,6 +653,18 @@ class CliProcessor:
                         f"p50={s['p50']:.6f} p90={s['p90']:.6f} "
                         f"p99={s['p99']:.6f} max={s['max']:.6f}"
                     )
+            # Host-phase share (ISSUE 19): worst resolver's deterministic
+            # encode+mirror_apply+readback fraction of host+device extent.
+            from ..server.status import role_objects
+
+            hf = None
+            for r in role_objects(self.cluster, "resolver"):
+                m = getattr(r, "metrics", None)
+                if m is not None and "host_fraction" in m.gauges:
+                    v = m.gauges["host_fraction"].value
+                    hf = v if hf is None else max(hf, v)
+            if hf is not None:
+                lines.append(f"host_fraction: {hf:.4f}")
             return lines
         from ..flow.latency_chain import latency_summary
         from ..flow.trace import global_collector

@@ -936,16 +936,22 @@ class SoakRun:
 
         hub = global_span_hub()
         overlap = 0.0
+        host_fraction = 0.0
         for r in role_objects(self.cluster, "resolver"):
             m = getattr(r, "metrics", None)
             if m is not None and "pipeline_overlap_efficiency" in m.gauges:
                 overlap = max(
                     overlap, m.gauges["pipeline_overlap_efficiency"].value
                 )
+            if m is not None and "host_fraction" in m.gauges:
+                host_fraction = max(
+                    host_fraction, m.gauges["host_fraction"].value
+                )
         return {
             "status": hub.status_section(),
             "stage_latency": span_latency_summary(hub),
             "pipeline_overlap_efficiency": overlap,
+            "host_fraction": host_fraction,
             "window": hub.window_dict(last_n=8),
         }
 

@@ -127,5 +127,39 @@ class PipelinedResolver:
             self.apply(b)
 
 
+class EncodeStager:
+    """ISSUE 19: the zero-copy batch-encode staging ring.  Encode packs
+    the batch blob into a REUSABLE per-length staging buffer; the
+    dispatch await parks the actor while the next batch's encode may
+    rotate onto the same storage.  Holding one buffer view across that
+    await and deref'ing it after is exactly the staging-reuse hazard the
+    ring's depth rule (ring length > pipeline depth) exists to prevent —
+    the device owns the bytes once dispatch returns, the host must not
+    re-read them."""
+
+    def __init__(self):
+        self.staging = {}
+
+    def rotate(self, n):
+        self.staging[n] = bytearray(n)  # mutation evidence: ring rotates
+
+    async def hold_staging_across_dispatch(self, loop):
+        buf = self.staging[4096]
+        await loop.delay(1)  # dispatch await: the ring may rotate here
+        return buf[0]  # EXPECT: WAIT001
+
+    async def snapshot_blob_before_dispatch(self, loop):
+        blob = list(self.staging[4096])  # copy-out before suspending
+        await loop.delay(1)
+        return blob[0]  # clean: the copy is ours alone
+
+    async def reacquire_after_dispatch(self, loop):
+        buf = self.staging[4096]
+        buf[0] = 1
+        await loop.delay(1)
+        buf = self.staging[4096]  # next slot re-acquired post-await
+        return buf[0]  # clean: bound after the await
+
+
 def report(x):
     return x

@@ -101,6 +101,35 @@ def test_soak_same_seed_byte_identical():
     assert transition_logs_json(a) != transition_logs_json(c)
 
 
+def test_soak_coalesce_on_byte_identical_and_exact():
+    """ISSUE 19 at the soak level: with coalesced mirror folds on
+    ('auto' ties the fold window to the pipeline depth) the same seed
+    still produces a byte-identical report, and the workload-visible
+    outcome (committed/conflicted/too_old tallies) matches the
+    coalesce-off run exactly — coalescing is a cost model, never a
+    behavior change."""
+    import os
+
+    faults = [FaultEvent(at=1.5, kind="clog", duration=0.6)]
+    env = {"FDB_TPU_MIRROR_COALESCE": "auto", "FDB_TPU_PIPELINE_DEPTH": "2"}
+    old = {kk: os.environ.get(kk) for kk in env}
+    os.environ.update(env)
+    try:
+        a = run_soak(_short_cfg(7, faults=faults))
+        b = run_soak(_short_cfg(7, faults=faults))
+    finally:
+        for kk, vv in old.items():
+            if vv is None:
+                os.environ.pop(kk, None)
+            else:
+                os.environ[kk] = vv
+    off = run_soak(_short_cfg(7, faults=faults))
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+    picks = ("committed", "conflicted", "too_old")
+    assert {f: a["totals"][f] for f in picks} == \
+        {f: off["totals"][f] for f in picks}
+
+
 def test_soak_device_outage_degrades_throttles_recovers():
     """Mid-soak device outage via DeviceFaultInjector: the PR-3 breaker
     walks ok -> degraded -> probing -> ok, the ratekeeper contracts to

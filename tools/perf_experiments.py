@@ -36,6 +36,8 @@ Run: python tools/perf_experiments.py   (on the TPU host)
      -> TIMELINE.json Perfetto artifact + phase attribution, any host)
      python tools/perf_experiments.py --contention  (witness-guided vs
      blind retry Zipf A/B -> CONTENTION_AB.json, any host)
+     python tools/perf_experiments.py --hostpath  (serialized host-path
+     phase decomposition + coalesce A/B -> BENCH_r08.json, any host)
 """
 
 import json
@@ -54,7 +56,19 @@ import bench
 rng = np.random.default_rng(2024)
 depth = os.environ.get("FDB_TPU_PIPELINE_DEPTH")
 mc = os.environ.get("BENCH_MULTICHIP")
-if mc:
+hp = os.environ.get("BENCH_HOSTPATH")
+if hp:
+    # Serialized host-path decomposition (ISSUE 19): per-phase wall costs
+    # at the round-11 stream shape — not a throughput contender.
+    phases = bench._pipeline_phase_costs(rng, 30, 2500, %(h_cap)d)
+    total_ms = (phases["encode_ms_per_batch"]
+                + phases["device_step_ms_per_batch"]
+                + phases["mirror_apply_ms_per_batch"])
+    print("RESULT " + json.dumps({
+        "txns_per_sec": round(2500 * 1e3 / max(1e-9, total_ms), 1),
+        "hostpath": phases,
+    }))
+elif mc:
     # Mesh-sharded variant (ISSUE 15): the full shard-granular resolve
     # loop (per-shard clipping + mirrors + host min-combine).
     rate, info = bench.bench_multichip(rng, int(mc), h_cap=%(h_cap)d)
@@ -208,6 +222,51 @@ def main():
         else:
             artifact["tail"] = (res.stdout + res.stderr)[-800:]
         out_path = os.path.join(REPO, "CONTENTION_AB.json")
+        bench.atomic_write_json(out_path, artifact, indent=2,
+                                sort_keys=True)
+        print(json.dumps(artifact, indent=2, sort_keys=True))
+        print(f"wrote {out_path}", file=sys.stderr)
+        return
+    if "--hostpath" in sys.argv:
+        # Serialized host-path decomposition (ISSUE 19): per-phase wall
+        # costs (encode / device step / mirror apply) at the round-11
+        # stream shape, with and without coalesced mirror folds, plus the
+        # depth-1/2 full resolve loop — the before/after evidence for the
+        # columnar mirror + vectorized encode work.  Runs anywhere (CPU
+        # backend); the fresh subprocess keeps env flags and the process-
+        # global span hub out of the score.
+        env = dict(os.environ)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        env["PYTHONPATH"] = REPO
+        code = (
+            "import json, os, sys; sys.path.insert(0, %r)\n"
+            "import numpy as np\n"
+            "import bench\n"
+            "out = bench.bench_pipeline_cpu(depths=(1, 2))\n"
+            "os.environ['FDB_TPU_MIRROR_COALESCE'] = '2'\n"
+            "out['phases_serialized_coalesce2'] = "
+            "bench._pipeline_phase_costs(\n"
+            "    np.random.default_rng(2024), 30, 2500, 1 << 19)\n"
+            "print('RESULT ' + json.dumps(out))\n"
+        ) % REPO
+        res = subprocess.run(
+            [sys.executable, "-c", code],
+            env=env, cwd=REPO, capture_output=True, text=True, timeout=3600,
+        )
+        line = next(
+            (l for l in res.stdout.splitlines() if l.startswith("RESULT ")),
+            None,
+        )
+        artifact = {
+            "rc": res.returncode,
+            "ok": res.returncode == 0 and line is not None,
+            "arm": "hostpath_serialized",
+        }
+        if line is not None:
+            artifact.update(json.loads(line[len("RESULT "):]))
+        else:
+            artifact["tail"] = (res.stdout + res.stderr)[-800:]
+        out_path = os.path.join(REPO, "BENCH_r08.json")
         bench.atomic_write_json(out_path, artifact, indent=2,
                                 sort_keys=True)
         print(json.dumps(artifact, indent=2, sort_keys=True))
