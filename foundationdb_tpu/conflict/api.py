@@ -37,8 +37,10 @@ Usage mirrors the reference ABI:
 
 from __future__ import annotations
 
+from contextlib import nullcontext
 from typing import List, Optional
 
+from ..flow.hotpath import hot_path
 from ..flow.knobs import g_env, g_knobs
 from .device_faults import (
     DeviceCircuitBreaker,
@@ -48,6 +50,21 @@ from .device_faults import (
 from .engine_cpu import CpuConflictSet, FlatCpuConflictSet
 from .oracle import OracleConflictSet
 from .types import TransactionConflictInfo
+
+
+def _transfer_guard_ctx():
+    """Belt-and-braces half of FDB_TPU_TRANSFER_GUARD (ISSUE 20): arm
+    jax's own device->host guard over the dispatch call so REAL
+    accelerators also catch transfers on values the GuardedDeviceValue
+    proxies (flow/hotpath.py) do not wrap.  On the CPU backend jax's
+    guard never fires (device buffers alias host memory, zero-copy reads
+    are exempt) — the proxies carry the whole load there.  The engine's
+    sanctioned sync scopes open matching "allow" islands inside."""
+    if not g_env.get("FDB_TPU_TRANSFER_GUARD"):
+        return nullcontext()
+    import jax
+
+    return jax.transfer_guard_device_to_host("disallow")
 
 
 class ConflictBatch:
@@ -583,6 +600,7 @@ class ConflictSet:
             witness=self.last_witness,
         )
 
+    @hot_path(bound="batch")
     def _pipeline_dispatch(
         self, txns, now, new_oldest_version
     ) -> Optional[InflightBatch]:
@@ -608,7 +626,8 @@ class ConflictSet:
                 # before deciding).
                 assert not self._pipe, "rehydrating around parked batches"
                 self._rehydrate_from_mirror(snapshot, take_fresh)
-            ticket = self._jax.dispatch_txns(txns, now, new_oldest_version)
+            with _transfer_guard_ctx():
+                ticket = self._jax.dispatch_txns(txns, now, new_oldest_version)
         except DeviceFault as e:
             self._breaker.on_failure(e)
             self._device_stale = True
@@ -635,6 +654,7 @@ class ConflictSet:
         self._pipe.append(entry)
         return entry
 
+    @hot_path(bound="batch")
     def pipeline_complete_oldest(self) -> None:
         """Sync + retire the OLDEST in-flight batch: block until its
         device statuses are ready (later dispatches keep the device

@@ -74,6 +74,7 @@ from typing import List, Optional, Tuple
 
 import numpy as np
 
+from ..flow.hotpath import hot_path
 from . import keys as keylib
 from .engine_cpu_flat import (  # re-exported: the shared pieces
     FLOOR_VERSION,
@@ -396,6 +397,7 @@ class CpuConflictSet:
             _Chunk.from_cols(ek, va, pfx, self._kw, mx, mp)
         )
 
+    @hot_path(bound="const")
     def take_fresh_chunks(self):
         """(chunks created since the last take, complete) — the device's
         incremental-sync hint.  complete=False means the backlog
@@ -409,6 +411,7 @@ class CpuConflictSet:
         return fresh, not overflow
 
     # -- snapshots --
+    @hot_path(bound="const")
     def snapshot(self) -> MirrorSnapshot:
         """O(1): the chunk tuple is already immutable."""
         self._settle()
@@ -759,6 +762,7 @@ class CpuConflictSet:
                 witness[t] = (int(m[q]), ridx[q])
         return True
 
+    @hot_path(bound="chunks")
     def apply_batch(
         self,
         transactions: List[TransactionConflictInfo],
@@ -823,6 +827,7 @@ class CpuConflictSet:
                 return
         self._apply_intervals_py(begins, ends, now)
 
+    @hot_path(bound="chunks")
     def _apply_intervals_cols(
         self, begins: list, ends: list, be: np.ndarray, now: int
     ) -> None:
@@ -868,9 +873,9 @@ class CpuConflictSet:
         h2 = nk + 2 * n_int
         out_kept = np.arange(nk) + 2 * np.searchsorted(rbl, kept_idx, "right")
         out_b = np.searchsorted(kept_idx, lbl, "left") + 2 * np.arange(n_int)
-        ek2 = np.empty((h2, be.shape[1]), np.uint32)
-        va2 = np.empty(h2, np.int64)
-        pfx2 = np.empty(h2, np.uint64)
+        ek2 = np.empty((h2, be.shape[1]), np.uint32)  # perfcheck: ignore[HOT003]: becomes the rebuilt span's chunk columns (retained), so the staging ring cannot serve it
+        va2 = np.empty(h2, np.int64)  # perfcheck: ignore[HOT003]: retained as chunk columns, see ek2
+        pfx2 = np.empty(h2, np.uint64)  # perfcheck: ignore[HOT003]: retained as chunk columns, see ek2
         sk = kept_idx + g0
         ek2[out_kept] = ek_g[sk]
         va2[out_kept] = va_g[sk]

@@ -44,6 +44,7 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..flow.hotpath import GuardedDeviceValue, g_hostguard, hot_path
 from ..ops.rangequery import (
     build_max_table,
     build_min_table,
@@ -180,6 +181,7 @@ class PackedBatch:
         self.n_w = 0
 
     @classmethod
+    @hot_path(bound="batch")
     def from_transactions(
         cls,
         txns: List[TransactionConflictInfo],
@@ -2045,7 +2047,15 @@ class JaxConflictSet:
                    # since the last device sync — rehydrate_keys_encoded
                    # vs rehydrate_keys_total is the asserted evidence.
                    "rehydrate_keys_total", "rehydrate_keys_encoded",
-                   "mirror_sync_keys_encoded"):
+                   "mirror_sync_keys_encoded",
+                   # Host-budget telemetry (ISSUE 20): every deliberate
+                   # blocking device->host readback enters a
+                   # _sanctioned_sync scope (+1 host_syncs), and every
+                   # staging-ring miss in _staging_blob is a fresh
+                   # per-batch allocation (+1 host_allocs).  perf_smoke
+                   # gates both: <=K syncs per healthy pipelined batch,
+                   # zero allocs once the ring is warm.
+                   "host_syncs", "host_allocs"):
             self.metrics.counter(_c)  # pre-create: snapshots list them all
         if self.tiered:
             # Tier telemetry (only in tiered mode, so flat-mode snapshots
@@ -2089,6 +2099,12 @@ class JaxConflictSet:
         # resolver folds it (plus the ConflictSet's mirror_apply share)
         # into the host_fraction gauge.
         self.host_phase_seq = 0
+        # Transfer guard (ISSUE 20, HOT001's dynamic twin): when armed,
+        # dispatch_txns wraps the ticket's device fields in
+        # GuardedDeviceValue proxies that raise on any implicit host
+        # materialization outside a _sanctioned_sync scope.  Read once:
+        # tests re-construct the engine under g_env.override.
+        self._transfer_guard = bool(g_env.get("FDB_TPU_TRANSFER_GUARD"))
 
     # -- state management --
     def _init_state(self, oldest_rel: int):
@@ -2158,9 +2174,28 @@ class JaxConflictSet:
         if self.fault_injector is not None:
             self.fault_injector.check(site)
 
+    def _sanctioned_sync(self, op: str):
+        """One declared blocking device->host readback (ISSUE 20).
+
+        Every deliberate sync on the dispatch/sync path runs inside this
+        scope: it counts toward the host_syncs budget perf_smoke gates,
+        and — guard mode — it is the ONLY place GuardedDeviceValue
+        ticket fields may materialize host-side (plus, on real
+        accelerators, a jax.transfer_guard_device_to_host('allow')
+        island inside the dispatch window's 'disallow')."""
+        from contextlib import ExitStack
+
+        self.metrics.counter("host_syncs").add()
+        stack = ExitStack()
+        stack.enter_context(g_hostguard.allowed())
+        if self._transfer_guard:
+            stack.enter_context(jax.transfer_guard_device_to_host("allow"))
+        return stack
+
     def _maybe_grow_or_rebase(self, now: int, wr_cap: int):
         if now - self._base > REBASE_THRESHOLD:
-            d = int(self._oldest)
+            with self._sanctioned_sync("rebase oldest readback"):
+                d = int(self._oldest)
             if d > 0:
                 self._check_fault("rebase")
                 self.metrics.counter("rebases").add()
@@ -2181,7 +2216,8 @@ class JaxConflictSet:
             # Bound exhausted: sync the true count once (this is the only
             # device round-trip on the dispatch path) and grow if the REAL
             # count is near capacity.
-            self._hcount_bound = int(self._hcount)
+            with self._sanctioned_sync("hcount bound refresh"):
+                self._hcount_bound = int(self._hcount)
             if self._hcount_bound + 2 * wr_cap + 2 > self.h_cap:
                 self._grow(max(self.h_cap * 2, self.h_cap + 4 * wr_cap))
 
@@ -2203,7 +2239,8 @@ class JaxConflictSet:
         # count once and grow the delta if this batch still cannot fit
         # (the tiered analog of the flat path's hcount_bound sync+grow).
         if self._dcount_bound + add + 2 > self.d_cap:
-            self._dcount_bound = int(self._dcount)
+            with self._sanctioned_sync("dcount bound refresh"):
+                self._dcount_bound = int(self._dcount)
             if self._dcount_bound + add + 2 > self.d_cap:
                 self._grow_delta(
                     _next_pow2(self._dcount_bound + add + 2, self.d_cap * 2)
@@ -2221,8 +2258,9 @@ class JaxConflictSet:
             need = self._hcount_bound + self._dcount_bound + add + 2
             if need > self.h_cap:
                 # Sync the true counts once before paying a grow.
-                self._hcount_bound = int(self._hcount)
-                self._dcount_bound = int(self._dcount)
+                with self._sanctioned_sync("compaction bound refresh"):
+                    self._hcount_bound = int(self._hcount)
+                    self._dcount_bound = int(self._dcount)
                 need = self._hcount_bound + self._dcount_bound + add + 2
                 if need > self.h_cap:
                     self._grow(max(self.h_cap * 2, _next_pow2(need, self.h_cap)))
@@ -2243,9 +2281,9 @@ class JaxConflictSet:
             # rebuilds the table itself, so building one here from the
             # OLD versions would be a discarded device sync + O(H log H)
             # host pass in the middle of fault recovery.
-            self._maxtab = jnp.asarray(
-                _build_max_table_np(np.asarray(self._hvers))
-            )
+            with self._sanctioned_sync("grow maxtab rebuild"):
+                hvers_np = np.asarray(self._hvers)
+            self._maxtab = jnp.asarray(_build_max_table_np(hvers_np))
 
     def _grow_delta(self, new_cap: int):
         """Resize the delta tier (a batch's wr_cap exceeded what the
@@ -2286,6 +2324,7 @@ class JaxConflictSet:
         if sp.seq is not None and sp.end_seq is not None:
             self.host_phase_seq += sp.end_seq - sp.seq
 
+    @hot_path(bound="const")
     def _staging_blob(self, nwords: int) -> np.ndarray:
         """Reusable uint32 staging buffer for one blob length, rotated
         round-robin through a ring sized past the pipeline depth
@@ -2307,11 +2346,15 @@ class JaxConflictSet:
                 size = int(raw)
             size = self._blob_ring_size = max(0, size)
         if size == 0:
-            return np.empty((nwords,), np.uint32)
+            # Staging explicitly disabled: every blob is a fresh buffer,
+            # and host_allocs makes the cost visible to perf_smoke.
+            self.metrics.counter("host_allocs").add()
+            return np.empty((nwords,), np.uint32)  # perfcheck: ignore[HOT003]: FDB_TPU_ENCODE_STAGING=0 explicitly opts out of the ring; the fresh allocation is the requested behavior and is counted above
         ring = self._blob_ring.get(nwords)
         if ring is None:
+            self.metrics.counter("host_allocs").add(max(2, size))
             ring = self._blob_ring[nwords] = (
-                [np.empty((nwords,), np.uint32) for _ in range(max(2, size))],
+                [np.empty((nwords,), np.uint32) for _ in range(max(2, size))],  # perfcheck: ignore[HOT003]: one-time ring population per blob length; steady state hands these buffers out with zero allocation
                 [0],
             )
         bufs, pos = ring
@@ -2319,6 +2362,7 @@ class JaxConflictSet:
         pos[0] = (pos[0] + 1) % len(bufs)
         return buf
 
+    @hot_path(bound="batch")
     def _pack_blob(self, pb: PackedBatch, now: int, new_oldest_version: int,
                    do_evict: int = 1):
         """Single contiguous uint32 blob for one-copy dispatch (see
@@ -2571,6 +2615,16 @@ class JaxConflictSet:
             self._note_host_span(rsp)
 
     def _readback_packed(self, pb, statuses, undecided, now, new_oldest_version):
+        # THE declared sync point of the unpipelined path: every host
+        # materialization of this batch's device outputs happens inside
+        # this one sanctioned scope.
+        with self._sanctioned_sync("batch readback"):
+            return self._readback_packed_body(
+                pb, statuses, undecided, now, new_oldest_version
+            )
+
+    def _readback_packed_body(self, pb, statuses, undecided, now,
+                              new_oldest_version):
         self.last_iters = int(self._last_iters_dev)
         # The sync point: iters/undecided are host ints here, so surfacing
         # the while_loop carry and the true boundary count costs no extra
@@ -2611,6 +2665,7 @@ class JaxConflictSet:
         return statuses_np
 
     # -- pipelined dispatch (ISSUE 11) --
+    @hot_path(bound="batch")
     def dispatch_txns(
         self,
         transactions: List[TransactionConflictInfo],
@@ -2638,19 +2693,45 @@ class JaxConflictSet:
         # are donated into the next dispatch (reading them after a
         # successor dispatches would hit a deleted buffer); statuses/
         # undecided/iters are per-dispatch outputs, never re-donated.
+        iters = self._last_iters_dev
+        hcount = jnp.add(self._hcount, 0)
+        dcount = jnp.add(self._dcount, 0) if self.tiered else None
+        witness = self._last_witness_dev
+        if self._transfer_guard:
+            # Guard mode (ISSUE 20): the ticket's device fields raise on
+            # any implicit host materialization until a sanctioned sync
+            # scope reads them back — the HOT001 dynamic twin, and
+            # deterministic even on the CPU backend where
+            # jax.transfer_guard never fires (zero-copy reads).
+            statuses = GuardedDeviceValue(statuses, "DispatchTicket.statuses")
+            undecided = GuardedDeviceValue(
+                undecided, "DispatchTicket.undecided"
+            )
+            iters = GuardedDeviceValue(iters, "DispatchTicket.iters")
+            hcount = GuardedDeviceValue(hcount, "DispatchTicket.hcount")
+            if dcount is not None:
+                dcount = GuardedDeviceValue(dcount, "DispatchTicket.dcount")
+            if witness is not None:
+                w_ver, w_rng, w_base = witness
+                witness = (
+                    GuardedDeviceValue(w_ver, "DispatchTicket.witness[0]"),
+                    GuardedDeviceValue(w_rng, "DispatchTicket.witness[1]"),
+                    w_base,
+                )
         return DispatchTicket(
             pb=pb,
             statuses=statuses,
             undecided=undecided,
-            iters=self._last_iters_dev,
-            hcount=jnp.add(self._hcount, 0),
-            dcount=jnp.add(self._dcount, 0) if self.tiered else None,
+            iters=iters,
+            hcount=hcount,
+            dcount=dcount,
             d_cap=self.d_cap,
             now=now,
             new_oldest_version=new_oldest_version,
-            witness=self._last_witness_dev,
+            witness=witness,
         )
 
+    @hot_path(bound="batch")
     def sync_ticket(self, ticket: "DispatchTicket"):
         """Sync ONE in-flight dispatch: blocks until the ticket's program
         finished (not on later dispatches — its arrays are that program's
@@ -2668,11 +2749,16 @@ class JaxConflictSet:
 
         rsp = begin_span("readback", attrs={"n_txn": ticket.pb.n_txn})
         try:
-            return self._sync_ticket_body(ticket)
+            # THE declared sync point of the pipelined path: ticket
+            # device fields (GuardedDeviceValue in guard mode) may only
+            # materialize host-side inside this sanctioned scope.
+            with self._sanctioned_sync("ticket readback"):
+                return self._sync_ticket_body(ticket)
         finally:
             rsp.end()
             self._note_host_span(rsp)
 
+    @hot_path(bound="batch")
     def _sync_ticket_body(self, ticket: "DispatchTicket"):
         iters = int(ticket.iters)
         self.last_iters = iters
@@ -2730,7 +2816,10 @@ class JaxConflictSet:
         return out
 
     def _witness_host(self, pb: PackedBatch, statuses, w_ver, w_rng, base):
-        return decode_witness(pb, statuses, w_ver, w_rng, base)
+        # Witness decode is its own declared readback: w_ver/w_rng are
+        # the dispatch's device outputs (guarded in guard mode).
+        with self._sanctioned_sync("witness readback"):
+            return decode_witness(pb, statuses, w_ver, w_rng, base)
 
     # -- hybrid state exchange with the CPU mirror --
     def _chunk_encoding(self, ch):
@@ -2738,6 +2827,7 @@ class JaxConflictSet:
         resolver's per-shard mirrors, ISSUE 15)."""
         return chunk_encoding(ch, self.key_words)
 
+    @hot_path(bound="chunks")
     def note_synced(self, snap, fresh=None) -> None:
         """Record that this device state now equals MirrorSnapshot `snap`
         (called by ConflictSet after every successful device-served
@@ -2851,6 +2941,13 @@ class JaxConflictSet:
         minus eviction (export preserves current state)."""
         from .engine_cpu import FLOOR_VERSION
 
+        # store_to is a declared sync point (diagnostic / fault-recovery
+        # export): O(H) host decode, deliberately outside the hot set.
+        with self._sanctioned_sync("merged state export"):
+            return self._merged_host_state_body(FLOOR_VERSION)
+
+    def _merged_host_state_body(self, floor_version):
+        FLOOR_VERSION = floor_version
         n = int(self._hcount)
         bkeys_np = np.asarray(self._hkeys[:, :n]).T
         bvers_np = np.asarray(self._hvers[:n])

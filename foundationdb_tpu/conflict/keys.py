@@ -26,16 +26,26 @@ from typing import List, Sequence
 
 import numpy as np
 
+from ..flow.hotpath import hot_path
+
 # Sentinel "plus infinity" key (greater than any real key: real length word
 # is < 2**31 and the sentinel is the max uint32).
 INF_WORD = np.uint32(0xFFFFFFFF)
 
+# Host-budget telemetry (ISSUE 20): perf_smoke pins "encode re-does zero
+# per-key python at n>=64" against these — "perkey" counts keys that took
+# the per-key ljust path, "bulk_batches" counts vectorized bulk encodes.
+# Plain module counters (not the metrics registry): encode_keys is a free
+# function with no registry handle, and tests read deltas around a call.
+ENCODE_OPS = {"perkey": 0, "bulk_batches": 0}
 
+
+@hot_path(bound="batch")
 def encode_keys(keys: Sequence[bytes], key_words: int) -> np.ndarray:
     """[N, key_words+1] uint32; words most-significant-FIRST, length last."""
     width = key_words * 4
     n = len(keys)
-    out = np.zeros((n, key_words + 1), dtype=np.uint32)
+    out = np.zeros((n, key_words + 1), dtype=np.uint32)  # perfcheck: ignore[HOT003]: result is returned to and retained by the caller, so it cannot ride the staging ring
     if n == 0:
         return out
     lens = np.fromiter((len(k) for k in keys), np.int64, count=n)
@@ -50,9 +60,10 @@ def encode_keys(keys: Sequence[bytes], key_words: int) -> np.ndarray:
         # n ljust'ed copies (the per-key method-call path below) — the
         # batch-encode hot path (one call digitizes every endpoint of a
         # 2500-txn batch).
-        flat = np.frombuffer(b"".join(keys), np.uint8)
-        buf = np.zeros(n * width, np.uint8)
-        starts = np.zeros(n, np.int64)
+        ENCODE_OPS["bulk_batches"] += 1
+        flat = np.frombuffer(b"".join(keys), np.uint8)  # perfcheck: ignore[HOT003]: zero-copy view over the joined bytes, no buffer is allocated
+        buf = np.zeros(n * width, np.uint8)  # perfcheck: ignore[HOT003]: uint8 scatter scratch the uint32 blob ring cannot serve; one zeroed buffer replaces n per-key ljust copies
+        starts = np.zeros(n, np.int64)  # perfcheck: ignore[HOT003]: int64 cumsum scratch; the uint32 blob ring cannot serve it and zeroing seeds starts[0]
         np.cumsum(lens[:-1], out=starts[1:])
         pos = (
             np.arange(flat.size, dtype=np.int64)
@@ -63,9 +74,11 @@ def encode_keys(keys: Sequence[bytes], key_words: int) -> np.ndarray:
     else:
         joined = b"".join(k.ljust(width, b"\x00") for k in keys)
         words = (
+            # perfcheck: ignore[HOT003]: zero-copy view over the joined bytes; this n<64 branch is the small-batch path ENCODE_OPS["perkey"] accounts for
             np.frombuffer(joined, dtype=">u4").reshape(n, key_words)
             .astype(np.uint32)
         )
+        ENCODE_OPS["perkey"] += n
     out[:, :key_words] = words
     out[:, key_words] = lens.astype(np.uint32)
     return out

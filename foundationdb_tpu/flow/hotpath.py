@@ -1,0 +1,175 @@
+"""Hot-path discipline: the runtime half of perfcheck (ISSUE 20).
+
+PR 19 drove the resolver's host fraction from ~0.24 to ~0.06 (columnar
+mirror apply + zero-copy batch encode), but nothing *enforced* those
+wins: one innocent ``np.asarray(device_array)`` inside the pipelined
+dispatch->sync window, or a per-row Python loop over mirror columns,
+silently regresses the overlap the kernels x pipeline x shards campaign
+depends on.  This module provides the two runtime pieces the static
+pass (tools/lint/hotpath.py) twins with:
+
+``@hot_path(bound=...)``
+    Declares a function part of the per-batch hot set with an explicit
+    complexity bound — ``"batch"`` (O(batch rows)), ``"chunks"``
+    (O(chunks touched since last sync), the Jiffy mirror contract) or
+    ``"const"`` (O(1), no data-dependent loops).  Zero runtime
+    overhead: the decorator tags the function and records it in a
+    registry; perfcheck's HOT002/HOT003/HOT004 check the declared bound
+    against loop/allocation facts statically.
+
+``GuardedDeviceValue`` / ``g_hostguard``
+    The dynamic twin of HOT001.  With FDB_TPU_TRANSFER_GUARD on, the
+    engine wraps every DispatchTicket device field in a proxy that
+    raises TransferGuardError on any implicit host materialization
+    (np.asarray / int() / float() / bool() / len() / iteration /
+    .item() / indexing) outside a sanctioned sync scope.  This is
+    deliberately NOT jax.transfer_guard: on the CPU backend device
+    buffers alias host memory and jax's guard never fires (zero-copy
+    reads are exempt), so sim runs would pass while TPU runs raise.
+    The proxy raises identically on every backend; the engine
+    ADDITIONALLY arms jax.transfer_guard_device_to_host around the
+    dispatch window so real accelerators catch transfers on values the
+    proxy does not wrap.
+"""
+
+from __future__ import annotations
+
+from contextlib import contextmanager
+from typing import Dict
+
+HOT_BOUNDS = ("batch", "chunks", "const")
+
+# "module.qualname" -> declared bound, for diagnostics and tests.  The
+# static pass does NOT import this (it matches the decorator by name in
+# the AST); the registry exists so runtime tooling can enumerate the
+# declared hot set.
+_REGISTRY: Dict[str, str] = {}
+
+
+def hot_path(bound: str = "batch"):
+    """Declare a per-batch hot-path function with an explicit bound.
+
+    bound="batch":  work is O(rows of the batch being served)
+    bound="chunks": work is O(mirror chunks touched since last sync)
+    bound="const":  no data-dependent Python loops at all
+    """
+    if bound not in HOT_BOUNDS:
+        raise ValueError(
+            f"hot_path bound must be one of {HOT_BOUNDS}, got {bound!r}"
+        )
+
+    def mark(fn):
+        fn.__hot_path_bound__ = bound
+        _REGISTRY[f"{fn.__module__}.{fn.__qualname__}"] = bound
+        return fn
+
+    return mark
+
+
+def hot_registry() -> Dict[str, str]:
+    """Snapshot of the declared hot set ("module.qualname" -> bound)."""
+    return dict(_REGISTRY)
+
+
+class TransferGuardError(RuntimeError):
+    """An implicit device->host sync hit a guarded in-flight value."""
+
+
+class HostSyncGuard:
+    """Scope tracker for sanctioned device->host sync points.
+
+    Guarded values block host materialization unless the read happens
+    inside an ``allowed()`` scope — the engine enters one at each
+    declared sync point (sync_ticket / store_to / the breaker's mirror
+    replay path), which is exactly the HOT001 sanction set.  Reentrant;
+    the simulator is single-threaded so a depth counter suffices."""
+
+    def __init__(self):
+        self._allow_depth = 0
+
+    def blocking(self) -> bool:
+        return self._allow_depth == 0
+
+    @contextmanager
+    def allowed(self):
+        self._allow_depth += 1
+        try:
+            yield
+        finally:
+            self._allow_depth -= 1
+
+
+g_hostguard = HostSyncGuard()
+
+
+class GuardedDeviceValue:
+    """Proxy around an in-flight device value (a DispatchTicket field).
+
+    Any implicit host materialization outside a sanctioned sync scope
+    raises TransferGuardError — the sim-deterministic analog of
+    jax.transfer_guard("disallow") over the dispatch->sync window.
+    Reads inside a sanctioned scope delegate to the wrapped value, so
+    the declared sync points behave byte-identically with the guard on
+    or off (the guard only ever raises or is a no-op)."""
+
+    __slots__ = ("_v", "_label")
+
+    def __init__(self, v, label: str):
+        self._v = v
+        self._label = label
+
+    def unwrap(self):
+        """The wrapped device value, without a guard check (for code
+        that forwards the value WITHOUT materializing it host-side)."""
+        return self._v
+
+    def _read(self, op: str):
+        if g_hostguard.blocking():
+            raise TransferGuardError(
+                f"implicit device->host sync: {op} on in-flight "
+                f"{self._label} outside a sanctioned sync point "
+                "(sync_ticket / store_to / breaker replay).  This is "
+                "HOT001's dynamic twin (FDB_TPU_TRANSFER_GUARD): a "
+                "hidden sync here blocks the host inside the pipelined "
+                "dispatch->sync window and kills pipeline overlap."
+            )
+        return self._v
+
+    # -- implicit host materializations ---------------------------------
+    def __array__(self, dtype=None, copy=None):
+        import numpy as np
+
+        a = np.asarray(self._read(f"np.asarray({self._label})"))
+        if dtype is not None:
+            a = a.astype(dtype, copy=False)
+        return a
+
+    def __int__(self):
+        return int(self._read(f"int({self._label})"))
+
+    def __float__(self):
+        return float(self._read(f"float({self._label})"))
+
+    def __bool__(self):
+        return bool(self._read(f"bool({self._label})"))
+
+    def __index__(self):
+        return int(self._read(f"index({self._label})"))
+
+    def __len__(self):
+        return len(self._read(f"len({self._label})"))
+
+    def __iter__(self):
+        return iter(self._read(f"iteration over {self._label}"))
+
+    def __getitem__(self, idx):
+        return self._read(f"indexing {self._label}")[idx]
+
+    def item(self):
+        return self._read(f"{self._label}.item()").item()
+
+    def tolist(self):
+        return self._read(f"{self._label}.tolist()").tolist()
+
+    def __repr__(self):
+        return f"GuardedDeviceValue({self._label})"

@@ -475,6 +475,19 @@ g_env.declare("FDB_TPU_PIPELINE_DEPTH", "2",
                    "encodes batch N+1.  Verdict streams are bit-identical "
                    "across depths (the device history advances in commit "
                    "order either way; only host-side work is deferred)")
+g_env.declare("FDB_TPU_TRANSFER_GUARD", "",
+              help="truthy: arm the dispatch->sync transfer guard "
+                   "(HOT001's dynamic twin, ISSUE 20).  DispatchTicket "
+                   "device fields are wrapped in GuardedDeviceValue "
+                   "proxies (flow/hotpath.py) that raise "
+                   "TransferGuardError on any implicit device->host "
+                   "materialization outside the sanctioned sync points "
+                   "(sync_ticket / store_to / breaker replay), and the "
+                   "pipelined dispatch additionally runs under "
+                   "jax.transfer_guard_device_to_host('disallow') for "
+                   "real accelerators.  The guard only ever raises or "
+                   "is a no-op, so same-seed replay is byte-identical "
+                   "with it on")
 g_env.declare("FDB_TPU_PROGRAM_COSTS", "",
               help="truthy: device_metrics()/status tpu eagerly compile "
                    "+ cost-account every DEVICE_ENTRY_POINTS program "

@@ -78,6 +78,7 @@ except ImportError:  # pre-0.5 releases export it under experimental only;
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..conflict import keys as keylib
+from ..flow.hotpath import hot_path
 from ..conflict.device_faults import DeviceCircuitBreaker, DeviceFault
 from ..conflict.engine_cpu import (
     CpuConflictSet,
@@ -830,6 +831,7 @@ class ShardedJaxConflictSet:
         """[(lo, hi_or_None)] per shard — the one definition."""
         return list(zip([b""] + self.split_keys, self.split_keys + [None]))
 
+    @hot_path(bound="batch")
     def _clip_txns_for(self, txns, s: int, with_read_map: bool = False):
         """This shard's view of the batch: every range clipped to
         [lo_s, hi_s), empty clips dropped (the host twin of the device
@@ -867,6 +869,7 @@ class ShardedJaxConflictSet:
             return out, rmap
         return out
 
+    @hot_path(bound="batch")
     def _committed_writes_per_shard(self, txns, rows, shards):
         """Per-shard clipped COMMITTED write ranges, judged by each
         shard's LOCAL verdict row (ref: each resolver commits on its
@@ -886,7 +889,7 @@ class ShardedJaxConflictSet:
                     continue
                 s0 = bisect_right(split, b)
                 s1 = bisect_left(split, e)
-                for s in range(s0, min(s1, last) + 1):
+                for s in range(s0, min(s1, last) + 1):  # perfcheck: ignore[HOT004]: iterates spanned SHARDS (bounded by the mesh, not rows); each reads one verdict scalar
                     lst = per.get(s)
                     if lst is None or int(rows[s][i]) != COMMITTED:
                         continue
@@ -910,6 +913,7 @@ class ShardedJaxConflictSet:
             txn, [COMMITTED] if ranges else [], now, new_oldest_version
         )
 
+    @hot_path(bound="chunks")
     def _note_synced_shard(self, s: int) -> None:
         """Record that shard s's device slice now equals its mirror,
         pre-encoding chunks created this batch (the mirror's

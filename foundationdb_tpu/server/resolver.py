@@ -28,6 +28,7 @@ from typing import Dict
 from ..conflict.api import ConflictSet
 from ..conflict.types import COMMITTED
 from ..flow.asyncvar import NotifiedVersion
+from ..flow.hotpath import hot_path
 from ..flow.knobs import g_knobs
 from ..rpc.network import SimProcess
 from ..rpc.stream import RequestStream
@@ -658,6 +659,7 @@ class Resolver:
         wit = list(getattr(engine, "last_witness", []) or [])
         return wit if len(wit) == n else []
 
+    @hot_path(bound="batch")
     def _complete_resolve(
         self, req, reply, statuses, degraded: bool, first_unseen: int,
         t_enter: float, span=None, witness=None,

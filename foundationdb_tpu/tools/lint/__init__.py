@@ -17,8 +17,19 @@ model:
   det101.py     DET101 — interprocedural determinism taint
   promises.py   PRM001-004/TSK001 — promise lifecycle + wait-graph
                 deadlock analysis (hangcheck; ISSUE 13)
+  races.py      RACE001-004/ENV002 — await-window atomicity (racecheck;
+                PR 16)
+  jaxir.py      JXP001-005 — jaxpr/IR structural analysis of the device
+                entry points (jaxcheck; ISSUE 7)
+  hotpath.py    HOT001-004 — host-path performance discipline: sync
+                taint in the dispatch->sync window, declared loop
+                bounds, unstaged allocs, scalarization (perfcheck;
+                ISSUE 20)
   project.py    project loader, per-file AST/mtime cache, orchestration
   cli.py        text/json/SARIF output, --changed-only git mode
+  runner.py     unified multi-tool runner (``python -m
+                foundationdb_tpu.tools.lint``): one warm cache, per-tool
+                counts, merged SARIF, --pragma-inventory
 
 ``foundationdb_tpu/tools/fdblint.py`` stays as the CLI shim; the public
 API (lint_source/lint_package/main/RULES/...) is re-exported here so both

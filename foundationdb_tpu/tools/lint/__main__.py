@@ -1,0 +1,7 @@
+"""``python -m foundationdb_tpu.tools.lint`` -> the unified runner."""
+
+import sys
+
+from .runner import main
+
+sys.exit(main())

@@ -45,6 +45,14 @@ RULES: Dict[str, str] = {
     "RACE002": "check-then-act: guard on shared state evaluated before an await that the guarded action outlives",
     "RACE003": "two attrs co-written atomically elsewhere split across an await (torn invariant)",
     "RACE004": "attr written by >=2 actor functions with >=1 write await-separated from its read (multi-writer race)",
+    # HOT family (perfcheck, tools/lint/hotpath.py): host-path performance
+    # discipline.  Own pragma namespace (# perfcheck: ignore[...]), listed
+    # here so shared configs may allowlist them and --list-rules shows the
+    # full registry.
+    "HOT001": "implicit device->host sync on in-flight dispatch state outside a sanctioned sync point",
+    "HOT002": "python loop exceeds the function's declared @hot_path bound",
+    "HOT003": "unstaged per-batch numpy allocation in a @hot_path function (ride the FDB_TPU_ENCODE_STAGING ring)",
+    "HOT004": "per-row python scalarization (.tolist() / python-int indexing loop) in a @hot_path function",
     "PRG001": "fdblint ignore pragma carries no reason string",
     "PRG002": "fdblint ignore pragma suppresses nothing (stale)",
 }

@@ -31,10 +31,12 @@ def count_by_rule(findings: List[Finding]) -> Dict[str, Dict[str, int]]:
     return {r: out[r] for r in sorted(out)}
 
 
-# Always shown in the counts line, zero or not: a RACE/ENV002 count that
-# silently vanished from the tier-1 output is how a burned-down family
-# quietly regrows (the racecheck PR's explicit gate).
-_ALWAYS_COUNTED = ("ENV002", "RACE001", "RACE002", "RACE003", "RACE004")
+# Always shown in the counts line, zero or not: a RACE/ENV002/HOT count
+# that silently vanished from the tier-1 output is how a burned-down
+# family quietly regrows (the racecheck PR's explicit gate; ISSUE 20
+# extends it to perfcheck's HOT family).
+_ALWAYS_COUNTED = ("ENV002", "RACE001", "RACE002", "RACE003", "RACE004",
+                   "HOT001", "HOT002", "HOT003", "HOT004")
 
 
 def format_counts(findings: List[Finding]) -> str:
@@ -53,6 +55,7 @@ def format_counts(findings: List[Finding]) -> str:
 _TOOL_DOCS = {
     "fdblint": "README.md#determinism-rules-fdblint",
     "jaxcheck": "README.md#jaxpr-structural-rules-jaxcheck",
+    "perfcheck": "README.md#host-path-performance-rules-perfcheck",
 }
 
 

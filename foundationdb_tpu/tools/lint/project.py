@@ -34,6 +34,12 @@ from .base import (
 )
 from .det101 import run_det101
 from .graphs import CallGraph, ModuleSummary, collect_summary
+from .hotpath import (
+    HOT_RULES,
+    ModuleHotFacts,
+    collect_hotpath,
+    run_hotpath_rules,
+)
 from .local import ModuleLinter
 from .promises import (
     ModulePromiseFacts,
@@ -56,6 +62,8 @@ class FileRecord:
     summary: ModuleSummary
     facts: ModulePromiseFacts       # promise-lifecycle facts (PRM/TSK)
     races: ModuleRaceFacts          # atomicity/lost-update facts (RACE/ENV002)
+    hot: ModuleHotFacts             # host-path perf facts (HOT, perfcheck)
+    perf_pragmas: Dict[int, Pragma]  # the `# perfcheck:` namespace
 
 
 _FINGERPRINT: Optional[str] = None
@@ -177,8 +185,11 @@ class Project:
         pragmas = parse_pragmas(source)
         summary = collect_summary(relpath, tree, self.root_pkg)
         facts = collect_promise_facts(relpath, tree)
+        hot = collect_hotpath(relpath, tree)
+        perf_pragmas = parse_pragmas(source, tool="perfcheck")
         self.stats["parsed"] += 1
-        return FileRecord(sig, digest, findings, pragmas, summary, facts, races)
+        return FileRecord(sig, digest, findings, pragmas, summary, facts,
+                          races, hot, perf_pragmas)
 
     def load(self):
         cached = self._load_cache()
@@ -217,39 +228,63 @@ class Project:
             self._save_cache()
 
     # -- linting -----------------------------------------------------------
-    def lint(self) -> List[Finding]:
+    def lint(self, tools: Tuple[str, ...] = ("fdblint", "perfcheck")) -> List[Finding]:
+        """Run the selected source-level tools over one warm load.
+        `tools` may name "fdblint" (the determinism/actor/race families)
+        and/or "perfcheck" (the HOT family) — both share the cached
+        per-file facts and ONE CallGraph, but apply their own pragma
+        namespaces so neither polices the other's suppressions."""
         if not self.records:
             self.load()
         summaries = {rp: r.summary for rp, r in self.records.items()}
-        facts = {rp: r.facts for rp, r in self.records.items()}
-        pragmas_by_file = {rp: r.pragmas for rp, r in self.records.items()}
+        graph = CallGraph(summaries)  # ONE linker shared by every pass
+        run_fdb = "fdblint" in tools
+        run_perf = "perfcheck" in tools
         consumed: Dict[str, set] = {}
-        graph = CallGraph(summaries)  # ONE linker shared by both passes
-        det = run_det101(
-            summaries, pragmas_by_file, self.config,
-            consumed_pragmas=consumed, graph=graph,
-        )
-        det += run_promise_rules(summaries, facts, graph=graph)
-        races = {rp: r.races for rp, r in self.records.items()}
-        det += run_race_rules(summaries, races, graph=graph)
         det_by_file: Dict[str, List[Finding]] = {}
-        for f in det:
-            det_by_file.setdefault(f.path, []).append(f)
+        if run_fdb:
+            facts = {rp: r.facts for rp, r in self.records.items()}
+            pragmas_by_file = {rp: r.pragmas for rp, r in self.records.items()}
+            det = run_det101(
+                summaries, pragmas_by_file, self.config,
+                consumed_pragmas=consumed, graph=graph,
+            )
+            det += run_promise_rules(summaries, facts, graph=graph)
+            races = {rp: r.races for rp, r in self.records.items()}
+            det += run_race_rules(summaries, races, graph=graph)
+            for f in det:
+                det_by_file.setdefault(f.path, []).append(f)
+        perf_by_file: Dict[str, List[Finding]] = {}
+        if run_perf:
+            hot = {rp: r.hot for rp, r in self.records.items()}
+            for f in run_hotpath_rules(summaries, hot, self.config, graph=graph):
+                perf_by_file.setdefault(f.path, []).append(f)
         out: List[Finding] = []
         for rp, rec in sorted(self.records.items()):
             # Work on copies: cached records must stay pristine (pragma
             # `used` flags and suppression marks are per-run state).
-            findings = [copy.copy(f) for f in rec.raw_findings]
-            findings += [copy.copy(f) for f in det_by_file.get(rp, [])]
-            findings = [
-                f for f in findings if not self.config.allows(f.rule, rp)
-            ]
-            pragmas = {
-                ln: Pragma(p.line, set(p.rules), p.reason,
-                           used=ln in consumed.get(rp, ()))
-                for ln, p in rec.pragmas.items()
-            }
-            out.extend(apply_pragmas(findings, pragmas, rp))
+            if run_fdb:
+                findings = [copy.copy(f) for f in rec.raw_findings]
+                findings += [copy.copy(f) for f in det_by_file.get(rp, [])]
+                findings = [
+                    f for f in findings if not self.config.allows(f.rule, rp)
+                ]
+                pragmas = {
+                    ln: Pragma(p.line, set(p.rules), p.reason,
+                               used=ln in consumed.get(rp, ()))
+                    for ln, p in rec.pragmas.items()
+                }
+                out.extend(apply_pragmas(findings, pragmas, rp))
+            if run_perf:
+                pf = [copy.copy(f) for f in perf_by_file.get(rp, [])]
+                pf = [f for f in pf if not self.config.allows(f.rule, rp)]
+                perf_pragmas = {
+                    ln: Pragma(p.line, set(p.rules), p.reason)
+                    for ln, p in rec.perf_pragmas.items()
+                }
+                out.extend(
+                    apply_pragmas(pf, perf_pragmas, rp, rules=HOT_RULES)
+                )
         out.sort(key=lambda f: (f.path, f.line, f.rule))
         return out
 
@@ -262,6 +297,7 @@ class Project:
 def lint_source(
     source: str, relpath: str, config: Optional[LintConfig] = None,
     whole_project: bool = True,
+    tools: Tuple[str, ...] = ("fdblint", "perfcheck"),
 ) -> List[Finding]:
     """Lint one module's source with every per-file pass plus DET101
     restricted to the module's own call graph; findings suppressed by
@@ -277,31 +313,41 @@ def lint_source(
     if _match_any(relpath, SKIP_MODULE_GLOBS):
         return []
     tree = ast.parse(source, filename=relpath)
-    findings = ModuleLinter(relpath, tree).run()
-    findings += run_wait_rules(relpath, tree)
-    findings += run_rpy001(relpath, tree)
-    race_findings, races = collect_race(relpath, tree)
-    findings += race_findings
-    pragmas = parse_pragmas(source)
     summary = collect_summary(relpath, tree, None)
-    consumed: Dict[str, set] = {}
     graph = CallGraph({relpath: summary})
-    findings += run_det101(
-        {relpath: summary}, {relpath: pragmas}, config,
-        consumed_pragmas=consumed, graph=graph,
-    )
-    findings += run_promise_rules(
-        {relpath: summary}, {relpath: collect_promise_facts(relpath, tree)},
-        whole_project=whole_project, graph=graph,
-    )
-    findings += run_race_rules(
-        {relpath: summary}, {relpath: races},
-        whole_project=whole_project, graph=graph,
-    )
-    findings = [f for f in findings if not config.allows(f.rule, relpath)]
-    for ln in consumed.get(relpath, ()):
-        pragmas[ln].used = True
-    return apply_pragmas(findings, pragmas, relpath)
+    out: List[Finding] = []
+    if "fdblint" in tools:
+        findings = ModuleLinter(relpath, tree).run()
+        findings += run_wait_rules(relpath, tree)
+        findings += run_rpy001(relpath, tree)
+        race_findings, races = collect_race(relpath, tree)
+        findings += race_findings
+        pragmas = parse_pragmas(source)
+        consumed: Dict[str, set] = {}
+        findings += run_det101(
+            {relpath: summary}, {relpath: pragmas}, config,
+            consumed_pragmas=consumed, graph=graph,
+        )
+        findings += run_promise_rules(
+            {relpath: summary}, {relpath: collect_promise_facts(relpath, tree)},
+            whole_project=whole_project, graph=graph,
+        )
+        findings += run_race_rules(
+            {relpath: summary}, {relpath: races},
+            whole_project=whole_project, graph=graph,
+        )
+        findings = [f for f in findings if not config.allows(f.rule, relpath)]
+        for ln in consumed.get(relpath, ()):
+            pragmas[ln].used = True
+        out += apply_pragmas(findings, pragmas, relpath)
+    if "perfcheck" in tools:
+        hot = {relpath: collect_hotpath(relpath, tree)}
+        perf = run_hotpath_rules({relpath: summary}, hot, config, graph=graph)
+        perf = [f for f in perf if not config.allows(f.rule, relpath)]
+        perf_pragmas = parse_pragmas(source, tool="perfcheck")
+        out += apply_pragmas(perf, perf_pragmas, relpath, rules=HOT_RULES)
+    out.sort(key=lambda f: (f.path, f.line, f.rule))
+    return out
 
 
 def lint_file(

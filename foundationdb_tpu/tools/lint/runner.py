@@ -1,0 +1,199 @@
+"""Unified lint runner: fdblint + perfcheck + jaxcheck from one load.
+
+``python -m foundationdb_tpu.tools.lint --all`` is the ONE gate
+entrypoint (ISSUE 20): the source-level tools (fdblint's determinism/
+actor/race families and perfcheck's HOT family) share a single warm
+Project cache and CallGraph, jaxcheck traces the registered device
+entry points, and the output is per-tool/per-rule counts, one merged
+JSON doc, or ONE merged SARIF document with one run per tool —
+exactly what CI uploads as a single artifact.
+
+``--pragma-inventory`` lists every suppression across all three pragma
+namespaces as a canonical sorted JSON doc (file, line, tool, rules,
+reason) — the auditable registry of everything the repo has chosen to
+silence."""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+from .base import Finding, LintConfig, RULES, parse_pragmas
+from .cli import SARIF_SCHEMA, count_by_rule, format_counts, to_sarif
+from .hotpath import HOT_RULES
+from .project import Project, iter_py_files
+
+# Every pragma namespace the repo uses: tool marker -> rule universe.
+PRAGMA_TOOLS: Tuple[str, ...] = ("fdblint", "jaxcheck", "perfcheck")
+
+SOURCE_TOOLS = ("fdblint", "perfcheck")
+
+
+def _default_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def run_source_tools(
+    root: str,
+    config: LintConfig,
+    tools=SOURCE_TOOLS,
+    use_cache: bool = True,
+) -> Dict[str, List[Finding]]:
+    """fdblint and/or perfcheck findings per tool, from ONE warm load
+    (the Project caches per-file facts for both namespaces together)."""
+    proj = Project(root, config, use_cache=use_cache)
+    proj.load()
+    return {t: proj.lint(tools=(t,)) for t in tools if t in SOURCE_TOOLS}
+
+
+def run_jax_tool(config: LintConfig) -> List[Finding]:
+    """jaxcheck over the default device-entry registry (traces on CPU)."""
+    from .jaxir import _ensure_cpu, run_jaxcheck
+
+    _ensure_cpu()
+    return run_jaxcheck(config=config)
+
+
+def pragma_inventory(root: str) -> List[dict]:
+    """Every suppression in every namespace, canonically sorted: the
+    stale-pragma sweep reads this (a pragma that suppresses nothing is
+    ALSO a PRG002 finding, so the gate catches staleness; the inventory
+    is the human-auditable registry)."""
+    out: List[dict] = []
+    for path in iter_py_files(root):
+        relpath = os.path.relpath(path, root).replace(os.sep, "/")
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        for tool in PRAGMA_TOOLS:
+            for line, p in parse_pragmas(source, tool=tool).items():
+                out.append({
+                    "file": relpath,
+                    "line": line,
+                    "tool": tool,
+                    "rules": sorted(p.rules),
+                    "reason": p.reason,
+                })
+    out.sort(key=lambda d: (d["file"], d["line"], d["tool"]))
+    return out
+
+
+def merged_sarif(by_tool: Dict[str, List[Finding]],
+                 show_suppressed: bool) -> dict:
+    """ONE SARIF document, one run per tool (the merge CI uploads)."""
+    rule_sets = {"fdblint": RULES, "perfcheck": HOT_RULES}
+    runs = []
+    for tool, findings in by_tool.items():
+        if tool == "jaxcheck":
+            from .jaxir import JAX_RULES
+
+            rules = JAX_RULES
+        else:
+            rules = rule_sets.get(tool, RULES)
+        shown = (findings if show_suppressed
+                 else [f for f in findings if not f.suppressed])
+        runs.extend(to_sarif(shown, rules=rules, tool=tool)["runs"])
+    return {"$schema": SARIF_SCHEMA, "version": "2.1.0", "runs": runs}
+
+
+def format_tool_counts(by_tool: Dict[str, List[Finding]]) -> List[str]:
+    lines = []
+    for tool in sorted(by_tool):
+        findings = by_tool[tool]
+        n_un = sum(1 for f in findings if not f.suppressed)
+        n_sup = len(findings) - n_un
+        lines.append(
+            f"[{tool}] {n_un} finding(s), {n_sup} suppressed; "
+            + format_counts(findings)
+        )
+    return lines
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m foundationdb_tpu.tools.lint",
+        description="Unified lint gate: fdblint + perfcheck (+ jaxcheck "
+                    "with --all) from one warm cache, one merged report.",
+    )
+    ap.add_argument("root", nargs="?", default=None,
+                    help="package dir to lint (default: foundationdb_tpu)")
+    ap.add_argument("--all", action="store_true",
+                    help="also run jaxcheck (traces device entry points)")
+    ap.add_argument("--format", choices=("text", "json", "sarif"),
+                    default="text")
+    ap.add_argument("--config",
+                    help="JSON allowlist config to merge over defaults")
+    ap.add_argument("--show-suppressed", action="store_true")
+    ap.add_argument("--no-cache", dest="cache", action="store_false",
+                    default=True)
+    ap.add_argument("--pragma-inventory", action="store_true",
+                    help="print every suppression in every namespace as "
+                         "canonical sorted JSON and exit 0")
+    args = ap.parse_args(argv)
+
+    root = args.root or _default_root()
+
+    if args.pragma_inventory:
+        print(json.dumps(pragma_inventory(root), indent=2))
+        return 0
+
+    config = LintConfig.load(args.config) if args.config else LintConfig()
+
+    by_tool = run_source_tools(root, config, use_cache=args.cache)
+    if args.all:
+        by_tool["jaxcheck"] = run_jax_tool(config)
+
+    all_findings = [f for fs in by_tool.values() for f in fs]
+    unsuppressed = [f for f in all_findings if not f.suppressed]
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "tools": {
+                    tool: {
+                        "findings": [
+                            f.to_dict() for f in fs
+                            if args.show_suppressed or not f.suppressed
+                        ],
+                        "total": len(fs),
+                        "unsuppressed": sum(
+                            1 for f in fs if not f.suppressed),
+                        "counts": count_by_rule(fs),
+                    }
+                    for tool, fs in sorted(by_tool.items())
+                },
+                "total": len(all_findings),
+                "unsuppressed": len(unsuppressed),
+            },
+            indent=2,
+        ))
+    elif args.format == "sarif":
+        print(json.dumps(
+            merged_sarif(by_tool, args.show_suppressed), indent=2))
+    else:
+        for tool in sorted(by_tool):
+            for f in by_tool[tool]:
+                if f.suppressed and not args.show_suppressed:
+                    continue
+                tag = (" (suppressed: %s)" % f.reason
+                       if f.suppressed else "")
+                print(f"[{tool}] " + f.format() + tag)
+        for line in format_tool_counts(by_tool):
+            print(line, file=sys.stderr)
+        print(
+            f"lint: {len(unsuppressed)} finding(s), "
+            f"{len(all_findings) - len(unsuppressed)} suppressed across "
+            f"{len(by_tool)} tool(s)",
+            file=sys.stderr,
+        )
+    return 1 if unsuppressed else 0
+
+
+if __name__ == "__main__":  # pragma: no cover - exercised via __main__
+    sys.exit(main())

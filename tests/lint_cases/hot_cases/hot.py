@@ -1,0 +1,57 @@
+"""HOT002/HOT003/HOT004 corpus: declared-bound loops, unstaged
+allocations, and per-row scalarization in @hot_path functions."""
+
+import numpy as np
+
+
+def hot_path(bound="batch"):
+    # Local stub: the static pass matches the DECORATOR NAME (it never
+    # imports the runtime module), exactly like production code that
+    # guards the import.
+    def deco(fn):
+        return fn
+    return deco
+
+
+@hot_path(bound="batch")
+def apply_rows(span):
+    total = 0
+    for k in span.keys:  # EXPECT: HOT002
+        total += 1
+    return total
+
+
+@hot_path(bound="chunks")
+def apply_chunks(span):
+    touched = 0
+    for c in span.chunks:  # chunk iteration is the declared bound: clean
+        touched += 1
+    return touched
+
+
+@hot_path(bound="const")
+def probe(span):
+    for c in span.chunks:  # EXPECT: HOT002
+        pass
+    for _ in (1, 2, 3):  # literal iteration is O(1): clean
+        pass
+
+
+def undecorated(span):
+    # No declared bound: HOT002 does not police undecorated functions.
+    for k in span.keys:
+        pass
+
+
+@hot_path(bound="batch")
+def build(n):
+    return np.zeros(n, np.uint8)  # EXPECT: HOT003
+
+
+@hot_path(bound="batch")
+def scalarize(vals, rows):
+    out = vals.tolist()  # EXPECT: HOT004
+    acc = 0
+    for i in range(len(rows)):  # EXPECT: HOT004
+        acc += rows[i]
+    return out, acc

@@ -1,0 +1,26 @@
+"""Pragma corpus for the HOT family: one reasoned suppression (appears
+suppressed, not unsuppressed), one reasonless pragma (PRG001), and one
+stale pragma that suppresses nothing (PRG002)."""
+
+import numpy as np
+
+
+def hot_path(bound="batch"):
+    def deco(fn):
+        return fn
+    return deco
+
+
+@hot_path(bound="batch")
+def staged(n):
+    return np.empty(n, np.uint32)  # perfcheck: ignore[HOT003]: retained output buffer returned to the caller; the staging ring cannot serve it
+
+
+@hot_path(bound="batch")
+def reasonless(vals):
+    return vals.tolist()  # perfcheck: ignore[HOT004]  # EXPECT: PRG001
+
+
+@hot_path(bound="batch")
+def stale(n):
+    return n + 1  # perfcheck: ignore[HOT001]: stale — nothing here syncs device state  # EXPECT: PRG002

@@ -1,0 +1,32 @@
+"""HOT001 corpus: implicit device->host syncs on in-flight dispatch
+state, inside and outside the depth-2 dispatch->sync window."""
+
+import numpy as np
+
+
+class Engine:
+    def dispatch_txns(self, txns, now, new_oldest_version):
+        return txns
+
+    def sync_ticket(self, ticket):
+        # Sanctioned sync point: blocking readbacks are this function's
+        # whole job, so the int() below must NOT flag.
+        return int(ticket.iters)
+
+
+def _peek_status(ticket):
+    # Depth 2: reached from drive() through the CallGraph — the finding
+    # must name the drive -> _peek_status chain.
+    return np.asarray(ticket.statuses)  # EXPECT: HOT001
+
+
+def drive(engine, txns):
+    ticket = engine.dispatch_txns(txns, 0, 0)
+    n = int(ticket.hcount)  # EXPECT: HOT001
+    flags = _peek_status(ticket)
+    return engine.sync_ticket(ticket), n, flags
+
+
+def tally(counts):
+    # Untainted int()/len(): no dispatch state involved, must not flag.
+    return int(counts.sum()) + len(counts)

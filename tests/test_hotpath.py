@@ -1,0 +1,252 @@
+"""perfcheck's runtime twin (ISSUE 20): @hot_path declarations, the
+FDB_TPU_TRANSFER_GUARD dynamic guard, and the static<->dynamic
+acceptance pair.
+
+The headline acceptance: a planted implicit device->host sync inside
+the depth-2 dispatch->sync window is caught BOTH statically (HOT001
+names the taint chain through the CallGraph) AND dynamically (a
+guard-on run raises TransferGuardError at the offending read), while a
+same-seed replay with the guard armed is byte-identical to the guard-
+off run — the guard only ever raises or is a no-op.
+
+Shape discipline (1-core CI host): key_words=3 + bucket_mins=(32, 128,
+64) + h_cap=1<<10 — the same static shapes test_resolver_pipeline
+compiles, so this module's marginal compile cost in a full run is near
+zero.
+
+Run alone: pytest -m perfcheck
+"""
+
+import numpy as np
+import pytest
+
+from foundationdb_tpu.conflict.api import ConflictSet
+from foundationdb_tpu.conflict.engine_cpu import CpuConflictSet
+from foundationdb_tpu.conflict.types import TransactionConflictInfo as T
+from foundationdb_tpu.flow import DeterministicRandom, set_event_loop
+from foundationdb_tpu.flow.hotpath import (
+    HOT_BOUNDS,
+    GuardedDeviceValue,
+    TransferGuardError,
+    g_hostguard,
+    hot_path,
+    hot_registry,
+)
+from foundationdb_tpu.tools.fdblint import lint_source
+
+pytestmark = pytest.mark.perfcheck
+
+WINDOW = 40
+
+
+@pytest.fixture(autouse=True)
+def _clean_loop():
+    yield
+    set_event_loop(None)
+
+
+def k(i: int) -> bytes:
+    return b"%08d" % i
+
+
+def _random_stream(seed, keyspace, batches, txns_per_batch, snap_lag=25):
+    rng = DeterministicRandom(seed)
+    version = 10
+    out = []
+    for _ in range(batches):
+        txns = []
+        for _ in range(rng.random_int(1, txns_per_batch + 1)):
+            tr = T(read_snapshot=max(0, version - rng.random_int(0, snap_lag)))
+            for _ in range(rng.random_int(0, 4)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 8))
+                tr.read_ranges.append((k(a), k(b)))
+            for _ in range(rng.random_int(0, 3)):
+                a = rng.random_int(0, keyspace)
+                b = a + 1 + rng.random_int(0, max(1, keyspace // 8))
+                tr.write_ranges.append((k(a), k(b)))
+            txns.append(tr)
+        version += rng.random_int(1, 10)
+        out.append((txns, version, max(0, version - WINDOW)))
+    return out
+
+
+def _device_set(monkeypatch, depth, guard=False):
+    monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", str(depth))
+    if guard:
+        monkeypatch.setenv("FDB_TPU_TRANSFER_GUARD", "1")
+    else:
+        monkeypatch.delenv("FDB_TPU_TRANSFER_GUARD", raising=False)
+    return ConflictSet(backend="jax", key_words=3,
+                       bucket_mins=(32, 128, 64), h_cap=1 << 10)
+
+
+def _drive_pipelined(cs, stream, depth):
+    entries = []
+    for txns, now, nov in stream:
+        entries.append(cs.pipeline_submit(txns, now, nov))
+        while cs.pipeline_inflight > depth - 1:
+            cs.pipeline_complete_oldest()
+    cs.pipeline_drain()
+    assert all(e.done for e in entries)
+    return [e.statuses for e in entries]
+
+
+def _exported_state(cs):
+    mirror = (list(cs._cpu.keys), list(cs._cpu.vers), cs._cpu.oldest_version)
+    export = CpuConflictSet()
+    cs._jax.store_to(export)
+    return mirror, (list(export.keys), list(export.vers),
+                    export.oldest_version)
+
+
+# ---------------------------------------------------------------------------
+# @hot_path declarations
+# ---------------------------------------------------------------------------
+
+
+def test_hot_path_registers_and_validates_bounds():
+    @hot_path(bound="chunks")
+    def _probe_fn():
+        return 1
+
+    assert _probe_fn() == 1  # the decorator is a pure tag
+    assert _probe_fn.__hot_path_bound__ == "chunks"
+    reg = hot_registry()
+    assert reg[f"{_probe_fn.__module__}.{_probe_fn.__qualname__}"] == "chunks"
+    assert set(reg.values()) <= set(HOT_BOUNDS)
+    with pytest.raises(ValueError):
+        hot_path(bound="rows")
+
+
+def test_hot_registry_covers_the_engine_hot_set():
+    # Importing the conflict stack registers the per-batch hot set; the
+    # declared bounds are what perfcheck's HOT002 statically polices.
+    # (api loads engine_jax lazily at first device construction.)
+    import foundationdb_tpu.conflict.engine_jax  # noqa: F401
+
+    reg = hot_registry()
+    want = {
+        "foundationdb_tpu.conflict.engine_jax.JaxConflictSet.dispatch_txns":
+            "batch",
+        "foundationdb_tpu.conflict.engine_jax.JaxConflictSet.sync_ticket":
+            "batch",
+        "foundationdb_tpu.conflict.engine_jax.JaxConflictSet.note_synced":
+            "chunks",
+        "foundationdb_tpu.conflict.keys.encode_keys": "batch",
+        "foundationdb_tpu.conflict.engine_cpu.CpuConflictSet.apply_batch":
+            "chunks",
+        "foundationdb_tpu.conflict.api.ConflictSet._pipeline_dispatch":
+            "batch",
+    }
+    for qual, bound in want.items():
+        assert reg.get(qual) == bound, (qual, reg.get(qual))
+
+
+# ---------------------------------------------------------------------------
+# GuardedDeviceValue semantics
+# ---------------------------------------------------------------------------
+
+
+def test_guarded_value_raises_on_implicit_materialization():
+    g = GuardedDeviceValue(np.arange(4), "DispatchTicket.statuses")
+    for op in (lambda: int(g[0] if False else g),  # __int__ via int()
+               lambda: float(g),
+               lambda: bool(g),
+               lambda: len(g),
+               lambda: list(g),
+               lambda: g[0],
+               lambda: g.item(),
+               lambda: g.tolist(),
+               lambda: np.asarray(g)):
+        with pytest.raises(TransferGuardError) as ei:
+            op()
+        assert "sanctioned sync point" in str(ei.value)
+    # Forwarding without materializing is always allowed.
+    assert g.unwrap() is not None and "statuses" in repr(g)
+
+
+def test_guarded_value_delegates_inside_sanctioned_scope():
+    g = GuardedDeviceValue(np.arange(4), "DispatchTicket.iters")
+    with g_hostguard.allowed():
+        assert not g_hostguard.blocking()
+        assert np.asarray(g).sum() == 6
+        assert list(g) == [0, 1, 2, 3]
+        assert len(g) == 4
+        # Reentrant: nested sanctioned scopes unwind correctly.
+        with g_hostguard.allowed():
+            assert g.tolist() == [0, 1, 2, 3]
+        assert not g_hostguard.blocking()
+    assert g_hostguard.blocking()
+    with pytest.raises(TransferGuardError):
+        np.asarray(g)
+
+
+# ---------------------------------------------------------------------------
+# The acceptance pair: one planted sync, caught statically AND dynamically
+# ---------------------------------------------------------------------------
+
+# The planted violation, as source (for the static half): a helper two
+# frames below the dispatch call materializes an in-flight ticket field.
+_PLANTED = '''\
+import numpy as np
+
+
+def _peek(ticket):
+    return np.asarray(ticket.statuses)
+
+
+def drive(engine, txns):
+    ticket = engine.dispatch_txns(txns, 0, 0)
+    return _peek(ticket), engine.sync_ticket(ticket)
+'''
+
+
+@pytest.mark.lint
+def test_planted_sync_caught_statically_with_chain():
+    findings = [f for f in lint_source(_PLANTED, "window.py")
+                if f.rule == "HOT001" and not f.suppressed]
+    assert len(findings) == 1, findings
+    msg = findings[0].message
+    # The finding names the depth-2 dispatch->sync window chain.
+    assert "drive -> _peek" in msg, msg
+    assert "np.asarray()" in msg and "sanctioned sync point" in msg
+
+
+def test_planted_sync_caught_dynamically_by_transfer_guard(monkeypatch):
+    cs = _device_set(monkeypatch, depth=2, guard=True)
+    assert cs._jax._transfer_guard
+    stream = _random_stream(7, 60, 4, 8)
+    txns, now, nov = stream[0]
+    entry = cs.pipeline_submit(txns, now, nov)
+    assert cs.pipeline_inflight == 1 and not entry.done
+    # The planted consumer: peeking at the parked ticket's statuses
+    # inside the dispatch->sync window — exactly what HOT001 flags
+    # statically — raises loudly instead of silently serializing.
+    with pytest.raises(TransferGuardError) as ei:
+        np.asarray(entry.ticket.statuses)
+    assert "DispatchTicket.statuses" in str(ei.value)
+    with pytest.raises(TransferGuardError):
+        int(entry.ticket.hcount)
+    # The sanctioned path still completes the batch normally.
+    cs.pipeline_drain()
+    assert entry.done and cs.pipeline_inflight == 0
+
+
+def test_guard_on_replay_is_byte_identical(monkeypatch):
+    # Same-seed, depth-2 pipelined runs with the guard off vs on: the
+    # guard only ever raises or is a no-op, so verdicts AND exported
+    # device/mirror state match exactly.
+    stream = _random_stream(11, 60, 12, 8)
+    base = _device_set(monkeypatch, depth=2, guard=False)
+    want = _drive_pipelined(base, stream, 2)
+    want_state = _exported_state(base)
+
+    guarded = _device_set(monkeypatch, depth=2, guard=True)
+    got = _drive_pipelined(guarded, stream, 2)
+    assert got == want
+    assert _exported_state(guarded) == want_state
+    dm = guarded.device_metrics()
+    assert dm["counters"]["pipeline_dispatches"] == len(stream)
+    # Every completed batch entered its sanctioned sync scopes.
+    assert dm["counters"]["host_syncs"] >= len(stream)

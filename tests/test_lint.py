@@ -31,6 +31,7 @@ from foundationdb_tpu.tools.fdblint import (
     main,
     parse_pragmas,
 )
+from foundationdb_tpu.tools.lint import runner as lint_runner
 
 pytestmark = pytest.mark.lint
 
@@ -46,11 +47,15 @@ def rules_of(findings, suppressed=False):
 def package_findings():
     # One whole-package scan shared by the gate tests (walking + parsing
     # every module 3x over would triple the gate's cost for nothing).
-    findings = lint_package(PKG_DIR)
-    # Per-rule counts in the tier-1 output (bypassing capture on purpose:
-    # a rule whose finding count quietly drifts is how regressions hide).
-    print(f"\n[fdblint] {format_counts(findings)}", file=sys.__stderr__)
-    return findings
+    # Printed through the unified runner's per-tool formatting so the
+    # tier-1 output attributes every count to its tool (bypassing capture
+    # on purpose: a rule whose finding count quietly drifts is how
+    # regressions hide).
+    by_tool = lint_runner.run_source_tools(PKG_DIR, LintConfig())
+    print("", file=sys.__stderr__)
+    for line in lint_runner.format_tool_counts(by_tool):
+        print(line, file=sys.__stderr__)
+    return [f for fs in by_tool.values() for f in fs]
 
 
 # ---------------------------------------------------------------------------
@@ -1196,7 +1201,7 @@ def _expected_markers(case_dir):
 
 @pytest.mark.parametrize(
     "case", ["wait_rules", "rpy_cases", "det101_pkg", "env_cases",
-             "spn_cases", "prm_cases", "race_cases"]
+             "spn_cases", "prm_cases", "race_cases", "hot_cases"]
 )
 def test_golden_corpus(case, capsys):
     case_dir = os.path.join(CASES_DIR, case)
@@ -1339,9 +1344,11 @@ def test_per_rule_counts_surface(package_findings):
     # The RACE family + ENV002 surface in the counts line EVEN AT ZERO:
     # a burned-down family that silently vanished from the output is how
     # it quietly regrows.
-    for rule in ("RACE001", "RACE002", "RACE003", "RACE004", "ENV002"):
+    for rule in ("RACE001", "RACE002", "RACE003", "RACE004", "ENV002",
+                 "HOT001", "HOT002", "HOT003", "HOT004"):
         assert f"{rule}=" in text, text
     assert "RACE003=" in format_counts([])  # zero findings still shows it
+    assert "HOT001=" in format_counts([])  # the HOT family too (ISSUE 20)
 
 
 # ---------------------------------------------------------------------------
@@ -1456,5 +1463,96 @@ def test_changed_only_outside_git_falls_back_to_full_scan(tmp_path, capsys):
 
 def test_new_rules_registered_and_documented():
     for rule in ("WAIT001", "WAIT002", "DET101", "RPY001", "ENV001",
-                 "RACE001", "RACE002", "RACE003", "RACE004", "ENV002"):
+                 "RACE001", "RACE002", "RACE003", "RACE004", "ENV002",
+                 "HOT001", "HOT002", "HOT003", "HOT004"):
         assert rule in RULES and RULES[rule]
+
+
+# ---------------------------------------------------------------------------
+# Unified runner (python -m foundationdb_tpu.tools.lint): one warm cache,
+# merged SARIF, per-tool counts, pragma inventory (ISSUE 20 satellites)
+# ---------------------------------------------------------------------------
+
+
+def test_unified_runner_module_entrypoint():
+    proc = subprocess.run(
+        [sys.executable, "-m", "foundationdb_tpu.tools.lint", PKG_DIR],
+        capture_output=True,
+        text=True,
+        cwd=os.path.dirname(PKG_DIR),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    # Per-tool count lines, HOT family visible even at zero.
+    assert "[fdblint]" in proc.stderr and "[perfcheck]" in proc.stderr
+    assert "HOT001=" in proc.stderr
+
+
+def test_unified_runner_merged_sarif(capsys):
+    rc = lint_runner.main(
+        [PKG_DIR, "--format=sarif", "--show-suppressed"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    # ONE document, one run per tool — what CI uploads as one artifact.
+    assert out["version"] == "2.1.0"
+    names = [r["tool"]["driver"]["name"] for r in out["runs"]]
+    assert names == ["fdblint", "perfcheck"]
+    perf = out["runs"][1]
+    rule_ids = {r["id"] for r in perf["tool"]["driver"]["rules"]}
+    assert {"HOT001", "HOT002", "HOT003", "HOT004"} <= rule_ids
+    # The repo's reasoned HOT pragmas ride along as justified suppressions.
+    sup = [r for r in perf["results"] if r.get("suppressions")]
+    assert sup and all(
+        s["suppressions"][0]["justification"] for s in sup)
+
+
+def test_unified_runner_json_per_tool_counts(capsys):
+    rc = lint_runner.main([PKG_DIR, "--format=json"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert set(out["tools"]) == {"fdblint", "perfcheck"}
+    assert out["unsuppressed"] == 0
+    # PR 19's staging-ring pragmas are perfcheck suppressions.
+    assert out["tools"]["perfcheck"]["counts"]["HOT003"]["suppressed"] >= 1
+
+
+def test_unified_runner_flags_planted_hot_violation(tmp_path, capsys):
+    # The runner is a real gate: a planted HOT003 exits 1 and attributes
+    # the finding to perfcheck, while fdblint stays clean.
+    pkg = tmp_path / "pkg"
+    pkg.mkdir()
+    (pkg / "mod.py").write_text(
+        "import numpy as np\n\n\n"
+        "def hot_path(bound='batch'):\n"
+        "    def deco(fn):\n"
+        "        return fn\n"
+        "    return deco\n\n\n"
+        "@hot_path(bound='batch')\n"
+        "def build(n):\n"
+        "    return np.zeros(n, np.uint8)\n"
+    )
+    rc = lint_runner.main([str(pkg), "--format=json", "--no-cache"])
+    out = json.loads(capsys.readouterr().out)
+    assert rc == 1
+    assert out["tools"]["fdblint"]["unsuppressed"] == 0
+    perf = out["tools"]["perfcheck"]
+    assert [f["rule"] for f in perf["findings"]] == ["HOT003"]
+
+
+def test_pragma_inventory_canonical_and_reasoned(capsys):
+    rc = lint_runner.main([PKG_DIR, "--pragma-inventory"])
+    inv = json.loads(capsys.readouterr().out)
+    assert rc == 0
+    assert inv, "the package genuinely uses pragmas"
+    # Canonical: sorted by (file, line, tool), stable field set.
+    key = lambda e: (e["file"], e["line"], e["tool"])
+    assert inv == sorted(inv, key=key)
+    assert all(set(e) == {"file", "line", "tool", "rules", "reason"}
+               for e in inv)
+    # All three namespaces appear, and the stale-pragma sweep holds:
+    # every suppression in the repo carries a reason.
+    assert {e["tool"] for e in inv} == {"fdblint", "jaxcheck", "perfcheck"}
+    assert all(e["reason"].strip() for e in inv)
+    # Determinism: a second run byte-identical.
+    lint_runner.main([PKG_DIR, "--pragma-inventory"])
+    again = json.loads(capsys.readouterr().out)
+    assert again == inv

@@ -32,6 +32,7 @@ from foundationdb_tpu.conflict.engine_jax import (
     detect_core,
     detect_core_tiered,
 )
+from foundationdb_tpu.conflict.keys import ENCODE_OPS
 from foundationdb_tpu.conflict.types import TransactionConflictInfo as T
 from foundationdb_tpu.tools.lint.jaxir import WORK_PRIMS, walk_jaxpr
 
@@ -403,6 +404,91 @@ def test_program_cost_table_covers_every_entry_point():
     assert set(DEVICE_ENTRY_POINTS) <= set(dm["programs"])
     for blk in dm["programs"].values():
         assert "compile_wall_seconds" not in blk
+
+
+# ---------------------------------------------------------------------------
+# 4. host-budget counters: the PR-19 wins pinned as numbers (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+
+def _big_batch(base, ranges=40):
+    """One txn per side-heavy batch: `ranges` ranges per side puts every
+    encode_keys call (begin+end concatenated = 2*ranges keys) on the
+    n>=64 bulk path."""
+    t = T(read_snapshot=0)
+    for j in range(ranges):
+        t.read_ranges.append((k(base + 4 * j), k(base + 4 * j + 1)))
+        t.write_ranges.append((k(base + 4 * j + 2), k(base + 4 * j + 3)))
+    return [t]
+
+
+@pytest.mark.parametrize("mode", ["flat", "tiered", "kernels"])
+def test_bulk_encode_does_zero_per_key_python(monkeypatch, mode):
+    """The zero-copy batch-encode win as an op count: at n>=64 keys per
+    encode call, the per-key ljust path runs ZERO times — across the
+    flat, tiered, and kernels-interpret engines (the counter twin of
+    perfcheck's HOT004)."""
+    if mode == "tiered":
+        monkeypatch.setenv("FDB_TPU_HISTORY", "tiered")
+        monkeypatch.setenv("FDB_TPU_DELTA_CAP", "512")
+        monkeypatch.setenv("FDB_TPU_EVICT_EVERY", "3")
+    if mode == "kernels":
+        monkeypatch.setenv("FDB_TPU_KERNELS", "1")
+    cs = JaxConflictSet(key_words=3, h_cap=1 << 10,
+                        bucket_mins=(32, 128, 64))
+    assert cs.tiered is (mode == "tiered")
+    perkey0 = ENCODE_OPS["perkey"]
+    bulk0 = ENCODE_OPS["bulk_batches"]
+    v = 0
+    for i in range(4):
+        v += 5
+        cs.detect(_big_batch(10_000 * i), v, max(0, v - 40))
+    assert ENCODE_OPS["perkey"] == perkey0, (
+        "a side-heavy batch took the per-key ljust path"
+    )
+    # Both sides of every batch rode the vectorized bulk encode.
+    assert ENCODE_OPS["bulk_batches"] >= bulk0 + 8
+
+
+def test_pipelined_batch_host_sync_and_alloc_budget(monkeypatch):
+    """FDB_TPU_TRANSFER_GUARD's counter half: a healthy pipelined batch
+    enters at most 3 sanctioned sync scopes (ticket readback + witness
+    readback + occasional planning), and with the staging ring on
+    (default 'auto') steady-state encode allocates NOTHING — the blob
+    ring hands out the same buffers forever."""
+    from foundationdb_tpu.conflict.api import ConflictSet
+
+    monkeypatch.setenv("FDB_TPU_PIPELINE_DEPTH", "2")
+    cs = ConflictSet(backend="jax", key_words=3, h_cap=1 << 10,
+                     bucket_mins=(32, 128, 64))
+    m = cs._jax.metrics
+
+    def drive(i0, n):
+        v = 5 * i0
+        for i in range(i0, i0 + n):
+            v += 5
+            e = cs.pipeline_submit(_big_batch(10_000 * i), v, 0)
+            while cs.pipeline_inflight > 1:
+                cs.pipeline_complete_oldest()
+            assert e is not None
+        cs.pipeline_drain()
+
+    drive(0, 2)  # warmup: compiles + populates the staging ring
+    syncs0 = m.counter("host_syncs").value
+    allocs0 = m.counter("host_allocs").value
+    batches = 8
+    drive(2, batches)
+    syncs = m.counter("host_syncs").value - syncs0
+    allocs = m.counter("host_allocs").value - allocs0
+    assert syncs <= 3 * batches, (
+        f"{syncs} sanctioned syncs over {batches} healthy batches "
+        f"(budget 3/batch)"
+    )
+    assert allocs == 0, (
+        f"steady-state encode allocated {allocs} buffers past the "
+        f"staging ring"
+    )
+    assert m.counter("host_syncs").value > 0  # the scopes really count
 
 
 def test_host_and_device_max_tables_agree():

@@ -38,6 +38,9 @@ Run: python tools/perf_experiments.py   (on the TPU host)
      blind retry Zipf A/B -> CONTENTION_AB.json, any host)
      python tools/perf_experiments.py --hostpath  (serialized host-path
      phase decomposition + coalesce A/B -> BENCH_r08.json, any host)
+     python tools/perf_experiments.py --hostbudget  (perfcheck's host
+     budgets live: host_syncs/host_allocs per pipelined batch + the
+     per-key vs bulk encode split, any host)
 """
 
 import json
@@ -226,6 +229,61 @@ def main():
                                 sort_keys=True)
         print(json.dumps(artifact, indent=2, sort_keys=True))
         print(f"wrote {out_path}", file=sys.stderr)
+        return
+    if "--hostbudget" in sys.argv:
+        # Host-budget counters live (ISSUE 20): the numbers the perfcheck
+        # pass family polices, measured on a depth-2 pipelined run —
+        # sanctioned host_syncs per batch (gate: <= 3), staging-ring
+        # allocations at steady state (gate: 0), and the per-key vs bulk
+        # encode split (gate: zero per-key Python on the resolve path).
+        # Runs anywhere (CPU backend); the pins live in
+        # tests/test_perf_smoke.py, this arm prints them at bench shape.
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        import numpy as np
+
+        from foundationdb_tpu.conflict.api import ConflictSet
+        from foundationdb_tpu.conflict.keys import ENCODE_OPS
+
+        rng = np.random.default_rng(2024)
+        depth, warm, measured, per_batch = 2, 4, 12, 2500
+        os.environ["FDB_TPU_PIPELINE_DEPTH"] = str(depth)
+        cs = ConflictSet(backend="jax", key_words=bench.KEY_WORDS,
+                         h_cap=1 << 19)
+        streams = [
+            bench.txns_from_packed(
+                bench.gen_packed(rng, per_batch, i, bench.KEY_WORDS),
+                per_batch)
+            for i in range(warm + measured)
+        ]
+
+        def run_one(i):
+            cs.pipeline_submit(streams[i], i + bench.WINDOW, i)
+            while cs.pipeline_inflight > depth - 1:
+                cs.pipeline_complete_oldest()
+
+        for i in range(warm):
+            run_one(i)
+        cs.pipeline_drain()
+        c0 = dict(cs.device_metrics()["counters"])
+        e0 = dict(ENCODE_OPS)
+        for j in range(measured):
+            run_one(warm + j)
+        cs.pipeline_drain()
+        c1 = cs.device_metrics()["counters"]
+        e1 = dict(ENCODE_OPS)
+        print(json.dumps({
+            "batches": measured,
+            "host_syncs_per_batch":
+                (c1["host_syncs"] - c0["host_syncs"]) / measured,
+            "host_allocs_per_batch":
+                (c1["host_allocs"] - c0["host_allocs"]) / measured,
+            "encode_perkey_delta": e1["perkey"] - e0["perkey"],
+            "encode_bulk_batches_delta":
+                e1["bulk_batches"] - e0["bulk_batches"],
+            "gates": {"host_syncs_per_batch": "<= 3 (sanctioned scopes)",
+                      "host_allocs_per_batch": "== 0 (staging ring)",
+                      "encode_perkey_delta": "== 0 (bulk encode path)"},
+        }, indent=2, sort_keys=True))
         return
     if "--hostpath" in sys.argv:
         # Serialized host-path decomposition (ISSUE 19): per-phase wall
